@@ -1,0 +1,25 @@
+from . import dtypes
+from .vector import (
+    Column,
+    ColumnVector,
+    ColumnarBatch,
+    StringColumn,
+    batch_from_pydict,
+    batch_to_pydict,
+    choose_capacity,
+    column_from_numpy,
+    live_mask,
+)
+
+__all__ = [
+    "dtypes",
+    "Column",
+    "ColumnVector",
+    "ColumnarBatch",
+    "StringColumn",
+    "batch_from_pydict",
+    "batch_to_pydict",
+    "choose_capacity",
+    "column_from_numpy",
+    "live_mask",
+]

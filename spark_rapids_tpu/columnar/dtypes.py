@@ -1,0 +1,296 @@
+"""Logical SQL data types for the TPU columnar engine.
+
+This is the TPU-native analogue of the Spark<->cuDF type mapping that the
+reference implements in ``GpuColumnVector.java`` (``toRapidsOrNull``,
+sql-plugin/src/main/java/com/nvidia/spark/rapids/GpuColumnVector.java:360).
+Instead of mapping Spark Catalyst types onto cuDF native types, we map SQL
+logical types onto JAX/XLA physical dtypes:
+
+- integers/floats/bool map 1:1 onto jnp dtypes,
+- DATE is days-since-epoch int32, TIMESTAMP is micros-since-epoch int64
+  (matching Spark's internal representation),
+- DECIMAL(p<=18) is a scaled int64 (Spark's "long-backed" decimals); p>18
+  uses a two-limb int64 encoding (see decimal128 module),
+- STRING is not a single array: it lowers to (offsets:int32[n+1], bytes:uint8)
+  pairs handled by the string columns in vector.py.
+
+Everything here is static/host-side metadata: inside ``jax.jit`` only the
+physical jnp dtypes exist.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class DType:
+    """Base class for logical SQL types."""
+
+    #: jnp dtype of the primary physical buffer (None for nested/string).
+    physical: Any = None
+    #: Spark SQL name, used by Explain/TypeSig docs.
+    sql_name: str = "?"
+
+    def __repr__(self) -> str:
+        return self.sql_name
+
+    def __eq__(self, other: object) -> bool:
+        return type(self) is type(other) and dataclasses.asdict(self) == dataclasses.asdict(other) \
+            if dataclasses.is_dataclass(self) else type(self) is type(other)
+
+    def __hash__(self) -> int:
+        return hash(repr(self))
+
+    @property
+    def is_numeric(self) -> bool:
+        return False
+
+    @property
+    def is_integral(self) -> bool:
+        return False
+
+    @property
+    def is_floating(self) -> bool:
+        return False
+
+    @property
+    def is_nested(self) -> bool:
+        return False
+
+
+class BooleanType(DType):
+    physical = jnp.bool_
+    sql_name = "boolean"
+
+
+class _IntegralType(DType):
+    @property
+    def is_numeric(self) -> bool:
+        return True
+
+    @property
+    def is_integral(self) -> bool:
+        return True
+
+
+class ByteType(_IntegralType):
+    physical = jnp.int8
+    sql_name = "tinyint"
+
+
+class ShortType(_IntegralType):
+    physical = jnp.int16
+    sql_name = "smallint"
+
+
+class IntegerType(_IntegralType):
+    physical = jnp.int32
+    sql_name = "int"
+
+
+class LongType(_IntegralType):
+    physical = jnp.int64
+    sql_name = "bigint"
+
+
+class _FloatingType(DType):
+    @property
+    def is_numeric(self) -> bool:
+        return True
+
+    @property
+    def is_floating(self) -> bool:
+        return True
+
+
+class FloatType(_FloatingType):
+    physical = jnp.float32
+    sql_name = "float"
+
+
+class DoubleType(_FloatingType):
+    physical = jnp.float64
+    sql_name = "double"
+
+
+class StringType(DType):
+    physical = None  # offsets+bytes pair; see StringColumn
+    sql_name = "string"
+
+
+class DateType(DType):
+    """Days since unix epoch, int32 — Spark's internal DateType layout."""
+
+    physical = jnp.int32
+    sql_name = "date"
+
+
+class TimestampType(DType):
+    """Microseconds since unix epoch (UTC), int64 — Spark's internal layout."""
+
+    physical = jnp.int64
+    sql_name = "timestamp"
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class DecimalType(DType):
+    """Fixed-point decimal.
+
+    precision<=18 is a scaled int64 ("long-backed", like Spark's internal
+    Decimal with ``changePrecision``); larger precisions use the two-limb
+    int128 emulation in ``decimal128.py`` (the reference leans on cuDF's
+    native DECIMAL128 columns, e.g. GpuCast.scala / decimalExpressions).
+    """
+
+    precision: int = 10
+    scale: int = 0
+
+    MAX_PRECISION = 38
+    MAX_LONG_PRECISION = 18
+
+    def __post_init__(self):
+        object.__setattr__(self, "sql_name", f"decimal({self.precision},{self.scale})")
+
+    @property
+    def physical(self):  # type: ignore[override]
+        return jnp.int64
+
+    @property
+    def is_numeric(self) -> bool:
+        return True
+
+    @property
+    def is_wide(self) -> bool:
+        return self.precision > self.MAX_LONG_PRECISION
+
+
+class NullType(DType):
+    physical = jnp.bool_
+    sql_name = "void"
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class ArrayType(DType):
+    """List column: offsets + child column (cuDF LIST layout)."""
+
+    element_type: DType = None  # type: ignore[assignment]
+    contains_null: bool = True
+
+    def __post_init__(self):
+        object.__setattr__(self, "sql_name", f"array<{self.element_type.sql_name}>")
+
+    @property
+    def is_nested(self) -> bool:
+        return True
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class StructType(DType):
+    """Struct column: named child columns sharing the parent validity."""
+
+    fields: tuple = ()  # tuple[(name, DType), ...]
+
+    def __post_init__(self):
+        inner = ",".join(f"{n}:{t.sql_name}" for n, t in self.fields)
+        object.__setattr__(self, "sql_name", f"struct<{inner}>")
+
+    @property
+    def is_nested(self) -> bool:
+        return True
+
+    def field_names(self):
+        return [n for n, _ in self.fields]
+
+    def field_types(self):
+        return [t for _, t in self.fields]
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class MapType(DType):
+    """Map column: list<struct<key,value>> layout, as in cuDF/Arrow."""
+
+    key_type: DType = None  # type: ignore[assignment]
+    value_type: DType = None  # type: ignore[assignment]
+
+    def __post_init__(self):
+        object.__setattr__(
+            self, "sql_name", f"map<{self.key_type.sql_name},{self.value_type.sql_name}>")
+
+    @property
+    def is_nested(self) -> bool:
+        return True
+
+
+# Singletons (Spark-style)
+BOOL = BooleanType()
+INT8 = ByteType()
+INT16 = ShortType()
+INT32 = IntegerType()
+INT64 = LongType()
+FLOAT32 = FloatType()
+FLOAT64 = DoubleType()
+STRING = StringType()
+DATE = DateType()
+TIMESTAMP = TimestampType()
+NULL = NullType()
+
+_NUMPY_TO_DTYPE = {
+    np.dtype(np.bool_): BOOL,
+    np.dtype(np.int8): INT8,
+    np.dtype(np.int16): INT16,
+    np.dtype(np.int32): INT32,
+    np.dtype(np.int64): INT64,
+    np.dtype(np.float32): FLOAT32,
+    np.dtype(np.float64): FLOAT64,
+}
+
+
+def from_numpy_dtype(dt) -> DType:
+    dt = np.dtype(dt)
+    if dt.kind in ("U", "S", "O"):
+        return STRING
+    if dt.kind == "M":  # datetime64
+        return TIMESTAMP
+    try:
+        return _NUMPY_TO_DTYPE[dt]
+    except KeyError:
+        raise TypeError(f"unsupported numpy dtype {dt}")
+
+
+_PROMOTION_ORDER = [INT8, INT16, INT32, INT64, FLOAT32, FLOAT64]
+
+
+def promote(a: DType, b: DType) -> DType:
+    """Numeric promotion for binary arithmetic, Spark-style."""
+    if a == b:
+        return a
+    if isinstance(a, DecimalType) or isinstance(b, DecimalType):
+        # Decimal arithmetic result types are computed per-op in expr/arithmetic.
+        raise TypeError("decimal promotion is handled per-operator")
+    if a in _PROMOTION_ORDER and b in _PROMOTION_ORDER:
+        return _PROMOTION_ORDER[max(_PROMOTION_ORDER.index(a), _PROMOTION_ORDER.index(b))]
+    raise TypeError(f"cannot promote {a} and {b}")
+
+
+def min_value(dt: DType):
+    if dt.is_integral or isinstance(dt, (DateType, TimestampType)):
+        return np.iinfo(np.dtype(dt.physical)).min
+    if dt.is_floating:
+        return -np.inf
+    if dt == BOOL:
+        return False
+    raise TypeError(f"no min for {dt}")
+
+
+def max_value(dt: DType):
+    if dt.is_integral or isinstance(dt, (DateType, TimestampType)):
+        return np.iinfo(np.dtype(dt.physical)).max
+    if dt.is_floating:
+        return np.inf
+    if dt == BOOL:
+        return True
+    raise TypeError(f"no max for {dt}")

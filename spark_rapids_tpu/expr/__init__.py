@@ -1,0 +1,130 @@
+"""Expression library — public surface.
+
+The flat function namespace mirrors pyspark.sql.functions so reference
+users find the API familiar; each symbol maps to the expression classes
+in the submodules (inventory mirrors SURVEY §2.5).
+"""
+
+from . import aggregates, arithmetic, cast, conditional, core, datetime, hashing, \
+    mathfns, predicates, strings
+from .aggregates import (AggregateFunction, Average, Count, CountStar, First,
+                         Last, Max, Min, StddevPop, StddevSamp, Sum,
+                         VariancePop, VarianceSamp)
+from .arithmetic import (Abs, Add, Divide, Greatest, IntegralDivide, Least,
+                         Multiply, Pmod, Remainder, Subtract, UnaryMinus)
+from .cast import Cast
+from .conditional import CaseWhen, Coalesce, If, NullIf, Nvl, Nvl2, when
+from .core import (Alias, ColumnRef, Expression, Literal, col, lit,
+                   output_name)
+from .datetime import (AddMonths, DateAdd, DateDiff, DateSub, DayOfMonth,
+                       DayOfWeek, DayOfYear, FromUnixTime, Hour, LastDay,
+                       MakeDate, Minute, Month, Quarter, Second, TruncDate,
+                       WeekDay, Year)
+from .hashing import Murmur3Hash, XxHash64, murmur3_row_hash
+from .mathfns import (Acos, Asin, Atan, Atan2, BRound, Cbrt, Ceil, Cos, Cosh,
+                      Exp, Expm1, Floor, Hypot, Log, Log1p, Log2, Log10, Pow,
+                      Rint, Round, Signum, Sin, Sinh, Sqrt, Tan, Tanh,
+                      ToDegrees, ToRadians)
+from .predicates import (And, EqualNullSafe, EqualTo, GreaterThan,
+                         GreaterThanOrEqual, InSet, IsNaN, IsNotNull, IsNull,
+                         LessThan, LessThanOrEqual, Not, Or)
+from .strings import (Concat, Contains, EndsWith, Length, Like, Lower,
+                      OctetLength, StartsWith, StringTrim, StringTrimLeft,
+                      StringTrimRight, Substring, Upper)
+
+
+# pyspark.sql.functions-style helpers
+def sum_(e):
+    return Sum(_e(e))
+
+
+def count(e):
+    return Count(_e(e))
+
+
+def count_star():
+    return CountStar()
+
+
+def min_(e):
+    return Min(_e(e))
+
+
+def max_(e):
+    return Max(_e(e))
+
+
+def avg(e):
+    return Average(_e(e))
+
+
+def first(e, ignore_nulls=False):
+    return First(_e(e), ignore_nulls)
+
+
+def last(e, ignore_nulls=False):
+    return Last(_e(e), ignore_nulls)
+
+
+def stddev(e):
+    return StddevSamp(_e(e))
+
+
+def stddev_pop(e):
+    return StddevPop(_e(e))
+
+
+def variance(e):
+    return VarianceSamp(_e(e))
+
+
+def var_pop(e):
+    return VariancePop(_e(e))
+
+
+def _e(e):
+    return core.col(e) if isinstance(e, str) else e
+
+
+def coalesce(*es):
+    return Coalesce(*[core._lit(e) for e in es])
+
+
+def concat(*es):
+    return Concat(*[core._lit(e) for e in es])
+
+
+def substring(e, pos, length=1 << 30):
+    return Substring(_e(e), pos, length)
+
+
+def length(e):
+    return Length(_e(e))
+
+
+def upper(e):
+    return Upper(_e(e))
+
+
+def lower(e):
+    return Lower(_e(e))
+
+
+def like(e, pattern):
+    return Like(_e(e), pattern)
+
+
+def year(e):
+    return Year(_e(e))
+
+
+def month(e):
+    return Month(_e(e))
+
+
+def dayofmonth(e):
+    return DayOfMonth(_e(e))
+
+
+def spark_hash(*es):
+    return Murmur3Hash(*[_e(e) for e in es])

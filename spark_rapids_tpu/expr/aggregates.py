@@ -1,0 +1,389 @@
+"""Aggregate functions.
+
+Reference surface: sql-plugin/.../org/apache/spark/sql/rapids/aggregate/
+(GpuSum, GpuCount, GpuMin/Max, GpuAverage, GpuM2-based stddev/variance,
+first/last; SURVEY §2.5). The reference splits every aggregate into an
+*update* phase (raw rows -> partial state) and a *merge* phase (partial
+states -> final state) so partial aggregation can run before a shuffle
+(AggHelper, GpuAggregateExec.scala:175). We keep exactly that split:
+
+- ``update(gid, col, num_groups)``: segment-reduce raw rows into
+  per-group partial-state columns (jnp scatter-reduce onto a static
+  ``num_groups``-capacity state table — the TPU replacement for cuDF's
+  hash groupby),
+- ``merge(gid, states, num_groups)``: combine partial states,
+- ``finalize(states)``: produce the output column.
+
+States are plain dicts of ColumnVector so they flow through jit and the
+shuffle serializer untouched.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax.numpy as jnp
+
+from ..columnar import dtypes as dt
+from ..columnar.vector import Column, ColumnVector, ColumnarBatch
+from .core import Expression, Schema, make_result
+
+State = Dict[str, ColumnVector]
+
+
+def _seg_sum(values, gid, num_groups, dtype=None):
+    out = jnp.zeros(num_groups, dtype or values.dtype)
+    return out.at[gid].add(values)
+
+
+def _seg_min(values, gid, num_groups, fill):
+    out = jnp.full(num_groups, fill, values.dtype)
+    return out.at[gid].min(values)
+
+
+def _seg_max(values, gid, num_groups, fill):
+    out = jnp.full(num_groups, fill, values.dtype)
+    return out.at[gid].max(values)
+
+
+def _phys_extreme(dtype, largest: bool):
+    """Largest/smallest representable value of a jnp dtype (incl. bool)."""
+    if dtype == jnp.bool_:
+        return largest
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.inf if largest else -jnp.inf
+    info = jnp.iinfo(dtype)
+    return info.max if largest else info.min
+
+
+class AggregateFunction(Expression):
+    """Base; children[0] (if any) is the input expression."""
+
+    name = "agg"
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        raise NotImplementedError
+
+    def state_schema(self, schema: Schema) -> List:
+        """[(state_name, DType), ...] — the partial-aggregation buffer."""
+        raise NotImplementedError
+
+    def update(self, gid, col: Column, num_groups: int, live) -> State:
+        raise NotImplementedError
+
+    def merge(self, gid, states: State, num_groups: int) -> State:
+        raise NotImplementedError
+
+    def finalize(self, states: State) -> ColumnVector:
+        raise NotImplementedError
+
+
+class Sum(AggregateFunction):
+    """Spark sum: long for integrals, double for floats, decimal widened;
+    empty/all-null group -> null."""
+
+    name = "sum"
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        t = self.children[0].data_type(schema)
+        if isinstance(t, dt.DecimalType):
+            return dt.DecimalType(min(t.precision + 10, 18), t.scale)
+        if t.is_integral:
+            return dt.INT64
+        return dt.FLOAT64
+
+    def state_schema(self, schema: Schema) -> List:
+        return [("sum", self.data_type(schema)), ("count", dt.INT64)]
+
+    def update(self, gid, col: Column, num_groups: int, live) -> State:
+        out_t = self._out_t(col)
+        phys = out_t.physical
+        vals = jnp.where(col.validity, col.data.astype(phys), jnp.zeros((), phys))
+        s = _seg_sum(vals, gid, num_groups)
+        n = _seg_sum(col.validity.astype(jnp.int64), gid, num_groups)
+        return {"sum": s, "count": n}
+
+    def _out_t(self, col: Column) -> dt.DType:
+        t = col.dtype
+        if isinstance(t, dt.DecimalType):
+            return dt.DecimalType(min(t.precision + 10, 18), t.scale)
+        if t.is_integral or isinstance(t, dt.BooleanType):
+            return dt.INT64
+        return dt.FLOAT64
+
+    def merge(self, gid, states: State, num_groups: int) -> State:
+        return {"sum": _seg_sum(states["sum"], gid, num_groups),
+                "count": _seg_sum(states["count"], gid, num_groups)}
+
+    def finalize(self, states: State) -> tuple:
+        return states["sum"], states["count"] > 0
+
+
+class Count(AggregateFunction):
+    """count(x) — non-null count; count(*) via CountStar."""
+
+    name = "count"
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.INT64
+
+    def state_schema(self, schema: Schema) -> List:
+        return [("count", dt.INT64)]
+
+    def update(self, gid, col: Column, num_groups: int, live) -> State:
+        return {"count": _seg_sum((col.validity & live).astype(jnp.int64),
+                                  gid, num_groups)}
+
+    def merge(self, gid, states: State, num_groups: int) -> State:
+        return {"count": _seg_sum(states["count"], gid, num_groups)}
+
+    def finalize(self, states: State) -> tuple:
+        return states["count"], jnp.ones_like(states["count"], jnp.bool_)
+
+
+class CountStar(AggregateFunction):
+    name = "count(*)"
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.INT64
+
+    def state_schema(self, schema: Schema) -> List:
+        return [("count", dt.INT64)]
+
+    def update(self, gid, col, num_groups: int, live) -> State:
+        return {"count": _seg_sum(live.astype(jnp.int64), gid, num_groups)}
+
+    def merge(self, gid, states: State, num_groups: int) -> State:
+        return {"count": _seg_sum(states["count"], gid, num_groups)}
+
+    def finalize(self, states: State) -> tuple:
+        return states["count"], jnp.ones_like(states["count"], jnp.bool_)
+
+
+class Min(AggregateFunction):
+    name = "min"
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return self.children[0].data_type(schema)
+
+    def state_schema(self, schema: Schema) -> List:
+        return [("min", self.data_type(schema)), ("seen", dt.BOOL)]
+
+    def update(self, gid, col: Column, num_groups: int, live) -> State:
+        fill = dt.max_value(col.dtype)
+        vals = jnp.where(col.validity, col.data,
+                         jnp.asarray(fill, col.data.dtype))
+        return {"min": _seg_min(vals, gid, num_groups, fill),
+                "seen": _seg_sum(col.validity.astype(jnp.int32), gid, num_groups) > 0}
+
+    def merge(self, gid, states: State, num_groups: int) -> State:
+        fill = _phys_extreme(states["min"].dtype, largest=True)
+        vals = jnp.where(states["seen"], states["min"],
+                         jnp.asarray(fill, states["min"].dtype))
+        return {"min": _seg_min(vals, gid, num_groups, fill),
+                "seen": _seg_sum(states["seen"].astype(jnp.int32), gid, num_groups) > 0}
+
+    def finalize(self, states: State) -> tuple:
+        return states["min"], states["seen"]
+
+
+class Max(AggregateFunction):
+    name = "max"
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return self.children[0].data_type(schema)
+
+    def state_schema(self, schema: Schema) -> List:
+        return [("max", self.data_type(schema)), ("seen", dt.BOOL)]
+
+    def update(self, gid, col: Column, num_groups: int, live) -> State:
+        fill = dt.min_value(col.dtype)
+        vals = jnp.where(col.validity, col.data,
+                         jnp.asarray(fill, col.data.dtype))
+        return {"max": _seg_max(vals, gid, num_groups, fill),
+                "seen": _seg_sum(col.validity.astype(jnp.int32), gid, num_groups) > 0}
+
+    def merge(self, gid, states: State, num_groups: int) -> State:
+        fill = _phys_extreme(states["max"].dtype, largest=False)
+        vals = jnp.where(states["seen"], states["max"],
+                         jnp.asarray(fill, states["max"].dtype))
+        return {"max": _seg_max(vals, gid, num_groups, fill),
+                "seen": _seg_sum(states["seen"].astype(jnp.int32), gid, num_groups) > 0}
+
+    def finalize(self, states: State) -> tuple:
+        return states["max"], states["seen"]
+
+
+class Average(AggregateFunction):
+    """avg — double result (decimal avg flows through double for now)."""
+
+    name = "avg"
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.FLOAT64
+
+    def state_schema(self, schema: Schema) -> List:
+        return [("sum", dt.FLOAT64), ("count", dt.INT64)]
+
+    def update(self, gid, col: Column, num_groups: int, live) -> State:
+        x = col.data.astype(jnp.float64)
+        if isinstance(col.dtype, dt.DecimalType):
+            x = x / (10.0 ** col.dtype.scale)
+        vals = jnp.where(col.validity, x, 0.0)
+        return {"sum": _seg_sum(vals, gid, num_groups),
+                "count": _seg_sum(col.validity.astype(jnp.int64), gid, num_groups)}
+
+    def merge(self, gid, states: State, num_groups: int) -> State:
+        return {"sum": _seg_sum(states["sum"], gid, num_groups),
+                "count": _seg_sum(states["count"], gid, num_groups)}
+
+    def finalize(self, states: State) -> tuple:
+        n = states["count"]
+        ok = n > 0
+        return states["sum"] / jnp.where(ok, n, 1).astype(jnp.float64), ok
+
+
+class _M2Base(AggregateFunction):
+    """Shared Welford/M2 machinery for variance & stddev (GpuM2)."""
+
+    ddof = 1
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.FLOAT64
+
+    def state_schema(self, schema: Schema) -> List:
+        return [("n", dt.FLOAT64), ("avg", dt.FLOAT64), ("m2", dt.FLOAT64)]
+
+    def update(self, gid, col: Column, num_groups: int, live) -> State:
+        x = jnp.where(col.validity, col.data.astype(jnp.float64), 0.0)
+        n = _seg_sum(col.validity.astype(jnp.float64), gid, num_groups)
+        s = _seg_sum(x, gid, num_groups)
+        mean = s / jnp.where(n > 0, n, 1.0)
+        dev = jnp.where(col.validity, x - mean[gid], 0.0)
+        m2 = _seg_sum(dev * dev, gid, num_groups)
+        return {"n": n, "avg": mean, "m2": m2}
+
+    def merge(self, gid, states: State, num_groups: int) -> State:
+        # Chan et al. parallel merge of (n, avg, M2)
+        n = states["n"]
+        navg = states["avg"]
+        nm2 = states["m2"]
+        n_tot = _seg_sum(n, gid, num_groups)
+        s_tot = _seg_sum(n * navg, gid, num_groups)
+        avg_tot = s_tot / jnp.where(n_tot > 0, n_tot, 1.0)
+        delta = navg - avg_tot[gid]
+        m2_tot = _seg_sum(nm2 + n * delta * delta, gid, num_groups)
+        return {"n": n_tot, "avg": avg_tot, "m2": m2_tot}
+
+    def _var(self, states: State):
+        n = states["n"]
+        denom = n - self.ddof
+        ok = denom > 0
+        return states["m2"] / jnp.where(ok, denom, 1.0), ok & (n > 0)
+
+
+class VariancePop(_M2Base):
+    name = "var_pop"
+    ddof = 0
+
+    def finalize(self, states: State) -> tuple:
+        v, ok = self._var(states)
+        return v, ok
+
+
+class VarianceSamp(_M2Base):
+    name = "var_samp"
+    ddof = 1
+
+    def finalize(self, states: State) -> tuple:
+        v, ok = self._var(states)
+        return v, ok
+
+
+class StddevPop(_M2Base):
+    name = "stddev_pop"
+    ddof = 0
+
+    def finalize(self, states: State) -> tuple:
+        v, ok = self._var(states)
+        return jnp.sqrt(v), ok
+
+
+class StddevSamp(_M2Base):
+    name = "stddev_samp"
+    ddof = 1
+
+    def finalize(self, states: State) -> tuple:
+        v, ok = self._var(states)
+        return jnp.sqrt(v), ok
+
+
+class First(AggregateFunction):
+    """first(x [, ignoreNulls]) — row order dependent, like the reference."""
+
+    name = "first"
+
+    def __init__(self, child: Expression, ignore_nulls: bool = False):
+        super().__init__(child)
+        self.ignore_nulls = ignore_nulls
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return self.children[0].data_type(schema)
+
+    def state_schema(self, schema: Schema) -> List:
+        return [("val", self.data_type(schema)), ("valid", dt.BOOL),
+                ("pos", dt.INT64)]
+
+    def update(self, gid, col: Column, num_groups: int, live) -> State:
+        cap = col.capacity
+        pos = jnp.arange(cap, dtype=jnp.int64)
+        eligible = live & (col.validity if self.ignore_nulls else jnp.ones_like(live))
+        big = jnp.iinfo(jnp.int64).max
+        keyed = jnp.where(eligible, pos, big)
+        first_pos = _seg_min(keyed, gid, num_groups, big)
+        take = jnp.clip(first_pos, 0, cap - 1)
+        val = col.data[take]
+        valid = col.validity[take] & (first_pos < big)
+        return {"val": jnp.where(first_pos < big, val, jnp.zeros_like(val)),
+                "valid": valid, "pos": first_pos}
+
+    def merge(self, gid, states: State, num_groups: int) -> State:
+        cap = states["pos"].shape[0]
+        big = jnp.iinfo(jnp.int64).max
+        best = _seg_min(states["pos"], gid, num_groups, big)
+        # pick the partial whose pos equals the winner
+        is_best = states["pos"] == best[gid]
+        idx = jnp.where(is_best, jnp.arange(cap), cap - 1)
+        pick = _seg_min(idx.astype(jnp.int64), gid, num_groups, cap - 1)
+        pick = jnp.clip(pick, 0, cap - 1)
+        return {"val": states["val"][pick], "valid": states["valid"][pick] &
+                (best < big), "pos": best}
+
+    def finalize(self, states: State) -> tuple:
+        return states["val"], states["valid"]
+
+
+class Last(First):
+    name = "last"
+
+    def update(self, gid, col: Column, num_groups: int, live) -> State:
+        cap = col.capacity
+        pos = jnp.arange(cap, dtype=jnp.int64)
+        eligible = live & (col.validity if self.ignore_nulls else jnp.ones_like(live))
+        keyed = jnp.where(eligible, pos, jnp.int64(-1))
+        last_pos = _seg_max(keyed, gid, num_groups, -1)
+        take = jnp.clip(last_pos, 0, cap - 1)
+        val = col.data[take]
+        valid = col.validity[take] & (last_pos >= 0)
+        return {"val": jnp.where(last_pos >= 0, val, jnp.zeros_like(val)),
+                "valid": valid, "pos": last_pos}
+
+    def merge(self, gid, states: State, num_groups: int) -> State:
+        cap = states["pos"].shape[0]
+        best = _seg_max(states["pos"], gid, num_groups, -1)
+        is_best = states["pos"] == best[gid]
+        idx = jnp.where(is_best, jnp.arange(cap), 0)
+        pick = _seg_max(idx.astype(jnp.int64), gid, num_groups, 0)
+        pick = jnp.clip(pick, 0, cap - 1)
+        return {"val": states["val"][pick], "valid": states["valid"][pick] &
+                (best >= 0), "pos": best}

@@ -1,0 +1,295 @@
+"""Arithmetic expressions.
+
+Covers the reference's arithmetic surface
+(sql-plugin/src/main/scala/org/apache/spark/sql/rapids/arithmetic.scala):
+add/subtract/multiply/divide/integral-divide/remainder/pmod/unary ops with
+Spark semantics — divide-by-zero yields null (non-ANSI mode), Divide on
+non-decimals returns double, decimal +,-,* follow Spark's result
+precision/scale rules for long-backed (p<=18) decimals.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..columnar import dtypes as dt
+from ..columnar.vector import ColumnVector, ColumnarBatch
+from .core import Expression, Schema, make_result, merged_validity
+
+
+def _decimal_result(op: str, a: dt.DecimalType, b: dt.DecimalType) -> dt.DecimalType:
+    """Spark DecimalPrecision result types (capped at long-backed p=18)."""
+    p1, s1, p2, s2 = a.precision, a.scale, b.precision, b.scale
+    if op in ("add", "sub"):
+        scale = max(s1, s2)
+        prec = max(p1 - s1, p2 - s2) + scale + 1
+    elif op == "mul":
+        scale = s1 + s2
+        prec = p1 + p2 + 1
+    else:
+        raise TypeError(f"decimal {op} unsupported")
+    prec = min(prec, dt.DecimalType.MAX_LONG_PRECISION)
+    scale = min(scale, prec)
+    return dt.DecimalType(prec, scale)
+
+
+class BinaryArithmetic(Expression):
+    op_name = "?"
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        lt = self.children[0].data_type(schema)
+        rt = self.children[1].data_type(schema)
+        if isinstance(lt, dt.DecimalType) and isinstance(rt, dt.DecimalType):
+            return self._decimal_type(lt, rt)
+        if isinstance(lt, dt.DecimalType) or isinstance(rt, dt.DecimalType):
+            raise TypeError("implicit decimal/non-decimal arithmetic needs a cast")
+        return self._result_type(lt, rt)
+
+    def _result_type(self, lt: dt.DType, rt: dt.DType) -> dt.DType:
+        return dt.promote(lt, rt)
+
+    def _decimal_type(self, lt, rt) -> dt.DType:
+        raise TypeError(f"{self.op_name} does not support decimals")
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        left = self.children[0].eval(batch)
+        right = self.children[1].eval(batch)
+        out_t = self.data_type(batch.schema())
+        validity = merged_validity(left, right)
+        if isinstance(out_t, dt.DecimalType):
+            data, validity = self._compute_decimal(
+                left, right, out_t, validity)
+            return make_result(data, validity, out_t)
+        phys = out_t.physical
+        a = left.data.astype(phys)
+        b = right.data.astype(phys)
+        data, validity = self._compute(a, b, validity, out_t)
+        return make_result(data, validity, out_t)
+
+    def _compute(self, a, b, validity, out_t):
+        raise NotImplementedError
+
+    def _compute_decimal(self, left, right, out_t, validity):
+        raise TypeError(f"{self.op_name} does not support decimals")
+
+
+def _rescale(data, from_scale: int, to_scale: int):
+    if to_scale > from_scale:
+        return data * jnp.asarray(10 ** (to_scale - from_scale), data.dtype)
+    if to_scale < from_scale:
+        return data // jnp.asarray(10 ** (from_scale - to_scale), data.dtype)
+    return data
+
+
+class Add(BinaryArithmetic):
+    op_name = "+"
+
+    def _compute(self, a, b, validity, out_t):
+        return a + b, validity
+
+    def _decimal_type(self, lt, rt):
+        return _decimal_result("add", lt, rt)
+
+    def _compute_decimal(self, left, right, out_t, validity):
+        a = _rescale(left.data, left.dtype.scale, out_t.scale)
+        b = _rescale(right.data, right.dtype.scale, out_t.scale)
+        return a + b, validity
+
+
+class Subtract(BinaryArithmetic):
+    op_name = "-"
+
+    def _compute(self, a, b, validity, out_t):
+        return a - b, validity
+
+    def _decimal_type(self, lt, rt):
+        return _decimal_result("sub", lt, rt)
+
+    def _compute_decimal(self, left, right, out_t, validity):
+        a = _rescale(left.data, left.dtype.scale, out_t.scale)
+        b = _rescale(right.data, right.dtype.scale, out_t.scale)
+        return a - b, validity
+
+
+class Multiply(BinaryArithmetic):
+    op_name = "*"
+
+    def _compute(self, a, b, validity, out_t):
+        return a * b, validity
+
+    def _decimal_type(self, lt, rt):
+        return _decimal_result("mul", lt, rt)
+
+    def _compute_decimal(self, left, right, out_t, validity):
+        raw = left.data * right.data  # scale s1+s2
+        raw_scale = left.dtype.scale + right.dtype.scale
+        return _rescale(raw, raw_scale, out_t.scale), validity
+
+
+class Divide(BinaryArithmetic):
+    """Spark Divide: non-decimal result is always double; x/0 -> null."""
+
+    op_name = "/"
+
+    def _result_type(self, lt, rt):
+        return dt.FLOAT64
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        left = self.children[0].eval(batch)
+        right = self.children[1].eval(batch)
+        validity = merged_validity(left, right)
+        a = left.data.astype(jnp.float64)
+        b = right.data.astype(jnp.float64)
+        if isinstance(left.dtype, dt.DecimalType):
+            a = a / (10.0 ** left.dtype.scale)
+        if isinstance(right.dtype, dt.DecimalType):
+            b = b / (10.0 ** right.dtype.scale)
+        validity = validity & (b != 0.0)
+        data = jnp.where(b != 0.0, a / jnp.where(b == 0.0, 1.0, b), 0.0)
+        return make_result(data, validity, dt.FLOAT64)
+
+    def _decimal_type(self, lt, rt):
+        # Simplified: decimal division flows through double (cast back if
+        # a decimal result is required). Full decimal division lands with
+        # the decimal128 work.
+        return dt.FLOAT64
+
+
+class IntegralDivide(BinaryArithmetic):
+    """`div` — always returns bigint; x div 0 -> null."""
+
+    op_name = "div"
+
+    def _result_type(self, lt, rt):
+        return dt.INT64
+
+    def _compute(self, a, b, validity, out_t):
+        zero = b == 0
+        validity = validity & ~zero
+        safe_b = jnp.where(zero, jnp.ones((), b.dtype), b)
+        # Spark/Java semantics: truncate toward zero (jnp floor-divides).
+        q = jnp.trunc(a.astype(jnp.float64) / safe_b.astype(jnp.float64)) \
+            if jnp.issubdtype(a.dtype, jnp.floating) else _trunc_div(a, safe_b)
+        return q.astype(jnp.int64), validity
+
+
+def _trunc_div(a, b):
+    q = a // b
+    r = a - q * b
+    # floor->trunc correction when signs differ and remainder nonzero
+    adjust = (r != 0) & ((a < 0) != (b < 0))
+    return q + adjust.astype(q.dtype)
+
+
+def _trunc_mod(a, b):
+    r = a % b
+    # Python % is floor-mod; Java % is trunc-mod: result takes sign of a.
+    adjust = (r != 0) & ((a < 0) != (b < 0))
+    return r - jnp.where(adjust, b, jnp.zeros((), b.dtype))
+
+
+class Remainder(BinaryArithmetic):
+    """% with Java sign semantics; x % 0 -> null."""
+
+    op_name = "%"
+
+    def _compute(self, a, b, validity, out_t):
+        if jnp.issubdtype(a.dtype, jnp.floating):
+            zero = b == 0.0
+            validity = validity & ~zero
+            safe = jnp.where(zero, jnp.ones((), b.dtype), b)
+            return jnp.fmod(a, safe), validity
+        zero = b == 0
+        validity = validity & ~zero
+        safe = jnp.where(zero, jnp.ones((), b.dtype), b)
+        return _trunc_mod(a, safe), validity
+
+
+class Pmod(BinaryArithmetic):
+    """pmod(a, b): positive modulus."""
+
+    op_name = "pmod"
+
+    def _compute(self, a, b, validity, out_t):
+        zero = b == 0
+        validity = validity & ~zero
+        safe = jnp.where(zero, jnp.ones((), b.dtype), b)
+        if jnp.issubdtype(a.dtype, jnp.floating):
+            r = jnp.fmod(a, safe)
+            r = jnp.where(r < 0, r + jnp.abs(safe), r)
+            return r, validity
+        r = _trunc_mod(a, safe)
+        r = jnp.where(r < 0, r + jnp.abs(safe), r)
+        return r, validity
+
+
+class UnaryMinus(Expression):
+    def data_type(self, schema: Schema) -> dt.DType:
+        return self.children[0].data_type(schema)
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        c = self.children[0].eval(batch)
+        return make_result(-c.data, c.validity, c.dtype)
+
+
+class UnaryPositive(Expression):
+    def data_type(self, schema: Schema) -> dt.DType:
+        return self.children[0].data_type(schema)
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        return self.children[0].eval(batch)
+
+
+class Abs(Expression):
+    def data_type(self, schema: Schema) -> dt.DType:
+        return self.children[0].data_type(schema)
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        c = self.children[0].eval(batch)
+        return make_result(jnp.abs(c.data), c.validity, c.dtype)
+
+
+class Least(Expression):
+    """least(...) — null-skipping minimum across columns."""
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        t = self.children[0].data_type(schema)
+        for c in self.children[1:]:
+            t = dt.promote(t, c.data_type(schema))
+        return t
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        out_t = self.data_type(batch.schema())
+        phys = out_t.physical
+        cols = [c.eval(batch) for c in self.children]
+        big = jnp.asarray(dt.max_value(out_t), phys)
+        data = jnp.full(batch.capacity, big, phys)
+        any_valid = jnp.zeros(batch.capacity, jnp.bool_)
+        for c in cols:
+            v = jnp.where(c.validity, c.data.astype(phys), big)
+            data = jnp.minimum(data, v)
+            any_valid = any_valid | c.validity
+        return make_result(data, any_valid, out_t)
+
+
+class Greatest(Expression):
+    """greatest(...) — null-skipping maximum across columns."""
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        t = self.children[0].data_type(schema)
+        for c in self.children[1:]:
+            t = dt.promote(t, c.data_type(schema))
+        return t
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        out_t = self.data_type(batch.schema())
+        phys = out_t.physical
+        cols = [c.eval(batch) for c in self.children]
+        small = jnp.asarray(dt.min_value(out_t), phys)
+        data = jnp.full(batch.capacity, small, phys)
+        any_valid = jnp.zeros(batch.capacity, jnp.bool_)
+        for c in cols:
+            v = jnp.where(c.validity, c.data.astype(phys), small)
+            data = jnp.maximum(data, v)
+            any_valid = any_valid | c.validity
+        return make_result(data, any_valid, out_t)

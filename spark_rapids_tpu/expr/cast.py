@@ -1,0 +1,166 @@
+"""Cast expression — the Spark cast matrix on TPU.
+
+Reference surface: sql-plugin/.../rapids/GpuCast.scala (1880 LoC; SURVEY
+§2.5). Non-ANSI Spark semantics:
+- integral narrowing wraps (Java narrowing conversion),
+- float->integral saturates, NaN -> 0 (Scala Double.toInt),
+- numeric->boolean is x != 0; boolean->numeric is 0/1,
+- decimal casts rescale with HALF_UP rounding on scale reduction and
+  null on overflow of the target precision,
+- date<->timestamp via days<->micros (UTC).
+
+String casts (parse/format) live in strings.py and are wired in here;
+unsupported combinations raise TypeError at plan time which the overrides
+layer turns into a CPU fallback (GpuOverrides tagging behavior).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..columnar import dtypes as dt
+from ..columnar.vector import Column, ColumnVector, ColumnarBatch, StringColumn
+from .core import Expression, Schema, make_result
+
+_INT_TYPES = (dt.ByteType, dt.ShortType, dt.IntegerType, dt.LongType)
+
+
+class Cast(Expression):
+    def __init__(self, child: Expression, to: dt.DType, ansi: bool = False):
+        super().__init__(child)
+        self.to = to
+        self.ansi = ansi
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return self.to
+
+    def check_supported(self, schema: Schema) -> None:
+        """Plan-time support check; raises TypeError for fallback combos."""
+        src = self.children[0].data_type(schema)
+        to = self.to
+        if src == to:
+            return
+        numericish = lambda t: (t.is_numeric or isinstance(t, (dt.BooleanType,))
+                                or isinstance(t, dt.DecimalType))
+        if numericish(src) and numericish(to):
+            return
+        if isinstance(src, (dt.DateType, dt.TimestampType)) and \
+                isinstance(to, (dt.DateType, dt.TimestampType, dt.LongType, dt.IntegerType,
+                                dt.StringType)):
+            return
+        if src.is_numeric and isinstance(to, dt.StringType):
+            return
+        if isinstance(src, dt.StringType) and (
+                to.is_numeric or isinstance(to, (dt.DateType, dt.TimestampType,
+                                                 dt.BooleanType))):
+            return
+        if src.is_integral and isinstance(to, (dt.TimestampType,)):
+            return
+        raise TypeError(f"cast {src} -> {to} not supported on TPU")
+
+    def eval(self, batch: ColumnarBatch) -> Column:
+        c = self.children[0].eval(batch)
+        return cast_column(c, self.to)
+
+
+def cast_column(c: Column, to: dt.DType) -> Column:
+    src = c.dtype
+    if src == to:
+        return c
+
+    if isinstance(c, StringColumn):
+        from . import strings
+        return strings.cast_from_string(c, to)
+
+    if isinstance(to, dt.StringType):
+        from . import strings
+        return strings.cast_to_string(c)
+
+    data, validity = c.data, c.validity
+
+    # unwrap decimal source to a scaled representation first
+    if isinstance(src, dt.DecimalType):
+        if isinstance(to, dt.DecimalType):
+            return _rescale_decimal(c, to)
+        if to.is_floating:
+            out = data.astype(jnp.float64) / (10.0 ** src.scale)
+            return make_result(out.astype(to.physical), validity, to)
+        if to.is_integral:
+            out = data // (10 ** src.scale)  # truncation toward -inf on positive scales
+            neg_fix = (data < 0) & (data % (10 ** src.scale) != 0)
+            out = out + neg_fix.astype(out.dtype)  # truncate toward zero
+            return _narrow_int(out, validity, to)
+        if isinstance(to, dt.BooleanType):
+            return make_result(data != 0, validity, to)
+        raise TypeError(f"cast {src} -> {to}")
+
+    if isinstance(to, dt.DecimalType):
+        if src.is_integral or isinstance(src, dt.BooleanType):
+            unscaled = data.astype(jnp.int64) * (10 ** to.scale)
+            ok = _fits_precision(unscaled, to)
+            return make_result(unscaled, validity & ok, to)
+        if src.is_floating:
+            scaled = data.astype(jnp.float64) * (10.0 ** to.scale)
+            rounded = jnp.sign(scaled) * jnp.floor(jnp.abs(scaled) + 0.5)
+            ok = jnp.isfinite(scaled) & (jnp.abs(rounded) < 10.0 ** min(to.precision, 18))
+            unscaled = jnp.where(ok, rounded, 0.0).astype(jnp.int64)
+            return make_result(unscaled, validity & ok, to)
+        raise TypeError(f"cast {src} -> {to}")
+
+    if isinstance(to, dt.BooleanType):
+        return make_result(data != 0, validity, to)
+
+    if isinstance(src, dt.BooleanType):
+        return make_result(data.astype(to.physical), validity, to)
+
+    if isinstance(src, dt.DateType) and isinstance(to, dt.TimestampType):
+        return make_result(data.astype(jnp.int64) * 86_400_000_000, validity, to)
+    if isinstance(src, dt.TimestampType) and isinstance(to, dt.DateType):
+        return make_result((data // 86_400_000_000).astype(jnp.int32), validity, to)
+    if isinstance(src, dt.TimestampType) and to.is_integral:
+        return _narrow_int(data // 1_000_000, validity, to)  # seconds
+    if isinstance(src, dt.DateType) and to.is_integral:
+        return _narrow_int(data, validity, to)
+    if src.is_integral and isinstance(to, dt.TimestampType):
+        return make_result(data.astype(jnp.int64) * 1_000_000, validity, to)
+
+    if src.is_floating and to.is_integral:
+        x = jnp.where(jnp.isnan(data), jnp.zeros((), data.dtype), data)
+        imin = dt.min_value(to)
+        imax = dt.max_value(to)
+        # float64(2**63-1) rounds UP to 2**63, so clip-then-convert would
+        # wrap to Long.MIN for large positives; saturate explicitly instead.
+        hi_bound = float(2 ** 63) if to == dt.INT64 else float(imax)
+        clamped = jnp.trunc(jnp.clip(x, float(imin), hi_bound))
+        out = clamped.astype(to.physical)
+        out = jnp.where(clamped >= hi_bound, jnp.asarray(imax, to.physical), out)
+        return make_result(out, validity, to)
+
+    if src.is_integral and to.is_integral:
+        return _narrow_int(data, validity, to)
+
+    # everything else: plain convert (int->float, float widening/narrowing)
+    return make_result(data.astype(to.physical), validity, to)
+
+
+def _narrow_int(data, validity, to: dt.DType) -> ColumnVector:
+    """Java narrowing: wrap via masking to the target width."""
+    return make_result(data.astype(jnp.int64).astype(to.physical), validity, to)
+
+
+def _fits_precision(unscaled, to: dt.DecimalType):
+    bound = 10 ** min(to.precision, 18)
+    return jnp.abs(unscaled) < bound
+
+
+def _rescale_decimal(c: ColumnVector, to: dt.DecimalType) -> ColumnVector:
+    src: dt.DecimalType = c.dtype  # type: ignore[assignment]
+    data = c.data
+    if to.scale > src.scale:
+        data = data * (10 ** (to.scale - src.scale))
+    elif to.scale < src.scale:
+        p = 10 ** (src.scale - to.scale)
+        half = p // 2
+        data = jnp.sign(data) * ((jnp.abs(data) + half) // p)  # HALF_UP
+    ok = _fits_precision(data, to)
+    return make_result(data, c.validity & ok, to)

@@ -1,0 +1,191 @@
+"""Conditional expressions: IF / CASE WHEN / COALESCE / NULLIF / NVL.
+
+Reference surface: sql-plugin/.../rapids/conditionalExpressions.scala and
+nullExpressions.scala. On TPU these lower to jnp.where chains that XLA
+fuses into the surrounding expression DAG — there is no lazy/short-circuit
+evaluation on a vector machine, matching the reference's columnar
+"evaluate all branches then select" semantics.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+
+from ..columnar import dtypes as dt
+from ..columnar.vector import Column, ColumnVector, ColumnarBatch, StringColumn
+from .core import Expression, Schema, make_result
+
+
+def _common_type(types: List[dt.DType]) -> dt.DType:
+    out = types[0]
+    for t in types[1:]:
+        if t == dt.NULL:
+            continue
+        if out == dt.NULL:
+            out = t
+        elif out != t:
+            out = dt.promote(out, t)
+    return out
+
+
+def _as_string(c: Column) -> StringColumn:
+    """Coerce an all-null ColumnVector (e.g. Literal(None)) to a string
+    column so string selects have two string operands."""
+    if isinstance(c, StringColumn):
+        return c
+    cap = c.capacity
+    return StringColumn(jnp.zeros(cap + 1, jnp.int32), jnp.zeros(128, jnp.uint8),
+                        jnp.zeros(cap, jnp.bool_), pad_bucket=8)
+
+
+def _select(cond, a: Column, b: Column, out_t: dt.DType) -> Column:
+    """Row-wise select between two columns of the same logical type."""
+    if isinstance(out_t, dt.StringType) or isinstance(a, StringColumn) \
+            or isinstance(b, StringColumn):
+        return _select_strings(cond, _as_string(a), _as_string(b))
+    phys = out_t.physical
+    data = jnp.where(cond, a.data.astype(phys), b.data.astype(phys))
+    validity = jnp.where(cond, a.validity, b.validity)
+    return make_result(data, validity, out_t)
+
+
+def _select_strings(cond, a: StringColumn, b: StringColumn) -> StringColumn:
+    """Select rebuilds offsets+chars by per-row extents (same pattern as
+    StringColumn.gather)."""
+    lens = jnp.where(cond, a.lengths(), b.lengths())
+    validity = jnp.where(cond, a.validity, b.validity)
+    lens = jnp.where(validity, lens, 0)
+    cap = a.capacity
+    new_offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(lens, dtype=jnp.int32)])
+    nbytes_cap = max(a.char_capacity, b.char_capacity)
+    pos = jnp.arange(nbytes_cap, dtype=jnp.int32)
+    row = jnp.searchsorted(new_offsets[1:], pos, side="right").astype(jnp.int32)
+    row_c = jnp.clip(row, 0, cap - 1)
+    within = pos - jnp.take(new_offsets, row_c)
+    a_src = jnp.take(a.offsets[:-1], row_c) + within
+    b_src = jnp.take(b.offsets[:-1], row_c) + within
+    a_byte = jnp.take(a.chars, jnp.clip(a_src, 0, a.char_capacity - 1))
+    b_byte = jnp.take(b.chars, jnp.clip(b_src, 0, b.char_capacity - 1))
+    byte = jnp.where(jnp.take(cond, row_c), a_byte, b_byte)
+    total = new_offsets[cap]
+    chars = jnp.where(pos < total, byte, jnp.zeros((), jnp.uint8))
+    return StringColumn(new_offsets, chars, validity,
+                        pad_bucket=max(a.pad_bucket, b.pad_bucket))
+
+
+class If(Expression):
+    """if(cond, a, b); null cond selects the else branch (Spark semantics)."""
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return _common_type([self.children[1].data_type(schema),
+                             self.children[2].data_type(schema)])
+
+    def eval(self, batch: ColumnarBatch) -> Column:
+        cond = self.children[0].eval(batch)
+        a = self.children[1].eval(batch)
+        b = self.children[2].eval(batch)
+        take_a = cond.data & cond.validity
+        return _select(take_a, a, b, self.data_type(batch.schema()))
+
+
+class CaseWhen(Expression):
+    """CASE WHEN ... THEN ... [ELSE ...] END."""
+
+    def __init__(self, branches: List[Tuple[Expression, Expression]],
+                 otherwise: Optional[Expression] = None):
+        from .core import Literal
+        self.branches = branches
+        self.otherwise = otherwise if otherwise is not None else Literal(None)
+        children = []
+        for c, v in branches:
+            children.extend([c, v])
+        children.append(self.otherwise)
+        super().__init__(*children)
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        ts = [v.data_type(schema) for _, v in self.branches]
+        ts.append(self.otherwise.data_type(schema))
+        return _common_type(ts)
+
+    def eval(self, batch: ColumnarBatch) -> Column:
+        out_t = self.data_type(batch.schema())
+        result = self.otherwise.eval(batch)
+        # Build from the last branch backwards so the first matching WHEN wins.
+        for cond_e, val_e in reversed(self.branches):
+            cond = cond_e.eval(batch)
+            val = val_e.eval(batch)
+            result = _select(cond.data & cond.validity, val, result, out_t)
+        return result
+
+
+class Coalesce(Expression):
+    """First non-null argument."""
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return _common_type([c.data_type(schema) for c in self.children])
+
+    def eval(self, batch: ColumnarBatch) -> Column:
+        out_t = self.data_type(batch.schema())
+        result = self.children[-1].eval(batch)
+        for e in reversed(self.children[:-1]):
+            c = e.eval(batch)
+            result = _select(c.validity, c, result, out_t)
+        return result
+
+
+class NullIf(Expression):
+    """nullif(a, b): null when a == b else a."""
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return self.children[0].data_type(schema)
+
+    def eval(self, batch: ColumnarBatch) -> Column:
+        from .predicates import EqualTo
+        a = self.children[0].eval(batch)
+        eq = EqualTo(self.children[0], self.children[1]).eval(batch)
+        kill = eq.data & eq.validity
+        return a.with_validity(a.validity & ~kill)
+
+
+class Nvl(Coalesce):
+    """nvl(a, b) == coalesce(a, b)."""
+
+
+class Nvl2(Expression):
+    """nvl2(a, b, c): b when a is not null else c."""
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return _common_type([self.children[1].data_type(schema),
+                             self.children[2].data_type(schema)])
+
+    def eval(self, batch: ColumnarBatch) -> Column:
+        a = self.children[0].eval(batch)
+        b = self.children[1].eval(batch)
+        c = self.children[2].eval(batch)
+        return _select(a.validity, b, c, self.data_type(batch.schema()))
+
+
+def when(cond: Expression, value) -> "WhenBuilder":
+    from .core import _lit
+    return WhenBuilder([(cond, _lit(value))])
+
+
+class WhenBuilder:
+    """Fluent builder: when(c, v).when(c2, v2).otherwise(v3)."""
+
+    def __init__(self, branches):
+        self.branches = branches
+
+    def when(self, cond: Expression, value) -> "WhenBuilder":
+        from .core import _lit
+        return WhenBuilder(self.branches + [(cond, _lit(value))])
+
+    def otherwise(self, value) -> CaseWhen:
+        from .core import _lit
+        return CaseWhen(self.branches, _lit(value))
+
+    def end(self) -> CaseWhen:
+        return CaseWhen(self.branches)

@@ -1,0 +1,255 @@
+"""Date/time expressions.
+
+Reference surface: sql-plugin/.../rapids/datetimeExpressions.scala (+ JNI
+GpuTimeZoneDB). All timestamps are UTC micros; session-timezone handling
+beyond UTC and the Julian/Gregorian rebase matrix (datetimeRebaseUtils)
+land with the IO rebase work. Calendar math uses Hinnant's civil-date
+algorithms (strings.py) — pure integer ops, fully vectorizable on the VPU.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..columnar import dtypes as dt
+from ..columnar.vector import ColumnVector, ColumnarBatch
+from .core import Expression, Schema, make_result, merged_validity
+from .strings import _civil_from_days, _days_from_civil
+
+_MICROS_PER_DAY = 86_400_000_000
+
+
+def _to_days(c: ColumnVector):
+    if isinstance(c.dtype, dt.TimestampType):
+        return c.data // _MICROS_PER_DAY
+    return c.data.astype(jnp.int64)
+
+
+class _DateField(Expression):
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.INT32
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        c = self.children[0].eval(batch)
+        y, m, d = _civil_from_days(_to_days(c))
+        return make_result(self._pick(y, m, d).astype(jnp.int32), c.validity, dt.INT32)
+
+    def _pick(self, y, m, d):
+        raise NotImplementedError
+
+
+class Year(_DateField):
+    def _pick(self, y, m, d):
+        return y
+
+
+class Month(_DateField):
+    def _pick(self, y, m, d):
+        return m
+
+
+class DayOfMonth(_DateField):
+    def _pick(self, y, m, d):
+        return d
+
+
+class Quarter(_DateField):
+    def _pick(self, y, m, d):
+        return (m - 1) // 3 + 1
+
+
+class DayOfWeek(_DateField):
+    """1 = Sunday … 7 = Saturday (Spark semantics)."""
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        c = self.children[0].eval(batch)
+        days = _to_days(c)
+        # 1970-01-01 was a Thursday (dow index 4 with Sunday=0)
+        dow = (days + 4) % 7
+        return make_result((dow + 1).astype(jnp.int32), c.validity, dt.INT32)
+
+
+class WeekDay(_DateField):
+    """0 = Monday … 6 = Sunday."""
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        c = self.children[0].eval(batch)
+        days = _to_days(c)
+        return make_result(((days + 3) % 7).astype(jnp.int32), c.validity, dt.INT32)
+
+
+class DayOfYear(_DateField):
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        c = self.children[0].eval(batch)
+        days = _to_days(c)
+        y, m, d = _civil_from_days(days)
+        jan1 = _days_from_civil(y, jnp.ones_like(y), jnp.ones_like(y))
+        return make_result((days - jan1 + 1).astype(jnp.int32), c.validity, dt.INT32)
+
+
+class LastDay(Expression):
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.DATE
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        c = self.children[0].eval(batch)
+        days = _to_days(c)
+        y, m, _ = _civil_from_days(days)
+        ny = jnp.where(m == 12, y + 1, y)
+        nm = jnp.where(m == 12, 1, m + 1)
+        nxt = _days_from_civil(ny, nm, jnp.ones_like(nm))
+        return make_result((nxt - 1).astype(jnp.int32), c.validity, dt.DATE)
+
+
+class _TimeField(Expression):
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.INT32
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        c = self.children[0].eval(batch)
+        us = c.data.astype(jnp.int64)
+        sec_of_day = (us % _MICROS_PER_DAY) // 1_000_000
+        sec_of_day = jnp.where(sec_of_day < 0, sec_of_day + 86_400, sec_of_day)
+        return make_result(self._pick(sec_of_day).astype(jnp.int32), c.validity, dt.INT32)
+
+    def _pick(self, s):
+        raise NotImplementedError
+
+
+class Hour(_TimeField):
+    def _pick(self, s):
+        return s // 3600
+
+
+class Minute(_TimeField):
+    def _pick(self, s):
+        return (s % 3600) // 60
+
+
+class Second(_TimeField):
+    def _pick(self, s):
+        return s % 60
+
+
+class DateAdd(Expression):
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.DATE
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        a = self.children[0].eval(batch)
+        b = self.children[1].eval(batch)
+        data = (a.data.astype(jnp.int64) + b.data.astype(jnp.int64)).astype(jnp.int32)
+        return make_result(data, merged_validity(a, b), dt.DATE)
+
+
+class DateSub(Expression):
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.DATE
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        a = self.children[0].eval(batch)
+        b = self.children[1].eval(batch)
+        data = (a.data.astype(jnp.int64) - b.data.astype(jnp.int64)).astype(jnp.int32)
+        return make_result(data, merged_validity(a, b), dt.DATE)
+
+
+class DateDiff(Expression):
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.INT32
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        a = self.children[0].eval(batch)
+        b = self.children[1].eval(batch)
+        data = (_to_days(a) - _to_days(b)).astype(jnp.int32)
+        return make_result(data, merged_validity(a, b), dt.INT32)
+
+
+class AddMonths(Expression):
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.DATE
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        a = self.children[0].eval(batch)
+        n = self.children[1].eval(batch)
+        y, m, d = _civil_from_days(_to_days(a))
+        months = y * 12 + (m - 1) + n.data.astype(jnp.int64)
+        ny = months // 12
+        nm = months % 12 + 1
+        # clamp day to last day of target month
+        ny2 = jnp.where(nm == 12, ny + 1, ny)
+        nm2 = jnp.where(nm == 12, 1, nm + 1)
+        last = _days_from_civil(ny2, nm2, jnp.ones_like(nm2)) - 1
+        _, _, last_d = _civil_from_days(last)
+        nd = jnp.minimum(d, last_d)
+        data = _days_from_civil(ny, nm, nd).astype(jnp.int32)
+        return make_result(data, merged_validity(a, n), dt.DATE)
+
+
+class TruncDate(Expression):
+    """trunc(date, fmt) for fmt in year/month/week/quarter."""
+
+    def __init__(self, child: Expression, fmt: str):
+        super().__init__(child)
+        self.fmt = fmt.lower()
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.DATE
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        c = self.children[0].eval(batch)
+        days = _to_days(c)
+        y, m, d = _civil_from_days(days)
+        if self.fmt in ("year", "yyyy", "yy"):
+            out = _days_from_civil(y, jnp.ones_like(m), jnp.ones_like(d))
+        elif self.fmt in ("month", "mon", "mm"):
+            out = _days_from_civil(y, m, jnp.ones_like(d))
+        elif self.fmt in ("quarter",):
+            qm = ((m - 1) // 3) * 3 + 1
+            out = _days_from_civil(y, qm, jnp.ones_like(d))
+        elif self.fmt in ("week",):
+            dow = (days + 3) % 7  # Monday=0
+            out = days - dow
+        else:
+            raise TypeError(f"trunc format {self.fmt!r} unsupported")
+        return make_result(out.astype(jnp.int32), c.validity, dt.DATE)
+
+
+class UnixTimestampToSeconds(Expression):
+    """unix_timestamp(ts) — seconds since epoch."""
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.INT64
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        c = self.children[0].eval(batch)
+        if isinstance(c.dtype, dt.DateType):
+            data = c.data.astype(jnp.int64) * 86_400
+        else:
+            data = c.data.astype(jnp.int64) // 1_000_000
+        return make_result(data, c.validity, dt.INT64)
+
+
+class FromUnixTime(Expression):
+    """Seconds since epoch -> timestamp."""
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.TIMESTAMP
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        c = self.children[0].eval(batch)
+        return make_result(c.data.astype(jnp.int64) * 1_000_000, c.validity, dt.TIMESTAMP)
+
+
+class MakeDate(Expression):
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.DATE
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        y = self.children[0].eval(batch)
+        m = self.children[1].eval(batch)
+        d = self.children[2].eval(batch)
+        validity = merged_validity(y, m, d)
+        ok = (m.data >= 1) & (m.data <= 12) & (d.data >= 1) & (d.data <= 31)
+        days = _days_from_civil(y.data.astype(jnp.int64), m.data.astype(jnp.int64),
+                                d.data.astype(jnp.int64))
+        return make_result(days.astype(jnp.int32), validity & ok, dt.DATE)

@@ -1,0 +1,273 @@
+"""Math expressions.
+
+Reference surface: sql-plugin/.../rapids/mathExpressions.scala. Spark math
+functions take/return double (except round/bround which preserve the input
+type family); domain errors return NaN/Inf like Java's StrictMath, not null.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..columnar import dtypes as dt
+from ..columnar.vector import ColumnVector, ColumnarBatch
+from .core import Expression, Schema, make_result, merged_validity
+
+
+class _UnaryDouble(Expression):
+    fn = None
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.FLOAT64
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        c = self.children[0].eval(batch)
+        x = c.data.astype(jnp.float64)
+        if isinstance(c.dtype, dt.DecimalType):
+            x = x / (10.0 ** c.dtype.scale)
+        return make_result(type(self).fn(x), c.validity, dt.FLOAT64)
+
+
+class Sqrt(_UnaryDouble):
+    fn = staticmethod(jnp.sqrt)
+
+
+class Cbrt(_UnaryDouble):
+    fn = staticmethod(jnp.cbrt)
+
+
+class Exp(_UnaryDouble):
+    fn = staticmethod(jnp.exp)
+
+
+class Expm1(_UnaryDouble):
+    fn = staticmethod(jnp.expm1)
+
+
+class Log(_UnaryDouble):
+    fn = staticmethod(jnp.log)
+
+    def eval(self, batch):
+        # Spark: log(x) for x <= 0 -> null
+        c = self.children[0].eval(batch)
+        x = c.data.astype(jnp.float64)
+        ok = x > 0
+        data = jnp.log(jnp.where(ok, x, 1.0))
+        return make_result(data, c.validity & ok, dt.FLOAT64)
+
+
+class Log1p(_UnaryDouble):
+    fn = staticmethod(jnp.log1p)
+
+    def eval(self, batch):
+        c = self.children[0].eval(batch)
+        x = c.data.astype(jnp.float64)
+        ok = x > -1
+        data = jnp.log1p(jnp.where(ok, x, 0.0))
+        return make_result(data, c.validity & ok, dt.FLOAT64)
+
+
+class Log2(_UnaryDouble):
+    def eval(self, batch):
+        c = self.children[0].eval(batch)
+        x = c.data.astype(jnp.float64)
+        ok = x > 0
+        data = jnp.log2(jnp.where(ok, x, 1.0))
+        return make_result(data, c.validity & ok, dt.FLOAT64)
+
+
+class Log10(_UnaryDouble):
+    def eval(self, batch):
+        c = self.children[0].eval(batch)
+        x = c.data.astype(jnp.float64)
+        ok = x > 0
+        data = jnp.log10(jnp.where(ok, x, 1.0))
+        return make_result(data, c.validity & ok, dt.FLOAT64)
+
+
+class Sin(_UnaryDouble):
+    fn = staticmethod(jnp.sin)
+
+
+class Cos(_UnaryDouble):
+    fn = staticmethod(jnp.cos)
+
+
+class Tan(_UnaryDouble):
+    fn = staticmethod(jnp.tan)
+
+
+class Asin(_UnaryDouble):
+    fn = staticmethod(jnp.arcsin)
+
+
+class Acos(_UnaryDouble):
+    fn = staticmethod(jnp.arccos)
+
+
+class Atan(_UnaryDouble):
+    fn = staticmethod(jnp.arctan)
+
+
+class Sinh(_UnaryDouble):
+    fn = staticmethod(jnp.sinh)
+
+
+class Cosh(_UnaryDouble):
+    fn = staticmethod(jnp.cosh)
+
+
+class Tanh(_UnaryDouble):
+    fn = staticmethod(jnp.tanh)
+
+
+class Asinh(_UnaryDouble):
+    fn = staticmethod(jnp.arcsinh)
+
+
+class Acosh(_UnaryDouble):
+    fn = staticmethod(jnp.arccosh)
+
+
+class Atanh(_UnaryDouble):
+    fn = staticmethod(jnp.arctanh)
+
+
+class ToDegrees(_UnaryDouble):
+    fn = staticmethod(jnp.degrees)
+
+
+class ToRadians(_UnaryDouble):
+    fn = staticmethod(jnp.radians)
+
+
+class Signum(_UnaryDouble):
+    fn = staticmethod(lambda x: jnp.sign(x))
+
+
+class Rint(_UnaryDouble):
+    fn = staticmethod(jnp.rint)
+
+
+class Floor(Expression):
+    """floor: bigint for integral/double input (Spark returns long)."""
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        t = self.children[0].data_type(schema)
+        if isinstance(t, dt.DecimalType):
+            return dt.DecimalType(min(t.precision - t.scale + 1, 18), 0)
+        return dt.INT64
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        c = self.children[0].eval(batch)
+        if isinstance(c.dtype, dt.DecimalType):
+            s = 10 ** c.dtype.scale
+            data = c.data // s
+            return make_result(data, c.validity, self.data_type(batch.schema()))
+        data = jnp.floor(c.data.astype(jnp.float64)).astype(jnp.int64)
+        return make_result(data, c.validity, dt.INT64)
+
+
+class Ceil(Expression):
+    def data_type(self, schema: Schema) -> dt.DType:
+        t = self.children[0].data_type(schema)
+        if isinstance(t, dt.DecimalType):
+            return dt.DecimalType(min(t.precision - t.scale + 1, 18), 0)
+        return dt.INT64
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        c = self.children[0].eval(batch)
+        if isinstance(c.dtype, dt.DecimalType):
+            s = 10 ** c.dtype.scale
+            data = -((-c.data) // s)
+            return make_result(data, c.validity, self.data_type(batch.schema()))
+        data = jnp.ceil(c.data.astype(jnp.float64)).astype(jnp.int64)
+        return make_result(data, c.validity, dt.INT64)
+
+
+class Pow(Expression):
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.FLOAT64
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        a = self.children[0].eval(batch)
+        b = self.children[1].eval(batch)
+        data = jnp.power(a.data.astype(jnp.float64), b.data.astype(jnp.float64))
+        return make_result(data, merged_validity(a, b), dt.FLOAT64)
+
+
+class Atan2(Expression):
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.FLOAT64
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        a = self.children[0].eval(batch)
+        b = self.children[1].eval(batch)
+        data = jnp.arctan2(a.data.astype(jnp.float64), b.data.astype(jnp.float64))
+        return make_result(data, merged_validity(a, b), dt.FLOAT64)
+
+
+class Hypot(Expression):
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.FLOAT64
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        a = self.children[0].eval(batch)
+        b = self.children[1].eval(batch)
+        data = jnp.hypot(a.data.astype(jnp.float64), b.data.astype(jnp.float64))
+        return make_result(data, merged_validity(a, b), dt.FLOAT64)
+
+
+class Round(Expression):
+    """round(x, d): HALF_UP rounding (Spark), input type preserved."""
+
+    def __init__(self, child: Expression, scale: int = 0):
+        super().__init__(child)
+        self.scale = scale
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        t = self.children[0].data_type(schema)
+        if isinstance(t, dt.DecimalType):
+            return dt.DecimalType(t.precision, min(self.scale, t.scale)) \
+                if self.scale >= 0 else dt.DecimalType(t.precision, 0)
+        return t
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        c = self.children[0].eval(batch)
+        out_t = self.data_type(batch.schema())
+        if isinstance(c.dtype, dt.DecimalType):
+            target = min(self.scale, c.dtype.scale) if self.scale >= 0 else 0
+            drop = c.dtype.scale - target
+            if drop <= 0:
+                return c
+            p = 10 ** drop
+            half = p // 2
+            # HALF_UP away from zero on the unscaled value
+            q = (jnp.abs(c.data) + half) // p
+            data = jnp.sign(c.data) * q
+            return make_result(data, c.validity, out_t)
+        if c.dtype.is_floating:
+            p = 10.0 ** self.scale
+            x = c.data.astype(jnp.float64) * p
+            data = (jnp.sign(x) * jnp.floor(jnp.abs(x) + 0.5)) / p
+            return make_result(data.astype(out_t.physical), c.validity, out_t)
+        if self.scale >= 0:
+            return c
+        p = 10 ** (-self.scale)
+        half = p // 2
+        q = (jnp.abs(c.data.astype(jnp.int64)) + half) // p * p
+        data = (jnp.sign(c.data) * q).astype(out_t.physical)
+        return make_result(data, c.validity, out_t)
+
+
+class BRound(Round):
+    """bround: HALF_EVEN (banker's) rounding."""
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        c = self.children[0].eval(batch)
+        out_t = self.data_type(batch.schema())
+        if c.dtype.is_floating:
+            p = 10.0 ** self.scale
+            data = jnp.round(c.data.astype(jnp.float64) * p) / p  # rint = HALF_EVEN
+            return make_result(data.astype(out_t.physical), c.validity, out_t)
+        return super().eval(batch)

@@ -1,0 +1,681 @@
+"""String expressions and string-side casts.
+
+Reference surface: sql-plugin/.../rapids/stringFunctions.scala plus the
+string halves of GpuCast.scala (spark-rapids-jni CastStrings). TPU has no
+native variable-length support (SURVEY §7 hard-part #2), so every kernel
+here works on one of two layouts:
+
+- the flat offsets+chars layout for packing results, and
+- the (capacity, pad_bucket) fixed-width padded view for per-character
+  logic; the pad bucket is static so XLA sees fixed shapes.
+
+LIKE is a vectorized dynamic program over the padded view — the pattern is
+a plan-time constant so the DP unrolls at trace time into pure vector ops.
+ASCII-only case mapping for upper/lower (documented divergence; full
+Unicode mapping is a lookup-table kernel planned with the regex engine).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import dtypes as dt
+from ..columnar.vector import Column, ColumnVector, ColumnarBatch, StringColumn
+from .core import Expression, Schema, make_result, merged_validity
+
+
+from ..columnar.vector import round_pow2 as _round_pow2
+
+
+def pack_padded(padded, lens, validity, pad_bucket: int) -> StringColumn:
+    """Build a StringColumn from a (capacity, W) byte matrix + lengths."""
+    cap, w = padded.shape
+    lens = jnp.where(validity, lens, 0).astype(jnp.int32)
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(lens, dtype=jnp.int32)])
+    nbytes = cap * w
+    pos = jnp.arange(nbytes, dtype=jnp.int32)
+    row = jnp.searchsorted(offsets[1:], pos, side="right").astype(jnp.int32)
+    row_c = jnp.clip(row, 0, cap - 1)
+    within = pos - jnp.take(offsets, row_c)
+    byte = padded[row_c, jnp.clip(within, 0, w - 1)]
+    total = offsets[cap]
+    chars = jnp.where(pos < total, byte, jnp.zeros((), jnp.uint8))
+    return StringColumn(offsets, chars, validity, pad_bucket=pad_bucket)
+
+
+class Length(Expression):
+    """char_length — counts UTF-8 codepoints (not bytes), like Spark."""
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.INT32
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        c = self.children[0].eval(batch)
+        padded = c.padded()
+        # count bytes that are NOT UTF-8 continuation bytes (0b10xxxxxx)
+        k = jnp.arange(c.pad_bucket)
+        in_str = k[None, :] < c.lengths()[:, None]
+        is_cont = (padded & 0xC0) == 0x80
+        n = jnp.sum(in_str & ~is_cont, axis=1).astype(jnp.int32)
+        return make_result(n, c.validity, dt.INT32)
+
+
+class OctetLength(Expression):
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.INT32
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        c = self.children[0].eval(batch)
+        return make_result(c.lengths().astype(jnp.int32), c.validity, dt.INT32)
+
+
+class _CaseMap(Expression):
+    lo, hi, delta = 0, 0, 0
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.STRING
+
+    def eval(self, batch: ColumnarBatch) -> StringColumn:
+        c = self.children[0].eval(batch)
+        src = c.chars
+        conv = (src >= self.lo) & (src <= self.hi)
+        chars = jnp.where(conv, src + jnp.uint8(self.delta), src)
+        return StringColumn(c.offsets, chars, c.validity, c.pad_bucket)
+
+
+class Upper(_CaseMap):
+    lo, hi, delta = ord("a"), ord("z"), -32 & 0xFF
+
+
+class Lower(_CaseMap):
+    lo, hi, delta = ord("A"), ord("Z"), 32
+
+
+class Substring(Expression):
+    """substring(str, pos, len) — 1-based pos; negative pos counts from end.
+
+    Byte-based (exact for ASCII; Spark is codepoint-based — multi-byte
+    offsets land with the regex/unicode work).
+    """
+
+    def __init__(self, child: Expression, pos: int, length: int = 1 << 30):
+        super().__init__(child)
+        self.pos = pos
+        self.length = length
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.STRING
+
+    def eval(self, batch: ColumnarBatch) -> StringColumn:
+        c = self.children[0].eval(batch)
+        lens = c.lengths()
+        if self.pos > 0:
+            start = jnp.minimum(jnp.asarray(self.pos - 1, jnp.int32), lens)
+        elif self.pos == 0:
+            start = jnp.zeros_like(lens)
+        else:
+            start = jnp.maximum(lens + self.pos, 0)
+        out_len = jnp.clip(jnp.minimum(jnp.asarray(self.length, jnp.int64),
+                                       (lens - start).astype(jnp.int64)), 0, None)
+        out_len = out_len.astype(jnp.int32)
+        w = c.pad_bucket
+        k = jnp.arange(w, dtype=jnp.int32)
+        idx = c.offsets[:-1][:, None] + start[:, None] + k[None, :]
+        padded = jnp.take(c.chars, jnp.clip(idx, 0, c.char_capacity - 1))
+        padded = jnp.where(k[None, :] < out_len[:, None], padded, jnp.zeros((), jnp.uint8))
+        return pack_padded(padded, out_len, c.validity, c.pad_bucket)
+
+
+class Concat(Expression):
+    """concat(...) — null if any input null (Spark concat semantics)."""
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.STRING
+
+    def eval(self, batch: ColumnarBatch) -> StringColumn:
+        cols = [c.eval(batch) for c in self.children]
+        validity = merged_validity(*cols)
+        w = sum(c.pad_bucket for c in cols)
+        pads = [c.padded() for c in cols]
+        lens = [c.lengths() for c in cols]
+        total = sum(lens)
+        # stack segments: write each input at its per-row offset
+        out = jnp.zeros((batch.capacity, w), jnp.uint8)
+        k = jnp.arange(w, dtype=jnp.int32)
+        acc = jnp.zeros(batch.capacity, jnp.int32)
+        for pad, ln, col in zip(pads, lens, cols):
+            src_idx = k[None, :] - acc[:, None]
+            in_range = (src_idx >= 0) & (src_idx < ln[:, None])
+            gathered = jnp.take_along_axis(
+                pad, jnp.clip(src_idx, 0, col.pad_bucket - 1), axis=1)
+            out = jnp.where(in_range, gathered, out)
+            acc = acc + ln
+        return pack_padded(out, total, validity, _round_pow2(w))
+
+
+class StartsWith(Expression):
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.BOOL
+
+    def __init__(self, child: Expression, prefix: str):
+        super().__init__(child)
+        self.prefix = prefix
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        c = self.children[0].eval(batch)
+        raw = np.frombuffer(self.prefix.encode("utf-8"), dtype=np.uint8)
+        n = len(raw)
+        if n == 0:
+            return make_result(jnp.ones(batch.capacity, jnp.bool_), c.validity, dt.BOOL)
+        padded = c.padded()
+        if n > c.pad_bucket:
+            return make_result(jnp.zeros(batch.capacity, jnp.bool_), c.validity, dt.BOOL)
+        hit = jnp.all(padded[:, :n] == jnp.asarray(raw), axis=1) & (c.lengths() >= n)
+        return make_result(hit, c.validity, dt.BOOL)
+
+
+class EndsWith(Expression):
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.BOOL
+
+    def __init__(self, child: Expression, suffix: str):
+        super().__init__(child)
+        self.suffix = suffix
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        c = self.children[0].eval(batch)
+        raw = np.frombuffer(self.suffix.encode("utf-8"), dtype=np.uint8)
+        n = len(raw)
+        if n == 0:
+            return make_result(jnp.ones(batch.capacity, jnp.bool_), c.validity, dt.BOOL)
+        lens = c.lengths()
+        start = lens - n
+        k = jnp.arange(n, dtype=jnp.int32)
+        idx = c.offsets[:-1][:, None] + start[:, None] + k[None, :]
+        window = jnp.take(c.chars, jnp.clip(idx, 0, c.char_capacity - 1))
+        hit = jnp.all(window == jnp.asarray(raw), axis=1) & (lens >= n)
+        return make_result(hit, c.validity, dt.BOOL)
+
+
+class Contains(Expression):
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.BOOL
+
+    def __init__(self, child: Expression, needle: str):
+        super().__init__(child)
+        self.needle = needle
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        c = self.children[0].eval(batch)
+        raw = np.frombuffer(self.needle.encode("utf-8"), dtype=np.uint8)
+        n = len(raw)
+        if n == 0:
+            return make_result(jnp.ones(batch.capacity, jnp.bool_), c.validity, dt.BOOL)
+        padded = c.padded()
+        w = c.pad_bucket
+        if n > w:
+            return make_result(jnp.zeros(batch.capacity, jnp.bool_), c.validity, dt.BOOL)
+        # sliding windows: for each start s, all(padded[:, s:s+n] == raw)
+        hit = jnp.zeros(batch.capacity, jnp.bool_)
+        lens = c.lengths()
+        for s in range(w - n + 1):
+            m = jnp.all(padded[:, s:s + n] == jnp.asarray(raw), axis=1) & (lens >= s + n)
+            hit = hit | m
+        return make_result(hit, c.validity, dt.BOOL)
+
+
+class Like(Expression):
+    """SQL LIKE with a constant pattern — vectorized DP over padded bytes.
+
+    The reference transpiles LIKE to cuDF's regex (stringFunctions.scala);
+    here the pattern is static at trace time, so the classic O(P*W) glob
+    DP unrolls into P vector steps over the (capacity, W) view.
+    """
+
+    def __init__(self, child: Expression, pattern: str, escape: str = "\\"):
+        super().__init__(child)
+        self.pattern = pattern
+        self.escape = escape
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.BOOL
+
+    def _tokens(self):
+        toks = []
+        i = 0
+        p = self.pattern
+        while i < len(p):
+            ch = p[i]
+            if ch == self.escape and i + 1 < len(p):
+                toks.append(("lit", p[i + 1]))
+                i += 2
+            elif ch == "%":
+                toks.append(("any", None))
+                i += 1
+            elif ch == "_":
+                toks.append(("one", None))
+                i += 1
+            else:
+                toks.append(("lit", ch))
+                i += 1
+        return toks
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        c = self.children[0].eval(batch)
+        padded = c.padded()
+        cap, w = padded.shape
+        lens = c.lengths()
+        in_str = jnp.arange(w)[None, :] < lens[:, None]
+        # dp[:, j] = pattern-so-far matches first j bytes
+        dp = jnp.zeros((cap, w + 1), jnp.bool_).at[:, 0].set(True)
+        for kind, ch in self._tokens():
+            if kind == "any":
+                dp = jnp.cumsum(dp, axis=1) > 0
+            elif kind == "one":
+                step = dp[:, :-1] & in_str
+                dp = jnp.concatenate(
+                    [jnp.zeros((cap, 1), jnp.bool_), step], axis=1)
+            else:
+                byte = ch.encode("utf-8")
+                if len(byte) != 1:
+                    raise TypeError("multi-byte LIKE literals not yet supported")
+                eq = padded == jnp.uint8(byte[0])
+                step = dp[:, :-1] & in_str & eq
+                dp = jnp.concatenate(
+                    [jnp.zeros((cap, 1), jnp.bool_), step], axis=1)
+        hit = jnp.take_along_axis(dp, lens[:, None].astype(jnp.int32), axis=1)[:, 0]
+        return make_result(hit, c.validity, dt.BOOL)
+
+
+class StringTrim(Expression):
+    side = "both"
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.STRING
+
+    def eval(self, batch: ColumnarBatch) -> StringColumn:
+        c = self.children[0].eval(batch)
+        padded = c.padded()
+        cap, w = padded.shape
+        lens = c.lengths()
+        k = jnp.arange(w, dtype=jnp.int32)
+        in_str = k[None, :] < lens[:, None]
+        is_space = (padded == jnp.uint8(32)) & in_str
+        nonspace = in_str & ~is_space
+        any_ns = jnp.any(nonspace, axis=1)
+        first_ns = jnp.argmax(nonspace, axis=1).astype(jnp.int32)
+        last_ns = (w - 1 - jnp.argmax(nonspace[:, ::-1], axis=1)).astype(jnp.int32)
+        if self.side in ("both", "leading"):
+            start = jnp.where(any_ns, first_ns, 0)
+        else:
+            start = jnp.zeros(cap, jnp.int32)
+        if self.side in ("both", "trailing"):
+            end = jnp.where(any_ns, last_ns + 1, 0)
+        else:
+            end = lens
+        out_len = jnp.maximum(end - start, 0)
+        idx = jnp.clip(start[:, None] + k[None, :], 0, w - 1)
+        out = jnp.take_along_axis(padded, idx, axis=1)
+        out = jnp.where(k[None, :] < out_len[:, None], out, jnp.zeros((), jnp.uint8))
+        return pack_padded(out, out_len, c.validity, c.pad_bucket)
+
+
+class StringTrimLeft(StringTrim):
+    side = "leading"
+
+
+class StringTrimRight(StringTrim):
+    side = "trailing"
+
+
+# ---------------------------------------------------------------------------
+# Casts: string <-> other types (GpuCast.scala string halves)
+# ---------------------------------------------------------------------------
+
+_POW10 = [10 ** k for k in range(19)]
+
+
+def _int_to_padded(mag, neg, width: int):
+    """(cap, width) digit bytes for unsigned magnitudes + sign column."""
+    ndig = jnp.ones_like(mag, dtype=jnp.int32)
+    for k in range(1, 19):
+        ndig = ndig + (mag >= jnp.uint64(_POW10[k])).astype(jnp.int32)
+    ndig = ndig + (mag >= jnp.uint64(10 ** 19)).astype(jnp.int32)
+    total = ndig + neg.astype(jnp.int32)
+    p = jnp.arange(width, dtype=jnp.int32)
+    di = p[None, :] - neg[:, None].astype(jnp.int32)  # digit index from left
+    power = ndig[:, None] - 1 - di
+    power_c = jnp.clip(power, 0, 19)
+    pow10 = jnp.asarray([10 ** k for k in range(20)], jnp.uint64)[power_c]
+    digit = (mag[:, None] // pow10) % jnp.uint64(10)
+    byte = (jnp.uint8(48) + digit.astype(jnp.uint8))
+    byte = jnp.where((di == -1)[:, :] | ((p[None, :] == 0) & neg[:, None]),
+                     jnp.uint8(45), byte)  # '-'
+    in_range = p[None, :] < total[:, None]
+    return jnp.where(in_range, byte, jnp.zeros((), jnp.uint8)), total
+
+
+def cast_to_string(c: ColumnVector) -> StringColumn:
+    src = c.dtype
+    cap = c.capacity
+    if isinstance(src, dt.BooleanType):
+        pad = jnp.zeros((cap, 8), jnp.uint8)
+        t = np.frombuffer(b"true\0\0\0\0", np.uint8)
+        f = np.frombuffer(b"false\0\0\0", np.uint8)
+        pad = jnp.where(c.data[:, None], jnp.asarray(t)[None, :], jnp.asarray(f)[None, :])
+        lens = jnp.where(c.data, 4, 5).astype(jnp.int32)
+        return pack_padded(pad, lens, c.validity, 8)
+    if src.is_integral or isinstance(src, dt.DecimalType):
+        v = c.data.astype(jnp.int64)
+        if isinstance(src, dt.DecimalType) and src.scale > 0:
+            return _decimal_to_string(c)
+        neg = v < 0
+        mag = jnp.where(neg, (-(v.astype(jnp.uint64))), v.astype(jnp.uint64))
+        padded, total = _int_to_padded(mag, neg, 21)
+        return pack_padded(padded, total, c.validity, 32)
+    if isinstance(src, dt.DateType):
+        y, m, d = _civil_from_days(c.data.astype(jnp.int64))
+        return _format_ymd(y, m, d, c.validity)
+    if isinstance(src, dt.TimestampType):
+        return _timestamp_to_string(c)
+    raise TypeError(f"cast {src} -> string not supported on TPU")
+
+
+def _decimal_to_string(c: ColumnVector) -> StringColumn:
+    src: dt.DecimalType = c.dtype  # type: ignore[assignment]
+    s = src.scale
+    v = c.data.astype(jnp.int64)
+    neg = v < 0
+    mag = jnp.where(neg, -(v.astype(jnp.uint64)), v.astype(jnp.uint64))
+    intpart = mag // jnp.uint64(_POW10[s])
+    frac = mag % jnp.uint64(_POW10[s])
+    ip, ip_len = _int_to_padded(intpart, neg, 21)
+    # frac: fixed s digits
+    p = jnp.arange(s, dtype=jnp.int32)
+    pow10 = jnp.asarray([_POW10[k] for k in range(s)], jnp.uint64)[::-1]
+    fdig = (frac[:, None] // pow10[None, :]) % jnp.uint64(10)
+    fbytes = jnp.uint8(48) + fdig.astype(jnp.uint8)
+    w = 21 + 1 + s
+    out = jnp.zeros((c.capacity, w), jnp.uint8)
+    out = out.at[:, :21].set(ip)
+    k = jnp.arange(w, dtype=jnp.int32)
+    dot_pos = ip_len
+    out = jnp.where(k[None, :] == dot_pos[:, None], jnp.uint8(46), out)
+    fidx = k[None, :] - dot_pos[:, None] - 1
+    in_frac = (fidx >= 0) & (fidx < s)
+    fval = jnp.take_along_axis(
+        fbytes, jnp.clip(fidx, 0, s - 1), axis=1) if s else out
+    out = jnp.where(in_frac, fval, out)
+    total = ip_len + 1 + s
+    return pack_padded(out, total, c.validity, _round_pow2(w))
+
+
+def _civil_from_days(z):
+    """Days-since-epoch -> (y, m, d); Hinnant's algorithm. jnp's //
+    already floors (the original's `z - 146096` trick exists only to make
+    C's truncating division floor), so plain floor-div is correct for
+    negative days too."""
+    z = z + 719468
+    era = z // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = jnp.where(mp < 10, mp + 3, mp - 9)
+    y = jnp.where(m <= 2, y + 1, y)
+    return y, m, d
+
+
+def _days_from_civil(y, m, d):
+    y = jnp.where(m <= 2, y - 1, y)
+    era = y // 400  # floor division — no C-truncation correction needed
+    yoe = y - era * 400
+    mp = jnp.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+def _two_digits(v):
+    return (jnp.uint8(48) + (v // 10).astype(jnp.uint8),
+            jnp.uint8(48) + (v % 10).astype(jnp.uint8))
+
+
+def _format_ymd(y, m, d, validity) -> StringColumn:
+    cap = y.shape[0]
+    out = jnp.zeros((cap, 16), jnp.uint8)
+    yd = [(y // 1000) % 10, (y // 100) % 10, (y // 10) % 10, y % 10]
+    for i, dig in enumerate(yd):
+        out = out.at[:, i].set(jnp.uint8(48) + dig.astype(jnp.uint8))
+    out = out.at[:, 4].set(jnp.uint8(45))
+    m1, m2 = _two_digits(m)
+    out = out.at[:, 5].set(m1).at[:, 6].set(m2)
+    out = out.at[:, 7].set(jnp.uint8(45))
+    d1, d2 = _two_digits(d)
+    out = out.at[:, 8].set(d1).at[:, 9].set(d2)
+    lens = jnp.full(cap, 10, jnp.int32)
+    return pack_padded(out, lens, validity, 16)
+
+
+def _timestamp_to_string(c: ColumnVector) -> StringColumn:
+    us = c.data.astype(jnp.int64)
+    days = us // 86_400_000_000
+    rem = us - days * 86_400_000_000
+    y, m, d = _civil_from_days(days)
+    sec = rem // 1_000_000
+    micro = rem % 1_000_000
+    hh = sec // 3600
+    mm = (sec % 3600) // 60
+    ss = sec % 60
+    cap = us.shape[0]
+    out = jnp.zeros((cap, 32), jnp.uint8)
+    yd = [(y // 1000) % 10, (y // 100) % 10, (y // 10) % 10, y % 10]
+    for i, dig in enumerate(yd):
+        out = out.at[:, i].set(jnp.uint8(48) + dig.astype(jnp.uint8))
+    out = out.at[:, 4].set(jnp.uint8(45))
+    a, b = _two_digits(m)
+    out = out.at[:, 5].set(a).at[:, 6].set(b)
+    out = out.at[:, 7].set(jnp.uint8(45))
+    a, b = _two_digits(d)
+    out = out.at[:, 8].set(a).at[:, 9].set(b)
+    out = out.at[:, 10].set(jnp.uint8(32))
+    a, b = _two_digits(hh)
+    out = out.at[:, 11].set(a).at[:, 12].set(b)
+    out = out.at[:, 13].set(jnp.uint8(58))
+    a, b = _two_digits(mm)
+    out = out.at[:, 14].set(a).at[:, 15].set(b)
+    out = out.at[:, 16].set(jnp.uint8(58))
+    a, b = _two_digits(ss)
+    out = out.at[:, 17].set(a).at[:, 18].set(b)
+    # fractional part: ".ffffff" trimmed of trailing zeros (Spark style)
+    fdig = jnp.stack([(micro // p) % 10 for p in
+                      [100000, 10000, 1000, 100, 10, 1]], axis=1)
+    nz = fdig != 0
+    any_frac = jnp.any(nz, axis=1)
+    # position of last nonzero fractional digit
+    last_nz = 5 - jnp.argmax(nz[:, ::-1], axis=1)
+    frac_len = jnp.where(any_frac, last_nz + 1, 0).astype(jnp.int32)
+    out = jnp.where((jnp.arange(32) == 19)[None, :] & any_frac[:, None],
+                    jnp.uint8(46), out)
+    k = jnp.arange(32, dtype=jnp.int32)
+    fidx = k[None, :] - 20
+    in_frac = (fidx >= 0) & (fidx < frac_len[:, None])
+    fval = jnp.take_along_axis(fdig, jnp.clip(fidx, 0, 5), axis=1)
+    out = jnp.where(in_frac, jnp.uint8(48) + fval.astype(jnp.uint8), out)
+    lens = jnp.where(any_frac, 20 + frac_len, 19).astype(jnp.int32)
+    return pack_padded(out, lens, c.validity, 32)
+
+
+def cast_from_string(c: StringColumn, to: dt.DType) -> Column:
+    padded = c.padded()
+    lens = c.lengths()
+    if to.is_integral:
+        val, ok = _parse_int(padded, lens)
+        data = val.astype(to.physical)
+        return make_result(data, c.validity & ok, to)
+    if to.is_floating:
+        val, ok = _parse_float(padded, lens)
+        return make_result(val.astype(to.physical), c.validity & ok, to)
+    if isinstance(to, dt.BooleanType):
+        return _parse_bool(c, padded, lens)
+    if isinstance(to, dt.DateType):
+        val, ok = _parse_date(padded, lens)
+        return make_result(val.astype(jnp.int32), c.validity & ok, to)
+    if isinstance(to, dt.DecimalType):
+        val, ok = _parse_float(padded, lens)
+        scaled = val * (10.0 ** to.scale)
+        unscaled = (jnp.sign(scaled) * jnp.floor(jnp.abs(scaled) + 0.5)).astype(jnp.int64)
+        ok = ok & (jnp.abs(unscaled) < 10 ** min(to.precision, 18))
+        return make_result(unscaled, c.validity & ok, to)
+    raise TypeError(f"cast string -> {to} not supported on TPU")
+
+
+def _strip_bounds(padded, lens):
+    """start/end after trimming ASCII whitespace."""
+    cap, w = padded.shape
+    k = jnp.arange(w, dtype=jnp.int32)
+    in_str = k[None, :] < lens[:, None]
+    is_sp = in_str & ((padded == 32) | (padded == 9) | (padded == 10) | (padded == 13))
+    non_sp = in_str & ~is_sp
+    any_c = jnp.any(non_sp, axis=1)
+    start = jnp.where(any_c, jnp.argmax(non_sp, axis=1), 0).astype(jnp.int32)
+    end = jnp.where(any_c, w - jnp.argmax(non_sp[:, ::-1], axis=1), 0).astype(jnp.int32)
+    return start, end, any_c
+
+
+def _parse_int(padded, lens):
+    cap, w = padded.shape
+    start, end, nonempty = _strip_bounds(padded, lens)
+    k = jnp.arange(w, dtype=jnp.int32)
+    first = jnp.take_along_axis(padded, start[:, None], axis=1)[:, 0]
+    neg = first == 45
+    has_sign = neg | (first == 43)
+    dstart = start + has_sign.astype(jnp.int32)
+    in_num = (k[None, :] >= dstart[:, None]) & (k[None, :] < end[:, None])
+    digit = padded - jnp.uint8(48)
+    is_digit = (padded >= 48) & (padded <= 57)
+    ok = nonempty & (end > dstart) & jnp.all(~in_num | is_digit, axis=1)
+    val = jnp.zeros(cap, jnp.int64)
+    for i in range(w):
+        use = in_num[:, i]
+        val = jnp.where(use, val * 10 + digit[:, i].astype(jnp.int64), val)
+    val = jnp.where(neg, -val, val)
+    return val, ok
+
+
+def _parse_float(padded, lens):
+    """Parse [+-]digits[.digits][eE[+-]digits]. Close-to-strtod accuracy."""
+    cap, w = padded.shape
+    start, end, nonempty = _strip_bounds(padded, lens)
+    k = jnp.arange(w, dtype=jnp.int32)[None, :]
+    first = jnp.take_along_axis(padded, start[:, None], axis=1)[:, 0]
+    neg = first == 45
+    has_sign = neg | (first == 43)
+    pos0 = start + has_sign.astype(jnp.int32)
+    in_str = (k >= pos0[:, None]) & (k < end[:, None])
+    is_digit = (padded >= 48) & (padded <= 57)
+    is_dot = padded == 46
+    is_e = (padded == 101) | (padded == 69)
+    # exponent marker position (first e/E), dot position
+    e_mask = in_str & is_e
+    has_e = jnp.any(e_mask, axis=1)
+    e_pos = jnp.where(has_e, jnp.argmax(e_mask, axis=1), end).astype(jnp.int32)
+    dot_mask = in_str & is_dot & (k < e_pos[:, None])
+    has_dot = jnp.any(dot_mask, axis=1)
+    dot_pos = jnp.where(has_dot, jnp.argmax(dot_mask, axis=1), e_pos).astype(jnp.int32)
+    # mantissa digits: positions in [pos0, e_pos) except the dot
+    mant_zone = in_str & (k < e_pos[:, None]) & ~is_dot
+    ok = nonempty & jnp.all(~mant_zone | is_digit, axis=1)
+    ok = ok & (jnp.sum(dot_mask, axis=1) <= 1) & jnp.any(mant_zone & is_digit, axis=1)
+    mant = jnp.zeros(cap, jnp.float64)
+    ndig_after_dot = jnp.zeros(cap, jnp.int32)
+    for i in range(w):
+        use = mant_zone[:, i]
+        mant = jnp.where(use, mant * 10 + (padded[:, i] - 48).astype(jnp.float64), mant)
+        ndig_after_dot = ndig_after_dot + (
+            use & (i > dot_pos) & has_dot).astype(jnp.int32)
+    # exponent
+    e_first_pos = e_pos + 1
+    efirst = jnp.take_along_axis(padded, jnp.clip(e_first_pos, 0, w - 1)[:, None],
+                                 axis=1)[:, 0]
+    eneg = efirst == 45
+    e_has_sign = eneg | (efirst == 43)
+    e_dstart = e_first_pos + e_has_sign.astype(jnp.int32)
+    e_zone = (k >= e_dstart[:, None]) & (k < end[:, None])
+    ok = ok & jnp.where(has_e,
+                        jnp.any(e_zone & is_digit, axis=1) &
+                        jnp.all(~e_zone | is_digit, axis=1),
+                        True)
+    ev = jnp.zeros(cap, jnp.int32)
+    for i in range(w):
+        use = e_zone[:, i] & has_e
+        ev = jnp.where(use, ev * 10 + (padded[:, i] - 48).astype(jnp.int32), ev)
+    ev = jnp.where(eneg, -ev, ev)
+    exp = ev - ndig_after_dot
+    val = mant * jnp.power(10.0, exp.astype(jnp.float64))
+    val = jnp.where(neg, -val, val)
+    return val, ok
+
+
+_TRUE_STRS = [b"true", b"t", b"yes", b"y", b"1"]
+_FALSE_STRS = [b"false", b"f", b"no", b"n", b"0"]
+
+
+def _parse_bool(c: StringColumn, padded, lens):
+    lowered = jnp.where((padded >= 65) & (padded <= 90), padded + 32, padded)
+    cap, w = lowered.shape
+
+    def match(s: bytes):
+        n = len(s)
+        if n > w:
+            return jnp.zeros(cap, jnp.bool_)
+        return (lens == n) & jnp.all(
+            lowered[:, :n] == jnp.asarray(np.frombuffer(s, np.uint8)), axis=1)
+
+    t = jnp.zeros(cap, jnp.bool_)
+    for s in _TRUE_STRS:
+        t = t | match(s)
+    f = jnp.zeros(cap, jnp.bool_)
+    for s in _FALSE_STRS:
+        f = f | match(s)
+    return make_result(t, c.validity & (t | f), dt.BOOL)
+
+
+def _parse_date(padded, lens):
+    """yyyy-[m]m-[d]d (Spark's accepted date literal forms, no time part)."""
+    cap, w = padded.shape
+    is_digit = (padded >= 48) & (padded <= 57)
+    is_dash = padded == 45
+    k = jnp.arange(w, dtype=jnp.int32)[None, :]
+    in_str = k < lens[:, None]
+    dash_mask = in_str & is_dash
+    # first and second dash positions
+    first_dash = jnp.where(jnp.any(dash_mask, axis=1),
+                           jnp.argmax(dash_mask, axis=1), 0).astype(jnp.int32)
+    after = dash_mask & (k > first_dash[:, None])
+    second_dash = jnp.where(jnp.any(after, axis=1),
+                            jnp.argmax(after, axis=1), 0).astype(jnp.int32)
+    ok = (jnp.sum(dash_mask, axis=1) == 2) & (first_dash == 4) & \
+        (second_dash > 5) & (second_dash <= 7) & (lens > second_dash) & \
+        (lens <= second_dash + 3)
+
+    def parse_span(lo, hi):
+        v = jnp.zeros(cap, jnp.int32)
+        good = jnp.ones(cap, jnp.bool_)
+        for i in range(w):
+            use = (i >= lo) & (i < hi)
+            v = jnp.where(use, v * 10 + (padded[:, i] - 48).astype(jnp.int32), v)
+            good = good & jnp.where(use, is_digit[:, i], True)
+        return v, good
+
+    y, gy = parse_span(jnp.zeros(cap, jnp.int32), first_dash)
+    m, gm = parse_span(first_dash + 1, second_dash)
+    d, gd = parse_span(second_dash + 1, lens)
+    ok = ok & gy & gm & gd & (m >= 1) & (m <= 12) & (d >= 1) & (d <= 31)
+    days = _days_from_civil(y.astype(jnp.int64), m.astype(jnp.int64),
+                            d.astype(jnp.int64))
+    return days, ok

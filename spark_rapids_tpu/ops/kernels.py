@@ -1,0 +1,427 @@
+"""Batch kernels: the jittable cores of the physical operators.
+
+This module is the TPU replacement for the cuDF kernel surface the
+reference calls through JNI (SURVEY §2.9: Table.gather / sort / groupBy /
+hashJoinGatherMaps / partition). Everything here is a pure function over
+ColumnarBatch pytrees with **static capacities**, so each operator
+pipeline compiles to one XLA program per capacity bucket:
+
+- cardinality changes (filter/join/aggregate) keep capacity and move
+  ``num_rows``; dead rows carry validity=False,
+- sort is a chain of stable ``argsort`` passes over int64 "rank keys"
+  (IEEE total-order transform for floats, packed big-endian words for
+  strings) — radix-style multi-pass, the XLA-friendly formulation,
+- group-by is sort-based: sort by keys, flag segment boundaries,
+  scatter-reduce into a static-capacity state table (the reference uses
+  cuDF hash groupby; sorting composes better with static shapes),
+- join is hash-partition-free sort-merge: sort the build side by a
+  64-bit combined key hash, binary-search probes into it, expand match
+  lists with a searchsorted-on-cumsum gather, then verify true key
+  equality (hash collisions only waste slots, never corrupt results).
+
+Join/expansion outputs that exceed the static output capacity report the
+true row count; the host-side retry framework (memory/retry.py) splits
+the probe batch and re-runs — the TPU analogue of the reference's
+SplitAndRetryOOM contract (RmmRapidsRetryIterator.scala).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar import dtypes as dt
+from ..columnar.vector import (Column, ColumnVector, ColumnarBatch,
+                               StringColumn, live_mask)
+
+# ---------------------------------------------------------------------------
+# Filter
+# ---------------------------------------------------------------------------
+
+
+def compact(batch: ColumnarBatch, keep: jnp.ndarray) -> ColumnarBatch:
+    """Keep rows where ``keep`` (restricted to live rows), preserving order."""
+    keep = keep & batch.live_mask()
+    n = jnp.sum(keep).astype(jnp.int32)
+    idx = jnp.argsort(~keep, stable=True).astype(jnp.int32)
+    return batch.gather(idx, n)
+
+
+def filter_batch(batch: ColumnarBatch, cond: ColumnVector) -> ColumnarBatch:
+    """SQL WHERE: keep rows where the predicate is true-and-not-null."""
+    return compact(batch, cond.data & cond.validity)
+
+
+# ---------------------------------------------------------------------------
+# Sort
+# ---------------------------------------------------------------------------
+
+
+def _rank_keys(col: Column) -> List[jnp.ndarray]:
+    """Lower a column to sort-key arrays whose ascending order equals SQL
+    value order (most significant first). Floats sort natively (XLA's
+    total-order comparator puts NaN last, matching Spark once NaN and
+    -0.0 are normalized); strings become packed big-endian uint64 words.
+    No 64-bit bitcasts — see utils/bits.py."""
+    if isinstance(col, StringColumn):
+        padded = col.padded()
+        cap, w = padded.shape
+        words = []
+        for b0 in range(0, w, 8):
+            chunk = padded[:, b0:b0 + 8]
+            if chunk.shape[1] < 8:
+                chunk = jnp.pad(chunk, ((0, 0), (0, 8 - chunk.shape[1])))
+            word = jnp.zeros(cap, jnp.uint64)
+            for k in range(8):
+                word = word | (chunk[:, k].astype(jnp.uint64) << (8 * (7 - k)))
+            words.append(word)
+        return words
+    d = col.data
+    if jnp.issubdtype(d.dtype, jnp.floating):
+        d = jnp.where(d == 0.0, jnp.zeros((), d.dtype), d)
+        d = jnp.where(jnp.isnan(d), jnp.full((), jnp.nan, d.dtype), d)
+        return [d]
+    if d.dtype == jnp.bool_:
+        return [d.astype(jnp.int8)]
+    return [d]
+
+
+def sort_indices(columns: Sequence[Column], ascending: Sequence[bool],
+                 nulls_first: Sequence[bool], live) -> jnp.ndarray:
+    """Stable multi-key sort permutation; dead rows always sort last.
+
+    Chain of stable argsorts from least-significant to most-significant
+    key (classic LSD radix structure).
+    """
+    cap = columns[0].capacity if columns else live.shape[0]
+    perm = jnp.arange(cap, dtype=jnp.int32)
+    for col, asc, nf in reversed(list(zip(columns, ascending, nulls_first))):
+        keys = _rank_keys(col)
+        for key in reversed(keys):
+            k = jnp.take(key, perm)
+            perm = jnp.take(perm, jnp.argsort(k, stable=True, descending=not asc))
+        # null placement pass (most significant within this key):
+        # ascending argsort puts 0 first, so the "goes first" class maps to 0
+        null_key = jnp.take(col.validity, perm) if nf else ~jnp.take(col.validity, perm)
+        perm = jnp.take(perm, jnp.argsort(null_key.astype(jnp.int8), stable=True))
+    dead = ~jnp.take(live, perm)
+    perm = jnp.take(perm, jnp.argsort(dead.astype(jnp.int8), stable=True))
+    return perm
+
+
+def sort_batch(batch: ColumnarBatch, key_cols: Sequence[Column],
+               ascending: Sequence[bool], nulls_first: Sequence[bool]) -> ColumnarBatch:
+    perm = sort_indices(key_cols, ascending, nulls_first, batch.live_mask())
+    return batch.gather(perm, batch.num_rows)
+
+
+# ---------------------------------------------------------------------------
+# Group-by aggregate (sort-based)
+# ---------------------------------------------------------------------------
+
+
+def _adjacent_equal(col: Column) -> jnp.ndarray:
+    """eq[i] = row i equals row i-1 (null-safe); eq[0] = False."""
+    if isinstance(col, StringColumn):
+        padded = col.padded()
+        data_eq = jnp.all(padded[1:] == padded[:-1], axis=1) & \
+            (col.lengths()[1:] == col.lengths()[:-1])
+    else:
+        d = col.data
+        if jnp.issubdtype(d.dtype, jnp.floating):
+            # NaN == NaN for grouping (Spark normalizes NaNs in group keys)
+            nan_eq = jnp.isnan(d[1:]) & jnp.isnan(d[:-1])
+            data_eq = (d[1:] == d[:-1]) | nan_eq
+        else:
+            data_eq = d[1:] == d[:-1]
+    v = col.validity
+    null_safe = (v[1:] == v[:-1]) & (~v[1:] | data_eq)
+    return jnp.concatenate([jnp.zeros(1, jnp.bool_), null_safe])
+
+
+def group_ids(sorted_keys: Sequence[Column], live) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """(gid, num_groups, boundary) for key-sorted rows."""
+    cap = live.shape[0]
+    if not sorted_keys:
+        # global aggregate: one group holding all live rows
+        gid = jnp.zeros(cap, jnp.int32)
+        boundary = jnp.zeros(cap, jnp.bool_).at[0].set(True) & live
+        num_groups = jnp.minimum(jnp.sum(live), 1).astype(jnp.int32)
+        return gid, num_groups, boundary
+    eq_prev = jnp.ones(cap, jnp.bool_)
+    for col in sorted_keys:
+        eq_prev = eq_prev & _adjacent_equal(col)
+    boundary = live & ~eq_prev
+    boundary = jnp.where(jnp.arange(cap) == 0, live, boundary)
+    gid = (jnp.cumsum(boundary.astype(jnp.int32)) - 1).clip(0)
+    num_groups = jnp.sum(boundary).astype(jnp.int32)
+    return gid.astype(jnp.int32), num_groups, boundary
+
+
+def group_aggregate(batch: ColumnarBatch, key_cols: Sequence[Column],
+                    agg_inputs: Sequence[Optional[Column]], agg_fns: Sequence,
+                    mode: str) -> Tuple[ColumnarBatch, List[dict]]:
+    """Sort-based group-by. Returns (key_batch, [state dicts]).
+
+    mode: 'update' aggregates raw rows; 'merge' merges partial states
+    (agg_inputs then carry state columns via the exec layer).
+    """
+    live = batch.live_mask()
+    cap = batch.capacity
+    perm = sort_indices(key_cols, [True] * len(key_cols),
+                        [True] * len(key_cols), live)
+    live_s = jnp.take(live, perm)
+    keys_s = [c.gather(perm, live_s) for c in key_cols]
+    gid, num_groups, boundary = group_ids(keys_s, live_s)
+
+    states = []
+    for inp, fn in zip(agg_inputs, agg_fns):
+        col_s = inp.gather(perm, live_s) if inp is not None else None
+        states.append(fn.update(gid, col_s, cap, live_s))
+
+    # key output: the first sorted row of each group
+    bpos = jnp.argsort(~boundary, stable=True).astype(jnp.int32)
+    key_out = [c.gather(bpos, live_mask(cap, num_groups)) for c in keys_s]
+    key_batch = ColumnarBatch(
+        key_out, [f"k{i}" for i in range(len(key_out))], num_groups)
+    return key_batch, states
+
+
+# ---------------------------------------------------------------------------
+# Join (sort-merge on 64-bit combined key hash + verification)
+# ---------------------------------------------------------------------------
+
+
+def _join_key_hash(cols: Sequence[Column], null_sentinel: int) -> jnp.ndarray:
+    """64-bit combined hash of the key columns; rows with any null key get
+    the given sentinel. Probe and build use *different* null sentinels so
+    null keys never pair up (SQL join semantics); a real hash landing on a
+    sentinel only creates spurious candidates that the equality
+    verification pass rejects."""
+    from ..expr import hashing as H
+    cap = cols[0].capacity
+    h1 = jnp.full((cap,), 42, jnp.uint32)
+    h2 = jnp.full((cap,), 0xDEADBEEF, jnp.uint32)
+    for c in cols:
+        h1 = H.murmur3_column(c, h1)
+        h2 = H.murmur3_column(c, h2)
+    h = (h1.astype(jnp.uint64) << 32) | h2.astype(jnp.uint64)
+    any_null = jnp.zeros(cap, jnp.bool_)
+    for c in cols:
+        any_null = any_null | ~c.validity
+    h_i64 = h.astype(jnp.int64)  # wrapping convert, not bitcast (TPU-legal)
+    return jnp.where(any_null, jnp.int64(null_sentinel), h_i64)
+
+
+def _keys_equal(a_cols: Sequence[Column], a_idx, b_cols: Sequence[Column],
+                b_idx) -> jnp.ndarray:
+    """True key equality for candidate pairs (collision verification)."""
+    ok = jnp.ones(a_idx.shape[0], jnp.bool_)
+    for ca, cb in zip(a_cols, b_cols):
+        va = jnp.take(ca.validity, a_idx)
+        vb = jnp.take(cb.validity, b_idx)
+        if isinstance(ca, StringColumn):
+            pa = ca.padded()
+            pb = cb.padded()
+            w = max(ca.pad_bucket, cb.pad_bucket)
+            if ca.pad_bucket < w:
+                pa = jnp.pad(pa, ((0, 0), (0, w - ca.pad_bucket)))
+            if cb.pad_bucket < w:
+                pb = jnp.pad(pb, ((0, 0), (0, w - cb.pad_bucket)))
+            eq = jnp.all(jnp.take(pa, a_idx, axis=0) == jnp.take(pb, b_idx, axis=0),
+                         axis=1)
+        else:
+            da = jnp.take(ca.data, a_idx)
+            db = jnp.take(cb.data, b_idx)
+            if da.dtype != db.dtype:
+                tgt = jnp.promote_types(da.dtype, db.dtype)
+                da = da.astype(tgt)
+                db = db.astype(tgt)
+            eq = da == db
+        ok = ok & va & vb & eq
+    return ok
+
+
+def join_gather_maps(probe_keys: Sequence[Column], build_keys: Sequence[Column],
+                     probe_live, build_live, out_capacity: int):
+    """Compute (probe_idx, build_idx, pair_valid, total_pairs) gather maps
+    for matching pairs — the cuDF ``hashJoinGatherMaps`` equivalent.
+
+    total_pairs is the true match count; if it exceeds out_capacity the
+    caller must split and retry.
+    """
+    imax = jnp.iinfo(jnp.int64).max
+    cap_b = build_keys[0].capacity
+    bh = _join_key_hash(build_keys, imax - 2)
+    bh = jnp.where(build_live, bh, jnp.int64(imax))
+    order = jnp.argsort(bh, stable=True).astype(jnp.int32)
+    bh_sorted = jnp.take(bh, order)
+
+    ph = _join_key_hash(probe_keys, imax - 3)
+    ph = jnp.where(probe_live, ph, jnp.int64(imax - 1))
+    lo = jnp.searchsorted(bh_sorted, ph, side="left").astype(jnp.int32)
+    hi = jnp.searchsorted(bh_sorted, ph, side="right").astype(jnp.int32)
+    counts = jnp.where(probe_live, hi - lo, 0)
+
+    offsets = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(counts, dtype=jnp.int32)])
+    total_cand = offsets[-1]
+    pos = jnp.arange(out_capacity, dtype=jnp.int32)
+    probe_row = jnp.searchsorted(offsets[1:], pos, side="right").astype(jnp.int32)
+    probe_row = jnp.clip(probe_row, 0, probe_keys[0].capacity - 1)
+    within = pos - jnp.take(offsets, probe_row)
+    build_sorted_pos = jnp.take(lo, probe_row) + within
+    build_row = jnp.take(order, jnp.clip(build_sorted_pos, 0, cap_b - 1))
+    cand_valid = pos < total_cand
+
+    true_eq = _keys_equal(probe_keys, probe_row, build_keys, build_row)
+    pair_valid = cand_valid & true_eq
+    return probe_row, build_row, pair_valid, total_cand, counts
+
+
+def inner_join(probe: ColumnarBatch, build: ColumnarBatch,
+               probe_keys: Sequence[Column], build_keys: Sequence[Column],
+               out_capacity: int) -> Tuple[ColumnarBatch, jnp.ndarray]:
+    """Inner join; returns (joined_batch, candidate_total) — the candidate
+    total lets the host detect output-capacity overflow."""
+    p_idx, b_idx, pair_valid, total_cand, _ = join_gather_maps(
+        probe_keys, build_keys, probe.live_mask(), build.live_mask(), out_capacity)
+    compact_idx = jnp.argsort(~pair_valid, stable=True).astype(jnp.int32)
+    n_out = jnp.sum(pair_valid).astype(jnp.int32)
+    p_take = jnp.take(p_idx, compact_idx)
+    b_take = jnp.take(b_idx, compact_idx)
+    valid = live_mask(out_capacity, n_out)
+    out_cols = [c.gather(p_take, valid) for c in probe.columns] + \
+        [c.gather(b_take, valid) for c in build.columns]
+    out_names = probe.names + build.names
+    return ColumnarBatch(out_cols, out_names, n_out), total_cand
+
+
+def left_join(probe: ColumnarBatch, build: ColumnarBatch,
+              probe_keys: Sequence[Column], build_keys: Sequence[Column],
+              out_capacity: int) -> Tuple[ColumnarBatch, jnp.ndarray]:
+    """Left outer join with probe as the left/stream side."""
+    cap_p = probe.capacity
+    p_idx, b_idx, pair_valid, total_cand, _ = join_gather_maps(
+        probe_keys, build_keys, probe.live_mask(), build.live_mask(), out_capacity)
+    # per-probe-row true match count
+    match_per_probe = jnp.zeros(cap_p, jnp.int32).at[p_idx].add(
+        pair_valid.astype(jnp.int32))
+    unmatched = probe.live_mask() & (match_per_probe == 0)
+    n_pairs = jnp.sum(pair_valid).astype(jnp.int32)
+    n_unmatched = jnp.sum(unmatched).astype(jnp.int32)
+    n_out = n_pairs + n_unmatched
+
+    pair_order = jnp.argsort(~pair_valid, stable=True).astype(jnp.int32)
+    un_order = jnp.argsort(~unmatched, stable=True).astype(jnp.int32)
+    pos = jnp.arange(out_capacity, dtype=jnp.int32)
+    from_pairs = pos < n_pairs
+    p_take = jnp.where(from_pairs,
+                       jnp.take(p_idx, jnp.take(pair_order, jnp.clip(pos, 0, out_capacity - 1))),
+                       jnp.take(un_order, jnp.clip(pos - n_pairs, 0, cap_p - 1)))
+    b_take = jnp.take(b_idx, jnp.take(pair_order, jnp.clip(pos, 0, out_capacity - 1)))
+    valid = live_mask(out_capacity, n_out)
+    build_valid = valid & from_pairs
+    out_cols = [c.gather(p_take, valid) for c in probe.columns] + \
+        [c.gather(b_take, build_valid) for c in build.columns]
+    return ColumnarBatch(out_cols, probe.names + build.names, n_out), total_cand
+
+
+def semi_anti_join(probe: ColumnarBatch, build_keys: Sequence[Column],
+                   probe_keys: Sequence[Column], build_live,
+                   anti: bool, scratch_capacity: Optional[int] = None) -> ColumnarBatch:
+    """Left semi / anti join — output rows come only from the probe side
+    (no expansion), but the *candidate window* can still overflow when
+    build keys are heavily duplicated. total_cand is returned so the host
+    retries with a larger scratch_capacity when total_cand exceeds it."""
+    cap_p = probe.capacity
+    scratch = scratch_capacity or cap_p
+    p_idx, b_idx, pair_valid, total_cand, counts = join_gather_maps(
+        probe_keys, build_keys, probe.live_mask(), build_live, scratch)
+    matched = jnp.zeros(cap_p, jnp.bool_).at[p_idx].max(pair_valid)
+    keep = probe.live_mask() & (~matched if anti else matched)
+    return compact(probe, keep), total_cand
+
+
+# ---------------------------------------------------------------------------
+# Concat / limit / slice
+# ---------------------------------------------------------------------------
+
+
+def concat_columns(cols: Sequence[Column], caps: Sequence[int], counts,
+                   out_capacity: int) -> Column:
+    """Concatenate the live prefixes of columns into one column."""
+    if isinstance(cols[0], StringColumn):
+        return _concat_strings(cols, caps, counts, out_capacity)
+    phys = cols[0].data.dtype
+    data = jnp.zeros(out_capacity, phys)
+    validity = jnp.zeros(out_capacity, jnp.bool_)
+    offset = jnp.int32(0)
+    for c, cap, n in zip(cols, caps, counts):
+        idx = jnp.arange(out_capacity, dtype=jnp.int32) - offset
+        in_range = (idx >= 0) & (idx < n)
+        take = jnp.clip(idx, 0, cap - 1)
+        data = jnp.where(in_range, jnp.take(c.data, take), data)
+        validity = jnp.where(in_range, jnp.take(c.validity, take), validity)
+        offset = offset + n.astype(jnp.int32) if hasattr(n, "astype") else offset + n
+    return ColumnVector(data, validity, cols[0].dtype)
+
+
+def _concat_strings(cols: Sequence[StringColumn], caps, counts,
+                    out_capacity: int) -> StringColumn:
+    lens = jnp.zeros(out_capacity, jnp.int32)
+    validity = jnp.zeros(out_capacity, jnp.bool_)
+    offset = jnp.int32(0)
+    for c, cap, n in zip(cols, caps, counts):
+        idx = jnp.arange(out_capacity, dtype=jnp.int32) - offset
+        in_range = (idx >= 0) & (idx < n)
+        take = jnp.clip(idx, 0, cap - 1)
+        lens = jnp.where(in_range, jnp.take(c.lengths(), take), lens)
+        validity = jnp.where(in_range, jnp.take(c.validity, take), validity)
+        offset = offset + (n.astype(jnp.int32) if hasattr(n, "astype") else jnp.int32(n))
+    offsets = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(lens, dtype=jnp.int32)])
+    char_cap = sum(c.char_capacity for c in cols)
+    pos = jnp.arange(char_cap, dtype=jnp.int32)
+    row = jnp.searchsorted(offsets[1:], pos, side="right").astype(jnp.int32)
+    row_c = jnp.clip(row, 0, out_capacity - 1)
+    within = pos - jnp.take(offsets, row_c)
+    # map row -> source column and source row
+    byte = jnp.zeros(char_cap, jnp.uint8)
+    offset = jnp.int32(0)
+    for c, cap, n in zip(cols, caps, counts):
+        nn = n.astype(jnp.int32) if hasattr(n, "astype") else jnp.int32(n)
+        src_row = row_c - offset
+        mine = (src_row >= 0) & (src_row < nn)
+        src_row_c = jnp.clip(src_row, 0, cap - 1)
+        src = jnp.take(c.offsets[:-1], src_row_c) + within
+        b = jnp.take(c.chars, jnp.clip(src, 0, c.char_capacity - 1))
+        byte = jnp.where(mine, b, byte)
+        offset = offset + nn
+    total = offsets[out_capacity]
+    chars = jnp.where(pos < total, byte, jnp.zeros((), jnp.uint8))
+    pad = max(c.pad_bucket for c in cols)
+    return StringColumn(offsets, chars, validity, pad_bucket=pad)
+
+
+def concat_batches(batches: Sequence[ColumnarBatch],
+                   out_capacity: int) -> ColumnarBatch:
+    """Concatenate batches (same schema) into one batch of out_capacity."""
+    counts = [b.num_rows for b in batches]
+    total = sum(int(c) if isinstance(c, int) else c for c in counts)
+    caps = [b.capacity for b in batches]
+    names = batches[0].names
+    out_cols = []
+    for ci in range(len(names)):
+        cols = [b.columns[ci] for b in batches]
+        out_cols.append(concat_columns(cols, caps, counts, out_capacity))
+    return ColumnarBatch(out_cols, names, total)
+
+
+def local_limit(batch: ColumnarBatch, n: int) -> ColumnarBatch:
+    new_n = jnp.minimum(batch.num_rows, n)
+    mask = live_mask(batch.capacity, new_n)
+    cols = [c.with_validity(c.validity & mask) for c in batch.columns]
+    return ColumnarBatch(cols, batch.names, new_n)

@@ -1,0 +1,52 @@
+"""Bit-manipulation helpers that stay TPU-legal.
+
+XLA's TPU x64 rewriting represents 64-bit integers as 32-bit pairs and
+does NOT implement 64-bit ``bitcast-convert`` — so ``.view(int64)`` /
+``.view(uint64)`` must never appear in device code. Integer
+reinterpretation uses wrapping ``astype`` (XLA convert wraps mod 2^64);
+float64 bit extraction is done arithmetically via frexp/ldexp.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def i64_to_u64(x):
+    """Reinterpret int64 as uint64 (wrapping convert — no bitcast)."""
+    return x.astype(jnp.uint64)
+
+
+def u64_to_i64(x):
+    return x.astype(jnp.int64)
+
+
+def f64_bits(x) -> jnp.ndarray:
+    """IEEE-754 bits of float64 as uint64, computed arithmetically.
+
+    Callers are expected to have normalized NaN (canonical positive) and
+    -0.0 (to +0.0) beforehand if Spark hashing semantics are required.
+    Exact for normals, subnormals, zeros, infinities, canonical NaN.
+    """
+    sign = x < 0
+    ax = jnp.abs(x)
+    m, e = jnp.frexp(ax)  # ax = m * 2^e, m in [0.5, 1)
+    is_zero = ax == 0
+    is_inf = jnp.isinf(ax)
+    is_nan = jnp.isnan(x)
+    biased = e + 1022
+    subnormal = biased <= 0
+    frac_normal = jnp.ldexp(m * 2.0 - 1.0, jnp.full_like(e, 52))
+    frac_sub = jnp.ldexp(ax, jnp.full_like(e, 1074))
+    frac = jnp.where(subnormal, frac_sub, frac_normal).astype(jnp.uint64)
+    exp_field = jnp.clip(jnp.where(subnormal, 0, biased), 0, 2046).astype(jnp.uint64)
+    bits = (exp_field << 52) | frac
+    bits = jnp.where(is_inf, jnp.uint64(0x7FF0000000000000), bits)
+    bits = jnp.where(is_nan, jnp.uint64(0x7FF8000000000000), bits)
+    bits = jnp.where(is_zero, jnp.uint64(0), bits)
+    return bits | (sign.astype(jnp.uint64) << 63)
+
+
+def f32_bits_u32(x) -> jnp.ndarray:
+    """float32 bits as uint32 — 32-bit bitcast is native on TPU."""
+    return x.view(jnp.uint32)

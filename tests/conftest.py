@@ -1,0 +1,25 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Mirrors the reference's single-host test strategy (SURVEY §4: "no real
+multi-node cluster is used anywhere") — all distributed paths are
+exercised on a virtual device mesh.
+"""
+
+import os
+
+# Must be set before jax initializes its backends. Tests run on a virtual
+# 8-device CPU mesh (fast, deterministic); set SRT_TEST_TPU=1 to run the
+# TPU smoke lane against real hardware instead.
+if not os.environ.get("SRT_TEST_TPU"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+# Persistent compile cache: kernel shapes repeat across test runs.
+jax.config.update("jax_compilation_cache_dir", "/tmp/srt_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

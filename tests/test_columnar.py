@@ -1,0 +1,82 @@
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar import (
+    ColumnarBatch, batch_from_pydict, batch_to_pydict, choose_capacity,
+    column_from_numpy, dtypes as dt)
+
+
+def test_choose_capacity_buckets():
+    assert choose_capacity(1) == 8
+    assert choose_capacity(8) == 8
+    assert choose_capacity(9) == 16
+    assert choose_capacity(1000) == 1024
+
+
+def test_roundtrip_primitives():
+    data = {"a": [1, 2, None, 4], "b": [1.5, None, 3.0, 4.5], "c": [True, False, None, True]}
+    b = batch_from_pydict(data)
+    assert b.num_rows == 4
+    assert b.capacity == 8
+    out = batch_to_pydict(b)
+    assert out["a"] == [1, 2, None, 4]
+    assert out["b"] == [1.5, None, 3.0, 4.5]
+    assert out["c"] == [True, False, None, True]
+
+
+def test_roundtrip_strings():
+    data = {"s": ["hello", None, "", "world!", "tpu"]}
+    b = batch_from_pydict(data)
+    out = batch_to_pydict(b)
+    assert out["s"] == ["hello", None, "", "world!", "tpu"]
+
+
+def test_dead_rows_are_invalid():
+    b = batch_from_pydict({"a": [1, 2, 3]})
+    col = b.column("a")
+    validity = np.asarray(col.validity)
+    assert validity[:3].all()
+    assert not validity[3:].any()
+
+
+def test_gather_primitives():
+    b = batch_from_pydict({"a": [10, 20, 30, None]})
+    idx = jnp.array([3, 1, 0, 0, 0, 0, 0, 0], dtype=jnp.int32)
+    g = b.gather(idx, 3)
+    out = batch_to_pydict(g)
+    assert out["a"] == [None, 20, 10]
+
+
+def test_gather_strings():
+    b = batch_from_pydict({"s": ["aa", "bbb", None, "c"]})
+    idx = jnp.array([3, 0, 1, 0, 0, 0, 0, 0], dtype=jnp.int32)
+    g = b.gather(idx, 3)
+    out = batch_to_pydict(g)
+    assert out["s"] == ["c", "aa", "bbb"]
+
+
+def test_schema_and_explicit_types():
+    b = batch_from_pydict({"a": [1, 2]}, schema=[("a", dt.INT32)])
+    assert b.schema() == [("a", dt.INT32)]
+
+
+def test_batch_is_pytree():
+    import jax
+    b = batch_from_pydict({"a": [1, 2, 3], "s": ["x", "y", "z"]})
+
+    @jax.jit
+    def ident(batch):
+        return batch
+
+    b2 = ident(b)
+    assert batch_to_pydict(b2) == batch_to_pydict(b)
+
+
+def test_promote():
+    assert dt.promote(dt.INT32, dt.INT64) == dt.INT64
+    assert dt.promote(dt.INT64, dt.FLOAT32) == dt.FLOAT32
+    assert dt.promote(dt.INT8, dt.INT8) == dt.INT8
+    with pytest.raises(TypeError):
+        dt.promote(dt.INT32, dt.DecimalType(10, 2))

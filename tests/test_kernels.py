@@ -1,0 +1,124 @@
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar import batch_from_pydict, batch_to_pydict, dtypes as dt
+from spark_rapids_tpu.expr import aggregates as agg
+from spark_rapids_tpu.ops import kernels as K
+
+
+def _mk(data, **kw):
+    return batch_from_pydict(data, **kw)
+
+
+def test_filter_compact():
+    b = _mk({"a": [1, 2, 3, 4, 5], "s": ["a", "bb", "cc", "d", "e"]})
+    keep = jnp.array([True, False, True, False, True, True, True, True])
+    out = K.compact(b, keep)
+    d = batch_to_pydict(out)
+    assert d["a"] == [1, 3, 5]
+    assert d["s"] == ["a", "cc", "e"]
+
+
+def test_sort_single_key():
+    b = _mk({"a": [3, 1, None, 2, 1]})
+    out = K.sort_batch(b, [b.column("a")], [True], [True])
+    assert batch_to_pydict(out)["a"] == [None, 1, 1, 2, 3]
+    out = K.sort_batch(b, [b.column("a")], [False], [False])
+    assert batch_to_pydict(out)["a"] == [3, 2, 1, 1, None]
+
+
+def test_sort_floats_nan():
+    b = _mk({"a": [1.5, float("nan"), -0.0, None, 2.5]})
+    out = K.sort_batch(b, [b.column("a")], [True], [True])
+    r = batch_to_pydict(out)["a"]
+    assert r[0] is None
+    assert r[1] == 0.0 and r[2] == 1.5 and r[3] == 2.5
+    assert np.isnan(r[4])
+
+
+def test_sort_strings():
+    b = _mk({"s": ["pear", "apple", None, "app", "banana"]})
+    out = K.sort_batch(b, [b.column("s")], [True], [True])
+    assert batch_to_pydict(out)["s"] == [None, "app", "apple", "banana", "pear"]
+
+
+def test_sort_multi_key_stable():
+    b = _mk({"k": [1, 2, 1, 2, 1], "v": [30, 10, 20, 40, 10]})
+    out = K.sort_batch(b, [b.column("k"), b.column("v")], [True, False], [True, True])
+    d = batch_to_pydict(out)
+    assert d["k"] == [1, 1, 1, 2, 2]
+    assert d["v"] == [30, 20, 10, 40, 10]
+
+
+def test_group_aggregate_sum_count():
+    b = _mk({"k": [1, 2, 1, None, 2, 1], "v": [10, 20, 30, 40, None, 50]})
+    s = agg.Sum(None)
+    c = agg.CountStar()
+    key_batch, states = K.group_aggregate(
+        b, [b.column("k")], [b.column("v"), None], [s, c], "update")
+    n = int(key_batch.num_rows)
+    assert n == 3
+    keys, kmask = key_batch.columns[0].to_numpy(n)
+    sums = np.asarray(states[0]["sum"])[:n]
+    counts = np.asarray(states[1]["count"])[:n]
+    # sorted key order: null first, then 1, 2
+    assert not kmask[0] and keys[1] == 1 and keys[2] == 2
+    assert sums[0] == 40 and sums[1] == 90 and sums[2] == 20
+    assert counts[0] == 1 and counts[1] == 3 and counts[2] == 2
+
+
+def test_inner_join():
+    left = _mk({"k": [1, 2, 3, None, 2], "lv": [10, 20, 30, 40, 50]})
+    right = _mk({"k2": [2, 4, 2, None], "rv": [200, 400, 201, 999]})
+    out, total = K.inner_join(left, right, [left.column("k")],
+                              [right.column("k2")], 32)
+    d = batch_to_pydict(out)
+    rows = sorted(zip(d["k"], d["lv"], d["rv"]))
+    assert rows == [(2, 20, 200), (2, 20, 201), (2, 50, 200), (2, 50, 201)]
+    assert int(total) == 4
+
+
+def test_left_join():
+    left = _mk({"k": [1, 2, None], "lv": [10, 20, 30]})
+    right = _mk({"k2": [2, 2], "rv": [100, 200]})
+    out, _ = K.left_join(left, right, [left.column("k")],
+                         [right.column("k2")], 32)
+    d = batch_to_pydict(out)
+    rows = sorted(zip([x if x is not None else -1 for x in d["k"]],
+                      d["lv"], [x if x is not None else -1 for x in d["rv"]]))
+    assert rows == [(-1, 30, -1), (1, 10, -1), (2, 20, 100), (2, 20, 200)]
+
+
+def test_semi_anti_join():
+    left = _mk({"k": [1, 2, 3, None], "lv": [10, 20, 30, 40]})
+    right = _mk({"k2": [2, 3, 3]})
+    semi, _ = K.semi_anti_join(left, [right.column("k2")],
+                               [left.column("k")], right.live_mask(), False)
+    assert batch_to_pydict(semi)["lv"] == [20, 30]
+    anti, _ = K.semi_anti_join(left, [right.column("k2")],
+                               [left.column("k")], right.live_mask(), True)
+    assert batch_to_pydict(anti)["lv"] == [10, 40]
+
+
+def test_string_join_keys():
+    left = _mk({"k": ["a", "bb", "cc"], "lv": [1, 2, 3]})
+    right = _mk({"k2": ["bb", "dd"], "rv": [20, 40]})
+    out, _ = K.inner_join(left, right, [left.column("k")],
+                          [right.column("k2")], 16)
+    d = batch_to_pydict(out)
+    assert d["k"] == ["bb"] and d["lv"] == [2] and d["rv"] == [20]
+
+
+def test_concat_batches():
+    b1 = _mk({"a": [1, 2], "s": ["x", "yy"]})
+    b2 = _mk({"a": [3, None], "s": [None, "zz"]})
+    out = K.concat_batches([b1, b2], 16)
+    d = batch_to_pydict(out)
+    assert d["a"] == [1, 2, 3, None]
+    assert d["s"] == ["x", "yy", None, "zz"]
+
+
+def test_local_limit():
+    b = _mk({"a": [1, 2, 3, 4, 5]})
+    out = K.local_limit(b, 3)
+    assert batch_to_pydict(out)["a"] == [1, 2, 3]
