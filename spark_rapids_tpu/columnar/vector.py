@@ -134,35 +134,43 @@ class StringColumn:
         take = jnp.take(self.chars, jnp.clip(idx, 0, self.char_capacity - 1))
         return jnp.where(k[None, :] < lens[:, None], take, jnp.zeros((), jnp.uint8))
 
-    def gather(self, indices: jax.Array, valid: Optional[jax.Array] = None) -> "StringColumn":
+    def gather(self, indices: jax.Array, valid: Optional[jax.Array] = None,
+               out_char_capacity: Optional[int] = None) -> "StringColumn":
         """Gather string rows, repacking bytes into a new flat buffer.
 
-        Keeps char_capacity; if gathered bytes exceed it the caller must
-        have sized buffers so total bytes are preserved (gather of a
-        permutation, the common case for sort/join output).
+        The output has ``len(indices)`` rows. The output byte buffer is
+        ``out_char_capacity`` (default: the source's char_capacity, right
+        for permutation-like gathers); expanding gathers — joins with
+        duplicate keys — must pass a larger static bound or bytes beyond
+        it are truncated to empty strings.
         """
-        cap = self.capacity
-        safe = jnp.clip(indices, 0, cap - 1)
+        src_cap = self.capacity
+        out_cap = indices.shape[0]
+        nbytes_cap = out_char_capacity or self.char_capacity
+        safe = jnp.clip(indices, 0, src_cap - 1)
         starts = jnp.take(self.offsets[:-1], safe)
         lens = jnp.take(self.lengths(), safe)
         validity = jnp.take(self.validity, safe)
         if valid is not None:
             validity = validity & valid
             lens = jnp.where(valid, lens, 0)
+        # Truncate rows that would start past the output buffer: they
+        # become empty rather than corrupting neighbours.
+        ends = jnp.cumsum(lens, dtype=jnp.int32)
+        lens = jnp.where(ends <= nbytes_cap, lens, 0)
         new_offsets = jnp.concatenate(
             [jnp.zeros((1,), jnp.int32), jnp.cumsum(lens, dtype=jnp.int32)])
         # Scatter-free repack: for each output byte position find its row via
         # searchsorted, then index into the source chars buffer.
-        nbytes_cap = self.char_capacity
         pos = jnp.arange(nbytes_cap, dtype=jnp.int32)
         row = jnp.searchsorted(new_offsets[1:], pos, side="right").astype(jnp.int32)
-        row_c = jnp.clip(row, 0, cap - 1)
+        row_c = jnp.clip(row, 0, out_cap - 1)
         within = pos - jnp.take(new_offsets, row_c)
         src = jnp.take(starts, row_c) + within
-        total = new_offsets[cap]
+        total = new_offsets[out_cap]
         new_chars = jnp.where(
             pos < total,
-            jnp.take(self.chars, jnp.clip(src, 0, nbytes_cap - 1)),
+            jnp.take(self.chars, jnp.clip(src, 0, self.char_capacity - 1)),
             jnp.zeros((), jnp.uint8))
         return StringColumn(new_offsets, new_chars, validity, self.pad_bucket)
 

@@ -1,0 +1,338 @@
+"""Configuration system — the RapidsConf equivalent.
+
+TPU-native analogue of the reference's config layer
+(sql-plugin/.../RapidsConf.scala: ConfBuilder/TypedConfBuilder DSL at
+lines 200-310, ~300 ``spark.rapids.*`` entries, doc generation via
+``RapidsConf.main`` at :2214). Same shape here: a typed builder DSL that
+registers every config with type, default, validation, and doc string
+under the ``srt.`` prefix (``spark_rapids_tpu``), plus markdown doc-gen
+so docs never drift from code.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+
+class ConfEntry:
+    """One registered configuration key."""
+
+    def __init__(self, key: str, conv: Callable[[str], Any], default: Any,
+                 doc: str, is_internal: bool, is_startup_only: bool,
+                 commonly_used: bool,
+                 checker: Optional[Callable[[Any], Optional[str]]] = None):
+        self.key = key
+        self.conv = conv
+        self.default = default
+        self.doc = doc
+        self.is_internal = is_internal
+        self.is_startup_only = is_startup_only
+        self.commonly_used = commonly_used
+        self.checker = checker
+
+    def get(self, settings: Dict[str, str]) -> Any:
+        raw = settings.get(self.key)
+        if raw is None:
+            raw = os.environ.get(self.key.replace(".", "_").upper())
+        if raw is None:
+            return self.default
+        value = self.conv(raw) if isinstance(raw, str) else raw
+        if self.checker is not None:
+            err = self.checker(value)
+            if err:
+                raise ValueError(f"{self.key}={value!r}: {err}")
+        return value
+
+
+_REGISTRY: Dict[str, ConfEntry] = {}
+
+
+class ConfBuilder:
+    """Typed builder DSL (TypedConfBuilder in the reference)."""
+
+    def __init__(self, key: str):
+        assert key.startswith("srt."), key
+        self.key = key
+        self._doc = ""
+        self._internal = False
+        self._startup_only = False
+        self._commonly_used = False
+        self._checker: Optional[Callable[[Any], Optional[str]]] = None
+
+    def doc(self, text: str) -> "ConfBuilder":
+        self._doc = text
+        return self
+
+    def internal(self) -> "ConfBuilder":
+        self._internal = True
+        return self
+
+    def startup_only(self) -> "ConfBuilder":
+        self._startup_only = True
+        return self
+
+    def commonly_used(self) -> "ConfBuilder":
+        self._commonly_used = True
+        return self
+
+    def check(self, fn: Callable[[Any], Optional[str]]) -> "ConfBuilder":
+        self._checker = fn
+        return self
+
+    def check_values(self, allowed: List[Any]) -> "ConfBuilder":
+        return self.check(
+            lambda v: None if v in allowed else f"must be one of {allowed}")
+
+    def _register(self, conv, default) -> ConfEntry:
+        entry = ConfEntry(self.key, conv, default, self._doc, self._internal,
+                          self._startup_only, self._commonly_used, self._checker)
+        _REGISTRY[self.key] = entry
+        return entry
+
+    def boolean(self, default: bool) -> ConfEntry:
+        return self._register(
+            lambda s: s.strip().lower() in ("true", "1", "yes"), default)
+
+    def integer(self, default: int) -> ConfEntry:
+        return self._register(int, default)
+
+    def double(self, default: float) -> ConfEntry:
+        return self._register(float, default)
+
+    def string(self, default: Optional[str]) -> ConfEntry:
+        return self._register(str, default)
+
+    def bytes_(self, default: int) -> ConfEntry:
+        return self._register(parse_bytes, default)
+
+
+def conf(key: str) -> ConfBuilder:
+    return ConfBuilder(key)
+
+
+def parse_bytes(s: str) -> int:
+    """'512m', '2g', '1024' -> bytes."""
+    s = s.strip().lower()
+    units = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30, "t": 1 << 40, "b": 1}
+    if s and s[-1] in units:
+        return int(float(s[:-1]) * units[s[-1]])
+    return int(s)
+
+
+def _positive(v) -> Optional[str]:
+    return None if v > 0 else "must be positive"
+
+
+def _fraction(v) -> Optional[str]:
+    return None if 0.0 < v <= 1.0 else "must be in (0, 1]"
+
+
+# ---------------------------------------------------------------------------
+# Registered configs. Reference counterparts cited per entry.
+# ---------------------------------------------------------------------------
+
+SQL_ENABLED = conf("srt.sql.enabled") \
+    .doc("Enable TPU acceleration of SQL operators. When false every plan "
+         "runs on the CPU oracle path. (spark.rapids.sql.enabled)") \
+    .commonly_used().boolean(True)
+
+EXPLAIN = conf("srt.sql.explain") \
+    .doc("Explain mode: NONE, NOT_ON_TPU (log only operators that could not "
+         "be placed on TPU and why), ALL. (spark.rapids.sql.explain, "
+         "RapidsConf.scala:1807)") \
+    .check_values(["NONE", "NOT_ON_TPU", "ALL"]).string("NONE")
+
+BATCH_SIZE_ROWS = conf("srt.sql.batchSizeRows") \
+    .doc("Target rows per columnar batch; capacities are bucketed to powers "
+         "of two at or below this. (spark.rapids.sql.batchSizeBytes, "
+         "RapidsConf.scala:550 — rows not bytes because XLA buffers are "
+         "statically shaped per column)") \
+    .check(_positive).commonly_used().integer(1 << 20)
+
+BATCH_SIZE_BYTES = conf("srt.sql.batchSizeBytes") \
+    .doc("Soft cap on bytes per batch used by the coalesce planner. "
+         "(spark.rapids.sql.batchSizeBytes)") \
+    .check(_positive).bytes_(1 << 30)
+
+CONCURRENT_TASKS = conf("srt.sql.concurrentTpuTasks") \
+    .doc("Number of host threads allowed to submit device work "
+         "concurrently. (spark.rapids.sql.concurrentGpuTasks, "
+         "RapidsConf.scala:535)") \
+    .check(_positive).commonly_used().integer(2)
+
+DEVICE_MEMORY_LIMIT = conf("srt.memory.tpu.poolSize") \
+    .doc("HBM budget in bytes for columnar batches; 0 means derive from the "
+         "device. Exceeding it triggers spill-and-retry. "
+         "(spark.rapids.memory.gpu.allocFraction / pool init, "
+         "GpuDeviceManager.scala:275)") \
+    .startup_only().bytes_(0)
+
+DEVICE_MEMORY_FRACTION = conf("srt.memory.tpu.allocFraction") \
+    .doc("Fraction of device HBM usable for batches when poolSize=0. "
+         "(spark.rapids.memory.gpu.allocFraction)") \
+    .check(_fraction).double(0.75)
+
+HOST_SPILL_LIMIT = conf("srt.memory.host.spillStorageSize") \
+    .doc("Host memory for spilled buffers before overflowing to disk. "
+         "(spark.rapids.memory.host.spillStorageSize)") \
+    .bytes_(4 << 30)
+
+SPILL_DIR = conf("srt.memory.spill.dir") \
+    .doc("Directory for disk-tier spill files. (Spark local dirs in the "
+         "reference, RapidsDiskStore.scala)") \
+    .string("/tmp/srt_spill")
+
+RETRY_MAX_SPLITS = conf("srt.memory.retry.maxSplits") \
+    .doc("Max recursive halvings of an input batch under "
+         "split-and-retry before giving up. (RmmRapidsRetryIterator "
+         "semantics)") \
+    .check(_positive).integer(8)
+
+OOM_INJECTION_MODE = conf("srt.test.oomInjection.mode") \
+    .doc("Test-only: inject synthetic OOM on the Nth allocation "
+         "(RmmSpark.forceRetryOOM analogue). NONE|RETRY|SPLIT") \
+    .internal().check_values(["NONE", "RETRY", "SPLIT"]).string("NONE")
+
+READER_TYPE = conf("srt.sql.format.parquet.reader.type") \
+    .doc("Parquet reader strategy: PERFILE, COALESCING, or MULTITHREADED "
+         "(cloud). (spark.rapids.sql.format.parquet.reader.type, "
+         "GpuParquetScan.scala:1862,2057)") \
+    .check_values(["PERFILE", "COALESCING", "MULTITHREADED"]) \
+    .string("COALESCING")
+
+READER_THREADS = conf("srt.sql.multiThreadedRead.numThreads") \
+    .doc("Host threads for the multithreaded reader pool. "
+         "(spark.rapids.sql.multiThreadedRead.numThreads)") \
+    .check(_positive).integer(8)
+
+MAX_READER_BATCH_SIZE_ROWS = conf("srt.sql.reader.batchSizeRows") \
+    .doc("Soft cap on rows per scan batch. "
+         "(spark.rapids.sql.reader.batchSizeRows)") \
+    .check(_positive).integer(1 << 20)
+
+SHUFFLE_MODE = conf("srt.shuffle.mode") \
+    .doc("Shuffle transport: MESH (XLA all-to-all over ICI/DCN), "
+         "MULTITHREADED (host partition exchange), CACHE_ONLY (single "
+         "process). (spark.rapids.shuffle.mode, RapidsConf.scala:1495)") \
+    .check_values(["MESH", "MULTITHREADED", "CACHE_ONLY"]).string("CACHE_ONLY")
+
+SHUFFLE_PARTITIONS = conf("srt.shuffle.partitions") \
+    .doc("Default shuffle partition count (spark.sql.shuffle.partitions)") \
+    .check(_positive).integer(8)
+
+SHUFFLE_COMPRESS = conf("srt.shuffle.compression.codec") \
+    .doc("Codec for serialized shuffle buffers: NONE or LZ4. "
+         "(spark.rapids.shuffle.compression.codec, nvcomp LZ4 in the "
+         "reference)") \
+    .check_values(["NONE", "LZ4"]).string("NONE")
+
+METRICS_LEVEL = conf("srt.sql.metrics.level") \
+    .doc("Operator metric detail: ESSENTIAL, MODERATE, DEBUG. "
+         "(spark.rapids.sql.metrics.level, GpuExec.scala:36-49)") \
+    .check_values(["ESSENTIAL", "MODERATE", "DEBUG"]).string("MODERATE")
+
+CPU_ORACLE_STRICT = conf("srt.test.cpuOracle.strict") \
+    .doc("Test-only: fail instead of falling back when an operator cannot "
+         "run on TPU (assert_tpu_fallback analogue).") \
+    .internal().boolean(False)
+
+ALLOW_INCOMPAT = conf("srt.sql.incompatibleOps.enabled") \
+    .doc("Enable operators whose semantics differ from Spark in corner "
+         "cases. (spark.rapids.sql.incompatibleOps.enabled)") \
+    .boolean(True)
+
+ANSI_ENABLED = conf("srt.sql.ansi.enabled") \
+    .doc("ANSI mode: arithmetic overflow and invalid casts raise instead "
+         "of returning null/wrapping (spark.sql.ansi.enabled semantics; "
+         "GpuCast.scala AnsiCast paths).") \
+    .boolean(False)
+
+MESH_DATA_AXIS = conf("srt.mesh.dataAxis") \
+    .doc("Name of the mesh axis partitions are sharded over.") \
+    .internal().string("data")
+
+
+class SrtConf:
+    """Immutable snapshot of settings, one per session (RapidsConf)."""
+
+    def __init__(self, settings: Optional[Dict[str, Any]] = None):
+        self._settings = dict(settings or {})
+        for k in self._settings:
+            if k.startswith("srt.") and k not in _REGISTRY:
+                raise KeyError(f"unknown config {k!r}; registered: "
+                               f"{sorted(_REGISTRY)}")
+
+    def get(self, entry: ConfEntry):
+        return entry.get(self._settings)
+
+    def with_settings(self, **kv) -> "SrtConf":
+        s = dict(self._settings)
+        s.update({k.replace("_", "."): v for k, v in kv.items()})
+        return SrtConf(s)
+
+    def set(self, key: str, value) -> "SrtConf":
+        s = dict(self._settings)
+        s[key] = value
+        return SrtConf(s)
+
+    # Property shorthands used across the codebase
+    @property
+    def sql_enabled(self) -> bool:
+        return self.get(SQL_ENABLED)
+
+    @property
+    def explain(self) -> str:
+        return self.get(EXPLAIN)
+
+    @property
+    def batch_size_rows(self) -> int:
+        return self.get(BATCH_SIZE_ROWS)
+
+    @property
+    def shuffle_partitions(self) -> int:
+        return self.get(SHUFFLE_PARTITIONS)
+
+    @property
+    def ansi(self) -> bool:
+        return self.get(ANSI_ENABLED)
+
+
+_ACTIVE = threading.local()
+
+
+def active_conf() -> SrtConf:
+    c = getattr(_ACTIVE, "conf", None)
+    if c is None:
+        c = SrtConf()
+        _ACTIVE.conf = c
+    return c
+
+
+def set_active_conf(c: SrtConf) -> None:
+    _ACTIVE.conf = c
+
+
+def generate_docs() -> str:
+    """Markdown table of all public configs (RapidsConf.main doc-gen,
+    RapidsConf.scala:2214 -> docs/configs.md)."""
+    lines = ["# spark_rapids_tpu configuration", "",
+             "Generated from `spark_rapids_tpu/conf.py` — do not edit.", "",
+             "| Name | Default | Description |", "|---|---|---|"]
+    for key in sorted(_REGISTRY):
+        e = _REGISTRY[key]
+        if e.is_internal:
+            continue
+        doc = e.doc.replace("\n", " ")
+        lines.append(f"| {e.key} | {e.default!r} | {doc} |")
+    return "\n".join(lines) + "\n"
+
+
+if __name__ == "__main__":
+    import sys
+    out = sys.argv[1] if len(sys.argv) > 1 else "docs/configs.md"
+    os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+    with open(out, "w") as f:
+        f.write(generate_docs())
+    print(f"wrote {out}")

@@ -1,0 +1,20 @@
+"""Memory management: HBM budget, tiered spill, split-and-retry.
+
+TPU-native rebuild of SURVEY §2.3 (RapidsBufferCatalog / stores /
+RmmRapidsRetryIterator / SpillableColumnarBatch).
+"""
+
+from .budget import (MemoryBudget, OutOfDeviceMemory, RetryOOM,
+                     SplitAndRetryOOM, TaskContext, device_budget,
+                     task_context)
+from .spill import SpillableBatch, SpillCatalog, SpillPriority, spill_catalog
+from .retry import (split_spillable_in_half_by_rows, with_restore_on_retry,
+                    with_retry, with_retry_no_split)
+
+__all__ = [
+    "MemoryBudget", "OutOfDeviceMemory", "RetryOOM", "SplitAndRetryOOM",
+    "TaskContext", "device_budget", "task_context",
+    "SpillableBatch", "SpillCatalog", "SpillPriority", "spill_catalog",
+    "split_spillable_in_half_by_rows", "with_restore_on_retry",
+    "with_retry", "with_retry_no_split",
+]

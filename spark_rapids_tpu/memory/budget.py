@@ -1,0 +1,170 @@
+"""HBM budget accounting and the OOM exception contract.
+
+The reference hooks RMM's allocation-failure callback
+(DeviceMemoryEventHandler.scala:36) and drives a per-thread retry state
+machine from native code (RmmSpark; RmmRapidsRetryIterator.scala:27).
+XLA's allocator is not user-hookable the same way (SURVEY §7 hard-part
+#3), so the TPU design inverts the control flow: batches are *accounted*
+against a logical HBM budget at registration time, and crossing the
+budget raises ``RetryOOM``/``SplitAndRetryOOM`` **before** the device
+allocator would fail. The spill catalog (spill.py) frees accounted bytes
+by moving cold batches to host/disk, exactly like the reference's
+device→host→disk store chain.
+
+OOM *injection* for tests lives here too: the analogue of
+``RmmSpark.forceRetryOOM`` (RmmSparkRetrySuiteBase.scala:48) — tests arm
+a countdown and the Nth allocation attempt throws.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+
+class OutOfDeviceMemory(RuntimeError):
+    """Base for device-memory pressure errors (GpuOOM in the JNI)."""
+
+
+class RetryOOM(OutOfDeviceMemory):
+    """Roll back to the last checkpoint and try again at the same size."""
+
+
+class SplitAndRetryOOM(OutOfDeviceMemory):
+    """Roll back, split the input, retry the halves (SplitAndRetryOOM)."""
+
+
+class TaskContext:
+    """Per-task bookkeeping (thread association + retry counters).
+
+    The reference associates JVM threads with Spark task ids inside
+    RmmSpark so the native state machine knows which task to interrupt;
+    here the context is a thread-local carrying injection state and
+    metrics.
+    """
+
+    def __init__(self, task_id: int):
+        self.task_id = task_id
+        self.retry_count = 0
+        self.split_count = 0
+        self.spilled_bytes = 0
+        # test-only injection counters (None = disarmed)
+        self._inject_retry_after: Optional[int] = None
+        self._inject_split_after: Optional[int] = None
+
+    # --- fault injection (RmmSpark.forceRetryOOM analogue) ---
+    def force_retry_oom(self, num_allocs_before: int = 0) -> None:
+        self._inject_retry_after = num_allocs_before
+
+    def force_split_and_retry_oom(self, num_allocs_before: int = 0) -> None:
+        self._inject_split_after = num_allocs_before
+
+    def on_alloc_attempt(self) -> None:
+        if self._inject_retry_after is not None:
+            if self._inject_retry_after == 0:
+                self._inject_retry_after = None
+                raise RetryOOM("injected RetryOOM")
+            self._inject_retry_after -= 1
+        if self._inject_split_after is not None:
+            if self._inject_split_after == 0:
+                self._inject_split_after = None
+                raise SplitAndRetryOOM("injected SplitAndRetryOOM")
+            self._inject_split_after -= 1
+
+
+_TL = threading.local()
+
+
+def task_context() -> TaskContext:
+    ctx = getattr(_TL, "ctx", None)
+    if ctx is None:
+        ctx = TaskContext(task_id=threading.get_ident())
+        _TL.ctx = ctx
+    return ctx
+
+
+def reset_task_context() -> TaskContext:
+    _TL.ctx = TaskContext(task_id=threading.get_ident())
+    return _TL.ctx
+
+
+class MemoryBudget:
+    """Logical byte budget over device HBM.
+
+    ``reserve`` is called before building device arrays for a batch;
+    if the budget would overflow it first asks the spill catalog to
+    release bytes (synchronousSpill, RapidsBufferCatalog.scala:589) and
+    only then raises RetryOOM. Thread-safe; shared across tasks like a
+    single device pool.
+    """
+
+    def __init__(self, limit_bytes: int):
+        self.limit = limit_bytes
+        self.used = 0
+        self._lock = threading.Lock()
+        self._spill_fn = None  # wired by the spill catalog
+
+    def set_spill_callback(self, fn) -> None:
+        self._spill_fn = fn
+
+    def reserve(self, nbytes: int) -> None:
+        task_context().on_alloc_attempt()
+        with self._lock:
+            if self.used + nbytes <= self.limit:
+                self.used += nbytes
+                return
+            needed = self.used + nbytes - self.limit
+        # Out of budget: try to spill (outside the lock — spilling calls
+        # back into release()).
+        if self._spill_fn is not None:
+            freed = self._spill_fn(needed)
+            with self._lock:
+                if self.used + nbytes <= self.limit:
+                    self.used += nbytes
+                    return
+        raise RetryOOM(
+            f"device budget exhausted: used={self.used} request={nbytes} "
+            f"limit={self.limit}")
+
+    def release(self, nbytes: int) -> None:
+        with self._lock:
+            self.used = max(0, self.used - nbytes)
+
+
+_DEVICE_BUDGET: Optional[MemoryBudget] = None
+_BUDGET_LOCK = threading.Lock()
+
+
+def device_budget() -> MemoryBudget:
+    """Process-wide device budget, sized from config on first use
+    (GpuDeviceManager.initializeRmm analogue)."""
+    global _DEVICE_BUDGET
+    with _BUDGET_LOCK:
+        if _DEVICE_BUDGET is None:
+            from ..conf import (DEVICE_MEMORY_FRACTION, DEVICE_MEMORY_LIMIT,
+                                active_conf)
+            conf = active_conf()
+            limit = conf.get(DEVICE_MEMORY_LIMIT)
+            if limit <= 0:
+                import jax
+                dev = jax.devices()[0]
+                stats = {}
+                try:
+                    stats = dev.memory_stats() or {}
+                except Exception:
+                    pass
+                hbm = stats.get("bytes_limit", 16 << 30)
+                limit = int(hbm * conf.get(DEVICE_MEMORY_FRACTION))
+            _DEVICE_BUDGET = MemoryBudget(limit)
+        return _DEVICE_BUDGET
+
+
+def reset_device_budget(limit_bytes: Optional[int] = None) -> MemoryBudget:
+    """Test hook: replace the global budget."""
+    global _DEVICE_BUDGET
+    with _BUDGET_LOCK:
+        if limit_bytes is None:
+            _DEVICE_BUDGET = None
+            return None  # re-derived lazily
+        _DEVICE_BUDGET = MemoryBudget(limit_bytes)
+        return _DEVICE_BUDGET

@@ -1,0 +1,145 @@
+"""Split-and-retry OOM handling.
+
+Rebuild of RmmRapidsRetryIterator.scala (686 LoC in the reference):
+``withRetry`` / ``withRetryNoSplit`` / ``withRestoreOnRetry`` plus
+``splitSpillableInHalfByRows``. The control flow is identical — attempt
+the body; on RetryOOM spill-and-retry at the same size; on
+SplitAndRetryOOM split the input and enqueue the halves — but the
+*trigger* differs: instead of a native allocator callback interrupting a
+JVM thread, OOMs here come from the MemoryBudget (budget.py) or from
+kernels whose true output size exceeded the static output capacity
+(e.g. join expansion overflow, ops/kernels.py).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, List, TypeVar, Union
+
+from ..conf import RETRY_MAX_SPLITS, active_conf
+from .budget import RetryOOM, SplitAndRetryOOM, task_context
+from .spill import SpillableBatch, spill_catalog
+
+T = TypeVar("T")
+R = TypeVar("R")
+
+
+def split_spillable_in_half_by_rows(sb: SpillableBatch) -> List[SpillableBatch]:
+    """The standard split policy (splitSpillableInHalfByRows,
+    RmmRapidsRetryIterator.scala:~447): halve by row count."""
+    from ..columnar.vector import choose_capacity
+    from ..ops.kernels import slice_batch
+
+    batch = sb.get()
+    n = int(batch.num_rows)
+    if n <= 1:
+        raise SplitAndRetryOOM(
+            f"cannot split a batch of {n} rows any further")
+    half = n // 2
+    lo = slice_batch(batch, 0, half, choose_capacity(half))
+    hi = slice_batch(batch, half, n - half, choose_capacity(n - half))
+    lo_sb = SpillableBatch(lo, sb.priority)
+    try:
+        hi_sb = SpillableBatch(hi, sb.priority)
+    except BaseException:
+        lo_sb.close()
+        raise
+    sb.close()
+    return [lo_sb, hi_sb]
+
+
+def with_retry(
+    inputs: Union[SpillableBatch, List[SpillableBatch]],
+    fn: Callable[[SpillableBatch], R],
+    split_policy: Callable[[SpillableBatch], List[SpillableBatch]] = None,
+) -> Iterator[R]:
+    """Run ``fn`` over each input with retry + optional split on OOM.
+
+    Yields one result per (possibly split) attempt. Inputs are consumed:
+    each SpillableBatch is closed by fn or by the split. Mirrors
+    ``withRetry`` (RmmRapidsRetryIterator.scala:33).
+    """
+    conf = active_conf()
+    max_splits = conf.get(RETRY_MAX_SPLITS)
+    max_retries = 8
+    pending: List[SpillableBatch] = (
+        list(inputs) if isinstance(inputs, (list, tuple)) else [inputs])
+    ctx = task_context()
+    splits_done = 0
+    retries_this_attempt = 0
+
+    def close_all(attempt):
+        attempt.close()
+        for p in pending:
+            p.close()
+
+    while pending:
+        attempt = pending.pop(0)
+        try:
+            result = fn(attempt)
+            retries_this_attempt = 0
+        except RetryOOM:
+            ctx.retry_count += 1
+            retries_this_attempt += 1
+            freed = spill_catalog().synchronous_spill(attempt.nbytes)
+            if retries_this_attempt > max_retries or (
+                    freed == 0 and retries_this_attempt > 1):
+                close_all(attempt)
+                raise
+            pending.insert(0, attempt)
+            continue
+        except SplitAndRetryOOM:
+            retries_this_attempt = 0
+            if split_policy is None:
+                close_all(attempt)
+                raise
+            if splits_done >= max_splits:
+                close_all(attempt)
+                raise SplitAndRetryOOM(
+                    f"still OOM after {splits_done} splits")
+            ctx.split_count += 1
+            splits_done += 1
+            try:
+                halves = split_policy(attempt)
+            except BaseException:
+                close_all(attempt)
+                raise
+            pending[:0] = halves
+            continue
+        except BaseException:
+            close_all(attempt)
+            raise
+        yield result
+
+
+def with_retry_no_split(body: Callable[[], R], max_retries: int = 8) -> R:
+    """Retry ``body`` on RetryOOM only (withRetryNoSplit). The body must
+    be idempotent up to device allocations."""
+    ctx = task_context()
+    last = None
+    for _ in range(max_retries):
+        try:
+            return body()
+        except RetryOOM as e:
+            ctx.retry_count += 1
+            last = e
+            spill_catalog().synchronous_spill(1 << 20)
+    raise RetryOOM(f"exhausted {max_retries} retries") from last
+
+
+class with_restore_on_retry:
+    """Context manager: snapshot checkpointable state, restore on OOM
+    (withRestoreOnRetry for non-deterministic expressions). The target
+    must expose checkpoint()/restore()."""
+
+    def __init__(self, target):
+        self.target = target
+
+    def __enter__(self):
+        self.target.checkpoint()
+        return self.target
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None and issubclass(exc_type,
+                                               (RetryOOM, SplitAndRetryOOM)):
+            self.target.restore()
+        return False

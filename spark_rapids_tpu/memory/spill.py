@@ -1,0 +1,291 @@
+"""Tiered spill: device (HBM) → host (numpy) → disk.
+
+Rebuild of the reference's spill framework (SURVEY §2.3):
+RapidsBufferCatalog.scala (handle-based registry, synchronousSpill:589,
+acquire:461), RapidsDeviceMemoryStore / RapidsHostMemoryStore /
+RapidsDiskStore, SpillableColumnarBatch.scala, SpillPriorities.scala.
+
+TPU mapping: a "device buffer" is the set of jax.Arrays inside a
+ColumnarBatch; spilling to host is ``jax.device_get`` into numpy,
+disk tier is an .npz file. Re-materialization is ``jnp.asarray`` back
+into HBM. All bytes are accounted against the shared MemoryBudget so
+spilling actually relieves device pressure.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+from enum import IntEnum
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar.vector import ColumnarBatch
+from .budget import MemoryBudget, device_budget
+
+
+class SpillPriority(IntEnum):
+    """Lower spills first (SpillPriorities.scala ordering)."""
+
+    SHUFFLE_OUTPUT = 0       # regeneratable / long-lived, cold
+    CACHED = 10
+    ACTIVE_ON_DECK = 50      # input batches queued behind an operator
+    ACTIVE_WORKING = 100     # spills last
+
+
+def batch_nbytes(batch: ColumnarBatch) -> int:
+    leaves = jax.tree_util.tree_leaves(batch)
+    return sum(x.size * x.dtype.itemsize for x in leaves
+               if hasattr(x, "dtype"))
+
+
+def _tree_to_host(batch: ColumnarBatch):
+    leaves, treedef = jax.tree_util.tree_flatten(batch)
+    host = [np.asarray(x) if hasattr(x, "dtype") else x for x in leaves]
+    return host, treedef
+
+
+def _tree_to_device(host_leaves, treedef) -> ColumnarBatch:
+    dev = [jnp.asarray(x) if isinstance(x, np.ndarray) else x
+           for x in host_leaves]
+    return jax.tree_util.tree_unflatten(treedef, dev)
+
+
+class SpillableBatch:
+    """A columnar batch registered for spill (SpillableColumnarBatch).
+
+    States: DEVICE (accounted against the HBM budget), HOST (numpy),
+    DISK (.npz file). ``get()`` re-materializes on device;
+    ``close()`` releases whatever tier holds it.
+    """
+
+    __slots__ = ("_batch", "_host", "_treedef", "_path", "_nbytes",
+                 "priority", "_lock", "_catalog", "handle", "closed",
+                 "_scalars", "_nleaves", "_num_rows")
+
+    def __init__(self, batch: ColumnarBatch,
+                 priority: SpillPriority = SpillPriority.ACTIVE_ON_DECK,
+                 catalog: Optional["SpillCatalog"] = None):
+        self._nbytes = batch_nbytes(batch)
+        self._catalog = catalog or spill_catalog()
+        self._catalog.budget.reserve(self._nbytes)
+        self._batch: Optional[ColumnarBatch] = batch
+        self._num_rows = int(batch.num_rows)
+        self._host = None
+        self._treedef = None
+        self._path: Optional[str] = None
+        self.priority = priority
+        self._lock = threading.Lock()
+        self.closed = False
+        self.handle = self._catalog.register(self)
+
+    @property
+    def nbytes(self) -> int:
+        return self._nbytes
+
+    @property
+    def tier(self) -> str:
+        if self._batch is not None:
+            return "device"
+        if self._host is not None:
+            return "host"
+        if self._path is not None:
+            return "disk"
+        return "closed"
+
+    def num_rows(self) -> int:
+        return self._num_rows
+
+    def spill_to_host(self) -> int:
+        """Device → host. Returns device bytes freed."""
+        with self._lock:
+            if self._batch is None or self.closed:
+                return 0
+            self._host, self._treedef = _tree_to_host(self._batch)
+            self._batch = None
+            self._catalog.budget.release(self._nbytes)
+            from .budget import task_context
+            task_context().spilled_bytes += self._nbytes
+            return self._nbytes
+
+    def spill_to_disk(self) -> int:
+        """Host → disk. Returns host bytes freed."""
+        with self._lock:
+            if self._host is None or self.closed:
+                return 0
+            fd, path = tempfile.mkstemp(suffix=".npz",
+                                        dir=self._catalog.spill_dir)
+            os.close(fd)
+            arrays = {f"a{i}": x for i, x in enumerate(self._host)
+                      if isinstance(x, np.ndarray)}
+            scalars = {i: x for i, x in enumerate(self._host)
+                       if not isinstance(x, np.ndarray)}
+            np.savez(path, **arrays)
+            self._path = path
+            self._scalars = scalars
+            self._nleaves = len(self._host)
+            self._host = None
+            return self._nbytes
+
+    def get(self) -> ColumnarBatch:
+        """Re-materialize on device (unspillBufferToDeviceStore,
+        RapidsBufferCatalog.scala:633).
+
+        budget.reserve runs OUTSIDE self._lock: its spill callback may
+        call back into this object's spill_to_disk (or another thread's
+        get may spill us) — holding the lock across it deadlocks.
+        """
+        with self._lock:
+            if self.closed:
+                raise ValueError("SpillableBatch used after close")
+            if self._batch is not None:
+                return self._batch
+        self._catalog.budget.reserve(self._nbytes)
+        with self._lock:
+            if self.closed:
+                self._catalog.budget.release(self._nbytes)
+                raise ValueError("SpillableBatch used after close")
+            if self._batch is not None:  # raced with another get()
+                self._catalog.budget.release(self._nbytes)
+                return self._batch
+            if self._host is None and self._path is not None:
+                data = np.load(self._path)
+                leaves = []
+                for i in range(self._nleaves):
+                    if i in self._scalars:
+                        leaves.append(self._scalars[i])
+                    else:
+                        leaves.append(data[f"a{i}"])
+                self._host = leaves
+                os.unlink(self._path)
+                self._path = None
+            self._batch = _tree_to_device(self._host, self._treedef)
+            self._host = None
+            return self._batch
+
+    def close(self) -> None:
+        with self._lock:
+            if self.closed:
+                return
+            self.closed = True
+            if self._batch is not None:
+                self._catalog.budget.release(self._nbytes)
+                self._batch = None
+            self._host = None
+            if self._path is not None:
+                try:
+                    os.unlink(self._path)
+                except OSError:
+                    pass
+                self._path = None
+        self._catalog.unregister(self.handle)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class SpillCatalog:
+    """Handle registry + spill policy (RapidsBufferCatalog.scala:62).
+
+    ``synchronous_spill(n)`` frees at least n device bytes by spilling
+    registered batches in priority order, then pushes host-tier overflow
+    to disk when the host limit is exceeded.
+    """
+
+    def __init__(self, budget: Optional[MemoryBudget] = None,
+                 host_limit: Optional[int] = None,
+                 spill_dir: Optional[str] = None):
+        from ..conf import HOST_SPILL_LIMIT, SPILL_DIR, active_conf
+        conf = active_conf()
+        self.budget = budget or device_budget()
+        self.budget.set_spill_callback(self.synchronous_spill)
+        self.host_limit = host_limit or conf.get(HOST_SPILL_LIMIT)
+        self.spill_dir = spill_dir or conf.get(SPILL_DIR)
+        os.makedirs(self.spill_dir, exist_ok=True)
+        self._entries: Dict[int, SpillableBatch] = {}
+        self._next = 0
+        self._lock = threading.Lock()
+
+    def register(self, sb: SpillableBatch) -> int:
+        with self._lock:
+            h = self._next
+            self._next += 1
+            self._entries[h] = sb
+            return h
+
+    def unregister(self, handle: int) -> None:
+        with self._lock:
+            self._entries.pop(handle, None)
+
+    def device_candidates(self) -> List[SpillableBatch]:
+        with self._lock:
+            return sorted(
+                (e for e in self._entries.values() if e.tier == "device"),
+                key=lambda e: (e.priority, -e.nbytes))
+
+    def synchronous_spill(self, target_bytes: int) -> int:
+        """Free >= target_bytes of device memory if possible
+        (RapidsBufferCatalog.synchronousSpill:589)."""
+        freed = 0
+        for e in self.device_candidates():
+            if freed >= target_bytes:
+                break
+            freed += e.spill_to_host()
+        self._enforce_host_limit()
+        return freed
+
+    def _host_used(self) -> int:
+        with self._lock:
+            return sum(e.nbytes for e in self._entries.values()
+                       if e.tier == "host")
+
+    def _enforce_host_limit(self) -> None:
+        used = self._host_used()
+        if used <= self.host_limit:
+            return
+        with self._lock:
+            host = sorted((e for e in self._entries.values()
+                           if e.tier == "host"),
+                          key=lambda e: (e.priority, -e.nbytes))
+        for e in host:
+            if used <= self.host_limit:
+                break
+            used -= e.spill_to_disk()
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            tiers = {"device": 0, "host": 0, "disk": 0}
+            for e in self._entries.values():
+                t = e.tier
+                if t in tiers:
+                    tiers[t] += e.nbytes
+        tiers["budget_used"] = self.budget.used
+        tiers["budget_limit"] = self.budget.limit
+        return tiers
+
+
+_CATALOG: Optional[SpillCatalog] = None
+_CAT_LOCK = threading.Lock()
+
+
+def spill_catalog() -> SpillCatalog:
+    global _CATALOG
+    with _CAT_LOCK:
+        if _CATALOG is None:
+            _CATALOG = SpillCatalog()
+        return _CATALOG
+
+
+def reset_spill_catalog(**kwargs) -> SpillCatalog:
+    """Test hook: fresh catalog (optionally with a fresh budget)."""
+    global _CATALOG
+    with _CAT_LOCK:
+        _CATALOG = SpillCatalog(**kwargs)
+        return _CATALOG

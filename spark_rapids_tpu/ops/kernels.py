@@ -331,7 +331,8 @@ def left_join(probe: ColumnarBatch, build: ColumnarBatch,
 
 def semi_anti_join(probe: ColumnarBatch, build_keys: Sequence[Column],
                    probe_keys: Sequence[Column], build_live,
-                   anti: bool, scratch_capacity: Optional[int] = None) -> ColumnarBatch:
+                   anti: bool, scratch_capacity: Optional[int] = None
+                   ) -> Tuple[ColumnarBatch, jnp.ndarray]:
     """Left semi / anti join — output rows come only from the probe side
     (no expansion), but the *candidate window* can still overflow when
     build keys are heavily duplicated. total_cand is returned so the host
@@ -418,6 +419,18 @@ def concat_batches(batches: Sequence[ColumnarBatch],
         cols = [b.columns[ci] for b in batches]
         out_cols.append(concat_columns(cols, caps, counts, out_capacity))
     return ColumnarBatch(out_cols, names, total)
+
+
+def slice_batch(batch: ColumnarBatch, start: int, length,
+                out_capacity: int) -> ColumnarBatch:
+    """Rows [start, start+length) into a fresh batch of out_capacity.
+
+    The split primitive behind split-and-retry (the contiguousSplit
+    analogue); start/length may be traced scalars.
+    """
+    idx = jnp.arange(out_capacity, dtype=jnp.int32) + start
+    n = jnp.minimum(length, jnp.maximum(batch.num_rows - start, 0))
+    return batch.gather(idx, n)
 
 
 def local_limit(batch: ColumnarBatch, n: int) -> ColumnarBatch:

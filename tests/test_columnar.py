@@ -80,3 +80,18 @@ def test_promote():
     assert dt.promote(dt.INT8, dt.INT8) == dt.INT8
     with pytest.raises(TypeError):
         dt.promote(dt.INT32, dt.DecimalType(10, 2))
+
+
+def test_string_gather_expanding():
+    # Regression: expanding gather (output rows > source capacity) must
+    # repack bytes correctly — exercised by joins with duplicate keys.
+    import jax.numpy as jnp
+    from spark_rapids_tpu.columnar.vector import batch_from_pydict
+
+    b = batch_from_pydict({"s": ["aa", "bb", "cc", "dd"]}, capacity=4)
+    col = b.column("s")
+    idx = jnp.array([0, 0, 1, 1, 2, 2, 3, 3], jnp.int32)
+    out = col.gather(idx, out_char_capacity=col.char_capacity)
+    vals, mask = out.to_numpy(8)
+    assert list(vals) == ["aa", "aa", "bb", "bb", "cc", "cc", "dd", "dd"]
+    assert mask.all()

@@ -1,0 +1,137 @@
+"""Memory subsystem tests: budget, spill tiers, retry/split, injection.
+
+Mirrors the reference's memory suites (SURVEY §4): RapidsBufferCatalogSuite,
+RapidsDeviceMemoryStoreSuite/HostMemoryStoreSuite/DiskStoreSuite,
+RmmSparkRetrySuiteBase-style OOM injection.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columnar.vector import batch_from_pydict, batch_to_pydict
+from spark_rapids_tpu.memory.budget import (RetryOOM, SplitAndRetryOOM,
+                                            reset_task_context)
+from spark_rapids_tpu.memory.retry import (split_spillable_in_half_by_rows,
+                                           with_retry, with_retry_no_split)
+from spark_rapids_tpu.memory.spill import (SpillableBatch, batch_nbytes,
+                                           reset_spill_catalog)
+from spark_rapids_tpu.memory.budget import MemoryBudget
+
+
+def make_batch(n=100):
+    return batch_from_pydict({
+        "a": list(range(n)),
+        "b": [float(i) * 0.5 for i in range(n)],
+    })
+
+
+@pytest.fixture()
+def catalog(tmp_path):
+    budget = MemoryBudget(1 << 30)
+    cat = reset_spill_catalog(budget=budget, host_limit=1 << 20,
+                              spill_dir=str(tmp_path))
+    reset_task_context()
+    yield cat
+    reset_spill_catalog(budget=MemoryBudget(1 << 40),
+                        spill_dir=str(tmp_path))
+
+
+def test_spill_roundtrip_device_host_disk(catalog):
+    b = make_batch(50)
+    expected = batch_to_pydict(b)
+    sb = SpillableBatch(b)
+    assert sb.tier == "device"
+    assert catalog.budget.used == sb.nbytes
+
+    freed = sb.spill_to_host()
+    assert freed == sb.nbytes
+    assert sb.tier == "host"
+    assert catalog.budget.used == 0
+
+    sb.spill_to_disk()
+    assert sb.tier == "disk"
+
+    out = sb.get()
+    assert sb.tier == "device"
+    assert batch_to_pydict(out) == expected
+    sb.close()
+    assert catalog.budget.used == 0
+
+
+def test_budget_triggers_spill(catalog):
+    b1 = SpillableBatch(make_batch(100))
+    nb = b1.nbytes
+    catalog.budget.limit = int(nb * 1.5)
+    # Second registration must push the first out of device tier.
+    b2 = SpillableBatch(make_batch(100))
+    assert b2.tier == "device"
+    assert b1.tier == "host"
+    b1.close()
+    b2.close()
+
+
+def test_budget_oom_when_nothing_to_spill(catalog):
+    catalog.budget.limit = 16
+    with pytest.raises(RetryOOM):
+        SpillableBatch(make_batch(1000))
+
+
+def test_injected_retry_oom_then_success(catalog):
+    ctx = reset_task_context()
+    ctx.force_retry_oom(num_allocs_before=0)
+    calls = []
+
+    def body():
+        calls.append(1)
+        catalog.budget.reserve(8)
+        catalog.budget.release(8)
+        return "ok"
+
+    assert with_retry_no_split(body) == "ok"
+    assert len(calls) == 2
+    assert ctx.retry_count == 1
+
+
+def test_with_retry_split_policy(catalog):
+    ctx = reset_task_context()
+    sb = SpillableBatch(make_batch(64))
+    seen_rows = []
+    armed = [True]
+
+    def fn(s):
+        if armed[0]:
+            armed[0] = False
+            raise SplitAndRetryOOM("synthetic")
+        batch = s.get()
+        seen_rows.append(int(batch.num_rows))
+        s.close()
+        return True
+
+    results = list(with_retry(sb, fn,
+                              split_policy=split_spillable_in_half_by_rows))
+    assert results == [True, True]
+    assert seen_rows == [32, 32]
+    assert ctx.split_count == 1
+
+
+def test_split_preserves_content(catalog):
+    b = make_batch(10)
+    expected = batch_to_pydict(b)
+    sb = SpillableBatch(b)
+    lo, hi = split_spillable_in_half_by_rows(sb)
+    out = batch_to_pydict(lo.get())
+    out2 = batch_to_pydict(hi.get())
+    merged = {k: out[k] + out2[k] for k in out}
+    assert merged == expected
+    lo.close()
+    hi.close()
+
+
+def test_host_limit_overflows_to_disk(catalog):
+    catalog.host_limit = 1  # force disk overflow on any host spill
+    sb = SpillableBatch(make_batch(100))
+    expected = batch_to_pydict(sb.get())
+    catalog.synchronous_spill(sb.nbytes)
+    assert sb.tier == "disk"
+    assert batch_to_pydict(sb.get()) == expected
+    sb.close()
