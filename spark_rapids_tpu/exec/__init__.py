@@ -1,0 +1,25 @@
+"""Physical operators — the GpuExec layer (SURVEY §1 L4, §2.4).
+
+Each exec is an iterator-of-ColumnarBatch over its children, evaluating
+jit-compiled kernels on device. Operators acquire the device semaphore
+before submitting work, register big intermediates as spillable, and run
+allocation-prone sections under the retry framework — the same runtime
+discipline as the reference's operators (GpuExec.scala:197,
+doExecuteColumnar:348).
+"""
+
+from .base import ExecContext, Metric, TpuExec, TpuSemaphore
+from .basic import (BatchScanExec, CoalesceBatchesExec, ExpandExec,
+                    FilterExec, LocalLimitExec, ProjectExec, RangeExec,
+                    UnionExec)
+from .aggregate import HashAggregateExec
+from .sort import SortExec, SortOrder, TopNExec
+from .join import BroadcastHashJoinExec, ShuffledHashJoinExec
+
+__all__ = [
+    "ExecContext", "Metric", "TpuExec", "TpuSemaphore",
+    "BatchScanExec", "CoalesceBatchesExec", "ExpandExec", "FilterExec",
+    "LocalLimitExec", "ProjectExec", "RangeExec", "UnionExec",
+    "HashAggregateExec", "SortExec", "SortOrder", "TopNExec",
+    "BroadcastHashJoinExec", "ShuffledHashJoinExec",
+]

@@ -1,0 +1,183 @@
+"""Hash aggregate exec.
+
+Rebuild of GpuHashAggregateExec (GpuAggregateExec.scala:1711; AggHelper
+:175; merge iterator :711). Same two-phase structure as the reference:
+
+  per input batch : update  (raw rows -> partial per-group states)
+  at exhaustion   : concat partials, merge states, finalize
+
+The kernel is sort-based (ops/kernels.py group_aggregate/group_merge)
+rather than cuDF's hash groupby — sorting composes with XLA's static
+shapes. Partial results are registered as spillable between the phases,
+mirroring the reference's spillable agg buffers; a merge pass too big
+for one batch falls back to split-and-retry via the memory framework.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar import dtypes as dt
+from ..columnar.vector import (ColumnVector, ColumnarBatch, choose_capacity,
+                               live_mask)
+from ..expr.aggregates import AggregateFunction
+from ..expr.core import Expression, make_result, output_name
+from ..ops import kernels as K
+from .base import ExecContext, Metric, Schema, TpuExec
+
+
+def _state_col_name(agg_index: int, state_name: str) -> str:
+    return f"__agg{agg_index}__{state_name}"
+
+
+class HashAggregateExec(TpuExec):
+    """groupBy(keys).agg(fns) over the child stream.
+
+    ``agg_exprs``: [(AggregateFunction, output_name)]. Aggregate inputs
+    are the function's child expressions evaluated against the child
+    schema.
+    """
+
+    def __init__(self, child: TpuExec, group_exprs: Sequence[Expression],
+                 agg_exprs: Sequence[Tuple[AggregateFunction, str]]):
+        super().__init__(child)
+        self.group_exprs = list(group_exprs)
+        self.agg_exprs = list(agg_exprs)
+        in_schema = child.output_schema
+        self._key_names = [output_name(e, i)
+                           for i, e in enumerate(self.group_exprs)]
+        self._schema = (
+            [(n, e.data_type(in_schema))
+             for n, e in zip(self._key_names, self.group_exprs)] +
+            [(name, fn.data_type(in_schema))
+             for fn, name in self.agg_exprs])
+        self._state_schemas = [fn.state_schema(in_schema)
+                               for fn, _ in self.agg_exprs]
+        self._jit_update = jax.jit(self._update)
+        self._jit_merge = jax.jit(self._merge_finalize)
+
+    @property
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    # --- phase 1: partial aggregation of one raw batch ---
+    def _update(self, batch: ColumnarBatch, row_offset) -> ColumnarBatch:
+        key_cols = [e.eval(batch) for e in self.group_exprs]
+        agg_in = [fn.children[0].eval(batch) if fn.children else None
+                  for fn, _ in self.agg_exprs]
+        key_batch, states = K.group_aggregate(
+            batch, key_cols, agg_in, [fn for fn, _ in self.agg_exprs],
+            row_offset=row_offset)
+        return self._pack(key_batch, states, key_batch.num_rows,
+                          batch.capacity)
+
+    def _pack(self, key_batch: ColumnarBatch, states: List[dict],
+              num_groups, cap: int) -> ColumnarBatch:
+        """Flatten state dicts into columns so partials flow as batches
+        (and therefore through spill + shuffle untouched)."""
+        cols: List[ColumnVector] = []
+        names: List[str] = []
+        lm = live_mask(cap, num_groups)
+        for kc, name in zip(key_batch.columns, self._key_names):
+            cols.append(kc)
+            names.append(name)
+        for i, ((fn, _), sschema) in enumerate(
+                zip(self.agg_exprs, self._state_schemas)):
+            for sname, stype in sschema:
+                arr = states[i][sname]
+                if arr.dtype == jnp.bool_:
+                    data = arr & lm
+                else:
+                    data = jnp.where(lm, arr, jnp.zeros((), arr.dtype))
+                cols.append(ColumnVector(data, lm, stype))
+                names.append(_state_col_name(i, sname))
+        return ColumnarBatch(cols, names, num_groups)
+
+    def _unpack(self, batch: ColumnarBatch):
+        key_cols = [batch.column(n) for n in self._key_names]
+        states = []
+        for i, sschema in enumerate(self._state_schemas):
+            states.append({sname: batch.column(_state_col_name(i, sname)).data
+                           for sname, _ in sschema})
+        return key_cols, states
+
+    # --- phase 2: merge partials + finalize ---
+    def _merge_finalize(self, batch: ColumnarBatch) -> ColumnarBatch:
+        key_cols, states = self._unpack(batch)
+        key_batch, merged, num_groups = K.group_merge(
+            batch, key_cols, states, [fn for fn, _ in self.agg_exprs])
+        if not self.group_exprs:
+            # Global aggregate: always exactly one output row, even on
+            # empty input (Spark semantics: count()=0, sum()=null).
+            num_groups = jnp.maximum(num_groups, 1)
+        cap = batch.capacity
+        lm = live_mask(cap, num_groups)
+        out_cols: List[ColumnVector] = [
+            kc for kc in key_batch.columns]
+        for i, (fn, name) in enumerate(self.agg_exprs):
+            data, ok = fn.finalize(merged[i])
+            out_cols.append(make_result(data, ok & lm,
+                                        self._schema[len(self._key_names) + i][1]))
+        names = [n for n, _ in self._schema]
+        return ColumnarBatch(out_cols, names, num_groups)
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        from ..memory.spill import SpillableBatch, SpillPriority
+        m = ctx.metrics_for(self.exec_id)
+        agg_time = m.setdefault("aggTime", Metric("aggTime", Metric.MODERATE,
+                                                  "ns"))
+        partials: List[SpillableBatch] = []
+        total_groups_bound = 0
+        row_offset = 0
+        try:
+            for batch in self.children[0].execute(ctx):
+                with ctx.semaphore:
+                    partial = self._jit_update(batch,
+                                               jnp.int64(row_offset))
+                row_offset += int(batch.num_rows)
+                total_groups_bound += int(partial.num_rows)
+                partials.append(
+                    SpillableBatch(partial, SpillPriority.ACTIVE_ON_DECK))
+
+            if not partials:
+                if self.group_exprs:
+                    return  # grouped agg over empty input: no rows
+                # global agg over empty input: one null/zero row
+                yield self._empty_global_result()
+                return
+
+            cap = choose_capacity(max(total_groups_bound, 1))
+            batches = [sb.get() for sb in partials]
+            with ctx.semaphore:
+                if len(batches) == 1:
+                    merged_in = batches[0]
+                else:
+                    merged_in = K.concat_batches(batches, cap)
+                out = self._jit_merge(merged_in)
+            yield out
+        finally:
+            for sb in partials:
+                sb.close()
+
+    def _empty_global_result(self) -> ColumnarBatch:
+        cap = 8
+        in_schema = self.children[0].output_schema
+        cols = []
+        for i, (fn, name) in enumerate(self.agg_exprs):
+            zero_states = {}
+            for sname, stype in self._state_schemas[i]:
+                phys = stype.physical
+                zero_states[sname] = jnp.zeros(cap, phys)
+            data, ok = fn.finalize(zero_states)
+            lm = live_mask(cap, 1)
+            cols.append(make_result(data, ok & lm,
+                                    fn.data_type(in_schema)))
+        return ColumnarBatch(cols, [n for _, n in self.agg_exprs], 1)
+
+    def node_description(self) -> str:
+        aggs = ", ".join(f"{fn.name} as {n}" for fn, n in self.agg_exprs)
+        keys = ", ".join(self._key_names)
+        return f"HashAggregate[keys=({keys}), aggs=({aggs})]"

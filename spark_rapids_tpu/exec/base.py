@@ -1,0 +1,204 @@
+"""Exec base: operator protocol, metrics, device semaphore.
+
+Reference counterparts: GpuExec.scala:197 (base trait + metrics
+GpuExec.scala:36-188), GpuSemaphore.scala (N tasks share the device,
+computeNumPermits :106), GpuMetric ESSENTIAL/MODERATE/DEBUG levels.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Iterator, List, Optional, Sequence
+
+from ..columnar import dtypes as dt
+from ..columnar.vector import ColumnarBatch
+from ..conf import CONCURRENT_TASKS, SrtConf, active_conf
+
+Schema = List  # [(name, DType), ...]
+
+
+class Metric:
+    """One operator metric (GpuMetric). Thread-safe accumulator."""
+
+    ESSENTIAL = "ESSENTIAL"
+    MODERATE = "MODERATE"
+    DEBUG = "DEBUG"
+
+    def __init__(self, name: str, level: str = MODERATE, unit: str = ""):
+        self.name = name
+        self.level = level
+        self.unit = unit
+        self.value = 0
+        self._lock = threading.Lock()
+
+    def add(self, v) -> None:
+        with self._lock:
+            self.value += int(v)
+
+    def set(self, v) -> None:
+        with self._lock:
+            self.value = int(v)
+
+    def __repr__(self):
+        return f"{self.name}={self.value}{self.unit}"
+
+
+class NvtxTimer:
+    """Scoped op-time accumulation (NvtxWithMetrics.scala:21-48).
+
+    On TPU there is no NVTX; ranges surface through jax.profiler traces.
+    """
+
+    def __init__(self, metric: Optional[Metric], name: str = ""):
+        self.metric = metric
+        self.name = name
+        self._t0 = 0.0
+
+    def __enter__(self):
+        self._t0 = time.perf_counter_ns()
+        try:
+            import jax.profiler
+            self._trace = jax.profiler.TraceAnnotation(self.name or "op")
+            self._trace.__enter__()
+        except Exception:
+            self._trace = None
+        return self
+
+    def __exit__(self, *exc):
+        if self._trace is not None:
+            self._trace.__exit__(*exc)
+        if self.metric is not None:
+            self.metric.add(time.perf_counter_ns() - self._t0)
+        return False
+
+
+class TpuSemaphore:
+    """Limits concurrent device-work submitters (GpuSemaphore.scala).
+
+    The reference grants 1000/N permits per task so configuration can
+    over/under-subscribe; here a plain counting semaphore over host
+    threads suffices because XLA serializes execution per device stream.
+    """
+
+    def __init__(self, permits: int):
+        self._sem = threading.Semaphore(permits)
+        self.permits = permits
+        self._holders: Dict[int, int] = {}
+        self._lock = threading.Lock()
+
+    def acquire_if_necessary(self) -> None:
+        tid = threading.get_ident()
+        with self._lock:
+            if self._holders.get(tid, 0) > 0:
+                self._holders[tid] += 1
+                return
+        self._sem.acquire()
+        with self._lock:
+            self._holders[tid] = 1
+
+    def release_if_held(self) -> None:
+        tid = threading.get_ident()
+        with self._lock:
+            n = self._holders.get(tid, 0)
+            if n == 0:
+                return
+            if n > 1:
+                self._holders[tid] = n - 1
+                return
+            del self._holders[tid]
+        self._sem.release()
+
+    def __enter__(self):
+        self.acquire_if_necessary()
+        return self
+
+    def __exit__(self, *exc):
+        self.release_if_held()
+        return False
+
+
+_GLOBAL_SEM: Optional[TpuSemaphore] = None
+_SEM_LOCK = threading.Lock()
+
+
+def device_semaphore() -> TpuSemaphore:
+    global _GLOBAL_SEM
+    with _SEM_LOCK:
+        if _GLOBAL_SEM is None:
+            _GLOBAL_SEM = TpuSemaphore(active_conf().get(CONCURRENT_TASKS))
+        return _GLOBAL_SEM
+
+
+class ExecContext:
+    """Per-query execution context: conf, metrics sink, semaphore."""
+
+    def __init__(self, conf: Optional[SrtConf] = None):
+        self.conf = conf or active_conf()
+        self.semaphore = device_semaphore()
+        self.metrics: Dict[str, Dict[str, Metric]] = {}
+
+    def metrics_for(self, exec_id: str) -> Dict[str, Metric]:
+        return self.metrics.setdefault(exec_id, {})
+
+
+class TpuExec:
+    """Base physical operator.
+
+    Children in ``children``; ``output_schema`` is the produced schema;
+    ``execute(ctx)`` yields ColumnarBatches. Subclasses implement
+    ``do_execute``.
+    """
+
+    _counter = [0]
+
+    def __init__(self, *children: "TpuExec"):
+        self.children: List[TpuExec] = list(children)
+        TpuExec._counter[0] += 1
+        self.exec_id = f"{type(self).__name__}#{TpuExec._counter[0]}"
+
+    @property
+    def output_schema(self) -> Schema:
+        raise NotImplementedError
+
+    def execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        m = ctx.metrics_for(self.exec_id)
+        rows = m.setdefault("numOutputRows", Metric("numOutputRows",
+                                                    Metric.ESSENTIAL))
+        batches = m.setdefault("numOutputBatches",
+                               Metric("numOutputBatches", Metric.MODERATE))
+        optime = m.setdefault("opTime", Metric("opTime", Metric.MODERATE,
+                                               "ns"))
+        it = iter(self.do_execute(ctx))
+        while True:
+            with NvtxTimer(optime, self.exec_id):
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    return
+            rows.add(int(batch.num_rows))
+            batches.add(1)
+            yield batch
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        raise NotImplementedError
+
+    # --- plan tree utilities ---
+    def tree_string(self, indent: int = 0) -> str:
+        line = "  " * indent + "* " + self.node_description()
+        return "\n".join([line] + [c.tree_string(indent + 1)
+                                   for c in self.children])
+
+    def node_description(self) -> str:
+        return type(self).__name__
+
+    def __repr__(self):
+        return self.tree_string()
+
+
+def schema_names(schema: Schema) -> List[str]:
+    return [n for n, _ in schema]
+
+
+def schema_types(schema: Schema) -> List[dt.DType]:
+    return [t for _, t in schema]

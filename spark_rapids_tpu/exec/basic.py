@@ -1,0 +1,273 @@
+"""Basic physical operators: scan, project, filter, limit, expand,
+union, range, coalesce.
+
+Reference counterparts (SURVEY §2.4): basicPhysicalOperators.scala
+(GpuProjectExec:350, GpuFilterExec:783), limit.scala, GpuExpandExec,
+GpuRangeExec, GpuCoalesceBatches.scala (AbstractGpuCoalesceIterator:250).
+
+Projection/filter evaluate the whole expression list inside one jitted
+trace per (capacity, schema) so XLA fuses the expression DAG — there is
+no per-expression kernel-launch loop to optimize away.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar import dtypes as dt
+from ..columnar.vector import (ColumnVector, ColumnarBatch, choose_capacity,
+                               live_mask)
+from ..expr.core import Expression, output_name
+from ..ops import kernels as K
+from .base import ExecContext, NvtxTimer, Schema, TpuExec
+
+
+class BatchScanExec(TpuExec):
+    """Leaf: yields pre-built batches (in-memory table scan).
+
+    File-format scans (parquet/csv/json) subclass the same shape in
+    io/scan.py.
+    """
+
+    def __init__(self, batches: Sequence[ColumnarBatch], schema: Schema):
+        super().__init__()
+        self._batches = list(batches)
+        self._schema = list(schema)
+
+    @property
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        yield from self._batches
+
+    def node_description(self) -> str:
+        return f"BatchScan[{len(self._batches)} batches]"
+
+
+class ProjectExec(TpuExec):
+    """Tiered projection (GpuProjectExec / GpuTieredProject)."""
+
+    def __init__(self, child: TpuExec, exprs: Sequence[Expression]):
+        super().__init__(child)
+        self.exprs = list(exprs)
+        in_schema = child.output_schema
+        self._schema = [(output_name(e, i), e.data_type(in_schema))
+                        for i, e in enumerate(self.exprs)]
+        self._jit = jax.jit(self._project)
+
+    def _project(self, batch: ColumnarBatch) -> ColumnarBatch:
+        cols = [e.eval(batch) for e in self.exprs]
+        return ColumnarBatch(cols, [n for n, _ in self._schema],
+                             batch.num_rows)
+
+    @property
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        for batch in self.children[0].execute(ctx):
+            with ctx.semaphore:
+                yield self._jit(batch)
+
+    def node_description(self) -> str:
+        return f"Project[{', '.join(n for n, _ in self._schema)}]"
+
+
+class FilterExec(TpuExec):
+    """WHERE: compacts passing rows to the batch prefix (GpuFilterExec)."""
+
+    def __init__(self, child: TpuExec, condition: Expression):
+        super().__init__(child)
+        self.condition = condition
+        self._jit = jax.jit(self._filter)
+
+    def _filter(self, batch: ColumnarBatch) -> ColumnarBatch:
+        cond = self.condition.eval(batch)
+        return K.filter_batch(batch, cond)
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        for batch in self.children[0].execute(ctx):
+            with ctx.semaphore:
+                yield self._jit(batch)
+
+    def node_description(self) -> str:
+        return f"Filter[{self.condition!r}]"
+
+
+class LocalLimitExec(TpuExec):
+    """LIMIT n within the stream (GpuLocalLimitExec, limit.scala)."""
+
+    def __init__(self, child: TpuExec, limit: int):
+        super().__init__(child)
+        self.limit = limit
+        # limit passed as a traced scalar: one compile per capacity
+        # bucket, not one per distinct remaining-count
+        self._jit = jax.jit(K.local_limit)
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        remaining = self.limit
+        for batch in self.children[0].execute(ctx):
+            if remaining <= 0:
+                return
+            with ctx.semaphore:
+                out = self._jit(batch, jnp.int64(remaining))
+            remaining -= int(out.num_rows)
+            yield out
+
+    def node_description(self) -> str:
+        return f"LocalLimit[{self.limit}]"
+
+
+class UnionExec(TpuExec):
+    """UNION ALL: concatenation of child streams (GpuUnionExec)."""
+
+    def __init__(self, *children: TpuExec):
+        super().__init__(*children)
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        names = [n for n, _ in self.output_schema]
+        for child in self.children:
+            for batch in child.execute(ctx):
+                # normalize column names across the union
+                yield ColumnarBatch(batch.columns, names, batch.num_rows)
+
+
+class ExpandExec(TpuExec):
+    """Multiple projection lists per input row — GROUPING SETS / rollup /
+    cube (GpuExpandExec)."""
+
+    def __init__(self, child: TpuExec, projections: Sequence[Sequence[Expression]],
+                 names: Sequence[str]):
+        super().__init__(child)
+        self.projections = [list(p) for p in projections]
+        in_schema = child.output_schema
+        self._schema = [(n, self.projections[0][i].data_type(in_schema))
+                        for i, n in enumerate(names)]
+        self._jits = [jax.jit(self._make_project(p)) for p in self.projections]
+
+    def _make_project(self, exprs):
+        def run(batch):
+            cols = [e.eval(batch) for e in exprs]
+            return ColumnarBatch(cols, [n for n, _ in self._schema],
+                                 batch.num_rows)
+        return run
+
+    @property
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        for batch in self.children[0].execute(ctx):
+            for jit in self._jits:
+                with ctx.semaphore:
+                    yield jit(batch)
+
+    def node_description(self) -> str:
+        return f"Expand[{len(self.projections)} projections]"
+
+
+class RangeExec(TpuExec):
+    """SELECT id FROM range(start, end, step) (GpuRangeExec)."""
+
+    def __init__(self, start: int, end: int, step: int = 1,
+                 batch_rows: Optional[int] = None):
+        super().__init__()
+        self.start, self.end, self.step = start, end, step
+        self.batch_rows = batch_rows
+
+    @property
+    def output_schema(self) -> Schema:
+        return [("id", dt.INT64)]
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        from ..conf import BATCH_SIZE_ROWS
+        per = self.batch_rows or ctx.conf.get(BATCH_SIZE_ROWS)
+        total = max(0, -(-(self.end - self.start) // self.step))
+        done = 0
+        while done < total:
+            n = min(per, total - done)
+            cap = choose_capacity(n)
+            base = self.start + done * self.step
+            data = base + jnp.arange(cap, dtype=jnp.int64) * self.step
+            live = live_mask(cap, n)
+            col = ColumnVector(jnp.where(live, data, 0), live, dt.INT64)
+            yield ColumnarBatch([col], ["id"], n)
+            done += n
+
+    def node_description(self) -> str:
+        return f"Range[{self.start}, {self.end}, step={self.step}]"
+
+
+class CoalesceBatchesExec(TpuExec):
+    """Combine small batches up to the target size (GpuCoalesceBatches,
+    AbstractGpuCoalesceIterator:250). Registers pending batches as
+    spillable while accumulating, like the reference's on-deck storage."""
+
+    def __init__(self, child: TpuExec, target_rows: Optional[int] = None):
+        super().__init__(child)
+        self.target_rows = target_rows
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        from ..conf import BATCH_SIZE_ROWS
+        from ..memory.spill import SpillableBatch, SpillPriority
+        target = self.target_rows or ctx.conf.get(BATCH_SIZE_ROWS)
+        pending: List[SpillableBatch] = []
+        pending_rows = 0
+
+        def flush() -> Optional[ColumnarBatch]:
+            nonlocal pending, pending_rows
+            if not pending:
+                return None
+            batches = [sb.get() for sb in pending]
+            if len(batches) == 1:
+                out = batches[0]
+            else:
+                cap = choose_capacity(pending_rows)
+                with ctx.semaphore:
+                    out = K.concat_batches(batches, cap)
+            for sb in pending:
+                sb.close()
+            pending, pending_rows = [], 0
+            return out
+
+        for batch in self.children[0].execute(ctx):
+            n = int(batch.num_rows)
+            if n == 0:
+                continue
+            if pending_rows + n > target and pending:
+                out = flush()
+                if out is not None:
+                    yield out
+            pending.append(SpillableBatch(batch,
+                                          SpillPriority.ACTIVE_ON_DECK))
+            pending_rows += n
+            if pending_rows >= target:
+                out = flush()
+                if out is not None:
+                    yield out
+        out = flush()
+        if out is not None:
+            yield out
+
+    def node_description(self) -> str:
+        return f"CoalesceBatches[target={self.target_rows or 'conf'}]"

@@ -1,0 +1,227 @@
+"""Join execs: shuffled/broadcast hash joins with overflow retry.
+
+Rebuild of the reference's join stack (SURVEY §2.4):
+GpuShuffledHashJoinExec.scala:90, GpuHashJoin.scala:104
+(HashJoinIterator:440, gather-map based), GpuBroadcastHashJoinExecBase,
+GpuSubPartitionHashJoin (oversized build sides). The kernel
+(ops/kernels.py join_gather_maps) reports the true match count; when it
+exceeds the static output capacity the exec doubles the capacity and
+re-runs — the TPU equivalent of the reference's SplitAndRetryOOM join
+contract — and past a cap it splits the probe batch instead.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar import dtypes as dt
+from ..columnar.vector import ColumnarBatch, choose_capacity
+from ..expr.core import Expression
+from ..ops import kernels as K
+from .base import ExecContext, Metric, Schema, TpuExec
+
+# Join types (Catalyst names)
+INNER = "inner"
+LEFT_OUTER = "left_outer"
+RIGHT_OUTER = "right_outer"
+FULL_OUTER = "full_outer"
+LEFT_SEMI = "left_semi"
+LEFT_ANTI = "left_anti"
+CROSS = "cross"
+
+# Output capacity growth is bounded: past this many doublings the probe
+# batch gets split instead (GpuSubPartitionHashJoin analogue).
+_MAX_GROWTH_STEPS = 4
+
+
+class _HashJoinBase(TpuExec):
+    """Shared machinery: build-side materialization + per-probe-batch
+    gather-map join with capacity retry."""
+
+    def __init__(self, left: TpuExec, right: TpuExec,
+                 left_keys: Sequence[Expression],
+                 right_keys: Sequence[Expression],
+                 join_type: str = INNER,
+                 build_side: str = "right",
+                 condition: Optional[Expression] = None):
+        super().__init__(left, right)
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.join_type = join_type
+        self.build_side = build_side
+        self.condition = condition
+        if join_type in (LEFT_SEMI, LEFT_ANTI):
+            if build_side != "right":
+                raise ValueError("semi/anti joins build the right side")
+        elif join_type == LEFT_OUTER:
+            if build_side != "right":
+                raise ValueError(
+                    "left outer requires build=right (probe preserves left)")
+        elif join_type == RIGHT_OUTER:
+            if build_side != "left":
+                raise ValueError(
+                    "right outer requires build=left (probe preserves right)")
+        elif join_type not in (INNER,):
+            raise NotImplementedError(
+                f"join type {join_type!r} not supported on TPU yet "
+                "(planner must fall back)")
+        self._jit_cache = {}
+
+    @property
+    def output_schema(self) -> Schema:
+        left_s = self.children[0].output_schema
+        right_s = self.children[1].output_schema
+        if self.join_type in (LEFT_SEMI, LEFT_ANTI):
+            return left_s
+        return left_s + right_s
+
+    # --- build side ---
+    def _materialize_build(self, ctx: ExecContext) -> Optional[ColumnarBatch]:
+        build_child = self.children[1] if self.build_side == "right" \
+            else self.children[0]
+        batches = [b for b in build_child.execute(ctx)
+                   if int(b.num_rows) > 0]
+        if not batches:
+            return None
+        total = sum(int(b.num_rows) for b in batches)
+        cap = choose_capacity(total)
+        with ctx.semaphore:
+            return (batches[0] if len(batches) == 1
+                    else K.concat_batches(batches, cap))
+
+    def _key_cols(self, batch: ColumnarBatch, exprs):
+        return [e.eval(batch) for e in exprs]
+
+    def _join_fn(self, out_capacity: int):
+        """jit per output capacity; cached so capacities reuse traces."""
+        key = out_capacity
+        if key not in self._jit_cache:
+            jt = self.join_type
+
+            def run(probe, build):
+                pk = self._key_cols(probe, self._probe_key_exprs)
+                bk = self._key_cols(build, self._build_key_exprs)
+                if jt in (LEFT_SEMI, LEFT_ANTI):
+                    out, total = K.semi_anti_join(
+                        probe, bk, pk, build.live_mask(),
+                        anti=(jt == LEFT_ANTI),
+                        scratch_capacity=out_capacity)
+                elif jt == INNER:
+                    out, total = K.inner_join(probe, build, pk, bk,
+                                              out_capacity)
+                else:  # LEFT_OUTER / RIGHT_OUTER: probe is preserved side
+                    out, total = K.left_join(probe, build, pk, bk,
+                                             out_capacity)
+                return out, total
+            self._jit_cache[key] = jax.jit(run)
+        return self._jit_cache[key]
+
+    @property
+    def _probe_key_exprs(self):
+        return self.left_keys if self.build_side == "right" \
+            else self.right_keys
+
+    @property
+    def _build_key_exprs(self):
+        return self.right_keys if self.build_side == "right" \
+            else self.left_keys
+
+    def _probe_stream(self, ctx: ExecContext):
+        probe_child = self.children[0] if self.build_side == "right" \
+            else self.children[1]
+        return probe_child.execute(ctx)
+
+    def _reorder_columns(self, out: ColumnarBatch) -> ColumnarBatch:
+        """Kernel output is probe-then-build; plan output is left-then-
+        right."""
+        if self.build_side == "right" or self.join_type in (LEFT_SEMI,
+                                                            LEFT_ANTI):
+            return out
+        n_right = len(self.children[1].output_schema)
+        cols = out.columns[n_right:] + out.columns[:n_right]
+        names = out.names[n_right:] + out.names[:n_right]
+        return ColumnarBatch(cols, names, out.num_rows)
+
+    def _empty_result(self, probe_stream, ctx) -> Iterator[ColumnarBatch]:
+        """Build side empty: inner/semi produce nothing; left-outer and
+        anti pass probe rows with null build columns."""
+        jt = self.join_type
+        if jt in (INNER, LEFT_SEMI):
+            return
+        build_schema = (self.children[1].output_schema
+                        if self.build_side == "right"
+                        else self.children[0].output_schema)
+        for probe in probe_stream:
+            if jt == LEFT_ANTI:
+                yield probe
+                continue
+            # left outer with empty build: null-extend
+            cap = probe.capacity
+            from ..columnar.vector import ColumnVector, StringColumn
+            null_cols = []
+            for name, t in build_schema:
+                if t == dt.STRING:
+                    null_cols.append(StringColumn(
+                        jnp.zeros(cap + 1, jnp.int32),
+                        jnp.zeros(128, jnp.uint8),
+                        jnp.zeros(cap, jnp.bool_)))
+                else:
+                    phys = t.physical
+                    null_cols.append(ColumnVector(
+                        jnp.zeros(cap, phys), jnp.zeros(cap, jnp.bool_), t))
+            out = ColumnarBatch(
+                list(probe.columns) + null_cols,
+                probe.names + [n for n, _ in build_schema], probe.num_rows)
+            yield self._reorder_columns(out)
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        m = ctx.metrics_for(self.exec_id)
+        retries = m.setdefault("joinOverflowRetries",
+                               Metric("joinOverflowRetries", Metric.DEBUG))
+        build = self._materialize_build(ctx)
+        if build is None:
+            yield from self._empty_result(self._probe_stream(ctx), ctx)
+            return
+        build_rows = int(build.num_rows)
+        for probe in self._probe_stream(ctx):
+            n_probe = int(probe.num_rows)
+            if n_probe == 0:
+                continue
+            # initial guess: every probe row matches ~1 build row
+            out_cap = choose_capacity(max(n_probe, 16))
+            for step in range(_MAX_GROWTH_STEPS + 1):
+                with ctx.semaphore:
+                    out, total = self._join_fn(out_cap)(probe, build)
+                total = int(total)
+                if total <= out_cap:
+                    break
+                retries.add(1)
+                out_cap = choose_capacity(total)
+            else:
+                raise RuntimeError(
+                    f"join expansion {total} exceeded capacity after "
+                    f"{_MAX_GROWTH_STEPS} growth steps")
+            yield self._reorder_columns(out)
+
+
+class ShuffledHashJoinExec(_HashJoinBase):
+    """Hash join where both sides arrive partitioned
+    (GpuShuffledHashJoinExec.scala:90)."""
+
+    def node_description(self) -> str:
+        return (f"ShuffledHashJoin[{self.join_type}, "
+                f"build={self.build_side}]")
+
+
+class BroadcastHashJoinExec(_HashJoinBase):
+    """Hash join with a broadcast build side
+    (GpuBroadcastHashJoinExecBase.scala). Single-process execution is
+    identical to the shuffled variant; under a mesh the build side is
+    replicated to every device (parallel/broadcast)."""
+
+    def node_description(self) -> str:
+        return (f"BroadcastHashJoin[{self.join_type}, "
+                f"build={self.build_side}]")

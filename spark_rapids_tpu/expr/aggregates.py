@@ -68,7 +68,11 @@ class AggregateFunction(Expression):
         """[(state_name, DType), ...] — the partial-aggregation buffer."""
         raise NotImplementedError
 
-    def update(self, gid, col: Column, num_groups: int, live) -> State:
+    def update(self, gid, col: Column, num_groups: int, live,
+               row_offset=0, perm=None) -> State:
+        """gid/col/live are key-sorted; ``perm`` maps sorted row -> original
+        row index; ``row_offset`` is the stream-global position of the
+        batch's row 0 (order-sensitive aggregates need both)."""
         raise NotImplementedError
 
     def merge(self, gid, states: State, num_groups: int) -> State:
@@ -95,7 +99,8 @@ class Sum(AggregateFunction):
     def state_schema(self, schema: Schema) -> List:
         return [("sum", self.data_type(schema)), ("count", dt.INT64)]
 
-    def update(self, gid, col: Column, num_groups: int, live) -> State:
+    def update(self, gid, col: Column, num_groups: int, live,
+               **kw) -> State:
         out_t = self._out_t(col)
         phys = out_t.physical
         vals = jnp.where(col.validity, col.data.astype(phys), jnp.zeros((), phys))
@@ -130,7 +135,8 @@ class Count(AggregateFunction):
     def state_schema(self, schema: Schema) -> List:
         return [("count", dt.INT64)]
 
-    def update(self, gid, col: Column, num_groups: int, live) -> State:
+    def update(self, gid, col: Column, num_groups: int, live,
+               **kw) -> State:
         return {"count": _seg_sum((col.validity & live).astype(jnp.int64),
                                   gid, num_groups)}
 
@@ -150,7 +156,7 @@ class CountStar(AggregateFunction):
     def state_schema(self, schema: Schema) -> List:
         return [("count", dt.INT64)]
 
-    def update(self, gid, col, num_groups: int, live) -> State:
+    def update(self, gid, col, num_groups: int, live, **kw) -> State:
         return {"count": _seg_sum(live.astype(jnp.int64), gid, num_groups)}
 
     def merge(self, gid, states: State, num_groups: int) -> State:
@@ -169,7 +175,8 @@ class Min(AggregateFunction):
     def state_schema(self, schema: Schema) -> List:
         return [("min", self.data_type(schema)), ("seen", dt.BOOL)]
 
-    def update(self, gid, col: Column, num_groups: int, live) -> State:
+    def update(self, gid, col: Column, num_groups: int, live,
+               **kw) -> State:
         fill = dt.max_value(col.dtype)
         vals = jnp.where(col.validity, col.data,
                          jnp.asarray(fill, col.data.dtype))
@@ -196,7 +203,8 @@ class Max(AggregateFunction):
     def state_schema(self, schema: Schema) -> List:
         return [("max", self.data_type(schema)), ("seen", dt.BOOL)]
 
-    def update(self, gid, col: Column, num_groups: int, live) -> State:
+    def update(self, gid, col: Column, num_groups: int, live,
+               **kw) -> State:
         fill = dt.min_value(col.dtype)
         vals = jnp.where(col.validity, col.data,
                          jnp.asarray(fill, col.data.dtype))
@@ -225,7 +233,8 @@ class Average(AggregateFunction):
     def state_schema(self, schema: Schema) -> List:
         return [("sum", dt.FLOAT64), ("count", dt.INT64)]
 
-    def update(self, gid, col: Column, num_groups: int, live) -> State:
+    def update(self, gid, col: Column, num_groups: int, live,
+               **kw) -> State:
         x = col.data.astype(jnp.float64)
         if isinstance(col.dtype, dt.DecimalType):
             x = x / (10.0 ** col.dtype.scale)
@@ -254,7 +263,8 @@ class _M2Base(AggregateFunction):
     def state_schema(self, schema: Schema) -> List:
         return [("n", dt.FLOAT64), ("avg", dt.FLOAT64), ("m2", dt.FLOAT64)]
 
-    def update(self, gid, col: Column, num_groups: int, live) -> State:
+    def update(self, gid, col: Column, num_groups: int, live,
+               **kw) -> State:
         x = jnp.where(col.validity, col.data.astype(jnp.float64), 0.0)
         n = _seg_sum(col.validity.astype(jnp.float64), gid, num_groups)
         s = _seg_sum(x, gid, num_groups)
@@ -334,18 +344,25 @@ class First(AggregateFunction):
         return [("val", self.data_type(schema)), ("valid", dt.BOOL),
                 ("pos", dt.INT64)]
 
-    def update(self, gid, col: Column, num_groups: int, live) -> State:
+    def update(self, gid, col: Column, num_groups: int, live,
+               row_offset=0, perm=None, **kw) -> State:
         cap = col.capacity
-        pos = jnp.arange(cap, dtype=jnp.int64)
+        # sorted index for the in-batch pick (stable sort preserves
+        # original order within a group), global position for the state
+        idx = jnp.arange(cap, dtype=jnp.int64)
         eligible = live & (col.validity if self.ignore_nulls else jnp.ones_like(live))
         big = jnp.iinfo(jnp.int64).max
-        keyed = jnp.where(eligible, pos, big)
-        first_pos = _seg_min(keyed, gid, num_groups, big)
-        take = jnp.clip(first_pos, 0, cap - 1)
+        keyed = jnp.where(eligible, idx, big)
+        sel = _seg_min(keyed, gid, num_groups, big)
+        found = sel < big
+        take = jnp.clip(sel, 0, cap - 1)
         val = col.data[take]
-        valid = col.validity[take] & (first_pos < big)
-        return {"val": jnp.where(first_pos < big, val, jnp.zeros_like(val)),
-                "valid": valid, "pos": first_pos}
+        valid = col.validity[take] & found
+        orig = (jnp.take(perm, take).astype(jnp.int64) if perm is not None
+                else take)
+        gpos = jnp.where(found, orig + row_offset, big)
+        return {"val": jnp.where(found, val, jnp.zeros_like(val)),
+                "valid": valid, "pos": gpos}
 
     def merge(self, gid, states: State, num_groups: int) -> State:
         cap = states["pos"].shape[0]
@@ -366,17 +383,22 @@ class First(AggregateFunction):
 class Last(First):
     name = "last"
 
-    def update(self, gid, col: Column, num_groups: int, live) -> State:
+    def update(self, gid, col: Column, num_groups: int, live,
+               row_offset=0, perm=None, **kw) -> State:
         cap = col.capacity
-        pos = jnp.arange(cap, dtype=jnp.int64)
+        idx = jnp.arange(cap, dtype=jnp.int64)
         eligible = live & (col.validity if self.ignore_nulls else jnp.ones_like(live))
-        keyed = jnp.where(eligible, pos, jnp.int64(-1))
-        last_pos = _seg_max(keyed, gid, num_groups, -1)
-        take = jnp.clip(last_pos, 0, cap - 1)
+        keyed = jnp.where(eligible, idx, jnp.int64(-1))
+        sel = _seg_max(keyed, gid, num_groups, -1)
+        found = sel >= 0
+        take = jnp.clip(sel, 0, cap - 1)
         val = col.data[take]
-        valid = col.validity[take] & (last_pos >= 0)
-        return {"val": jnp.where(last_pos >= 0, val, jnp.zeros_like(val)),
-                "valid": valid, "pos": last_pos}
+        valid = col.validity[take] & found
+        orig = (jnp.take(perm, take).astype(jnp.int64) if perm is not None
+                else take)
+        gpos = jnp.where(found, orig + row_offset, jnp.int64(-1))
+        return {"val": jnp.where(found, val, jnp.zeros_like(val)),
+                "valid": valid, "pos": gpos}
 
     def merge(self, gid, states: State, num_groups: int) -> State:
         cap = states["pos"].shape[0]

@@ -160,13 +160,13 @@ def group_ids(sorted_keys: Sequence[Column], live) -> Tuple[jnp.ndarray, jnp.nda
     return gid.astype(jnp.int32), num_groups, boundary
 
 
-def group_aggregate(batch: ColumnarBatch, key_cols: Sequence[Column],
-                    agg_inputs: Sequence[Optional[Column]], agg_fns: Sequence,
-                    mode: str) -> Tuple[ColumnarBatch, List[dict]]:
-    """Sort-based group-by. Returns (key_batch, [state dicts]).
+def _sorted_group_prelude(batch: ColumnarBatch, key_cols: Sequence[Column]):
+    """Shared sort/group-id machinery for update and merge passes.
 
-    mode: 'update' aggregates raw rows; 'merge' merges partial states
-    (agg_inputs then carry state columns via the exec layer).
+    Returns (perm, live_s, gid_safe, num_groups, key_batch, row_pos).
+    Dead rows are routed to a scratch gid just past the live groups so
+    their (zeroed) values never pollute a real group; ``row_pos`` is each
+    sorted row's original position (for order-sensitive aggregates).
     """
     live = batch.live_mask()
     cap = batch.capacity
@@ -175,18 +175,53 @@ def group_aggregate(batch: ColumnarBatch, key_cols: Sequence[Column],
     live_s = jnp.take(live, perm)
     keys_s = [c.gather(perm, live_s) for c in key_cols]
     gid, num_groups, boundary = group_ids(keys_s, live_s)
-
-    states = []
-    for inp, fn in zip(agg_inputs, agg_fns):
-        col_s = inp.gather(perm, live_s) if inp is not None else None
-        states.append(fn.update(gid, col_s, cap, live_s))
-
-    # key output: the first sorted row of each group
+    # scratch slot for dead rows; num_groups == cap implies no dead rows
+    gid_safe = jnp.where(live_s, gid,
+                         jnp.minimum(num_groups, cap - 1).astype(jnp.int32))
     bpos = jnp.argsort(~boundary, stable=True).astype(jnp.int32)
     key_out = [c.gather(bpos, live_mask(cap, num_groups)) for c in keys_s]
     key_batch = ColumnarBatch(
         key_out, [f"k{i}" for i in range(len(key_out))], num_groups)
+    return perm, live_s, gid_safe, num_groups, key_batch
+
+
+def group_aggregate(batch: ColumnarBatch, key_cols: Sequence[Column],
+                    agg_inputs: Sequence[Optional[Column]], agg_fns: Sequence,
+                    row_offset=0) -> Tuple[ColumnarBatch, List[dict]]:
+    """Sort-based group-by update pass: raw rows -> per-group partial
+    states. ``row_offset`` is the stream-global position of this batch's
+    row 0, consumed by order-sensitive aggregates (first/last)."""
+    cap = batch.capacity
+    perm, live_s, gid, num_groups, key_batch = _sorted_group_prelude(
+        batch, key_cols)
+    states = []
+    for inp, fn in zip(agg_inputs, agg_fns):
+        col_s = inp.gather(perm, live_s) if inp is not None else None
+        states.append(fn.update(gid, col_s, cap, live_s,
+                                row_offset=row_offset, perm=perm))
     return key_batch, states
+
+
+def group_merge(batch: ColumnarBatch, key_cols: Sequence[Column],
+                agg_states: Sequence[dict], agg_fns: Sequence
+                ) -> Tuple[ColumnarBatch, List[dict], jnp.ndarray]:
+    """Merge partial aggregation states (the reference's merge pass,
+    GpuMergeAggregateIterator GpuAggregateExec.scala:711).
+
+    ``agg_states[i]`` is a dict of state arrays (capacity-length) aligned
+    with ``batch`` rows; returns merged (key_batch, states, num_groups).
+    Dead rows merge into the scratch gid (see _sorted_group_prelude), so
+    their zeroed states cannot corrupt the last real group.
+    """
+    cap = batch.capacity
+    perm, live_s, gid, num_groups, key_batch = _sorted_group_prelude(
+        batch, key_cols)
+    merged = []
+    for states, fn in zip(agg_states, agg_fns):
+        sorted_states = {k: jnp.take(v, perm, axis=0)
+                         for k, v in states.items()}
+        merged.append(fn.merge(gid, sorted_states, cap))
+    return key_batch, merged, num_groups
 
 
 # ---------------------------------------------------------------------------
@@ -302,7 +337,12 @@ def inner_join(probe: ColumnarBatch, build: ColumnarBatch,
 def left_join(probe: ColumnarBatch, build: ColumnarBatch,
               probe_keys: Sequence[Column], build_keys: Sequence[Column],
               out_capacity: int) -> Tuple[ColumnarBatch, jnp.ndarray]:
-    """Left outer join with probe as the left/stream side."""
+    """Left outer join with probe as the preserved/stream side.
+
+    The returned size scalar is max(candidate window, true output rows
+    incl. unmatched probe rows) — if it exceeds out_capacity the caller
+    must retry bigger (candidates past the window are lost AND output
+    rows past capacity are dropped, so both bound the retry)."""
     cap_p = probe.capacity
     p_idx, b_idx, pair_valid, total_cand, _ = join_gather_maps(
         probe_keys, build_keys, probe.live_mask(), build.live_mask(), out_capacity)
@@ -326,7 +366,8 @@ def left_join(probe: ColumnarBatch, build: ColumnarBatch,
     build_valid = valid & from_pairs
     out_cols = [c.gather(p_take, valid) for c in probe.columns] + \
         [c.gather(b_take, build_valid) for c in build.columns]
-    return ColumnarBatch(out_cols, probe.names + build.names, n_out), total_cand
+    required = jnp.maximum(total_cand, n_out)
+    return ColumnarBatch(out_cols, probe.names + build.names, n_out), required
 
 
 def semi_anti_join(probe: ColumnarBatch, build_keys: Sequence[Column],

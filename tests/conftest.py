@@ -19,6 +19,12 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
+# The axon TPU plugin force-sets jax_platforms='axon,cpu' at import,
+# overriding the env var — override it back, or "CPU" tests silently run
+# on the TPU chip with emulated (~48-bit) float64.
+if not os.environ.get("SRT_TEST_TPU"):
+    jax.config.update("jax_platforms", "cpu")
+
 jax.config.update("jax_enable_x64", True)
 # Persistent compile cache: kernel shapes repeat across test runs.
 jax.config.update("jax_compilation_cache_dir", "/tmp/srt_jax_cache")
