@@ -1,0 +1,113 @@
+"""Benchmark driver: TPC-H q6-shaped scan/filter/aggregate (BASELINE.md
+config 1) on the attached accelerator vs a single-threaded pandas CPU
+baseline (the stand-in for CPU Spark until a real cluster baseline is
+captured).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``value`` is accelerator throughput in Mrows/s; ``vs_baseline`` is the
+speedup over the CPU baseline on identical data (>1 = faster).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+ROWS = 1 << 22  # 4M rows/batch
+ITERS = 10
+
+
+def make_data(rows: int):
+    rng = np.random.default_rng(42)
+    return {
+        "extendedprice": rng.uniform(100.0, 10_000.0, rows).astype(np.float32),
+        "discount": (rng.integers(0, 11, rows).astype(np.float32) / 100.0),
+        "quantity": rng.integers(1, 51, rows).astype(np.float32),
+        "shipdate": rng.integers(8766, 10957, rows).astype(np.int32),
+    }
+
+
+def cpu_baseline(data, iters: int) -> float:
+    """pandas q6: best-of wall seconds per iteration."""
+    import pandas as pd
+    df = pd.DataFrame(data)
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        m = ((df["shipdate"] >= 9131) & (df["shipdate"] < 9496) &
+             (df["discount"] >= 0.05) & (df["discount"] <= 0.07) &
+             (df["quantity"] < 24.0))
+        sel = df[m]
+        _ = (sel["extendedprice"] * sel["discount"]).sum(), len(sel)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def tpu_run(data, iters: int) -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.columnar import dtypes as dt
+    from spark_rapids_tpu.columnar.vector import ColumnarBatch, ColumnVector
+    from spark_rapids_tpu.exec.aggregate import HashAggregateExec
+    from spark_rapids_tpu.exec.basic import BatchScanExec
+    from spark_rapids_tpu.expr import col
+    from spark_rapids_tpu.expr.aggregates import CountStar, Sum
+    from spark_rapids_tpu.ops import kernels as K
+
+    rows = len(data["shipdate"])
+    types = {"extendedprice": dt.FLOAT32, "discount": dt.FLOAT32,
+             "quantity": dt.FLOAT32, "shipdate": dt.INT32}
+    valid = jnp.ones(rows, jnp.bool_)
+    cols = [ColumnVector(jnp.asarray(data[n]), valid, types[n])
+            for n in types]
+    batch = ColumnarBatch(cols, list(types), rows)
+
+    agg = HashAggregateExec(
+        BatchScanExec([], batch.schema()), [],
+        [(Sum(col("extendedprice") * col("discount")), "revenue"),
+         (CountStar(), "n")])
+    # float32 literals keep the comparison lanes in float32 (a float64
+    # literal would promote the whole predicate to emulated-f64 on TPU
+    # and shift which discounts pass the boundary).
+    from spark_rapids_tpu.expr.core import lit
+    f32 = lambda v: lit(float(np.float32(v)), dt.FLOAT32)
+    pred = ((col("shipdate") >= 9131) & (col("shipdate") < 9496) &
+            (col("discount") >= f32(0.05)) & (col("discount") <= f32(0.07)) &
+            (col("quantity") < f32(24.0)))
+
+    @jax.jit
+    def q6(b):
+        cond = pred.eval(b)
+        filtered = K.filter_batch(b, cond)
+        partial = agg._update(filtered, jnp.int32(0))
+        return agg._merge_finalize(partial)
+
+    out = q6(batch)  # compile
+    jax.block_until_ready(jax.tree_util.tree_leaves(out))
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = q6(batch)
+        jax.block_until_ready(jax.tree_util.tree_leaves(out))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    data = make_data(ROWS)
+    cpu_s = cpu_baseline(data, ITERS)
+    tpu_s = tpu_run(data, ITERS)
+    mrows = ROWS / tpu_s / 1e6
+    print(json.dumps({
+        "metric": "tpch_q6_throughput",
+        "value": round(mrows, 2),
+        "unit": "Mrows/s",
+        "vs_baseline": round(cpu_s / tpu_s, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

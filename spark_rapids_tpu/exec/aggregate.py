@@ -26,7 +26,7 @@ from ..columnar.vector import (ColumnVector, ColumnarBatch, choose_capacity,
 from ..expr.aggregates import AggregateFunction
 from ..expr.core import Expression, make_result, output_name
 from ..ops import kernels as K
-from .base import ExecContext, Metric, Schema, TpuExec
+from .base import ExecContext, Metric, NvtxTimer, Schema, TpuExec
 
 
 def _state_col_name(agg_index: int, state_name: str) -> str:
@@ -134,7 +134,7 @@ class HashAggregateExec(TpuExec):
         row_offset = 0
         try:
             for batch in self.children[0].execute(ctx):
-                with ctx.semaphore:
+                with ctx.semaphore, NvtxTimer(agg_time, "agg.update"):
                     partial = self._jit_update(batch,
                                                jnp.int64(row_offset))
                 row_offset += int(batch.num_rows)
@@ -151,7 +151,7 @@ class HashAggregateExec(TpuExec):
 
             cap = choose_capacity(max(total_groups_bound, 1))
             batches = [sb.get() for sb in partials]
-            with ctx.semaphore:
+            with ctx.semaphore, NvtxTimer(agg_time, "agg.merge"):
                 if len(batches) == 1:
                     merged_in = batches[0]
                 else:

@@ -73,6 +73,52 @@ class NvtxTimer:
         return False
 
 
+class SelfTimer:
+    """Self-time accumulation for nested operator pulls.
+
+    Operators pull their children inside ``next()``, so a naive scoped
+    timer would charge the whole subtree to every ancestor (the reference
+    explicitly excludes child time from op time). A per-context timer
+    stack pauses the enclosing operator's clock while a nested one runs:
+    each metric receives only the time its own operator spent. The stack
+    assumes one pulling thread per ExecContext (the generator pipeline is
+    single-threaded; I/O thread pools do their timing elsewhere).
+    """
+
+    def __init__(self, stack: list, metric: Optional[Metric], name: str = ""):
+        self.stack = stack
+        self.metric = metric
+        self.name = name
+        self._t0 = 0
+
+    def __enter__(self):
+        t = time.perf_counter_ns()
+        if self.stack:
+            parent = self.stack[-1]
+            if parent.metric is not None:
+                parent.metric.add(t - parent._t0)
+        self._t0 = t
+        self.stack.append(self)
+        try:
+            import jax.profiler
+            self._trace = jax.profiler.TraceAnnotation(self.name or "op")
+            self._trace.__enter__()
+        except Exception:
+            self._trace = None
+        return self
+
+    def __exit__(self, *exc):
+        if self._trace is not None:
+            self._trace.__exit__(*exc)
+        t = time.perf_counter_ns()
+        if self.metric is not None:
+            self.metric.add(t - self._t0)
+        self.stack.pop()
+        if self.stack:
+            self.stack[-1]._t0 = t
+        return False
+
+
 class TpuSemaphore:
     """Limits concurrent device-work submitters (GpuSemaphore.scala).
 
@@ -137,6 +183,7 @@ class ExecContext:
         self.conf = conf or active_conf()
         self.semaphore = device_semaphore()
         self.metrics: Dict[str, Dict[str, Metric]] = {}
+        self.timer_stack: list = []
 
     def metrics_for(self, exec_id: str) -> Dict[str, Metric]:
         return self.metrics.setdefault(exec_id, {})
@@ -171,7 +218,7 @@ class TpuExec:
                                                "ns"))
         it = iter(self.do_execute(ctx))
         while True:
-            with NvtxTimer(optime, self.exec_id):
+            with SelfTimer(ctx.timer_stack, optime, self.exec_id):
                 try:
                     batch = next(it)
                 except StopIteration:

@@ -157,8 +157,21 @@ class ExpandExec(TpuExec):
         super().__init__(child)
         self.projections = [list(p) for p in projections]
         in_schema = child.output_schema
-        self._schema = [(n, self.projections[0][i].data_type(in_schema))
-                        for i, n in enumerate(names)]
+        # Unify each output column's dtype across ALL projection lists
+        # (grouping sets routinely mix e.g. col and NULL literal slots)
+        # and cast divergent slots, so every emitted batch matches the
+        # declared schema.
+        from ..expr.cast import Cast
+        from ..expr.conditional import _common_type
+        unified = [
+            _common_type([p[i].data_type(in_schema)
+                          for p in self.projections])
+            for i in range(len(names))]
+        for p in self.projections:
+            for i, t in enumerate(unified):
+                if p[i].data_type(in_schema) != t:
+                    p[i] = Cast(p[i], t)
+        self._schema = list(zip(names, unified))
         self._jits = [jax.jit(self._make_project(p)) for p in self.projections]
 
     def _make_project(self, exprs):

@@ -4,10 +4,13 @@ Rebuild of the reference's join stack (SURVEY §2.4):
 GpuShuffledHashJoinExec.scala:90, GpuHashJoin.scala:104
 (HashJoinIterator:440, gather-map based), GpuBroadcastHashJoinExecBase,
 GpuSubPartitionHashJoin (oversized build sides). The kernel
-(ops/kernels.py join_gather_maps) reports the true match count; when it
-exceeds the static output capacity the exec doubles the capacity and
-re-runs — the TPU equivalent of the reference's SplitAndRetryOOM join
-contract — and past a cap it splits the probe batch instead.
+(ops/kernels.py join_gather_maps) reports the true required output size;
+when it exceeds the static output capacity the exec re-runs with the
+reported size's capacity bucket (so the second attempt always fits) —
+the TPU equivalent of the reference's SplitAndRetryOOM join contract.
+_MAX_GROWTH_STEPS is a safety net against a kernel under-reporting, not
+a working-set bound; sub-partitioning oversized build sides
+(GpuSubPartitionHashJoin) is not yet implemented.
 """
 
 from __future__ import annotations
@@ -185,7 +188,6 @@ class _HashJoinBase(TpuExec):
         if build is None:
             yield from self._empty_result(self._probe_stream(ctx), ctx)
             return
-        build_rows = int(build.num_rows)
         for probe in self._probe_stream(ctx):
             n_probe = int(probe.num_rows)
             if n_probe == 0:

@@ -96,6 +96,9 @@ class TopNExec(TpuExec):
         self.order = list(order)
         self.limit = limit
         self._jit_topn = jax.jit(self._topn)
+        self._jit_shrink = jax.jit(
+            lambda b: K.slice_batch(b, 0, b.num_rows,
+                                    choose_capacity(self.limit)))
 
     def _topn(self, batch: ColumnarBatch) -> ColumnarBatch:
         key_cols = [o.expr.eval(batch) for o in self.order]
@@ -111,11 +114,17 @@ class TopNExec(TpuExec):
     def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
         partials: List[ColumnarBatch] = []
         total = 0
+        # Each partial holds <= limit live rows; compact it down to the
+        # limit's capacity bucket so retained memory is O(batches*limit),
+        # not O(batches*input_capacity).
+        part_cap = choose_capacity(self.limit)
         for batch in self.children[0].execute(ctx):
             if int(batch.num_rows) == 0:
                 continue
             with ctx.semaphore:
                 part = self._jit_topn(batch)
+                if part.capacity > part_cap:
+                    part = self._jit_shrink(part)
             partials.append(part)
             total += int(part.num_rows)
         if not partials:

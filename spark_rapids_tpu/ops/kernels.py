@@ -163,10 +163,10 @@ def group_ids(sorted_keys: Sequence[Column], live) -> Tuple[jnp.ndarray, jnp.nda
 def _sorted_group_prelude(batch: ColumnarBatch, key_cols: Sequence[Column]):
     """Shared sort/group-id machinery for update and merge passes.
 
-    Returns (perm, live_s, gid_safe, num_groups, key_batch, row_pos).
-    Dead rows are routed to a scratch gid just past the live groups so
-    their (zeroed) values never pollute a real group; ``row_pos`` is each
-    sorted row's original position (for order-sensitive aggregates).
+    Returns (perm, live_s, gid_safe, num_groups, key_batch). Dead rows
+    are routed to a scratch gid just past the live groups so their
+    (zeroed) values never pollute a real group. Order-sensitive
+    aggregates recover each sorted row's original position from ``perm``.
     """
     live = batch.live_mask()
     cap = batch.capacity

@@ -1,0 +1,17 @@
+"""Distributed execution: device meshes, on-device partitioning, and the
+SPMD shuffle.
+
+TPU-native replacement for the reference's distributed layer (SURVEY §2.7):
+where spark-rapids moves shuffle blocks point-to-point over UCX/RDMA with a
+catalog of device-resident buffers, a TPU pod is an SPMD machine — shuffle
+is reformulated as a windowed ``all_to_all`` over a ``jax.sharding.Mesh``
+riding ICI, with XLA inserting the collectives.
+"""
+
+from .mesh import DATA_AXIS, data_mesh, local_mesh
+from .partition import (PartitionedBatch, flatten_partitions,
+                        hash_partition_ids, partition_batch,
+                        round_robin_partition_ids, string_from_padded)
+from .shuffle import (all_gather_batch, all_to_all_partitions,
+                      distributed_aggregate, shuffle_exchange,
+                      stack_shards, unstack_shards)

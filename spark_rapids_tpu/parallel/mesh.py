@@ -1,0 +1,37 @@
+"""Device mesh construction.
+
+The mesh is the TPU analogue of the reference's executor topology: one
+axis, ``"data"``, plays the role of Spark's task/partition parallelism
+(SURVEY header table: "Spark tasks x partitions"). Shuffle exchanges ride
+this axis as ICI all-to-alls; broadcast joins ride it as all-gathers.
+Cross-slice (DCN) scaling adds an outer axis later without changing any
+operator code — shard_map composes over multi-axis meshes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+
+
+def data_mesh(n_devices: Optional[int] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """A 1-D mesh over the first ``n_devices`` devices, axis ``"data"``."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            raise ValueError(
+                f"need {n_devices} devices, have {len(devices)}")
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (DATA_AXIS,))
+
+
+def local_mesh() -> Mesh:
+    """Mesh over every visible device."""
+    return data_mesh()

@@ -1,0 +1,185 @@
+"""On-device partitioning of columnar batches.
+
+Rebuild of the reference's GPU partitioning layer (GpuPartitioning.scala
+``sliceInternalOnGpuAndClose:63``, GpuHashPartitioningBase.scala:64,
+GpuRoundRobinPartitioning.scala): rows are assigned a destination
+partition on device, then sliced into per-partition sub-batches. The
+static-shape formulation packs every partition into a dense
+``(num_parts, slot_capacity)`` layout — exactly the shape
+``lax.all_to_all`` wants — with per-partition row counts carried
+alongside. A partition that would overflow ``slot_capacity`` reports its
+true count so the host can split-and-retry, mirroring the reference's
+SplitAndRetryOOM contract.
+
+Spark semantics preserved: hash partitioning is
+``pmod(murmur3(keys, seed=42), num_parts)`` so a row lands on the same
+partition id the CPU would send it to (GpuHashPartitioningBase.scala:64).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar import dtypes as dt
+from ..columnar.vector import (Column, ColumnVector, ColumnarBatch,
+                               StringColumn)
+from ..expr.hashing import murmur3_row_hash
+
+
+def hash_partition_ids(key_cols: Sequence[Column], num_parts: int,
+                       seed: int = 42) -> jnp.ndarray:
+    """int32[capacity] destination partition per row (Spark pmod rule)."""
+    h = murmur3_row_hash(key_cols, seed=seed)  # int32
+    m = h % jnp.int32(num_parts)
+    return jnp.where(m < 0, m + num_parts, m)
+
+
+def round_robin_partition_ids(capacity: int, num_parts: int,
+                              start: int = 0) -> jnp.ndarray:
+    return ((jnp.arange(capacity, dtype=jnp.int32) + start) % num_parts)
+
+
+class PartitionedBatch:
+    """A batch split into ``num_parts`` dense slots.
+
+    ``columns[i]`` holds per-column arrays with a leading partition dim:
+      - primitive: data (P, S), validity (P, S)
+      - string:    padded bytes (P, S, W), lengths (P, S), validity (P, S)
+    ``counts`` is int32[P] live rows per partition. All shapes static.
+    """
+
+    __slots__ = ("columns", "names", "dtypes", "counts", "slot_capacity")
+
+    def __init__(self, columns, names, dtypes, counts, slot_capacity: int):
+        self.columns = columns
+        self.names = list(names)
+        self.dtypes = list(dtypes)
+        self.counts = counts
+        self.slot_capacity = slot_capacity
+
+    @property
+    def num_parts(self) -> int:
+        return self.counts.shape[0]
+
+
+def _pb_flatten(p: PartitionedBatch):
+    return (tuple(p.columns), p.counts), (tuple(p.names), tuple(p.dtypes),
+                                          p.slot_capacity)
+
+
+def _pb_unflatten(aux, children):
+    names, dtypes, slot_capacity = aux
+    columns, counts = children
+    return PartitionedBatch(list(columns), list(names), list(dtypes), counts,
+                            slot_capacity)
+
+
+jax.tree_util.register_pytree_node(PartitionedBatch, _pb_flatten, _pb_unflatten)
+
+
+def partition_batch(batch: ColumnarBatch, part_ids: jnp.ndarray,
+                    num_parts: int,
+                    slot_capacity: Optional[int] = None) -> PartitionedBatch:
+    """Pack rows into a dense (num_parts, slot_capacity) layout.
+
+    Rows keep their relative order within a partition (stable sort by
+    destination). Dead rows are routed past the live buckets and dropped.
+    """
+    cap = batch.capacity
+    S = slot_capacity or cap
+    live = batch.live_mask()
+    pid = jnp.where(live, part_ids, jnp.int32(num_parts))
+    order = jnp.argsort(pid, stable=True).astype(jnp.int32)
+    counts_all = jnp.zeros(num_parts + 1, jnp.int32).at[
+        jnp.clip(pid, 0, num_parts)].add(1)
+    counts = counts_all[:num_parts]
+    offsets = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(counts, dtype=jnp.int32)])
+    j = jnp.arange(S, dtype=jnp.int32)
+    srcpos = offsets[:num_parts, None] + j[None, :]          # (P, S)
+    row = jnp.take(order, jnp.clip(srcpos, 0, cap - 1))      # (P, S)
+    valid = j[None, :] < jnp.minimum(counts, S)[:, None]     # (P, S)
+
+    cols_out = []
+    for c in batch.columns:
+        if isinstance(c, StringColumn):
+            padded = c.padded()                              # (cap, W)
+            lens = c.lengths()
+            pb = jnp.take(padded, row, axis=0)               # (P, S, W)
+            pl = jnp.where(valid, jnp.take(lens, row), 0)
+            pv = valid & jnp.take(c.validity, row)
+            pb = jnp.where(valid[:, :, None], pb, jnp.zeros((), jnp.uint8))
+            cols_out.append((pb, pl, pv))
+        else:
+            data = jnp.take(c.data, row)
+            data = jnp.where(valid, data, jnp.zeros((), data.dtype))
+            v = valid & jnp.take(c.validity, row)
+            cols_out.append((data, v))
+    return PartitionedBatch(cols_out, batch.names,
+                            [c.dtype for c in batch.columns],
+                            jnp.minimum(counts, S), S)
+
+
+def string_from_padded(padded: jnp.ndarray, lens: jnp.ndarray,
+                       validity: jnp.ndarray,
+                       char_capacity: Optional[int] = None) -> StringColumn:
+    """Rebuild a StringColumn from a fixed-width (N, W) padded view.
+
+    The inverse of ``StringColumn.padded()`` — used on the receive side of
+    the shuffle, where strings travel as fixed-width byte lanes.
+    """
+    n, w = padded.shape
+    nbytes = char_capacity or n * w
+    offsets = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(lens, dtype=jnp.int32)])
+    pos = jnp.arange(nbytes, dtype=jnp.int32)
+    rowid = jnp.searchsorted(offsets[1:], pos, side="right").astype(jnp.int32)
+    row_c = jnp.clip(rowid, 0, n - 1)
+    within = pos - jnp.take(offsets, row_c)
+    total = offsets[n]
+    chars = jnp.where(
+        pos < total,
+        padded[row_c, jnp.clip(within, 0, w - 1)],
+        jnp.zeros((), jnp.uint8))
+    return StringColumn(offsets, chars, validity, pad_bucket=w)
+
+
+def flatten_partitions(pb: PartitionedBatch,
+                       received_counts: Optional[jnp.ndarray] = None
+                       ) -> ColumnarBatch:
+    """Flatten a (P, S) partitioned layout back into one dense batch.
+
+    ``received_counts`` overrides ``pb.counts`` (after an all_to_all, the
+    exchanged counts describe the blocks now held). Rows are compacted so
+    the output is a standard live-prefix batch of capacity P*S.
+    """
+    P, S = pb.num_parts, pb.slot_capacity
+    counts = pb.counts if received_counts is None else received_counts
+    cap = P * S
+    j = jnp.arange(S, dtype=jnp.int32)
+    slot_valid = (j[None, :] < counts[:, None]).reshape(cap)
+    n = jnp.sum(jnp.minimum(counts, S)).astype(jnp.int32)
+    order = jnp.argsort(~slot_valid, stable=True).astype(jnp.int32)
+
+    cols: List[Column] = []
+    for spec, dtype in zip(pb.columns, pb.dtypes):
+        if dtype == dt.STRING:
+            padded, lens, valid = spec
+            w = padded.shape[-1]
+            flat_b = jnp.take(padded.reshape(cap, w), order, axis=0)
+            flat_l = jnp.take(lens.reshape(cap), order)
+            flat_v = jnp.take(valid.reshape(cap), order)
+            keep = jnp.take(slot_valid, order)
+            flat_l = jnp.where(keep, flat_l, 0)
+            flat_v = flat_v & keep
+            cols.append(string_from_padded(flat_b, flat_l, flat_v))
+        else:
+            data, valid = spec
+            d = jnp.take(data.reshape(cap), order)
+            v = jnp.take(valid.reshape(cap), order) & jnp.take(slot_valid, order)
+            d = jnp.where(v, d, jnp.zeros((), d.dtype))
+            cols.append(ColumnVector(d, v, dtype))
+    return ColumnarBatch(cols, pb.names, n)

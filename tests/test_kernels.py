@@ -55,7 +55,7 @@ def test_group_aggregate_sum_count():
     s = agg.Sum(None)
     c = agg.CountStar()
     key_batch, states = K.group_aggregate(
-        b, [b.column("k")], [b.column("v"), None], [s, c], "update")
+        b, [b.column("k")], [b.column("v"), None], [s, c])
     n = int(key_batch.num_rows)
     assert n == 3
     keys, kmask = key_batch.columns[0].to_numpy(n)
