@@ -22,11 +22,21 @@ Layer map (mirrors SURVEY.md §1, re-architected for TPU):
 
 __version__ = "0.1.0"
 
+import os as _os
+
 import jax as _jax
 
 # SQL semantics (Spark bigint/double) require 64-bit lanes; TPU executes
 # int64/float64 element-wise ops via 32-bit emulation, and the hot matmul
 # paths stay in narrow types regardless.
 _jax.config.update("jax_enable_x64", True)
+
+# The axon TPU plugin force-sets jax_platforms='axon,cpu' at its import,
+# silently overriding a JAX_PLATFORMS=cpu request (used for virtual
+# multi-device CPU runs). Re-assert the env var here — package import
+# necessarily precedes first backend use by any of our entry points,
+# and the update is a no-op once a backend exists.
+if _os.environ.get("JAX_PLATFORMS") == "cpu":
+    _jax.config.update("jax_platforms", "cpu")
 
 from . import columnar  # noqa: F401,E402

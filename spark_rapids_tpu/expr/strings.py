@@ -308,7 +308,8 @@ class StringTrim(Expression):
         first_ns = jnp.argmax(nonspace, axis=1).astype(jnp.int32)
         last_ns = (w - 1 - jnp.argmax(nonspace[:, ::-1], axis=1)).astype(jnp.int32)
         if self.side in ("both", "leading"):
-            start = jnp.where(any_ns, first_ns, 0)
+            # all-space strings trim to empty: start lands at lens
+            start = jnp.where(any_ns, first_ns, lens)
         else:
             start = jnp.zeros(cap, jnp.int32)
         if self.side in ("both", "trailing"):

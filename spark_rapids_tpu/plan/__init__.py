@@ -1,0 +1,22 @@
+"""Plan layer: logical plans, tag-then-convert overrides, CPU fallback.
+
+TPU-native rebuild of the reference's "compiler" (SURVEY §2.2):
+GpuOverrides.scala's rule registry + RapidsMeta.scala's wrapper/tagging
+hierarchy + TypeChecks.scala's support matrices + GpuTransitionOverrides'
+host<->device transition insertion — re-shaped around our own DataFrame
+frontend instead of Catalyst (there is no Spark underneath on TPU; the
+framework IS the query engine, with a numpy CPU executor playing the
+role of "CPU Spark" both as the fallback path and as the differential-
+test oracle).
+"""
+
+from .logical import (Aggregate, Distinct, Expand, Filter, Join, Limit,
+                      LocalRelation, LogicalPlan, Project, Range, Sort,
+                      Union)
+from .session import DataFrame, TpuSession
+
+__all__ = [
+    "LogicalPlan", "LocalRelation", "Project", "Filter", "Aggregate",
+    "Join", "Sort", "Limit", "Union", "Expand", "Range", "Distinct",
+    "DataFrame", "TpuSession",
+]

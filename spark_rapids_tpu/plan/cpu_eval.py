@@ -1,0 +1,1101 @@
+"""CPU (numpy) expression evaluator over HostTable.
+
+The fallback interpreter: evaluates the SAME Expression trees the TPU
+path jit-compiles, but with numpy over host columns. Plays the role of
+"CPU Spark" in the reference's architecture — both the destination of
+unsupported-op fallback (GpuOverrides tagging, SURVEY §2.2) and the
+oracle of the differential test harness (SURVEY §4: CPU plan ≡ GPU plan).
+
+Semantics mirror the expr/ modules (which cite Spark): divide-by-zero ->
+null, Java trunc-mod sign rules, Kleene AND/OR, NaN-greatest ordering,
+null-iff-any-input-null for scalar fns, decimal lanes as scaled int64.
+Every evaluator returns (values, mask) with device physical encodings
+(see host_table.py).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, Tuple, Type
+
+import numpy as np
+
+from ..columnar import dtypes as dt
+from ..expr import arithmetic as A
+from ..expr import cast as C
+from ..expr import conditional as Cond
+from ..expr import core as E
+from ..expr import datetime as D
+from ..expr import mathfns as M
+from ..expr import predicates as P
+from ..expr import strings as S
+from .host_table import HostColumn, HostTable
+
+Result = Tuple[np.ndarray, np.ndarray]  # (values, mask)
+
+_EVALUATORS: Dict[Type, Callable] = {}
+
+
+def cpu_supported(expr: E.Expression) -> bool:
+    return type(expr) in _EVALUATORS
+
+
+def evaluate(expr: E.Expression, table: HostTable) -> HostColumn:
+    """Evaluate to a HostColumn (physical lanes + null mask)."""
+    fn = _EVALUATORS.get(type(expr))
+    if fn is None:
+        raise NotImplementedError(
+            f"no CPU evaluator for {type(expr).__name__}")
+    values, mask = fn(expr, table)
+    return HostColumn(np.asarray(values), np.asarray(mask),
+                      expr.data_type(table.schema()))
+
+
+def _reg(cls):
+    def deco(fn):
+        _EVALUATORS[cls] = fn
+        return fn
+    return deco
+
+
+def _ev(expr, table) -> Result:
+    c = evaluate(expr, table)
+    return c.values, c.mask
+
+
+def _zero_nulls(values, mask):
+    """Zero data lanes under nulls (the device-side invariant)."""
+    if values.dtype == object:
+        return np.where(mask, values, "")
+    return np.where(mask, values, np.zeros(1, dtype=values.dtype))
+
+
+# ---------------------------------------------------------------------------
+# core
+# ---------------------------------------------------------------------------
+
+@_reg(E.ColumnRef)
+def _col(expr, table):
+    c = table.column(expr.name)
+    return c.values, c.mask
+
+
+@_reg(E.Alias)
+def _alias(expr, table):
+    return _ev(expr.children[0], table)
+
+
+@_reg(E.Literal)
+def _literal(expr, table):
+    n = table.num_rows
+    t = expr.dtype
+    if expr.value is None:
+        phys = object if t == dt.STRING else np.dtype(
+            (t.physical or np.int32))
+        return np.zeros(n, phys), np.zeros(n, bool)
+    if t == dt.STRING:
+        return np.full(n, str(expr.value), dtype=object), np.ones(n, bool)
+    from ..columnar.vector import _to_physical
+    v = _to_physical(expr.value, t)
+    return (np.full(n, v, dtype=np.dtype(t.physical)), np.ones(n, bool))
+
+
+# ---------------------------------------------------------------------------
+# arithmetic
+# ---------------------------------------------------------------------------
+
+def _rescale_np(data, from_scale: int, to_scale: int):
+    if to_scale > from_scale:
+        return data * np.int64(10 ** (to_scale - from_scale))
+    if to_scale < from_scale:
+        return data // np.int64(10 ** (from_scale - to_scale))
+    return data
+
+
+def _binary_arith(expr, table, op):
+    lt = expr.children[0].data_type(table.schema())
+    rt = expr.children[1].data_type(table.schema())
+    out_t = expr.data_type(table.schema())
+    a, am = _ev(expr.children[0], table)
+    b, bm = _ev(expr.children[1], table)
+    mask = am & bm
+    if isinstance(out_t, dt.DecimalType):
+        a = _rescale_np(a.astype(np.int64), lt.scale, out_t.scale) \
+            if op != "mul" else a.astype(np.int64)
+        b = _rescale_np(b.astype(np.int64), rt.scale, out_t.scale) \
+            if op != "mul" else b.astype(np.int64)
+        if op == "add":
+            out = a + b
+        elif op == "sub":
+            out = a - b
+        else:
+            out = _rescale_np(a * b, lt.scale + rt.scale, out_t.scale)
+        return _zero_nulls(out, mask), mask
+    phys = np.dtype(out_t.physical)
+    a = a.astype(phys)
+    b = b.astype(phys)
+    with np.errstate(over="ignore"):
+        if op == "add":
+            out = a + b
+        elif op == "sub":
+            out = a - b
+        else:
+            out = a * b
+    return _zero_nulls(out, mask), mask
+
+
+@_reg(A.Add)
+def _add(e, t):
+    return _binary_arith(e, t, "add")
+
+
+@_reg(A.Subtract)
+def _sub(e, t):
+    return _binary_arith(e, t, "sub")
+
+
+@_reg(A.Multiply)
+def _mul(e, t):
+    return _binary_arith(e, t, "mul")
+
+
+@_reg(A.Divide)
+def _div(expr, table):
+    lt = expr.children[0].data_type(table.schema())
+    rt = expr.children[1].data_type(table.schema())
+    a, am = _ev(expr.children[0], table)
+    b, bm = _ev(expr.children[1], table)
+    a = a.astype(np.float64)
+    b = b.astype(np.float64)
+    if isinstance(lt, dt.DecimalType):
+        a = a / (10.0 ** lt.scale)
+    if isinstance(rt, dt.DecimalType):
+        b = b / (10.0 ** rt.scale)
+    mask = am & bm & (b != 0.0)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        out = np.where(b != 0.0, a / np.where(b == 0.0, 1.0, b), 0.0)
+    return _zero_nulls(out, mask), mask
+
+
+def _trunc_div_np(a, b):
+    q = a // b
+    r = a - q * b
+    adjust = (r != 0) & ((a < 0) != (b < 0))
+    return q + adjust.astype(q.dtype)
+
+
+def _trunc_mod_np(a, b):
+    r = a % b
+    adjust = (r != 0) & ((a < 0) != (b < 0))
+    return r - np.where(adjust, b, np.zeros(1, b.dtype))
+
+
+@_reg(A.IntegralDivide)
+def _idiv(expr, table):
+    a, am = _ev(expr.children[0], table)
+    b, bm = _ev(expr.children[1], table)
+    mask = am & bm & (b != 0)
+    safe = np.where(b == 0, np.ones(1, b.dtype), b)
+    if np.issubdtype(a.dtype, np.floating):
+        q = np.trunc(a.astype(np.float64) / safe.astype(np.float64))
+    else:
+        q = _trunc_div_np(a, safe)
+    return _zero_nulls(q.astype(np.int64), mask), mask
+
+
+@_reg(A.Remainder)
+def _rem(expr, table):
+    out_t = expr.data_type(table.schema())
+    phys = np.dtype(out_t.physical)
+    a, am = _ev(expr.children[0], table)
+    b, bm = _ev(expr.children[1], table)
+    a = a.astype(phys)
+    b = b.astype(phys)
+    mask = am & bm & (b != 0)
+    safe = np.where(b == 0, np.ones(1, b.dtype), b)
+    if np.issubdtype(a.dtype, np.floating):
+        out = np.fmod(a, safe)
+    else:
+        out = _trunc_mod_np(a, safe)
+    return _zero_nulls(out, mask), mask
+
+
+@_reg(A.Pmod)
+def _pmod(expr, table):
+    out_t = expr.data_type(table.schema())
+    phys = np.dtype(out_t.physical)
+    a, am = _ev(expr.children[0], table)
+    b, bm = _ev(expr.children[1], table)
+    a = a.astype(phys)
+    b = b.astype(phys)
+    mask = am & bm & (b != 0)
+    safe = np.where(b == 0, np.ones(1, b.dtype), b)
+    if np.issubdtype(a.dtype, np.floating):
+        r = np.fmod(a, safe)
+    else:
+        r = _trunc_mod_np(a, safe)
+    r = np.where(r < 0, r + np.abs(safe), r)
+    return _zero_nulls(r, mask), mask
+
+
+@_reg(A.UnaryMinus)
+def _neg(expr, table):
+    a, m = _ev(expr.children[0], table)
+    return _zero_nulls(-a, m), m
+
+
+@_reg(A.UnaryPositive)
+def _pos(expr, table):
+    return _ev(expr.children[0], table)
+
+
+@_reg(A.Abs)
+def _abs(expr, table):
+    a, m = _ev(expr.children[0], table)
+    return _zero_nulls(np.abs(a), m), m
+
+
+def _least_greatest(expr, table, largest: bool):
+    out_t = expr.data_type(table.schema())
+    phys = np.dtype(out_t.physical)
+    n = table.num_rows
+    fill = dt.max_value(out_t) if not largest else dt.min_value(out_t)
+    acc = np.full(n, fill, phys)
+    any_valid = np.zeros(n, bool)
+    for c in expr.children:
+        v, m = _ev(c, table)
+        v = np.where(m, v.astype(phys), np.asarray(fill, phys))
+        acc = np.maximum(acc, v) if largest else np.minimum(acc, v)
+        any_valid |= m
+    return _zero_nulls(acc, any_valid), any_valid
+
+
+@_reg(A.Least)
+def _least(e, t):
+    return _least_greatest(e, t, largest=False)
+
+
+@_reg(A.Greatest)
+def _greatest(e, t):
+    return _least_greatest(e, t, largest=True)
+
+
+# ---------------------------------------------------------------------------
+# predicates
+# ---------------------------------------------------------------------------
+
+def _aligned_np(expr, table):
+    lt = expr.children[0].data_type(table.schema())
+    rt = expr.children[1].data_type(table.schema())
+    a, am = _ev(expr.children[0], table)
+    b, bm = _ev(expr.children[1], table)
+    mask = am & bm
+    l_dec = isinstance(lt, dt.DecimalType)
+    r_dec = isinstance(rt, dt.DecimalType)
+    if lt == dt.STRING or rt == dt.STRING:
+        return a, b, mask, True
+    if l_dec or r_dec:
+        lf = (not l_dec) and lt.is_floating
+        rf = (not r_dec) and rt.is_floating
+        if lf or rf:
+            a = a.astype(np.float64) / (10.0 ** lt.scale if l_dec else 1.0)
+            b = b.astype(np.float64) / (10.0 ** rt.scale if r_dec else 1.0)
+        else:
+            ls = lt.scale if l_dec else 0
+            rs = rt.scale if r_dec else 0
+            s = max(ls, rs)
+            a = a.astype(np.int64) * (10 ** (s - ls))
+            b = b.astype(np.int64) * (10 ** (s - rs))
+        return a, b, mask, False
+    if a.dtype != b.dtype:
+        out_t = dt.promote(lt, rt)
+        phys = np.dtype(out_t.physical)
+        a = a.astype(phys)
+        b = b.astype(phys)
+    return a, b, mask, False
+
+
+def _nan_lt(a, b):
+    if np.issubdtype(a.dtype, np.floating):
+        a_nan = np.isnan(a)
+        b_nan = np.isnan(b)
+        return np.where(a_nan, False, np.where(b_nan, True, a < b))
+    return a < b
+
+
+def _nan_eq(a, b):
+    if a.dtype != object and np.issubdtype(a.dtype, np.floating):
+        return (np.isnan(a) & np.isnan(b)) | (a == b)
+    return a == b
+
+
+def _str_lt(a, b):
+    # Python str compare is code-point order == UTF-8 byte order.
+    return np.array([x < y for x, y in zip(a, b)], dtype=bool) \
+        if len(a) else np.zeros(0, bool)
+
+
+def _cmp(expr, table, kind):
+    a, b, mask, is_str = _aligned_np(expr, table)
+    if is_str:
+        if kind == "eq":
+            out = a == b
+        elif kind == "lt":
+            out = _str_lt(a, b)
+        elif kind == "gt":
+            out = _str_lt(b, a)
+        elif kind == "le":
+            out = ~_str_lt(b, a)
+        else:
+            out = ~_str_lt(a, b)
+    else:
+        if kind == "eq":
+            out = _nan_eq(a, b)
+        elif kind == "lt":
+            out = _nan_lt(a, b)
+        elif kind == "gt":
+            out = _nan_lt(b, a)
+        elif kind == "le":
+            out = ~_nan_lt(b, a)
+        else:
+            out = ~_nan_lt(a, b)
+    out = np.asarray(out, bool)
+    return out & mask, mask
+
+
+@_reg(P.EqualTo)
+def _eq(e, t):
+    return _cmp(e, t, "eq")
+
+
+@_reg(P.LessThan)
+def _lt(e, t):
+    return _cmp(e, t, "lt")
+
+
+@_reg(P.GreaterThan)
+def _gt(e, t):
+    return _cmp(e, t, "gt")
+
+
+@_reg(P.LessThanOrEqual)
+def _le(e, t):
+    return _cmp(e, t, "le")
+
+
+@_reg(P.GreaterThanOrEqual)
+def _ge(e, t):
+    return _cmp(e, t, "ge")
+
+
+@_reg(P.EqualNullSafe)
+def _eqns(expr, table):
+    a, am = _ev(expr.children[0], table)
+    b, bm = _ev(expr.children[1], table)
+    lt = expr.children[0].data_type(table.schema())
+    if lt == dt.STRING:
+        eq = a == b
+    else:
+        eq = _nan_eq(a, b)
+    out = (~am & ~bm) | (am & bm & np.asarray(eq, bool))
+    return out, np.ones(table.num_rows, bool)
+
+
+@_reg(P.And)
+def _and(expr, table):
+    a, am = _ev(expr.children[0], table)
+    b, bm = _ev(expr.children[1], table)
+    known_false = (am & ~a) | (bm & ~b)
+    mask = (am & bm) | known_false
+    return (a & b) & ~known_false & mask, mask
+
+
+@_reg(P.Or)
+def _or(expr, table):
+    a, am = _ev(expr.children[0], table)
+    b, bm = _ev(expr.children[1], table)
+    known_true = (am & a) | (bm & b)
+    mask = (am & bm) | known_true
+    return (known_true | (a & b)) & mask, mask
+
+
+@_reg(P.Not)
+def _not(expr, table):
+    a, m = _ev(expr.children[0], table)
+    return (~a) & m, m
+
+
+@_reg(P.IsNull)
+def _isnull(expr, table):
+    _, m = _ev(expr.children[0], table)
+    return ~m, np.ones(table.num_rows, bool)
+
+
+@_reg(P.IsNotNull)
+def _isnotnull(expr, table):
+    _, m = _ev(expr.children[0], table)
+    return m, np.ones(table.num_rows, bool)
+
+
+@_reg(P.IsNaN)
+def _isnan(expr, table):
+    a, m = _ev(expr.children[0], table)
+    out = np.isnan(a.astype(np.float64)) if a.dtype != object else \
+        np.zeros(len(a), bool)
+    return out & m, m
+
+
+@_reg(P.InSet)
+def _inset(expr, table):
+    a, m = _ev(expr.children[0], table)
+    lt = expr.children[0].data_type(table.schema())
+    vals = [v for v in expr.values if v is not None]
+    if lt == dt.STRING:
+        hit = np.isin(np.asarray(a, dtype=object), np.array(vals, object)) \
+            if vals else np.zeros(len(a), bool)
+    else:
+        from ..columnar.vector import _to_physical
+        phys = [_to_physical(v, lt) for v in vals]
+        hit = np.isin(a, np.array(phys, a.dtype)) if phys else \
+            np.zeros(len(a), bool)
+    return hit & m, m
+
+
+# ---------------------------------------------------------------------------
+# conditionals
+# ---------------------------------------------------------------------------
+
+def _coerce_to(values, mask, from_t, to_t, n):
+    """Cast already-evaluated lanes to the common output type."""
+    if from_t == to_t:
+        return values, mask
+    if to_t == dt.STRING or from_t == dt.STRING:
+        return values, mask
+    if isinstance(to_t, dt.DecimalType):
+        if isinstance(from_t, dt.DecimalType):
+            return _rescale_np(values.astype(np.int64), from_t.scale,
+                               to_t.scale), mask
+        return values.astype(np.int64) * np.int64(10 ** to_t.scale), mask
+    return values.astype(np.dtype(to_t.physical)), mask
+
+
+def _select_eval(expr, table, branches, default):
+    """Shared CASE WHEN machinery: branches = [(cond_expr, value_expr)]."""
+    schema = table.schema()
+    out_t = expr.data_type(schema)
+    n = table.num_rows
+    if out_t == dt.STRING:
+        out = np.full(n, "", dtype=object)
+    else:
+        out = np.zeros(n, np.dtype(out_t.physical))
+    out_mask = np.zeros(n, bool)
+    decided = np.zeros(n, bool)
+    for cond_e, val_e in branches:
+        cv, cm = _ev(cond_e, table)
+        take = (~decided) & cm & cv
+        v, m = _ev(val_e, table)
+        v, m = _coerce_to(v, m, val_e.data_type(schema), out_t, n)
+        out = np.where(take, v, out)
+        out_mask = np.where(take, m, out_mask)
+        decided |= take
+    if default is not None:
+        v, m = _ev(default, table)
+        v, m = _coerce_to(v, m, default.data_type(schema), out_t, n)
+        out = np.where(~decided, v, out)
+        out_mask = np.where(~decided, m, out_mask)
+    return _zero_nulls(out, out_mask), out_mask
+
+
+@_reg(Cond.If)
+def _if(expr, table):
+    pred, a, b = expr.children
+    return _select_eval(expr, table, [(pred, a)], b)
+
+
+@_reg(Cond.CaseWhen)
+def _casewhen(expr, table):
+    return _select_eval(expr, table, expr.branches, expr.otherwise)
+
+
+@_reg(Cond.Coalesce)
+def _coalesce(expr, table):
+    schema = table.schema()
+    out_t = expr.data_type(schema)
+    n = table.num_rows
+    if out_t == dt.STRING:
+        out = np.full(n, "", dtype=object)
+    else:
+        out = np.zeros(n, np.dtype(out_t.physical))
+    out_mask = np.zeros(n, bool)
+    for c in expr.children:
+        v, m = _ev(c, table)
+        v, m = _coerce_to(v, m, c.data_type(schema), out_t, n)
+        take = (~out_mask) & m
+        out = np.where(take, v, out)
+        out_mask |= take
+    return _zero_nulls(out, out_mask), out_mask
+
+
+@_reg(Cond.Nvl)
+def _nvl(expr, table):
+    return _coalesce(expr, table)
+
+
+@_reg(Cond.NullIf)
+def _nullif(expr, table):
+    a, am = _ev(expr.children[0], table)
+    b, bm = _ev(expr.children[1], table)
+    lt = expr.children[0].data_type(table.schema())
+    eq = (a == b) if lt == dt.STRING else _nan_eq(a, b)
+    mask = am & ~(am & bm & np.asarray(eq, bool))
+    return _zero_nulls(a, mask), mask
+
+
+@_reg(Cond.Nvl2)
+def _nvl2(expr, table):
+    from ..expr.predicates import IsNotNull
+    x, a, b = expr.children
+    return _select_eval(expr, table, [(IsNotNull(x), a)], b)
+
+
+# ---------------------------------------------------------------------------
+# math
+# ---------------------------------------------------------------------------
+
+def _unary_double(fn):
+    def ev(expr, table):
+        a, m = _ev(expr.children[0], table)
+        with np.errstate(all="ignore"):
+            out = fn(a.astype(np.float64))
+        return _zero_nulls(out, m), m
+    return ev
+
+
+_MATH_FNS = {
+    M.Sqrt: np.sqrt, M.Cbrt: np.cbrt, M.Exp: np.exp, M.Expm1: np.expm1,
+    M.Log1p: np.log1p,
+    M.Sin: np.sin, M.Cos: np.cos, M.Tan: np.tan,
+    M.Asin: np.arcsin, M.Acos: np.arccos, M.Atan: np.arctan,
+    M.Sinh: np.sinh, M.Cosh: np.cosh, M.Tanh: np.tanh,
+    M.Asinh: np.arcsinh, M.Acosh: np.arccosh, M.Atanh: np.arctanh,
+    M.ToDegrees: np.degrees, M.ToRadians: np.radians,
+    M.Signum: np.sign, M.Rint: np.rint,
+}
+for _cls, _fn in _MATH_FNS.items():
+    _EVALUATORS[_cls] = _unary_double(_fn)
+
+
+def _log_like(np_fn):
+    """Spark log-family: non-positive input -> null."""
+    def ev(expr, table):
+        a, m = _ev(expr.children[0], table)
+        x = a.astype(np.float64)
+        mask = m & (x > 0)
+        with np.errstate(all="ignore"):
+            out = np_fn(np.where(x > 0, x, 1.0))
+        return _zero_nulls(out, mask), mask
+    return ev
+
+
+_EVALUATORS[M.Log] = _log_like(np.log)
+_EVALUATORS[M.Log2] = _log_like(np.log2)
+_EVALUATORS[M.Log10] = _log_like(np.log10)
+
+
+@_reg(M.Floor)
+def _floor(expr, table):
+    a, m = _ev(expr.children[0], table)
+    t = expr.children[0].data_type(table.schema())
+    if isinstance(t, dt.DecimalType):
+        out = a.astype(np.int64) // np.int64(10 ** t.scale)
+        return _zero_nulls(out, m), m
+    return _zero_nulls(np.floor(a.astype(np.float64)).astype(np.int64), m), m
+
+
+@_reg(M.Ceil)
+def _ceil(expr, table):
+    a, m = _ev(expr.children[0], table)
+    t = expr.children[0].data_type(table.schema())
+    if isinstance(t, dt.DecimalType):
+        out = -((-a.astype(np.int64)) // np.int64(10 ** t.scale))
+        return _zero_nulls(out, m), m
+    return _zero_nulls(np.ceil(a.astype(np.float64)).astype(np.int64), m), m
+
+
+@_reg(M.Pow)
+def _pow(expr, table):
+    a, am = _ev(expr.children[0], table)
+    b, bm = _ev(expr.children[1], table)
+    m = am & bm
+    with np.errstate(all="ignore"):
+        out = np.power(a.astype(np.float64), b.astype(np.float64))
+    return _zero_nulls(out, m), m
+
+
+@_reg(M.Atan2)
+def _atan2(expr, table):
+    a, am = _ev(expr.children[0], table)
+    b, bm = _ev(expr.children[1], table)
+    m = am & bm
+    out = np.arctan2(a.astype(np.float64), b.astype(np.float64))
+    return _zero_nulls(out, m), m
+
+
+@_reg(M.Hypot)
+def _hypot(expr, table):
+    a, am = _ev(expr.children[0], table)
+    b, bm = _ev(expr.children[1], table)
+    m = am & bm
+    out = np.hypot(a.astype(np.float64), b.astype(np.float64))
+    return _zero_nulls(out, m), m
+
+
+def _round_half_up(x, scale):
+    f = 10.0 ** scale
+    return np.floor(np.abs(x) * f + 0.5) / f * np.sign(x)
+
+
+def _round_common(expr, table, half_even: bool):
+    a, m = _ev(expr.children[0], table)
+    t = expr.children[0].data_type(table.schema())
+    scale = expr.scale
+    if isinstance(t, dt.DecimalType):
+        # output scale = min(scale, t.scale) (scale>=0) else 0; HALF_UP on
+        # the unscaled lanes (mirrors Round.eval for decimals)
+        target = min(scale, t.scale) if scale >= 0 else 0
+        drop = t.scale - target
+        if drop <= 0:
+            return a, m
+        p = np.int64(10 ** drop)
+        half = p // 2
+        av = a.astype(np.int64)
+        out = np.where(av >= 0, (av + half) // p, -((-av + half) // p))
+        return _zero_nulls(out, m), m
+    if t.is_integral:
+        if scale >= 0:
+            return a, m
+        p = np.int64(10 ** (-scale))
+        half = p // 2
+        out = np.where(a >= 0, (a + half) // p, -((-a + half) // p)) * p
+        return _zero_nulls(out, m), m
+    x = a.astype(np.float64)
+    if half_even:
+        f = 10.0 ** scale
+        out = np.round(x * f) / f  # numpy round = HALF_EVEN
+    else:
+        out = _round_half_up(x, scale)
+    return _zero_nulls(out.astype(a.dtype), m), m
+
+
+@_reg(M.Round)
+def _round(expr, table):
+    return _round_common(expr, table, half_even=False)
+
+
+@_reg(M.BRound)
+def _bround(expr, table):
+    return _round_common(expr, table, half_even=True)
+
+
+# ---------------------------------------------------------------------------
+# strings
+# ---------------------------------------------------------------------------
+
+def _str_map(fn):
+    def ev(expr, table):
+        a, m = _ev(expr.children[0], table)
+        out = np.array([fn(x) for x in a], dtype=object) if len(a) else \
+            np.empty(0, object)
+        return np.where(m, out, ""), m
+    return ev
+
+
+@_reg(S.Length)
+def _length(expr, table):
+    a, m = _ev(expr.children[0], table)
+    out = np.array([len(x) for x in a], dtype=np.int32) if len(a) else \
+        np.empty(0, np.int32)
+    return _zero_nulls(out, m), m
+
+
+@_reg(S.OctetLength)
+def _octet_length(expr, table):
+    a, m = _ev(expr.children[0], table)
+    out = np.array([len(x.encode("utf-8")) for x in a], dtype=np.int32) \
+        if len(a) else np.empty(0, np.int32)
+    return _zero_nulls(out, m), m
+
+
+_EVALUATORS[S.Upper] = _str_map(lambda s: s.upper())
+_EVALUATORS[S.Lower] = _str_map(lambda s: s.lower())
+
+
+@_reg(S.Substring)
+def _substring(expr, table):
+    a, m = _ev(expr.children[0], table)
+    pos, length = expr.pos, expr.length
+    def sub(s):
+        # Spark 1-based substring semantics
+        if pos > 0:
+            start = pos - 1
+        elif pos == 0:
+            start = 0
+        else:
+            start = max(len(s) + pos, 0)
+        end = min(start + length, len(s))
+        return s[start:end]
+    out = np.array([sub(x) for x in a], dtype=object) if len(a) else \
+        np.empty(0, object)
+    return np.where(m, out, ""), m
+
+
+@_reg(S.Concat)
+def _concat(expr, table):
+    n = table.num_rows
+    parts = [_ev(c, table) for c in expr.children]
+    mask = np.ones(n, bool)
+    for _, m in parts:
+        mask &= m
+    out = np.array(["".join(p[0][i] for p in parts) for i in range(n)],
+                   dtype=object) if n else np.empty(0, object)
+    return np.where(mask, out, ""), mask
+
+
+def _str_static_predicate(attr, fn):
+    # StartsWith/EndsWith/Contains carry a static pattern string
+    def ev(expr, table):
+        a, m = _ev(expr.children[0], table)
+        p = getattr(expr, attr)
+        out = np.array([fn(x, p) for x in a], dtype=bool) \
+            if len(a) else np.empty(0, bool)
+        return out & m, m
+    return ev
+
+
+_EVALUATORS[S.StartsWith] = _str_static_predicate(
+    "prefix", lambda s, p: s.startswith(p))
+_EVALUATORS[S.EndsWith] = _str_static_predicate(
+    "suffix", lambda s, p: s.endswith(p))
+_EVALUATORS[S.Contains] = _str_static_predicate(
+    "needle", lambda s, p: p in s)
+
+
+@_reg(S.Like)
+def _like(expr, table):
+    import re
+    a, m = _ev(expr.children[0], table)
+    pat = expr.pattern
+    esc = expr.escape
+    regex = []
+    i = 0
+    while i < len(pat):
+        ch = pat[i]
+        if ch == esc and i + 1 < len(pat):
+            regex.append(re.escape(pat[i + 1]))
+            i += 2
+            continue
+        if ch == "%":
+            regex.append(".*")
+        elif ch == "_":
+            regex.append(".")
+        else:
+            regex.append(re.escape(ch))
+        i += 1
+    prog = re.compile("(?s)^" + "".join(regex) + "$")
+    out = np.array([prog.match(x) is not None for x in a], dtype=bool) \
+        if len(a) else np.empty(0, bool)
+    return out & m, m
+
+
+def _trim_eval(which):
+    # TPU impl trims only ASCII space (byte 32); mirror exactly.
+    def ev(expr, table):
+        a, m = _ev(expr.children[0], table)
+        def trim(s):
+            if which == "both":
+                return s.strip(" ")
+            if which == "left":
+                return s.lstrip(" ")
+            return s.rstrip(" ")
+        out = np.array([trim(x) for x in a], dtype=object) if len(a) else \
+            np.empty(0, object)
+        return np.where(m, out, ""), m
+    return ev
+
+
+_EVALUATORS[S.StringTrim] = _trim_eval("both")
+_EVALUATORS[S.StringTrimLeft] = _trim_eval("left")
+_EVALUATORS[S.StringTrimRight] = _trim_eval("right")
+
+
+# ---------------------------------------------------------------------------
+# datetime (lanes: date = int32 days since epoch, ts = int64 micros UTC)
+# ---------------------------------------------------------------------------
+
+_EPOCH = np.datetime64("1970-01-01", "D")
+
+
+def _days_to_ymd(days):
+    d = _EPOCH + days.astype("timedelta64[D]")
+    y = d.astype("datetime64[Y]").astype(np.int64) + 1970
+    month = (d.astype("datetime64[M]").astype(np.int64) % 12) + 1
+    day = (d - d.astype("datetime64[M]")).astype(np.int64) + 1
+    return y, month, day
+
+
+def _date_field(fn):
+    def ev(expr, table):
+        a, m = _ev(expr.children[0], table)
+        y, mo, dnum = _days_to_ymd(a.astype(np.int64))
+        out = fn(a.astype(np.int64), y, mo, dnum).astype(np.int32)
+        return _zero_nulls(out, m), m
+    return ev
+
+
+_EVALUATORS[D.Year] = _date_field(lambda d, y, mo, dd: y)
+_EVALUATORS[D.Month] = _date_field(lambda d, y, mo, dd: mo)
+_EVALUATORS[D.DayOfMonth] = _date_field(lambda d, y, mo, dd: dd)
+_EVALUATORS[D.Quarter] = _date_field(lambda d, y, mo, dd: (mo - 1) // 3 + 1)
+# Spark dayofweek: 1 = Sunday. Epoch (1970-01-01) was a Thursday.
+_EVALUATORS[D.DayOfWeek] = _date_field(
+    lambda d, y, mo, dd: ((d + 4) % 7) + 1)
+# weekday(): 0 = Monday
+_EVALUATORS[D.WeekDay] = _date_field(lambda d, y, mo, dd: (d + 3) % 7)
+_EVALUATORS[D.DayOfYear] = _date_field(
+    lambda d, y, mo, dd: d - (
+        (_EPOCH + d.astype("timedelta64[D]")).astype("datetime64[Y]")
+        - _EPOCH).astype(np.int64) + 1)
+
+
+@_reg(D.LastDay)
+def _lastday(expr, table):
+    a, m = _ev(expr.children[0], table)
+    d = _EPOCH + a.astype(np.int64).astype("timedelta64[D]")
+    month_start = d.astype("datetime64[M]")
+    next_month = month_start + np.timedelta64(1, "M")
+    out = (next_month.astype("datetime64[D]") - np.timedelta64(1, "D")
+           - _EPOCH).astype(np.int32)
+    return _zero_nulls(out, m), m
+
+
+def _time_field(fn):
+    def ev(expr, table):
+        a, m = _ev(expr.children[0], table)
+        micros = a.astype(np.int64)
+        secs = np.floor_divide(micros, 1_000_000)
+        out = fn(secs).astype(np.int32)
+        return _zero_nulls(out, m), m
+    return ev
+
+
+_EVALUATORS[D.Hour] = _time_field(lambda s: (s % 86400) // 3600)
+_EVALUATORS[D.Minute] = _time_field(lambda s: (s % 3600) // 60)
+_EVALUATORS[D.Second] = _time_field(lambda s: s % 60)
+
+
+@_reg(D.DateAdd)
+def _dateadd(expr, table):
+    a, am = _ev(expr.children[0], table)
+    b, bm = _ev(expr.children[1], table)
+    m = am & bm
+    return _zero_nulls((a.astype(np.int64) + b.astype(np.int64))
+                       .astype(np.int32), m), m
+
+
+@_reg(D.DateSub)
+def _datesub(expr, table):
+    a, am = _ev(expr.children[0], table)
+    b, bm = _ev(expr.children[1], table)
+    m = am & bm
+    return _zero_nulls((a.astype(np.int64) - b.astype(np.int64))
+                       .astype(np.int32), m), m
+
+
+@_reg(D.DateDiff)
+def _datediff(expr, table):
+    a, am = _ev(expr.children[0], table)
+    b, bm = _ev(expr.children[1], table)
+    m = am & bm
+    return _zero_nulls((a.astype(np.int64) - b.astype(np.int64))
+                       .astype(np.int32), m), m
+
+
+@_reg(D.AddMonths)
+def _addmonths(expr, table):
+    a, am = _ev(expr.children[0], table)
+    b, bm = _ev(expr.children[1], table)
+    m = am & bm
+    d = _EPOCH + a.astype(np.int64).astype("timedelta64[D]")
+    month0 = d.astype("datetime64[M]")
+    day_in_month = (d - month0).astype(np.int64)
+    new_month = month0 + b.astype(np.int64).astype("timedelta64[M]")
+    next_m = new_month + np.timedelta64(1, "M")
+    month_len = (next_m.astype("datetime64[D]")
+                 - new_month.astype("datetime64[D]")).astype(np.int64)
+    day = np.minimum(day_in_month, month_len - 1)
+    out = (new_month.astype("datetime64[D]") - _EPOCH).astype(np.int64) + day
+    return _zero_nulls(out.astype(np.int32), m), m
+
+
+@_reg(D.UnixTimestampToSeconds)
+def _unixts(expr, table):
+    a, m = _ev(expr.children[0], table)
+    out = np.floor_divide(a.astype(np.int64), 1_000_000)
+    return _zero_nulls(out, m), m
+
+
+@_reg(D.FromUnixTime)
+def _fromunix(expr, table):
+    a, m = _ev(expr.children[0], table)
+    out = a.astype(np.int64) * 1_000_000
+    return _zero_nulls(out, m), m
+
+
+@_reg(D.MakeDate)
+def _makedate(expr, table):
+    y, ym = _ev(expr.children[0], table)
+    mo, mm = _ev(expr.children[1], table)
+    d, dm = _ev(expr.children[2], table)
+    m = ym & mm & dm
+    out = np.zeros(len(y), np.int32)
+    ok = np.ones(len(y), bool)
+    for i in range(len(y)):
+        if not m[i]:
+            continue
+        try:
+            import datetime
+            out[i] = (datetime.date(int(y[i]), int(mo[i]), int(d[i]))
+                      - datetime.date(1970, 1, 1)).days
+        except ValueError:
+            ok[i] = False
+    m = m & ok
+    return _zero_nulls(out, m), m
+
+
+# ---------------------------------------------------------------------------
+# cast
+# ---------------------------------------------------------------------------
+
+@_reg(C.Cast)
+def _cast(expr, table):
+    schema = table.schema()
+    from_t = expr.children[0].data_type(schema)
+    to_t = expr.to
+    a, m = _ev(expr.children[0], table)
+    n = table.num_rows
+    if from_t == to_t:
+        return a, m
+    # string -> X
+    if from_t == dt.STRING:
+        if to_t == dt.STRING:
+            return a, m
+        out = np.zeros(n, np.dtype(to_t.physical))
+        ok = np.zeros(n, bool)
+        for i in range(n):
+            if not m[i]:
+                continue
+            s = str(a[i]).strip()
+            try:
+                if isinstance(to_t, dt.DecimalType):
+                    import decimal
+                    out[i] = int(decimal.Decimal(s)
+                                 .scaleb(to_t.scale).to_integral_value())
+                elif to_t.is_floating:
+                    out[i] = float(s)
+                elif to_t == dt.BOOL:
+                    sl = s.lower()
+                    if sl in ("t", "true", "y", "yes", "1"):
+                        out[i] = True
+                    elif sl in ("f", "false", "n", "no", "0"):
+                        out[i] = False
+                    else:
+                        raise ValueError(s)
+                elif to_t == dt.DATE:
+                    import datetime
+                    out[i] = (datetime.date.fromisoformat(s[:10])
+                              - datetime.date(1970, 1, 1)).days
+                else:
+                    out[i] = int(float(s)) if ("." in s or "e" in s.lower()) \
+                        else int(s)
+                ok[i] = True
+            except (ValueError, ArithmeticError):
+                ok[i] = False
+        m = m & ok
+        return _zero_nulls(out, m), m
+    # X -> string
+    if to_t == dt.STRING:
+        out = np.empty(n, object)
+        for i in range(n):
+            out[i] = _value_to_string(a[i], from_t) if m[i] else ""
+        return out, m
+    # decimal source
+    if isinstance(from_t, dt.DecimalType):
+        real = a.astype(np.float64) / (10.0 ** from_t.scale)
+        if isinstance(to_t, dt.DecimalType):
+            out = _rescale_np(a.astype(np.int64), from_t.scale, to_t.scale)
+            lim = np.int64(10 ** min(to_t.precision, 18))
+            ok = np.abs(out) < lim
+            m = m & ok
+            return _zero_nulls(out, m), m
+        if to_t.is_floating:
+            return _zero_nulls(real.astype(np.dtype(to_t.physical)), m), m
+        return _zero_nulls(np.trunc(real).astype(np.dtype(to_t.physical)),
+                           m), m
+    # numeric -> decimal
+    if isinstance(to_t, dt.DecimalType):
+        if from_t.is_floating:
+            scaled = np.round(a.astype(np.float64) * 10 ** to_t.scale)
+            ok = np.isfinite(scaled) & (np.abs(scaled) < 10 ** min(
+                to_t.precision, 18))
+            m = m & ok
+            out = np.where(ok, scaled, 0).astype(np.int64)
+            return _zero_nulls(out, m), m
+        out = a.astype(np.int64) * np.int64(10 ** to_t.scale)
+        lim = np.int64(10 ** min(to_t.precision, 18))
+        ok = np.abs(out) < lim
+        m = m & ok
+        return _zero_nulls(out, m), m
+    # timestamp <-> date
+    if from_t == dt.TIMESTAMP and to_t == dt.DATE:
+        out = np.floor_divide(a.astype(np.int64),
+                              86_400_000_000).astype(np.int32)
+        return _zero_nulls(out, m), m
+    if from_t == dt.DATE and to_t == dt.TIMESTAMP:
+        out = a.astype(np.int64) * 86_400_000_000
+        return _zero_nulls(out, m), m
+    # numeric <-> numeric / bool
+    phys = np.dtype(to_t.physical)
+    if from_t.is_floating and not (to_t.is_floating or to_t == dt.BOOL):
+        with np.errstate(invalid="ignore"):
+            out = np.trunc(a).astype(phys)
+        return _zero_nulls(out, m), m
+    out = a.astype(phys)
+    return _zero_nulls(out, m), m
+
+
+def _value_to_string(v, from_t) -> str:
+    if isinstance(from_t, dt.BooleanType):
+        return "true" if v else "false"
+    if isinstance(from_t, dt.DecimalType):
+        import decimal
+        return str(decimal.Decimal(int(v)).scaleb(-from_t.scale))
+    if isinstance(from_t, dt.DateType):
+        import datetime
+        return str(datetime.date(1970, 1, 1)
+                   + datetime.timedelta(days=int(v)))
+    if isinstance(from_t, dt.TimestampType):
+        import datetime
+        ts = (datetime.datetime(1970, 1, 1, tzinfo=datetime.timezone.utc)
+              + datetime.timedelta(microseconds=int(v)))
+        return ts.strftime("%Y-%m-%d %H:%M:%S") + (
+            f".{ts.microsecond:06d}".rstrip("0")
+            if ts.microsecond else "")
+    if from_t.is_floating:
+        f = float(v)
+        if f != f or f in (float("inf"), float("-inf")):
+            return {"inf": "Infinity", "-inf": "-Infinity"}.get(
+                str(f), "NaN")
+        if f == int(f) and abs(f) < 1e16:
+            return f"{f:.1f}"
+        return repr(f)
+    return str(int(v))

@@ -1,0 +1,377 @@
+"""CPU physical operators over HostTable.
+
+The fallback execution engine — what runs a plan subtree the overrides
+tagged off the TPU (the role CPU Spark plays for the reference; its exec
+nodes are the analogue of Spark's row-based SparkPlan operators, but
+columnar over numpy). Also the differential-test oracle (SURVEY §4).
+
+Aggregation/join/sort semantics mirror the TPU execs:
+- group nulls form their own group (Spark GROUP BY semantics),
+- min/max skip nulls, NaN sorts greatest, empty-group sum/avg -> null,
+- joins are equi hash joins; order of output rows is not part of the
+  contract (tests sort before comparing).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..columnar import dtypes as dt
+from ..expr import aggregates as Agg
+from ..expr.core import Expression, output_name
+from . import cpu_eval
+from .host_table import (HostColumn, HostTable, concat_tables, empty_like,
+                         from_pydict)
+from .logical import (Aggregate, Expand, Filter, Join, Limit, LocalRelation,
+                      LogicalPlan, Project, Range, Sort, Union)
+
+
+def execute_cpu(plan: LogicalPlan) -> HostTable:
+    """Interpret a logical plan subtree entirely on CPU."""
+    return apply_cpu_node(plan, [execute_cpu(c) for c in plan.children])
+
+
+def apply_cpu_node(plan: LogicalPlan,
+                   children: List[HostTable]) -> HostTable:
+    """Apply ONE logical node to already-evaluated child tables. The seam
+    that lets mixed CPU/TPU physical trees reuse the CPU interpreter
+    (transitions.py wraps TPU subtrees so they appear as child tables)."""
+    if isinstance(plan, LocalRelation):
+        return from_pydict(plan.data, plan.schema)
+    if isinstance(plan, Range):
+        n = max(0, -(-(plan.end - plan.start) // plan.step))
+        vals = plan.start + np.arange(n, dtype=np.int64) * plan.step
+        return HostTable([HostColumn(vals, np.ones(n, bool), dt.INT64)],
+                         ["id"])
+    if isinstance(plan, Project):
+        child = children[0]
+        cols = [cpu_eval.evaluate(e, child) for e in plan.exprs]
+        return HostTable(cols, [n for n, _ in plan.schema])
+    if isinstance(plan, Filter):
+        child = children[0]
+        cond = cpu_eval.evaluate(plan.condition, child)
+        return child.select_rows(cond.values & cond.mask)
+    if isinstance(plan, Limit):
+        child = children[0]
+        return child.take(np.arange(min(plan.n, child.num_rows)))
+    if isinstance(plan, Union):
+        return concat_tables([_normalize(c, [n for n, _ in plan.schema])
+                              for c in children])
+    if isinstance(plan, Expand):
+        child = children[0]
+        parts = []
+        for proj in plan.projections:
+            cols = [cpu_eval.evaluate(e, child) for e in proj]
+            cols = [_coerce_col(c, t) for c, (_, t) in zip(cols, plan.schema)]
+            parts.append(HostTable(cols, [n for n, _ in plan.schema]))
+        return concat_tables(parts)
+    if isinstance(plan, Sort):
+        return _sort_table(children[0], plan.order)
+    if isinstance(plan, Aggregate):
+        return _aggregate_table(children[0], plan)
+    if isinstance(plan, Join):
+        return _join_tables(children[0], children[1], plan)
+    raise NotImplementedError(f"CPU executor: {type(plan).__name__}")
+
+
+def _normalize(t: HostTable, names: List[str]) -> HostTable:
+    return HostTable(t.columns, names)
+
+
+def _coerce_col(c: HostColumn, t: dt.DType) -> HostColumn:
+    if c.dtype == t or t == dt.STRING:
+        return c
+    if isinstance(t, dt.DecimalType):
+        if isinstance(c.dtype, dt.DecimalType):
+            from .cpu_eval import _rescale_np
+            return HostColumn(_rescale_np(c.values.astype(np.int64),
+                                          c.dtype.scale, t.scale), c.mask, t)
+        return HostColumn(c.values.astype(np.int64)
+                          * np.int64(10 ** t.scale), c.mask, t)
+    return HostColumn(c.values.astype(np.dtype(t.physical)), c.mask, t)
+
+
+# ---------------------------------------------------------------------------
+# sort
+# ---------------------------------------------------------------------------
+
+def _sort_keys(col: HostColumn, ascending: bool, nulls_first: bool):
+    """Build (null_rank, value_key) so np.lexsort matches Spark ordering."""
+    n = len(col)
+    null_rank = np.where(col.mask, 1, 0 if nulls_first else 2)
+    if col.dtype == dt.STRING:
+        # rank strings by sorted order (stable, handles desc via negation)
+        order = np.argsort(np.where(col.mask, col.values, ""), kind="stable")
+        rank = np.empty(n, np.int64)
+        # equal strings must share a rank for desc negation to be correct
+        vals = np.where(col.mask, col.values, "")
+        sorted_vals = vals[order]
+        uniq_rank = np.zeros(n, np.int64)
+        if n:
+            neq = np.concatenate([[0], (sorted_vals[1:] != sorted_vals[:-1])
+                                  .astype(np.int64)])
+            uniq_rank = np.cumsum(neq)
+        rank[order] = uniq_rank
+        key = rank
+    elif np.issubdtype(col.values.dtype, np.floating):
+        # NaN greatest: map to +inf rank beyond all finite
+        v = col.values.astype(np.float64)
+        key = np.where(np.isnan(v), np.inf, v)
+        # -0.0 == 0.0 in Spark ordering; np handles that already
+    else:
+        key = col.values
+    if not ascending:
+        if key.dtype == np.float64:
+            key = -key
+            # NaN was mapped to inf -> -inf, still extreme but now first:
+            # correct, NaN is greatest so it comes first in desc order.
+        else:
+            key = -(key.astype(np.int64))
+        null_rank = np.where(col.mask, 1, 0 if nulls_first else 2)
+    return null_rank, key
+
+
+def _sort_table(table: HostTable, order) -> HostTable:
+    if table.num_rows == 0:
+        return table
+    keys = []
+    for o in order:
+        col = cpu_eval.evaluate(o.expr, table)
+        null_rank, key = _sort_keys(col, o.ascending, o.nulls_first)
+        keys.append(key)
+        keys.append(null_rank)
+    # lexsort: last key is primary
+    idx = np.lexsort(tuple(reversed(keys)))
+    return table.take(idx)
+
+
+# ---------------------------------------------------------------------------
+# aggregate
+# ---------------------------------------------------------------------------
+
+def _group_ids(key_cols: List[HostColumn], n: int):
+    """Assign group ids; returns (gid array, representative row indices in
+    first-seen order)."""
+    if not key_cols:
+        return np.zeros(n, np.int64), (np.array([0], np.int64) if n
+                                       else np.zeros(0, np.int64))
+    seen: Dict[tuple, int] = {}
+    gid = np.empty(n, np.int64)
+    reps: List[int] = []
+    for i in range(n):
+        k = tuple((None if not c.mask[i]
+                   else (c.values[i] if c.dtype == dt.STRING
+                         else c.values[i].item()))
+                  for c in key_cols)
+        g = seen.get(k)
+        if g is None:
+            g = len(reps)
+            seen[k] = g
+            reps.append(i)
+        gid[i] = g
+    return gid, np.asarray(reps, np.int64)
+
+
+def _agg_cpu(fn: Agg.AggregateFunction, values: Optional[np.ndarray],
+             mask: Optional[np.ndarray], rows: np.ndarray,
+             in_dtype: Optional[dt.DType], out_t: dt.DType):
+    """One aggregate over the rows of one group -> (value, valid)."""
+    if isinstance(fn, Agg.CountStar):
+        return len(rows), True
+    v = values[rows]
+    m = mask[rows]
+    if isinstance(fn, Agg.Count):
+        return int(m.sum()), True
+    valid_v = v[m]
+    if isinstance(fn, Agg.First):  # Last subclasses First
+        is_last = isinstance(fn, Agg.Last)
+        if fn.ignore_nulls:
+            if len(valid_v) == 0:
+                return 0, False
+            return valid_v[-1 if is_last else 0], True
+        if len(v) == 0:
+            return 0, False
+        i = -1 if is_last else 0
+        return v[i], bool(m[i])
+    if len(valid_v) == 0:
+        return 0, False
+    if isinstance(fn, Agg.Sum):
+        if isinstance(out_t, dt.DecimalType):
+            return int(valid_v.astype(np.int64).sum()), True
+        if out_t == dt.INT64:
+            return int(valid_v.astype(np.int64).sum()), True
+        return float(valid_v.astype(np.float64).sum()), True
+    if isinstance(fn, Agg.Min) or isinstance(fn, Agg.Max):
+        want_max = isinstance(fn, Agg.Max)
+        if in_dtype == dt.STRING:
+            return (max(valid_v) if want_max else min(valid_v)), True
+        x = valid_v
+        if np.issubdtype(x.dtype, np.floating):
+            # NaN greatest (Spark ordering)
+            if want_max:
+                return (np.nan if np.isnan(x).any()
+                        else float(x.max())), True
+            non_nan = x[~np.isnan(x)]
+            return ((float(non_nan.min()) if len(non_nan) else np.nan),
+                    True)
+        return (x.max() if want_max else x.min()), True
+    if isinstance(fn, Agg.Average):
+        x = valid_v.astype(np.float64)
+        if isinstance(in_dtype, dt.DecimalType):
+            x = x / (10.0 ** in_dtype.scale)
+        return float(x.sum() / len(x)), True
+    if isinstance(fn, Agg._M2Base):
+        x = valid_v.astype(np.float64)
+        if isinstance(in_dtype, dt.DecimalType):
+            x = x / (10.0 ** in_dtype.scale)
+        n = len(x)
+        mean = x.mean()
+        m2 = float(((x - mean) ** 2).sum())
+        ddof = fn.ddof
+        if n - ddof <= 0:
+            return 0.0, False
+        var = m2 / (n - ddof)
+        if isinstance(fn, (Agg.StddevPop, Agg.StddevSamp)):
+            return float(np.sqrt(var)), True
+        return var, True
+    raise NotImplementedError(f"CPU aggregate {type(fn).__name__}")
+
+
+def _aggregate_table(table: HostTable, plan: Aggregate) -> HostTable:
+    schema_in = table.schema()
+    key_cols = [cpu_eval.evaluate(e, table) for e in plan.group_exprs]
+    n = table.num_rows
+    gid, reps = _group_ids(key_cols, n)
+    num_groups = len(reps)
+    if not plan.group_exprs and n == 0:
+        num_groups = 1  # global aggregate over empty input: one null row
+        reps = np.zeros(0, np.int64)
+        groups_rows = [np.zeros(0, np.int64)]
+    else:
+        groups_rows = [np.nonzero(gid == g)[0] for g in range(num_groups)]
+    out_cols: List[HostColumn] = []
+    names = [nm for nm, _ in plan.schema]
+    # key columns: representative row of each group
+    for kc in key_cols:
+        if len(reps):
+            out_cols.append(kc.take(reps))
+        else:
+            out_cols.append(HostColumn(
+                np.zeros(num_groups, kc.values.dtype if
+                         kc.dtype != dt.STRING else object),
+                np.zeros(num_groups, bool), kc.dtype))
+    # aggregates
+    for fn, nm in plan.agg_exprs:
+        out_t = fn.data_type(schema_in)
+        if fn.children:
+            in_col = cpu_eval.evaluate(fn.children[0], table)
+            in_dtype = in_col.dtype
+            values, mask = in_col.values, in_col.mask
+        else:
+            in_dtype, values, mask = None, None, None
+        vals: List = []
+        valids: List[bool] = []
+        for rows in groups_rows:
+            v, ok = _agg_cpu(fn, values, mask, rows, in_dtype, out_t)
+            vals.append(v)
+            valids.append(ok)
+        if out_t == dt.STRING:
+            arr = np.array([v if ok else "" for v, ok in zip(vals, valids)],
+                           dtype=object)
+        else:
+            arr = np.array([v if ok else 0 for v, ok in zip(vals, valids)],
+                           dtype=np.dtype(out_t.physical))
+        out_cols.append(HostColumn(arr, np.asarray(valids, bool), out_t))
+    return HostTable(out_cols, names)
+
+
+# ---------------------------------------------------------------------------
+# join
+# ---------------------------------------------------------------------------
+
+def _key_tuple(cols: List[HostColumn], i: int):
+    out = []
+    for c in cols:
+        if not c.mask[i]:
+            return None  # null keys never match (SQL equi-join)
+        out.append(c.values[i] if c.dtype == dt.STRING
+                   else c.values[i].item())
+    return tuple(out)
+
+
+def _join_tables(left: HostTable, right: HostTable, plan: Join) -> HostTable:
+    lk = [cpu_eval.evaluate(e, left) for e in plan.left_keys]
+    rk = [cpu_eval.evaluate(e, right) for e in plan.right_keys]
+    ln, rn = left.num_rows, right.num_rows
+    index: Dict[tuple, List[int]] = {}
+    for j in range(rn):
+        k = _key_tuple(rk, j)
+        if k is not None:
+            index.setdefault(k, []).append(j)
+    jt = plan.join_type
+    li: List[int] = []
+    ri: List[int] = []
+    l_matched = np.zeros(ln, bool)
+    r_matched = np.zeros(rn, bool)
+    for i in range(ln):
+        k = _key_tuple(lk, i)
+        matches = index.get(k, []) if k is not None else []
+        if matches:
+            l_matched[i] = True
+            for j in matches:
+                r_matched[j] = True
+                li.append(i)
+                ri.append(j)
+    names = [nm for nm, _ in plan.schema]
+
+    def gather(tbl: HostTable, idx, valid=None) -> List[HostColumn]:
+        arr = np.asarray(idx, np.int64)
+        return [c.take(arr, valid) for c in tbl.columns]
+
+    if jt == "inner" or jt == "cross":
+        cols = gather(left, li) + gather(right, ri)
+        out = HostTable(cols, names)
+        # residual condition (inner only)
+        if plan.condition is not None:
+            cond = cpu_eval.evaluate(plan.condition, out)
+            out = out.select_rows(cond.values & cond.mask)
+        return out
+    if jt == "left_semi":
+        return left.select_rows(l_matched)
+    if jt == "left_anti":
+        return left.select_rows(~l_matched)
+    if jt == "left_outer":
+        un = np.nonzero(~l_matched)[0]
+        all_li = np.concatenate([np.asarray(li, np.int64), un])
+        all_ri = np.concatenate([np.asarray(ri, np.int64),
+                                 np.zeros(len(un), np.int64)])
+        rvalid = np.concatenate([np.ones(len(li), bool),
+                                 np.zeros(len(un), bool)])
+        cols = gather(left, all_li) + gather(right, all_ri, rvalid)
+        return HostTable(cols, names)
+    if jt == "right_outer":
+        un = np.nonzero(~r_matched)[0]
+        all_li = np.concatenate([np.asarray(li, np.int64),
+                                 np.zeros(len(un), np.int64)])
+        all_ri = np.concatenate([np.asarray(ri, np.int64), un])
+        lvalid = np.concatenate([np.ones(len(li), bool),
+                                 np.zeros(len(un), bool)])
+        cols = gather(left, all_li, lvalid) + gather(right, all_ri)
+        return HostTable(cols, names)
+    if jt == "full_outer":
+        lun = np.nonzero(~l_matched)[0]
+        run = np.nonzero(~r_matched)[0]
+        all_li = np.concatenate([np.asarray(li, np.int64), lun,
+                                 np.zeros(len(run), np.int64)])
+        all_ri = np.concatenate([np.asarray(ri, np.int64),
+                                 np.zeros(len(lun), np.int64), run])
+        lvalid = np.concatenate([np.ones(len(li) + len(lun), bool),
+                                 np.zeros(len(run), bool)])
+        rvalid = np.concatenate([np.ones(len(li), bool),
+                                 np.zeros(len(lun), bool),
+                                 np.ones(len(run), bool)])
+        cols = gather(left, all_li, lvalid) + gather(right, all_ri, rvalid)
+        return HostTable(cols, names)
+    raise NotImplementedError(f"CPU join type {jt}")

@@ -1,0 +1,177 @@
+"""Host-side columnar table: the CPU fallback's data representation.
+
+A HostTable is the row-variable CPU mirror of a device ColumnarBatch:
+each column is (values: np.ndarray, mask: np.ndarray bool) in the SAME
+physical lane encoding the device side uses (dates = int32 days,
+timestamps = int64 micros, decimals = scaled int64, strings = object
+array of str). Keeping physical encodings identical makes
+device<->host transitions exact bit-level copies and lets the
+differential test harness compare CPU and TPU results directly.
+
+Reference counterpart: the row<->columnar transition layer
+(GpuRowToColumnarExec.scala / GpuColumnarToRowExec.scala, SURVEY §1 L2) —
+except our CPU side is columnar too, so transitions are buffer copies,
+not row pivots.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..columnar import dtypes as dt
+from ..columnar.vector import (ColumnarBatch, ColumnVector, StringColumn,
+                               choose_capacity, column_from_numpy,
+                               from_physical)
+
+Schema = List  # [(name, DType), ...]
+
+
+class HostColumn:
+    __slots__ = ("values", "mask", "dtype")
+
+    def __init__(self, values: np.ndarray, mask: np.ndarray, dtype: dt.DType):
+        assert len(values) == len(mask)
+        self.values = values
+        self.mask = np.asarray(mask, dtype=bool)
+        self.dtype = dtype
+
+    def __len__(self):
+        return len(self.values)
+
+    def take(self, idx: np.ndarray, valid: Optional[np.ndarray] = None) -> "HostColumn":
+        safe = np.clip(idx, 0, max(len(self.values) - 1, 0))
+        if len(self.values) == 0:
+            values = np.zeros(len(idx), dtype=self.values.dtype)
+            mask = np.zeros(len(idx), dtype=bool)
+        else:
+            values = self.values[safe]
+            mask = self.mask[safe]
+        if valid is not None:
+            mask = mask & valid
+        return HostColumn(values, mask, self.dtype)
+
+    def __repr__(self):
+        return f"HostColumn({self.dtype}, n={len(self)})"
+
+
+class HostTable:
+    """Ordered named host columns; all the CPU operators' currency."""
+
+    def __init__(self, columns: Sequence[HostColumn], names: Sequence[str]):
+        assert len(columns) == len(names)
+        self.columns = list(columns)
+        self.names = list(names)
+
+    @property
+    def num_rows(self) -> int:
+        return len(self.columns[0]) if self.columns else 0
+
+    def column(self, name: str) -> HostColumn:
+        return self.columns[self.names.index(name)]
+
+    def schema(self) -> Schema:
+        return [(n, c.dtype) for n, c in zip(self.names, self.columns)]
+
+    def take(self, idx: np.ndarray, valid: Optional[np.ndarray] = None) -> "HostTable":
+        return HostTable([c.take(idx, valid) for c in self.columns], self.names)
+
+    def select_rows(self, mask: np.ndarray) -> "HostTable":
+        idx = np.nonzero(mask)[0]
+        return self.take(idx)
+
+    def with_columns(self, columns: Sequence[HostColumn],
+                     names: Sequence[str]) -> "HostTable":
+        return HostTable(list(columns), list(names))
+
+    def __repr__(self):
+        cols = ", ".join(f"{n}:{c.dtype}" for n, c in zip(self.names, self.columns))
+        return f"HostTable[{cols}](n={self.num_rows})"
+
+
+def empty_like(schema: Schema) -> HostTable:
+    cols = []
+    for _, t in schema:
+        if t == dt.STRING:
+            cols.append(HostColumn(np.empty(0, object), np.empty(0, bool), t))
+        else:
+            cols.append(HostColumn(np.empty(0, np.dtype(t.physical)),
+                                   np.empty(0, bool), t))
+    return HostTable(cols, [n for n, _ in schema])
+
+
+def concat_tables(tables: Sequence[HostTable]) -> HostTable:
+    first = tables[0]
+    cols = []
+    for i in range(len(first.columns)):
+        values = np.concatenate([t.columns[i].values for t in tables])
+        mask = np.concatenate([t.columns[i].mask for t in tables])
+        cols.append(HostColumn(values, mask, first.columns[i].dtype))
+    return HostTable(cols, first.names)
+
+
+def from_pydict(data: dict, schema: Schema) -> HostTable:
+    """Build from {name: [python values]} using device physical encodings."""
+    from ..columnar.vector import _to_physical
+    n = len(next(iter(data.values()))) if data else 0
+    cols = []
+    for name, t in schema:
+        raw = data[name]
+        mask = np.array([v is not None for v in raw], dtype=bool)
+        if t == dt.STRING:
+            values = np.array([v if v is not None else "" for v in raw],
+                              dtype=object)
+        else:
+            phys = np.dtype(t.physical)
+            values = np.array(
+                [_to_physical(v, t) if v is not None else 0 for v in raw],
+                dtype=phys)
+        cols.append(HostColumn(values, mask, t))
+    return HostTable(cols, [n for n, _ in schema])
+
+
+def to_pydict(table: HostTable) -> dict:
+    out = {}
+    for name, col in zip(table.names, table.columns):
+        if col.dtype == dt.STRING:
+            out[name] = [col.values[i] if col.mask[i] else None
+                         for i in range(len(col))]
+        else:
+            out[name] = [from_physical(col.values[i], col.dtype)
+                         if col.mask[i] else None for i in range(len(col))]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Host <-> device transitions (GpuRowToColumnar / GpuColumnarToRow equiv)
+# ---------------------------------------------------------------------------
+
+def table_to_batch(table: HostTable,
+                   capacity: Optional[int] = None) -> ColumnarBatch:
+    n = table.num_rows
+    cap = capacity or choose_capacity(n)
+    cols = []
+    for c in table.columns:
+        if c.dtype == dt.STRING:
+            cols.append(column_from_numpy(
+                np.asarray(c.values, dtype=object), cap,
+                dtype=dt.STRING, mask=c.mask))
+        else:
+            cols.append(column_from_numpy(c.values, cap, dtype=c.dtype,
+                                          mask=c.mask))
+    return ColumnarBatch(cols, table.names, n)
+
+
+def batch_to_table(batch: ColumnarBatch) -> HostTable:
+    n = int(batch.num_rows)
+    cols = []
+    for c in batch.columns:
+        vals, mask = c.to_numpy(n)
+        if isinstance(c, StringColumn):
+            cols.append(HostColumn(np.asarray(vals, dtype=object),
+                                   np.asarray(mask), dt.STRING))
+        else:
+            cols.append(HostColumn(np.asarray(vals), np.asarray(mask),
+                                   c.dtype))
+    return HostTable(cols, batch.names)

@@ -1,0 +1,285 @@
+"""Logical plan nodes.
+
+The frontend IR that the overrides driver (overrides.py) tags and
+converts — the role Catalyst's SparkPlan tree plays for the reference
+(GpuOverrides.scala:4312 wrapAndTagPlan walks the physical plan; here we
+walk this logical tree and emit either TpuExec or CPU fallback nodes).
+
+Every node knows its output ``schema`` ([(name, DType), ...]) at plan
+time; schema resolution errors surface when the node is built, the way
+Catalyst's analyzer resolves before physical planning.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from ..columnar import dtypes as dt
+from ..expr.aggregates import AggregateFunction
+from ..expr.core import Expression, output_name
+
+Schema = List  # [(name, DType), ...]
+
+
+class LogicalPlan:
+    """Base logical node; children in ``children``."""
+
+    def __init__(self, *children: "LogicalPlan"):
+        self.children: List[LogicalPlan] = list(children)
+
+    @property
+    def schema(self) -> Schema:
+        raise NotImplementedError
+
+    def expressions(self) -> List[Expression]:
+        """All expressions held directly by this node (for tagging)."""
+        return []
+
+    def expressions_with_schemas(self):
+        """[(expr, resolution schema)] — nodes whose expressions resolve
+        against different children (Join) override this."""
+        schema = self.children[0].schema if self.children else self.schema
+        return [(e, schema) for e in self.expressions()]
+
+    def node_name(self) -> str:
+        return type(self).__name__
+
+    def node_description(self) -> str:
+        return self.node_name()
+
+    def tree_string(self, indent: int = 0) -> str:
+        line = "  " * indent + "* " + self.node_description()
+        return "\n".join([line] + [c.tree_string(indent + 1)
+                                   for c in self.children])
+
+    def __repr__(self):
+        return self.tree_string()
+
+
+class LocalRelation(LogicalPlan):
+    """In-memory data: {name: [values]} with an explicit or inferred schema."""
+
+    def __init__(self, data: dict, schema: Schema):
+        super().__init__()
+        self.data = data
+        self._schema = list(schema)
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def node_description(self) -> str:
+        return f"LocalRelation[{', '.join(n for n, _ in self._schema)}]"
+
+
+class Project(LogicalPlan):
+    def __init__(self, child: LogicalPlan, exprs: Sequence[Expression]):
+        super().__init__(child)
+        self.exprs = list(exprs)
+        in_schema = child.schema
+        self._schema = [(output_name(e, i), e.data_type(in_schema))
+                        for i, e in enumerate(self.exprs)]
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def expressions(self) -> List[Expression]:
+        return list(self.exprs)
+
+    def node_description(self) -> str:
+        return f"Project[{', '.join(n for n, _ in self._schema)}]"
+
+
+class Filter(LogicalPlan):
+    def __init__(self, child: LogicalPlan, condition: Expression):
+        super().__init__(child)
+        self.condition = condition
+        if condition.data_type(child.schema) != dt.BOOL:
+            raise TypeError(f"filter condition must be boolean, got "
+                            f"{condition.data_type(child.schema)}")
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def expressions(self) -> List[Expression]:
+        return [self.condition]
+
+    def node_description(self) -> str:
+        return f"Filter[{self.condition!r}]"
+
+
+class Aggregate(LogicalPlan):
+    """groupBy(group_exprs).agg(agg_exprs); empty group_exprs = global agg."""
+
+    def __init__(self, child: LogicalPlan, group_exprs: Sequence[Expression],
+                 agg_exprs: Sequence[Tuple[AggregateFunction, str]]):
+        super().__init__(child)
+        self.group_exprs = list(group_exprs)
+        self.agg_exprs = list(agg_exprs)
+        in_schema = child.schema
+        self._schema = (
+            [(output_name(e, i), e.data_type(in_schema))
+             for i, e in enumerate(self.group_exprs)] +
+            [(name, fn.data_type(in_schema)) for fn, name in self.agg_exprs])
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def expressions(self) -> List[Expression]:
+        return list(self.group_exprs) + [fn for fn, _ in self.agg_exprs]
+
+    def node_description(self) -> str:
+        keys = ", ".join(repr(e) for e in self.group_exprs)
+        aggs = ", ".join(f"{fn.name}->{n}" for fn, n in self.agg_exprs)
+        return f"Aggregate[keys=({keys}), aggs=({aggs})]"
+
+
+class Join(LogicalPlan):
+    """Equi-join on key expression pairs (+ optional residual condition)."""
+
+    def __init__(self, left: LogicalPlan, right: LogicalPlan,
+                 left_keys: Sequence[Expression],
+                 right_keys: Sequence[Expression],
+                 join_type: str = "inner",
+                 condition: Optional[Expression] = None):
+        super().__init__(left, right)
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.join_type = join_type
+        self.condition = condition
+        if len(self.left_keys) != len(self.right_keys):
+            raise ValueError("left/right key counts differ")
+
+    @property
+    def schema(self) -> Schema:
+        left_s, right_s = self.children[0].schema, self.children[1].schema
+        if self.join_type in ("left_semi", "left_anti"):
+            return left_s
+        # outer joins make the non-preserved side nullable; dtypes unchanged
+        return left_s + right_s
+
+    def expressions(self) -> List[Expression]:
+        out = self.left_keys + self.right_keys
+        if self.condition is not None:
+            out.append(self.condition)
+        return out
+
+    def expressions_with_schemas(self):
+        ls = self.children[0].schema
+        rs = self.children[1].schema
+        out = ([(e, ls) for e in self.left_keys] +
+               [(e, rs) for e in self.right_keys])
+        if self.condition is not None:
+            out.append((self.condition, ls + rs))
+        return out
+
+    def node_description(self) -> str:
+        keys = ", ".join(f"{l!r}={r!r}" for l, r in
+                         zip(self.left_keys, self.right_keys))
+        return f"Join[{self.join_type}, {keys}]"
+
+
+class SortField:
+    """(expr, ascending, nulls_first) — mirrors exec.sort.SortOrder but at
+    the logical level (Catalyst SortOrder)."""
+
+    def __init__(self, expr: Expression, ascending: bool = True,
+                 nulls_first: Optional[bool] = None):
+        self.expr = expr
+        self.ascending = ascending
+        self.nulls_first = ascending if nulls_first is None else nulls_first
+
+    def __repr__(self):
+        direction = "ASC" if self.ascending else "DESC"
+        nulls = "NULLS FIRST" if self.nulls_first else "NULLS LAST"
+        return f"{self.expr!r} {direction} {nulls}"
+
+
+class Sort(LogicalPlan):
+    def __init__(self, child: LogicalPlan, order: Sequence[SortField],
+                 is_global: bool = True):
+        super().__init__(child)
+        self.order = list(order)
+        self.is_global = is_global
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def expressions(self) -> List[Expression]:
+        return [o.expr for o in self.order]
+
+    def node_description(self) -> str:
+        return f"Sort[{', '.join(repr(o) for o in self.order)}]"
+
+
+class Limit(LogicalPlan):
+    def __init__(self, child: LogicalPlan, n: int):
+        super().__init__(child)
+        self.n = n
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def node_description(self) -> str:
+        return f"Limit[{self.n}]"
+
+
+class Union(LogicalPlan):
+    def __init__(self, *children: LogicalPlan):
+        super().__init__(*children)
+        first = children[0].schema
+        for c in children[1:]:
+            if len(c.schema) != len(first):
+                raise ValueError("UNION children column counts differ")
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+
+class Expand(LogicalPlan):
+    """GROUPING SETS / rollup / cube pre-projection."""
+
+    def __init__(self, child: LogicalPlan,
+                 projections: Sequence[Sequence[Expression]],
+                 names: Sequence[str]):
+        super().__init__(child)
+        self.projections = [list(p) for p in projections]
+        self.names = list(names)
+        in_schema = child.schema
+        from ..expr.conditional import _common_type
+        self._schema = [
+            (n, _common_type([p[i].data_type(in_schema)
+                              for p in self.projections]))
+            for i, n in enumerate(self.names)]
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def expressions(self) -> List[Expression]:
+        return [e for p in self.projections for e in p]
+
+
+class Range(LogicalPlan):
+    def __init__(self, start: int, end: int, step: int = 1):
+        super().__init__()
+        self.start, self.end, self.step = start, end, step
+
+    @property
+    def schema(self) -> Schema:
+        return [("id", dt.INT64)]
+
+    def node_description(self) -> str:
+        return f"Range[{self.start}, {self.end}, {self.step}]"
+
+
+def Distinct(child: LogicalPlan) -> Aggregate:
+    """DISTINCT = group by all columns with no aggregates."""
+    from ..expr.core import col
+    return Aggregate(child, [col(n) for n, _ in child.schema], [])

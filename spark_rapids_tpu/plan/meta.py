@@ -1,0 +1,107 @@
+"""Meta wrapper hierarchy: tag-then-convert state.
+
+Rebuild of RapidsMeta.scala (SURVEY §2.2): every logical node and every
+expression gets wrapped in a meta that records *why* it cannot run on
+TPU (``will_not_work_on_tpu``). After tagging, ``can_this_be_replaced``
+drives conversion; the reasons feed the explain output
+(spark.rapids.sql.explain NOT_ON_GPU equivalent).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from ..expr.core import Expression
+from .logical import LogicalPlan
+
+
+class BaseMeta:
+    def __init__(self):
+        self._cannot_reasons: List[str] = []
+
+    def will_not_work_on_tpu(self, reason: str) -> None:
+        if reason not in self._cannot_reasons:
+            self._cannot_reasons.append(reason)
+
+    @property
+    def can_this_be_replaced(self) -> bool:
+        return not self._cannot_reasons
+
+    @property
+    def reasons(self) -> List[str]:
+        return list(self._cannot_reasons)
+
+
+class ExprMeta(BaseMeta):
+    """Wraps one Expression; child metas in ``child_exprs``."""
+
+    def __init__(self, expr: Expression, schema):
+        super().__init__()
+        self.expr = expr
+        self.schema = schema
+        self.child_exprs = [ExprMeta(c, schema) for c in expr.children]
+
+    def tag_for_tpu(self) -> None:
+        from . import overrides
+        for c in self.child_exprs:
+            c.tag_for_tpu()
+        rule = overrides.expr_rule_for(type(self.expr))
+        if rule is None:
+            self.will_not_work_on_tpu(
+                f"expression {type(self.expr).__name__} has no TPU "
+                "implementation")
+            return
+        rule.tag(self)
+
+    @property
+    def can_expr_tree_be_replaced(self) -> bool:
+        return self.can_this_be_replaced and all(
+            c.can_expr_tree_be_replaced for c in self.child_exprs)
+
+    def tree_reasons(self) -> List[str]:
+        out = list(self._cannot_reasons)
+        for c in self.child_exprs:
+            out.extend(c.tree_reasons())
+        return out
+
+
+class PlanMeta(BaseMeta):
+    """Wraps one logical node; children wrapped recursively."""
+
+    def __init__(self, plan: LogicalPlan):
+        super().__init__()
+        self.plan = plan
+        self.child_plans = [PlanMeta(c) for c in plan.children]
+        self.expr_metas = [ExprMeta(e, schema)
+                           for e, schema in plan.expressions_with_schemas()]
+
+    def tag_for_tpu(self) -> None:
+        from . import overrides
+        for c in self.child_plans:
+            c.tag_for_tpu()
+        for em in self.expr_metas:
+            em.tag_for_tpu()
+        rule = overrides.exec_rule_for(type(self.plan))
+        if rule is None:
+            self.will_not_work_on_tpu(
+                f"operator {type(self.plan).__name__} has no TPU "
+                "implementation")
+        else:
+            rule.tag(self)
+        for em in self.expr_metas:
+            if not em.can_expr_tree_be_replaced:
+                for r in em.tree_reasons():
+                    self.will_not_work_on_tpu(r)
+
+    def explain_lines(self, indent: int = 0, only_not_on_tpu: bool = False
+                      ) -> List[str]:
+        mark = "*" if self.can_this_be_replaced else "!"
+        line = "  " * indent + f"{mark} {self.plan.node_description()}"
+        lines = []
+        if not only_not_on_tpu or not self.can_this_be_replaced:
+            reasons = "; ".join(self._cannot_reasons)
+            lines.append(line + (f"  [cannot replace: {reasons}]"
+                                 if reasons else ""))
+        for c in self.child_plans:
+            lines.extend(c.explain_lines(indent + 1, only_not_on_tpu))
+        return lines

@@ -1,0 +1,329 @@
+"""Rule registry + tag-then-convert driver.
+
+Rebuild of GpuOverrides.scala (SURVEY §2.2, 4668 LoC): a registry of
+expression rules and exec rules, the wrap/tag pass (meta.py), and the
+conversion of tagged logical trees into mixed TPU/CPU physical trees
+with transitions at the seams (GpuTransitionOverrides role).
+
+Where the reference registers ~215 expression rules mapping Catalyst
+Expressions to Gpu* implementations, our frontend expressions ARE the
+TPU implementations, so an expression rule here carries only the
+support metadata: TypeSig + extra plan-time checks. Fallback maps the
+expression to the CPU interpreter (cpu_eval.py) instead.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Type
+
+from ..columnar import dtypes as dt
+from ..conf import EXPLAIN, SQL_ENABLED, SrtConf, active_conf
+from ..exec.aggregate import HashAggregateExec
+from ..exec.base import TpuExec
+from ..exec.basic import (BatchScanExec, CoalesceBatchesExec, ExpandExec,
+                          FilterExec, LocalLimitExec, ProjectExec, RangeExec,
+                          UnionExec)
+from ..exec.join import ShuffledHashJoinExec
+from ..exec.sort import SortExec, SortOrder, TopNExec
+from ..expr import aggregates as Agg
+from ..expr import arithmetic as A
+from ..expr import cast as C
+from ..expr import conditional as Cond
+from ..expr import core as E
+from ..expr import datetime as D
+from ..expr import hashing as H
+from ..expr import mathfns as M
+from ..expr import predicates as P
+from ..expr import strings as S
+from . import cpu_eval, typechecks as ts
+from .logical import (Aggregate, Expand, Filter, Join, Limit, LocalRelation,
+                      LogicalPlan, Project, Range, Sort, Union)
+from .meta import ExprMeta, PlanMeta
+from .transitions import (CpuPhysical, DeviceToHostBridge, HostToDeviceExec)
+
+
+class ExprRule:
+    """Support metadata for one expression class (GpuOverrides.expr)."""
+
+    def __init__(self, cls: Type, sig: ts.TypeSig,
+                 extra_tag: Optional[Callable[[ExprMeta], None]] = None,
+                 description: str = ""):
+        self.cls = cls
+        self.sig = sig
+        self.extra_tag = extra_tag
+        self.description = description or cls.__doc__ or ""
+
+    def tag(self, meta: ExprMeta) -> None:
+        for child in meta.expr.children:
+            t = child.data_type(meta.schema)
+            reason = self.sig.reason_if_unsupported(
+                t, f"{type(meta.expr).__name__} input")
+            if reason:
+                meta.will_not_work_on_tpu(reason)
+        if self.extra_tag is not None:
+            self.extra_tag(meta)
+
+
+class ExecRule:
+    """Support metadata for one logical-plan class (GpuOverrides.exec)."""
+
+    def __init__(self, cls: Type,
+                 tag_fn: Optional[Callable[[PlanMeta], None]] = None,
+                 description: str = ""):
+        self.cls = cls
+        self.tag_fn = tag_fn
+        self.description = description
+
+    def tag(self, meta: PlanMeta) -> None:
+        if self.tag_fn is not None:
+            self.tag_fn(meta)
+
+
+_EXPR_RULES: Dict[Type, ExprRule] = {}
+_EXEC_RULES: Dict[Type, ExecRule] = {}
+
+
+def expr_rule_for(cls: Type) -> Optional[ExprRule]:
+    return _EXPR_RULES.get(cls)
+
+
+def exec_rule_for(cls: Type) -> Optional[ExecRule]:
+    return _EXEC_RULES.get(cls)
+
+
+def _expr(cls, sig: ts.TypeSig, extra=None):
+    _EXPR_RULES[cls] = ExprRule(cls, sig, extra)
+
+
+# --- expression rules ------------------------------------------------------
+
+_expr(E.ColumnRef, ts.all_basic)
+_expr(E.Alias, ts.all_basic)
+
+
+def _tag_literal(meta: ExprMeta):
+    t = meta.expr.data_type(meta.schema)
+    reason = ts.all_basic.reason_if_unsupported(t, "literal")
+    if reason:
+        meta.will_not_work_on_tpu(reason)
+
+
+_expr(E.Literal, ts.all_basic, _tag_literal)
+
+for _cls in (A.Add, A.Subtract, A.Multiply):
+    _expr(_cls, ts.numeric)
+for _cls in (A.Divide, A.IntegralDivide, A.Remainder, A.Pmod):
+    _expr(_cls, ts.numeric)
+for _cls in (A.UnaryMinus, A.UnaryPositive, A.Abs):
+    _expr(_cls, ts.numeric)
+for _cls in (A.Least, A.Greatest):
+    _expr(_cls, ts.numeric_no_decimal + ts.TypeSig(
+        ts.DATE, ts.TIMESTAMP, ts.BOOLEAN))
+
+for _cls in (P.EqualTo, P.LessThan, P.GreaterThan, P.LessThanOrEqual,
+             P.GreaterThanOrEqual, P.EqualNullSafe):
+    _expr(_cls, ts.comparable)
+for _cls in (P.And, P.Or, P.Not):
+    _expr(_cls, ts.TypeSig(ts.BOOLEAN))
+for _cls in (P.IsNull, P.IsNotNull):
+    _expr(_cls, ts.all_basic)
+_expr(P.IsNaN, ts.fp)
+_expr(P.InSet, ts.comparable)
+
+for _cls in (Cond.If, Cond.CaseWhen, Cond.Coalesce, Cond.NullIf, Cond.Nvl,
+             Cond.Nvl2):
+    _expr(_cls, ts.all_basic)
+
+
+def _tag_cast(meta: ExprMeta):
+    try:
+        meta.expr.check_supported(meta.schema)
+    except TypeError as e:
+        meta.will_not_work_on_tpu(f"cast: {e}")
+
+
+_expr(C.Cast, ts.all_basic, _tag_cast)
+
+for _cls in list(cpu_eval._MATH_FNS) + [M.Log, M.Log2, M.Log10, M.Floor,
+                                        M.Ceil, M.Pow, M.Atan2, M.Hypot,
+                                        M.Round, M.BRound]:
+    _expr(_cls, ts.numeric)
+
+for _cls in (S.Length, S.OctetLength, S.Upper, S.Lower, S.Substring,
+             S.Concat, S.StartsWith, S.EndsWith, S.Contains, S.StringTrim,
+             S.StringTrimLeft, S.StringTrimRight):
+    _expr(_cls, ts.TypeSig(ts.STRING))
+
+
+def _tag_like(meta: ExprMeta):
+    for ch in meta.expr.pattern:
+        if ch not in ("%", "_") and len(ch.encode("utf-8")) != 1:
+            meta.will_not_work_on_tpu(
+                "LIKE: multi-byte pattern literals not supported on TPU")
+            return
+
+
+_expr(S.Like, ts.TypeSig(ts.STRING), _tag_like)
+
+for _cls in (D.Year, D.Month, D.DayOfMonth, D.Quarter, D.DayOfWeek,
+             D.WeekDay, D.DayOfYear, D.LastDay):
+    _expr(_cls, ts.TypeSig(ts.DATE))
+for _cls in (D.Hour, D.Minute, D.Second, D.UnixTimestampToSeconds):
+    _expr(_cls, ts.TypeSig(ts.TIMESTAMP))
+for _cls in (D.DateAdd, D.DateSub, D.DateDiff):
+    _expr(_cls, ts.TypeSig(ts.DATE) + ts.integral)
+_expr(D.AddMonths, ts.TypeSig(ts.DATE) + ts.integral)
+_expr(D.FromUnixTime, ts.integral)
+_expr(D.MakeDate, ts.integral)
+_expr(D.TruncDate, ts.TypeSig(ts.DATE, ts.STRING))
+
+_expr(H.Murmur3Hash, ts.comparable)
+_expr(H.XxHash64, ts.comparable)
+
+for _cls in (Agg.Count, Agg.CountStar, Agg.First, Agg.Last):
+    _expr(_cls, ts.comparable)
+for _cls in (Agg.Sum, Agg.Average, Agg.VariancePop, Agg.VarianceSamp,
+             Agg.StddevPop, Agg.StddevSamp):
+    _expr(_cls, ts.numeric)
+# min/max: the sort-based group kernel needs a physical extreme fill,
+# which strings don't have yet -> CPU fallback for string min/max
+for _cls in (Agg.Min, Agg.Max):
+    _expr(_cls, ts.numeric + ts.TypeSig(ts.BOOLEAN, ts.DATE, ts.TIMESTAMP))
+
+
+# --- exec rules ------------------------------------------------------------
+
+_TPU_JOIN_TYPES = ("inner", "left_outer", "right_outer", "left_semi",
+                   "left_anti")
+
+
+def _tag_join(meta: PlanMeta):
+    plan: Join = meta.plan
+    if plan.join_type not in _TPU_JOIN_TYPES:
+        meta.will_not_work_on_tpu(
+            f"join type {plan.join_type} not supported on TPU yet")
+    if plan.condition is not None:
+        meta.will_not_work_on_tpu(
+            "join residual condition not supported on TPU yet")
+
+
+def _tag_agg(meta: PlanMeta):
+    plan: Aggregate = meta.plan
+    for fn, _ in plan.agg_exprs:
+        if isinstance(fn, (Agg.First, Agg.Last)) and not plan.group_exprs:
+            # fine — still grouped as a single group
+            pass
+
+
+_EXEC_RULES.update({
+    LocalRelation: ExecRule(LocalRelation),
+    Range: ExecRule(Range),
+    Project: ExecRule(Project),
+    Filter: ExecRule(Filter),
+    Limit: ExecRule(Limit),
+    Union: ExecRule(Union),
+    Expand: ExecRule(Expand),
+    Sort: ExecRule(Sort),
+    Aggregate: ExecRule(Aggregate, _tag_agg),
+    Join: ExecRule(Join, _tag_join),
+})
+
+
+# --- conversion ------------------------------------------------------------
+
+def _build_tpu_exec(plan: LogicalPlan, children: List[TpuExec]) -> TpuExec:
+    if isinstance(plan, (LocalRelation, Range)) :
+        # host-resident leaves enter the device through the transition
+        return HostToDeviceExec(CpuPhysical(plan, []))
+    if isinstance(plan, Project):
+        return ProjectExec(children[0], plan.exprs)
+    if isinstance(plan, Filter):
+        return FilterExec(children[0], plan.condition)
+    if isinstance(plan, Limit):
+        return LocalLimitExec(children[0], plan.n)
+    if isinstance(plan, Union):
+        return UnionExec(*children)
+    if isinstance(plan, Expand):
+        return ExpandExec(children[0], plan.projections, plan.names)
+    if isinstance(plan, Sort):
+        return SortExec(children[0],
+                        [SortOrder(o.expr, o.ascending, o.nulls_first)
+                         for o in plan.order],
+                        global_sort=plan.is_global)
+    if isinstance(plan, Aggregate):
+        return HashAggregateExec(children[0], plan.group_exprs,
+                                 plan.agg_exprs)
+    if isinstance(plan, Join):
+        build = "left" if plan.join_type == "right_outer" else "right"
+        return ShuffledHashJoinExec(children[0], children[1],
+                                    plan.left_keys, plan.right_keys,
+                                    join_type=plan.join_type,
+                                    build_side=build)
+    raise NotImplementedError(type(plan).__name__)
+
+
+def _to_physical(meta: PlanMeta, conf: SrtConf):
+    # TopN fusion: Limit(Sort) both replaceable -> TopNExec
+    if (isinstance(meta.plan, Limit) and len(meta.child_plans) == 1
+            and isinstance(meta.child_plans[0].plan, Sort)
+            and meta.can_this_be_replaced
+            and meta.child_plans[0].can_this_be_replaced
+            and conf.get(SQL_ENABLED)):
+        sort_meta = meta.child_plans[0]
+        grandkids = [_to_physical(c, conf)
+                     for c in sort_meta.child_plans]
+        dev = [c if isinstance(c, TpuExec) else HostToDeviceExec(c)
+               for c in grandkids]
+        order = [SortOrder(o.expr, o.ascending, o.nulls_first)
+                 for o in sort_meta.plan.order]
+        return TopNExec(dev[0], order, meta.plan.n)
+    children = [_to_physical(c, conf) for c in meta.child_plans]
+    if meta.can_this_be_replaced and conf.get(SQL_ENABLED):
+        dev = [c if isinstance(c, TpuExec) else HostToDeviceExec(c)
+               for c in children]
+        return _build_tpu_exec(meta.plan, dev)
+    host = [c if not isinstance(c, TpuExec) else DeviceToHostBridge(c)
+            for c in children]
+    return CpuPhysical(meta.plan, host)
+
+
+def apply_overrides(plan: LogicalPlan, conf: Optional[SrtConf] = None):
+    """wrap -> tag -> convert (GpuOverrides.applyWithContext equivalent).
+
+    Returns the physical root: a TpuExec (device result) or a
+    CpuPhysical/DeviceToHostBridge (host result).
+    """
+    conf = conf or active_conf()
+    meta = PlanMeta(plan)
+    meta.tag_for_tpu()
+    mode = conf.get(EXPLAIN)
+    if mode == "ALL":
+        print("\n".join(meta.explain_lines()))
+    elif mode == "NOT_ON_TPU":
+        lines = meta.explain_lines(only_not_on_tpu=True)
+        if lines:
+            print("\n".join(lines))
+    return _to_physical(meta, conf)
+
+
+def tag_only(plan: LogicalPlan) -> PlanMeta:
+    """Tagging pass without conversion (explain-only mode — the
+    reference's spark.rapids.sql.mode=explainOnly)."""
+    meta = PlanMeta(plan)
+    meta.tag_for_tpu()
+    return meta
+
+
+# --- supported-ops doc-gen (TypeChecks.scala doc generation) ---------------
+
+def generate_supported_ops_doc() -> str:
+    lines = ["# Supported expressions on TPU", "",
+             "| Expression | Supported input types |", "|---|---|"]
+    for cls in sorted(_EXPR_RULES, key=lambda c: c.__name__):
+        rule = _EXPR_RULES[cls]
+        lines.append(f"| {cls.__name__} | "
+                     f"{', '.join(sorted(rule.sig.tags))} |")
+    lines += ["", "# Supported operators on TPU", ""]
+    for cls in sorted(_EXEC_RULES, key=lambda c: c.__name__):
+        lines.append(f"- {cls.__name__}")
+    return "\n".join(lines) + "\n"

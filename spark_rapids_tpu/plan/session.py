@@ -1,0 +1,267 @@
+"""User-facing session + DataFrame API.
+
+The frontend that plays Spark's role above the plan-rewrite layer: users
+build DataFrames (logical plans), and ``collect`` runs them through the
+overrides driver (overrides.py) onto the TPU, with CPU fallback for
+anything tagged unsupported — the full tag-then-convert architecture of
+the reference (Plugin.scala ColumnarOverrideRules) with our own engine
+underneath instead of Spark's.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple, Union as TUnion
+
+import numpy as np
+
+from ..columnar import dtypes as dt
+from ..conf import SrtConf, active_conf, set_active_conf
+from ..exec.base import ExecContext, TpuExec
+from ..expr.aggregates import (Average, Count, CountStar, First, Last, Max,
+                               Min, StddevSamp, Sum)
+from ..expr.core import Alias, ColumnRef, Expression, col, lit, output_name
+from . import logical as L
+from . import overrides
+from .host_table import HostTable, batch_to_table, concat_tables, empty_like, to_pydict
+from .transitions import CpuPhysical, DeviceToHostBridge
+
+
+class TpuSession:
+    """Entry point (SparkSession analogue). Holds the active conf."""
+
+    def __init__(self, conf: Optional[SrtConf] = None):
+        self.conf = conf or active_conf()
+
+    # --- constructors ---
+    def create_dataframe(self, data: Dict[str, list],
+                         schema: Optional[List] = None) -> "DataFrame":
+        if schema is None:
+            schema = _infer_schema(data)
+        return DataFrame(self, L.LocalRelation(data, schema))
+
+    def range(self, start: int, end: Optional[int] = None,
+              step: int = 1) -> "DataFrame":
+        if end is None:
+            start, end = 0, start
+        return DataFrame(self, L.Range(start, end, step))
+
+    @property
+    def read(self) -> "DataFrameReader":
+        from ..io.reader import DataFrameReader
+        return DataFrameReader(self)
+
+    # --- execution ---
+    def execute(self, plan: L.LogicalPlan) -> HostTable:
+        physical = overrides.apply_overrides(plan, self.conf)
+        ctx = ExecContext(self.conf)
+        if isinstance(physical, TpuExec):
+            tables = [batch_to_table(b) for b in physical.execute(ctx)
+                      if int(b.num_rows) > 0]
+            if not tables:
+                return empty_like(plan.schema)
+            return concat_tables(tables)
+        return physical.evaluate(ctx)
+
+
+def _infer_schema(data: Dict[str, list]) -> List:
+    import datetime
+    import decimal
+    schema = []
+    for name, values in data.items():
+        sample = next((v for v in values if v is not None), None)
+        if sample is None:
+            t = dt.INT32
+        elif isinstance(sample, bool):
+            t = dt.BOOL
+        elif isinstance(sample, int):
+            t = dt.INT64
+        elif isinstance(sample, float):
+            t = dt.FLOAT64
+        elif isinstance(sample, str):
+            t = dt.STRING
+        elif isinstance(sample, datetime.datetime):
+            t = dt.TIMESTAMP
+        elif isinstance(sample, datetime.date):
+            t = dt.DATE
+        elif isinstance(sample, decimal.Decimal):
+            exp = -sample.as_tuple().exponent
+            t = dt.DecimalType(18, max(exp, 0))
+        else:
+            raise TypeError(f"cannot infer dtype for column {name!r}")
+        schema.append((name, t))
+    return schema
+
+
+def _to_expr(c) -> Expression:
+    if isinstance(c, Expression):
+        return c
+    if isinstance(c, str):
+        return col(c)
+    return lit(c)
+
+
+class DataFrame:
+    """Lazy logical-plan builder (Spark DataFrame analogue)."""
+
+    def __init__(self, session: TpuSession, plan: L.LogicalPlan):
+        self.session = session
+        self.plan = plan
+
+    # --- transformations ---
+    def select(self, *cols) -> "DataFrame":
+        return DataFrame(self.session,
+                         L.Project(self.plan, [_to_expr(c) for c in cols]))
+
+    def with_column(self, name: str, expr) -> "DataFrame":
+        existing = [col(n) for n, _ in self.plan.schema if n != name]
+        return DataFrame(self.session, L.Project(
+            self.plan, existing + [Alias(_to_expr(expr), name)]))
+
+    def filter(self, condition) -> "DataFrame":
+        return DataFrame(self.session,
+                         L.Filter(self.plan, _to_expr(condition)))
+
+    where = filter
+
+    def group_by(self, *cols) -> "GroupedData":
+        return GroupedData(self, [_to_expr(c) for c in cols])
+
+    def agg(self, *aggs) -> "DataFrame":
+        return GroupedData(self, []).agg(*aggs)
+
+    def join(self, other: "DataFrame", on, how: str = "inner"
+             ) -> "DataFrame":
+        how = {"inner": "inner", "left": "left_outer",
+               "left_outer": "left_outer", "right": "right_outer",
+               "right_outer": "right_outer", "full": "full_outer",
+               "full_outer": "full_outer", "outer": "full_outer",
+               "semi": "left_semi", "left_semi": "left_semi",
+               "anti": "left_anti", "left_anti": "left_anti",
+               "cross": "cross"}[how]
+        if isinstance(on, str):
+            on = [on]
+        using: List[str] = []
+        if isinstance(on, (list, tuple)) and on and isinstance(on[0], str):
+            using = list(on)
+            lk = [col(n) for n in on]
+            rk = [col(n) for n in on]
+        elif isinstance(on, tuple) and len(on) == 2:
+            lk, rk = [_to_expr(e) for e in on[0]], \
+                [_to_expr(e) for e in on[1]]
+        else:
+            raise TypeError("join `on`: column name(s) or (left_exprs, "
+                            "right_exprs)")
+        joined = L.Join(self.plan, other.plan, lk, rk, how)
+        # USING semantics: emit the key once. left's copy is the correct
+        # survivor for inner/left/semi/anti; other types keep both.
+        if using and how in ("inner", "left_outer", "left_semi",
+                             "left_anti"):
+            keep = [col(n) for n in self.columns]
+            if how in ("inner", "left_outer"):
+                keep += [col(n) for n in other.columns if n not in using]
+                # name-based refs resolve to the first (left) occurrence;
+                # right non-key columns are unique by assumption
+            joined = L.Project(joined, keep)
+        return DataFrame(self.session, joined)
+
+    def sort(self, *cols, ascending: TUnion[bool, Sequence[bool]] = True
+             ) -> "DataFrame":
+        exprs = [_to_expr(c) for c in cols]
+        if isinstance(ascending, bool):
+            ascending = [ascending] * len(exprs)
+        order = [L.SortField(e, a) for e, a in zip(exprs, ascending)]
+        return DataFrame(self.session, L.Sort(self.plan, order))
+
+    order_by = sort
+
+    def sort_desc(self, *cols) -> "DataFrame":
+        return self.sort(*cols, ascending=False)
+
+    def limit(self, n: int) -> "DataFrame":
+        return DataFrame(self.session, L.Limit(self.plan, n))
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        return DataFrame(self.session, L.Union(self.plan, other.plan))
+
+    def distinct(self) -> "DataFrame":
+        return DataFrame(self.session, L.Distinct(self.plan))
+
+    # --- metadata ---
+    @property
+    def schema(self) -> List:
+        return self.plan.schema
+
+    @property
+    def columns(self) -> List[str]:
+        return [n for n, _ in self.plan.schema]
+
+    def __getitem__(self, name: str) -> ColumnRef:
+        if name not in self.columns:
+            raise KeyError(name)
+        return col(name)
+
+    # --- actions ---
+    def collect(self) -> List[dict]:
+        table = self.session.execute(self.plan)
+        data = to_pydict(table)
+        names = list(data.keys())
+        n = table.num_rows
+        return [{k: data[k][i] for k in names} for i in range(n)]
+
+    def to_pydict(self) -> dict:
+        return to_pydict(self.session.execute(self.plan))
+
+    def to_pandas(self):
+        import pandas as pd
+        return pd.DataFrame(self.to_pydict())
+
+    def count(self) -> int:
+        return self.session.execute(self.plan).num_rows
+
+    def explain(self, mode: str = "ALL") -> str:
+        meta = overrides.tag_only(self.plan)
+        out = "\n".join(meta.explain_lines(
+            only_not_on_tpu=(mode == "NOT_ON_TPU")))
+        print(out)
+        return out
+
+    def __repr__(self):
+        cols = ", ".join(f"{n}: {t}" for n, t in self.plan.schema)
+        return f"DataFrame[{cols}]"
+
+
+class GroupedData:
+    def __init__(self, df: DataFrame, keys: List[Expression]):
+        self.df = df
+        self.keys = keys
+
+    def agg(self, *aggs) -> DataFrame:
+        pairs = []
+        for i, a in enumerate(aggs):
+            if isinstance(a, Alias):
+                pairs.append((a.children[0], a.name))
+            else:
+                pairs.append((a, output_name(a, len(self.keys) + i)))
+        return DataFrame(self.df.session,
+                         L.Aggregate(self.df.plan, self.keys, pairs))
+
+    def count(self) -> DataFrame:
+        return self.agg(Alias(CountStar(), "count"))
+
+    def _simple(self, fn_cls, cols) -> DataFrame:
+        return self.agg(*[Alias(fn_cls(_to_expr(c)), f"{fn_cls.name}({c})")
+                          for c in cols])
+
+    def sum(self, *cols) -> DataFrame:
+        return self._simple(Sum, cols)
+
+    def min(self, *cols) -> DataFrame:
+        return self._simple(Min, cols)
+
+    def max(self, *cols) -> DataFrame:
+        return self._simple(Max, cols)
+
+    def avg(self, *cols) -> DataFrame:
+        return self._simple(Average, cols)
+
+    mean = avg

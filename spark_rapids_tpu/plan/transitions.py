@@ -1,0 +1,100 @@
+"""Host<->device transition nodes.
+
+Rebuild of GpuTransitionOverrides.scala + the row<->columnar boundary
+execs (GpuRowToColumnarExec / GpuColumnarToRowExec, SURVEY §2.2): the
+overrides driver emits mixed trees where TPU subtrees and CPU-fallback
+subtrees meet; these adapters are the seams. Both sides are columnar
+(HostTable on CPU), so a transition is a buffer copy + capacity
+bucketing, not a row pivot.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from ..columnar.vector import ColumnarBatch, choose_capacity
+from ..exec.base import ExecContext, Schema, TpuExec
+from .cpu_exec import apply_cpu_node
+from .host_table import (HostTable, batch_to_table, concat_tables,
+                         empty_like, table_to_batch)
+from .logical import LogicalPlan
+
+
+class CpuPhysical:
+    """A logical node executing on CPU, with mixed-device children."""
+
+    def __init__(self, plan: LogicalPlan, children: List):
+        self.plan = plan
+        self.children = children  # CpuPhysical | DeviceToHostBridge
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.plan.schema
+
+    def evaluate(self, ctx: ExecContext) -> HostTable:
+        tables = [c.evaluate(ctx) for c in self.children]
+        return apply_cpu_node(self.plan, tables)
+
+    def tree_string(self, indent: int = 0) -> str:
+        line = "  " * indent + "* Cpu" + self.plan.node_description()
+        return "\n".join([line] + [c.tree_string(indent + 1)
+                                   for c in self.children])
+
+
+class DeviceToHostBridge:
+    """Drains a TPU subtree to a HostTable (GpuColumnarToRowExec role)."""
+
+    def __init__(self, tpu_exec: TpuExec):
+        self.tpu = tpu_exec
+        self.children = [tpu_exec]
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.tpu.output_schema
+
+    def evaluate(self, ctx: ExecContext) -> HostTable:
+        tables = [batch_to_table(b) for b in self.tpu.execute(ctx)
+                  if int(b.num_rows) > 0]
+        if not tables:
+            return empty_like(self.tpu.output_schema)
+        return concat_tables(tables)
+
+    def tree_string(self, indent: int = 0) -> str:
+        line = "  " * indent + "* DeviceToHost"
+        return "\n".join([line, self.tpu.tree_string(indent + 1)])
+
+
+class HostToDeviceExec(TpuExec):
+    """Feeds a CPU subtree's result to the device as ColumnarBatches
+    (GpuRowToColumnarExec role). Splits the host table into
+    target-batch-size chunks so device capacities stay bucketed."""
+
+    def __init__(self, cpu_child):
+        super().__init__()
+        self.cpu_child = cpu_child
+        self._schema = cpu_child.output_schema
+
+    @property
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        from ..conf import BATCH_SIZE_ROWS
+        table = self.cpu_child.evaluate(ctx)
+        per = ctx.conf.get(BATCH_SIZE_ROWS)
+        n = table.num_rows
+        if n == 0:
+            yield table_to_batch(table, capacity=8)
+            return
+        for start in range(0, n, per):
+            chunk = table.take(np.arange(start, min(start + per, n)))
+            yield table_to_batch(chunk)
+
+    def node_description(self) -> str:
+        return "HostToDevice"
+
+    def tree_string(self, indent: int = 0) -> str:
+        line = "  " * indent + "* HostToDevice"
+        return "\n".join([line, self.cpu_child.tree_string(indent + 1)])

@@ -1,0 +1,21 @@
+"""Test harness: CPU≡TPU differential asserts + typed data generators.
+
+Rebuild of the reference's integration-test architecture (SURVEY §4):
+integration_tests/src/main/python/asserts.py (assert_gpu_and_cpu_are_
+equal_collect, fallback capture) and data_gen.py (composable typed
+random generators). The CPU oracle is the numpy interpreter
+(plan/cpu_exec.py); the TPU side is the full overrides->exec pipeline.
+"""
+
+from .asserts import (assert_falls_back_to_cpu, assert_runs_on_tpu,
+                      assert_tpu_cpu_equal, assert_tpu_cpu_equal_df)
+from .datagen import (BoolGen, DateGen, DecimalGen, DoubleGen, FloatGen,
+                      IntGen, LongGen, ShortGen, StringGen, TimestampGen,
+                      gen_table)
+
+__all__ = [
+    "assert_tpu_cpu_equal", "assert_tpu_cpu_equal_df",
+    "assert_falls_back_to_cpu", "assert_runs_on_tpu",
+    "IntGen", "LongGen", "ShortGen", "DoubleGen", "FloatGen", "BoolGen",
+    "StringGen", "DateGen", "TimestampGen", "DecimalGen", "gen_table",
+]

@@ -1,0 +1,179 @@
+"""Composable typed random data generators.
+
+Rebuild of integration_tests/src/main/python/data_gen.py (SURVEY §4):
+each generator produces python values (None = null) for one column,
+with the edge cases the reference bakes in — numeric extremes, special
+floats (NaN/±Inf/±0.0), empty strings, epoch-adjacent dates.
+"""
+
+from __future__ import annotations
+
+import datetime
+import decimal
+import string
+from typing import List, Optional
+
+import numpy as np
+
+from ..columnar import dtypes as dt
+
+
+class DataGen:
+    dtype: dt.DType = None
+    null_prob = 0.1
+
+    def __init__(self, nullable: bool = True,
+                 null_prob: Optional[float] = None):
+        self.nullable = nullable
+        if null_prob is not None:
+            self.null_prob = null_prob
+
+    def gen(self, n: int, rng: np.random.Generator) -> List:
+        vals = self._values(n, rng)
+        if not self.nullable:
+            return list(vals)
+        nulls = rng.random(n) < self.null_prob
+        return [None if nulls[i] else vals[i] for i in range(n)]
+
+    def _values(self, n, rng):
+        raise NotImplementedError
+
+
+class _IntegralGen(DataGen):
+    lo, hi = -100, 100
+    specials: List[int] = []
+
+    def __init__(self, lo=None, hi=None, **kw):
+        super().__init__(**kw)
+        if lo is not None:
+            self.lo = lo
+        if hi is not None:
+            self.hi = hi
+
+    def _values(self, n, rng):
+        vals = rng.integers(self.lo, self.hi + 1, n).tolist()
+        for s in self.specials:
+            # specials respect the caller's bounds
+            if self.lo <= s <= self.hi and n and rng.random() < 0.5:
+                vals[int(rng.integers(0, n))] = s
+        return [int(v) for v in vals]
+
+
+class ByteGen(_IntegralGen):
+    dtype = dt.INT8
+    lo, hi = -128, 127
+
+
+class ShortGen(_IntegralGen):
+    dtype = dt.INT16
+    lo, hi = -(2 ** 15), 2 ** 15 - 1
+
+
+class IntGen(_IntegralGen):
+    dtype = dt.INT32
+    lo, hi = -(2 ** 31), 2 ** 31 - 1
+    specials = [0, -1, 1, 2 ** 31 - 1, -(2 ** 31)]
+
+
+class LongGen(_IntegralGen):
+    dtype = dt.INT64
+    lo, hi = -(2 ** 63), 2 ** 63 - 1
+    specials = [0, -1, 1, 2 ** 63 - 1, -(2 ** 63)]
+
+
+class BoolGen(DataGen):
+    dtype = dt.BOOL
+
+    def _values(self, n, rng):
+        return [bool(v) for v in rng.integers(0, 2, n)]
+
+
+class DoubleGen(DataGen):
+    dtype = dt.FLOAT64
+    specials = [0.0, -0.0, float("nan"), float("inf"), float("-inf"),
+                1.0, -1.0]
+
+    def __init__(self, no_special: bool = False, lo=-1e6, hi=1e6, **kw):
+        super().__init__(**kw)
+        self.no_special = no_special
+        self.lo, self.hi = lo, hi
+
+    def _values(self, n, rng):
+        vals = rng.uniform(self.lo, self.hi, n).tolist()
+        if not self.no_special:
+            for s in self.specials:
+                if n and rng.random() < 0.3:
+                    vals[int(rng.integers(0, n))] = s
+        return [float(v) for v in vals]
+
+
+class FloatGen(DoubleGen):
+    dtype = dt.FLOAT32
+
+    def _values(self, n, rng):
+        return [float(np.float32(v)) for v in super()._values(n, rng)]
+
+
+class StringGen(DataGen):
+    dtype = dt.STRING
+
+    def __init__(self, charset: str = string.ascii_letters + string.digits,
+                 max_len: int = 12, **kw):
+        super().__init__(**kw)
+        self.charset = charset
+        self.max_len = max_len
+
+    def _values(self, n, rng):
+        out = []
+        for _ in range(n):
+            ln = int(rng.integers(0, self.max_len + 1))
+            out.append("".join(self.charset[int(i)] for i in
+                               rng.integers(0, len(self.charset), ln)))
+        if n and rng.random() < 0.5:
+            out[int(rng.integers(0, n))] = ""
+        return out
+
+
+class DateGen(DataGen):
+    dtype = dt.DATE
+    # epoch-adjacent through far future (reference uses 0001..9999; we
+    # bound to the int32-days-safe modern range)
+    lo_days, hi_days = -25567, 47482  # 1900-01-01 .. 2100-01-01
+
+    def _values(self, n, rng):
+        days = rng.integers(self.lo_days, self.hi_days, n)
+        epoch = datetime.date(1970, 1, 1)
+        return [epoch + datetime.timedelta(days=int(d)) for d in days]
+
+
+class TimestampGen(DataGen):
+    dtype = dt.TIMESTAMP
+
+    def _values(self, n, rng):
+        micros = rng.integers(-2_208_988_800_000_000,  # 1900-01-01
+                              4_102_444_800_000_000, n)  # 2100-01-01
+        epoch = datetime.datetime(1970, 1, 1,
+                                  tzinfo=datetime.timezone.utc)
+        return [epoch + datetime.timedelta(microseconds=int(m))
+                for m in micros]
+
+
+class DecimalGen(DataGen):
+    def __init__(self, precision: int = 18, scale: int = 2, **kw):
+        super().__init__(**kw)
+        self.dtype = dt.DecimalType(precision, scale)
+        self.precision, self.scale = precision, scale
+
+    def _values(self, n, rng):
+        lim = 10 ** min(self.precision, 15)
+        unscaled = rng.integers(-lim + 1, lim, n)
+        return [decimal.Decimal(int(u)).scaleb(-self.scale)
+                for u in unscaled]
+
+
+def gen_table(gens: dict, n: int = 256, seed: int = 0):
+    """{name: DataGen} -> (data dict, schema). The standard test input."""
+    rng = np.random.default_rng(seed)
+    data = {name: g.gen(n, rng) for name, g in gens.items()}
+    schema = [(name, g.dtype) for name, g in gens.items()]
+    return data, schema

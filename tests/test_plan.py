@@ -1,0 +1,153 @@
+"""Plan layer tests: DataFrame frontend, overrides tagging, transitions,
+explain, and CPU fallback (SURVEY §2.2 equivalents)."""
+
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.expr.aggregates import Average, CountStar, Max, Min, Sum
+from spark_rapids_tpu.expr.core import col, lit
+from spark_rapids_tpu.plan import TpuSession
+from spark_rapids_tpu.plan import overrides
+from spark_rapids_tpu.plan.transitions import (CpuPhysical,
+                                               DeviceToHostBridge,
+                                               HostToDeviceExec)
+from spark_rapids_tpu.exec.base import TpuExec
+from spark_rapids_tpu.exec.sort import TopNExec
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession()
+
+
+def test_select_filter_collect(session):
+    df = session.create_dataframe({"a": [1, 2, 3, None], "b": [1.0, 2.0, 3.0, 4.0]})
+    out = df.filter(col("a") >= 2).select("a", (col("b") * 2).alias("b2")).collect()
+    assert out == [{"a": 2, "b2": 4.0}, {"a": 3, "b2": 6.0}]
+
+
+def test_with_column_and_getitem(session):
+    df = session.create_dataframe({"x": [1, 2]})
+    out = df.with_column("y", df["x"] + 10).collect()
+    assert out == [{"x": 1, "y": 11}, {"x": 2, "y": 12}]
+
+
+def test_group_by_agg(session):
+    df = session.create_dataframe({"k": ["a", "b", "a"], "v": [1, 2, 3]})
+    out = df.group_by("k").agg(Sum(col("v")).alias("s"),
+                               CountStar().alias("n")).collect()
+    by_k = {r["k"]: r for r in out}
+    assert by_k["a"] == {"k": "a", "s": 4, "n": 2}
+    assert by_k["b"] == {"k": "b", "s": 2, "n": 1}
+
+
+def test_join_api(session):
+    left = session.create_dataframe({"k": [1, 2, 3], "l": ["x", "y", "z"]})
+    right = session.create_dataframe({"k": [2, 3, 4], "r": [20, 30, 40]})
+    out = left.join(right, on="k").collect()
+    ks = sorted(r["k"] for r in out)
+    assert ks == [2, 3]
+
+
+def test_sort_limit_fuses_to_topn(session):
+    df = session.create_dataframe({"v": [5, 1, 4, 2, 3]})
+    plan = df.sort("v").limit(2).plan
+    physical = overrides.apply_overrides(plan, session.conf)
+    # Limit(Sort) must fuse into TopNExec on the device
+    assert isinstance(physical, TopNExec)
+    out = df.sort("v").limit(2).collect()
+    assert [r["v"] for r in out] == [1, 2]
+
+
+def test_distinct(session):
+    df = session.create_dataframe({"v": [1, 2, 2, 3, 3, 3]})
+    out = sorted(r["v"] for r in df.distinct().collect())
+    assert out == [1, 2, 3]
+
+
+def test_union(session):
+    a = session.create_dataframe({"v": [1]})
+    b = session.create_dataframe({"v": [2]})
+    assert sorted(r["v"] for r in a.union(b).collect()) == [1, 2]
+
+
+def test_range(session):
+    out = session.range(0, 10, 3).collect()
+    assert [r["id"] for r in out] == [0, 3, 6, 9]
+
+
+def test_full_outer_join_falls_back(session):
+    """full_outer has no TPU impl yet: the plan must contain a CPU node
+    and still produce correct results through the fallback."""
+    left = session.create_dataframe({"lk": [1, 2], "l": [10, 20]})
+    right = session.create_dataframe({"rk": [2, 3], "r": [200, 300]})
+    df = left.join(right, on=([col("lk")], [col("rk")]), how="full")
+    meta = overrides.tag_only(df.plan)
+    assert not meta.can_this_be_replaced
+    physical = overrides.apply_overrides(df.plan, session.conf)
+    assert isinstance(physical, (CpuPhysical, DeviceToHostBridge))
+    rows = df.collect()
+    assert len(rows) == 3
+    by_k = {(r["lk"], r["r"]) for r in rows}
+    assert (1, None) in by_k
+
+
+def test_fallback_sandwich_transitions(session):
+    """TPU-supported ops above a CPU-fallback node must re-enter the
+    device through HostToDeviceExec."""
+    left = session.create_dataframe({"k": [1, 2, 2], "l": [1, 2, 3]})
+    right = session.create_dataframe({"k": [2, 3], "r": [20, 30]})
+    df = left.join(right, on="k", how="full").filter(col("l") >= 1)
+    physical = overrides.apply_overrides(df.plan, session.conf)
+    # Filter is supported -> device node fed by HostToDevice transition
+    assert isinstance(physical, TpuExec)
+    found = []
+    def walk(n):
+        found.append(type(n).__name__)
+        for c in getattr(n, "children", []):
+            walk(c)
+        if isinstance(n, HostToDeviceExec):
+            walk(n.cpu_child)
+    walk(physical)
+    assert "HostToDeviceExec" in found
+    assert df.count() == 3
+
+
+def test_explain_lists_fallback_reason(session, capsys):
+    left = session.create_dataframe({"k": [1]})
+    right = session.create_dataframe({"k": [1]})
+    df = left.join(right, on="k", how="full")
+    out = df.explain()
+    assert "full_outer" in out and "!" in out
+
+
+def test_sql_enabled_off_runs_cpu(session):
+    from spark_rapids_tpu.conf import SQL_ENABLED, SrtConf
+    conf = SrtConf({SQL_ENABLED.key: "false"})
+    s = TpuSession(conf)
+    df = s.create_dataframe({"a": [1, 2]}).select((col("a") + 1).alias("b"))
+    physical = overrides.apply_overrides(df.plan, conf)
+    assert isinstance(physical, CpuPhysical)
+    assert [r["b"] for r in df.collect()] == [2, 3]
+
+
+def test_supported_ops_doc():
+    doc = overrides.generate_supported_ops_doc()
+    assert "| Add |" in doc
+    assert "Aggregate" in doc
+
+
+def test_unsupported_expression_falls_back(session):
+    """An expression class with no rule forces its operator to CPU."""
+    from spark_rapids_tpu.expr.core import Expression
+
+    class WeirdExpr(Expression):
+        def data_type(self, schema):
+            return dt.INT64
+
+    df = session.create_dataframe({"a": [1]})
+    plan = df.select(col("a")).plan
+    plan.exprs[0] = WeirdExpr()
+    meta = overrides.tag_only(plan)
+    assert not meta.can_this_be_replaced
+    assert any("no TPU" in r for r in meta.reasons)
