@@ -1,0 +1,371 @@
+"""Window exec: sort-based segmented-scan window computation.
+
+Rebuild of GpuWindowExec.scala (SURVEY §2.4, 2108 LoC). cuDF exposes
+rolling/scan window kernels; the TPU formulation sorts the whole input
+by (partition keys, order keys) once, derives segment boundaries, and
+lowers every window function to vectorized segmented scans / gathers:
+
+  row_number   idx - segment_start + 1
+  rank         cummax of order-run starts within the segment
+  dense_rank   segmented cumsum of order-run starts
+  ntile        closed-form bucket from row_number and partition size
+  lead/lag     index-shifted gather masked to the segment
+  running agg  segmented associative_scan (sum/min/max/count/avg)
+  whole-part.  segment reduce + gather
+  sliding ROWS prefix-sum differences (sum/count/avg) or O(w) masked
+               min/max for static window widths
+
+Everything runs in ONE jit per (capacity, plan) — there is no per-
+function kernel launch loop. Results scatter back to input order, so
+the node is order-preserving (stronger than Spark's contract).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar import dtypes as dt
+from ..columnar.vector import (Column, ColumnVector, ColumnarBatch,
+                               StringColumn, choose_capacity)
+from ..expr.aggregates import (AggregateFunction, Average, Count, CountStar,
+                               Max, Min, Sum)
+from ..expr.core import Expression, make_result
+from ..expr.window import (Lag, Lead, DenseRank, NTile, PercentRank, Rank,
+                           RowNumber, WindowExpression, WindowFrame)
+from ..ops import kernels as K
+from .base import ExecContext, Schema, TpuExec
+
+
+# ---------------------------------------------------------------------------
+# segmented primitives (all length-N over the sorted layout)
+# ---------------------------------------------------------------------------
+
+def _seg_scan(op, vals, new_seg):
+    """Inclusive segmented scan: op-accumulate, restarting where
+    new_seg[i] is True (classic segmented-scan monoid lift)."""
+    def combine(a, b):
+        af, av = a
+        bf, bv = b
+        return (af | bf, jnp.where(bf, bv, op(av, bv)))
+    flags, out = jax.lax.associative_scan(combine, (new_seg, vals))
+    return out
+
+
+def _seg_start_idx(new_seg):
+    """For each row, index of its segment's first row (via cummax)."""
+    n = new_seg.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    starts = jnp.where(new_seg, idx, 0)
+    return jax.lax.associative_scan(jnp.maximum, starts)
+
+
+def _seg_counts(gid, num_rows, cap):
+    """Per-row count of live rows in the row's segment."""
+    ones = (jnp.arange(cap) < num_rows).astype(jnp.int64)
+    totals = jnp.zeros(cap, jnp.int64).at[gid].add(ones)
+    return totals[gid]
+
+
+def _prev_differs(cols: Sequence[Column]) -> jnp.ndarray:
+    """True where row i's keys differ from row i-1 (row 0 = True;
+    K._adjacent_equal already yields eq[0] = False)."""
+    eq = K._adjacent_equal(cols[0])
+    for c in cols[1:]:
+        eq = eq & K._adjacent_equal(c)
+    return ~eq
+
+
+class WindowExec(TpuExec):
+    """Computes window columns for expressions sharing one
+    (partition_by, order_by) spec; appends them to the child schema."""
+
+    def __init__(self, child: TpuExec,
+                 window_exprs: Sequence[Tuple[WindowExpression, str]]):
+        super().__init__(child)
+        self.window_exprs = list(window_exprs)
+        spec = window_exprs[0][0].spec
+        self.partition_by = spec.partition_by
+        self.order_by = spec.order_fields
+        for we, _ in window_exprs[1:]:
+            if (repr(we.spec.partition_by) != repr(self.partition_by)
+                    or repr(we.spec.order_fields) != repr(self.order_by)):
+                raise ValueError(
+                    "one WindowExec handles one (partition, order) spec; "
+                    "the planner must split differing specs")
+        in_schema = child.output_schema
+        self._schema = list(in_schema) + [
+            (name, we.data_type(in_schema))
+            for we, name in self.window_exprs]
+        self._jit = jax.jit(self._compute)
+
+    @property
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    # --- the one big kernel ---
+    def _compute(self, batch: ColumnarBatch) -> ColumnarBatch:
+        cap = batch.capacity
+        n = batch.num_rows
+        live = batch.live_mask()
+        part_cols = [e.eval(batch) for e in self.partition_by]
+        order_cols = [o.expr.eval(batch) for o in self.order_by]
+
+        # sort by (partition, order); dead rows sort last
+        asc = [True] * len(part_cols) + [o.ascending for o in self.order_by]
+        nf = [True] * len(part_cols) + [o.nulls_first for o in self.order_by]
+        perm = K.sort_indices(part_cols + order_cols, asc, nf, live)
+        sorted_batch = batch.gather(perm, n)
+        s_part = [c.gather(perm) for c in part_cols]
+        s_order = [c.gather(perm) for c in order_cols]
+
+        idx = jnp.arange(cap, dtype=jnp.int32)
+        s_live = idx < n
+        new_part = _prev_differs(s_part) if s_part else \
+            (idx == 0)
+        new_part = new_part | (idx == 0)
+        gid = jnp.cumsum(new_part.astype(jnp.int32)) - 1
+        seg_start = _seg_start_idx(new_part)
+        counts = _seg_counts(gid, n, cap)
+        new_order = new_part | (_prev_differs(s_order)
+                                if s_order else jnp.zeros(cap, jnp.bool_))
+        # last row index of each order-key run (RANGE peer semantics):
+        # next run's start - 1, via reversed inclusive cummin of starts
+        starts_only = jnp.where(new_order, idx, jnp.int32(cap))
+        incl_next = jax.lax.associative_scan(
+            jnp.minimum, starts_only[::-1])[::-1]
+        next_start = jnp.concatenate(
+            [incl_next[1:], jnp.full(1, cap, jnp.int32)])
+        run_end = jnp.clip(next_start - 1, 0, cap - 1)
+
+        out_cols: List[Column] = []
+        for we, _name in self.window_exprs:
+            out_cols.append(self._one_function(
+                we, sorted_batch, idx, s_live, new_part, new_order, gid,
+                seg_start, counts, run_end, cap, n))
+
+        # scatter results back to input order
+        inv = jnp.zeros(cap, jnp.int32).at[perm].set(idx)
+        restored = [c.gather(inv) for c in out_cols]
+        return ColumnarBatch(
+            list(batch.columns) + restored,
+            [nm for nm, _ in self._schema], n)
+
+    def _one_function(self, we: WindowExpression, sorted_batch, idx,
+                      s_live, new_part, new_order, gid, seg_start, counts,
+                      run_end, cap, n) -> Column:
+        fn = we.func
+        live_valid = s_live
+        rn = idx - seg_start + 1  # row_number, 1-based
+
+        if isinstance(fn, RowNumber):
+            return make_result(rn.astype(jnp.int32), live_valid, dt.INT32)
+        if isinstance(fn, (Rank, DenseRank, PercentRank)):
+            run_start = jnp.where(new_order, idx, 0)
+            rank_idx = jax.lax.associative_scan(jnp.maximum, run_start)
+            rank = (rank_idx - seg_start + 1).astype(jnp.int32)
+            if isinstance(fn, Rank):
+                return make_result(rank, live_valid, dt.INT32)
+            if isinstance(fn, DenseRank):
+                dr = _seg_scan(jnp.add, new_order.astype(jnp.int32),
+                               new_part).astype(jnp.int32)
+                return make_result(dr, live_valid, dt.INT32)
+            denom = jnp.maximum(counts - 1, 1).astype(jnp.float64)
+            pr = jnp.where(counts > 1, (rank - 1).astype(jnp.float64)
+                           / denom, 0.0)
+            return make_result(pr, live_valid, dt.FLOAT64)
+        if isinstance(fn, NTile):
+            nt = jnp.int64(fn.n)
+            cnt = counts
+            q = cnt // nt
+            r = cnt % nt
+            i0 = (rn - 1).astype(jnp.int64)
+            big_span = r * (q + 1)
+            in_big = i0 < big_span
+            bucket = jnp.where(
+                in_big, i0 // jnp.maximum(q + 1, 1),
+                r + jnp.where(q > 0, (i0 - big_span) // jnp.maximum(q, 1),
+                              i0 - big_span))
+            return make_result((bucket + 1).astype(jnp.int32), live_valid,
+                               dt.INT32)
+        if isinstance(fn, (Lead, Lag)):
+            col = fn.children[0].eval(sorted_batch)
+            k = fn.offset if isinstance(fn, Lead) and not isinstance(fn, Lag) \
+                else -fn.offset
+            target = idx + k
+            seg_end = seg_start + counts.astype(jnp.int32) - 1
+            in_seg = (target >= seg_start) & (target <= seg_end) & \
+                (target >= 0) & (target < cap)
+            got = col.gather(jnp.clip(target, 0, cap - 1))
+            if fn.default is not None:
+                from ..expr.core import Literal
+                d = Literal(fn.default).eval(sorted_batch)
+                if isinstance(got, StringColumn):
+                    from ..expr.conditional import _select_strings
+                    out = _select_strings(in_seg, got, d)
+                    return out.with_validity(
+                        jnp.where(in_seg, got.validity, d.validity) & s_live)
+                data = jnp.where(in_seg, got.data, d.data.astype(got.data.dtype))
+                valid = jnp.where(in_seg, got.validity, d.validity) & s_live
+                return make_result(data, valid, got.dtype)
+            valid = got.validity & in_seg & s_live
+            if isinstance(got, StringColumn):
+                return got.with_validity(valid)
+            return make_result(got.data, valid, got.dtype)
+        if isinstance(fn, AggregateFunction):
+            return self._window_aggregate(fn, we.spec.frame, sorted_batch,
+                                          idx, s_live, new_part, gid,
+                                          seg_start, counts, run_end, cap)
+        raise NotImplementedError(type(fn).__name__)
+
+    def _window_aggregate(self, fn: AggregateFunction, frame: WindowFrame,
+                          sorted_batch, idx, s_live, new_part, gid,
+                          seg_start, counts, run_end, cap) -> Column:
+        in_schema = sorted_batch.schema()
+        if isinstance(fn, CountStar):
+            vals = s_live.astype(jnp.int64)
+            valid_in = s_live
+            out_t = dt.INT64
+        else:
+            col = fn.children[0].eval(sorted_batch)
+            out_t = fn.data_type(in_schema)
+            valid_in = col.validity
+            if isinstance(fn, (Sum, Average, Count)):
+                phys = jnp.float64 if isinstance(fn, Average) or \
+                    (isinstance(fn, Sum) and out_t == dt.FLOAT64) else \
+                    out_t.physical
+                vals = col.data.astype(jnp.float64
+                                       if isinstance(fn, Average)
+                                       else phys)
+                if isinstance(col.dtype, dt.DecimalType) and \
+                        isinstance(fn, Average):
+                    vals = vals / (10.0 ** col.dtype.scale)
+            else:
+                vals = col.data
+
+        cnt_vals = valid_in.astype(jnp.int64)
+        if isinstance(fn, Count) or isinstance(fn, CountStar):
+            agg_vals = cnt_vals
+            op = jnp.add
+            zero_for_null = 0
+        elif isinstance(fn, Sum) or isinstance(fn, Average):
+            agg_vals = jnp.where(valid_in, vals, 0)
+            op = jnp.add
+            zero_for_null = 0
+        elif isinstance(fn, Min):
+            fill = dt.max_value(out_t)
+            agg_vals = jnp.where(valid_in, vals,
+                                 jnp.asarray(fill, vals.dtype))
+            op = jnp.minimum
+        elif isinstance(fn, Max):
+            fill = dt.min_value(out_t)
+            agg_vals = jnp.where(valid_in, vals,
+                                 jnp.asarray(fill, vals.dtype))
+            op = jnp.maximum
+        else:
+            raise NotImplementedError(
+                f"window aggregate {type(fn).__name__}")
+
+        if frame.is_unbounded:
+            if op is jnp.add:
+                total = jnp.zeros(cap, agg_vals.dtype).at[gid].add(agg_vals)
+            elif op is jnp.minimum:
+                total = jnp.full(cap, jnp.asarray(
+                    dt.max_value(out_t), agg_vals.dtype)).at[gid].min(agg_vals)
+            else:
+                total = jnp.full(cap, jnp.asarray(
+                    dt.min_value(out_t), agg_vals.dtype)).at[gid].max(agg_vals)
+            acc = total[gid]
+            ncnt = jnp.zeros(cap, jnp.int64).at[gid].add(cnt_vals)[gid]
+        elif frame.is_running:
+            acc = _seg_scan(op, agg_vals, new_part)
+            ncnt = _seg_scan(jnp.add, cnt_vals, new_part)
+            if not frame.row_based:
+                # RANGE running: all peers of the current order key share
+                # the value at their run's LAST row (SQL peer semantics)
+                acc = jnp.take(acc, run_end)
+                ncnt = jnp.take(ncnt, run_end)
+        else:
+            return self._sliding(fn, frame, agg_vals, cnt_vals, idx,
+                                 seg_start, counts, cap, out_t, op, s_live)
+
+        return self._finalize_agg(fn, acc, ncnt, s_live, out_t)
+
+    def _sliding(self, fn, frame, agg_vals, cnt_vals, idx, seg_start,
+                 counts, cap, out_t, op, s_live):
+        """ROWS BETWEEN a AND b with integer bounds: prefix-sum
+        differences for add-monoids, O(width) masked scan otherwise."""
+        lo = frame.lo
+        hi = frame.hi
+        seg_end = seg_start + counts.astype(jnp.int32) - 1
+        lo_i = seg_start if lo is None else \
+            jnp.maximum(idx + lo, seg_start)
+        hi_i = seg_end if hi is None else \
+            jnp.minimum(idx + hi, seg_end)
+        width_empty = hi_i < lo_i
+        if op is jnp.add:
+            csum = jnp.cumsum(agg_vals)
+            ccnt = jnp.cumsum(cnt_vals)
+            def rng_sum(ps, at_lo, at_hi):
+                top = ps[jnp.clip(at_hi, 0, cap - 1)]
+                bot = jnp.where(at_lo > 0, ps[jnp.clip(at_lo - 1, 0, cap - 1)], 0)
+                return top - bot
+            acc = jnp.where(width_empty, 0, rng_sum(csum, lo_i, hi_i))
+            ncnt = jnp.where(width_empty, 0, rng_sum(ccnt, lo_i, hi_i))
+        else:
+            if lo is None or hi is None:
+                raise NotImplementedError(
+                    "min/max sliding frames need bounded ROWS offsets")
+            width = hi - lo + 1
+            acc = jnp.take(agg_vals, jnp.clip(lo_i, 0, cap - 1))
+            ncnt = jnp.zeros(cap, jnp.int64)
+            for off in range(width):
+                j = lo_i + off
+                ok = (j <= hi_i)
+                v = jnp.take(agg_vals, jnp.clip(j, 0, cap - 1))
+                acc = jnp.where(ok, op(acc, v), acc)
+                ncnt = ncnt + jnp.where(
+                    ok, jnp.take(cnt_vals, jnp.clip(j, 0, cap - 1)), 0)
+        return self._finalize_agg(fn, acc, ncnt, s_live, out_t)
+
+    def _finalize_agg(self, fn, acc, ncnt, s_live, out_t) -> ColumnVector:
+        if isinstance(fn, (Count, CountStar)):
+            return make_result(acc.astype(jnp.int64), s_live, dt.INT64)
+        has_vals = ncnt > 0
+        if isinstance(fn, Average):
+            out = acc / jnp.where(has_vals, ncnt, 1).astype(jnp.float64)
+            return make_result(out, has_vals & s_live, dt.FLOAT64)
+        if isinstance(fn, Sum):
+            phys = out_t.physical
+            return make_result(acc.astype(phys), has_vals & s_live, out_t)
+        return make_result(acc, has_vals & s_live, out_t)
+
+    # --- streaming shell (global materialization, like SortExec) ---
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        from ..memory.spill import SpillableBatch, SpillPriority
+        runs: List[SpillableBatch] = []
+        total = 0
+        try:
+            for b in self.children[0].execute(ctx):
+                if int(b.num_rows) == 0:
+                    continue
+                total += int(b.num_rows)
+                runs.append(SpillableBatch(b, SpillPriority.ACTIVE_ON_DECK))
+            if not runs:
+                return
+            batches = [sb.get() for sb in runs]
+            cap = choose_capacity(total)
+            with ctx.semaphore:
+                merged = (batches[0] if len(batches) == 1
+                          else K.concat_batches(batches, cap))
+                yield self._jit(merged)
+        finally:
+            for sb in runs:
+                sb.close()
+
+    def node_description(self) -> str:
+        fns = ", ".join(type(we.func).__name__
+                        for we, _ in self.window_exprs)
+        return f"Window[{fns}]"

@@ -64,6 +64,11 @@ class AggregateFunction(Expression):
     def data_type(self, schema: Schema) -> dt.DType:
         raise NotImplementedError
 
+    def over(self, spec):
+        """Use as a window aggregate: sum(x).over(spec)."""
+        from .window import WindowExpression
+        return WindowExpression(self, spec)
+
     def state_schema(self, schema: Schema) -> List:
         """[(state_name, DType), ...] — the partial-aggregation buffer."""
         raise NotImplementedError

@@ -1,0 +1,21 @@
+"""I/O layer: file-format scans and writers (SURVEY §2.6).
+
+TPU-native re-architecture of the reference's GpuParquetScan/GpuOrcScan/
+GpuCSVScan + GpuMultiFileReader + writer stack. The reference decodes
+files ON the GPU (cuDF kernels); XLA has no file-decode kernels, so the
+TPU design keeps the reference's *host-side* structure — multithreaded /
+coalescing readers that parse and filter on host threads WITHOUT holding
+the device semaphore (GpuParquetScan.scala:1862,2057: "host threads
+read+coalesce parquet blocks (no GPU held)") — and uploads decoded
+columnar buffers to HBM, acquiring the semaphore only for the upload.
+Arrow (pyarrow) plays the role cuDF's host parsers play.
+"""
+
+from .arrow_convert import arrow_to_host_table, host_table_to_arrow
+from .reader import DataFrameReader
+from .scan import FileScan, FileSourceScanExec
+from .writer import DataFrameWriter, WriteStats
+
+__all__ = ["DataFrameReader", "DataFrameWriter", "FileScan",
+           "FileSourceScanExec", "WriteStats", "arrow_to_host_table",
+           "host_table_to_arrow"]

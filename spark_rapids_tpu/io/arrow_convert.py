@@ -1,0 +1,144 @@
+"""Arrow <-> HostTable conversion with our device physical encodings.
+
+The boundary between pyarrow's decoded buffers and the framework's
+columnar model (the role cudf-java's Table.readParquet return plays in
+the reference): every Arrow type maps to the same physical lanes the
+device uses (date32 -> int32 days, timestamp -> int64 micros UTC,
+decimal128(p<=18) -> scaled int64, strings -> object array).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from ..columnar import dtypes as dt
+from ..plan.host_table import HostColumn, HostTable
+
+
+def arrow_type_to_dtype(t: pa.DataType) -> dt.DType:
+    if pa.types.is_boolean(t):
+        return dt.BOOL
+    if pa.types.is_int8(t):
+        return dt.INT8
+    if pa.types.is_int16(t):
+        return dt.INT16
+    if pa.types.is_int32(t):
+        return dt.INT32
+    if pa.types.is_int64(t):
+        return dt.INT64
+    if pa.types.is_float32(t):
+        return dt.FLOAT32
+    if pa.types.is_float64(t):
+        return dt.FLOAT64
+    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        return dt.STRING
+    if pa.types.is_date32(t):
+        return dt.DATE
+    if pa.types.is_timestamp(t):
+        return dt.TIMESTAMP
+    if pa.types.is_decimal(t):
+        if t.precision > 18:
+            raise TypeError(
+                f"decimal precision {t.precision} > 18 not supported yet")
+        return dt.DecimalType(t.precision, t.scale)
+    raise TypeError(f"unsupported arrow type {t}")
+
+
+def dtype_to_arrow_type(t: dt.DType) -> pa.DataType:
+    if isinstance(t, dt.BooleanType):
+        return pa.bool_()
+    if isinstance(t, dt.ByteType):
+        return pa.int8()
+    if isinstance(t, dt.ShortType):
+        return pa.int16()
+    if isinstance(t, dt.IntegerType):
+        return pa.int32()
+    if isinstance(t, dt.LongType):
+        return pa.int64()
+    if isinstance(t, dt.FloatType):
+        return pa.float32()
+    if isinstance(t, dt.DoubleType):
+        return pa.float64()
+    if isinstance(t, dt.StringType):
+        return pa.string()
+    if isinstance(t, dt.DateType):
+        return pa.date32()
+    if isinstance(t, dt.TimestampType):
+        return pa.timestamp("us", tz="UTC")
+    if isinstance(t, dt.DecimalType):
+        return pa.decimal128(t.precision, t.scale)
+    raise TypeError(f"unsupported dtype {t}")
+
+
+def arrow_schema_to_schema(schema: pa.Schema) -> List:
+    return [(f.name, arrow_type_to_dtype(f.type)) for f in schema]
+
+
+def _chunked_to_column(arr: pa.ChunkedArray) -> HostColumn:
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    t = arr.type
+    out_t = arrow_type_to_dtype(t)
+    n = len(arr)
+    mask = np.asarray(arr.is_valid())
+    if out_t == dt.STRING:
+        vals = np.array([v if v is not None else ""
+                         for v in arr.to_pylist()], dtype=object)
+        return HostColumn(vals, mask, out_t)
+    if isinstance(out_t, dt.DecimalType):
+        # unscaled int64 lanes
+        ints = pa.compute.cast(arr, pa.decimal128(38, out_t.scale))
+        vals = np.array([0 if v is None else int(v.scaleb(out_t.scale)
+                                                 .to_integral_value())
+                         for v in ints.to_pylist()], dtype=np.int64)
+        return HostColumn(vals, mask, out_t)
+    if out_t == dt.DATE:
+        vals = np.asarray(pa.compute.cast(arr, pa.int32())
+                          .fill_null(0)).astype(np.int32)
+        return HostColumn(vals, mask, out_t)
+    if out_t == dt.TIMESTAMP:
+        cast = pa.compute.cast(arr, pa.timestamp("us"))
+        vals = np.asarray(pa.compute.cast(cast, pa.int64())
+                          .fill_null(0)).astype(np.int64)
+        return HostColumn(vals, mask, out_t)
+    phys = np.dtype(out_t.physical)
+    vals = np.asarray(arr.fill_null(0)).astype(phys, copy=False)
+    return HostColumn(np.ascontiguousarray(vals), mask, out_t)
+
+
+def arrow_to_host_table(table: pa.Table) -> HostTable:
+    cols = [_chunked_to_column(table.column(i))
+            for i in range(table.num_columns)]
+    return HostTable(cols, list(table.column_names))
+
+
+def host_table_to_arrow(table: HostTable) -> pa.Table:
+    arrays = []
+    for c in table.columns:
+        at = dtype_to_arrow_type(c.dtype)
+        mask = ~c.mask
+        if c.dtype == dt.STRING:
+            vals = [None if not c.mask[i] else c.values[i]
+                    for i in range(len(c))]
+            arrays.append(pa.array(vals, type=at))
+        elif isinstance(c.dtype, dt.DecimalType):
+            import decimal
+            vals = [None if not c.mask[i] else
+                    decimal.Decimal(int(c.values[i])).scaleb(-c.dtype.scale)
+                    for i in range(len(c))]
+            arrays.append(pa.array(vals, type=at))
+        elif c.dtype == dt.DATE:
+            arrays.append(pa.Array.from_pandas(
+                c.values.astype(np.int32), mask=mask,
+                type=pa.int32()).cast(pa.date32()))
+        elif c.dtype == dt.TIMESTAMP:
+            arrays.append(pa.Array.from_pandas(
+                c.values.astype(np.int64), mask=mask,
+                type=pa.int64()).cast(pa.timestamp("us", tz="UTC")))
+        else:
+            arrays.append(pa.Array.from_pandas(c.values, mask=mask,
+                                               type=at))
+    return pa.table(arrays, names=table.names)

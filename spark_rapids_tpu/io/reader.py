@@ -1,0 +1,50 @@
+"""DataFrameReader: session.read.parquet/orc/csv/json.
+
+Frontend over FileScan (the role Spark's DataFrameReader + the
+reference's scan metas play). Schema is inferred from the first file
+unless given explicitly.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .scan import FileScan
+
+
+class DataFrameReader:
+    def __init__(self, session):
+        self.session = session
+        self._options: dict = {}
+
+    def option(self, key: str, value) -> "DataFrameReader":
+        self._options[key] = value
+        return self
+
+    def options(self, **kw) -> "DataFrameReader":
+        self._options.update(kw)
+        return self
+
+    def _scan(self, paths, fmt: str, schema: Optional[List] = None):
+        from ..plan.session import DataFrame
+        return DataFrame(self.session,
+                         FileScan(paths, fmt, schema, dict(self._options)))
+
+    def parquet(self, *paths, schema: Optional[List] = None):
+        return self._scan(list(paths) if len(paths) > 1 else paths[0],
+                          "parquet", schema)
+
+    def orc(self, *paths, schema: Optional[List] = None):
+        return self._scan(list(paths) if len(paths) > 1 else paths[0],
+                          "orc", schema)
+
+    def csv(self, *paths, header: bool = True, sep: str = ",",
+            schema: Optional[List] = None):
+        self._options.setdefault("header", header)
+        self._options.setdefault("sep", sep)
+        return self._scan(list(paths) if len(paths) > 1 else paths[0],
+                          "csv", schema)
+
+    def json(self, *paths, schema: Optional[List] = None):
+        return self._scan(list(paths) if len(paths) > 1 else paths[0],
+                          "json", schema)

@@ -25,7 +25,7 @@ from . import cpu_eval
 from .host_table import (HostColumn, HostTable, concat_tables, empty_like,
                          from_pydict)
 from .logical import (Aggregate, Expand, Filter, Join, Limit, LocalRelation,
-                      LogicalPlan, Project, Range, Sort, Union)
+                      LogicalPlan, Project, Range, Sort, Union, Window)
 
 
 def execute_cpu(plan: LogicalPlan) -> HostTable:
@@ -40,6 +40,14 @@ def apply_cpu_node(plan: LogicalPlan,
     (transitions.py wraps TPU subtrees so they appear as child tables)."""
     if isinstance(plan, LocalRelation):
         return from_pydict(plan.data, plan.schema)
+    from ..io.scan import FileScan
+    if isinstance(plan, FileScan):
+        from ..io.scan import read_file_to_tables
+        tables = []
+        for p in plan.paths:
+            tables.extend(read_file_to_tables(
+                p, plan.fmt, plan.schema, plan.options, None, 1 << 30))
+        return concat_tables(tables) if tables else empty_like(plan.schema)
     if isinstance(plan, Range):
         n = max(0, -(-(plan.end - plan.start) // plan.step))
         vals = plan.start + np.arange(n, dtype=np.int64) * plan.step
@@ -73,6 +81,8 @@ def apply_cpu_node(plan: LogicalPlan,
         return _aggregate_table(children[0], plan)
     if isinstance(plan, Join):
         return _join_tables(children[0], children[1], plan)
+    if isinstance(plan, Window):
+        return _window_table(children[0], plan)
     raise NotImplementedError(f"CPU executor: {type(plan).__name__}")
 
 
@@ -140,8 +150,10 @@ def _sort_table(table: HostTable, order) -> HostTable:
     for o in order:
         col = cpu_eval.evaluate(o.expr, table)
         null_rank, key = _sort_keys(col, o.ascending, o.nulls_first)
-        keys.append(key)
+        # null placement dominates the value key (nulls sort before/after
+        # ALL values, including negatives)
         keys.append(null_rank)
+        keys.append(key)
     # lexsort: last key is primary
     idx = np.lexsort(tuple(reversed(keys)))
     return table.take(idx)
@@ -284,6 +296,126 @@ def _aggregate_table(table: HostTable, plan: Aggregate) -> HostTable:
             arr = np.array([v if ok else 0 for v, ok in zip(vals, valids)],
                            dtype=np.dtype(out_t.physical))
         out_cols.append(HostColumn(arr, np.asarray(valids, bool), out_t))
+    return HostTable(out_cols, names)
+
+
+# ---------------------------------------------------------------------------
+# window (oracle: explicit per-partition python loops)
+# ---------------------------------------------------------------------------
+
+def _window_table(table: HostTable, plan: Window) -> HostTable:
+    from ..expr.window import (Lag, Lead, DenseRank, NTile, PercentRank,
+                               Rank, RowNumber)
+    n = table.num_rows
+    spec = plan.window_exprs[0][0].spec
+    part_cols = [cpu_eval.evaluate(e, table) for e in spec.partition_by]
+    # partition grouping
+    gid, _reps = _group_ids(part_cols, n)
+    # order within partition: global stable sort by order keys, then
+    # walk rows partition by partition in that order
+    if spec.order_fields:
+        keys = []
+        for o in spec.order_fields:
+            c = cpu_eval.evaluate(o.expr, table)
+            null_rank, key = _sort_keys(c, o.ascending, o.nulls_first)
+            keys.extend([null_rank, key])
+        order_perm = np.lexsort(tuple(reversed(keys)))
+    else:
+        order_perm = np.arange(n)
+    part_rows: Dict[int, List[int]] = {}
+    for i in order_perm:
+        part_rows.setdefault(int(gid[i]), []).append(int(i))
+
+    order_key_cols = [cpu_eval.evaluate(o.expr, table)
+                      for o in spec.order_fields]
+
+    def order_tuple(i):
+        return tuple(
+            (None if not c.mask[i] else
+             (c.values[i] if c.dtype == dt.STRING else c.values[i].item()))
+            for c in order_key_cols)
+
+    out_cols = list(table.columns)
+    names = [nm for nm, _ in plan.schema]
+    schema_in = table.schema()
+    for we, _name in plan.window_exprs:
+        fn = we.func
+        out_t = we.data_type(schema_in)
+        if out_t == dt.STRING:
+            vals = np.full(n, "", dtype=object)
+        else:
+            vals = np.zeros(n, np.dtype(out_t.physical))
+        mask = np.zeros(n, bool)
+        if fn.children:
+            in_col = cpu_eval.evaluate(fn.children[0], table)
+        else:
+            in_col = None
+        for rows in part_rows.values():
+            cnt = len(rows)
+            for pos, i in enumerate(rows):
+                if isinstance(fn, RowNumber):
+                    vals[i], mask[i] = pos + 1, True
+                elif isinstance(fn, (Rank, DenseRank, PercentRank)):
+                    r = d = 1
+                    for p in range(1, pos + 1):
+                        if order_tuple(rows[p]) != order_tuple(rows[p - 1]):
+                            r = p + 1
+                            d += 1
+                    if isinstance(fn, Rank):
+                        vals[i], mask[i] = r, True
+                    elif isinstance(fn, DenseRank):
+                        vals[i], mask[i] = d, True
+                    else:
+                        vals[i] = (r - 1) / (cnt - 1) if cnt > 1 else 0.0
+                        mask[i] = True
+                elif isinstance(fn, NTile):
+                    q, rr = divmod(cnt, fn.n)
+                    big = rr * (q + 1)
+                    if pos < big:
+                        b = pos // (q + 1)
+                    elif q > 0:
+                        b = rr + (pos - big) // q
+                    else:
+                        b = pos - big + rr
+                    vals[i], mask[i] = b + 1, True
+                elif isinstance(fn, Lead):  # Lag subclasses Lead
+                    k = -fn.offset if isinstance(fn, Lag) else fn.offset
+                    t = pos + k
+                    if 0 <= t < cnt:
+                        j = rows[t]
+                        vals[i], mask[i] = in_col.values[j], in_col.mask[j]
+                    elif fn.default is not None:
+                        from ..columnar.vector import _to_physical
+                        vals[i] = fn.default if out_t == dt.STRING else \
+                            _to_physical(fn.default, out_t)
+                        mask[i] = True
+                else:
+                    # aggregate over the frame
+                    frame = we.spec.frame
+                    if frame.is_unbounded:
+                        lo, hi = 0, cnt - 1
+                    elif frame.is_running:
+                        lo, hi = 0, pos
+                        if not frame.row_based:
+                            # RANGE: include all peers of the current key
+                            while hi + 1 < cnt and order_tuple(
+                                    rows[hi + 1]) == order_tuple(rows[pos]):
+                                hi += 1
+                    else:
+                        lo = 0 if frame.lo is None else max(pos + frame.lo, 0)
+                        hi = cnt - 1 if frame.hi is None else \
+                            min(pos + frame.hi, cnt - 1)
+                    frame_rows = np.asarray(rows[lo:hi + 1], np.int64) \
+                        if hi >= lo else np.zeros(0, np.int64)
+                    v, ok = _agg_cpu(
+                        fn,
+                        in_col.values if in_col is not None else None,
+                        in_col.mask if in_col is not None else None,
+                        frame_rows,
+                        in_col.dtype if in_col is not None else None, out_t)
+                    vals[i], mask[i] = (v if ok else
+                                        ("" if out_t == dt.STRING else 0)), ok
+        out_cols.append(HostColumn(vals, mask, out_t))
     return HostTable(out_cols, names)
 
 

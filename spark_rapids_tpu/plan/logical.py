@@ -266,6 +266,36 @@ class Expand(LogicalPlan):
         return [e for p in self.projections for e in p]
 
 
+class Window(LogicalPlan):
+    """Append window-function columns; all entries share one
+    (partition_by, order_by) spec (the planner splits differing specs
+    into a chain of Window nodes, like Spark's Window exec)."""
+
+    def __init__(self, child: LogicalPlan, window_exprs):
+        super().__init__(child)
+        self.window_exprs = list(window_exprs)  # [(WindowExpression, name)]
+        in_schema = child.schema
+        self._schema = list(in_schema) + [
+            (name, we.data_type(in_schema)) for we, name in self.window_exprs]
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def expressions(self) -> List[Expression]:
+        out = []
+        for we, _ in self.window_exprs:
+            out.extend(we.func.children)
+            out.extend(we.spec.partition_by)
+            out.extend(o.expr for o in we.spec.order_fields)
+        return out
+
+    def node_description(self) -> str:
+        fns = ", ".join(f"{type(we.func).__name__}->{n}"
+                        for we, n in self.window_exprs)
+        return f"Window[{fns}]"
+
+
 class Range(LogicalPlan):
     def __init__(self, start: int, end: int, step: int = 1):
         super().__init__()

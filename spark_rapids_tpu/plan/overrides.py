@@ -37,7 +37,7 @@ from ..expr import predicates as P
 from ..expr import strings as S
 from . import cpu_eval, typechecks as ts
 from .logical import (Aggregate, Expand, Filter, Join, Limit, LocalRelation,
-                      LogicalPlan, Project, Range, Sort, Union)
+                      LogicalPlan, Project, Range, Sort, Union, Window)
 from .meta import ExprMeta, PlanMeta
 from .transitions import (CpuPhysical, DeviceToHostBridge, HostToDeviceExec)
 
@@ -215,23 +215,78 @@ def _tag_agg(meta: PlanMeta):
             pass
 
 
-_EXEC_RULES.update({
-    LocalRelation: ExecRule(LocalRelation),
-    Range: ExecRule(Range),
-    Project: ExecRule(Project),
-    Filter: ExecRule(Filter),
-    Limit: ExecRule(Limit),
-    Union: ExecRule(Union),
-    Expand: ExecRule(Expand),
-    Sort: ExecRule(Sort),
-    Aggregate: ExecRule(Aggregate, _tag_agg),
-    Join: ExecRule(Join, _tag_join),
-})
+def _tag_file_scan(meta: PlanMeta):
+    from ..io.scan import FileScan
+    plan: FileScan = meta.plan
+    for name, t in plan.schema:
+        reason = ts.all_basic.reason_if_unsupported(t, f"scan column {name}")
+        if reason:
+            meta.will_not_work_on_tpu(reason)
+
+
+def _tag_window(meta: PlanMeta):
+    from ..expr.window import (Lag, Lead, DenseRank, NTile, PercentRank,
+                               Rank, RowNumber)
+    plan: Window = meta.plan
+    in_schema = plan.children[0].schema
+    supported_rank = (RowNumber, Rank, DenseRank, PercentRank, NTile,
+                      Lead, Lag)
+    for we, name in plan.window_exprs:
+        fn = we.func
+        if isinstance(fn, supported_rank):
+            continue
+        if isinstance(fn, (Agg.Sum, Agg.Count, Agg.CountStar, Agg.Average)):
+            pass
+        elif isinstance(fn, (Agg.Min, Agg.Max)):
+            if fn.children and fn.children[0].data_type(in_schema) == \
+                    dt.STRING:
+                meta.will_not_work_on_tpu(
+                    f"window {name}: string min/max not on TPU yet")
+                continue
+        else:
+            meta.will_not_work_on_tpu(
+                f"window function {type(fn).__name__} not on TPU yet")
+            continue
+        frame = we.spec.frame
+        if frame is not None and not frame.row_based and not (
+                frame.is_running or frame.is_unbounded):
+            meta.will_not_work_on_tpu(
+                f"window {name}: general RANGE frames not on TPU yet")
+        if frame is not None and isinstance(fn, (Agg.Min, Agg.Max)) and \
+                not (frame.is_running or frame.is_unbounded) and \
+                (frame.lo is None or frame.hi is None):
+            meta.will_not_work_on_tpu(
+                f"window {name}: min/max sliding frames need bounded "
+                "ROWS offsets")
+
+
+def _register_exec_rules():
+    from ..io.scan import FileScan
+    _EXEC_RULES.update({
+        LocalRelation: ExecRule(LocalRelation),
+        Range: ExecRule(Range),
+        Project: ExecRule(Project),
+        Filter: ExecRule(Filter),
+        Limit: ExecRule(Limit),
+        Union: ExecRule(Union),
+        Expand: ExecRule(Expand),
+        Sort: ExecRule(Sort),
+        Aggregate: ExecRule(Aggregate, _tag_agg),
+        Join: ExecRule(Join, _tag_join),
+        Window: ExecRule(Window, _tag_window),
+        FileScan: ExecRule(FileScan, _tag_file_scan),
+    })
+
+
+_register_exec_rules()
 
 
 # --- conversion ------------------------------------------------------------
 
 def _build_tpu_exec(plan: LogicalPlan, children: List[TpuExec]) -> TpuExec:
+    from ..io.scan import FileScan, FileSourceScanExec
+    if isinstance(plan, FileScan):
+        return FileSourceScanExec(plan)
     if isinstance(plan, (LocalRelation, Range)) :
         # host-resident leaves enter the device through the transition
         return HostToDeviceExec(CpuPhysical(plan, []))
@@ -253,6 +308,9 @@ def _build_tpu_exec(plan: LogicalPlan, children: List[TpuExec]) -> TpuExec:
     if isinstance(plan, Aggregate):
         return HashAggregateExec(children[0], plan.group_exprs,
                                  plan.agg_exprs)
+    if isinstance(plan, Window):
+        from ..exec.window import WindowExec
+        return WindowExec(children[0], plan.window_exprs)
     if isinstance(plan, Join):
         build = "left" if plan.join_type == "right_outer" else "right"
         return ShuffledHashJoinExec(children[0], children[1],
@@ -287,6 +345,18 @@ def _to_physical(meta: PlanMeta, conf: SrtConf):
     return CpuPhysical(meta.plan, host)
 
 
+def push_down_filters(plan: LogicalPlan) -> None:
+    """Filter-over-scan pushdown (ParquetFilters role): the scan prunes
+    row groups/files with the translatable conjuncts; the Filter node
+    stays, so device-side semantics are unchanged."""
+    from ..io.scan import FileScan
+    for i, c in enumerate(plan.children):
+        push_down_filters(c)
+        if isinstance(plan, Filter) and isinstance(c, FileScan) \
+                and c.pushed_filter is None:
+            plan.children[i] = c.with_pushed_filter(plan.condition)
+
+
 def apply_overrides(plan: LogicalPlan, conf: Optional[SrtConf] = None):
     """wrap -> tag -> convert (GpuOverrides.applyWithContext equivalent).
 
@@ -294,6 +364,7 @@ def apply_overrides(plan: LogicalPlan, conf: Optional[SrtConf] = None):
     CpuPhysical/DeviceToHostBridge (host result).
     """
     conf = conf or active_conf()
+    push_down_filters(plan)
     meta = PlanMeta(plan)
     meta.tag_for_tpu()
     mode = conf.get(EXPLAIN)

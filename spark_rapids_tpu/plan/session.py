@@ -100,6 +100,33 @@ def _to_expr(c) -> Expression:
     return lit(c)
 
 
+def _extract_windows(plan: L.LogicalPlan, exprs):
+    """Pull WindowExpressions out of a projection list into Window nodes
+    (the analyzer step Spark performs for window functions in select):
+    one Window node per distinct (partition_by, order_by) spec, chained;
+    the projection then references the produced columns by name."""
+    from ..expr.window import WindowExpression
+    groups = {}  # spec signature -> [(WindowExpression, gen_name)]
+    out_exprs = []
+    for i, e in enumerate(exprs):
+        inner = e.children[0] if isinstance(e, Alias) else e
+        if isinstance(inner, WindowExpression):
+            # always a fresh internal name: a user alias may collide with
+            # an input column, and name lookup resolves first-match
+            gen = f"__w{i}"
+            user = e.name if isinstance(e, Alias) else f"_w{i}"
+            sig = (repr(inner.spec.partition_by),
+                   repr([ (repr(o.expr), o.ascending, o.nulls_first)
+                          for o in inner.spec.order_fields]))
+            groups.setdefault(sig, []).append((inner, gen))
+            out_exprs.append(Alias(col(gen), user))
+        else:
+            out_exprs.append(e)
+    for _, wexprs in groups.items():
+        plan = L.Window(plan, wexprs)
+    return plan, out_exprs
+
+
 class DataFrame:
     """Lazy logical-plan builder (Spark DataFrame analogue)."""
 
@@ -109,13 +136,15 @@ class DataFrame:
 
     # --- transformations ---
     def select(self, *cols) -> "DataFrame":
-        return DataFrame(self.session,
-                         L.Project(self.plan, [_to_expr(c) for c in cols]))
+        exprs = [_to_expr(c) for c in cols]
+        plan, exprs = _extract_windows(self.plan, exprs)
+        return DataFrame(self.session, L.Project(plan, exprs))
 
     def with_column(self, name: str, expr) -> "DataFrame":
         existing = [col(n) for n, _ in self.plan.schema if n != name]
-        return DataFrame(self.session, L.Project(
-            self.plan, existing + [Alias(_to_expr(expr), name)]))
+        exprs = existing + [Alias(_to_expr(expr), name)]
+        plan, exprs = _extract_windows(self.plan, exprs)
+        return DataFrame(self.session, L.Project(plan, exprs))
 
     def filter(self, condition) -> "DataFrame":
         return DataFrame(self.session,
@@ -217,6 +246,11 @@ class DataFrame:
 
     def count(self) -> int:
         return self.session.execute(self.plan).num_rows
+
+    @property
+    def write(self):
+        from ..io.writer import DataFrameWriter
+        return DataFrameWriter(self)
 
     def explain(self, mode: str = "ALL") -> str:
         meta = overrides.tag_only(self.plan)

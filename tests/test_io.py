@@ -1,0 +1,183 @@
+"""I/O layer tests: scans (all reader modes), predicate pushdown,
+writers, partitioned writes, round trips (SURVEY §2.6 equivalents)."""
+
+import datetime
+import decimal
+import os
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.conf import READER_TYPE, SrtConf
+from spark_rapids_tpu.expr.aggregates import CountStar, Sum
+from spark_rapids_tpu.expr.core import col
+from spark_rapids_tpu.plan import TpuSession
+from spark_rapids_tpu.testing import (DateGen, DoubleGen, IntGen, StringGen,
+                                      TimestampGen, assert_tpu_cpu_equal_df,
+                                      gen_table)
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession()
+
+
+@pytest.fixture(scope="module")
+def pq_dir(tmp_path_factory, session):
+    """Three parquet files with the same schema."""
+    d = tmp_path_factory.mktemp("pq")
+    gens = {"k": IntGen(lo=0, hi=9), "v": DoubleGen(no_special=True),
+            "s": StringGen(max_len=6), "d": DateGen()}
+    for i in range(3):
+        data, schema = gen_table(gens, n=100, seed=i)
+        df = session.create_dataframe(data, schema)
+        df.write.mode("append").parquet(str(d))
+    return str(d)
+
+
+def test_parquet_roundtrip(session, tmp_path):
+    data, schema = gen_table(
+        {"i": IntGen(), "f": DoubleGen(), "s": StringGen(),
+         "d": DateGen(), "t": TimestampGen()}, n=64)
+    df = session.create_dataframe(data, schema)
+    path = str(tmp_path / "rt")
+    df.write.parquet(path)
+    back = session.read.parquet(path)
+    assert [t for _, t in back.schema] == [t for _, t in schema]
+    orig = df.collect()
+    got = back.collect()
+    key = lambda r: str(sorted((k, str(v)) for k, v in r.items()))
+    assert sorted(got, key=key) == sorted(orig, key=key)
+
+
+def test_orc_roundtrip(session, tmp_path):
+    data, schema = gen_table({"i": IntGen(), "s": StringGen()}, n=32)
+    df = session.create_dataframe(data, schema)
+    path = str(tmp_path / "orc")
+    df.write.orc(path)
+    back = session.read.orc(path).collect()
+    assert len(back) == 32
+
+
+def test_csv_roundtrip(session, tmp_path):
+    df = session.create_dataframe(
+        {"a": [1, 2, 3], "b": ["x", "y", "z"]})
+    path = str(tmp_path / "csv")
+    df.write.csv(path)
+    back = session.read.csv(path).collect()
+    assert sorted(r["a"] for r in back) == [1, 2, 3]
+
+
+def test_json_roundtrip(session, tmp_path):
+    df = session.create_dataframe({"a": [1, None, 3], "s": ["p", "q", None]})
+    path = str(tmp_path / "json")
+    df.write.json(path)
+    back = session.read.json(path).collect()
+    assert len(back) == 3
+    assert any(r["a"] is None for r in back)
+
+
+@pytest.mark.parametrize("reader", ["PERFILE", "COALESCING",
+                                    "MULTITHREADED"])
+def test_reader_modes(session, pq_dir, reader):
+    conf = SrtConf({READER_TYPE.key: reader})
+    s = TpuSession(conf)
+    df = s.read.parquet(pq_dir)
+    assert df.count() == 300
+    agg = df.group_by("k").agg(CountStar().alias("n")).collect()
+    assert sum(r["n"] for r in agg) == 300
+
+
+def test_scan_filter_aggregate_differential(session, pq_dir):
+    df = (session.read.parquet(pq_dir)
+          .filter((col("k") >= 3) & col("v").is_not_null())
+          .group_by("k").agg(Sum(col("v")).alias("sv"),
+                             CountStar().alias("n")))
+    assert_tpu_cpu_equal_df(df)
+
+
+def test_predicate_pushdown_prunes(session, tmp_path):
+    """Row-group pruning: a filter on a sorted column must reduce rows
+    decoded (observable via the scan's arrow filter)."""
+    from spark_rapids_tpu.io.scan import FileScan, to_arrow_filter
+    d = tmp_path / "pp"
+    df = session.create_dataframe({"x": list(range(1000))})
+    df.write.parquet(str(d))
+    scan = FileScan(str(d), "parquet")
+    pushed = scan.with_pushed_filter(col("x") < 10)
+    assert pushed.pushed_filter is not None
+    assert to_arrow_filter(pushed.pushed_filter) is not None
+    # full pipeline: filter over scan gets pushed and stays correct
+    q = session.read.parquet(str(d)).filter(col("x") < 10)
+    assert q.count() == 10
+
+
+def test_pushdown_untranslatable_is_safe(session, tmp_path):
+    from spark_rapids_tpu.io.scan import to_arrow_filter
+    from spark_rapids_tpu.expr import mathfns as M
+    # sqrt(x) < 3 is not translatable -> no pushdown, still correct
+    assert to_arrow_filter(M.Sqrt(col("x")) < 3.0) is None
+    d = tmp_path / "pu"
+    session.create_dataframe({"x": [1.0, 4.0, 9.0, 16.0]}).write.parquet(
+        str(d))
+    out = session.read.parquet(str(d)).filter(
+        M.Sqrt(col("x")) < 3.0).collect()
+    assert sorted(r["x"] for r in out) == [1.0, 4.0]
+
+
+def test_partitioned_write(session, tmp_path):
+    d = str(tmp_path / "part")
+    df = session.create_dataframe(
+        {"k": ["a", "b", "a", None], "v": [1, 2, 3, 4]})
+    stats = df.write.partition_by("k").parquet(d)
+    assert stats.num_files == 3
+    assert stats.num_rows == 4
+    assert os.path.isdir(os.path.join(d, "k=a"))
+    assert os.path.isdir(os.path.join(d, "k=__HIVE_DEFAULT_PARTITION__"))
+    # partition column is recoverable from dir structure; data cols intact
+    back = session.read.parquet(os.path.join(d, "k=a")).collect()
+    assert sorted(r["v"] for r in back) == [1, 3]
+
+
+def test_write_modes(session, tmp_path):
+    d = str(tmp_path / "modes")
+    df = session.create_dataframe({"v": [1]})
+    df.write.parquet(d)
+    with pytest.raises(FileExistsError):
+        df.write.parquet(d)
+    df.write.mode("append").parquet(d)
+    assert session.read.parquet(d).count() == 2
+    df.write.mode("overwrite").parquet(d)
+    assert session.read.parquet(d).count() == 1
+
+
+def test_decimal_parquet_roundtrip(session, tmp_path):
+    vals = [decimal.Decimal("12.34"), decimal.Decimal("-0.01"), None]
+    df = session.create_dataframe({"d": vals},
+                                  [("d", dt.DecimalType(10, 2))])
+    path = str(tmp_path / "dec")
+    df.write.parquet(path)
+    back = session.read.parquet(path).collect()
+    assert [r["d"] for r in back] == vals
+
+
+def test_headerless_csv_with_schema(session, tmp_path):
+    p = tmp_path / "h.csv"
+    p.write_text("1,x\n2,y\n")
+    df = session.read.csv(str(p), header=False,
+                          schema=[("a", dt.INT64), ("b", dt.STRING)])
+    out = df.collect()
+    assert out == [{"a": 1, "b": "x"}, {"a": 2, "b": "y"}]
+
+
+def test_user_schema_casts_parquet(session, tmp_path):
+    d = str(tmp_path / "cast")
+    session.create_dataframe({"a": [1, 2]},
+                             [("a", dt.INT32)]).write.parquet(d)
+    back = session.read.parquet(d, schema=[("a", dt.INT64)])
+    assert back.schema == [("a", dt.INT64)]
+    rows = back.collect()
+    assert sorted(r["a"] for r in rows) == [1, 2]
+    # and the physical lanes really are int64 (sum works on device)
+    assert back.agg(Sum(col("a")).alias("s")).collect()[0]["s"] == 3

@@ -1,0 +1,184 @@
+"""Window exec tests: ranking, lead/lag, running/unbounded/sliding
+aggregate frames — all differential against the CPU oracle
+(GpuWindowExec / GpuWindowExpression equivalents, SURVEY §2.4)."""
+
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.expr.aggregates import Average, Count, CountStar, Max, Min, Sum
+from spark_rapids_tpu.expr.core import col, lit
+from spark_rapids_tpu.expr.window import (DenseRank, Lag, Lead, NTile,
+                                          PercentRank, Rank, RowNumber,
+                                          Window, WindowFrame)
+from spark_rapids_tpu.plan import TpuSession
+from spark_rapids_tpu.testing import (DoubleGen, IntGen, StringGen,
+                                      assert_tpu_cpu_equal_df, gen_table)
+
+N = 96
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession()
+
+
+def make_df(session, seed=0, n=N):
+    data, schema = gen_table(
+        {"k": IntGen(lo=0, hi=4), "o": IntGen(lo=0, hi=1000),
+         "v": IntGen(lo=-100, hi=100),
+         "f": DoubleGen(no_special=True)}, n, seed)
+    return session.create_dataframe(data, schema)
+
+
+def spec(session):
+    return Window.partition_by("k").order_by("o")
+
+
+def test_row_number(session):
+    df = make_df(session)
+    w = Window.partition_by("k").order_by("o", "v")
+    assert_tpu_cpu_equal_df(df.select(
+        "k", "o", "v", RowNumber().over(w).alias("rn")))
+
+
+def test_rank_dense_rank(session):
+    # low-cardinality order key -> plenty of rank ties
+    df = make_df(session)
+    w = Window.partition_by("k").order_by((col("o") % 5).alias("om"))
+    assert_tpu_cpu_equal_df(df.select(
+        "k", "o",
+        Rank().over(w).alias("r"),
+        DenseRank().over(w).alias("dr"),
+        PercentRank().over(w).alias("pr")))
+
+
+def test_ntile(session):
+    df = make_df(session)
+    w = Window.partition_by("k").order_by("o", "v")
+    assert_tpu_cpu_equal_df(df.select(
+        "k", NTile(3).over(w).alias("n3"),
+        NTile(7).over(w).alias("n7")))
+
+
+def test_lead_lag(session):
+    df = make_df(session)
+    w = Window.partition_by("k").order_by("o", "v")
+    assert_tpu_cpu_equal_df(df.select(
+        "k", "o", "v",
+        Lead(col("v")).over(w).alias("ld1"),
+        Lag(col("v"), 2).over(w).alias("lg2"),
+        Lead(col("v"), 1, default=-999).over(w).alias("ldd")))
+
+
+def test_running_aggregates(session):
+    df = make_df(session)
+    w = Window.partition_by("k").order_by("o", "v")
+    assert_tpu_cpu_equal_df(df.select(
+        "k", "o",
+        Sum(col("v")).over(w).alias("rsum"),
+        Count(col("v")).over(w).alias("rcnt"),
+        CountStar().over(w).alias("rn"),
+        Min(col("v")).over(w).alias("rmin"),
+        Max(col("v")).over(w).alias("rmax"),
+        Average(col("f")).over(w).alias("ravg")))
+
+
+def test_whole_partition_aggregates(session):
+    df = make_df(session)
+    w = Window.partition_by("k")  # no order -> whole partition
+    assert_tpu_cpu_equal_df(df.select(
+        "k", "v",
+        Sum(col("v")).over(w).alias("psum"),
+        Average(col("f")).over(w).alias("pavg"),
+        CountStar().over(w).alias("pn")))
+
+
+def test_sliding_frames(session):
+    df = make_df(session)
+    base = Window.partition_by("k").order_by("o", "v")
+    w_sum = base.with_frame(WindowFrame(-2, 2))
+    w_min = base.with_frame(WindowFrame(-1, 1))
+    assert_tpu_cpu_equal_df(df.select(
+        "k", "o",
+        Sum(col("v")).over(w_sum).alias("ssum"),
+        Count(col("v")).over(w_sum).alias("scnt"),
+        Min(col("v")).over(w_min).alias("smin"),
+        Max(col("v")).over(w_min).alias("smax")))
+
+
+def test_trailing_frame(session):
+    df = make_df(session)
+    w = Window.partition_by("k").order_by("o", "v") \
+        .with_frame(WindowFrame(-3, 0))
+    assert_tpu_cpu_equal_df(df.select(
+        "k", Sum(col("v")).over(w).alias("tsum")))
+
+
+def test_no_partition_window(session):
+    df = make_df(session, n=48)
+    w = Window.partition_by().order_by("o", "v")
+    assert_tpu_cpu_equal_df(df.select(
+        "o", "v", RowNumber().over(w).alias("rn"),
+        Sum(col("v")).over(w).alias("rs")))
+
+
+def test_multiple_specs_chain(session):
+    """Different (partition, order) specs split into chained Window
+    nodes."""
+    df = make_df(session)
+    w1 = Window.partition_by("k").order_by("o", "v")
+    w2 = Window.partition_by().order_by("v", "o")
+    q = df.select("k", "o",
+                  RowNumber().over(w1).alias("rn_k"),
+                  Rank().over(w2).alias("r_all"))
+    from spark_rapids_tpu.plan.logical import Window as LWindow
+    # plan contains two Window nodes
+    def count_windows(p):
+        return (1 if isinstance(p, LWindow) else 0) + \
+            sum(count_windows(c) for c in p.children)
+    assert count_windows(q.plan) == 2
+    assert_tpu_cpu_equal_df(q)
+
+
+def test_window_over_strings_falls_back(session):
+    from spark_rapids_tpu.testing import assert_falls_back_to_cpu
+    data, schema = gen_table(
+        {"k": IntGen(lo=0, hi=3), "s": StringGen(max_len=4)}, 48, 3)
+    df = session.create_dataframe(data, schema)
+    w = Window.partition_by("k").order_by("s")
+    q = df.select("k", Min(col("s")).over(w).alias("ms"))
+    assert_falls_back_to_cpu(q, "string min/max")
+
+
+def test_windows_on_tpu_no_fallback(session):
+    from spark_rapids_tpu.testing import assert_runs_on_tpu
+    df = make_df(session, n=32)
+    w = Window.partition_by("k").order_by("o", "v")
+    assert_runs_on_tpu(df.select("k", RowNumber().over(w).alias("rn"),
+                                 Sum(col("v")).over(w).alias("rs")))
+
+
+def test_window_column_replaces_existing(session):
+    """with_column overwriting an input column with a window result must
+    yield the WINDOW values, not the original column."""
+    df = session.create_dataframe({"k": [1, 1, 2], "x": [10, 20, 30]})
+    w = Window.partition_by("k")
+    out = df.with_column("x", Sum(col("x")).over(w)).collect()
+    vals = sorted((r["k"], r["x"]) for r in out)
+    assert vals == [(1, 30), (1, 30), (2, 30)]
+    assert_tpu_cpu_equal_df(df.with_column("x", Sum(col("x")).over(w)))
+
+
+def test_range_running_frame_peers(session):
+    """RANGE UNBOUNDED..CURRENT must give tied order keys the same
+    running value (peer semantics), unlike ROWS."""
+    df = session.create_dataframe(
+        {"k": [1] * 6, "o": [1, 1, 2, 2, 2, 3], "v": [1, 2, 3, 4, 5, 6]})
+    rng_frame = WindowFrame(None, 0, row_based=False)
+    w = Window.partition_by("k").order_by("o").with_frame(rng_frame)
+    out = df.select("o", "v", Sum(col("v")).over(w).alias("rs")).collect()
+    by_v = {r["v"]: r["rs"] for r in out}
+    # peers share the run-total: o=1 -> 3, o=2 -> 3+12=15, o=3 -> 21
+    assert by_v == {1: 3, 2: 3, 3: 15, 4: 15, 5: 15, 6: 21}
+    assert_tpu_cpu_equal_df(
+        df.select("o", "v", Sum(col("v")).over(w).alias("rs")))
