@@ -1,0 +1,327 @@
+// tpu-table native host runtime.
+//
+// The C++ seam of SURVEY §2.9's build directive: where the reference's
+// host-side hot paths live in native code (spark-rapids-jni
+// RowConversion, nvcomp's LZ4 batch codec, RMM/pinned host pools), this
+// library provides the TPU framework's equivalents behind a plain C ABI
+// consumed via ctypes (no pybind11 in the image):
+//
+//   - slz4_*: LZ4-format block compression (shuffle/spill codec; the
+//     nvcomp LZ4 role). Independent implementation of the public LZ4
+//     block format.
+//   - rows_to_columns / columns_to_rows: fixed-width row-major <->
+//     columnar conversion with a leading per-row null bitset (the
+//     CudfUnsafeRow / RowConversion role at the row<->columnar
+//     transition boundary).
+//   - hostpool_*: aligned host slab allocator with first-fit freelist
+//     and stats (HostAlloc.scala / PinnedMemoryPool role).
+//
+// Build: g++ -O3 -shared -fPIC (driven by spark_rapids_tpu/native).
+
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+#include <mutex>
+#include <map>
+#include <new>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// LZ4 block codec
+// ---------------------------------------------------------------------------
+//
+// Block format: sequences of
+//   token: high nibble = literal length (15 = extended), low nibble =
+//          match length - 4 (15 = extended)
+//   [literal length extension bytes] literals
+//   little-endian u16 match offset (1..65535)
+//   [match length extension bytes]
+// The final sequence has no match (literals run to the end).
+
+static inline uint32_t hash4(const uint8_t* p) {
+    uint32_t v;
+    std::memcpy(&v, p, 4);
+    return (v * 2654435761u) >> 20;  // 12-bit table
+}
+
+int64_t slz4_max_compressed_size(int64_t n) {
+    return n + n / 255 + 16;
+}
+
+// Returns compressed size, or -1 if dst too small.
+int64_t slz4_compress(const uint8_t* src, int64_t n, uint8_t* dst,
+                      int64_t dst_cap) {
+    const int64_t MINMATCH = 4;
+    uint8_t* op = dst;
+    uint8_t* const oend = dst + dst_cap;
+    int32_t table[4096];
+    for (int i = 0; i < 4096; i++) table[i] = -1;
+
+    int64_t anchor = 0;
+    int64_t i = 0;
+    // last 5 bytes are always literals (format requirement); need 4 for
+    // hashing too
+    const int64_t mflimit = n - 12;
+
+    auto emit = [&](int64_t lit_len, int64_t match_len,
+                    int64_t offset) -> bool {
+        // token
+        if (op >= oend) return false;
+        uint8_t* token = op++;
+        int64_t ll = lit_len;
+        int64_t ml = match_len >= MINMATCH ? match_len - MINMATCH : 0;
+        *token = (uint8_t)((ll >= 15 ? 15 : ll) << 4 |
+                           (match_len ? (ml >= 15 ? 15 : ml) : 0));
+        if (ll >= 15) {
+            int64_t rest = ll - 15;
+            while (rest >= 255) {
+                if (op >= oend) return false;
+                *op++ = 255;
+                rest -= 255;
+            }
+            if (op >= oend) return false;
+            *op++ = (uint8_t)rest;
+        }
+        if (op + lit_len > oend) return false;
+        std::memcpy(op, src + anchor, lit_len);
+        op += lit_len;
+        if (match_len) {
+            if (op + 2 > oend) return false;
+            *op++ = (uint8_t)(offset & 0xFF);
+            *op++ = (uint8_t)(offset >> 8);
+            if (ml >= 15) {
+                int64_t rest = ml - 15;
+                while (rest >= 255) {
+                    if (op >= oend) return false;
+                    *op++ = 255;
+                    rest -= 255;
+                }
+                if (op >= oend) return false;
+                *op++ = (uint8_t)rest;
+            }
+        }
+        return true;
+    };
+
+    if (n >= 13) {
+        i = 0;
+        while (i <= mflimit) {
+            uint32_t h = hash4(src + i);
+            int64_t cand = table[h];
+            table[h] = (int32_t)i;
+            if (cand >= 0 && i - cand <= 65535 &&
+                std::memcmp(src + cand, src + i, 4) == 0) {
+                // extend match
+                int64_t m = i + 4;
+                int64_t c = cand + 4;
+                while (m < n - 5 && src[m] == src[c]) { m++; c++; }
+                int64_t match_len = m - i;
+                if (!emit(i - anchor, match_len, i - cand)) return -1;
+                i = m;
+                anchor = i;
+                continue;
+            }
+            i++;
+        }
+    }
+    // trailing literals
+    if (!emit(n - anchor, 0, 0)) return -1;
+    return op - dst;
+}
+
+// Returns decompressed size, or -1 on malformed input / overflow.
+int64_t slz4_decompress(const uint8_t* src, int64_t n, uint8_t* dst,
+                        int64_t dst_cap) {
+    const uint8_t* ip = src;
+    const uint8_t* const iend = src + n;
+    uint8_t* op = dst;
+    uint8_t* const oend = dst + dst_cap;
+
+    while (ip < iend) {
+        uint8_t token = *ip++;
+        int64_t lit = token >> 4;
+        if (lit == 15) {
+            uint8_t b;
+            do {
+                if (ip >= iend) return -1;
+                b = *ip++;
+                lit += b;
+            } while (b == 255);
+        }
+        if (ip + lit > iend || op + lit > oend) return -1;
+        std::memcpy(op, ip, lit);
+        ip += lit;
+        op += lit;
+        if (ip >= iend) break;  // final sequence: literals only
+        if (ip + 2 > iend) return -1;
+        int64_t offset = ip[0] | (ip[1] << 8);
+        ip += 2;
+        if (offset == 0 || op - dst < offset) return -1;
+        int64_t ml = (token & 0xF) + 4;
+        if ((token & 0xF) == 15) {
+            uint8_t b;
+            do {
+                if (ip >= iend) return -1;
+                b = *ip++;
+                ml += b;
+            } while (b == 255);
+        }
+        if (op + ml > oend) return -1;
+        const uint8_t* match = op - offset;
+        for (int64_t k = 0; k < ml; k++) op[k] = match[k];  // may overlap
+        op += ml;
+    }
+    return op - dst;
+}
+
+// ---------------------------------------------------------------------------
+// row <-> column conversion (fixed-width lanes)
+// ---------------------------------------------------------------------------
+//
+// Row layout (CudfUnsafeRow-like): null bitset of ceil(n_cols/8) bytes
+// (bit c set = column c VALID), then each column's value at
+// field_offsets[c] with field_sizes[c] bytes. row_stride bytes per row.
+
+void columns_to_rows(const uint8_t* const* col_data,
+                     const uint8_t* const* col_valid,
+                     const int32_t* field_sizes,
+                     const int32_t* field_offsets,
+                     int32_t n_cols, int64_t n_rows,
+                     uint8_t* rows, int64_t row_stride) {
+    const int64_t null_bytes = (n_cols + 7) / 8;
+    for (int64_t r = 0; r < n_rows; r++) {
+        uint8_t* row = rows + r * row_stride;
+        std::memset(row, 0, null_bytes);
+        for (int32_t c = 0; c < n_cols; c++) {
+            if (col_valid[c][r]) {
+                row[c >> 3] |= (uint8_t)(1u << (c & 7));
+                std::memcpy(row + field_offsets[c],
+                            col_data[c] + (int64_t)field_sizes[c] * r,
+                            field_sizes[c]);
+            } else {
+                std::memset(row + field_offsets[c], 0, field_sizes[c]);
+            }
+        }
+    }
+}
+
+void rows_to_columns(const uint8_t* rows, int64_t row_stride,
+                     int64_t n_rows,
+                     const int32_t* field_sizes,
+                     const int32_t* field_offsets,
+                     int32_t n_cols,
+                     uint8_t* const* col_data,
+                     uint8_t* const* col_valid) {
+    for (int64_t r = 0; r < n_rows; r++) {
+        const uint8_t* row = rows + r * row_stride;
+        for (int32_t c = 0; c < n_cols; c++) {
+            bool valid = (row[c >> 3] >> (c & 7)) & 1;
+            col_valid[c][r] = valid ? 1 : 0;
+            if (valid) {
+                std::memcpy(col_data[c] + (int64_t)field_sizes[c] * r,
+                            row + field_offsets[c], field_sizes[c]);
+            } else {
+                std::memset(col_data[c] + (int64_t)field_sizes[c] * r, 0,
+                            field_sizes[c]);
+            }
+        }
+    }
+}
+
+// ---------------------------------------------------------------------------
+// host memory pool (first-fit freelist over one aligned slab)
+// ---------------------------------------------------------------------------
+
+struct HostPool {
+    uint8_t* base;
+    int64_t size;
+    std::map<int64_t, int64_t> free_blocks;  // offset -> length
+    std::map<int64_t, int64_t> used_blocks;  // offset -> length
+    int64_t in_use;
+    int64_t peak;
+    int64_t alloc_count;
+    int64_t fail_count;
+    std::mutex mu;
+};
+
+void* hostpool_create(int64_t size) {
+    void* mem = nullptr;
+    if (posix_memalign(&mem, 4096, (size_t)size) != 0) return nullptr;
+    HostPool* p = new (std::nothrow) HostPool();
+    if (!p) { free(mem); return nullptr; }
+    p->base = (uint8_t*)mem;
+    p->size = size;
+    p->free_blocks[0] = size;
+    p->in_use = p->peak = p->alloc_count = p->fail_count = 0;
+    return p;
+}
+
+void hostpool_destroy(void* pool) {
+    HostPool* p = (HostPool*)pool;
+    free(p->base);
+    delete p;
+}
+
+static const int64_t ALIGN = 256;  // device-DMA friendly
+
+void* hostpool_alloc(void* pool, int64_t size) {
+    HostPool* p = (HostPool*)pool;
+    int64_t need = (size + ALIGN - 1) / ALIGN * ALIGN;
+    if (need == 0) need = ALIGN;
+    std::lock_guard<std::mutex> g(p->mu);
+    for (auto it = p->free_blocks.begin(); it != p->free_blocks.end();
+         ++it) {
+        if (it->second >= need) {
+            int64_t off = it->first;
+            int64_t len = it->second;
+            p->free_blocks.erase(it);
+            if (len > need) p->free_blocks[off + need] = len - need;
+            p->used_blocks[off] = need;
+            p->in_use += need;
+            if (p->in_use > p->peak) p->peak = p->in_use;
+            p->alloc_count++;
+            return p->base + off;
+        }
+    }
+    p->fail_count++;
+    return nullptr;  // caller's spill-and-retry hook fires
+}
+
+int hostpool_free(void* pool, void* ptr) {
+    HostPool* p = (HostPool*)pool;
+    std::lock_guard<std::mutex> g(p->mu);
+    int64_t off = (uint8_t*)ptr - p->base;
+    auto it = p->used_blocks.find(off);
+    if (it == p->used_blocks.end()) return -1;
+    int64_t len = it->second;
+    p->used_blocks.erase(it);
+    p->in_use -= len;
+    // coalesce with neighbours
+    auto nxt = p->free_blocks.lower_bound(off);
+    if (nxt != p->free_blocks.end() && off + len == nxt->first) {
+        len += nxt->second;
+        nxt = p->free_blocks.erase(nxt);
+    }
+    if (nxt != p->free_blocks.begin()) {
+        auto prv = std::prev(nxt);
+        if (prv->first + prv->second == off) {
+            off = prv->first;
+            len += prv->second;
+            p->free_blocks.erase(prv);
+        }
+    }
+    p->free_blocks[off] = len;
+    return 0;
+}
+
+void hostpool_stats(void* pool, int64_t* out4) {
+    HostPool* p = (HostPool*)pool;
+    std::lock_guard<std::mutex> g(p->mu);
+    out4[0] = p->in_use;
+    out4[1] = p->peak;
+    out4[2] = p->alloc_count;
+    out4[3] = p->fail_count;
+}
+
+}  // extern "C"

@@ -223,10 +223,11 @@ SHUFFLE_PARTITIONS = conf("srt.shuffle.partitions") \
     .check(_positive).integer(8)
 
 SHUFFLE_COMPRESS = conf("srt.shuffle.compression.codec") \
-    .doc("Codec for serialized shuffle buffers: NONE or LZ4. "
+    .doc("Codec for serialized shuffle buffers: NONE, LZ4 (native "
+         "codec), or ZSTD. "
          "(spark.rapids.shuffle.compression.codec, nvcomp LZ4 in the "
          "reference)") \
-    .check_values(["NONE", "LZ4"]).string("NONE")
+    .check_values(["NONE", "LZ4", "ZSTD"]).string("NONE")
 
 METRICS_LEVEL = conf("srt.sql.metrics.level") \
     .doc("Operator metric detail: ESSENTIAL, MODERATE, DEBUG. "

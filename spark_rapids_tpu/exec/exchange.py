@@ -1,0 +1,136 @@
+"""Shuffle exchange exec: hash-partition the child stream through the
+ShuffleManager.
+
+Rebuild of GpuShuffleExchangeExecBase.scala (:167,
+prepareBatchShuffleDependency :277) + GpuHashPartitioningBase (SURVEY
+§2.7): each incoming batch is split on-device into the target
+partitions (parallel/partition.py — the cudf Table.partition
+equivalent), the per-partition slices become shuffle blocks via the
+manager (device-cached or serialized host blocks), and the read side
+streams one reduce partition's blocks back (GpuShuffleCoalesceExec is
+the downstream CoalesceBatchesExec).
+
+Under a device mesh the same partitioning feeds the all-to-all
+collective instead (parallel/shuffle.py shuffle_exchange) — that path
+compiles into the SPMD program and never touches this manager.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Iterator, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar import dtypes as dt
+from ..columnar.vector import (ColumnVector, ColumnarBatch, StringColumn,
+                               choose_capacity)
+from ..conf import SHUFFLE_PARTITIONS
+from ..expr.core import Expression
+from ..parallel.partition import (PartitionedBatch, hash_partition_ids,
+                                  partition_batch, round_robin_partition_ids,
+                                  string_from_padded)
+from ..parallel.shuffle_manager import ShuffleManager, shuffle_manager
+from .base import ExecContext, Metric, Schema, TpuExec
+
+_SHUFFLE_IDS = itertools.count(1)
+_IDS_LOCK = threading.Lock()
+
+
+def next_shuffle_id() -> int:
+    with _IDS_LOCK:
+        return next(_SHUFFLE_IDS)
+
+
+def partition_slice(pb: PartitionedBatch, i: int) -> ColumnarBatch:
+    """Extract partition i of a PartitionedBatch as a standalone batch."""
+    S = pb.slot_capacity
+    cols = []
+    for spec, dtype in zip(pb.columns, pb.dtypes):
+        if dtype == dt.STRING:
+            padded, lens, valid = spec
+            cols.append(string_from_padded(padded[i], lens[i], valid[i]))
+        else:
+            data, valid = spec
+            cols.append(ColumnVector(data[i], valid[i], dtype))
+    return ColumnarBatch(cols, pb.names, pb.counts[i])
+
+
+class ShuffleExchangeExec(TpuExec):
+    """Hash (or round-robin) repartitioning through the ShuffleManager."""
+
+    def __init__(self, child: TpuExec,
+                 key_exprs: Sequence[Expression],
+                 num_partitions: Optional[int] = None,
+                 manager: Optional[ShuffleManager] = None):
+        super().__init__(child)
+        self.key_exprs = list(key_exprs)
+        self.num_partitions = num_partitions
+        self.manager = manager
+        self.shuffle_id = next_shuffle_id()
+        self._jit_cache = {}
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema
+
+    def _partition_fn(self, num_parts: int):
+        if num_parts not in self._jit_cache:
+            def run(batch: ColumnarBatch) -> PartitionedBatch:
+                if self.key_exprs:
+                    keys = [e.eval(batch) for e in self.key_exprs]
+                    pids = hash_partition_ids(keys, num_parts)
+                else:
+                    pids = round_robin_partition_ids(batch.capacity,
+                                                     num_parts)
+                return partition_batch(batch, pids, num_parts)
+            self._jit_cache[num_parts] = jax.jit(run)
+        return self._jit_cache[num_parts]
+
+    def write(self, ctx: ExecContext) -> int:
+        """Map phase: drain the child, write all blocks. Returns the
+        number of map tasks (batches) written."""
+        mgr = self.manager or shuffle_manager()
+        n_parts = self.num_partitions or ctx.conf.get(SHUFFLE_PARTITIONS)
+        mgr.register_shuffle(self.shuffle_id, n_parts)
+        m = ctx.metrics_for(self.exec_id)
+        part_time = m.setdefault("partitionTime",
+                                 Metric("partitionTime", Metric.MODERATE,
+                                        "ns"))
+        map_id = 0
+        for batch in self.children[0].execute(ctx):
+            if int(batch.num_rows) == 0:
+                continue
+            import time
+            t0 = time.perf_counter_ns()
+            with ctx.semaphore:
+                pb = self._partition_fn(n_parts)(batch)
+                parts = [partition_slice(pb, i) for i in range(n_parts)]
+            part_time.add(time.perf_counter_ns() - t0)
+            mgr.write_map_output(self.shuffle_id, map_id, parts)
+            map_id += 1
+        return map_id
+
+    def read_partition(self, ctx: ExecContext,
+                       reduce_id: int) -> Iterator[ColumnarBatch]:
+        mgr = self.manager or shuffle_manager()
+        yield from mgr.read_partition(self.shuffle_id, reduce_id)
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        """Single-process execution: write all map outputs, then stream
+        partitions in order (partition boundaries preserved for
+        downstream partition-wise operators)."""
+        mgr = self.manager or shuffle_manager()
+        self.write(ctx)
+        n_parts = mgr.num_partitions(self.shuffle_id)
+        try:
+            for reduce_id in range(n_parts):
+                yield from self.read_partition(ctx, reduce_id)
+        finally:
+            mgr.unregister_shuffle(self.shuffle_id)
+
+    def node_description(self) -> str:
+        keys = ", ".join(repr(e) for e in self.key_exprs) or "round-robin"
+        return f"ShuffleExchange[{keys}]"

@@ -680,3 +680,343 @@ def _parse_date(padded, lens):
     days = _days_from_civil(y.astype(jnp.int64), m.astype(jnp.int64),
                             d.astype(jnp.int64))
     return days, ok
+
+
+# ---------------------------------------------------------------------------
+# Extended string functions (stringFunctions.scala breadth)
+# ---------------------------------------------------------------------------
+
+class Reverse(Expression):
+    """reverse(str) — per-row byte reversal (exact for ASCII)."""
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.STRING
+
+    def eval(self, batch: ColumnarBatch) -> StringColumn:
+        c = self.children[0].eval(batch)
+        padded = c.padded()
+        cap, w = padded.shape
+        lens = c.lengths()
+        k = jnp.arange(w, dtype=jnp.int32)
+        src = jnp.clip(lens[:, None] - 1 - k[None, :], 0, w - 1)
+        out = jnp.take_along_axis(padded, src, axis=1)
+        out = jnp.where(k[None, :] < lens[:, None], out,
+                        jnp.zeros((), jnp.uint8))
+        return pack_padded(out, lens, c.validity, c.pad_bucket)
+
+
+class _Pad(Expression):
+    left = True
+
+    def __init__(self, child: Expression, length: int, pad: str = " "):
+        super().__init__(child)
+        self.length = length
+        self.pad = pad.encode("utf-8") or b" "
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.STRING
+
+    def eval(self, batch: ColumnarBatch) -> StringColumn:
+        c = self.children[0].eval(batch)
+        padded = c.padded()
+        cap, w = padded.shape
+        lens = c.lengths()
+        tgt = self.length
+        out_w = _round_pow2(max(tgt, 1))
+        pad_arr = jnp.asarray(
+            np.frombuffer(self.pad * ((tgt // len(self.pad)) + 1),
+                          dtype=np.uint8)[:max(tgt, 1)])
+        k = jnp.arange(out_w, dtype=jnp.int32)
+        out_len = jnp.minimum(jnp.maximum(lens, tgt), tgt)
+        # rows longer than tgt truncate to tgt (Spark lpad/rpad semantics)
+        n_pad = jnp.maximum(tgt - lens, 0)
+        if self.left:
+            # pad bytes then string bytes
+            from_pad = k[None, :] < n_pad[:, None]
+            src_str = jnp.clip(k[None, :] - n_pad[:, None], 0, w - 1)
+        else:
+            from_pad = k[None, :] >= lens[:, None]
+            src_str = jnp.clip(jnp.broadcast_to(k[None, :], (cap, out_w)),
+                               0, w - 1)
+        str_bytes = jnp.take_along_axis(
+            padded, jnp.clip(src_str, 0, w - 1), axis=1) \
+            if w else jnp.zeros((cap, out_w), jnp.uint8)
+        pad_idx = jnp.where(self.left, k[None, :],
+                            jnp.clip(k[None, :] - lens[:, None], 0,
+                                     max(tgt - 1, 0)))
+        pad_bytes = jnp.take(pad_arr, jnp.clip(pad_idx, 0,
+                                               pad_arr.shape[0] - 1))
+        out = jnp.where(from_pad, pad_bytes, str_bytes)
+        out = jnp.where(k[None, :] < tgt, out, jnp.zeros((), jnp.uint8))
+        return pack_padded(out, jnp.full(cap, tgt, jnp.int32) * 0 + tgt,
+                           c.validity, out_w)
+
+
+class Lpad(_Pad):
+    left = True
+
+
+class Rpad(_Pad):
+    left = False
+
+
+class InitCap(Expression):
+    """initcap: first letter of each whitespace-separated word upper,
+    rest lower (ASCII)."""
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.STRING
+
+    def eval(self, batch: ColumnarBatch) -> StringColumn:
+        c = self.children[0].eval(batch)
+        padded = c.padded()
+        lens = c.lengths()
+        is_lower = (padded >= 97) & (padded <= 122)
+        is_upper = (padded >= 65) & (padded <= 90)
+        prev_space = jnp.concatenate(
+            [jnp.ones((padded.shape[0], 1), jnp.bool_),
+             padded[:, :-1] == 32], axis=1)
+        upped = jnp.where(is_lower & prev_space, padded - 32, padded)
+        lowed = jnp.where(is_upper & ~prev_space, upped + 32, upped)
+        return pack_padded(lowed, lens, c.validity, c.pad_bucket)
+
+
+class ConcatWs(Expression):
+    """concat_ws(sep, ...) — skips nulls (unlike concat)."""
+
+    def __init__(self, sep: str, *children: Expression):
+        super().__init__(*children)
+        self.sep = sep
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.STRING
+
+    def nullable(self, schema: Schema) -> bool:
+        return False
+
+    def eval(self, batch: ColumnarBatch) -> StringColumn:
+        from .core import Literal
+        cols = [c.eval(batch) for c in self.children]
+        cols = [c if isinstance(c, StringColumn) else _as_string_col(c)
+                for c in cols]
+        sep_raw = np.frombuffer(self.sep.encode("utf-8"), dtype=np.uint8)
+        cap = batch.capacity
+        w_total = _round_pow2(sum(c.pad_bucket for c in cols) +
+                              len(sep_raw) * max(len(cols) - 1, 0) + 1)
+        out = jnp.zeros((cap, w_total), jnp.uint8)
+        pos = jnp.zeros(cap, jnp.int32)
+        k = jnp.arange(w_total, dtype=jnp.int32)
+        first_done = jnp.zeros(cap, jnp.bool_)
+        for c in cols:
+            valid = c.validity
+            # separator before this part (only between non-null parts)
+            if len(sep_raw):
+                put_sep = valid & first_done
+                for si, sb in enumerate(sep_raw):
+                    tgt = pos + si
+                    mask = put_sep[:, None] & (k[None, :] == tgt[:, None])
+                    out = jnp.where(mask, jnp.uint8(sb), out)
+                pos = jnp.where(put_sep, pos + len(sep_raw), pos)
+            p = c.padded()
+            lens = c.lengths()
+            wp = p.shape[1]
+            idx = k[None, :] - pos[:, None]
+            src = jnp.clip(idx, 0, wp - 1)
+            bytes_ = jnp.take_along_axis(p, src, axis=1)
+            write = valid[:, None] & (idx >= 0) & (idx < lens[:, None])
+            out = jnp.where(write, bytes_, out)
+            pos = jnp.where(valid, pos + lens, pos)
+            first_done = first_done | valid
+        live = batch.live_mask()
+        return pack_padded(out, pos, live, w_total)
+
+
+def _as_string_col(c):
+    from .cast import cast_column
+    return cast_column(c, dt.STRING)
+
+
+class StringLocate(Expression):
+    """locate/instr(substr in str) — 1-based position, 0 if absent."""
+
+    def __init__(self, child: Expression, substr: str, start: int = 1):
+        super().__init__(child)
+        self.substr = substr
+        self.start = start
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.INT32
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        c = self.children[0].eval(batch)
+        raw = np.frombuffer(self.substr.encode("utf-8"), dtype=np.uint8)
+        n = len(raw)
+        cap = batch.capacity
+        lens = c.lengths()
+        if self.start <= 0:
+            # Spark: locate(sub, str, 0) = 0 regardless of content
+            return make_result(jnp.zeros(cap, jnp.int32), c.validity,
+                               dt.INT32)
+        if n == 0:
+            pos = jnp.where(lens >= 0, jnp.int32(self.start), 0)
+            return make_result(
+                jnp.where(jnp.int32(self.start) <= lens + 1, pos, 0),
+                c.validity, dt.INT32)
+        padded = c.padded()
+        w = c.pad_bucket
+        first = jnp.zeros(cap, jnp.int32)
+        found = jnp.zeros(cap, jnp.bool_)
+        lo = max(self.start - 1, 0)
+        for s in range(lo, max(w - n + 1, lo)):
+            if s + n > w:
+                break
+            m = jnp.all(padded[:, s:s + n] == jnp.asarray(raw), axis=1) & \
+                (lens >= s + n)
+            first = jnp.where(m & ~found, jnp.int32(s + 1), first)
+            found = found | m
+        return make_result(first, c.validity, dt.INT32)
+
+
+class StringRepeat(Expression):
+    """repeat(str, n) with a plan-time constant n."""
+
+    def __init__(self, child: Expression, n: int):
+        super().__init__(child)
+        self.n = max(int(n), 0)
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.STRING
+
+    def eval(self, batch: ColumnarBatch) -> StringColumn:
+        c = self.children[0].eval(batch)
+        padded = c.padded()
+        cap, w = padded.shape
+        lens = c.lengths()
+        if self.n == 0:
+            return pack_padded(jnp.zeros((cap, 1), jnp.uint8),
+                               jnp.zeros(cap, jnp.int32), c.validity, 1)
+        out_w = _round_pow2(w * self.n)
+        k = jnp.arange(out_w, dtype=jnp.int32)
+        safe_len = jnp.maximum(lens, 1)
+        src = jnp.clip(k[None, :] % safe_len[:, None], 0, w - 1)
+        out = jnp.take_along_axis(padded, src, axis=1)
+        out_len = lens * self.n
+        out = jnp.where(k[None, :] < out_len[:, None], out,
+                        jnp.zeros((), jnp.uint8))
+        return pack_padded(out, out_len, c.validity, out_w)
+
+
+class StringReplace(Expression):
+    """replace(str, search, replace) with constant search/replace.
+
+    Non-overlapping leftmost matches; expansion-aware output width.
+    """
+
+    def __init__(self, child: Expression, search: str, replace: str = ""):
+        super().__init__(child)
+        if not search:
+            raise TypeError("replace search string must be non-empty")
+        self.search = np.frombuffer(search.encode("utf-8"), np.uint8)
+        self.replace = np.frombuffer(replace.encode("utf-8"), np.uint8)
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.STRING
+
+    def eval(self, batch: ColumnarBatch) -> StringColumn:
+        c = self.children[0].eval(batch)
+        padded = c.padded()
+        cap, w = padded.shape
+        lens = c.lengths()
+        ns, nr = len(self.search), len(self.replace)
+        # candidate match starts (sliding equality)
+        cand = jnp.zeros((cap, w), jnp.bool_)
+        for s in range(0, max(w - ns + 1, 0)):
+            m = jnp.all(padded[:, s:s + ns] == jnp.asarray(self.search),
+                        axis=1) & (lens >= s + ns)
+            cand = cand.at[:, s].set(m)
+        # non-overlapping leftmost selection: scan with "blocked-until"
+        def pick(carry, j_col):
+            blocked_until, = carry
+            j, col = j_col
+            take = col & (j >= blocked_until)
+            blocked_until = jnp.where(take, j + ns, blocked_until)
+            return (blocked_until,), take
+        import jax
+        (_, ), takes = jax.lax.scan(
+            pick, (jnp.zeros(cap, jnp.int32),),
+            (jnp.arange(w, dtype=jnp.int32), cand.T))
+        starts = takes.T  # (cap, w) selected match starts
+        in_match = jnp.zeros((cap, w), jnp.bool_)
+        for off in range(ns):
+            rolled = jnp.roll(starts, off, axis=1)
+            if off:
+                rolled = rolled.at[:, :off].set(False)
+            in_match = in_match | rolled
+        # per input byte output contribution
+        contrib = jnp.where(starts, nr,
+                            jnp.where(in_match, 0, 1)).astype(jnp.int32)
+        contrib = jnp.where(jnp.arange(w)[None, :] < lens[:, None],
+                            contrib, 0)
+        out_pos = jnp.cumsum(contrib, axis=1) - contrib  # exclusive
+        out_len = jnp.sum(contrib, axis=1)
+        grow = max(1, -(-nr // ns)) if ns else 1
+        out_w = _round_pow2(max(w * grow, 1))
+        out = jnp.zeros((cap, out_w), jnp.uint8)
+        rows = jnp.arange(cap)[:, None]
+        # literal (non-match) bytes — contrib==1 alone is NOT enough: a
+        # match start also has contrib 1 when len(replace)==1
+        lit_mask = (contrib == 1) & ~starts
+        tgt = jnp.clip(out_pos, 0, out_w - 1)
+        out = out.at[rows, tgt].max(
+            jnp.where(lit_mask, padded[:, :w], 0))
+        # replacement bytes
+        for off in range(nr):
+            tgt_r = jnp.clip(out_pos + off, 0, out_w - 1)
+            out = out.at[rows, tgt_r].max(
+                jnp.where(starts, jnp.uint8(self.replace[off]), 0))
+        return pack_padded(out, out_len, c.validity, out_w)
+
+
+class StringTranslate(Expression):
+    """translate(str, from, to) — per-byte mapping (ASCII)."""
+
+    def __init__(self, child: Expression, src: str, dst: str):
+        super().__init__(child)
+        table = np.arange(256, dtype=np.int16)
+        delete = np.zeros(256, bool)
+        for ch in dst:
+            if ord(ch) > 127:
+                raise TypeError("translate: non-ASCII unsupported on TPU")
+        for i, ch in enumerate(src):
+            b = ord(ch)
+            if b > 127:
+                raise TypeError("translate: non-ASCII unsupported on TPU")
+            if i < len(dst):
+                table[b] = ord(dst[i])
+            else:
+                delete[b] = True
+        self.table = table.astype(np.uint8)
+        self.delete = delete
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.STRING
+
+    def eval(self, batch: ColumnarBatch) -> StringColumn:
+        c = self.children[0].eval(batch)
+        padded = c.padded()
+        cap, w = padded.shape
+        lens = c.lengths()
+        k = jnp.arange(w, dtype=jnp.int32)
+        in_str = k[None, :] < lens[:, None]
+        mapped = jnp.take(jnp.asarray(self.table),
+                          padded.astype(jnp.int32))
+        keep = in_str & ~jnp.take(jnp.asarray(self.delete),
+                                  padded.astype(jnp.int32))
+        # compact kept bytes to the row prefix
+        new_pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+        out_len = jnp.sum(keep, axis=1).astype(jnp.int32)
+        out = jnp.zeros((cap, w), jnp.uint8)
+        rows = jnp.arange(cap)[:, None]
+        out = out.at[rows, jnp.clip(new_pos, 0, w - 1)].max(
+            jnp.where(keep, mapped, 0))
+        return pack_padded(out, out_len, c.validity, c.pad_bucket)

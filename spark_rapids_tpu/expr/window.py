@@ -179,10 +179,11 @@ class WindowExpression(Expression):
         self.func = func
         self.spec = spec
         if isinstance(func, AggregateFunction) and spec.frame is None:
-            # Spark default: with ORDER BY -> running frame; without ->
-            # whole partition
+            # Spark default: with ORDER BY -> RANGE UNBOUNDED..CURRENT
+            # (peers share their run's value); without -> whole partition
             self.spec = spec.with_frame(
-                RUNNING if spec.order_fields else WHOLE_PARTITION)
+                WindowFrame(UNBOUNDED, CURRENT_ROW, row_based=False)
+                if spec.order_fields else WHOLE_PARTITION)
         elif spec.frame is None:
             self.spec = spec.with_frame(RUNNING)
 

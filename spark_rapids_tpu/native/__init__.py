@@ -1,0 +1,210 @@
+"""ctypes bindings for the tpu-table native host runtime.
+
+Builds native/tputable.cpp with g++ on first import (content-hashed so
+rebuilds happen only when the source changes) and exposes:
+
+- lz4_compress / lz4_decompress — LZ4 block codec (shuffle/spill)
+- columns_to_rows / rows_to_columns — fixed-width row<->columnar
+  conversion (CudfUnsafeRow / RowConversion role)
+- HostMemoryPool — aligned slab allocator with alloc-failure signaling
+  (HostAlloc / PinnedMemoryPool role)
+
+SURVEY §2.9: these are the framework's native equivalents of the
+reference's external C++/CUDA artifacts.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "tputable.cpp")
+_BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
+
+_LIB = None
+_LIB_LOCK = threading.Lock()
+
+
+def _build_lib() -> str:
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    so = os.path.join(_BUILD_DIR, f"libtputable-{digest}.so")
+    if not os.path.exists(so):
+        tmp = so + ".tmp"
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp,
+             _SRC],
+            check=True, capture_output=True)
+        os.replace(tmp, so)
+    return so
+
+
+def _lib() -> ctypes.CDLL:
+    global _LIB
+    with _LIB_LOCK:
+        if _LIB is None:
+            lib = ctypes.CDLL(_build_lib())
+            u8p = ctypes.POINTER(ctypes.c_uint8)
+            lib.slz4_max_compressed_size.restype = ctypes.c_int64
+            lib.slz4_max_compressed_size.argtypes = [ctypes.c_int64]
+            lib.slz4_compress.restype = ctypes.c_int64
+            lib.slz4_compress.argtypes = [u8p, ctypes.c_int64, u8p,
+                                          ctypes.c_int64]
+            lib.slz4_decompress.restype = ctypes.c_int64
+            lib.slz4_decompress.argtypes = [u8p, ctypes.c_int64, u8p,
+                                            ctypes.c_int64]
+            lib.hostpool_create.restype = ctypes.c_void_p
+            lib.hostpool_create.argtypes = [ctypes.c_int64]
+            lib.hostpool_destroy.argtypes = [ctypes.c_void_p]
+            lib.hostpool_alloc.restype = ctypes.c_void_p
+            lib.hostpool_alloc.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+            lib.hostpool_free.restype = ctypes.c_int
+            lib.hostpool_free.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+            lib.hostpool_stats.argtypes = [ctypes.c_void_p,
+                                           ctypes.POINTER(ctypes.c_int64)]
+            u8pp = ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))
+            i32p = ctypes.POINTER(ctypes.c_int32)
+            lib.columns_to_rows.restype = None
+            lib.columns_to_rows.argtypes = [
+                u8pp, u8pp, i32p, i32p, ctypes.c_int32, ctypes.c_int64,
+                u8p, ctypes.c_int64]
+            lib.rows_to_columns.restype = None
+            lib.rows_to_columns.argtypes = [
+                u8p, ctypes.c_int64, ctypes.c_int64, i32p, i32p,
+                ctypes.c_int32, u8pp, u8pp]
+            _LIB = lib
+        return _LIB
+
+
+def _u8ptr(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def lz4_compress(data: bytes) -> bytes:
+    lib = _lib()
+    src = np.frombuffer(data, np.uint8)
+    cap = int(lib.slz4_max_compressed_size(len(src)))
+    dst = np.empty(cap, np.uint8)
+    n = int(lib.slz4_compress(_u8ptr(src), len(src), _u8ptr(dst), cap))
+    if n < 0:
+        raise RuntimeError("lz4 compression overflow")
+    return dst[:n].tobytes()
+
+
+def lz4_decompress(data: bytes, decompressed_size: int) -> bytes:
+    lib = _lib()
+    src = np.frombuffer(data, np.uint8)
+    dst = np.empty(decompressed_size, np.uint8)
+    n = int(lib.slz4_decompress(_u8ptr(src), len(src), _u8ptr(dst),
+                                decompressed_size))
+    if n != decompressed_size:
+        raise RuntimeError(
+            f"lz4 decompression produced {n}, expected "
+            f"{decompressed_size}")
+    return dst.tobytes()
+
+
+def columns_to_rows(col_data, col_valid, field_sizes) -> np.ndarray:
+    """Pack columnar buffers into fixed-width rows.
+
+    col_data: list of contiguous np arrays (one per column)
+    col_valid: list of uint8/bool arrays
+    Returns (rows bytes ndarray, row_stride, field_offsets).
+    """
+    lib = _lib()
+    n_cols = len(col_data)
+    n_rows = len(col_data[0]) if n_cols else 0
+    null_bytes = (n_cols + 7) // 8
+    # 8-byte aligned fields after the null bitset (CudfUnsafeRow layout)
+    offsets = []
+    pos = (null_bytes + 7) // 8 * 8
+    for s in field_sizes:
+        pos = (pos + s - 1) // s * s  # natural alignment
+        offsets.append(pos)
+        pos += s
+    stride = (pos + 7) // 8 * 8
+    rows = np.zeros(n_rows * stride, np.uint8)
+    data_arrs = [np.ascontiguousarray(a).view(np.uint8).reshape(-1)
+                 for a in col_data]
+    valid_arrs = [np.ascontiguousarray(v, dtype=np.uint8)
+                  for v in col_valid]
+    DataPtrs = ctypes.POINTER(ctypes.c_uint8) * n_cols
+    dp = DataPtrs(*[_u8ptr(a) for a in data_arrs])
+    vp = DataPtrs(*[_u8ptr(v) for v in valid_arrs])
+    fs = (ctypes.c_int32 * n_cols)(*field_sizes)
+    fo = (ctypes.c_int32 * n_cols)(*offsets)
+    lib.columns_to_rows(dp, vp, fs, fo, n_cols, n_rows, _u8ptr(rows),
+                        stride)
+    return rows, stride, offsets
+
+
+def rows_to_columns(rows: np.ndarray, stride: int, n_rows: int,
+                    field_sizes, field_offsets, np_dtypes):
+    """Unpack fixed-width rows into columnar (data, valid) pairs."""
+    lib = _lib()
+    n_cols = len(field_sizes)
+    outs = [np.zeros(n_rows, np.dtype(d)) for d in np_dtypes]
+    valids = [np.zeros(n_rows, np.uint8) for _ in range(n_cols)]
+    DataPtrs = ctypes.POINTER(ctypes.c_uint8) * n_cols
+    dp = DataPtrs(*[_u8ptr(a.view(np.uint8).reshape(-1)) for a in outs])
+    vp = DataPtrs(*[_u8ptr(v) for v in valids])
+    fs = (ctypes.c_int32 * n_cols)(*field_sizes)
+    fo = (ctypes.c_int32 * n_cols)(*field_offsets)
+    lib.rows_to_columns(_u8ptr(rows), stride, n_rows, fs, fo, n_cols,
+                        dp, vp)
+    return outs, [v.astype(bool) for v in valids]
+
+
+class HostMemoryPool:
+    """Aligned slab allocator; alloc returns None when exhausted so the
+    caller can spill-and-retry (DeviceMemoryEventHandler pattern on the
+    host side)."""
+
+    def __init__(self, size: int):
+        self._lib = _lib()
+        self._pool = self._lib.hostpool_create(size)
+        if not self._pool:
+            raise MemoryError(f"hostpool_create({size})")
+        self.size = size
+
+    def alloc(self, size: int) -> Optional[int]:
+        p = self._lib.hostpool_alloc(self._pool, size)
+        return p or None
+
+    def free(self, ptr: int) -> None:
+        if self._lib.hostpool_free(self._pool, ptr) != 0:
+            raise ValueError("hostpool_free: unknown pointer")
+
+    def stats(self) -> dict:
+        out = (ctypes.c_int64 * 4)()
+        self._lib.hostpool_stats(self._pool, out)
+        return {"in_use": out[0], "peak": out[1],
+                "alloc_count": out[2], "fail_count": out[3]}
+
+    def close(self) -> None:
+        if self._pool:
+            self._lib.hostpool_destroy(self._pool)
+            self._pool = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def native_available() -> bool:
+    try:
+        _lib()
+        return True
+    except Exception:
+        return False

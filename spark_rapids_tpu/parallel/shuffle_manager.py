@@ -1,0 +1,277 @@
+"""Shuffle manager: pluggable block-based shuffle with three modes.
+
+Rebuild of RapidsShuffleInternalManagerBase.scala (:1075, SURVEY §2.7)
+and its catalogs (ShuffleBufferCatalog / ShuffleReceivedBufferCatalog),
+re-architected for TPU:
+
+- CACHE_ONLY:     blocks stay device-resident as SpillableBatches in a
+                  ShuffleBlockCatalog (RapidsCachingWriter path); spill
+                  tiering applies automatically under memory pressure.
+- MULTITHREADED:  blocks serialize on a writer thread pool to host
+                  memory (optionally zstd-compressed, the nvcomp-LZ4
+                  role) and deserialize on a reader pool — the
+                  reference's threaded file shuffle with host RAM
+                  standing in for shuffle files.
+- NATIVE:         the SPMD path: shuffle IS a mesh all-to-all inside
+                  the compiled program (shuffle.py shuffle_exchange) —
+                  this manager only records metadata for it, because
+                  ICI collectives live inside jit, not behind an RPC
+                  (SURVEY §2.7 "TPU equivalent" row).
+
+A driver-side heartbeat registry (RapidsShuffleHeartbeatManager role)
+tracks executor liveness for multi-host deployments.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+from ..columnar.vector import ColumnarBatch, choose_capacity
+from ..conf import (SHUFFLE_COMPRESS, SHUFFLE_MODE, SHUFFLE_PARTITIONS,
+                    SrtConf, active_conf)
+from ..memory.spill import SpillPriority, SpillableBatch
+from .serializer import deserialize_batch, serialize_batch
+
+BlockId = Tuple[int, int, int]  # (shuffle_id, map_id, reduce_id)
+
+
+class ShuffleBlockCatalog:
+    """Device-resident shuffle blocks as spillables
+    (ShuffleBufferCatalog.scala role)."""
+
+    def __init__(self):
+        self._blocks: Dict[BlockId, List[SpillableBatch]] = {}
+        self._lock = threading.Lock()
+
+    def add(self, block: BlockId, batch: ColumnarBatch) -> None:
+        sb = SpillableBatch(batch, SpillPriority.SHUFFLE_OUTPUT)
+        with self._lock:
+            self._blocks.setdefault(block, []).append(sb)
+
+    def get(self, block: BlockId) -> List[ColumnarBatch]:
+        with self._lock:
+            sbs = list(self._blocks.get(block, []))
+        return [sb.get() for sb in sbs]
+
+    def blocks_for_reduce(self, shuffle_id: int,
+                          reduce_id: int) -> List[BlockId]:
+        with self._lock:
+            return sorted(b for b in self._blocks
+                          if b[0] == shuffle_id and b[2] == reduce_id)
+
+    def remove_shuffle(self, shuffle_id: int) -> int:
+        with self._lock:
+            gone = [b for b in self._blocks if b[0] == shuffle_id]
+            n = 0
+            for b in gone:
+                for sb in self._blocks.pop(b):
+                    sb.close()
+                    n += 1
+        return n
+
+
+class HostBlockStore:
+    """Serialized host-memory blocks (the MULTITHREADED mode's 'shuffle
+    files')."""
+
+    def __init__(self):
+        self._blocks: Dict[BlockId, bytes] = {}
+        self._lock = threading.Lock()
+        self.bytes_written = 0
+
+    def put(self, block: BlockId, data: bytes) -> None:
+        with self._lock:
+            self._blocks[block] = data
+            self.bytes_written += len(data)
+
+    def get(self, block: BlockId) -> Optional[bytes]:
+        with self._lock:
+            return self._blocks.get(block)
+
+    def blocks_for_reduce(self, shuffle_id: int,
+                          reduce_id: int) -> List[BlockId]:
+        with self._lock:
+            return sorted(b for b in self._blocks
+                          if b[0] == shuffle_id and b[2] == reduce_id)
+
+    def remove_shuffle(self, shuffle_id: int) -> int:
+        with self._lock:
+            gone = [b for b in self._blocks if b[0] == shuffle_id]
+            for b in gone:
+                self.bytes_written -= len(self._blocks.pop(b))
+            return len(gone)
+
+
+@dataclass
+class ShuffleWriteMetrics:
+    blocks_written: int = 0
+    rows_written: int = 0
+    bytes_written: int = 0
+    write_time_ns: int = 0
+
+
+class ShuffleManager:
+    """getWriter/getReader surface over the mode-selected store."""
+
+    def __init__(self, conf: Optional[SrtConf] = None,
+                 num_threads: int = 4):
+        self.conf = conf or active_conf()
+        self.mode = self.conf.get(SHUFFLE_MODE).upper()  # MESH|MULTITHREADED|CACHE_ONLY
+        self.codec = self.conf.get(SHUFFLE_COMPRESS).lower()
+        self.compress = self.codec != "none"
+        self.catalog = ShuffleBlockCatalog()
+        self.host_store = HostBlockStore()
+        self._pool = cf.ThreadPoolExecutor(max_workers=num_threads)
+        self._registered: Dict[int, int] = {}  # shuffle_id -> num_parts
+        self.write_metrics = ShuffleWriteMetrics()
+        self._lock = threading.Lock()
+
+    # --- lifecycle ---
+    def register_shuffle(self, shuffle_id: int, num_partitions: int) -> None:
+        with self._lock:
+            self._registered[shuffle_id] = num_partitions
+
+    def unregister_shuffle(self, shuffle_id: int) -> None:
+        self.catalog.remove_shuffle(shuffle_id)
+        self.host_store.remove_shuffle(shuffle_id)
+        with self._lock:
+            self._registered.pop(shuffle_id, None)
+
+    def num_partitions(self, shuffle_id: int) -> int:
+        return self._registered[shuffle_id]
+
+    # --- write path ---
+    def write_map_output(self, shuffle_id: int, map_id: int,
+                         partitions: Sequence[ColumnarBatch]) -> None:
+        """One map task's output: partitions[i] goes to reduce i."""
+        t0 = time.perf_counter_ns()
+        futures = []
+        for reduce_id, batch in enumerate(partitions):
+            if batch is None or int(batch.num_rows) == 0:
+                continue
+            block = (shuffle_id, map_id, reduce_id)
+            if self.mode == "CACHE_ONLY":
+                self.catalog.add(block, batch)
+                self.write_metrics.rows_written += int(batch.num_rows)
+                self.write_metrics.blocks_written += 1
+            else:  # MULTITHREADED (MESH writes never reach here)
+                futures.append(self._pool.submit(
+                    self._serialize_one, block, batch))
+        for f in futures:
+            f.result()
+        self.write_metrics.write_time_ns += time.perf_counter_ns() - t0
+
+    def _serialize_one(self, block: BlockId, batch: ColumnarBatch) -> None:
+        data = serialize_batch(batch, compress=self.compress,
+                               codec=self.codec)
+        self.host_store.put(block, data)
+        with self._lock:  # writer pool threads race on the counters
+            self.write_metrics.rows_written += int(batch.num_rows)
+            self.write_metrics.blocks_written += 1
+            self.write_metrics.bytes_written += len(data)
+
+    # --- read path ---
+    def read_partition(self, shuffle_id: int,
+                       reduce_id: int) -> Iterator[ColumnarBatch]:
+        """All map outputs for one reduce partition, in map order."""
+        if self.mode == "CACHE_ONLY":
+            for block in self.catalog.blocks_for_reduce(shuffle_id,
+                                                        reduce_id):
+                yield from self.catalog.get(block)
+            return
+        blocks = self.host_store.blocks_for_reduce(shuffle_id, reduce_id)
+        futures = [self._pool.submit(self._deserialize_one, b)
+                   for b in blocks]
+        for f in futures:
+            batch = f.result()
+            if batch is not None:
+                yield batch
+
+    def _deserialize_one(self, block: BlockId) -> Optional[ColumnarBatch]:
+        data = self.host_store.get(block)
+        if data is None:
+            return None
+        return deserialize_batch(data)
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=False)
+
+
+_MANAGER: Optional[ShuffleManager] = None
+_MANAGER_LOCK = threading.Lock()
+
+
+def shuffle_manager() -> ShuffleManager:
+    global _MANAGER
+    with _MANAGER_LOCK:
+        if _MANAGER is None:
+            _MANAGER = ShuffleManager()
+        return _MANAGER
+
+
+def reset_shuffle_manager(conf: Optional[SrtConf] = None) -> ShuffleManager:
+    global _MANAGER
+    with _MANAGER_LOCK:
+        if _MANAGER is not None:
+            _MANAGER.shutdown()
+        _MANAGER = ShuffleManager(conf)
+        return _MANAGER
+
+
+# ---------------------------------------------------------------------------
+# heartbeat registry (RapidsShuffleHeartbeatManager role)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ExecutorInfo:
+    executor_id: str
+    endpoint: str
+    last_heartbeat: float = field(default_factory=time.monotonic)
+
+
+class ShuffleHeartbeatManager:
+    """Driver-side registry of live shuffle peers. In the reference this
+    bootstraps UCX endpoint exchange (Plugin.scala:292-303); here it
+    carries host:port endpoints for the DCN block-fetch path and lets
+    the planner exclude dead peers."""
+
+    def __init__(self, timeout_s: float = 60.0):
+        self.timeout_s = timeout_s
+        self._executors: Dict[str, ExecutorInfo] = {}
+        self._lock = threading.Lock()
+
+    def register(self, executor_id: str, endpoint: str) -> List[ExecutorInfo]:
+        """Returns the current peer list (what a new executor needs to
+        open connections)."""
+        with self._lock:
+            self._executors[executor_id] = ExecutorInfo(executor_id,
+                                                        endpoint)
+            return [e for e in self._executors.values()
+                    if e.executor_id != executor_id]
+
+    def heartbeat(self, executor_id: str) -> bool:
+        with self._lock:
+            info = self._executors.get(executor_id)
+            if info is None:
+                return False  # unknown: executor must re-register
+            info.last_heartbeat = time.monotonic()
+            return True
+
+    def live_executors(self) -> List[str]:
+        now = time.monotonic()
+        with self._lock:
+            return [e.executor_id for e in self._executors.values()
+                    if now - e.last_heartbeat <= self.timeout_s]
+
+    def expire_dead(self) -> List[str]:
+        now = time.monotonic()
+        with self._lock:
+            dead = [eid for eid, e in self._executors.items()
+                    if now - e.last_heartbeat > self.timeout_s]
+            for eid in dead:
+                del self._executors[eid]
+            return dead

@@ -1099,3 +1099,166 @@ def _value_to_string(v, from_t) -> str:
             return f"{f:.1f}"
         return repr(f)
     return str(int(v))
+
+
+# ---------------------------------------------------------------------------
+# extended strings + regex (CPU side uses python re — the "CPU Spark"
+# engine the TPU result is differentially tested against)
+# ---------------------------------------------------------------------------
+
+_EVALUATORS[S.Reverse] = _str_map(lambda s: s[::-1])
+
+
+def _pad_eval(left: bool):
+    def ev(expr, table):
+        a, m = _ev(expr.children[0], table)
+        tgt = expr.length
+        pad = expr.pad.decode("utf-8")
+        def one(s):
+            if len(s) >= tgt:
+                return s[:tgt]
+            fill = (pad * tgt)[: tgt - len(s)]
+            return fill + s if left else s + fill
+        out = np.array([one(x) for x in a], dtype=object) if len(a) else \
+            np.empty(0, object)
+        return np.where(m, out, ""), m
+    return ev
+
+
+_EVALUATORS[S.Lpad] = _pad_eval(True)
+_EVALUATORS[S.Rpad] = _pad_eval(False)
+
+
+def _initcap(s: str) -> str:
+    out = []
+    prev_space = True
+    for ch in s:
+        if prev_space and "a" <= ch <= "z":
+            out.append(ch.upper())
+        elif not prev_space and "A" <= ch <= "Z":
+            out.append(ch.lower())
+        else:
+            out.append(ch)
+        prev_space = ch == " "
+    return "".join(out)
+
+
+_EVALUATORS[S.InitCap] = _str_map(_initcap)
+
+
+@_reg(S.ConcatWs)
+def _concat_ws(expr, table):
+    n = table.num_rows
+    schema = table.schema()
+    parts = []
+    for c in expr.children:
+        v, m = _ev(c, table)
+        t = c.data_type(schema)
+        if t != dt.STRING:
+            # mirror the TPU side's cast_column lowering, not python str()
+            v = np.array([_value_to_string(x, t) for x in v], dtype=object)
+        parts.append((v, m))
+    out = []
+    for i in range(n):
+        vals = [p[0][i] for p in parts if p[1][i]]
+        out.append(expr.sep.join(vals))
+    return (np.array(out, dtype=object) if n else np.empty(0, object),
+            np.ones(n, bool))
+
+
+@_reg(S.StringLocate)
+def _locate(expr, table):
+    a, m = _ev(expr.children[0], table)
+    sub = expr.substr
+    start = max(expr.start - 1, 0)
+    def one(s):
+        if expr.start <= 0:
+            return 0  # Spark: locate with start 0 is always 0
+        if sub == "":
+            return expr.start if expr.start <= len(s) + 1 else 0
+        p = s.find(sub, start)
+        return p + 1
+    out = np.array([one(x) for x in a], dtype=np.int32) if len(a) else \
+        np.empty(0, np.int32)
+    return _zero_nulls(out, m), m
+
+
+@_reg(S.StringRepeat)
+def _repeat(expr, table):
+    a, m = _ev(expr.children[0], table)
+    out = np.array([x * expr.n for x in a], dtype=object) if len(a) else \
+        np.empty(0, object)
+    return np.where(m, out, ""), m
+
+
+@_reg(S.StringReplace)
+def _replace(expr, table):
+    a, m = _ev(expr.children[0], table)
+    search = expr.search.tobytes().decode("utf-8")
+    repl = expr.replace.tobytes().decode("utf-8")
+    out = np.array([x.replace(search, repl) for x in a], dtype=object) \
+        if len(a) else np.empty(0, object)
+    return np.where(m, out, ""), m
+
+
+@_reg(S.StringTranslate)
+def _translate(expr, table):
+    a, m = _ev(expr.children[0], table)
+    tbl = expr.table
+    dele = expr.delete
+    def one(s):
+        bs = s.encode("utf-8")
+        return bytes(tbl[b] for b in bs if not dele[b]).decode(
+            "utf-8", errors="replace")
+    out = np.array([one(x) for x in a], dtype=object) if len(a) else \
+        np.empty(0, object)
+    return np.where(m, out, ""), m
+
+
+from ..expr import regex as RX  # noqa: E402
+
+
+def _java_like_re(pattern: str):
+    import re
+    # Java regex classes (\d \w \s) are ASCII by default; python's are
+    # Unicode — re.ASCII aligns the CPU engine with Java/Spark and the
+    # byte-level TPU NFA.
+    return re.compile(pattern, re.ASCII)
+
+
+@_reg(RX.RLike)
+def _rlike(expr, table):
+    a, m = _ev(expr.children[0], table)
+    prog = _java_like_re(expr.pattern)
+    out = np.array([prog.search(x) is not None for x in a], dtype=bool) \
+        if len(a) else np.empty(0, bool)
+    return out & m, m
+
+
+@_reg(RX.RegExpExtract)
+def _regexp_extract(expr, table):
+    a, m = _ev(expr.children[0], table)
+    prog = _java_like_re(expr.pattern)
+    def one(s):
+        mt = prog.search(s)
+        if mt is None:
+            return ""
+        try:
+            g = mt.group(expr.group)
+        except IndexError:
+            return ""
+        return g if g is not None else ""
+    out = np.array([one(x) for x in a], dtype=object) if len(a) else \
+        np.empty(0, object)
+    return np.where(m, out, ""), m
+
+
+@_reg(RX.RegExpReplace)
+def _regexp_replace(expr, table):
+    import re
+    a, m = _ev(expr.children[0], table)
+    prog = _java_like_re(expr.pattern)
+    repl = expr.replacement
+    out = np.array([prog.sub(repl, x) for x in a], dtype=object) \
+        if len(a) else np.empty(0, object)
+    return np.where(m, out, ""), m

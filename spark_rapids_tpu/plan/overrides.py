@@ -165,6 +165,32 @@ def _tag_like(meta: ExprMeta):
 
 _expr(S.Like, ts.TypeSig(ts.STRING), _tag_like)
 
+for _cls in (S.Reverse, S.Lpad, S.Rpad, S.InitCap, S.ConcatWs,
+             S.StringLocate, S.StringRepeat, S.StringReplace,
+             S.StringTranslate):
+    _expr(_cls, ts.TypeSig(ts.STRING))
+
+
+def _tag_rlike(meta: ExprMeta):
+    """transpile-or-fallback (RegexParser.transpile contract): patterns
+    the NFA engine rejects run on CPU via python re."""
+    from ..expr.regex import RegexUnsupported, transpile
+    try:
+        transpile(meta.expr.pattern)
+    except RegexUnsupported as e:
+        meta.will_not_work_on_tpu(f"rlike: {e}")
+
+
+def _register_regex_rules():
+    from ..expr import regex as RX
+    _EXPR_RULES[RX.RLike] = ExprRule(RX.RLike, ts.TypeSig(ts.STRING),
+                                     _tag_rlike)
+    # extract/replace need submatch tracking: CPU-only for now — no rule
+    # registered means the tagging pass routes them to the CPU engine.
+
+
+_register_regex_rules()
+
 for _cls in (D.Year, D.Month, D.DayOfMonth, D.Quarter, D.DayOfWeek,
              D.WeekDay, D.DayOfYear, D.LastDay):
     _expr(_cls, ts.TypeSig(ts.DATE))

@@ -1,0 +1,147 @@
+"""Shuffle manager tests: serializer round trips, block catalogs, the
+three modes, exchange exec, heartbeats (SURVEY §2.7 equivalents)."""
+
+import datetime
+import decimal
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.vector import batch_from_pydict, batch_to_pydict
+from spark_rapids_tpu.conf import (SHUFFLE_COMPRESS, SHUFFLE_MODE,
+                                   SHUFFLE_PARTITIONS, SrtConf)
+from spark_rapids_tpu.exec.base import ExecContext
+from spark_rapids_tpu.exec.basic import BatchScanExec
+from spark_rapids_tpu.exec.exchange import ShuffleExchangeExec, partition_slice
+from spark_rapids_tpu.expr.core import col
+from spark_rapids_tpu.parallel.serializer import (deserialize_batch,
+                                                  serialize_batch)
+from spark_rapids_tpu.parallel.shuffle_manager import (ShuffleHeartbeatManager,
+                                                       ShuffleManager)
+
+
+def sample_batch():
+    return batch_from_pydict({
+        "i": [1, None, 3, 4, 5],
+        "f": [1.5, 2.5, None, float("nan"), -0.0],
+        "s": ["hello", "", None, "wörld", "x" * 40],
+        "d": [datetime.date(2020, 1, 1), None, datetime.date(1969, 12, 31),
+              datetime.date(2100, 1, 1), datetime.date(1970, 1, 1)],
+        "dec": [decimal.Decimal("1.23"), decimal.Decimal("-99.99"), None,
+                decimal.Decimal("0.01"), decimal.Decimal("0")],
+    }, schema=[("i", dt.INT64), ("f", dt.FLOAT64), ("s", dt.STRING),
+               ("d", dt.DATE), ("dec", dt.DecimalType(10, 2))])
+
+
+def _rows_equal(a, b):
+    if a.keys() != b.keys():
+        return False
+    for k in a:
+        for x, y in zip(a[k], b[k]):
+            if isinstance(x, float) and isinstance(y, float) and \
+                    np.isnan(x) and np.isnan(y):
+                continue
+            if x != y:
+                return False
+    return True
+
+
+@pytest.mark.parametrize("compress", [False, True])
+def test_serializer_roundtrip(compress):
+    b = sample_batch()
+    data = serialize_batch(b, compress=compress)
+    back = deserialize_batch(data)
+    assert _rows_equal(batch_to_pydict(back), batch_to_pydict(b))
+
+
+def test_serializer_strips_dead_rows():
+    b = batch_from_pydict({"v": list(range(5))}, capacity=64)
+    data = serialize_batch(b)
+    small = serialize_batch(batch_from_pydict({"v": list(range(5))},
+                                              capacity=8))
+    # capacity must not leak into the wire size (only live rows travel)
+    assert abs(len(data) - len(small)) <= 8
+
+
+def _mgr(mode, compress="NONE"):
+    return ShuffleManager(SrtConf({SHUFFLE_MODE.key: mode,
+                                   SHUFFLE_COMPRESS.key: compress}))
+
+
+@pytest.mark.parametrize("mode,codec", [("CACHE_ONLY", "NONE"),
+                                        ("MULTITHREADED", "NONE"),
+                                        ("MULTITHREADED", "ZSTD")])
+def test_manager_write_read(mode, codec):
+    mgr = _mgr(mode, codec)
+    mgr.register_shuffle(1, 3)
+    parts = [batch_from_pydict({"v": [p * 10 + i for i in range(p + 1)]})
+             for p in range(3)]
+    mgr.write_map_output(1, 0, parts)
+    mgr.write_map_output(1, 1, parts)
+    for reduce_id in range(3):
+        rows = []
+        for b in mgr.read_partition(1, reduce_id):
+            rows.extend(batch_to_pydict(b)["v"])
+        assert rows == [reduce_id * 10 + i for i in range(reduce_id + 1)] * 2
+    assert mgr.write_metrics.blocks_written == 6
+    assert mgr.unregister_shuffle(1) is None
+    assert list(mgr.read_partition(1, 0)) == []
+
+
+def test_exchange_exec_partitions_by_hash():
+    from spark_rapids_tpu.testing import gen_table, IntGen
+    data, schema = gen_table({"k": IntGen(lo=0, hi=50), "v": IntGen()},
+                             n=200, seed=9)
+    batches = [batch_from_pydict(
+        {k: v[i * 50:(i + 1) * 50] for k, v in data.items()},
+        schema=schema) for i in range(4)]
+    scan = BatchScanExec(batches, schema)
+    mgr = _mgr("CACHE_ONLY")
+    ex = ShuffleExchangeExec(scan, [col("k")], num_partitions=4,
+                             manager=mgr)
+    ctx = ExecContext()
+    out_rows = []
+    seen_keys_per_part = []
+    ex.write(ctx)
+    for rid in range(4):
+        keys = set()
+        for b in ex.read_partition(ctx, rid):
+            d = batch_to_pydict(b)
+            out_rows.extend(zip(d["k"], d["v"]))
+            keys.update(k for k in d["k"] if k is not None)
+        seen_keys_per_part.append(keys)
+    # same multiset of rows out as in
+    in_rows = list(zip(data["k"], data["v"]))
+    assert sorted(map(str, out_rows)) == sorted(map(str, in_rows))
+    # a key never lands in two partitions
+    for i in range(4):
+        for j in range(i + 1, 4):
+            assert not (seen_keys_per_part[i] & seen_keys_per_part[j])
+
+
+def test_exchange_stream_mode():
+    batches = [batch_from_pydict({"k": [1, 2, 3, 4], "v": [10, 20, 30, 40]})]
+    scan = BatchScanExec(batches, [("k", dt.INT64), ("v", dt.INT64)])
+    ex = ShuffleExchangeExec(scan, [col("k")], num_partitions=2,
+                             manager=_mgr("MULTITHREADED"))
+    rows = []
+    for b in ex.execute(ExecContext()):
+        rows.extend(batch_to_pydict(b)["v"])
+    assert sorted(rows) == [10, 20, 30, 40]
+
+
+def test_heartbeats():
+    hb = ShuffleHeartbeatManager(timeout_s=0.2)
+    peers = hb.register("exec-0", "host0:1234")
+    assert peers == []
+    peers = hb.register("exec-1", "host1:1234")
+    assert [p.executor_id for p in peers] == ["exec-0"]
+    assert hb.heartbeat("exec-0")
+    assert not hb.heartbeat("unknown")
+    assert set(hb.live_executors()) == {"exec-0", "exec-1"}
+    import time
+    time.sleep(0.25)
+    assert hb.live_executors() == []
+    assert set(hb.expire_dead()) == {"exec-0", "exec-1"}
+    assert hb.register("exec-2", "host2:9") == []

@@ -1,0 +1,175 @@
+"""Extended string functions + the regex engine (RegexParser.scala /
+stringFunctions.scala equivalents, SURVEY §2.5) — differential vs the
+CPU oracle, plus direct NFA-vs-python-re cross checks."""
+
+import re
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.expr import strings as S
+from spark_rapids_tpu.expr.core import col, lit
+from spark_rapids_tpu.expr.regex import (RLike, RegExpExtract,
+                                         RegExpReplace, RegexUnsupported,
+                                         transpile)
+from spark_rapids_tpu.plan import TpuSession
+from spark_rapids_tpu.testing import (StringGen, assert_falls_back_to_cpu,
+                                      assert_runs_on_tpu,
+                                      assert_tpu_cpu_equal_df, gen_table)
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession()
+
+
+def make_df(session, charset="abcABC 012", n=96, seed=0, max_len=10):
+    data, schema = gen_table({"s": StringGen(charset=charset,
+                                             max_len=max_len)}, n, seed)
+    return session.create_dataframe(data, schema)
+
+
+def test_reverse_initcap_pad(session):
+    df = make_df(session)
+    assert_tpu_cpu_equal_df(df.select(
+        S.Reverse(col("s")).alias("rev"),
+        S.InitCap(col("s")).alias("ic"),
+        S.Lpad(col("s"), 8, "*").alias("lp"),
+        S.Rpad(col("s"), 8, "xy").alias("rp")))
+
+
+def test_concat_ws_skips_nulls(session):
+    df = session.create_dataframe(
+        {"a": ["x", None, "y"], "b": ["1", "2", None]})
+    out = df.select(S.ConcatWs("-", col("a"), col("b"),
+                               lit("z")).alias("c")).collect()
+    assert [r["c"] for r in out] == ["x-1-z", "2-z", "y-z"]
+    assert_tpu_cpu_equal_df(df.select(
+        S.ConcatWs("-", col("a"), col("b")).alias("c")))
+
+
+def test_locate_repeat(session):
+    df = make_df(session)
+    assert_tpu_cpu_equal_df(df.select(
+        S.StringLocate(col("s"), "ab").alias("loc"),
+        S.StringLocate(col("s"), "b", start=2).alias("loc2"),
+        S.StringRepeat(col("s"), 2).alias("rep")))
+
+
+def test_replace_translate(session):
+    df = make_df(session)
+    assert_tpu_cpu_equal_df(df.select(
+        S.StringReplace(col("s"), "ab", "Z").alias("r1"),
+        S.StringReplace(col("s"), "a", "longer").alias("r2"),
+        S.StringReplace(col("s"), "c", "").alias("r3"),
+        S.StringTranslate(col("s"), "abc", "xy").alias("tr")))
+
+
+# --- regex engine ----------------------------------------------------------
+
+PATTERNS = [
+    "abc", "a.c", "a*", "a+b", "ab?c", "[abc]+", "[^ab]", "[a-c0-2]+",
+    "a|bc|d", "(ab)+c", "(?:a|b)c", "a{2}", "a{2,}b", "a{1,3}c",
+    r"\d+", r"\w+\s\w+", r"\S+", "^ab", "ab$", "^a.*c$", "a.*b",
+    "", "^$", ".*", "x", "[abc]{2,4}$",
+]
+
+
+@pytest.mark.parametrize("pattern", PATTERNS)
+def test_nfa_matches_python_re(session, pattern):
+    """The vectorized NFA must agree with python re.search on every
+    supported pattern over adversarial inputs."""
+    import spark_rapids_tpu  # noqa: F401
+    from spark_rapids_tpu.columnar.vector import batch_from_pydict
+    from spark_rapids_tpu.expr.regex import _simulate
+    strings = ["", "a", "b", "ab", "abc", "abcc", "aabbcc", "xaby",
+               "cba", "a c", "ab cd", "0123", "aaa", "abab", "x", "ac",
+               "aaac", "bc", "d", "aXc"]
+    batch = batch_from_pydict({"s": strings})
+    rx = transpile(pattern)
+    got = np.asarray(_simulate(rx, batch.column("s")))[:len(strings)]
+    want = [re.search(pattern, s) is not None for s in strings]
+    assert list(got) == want, (pattern, list(zip(strings, got, want)))
+
+
+def test_rlike_differential(session):
+    df = make_df(session, charset="abc01 ", n=128)
+    assert_tpu_cpu_equal_df(df.select(
+        RLike(col("s"), "a+b").alias("m1"),
+        RLike(col("s"), r"\d\d").alias("m2"),
+        RLike(col("s"), "^[ab]").alias("m3")))
+
+
+def test_rlike_runs_on_tpu(session):
+    df = make_df(session, n=32)
+    assert_runs_on_tpu(df.select(RLike(col("s"), "a.c").alias("m")))
+
+
+def test_unsupported_regex_falls_back(session):
+    with pytest.raises(RegexUnsupported):
+        transpile(r"(a)\1")  # backreference
+    with pytest.raises(RegexUnsupported):
+        transpile(r"a(?=b)")  # lookahead
+    with pytest.raises(RegexUnsupported):
+        transpile(r"\bword\b")  # word boundary
+    df = make_df(session, n=32)
+    q = df.select(RLike(col("s"), r"a(?=b)").alias("m"))
+    assert_falls_back_to_cpu(q, "rlike")
+
+
+def test_regexp_extract_replace_cpu(session):
+    """extract/replace are CPU-engine expressions (no TPU rule yet):
+    results must flow through fallback and match python re."""
+    df = session.create_dataframe(
+        {"s": ["foo123bar", "no digits", "9x8", None]})
+    q = df.select(
+        RegExpExtract(col("s"), r"(\d+)", 1).alias("ex"),
+        RegExpReplace(col("s"), r"\d+", "#").alias("rp"))
+    out = q.collect()
+    assert [r["ex"] for r in out] == ["123", "", "9", None]
+    assert [r["rp"] for r in out] == ["foo#bar", "no digits", "#x#", None]
+    assert_falls_back_to_cpu(q, "no TPU")
+
+
+def test_anchor_with_alternation_falls_back(session):
+    """'a|b$' scopes '$' to the 'b' branch in Java — the NFA can't
+    express that yet, so it must REJECT (fallback), not mis-match."""
+    with pytest.raises(RegexUnsupported):
+        transpile("a|b$")
+    with pytest.raises(RegexUnsupported):
+        transpile("^a|b")
+    df = session.create_dataframe({"s": ["ax", "cb", "b"]})
+    q = df.select(RLike(col("s"), "a|b$").alias("m"))
+    assert_falls_back_to_cpu(q, "rlike")
+    assert [r["m"] for r in q.collect()] == [True, True, True]
+
+
+def test_cpu_regex_is_ascii():
+    """CPU engine must use Java's ASCII classes, matching the TPU NFA."""
+    from spark_rapids_tpu.plan.cpu_eval import _java_like_re
+    assert _java_like_re(r"\d").search("٣") is None  # Arabic-Indic digit
+    assert _java_like_re(r"\d").search("7") is not None
+
+
+def test_translate_rejects_non_ascii_dst(session):
+    with pytest.raises(TypeError):
+        S.StringTranslate(col("s"), "a", "ā")
+
+
+def test_locate_start_zero(session):
+    df = session.create_dataframe({"s": ["abc", ""]})
+    out = df.select(
+        S.StringLocate(col("s"), "a", start=0).alias("l0"),
+        S.StringLocate(col("s"), "", start=0).alias("le")).collect()
+    assert [r["l0"] for r in out] == [0, 0]
+    assert [r["le"] for r in out] == [0, 0]
+    assert_tpu_cpu_equal_df(df.select(
+        S.StringLocate(col("s"), "a", start=0).alias("l0")))
+
+
+def test_concat_ws_non_string_children(session):
+    df = session.create_dataframe({"b": [True, False], "i": [1, 2]})
+    q = df.select(S.ConcatWs("-", col("b"), col("i")).alias("c"))
+    assert [r["c"] for r in q.collect()] == ["true-1", "false-2"]
+    assert_tpu_cpu_equal_df(q)

@@ -182,3 +182,15 @@ def test_range_running_frame_peers(session):
     assert by_v == {1: 3, 2: 3, 3: 15, 4: 15, 5: 15, 6: 21}
     assert_tpu_cpu_equal_df(
         df.select("o", "v", Sum(col("v")).over(w).alias("rs")))
+
+
+def test_default_frame_is_range_running(session):
+    """Spark's default frame with ORDER BY is RANGE running: tied order
+    keys share the cumulative value."""
+    df = session.create_dataframe(
+        {"k": [1] * 4, "o": [1, 1, 2, 2], "v": [1, 2, 3, 4]})
+    w = Window.partition_by("k").order_by("o")
+    out = df.select("v", Sum(col("v")).over(w).alias("rs")).collect()
+    by_v = {r["v"]: r["rs"] for r in out}
+    assert by_v == {1: 3, 2: 3, 3: 10, 4: 10}
+    assert_tpu_cpu_equal_df(df.select("v", Sum(col("v")).over(w).alias("rs")))
