@@ -1,0 +1,64 @@
+"""Cached batch serializer: df.cache() as compressed host blocks.
+
+Rebuild of ParquetCachedBatchSerializer.scala (SURVEY §2.8, 1407 LoC):
+the reference stores df.cache() data as parquet-encoded blobs that the
+GPU can (de)compress; here cached plans materialize once into the
+framework's own wire format (parallel/serializer.py) with the native
+LZ4 codec — compressed host memory, re-uploaded in capacity-bucketed
+batches on each reuse.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .columnar.vector import ColumnarBatch
+from .plan import logical as L
+from .plan.host_table import batch_to_table, table_to_batch
+from .parallel.serializer import deserialize_batch, serialize_batch
+
+
+class CachedRelation(L.LogicalPlan):
+    """Leaf node holding the materialized, compressed result."""
+
+    def __init__(self, blocks: List[bytes], schema, num_rows: int):
+        super().__init__()
+        self.blocks = blocks
+        self._schema = list(schema)
+        self.num_rows = num_rows
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def batches(self) -> List[ColumnarBatch]:
+        return [deserialize_batch(b) for b in self.blocks]
+
+    def node_description(self) -> str:
+        nbytes = sum(len(b) for b in self.blocks)
+        return (f"CachedRelation[{self.num_rows} rows, "
+                f"{len(self.blocks)} blocks, {nbytes}B]")
+
+
+def cache_dataframe(df):
+    """Materialize df's plan once; return a DataFrame over the cache."""
+    from .native import native_available
+    from .plan.session import DataFrame
+    codec = "lz4" if native_available() else "zstd"
+    table = df.session.execute(df.plan)
+    # one block per target batch size so reuse re-batches sanely
+    from .conf import BATCH_SIZE_ROWS
+    per = df.session.conf.get(BATCH_SIZE_ROWS)
+    import numpy as np
+    blocks = []
+    n = table.num_rows
+    for start in range(0, max(n, 1), per):
+        chunk = table.take(np.arange(start, min(start + per, n)))
+        if chunk.num_rows == 0 and start > 0:
+            break
+        blocks.append(serialize_batch(table_to_batch(chunk),
+                                      compress=True, codec=codec))
+    rel = CachedRelation(blocks, df.plan.schema, n)
+    return DataFrame(df.session, rel)
+
+

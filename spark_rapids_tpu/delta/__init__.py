@@ -1,0 +1,21 @@
+"""ACID table format: transaction log + DML (the Delta Lake layer).
+
+Rebuild of the reference's delta-lake/ integration (36k LoC across
+version shims, SURVEY §2.6): GpuOptimisticTransaction,
+GpuMergeIntoCommand, GpuUpdateCommand, GpuDeleteCommand — as a
+first-party table format over the framework's own parquet writer
+instead of a plugin into someone else's. Same architecture:
+
+- an append-only ``_delta_log`` of JSON commit files; a snapshot is the
+  fold of add/remove actions up to a version (time travel = fold to an
+  older version),
+- optimistic concurrency: commit N is an O_EXCL create of
+  ``N.json`` — losers re-read, re-validate, retry,
+- DML rewrites data files copy-on-write and commits add+remove pairs
+  atomically.
+"""
+
+from .log import CommitConflict, TransactionLog
+from .table import AcidTable
+
+__all__ = ["AcidTable", "TransactionLog", "CommitConflict"]

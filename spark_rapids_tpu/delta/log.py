@@ -1,0 +1,120 @@
+"""Transaction log: ordered JSON commits with optimistic concurrency.
+
+The GpuOptimisticTransaction equivalent (delta-lake/.../
+GpuOptimisticTransaction.scala): writers prepare actions against a read
+snapshot, then race to create the next numbered commit file with
+O_CREAT|O_EXCL (the filesystem is the arbiter, like Delta's LogStore
+contract). A loser whose read snapshot went stale raises
+CommitConflict; idempotent retries re-validate against the new head.
+
+Action vocabulary (one JSON object per line, Delta-style):
+  {"metaData": {"schemaString": ..., "partitionColumns": [...]}}
+  {"add":    {"path": ..., "numRecords": N, "dataChange": true}}
+  {"remove": {"path": ..., "dataChange": true}}
+  {"commitInfo": {"operation": ..., "timestamp": ...}}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+class CommitConflict(RuntimeError):
+    """Another writer committed the version this transaction targeted."""
+
+
+class TransactionLog:
+    def __init__(self, table_path: str):
+        self.table_path = table_path
+        self.log_dir = os.path.join(table_path, "_delta_log")
+
+    def exists(self) -> bool:
+        return os.path.isdir(self.log_dir)
+
+    # --- reading ---
+    def versions(self) -> List[int]:
+        if not self.exists():
+            return []
+        out = []
+        for f in os.listdir(self.log_dir):
+            if f.endswith(".json"):
+                try:
+                    out.append(int(f[:-5]))
+                except ValueError:
+                    pass
+        return sorted(out)
+
+    def latest_version(self) -> int:
+        vs = self.versions()
+        return vs[-1] if vs else -1
+
+    def read_actions(self, version: int) -> List[dict]:
+        path = os.path.join(self.log_dir, f"{version:020d}.json")
+        with open(path) as f:
+            return [json.loads(line) for line in f if line.strip()]
+
+    def snapshot(self, version: Optional[int] = None
+                 ) -> Tuple[dict, Dict[str, dict]]:
+        """Fold the log to (metadata, {path: add_action}) at ``version``
+        (default: head). Time travel = pass an older version."""
+        head = self.latest_version()
+        if head < 0:
+            raise FileNotFoundError(f"no table at {self.table_path}")
+        v = head if version is None else version
+        if v > head:
+            raise ValueError(f"version {v} > latest {head}")
+        meta: dict = {}
+        files: Dict[str, dict] = {}
+        for ver in self.versions():
+            if ver > v:
+                break
+            for action in self.read_actions(ver):
+                if "metaData" in action:
+                    meta = action["metaData"]
+                elif "add" in action:
+                    files[action["add"]["path"]] = action["add"]
+                elif "remove" in action:
+                    files.pop(action["remove"]["path"], None)
+        return meta, files
+
+    # --- writing ---
+    def commit(self, read_version: int, actions: List[dict],
+               operation: str) -> int:
+        """Atomically commit as version read_version+1; CommitConflict if
+        that version exists (optimistic loser)."""
+        os.makedirs(self.log_dir, exist_ok=True)
+        version = read_version + 1
+        payload = list(actions)
+        payload.append({"commitInfo": {
+            "operation": operation,
+            "timestamp": int(time.time() * 1000),
+            "readVersion": read_version,
+        }})
+        path = os.path.join(self.log_dir, f"{version:020d}.json")
+        tmp = path + f".{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            for a in payload:
+                f.write(json.dumps(a) + "\n")
+        try:
+            # O_EXCL link: the filesystem arbitrates the race
+            os.link(tmp, path)
+        except FileExistsError:
+            raise CommitConflict(
+                f"version {version} already committed "
+                f"(read snapshot {read_version} is stale)")
+        finally:
+            os.unlink(tmp)
+        return version
+
+    def history(self) -> List[dict]:
+        out = []
+        for v in self.versions():
+            for a in self.read_actions(v):
+                if "commitInfo" in a:
+                    info = dict(a["commitInfo"])
+                    info["version"] = v
+                    out.append(info)
+        return out

@@ -1,0 +1,268 @@
+"""AcidTable: DML over the transaction log.
+
+The GpuMergeIntoCommand / GpuUpdateCommand / GpuDeleteCommand layer
+(delta-lake/delta-24x/..., SURVEY §2.6). All DML is copy-on-write:
+affected files are rewritten through the TPU engine (scan -> filter/
+project/join on device -> parquet writer) and the log commits the
+add/remove pairs in one atomic version.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import uuid
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..columnar import dtypes as dt
+from ..expr.conditional import If
+from ..expr.core import Alias, ColumnRef, Expression, col, lit
+from ..expr.predicates import Not
+# plan must initialize before io (io's scan registration reaches back
+# into the plan package mid-import otherwise)
+from ..plan import logical as L
+from ..plan.host_table import HostTable, concat_tables, empty_like
+from ..io.scan import FileScan
+from ..io.writer import write_host_table
+from .log import CommitConflict, TransactionLog
+
+
+def _schema_to_json(schema) -> str:
+    return json.dumps([[n, repr(t) if not isinstance(t, dt.DecimalType)
+                        else f"decimal({t.precision},{t.scale})"]
+                       for n, t in schema])
+
+
+def _schema_from_json(s: str):
+    from ..parallel.serializer import _tag_dtype
+    return [(n, _tag_dtype(tag)) for n, tag in json.loads(s)]
+
+
+class AcidTable:
+    """A transactional parquet table (DeltaTable API shape)."""
+
+    def __init__(self, session, path: str):
+        self.session = session
+        self.path = path
+        self.log = TransactionLog(path)
+
+    # --- creation ---
+    @classmethod
+    def create(cls, session, path: str, schema) -> "AcidTable":
+        t = cls(session, path)
+        if t.log.exists():
+            raise FileExistsError(f"table exists at {path}")
+        os.makedirs(path, exist_ok=True)
+        t.log.commit(-1, [{"metaData": {
+            "schemaString": _schema_to_json(schema),
+            "partitionColumns": [],
+        }}], "CREATE TABLE")
+        return t
+
+    @classmethod
+    def for_path(cls, session, path: str) -> "AcidTable":
+        t = cls(session, path)
+        if not t.log.exists():
+            raise FileNotFoundError(f"no table at {path}")
+        return t
+
+    # --- reads ---
+    def schema(self, version: Optional[int] = None):
+        meta, _ = self.log.snapshot(version)
+        return _schema_from_json(meta["schemaString"])
+
+    def files(self, version: Optional[int] = None) -> List[str]:
+        _, files = self.log.snapshot(version)
+        return sorted(os.path.join(self.path, p) for p in files)
+
+    def to_df(self, version: Optional[int] = None):
+        from ..plan.session import DataFrame
+        schema = self.schema(version)
+        files = self.files(version)
+        if not files:
+            return self.session.create_dataframe(
+                {n: [] for n, _ in schema}, schema)
+        return DataFrame(self.session,
+                         FileScan(files, "parquet", schema))
+
+    def version(self) -> int:
+        return self.log.latest_version()
+
+    def history(self) -> List[dict]:
+        return self.log.history()
+
+    # --- writes ---
+    def _write_files(self, table: HostTable) -> List[dict]:
+        """Write one parquet file per call (plus stats) -> add actions."""
+        if table.num_rows == 0:
+            return []
+        fname = f"part-{uuid.uuid4().hex[:12]}.parquet"
+        from ..io.arrow_convert import host_table_to_arrow
+        import pyarrow.parquet as pq
+        at = host_table_to_arrow(table)
+        full = os.path.join(self.path, fname)
+        pq.write_table(at, full)
+        return [{"add": {"path": fname, "numRecords": table.num_rows,
+                         "dataChange": True}}]
+
+    def _commit_blind(self, actions: List[dict], operation: str,
+                      retries: int = 3) -> int:
+        """Snapshot-independent commits (append): retrying the same
+        actions against a newer head is safe."""
+        for attempt in range(retries + 1):
+            read_v = self.log.latest_version()
+            try:
+                return self.log.commit(read_v, actions, operation)
+            except CommitConflict:
+                if attempt == retries:
+                    raise
+        raise AssertionError("unreachable")
+
+    def _commit_rewrite(self, build_actions, operation: str,
+                        retries: int = 3) -> int:
+        """Copy-on-write commits: ``build_actions(read_version)`` must
+        read the CURRENT snapshot and return its actions — on conflict
+        the whole rewrite recomputes against the winner's table state
+        (optimistic losers must not replay stale file sets)."""
+        for attempt in range(retries + 1):
+            read_v = self.log.latest_version()
+            actions = build_actions(read_v)
+            try:
+                return self.log.commit(read_v, actions, operation)
+            except CommitConflict:
+                if attempt == retries:
+                    raise
+        raise AssertionError("unreachable")
+
+    def _remove_all_current(self, read_v: int) -> List[dict]:
+        _, files = self.log.snapshot(read_v)
+        return [{"remove": {"path": p, "dataChange": True}}
+                for p in files]
+
+    def append(self, df) -> int:
+        table = self.session.execute(df.plan)
+        actions = self._write_files(table)
+        return self._commit_blind(actions, "WRITE (append)")
+
+    def overwrite(self, df) -> int:
+        table = self.session.execute(df.plan)
+
+        def build(read_v: int) -> List[dict]:
+            return self._remove_all_current(read_v) + \
+                self._write_files(table)
+        return self._commit_rewrite(build, "WRITE (overwrite)")
+
+    def delete(self, condition: Expression) -> int:
+        """DELETE WHERE cond (GpuDeleteCommand): rewrite surviving rows."""
+
+        def build(read_v: int) -> List[dict]:
+            keep = self.to_df(version=read_v).filter(Not(condition))
+            table = self.session.execute(keep.plan)
+            return self._remove_all_current(read_v) + \
+                self._write_files(table)
+        return self._commit_rewrite(build, "DELETE")
+
+    def update(self, set_exprs: Dict[str, Expression],
+               condition: Optional[Expression] = None) -> int:
+        """UPDATE SET col=expr [WHERE cond] (GpuUpdateCommand)."""
+        cond = condition if condition is not None else lit(True)
+
+        def build(read_v: int) -> List[dict]:
+            df = self.to_df(version=read_v)
+            projected = []
+            for name, t in self.schema(read_v):
+                if name in set_exprs:
+                    e = If(cond, set_exprs[name], col(name))
+                    if e.data_type(df.schema) != t:
+                        e = e.cast(t)
+                    projected.append(Alias(e, name))
+                else:
+                    projected.append(col(name))
+            table = self.session.execute(L.Project(df.plan, projected))
+            return self._remove_all_current(read_v) + \
+                self._write_files(table)
+        return self._commit_rewrite(build, "UPDATE")
+
+    def merge(self, source, on: Sequence[str],
+              when_matched_update: Optional[Dict[str, Expression]] = None,
+              when_matched_delete: bool = False,
+              when_not_matched_insert: bool = True) -> int:
+        """MERGE INTO target USING source ON target.k = source.k
+        (GpuMergeIntoCommand shape):
+
+        - matched + update: matched target rows take source-side values
+          from ``when_matched_update`` ({target_col: expr over source
+          columns prefixed 'src_'}),
+        - matched + delete: matched target rows drop,
+        - not matched + insert: source rows absent from the target
+          insert (columns matched by name).
+        """
+        if when_matched_update and when_matched_delete:
+            raise ValueError("update and delete are mutually exclusive")
+        src_renamed = source.select(
+            *[Alias(col(n), f"src_{n}") for n in source.columns])
+        lk = [col(n) for n in on]
+        rk = [col(f"src_{n}") for n in on]
+
+        # Delta contract: a target row may match at most one source row
+        from ..expr.aggregates import CountStar, Max
+        dup = source.group_by(*[col(n) for n in on]).agg(
+            Alias(CountStar(), "__n")).filter(col("__n") > 1)
+        if dup.count() > 0:
+            raise ValueError(
+                "MERGE: multiple source rows matched the same key")
+
+        def build(read_v: int) -> List[dict]:
+            target_df = self.to_df(version=read_v)
+            schema = self.schema(read_v)
+            if when_matched_delete:
+                matched_part = None  # matched rows vanish
+            elif when_matched_update:
+                joined = L.Join(target_df.plan, src_renamed.plan, lk, rk,
+                                "inner")
+                projected = []
+                for name, t in schema:
+                    e = when_matched_update.get(name, col(name))
+                    if e.data_type(joined.schema) != t:
+                        e = e.cast(t)
+                    projected.append(Alias(e, name))
+                matched_part = L.Project(joined, projected)
+            else:
+                matched_part = None
+
+            # target rows with no source match survive unchanged
+            unmatched_target = L.Join(target_df.plan, src_renamed.plan,
+                                      lk, rk, "left_anti")
+            parts = [unmatched_target]
+            if matched_part is not None:
+                parts.append(matched_part)
+            if when_not_matched_insert:
+                unmatched_src = L.Join(
+                    src_renamed.plan, target_df.plan, rk, lk, "left_anti")
+                insert_cols = []
+                src_cols = set(source.columns)
+                for name, t in schema:
+                    if name in src_cols:
+                        e = col(f"src_{name}")
+                        if e.data_type(unmatched_src.schema) != t:
+                            e = e.cast(t)
+                        insert_cols.append(Alias(e, name))
+                    else:
+                        insert_cols.append(Alias(lit(None, t), name))
+                parts.append(L.Project(unmatched_src, insert_cols))
+            plan = parts[0] if len(parts) == 1 else L.Union(*parts)
+            table = self.session.execute(plan)
+            return self._remove_all_current(read_v) + \
+                self._write_files(table)
+        return self._commit_rewrite(build, "MERGE")
+
+    def vacuum(self) -> List[str]:
+        """Delete data files no longer referenced by the head snapshot."""
+        _, files = self.log.snapshot()
+        live = set(files)
+        removed = []
+        for f in os.listdir(self.path):
+            if f.endswith(".parquet") and f not in live:
+                os.unlink(os.path.join(self.path, f))
+                removed.append(f)
+        return removed

@@ -1262,3 +1262,52 @@ def _regexp_replace(expr, table):
     out = np.array([prog.sub(repl, x) for x in a], dtype=object) \
         if len(a) else np.empty(0, object)
     return np.where(m, out, ""), m
+
+
+# ---------------------------------------------------------------------------
+# interpreted python UDFs (udf/python_udf.py) — CPU-only row loop, the
+# numpy stand-in for the reference's Arrow/Pandas worker path
+# ---------------------------------------------------------------------------
+
+def _register_python_udf():
+    from ..udf.python_udf import PythonUDF
+    from ..columnar.vector import _to_physical, from_physical
+
+    @_reg(PythonUDF)
+    def _python_udf(expr, table):
+        n = table.num_rows
+        schema = table.schema()
+        children = []
+        for c in expr.children:
+            v, m = _ev(c, table)
+            t = c.data_type(schema)
+            children.append((v, m, t))
+        out_t = expr.return_type
+        if out_t == dt.STRING:
+            out = np.full(n, "", dtype=object)
+        else:
+            out = np.zeros(n, np.dtype(out_t.physical))
+        mask = np.zeros(n, bool)
+        for i in range(n):
+            args = []
+            for v, m, t in children:
+                if not m[i]:
+                    args.append(None)
+                elif t == dt.STRING:
+                    args.append(v[i])
+                else:
+                    args.append(from_physical(v[i], t))
+            try:
+                r = expr.fn(*args)
+            except (ZeroDivisionError, ValueError, OverflowError,
+                    ArithmeticError):
+                r = None  # data error -> null (non-ANSI UDF semantics);
+                # programming errors (TypeError/NameError/...) propagate
+            if r is None:
+                continue
+            mask[i] = True
+            out[i] = r if out_t == dt.STRING else _to_physical(r, out_t)
+        return _zero_nulls(out, mask), mask
+
+
+_register_python_udf()

@@ -40,6 +40,12 @@ def apply_cpu_node(plan: LogicalPlan,
     (transitions.py wraps TPU subtrees so they appear as child tables)."""
     if isinstance(plan, LocalRelation):
         return from_pydict(plan.data, plan.schema)
+    from ..cache import CachedRelation
+    if isinstance(plan, CachedRelation):
+        from .host_table import batch_to_table
+        tables = [batch_to_table(b) for b in plan.batches()
+                  if int(b.num_rows) > 0]
+        return concat_tables(tables) if tables else empty_like(plan.schema)
     from ..io.scan import FileScan
     if isinstance(plan, FileScan):
         from ..io.scan import read_file_to_tables

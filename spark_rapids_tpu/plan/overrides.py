@@ -287,7 +287,9 @@ def _tag_window(meta: PlanMeta):
 
 
 def _register_exec_rules():
+    from ..cache import CachedRelation
     from ..io.scan import FileScan
+    _EXEC_RULES[CachedRelation] = ExecRule(CachedRelation)
     _EXEC_RULES.update({
         LocalRelation: ExecRule(LocalRelation),
         Range: ExecRule(Range),
@@ -310,7 +312,10 @@ _register_exec_rules()
 # --- conversion ------------------------------------------------------------
 
 def _build_tpu_exec(plan: LogicalPlan, children: List[TpuExec]) -> TpuExec:
+    from ..cache import CachedRelation
     from ..io.scan import FileScan, FileSourceScanExec
+    if isinstance(plan, CachedRelation):
+        return BatchScanExec(plan.batches(), plan.schema)
     if isinstance(plan, FileScan):
         return FileSourceScanExec(plan)
     if isinstance(plan, (LocalRelation, Range)) :

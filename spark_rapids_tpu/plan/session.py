@@ -100,6 +100,15 @@ def _to_expr(c) -> Expression:
     return lit(c)
 
 
+class DeviceColumns(dict):
+    """Mapping of {name: (data, validity)} device arrays with the live
+    row count — arrays are capacity-padded past ``num_rows``."""
+
+    def __init__(self, cols: dict, num_rows: int):
+        super().__init__(cols)
+        self.num_rows = num_rows
+
+
 def _extract_windows(plan: L.LogicalPlan, exprs):
     """Pull WindowExpressions out of a projection list into Window nodes
     (the analyzer step Spark performs for window functions in select):
@@ -251,6 +260,43 @@ class DataFrame:
     def write(self):
         from ..io.writer import DataFrameWriter
         return DataFrameWriter(self)
+
+    def cache(self) -> "DataFrame":
+        """Materialize once into compressed host blocks; further use
+        re-reads the cache (ParquetCachedBatchSerializer role)."""
+        from ..cache import cache_dataframe
+        return cache_dataframe(self)
+
+    def to_device_arrays(self) -> "DeviceColumns":
+        """Zero-copy ML export (ColumnarRdd.scala:42 role — the
+        reference hands cuDF tables to XGBoost; here downstream jax ML
+        code consumes the columns directly). Returns a DeviceColumns:
+        mapping of {name: (data jax.Array, validity)} plus ``num_rows``
+        — arrays are capacity-padded, so consumers MUST slice to
+        num_rows (padding rows are indistinguishable from nulls by
+        validity alone)."""
+        from .. import ops  # noqa: F401
+        from ..exec.base import ExecContext, TpuExec
+        from ..ops import kernels as K
+        from ..columnar.vector import choose_capacity
+        from . import overrides as O
+        physical = O.apply_overrides(self.plan, self.session.conf)
+        ctx = ExecContext(self.session.conf)
+        if isinstance(physical, TpuExec):
+            batches = [b for b in physical.execute(ctx)
+                       if int(b.num_rows) > 0]
+        else:
+            from .host_table import table_to_batch
+            batches = [table_to_batch(physical.evaluate(ctx))]
+        if not batches:
+            return DeviceColumns({}, 0)
+        total = sum(int(b.num_rows) for b in batches)
+        merged = batches[0] if len(batches) == 1 else \
+            K.concat_batches(batches, choose_capacity(total))
+        cols = {name: (c.data if not hasattr(c, "chars") else
+                       (c.offsets, c.chars), c.validity)
+                for name, c in zip(merged.names, merged.columns)}
+        return DeviceColumns(cols, int(merged.num_rows))
 
     def explain(self, mode: str = "ALL") -> str:
         meta = overrides.tag_only(self.plan)

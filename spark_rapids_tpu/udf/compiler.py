@@ -1,0 +1,355 @@
+"""Symbolic executor over CPython bytecode (3.11-3.13 opcode surface).
+
+The CatalystExpressionBuilder/CFG/State equivalent (udf-compiler/.../
+CatalystExpressionBuilder.scala:35, CFG.scala, State.scala): a symbolic
+stack machine where every slot holds an Expression. Conditional jumps
+recursively execute both successors and join at RETURN with
+``If(cond, then_value, else_value)`` — the standard tail-duplication
+formulation (exponential only in branch nesting, bounded by
+_MAX_BRANCH_DEPTH).
+"""
+
+from __future__ import annotations
+
+import dis
+import math
+from typing import Callable, Dict, List, Optional
+
+from ..columnar import dtypes as dt
+from ..expr import mathfns as M
+from ..expr import strings as S
+from ..expr.arithmetic import (Abs, Add, Divide, Greatest, IntegralDivide,
+                               Least, Multiply, Pmod, Remainder, Subtract,
+                               UnaryMinus)
+from ..expr.conditional import If
+from ..expr.core import Expression, Literal, col
+from ..expr.predicates import (And, EqualTo, GreaterThan,
+                               GreaterThanOrEqual, InSet, IsNotNull, IsNull,
+                               LessThan, LessThanOrEqual, Not, Or)
+
+_MAX_BRANCH_DEPTH = 12
+
+
+class UdfCompileError(TypeError):
+    """The function uses a construct the compiler can't translate."""
+
+
+class _Marker:
+    """Non-expression stack values (modules, bound methods, callables)."""
+
+    def __init__(self, kind: str, payload=None, extra=None):
+        self.kind = kind
+        self.payload = payload
+        self.extra = extra
+
+
+_BINARY = {
+    "+": Add, "-": Subtract, "*": Multiply, "/": Divide,
+    "//": IntegralDivide, "%": Remainder, "**": None,
+}
+
+_COMPARE = {
+    "<": LessThan, "<=": LessThanOrEqual, ">": GreaterThan,
+    ">=": GreaterThanOrEqual, "==": EqualTo,
+}
+
+# callables resolvable from globals/builtins
+_GLOBAL_FUNCS: Dict[object, Callable] = {
+    abs: lambda a: Abs(a),
+    min: lambda *a: Least(*a),
+    max: lambda *a: Greatest(*a),
+    len: lambda a: S.Length(a),
+    math.sqrt: lambda a: M.Sqrt(a),
+    math.exp: lambda a: M.Exp(a),
+    math.log: lambda a: M.Log(a),
+    math.log10: lambda a: M.Log10(a),
+    math.log2: lambda a: M.Log2(a),
+    math.sin: lambda a: M.Sin(a),
+    math.cos: lambda a: M.Cos(a),
+    math.tan: lambda a: M.Tan(a),
+    math.floor: lambda a: M.Floor(a),
+    math.ceil: lambda a: M.Ceil(a),
+    math.pow: lambda a, b: M.Pow(a, b),
+    math.atan2: lambda a, b: M.Atan2(a, b),
+    math.hypot: lambda a, b: M.Hypot(a, b),
+    round: lambda a, *s: M.Round(a, s[0].value if s else 0),
+}
+
+# str methods: name -> builder(expr, *literal_args)
+_STR_METHODS: Dict[str, Callable] = {
+    "upper": lambda e: S.Upper(e),
+    "lower": lambda e: S.Lower(e),
+    "strip": lambda e: S.StringTrim(e),
+    "lstrip": lambda e: S.StringTrimLeft(e),
+    "rstrip": lambda e: S.StringTrimRight(e),
+    "startswith": lambda e, p: S.StartsWith(e, _const_str(p)),
+    "endswith": lambda e, p: S.EndsWith(e, _const_str(p)),
+    "replace": lambda e, a, b: S.StringReplace(e, _const_str(a),
+                                               _const_str(b)),
+    "find": lambda e, p: Add(S.StringLocate(e, _const_str(p)),
+                             Literal(-1)),
+}
+
+
+def _const_str(e) -> str:
+    if isinstance(e, Literal) and isinstance(e.value, str):
+        return e.value
+    raise UdfCompileError("string-method argument must be a constant")
+
+
+def _to_expr(v) -> Expression:
+    if isinstance(v, Expression):
+        return v
+    if isinstance(v, _Marker):
+        raise UdfCompileError(f"cannot use {v.kind} as a value")
+    return Literal(v)
+
+
+class _Compiler:
+    def __init__(self, fn: Callable, arg_exprs: List[Expression]):
+        self.fn = fn
+        code = fn.__code__
+        if code.co_argcount != len(arg_exprs):
+            raise UdfCompileError(
+                f"UDF takes {code.co_argcount} args, got "
+                f"{len(arg_exprs)}")
+        if code.co_flags & 0x08 or code.co_flags & 0x04:
+            raise UdfCompileError("*args/**kwargs not supported")
+        self.locals: Dict[str, Expression] = {
+            code.co_varnames[i]: arg_exprs[i]
+            for i in range(code.co_argcount)}
+        self.instrs = list(dis.get_instructions(fn))
+        self.by_offset = {i.offset: idx
+                          for idx, i in enumerate(self.instrs)}
+
+    def run(self) -> Expression:
+        return self._exec(0, [], dict(self.locals), 0)
+
+    def _fail(self, instr, why: str = ""):
+        raise UdfCompileError(
+            f"unsupported bytecode {instr.opname} "
+            f"{instr.argrepr or ''} {why}".strip())
+
+    def _resolve_global(self, name: str):
+        g = self.fn.__globals__
+        if name in g:
+            return g[name]
+        import builtins
+        if hasattr(builtins, name):
+            return getattr(builtins, name)
+        raise UdfCompileError(f"unresolvable global {name!r}")
+
+    def _exec(self, idx: int, stack: list, local_vars: dict,
+              depth: int) -> Expression:
+        if depth > _MAX_BRANCH_DEPTH:
+            raise UdfCompileError("branch nesting too deep")
+        while idx < len(self.instrs):
+            ins = self.instrs[idx]
+            op = ins.opname
+            if op in ("RESUME", "NOP", "CACHE", "PRECALL",
+                      "TO_BOOL", "COPY_FREE_VARS", "PUSH_NULL",
+                      "NOT_TAKEN"):
+                pass
+            elif op == "LOAD_FAST" or op == "LOAD_FAST_CHECK" or \
+                    op == "LOAD_FAST_BORROW":
+                if ins.argval not in local_vars:
+                    raise UdfCompileError(
+                        f"uninitialized local {ins.argval!r}")
+                stack.append(local_vars[ins.argval])
+            elif op == "STORE_FAST":
+                local_vars[ins.argval] = _to_expr(stack.pop())
+            elif op == "LOAD_FAST_LOAD_FAST":  # 3.13 superinstruction
+                n1, n2 = ins.argval
+                for nm in (n1, n2):
+                    if nm not in local_vars:
+                        raise UdfCompileError(
+                            f"uninitialized local {nm!r}")
+                stack.append(local_vars[n1])
+                stack.append(local_vars[n2])
+            elif op == "STORE_FAST_LOAD_FAST":  # 3.13
+                n1, n2 = ins.argval
+                local_vars[n1] = _to_expr(stack.pop())
+                stack.append(local_vars[n2])
+            elif op == "STORE_FAST_STORE_FAST":  # 3.13
+                n1, n2 = ins.argval
+                local_vars[n1] = _to_expr(stack.pop())
+                local_vars[n2] = _to_expr(stack.pop())
+            elif op == "LOAD_CONST":
+                v = ins.argval
+                if v is None or isinstance(v, (bool, int, float, str)):
+                    stack.append(Literal(v) if v is not None
+                                 else Literal(None))
+                elif isinstance(v, tuple):
+                    stack.append(_Marker("const_tuple", v))
+                else:
+                    self._fail(ins, f"const {type(v).__name__}")
+            elif op in ("LOAD_GLOBAL", "LOAD_NAME"):
+                obj = self._resolve_global(ins.argval)
+                if ins.argrepr.startswith("NULL + "):
+                    stack.append(_Marker("null"))  # callable marker slot
+                stack.append(_Marker("global", obj))
+            elif op == "LOAD_DEREF":
+                # closure cell (e.g. a module imported in the enclosing
+                # test/function scope)
+                code = self.fn.__code__
+                free = code.co_freevars
+                if ins.argval in free and self.fn.__closure__:
+                    cell = self.fn.__closure__[free.index(ins.argval)]
+                    v = cell.cell_contents
+                    if isinstance(v, (bool, int, float, str)):
+                        stack.append(Literal(v))
+                    else:
+                        stack.append(_Marker("global", v))
+                else:
+                    self._fail(ins)
+            elif op in ("LOAD_ATTR", "LOAD_METHOD"):
+                base = stack.pop()
+                if isinstance(base, _Marker) and base.kind == "global":
+                    # module attr (math.sqrt)
+                    stack.append(_Marker(
+                        "global", getattr(base.payload, ins.argval)))
+                elif isinstance(base, Expression):
+                    # method on an expression (str methods)
+                    stack.append(_Marker("method", base,
+                                         extra=ins.argval))
+                else:
+                    self._fail(ins)
+            elif op == "BINARY_OP":
+                b = _to_expr(stack.pop())
+                a = _to_expr(stack.pop())
+                sym = ins.argrepr
+                if sym == "**":
+                    stack.append(M.Pow(a, b))
+                elif sym in _BINARY and _BINARY[sym] is not None:
+                    stack.append(_BINARY[sym](a, b))
+                else:
+                    self._fail(ins)
+            elif op == "COMPARE_OP":
+                b = stack.pop()
+                a = stack.pop()
+                sym = ins.argrepr.strip()
+                if sym.startswith("bool(") and sym.endswith(")"):
+                    sym = sym[5:-1]  # 3.13 argrepr form "bool(<)"
+                sym = sym.split()[0]
+                if sym == "!=":
+                    stack.append(Not(EqualTo(_to_expr(a), _to_expr(b))))
+                elif sym in _COMPARE:
+                    stack.append(_COMPARE[sym](_to_expr(a), _to_expr(b)))
+                else:
+                    self._fail(ins)
+            elif op == "IS_OP":
+                b = stack.pop()
+                a = _to_expr(stack.pop())
+                is_none = (isinstance(b, Expression) and
+                           isinstance(b, Literal) and b.value is None)
+                if not is_none:
+                    self._fail(ins, "only `is None` supported")
+                stack.append(Not(IsNull(a)) if ins.argval == 1
+                             else IsNull(a))
+            elif op == "CONTAINS_OP":
+                container = stack.pop()
+                a = _to_expr(stack.pop())
+                if isinstance(container, _Marker) and \
+                        container.kind == "const_tuple":
+                    e = InSet(a, list(container.payload))
+                    stack.append(Not(e) if ins.argval == 1 else e)
+                else:
+                    self._fail(ins, "`in` needs a constant tuple")
+            elif op == "UNARY_NEGATIVE":
+                stack.append(UnaryMinus(_to_expr(stack.pop())))
+            elif op == "UNARY_NOT":
+                stack.append(Not(_to_expr(stack.pop())))
+            elif op == "COPY":
+                stack.append(stack[-ins.argval])
+            elif op == "SWAP":
+                stack[-1], stack[-ins.argval] = (stack[-ins.argval],
+                                                 stack[-1])
+            elif op == "POP_TOP":
+                stack.pop()
+            elif op == "CALL":
+                argc = ins.argval
+                args = [stack.pop() for _ in range(argc)][::-1]
+                callee = stack.pop()
+                # LOAD_GLOBAL's NULL slot sits under the callable
+                if stack and isinstance(stack[-1], _Marker) and \
+                        stack[-1].kind == "null":
+                    stack.pop()
+                stack.append(self._call(ins, callee, args))
+            elif op == "CALL_METHOD":
+                argc = ins.argval
+                args = [stack.pop() for _ in range(argc)][::-1]
+                callee = stack.pop()
+                stack.append(self._call(ins, callee, args))
+            elif op in ("POP_JUMP_IF_FALSE", "POP_JUMP_IF_TRUE",
+                        "POP_JUMP_FORWARD_IF_FALSE",
+                        "POP_JUMP_FORWARD_IF_TRUE"):
+                cond = _to_expr(stack.pop())
+                if "TRUE" in op:
+                    cond = Not(cond)
+                tgt = self.by_offset[ins.argval]
+                then_v = self._exec(idx + 1, list(stack),
+                                    dict(local_vars), depth + 1)
+                else_v = self._exec(tgt, list(stack), dict(local_vars),
+                                    depth + 1)
+                return If(cond, then_v, else_v)
+            elif op in ("POP_JUMP_IF_NONE", "POP_JUMP_IF_NOT_NONE"):
+                v = _to_expr(stack.pop())
+                cond = IsNull(v) if op.endswith("IF_NONE") else \
+                    IsNotNull(v)
+                tgt = self.by_offset[ins.argval]
+                then_v = self._exec(tgt, list(stack), dict(local_vars),
+                                    depth + 1)
+                else_v = self._exec(idx + 1, list(stack),
+                                    dict(local_vars), depth + 1)
+                return If(cond, then_v, else_v)
+            elif op in ("JUMP_IF_TRUE_OR_POP", "JUMP_IF_FALSE_OR_POP"):
+                v = _to_expr(stack[-1])
+                cond = v if op.startswith("JUMP_IF_TRUE") else Not(v)
+                tgt = self.by_offset[ins.argval]
+                keep = self._exec(tgt, list(stack), dict(local_vars),
+                                  depth + 1)
+                popped = list(stack)[:-1]
+                other = self._exec(idx + 1, popped, dict(local_vars),
+                                   depth + 1)
+                return If(cond, keep, other)
+            elif op in ("JUMP_FORWARD", "JUMP_BACKWARD_NO_INTERRUPT"):
+                idx = self.by_offset[ins.argval]
+                continue
+            elif op == "JUMP_BACKWARD":
+                raise UdfCompileError("loops not supported")
+            elif op in ("RETURN_VALUE",):
+                return _to_expr(stack.pop())
+            elif op == "RETURN_CONST":
+                v = ins.argval
+                return Literal(v)
+            else:
+                self._fail(ins)
+            idx += 1
+        raise UdfCompileError("fell off the end of the bytecode")
+
+    def _call(self, ins, callee, args) -> Expression:
+        if isinstance(callee, _Marker) and callee.kind == "method":
+            builder = _STR_METHODS.get(callee.extra)
+            if builder is None:
+                self._fail(ins, f"method .{callee.extra}()")
+            return builder(callee.payload,
+                           *[_to_expr(a) for a in args])
+        if isinstance(callee, _Marker) and callee.kind == "global":
+            target = callee.payload
+            builder = _GLOBAL_FUNCS.get(target)
+            if builder is None:
+                if target is float or target is int or target is bool:
+                    t = {float: dt.FLOAT64, int: dt.INT64,
+                         bool: dt.BOOL}[target]
+                    return _to_expr(args[0]).cast(t)
+                if target is str:
+                    return _to_expr(args[0]).cast(dt.STRING)
+                self._fail(ins, f"call to {target!r}")
+            return builder(*[_to_expr(a) for a in args])
+        self._fail(ins, "uncallable")
+
+
+def compile_udf(fn: Callable, arg_exprs: List[Expression]) -> Expression:
+    """Translate ``fn(args...)`` into an Expression over arg_exprs, or
+    raise UdfCompileError."""
+    return _Compiler(fn, list(arg_exprs)).run()

@@ -1,0 +1,67 @@
+"""PythonUDF expression + the user-facing ``udf`` wrapper.
+
+compile-or-fallback: ``udf(fn)`` first tries the bytecode compiler
+(compiler.py) so the function fuses into the device program; if that
+fails, the call becomes a PythonUDF expression that only the CPU engine
+can evaluate (row-at-a-time), and the tagging pass routes the operator
+to the CPU — the reference's behavior when udf-compiler can't translate
+a lambda (the original UDF stays in the plan and runs on CPU, with the
+Arrow/Pandas worker machinery of SURVEY §2.8 playing the role our numpy
+interpreter plays here).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from ..columnar import dtypes as dt
+from ..expr.core import Expression, Schema
+from .compiler import UdfCompileError, compile_udf
+
+
+class PythonUDF(Expression):
+    """Opaque python function over row values — CPU-only (no TPU rule
+    registered, so operators containing it always fall back)."""
+
+    def __init__(self, fn: Callable, return_type: dt.DType,
+                 *children: Expression):
+        super().__init__(*children)
+        self.fn = fn
+        self.return_type = return_type
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return self.return_type
+
+    def __repr__(self):
+        return f"PythonUDF({getattr(self.fn, '__name__', '<fn>')})"
+
+
+class CompiledOrInterpretedUdf:
+    """The object ``udf(fn)`` returns: call it with column expressions."""
+
+    def __init__(self, fn: Callable, return_type: Optional[dt.DType]):
+        self.fn = fn
+        self.return_type = return_type
+
+    def __call__(self, *args: Expression) -> Expression:
+        try:
+            expr = compile_udf(self.fn, list(args))
+            self.compiled = True
+            return expr
+        except UdfCompileError:
+            self.compiled = False
+            if self.return_type is None:
+                raise UdfCompileError(
+                    f"UDF {getattr(self.fn, '__name__', '<fn>')} could "
+                    "not be compiled; pass return_type= to allow the "
+                    "interpreted CPU fallback")
+            return PythonUDF(self.fn, self.return_type, *args)
+
+
+def udf(fn: Optional[Callable] = None, *,
+        return_type: Optional[dt.DType] = None):
+    """Decorator/wrapper: ``my_udf = udf(lambda x: x + 1)`` or
+    ``@udf(return_type=dt.FLOAT64)``."""
+    if fn is None:
+        return lambda f: CompiledOrInterpretedUdf(f, return_type)
+    return CompiledOrInterpretedUdf(fn, return_type)

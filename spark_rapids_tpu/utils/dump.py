@@ -1,0 +1,32 @@
+"""Debug dump: write batches to parquet for offline repro.
+
+Rebuild of DumpUtils.scala (SURVEY §2.8): an operator input that
+triggers a failure can be captured to disk and replayed through either
+engine. Dump files are plain parquet, so any tool opens them.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+from ..columnar.vector import ColumnarBatch
+
+
+def dump_batch(batch: ColumnarBatch, out_dir: str,
+               prefix: str = "batch") -> str:
+    """Write one batch's live rows as parquet; returns the path."""
+    from ..io.arrow_convert import host_table_to_arrow
+    from ..plan.host_table import batch_to_table
+    import pyarrow.parquet as pq
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(
+        out_dir, f"{prefix}-{int(time.time() * 1e3)}-{os.getpid()}.parquet")
+    pq.write_table(host_table_to_arrow(batch_to_table(batch)), path)
+    return path
+
+
+def load_dump(session, path: str):
+    """Reload a dump as a DataFrame for replay."""
+    return session.read.parquet(path)

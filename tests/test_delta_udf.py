@@ -1,0 +1,263 @@
+"""ACID table (delta-lake equivalent) + UDF compiler tests
+(SURVEY §2.6 delta, §2.8 udf-compiler)."""
+
+import os
+import threading
+
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.delta import AcidTable, CommitConflict, TransactionLog
+from spark_rapids_tpu.expr.core import col, lit
+from spark_rapids_tpu.plan import TpuSession
+from spark_rapids_tpu.testing import assert_falls_back_to_cpu
+from spark_rapids_tpu.udf import UdfCompileError, compile_udf, udf
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession()
+
+
+def make_table(session, tmp_path, name="t"):
+    t = AcidTable.create(session, str(tmp_path / name),
+                         [("id", dt.INT64), ("v", dt.FLOAT64),
+                          ("tag", dt.STRING)])
+    df = session.create_dataframe({
+        "id": [1, 2, 3, 4], "v": [10.0, 20.0, 30.0, 40.0],
+        "tag": ["a", "b", "a", "c"]})
+    t.append(df)
+    return t
+
+
+def rows(t, version=None):
+    return sorted(t.to_df(version).collect(), key=lambda r: r["id"])
+
+
+def test_create_append_read(session, tmp_path):
+    t = make_table(session, tmp_path)
+    assert t.version() == 1
+    assert [r["id"] for r in rows(t)] == [1, 2, 3, 4]
+    t.append(session.create_dataframe(
+        {"id": [5], "v": [50.0], "tag": ["d"]}))
+    assert t.version() == 2
+    assert [r["id"] for r in rows(t)] == [1, 2, 3, 4, 5]
+
+
+def test_time_travel(session, tmp_path):
+    t = make_table(session, tmp_path)
+    t.append(session.create_dataframe(
+        {"id": [9], "v": [90.0], "tag": ["z"]}))
+    assert len(rows(t)) == 5
+    assert len(rows(t, version=1)) == 4  # before the second append
+    assert len(rows(t, version=0)) == 0  # just CREATE TABLE
+
+
+def test_delete(session, tmp_path):
+    t = make_table(session, tmp_path)
+    t.delete(col("tag") == "a")
+    assert [r["id"] for r in rows(t)] == [2, 4]
+    ops = [h["operation"] for h in t.history()]
+    assert "DELETE" in ops
+
+
+def test_update(session, tmp_path):
+    t = make_table(session, tmp_path)
+    t.update({"v": col("v") * 2}, col("id") >= 3)
+    got = {r["id"]: r["v"] for r in rows(t)}
+    assert got == {1: 10.0, 2: 20.0, 3: 60.0, 4: 80.0}
+
+
+def test_merge_upsert(session, tmp_path):
+    t = make_table(session, tmp_path)
+    source = session.create_dataframe({
+        "id": [2, 3, 99], "v": [200.0, 300.0, 990.0],
+        "tag": ["B", "C", "NEW"]})
+    t.merge(source, on=["id"],
+            when_matched_update={"v": col("src_v"), "tag": col("src_tag")},
+            when_not_matched_insert=True)
+    got = {r["id"]: (r["v"], r["tag"]) for r in rows(t)}
+    assert got == {1: (10.0, "a"), 2: (200.0, "B"), 3: (300.0, "C"),
+                   4: (40.0, "c"), 99: (990.0, "NEW")}
+
+
+def test_merge_delete(session, tmp_path):
+    t = make_table(session, tmp_path)
+    source = session.create_dataframe(
+        {"id": [1, 3], "v": [0.0, 0.0], "tag": ["", ""]})
+    t.merge(source, on=["id"], when_matched_delete=True,
+            when_not_matched_insert=False)
+    assert [r["id"] for r in rows(t)] == [2, 4]
+
+
+def test_optimistic_conflict(session, tmp_path):
+    t = make_table(session, tmp_path)
+    log = t.log
+    read_v = log.latest_version()
+    log.commit(read_v, [{"add": {"path": "x.parquet", "numRecords": 0,
+                                 "dataChange": True}}], "WRITE")
+    with pytest.raises(CommitConflict):
+        log.commit(read_v, [], "WRITE")  # same read version: loser
+
+
+def test_vacuum(session, tmp_path):
+    t = make_table(session, tmp_path)
+    old_files = set(os.listdir(t.path))
+    t.overwrite(session.create_dataframe(
+        {"id": [7], "v": [7.0], "tag": ["v"]}))
+    removed = t.vacuum()
+    assert removed  # the pre-overwrite file is unreferenced now
+    assert len(rows(t)) == 1
+
+
+# --- UDF compiler ----------------------------------------------------------
+
+def test_compile_arithmetic(session):
+    df = session.create_dataframe({"x": [1, 2, 3], "y": [10, 20, 30]})
+    f = udf(lambda x, y: (x + y) * 2 - x % 2)
+    out = df.select(f(col("x"), col("y")).alias("r")).collect()
+    assert [r["r"] for r in out] == [21, 44, 65]
+    assert f.compiled
+
+
+def test_compile_conditional_and_bool(session):
+    df = session.create_dataframe({"x": [-5, 0, 7]})
+    f = udf(lambda x: x * 10 if x > 0 else -x)
+    out = df.select(f(col("x")).alias("r")).collect()
+    assert [r["r"] for r in out] == [5, 0, 70]
+
+
+def test_compile_math_and_builtins(session):
+    import math
+    df = session.create_dataframe({"x": [4.0, 9.0]})
+    f = udf(lambda x: math.sqrt(x) + abs(-x) + min(x, 5.0))
+    out = df.select(f(col("x")).alias("r")).collect()
+    assert out[0]["r"] == pytest.approx(2 + 4 + 4)
+    assert out[1]["r"] == pytest.approx(3 + 9 + 5)
+
+
+def test_compile_string_methods(session):
+    df = session.create_dataframe({"s": ["  Hello ", "world"]})
+    f = udf(lambda s: s.strip().upper())
+    out = df.select(f(col("s")).alias("r")).collect()
+    assert [r["r"] for r in out] == ["HELLO", "WORLD"]
+
+
+def test_compile_none_checks(session):
+    df = session.create_dataframe({"x": [1, None, 3]})
+    f = udf(lambda x: -1 if x is None else x)
+    out = df.select(f(col("x")).alias("r")).collect()
+    assert [r["r"] for r in out] == [1, -1, 3]
+
+
+def test_compile_in_tuple(session):
+    df = session.create_dataframe({"x": [1, 2, 3, 4]})
+    f = udf(lambda x: x in (2, 4))
+    out = df.select(f(col("x")).alias("r")).collect()
+    assert [r["r"] for r in out] == [False, True, False, True]
+
+
+def test_compiled_udf_runs_on_tpu(session):
+    from spark_rapids_tpu.testing import assert_runs_on_tpu
+    df = session.create_dataframe({"x": [1.0, 2.0]})
+    f = udf(lambda x: x * 2 + 1)
+    assert_runs_on_tpu(df.select(f(col("x")).alias("r")))
+
+
+def test_uncompilable_falls_back_interpreted(session):
+    def weird(x):
+        return sum(int(c) for c in str(x))  # loops: not compilable
+
+    with pytest.raises(UdfCompileError):
+        udf(weird)(col("x"))
+    f = udf(weird, return_type=dt.INT64)
+    df = session.create_dataframe({"x": [123, 45]})
+    q = df.select(f(col("x")).alias("digit_sum"))
+    assert_falls_back_to_cpu(q, "no TPU")
+    assert [r["digit_sum"] for r in q.collect()] == [6, 9]
+
+
+def test_interpreted_udf_exception_is_null(session):
+    f = udf(lambda x: 1 // x, return_type=dt.INT64)
+    # force interpretation by using a construct the compiler rejects
+    def div(x):
+        try:
+            return 1 // x
+        except ZeroDivisionError:
+            return None
+    g = udf(div, return_type=dt.INT64)
+    df = session.create_dataframe({"x": [1, 0, 2]})
+    out = df.select(g(col("x")).alias("r")).collect()
+    assert [r["r"] for r in out] == [1, None, 0]
+
+
+def test_concurrent_rewrite_recomputes(session, tmp_path):
+    """Optimistic loser must recompute against the winner's state, not
+    replay stale file sets (the classic lost-update scenario)."""
+    t = make_table(session, tmp_path)  # ids 1..4
+    # Simulate interleaving: a competing writer commits between this
+    # delete's snapshot read and its commit attempt.
+    orig_commit = t.log.commit
+    raced = {"done": False}
+
+    def racing_commit(read_v, actions, operation):
+        if not raced["done"] and operation == "DELETE":
+            raced["done"] = True
+            # competing transaction wins first: delete id==4
+            t2 = AcidTable.for_path(session, t.path)
+            t2.delete(col("id") == 4)
+        return orig_commit(read_v, actions, operation)
+
+    t.log.commit = racing_commit
+    t.delete(col("id") == 1)
+    t.log.commit = orig_commit
+    ids = [r["id"] for r in rows(t)]
+    assert ids == [2, 3], ids  # BOTH deletes applied, no duplicates
+
+
+def test_merge_duplicate_source_keys_rejected(session, tmp_path):
+    t = make_table(session, tmp_path)
+    dup_src = session.create_dataframe(
+        {"id": [2, 2], "v": [0.0, 1.0], "tag": ["x", "y"]})
+    with pytest.raises(ValueError, match="multiple source rows"):
+        t.merge(dup_src, on=["id"],
+                when_matched_update={"v": col("src_v")})
+
+
+def test_datagen_seed_is_process_stable():
+    import subprocess, sys
+    code = (
+        "from spark_rapids_tpu.datagen import generate_chunk, "
+        "lineitem_spec\n"
+        "c = generate_chunk(lineitem_spec(10000), 3, 50)\n"
+        "print(list(c.columns[1].values[:5]))\n")
+    outs = set()
+    for _ in range(2):
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True,
+                           env={**__import__('os').environ,
+                                "JAX_PLATFORMS": "cpu"})
+        outs.add(r.stdout.strip().splitlines()[-1])
+    assert len(outs) == 1, outs  # identical across processes
+
+
+def test_interpreted_udf_programming_error_propagates(session):
+    f = udf(lambda s: s.uper(), return_type=dt.STRING)  # typo'd method
+
+    def call(s):
+        try:
+            return s.uper()
+        except AttributeError:
+            raise
+    g = udf(call, return_type=dt.STRING)
+    df = session.create_dataframe({"s": ["x"]})
+    with pytest.raises(AttributeError):
+        df.select(g(col("s")).alias("r")).collect()
+
+
+def test_ml_export_carries_num_rows(session):
+    df = session.create_dataframe({"x": [1.0, 2.0, 3.0]})
+    arrs = df.to_device_arrays()
+    assert arrs.num_rows == 3
+    data, valid = arrs["x"]
+    assert data.shape[0] >= 3  # capacity padded; slice to num_rows

@@ -1,0 +1,91 @@
+"""Ecosystem tests: cache serializer, scale datagen, debug dump, doc
+freshness, ML export (SURVEY §2.8 equivalents)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.expr.aggregates import CountStar, Sum
+from spark_rapids_tpu.expr.core import col
+from spark_rapids_tpu.plan import TpuSession
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession()
+
+
+def test_cache_roundtrip_and_reuse(session):
+    df = session.create_dataframe(
+        {"x": list(range(50)), "s": [f"s{i % 3}" for i in range(50)]})
+    cached = df.filter(col("x") % 2 == 0).cache()
+    from spark_rapids_tpu.cache import CachedRelation
+    assert isinstance(cached.plan, CachedRelation)
+    assert cached.count() == 25
+    # downstream ops run on the cached blocks (both engines)
+    agg = cached.group_by("s").agg(Sum(col("x")).alias("sx")).collect()
+    assert sum(r["sx"] for r in agg) == sum(range(0, 50, 2))
+    from spark_rapids_tpu.testing import assert_tpu_cpu_equal_df
+    assert_tpu_cpu_equal_df(cached.select((col("x") + 1).alias("y")))
+
+
+def test_cache_compresses(session):
+    df = session.create_dataframe({"x": [7] * 10000})
+    cached = df.cache()
+    nbytes = sum(len(b) for b in cached.plan.blocks)
+    assert nbytes < 10000 * 8 // 4  # constant column compresses well
+
+
+def test_datagen_deterministic_chunks(session, tmp_path):
+    from spark_rapids_tpu.datagen import (TableSpec, ColumnSpec,
+                                          generate_chunk, generate_table,
+                                          lineitem_spec)
+    spec = lineitem_spec(10_000)
+    a = generate_chunk(spec, 3, 1000)
+    b = generate_chunk(spec, 3, 1000)  # regenerate independently
+    assert (a.columns[0].values == b.columns[0].values).all()
+    paths = generate_table(session, lineitem_spec(5000),
+                           str(tmp_path / "li"), chunk_rows=2000)
+    assert len(paths) == 3
+    df = session.read.parquet(str(tmp_path / "li"))
+    assert df.count() == 5000
+    # discount values come from the choice list
+    out = df.group_by("l_discount").agg(CountStar().alias("n")).collect()
+    assert all(0 <= r["l_discount"] <= 0.10 for r in out)
+
+
+def test_dump_and_replay(session, tmp_path):
+    from spark_rapids_tpu.columnar.vector import batch_from_pydict
+    from spark_rapids_tpu.utils.dump import dump_batch, load_dump
+    b = batch_from_pydict({"v": [1, None, 3], "s": ["a", "b", None]})
+    path = dump_batch(b, str(tmp_path / "dumps"), prefix="repro")
+    assert os.path.exists(path)
+    back = load_dump(session, path).collect()
+    assert [r["v"] for r in back] == [1, None, 3]
+
+
+def test_docs_are_fresh():
+    """docs regenerate to exactly what's committed (the reference
+    CI-enforces generated docs the same way)."""
+    from spark_rapids_tpu.conf import generate_docs
+    from spark_rapids_tpu.plan.overrides import generate_supported_ops_doc
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "docs", "configs.md")) as f:
+        assert f.read() == generate_docs(), \
+            "docs/configs.md stale: run python tools/gen_docs.py"
+    with open(os.path.join(root, "docs", "supported_ops.md")) as f:
+        assert f.read() == generate_supported_ops_doc(), \
+            "docs/supported_ops.md stale: run python tools/gen_docs.py"
+
+
+def test_ml_export_device_arrays(session):
+    import jax
+    df = session.create_dataframe({"f1": [1.0, 2.0, 3.0],
+                                   "label": [0, 1, 0]})
+    arrs = df.to_device_arrays()
+    f1, f1_valid = arrs["f1"]
+    assert isinstance(f1, jax.Array)
+    assert np.asarray(f1)[:3].tolist() == [1.0, 2.0, 3.0]
+    assert np.asarray(f1_valid)[:3].all()

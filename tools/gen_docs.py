@@ -1,0 +1,31 @@
+"""Regenerate docs/configs.md and docs/supported_ops.md.
+
+The reference generates these from code and CI-enforces freshness
+(RapidsConf.main, RapidsConf.scala:2214; TypeChecks doc-gen) — same
+contract here: tests/test_docs.py fails if these files go stale.
+Run: python tools/gen_docs.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from spark_rapids_tpu.conf import generate_docs
+from spark_rapids_tpu.plan.overrides import generate_supported_ops_doc
+
+DOCS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "docs")
+
+
+def main():
+    os.makedirs(DOCS, exist_ok=True)
+    with open(os.path.join(DOCS, "configs.md"), "w") as f:
+        f.write(generate_docs())
+    with open(os.path.join(DOCS, "supported_ops.md"), "w") as f:
+        f.write(generate_supported_ops_doc())
+    print("wrote docs/configs.md, docs/supported_ops.md")
+
+
+if __name__ == "__main__":
+    main()
