@@ -39,4 +39,17 @@ _jax.config.update("jax_enable_x64", True)
 if _os.environ.get("JAX_PLATFORMS") == "cpu":
     _jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compile cache: capacity buckets repeat across queries
+# and sessions, and each miss costs 10-40s through a remote-compile
+# tunnel. (The reference's equivalent concern is cuDF JIT kernel
+# caching.) Override via JAX_COMPILATION_CACHE_DIR; set it empty to
+# disable.
+if "JAX_COMPILATION_CACHE_DIR" not in _os.environ:
+    # per-uid path: a fixed shared /tmp name would let another local
+    # user pre-create (denying the cache) or poison cached executables
+    _jax.config.update(
+        "jax_compilation_cache_dir",
+        f"/tmp/srt_jax_cache-{_os.getuid() if hasattr(_os, 'getuid') else 0}")
+    _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
 from . import columnar  # noqa: F401,E402

@@ -135,18 +135,27 @@ class StringColumn:
         return jnp.where(k[None, :] < lens[:, None], take, jnp.zeros((), jnp.uint8))
 
     def gather(self, indices: jax.Array, valid: Optional[jax.Array] = None,
-               out_char_capacity: Optional[int] = None) -> "StringColumn":
+               out_char_capacity: Optional[int] = None,
+               unique: bool = False) -> "StringColumn":
         """Gather string rows, repacking bytes into a new flat buffer.
 
-        The output has ``len(indices)`` rows. The output byte buffer is
-        ``out_char_capacity`` (default: the source's char_capacity, right
-        for permutation-like gathers); expanding gathers — joins with
-        duplicate keys — must pass a larger static bound or bytes beyond
-        it are truncated to empty strings.
+        The output has ``len(indices)`` rows. The default output byte
+        buffer is ``len(indices) * pad_bucket`` rounded to a power of
+        two — a hard upper bound (every row is at most pad_bucket
+        bytes), so duplicating gathers (joins with repeated keys,
+        cross-pair replication) can never overflow-truncate.
+        ``unique=True`` (permutations/compactions: each source row used
+        at most once) keeps the tight source-sized buffer instead —
+        total gathered bytes can't exceed the source total.
         """
         src_cap = self.capacity
         out_cap = indices.shape[0]
-        nbytes_cap = out_char_capacity or self.char_capacity
+        if out_char_capacity is not None:
+            nbytes_cap = out_char_capacity
+        elif unique:
+            nbytes_cap = self.char_capacity
+        else:
+            nbytes_cap = round_pow2(max(out_cap * self.pad_bucket, 128))
         safe = jnp.clip(indices, 0, src_cap - 1)
         starts = jnp.take(self.offsets[:-1], safe)
         lens = jnp.take(self.lengths(), safe)
@@ -229,11 +238,16 @@ class ColumnarBatch:
     def select(self, names: Sequence[str]) -> "ColumnarBatch":
         return ColumnarBatch([self.column(n) for n in names], list(names), self.num_rows)
 
-    def gather(self, indices: jax.Array, new_num_rows) -> "ColumnarBatch":
-        """Gather rows by index; indices beyond new_num_rows produce dead rows."""
+    def gather(self, indices: jax.Array, new_num_rows,
+               unique: bool = False) -> "ColumnarBatch":
+        """Gather rows by index; indices beyond new_num_rows produce dead
+        rows. ``unique=True`` = permutation/compaction (no source row
+        duplicated): string columns keep their tight byte buffers."""
         cap = indices.shape[0]
         valid = live_mask(cap, new_num_rows)
-        cols = [c.gather(indices, valid) for c in self.columns]
+        cols = [c.gather(indices, valid, unique=unique)
+                if isinstance(c, StringColumn) else c.gather(indices, valid)
+                for c in self.columns]
         return ColumnarBatch(cols, self.names, new_num_rows)
 
     def schema(self):

@@ -254,6 +254,18 @@ MESH_DATA_AXIS = conf("srt.mesh.dataAxis") \
     .doc("Name of the mesh axis partitions are sharded over.") \
     .internal().string("data")
 
+OPTIMIZER_ENABLED = conf("srt.sql.optimizer.enabled") \
+    .doc("Cost-based optimizer: keep plans below the row threshold on "
+         "the CPU engine where device compile/transfer overhead "
+         "dominates. (spark.rapids.sql.optimizer.enabled, "
+         "CostBasedOptimizer.scala:54)") \
+    .boolean(False)
+
+OPTIMIZER_ROW_THRESHOLD = conf("srt.sql.optimizer.rowThreshold") \
+    .doc("Weighted row-volume below which the cost model keeps a plan "
+         "on CPU (only with srt.sql.optimizer.enabled).") \
+    .check(_positive).integer(10_000)
+
 
 class SrtConf:
     """Immutable snapshot of settings, one per session (RapidsConf)."""

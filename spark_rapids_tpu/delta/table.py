@@ -256,6 +256,23 @@ class AcidTable:
                 self._write_files(table)
         return self._commit_rewrite(build, "MERGE")
 
+    def optimize(self, zorder_by: Optional[Sequence[str]] = None) -> int:
+        """OPTIMIZE [ZORDER BY cols]: rewrite the table as one file,
+        z-order-clustered when columns are given (delta-lake z-order
+        optimize write, GpuOptimisticTransaction + ZOrderRules)."""
+        from ..expr.bitwise import InterleaveBits
+
+        def build(read_v: int) -> List[dict]:
+            df = self.to_df(version=read_v)
+            if zorder_by:
+                df = df.sort(InterleaveBits(
+                    *[col(c) for c in zorder_by]))
+            table = self.session.execute(df.plan)
+            return self._remove_all_current(read_v) + \
+                self._write_files(table)
+        return self._commit_rewrite(
+            build, f"OPTIMIZE{' ZORDER' if zorder_by else ''}")
+
     def vacuum(self) -> List[str]:
         """Delete data files no longer referenced by the head snapshot."""
         _, files = self.log.snapshot()

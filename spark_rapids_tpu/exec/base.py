@@ -139,7 +139,14 @@ class TpuSemaphore:
             if self._holders.get(tid, 0) > 0:
                 self._holders[tid] += 1
                 return
-        self._sem.acquire()
+        # uncontended fast path: only actual blocking counts as wait
+        # (GpuTaskMetrics semaphore-wait accumulator)
+        if not self._sem.acquire(blocking=False):
+            t0 = time.perf_counter_ns()
+            self._sem.acquire()
+            from ..memory.budget import task_context
+            task_context().semaphore_wait_ns += \
+                time.perf_counter_ns() - t0
         with self._lock:
             self._holders[tid] = 1
 

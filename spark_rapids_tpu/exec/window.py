@@ -117,7 +117,7 @@ class WindowExec(TpuExec):
         asc = [True] * len(part_cols) + [o.ascending for o in self.order_by]
         nf = [True] * len(part_cols) + [o.nulls_first for o in self.order_by]
         perm = K.sort_indices(part_cols + order_cols, asc, nf, live)
-        sorted_batch = batch.gather(perm, n)
+        sorted_batch = batch.gather(perm, n, unique=True)
         s_part = [c.gather(perm) for c in part_cols]
         s_order = [c.gather(perm) for c in order_cols]
 

@@ -414,3 +414,40 @@ class Last(First):
         pick = jnp.clip(pick, 0, cap - 1)
         return {"val": states["val"][pick], "valid": states["valid"][pick] &
                 (best >= 0), "pos": best}
+
+
+class CollectList(AggregateFunction):
+    """collect_list — gathers group values into an array column.
+
+    Array-typed outputs have no device representation yet (SURVEY §7
+    hard-part #2 nested types), so no TPU rule is registered: operators
+    containing collects run on the CPU engine (tagged fallback), like
+    the reference before cuDF grew list support.
+    """
+
+    name = "collect_list"
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.ArrayType(self.children[0].data_type(schema))
+
+
+class CollectSet(CollectList):
+    name = "collect_set"
+
+
+class Percentile(AggregateFunction):
+    """percentile(col, p) — exact, linear interpolation (Spark
+    semantics). Not decomposable into fixed-width partial states, so
+    CPU-only for now (the reference's GPU approx_percentile uses
+    t-digest sketches; that is the planned device path)."""
+
+    name = "percentile"
+
+    def __init__(self, child: Expression, percentage: float):
+        super().__init__(child)
+        if not 0.0 <= percentage <= 1.0:
+            raise ValueError("percentage must be in [0, 1]")
+        self.percentage = percentage
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.FLOAT64

@@ -1,0 +1,170 @@
+"""Bitwise expressions (sql-plugin/.../rapids/bitwise.scala surface):
+and/or/xor/not and the shift family, plus bit interleaving for z-order
+clustering (zorder/GpuInterleaveBits + spark-rapids-jni ZOrder,
+SURVEY §2.5)."""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax.numpy as jnp
+
+from ..columnar import dtypes as dt
+from ..columnar.vector import ColumnVector, ColumnarBatch
+from .core import Expression, Schema, make_result, merged_validity
+
+
+class _BitwiseBinary(Expression):
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.promote(self.children[0].data_type(schema),
+                          self.children[1].data_type(schema))
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        a = self.children[0].eval(batch)
+        b = self.children[1].eval(batch)
+        out_t = self.data_type(batch.schema())
+        phys = out_t.physical
+        data = self._op(a.data.astype(phys), b.data.astype(phys))
+        return make_result(data, merged_validity(a, b), out_t)
+
+
+class BitwiseAnd(_BitwiseBinary):
+    def _op(self, a, b):
+        return a & b
+
+
+class BitwiseOr(_BitwiseBinary):
+    def _op(self, a, b):
+        return a | b
+
+
+class BitwiseXor(_BitwiseBinary):
+    def _op(self, a, b):
+        return a ^ b
+
+
+class BitwiseNot(Expression):
+    def data_type(self, schema: Schema) -> dt.DType:
+        return self.children[0].data_type(schema)
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        c = self.children[0].eval(batch)
+        return make_result(~c.data, c.validity, c.dtype)
+
+
+class ShiftLeft(_BitwiseBinary):
+    """shiftleft(x, n) — Java semantics: byte/short/int promote to INT,
+    long stays LONG; n masked to the RESULT width (shifting in the
+    narrow dtype with n >= its width is XLA-undefined)."""
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        t = self.children[0].data_type(schema)
+        return dt.INT64 if isinstance(t, dt.LongType) else dt.INT32
+
+    def _operands(self, batch):
+        a = self.children[0].eval(batch)
+        b = self.children[1].eval(batch)
+        out_t = self.data_type(batch.schema())
+        width = 64 if out_t == dt.INT64 else 32
+        x = a.data.astype(out_t.physical)
+        n = (b.data.astype(jnp.int32) & (width - 1)).astype(x.dtype)
+        return x, n, merged_validity(a, b), out_t, width
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        x, n, validity, out_t, _ = self._operands(batch)
+        return make_result(x << n, validity, out_t)
+
+
+class ShiftRight(ShiftLeft):
+    """Arithmetic (sign-extending) right shift."""
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        x, n, validity, out_t, _ = self._operands(batch)
+        return make_result(x >> n, validity, out_t)
+
+
+class ShiftRightUnsigned(ShiftLeft):
+    """Logical right shift (>>> in Java). No 64-bit bitcasts on TPU
+    (utils/bits.py constraint): arithmetic shift, then clear the
+    sign-copied top bits."""
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        x, n, validity, out_t, width = self._operands(batch)
+        shifted = x >> n
+        one = jnp.asarray(1, x.dtype)
+        neg_one = jnp.asarray(-1, x.dtype)
+        mask = jnp.where(n > 0, (one << (width - n)) - 1, neg_one)
+        return make_result(shifted & mask, validity, out_t)
+
+
+class BitCount(Expression):
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.INT32
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        c = self.children[0].eval(batch)
+        x = c.data
+        if x.dtype == jnp.bool_:
+            return make_result(x.astype(jnp.int32), c.validity, dt.INT32)
+        # popcount on the two 32-bit halves (no 64-bit bitcasts)
+        if x.dtype == jnp.int64:
+            lo = (x & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
+            hi_arith = (x >> 32).astype(jnp.int64)
+            hi = (hi_arith & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
+            n = _popcount32(lo) + _popcount32(hi)
+        else:
+            n = _popcount32(x.astype(jnp.uint32))
+        return make_result(n.astype(jnp.int32), c.validity, dt.INT32)
+
+
+def _popcount32(x):
+    x = x - ((x >> 1) & jnp.uint32(0x55555555))
+    x = (x & jnp.uint32(0x33333333)) + ((x >> 2) & jnp.uint32(0x33333333))
+    x = (x + (x >> 4)) & jnp.uint32(0x0F0F0F0F)
+    return ((x * jnp.uint32(0x01010101)) >> 24).astype(jnp.int32)
+
+
+class InterleaveBits(Expression):
+    """z-order key: bit-interleave up to 4 int32 columns into int64
+    (zorder/GpuInterleaveBits; Delta OPTIMIZE ZORDER BY clustering).
+
+    Values are offset to unsigned order first so negative numbers
+    cluster correctly (the reference's ZOrder kernel does the same
+    sign-flip normalization).
+    """
+
+    def __init__(self, *children: Expression):
+        super().__init__(*children)
+        if not 1 <= len(children) <= 4:
+            raise TypeError("interleave_bits takes 1-4 columns")
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.INT64
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        cols = [c.eval(batch) for c in self.children]
+        k = len(cols)
+        bits_per = 63 // k
+        parts = []
+        validity = cols[0].validity
+        for c in cols[1:]:
+            validity = validity & c.validity
+        for c in cols:
+            width = 64 if isinstance(c.dtype, dt.LongType) else 32
+            x = c.data.astype(jnp.int64)
+            # map to unsigned order within the SOURCE width, then take
+            # the top bits_per bits of that width (int32 inputs must
+            # normalize at 32 bits, not 64, or sign extension collapses
+            # every value into two buckets)
+            if width == 64:
+                u = x ^ jnp.int64(-(2 ** 63))  # sign-bit flip, no overflow
+            else:
+                u = x + jnp.int64(2 ** (width - 1))  # [0, 2^width)
+            u = (u >> (width - bits_per)) & jnp.int64(2 ** bits_per - 1)
+            parts.append(u)
+        out = jnp.zeros_like(parts[0])
+        for bit in range(bits_per):
+            for ci, p in enumerate(parts):
+                src_bit = (p >> bit) & 1
+                out = out | (src_bit << (bit * k + ci))
+        return make_result(out, validity, dt.INT64)

@@ -234,6 +234,11 @@ class Literal(Expression):
         cap = batch.capacity
         live = batch.live_mask()
         if self.value is None:
+            if self.dtype == dt.STRING:
+                return StringColumn(jnp.zeros(cap + 1, jnp.int32),
+                                    jnp.zeros(8, jnp.uint8),
+                                    jnp.zeros(cap, jnp.bool_),
+                                    pad_bucket=8)
             phys = self.dtype.physical or jnp.int32
             return ColumnVector(jnp.zeros(cap, phys), jnp.zeros(cap, jnp.bool_),
                                 self.dtype if self.dtype != dt.NULL else dt.INT32)

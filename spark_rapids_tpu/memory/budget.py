@@ -48,9 +48,22 @@ class TaskContext:
         self.retry_count = 0
         self.split_count = 0
         self.spilled_bytes = 0
+        # GpuTaskMetrics.scala:81-146 accumulators
+        self.semaphore_wait_ns = 0
+        self.spill_time_ns = 0
+        self.retry_compute_ns = 0
         # test-only injection counters (None = disarmed)
         self._inject_retry_after: Optional[int] = None
         self._inject_split_after: Optional[int] = None
+
+    def metrics(self) -> dict:
+        """Snapshot (surfaced per task, like GpuTaskMetrics in the UI)."""
+        return {"retryCount": self.retry_count,
+                "splitAndRetryCount": self.split_count,
+                "spilledBytes": self.spilled_bytes,
+                "semaphoreWaitTimeNs": self.semaphore_wait_ns,
+                "spillTimeNs": self.spill_time_ns,
+                "retryComputationTimeNs": self.retry_compute_ns}
 
     # --- fault injection (RmmSpark.forceRetryOOM analogue) ---
     def force_retry_oom(self, num_allocs_before: int = 0) -> None:

@@ -102,14 +102,18 @@ class SpillableBatch:
 
     def spill_to_host(self) -> int:
         """Device → host. Returns device bytes freed."""
+        import time as _time
         with self._lock:
             if self._batch is None or self.closed:
                 return 0
+            t0 = _time.perf_counter_ns()
             self._host, self._treedef = _tree_to_host(self._batch)
             self._batch = None
             self._catalog.budget.release(self._nbytes)
             from .budget import task_context
-            task_context().spilled_bytes += self._nbytes
+            ctx = task_context()
+            ctx.spilled_bytes += self._nbytes
+            ctx.spill_time_ns += _time.perf_counter_ns() - t0
             return self._nbytes
 
     def spill_to_disk(self) -> int:

@@ -46,7 +46,7 @@ def compact(batch: ColumnarBatch, keep: jnp.ndarray) -> ColumnarBatch:
     keep = keep & batch.live_mask()
     n = jnp.sum(keep).astype(jnp.int32)
     idx = jnp.argsort(~keep, stable=True).astype(jnp.int32)
-    return batch.gather(idx, n)
+    return batch.gather(idx, n, unique=True)
 
 
 def filter_batch(batch: ColumnarBatch, cond: ColumnVector) -> ColumnarBatch:
@@ -114,7 +114,7 @@ def sort_indices(columns: Sequence[Column], ascending: Sequence[bool],
 def sort_batch(batch: ColumnarBatch, key_cols: Sequence[Column],
                ascending: Sequence[bool], nulls_first: Sequence[bool]) -> ColumnarBatch:
     perm = sort_indices(key_cols, ascending, nulls_first, batch.live_mask())
-    return batch.gather(perm, batch.num_rows)
+    return batch.gather(perm, batch.num_rows, unique=True)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,133 @@
+"""TCP shuffle block transport (the DCN path).
+
+Rebuild of the reference's shuffle transport stack (SURVEY §2.7:
+RapidsShuffleServer.scala:71 / RapidsShuffleClient.scala:90 /
+RapidsShuffleIterator): executors serve their local shuffle blocks over
+a length-prefixed TCP protocol; remote reads stream a whole reduce
+partition's blocks. Within a pod the MESH mode's in-program all-to-all
+replaces this entirely; across pods (DCN) — or between plain hosts —
+this transport is the fetch path, with the heartbeat registry
+(shuffle_manager.ShuffleHeartbeatManager) distributing endpoints.
+
+Wire protocol (all little-endian):
+  request:  magic u32 | shuffle_id u32 | reduce_id u32
+  response: count u32, then per block: map_id u32 | length u64 | bytes
+Transfers reuse the serializer's self-describing block format, so the
+receiving side deserializes straight into capacity-bucketed batches
+(ShuffleReceivedBufferCatalog role falls to the caller's manager).
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+from typing import Iterator, List, Optional, Tuple
+
+from ..columnar.vector import ColumnarBatch
+from .serializer import deserialize_batch
+from .shuffle_manager import ShuffleManager
+
+MAGIC = 0x53525453  # "SRTS"
+_REQ = struct.Struct("<III")
+_BLOCK_HDR = struct.Struct("<IQ")
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        mgr: ShuffleManager = self.server.manager  # type: ignore
+        raw = self._recv_exact(_REQ.size)
+        if raw is None:
+            return
+        magic, shuffle_id, reduce_id = _REQ.unpack(raw)
+        if magic != MAGIC:
+            return
+        blocks = mgr.host_store.blocks_for_reduce(shuffle_id, reduce_id)
+        payload = [(b[1], mgr.host_store.get(b)) for b in blocks]
+        payload = [(m, d) for m, d in payload if d is not None]
+        self.request.sendall(struct.pack("<I", len(payload)))
+        for map_id, data in payload:
+            self.request.sendall(_BLOCK_HDR.pack(map_id, len(data)))
+            self.request.sendall(data)
+
+    def _recv_exact(self, n: int) -> Optional[bytes]:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.request.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+
+class ShuffleBlockServer:
+    """Serves this process's host-store shuffle blocks
+    (RapidsShuffleServer)."""
+
+    def __init__(self, manager: ShuffleManager, host: str = "127.0.0.1",
+                 port: int = 0):
+        self._server = socketserver.ThreadingTCPServer(
+            (host, port), _Handler, bind_and_activate=True)
+        self._server.daemon_threads = True
+        self._server.manager = manager  # type: ignore
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def endpoint(self) -> str:
+        host, port = self._server.server_address
+        return f"{host}:{port}"
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class ShuffleBlockClient:
+    """Fetches a reduce partition's blocks from a peer
+    (RapidsShuffleClient.doFetch)."""
+
+    def __init__(self, endpoint: str, timeout_s: float = 30.0):
+        self.host, port = endpoint.rsplit(":", 1)
+        self.port = int(port)
+        self.timeout_s = timeout_s
+
+    def fetch_raw(self, shuffle_id: int,
+                  reduce_id: int) -> List[Tuple[int, bytes]]:
+        with socket.create_connection((self.host, self.port),
+                                      timeout=self.timeout_s) as sock:
+            sock.sendall(_REQ.pack(MAGIC, shuffle_id, reduce_id))
+            count = struct.unpack("<I", _recv_exact(sock, 4))[0]
+            out = []
+            for _ in range(count):
+                map_id, length = _BLOCK_HDR.unpack(
+                    _recv_exact(sock, _BLOCK_HDR.size))
+                out.append((map_id, _recv_exact(sock, length)))
+            return out
+
+    def fetch_partition(self, shuffle_id: int,
+                        reduce_id: int) -> Iterator[ColumnarBatch]:
+        for _map_id, data in sorted(self.fetch_raw(shuffle_id,
+                                                   reduce_id)):
+            yield deserialize_batch(data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-message")
+        buf += chunk
+    return buf
+
+
+def fetch_all_partitions(endpoints: List[str], shuffle_id: int,
+                         reduce_id: int) -> Iterator[ColumnarBatch]:
+    """Reduce-side iterator over every peer's blocks for one partition
+    (RapidsShuffleIterator role)."""
+    for ep in endpoints:
+        yield from ShuffleBlockClient(ep).fetch_partition(shuffle_id,
+                                                          reduce_id)

@@ -1,0 +1,86 @@
+"""Cost-based optimizer: keep tiny plans off the accelerator.
+
+Rebuild of CostBasedOptimizer.scala (SURVEY §2.2: CpuCostModel :284 /
+GpuCostModel :334). The reference estimates per-operator CPU vs GPU
+cost plus row<->columnar transition overhead and re-tags sections where
+the accelerator isn't worth it. Here the dominant fixed cost is XLA
+compilation + host->HBM transfer, so the model is: device execution
+pays off once estimated rows clear a threshold; below it, plans whose
+inputs are all host-resident already (local data, tiny files) are
+tagged back to the CPU engine.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..conf import OPTIMIZER_ENABLED, OPTIMIZER_ROW_THRESHOLD, SrtConf
+from .logical import (Aggregate, Expand, Filter, Join, Limit,
+                      LocalRelation, LogicalPlan, Project, Range, Sort,
+                      Union, Window)
+from .meta import PlanMeta
+
+# relative per-row op weights (CostBasedOptimizer default coefficients)
+_OP_WEIGHT = {
+    Project: 1.0, Filter: 1.0, Limit: 0.1, Union: 0.2, Expand: 2.0,
+    Sort: 4.0, Aggregate: 4.0, Join: 6.0, Window: 8.0, Range: 0.1,
+    LocalRelation: 0.1,
+}
+
+
+def estimate_rows(plan: LogicalPlan) -> float:
+    """Cardinality estimation (static, like the reference's)."""
+    from ..io.scan import FileScan
+    if isinstance(plan, LocalRelation):
+        vals = next(iter(plan.data.values()), [])
+        return float(len(vals))
+    if isinstance(plan, Range):
+        return float(max(0, -(-(plan.end - plan.start) // plan.step)))
+    if isinstance(plan, FileScan):
+        # bytes-based guess: ~64B/row parquet, ~32B/row text
+        total = sum(os.path.getsize(p) for p in plan.paths
+                    if os.path.exists(p))
+        per_row = 64 if plan.fmt in ("parquet", "orc") else 32
+        return max(total / per_row, 1.0)
+    child_rows = [estimate_rows(c) for c in plan.children]
+    if isinstance(plan, Filter):
+        return child_rows[0] * 0.5  # default selectivity
+    if isinstance(plan, Limit):
+        return float(min(plan.n, child_rows[0]))
+    if isinstance(plan, Aggregate):
+        return max(child_rows[0] * 0.1, 1.0)
+    if isinstance(plan, Join):
+        return max(child_rows) if child_rows else 0.0
+    if isinstance(plan, Union):
+        return sum(child_rows)
+    if isinstance(plan, Expand):
+        return child_rows[0] * len(plan.projections)
+    return child_rows[0] if child_rows else 0.0
+
+
+def total_cost_rows(plan: LogicalPlan) -> float:
+    """Weighted row-volume of the whole tree."""
+    w = _OP_WEIGHT.get(type(plan), 1.0)
+    return w * estimate_rows(plan) + sum(total_cost_rows(c)
+                                         for c in plan.children)
+
+
+def apply_cost_model(meta: PlanMeta, conf: SrtConf) -> None:
+    """Tag the whole plan off the device when it's too small to pay for
+    compile + transfer (the reference's 'force sections back to CPU')."""
+    if not conf.get(OPTIMIZER_ENABLED):
+        return
+    threshold = conf.get(OPTIMIZER_ROW_THRESHOLD)
+    cost = total_cost_rows(meta.plan)
+    if cost < threshold:
+        _tag_tree(meta,
+                  f"cost model: estimated work {cost:.0f} rows < "
+                  f"threshold {threshold} (device compile/transfer "
+                  "overhead dominates)")
+
+
+def _tag_tree(meta: PlanMeta, reason: str) -> None:
+    meta.will_not_work_on_tpu(reason)
+    for c in meta.child_plans:
+        _tag_tree(c, reason)

@@ -1311,3 +1311,93 @@ def _register_python_udf():
 
 
 _register_python_udf()
+
+
+# ---------------------------------------------------------------------------
+# bitwise
+# ---------------------------------------------------------------------------
+
+from ..expr import bitwise as B  # noqa: E402
+
+
+def _bitwise_binary(np_op):
+    def ev(expr, table):
+        out_t = expr.data_type(table.schema())
+        phys = np.dtype(out_t.physical)
+        a, am = _ev(expr.children[0], table)
+        b, bm = _ev(expr.children[1], table)
+        m = am & bm
+        out = np_op(a.astype(phys), b.astype(phys))
+        return _zero_nulls(out, m), m
+    return ev
+
+
+_EVALUATORS[B.BitwiseAnd] = _bitwise_binary(np.bitwise_and)
+_EVALUATORS[B.BitwiseOr] = _bitwise_binary(np.bitwise_or)
+_EVALUATORS[B.BitwiseXor] = _bitwise_binary(np.bitwise_xor)
+
+
+@_reg(B.BitwiseNot)
+def _bitwise_not(expr, table):
+    a, m = _ev(expr.children[0], table)
+    return _zero_nulls(~a, m), m
+
+
+def _shift_eval(kind):
+    def ev(expr, table):
+        a, am = _ev(expr.children[0], table)
+        b, bm = _ev(expr.children[1], table)
+        m = am & bm
+        width = 64 if a.dtype == np.int64 else 32
+        n = b.astype(np.int64) & (width - 1)
+        x = a.astype(np.int64) if width == 64 else a.astype(np.int32)
+        if kind == "left":
+            out = x << n.astype(x.dtype)
+        elif kind == "right":
+            out = x >> n.astype(x.dtype)
+        else:  # unsigned right
+            ux = x.astype(np.uint64 if width == 64 else np.uint32)
+            out = (ux >> n.astype(ux.dtype)).astype(x.dtype)
+        return _zero_nulls(out, m), m
+    return ev
+
+
+_EVALUATORS[B.ShiftLeft] = _shift_eval("left")
+_EVALUATORS[B.ShiftRight] = _shift_eval("right")
+_EVALUATORS[B.ShiftRightUnsigned] = _shift_eval("uright")
+
+
+@_reg(B.BitCount)
+def _bitcount(expr, table):
+    a, m = _ev(expr.children[0], table)
+    if a.dtype == np.bool_:
+        return _zero_nulls(a.astype(np.int32), m), m
+    u = a.astype(np.uint64 if a.dtype == np.int64 else np.uint32)
+    out = np.array([bin(int(v)).count("1") for v in u], np.int32) \
+        if len(u) else np.empty(0, np.int32)
+    return _zero_nulls(out, m), m
+
+
+@_reg(B.InterleaveBits)
+def _interleave(expr, table):
+    k = len(expr.children)
+    bits_per = 63 // k
+    parts = []
+    mask = np.ones(table.num_rows, bool)
+    schema = table.schema()
+    for c in expr.children:
+        v, m = _ev(c, table)
+        mask &= m
+        width = 64 if c.data_type(schema) == dt.INT64 else 32
+        x = v.astype(np.int64)
+        if width == 64:
+            u = (x.astype(np.uint64) ^ np.uint64(1 << 63)).astype(np.int64)
+        else:
+            u = x + np.int64(1 << 31)
+        parts.append((u >> (width - bits_per)) &
+                     np.int64((1 << bits_per) - 1))
+    out = np.zeros(table.num_rows, np.int64)
+    for bit in range(bits_per):
+        for ci, p in enumerate(parts):
+            out |= ((p >> bit) & 1) << (bit * k + ci)
+    return _zero_nulls(out, mask), mask

@@ -213,8 +213,22 @@ def _agg_cpu(fn: Agg.AggregateFunction, values: Optional[np.ndarray],
             return 0, False
         i = -1 if is_last else 0
         return v[i], bool(m[i])
+    if isinstance(fn, Agg.CollectList):  # CollectSet subclasses it
+        vals = [v.item() if hasattr(v, "item") else v for v in valid_v]
+        if isinstance(fn, Agg.CollectSet):
+            seen = []
+            for v in vals:
+                if v not in seen:
+                    seen.append(v)
+            vals = seen
+        return vals, True  # collect of empty group = empty array
     if len(valid_v) == 0:
         return 0, False
+    if isinstance(fn, Agg.Percentile):
+        x = valid_v.astype(np.float64)
+        if isinstance(in_dtype, dt.DecimalType):
+            x = x / (10.0 ** in_dtype.scale)
+        return float(np.percentile(x, fn.percentage * 100)), True
     if isinstance(fn, Agg.Sum):
         if isinstance(out_t, dt.DecimalType):
             return int(valid_v.astype(np.int64).sum()), True
@@ -298,6 +312,10 @@ def _aggregate_table(table: HostTable, plan: Aggregate) -> HostTable:
         if out_t == dt.STRING:
             arr = np.array([v if ok else "" for v, ok in zip(vals, valids)],
                            dtype=object)
+        elif isinstance(out_t, dt.ArrayType):
+            arr = np.empty(len(vals), dtype=object)
+            for i, (v, ok) in enumerate(zip(vals, valids)):
+                arr[i] = v if ok else []
         else:
             arr = np.array([v if ok else 0 for v, ok in zip(vals, valids)],
                            dtype=np.dtype(out_t.physical))
@@ -451,31 +469,37 @@ def _join_tables(left: HostTable, right: HostTable, plan: Join) -> HostTable:
     jt = plan.join_type
     li: List[int] = []
     ri: List[int] = []
-    l_matched = np.zeros(ln, bool)
-    r_matched = np.zeros(rn, bool)
     for i in range(ln):
         k = _key_tuple(lk, i)
         matches = index.get(k, []) if k is not None else []
-        if matches:
-            l_matched[i] = True
-            for j in matches:
-                r_matched[j] = True
-                li.append(i)
-                ri.append(j)
+        for j in matches:
+            li.append(i)
+            ri.append(j)
     names = [nm for nm, _ in plan.schema]
 
     def gather(tbl: HostTable, idx, valid=None) -> List[HostColumn]:
         arr = np.asarray(idx, np.int64)
         return [c.take(arr, valid) for c in tbl.columns]
 
+    # A residual condition restricts which key-matched PAIRS count as
+    # matches (SQL ON semantics — affects outer/semi/anti row survival,
+    # not just output filtering).
+    if plan.condition is not None and li:
+        paired = HostTable(gather(left, li) + gather(right, ri),
+                           left.names + right.names)
+        cond = cpu_eval.evaluate(plan.condition, paired)
+        keep = cond.values & cond.mask
+        li = [i for i, k in zip(li, keep) if k]
+        ri = [j for j, k in zip(ri, keep) if k]
+    l_matched = np.zeros(ln, bool)
+    r_matched = np.zeros(rn, bool)
+    for i in li:
+        l_matched[i] = True
+    for j in ri:
+        r_matched[j] = True
+
     if jt == "inner" or jt == "cross":
-        cols = gather(left, li) + gather(right, ri)
-        out = HostTable(cols, names)
-        # residual condition (inner only)
-        if plan.condition is not None:
-            cond = cpu_eval.evaluate(plan.condition, out)
-            out = out.select_rows(cond.values & cond.mask)
-        return out
+        return HostTable(gather(left, li) + gather(right, ri), names)
     if jt == "left_semi":
         return left.select_rows(l_matched)
     if jt == "left_anti":

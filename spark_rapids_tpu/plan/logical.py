@@ -152,6 +152,9 @@ class Join(LogicalPlan):
         self.condition = condition
         if len(self.left_keys) != len(self.right_keys):
             raise ValueError("left/right key counts differ")
+        if join_type == "cross" and self.left_keys:
+            raise ValueError("cross join takes no keys (use inner, or "
+                             "cross_join with a condition)")
 
     @property
     def schema(self) -> Schema:

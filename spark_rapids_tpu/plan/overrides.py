@@ -206,6 +206,15 @@ _expr(D.TruncDate, ts.TypeSig(ts.DATE, ts.STRING))
 _expr(H.Murmur3Hash, ts.comparable)
 _expr(H.XxHash64, ts.comparable)
 
+from ..expr import bitwise as BW  # noqa: E402
+
+for _cls in (BW.BitwiseAnd, BW.BitwiseOr, BW.BitwiseXor, BW.BitwiseNot,
+             BW.BitCount):
+    _expr(_cls, ts.integral + ts.TypeSig(ts.BOOLEAN))
+for _cls in (BW.ShiftLeft, BW.ShiftRight, BW.ShiftRightUnsigned):
+    _expr(_cls, ts.integral)
+_expr(BW.InterleaveBits, ts.integral)
+
 for _cls in (Agg.Count, Agg.CountStar, Agg.First, Agg.Last):
     _expr(_cls, ts.comparable)
 for _cls in (Agg.Sum, Agg.Average, Agg.VariancePop, Agg.VarianceSamp,
@@ -220,7 +229,7 @@ for _cls in (Agg.Min, Agg.Max):
 # --- exec rules ------------------------------------------------------------
 
 _TPU_JOIN_TYPES = ("inner", "left_outer", "right_outer", "left_semi",
-                   "left_anti")
+                   "left_anti", "full_outer", "cross")
 
 
 def _tag_join(meta: PlanMeta):
@@ -228,9 +237,16 @@ def _tag_join(meta: PlanMeta):
     if plan.join_type not in _TPU_JOIN_TYPES:
         meta.will_not_work_on_tpu(
             f"join type {plan.join_type} not supported on TPU yet")
-    if plan.condition is not None:
+    if plan.condition is not None and plan.join_type not in ("inner",
+                                                            "cross"):
+        # residual conditions on outer/semi/anti change match semantics
+        # (not merely filter output) — CPU engine handles those
         meta.will_not_work_on_tpu(
-            "join residual condition not supported on TPU yet")
+            f"join residual condition on {plan.join_type} not supported "
+            "on TPU yet")
+    if not plan.left_keys and plan.join_type not in ("inner", "cross"):
+        meta.will_not_work_on_tpu(
+            f"keyless {plan.join_type} join not supported on TPU yet")
 
 
 def _tag_agg(meta: PlanMeta):
@@ -343,12 +359,48 @@ def _build_tpu_exec(plan: LogicalPlan, children: List[TpuExec]) -> TpuExec:
         from ..exec.window import WindowExec
         return WindowExec(children[0], plan.window_exprs)
     if isinstance(plan, Join):
-        build = "left" if plan.join_type == "right_outer" else "right"
-        return ShuffledHashJoinExec(children[0], children[1],
-                                    plan.left_keys, plan.right_keys,
-                                    join_type=plan.join_type,
-                                    build_side=build)
+        return _build_join(plan, children)
     raise NotImplementedError(type(plan).__name__)
+
+
+def _build_join(plan: Join, children: List[TpuExec]) -> TpuExec:
+    from ..exec.nested_loop_join import (BroadcastNestedLoopJoinExec,
+                                         CartesianProductExec)
+    left, right = children
+    if not plan.left_keys:
+        # keyless: cartesian / conditioned nested loop
+        if plan.condition is None:
+            return CartesianProductExec(left, right)
+        return BroadcastNestedLoopJoinExec(left, right, plan.condition,
+                                           "inner")
+    if plan.join_type == "full_outer":
+        # full outer = left_outer(L,R) UNION null-extended anti(R,L)
+        # (both pieces are device-supported; the Union concatenates)
+        lo = ShuffledHashJoinExec(left, right, plan.left_keys,
+                                  plan.right_keys,
+                                  join_type="left_outer",
+                                  build_side="right")
+        anti = ShuffledHashJoinExec(right, left, plan.right_keys,
+                                    plan.left_keys,
+                                    join_type="left_anti",
+                                    build_side="right")
+        left_schema = plan.children[0].schema
+        null_left = [E.Literal(None, t) for _, t in left_schema]
+        exprs = ([E.Alias(e, n) for e, (n, _) in
+                  zip(null_left, left_schema)] +
+                 [E.Alias(E.col(n), n)
+                  for n, _ in plan.children[1].schema])
+        extended = ProjectExec(anti, exprs)
+        return UnionExec(lo, extended)
+    build = "left" if plan.join_type == "right_outer" else "right"
+    joined = ShuffledHashJoinExec(left, right, plan.left_keys,
+                                  plan.right_keys,
+                                  join_type=plan.join_type,
+                                  build_side=build)
+    if plan.condition is not None and plan.join_type == "inner":
+        # residual condition = post-join filter (sound for inner)
+        return FilterExec(joined, plan.condition)
+    return joined
 
 
 def _to_physical(meta: PlanMeta, conf: SrtConf):
@@ -398,6 +450,8 @@ def apply_overrides(plan: LogicalPlan, conf: Optional[SrtConf] = None):
     push_down_filters(plan)
     meta = PlanMeta(plan)
     meta.tag_for_tpu()
+    from .cost import apply_cost_model
+    apply_cost_model(meta, conf)
     mode = conf.get(EXPLAIN)
     if mode == "ALL":
         print("\n".join(meta.explain_lines()))

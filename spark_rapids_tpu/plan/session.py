@@ -202,6 +202,15 @@ class DataFrame:
             joined = L.Project(joined, keep)
         return DataFrame(self.session, joined)
 
+    def cross_join(self, other: "DataFrame",
+                   condition: Optional[Expression] = None) -> "DataFrame":
+        """Cartesian product, optionally with a non-equi condition
+        (nested-loop join on device)."""
+        how = "cross" if condition is None else "inner"
+        return DataFrame(self.session,
+                         L.Join(self.plan, other.plan, [], [], how,
+                                condition=condition))
+
     def sort(self, *cols, ascending: TUnion[bool, Sequence[bool]] = True
              ) -> "DataFrame":
         exprs = [_to_expr(c) for c in cols]

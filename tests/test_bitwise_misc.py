@@ -1,0 +1,161 @@
+"""Bitwise/z-order expressions + collect/percentile aggregates
+(SURVEY §2.5 bitwise.scala, zorder/, aggregate collect/percentile)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.expr import bitwise as B
+from spark_rapids_tpu.expr.aggregates import (CollectList, CollectSet,
+                                              Percentile)
+from spark_rapids_tpu.expr.core import col, lit
+from spark_rapids_tpu.plan import TpuSession
+from spark_rapids_tpu.testing import (IntGen, LongGen, assert_runs_on_tpu,
+                                      assert_tpu_cpu_equal_df, gen_table)
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession()
+
+
+def test_bitwise_ops(session):
+    data, schema = gen_table({"a": LongGen(lo=-10**6, hi=10**6),
+                              "b": IntGen(lo=0, hi=63)}, 96, 21)
+    df = session.create_dataframe(data, schema)
+    q = df.select(
+        B.BitwiseAnd(col("a"), lit(0xFF)).alias("and_"),
+        B.BitwiseOr(col("a"), lit(0x10)).alias("or_"),
+        B.BitwiseXor(col("a"), col("a") + 1).alias("xor_"),
+        B.BitwiseNot(col("a")).alias("not_"),
+        B.BitCount(col("a")).alias("pc"))
+    assert_tpu_cpu_equal_df(q)
+    assert_runs_on_tpu(q)
+
+
+def test_shifts(session):
+    data, schema = gen_table({"a": LongGen(lo=-10**9, hi=10**9),
+                              "n": IntGen(lo=0, hi=63, null_prob=0)},
+                             96, 22)
+    df = session.create_dataframe(data, schema)
+    assert_tpu_cpu_equal_df(df.select(
+        B.ShiftLeft(col("a"), col("n")).alias("sl"),
+        B.ShiftRight(col("a"), col("n")).alias("sr"),
+        B.ShiftRightUnsigned(col("a"), col("n")).alias("sru")))
+
+
+def test_shift_right_unsigned_negative():
+    """-1 >>> 1 must be 2^63 - 1 (Java semantics)."""
+    s = TpuSession()
+    df = s.create_dataframe({"a": [-1, -8]})
+    out = df.select(
+        B.ShiftRightUnsigned(col("a"), lit(1)).alias("r")).collect()
+    assert out[0]["r"] == 2 ** 63 - 1
+    assert out[1]["r"] == (2 ** 64 - 8) >> 1
+
+
+def test_interleave_bits_locality(session):
+    """z-order property: interleaved keys of nearby (x, y) points sort
+    near each other; differential vs CPU."""
+    data, schema = gen_table({"x": IntGen(lo=0, hi=1000, null_prob=0),
+                              "y": IntGen(lo=0, hi=1000, null_prob=0)},
+                             64, 23)
+    df = session.create_dataframe(data, schema)
+    q = df.select("x", "y",
+                  B.InterleaveBits(col("x"), col("y")).alias("z"))
+    assert_tpu_cpu_equal_df(q)
+    out = q.collect()
+    # identical points share a key; distinct points mostly don't
+    zs = {}
+    for r in out:
+        zs.setdefault((r["x"], r["y"]), set()).add(r["z"])
+    assert all(len(v) == 1 for v in zs.values())
+
+
+def test_collect_list_set(session):
+    df = session.create_dataframe(
+        {"k": [1, 1, 2, 1, 2], "v": [3, 1, 9, 3, 9]})
+    q = df.group_by("k").agg(CollectList(col("v")).alias("cl"),
+                             CollectSet(col("v")).alias("cs"))
+    from spark_rapids_tpu.testing import assert_falls_back_to_cpu
+    assert_falls_back_to_cpu(q)  # array outputs: CPU engine
+    out = {r["k"]: r for r in q.collect()}
+    assert sorted(out[1]["cl"]) == [1, 3, 3]
+    assert sorted(out[1]["cs"]) == [1, 3]
+    assert out[2]["cs"] == [9]
+
+
+def test_percentile(session):
+    df = session.create_dataframe(
+        {"k": [1] * 5 + [2] * 4,
+         "v": [10.0, 20.0, 30.0, 40.0, 50.0, 1.0, 2.0, 3.0, 4.0]})
+    q = df.group_by("k").agg(Percentile(col("v"), 0.5).alias("p50"),
+                             Percentile(col("v"), 0.25).alias("p25"))
+    out = {r["k"]: r for r in q.collect()}
+    assert out[1]["p50"] == 30.0
+    assert out[1]["p25"] == 20.0
+    assert out[2]["p50"] == 2.5
+
+
+def test_zorder_optimize(session, tmp_path):
+    from spark_rapids_tpu.delta import AcidTable
+    t = AcidTable.create(session, str(tmp_path / "z"),
+                         [("x", dt.INT64), ("y", dt.INT64)])
+    rng = np.random.default_rng(0)
+    for _ in range(3):  # three files
+        t.append(session.create_dataframe(
+            {"x": [int(v) for v in rng.integers(0, 1000, 50)],
+             "y": [int(v) for v in rng.integers(0, 1000, 50)]}))
+    assert len(t.files()) == 3
+    t.optimize(zorder_by=["x", "y"])
+    assert len(t.files()) == 1
+    assert t.to_df().count() == 150
+    ops = [h["operation"] for h in t.history()]
+    assert "OPTIMIZE ZORDER" in ops
+
+
+# --- cost model / plugin shell / task metrics ------------------------------
+
+def test_cost_model_keeps_tiny_plans_on_cpu():
+    from spark_rapids_tpu.conf import (OPTIMIZER_ENABLED,
+                                       OPTIMIZER_ROW_THRESHOLD, SrtConf)
+    from spark_rapids_tpu.plan import TpuSession, overrides
+    from spark_rapids_tpu.plan.transitions import CpuPhysical
+    conf = SrtConf({OPTIMIZER_ENABLED.key: "true",
+                    OPTIMIZER_ROW_THRESHOLD.key: "1000"})
+    s = TpuSession(conf)
+    tiny = s.create_dataframe({"x": [1, 2, 3]}).select(
+        (col("x") + 1).alias("y"))
+    physical = overrides.apply_overrides(tiny.plan, conf)
+    assert isinstance(physical, CpuPhysical)  # too small for the device
+    assert [r["y"] for r in tiny.collect()] == [2, 3, 4]
+    big = s.create_dataframe({"x": list(range(5000))}).select(
+        (col("x") + 1).alias("y"))
+    from spark_rapids_tpu.exec.base import TpuExec
+    assert isinstance(overrides.apply_overrides(big.plan, conf), TpuExec)
+
+
+def test_plugin_initialize():
+    from spark_rapids_tpu import plugin
+    info = plugin.initialize()
+    assert info.num_local_devices >= 1
+    assert plugin.initialize() is info  # idempotent
+    assert not plugin.is_fatal(
+        __import__("spark_rapids_tpu.memory.budget",
+                   fromlist=["RetryOOM"]).RetryOOM("x"))
+    assert plugin.is_fatal(RuntimeError("INTERNAL: device halt detected"))
+
+
+def test_task_metrics_accumulate():
+    from spark_rapids_tpu.memory.budget import reset_task_context
+    from spark_rapids_tpu.memory.spill import SpillableBatch, SpillPriority
+    from spark_rapids_tpu.columnar.vector import batch_from_pydict
+    ctx = reset_task_context()
+    sb = SpillableBatch(batch_from_pydict({"v": list(range(100))}),
+                        SpillPriority.CACHED)
+    freed = sb.spill_to_host()
+    assert freed > 0
+    m = ctx.metrics()
+    assert m["spilledBytes"] >= freed
+    assert m["spillTimeNs"] > 0
+    sb.close()

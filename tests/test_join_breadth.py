@@ -1,0 +1,123 @@
+"""Join breadth: cartesian, nested-loop with conditions, residual
+conditions on hash joins, device full_outer (SURVEY §2.4)."""
+
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.expr.core import col, lit
+from spark_rapids_tpu.plan import TpuSession, overrides
+from spark_rapids_tpu.testing import (IntGen, assert_runs_on_tpu,
+                                      assert_tpu_cpu_equal_df, gen_table)
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession()
+
+
+def two_tables(session, n=48, m=16):
+    a, sa = gen_table({"x": IntGen(lo=0, hi=20), "l": IntGen()}, n, 11)
+    b, sb = gen_table({"y": IntGen(lo=0, hi=20), "r": IntGen()}, m, 12)
+    return (session.create_dataframe(a, sa),
+            session.create_dataframe(b, sb))
+
+
+def test_cross_join(session):
+    left, right = two_tables(session, n=12, m=7)
+    q = left.cross_join(right)
+    assert q.count() == 12 * 7
+    assert_tpu_cpu_equal_df(q)
+    assert_runs_on_tpu(q)
+
+
+def test_nested_loop_condition(session):
+    left, right = two_tables(session)
+    q = left.cross_join(right, condition=col("x") < col("y"))
+    assert_tpu_cpu_equal_df(q)
+    assert_runs_on_tpu(q)
+
+
+def test_nested_loop_range_condition(session):
+    left, right = two_tables(session)
+    cond = (col("x") >= col("y") - 2) & (col("x") <= col("y") + 2)
+    q = left.cross_join(right, condition=cond)
+    assert_tpu_cpu_equal_df(q)
+
+
+def test_hash_join_residual_condition(session):
+    left, right = two_tables(session)
+    q = left.join(right, on=([col("x")], [col("y")]), how="inner") \
+        .filter(col("l") < col("r"))
+    assert_tpu_cpu_equal_df(q)
+    # condition carried inside the Join node also works on device
+    from spark_rapids_tpu.plan import logical as L
+    j = L.Join(left.plan, right.plan, [col("x")], [col("y")], "inner",
+               condition=col("l") < col("r"))
+    from spark_rapids_tpu.plan.session import DataFrame
+    assert_runs_on_tpu(DataFrame(session, j))
+
+
+def test_full_outer_on_device(session):
+    left, right = two_tables(session)
+    q = left.join(right, on=([col("x")], [col("y")]), how="full")
+    assert_tpu_cpu_equal_df(q)
+    assert_runs_on_tpu(q)  # no CPU fallback anymore
+
+
+def test_full_outer_with_strings_on_device(session):
+    from spark_rapids_tpu.testing import StringGen
+    a, sa = gen_table({"x": IntGen(lo=0, hi=6),
+                       "s": StringGen(max_len=4)}, 32, 13)
+    b, sb = gen_table({"y": IntGen(lo=0, hi=6),
+                       "t": StringGen(max_len=4)}, 24, 14)
+    left = session.create_dataframe(a, sa)
+    right = session.create_dataframe(b, sb)
+    q = left.join(right, on=([col("x")], [col("y")]), how="full")
+    assert_tpu_cpu_equal_df(q)
+
+
+def test_empty_sides(session):
+    left = session.create_dataframe({"x": [1, 2], "l": [1, 2]})
+    empty = session.create_dataframe({"y": [], "r": []},
+                                     [("y", dt.INT64), ("r", dt.INT64)])
+    assert left.cross_join(empty).count() == 0
+    q = left.join(empty, on=([col("x")], [col("y")]), how="full")
+    assert q.count() == 2
+
+
+def test_keyed_cross_join_rejected(session):
+    left, right = two_tables(session, n=4, m=4)
+    from spark_rapids_tpu.plan import logical as L
+    with pytest.raises(ValueError, match="cross join takes no keys"):
+        L.Join(left.plan, right.plan, [col("x")], [col("y")], "cross")
+
+
+def test_outer_join_residual_condition_on_cpu(session):
+    """ON-clause conditions on outer joins affect MATCH survival, not
+    just output filtering — the CPU engine must implement this (the
+    tagging pass promises it as the fallback)."""
+    from spark_rapids_tpu.plan import logical as L
+    from spark_rapids_tpu.plan.session import DataFrame
+    left = session.create_dataframe({"k": [1, 2], "l": [10, 99]})
+    right = session.create_dataframe({"k2": [1, 2], "r": [50, 50]})
+    j = L.Join(left.plan, right.plan, [col("k")], [col("k2")],
+               "left_outer", condition=col("l") < col("r"))
+    rows = sorted(DataFrame(session, j).collect(),
+                  key=lambda r: r["k"])
+    # k=1 matches (10<50): joined; k=2 fails the condition: null-extended
+    assert rows == [{"k": 1, "l": 10, "k2": 1, "r": 50},
+                    {"k": 2, "l": 99, "k2": None, "r": None}]
+
+
+def test_shift_narrow_types_promote(session):
+    from spark_rapids_tpu.expr import bitwise as B
+    from spark_rapids_tpu.columnar import dtypes as dtm
+    df = session.create_dataframe({"b": [1, -1, 5]},
+                                  [("b", dtm.INT8)])
+    q = df.select(B.ShiftLeft(col("b"), lit(8)).alias("sl"),
+                  B.ShiftRightUnsigned(col("b"), lit(4)).alias("sru"))
+    out = q.collect()
+    # Java: byte promotes to int; 1 << 8 = 256, -1 >>> 4 = 0x0FFFFFFF
+    assert out[0]["sl"] == 256
+    assert out[1]["sru"] == 0x0FFFFFFF
+    assert_tpu_cpu_equal_df(q)

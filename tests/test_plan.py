@@ -76,30 +76,36 @@ def test_range(session):
     assert [r["id"] for r in out] == [0, 3, 6, 9]
 
 
-def test_full_outer_join_falls_back(session):
-    """full_outer has no TPU impl yet: the plan must contain a CPU node
-    and still produce correct results through the fallback."""
+def test_full_outer_join_on_device(session):
+    """full_outer lowers to left_outer UNION null-extended anti on the
+    device (no fallback), with correct null-extension."""
     left = session.create_dataframe({"lk": [1, 2], "l": [10, 20]})
     right = session.create_dataframe({"rk": [2, 3], "r": [200, 300]})
     df = left.join(right, on=([col("lk")], [col("rk")]), how="full")
     meta = overrides.tag_only(df.plan)
-    assert not meta.can_this_be_replaced
+    assert meta.can_this_be_replaced
     physical = overrides.apply_overrides(df.plan, session.conf)
-    assert isinstance(physical, (CpuPhysical, DeviceToHostBridge))
+    assert isinstance(physical, TpuExec)
     rows = df.collect()
     assert len(rows) == 3
     by_k = {(r["lk"], r["r"]) for r in rows}
-    assert (1, None) in by_k
+    assert (1, None) in by_k and (None, 300) in by_k
 
 
 def test_fallback_sandwich_transitions(session):
     """TPU-supported ops above a CPU-fallback node must re-enter the
     device through HostToDeviceExec."""
-    left = session.create_dataframe({"k": [1, 2, 2], "l": [1, 2, 3]})
-    right = session.create_dataframe({"k": [2, 3], "r": [20, 30]})
-    df = left.join(right, on="k", how="full").filter(col("l") >= 1)
+    from spark_rapids_tpu.columnar import dtypes as dtypes_mod
+    from spark_rapids_tpu.udf import udf
+
+    def opaque(x):
+        return [x, x][0]  # uncompilable: list construction
+
+    f = udf(opaque, return_type=dtypes_mod.INT64)
+    df = session.create_dataframe({"k": [1, 2, 3], "l": [1, 2, 3]})
+    # CPU-only PythonUDF project, then a device-supported filter above it
+    df = df.select("k", f(col("l")).alias("fl")).filter(col("fl") >= 2)
     physical = overrides.apply_overrides(df.plan, session.conf)
-    # Filter is supported -> device node fed by HostToDevice transition
     assert isinstance(physical, TpuExec)
     found = []
     def walk(n):
@@ -110,15 +116,19 @@ def test_fallback_sandwich_transitions(session):
             walk(n.cpu_child)
     walk(physical)
     assert "HostToDeviceExec" in found
-    assert df.count() == 3
+    assert df.count() == 2
 
 
 def test_explain_lists_fallback_reason(session, capsys):
-    left = session.create_dataframe({"k": [1]})
-    right = session.create_dataframe({"k": [1]})
-    df = left.join(right, on="k", how="full")
-    out = df.explain()
-    assert "full_outer" in out and "!" in out
+    from spark_rapids_tpu.plan import logical as L
+    from spark_rapids_tpu.plan.session import DataFrame
+    left = session.create_dataframe({"k": [1], "l": [1]})
+    right = session.create_dataframe({"k": [1], "r": [2]})
+    # residual condition on an outer join: genuinely CPU-only
+    j = L.Join(left.plan, right.plan, [col("k")], [col("k")],
+               "left_outer", condition=col("l") < col("r"))
+    out = DataFrame(session, j).explain()
+    assert "residual condition" in out and "!" in out
 
 
 def test_sql_enabled_off_runs_cpu(session):

@@ -145,3 +145,56 @@ def test_heartbeats():
     assert hb.live_executors() == []
     assert set(hb.expire_dead()) == {"exec-0", "exec-1"}
     assert hb.register("exec-2", "host2:9") == []
+
+
+# --- TCP transport (DCN fetch path) ----------------------------------------
+
+def test_tcp_block_transport():
+    from spark_rapids_tpu.parallel.transport import (ShuffleBlockClient,
+                                                     ShuffleBlockServer,
+                                                     fetch_all_partitions)
+    mgr = _mgr("MULTITHREADED", "ZSTD")
+    mgr.register_shuffle(7, 2)
+    parts = [batch_from_pydict({"v": [1, 2, 3]}),
+             batch_from_pydict({"v": [40, 50]})]
+    mgr.write_map_output(7, 0, parts)
+    mgr.write_map_output(7, 1, parts)
+    server = ShuffleBlockServer(mgr)
+    try:
+        client = ShuffleBlockClient(server.endpoint)
+        got = [batch_to_pydict(b)["v"]
+               for b in client.fetch_partition(7, 1)]
+        assert got == [[40, 50], [40, 50]]
+        # empty partition fetch
+        assert list(client.fetch_partition(99, 0)) == []
+        # iterator over multiple peers (same server twice here)
+        rows = []
+        for b in fetch_all_partitions([server.endpoint, server.endpoint],
+                                      7, 0):
+            rows.extend(batch_to_pydict(b)["v"])
+        assert rows == [1, 2, 3] * 4
+    finally:
+        server.close()
+
+
+def test_tcp_transport_with_heartbeat_registry():
+    """Endpoint discovery through the heartbeat manager, then fetch."""
+    from spark_rapids_tpu.parallel.transport import (ShuffleBlockServer,
+                                                     fetch_all_partitions)
+    mgr = _mgr("MULTITHREADED")
+    mgr.register_shuffle(8, 1)
+    mgr.write_map_output(8, 0, [batch_from_pydict({"v": [9]})])
+    server = ShuffleBlockServer(mgr)
+    hb = ShuffleHeartbeatManager()
+    hb.register("exec-0", server.endpoint)
+    try:
+        eps = [server.endpoint]
+        # a joining executor discovers peers via register()
+        peers = hb.register("exec-1", "127.0.0.1:1")
+        assert [p.endpoint for p in peers] == eps
+        rows = []
+        for b in fetch_all_partitions(eps, 8, 0):
+            rows.extend(batch_to_pydict(b)["v"])
+        assert rows == [9]
+    finally:
+        server.close()
