@@ -222,6 +222,22 @@ SHUFFLE_PARTITIONS = conf("srt.shuffle.partitions") \
     .doc("Default shuffle partition count (spark.sql.shuffle.partitions)") \
     .check(_positive).integer(8)
 
+EXCHANGE_ENABLED = conf("srt.shuffle.exchange.enabled") \
+    .doc("Plan shuffle/broadcast exchanges between pipeline stages "
+         "(EnsureRequirements): hash exchange before aggregate merge and "
+         "shuffled joins, range exchange before global sort, broadcast "
+         "exchange for small build sides. When false the staged "
+         "operators run single-stream. "
+         "(GpuShuffleExchangeExecBase.scala:167)") \
+    .commonly_used().boolean(True)
+
+BROADCAST_THRESHOLD_ROWS = conf("srt.sql.broadcastRowThreshold") \
+    .doc("Estimated build-side row count at or below which a join uses a "
+         "broadcast exchange instead of shuffling both sides. "
+         "(spark.sql.autoBroadcastJoinThreshold, bytes there — rows here "
+         "because batch capacities are row-bucketed)") \
+    .check(_positive).integer(100_000)
+
 SHUFFLE_COMPRESS = conf("srt.shuffle.compression.codec") \
     .doc("Codec for serialized shuffle buffers: NONE, LZ4 (native "
          "codec), or ZSTD. "

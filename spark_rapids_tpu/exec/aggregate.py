@@ -1,10 +1,13 @@
 """Hash aggregate exec.
 
 Rebuild of GpuHashAggregateExec (GpuAggregateExec.scala:1711; AggHelper
-:175; merge iterator :711). Same two-phase structure as the reference:
+:175; merge iterator :711). Same staged structure as the reference:
 
-  per input batch : update  (raw rows -> partial per-group states)
-  at exhaustion   : concat partials, merge states, finalize
+  PARTIAL  : per input batch, raw rows -> packed per-group state batch
+  (exchange: hash-partition packed partials by the group keys —
+   inserted by the planner, GpuShuffleExchangeExecBase role)
+  FINAL    : per partition, concat partials, merge states, finalize
+  COMPLETE : both phases in one node (single-stage plans)
 
 The kernel is sort-based (ops/kernels.py group_aggregate/group_merge)
 rather than cuDF's hash groupby — sorting composes with XLA's static
@@ -28,6 +31,10 @@ from ..expr.core import Expression, make_result, output_name
 from ..ops import kernels as K
 from .base import ExecContext, Metric, NvtxTimer, Schema, TpuExec
 
+PARTIAL = "partial"
+FINAL = "final"
+COMPLETE = "complete"
+
 
 def _state_col_name(agg_index: int, state_name: str) -> str:
     return f"__agg{agg_index}__{state_name}"
@@ -37,31 +44,64 @@ class HashAggregateExec(TpuExec):
     """groupBy(keys).agg(fns) over the child stream.
 
     ``agg_exprs``: [(AggregateFunction, output_name)]. Aggregate inputs
-    are the function's child expressions evaluated against the child
-    schema.
+    are the function's child expressions evaluated against the original
+    (pre-partial) input schema. For ``mode=FINAL`` the child produces
+    packed partial batches, so the original schema must be supplied via
+    ``input_schema``.
     """
 
     def __init__(self, child: TpuExec, group_exprs: Sequence[Expression],
-                 agg_exprs: Sequence[Tuple[AggregateFunction, str]]):
+                 agg_exprs: Sequence[Tuple[AggregateFunction, str]],
+                 mode: str = COMPLETE, input_schema: Optional[Schema] = None):
         super().__init__(child)
+        self.mode = mode
         self.group_exprs = list(group_exprs)
         self.agg_exprs = list(agg_exprs)
-        in_schema = child.output_schema
+        in_schema = input_schema if input_schema is not None \
+            else child.output_schema
+        self.input_schema = in_schema
         self._key_names = [output_name(e, i)
                            for i, e in enumerate(self.group_exprs)]
-        self._schema = (
-            [(n, e.data_type(in_schema))
-             for n, e in zip(self._key_names, self.group_exprs)] +
+        key_schema = [(n, e.data_type(in_schema))
+                      for n, e in zip(self._key_names, self.group_exprs)]
+        self._result_schema = (
+            key_schema +
             [(name, fn.data_type(in_schema))
              for fn, name in self.agg_exprs])
         self._state_schemas = [fn.state_schema(in_schema)
                                for fn, _ in self.agg_exprs]
+        self._packed_schema = list(key_schema)
+        for i, sschema in enumerate(self._state_schemas):
+            for sname, stype in sschema:
+                self._packed_schema.append((_state_col_name(i, sname), stype))
         self._jit_update = jax.jit(self._update)
         self._jit_merge = jax.jit(self._merge_finalize)
 
     @property
     def output_schema(self) -> Schema:
-        return self._schema
+        return self._packed_schema if self.mode == PARTIAL \
+            else self._result_schema
+
+    def required_child_distributions(self):
+        from ..plan.distribution import (AllTuples, ClusteredDistribution,
+                                         UnspecifiedDistribution)
+        if self.mode != FINAL:
+            return [UnspecifiedDistribution()]
+        if not self.group_exprs:
+            return [AllTuples()]
+        from ..expr.core import col
+        return [ClusteredDistribution([col(n) for n in self._key_names])]
+
+    @property
+    def output_partitioning(self):
+        # grouping keys survive both phases under their output names, so
+        # the child's partitioning (hash on those names) still holds.
+        if self.mode == FINAL and self.group_exprs:
+            return self.children[0].output_partitioning
+        from ..plan.distribution import SinglePartition, UnknownPartitioning
+        if self.mode == FINAL:
+            return SinglePartition()
+        return UnknownPartitioning(1)
 
     # --- phase 1: partial aggregation of one raw batch ---
     def _update(self, batch: ColumnarBatch, row_offset) -> ColumnarBatch:
@@ -119,52 +159,77 @@ class HashAggregateExec(TpuExec):
             kc for kc in key_batch.columns]
         for i, (fn, name) in enumerate(self.agg_exprs):
             data, ok = fn.finalize(merged[i])
-            out_cols.append(make_result(data, ok & lm,
-                                        self._schema[len(self._key_names) + i][1]))
-        names = [n for n, _ in self._schema]
+            out_cols.append(make_result(
+                data, ok & lm,
+                self._result_schema[len(self._key_names) + i][1]))
+        names = [n for n, _ in self._result_schema]
         return ColumnarBatch(out_cols, names, num_groups)
 
-    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+    def _partial_stream(self, ctx: ExecContext, agg_time: Metric
+                        ) -> Iterator[ColumnarBatch]:
+        row_offset = 0
+        for batch in self.children[0].execute(ctx):
+            if int(batch.num_rows) == 0:
+                continue
+            with ctx.semaphore, NvtxTimer(agg_time, "agg.update"):
+                partial = self._jit_update(batch, jnp.int64(row_offset))
+            row_offset += int(batch.num_rows)
+            yield partial
+
+    def _merge_partition(self, ctx: ExecContext, partials,
+                         agg_time: Metric) -> Optional[ColumnarBatch]:
+        """Concat + merge one partition's packed partials. Returns None
+        for an empty grouped partition."""
         from ..memory.spill import SpillableBatch, SpillPriority
+        held: List = []
+        total = 0
+        try:
+            for p in partials:
+                if int(p.num_rows) == 0:
+                    continue
+                total += int(p.num_rows)
+                held.append(SpillableBatch(p, SpillPriority.ACTIVE_ON_DECK))
+            if not held:
+                if self.group_exprs:
+                    return None
+                return self._empty_global_result()
+            cap = choose_capacity(max(total, 1))
+            batches = [sb.get() for sb in held]
+            with ctx.semaphore, NvtxTimer(agg_time, "agg.merge"):
+                merged_in = (batches[0] if len(batches) == 1
+                             else K.concat_batches(batches, cap))
+                return self._jit_merge(merged_in)
+        finally:
+            for sb in held:
+                sb.close()
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
         m = ctx.metrics_for(self.exec_id)
         agg_time = m.setdefault("aggTime", Metric("aggTime", Metric.MODERATE,
                                                   "ns"))
-        partials: List[SpillableBatch] = []
-        total_groups_bound = 0
-        row_offset = 0
-        try:
-            for batch in self.children[0].execute(ctx):
-                with ctx.semaphore, NvtxTimer(agg_time, "agg.update"):
-                    partial = self._jit_update(batch,
-                                               jnp.int64(row_offset))
-                row_offset += int(batch.num_rows)
-                total_groups_bound += int(partial.num_rows)
-                partials.append(
-                    SpillableBatch(partial, SpillPriority.ACTIVE_ON_DECK))
-
-            if not partials:
-                if self.group_exprs:
-                    return  # grouped agg over empty input: no rows
-                # global agg over empty input: one null/zero row
+        if self.mode == PARTIAL:
+            yield from self._partial_stream(ctx, agg_time)
+            return
+        if self.mode == FINAL:
+            # partition-wise merge: one output batch per child partition
+            saw_any = False
+            for part in self.children[0].execute_partitioned(ctx):
+                out = self._merge_partition(ctx, part, agg_time)
+                if out is not None:
+                    saw_any = True
+                    yield out
+            if not saw_any and not self.group_exprs:
                 yield self._empty_global_result()
-                return
-
-            cap = choose_capacity(max(total_groups_bound, 1))
-            batches = [sb.get() for sb in partials]
-            with ctx.semaphore, NvtxTimer(agg_time, "agg.merge"):
-                if len(batches) == 1:
-                    merged_in = batches[0]
-                else:
-                    merged_in = K.concat_batches(batches, cap)
-                out = self._jit_merge(merged_in)
+            return
+        # COMPLETE: partial + merge fused in one stage
+        out = self._merge_partition(
+            ctx, self._partial_stream(ctx, agg_time), agg_time)
+        if out is not None:
             yield out
-        finally:
-            for sb in partials:
-                sb.close()
 
     def _empty_global_result(self) -> ColumnarBatch:
         cap = 8
-        in_schema = self.children[0].output_schema
+        in_schema = self.input_schema
         cols = []
         for i, (fn, name) in enumerate(self.agg_exprs):
             zero_states = {}
@@ -180,4 +245,5 @@ class HashAggregateExec(TpuExec):
     def node_description(self) -> str:
         aggs = ", ".join(f"{fn.name} as {n}" for fn, n in self.agg_exprs)
         keys = ", ".join(self._key_names)
-        return f"HashAggregate[keys=({keys}), aggs=({aggs})]"
+        return (f"HashAggregate[{self.mode}, keys=({keys}), "
+                f"aggs=({aggs})]")

@@ -215,6 +215,31 @@ class TpuExec:
     def output_schema(self) -> Schema:
         raise NotImplementedError
 
+    # --- distribution protocol (plan/distribution.py; EnsureRequirements) ---
+    @property
+    def output_partitioning(self):
+        """How this node's output rows are spread across partitions.
+        Default: unknown (forces an exchange wherever a parent needs a
+        specific distribution)."""
+        from ..plan.distribution import UnknownPartitioning
+        return UnknownPartitioning(1)
+
+    def required_child_distributions(self):
+        """Per-child Distribution requirements; the planner inserts
+        exchanges for children that do not satisfy them."""
+        from ..plan.distribution import UnspecifiedDistribution
+        return [UnspecifiedDistribution() for _ in self.children]
+
+    def execute_partitioned(self, ctx: "ExecContext"):
+        """Yield one batch-iterator per output partition.
+
+        Exchange nodes yield their reduce partitions; everything else is
+        a single stream. Partition-wise consumers (final aggregate,
+        shuffled join, partition sort) pull through this instead of
+        ``execute`` so partition boundaries survive the operator.
+        """
+        yield self.execute(ctx)
+
     def execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
         m = ctx.metrics_for(self.exec_id)
         rows = m.setdefault("numOutputRows", Metric("numOutputRows",

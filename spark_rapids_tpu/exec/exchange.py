@@ -1,39 +1,48 @@
-"""Shuffle exchange exec: hash-partition the child stream through the
-ShuffleManager.
+"""Exchange execs: shuffle (hash/round-robin/range) and broadcast.
 
 Rebuild of GpuShuffleExchangeExecBase.scala (:167,
-prepareBatchShuffleDependency :277) + GpuHashPartitioningBase (SURVEY
-§2.7): each incoming batch is split on-device into the target
-partitions (parallel/partition.py — the cudf Table.partition
-equivalent), the per-partition slices become shuffle blocks via the
-manager (device-cached or serialized host blocks), and the read side
-streams one reduce partition's blocks back (GpuShuffleCoalesceExec is
-the downstream CoalesceBatchesExec).
+prepareBatchShuffleDependency :277) + GpuHashPartitioningBase /
+GpuRangePartitioner + GpuBroadcastExchangeExec.scala:352 (SURVEY §2.7):
+each incoming batch is split on-device into the target partitions
+(parallel/partition.py — the cudf Table.partition equivalent), the
+per-partition slices become shuffle blocks via the manager
+(device-cached or serialized host blocks), and the read side streams one
+reduce partition's blocks back.
+
+These nodes are *planned*: overrides.ensure_distribution inserts them
+wherever a parent operator's required distribution (aggregate merge
+clustering, join co-partitioning, global-sort ordering) is not satisfied
+by its child — Spark's EnsureRequirements over our exec tree.
 
 Under a device mesh the same partitioning feeds the all-to-all
 collective instead (parallel/shuffle.py shuffle_exchange) — that path
-compiles into the SPMD program and never touches this manager.
+compiles into the SPMD program and never touches this manager
+(plan/mesh_executor.py lowers these nodes to collectives).
 """
 
 from __future__ import annotations
 
 import itertools
 import threading
+import time
 from typing import Iterator, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..columnar import dtypes as dt
 from ..columnar.vector import (ColumnVector, ColumnarBatch, StringColumn,
                                choose_capacity)
 from ..conf import SHUFFLE_PARTITIONS
 from ..expr.core import Expression
+from ..ops import kernels as K
 from ..parallel.partition import (PartitionedBatch, hash_partition_ids,
-                                  partition_batch, round_robin_partition_ids,
+                                  partition_batch, range_partition_ids,
+                                  round_robin_partition_ids,
                                   string_from_padded)
 from ..parallel.shuffle_manager import ShuffleManager, shuffle_manager
-from .base import ExecContext, Metric, Schema, TpuExec
+from .base import ExecContext, Metric, NvtxTimer, Schema, TpuExec
 
 _SHUFFLE_IDS = itertools.count(1)
 _IDS_LOCK = threading.Lock()
@@ -59,78 +68,305 @@ def partition_slice(pb: PartitionedBatch, i: int) -> ColumnarBatch:
 
 
 class ShuffleExchangeExec(TpuExec):
-    """Hash (or round-robin) repartitioning through the ShuffleManager."""
+    """Repartitioning through the ShuffleManager.
+
+    ``key_exprs`` non-empty -> hash partitioning; empty + ``sort_orders``
+    -> range partitioning (sample child, compute bounds, partition by
+    bound search); both empty -> round-robin (or a single-partition
+    concentrator when num_partitions == 1).
+    """
 
     def __init__(self, child: TpuExec,
                  key_exprs: Sequence[Expression],
                  num_partitions: Optional[int] = None,
-                 manager: Optional[ShuffleManager] = None):
+                 manager: Optional[ShuffleManager] = None,
+                 sort_orders: Optional[Sequence] = None):
         super().__init__(child)
         self.key_exprs = list(key_exprs)
+        self.sort_orders = list(sort_orders) if sort_orders else []
+        if self.key_exprs and self.sort_orders:
+            raise ValueError("hash keys and range orders are exclusive")
         self.num_partitions = num_partitions
         self.manager = manager
         self.shuffle_id = next_shuffle_id()
+        self._written = False
         self._jit_cache = {}
 
     @property
     def output_schema(self) -> Schema:
         return self.children[0].output_schema
 
-    def _partition_fn(self, num_parts: int):
-        if num_parts not in self._jit_cache:
-            def run(batch: ColumnarBatch) -> PartitionedBatch:
-                if self.key_exprs:
+    @property
+    def output_partitioning(self):
+        from ..plan.distribution import (HashPartitioning, RangePartitioning,
+                                         SinglePartition, UnknownPartitioning)
+        n = self.num_partitions or 1
+        if self.sort_orders:
+            return RangePartitioning(self.sort_orders, n)
+        if self.key_exprs:
+            return HashPartitioning(self.key_exprs, n)
+        if n == 1:
+            return SinglePartition()
+        return UnknownPartitioning(n)
+
+    def _effective_parts(self, ctx: ExecContext) -> int:
+        return self.num_partitions or ctx.conf.get(SHUFFLE_PARTITIONS)
+
+    def _partition_fn(self, num_parts: int, bounds=None):
+        key = (num_parts, bounds is not None)
+        if key not in self._jit_cache:
+            if self.sort_orders:
+                orders = self.sort_orders
+
+                def run(batch: ColumnarBatch, bnds) -> PartitionedBatch:
+                    keys = [o.expr.eval(batch) for o in orders]
+                    pids = range_partition_ids(
+                        keys, bnds,
+                        [o.ascending for o in orders],
+                        [o.nulls_first for o in orders])
+                    return partition_batch(batch, pids, num_parts)
+                self._jit_cache[key] = jax.jit(run)
+            elif self.key_exprs:
+                def run(batch: ColumnarBatch) -> PartitionedBatch:
                     keys = [e.eval(batch) for e in self.key_exprs]
                     pids = hash_partition_ids(keys, num_parts)
-                else:
+                    return partition_batch(batch, pids, num_parts)
+                self._jit_cache[key] = jax.jit(run)
+            else:
+                def run(batch: ColumnarBatch) -> PartitionedBatch:
                     pids = round_robin_partition_ids(batch.capacity,
                                                      num_parts)
-                return partition_batch(batch, pids, num_parts)
-            self._jit_cache[num_parts] = jax.jit(run)
-        return self._jit_cache[num_parts]
+                    return partition_batch(batch, pids, num_parts)
+                self._jit_cache[key] = jax.jit(run)
+        return self._jit_cache[key]
 
-    def write(self, ctx: ExecContext) -> int:
-        """Map phase: drain the child, write all blocks. Returns the
-        number of map tasks (batches) written."""
+    # --- range bounds (GpuRangePartitioner.sketch: sample to the
+    # driver, sort, take quantile bounds) ---
+    def _compute_bounds(self, ctx: ExecContext,
+                        batches: List[ColumnarBatch], num_parts: int):
+        """Sample the buffered child, return per-key bound Columns with
+        (num_parts - 1) rows, device-resident."""
+        orders = self.sort_orders
+        per_batch = max(1, (num_parts * 40) // max(len(batches), 1))
+        samples: List[tuple] = []  # row tuples of physical values
+        for b in batches:
+            n = int(b.num_rows)
+            take = min(n, per_batch)
+            if take == 0:
+                continue
+            with ctx.semaphore:
+                keys = [o.expr.eval(b) for o in orders]
+            # host copies of the first `take` live rows
+            cols = []
+            for kc in keys:
+                vals, mask = kc.to_numpy(take)
+                cols.append((vals, mask))
+            for i in range(take):
+                samples.append(tuple(
+                    (None if not cols[k][1][i] else cols[k][0][i])
+                    for k in range(len(orders))))
+        if not samples:
+            samples = [tuple(None for _ in orders)]
+
+        def sort_key(row):
+            parts = []
+            for v, o in zip(row, orders):
+                null_rank = 0 if o.nulls_first else 2
+                if v is None:
+                    parts.append((null_rank if o.ascending else 2 - null_rank,
+                                  0))
+                else:
+                    key = v
+                    if isinstance(v, (bytes, str)):
+                        key = _InvertibleStr(str(v), o.ascending)
+                        parts.append((1, key))
+                        continue
+                    parts.append((1, key if o.ascending else -key))
+            return parts
+        samples.sort(key=sort_key)
+        # quantile bounds: num_parts-1 cut rows
+        bounds_rows = []
+        m = len(samples)
+        for i in range(1, num_parts):
+            bounds_rows.append(samples[min(m - 1, (i * m) // num_parts)])
+        # build device columns for the bounds; capacity == bound count
+        # exactly (range_partition_ids treats every slot as a bound).
+        # Sampled non-string values are already physical lanes (the
+        # to_numpy copy is raw), so primitive bounds are built directly
+        # rather than through column_from_numpy's python-value coercion.
+        in_schema = self.children[0].output_schema
+        bound_cols = []
+        cap = len(bounds_rows)
+        from ..columnar.vector import column_from_numpy
+        for k, o in enumerate(orders):
+            ktype = o.expr.data_type(in_schema)
+            mask = np.array([r[k] is not None for r in bounds_rows],
+                            dtype=bool)
+            if ktype == dt.STRING:
+                values = np.array([r[k] for r in bounds_rows], dtype=object)
+                bound_cols.append(column_from_numpy(values, cap,
+                                                    dtype=ktype, mask=mask))
+            else:
+                phys = np.dtype(ktype.physical)
+                data = np.array([0 if r[k] is None else r[k]
+                                 for r in bounds_rows], dtype=phys)
+                bound_cols.append(ColumnVector(jnp.asarray(data),
+                                               jnp.asarray(mask), ktype))
+        return bound_cols, len(bounds_rows)
+
+    def _write(self, ctx: ExecContext) -> None:
+        """Map phase: drain the child, write all blocks. Idempotent."""
+        if self._written:
+            return
+        self._written = True
         mgr = self.manager or shuffle_manager()
-        n_parts = self.num_partitions or ctx.conf.get(SHUFFLE_PARTITIONS)
+        n_parts = self._effective_parts(ctx)
         mgr.register_shuffle(self.shuffle_id, n_parts)
         m = ctx.metrics_for(self.exec_id)
         part_time = m.setdefault("partitionTime",
                                  Metric("partitionTime", Metric.MODERATE,
                                         "ns"))
+        write_rows = m.setdefault("shuffleWriteRows",
+                                  Metric("shuffleWriteRows",
+                                         Metric.ESSENTIAL))
         map_id = 0
+        if self.sort_orders:
+            # buffer spillable, sample bounds, then partition
+            from ..memory.spill import SpillableBatch, SpillPriority
+            held = []
+            try:
+                for batch in self.children[0].execute(ctx):
+                    if int(batch.num_rows) == 0:
+                        continue
+                    held.append(SpillableBatch(batch,
+                                               SpillPriority.ACTIVE_ON_DECK))
+                batches = [sb.get() for sb in held]
+                bounds, n_bounds = self._compute_bounds(ctx, batches,
+                                                        n_parts)
+                fn = self._partition_fn(n_parts, bounds=True)
+                for batch in batches:
+                    t0 = time.perf_counter_ns()
+                    with ctx.semaphore:
+                        pb = fn(batch, bounds)
+                        parts = [partition_slice(pb, i)
+                                 for i in range(n_parts)]
+                    part_time.add(time.perf_counter_ns() - t0)
+                    write_rows.add(int(batch.num_rows))
+                    mgr.write_map_output(self.shuffle_id, map_id, parts)
+                    map_id += 1
+            finally:
+                for sb in held:
+                    sb.close()
+            return
+        fn = self._partition_fn(n_parts)
         for batch in self.children[0].execute(ctx):
             if int(batch.num_rows) == 0:
                 continue
-            import time
             t0 = time.perf_counter_ns()
             with ctx.semaphore:
-                pb = self._partition_fn(n_parts)(batch)
+                pb = fn(batch)
                 parts = [partition_slice(pb, i) for i in range(n_parts)]
             part_time.add(time.perf_counter_ns() - t0)
+            write_rows.add(int(batch.num_rows))
             mgr.write_map_output(self.shuffle_id, map_id, parts)
             map_id += 1
-        return map_id
+
+    # kept for existing callers/tests
+    def write(self, ctx: ExecContext) -> None:
+        self._write(ctx)
 
     def read_partition(self, ctx: ExecContext,
                        reduce_id: int) -> Iterator[ColumnarBatch]:
         mgr = self.manager or shuffle_manager()
+        self._write(ctx)
         yield from mgr.read_partition(self.shuffle_id, reduce_id)
 
-    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
-        """Single-process execution: write all map outputs, then stream
-        partitions in order (partition boundaries preserved for
-        downstream partition-wise operators)."""
+    def execute_partitioned(self, ctx: ExecContext):
+        """One iterator per reduce partition, in partition order."""
         mgr = self.manager or shuffle_manager()
-        self.write(ctx)
+        self._write(ctx)
         n_parts = mgr.num_partitions(self.shuffle_id)
         try:
             for reduce_id in range(n_parts):
-                yield from self.read_partition(ctx, reduce_id)
+                yield mgr.read_partition(self.shuffle_id, reduce_id)
         finally:
             mgr.unregister_shuffle(self.shuffle_id)
 
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        """Single-stream execution: write all map outputs, then stream
+        partitions in order (partition boundaries preserved for
+        downstream partition-wise operators)."""
+        for part in self.execute_partitioned(ctx):
+            yield from part
+
     def node_description(self) -> str:
-        keys = ", ".join(repr(e) for e in self.key_exprs) or "round-robin"
-        return f"ShuffleExchange[{keys}]"
+        if self.sort_orders:
+            keys = "range: " + ", ".join(repr(o.expr)
+                                         for o in self.sort_orders)
+        else:
+            keys = ", ".join(repr(e) for e in self.key_exprs) or "round-robin"
+        n = self.num_partitions or "conf"
+        return f"ShuffleExchange[{keys}, parts={n}]"
+
+
+class _InvertibleStr:
+    """String wrapper whose ordering can be flipped (descending bounds
+    sort on the host sampler)."""
+
+    __slots__ = ("s", "asc")
+
+    def __init__(self, s: str, asc: bool):
+        self.s = s
+        self.asc = asc
+
+    def __lt__(self, other):
+        return (self.s < other.s) if self.asc else (self.s > other.s)
+
+    def __eq__(self, other):
+        return self.s == other.s
+
+
+class BroadcastExchangeExec(TpuExec):
+    """Materialize the child into one batch replicated to every consumer
+    (GpuBroadcastExchangeExec.scala:352 doExecuteBroadcast:467). In
+    single-process execution this is a concat; under a mesh it lowers to
+    an all_gather (parallel/shuffle.py all_gather_batch)."""
+
+    def __init__(self, child: TpuExec):
+        super().__init__(child)
+        self._materialized: Optional[ColumnarBatch] = None
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema
+
+    @property
+    def output_partitioning(self):
+        from ..plan.distribution import BroadcastPartitioning
+        return BroadcastPartitioning()
+
+    def materialize(self, ctx: ExecContext) -> Optional[ColumnarBatch]:
+        if self._materialized is None:
+            m = ctx.metrics_for(self.exec_id)
+            bt = m.setdefault("broadcastTime",
+                              Metric("broadcastTime", Metric.MODERATE, "ns"))
+            with NvtxTimer(bt, "broadcast.build"):
+                batches = [b for b in self.children[0].execute(ctx)
+                           if int(b.num_rows) > 0]
+                if not batches:
+                    return None
+                total = sum(int(b.num_rows) for b in batches)
+                with ctx.semaphore:
+                    self._materialized = (
+                        batches[0] if len(batches) == 1
+                        else K.concat_batches(batches,
+                                              choose_capacity(total)))
+        return self._materialized
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        out = self.materialize(ctx)
+        if out is not None:
+            yield out
+
+    def node_description(self) -> str:
+        return "BroadcastExchange"

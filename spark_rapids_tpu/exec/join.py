@@ -82,11 +82,9 @@ class _HashJoinBase(TpuExec):
         return left_s + right_s
 
     # --- build side ---
-    def _materialize_build(self, ctx: ExecContext) -> Optional[ColumnarBatch]:
-        build_child = self.children[1] if self.build_side == "right" \
-            else self.children[0]
-        batches = [b for b in build_child.execute(ctx)
-                   if int(b.num_rows) > 0]
+    def _concat_build(self, ctx: ExecContext,
+                      stream) -> Optional[ColumnarBatch]:
+        batches = [b for b in stream if int(b.num_rows) > 0]
         if not batches:
             return None
         total = sum(int(b.num_rows) for b in batches)
@@ -137,6 +135,11 @@ class _HashJoinBase(TpuExec):
             else self.children[1]
         return probe_child.execute(ctx)
 
+    def _build_stream(self, ctx: ExecContext):
+        build_child = self.children[1] if self.build_side == "right" \
+            else self.children[0]
+        return build_child.execute(ctx)
+
     def _reorder_columns(self, out: ColumnarBatch) -> ColumnarBatch:
         """Kernel output is probe-then-build; plan output is left-then-
         right."""
@@ -180,15 +183,17 @@ class _HashJoinBase(TpuExec):
                 probe.names + [n for n, _ in build_schema], probe.num_rows)
             yield self._reorder_columns(out)
 
-    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+    def _join_partition(self, ctx: ExecContext, probe_stream,
+                        build_stream) -> Iterator[ColumnarBatch]:
+        """Join one (probe partition, build partition) pair."""
         m = ctx.metrics_for(self.exec_id)
         retries = m.setdefault("joinOverflowRetries",
                                Metric("joinOverflowRetries", Metric.DEBUG))
-        build = self._materialize_build(ctx)
+        build = self._concat_build(ctx, build_stream)
         if build is None:
-            yield from self._empty_result(self._probe_stream(ctx), ctx)
+            yield from self._empty_result(probe_stream, ctx)
             return
-        for probe in self._probe_stream(ctx):
+        for probe in probe_stream:
             n_probe = int(probe.num_rows)
             if n_probe == 0:
                 continue
@@ -208,10 +213,53 @@ class _HashJoinBase(TpuExec):
                     f"{_MAX_GROWTH_STEPS} growth steps")
             yield self._reorder_columns(out)
 
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        yield from self._join_partition(ctx, self._probe_stream(ctx),
+                                        self._build_stream(ctx))
+
 
 class ShuffledHashJoinExec(_HashJoinBase):
-    """Hash join where both sides arrive partitioned
-    (GpuShuffledHashJoinExec.scala:90)."""
+    """Hash join where both sides arrive co-partitioned on the join keys
+    (GpuShuffledHashJoinExec.scala:90): the planner exchanges both
+    children into the same hash partitioning; each partition pair joins
+    independently (the distributed join decomposition)."""
+
+    def required_child_distributions(self):
+        from ..plan.distribution import (ClusteredDistribution,
+                                         UnspecifiedDistribution)
+        if not self.left_keys:
+            return [UnspecifiedDistribution(), UnspecifiedDistribution()]
+        return [ClusteredDistribution(self.left_keys),
+                ClusteredDistribution(self.right_keys)]
+
+    @property
+    def output_partitioning(self):
+        # rows stay in their partition; the probe side's placement holds
+        probe = self.children[0] if self.build_side == "right" \
+            else self.children[1]
+        return probe.output_partitioning
+
+    def _zipped_partitions(self, ctx: ExecContext):
+        """Pairwise (probe, build) partition streams. zip_longest (not
+        zip) so both child generators are driven to exhaustion in order
+        — an exchange unregisters its shuffle in a finally that must run
+        only after its last partition has been consumed."""
+        import itertools
+        left_parts = self.children[0].execute_partitioned(ctx)
+        right_parts = self.children[1].execute_partitioned(ctx)
+        for lp, rp in itertools.zip_longest(left_parts, right_parts):
+            if lp is None or rp is None:
+                raise RuntimeError(
+                    "join children partition counts differ")
+            yield ((lp, rp) if self.build_side == "right" else (rp, lp))
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        for probe, build in self._zipped_partitions(ctx):
+            yield from self._join_partition(ctx, probe, build)
+
+    def execute_partitioned(self, ctx: ExecContext):
+        for probe, build in self._zipped_partitions(ctx):
+            yield self._join_partition(ctx, probe, build)
 
     def node_description(self) -> str:
         return (f"ShuffledHashJoin[{self.join_type}, "
@@ -220,9 +268,23 @@ class ShuffledHashJoinExec(_HashJoinBase):
 
 class BroadcastHashJoinExec(_HashJoinBase):
     """Hash join with a broadcast build side
-    (GpuBroadcastHashJoinExecBase.scala). Single-process execution is
-    identical to the shuffled variant; under a mesh the build side is
-    replicated to every device (parallel/broadcast)."""
+    (GpuBroadcastHashJoinExecBase.scala): the build child is a
+    BroadcastExchangeExec; the probe side streams through unexchanged.
+    Under a mesh the build side is replicated to every device
+    (all_gather)."""
+
+    def required_child_distributions(self):
+        from ..plan.distribution import (BroadcastDistribution,
+                                         UnspecifiedDistribution)
+        if self.build_side == "right":
+            return [UnspecifiedDistribution(), BroadcastDistribution()]
+        return [BroadcastDistribution(), UnspecifiedDistribution()]
+
+    @property
+    def output_partitioning(self):
+        probe = self.children[0] if self.build_side == "right" \
+            else self.children[1]
+        return probe.output_partitioning
 
     def node_description(self) -> str:
         return (f"BroadcastHashJoin[{self.join_type}, "

@@ -50,23 +50,36 @@ class SortExec(TpuExec):
     def output_schema(self) -> Schema:
         return self.children[0].output_schema
 
-    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+    def required_child_distributions(self):
+        from ..plan.distribution import (OrderedDistribution,
+                                         UnspecifiedDistribution)
+        if self.global_sort:
+            return [OrderedDistribution(self.order)]
+        return [UnspecifiedDistribution()]
+
+    @property
+    def output_partitioning(self):
+        from ..plan.distribution import RangePartitioning
+        if self.global_sort:
+            child = self.children[0].output_partitioning
+            return RangePartitioning(self.order, child.num_partitions)
+        return self.children[0].output_partitioning
+
+    def _sort_partition(self, ctx: ExecContext,
+                        stream) -> Iterator[ColumnarBatch]:
+        """Buffer one partition (spillable), concat, sort — the
+        out-of-core shape of GpuSortExec.scala:242 with the spill tier
+        holding the runs."""
         from ..memory.spill import SpillableBatch, SpillPriority
         runs: List[SpillableBatch] = []
         total = 0
         try:
-            for batch in self.children[0].execute(ctx):
+            for batch in stream:
                 if int(batch.num_rows) == 0:
-                    continue
-                if not self.global_sort:
-                    with ctx.semaphore:
-                        yield self._jit_sort(batch)
                     continue
                 total += int(batch.num_rows)
                 runs.append(SpillableBatch(batch,
                                            SpillPriority.ACTIVE_ON_DECK))
-            if not self.global_sort:
-                return
             if not runs:
                 return
             cap = choose_capacity(total)
@@ -78,6 +91,20 @@ class SortExec(TpuExec):
         finally:
             for sb in runs:
                 sb.close()
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        if not self.global_sort:
+            for batch in self.children[0].execute(ctx):
+                if int(batch.num_rows) == 0:
+                    continue
+                with ctx.semaphore:
+                    yield self._jit_sort(batch)
+            return
+        # Global sort over a range-partitioned child: sorting each
+        # partition and emitting in partition order is globally sorted
+        # (partition i's rows all precede partition i+1's).
+        for part in self.children[0].execute_partitioned(ctx):
+            yield from self._sort_partition(ctx, part)
 
     def node_description(self) -> str:
         keys = ", ".join(

@@ -42,6 +42,62 @@ def round_robin_partition_ids(capacity: int, num_parts: int,
     return ((jnp.arange(capacity, dtype=jnp.int32) + start) % num_parts)
 
 
+def _order_class(col: Column, n: int, asc: bool, nf: bool) -> jnp.ndarray:
+    """int8[n] ordering class consistent with ops.kernels.sort_indices:
+    nulls-first nulls < values < NaN (ascending) with NaN leading under
+    descending, nulls-last nulls always last."""
+    valid = col.validity
+    if isinstance(col, ColumnVector) and \
+            jnp.issubdtype(col.data.dtype, jnp.floating):
+        nan = jnp.isnan(col.data)
+    else:
+        nan = jnp.zeros(n, jnp.bool_)
+    value_cls = jnp.where(nan, jnp.int8(2 if asc else 1),
+                          jnp.int8(1 if asc else 2))
+    return jnp.where(valid, value_cls, jnp.int8(0 if nf else 3))
+
+
+def range_partition_ids(key_cols: Sequence[Column],
+                        bound_cols: Sequence[Column],
+                        ascending: Sequence[bool],
+                        nulls_first: Sequence[bool]) -> jnp.ndarray:
+    """int32[capacity] destination partition by bound search.
+
+    GpuRangePartitioner semantics: partition id = number of bounds the
+    row sorts strictly after, so rows equal to a bound land with that
+    bound's partition and the concatenation of partitions in id order is
+    globally sorted. ``bound_cols`` hold exactly ``num_parts - 1`` rows
+    (capacity == row count; null bounds are legitimate sampled keys).
+    Comparison semantics match ops.kernels.sort_indices exactly —
+    required for distributed sort correctness.
+    """
+    from ..ops.kernels import _rank_keys
+    cap = key_cols[0].capacity
+    B = bound_cols[0].capacity
+    before = jnp.zeros((cap, B), jnp.bool_)
+    eq = jnp.ones((cap, B), jnp.bool_)
+    for rc, bc, asc, nf in zip(key_cols, bound_cols, ascending, nulls_first):
+        rcls = _order_class(rc, cap, asc, nf)
+        bcls = _order_class(bc, B, asc, nf)
+        before = before | (eq & (rcls[:, None] < bcls[None, :]))
+        eq = eq & (rcls[:, None] == bcls[None, :])
+        rkeys = list(_rank_keys(rc))
+        bkeys = list(_rank_keys(bc))
+        # strings of different pad buckets produce different word counts;
+        # zero-extend (zero == empty suffix, ordered before any byte)
+        while len(rkeys) < len(bkeys):
+            rkeys.append(jnp.zeros(cap, rkeys[0].dtype))
+        while len(bkeys) < len(rkeys):
+            bkeys.append(jnp.zeros(B, bkeys[0].dtype))
+        for rk, bk in zip(rkeys, bkeys):
+            lt = (rk[:, None] < bk[None, :]) if asc \
+                else (rk[:, None] > bk[None, :])
+            before = before | (eq & lt)
+            eq = eq & (rk[:, None] == bk[None, :])
+    after = ~(before | eq)
+    return jnp.sum(after.astype(jnp.int32), axis=1)
+
+
 class PartitionedBatch:
     """A batch split into ``num_parts`` dense slots.
 

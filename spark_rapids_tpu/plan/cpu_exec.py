@@ -132,20 +132,25 @@ def _sort_keys(col: HostColumn, ascending: bool, nulls_first: bool):
         rank[order] = uniq_rank
         key = rank
     elif np.issubdtype(col.values.dtype, np.floating):
-        # NaN greatest: map to +inf rank beyond all finite
+        # NaN strictly greatest (> +inf): lift it into the class rank —
+        # mapping it onto inf would tie with real infinities. Classes:
+        # nulls-first null(0) < values(1) < NaN(2) < nulls-last null(3)
+        # ascending; descending flips the value/NaN order (NaN first).
         v = col.values.astype(np.float64)
-        key = np.where(np.isnan(v), np.inf, v)
+        nan = np.isnan(v)
+        nan_cls = 2 if ascending else 1
+        val_cls = 1 if ascending else 2
+        null_rank = np.where(col.mask, np.where(nan, nan_cls, val_cls),
+                             0 if nulls_first else 3)
+        key = np.where(nan, 0.0, v)
         # -0.0 == 0.0 in Spark ordering; np handles that already
     else:
         key = col.values
     if not ascending:
-        if key.dtype == np.float64:
+        if np.issubdtype(np.asarray(key).dtype, np.floating):
             key = -key
-            # NaN was mapped to inf -> -inf, still extreme but now first:
-            # correct, NaN is greatest so it comes first in desc order.
         else:
             key = -(key.astype(np.int64))
-        null_rank = np.where(col.mask, 1, 0 if nulls_first else 2)
     return null_rank, key
 
 

@@ -1,0 +1,180 @@
+"""Data distribution requirements and output partitionings.
+
+The physical-planning vocabulary that lets the planner place shuffle
+exchanges between pipeline stages — the role Catalyst's
+``Distribution``/``Partitioning`` lattice plays for the reference
+(GpuShuffleExchangeExecBase.scala:167 consumes a target partitioning,
+GpuHashPartitioningBase.scala:64 implements it on device). Every
+``TpuExec`` reports an ``output_partitioning`` and a per-child
+``required_child_distributions`` list; ``ensure_distribution`` (in
+overrides.py) walks the physical tree and inserts
+``ShuffleExchangeExec`` / ``BroadcastExchangeExec`` nodes wherever a
+child's partitioning does not satisfy its parent's requirement —
+Spark's EnsureRequirements rule, rebuilt over our exec tree.
+
+Expression identity is structural (by ``repr``): the frontend overrides
+``__eq__`` to build predicate trees, so reprs are the canonical key.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+
+def _expr_key(e) -> str:
+    return repr(e)
+
+
+# --- distributions (what a parent requires of a child) ---------------------
+
+class Distribution:
+    """Base requirement on how a child's rows are spread across
+    partitions."""
+
+
+class UnspecifiedDistribution(Distribution):
+    """No requirement."""
+
+    def __repr__(self):
+        return "Unspecified"
+
+
+class AllTuples(Distribution):
+    """All rows in a single partition (global aggregates, limits)."""
+
+    def __repr__(self):
+        return "AllTuples"
+
+
+class ClusteredDistribution(Distribution):
+    """Rows with equal values of ``exprs`` land in the same partition
+    (aggregate merge, shuffled join)."""
+
+    def __init__(self, exprs: Sequence, num_partitions: Optional[int] = None):
+        self.exprs = list(exprs)
+        self.num_partitions = num_partitions
+
+    def __repr__(self):
+        return f"Clustered({', '.join(map(repr, self.exprs))})"
+
+
+class OrderedDistribution(Distribution):
+    """Rows are range-partitioned by the sort order: partition i holds
+    rows strictly below partition i+1 (global sort)."""
+
+    def __init__(self, sort_orders: Sequence):
+        self.sort_orders = list(sort_orders)
+
+    def __repr__(self):
+        return "Ordered"
+
+
+class BroadcastDistribution(Distribution):
+    """Every participant holds a full copy (broadcast join build side)."""
+
+    def __repr__(self):
+        return "Broadcast"
+
+
+# --- partitionings (what a node produces) ----------------------------------
+
+class Partitioning:
+    num_partitions: int = 1
+
+    def satisfies(self, dist: Distribution) -> bool:
+        if isinstance(dist, UnspecifiedDistribution):
+            return True
+        return False
+
+
+class UnknownPartitioning(Partitioning):
+    """No known structure. ``num_partitions`` is a hint only."""
+
+    def __init__(self, num_partitions: int = 1):
+        self.num_partitions = num_partitions
+
+    def __repr__(self):
+        return f"UnknownPartitioning({self.num_partitions})"
+
+
+class SinglePartition(Partitioning):
+    """Exactly one partition: satisfies everything except broadcast
+    (matching Spark: a single partition is trivially clustered and
+    ordered)."""
+
+    num_partitions = 1
+
+    def satisfies(self, dist: Distribution) -> bool:
+        return not isinstance(dist, BroadcastDistribution)
+
+    def __repr__(self):
+        return "SinglePartition"
+
+
+class HashPartitioning(Partitioning):
+    """pmod(murmur3(exprs), n) row placement
+    (GpuHashPartitioningBase.scala:64)."""
+
+    def __init__(self, exprs: Sequence, num_partitions: int):
+        self.exprs = list(exprs)
+        self.num_partitions = num_partitions
+
+    def satisfies(self, dist: Distribution) -> bool:
+        if isinstance(dist, UnspecifiedDistribution):
+            return True
+        if isinstance(dist, ClusteredDistribution):
+            if dist.num_partitions is not None and \
+                    dist.num_partitions != self.num_partitions:
+                return False
+            # hash exprs must be a subset of the clustering exprs and
+            # non-empty: equal cluster keys then imply equal hash keys.
+            mine = [_expr_key(e) for e in self.exprs]
+            theirs = {_expr_key(e) for e in dist.exprs}
+            return bool(mine) and all(k in theirs for k in mine)
+        return False
+
+    def __repr__(self):
+        return (f"HashPartitioning({', '.join(map(repr, self.exprs))}, "
+                f"{self.num_partitions})")
+
+
+class RangePartitioning(Partitioning):
+    """Rows range-partitioned by sort order (GpuRangePartitioner)."""
+
+    def __init__(self, sort_orders: Sequence, num_partitions: int):
+        self.sort_orders = list(sort_orders)
+        self.num_partitions = num_partitions
+
+    def satisfies(self, dist: Distribution) -> bool:
+        if isinstance(dist, UnspecifiedDistribution):
+            return True
+        if isinstance(dist, OrderedDistribution):
+            if len(dist.sort_orders) > len(self.sort_orders):
+                return False
+            for want, have in zip(dist.sort_orders, self.sort_orders):
+                if (_expr_key(want.expr) != _expr_key(have.expr)
+                        or want.ascending != have.ascending
+                        or want.nulls_first != have.nulls_first):
+                    return False
+            return True
+        if isinstance(dist, ClusteredDistribution):
+            theirs = {_expr_key(e) for e in dist.exprs}
+            return all(_expr_key(o.expr) in theirs
+                       for o in self.sort_orders)
+        return False
+
+    def __repr__(self):
+        return f"RangePartitioning({self.num_partitions})"
+
+
+class BroadcastPartitioning(Partitioning):
+    """Output of a broadcast exchange: a full copy everywhere."""
+
+    num_partitions = 1
+
+    def satisfies(self, dist: Distribution) -> bool:
+        return isinstance(dist, (BroadcastDistribution,
+                                 UnspecifiedDistribution))
+
+    def __repr__(self):
+        return "BroadcastPartitioning"

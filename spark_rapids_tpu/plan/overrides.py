@@ -17,7 +17,8 @@ from __future__ import annotations
 from typing import Callable, Dict, List, Optional, Type
 
 from ..columnar import dtypes as dt
-from ..conf import EXPLAIN, SQL_ENABLED, SrtConf, active_conf
+from ..conf import (BROADCAST_THRESHOLD_ROWS, EXCHANGE_ENABLED, EXPLAIN,
+                    SHUFFLE_PARTITIONS, SQL_ENABLED, SrtConf, active_conf)
 from ..exec.aggregate import HashAggregateExec
 from ..exec.base import TpuExec
 from ..exec.basic import (BatchScanExec, CoalesceBatchesExec, ExpandExec,
@@ -327,7 +328,8 @@ _register_exec_rules()
 
 # --- conversion ------------------------------------------------------------
 
-def _build_tpu_exec(plan: LogicalPlan, children: List[TpuExec]) -> TpuExec:
+def _build_tpu_exec(plan: LogicalPlan, children: List[TpuExec],
+                    conf: SrtConf) -> TpuExec:
     from ..cache import CachedRelation
     from ..io.scan import FileScan, FileSourceScanExec
     if isinstance(plan, CachedRelation):
@@ -353,19 +355,57 @@ def _build_tpu_exec(plan: LogicalPlan, children: List[TpuExec]) -> TpuExec:
                          for o in plan.order],
                         global_sort=plan.is_global)
     if isinstance(plan, Aggregate):
-        return HashAggregateExec(children[0], plan.group_exprs,
-                                 plan.agg_exprs)
+        # staged (GpuAggregateExec partial -> exchange -> final); the
+        # ensure_distribution pass places the exchange between them
+        from ..exec.aggregate import FINAL, PARTIAL
+        partial = HashAggregateExec(children[0], plan.group_exprs,
+                                    plan.agg_exprs, mode=PARTIAL)
+        return HashAggregateExec(partial, plan.group_exprs, plan.agg_exprs,
+                                 mode=FINAL,
+                                 input_schema=plan.children[0].schema)
     if isinstance(plan, Window):
         from ..exec.window import WindowExec
         return WindowExec(children[0], plan.window_exprs)
     if isinstance(plan, Join):
-        return _build_join(plan, children)
+        return _build_join(plan, children, conf)
     raise NotImplementedError(type(plan).__name__)
 
 
-def _build_join(plan: Join, children: List[TpuExec]) -> TpuExec:
+def _coerce_join_keys(plan: Join):
+    """Join keys must share a dtype across sides: the partitioner hashes
+    key *values*, and murmur3 is width-sensitive (Spark's analyzer
+    inserts these casts before planning)."""
+    from ..expr.conditional import _common_type
+    ls, rs = plan.children[0].schema, plan.children[1].schema
+    lk, rk = [], []
+    for l, r in zip(plan.left_keys, plan.right_keys):
+        lt, rt = l.data_type(ls), r.data_type(rs)
+        if lt == rt:
+            lk.append(l)
+            rk.append(r)
+            continue
+        ct = _common_type([lt, rt])
+        lk.append(l if lt == ct else C.Cast(l, ct))
+        rk.append(r if rt == ct else C.Cast(r, ct))
+    return lk, rk
+
+
+def _join_cls(plan: Join, build: str, conf: SrtConf):
+    """Broadcast when the build side's estimated rows are small
+    (spark.sql.autoBroadcastJoinThreshold role)."""
+    from .cost import estimate_rows
+    build_plan = plan.children[1] if build == "right" else plan.children[0]
+    if estimate_rows(build_plan) <= conf.get(BROADCAST_THRESHOLD_ROWS):
+        from ..exec.join import BroadcastHashJoinExec
+        return BroadcastHashJoinExec
+    return ShuffledHashJoinExec
+
+
+def _build_join(plan: Join, children: List[TpuExec],
+                conf: SrtConf) -> TpuExec:
     from ..exec.nested_loop_join import (BroadcastNestedLoopJoinExec,
                                          CartesianProductExec)
+    from .cost import estimate_rows
     left, right = children
     if not plan.left_keys:
         # keyless: cartesian / conditioned nested loop
@@ -373,15 +413,14 @@ def _build_join(plan: Join, children: List[TpuExec]) -> TpuExec:
             return CartesianProductExec(left, right)
         return BroadcastNestedLoopJoinExec(left, right, plan.condition,
                                            "inner")
+    left_keys, right_keys = _coerce_join_keys(plan)
     if plan.join_type == "full_outer":
         # full outer = left_outer(L,R) UNION null-extended anti(R,L)
         # (both pieces are device-supported; the Union concatenates)
-        lo = ShuffledHashJoinExec(left, right, plan.left_keys,
-                                  plan.right_keys,
+        lo = ShuffledHashJoinExec(left, right, left_keys, right_keys,
                                   join_type="left_outer",
                                   build_side="right")
-        anti = ShuffledHashJoinExec(right, left, plan.right_keys,
-                                    plan.left_keys,
+        anti = ShuffledHashJoinExec(right, left, right_keys, left_keys,
                                     join_type="left_anti",
                                     build_side="right")
         left_schema = plan.children[0].schema
@@ -393,10 +432,9 @@ def _build_join(plan: Join, children: List[TpuExec]) -> TpuExec:
         extended = ProjectExec(anti, exprs)
         return UnionExec(lo, extended)
     build = "left" if plan.join_type == "right_outer" else "right"
-    joined = ShuffledHashJoinExec(left, right, plan.left_keys,
-                                  plan.right_keys,
-                                  join_type=plan.join_type,
-                                  build_side=build)
+    cls = _join_cls(plan, build, conf)
+    joined = cls(left, right, left_keys, right_keys,
+                 join_type=plan.join_type, build_side=build)
     if plan.condition is not None and plan.join_type == "inner":
         # residual condition = post-join filter (sound for inner)
         return FilterExec(joined, plan.condition)
@@ -422,10 +460,77 @@ def _to_physical(meta: PlanMeta, conf: SrtConf):
     if meta.can_this_be_replaced and conf.get(SQL_ENABLED):
         dev = [c if isinstance(c, TpuExec) else HostToDeviceExec(c)
                for c in children]
-        return _build_tpu_exec(meta.plan, dev)
+        return _build_tpu_exec(meta.plan, dev, conf)
     host = [c if not isinstance(c, TpuExec) else DeviceToHostBridge(c)
             for c in children]
     return CpuPhysical(meta.plan, host)
+
+
+# --- EnsureRequirements: place exchanges ----------------------------------
+
+def ensure_distribution(node: TpuExec, conf: SrtConf) -> TpuExec:
+    """Insert shuffle/broadcast exchanges wherever a child's output
+    partitioning does not satisfy its parent's required distribution
+    (Spark EnsureRequirements; reference stages are glued the same way —
+    GpuShuffleExchangeExecBase between partial and final aggregates,
+    co-partitioning for GpuShuffledHashJoinExec, GpuRangePartitioner
+    under global sort)."""
+    from ..exec.exchange import BroadcastExchangeExec, ShuffleExchangeExec
+    from .distribution import (AllTuples, BroadcastDistribution,
+                               ClusteredDistribution, OrderedDistribution)
+    # recurse into device children (and through host islands)
+    node.children = [ensure_distribution(c, conf) for c in node.children]
+    if hasattr(node, "cpu_child"):
+        node.cpu_child = _ensure_physical(node.cpu_child, conf)
+    if not conf.get(EXCHANGE_ENABLED):
+        return node
+    reqs = node.required_child_distributions()
+    n_parts = conf.get(SHUFFLE_PARTITIONS)
+    clustered = [r for r in reqs if isinstance(r, ClusteredDistribution)]
+    if len(clustered) > 1:
+        # co-partitioning (join): all clustered children must agree on
+        # the partition count, so pin it in the requirement
+        for r in clustered:
+            r.num_partitions = n_parts
+    out_children = []
+    for child, req in zip(node.children, reqs):
+        if child.output_partitioning.satisfies(req):
+            out_children.append(child)
+        elif isinstance(req, BroadcastDistribution):
+            out_children.append(BroadcastExchangeExec(child))
+        elif isinstance(req, AllTuples):
+            out_children.append(ShuffleExchangeExec(child, [],
+                                                    num_partitions=1))
+        elif isinstance(req, ClusteredDistribution):
+            out_children.append(ShuffleExchangeExec(
+                child, req.exprs, num_partitions=n_parts))
+        elif isinstance(req, OrderedDistribution):
+            if n_parts > 1:
+                out_children.append(ShuffleExchangeExec(
+                    child, [], num_partitions=n_parts,
+                    sort_orders=req.sort_orders))
+            else:
+                out_children.append(child)
+        else:
+            out_children.append(child)
+    node.children = out_children
+    return node
+
+
+def _ensure_physical(physical, conf: SrtConf):
+    """Walk a mixed host/device physical tree applying
+    ensure_distribution to every device island."""
+    if isinstance(physical, TpuExec):
+        return ensure_distribution(physical, conf)
+    if isinstance(physical, DeviceToHostBridge):
+        physical.tpu = ensure_distribution(physical.tpu, conf)
+        physical.children = [physical.tpu]
+        return physical
+    if isinstance(physical, CpuPhysical):
+        physical.children = [_ensure_physical(c, conf)
+                             for c in physical.children]
+        return physical
+    return physical
 
 
 def push_down_filters(plan: LogicalPlan) -> None:
@@ -459,7 +564,7 @@ def apply_overrides(plan: LogicalPlan, conf: Optional[SrtConf] = None):
         lines = meta.explain_lines(only_not_on_tpu=True)
         if lines:
             print("\n".join(lines))
-    return _to_physical(meta, conf)
+    return _ensure_physical(_to_physical(meta, conf), conf)
 
 
 def tag_only(plan: LogicalPlan) -> PlanMeta:
