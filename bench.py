@@ -1,111 +1,252 @@
-"""Benchmark driver: TPC-H q6-shaped scan/filter/aggregate (BASELINE.md
-config 1) on the attached accelerator vs a single-threaded pandas CPU
-baseline (the stand-in for CPU Spark until a real cluster baseline is
-captured).
+"""Benchmark driver: TPC-H q6/q1/q3 END-TO-END through the framework —
+session -> planner (staged exchanges) -> parquet scan -> device exec ->
+collect — vs a single-process pandas CPU baseline running the same
+queries over the same parquet files (the stand-in for CPU Spark until a
+real cluster baseline is captured). BASELINE.md config 1.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-``value`` is accelerator throughput in Mrows/s; ``vs_baseline`` is the
-speedup over the CPU baseline on identical data (>1 = faster).
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+``value`` is q6 end-to-end throughput in Mrows/s over the lineitem
+table; ``vs_baseline`` is the speedup over the pandas baseline (>1 =
+faster). Extra keys carry q1/q3 wall-clocks, the kernel-only q6 number
+(so regressions are attributable to kernels vs the pipeline around
+them), effective scan bandwidth, and a measured-roofline HBM utilization
+estimate for the kernel pipeline.
+
+Environment knobs: SRT_BENCH_SCALE (lineitem rows, default 6,000,000 =
+SF1-shaped), SRT_BENCH_ITERS, SRT_BENCH_DIR (parquet cache; data is
+generated once per scale and reused).
 """
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
 
+SCALE = int(os.environ.get("SRT_BENCH_SCALE", 6_000_000))
+ITERS = int(os.environ.get("SRT_BENCH_ITERS", 3))
+DATA_DIR = os.environ.get("SRT_BENCH_DIR",
+                          f"/tmp/srt_bench_sf_{SCALE}")
+KERNEL_ROWS = 1 << 22
+KERNEL_ITERS = 10
 
-ROWS = 1 << 22  # 4M rows/batch
-ITERS = 10
-
-
-def make_data(rows: int):
-    rng = np.random.default_rng(42)
-    return {
-        "extendedprice": rng.uniform(100.0, 10_000.0, rows).astype(np.float32),
-        "discount": (rng.integers(0, 11, rows).astype(np.float32) / 100.0),
-        "quantity": rng.integers(1, 51, rows).astype(np.float32),
-        "shipdate": rng.integers(8766, 10957, rows).astype(np.int32),
-    }
+# bytes per lineitem row actually touched by q6 on device:
+# l_extendedprice/l_discount/l_quantity float64 + l_shipdate int32-date
+Q6_BYTES_PER_ROW = 8 * 3 + 4
 
 
-def cpu_baseline(data, iters: int) -> float:
-    """pandas q6: best-of wall seconds per iteration."""
-    import pandas as pd
-    df = pd.DataFrame(data)
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def ensure_data():
+    """Generate (once) lineitem/orders/customer parquet at SCALE."""
+    from spark_rapids_tpu.datagen import generate_table, lineitem_spec, \
+        orders_spec
+    from spark_rapids_tpu.models.tpch import customer_spec
+    specs = (lineitem_spec(SCALE), orders_spec(max(SCALE // 4, 1)),
+             customer_spec(max(SCALE // 40, 1)))
+    for spec in specs:
+        out = os.path.join(DATA_DIR, spec.name)
+        if not (os.path.isdir(out) and os.listdir(out)):
+            log(f"generating {spec.name} ({spec.num_rows} rows)...")
+            generate_table(None, spec, out, chunk_rows=1 << 20)
+    return {s.name: os.path.join(DATA_DIR, s.name) for s in specs}
+
+
+def _best(fn, iters):
     best = float("inf")
     for _ in range(iters):
         t0 = time.perf_counter()
-        m = ((df["shipdate"] >= 9131) & (df["shipdate"] < 9496) &
-             (df["discount"] >= 0.05) & (df["discount"] <= 0.07) &
-             (df["quantity"] < 24.0))
-        sel = df[m]
-        _ = (sel["extendedprice"] * sel["discount"]).sum(), len(sel)
+        fn()
         best = min(best, time.perf_counter() - t0)
     return best
 
 
-def tpu_run(data, iters: int) -> float:
+# ---------------------------------------------------------------------------
+# pandas CPU baseline (end-to-end: parquet read + query, per iteration)
+# ---------------------------------------------------------------------------
+
+def pandas_q6(paths):
+    import pandas as pd
+    li = pd.read_parquet(paths["lineitem"],
+                         columns=["l_shipdate", "l_discount",
+                                  "l_quantity", "l_extendedprice"])
+    import datetime
+    lo, hi = datetime.date(1994, 1, 1), datetime.date(1995, 1, 1)
+    m = ((li["l_shipdate"] >= lo) & (li["l_shipdate"] < hi) &
+         (li["l_discount"] >= 0.05) & (li["l_discount"] <= 0.07) &
+         (li["l_quantity"] < 24.0))
+    sel = li[m]
+    return float((sel["l_extendedprice"] * sel["l_discount"]).sum())
+
+
+def pandas_q1(paths):
+    import pandas as pd
+    import datetime
+    li = pd.read_parquet(paths["lineitem"])
+    li = li[li["l_shipdate"] <= datetime.date(1998, 9, 2)]
+    li["disc_price"] = li["l_extendedprice"] * (1 - li["l_discount"])
+    li["charge"] = li["disc_price"] * (1 + li["l_tax"])
+    g = li.groupby(["l_returnflag", "l_linestatus"]).agg(
+        sum_qty=("l_quantity", "sum"),
+        sum_base_price=("l_extendedprice", "sum"),
+        sum_disc_price=("disc_price", "sum"),
+        sum_charge=("charge", "sum"),
+        avg_qty=("l_quantity", "mean"),
+        avg_price=("l_extendedprice", "mean"),
+        avg_disc=("l_discount", "mean"),
+        count_order=("l_quantity", "count"))
+    return g.sort_index()
+
+
+def pandas_q3(paths):
+    import pandas as pd
+    import datetime
+    cutoff = datetime.date(1995, 3, 15)
+    cust = pd.read_parquet(paths["customer"])
+    orders = pd.read_parquet(paths["orders"])
+    li = pd.read_parquet(paths["lineitem"],
+                         columns=["l_orderkey", "l_extendedprice",
+                                  "l_discount", "l_shipdate"])
+    c = cust[cust["c_mktsegment"] == "BUILDING"]
+    o = orders[orders["o_orderdate"] < cutoff]
+    l = li[li["l_shipdate"] > cutoff]
+    j = c.merge(o, left_on="c_custkey", right_on="o_custkey") \
+         .merge(l, left_on="o_orderkey", right_on="l_orderkey")
+    j["revenue"] = j["l_extendedprice"] * (1 - j["l_discount"])
+    g = (j.groupby(["o_orderkey", "o_orderdate"], as_index=False)
+          ["revenue"].sum()
+          .sort_values("revenue", ascending=False).head(10))
+    return g
+
+
+# ---------------------------------------------------------------------------
+# framework end-to-end
+# ---------------------------------------------------------------------------
+
+def framework_session():
+    from spark_rapids_tpu.conf import SrtConf
+    from spark_rapids_tpu.plan.session import TpuSession
+    return TpuSession(SrtConf({"srt.shuffle.partitions": 4}))
+
+
+def framework_queries(session, paths):
+    from spark_rapids_tpu.models import q1, q3, q6
+    t = {name: session.read.parquet(p) for name, p in paths.items()}
+    return {
+        "q6": lambda: q6(t["lineitem"]).collect(),
+        "q1": lambda: q1(t["lineitem"]).collect(),
+        "q3": lambda: q3(t["customer"], t["orders"],
+                         t["lineitem"]).collect(),
+    }
+
+
+# ---------------------------------------------------------------------------
+# kernel-only q6 (secondary metric: device pipeline without scan)
+# ---------------------------------------------------------------------------
+
+def kernel_q6_seconds() -> float:
     import jax
     import jax.numpy as jnp
-
     from spark_rapids_tpu.columnar import dtypes as dt
     from spark_rapids_tpu.columnar.vector import ColumnarBatch, ColumnVector
     from spark_rapids_tpu.exec.aggregate import HashAggregateExec
     from spark_rapids_tpu.exec.basic import BatchScanExec
     from spark_rapids_tpu.expr import col
     from spark_rapids_tpu.expr.aggregates import CountStar, Sum
+    from spark_rapids_tpu.expr.core import lit
     from spark_rapids_tpu.ops import kernels as K
 
-    rows = len(data["shipdate"])
-    types = {"extendedprice": dt.FLOAT32, "discount": dt.FLOAT32,
-             "quantity": dt.FLOAT32, "shipdate": dt.INT32}
+    rows = KERNEL_ROWS
+    rng = np.random.default_rng(42)
+    data = {
+        "extendedprice": (rng.uniform(100.0, 10_000.0, rows)
+                          .astype(np.float32), dt.FLOAT32),
+        "discount": ((rng.integers(0, 11, rows).astype(np.float32)
+                      / 100.0), dt.FLOAT32),
+        "quantity": (rng.integers(1, 51, rows).astype(np.float32),
+                     dt.FLOAT32),
+        "shipdate": (rng.integers(8766, 10957, rows).astype(np.int32),
+                     dt.INT32),
+    }
     valid = jnp.ones(rows, jnp.bool_)
-    cols = [ColumnVector(jnp.asarray(data[n]), valid, types[n])
-            for n in types]
-    batch = ColumnarBatch(cols, list(types), rows)
-
+    cols = [ColumnVector(jnp.asarray(a), valid, t)
+            for a, t in data.values()]
+    batch = ColumnarBatch(cols, list(data), rows)
     agg = HashAggregateExec(
         BatchScanExec([], batch.schema()), [],
         [(Sum(col("extendedprice") * col("discount")), "revenue"),
          (CountStar(), "n")])
-    # float32 literals keep the comparison lanes in float32 (a float64
-    # literal would promote the whole predicate to emulated-f64 on TPU
-    # and shift which discounts pass the boundary).
-    from spark_rapids_tpu.expr.core import lit
     f32 = lambda v: lit(float(np.float32(v)), dt.FLOAT32)
     pred = ((col("shipdate") >= 9131) & (col("shipdate") < 9496) &
             (col("discount") >= f32(0.05)) & (col("discount") <= f32(0.07)) &
             (col("quantity") < f32(24.0)))
 
     @jax.jit
-    def q6(b):
-        cond = pred.eval(b)
-        filtered = K.filter_batch(b, cond)
+    def q6k(b):
+        filtered = K.filter_batch(b, pred.eval(b))
         partial = agg._update(filtered, jnp.int32(0))
         return agg._merge_finalize(partial)
 
-    out = q6(batch)  # compile
+    out = q6k(batch)
     jax.block_until_ready(jax.tree_util.tree_leaves(out))
-    best = float("inf")
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        out = q6(batch)
-        jax.block_until_ready(jax.tree_util.tree_leaves(out))
-        best = min(best, time.perf_counter() - t0)
-    return best
+    return _best(lambda: jax.block_until_ready(
+        jax.tree_util.tree_leaves(q6k(batch))), KERNEL_ITERS)
+
+
+def measured_peak_bw_gbs() -> float:
+    """Empirical HBM roofline: best-case bytes/s of a device copy."""
+    import jax
+    import jax.numpy as jnp
+    n = 1 << 26  # 64M f32 = 256MB
+    x = jnp.arange(n, dtype=jnp.float32)
+    f = jax.jit(lambda a: a * 1.0000001)
+    jax.block_until_ready(f(x))
+    t = _best(lambda: jax.block_until_ready(f(x)), 5)
+    return (2 * 4 * n) / t / 1e9  # read + write
 
 
 def main():
-    data = make_data(ROWS)
-    cpu_s = cpu_baseline(data, ITERS)
-    tpu_s = tpu_run(data, ITERS)
-    mrows = ROWS / tpu_s / 1e6
+    paths = ensure_data()
+    log("pandas baselines...")
+    cpu = {name: _best(lambda fn=fn: fn(paths), max(ITERS - 1, 1))
+           for name, fn in (("q6", pandas_q6), ("q1", pandas_q1),
+                            ("q3", pandas_q3))}
+    log(f"pandas: {cpu}")
+
+    session = framework_session()
+    queries = framework_queries(session, paths)
+    tpu = {}
+    for name in ("q6", "q1", "q3"):
+        queries[name]()  # warm: compile + populate caches
+        tpu[name] = _best(queries[name], ITERS)
+        log(f"framework {name}: {tpu[name]:.3f}s "
+            f"(pandas {cpu[name]:.3f}s, {cpu[name] / tpu[name]:.2f}x)")
+
+    kq6 = kernel_q6_seconds()
+    peak = measured_peak_bw_gbs()
+    kernel_mrows = KERNEL_ROWS / kq6 / 1e6
+    kernel_bytes_s = KERNEL_ROWS * (4 * 4) / kq6  # 4 f32/i32 cols
+    e2e_mrows = SCALE / tpu["q6"] / 1e6
+    scan_gbs = SCALE * Q6_BYTES_PER_ROW / tpu["q6"] / 1e9
+
     print(json.dumps({
-        "metric": "tpch_q6_throughput",
-        "value": round(mrows, 2),
+        "metric": "tpch_q6_e2e_throughput",
+        "value": round(e2e_mrows, 2),
         "unit": "Mrows/s",
-        "vs_baseline": round(cpu_s / tpu_s, 3),
+        "vs_baseline": round(cpu["q6"] / tpu["q6"], 3),
+        "rows": SCALE,
+        "q6_s": round(tpu["q6"], 4),
+        "q1_s": round(tpu["q1"], 4),
+        "q3_s": round(tpu["q3"], 4),
+        "q1_vs_baseline": round(cpu["q1"] / tpu["q1"], 3),
+        "q3_vs_baseline": round(cpu["q3"] / tpu["q3"], 3),
+        "q6_kernel_mrows_s": round(kernel_mrows, 1),
+        "q6_effective_gb_s": round(scan_gbs, 2),
+        "kernel_hbm_util_est": round(kernel_bytes_s / 1e9 / peak, 4),
+        "measured_peak_gb_s": round(peak, 1),
     }))
 
 

@@ -5,8 +5,12 @@ users find the API familiar; each symbol maps to the expression classes
 in the submodules (inventory mirrors SURVEY §2.5).
 """
 
-from . import aggregates, arithmetic, cast, conditional, core, datetime, hashing, \
-    mathfns, predicates, strings
+from . import aggregates, arithmetic, cast, collections, conditional, core, \
+    datetime, hashing, mathfns, predicates, strings
+from .collections import (ArrayContains, ArrayMax, ArrayMin, CreateArray,
+                          CreateNamedStruct, ElementAt, Explode,
+                          GetArrayItem, GetStructField, Size, SortArray,
+                          array, explode, explode_outer, posexplode, struct)
 from .aggregates import (AggregateFunction, Average, Count, CountStar, First,
                          Last, Max, Min, StddevPop, StddevSamp, Sum,
                          VariancePop, VarianceSamp)

@@ -1,0 +1,357 @@
+"""Collection (array/struct) expressions on device.
+
+Rebuild of the reference's complex-type expression surface (SURVEY §2.5:
+collectionOperations.scala ~4k LoC, complexTypeCreator.scala,
+complexTypeExtractors.scala). Device lowering rides the static
+``pad_bucket`` lane view of ListColumn (columnar/nested.py
+element_lanes) — each list kernel is a masked reduction/selection over a
+dense ``(capacity, pad_bucket)`` block, which XLA fuses and vectorizes;
+there is no per-row ragged loop.
+
+Null semantics follow Spark:
+- size(null) -> null, element access out of bounds -> null,
+- array_contains: true if found; null if not found and the array has a
+  null element (3-valued membership, like IN),
+- array_min/max skip nulls; all-null/empty -> null,
+- struct field access of a null struct -> null.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+
+from ..columnar import dtypes as dt
+from ..columnar.nested import ListColumn, StructColumn
+from ..columnar.vector import (Column, ColumnVector, ColumnarBatch,
+                               StringColumn, round_pow2)
+from .core import Expression, Schema, make_result, merged_validity
+
+
+def _element_type(expr: Expression, schema: Schema) -> dt.DType:
+    t = expr.data_type(schema)
+    if not isinstance(t, dt.ArrayType):
+        raise TypeError(f"expected array input, got {t}")
+    return t.element_type
+
+
+class CreateArray(Expression):
+    """array(e1, e2, ...) — fixed-width list per row
+    (complexTypeCreator.scala GpuCreateArray)."""
+
+    def __init__(self, *children: Expression):
+        super().__init__(*children)
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        from .conditional import _common_type
+        et = _common_type([c.data_type(schema) for c in self.children])
+        return dt.ArrayType(et)
+
+    def eval(self, batch: ColumnarBatch) -> ListColumn:
+        cols = [c.eval(batch) for c in self.children]
+        k = len(cols)
+        cap = batch.capacity
+        child_dt = cols[0].dtype
+        for c in cols[1:]:
+            if c.dtype != child_dt:
+                child_dt = dt.promote(child_dt, c.dtype)
+        phys = child_dt.physical
+        live = batch.live_mask()
+        # interleave row-major: row i's elements at [i*k, (i+1)*k)
+        vals = jnp.stack([c.data.astype(phys) for c in cols],
+                         axis=1).reshape(cap * k)
+        valid = jnp.stack([c.validity & live for c in cols],
+                          axis=1).reshape(cap * k)
+        child_cap = round_pow2(max(cap * k, 8))
+        if child_cap > cap * k:
+            vals = jnp.concatenate(
+                [vals, jnp.zeros(child_cap - cap * k, phys)])
+            valid = jnp.concatenate(
+                [valid, jnp.zeros(child_cap - cap * k, jnp.bool_)])
+        vals = jnp.where(valid, vals, jnp.zeros((), phys))
+        child = ColumnVector(vals, valid, child_dt)
+        offsets = jnp.arange(cap + 1, dtype=jnp.int32) * k
+        # dead rows keep extents but validity=False; kernels mask on it
+        return ListColumn(offsets, child, live, child_dt,
+                          pad_bucket=round_pow2(max(k, 1)))
+
+    def __repr__(self):
+        return f"array({', '.join(map(repr, self.children))})"
+
+
+class Size(Expression):
+    """size(array) (collectionOperations.scala GpuSize); null -> null."""
+
+    def __init__(self, child: Expression):
+        super().__init__(child)
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        _element_type(self.children[0], schema)
+        return dt.INT32
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        lc: ListColumn = self.children[0].eval(batch)
+        return make_result(lc.lengths().astype(jnp.int32), lc.validity,
+                           dt.INT32)
+
+
+class GetArrayItem(Expression):
+    """arr[i] — zero-based element access (complexTypeExtractors.scala
+    GpuGetArrayItem). Out of bounds / negative -> null."""
+
+    def __init__(self, child: Expression, ordinal: Expression):
+        super().__init__(child, ordinal)
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return _element_type(self.children[0], schema)
+
+    def eval(self, batch: ColumnarBatch):
+        lc: ListColumn = self.children[0].eval(batch)
+        idx = self.children[1].eval(batch)
+        lens = lc.lengths()
+        i = idx.data.astype(jnp.int32)
+        in_bounds = (i >= 0) & (i < lens)
+        ok = lc.validity & idx.validity & in_bounds
+        src = jnp.clip(lc.offsets[:-1] + jnp.clip(i, 0), 0,
+                       lc.child_capacity - 1)
+        return lc.child.gather(src, ok)
+
+
+class ElementAt(Expression):
+    """element_at(arr, i) — 1-based; negative counts from the end
+    (GpuElementAt)."""
+
+    def __init__(self, child: Expression, ordinal: Expression):
+        super().__init__(child, ordinal)
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        t = self.children[0].data_type(schema)
+        if isinstance(t, dt.ArrayType):
+            return t.element_type
+        raise TypeError(f"element_at on {t}")
+
+    def eval(self, batch: ColumnarBatch):
+        lc: ListColumn = self.children[0].eval(batch)
+        idx = self.children[1].eval(batch)
+        lens = lc.lengths()
+        i = idx.data.astype(jnp.int32)
+        zero_based = jnp.where(i > 0, i - 1, lens + i)
+        in_bounds = (zero_based >= 0) & (zero_based < lens) & (i != 0)
+        ok = lc.validity & idx.validity & in_bounds
+        src = jnp.clip(lc.offsets[:-1] + jnp.clip(zero_based, 0), 0,
+                       lc.child_capacity - 1)
+        return lc.child.gather(src, ok)
+
+
+class ArrayContains(Expression):
+    """array_contains(arr, v) with 3-valued membership
+    (collectionOperations.scala GpuArrayContains)."""
+
+    def __init__(self, child: Expression, value: Expression):
+        super().__init__(child, value)
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.BOOL
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        lc: ListColumn = self.children[0].eval(batch)
+        needle = self.children[1].eval(batch)
+        vals, lane_ok, elem_ok = lc.element_lanes()
+        hit = elem_ok & (vals == needle.data[:, None])
+        found = jnp.any(hit, axis=1)
+        has_null_elem = jnp.any(lane_ok & ~elem_ok, axis=1)
+        ok = lc.validity & needle.validity & (found | ~has_null_elem)
+        return make_result(found, ok, dt.BOOL)
+
+
+class _ArrayExtreme(Expression):
+    def __init__(self, child: Expression):
+        super().__init__(child)
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return _element_type(self.children[0], schema)
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        lc: ListColumn = self.children[0].eval(batch)
+        vals, lane_ok, elem_ok = lc.element_lanes()
+        et = lc.dtype.element_type
+        fill = self._fill(vals.dtype, et)
+        masked = jnp.where(elem_ok, vals, fill)
+        out = self._reduce(masked, axis=1)
+        any_elem = jnp.any(elem_ok, axis=1)
+        return make_result(out, lc.validity & any_elem, et)
+
+
+class ArrayMin(_ArrayExtreme):
+    """array_min: nulls skipped (GpuArrayMin)."""
+
+    def _fill(self, phys, et):
+        return jnp.array(dt.max_value(et), phys)
+
+    def _reduce(self, x, axis):
+        return jnp.min(x, axis=axis)
+
+
+class ArrayMax(_ArrayExtreme):
+    """array_max: nulls skipped (GpuArrayMax)."""
+
+    def _fill(self, phys, et):
+        return jnp.array(dt.min_value(et), phys)
+
+    def _reduce(self, x, axis):
+        return jnp.max(x, axis=axis)
+
+
+class SortArray(Expression):
+    """sort_array(arr, asc) over primitive elements (GpuSortArray).
+    Null elements first for asc, last for desc (Spark semantics)."""
+
+    def __init__(self, child: Expression, ascending: bool = True):
+        super().__init__(child)
+        self.ascending = ascending
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return self.children[0].data_type(schema)
+
+    def eval(self, batch: ColumnarBatch) -> ListColumn:
+        lc: ListColumn = self.children[0].eval(batch)
+        vals, lane_ok, elem_ok = lc.element_lanes()
+        et = lc.dtype.element_type
+        # order key: dead lanes always last; null elements first for
+        # asc, last for desc (Spark sort_array semantics)
+        null_cls = 1 if self.ascending else 2
+        val_cls = 2 if self.ascending else 1
+        cls = jnp.where(~lane_ok, jnp.int8(3),
+                        jnp.where(~elem_ok, jnp.int8(null_cls),
+                                  jnp.int8(val_cls)))
+        # stable two-pass argsort: values then class
+        order = jnp.argsort(vals, axis=1, stable=True,
+                            descending=not self.ascending)
+        cls_o = jnp.take_along_axis(cls, order, axis=1)
+        order2 = jnp.argsort(cls_o, axis=1, stable=True)
+        order = jnp.take_along_axis(order, order2, axis=1)
+        new_vals = jnp.take_along_axis(vals, order, axis=1)
+        new_ok = jnp.take_along_axis(elem_ok, order, axis=1)
+        # repack lanes into a flat child with the original offsets
+        cap, w = new_vals.shape
+        starts = lc.offsets[:-1]
+        lens = lc.lengths()
+        child_cap = lc.child_capacity
+        pos = jnp.arange(child_cap, dtype=jnp.int32)
+        row = jnp.searchsorted(lc.offsets[1:], pos,
+                               side="right").astype(jnp.int32)
+        row_c = jnp.clip(row, 0, cap - 1)
+        within = jnp.clip(pos - jnp.take(starts, row_c), 0, w - 1)
+        data = new_vals[row_c, within]
+        okv = new_ok[row_c, within] & (pos < lc.offsets[cap])
+        data = jnp.where(okv, data, jnp.zeros((), data.dtype))
+        child = ColumnVector(data, okv, et)
+        return ListColumn(lc.offsets, child, lc.validity, et,
+                          lc.pad_bucket)
+
+    def __repr__(self):
+        return (f"sort_array({self.children[0]!r}, "
+                f"{'asc' if self.ascending else 'desc'})")
+
+
+class CreateNamedStruct(Expression):
+    """named_struct(n1, v1, ...) (complexTypeCreator.scala
+    GpuCreateNamedStruct)."""
+
+    def __init__(self, names: Sequence[str], values: Sequence[Expression]):
+        super().__init__(*values)
+        self.names = list(names)
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.StructType(tuple(
+            (n, v.data_type(schema))
+            for n, v in zip(self.names, self.children)))
+
+    def eval(self, batch: ColumnarBatch) -> StructColumn:
+        kids = [c.eval(batch) for c in self.children]
+        live = batch.live_mask()
+        st = dt.StructType(tuple(
+            (n, k.dtype) for n, k in zip(self.names, kids)))
+        return StructColumn(kids, live, st)
+
+    def __repr__(self):
+        inner = ", ".join(f"{n}={v!r}"
+                          for n, v in zip(self.names, self.children))
+        return f"named_struct({inner})"
+
+
+class GetStructField(Expression):
+    """struct.field access (complexTypeExtractors.scala
+    GpuGetStructField)."""
+
+    def __init__(self, child: Expression, field: str):
+        super().__init__(child)
+        self.field = field
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        t = self.children[0].data_type(schema)
+        if not isinstance(t, dt.StructType):
+            raise TypeError(f"field access on {t}")
+        for n, ft in t.fields:
+            if n == self.field:
+                return ft
+        raise KeyError(self.field)
+
+    def eval(self, batch: ColumnarBatch):
+        sc: StructColumn = self.children[0].eval(batch)
+        child = sc.field(self.field)
+        v = child.validity & sc.validity
+        if isinstance(child, ColumnVector):
+            return make_result(child.data, v, child.dtype)
+        return child.with_validity(v)
+
+    def __repr__(self):
+        return f"{self.children[0]!r}.{self.field}"
+
+
+class Explode(Expression):
+    """Marker generator expression: one output row per array element
+    (GpuExplode, GpuGenerateExec). Never evaluated row-wise — the
+    planner rewrites a projection containing Explode into a Generate
+    node (plan/logical.py)."""
+
+    def __init__(self, child: Expression, outer: bool = False,
+                 with_position: bool = False):
+        super().__init__(child)
+        self.outer = outer
+        self.with_position = with_position
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return _element_type(self.children[0], schema)
+
+    def eval(self, batch: ColumnarBatch):
+        raise RuntimeError("Explode must be planned as Generate, not "
+                           "evaluated as a row expression")
+
+    def __repr__(self):
+        kind = "posexplode" if self.with_position else "explode"
+        return f"{kind}{'_outer' if self.outer else ''}" \
+            f"({self.children[0]!r})"
+
+
+def explode(e) -> Explode:
+    return Explode(e)
+
+
+def explode_outer(e) -> Explode:
+    return Explode(e, outer=True)
+
+
+def posexplode(e) -> Explode:
+    return Explode(e, with_position=True)
+
+
+def array(*exprs) -> CreateArray:
+    from .core import _lit
+    return CreateArray(*[_lit(e) for e in exprs])
+
+
+def struct(**kw) -> CreateNamedStruct:
+    from .core import _lit
+    return CreateNamedStruct(list(kw), [_lit(v) for v in kw.values()])

@@ -44,6 +44,15 @@ def arrow_type_to_dtype(t: pa.DataType) -> dt.DType:
             raise TypeError(
                 f"decimal precision {t.precision} > 18 not supported yet")
         return dt.DecimalType(t.precision, t.scale)
+    if pa.types.is_list(t) or pa.types.is_large_list(t):
+        return dt.ArrayType(arrow_type_to_dtype(t.value_type))
+    if pa.types.is_struct(t):
+        return dt.StructType(tuple(
+            (t.field(i).name, arrow_type_to_dtype(t.field(i).type))
+            for i in range(t.num_fields)))
+    if pa.types.is_map(t):
+        return dt.MapType(arrow_type_to_dtype(t.key_type),
+                          arrow_type_to_dtype(t.item_type))
     raise TypeError(f"unsupported arrow type {t}")
 
 
@@ -70,6 +79,14 @@ def dtype_to_arrow_type(t: dt.DType) -> pa.DataType:
         return pa.timestamp("us", tz="UTC")
     if isinstance(t, dt.DecimalType):
         return pa.decimal128(t.precision, t.scale)
+    if isinstance(t, dt.ArrayType):
+        return pa.list_(dtype_to_arrow_type(t.element_type))
+    if isinstance(t, dt.StructType):
+        return pa.struct([pa.field(n, dtype_to_arrow_type(ft))
+                          for n, ft in t.fields])
+    if isinstance(t, dt.MapType):
+        return pa.map_(dtype_to_arrow_type(t.key_type),
+                       dtype_to_arrow_type(t.value_type))
     raise TypeError(f"unsupported dtype {t}")
 
 
@@ -84,6 +101,14 @@ def _chunked_to_column(arr: pa.ChunkedArray) -> HostColumn:
     out_t = arrow_type_to_dtype(t)
     n = len(arr)
     mask = np.asarray(arr.is_valid())
+    if out_t.is_nested:
+        # LOGICAL python values (lists/dicts); pyarrow to_pylist already
+        # yields date/Decimal/datetime objects for nested leaves
+        items = arr.to_pylist()
+        vals = np.empty(n, dtype=object)
+        for i, v in enumerate(items):
+            vals[i] = v
+        return HostColumn(vals, mask, out_t)
     if out_t == dt.STRING:
         vals = np.array([v if v is not None else ""
                          for v in arr.to_pylist()], dtype=object)
@@ -120,7 +145,13 @@ def host_table_to_arrow(table: HostTable) -> pa.Table:
     for c in table.columns:
         at = dtype_to_arrow_type(c.dtype)
         mask = ~c.mask
-        if c.dtype == dt.STRING:
+        if c.dtype.is_nested:
+            vals = [None if not c.mask[i] else
+                    (dict(c.values[i]) if isinstance(c.dtype, dt.MapType)
+                     else c.values[i])
+                    for i in range(len(c))]
+            arrays.append(pa.array(vals, type=at))
+        elif c.dtype == dt.STRING:
             vals = [None if not c.mask[i] else c.values[i]
                     for i in range(len(c))]
             arrays.append(pa.array(vals, type=at))

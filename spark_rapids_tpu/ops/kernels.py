@@ -479,3 +479,51 @@ def local_limit(batch: ColumnarBatch, n: int) -> ColumnarBatch:
     mask = live_mask(batch.capacity, new_n)
     cols = [c.with_validity(c.validity & mask) for c in batch.columns]
     return ColumnarBatch(cols, batch.names, new_n)
+
+
+# ---------------------------------------------------------------------------
+# Generate / explode
+# ---------------------------------------------------------------------------
+
+def explode_batch(batch: ColumnarBatch, list_col, element_name: str,
+                  out_capacity: int, outer: bool = False,
+                  pos_name: str = None):
+    """One output row per list element (GpuExplode / GpuGenerateExec).
+
+    ``outer=True``: null/empty lists still produce one row with a null
+    element (explode_outer). ``pos_name`` adds the 0-based element
+    position column (posexplode). Returns (out_batch, total_rows);
+    total may exceed out_capacity — the caller retries with a larger
+    capacity bucket (the same overflow contract as the join kernels).
+    """
+    cap = batch.capacity
+    live = batch.live_mask()
+    real = jnp.where(list_col.validity & live, list_col.lengths(), 0)
+    eff = jnp.maximum(real, 1) if outer else real
+    eff = jnp.where(live, eff, 0)
+    out_offsets = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(eff, dtype=jnp.int32)])
+    total = out_offsets[cap]
+    pos = jnp.arange(out_capacity, dtype=jnp.int32)
+    row = jnp.searchsorted(out_offsets[1:], pos,
+                           side="right").astype(jnp.int32)
+    row_c = jnp.clip(row, 0, cap - 1)
+    within = pos - jnp.take(out_offsets, row_c)
+    n_out = jnp.minimum(total, out_capacity)
+    gathered = batch.gather(row_c, n_out)
+    out_live = live_mask(out_capacity, n_out)
+    elem_ok = out_live & (within < jnp.take(real, row_c))
+    src = jnp.take(list_col.offsets[:-1], row_c) + \
+        jnp.clip(within, 0)
+    element = list_col.child.gather(
+        jnp.clip(src, 0, list_col.child_capacity - 1), elem_ok)
+    cols = list(gathered.columns)
+    names = list(gathered.names)
+    if pos_name is not None:
+        pdata = jnp.where(elem_ok, within, jnp.zeros((), jnp.int32))
+        from ..columnar import dtypes as _dt
+        cols.append(ColumnVector(pdata, elem_ok, _dt.INT32))
+        names.append(pos_name)
+    cols.append(element)
+    names.append(element_name)
+    return ColumnarBatch(cols, names, n_out), total

@@ -1401,3 +1401,171 @@ def _interleave(expr, table):
         for ci, p in enumerate(parts):
             out |= ((p >> bit) & 1) << (bit * k + ci)
     return _zero_nulls(out, mask), mask
+
+
+# ---------------------------------------------------------------------------
+# collections (arrays/structs) — host lists/dicts of LOGICAL values
+# (collectionOperations.scala / complexTypeExtractors.scala oracle)
+# ---------------------------------------------------------------------------
+
+def _obj_array(items):
+    out = np.empty(len(items), dtype=object)
+    for i, v in enumerate(items):
+        out[i] = v
+    return out
+
+
+def _logical_of(col_values, col_mask, i, t: dt.DType):
+    from ..columnar.vector import from_physical
+    if not col_mask[i]:
+        return None
+    if t == dt.STRING or t.is_nested:
+        return col_values[i]
+    return from_physical(col_values[i], t)
+
+
+def _physical_scalar(v, t: dt.DType):
+    from ..columnar.vector import _to_physical
+    if v is None:
+        return 0
+    if t == dt.STRING or t.is_nested:
+        return v
+    return _to_physical(v, t)
+
+
+def _register_collections():
+    from ..expr import collections as CX
+
+    @_reg(CX.CreateArray)
+    def _create_array(expr, table):
+        schema = table.schema()
+        kids = [evaluate(c, table) for c in expr.children]
+        types = [c.data_type(schema) for c in expr.children]
+        n = table.num_rows
+        out = _obj_array([
+            [_logical_of(k.values, k.mask, i, t)
+             for k, t in zip(kids, types)]
+            for i in range(n)])
+        return out, np.ones(n, bool)
+
+    @_reg(CX.Size)
+    def _size(expr, table):
+        v, m = _ev(expr.children[0], table)
+        out = np.array([len(v[i]) if m[i] else 0 for i in range(len(v))],
+                       dtype=np.int32)
+        return out, m.copy()
+
+    def _item(expr, table, one_based):
+        schema = table.schema()
+        et = expr.data_type(schema)
+        arr, am = _ev(expr.children[0], table)
+        idx, im = _ev(expr.children[1], table)
+        n = len(arr)
+        vals, mask = [], np.zeros(n, bool)
+        for i in range(n):
+            v = None
+            if am[i] and im[i]:
+                k = int(idx[i])
+                lst = arr[i]
+                if one_based:
+                    k = k - 1 if k > 0 else len(lst) + k
+                    if int(idx[i]) == 0:
+                        k = -10**9
+                if 0 <= k < len(lst):
+                    v = lst[k]
+            mask[i] = v is not None
+            vals.append(_physical_scalar(v, et))
+        if et == dt.STRING or et.is_nested:
+            return _obj_array(vals), mask
+        return np.array(vals, dtype=np.dtype(et.physical)), mask
+
+    _EVALUATORS[CX.GetArrayItem] = \
+        lambda e, t: _item(e, t, one_based=False)
+    _EVALUATORS[CX.ElementAt] = lambda e, t: _item(e, t, one_based=True)
+
+    @_reg(CX.ArrayContains)
+    def _contains(expr, table):
+        schema = table.schema()
+        et = expr.children[0].data_type(schema).element_type
+        arr, am = _ev(expr.children[0], table)
+        needle = evaluate(expr.children[1], table)
+        n = len(arr)
+        out = np.zeros(n, bool)
+        mask = np.zeros(n, bool)
+        for i in range(n):
+            if not (am[i] and needle.mask[i]):
+                continue
+            want = _logical_of(needle.values, needle.mask, i,
+                              expr.children[1].data_type(schema))
+            found = any(e is not None and e == want for e in arr[i])
+            has_null = any(e is None for e in arr[i])
+            out[i] = found
+            mask[i] = found or not has_null
+        return out, mask
+
+    def _extreme(expr, table, fn):
+        schema = table.schema()
+        et = expr.data_type(schema)
+        arr, am = _ev(expr.children[0], table)
+        n = len(arr)
+        vals, mask = [], np.zeros(n, bool)
+        for i in range(n):
+            v = None
+            if am[i]:
+                elems = [e for e in arr[i] if e is not None]
+                if elems:
+                    v = fn(elems)
+            mask[i] = v is not None
+            vals.append(_physical_scalar(v, et))
+        if et == dt.STRING or et.is_nested:
+            return _obj_array(vals), mask
+        return np.array(vals, dtype=np.dtype(et.physical)), mask
+
+    _EVALUATORS[CX.ArrayMin] = lambda e, t: _extreme(e, t, min)
+    _EVALUATORS[CX.ArrayMax] = lambda e, t: _extreme(e, t, max)
+
+    @_reg(CX.SortArray)
+    def _sort_array(expr, table):
+        arr, am = _ev(expr.children[0], table)
+        n = len(arr)
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            if not am[i]:
+                out[i] = None
+                continue
+            non_null = sorted([e for e in arr[i] if e is not None],
+                              reverse=not expr.ascending)
+            nulls = [None] * (len(arr[i]) - len(non_null))
+            out[i] = (nulls + non_null) if expr.ascending \
+                else (non_null + nulls)
+        return out, am.copy()
+
+    @_reg(CX.CreateNamedStruct)
+    def _named_struct(expr, table):
+        schema = table.schema()
+        kids = [evaluate(c, table) for c in expr.children]
+        types = [c.data_type(schema) for c in expr.children]
+        n = table.num_rows
+        out = _obj_array([
+            {fn: _logical_of(k.values, k.mask, i, t)
+             for fn, k, t in zip(expr.names, kids, types)}
+            for i in range(n)])
+        return out, np.ones(n, bool)
+
+    @_reg(CX.GetStructField)
+    def _get_field(expr, table):
+        schema = table.schema()
+        et = expr.data_type(schema)
+        sv, sm = _ev(expr.children[0], table)
+        n = len(sv)
+        vals, mask = [], np.zeros(n, bool)
+        for i in range(n):
+            v = sv[i].get(expr.field) if sm[i] else None
+            mask[i] = v is not None
+            vals.append(_physical_scalar(v, et))
+        if et == dt.STRING or et.is_nested:
+            return _obj_array(vals), mask
+        return np.array(vals, dtype=np.dtype(et.physical)), mask
+
+
+_register_collections()

@@ -81,6 +81,9 @@ def apply_cpu_node(plan: LogicalPlan,
             cols = [_coerce_col(c, t) for c, (_, t) in zip(cols, plan.schema)]
             parts.append(HostTable(cols, [n for n, _ in plan.schema]))
         return concat_tables(parts)
+    from .logical import Generate
+    if isinstance(plan, Generate):
+        return _generate_table(children[0], plan)
     if isinstance(plan, Sort):
         return _sort_table(children[0], plan.order)
     if isinstance(plan, Aggregate):
@@ -107,6 +110,52 @@ def _coerce_col(c: HostColumn, t: dt.DType) -> HostColumn:
         return HostColumn(c.values.astype(np.int64)
                           * np.int64(10 ** t.scale), c.mask, t)
     return HostColumn(c.values.astype(np.dtype(t.physical)), c.mask, t)
+
+
+# ---------------------------------------------------------------------------
+# generate (explode)
+# ---------------------------------------------------------------------------
+
+def _generate_table(child: HostTable, plan) -> HostTable:
+    """Explode/posexplode oracle (GpuGenerateExec semantics)."""
+    from ..columnar.vector import _to_physical
+    gen = plan.generator
+    lists = cpu_eval.evaluate(gen.children[0], child)
+    et = gen.data_type(child.schema())
+    rows, positions, elems = [], [], []
+    for i in range(child.num_rows):
+        lst = lists.values[i] if lists.mask[i] else None
+        if not lst:
+            if gen.outer:
+                rows.append(i)
+                positions.append(None)
+                elems.append(None)
+            continue
+        for p, e in enumerate(lst):
+            rows.append(i)
+            positions.append(p)
+            elems.append(e)
+    idx = np.array(rows, dtype=np.int64)
+    out = child.take(idx)
+    cols, names = list(out.columns), list(out.names)
+    if plan.pos_name:
+        pmask = np.array([p is not None for p in positions], dtype=bool)
+        pvals = np.array([p if p is not None else 0 for p in positions],
+                         dtype=np.int32)
+        cols.append(HostColumn(pvals, pmask, dt.INT32))
+        names.append(plan.pos_name)
+    emask = np.array([e is not None for e in elems], dtype=bool)
+    if et == dt.STRING or et.is_nested:
+        evals = np.empty(len(elems), dtype=object)
+        for i, e in enumerate(elems):
+            evals[i] = e if e is not None else ("" if et == dt.STRING
+                                                else None)
+    else:
+        evals = np.array([_to_physical(e, et) if e is not None else 0
+                          for e in elems], dtype=np.dtype(et.physical))
+    cols.append(HostColumn(evals, emask, et))
+    names.append(plan.element_name)
+    return HostTable(cols, names)
 
 
 # ---------------------------------------------------------------------------

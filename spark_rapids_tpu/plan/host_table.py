@@ -93,7 +93,7 @@ class HostTable:
 def empty_like(schema: Schema) -> HostTable:
     cols = []
     for _, t in schema:
-        if t == dt.STRING:
+        if t == dt.STRING or t.is_nested:
             cols.append(HostColumn(np.empty(0, object), np.empty(0, bool), t))
         else:
             cols.append(HostColumn(np.empty(0, np.dtype(t.physical)),
@@ -119,7 +119,13 @@ def from_pydict(data: dict, schema: Schema) -> HostTable:
     for name, t in schema:
         raw = data[name]
         mask = np.array([v is not None for v in raw], dtype=bool)
-        if t == dt.STRING:
+        if t.is_nested:
+            # nested host columns hold LOGICAL python values
+            # (lists/dicts), not physical lanes
+            values = np.empty(len(raw), dtype=object)
+            for i, v in enumerate(raw):
+                values[i] = v
+        elif t == dt.STRING:
             values = np.array([v if v is not None else "" for v in raw],
                               dtype=object)
         else:
@@ -134,7 +140,7 @@ def from_pydict(data: dict, schema: Schema) -> HostTable:
 def to_pydict(table: HostTable) -> dict:
     out = {}
     for name, col in zip(table.names, table.columns):
-        if col.dtype == dt.STRING:
+        if col.dtype == dt.STRING or col.dtype.is_nested:
             out[name] = [col.values[i] if col.mask[i] else None
                          for i in range(len(col))]
         else:
@@ -153,7 +159,13 @@ def table_to_batch(table: HostTable,
     cap = capacity or choose_capacity(n)
     cols = []
     for c in table.columns:
-        if c.dtype == dt.STRING:
+        if c.dtype.is_nested:
+            from ..columnar.nested import nested_column_from_pylist
+            values = [c.values[i] if c.mask[i] else None
+                      for i in range(len(c))]
+            cols.append(nested_column_from_pylist(
+                values + [None] * (cap - n), cap, c.dtype))
+        elif c.dtype == dt.STRING:
             cols.append(column_from_numpy(
                 np.asarray(c.values, dtype=object), cap,
                 dtype=dt.STRING, mask=c.mask))
@@ -164,13 +176,14 @@ def table_to_batch(table: HostTable,
 
 
 def batch_to_table(batch: ColumnarBatch) -> HostTable:
+    from ..columnar.nested import ListColumn, StructColumn
     n = int(batch.num_rows)
     cols = []
     for c in batch.columns:
         vals, mask = c.to_numpy(n)
-        if isinstance(c, StringColumn):
+        if isinstance(c, (StringColumn, ListColumn, StructColumn)):
             cols.append(HostColumn(np.asarray(vals, dtype=object),
-                                   np.asarray(mask), dt.STRING))
+                                   np.asarray(mask), c.dtype))
         else:
             cols.append(HostColumn(np.asarray(vals), np.asarray(mask),
                                    c.dtype))

@@ -299,6 +299,35 @@ class Window(LogicalPlan):
         return f"Window[{fns}]"
 
 
+class Generate(LogicalPlan):
+    """Explode/posexplode generator (GpuGenerateExec): output = child
+    columns (+ position) + element column, one row per list element."""
+
+    def __init__(self, child: LogicalPlan, generator,
+                 element_name: str, pos_name: Optional[str] = None):
+        super().__init__(child)
+        from ..expr.collections import Explode
+        assert isinstance(generator, Explode)
+        self.generator = generator
+        self.element_name = element_name
+        self.pos_name = pos_name if generator.with_position else None
+        elem_t = generator.data_type(child.schema)
+        self._schema = list(child.schema)
+        if self.pos_name:
+            self._schema.append((self.pos_name, dt.INT32))
+        self._schema.append((element_name, elem_t))
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def expressions(self) -> List[Expression]:
+        return [self.generator]
+
+    def node_description(self) -> str:
+        return f"Generate[{self.generator!r} -> {self.element_name}]"
+
+
 class Range(LogicalPlan):
     def __init__(self, start: int, end: int, step: int = 1):
         super().__init__()

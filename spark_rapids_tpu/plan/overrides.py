@@ -99,7 +99,24 @@ def _expr(cls, sig: ts.TypeSig, extra=None):
 # --- expression rules ------------------------------------------------------
 
 _expr(E.ColumnRef, ts.all_basic)
-_expr(E.Alias, ts.all_basic)
+_expr(E.Alias, ts.all_basic + ts.TypeSig(ts.ARRAY, ts.STRUCT))
+
+
+def device_type_ok(t: dt.DType) -> Optional[str]:
+    """Recursive device support for a column type (TypeSig nested
+    checks): arrays/structs of supported types flow through
+    project/filter/generate; maps are CPU-only for now."""
+    if isinstance(t, dt.ArrayType):
+        return device_type_ok(t.element_type)
+    if isinstance(t, dt.StructType):
+        for _, ft in t.fields:
+            reason = device_type_ok(ft)
+            if reason:
+                return reason
+        return None
+    if isinstance(t, dt.MapType):
+        return f"type {t} not supported on TPU yet"
+    return ts.all_basic.reason_if_unsupported(t, "column")
 
 
 def _tag_literal(meta: ExprMeta):
@@ -216,6 +233,43 @@ for _cls in (BW.ShiftLeft, BW.ShiftRight, BW.ShiftRightUnsigned):
     _expr(_cls, ts.integral)
 _expr(BW.InterleaveBits, ts.integral)
 
+# --- collections (arrays/structs) ---
+from ..expr import collections as CX  # noqa: E402
+
+_nested_ok = ts.all_basic + ts.TypeSig(ts.ARRAY, ts.STRUCT)
+
+
+def _primitive_elements(meta: ExprMeta):
+    """Lane-kernel exprs need a primitive (non-string) element type."""
+    t = meta.expr.children[0].data_type(meta.schema)
+    if isinstance(t, dt.ArrayType) and (t.element_type.is_nested or
+                                        t.element_type == dt.STRING):
+        meta.will_not_work_on_tpu(
+            f"{type(meta.expr).__name__}: element type "
+            f"{t.element_type} needs lane lowering not yet on TPU")
+
+
+_expr(CX.CreateArray, ts.numeric + ts.TypeSig(ts.BOOLEAN, ts.DATE,
+                                              ts.TIMESTAMP, ts.NULL))
+_expr(CX.Size, _nested_ok)
+_expr(CX.GetArrayItem, _nested_ok)
+_expr(CX.ElementAt, _nested_ok)
+_expr(CX.ArrayContains, _nested_ok, _primitive_elements)
+_expr(CX.ArrayMin, _nested_ok, _primitive_elements)
+_expr(CX.ArrayMax, _nested_ok, _primitive_elements)
+_expr(CX.SortArray, _nested_ok, _primitive_elements)
+_expr(CX.CreateNamedStruct, ts.all_basic)
+_expr(CX.GetStructField, ts.TypeSig(ts.STRUCT))
+
+
+def _tag_explode(meta: ExprMeta):
+    t = meta.expr.children[0].data_type(meta.schema)
+    if not isinstance(t, dt.ArrayType):
+        meta.will_not_work_on_tpu(f"explode of {t} not supported on TPU")
+
+
+_expr(CX.Explode, _nested_ok, _tag_explode)
+
 for _cls in (Agg.Count, Agg.CountStar, Agg.First, Agg.Last):
     _expr(_cls, ts.comparable)
 for _cls in (Agg.Sum, Agg.Average, Agg.VariancePop, Agg.VarianceSamp,
@@ -252,19 +306,36 @@ def _tag_join(meta: PlanMeta):
 
 def _tag_agg(meta: PlanMeta):
     plan: Aggregate = meta.plan
-    for fn, _ in plan.agg_exprs:
-        if isinstance(fn, (Agg.First, Agg.Last)) and not plan.group_exprs:
-            # fine — still grouped as a single group
-            pass
+    in_schema = plan.children[0].schema
+    for e in plan.group_exprs:
+        t = e.data_type(in_schema)
+        if t.is_nested:
+            meta.will_not_work_on_tpu(
+                f"group-by key of type {t} not supported on TPU yet")
 
 
 def _tag_file_scan(meta: PlanMeta):
     from ..io.scan import FileScan
     plan: FileScan = meta.plan
     for name, t in plan.schema:
-        reason = ts.all_basic.reason_if_unsupported(t, f"scan column {name}")
+        reason = device_type_ok(t)
         if reason:
-            meta.will_not_work_on_tpu(reason)
+            meta.will_not_work_on_tpu(f"scan column {name}: {reason}")
+
+
+def _no_nested_inputs(what: str):
+    """Execs whose kernels concat/partition/sort batches don't take
+    nested payload columns yet (the reference gates the same surface
+    per-op via TypeSig; GpuHashJoin/GpuSortExec nested support)."""
+    def tag(meta: PlanMeta):
+        for c in meta.plan.children:
+            for name, t in c.schema:
+                if t.is_nested:
+                    meta.will_not_work_on_tpu(
+                        f"{what}: nested column {name} ({t}) not "
+                        "supported on TPU yet")
+                    return
+    return tag
 
 
 def _tag_window(meta: PlanMeta):
@@ -303,9 +374,15 @@ def _tag_window(meta: PlanMeta):
                 "ROWS offsets")
 
 
+def _tag_join_all(meta: PlanMeta):
+    _tag_join(meta)
+    _no_nested_inputs("join")(meta)
+
+
 def _register_exec_rules():
     from ..cache import CachedRelation
     from ..io.scan import FileScan
+    from .logical import Generate
     _EXEC_RULES[CachedRelation] = ExecRule(CachedRelation)
     _EXEC_RULES.update({
         LocalRelation: ExecRule(LocalRelation),
@@ -313,13 +390,14 @@ def _register_exec_rules():
         Project: ExecRule(Project),
         Filter: ExecRule(Filter),
         Limit: ExecRule(Limit),
-        Union: ExecRule(Union),
-        Expand: ExecRule(Expand),
-        Sort: ExecRule(Sort),
+        Union: ExecRule(Union, _no_nested_inputs("union")),
+        Expand: ExecRule(Expand, _no_nested_inputs("expand")),
+        Sort: ExecRule(Sort, _no_nested_inputs("sort")),
         Aggregate: ExecRule(Aggregate, _tag_agg),
-        Join: ExecRule(Join, _tag_join),
+        Join: ExecRule(Join, _tag_join_all),
         Window: ExecRule(Window, _tag_window),
         FileScan: ExecRule(FileScan, _tag_file_scan),
+        Generate: ExecRule(Generate),
     })
 
 
@@ -366,6 +444,11 @@ def _build_tpu_exec(plan: LogicalPlan, children: List[TpuExec],
     if isinstance(plan, Window):
         from ..exec.window import WindowExec
         return WindowExec(children[0], plan.window_exprs)
+    from .logical import Generate
+    if isinstance(plan, Generate):
+        from ..exec.generate import GenerateExec
+        return GenerateExec(children[0], plan.generator,
+                            plan.element_name, plan.pos_name)
     if isinstance(plan, Join):
         return _build_join(plan, children, conf)
     raise NotImplementedError(type(plan).__name__)

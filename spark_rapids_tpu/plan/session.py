@@ -63,32 +63,43 @@ class TpuSession:
         return physical.evaluate(ctx)
 
 
-def _infer_schema(data: Dict[str, list]) -> List:
+def _infer_value_type(sample, values=()):
     import datetime
     import decimal
+    if sample is None:
+        return dt.INT32
+    if isinstance(sample, bool):
+        return dt.BOOL
+    if isinstance(sample, int):
+        return dt.INT64
+    if isinstance(sample, float):
+        return dt.FLOAT64
+    if isinstance(sample, str):
+        return dt.STRING
+    if isinstance(sample, datetime.datetime):
+        return dt.TIMESTAMP
+    if isinstance(sample, datetime.date):
+        return dt.DATE
+    if isinstance(sample, decimal.Decimal):
+        exp = -sample.as_tuple().exponent
+        return dt.DecimalType(18, max(exp, 0))
+    if isinstance(sample, (list, tuple)):
+        elems = [e for v in values if v is not None for e in v
+                 if e is not None] or \
+            [e for e in sample if e is not None]
+        et = _infer_value_type(elems[0], elems) if elems else dt.INT64
+        return dt.ArrayType(et)
+    if isinstance(sample, dict):
+        return dt.StructType(tuple(
+            (k, _infer_value_type(v)) for k, v in sample.items()))
+    raise TypeError(f"cannot infer dtype for value {sample!r}")
+
+
+def _infer_schema(data: Dict[str, list]) -> List:
     schema = []
     for name, values in data.items():
         sample = next((v for v in values if v is not None), None)
-        if sample is None:
-            t = dt.INT32
-        elif isinstance(sample, bool):
-            t = dt.BOOL
-        elif isinstance(sample, int):
-            t = dt.INT64
-        elif isinstance(sample, float):
-            t = dt.FLOAT64
-        elif isinstance(sample, str):
-            t = dt.STRING
-        elif isinstance(sample, datetime.datetime):
-            t = dt.TIMESTAMP
-        elif isinstance(sample, datetime.date):
-            t = dt.DATE
-        elif isinstance(sample, decimal.Decimal):
-            exp = -sample.as_tuple().exponent
-            t = dt.DecimalType(18, max(exp, 0))
-        else:
-            raise TypeError(f"cannot infer dtype for column {name!r}")
-        schema.append((name, t))
+        schema.append((name, _infer_value_type(sample, values)))
     return schema
 
 
@@ -136,6 +147,32 @@ def _extract_windows(plan: L.LogicalPlan, exprs):
     return plan, out_exprs
 
 
+def _extract_generators(plan: L.LogicalPlan, exprs):
+    """Pull Explode generators out of a projection into a Generate node
+    (the analyzer step Spark performs for explode() in select): at most
+    one generator per projection, like Spark."""
+    from ..expr.collections import Explode
+    out_exprs = []
+    gen_count = 0
+    for i, e in enumerate(exprs):
+        inner = e.children[0] if isinstance(e, Alias) else e
+        if isinstance(inner, Explode):
+            gen_count += 1
+            if gen_count > 1:
+                raise ValueError("only one generator allowed per select")
+            user = e.name if isinstance(e, Alias) else "col"
+            if inner.with_position:
+                pos_name = f"__gpos{i}"
+                plan = L.Generate(plan, inner, f"__gen{i}", pos_name)
+                out_exprs.append(Alias(col(pos_name), "pos"))
+            else:
+                plan = L.Generate(plan, inner, f"__gen{i}")
+            out_exprs.append(Alias(col(f"__gen{i}"), user))
+        else:
+            out_exprs.append(e)
+    return plan, out_exprs
+
+
 class DataFrame:
     """Lazy logical-plan builder (Spark DataFrame analogue)."""
 
@@ -146,7 +183,8 @@ class DataFrame:
     # --- transformations ---
     def select(self, *cols) -> "DataFrame":
         exprs = [_to_expr(c) for c in cols]
-        plan, exprs = _extract_windows(self.plan, exprs)
+        plan, exprs = _extract_generators(self.plan, exprs)
+        plan, exprs = _extract_windows(plan, exprs)
         return DataFrame(self.session, L.Project(plan, exprs))
 
     def with_column(self, name: str, expr) -> "DataFrame":

@@ -1,0 +1,173 @@
+"""Nested types on device: list/struct columns, collection expressions,
+explode/Generate, parquet round-trip — differential vs the CPU oracle
+(reference surface: collectionOperations.scala, complexTypeCreator.scala,
+complexTypeExtractors.scala, GpuGenerateExec.scala)."""
+
+import datetime
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.expr import (ArrayContains, ArrayMax, ArrayMin,
+                                   ElementAt, GetArrayItem, GetStructField,
+                                   Size, SortArray, array, col, explode,
+                                   lit, posexplode, struct)
+from spark_rapids_tpu.expr.collections import explode_outer
+from spark_rapids_tpu.expr.core import Alias
+from spark_rapids_tpu.plan.session import TpuSession
+from spark_rapids_tpu.testing import (assert_runs_on_tpu,
+                                      assert_tpu_cpu_equal_df)
+
+
+@pytest.fixture()
+def session():
+    return TpuSession()
+
+
+@pytest.fixture()
+def arrays_df(session):
+    rng = np.random.default_rng(7)
+    rows = []
+    for i in range(200):
+        r = rng.random()
+        if r < 0.1:
+            rows.append(None)
+        elif r < 0.2:
+            rows.append([])
+        else:
+            lst = [int(v) if rng.random() > 0.15 else None
+                   for v in rng.integers(-50, 50, int(rng.integers(1, 9)))]
+            rows.append(lst)
+    return session.create_dataframe(
+        {"a": rows, "x": list(range(200))},
+        schema=[("a", dt.ArrayType(dt.INT64)), ("x", dt.INT64)])
+
+
+def test_size_item_contains(arrays_df):
+    df = arrays_df.select(
+        col("x"),
+        Alias(Size(col("a")), "n"),
+        Alias(GetArrayItem(col("a"), lit(0)), "first"),
+        Alias(GetArrayItem(col("a"), lit(3)), "fourth"),
+        Alias(ElementAt(col("a"), lit(1)), "e1"),
+        Alias(ElementAt(col("a"), lit(-2)), "em2"),
+        Alias(ArrayContains(col("a"), lit(7)), "has7"))
+    assert_runs_on_tpu(df)
+
+
+def test_array_min_max_sort(arrays_df):
+    df = arrays_df.select(
+        col("x"),
+        Alias(ArrayMin(col("a")), "mn"),
+        Alias(ArrayMax(col("a")), "mx"),
+        Alias(SortArray(col("a")), "sa"),
+        Alias(SortArray(col("a"), False), "sd"))
+    assert_runs_on_tpu(df)
+
+
+def test_create_array_and_struct(session):
+    df = session.create_dataframe({"x": list(range(50)),
+                                   "y": [i * 1.5 for i in range(50)]})
+    out = df.select(
+        col("x"),
+        Alias(array(col("x"), col("x") * 2, lit(None)), "arr"),
+        Alias(struct(a=col("x"), b=col("y")), "st"))
+    assert_runs_on_tpu(out)
+
+
+def test_struct_field_access(session):
+    df = session.create_dataframe({"x": list(range(30))})
+    st = df.select(col("x"), Alias(struct(u=col("x"), v=col("x") + 5),
+                                   "s"))
+    out = st.select(col("x"), Alias(GetStructField(col("s"), "v"), "v"))
+    assert_runs_on_tpu(out)
+
+
+def test_struct_column_from_data(session):
+    rows = [{"name": f"n{i}", "score": float(i)} if i % 7 else None
+            for i in range(60)]
+    df = session.create_dataframe(
+        {"s": rows, "x": list(range(60))},
+        schema=[("s", dt.StructType((("name", dt.STRING),
+                                     ("score", dt.FLOAT64)))),
+                ("x", dt.INT64)])
+    out = df.select(col("x"),
+                    Alias(GetStructField(col("s"), "name"), "nm"),
+                    Alias(GetStructField(col("s"), "score"), "sc"))
+    assert_tpu_cpu_equal_df(out)
+
+
+@pytest.mark.parametrize("gen", [explode, posexplode, explode_outer])
+def test_explode_variants(arrays_df, gen):
+    df = arrays_df.select(col("x"), Alias(gen(col("a")), "e"))
+    assert_runs_on_tpu(df)
+
+
+def test_explode_filter_on_device(arrays_df):
+    df = arrays_df.select(col("x"), Alias(explode(col("a")), "e")) \
+        .filter(col("e") > 0)
+    assert_runs_on_tpu(df)
+
+
+def test_explode_then_aggregate(arrays_df):
+    from spark_rapids_tpu.expr.aggregates import CountStar, Sum
+    df = arrays_df.select(Alias(explode(col("a")), "e")) \
+        .group_by("e").agg(Alias(CountStar(), "c"))
+    assert_tpu_cpu_equal_df(df)
+
+
+def test_string_array_explode(session):
+    rows = [["alpha", "beta"], None, ["gamma", None, "delta"], []]
+    df = session.create_dataframe(
+        {"a": rows * 10, "x": list(range(40))},
+        schema=[("a", dt.ArrayType(dt.STRING)), ("x", dt.INT64)])
+    out = df.select(col("x"), Alias(explode(col("a")), "s"))
+    assert_tpu_cpu_equal_df(out)
+
+
+def test_filter_carries_list_column(arrays_df):
+    # list column flows through a device filter untouched
+    df = arrays_df.filter(col("x") % 3 == 0)
+    assert_tpu_cpu_equal_df(df)
+
+
+def test_nested_join_falls_back(session):
+    """Nested payload through a join routes to CPU (correct results
+    via fallback) until partition/concat support nested columns."""
+    from spark_rapids_tpu.testing import assert_falls_back_to_cpu
+    left = session.create_dataframe(
+        {"k": [1, 2, 3], "a": [[1], [2, 2], None]},
+        schema=[("k", dt.INT64), ("a", dt.ArrayType(dt.INT64))])
+    right = session.create_dataframe({"k": [1, 2], "w": [10, 20]})
+    assert_falls_back_to_cpu(left.join(right, "k"), "nested")
+
+
+def test_parquet_nested_round_trip(session, tmp_path):
+    rows = [[1, 2], None, [3, None, 5], []]
+    structs = [{"u": i, "v": f"s{i}"} for i in range(4)]
+    df = session.create_dataframe(
+        {"a": rows, "s": structs, "x": [1, 2, 3, 4]},
+        schema=[("a", dt.ArrayType(dt.INT64)),
+                ("s", dt.StructType((("u", dt.INT64), ("v", dt.STRING)))),
+                ("x", dt.INT64)])
+    path = str(tmp_path / "nested")
+    df.write.parquet(path)
+    back = session.read.parquet(path)
+    got = sorted(back.collect(), key=lambda r: r["x"])
+    want = sorted(df.collect(), key=lambda r: r["x"])
+    assert got == want
+    # and the scan's list column is device-explodable
+    out = back.select(col("x"), Alias(explode_outer(col("a")), "e"))
+    assert_tpu_cpu_equal_df(out)
+
+
+def test_date_array_elements(session):
+    d = datetime.date
+    rows = [[d(2024, 1, 1), d(2023, 5, 5)], None, [d(2020, 2, 29)]]
+    df = session.create_dataframe(
+        {"a": rows, "x": [1, 2, 3]},
+        schema=[("a", dt.ArrayType(dt.DATE)), ("x", dt.INT64)])
+    out = df.select(col("x"), Alias(ArrayMin(col("a")), "mn"),
+                    Alias(explode_outer(col("a")), "e"))
+    assert_tpu_cpu_equal_df(out)
