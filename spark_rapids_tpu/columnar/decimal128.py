@@ -1,0 +1,292 @@
+"""Two-limb int128 decimal column and arithmetic.
+
+The device representation for DECIMAL(p>18): an unscaled 128-bit signed
+integer split into ``hi`` (int64, sign-carrying) and ``lo`` (uint64)
+limbs — the layout cuDF's DECIMAL128 columns use natively and the
+reference leans on throughout (decimalExpressions.scala, GpuCast.scala
+decimal paths, SURVEY §7 hard-part 6). TPU constraint: XLA's x64
+rewriting has no 64-bit bitcast and no 128-bit integers, so every
+operation here is built from wrapping 64-bit adds/multiplies and 32-bit
+limb decompositions (utils/bits.py conventions).
+
+Key ops: add/sub with carry, full 128x128 multiply (truncated, with
+overflow detection), scale by 10^k, divide by 10^k with HALF_UP
+rounding (chunked 32-bit schoolbook division so no intermediate exceeds
+64 bits), comparisons, and precision-overflow checks against 10^p
+bounds.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtypes as dt
+
+_U32 = jnp.uint64(0xFFFFFFFF)
+
+
+def _u(x):
+    return x.astype(jnp.uint64)
+
+
+def _s(x):
+    return x.astype(jnp.int64)
+
+
+class Decimal128Column:
+    """DECIMAL(p>18) column: hi:int64 + lo:uint64 unscaled limbs."""
+
+    __slots__ = ("hi", "lo", "validity", "dtype")
+
+    def __init__(self, hi: jax.Array, lo: jax.Array, validity: jax.Array,
+                 dtype: dt.DecimalType):
+        self.hi = hi
+        self.lo = lo
+        self.validity = validity
+        self.dtype = dtype
+
+    @property
+    def capacity(self) -> int:
+        return self.hi.shape[0]
+
+    def with_validity(self, validity: jax.Array) -> "Decimal128Column":
+        return Decimal128Column(self.hi, self.lo, validity, self.dtype)
+
+    def gather(self, indices: jax.Array,
+               valid: Optional[jax.Array] = None) -> "Decimal128Column":
+        safe = jnp.clip(indices, 0, self.capacity - 1)
+        hi = jnp.take(self.hi, safe)
+        lo = jnp.take(self.lo, safe)
+        validity = jnp.take(self.validity, safe)
+        if valid is not None:
+            validity = validity & valid
+            hi = jnp.where(validity, hi, jnp.zeros((), hi.dtype))
+            lo = jnp.where(validity, lo, jnp.zeros((), lo.dtype))
+        return Decimal128Column(hi, lo, validity, self.dtype)
+
+    def to_numpy(self, num_rows: Optional[int] = None):
+        n = self.capacity if num_rows is None else int(num_rows)
+        hi = np.asarray(self.hi)[:n].astype(object)
+        lo = np.asarray(self.lo)[:n].astype(object)
+        vals = np.empty(n, dtype=object)
+        for i in range(n):
+            vals[i] = int(hi[i]) * (1 << 64) + int(lo[i])
+        return vals, np.asarray(self.validity)[:n]
+
+    def __repr__(self):
+        return f"Decimal128Column({self.dtype}, capacity={self.capacity})"
+
+
+def _d128_flatten(v: Decimal128Column):
+    return (v.hi, v.lo, v.validity), v.dtype
+
+
+def _d128_unflatten(dtype, children):
+    return Decimal128Column(*children, dtype=dtype)
+
+
+jax.tree_util.register_pytree_node(Decimal128Column, _d128_flatten,
+                                   _d128_unflatten)
+
+
+# ---------------------------------------------------------------------------
+# limb arithmetic ((hi:int64, lo:uint64) pairs; wrapping semantics)
+# ---------------------------------------------------------------------------
+
+def d128_from_i64(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Sign-extend an int64 into two limbs."""
+    return jnp.where(x < 0, jnp.int64(-1), jnp.int64(0)), _u(x)
+
+
+def d128_add(ah, al, bh, bl):
+    lo = al + bl  # wrapping uint64
+    carry = (lo < al).astype(jnp.int64)
+    hi = ah + bh + carry
+    return hi, lo
+
+
+def d128_neg(h, l):
+    nl = (~l) + jnp.uint64(1)
+    nh = (~h) + jnp.where(nl == 0, jnp.int64(1), jnp.int64(0))
+    return nh, nl
+
+
+def d128_sub(ah, al, bh, bl):
+    nh, nl = d128_neg(bh, bl)
+    return d128_add(ah, al, nh, nl)
+
+
+def d128_abs(h, l):
+    neg = h < 0
+    nh, nl = d128_neg(h, l)
+    return jnp.where(neg, nh, h), jnp.where(neg, nl, l)
+
+
+def d128_lt(ah, al, bh, bl):
+    return (ah < bh) | ((ah == bh) & (al < bl))
+
+
+def d128_eq(ah, al, bh, bl):
+    return (ah == bh) & (al == bl)
+
+
+def _mul_u64(a, b):
+    """Full 64x64 -> 128 unsigned multiply via 32-bit limbs."""
+    a0, a1 = a & _U32, a >> jnp.uint64(32)
+    b0, b1 = b & _U32, b >> jnp.uint64(32)
+    p00 = a0 * b0
+    p01 = a0 * b1
+    p10 = a1 * b0
+    p11 = a1 * b1
+    mid = (p00 >> jnp.uint64(32)) + (p01 & _U32) + (p10 & _U32)
+    lo = (p00 & _U32) | (mid << jnp.uint64(32))
+    hi = p11 + (p01 >> jnp.uint64(32)) + (p10 >> jnp.uint64(32)) + \
+        (mid >> jnp.uint64(32))
+    return hi, lo
+
+
+def d128_mul(ah, al, bh, bl):
+    """Signed 128x128 multiply, truncated to 128 bits, with an overflow
+    flag (true when the mathematical product does not fit in 128 bits).
+    Operates on magnitudes, reapplies sign — overflow detection is then
+    a check on the high magnitude limbs."""
+    sa, sb = ah < 0, bh < 0
+    ah1, al1 = d128_abs(ah, al)
+    bh1, bl1 = d128_abs(bh, bl)
+    uah, ubh = _u(ah1), _u(bh1)
+    # |a| * |b| = (ah*2^64 + al)(bh*2^64 + bl)
+    p_hi, p_lo = _mul_u64(al1, bl1)          # al*bl -> (hi, lo)
+    cross1 = uah * bl1                        # wraps; overflow checked below
+    cross2 = ubh * al1
+    hi = p_hi + cross1 + cross2
+    # overflow if: both highs nonzero, or cross terms overflow 64 bits,
+    # or result hi exceeds the signed-positive range
+    c1h, _ = _mul_u64(uah, bl1)
+    c2h, _ = _mul_u64(ubh, al1)
+    overflow = (uah != 0) & (ubh != 0)
+    overflow |= (c1h != 0) | (c2h != 0)
+    overflow |= (hi < p_hi)  # wrapped on accumulate (approximate)
+    neg = sa ^ sb
+    nh, nl = d128_neg(_s(hi), p_lo)
+    rh = jnp.where(neg, nh, _s(hi))
+    rl = jnp.where(neg, nl, p_lo)
+    overflow |= (_s(hi) < 0)  # magnitude spilled into the sign bit
+    return rh, rl, overflow
+
+
+_POW10_U64 = [10 ** k for k in range(20)]
+
+
+def d128_mul_pow10(h, l, k: int):
+    """(h, l) * 10^k, k static >= 0; overflow flag like d128_mul."""
+    overflow = jnp.zeros(h.shape, jnp.bool_)
+    while k > 0:
+        step = min(k, 18)
+        m = jnp.uint64(_POW10_U64[step])
+        sa = h < 0
+        h1, l1 = d128_abs(h, l)
+        phi, plo = _mul_u64(l1, m)
+        cross = _u(h1) * m
+        chk, _ = _mul_u64(_u(h1), m)
+        hi = phi + cross
+        overflow |= (chk != 0) | (hi < phi) | (_s(hi) < 0)
+        nh, nl = d128_neg(_s(hi), plo)
+        h = jnp.where(sa, nh, _s(hi))
+        l = jnp.where(sa, nl, plo)
+        k -= step
+    return h, l, overflow
+
+
+def _divmod_small(h, l, d: int):
+    """Unsigned (h:uint64, l:uint64) // d for d < 2^31, via 32-bit
+    schoolbook division (no intermediate exceeds 64 bits)."""
+    dd = jnp.uint64(d)
+    limbs = [h >> jnp.uint64(32), h & _U32, l >> jnp.uint64(32), l & _U32]
+    rem = jnp.zeros(h.shape, jnp.uint64)
+    qs = []
+    for limb in limbs:
+        cur = (rem << jnp.uint64(32)) | limb
+        q = cur // dd
+        rem = cur - q * dd
+        qs.append(q & _U32)
+    qh = (qs[0] << jnp.uint64(32)) | qs[1]
+    ql = (qs[2] << jnp.uint64(32)) | qs[3]
+    return qh, ql, rem
+
+
+def d128_div_pow10_half_up(h, l, k: int):
+    """(h, l) / 10^k with HALF_UP rounding, k static >= 0."""
+    if k == 0:
+        return h, l
+    neg = h < 0
+    mh, ml = d128_abs(h, l)
+    uh, ul = _u(mh), _u(ml)
+    # add 10^k / 2 for HALF_UP before truncating division
+    half = 10 ** k // 2
+    add_h = jnp.uint64(half >> 64)
+    add_l = jnp.uint64(half & ((1 << 64) - 1))
+    nl = ul + add_l
+    carry = (nl < ul).astype(jnp.uint64)
+    nh = uh + add_h + carry
+    uh, ul = nh, nl
+    kk = k
+    while kk > 0:
+        step = min(kk, 9)
+        uh, ul, _ = _divmod_small(uh, ul, 10 ** step)
+        kk -= step
+    rh, rl = _s(uh), ul
+    nh2, nl2 = d128_neg(rh, rl)
+    return jnp.where(neg, nh2, rh), jnp.where(neg, nl2, rl)
+
+
+def _pow10_limbs(p: int) -> Tuple[int, int]:
+    v = 10 ** p
+    return v >> 64, v & ((1 << 64) - 1)
+
+
+def d128_fits_precision(h, l, precision: int):
+    """|x| < 10^precision (Spark changePrecision overflow check)."""
+    if precision >= 39:
+        return jnp.ones(h.shape, jnp.bool_)
+    bh, bl = _pow10_limbs(precision)
+    mh, ml = d128_abs(h, l)
+    return d128_lt(mh, ml, jnp.int64(bh), jnp.uint64(bl))
+
+
+def d128_rescale(h, l, from_scale: int, to_scale: int):
+    """Change scale; returns (h, l, overflow_from_upscale)."""
+    if to_scale == from_scale:
+        return h, l, jnp.zeros(h.shape, jnp.bool_)
+    if to_scale > from_scale:
+        return d128_mul_pow10(h, l, to_scale - from_scale)
+    h2, l2 = d128_div_pow10_half_up(h, l, from_scale - to_scale)
+    return h2, l2, jnp.zeros(h.shape, jnp.bool_)
+
+
+# ---------------------------------------------------------------------------
+# host <-> device
+# ---------------------------------------------------------------------------
+
+def from_unscaled_ints(values, capacity: int, dtype: dt.DecimalType,
+                       mask: Optional[np.ndarray] = None
+                       ) -> Decimal128Column:
+    """Build from python unscaled ints (arbitrary precision)."""
+    n = len(values)
+    valid = np.array([v is not None for v in values], dtype=bool) \
+        if mask is None else np.asarray(mask, dtype=bool)
+    hi = np.zeros(capacity, np.int64)
+    lo = np.zeros(capacity, np.uint64)
+    for i in range(n):
+        if not valid[i] or values[i] is None:
+            continue
+        v = int(values[i])
+        hi[i] = np.int64(v >> 64)  # python >> is arithmetic: sign-correct
+        lo[i] = np.uint64(v & ((1 << 64) - 1))
+    validity = np.zeros(capacity, bool)
+    validity[:n] = valid
+    return Decimal128Column(jnp.asarray(hi), jnp.asarray(lo),
+                            jnp.asarray(validity), dtype)

@@ -353,6 +353,12 @@ def column_from_numpy(values: np.ndarray, capacity: int,
         return StringColumn(jnp.asarray(offsets), jnp.asarray(chars), jnp.asarray(validity),
                             pad_bucket=round_pow2(max_len))
 
+    if isinstance(dtype, dt.DecimalType) and dtype.is_wide:
+        from .decimal128 import from_unscaled_ints
+        unscaled = [None if not valid[i] or values[i] is None
+                    else _to_physical(values[i], dtype) for i in range(n)]
+        return from_unscaled_ints(unscaled, capacity, dtype, mask=valid)
+
     phys = np.dtype(dtype.physical)
     data = np.zeros(capacity, dtype=phys)
     vals = np.asarray(values)

@@ -256,6 +256,14 @@ class Literal(Expression):
         if isinstance(self.dtype, dt.DecimalType):
             import decimal
             value = int(decimal.Decimal(value).scaleb(self.dtype.scale).to_integral_value())
+            if self.dtype.is_wide:
+                from ..columnar.decimal128 import Decimal128Column
+                hi = jnp.full(cap, value >> 64, jnp.int64)
+                lo = jnp.full(cap, value & ((1 << 64) - 1), jnp.uint64)
+                z64, zu = jnp.zeros((), jnp.int64), jnp.zeros((), jnp.uint64)
+                return Decimal128Column(jnp.where(live, hi, z64),
+                                        jnp.where(live, lo, zu),
+                                        live, self.dtype)
         import datetime
         if isinstance(value, datetime.datetime):
             value = int(value.replace(tzinfo=datetime.timezone.utc).timestamp() * 1_000_000)
