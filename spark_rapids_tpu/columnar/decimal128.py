@@ -243,6 +243,318 @@ def d128_div_pow10_half_up(h, l, k: int):
     return jnp.where(neg, nh2, rh), jnp.where(neg, nl2, rl)
 
 
+def d128_div_pow10_trunc(h, l, k: int):
+    """(h, l) / 10^k truncating toward zero, k static >= 0."""
+    if k == 0:
+        return h, l
+    neg = h < 0
+    mh, ml = d128_abs(h, l)
+    uh, ul = _u(mh), _u(ml)
+    kk = k
+    while kk > 0:
+        step = min(kk, 9)
+        uh, ul, _ = _divmod_small(uh, ul, 10 ** step)
+        kk -= step
+    rh, rl = _s(uh), ul
+    nh2, nl2 = d128_neg(rh, rl)
+    return jnp.where(neg, nh2, rh), jnp.where(neg, nl2, rl)
+
+
+def _u128_ge(ah, al, bh, bl):
+    """Unsigned (ah,al) >= (bh,bl); all uint64."""
+    return (ah > bh) | ((ah == bh) & (al >= bl))
+
+
+def _u128_sub(ah, al, bh, bl):
+    lo = al - bl
+    borrow = (al < bl).astype(jnp.uint64)
+    return ah - bh - borrow, lo
+
+
+def d128_divmod_u(nh, nl, dh, dl):
+    """Unsigned 128/128 long division: returns (qh, ql, rh, rl), all
+    uint64. Division by zero yields garbage — callers must mask.
+
+    Shift-subtract restoring division, 128 fixed iterations under
+    ``lax.fori_loop`` — data-independent control flow, so XLA compiles
+    one small loop body instead of a 128-step unrolled graph."""
+    zero = jnp.zeros_like(nh)
+
+    def body(i, st):
+        qh, ql, rh, rl = st
+        k = jnp.uint64(127) - jnp.uint64(i)
+        # bit k of the dividend
+        bit = jnp.where(
+            k >= 64,
+            (nh >> jnp.where(k >= 64, k - jnp.uint64(64), jnp.uint64(0)))
+            & jnp.uint64(1),
+            (nl >> jnp.where(k >= 64, jnp.uint64(0), k)) & jnp.uint64(1))
+        # remainder <<= 1 | bit
+        rh = (rh << jnp.uint64(1)) | (rl >> jnp.uint64(63))
+        rl = (rl << jnp.uint64(1)) | bit
+        ge = _u128_ge(rh, rl, dh, dl)
+        sh, sl = _u128_sub(rh, rl, dh, dl)
+        rh = jnp.where(ge, sh, rh)
+        rl = jnp.where(ge, sl, rl)
+        qbit = ge.astype(jnp.uint64)
+        qh = qh | jnp.where(
+            k >= 64,
+            qbit << jnp.where(k >= 64, k - jnp.uint64(64), jnp.uint64(0)),
+            jnp.uint64(0))
+        ql = ql | jnp.where(
+            k >= 64, jnp.uint64(0),
+            qbit << jnp.where(k >= 64, jnp.uint64(0), k))
+        return qh, ql, rh, rl
+
+    qh, ql, rh, rl = jax.lax.fori_loop(
+        0, 128, body, (zero, zero, zero, zero))
+    return qh, ql, rh, rl
+
+
+def d128_div_trunc(ah, al, bh, bl):
+    """Signed truncating 128/128 divide; returns (q_hi, q_lo, r_hi,
+    r_lo) with the remainder taking the dividend's sign (Java %)."""
+    qneg = (ah < 0) ^ (bh < 0)
+    rneg = ah < 0
+    mah, mal = d128_abs(ah, al)
+    mbh, mbl = d128_abs(bh, bl)
+    qh, ql, rh, rl = d128_divmod_u(_u(mah), _u(mal), _u(mbh), _u(mbl))
+    sqh, sql = _s(qh), ql
+    srh, srl = _s(rh), rl
+    nqh, nql = d128_neg(sqh, sql)
+    nrh, nrl = d128_neg(srh, srl)
+    return (jnp.where(qneg, nqh, sqh), jnp.where(qneg, nql, sql),
+            jnp.where(rneg, nrh, srh), jnp.where(rneg, nrl, srl))
+
+
+# ---------------------------------------------------------------------------
+# 256-bit intermediates (Spark-exact wide multiply / divide)
+#
+# decimal(38)*decimal(38) products and scaled-up division numerators
+# exceed 128 bits before the result scale is applied — the reference
+# leans on cuDF's __int128/256-bit fixed-point paths for the same reason
+# (decimalExpressions.scala, GpuDecimalMultiply/GpuDecimalDivide). Here a
+# 256-bit magnitude is four uint64 limbs, little-endian.
+# ---------------------------------------------------------------------------
+
+def _mul_u128_to_256(ah, al, bh, bl):
+    """Unsigned 128x128 -> 256-bit product as 4 uint64 limbs (LE)."""
+    p0h, p0l = _mul_u64(al, bl)          # al*bl -> limbs 0,1
+    p1h, p1l = _mul_u64(al, bh)          # -> limbs 1,2
+    p2h, p2l = _mul_u64(ah, bl)          # -> limbs 1,2
+    p3h, p3l = _mul_u64(ah, bh)          # -> limbs 2,3
+    w0 = p0l
+    w1 = p0h + p1l
+    c1 = (w1 < p0h).astype(jnp.uint64)
+    w1b = w1 + p2l
+    c1 = c1 + (w1b < w1).astype(jnp.uint64)
+    w2 = p1h + p2h
+    c2 = (w2 < p1h).astype(jnp.uint64)
+    w2b = w2 + p3l
+    c2 = c2 + (w2b < w2).astype(jnp.uint64)
+    w2c = w2b + c1
+    c2 = c2 + (w2c < w2b).astype(jnp.uint64)
+    w3 = p3h + c2
+    return w0, w1b, w2c, w3
+
+
+def _d256_divmod_small(limbs, d: int):
+    """(4xuint64 LE) // d for d < 2^31 via 32-bit schoolbook division.
+    Returns (quotient limbs, remainder)."""
+    dd = jnp.uint64(d)
+    w0, w1, w2, w3 = limbs
+    chunks = []
+    for w in (w3, w2, w1, w0):
+        chunks.extend([w >> jnp.uint64(32), w & _U32])
+    rem = jnp.zeros(w0.shape, jnp.uint64)
+    qs = []
+    for c in chunks:
+        cur = (rem << jnp.uint64(32)) | c
+        q = cur // dd
+        rem = cur - q * dd
+        qs.append(q & _U32)
+    out = []
+    for i in (3, 2, 1, 0):
+        out.append((qs[2 * i] << jnp.uint64(32)) | qs[2 * i + 1])
+    return tuple(out), rem
+
+
+def _d256_add_small(limbs, const: int):
+    """Add a python-int constant (< 2^256) to a 256-bit magnitude."""
+    out = []
+    carry = jnp.zeros(limbs[0].shape, jnp.uint64)
+    for i, w in enumerate(limbs):
+        a = jnp.uint64((const >> (64 * i)) & ((1 << 64) - 1))
+        r = w + a
+        c_new = (r < w).astype(jnp.uint64)
+        r2 = r + carry
+        c_new = c_new + (r2 < carry).astype(jnp.uint64)
+        out.append(r2)
+        carry = c_new
+    return tuple(out)
+
+
+def d256_div_pow10_half_up(limbs, k: int):
+    """256-bit magnitude / 10^k with HALF_UP rounding."""
+    if k == 0:
+        return limbs
+    limbs = _d256_add_small(limbs, 10 ** k // 2)
+    kk = k
+    while kk > 0:
+        step = min(kk, 9)
+        limbs, _ = _d256_divmod_small(limbs, 10 ** step)
+        kk -= step
+    return limbs
+
+
+def _d256_mul_small(limbs, m: int):
+    """256-bit magnitude * m (m < 2^31). Returns (limbs, overflow)."""
+    mm = jnp.uint64(m)
+    w0, w1, w2, w3 = limbs
+    chunks = []
+    for w in (w0, w1, w2, w3):
+        chunks.extend([w & _U32, w >> jnp.uint64(32)])
+    carry = jnp.zeros(w0.shape, jnp.uint64)
+    outc = []
+    for c in chunks:
+        cur = c * mm + carry
+        outc.append(cur & _U32)
+        carry = cur >> jnp.uint64(32)
+    out = tuple((outc[2 * i + 1] << jnp.uint64(32)) | outc[2 * i]
+                for i in range(4))
+    return out, carry != 0
+
+
+def d256_mul_pow10(limbs, k: int):
+    """256-bit magnitude * 10^k with overflow detection."""
+    overflow = jnp.zeros(limbs[0].shape, jnp.bool_)
+    while k > 0:
+        step = min(k, 9)
+        limbs, o = _d256_mul_small(limbs, 10 ** step)
+        overflow |= o
+        k -= step
+    return limbs, overflow
+
+
+def d256_fits_128(limbs):
+    """Magnitude fits a signed 128-bit value (< 2^127)."""
+    w0, w1, w2, w3 = limbs
+    return (w2 == 0) & (w3 == 0) & ((w1 >> jnp.uint64(63)) == 0)
+
+
+def d256_divmod_u128(n_limbs, dh, dl):
+    """Unsigned 256-bit / 128-bit long division. Returns (overflow,
+    qh, ql, rh, rl): ``overflow`` is set when the quotient exceeds 128
+    bits. Division by zero yields garbage — callers must mask."""
+    w0, w1, w2, w3 = n_limbs
+    zero = jnp.zeros_like(w0)
+
+    def bit_of(k):
+        """bit k (0..255) of the 256-bit dividend; k traced uint64."""
+        limb_idx = k >> jnp.uint64(6)
+        sh = k & jnp.uint64(63)
+        v0 = (w0 >> sh) & jnp.uint64(1)
+        v1 = (w1 >> sh) & jnp.uint64(1)
+        v2 = (w2 >> sh) & jnp.uint64(1)
+        v3 = (w3 >> sh) & jnp.uint64(1)
+        return jnp.where(limb_idx == 0, v0,
+                         jnp.where(limb_idx == 1, v1,
+                                   jnp.where(limb_idx == 2, v2, v3)))
+
+    def body(i, st):
+        qh, ql, rh, rl, ovf = st
+        k = jnp.uint64(255) - jnp.uint64(i)
+        bit = bit_of(k)
+        rh = (rh << jnp.uint64(1)) | (rl >> jnp.uint64(63))
+        rl = (rl << jnp.uint64(1)) | bit
+        ge = _u128_ge(rh, rl, dh, dl)
+        sh_, sl_ = _u128_sub(rh, rl, dh, dl)
+        rh = jnp.where(ge, sh_, rh)
+        rl = jnp.where(ge, sl_, rl)
+        # shift a new bit into the quotient; anything pushed past bit
+        # 127 is overflow
+        ovf = ovf | ((qh >> jnp.uint64(63)) & jnp.uint64(1)).astype(jnp.bool_)
+        qh = (qh << jnp.uint64(1)) | (ql >> jnp.uint64(63))
+        ql = (ql << jnp.uint64(1)) | ge.astype(jnp.uint64)
+        return qh, ql, rh, rl, ovf
+
+    qh, ql, rh, rl, ovf = jax.lax.fori_loop(
+        0, 256, body, (zero, zero, zero, zero,
+                       jnp.zeros(w0.shape, jnp.bool_)))
+    return ovf, qh, ql, rh, rl
+
+
+def d128_mul_exact(ah, al, bh, bl, drop_scale: int):
+    """Spark-exact wide multiply: |a|*|b| in 256 bits, divide by
+    10^drop_scale with HALF_UP, reapply sign. Returns (hi, lo,
+    overflow) where overflow = the rounded product exceeds 128 bits."""
+    neg = (ah < 0) ^ (bh < 0)
+    mah, mal = d128_abs(ah, al)
+    mbh, mbl = d128_abs(bh, bl)
+    limbs = _mul_u128_to_256(_u(mah), _u(mal), _u(mbh), _u(mbl))
+    limbs = d256_div_pow10_half_up(limbs, drop_scale)
+    ok = d256_fits_128(limbs)
+    w0, w1 = limbs[0], limbs[1]
+    sh, sl = _s(w1), w0
+    nh, nl = d128_neg(sh, sl)
+    return jnp.where(neg, nh, sh), jnp.where(neg, nl, sl), ~ok
+
+
+def d128_div_exact(ah, al, bh, bl, up_scale: int):
+    """Spark-exact wide divide: (|a| * 10^up_scale) / |b| with HALF_UP
+    rounding via 256-bit numerator. Returns (hi, lo, overflow);
+    division by zero must be masked by the caller."""
+    neg = (ah < 0) ^ (bh < 0)
+    mah, mal = d128_abs(ah, al)
+    mbh, mbl = d128_abs(bh, bl)
+    k0 = min(up_scale, 38)
+    ph, pl = _pow10_limbs(k0)
+    n_limbs = _mul_u128_to_256(_u(mah), _u(mal),
+                               jnp.full(ah.shape, np.uint64(ph)),
+                               jnp.full(ah.shape, np.uint64(pl)))
+    num_ovf = jnp.zeros(ah.shape, jnp.bool_)
+    if up_scale > k0:
+        n_limbs, num_ovf = d256_mul_pow10(n_limbs, up_scale - k0)
+    ubh, ubl = _u(mbh), _u(mbl)
+    ovf, qh, ql, rh, rl = d256_divmod_u128(n_limbs, ubh, ubl)
+    ovf = ovf | num_ovf
+    # HALF_UP on the remainder
+    r2h = (rh << jnp.uint64(1)) | (rl >> jnp.uint64(63))
+    r2l = rl << jnp.uint64(1)
+    bump = _u128_ge(r2h, r2l, ubh, ubl).astype(jnp.uint64)
+    ql2 = ql + bump
+    qh2 = qh + (ql2 < ql).astype(jnp.uint64)
+    ovf = ovf | ((qh2 >> jnp.uint64(63)) != 0)
+    sh, sl = _s(qh2), ql2
+    nh, nl = d128_neg(sh, sl)
+    return jnp.where(neg, nh, sh), jnp.where(neg, nl, sl), ovf
+
+
+def d128_to_f64(h, l):
+    """Approximate float64 value of the signed 128-bit integer."""
+    return h.astype(jnp.float64) * (2.0 ** 64) + l.astype(jnp.float64)
+
+
+def f64_to_d128(x):
+    """Round a float64 to the nearest signed 128-bit integer limbs.
+    Precision is inherently float64's 53 bits; out-of-range values wrap
+    (callers bound-check via the float before converting)."""
+    neg = x < 0
+    m = jnp.abs(x)
+    hi_f = jnp.floor(m / (2.0 ** 64))
+    lo_f = m - hi_f * (2.0 ** 64)
+    # round lo; a carry can push it to exactly 2^64
+    lo_f = jnp.floor(lo_f + 0.5)
+    carry = lo_f >= 2.0 ** 64
+    hi_f = hi_f + carry
+    lo_f = jnp.where(carry, 0.0, lo_f)
+    h = jnp.clip(hi_f, 0.0, 2.0 ** 63).astype(jnp.uint64)
+    l = lo_f.astype(jnp.uint64)
+    sh, sl = _s(h), l
+    nh, nl = d128_neg(sh, sl)
+    return jnp.where(neg, nh, sh), jnp.where(neg, nl, sl)
+
+
 def _pow10_limbs(p: int) -> Tuple[int, int]:
     v = 10 ** p
     return v >> 64, v & ((1 << 64) - 1)
@@ -270,6 +582,81 @@ def d128_rescale(h, l, from_scale: int, to_scale: int):
 # ---------------------------------------------------------------------------
 # host <-> device
 # ---------------------------------------------------------------------------
+
+def limbs_of(col) -> Tuple[jax.Array, jax.Array]:
+    """(hi:int64, lo:uint64) limbs of any decimal column — sign-extends
+    long-backed (int64) decimals, passes wide columns through."""
+    if isinstance(col, Decimal128Column):
+        return col.hi, col.lo
+    return d128_from_i64(col.data.astype(jnp.int64))
+
+
+def build_decimal_column(hi, lo, validity, dtype: dt.DecimalType):
+    """Materialize limbs as the physical column for ``dtype``: a
+    Decimal128Column when wide, otherwise an int64 ColumnVector (the
+    value is known to fit by the caller's precision check). Lanes under
+    nulls are zeroed (the engine-wide invariant)."""
+    from .vector import ColumnVector
+    z64 = jnp.zeros((), jnp.int64)
+    if dtype.is_wide:
+        zu = jnp.zeros((), jnp.uint64)
+        return Decimal128Column(jnp.where(validity, hi, z64),
+                                jnp.where(validity, lo, zu),
+                                validity, dtype)
+    data = lo.astype(jnp.int64)  # wrapping; exact when |v| < 2^63
+    return ColumnVector(jnp.where(validity, data, z64), validity, dtype)
+
+
+def seg_sum128(hi, lo, gid, num_groups):
+    """Segmented 128-bit sum. Decomposes each two's-complement value
+    into four 32-bit limbs, segment-sums each into uint64 accumulators
+    (exact for < 2^32 rows), then carry-propagates back to (hi, lo).
+    The result is the true sum mod 2^128 — wrap detection is the
+    caller's job (see expr/aggregates.py decimal sum)."""
+    uh, ul = _u(hi), lo
+    limbs = [ul & _U32, ul >> jnp.uint64(32), uh & _U32,
+             uh >> jnp.uint64(32)]
+    sums = []
+    for w in limbs:
+        acc = jnp.zeros(num_groups, jnp.uint64)
+        sums.append(acc.at[gid].add(w))
+    acc = sums[0]
+    w0 = acc & _U32
+    acc = (acc >> jnp.uint64(32)) + sums[1]
+    w1 = acc & _U32
+    acc = (acc >> jnp.uint64(32)) + sums[2]
+    w2 = acc & _U32
+    acc = (acc >> jnp.uint64(32)) + sums[3]
+    w3 = acc & _U32
+    out_lo = w0 | (w1 << jnp.uint64(32))
+    out_hi = _s(w2 | (w3 << jnp.uint64(32)))
+    return out_hi, out_lo
+
+
+def sort_key_bias(h):
+    """Order-preserving uint64 image of the hi limb: flip the sign bit
+    so (biased_hi, lo) lexicographic unsigned order == signed 128-bit
+    numeric order. Used by segmented min/max and sort-key expansion."""
+    return _u(h) ^ jnp.uint64(1 << 63)
+
+
+def seg_minmax128(hi, lo, valid, gid, num_groups, largest: bool):
+    """Segmented 128-bit min/max via two lexicographic passes: first
+    reduce the biased hi limb, then reduce lo among rows whose hi limb
+    equals the group winner."""
+    bh = sort_key_bias(hi)
+    hi_fill = jnp.uint64(0) if largest else jnp.uint64(0xFFFFFFFFFFFFFFFF)
+    lo_fill = hi_fill
+    bh_m = jnp.where(valid, bh, hi_fill)
+    acc = jnp.full(num_groups, hi_fill, jnp.uint64)
+    best_hi = (acc.at[gid].max(bh_m) if largest else acc.at[gid].min(bh_m))
+    on_best = valid & (bh_m == best_hi[gid])
+    lo_m = jnp.where(on_best, lo, lo_fill)
+    acc2 = jnp.full(num_groups, lo_fill, jnp.uint64)
+    best_lo = (acc2.at[gid].max(lo_m) if largest else acc2.at[gid].min(lo_m))
+    out_hi = _s(best_hi ^ jnp.uint64(1 << 63))
+    return out_hi, best_lo
+
 
 def from_unscaled_ints(values, capacity: int, dtype: dt.DecimalType,
                        mask: Optional[np.ndarray] = None

@@ -261,6 +261,40 @@ def from_numpy_dtype(dt) -> DType:
         raise TypeError(f"unsupported numpy dtype {dt}")
 
 
+def adjust_decimal_precision(precision: int, scale: int) -> "DecimalType":
+    """Spark's DecimalPrecision.adjustPrecisionScale with
+    allowPrecisionLoss=true: cap at MAX_PRECISION, keeping at least 6
+    fractional digits (or the natural scale if smaller)."""
+    if precision <= DecimalType.MAX_PRECISION:
+        return DecimalType(precision, scale)
+    digits = precision - scale  # integral digits, preserved
+    min_scale = min(scale, 6)
+    adj_scale = max(DecimalType.MAX_PRECISION - digits, min_scale)
+    return DecimalType(DecimalType.MAX_PRECISION, adj_scale)
+
+
+def decimal_result_type(op: str, a: "DecimalType", b: "DecimalType"
+                        ) -> "DecimalType":
+    """Spark DecimalPrecision result types for binary arithmetic
+    (add/sub/mul/div/mod), allowPrecisionLoss=true semantics."""
+    p1, s1, p2, s2 = a.precision, a.scale, b.precision, b.scale
+    if op in ("add", "sub"):
+        scale = max(s1, s2)
+        prec = max(p1 - s1, p2 - s2) + scale + 1
+    elif op == "mul":
+        scale = s1 + s2
+        prec = p1 + p2 + 1
+    elif op == "div":
+        scale = max(6, s1 + p2 + 1)
+        prec = p1 - s1 + s2 + scale
+    elif op == "mod":
+        scale = max(s1, s2)
+        prec = min(p1 - s1, p2 - s2) + scale
+    else:
+        raise TypeError(f"decimal {op} unsupported")
+    return adjust_decimal_precision(prec, scale)
+
+
 _PROMOTION_ORDER = [INT8, INT16, INT32, INT64, FLOAT32, FLOAT64]
 
 
@@ -277,7 +311,8 @@ def promote(a: DType, b: DType) -> DType:
 
 
 def min_value(dt: DType):
-    if dt.is_integral or isinstance(dt, (DateType, TimestampType)):
+    if dt.is_integral or isinstance(dt, (DateType, TimestampType)) or \
+            isinstance(dt, DecimalType):
         return np.iinfo(np.dtype(dt.physical)).min
     if dt.is_floating:
         return -np.inf
@@ -287,7 +322,8 @@ def min_value(dt: DType):
 
 
 def max_value(dt: DType):
-    if dt.is_integral or isinstance(dt, (DateType, TimestampType)):
+    if dt.is_integral or isinstance(dt, (DateType, TimestampType)) or \
+            isinstance(dt, DecimalType):
         return np.iinfo(np.dtype(dt.physical)).max
     if dt.is_floating:
         return np.inf

@@ -40,6 +40,19 @@ def _state_col_name(agg_index: int, state_name: str) -> str:
     return f"__agg{agg_index}__{state_name}"
 
 
+def make_agg_result(data, validity, out_t: dt.DType):
+    """Finalized aggregate -> output column. Decimal aggregates with
+    128-bit states finalize to (hi, lo) limb tuples; everything else is
+    a plain lane array."""
+    if isinstance(data, tuple):
+        from ..columnar import decimal128 as d128
+        hi, lo = data
+        validity = validity & d128.d128_fits_precision(hi, lo,
+                                                       out_t.precision)
+        return d128.build_decimal_column(hi, lo, validity, out_t)
+    return make_result(data, validity, out_t)
+
+
 class HashAggregateExec(TpuExec):
     """groupBy(keys).agg(fns) over the child stream.
 
@@ -159,7 +172,7 @@ class HashAggregateExec(TpuExec):
             kc for kc in key_batch.columns]
         for i, (fn, name) in enumerate(self.agg_exprs):
             data, ok = fn.finalize(merged[i])
-            out_cols.append(make_result(
+            out_cols.append(make_agg_result(
                 data, ok & lm,
                 self._result_schema[len(self._key_names) + i][1]))
         names = [n for n, _ in self._result_schema]
@@ -238,8 +251,8 @@ class HashAggregateExec(TpuExec):
                 zero_states[sname] = jnp.zeros(cap, phys)
             data, ok = fn.finalize(zero_states)
             lm = live_mask(cap, 1)
-            cols.append(make_result(data, ok & lm,
-                                    fn.data_type(in_schema)))
+            cols.append(make_agg_result(data, ok & lm,
+                                        fn.data_type(in_schema)))
         return ColumnarBatch(cols, [n for _, n in self.agg_exprs], 1)
 
     def node_description(self) -> str:

@@ -61,6 +61,10 @@ def partition_slice(pb: PartitionedBatch, i: int) -> ColumnarBatch:
         if dtype == dt.STRING:
             padded, lens, valid = spec
             cols.append(string_from_padded(padded[i], lens[i], valid[i]))
+        elif isinstance(dtype, dt.DecimalType) and dtype.is_wide:
+            from ..columnar.decimal128 import Decimal128Column
+            hi, lo, valid = spec
+            cols.append(Decimal128Column(hi[i], lo[i], valid[i], dtype))
         else:
             data, valid = spec
             cols.append(ColumnVector(data[i], valid[i], dtype))

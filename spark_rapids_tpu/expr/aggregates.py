@@ -87,26 +87,78 @@ class AggregateFunction(Expression):
         raise NotImplementedError
 
 
-class Sum(AggregateFunction):
-    """Spark sum: long for integrals, double for floats, decimal widened;
-    empty/all-null group -> null."""
+def _sum_decimal_type(t: dt.DecimalType) -> dt.DecimalType:
+    """Spark sum result: decimal(p+10, s) capped at MAX_PRECISION."""
+    return dt.DecimalType(min(t.precision + 10, dt.DecimalType.MAX_PRECISION),
+                          t.scale)
+
+
+# a 128-bit segmented sum wrapped iff the true sum's magnitude exceeds
+# 2^127 ~= 1.70e38; the float64 shadow sum detects that reliably at this
+# guard (see Sum docstring)
+_WRAP_GUARD = 1.6e38
+
+
+class _Decimal128SumMixin:
+    """Shared 128-bit decimal sum machinery (Sum / Average states).
+
+    State: (hi, lo) segmented two's-complement sum (exact mod 2^128,
+    columnar/decimal128.py seg_sum128) + a float64 shadow sum. A group
+    whose shadow magnitude exceeds ~2^127 must have wrapped (or is far
+    out of any decimal bound) -> overflow null, mirroring GpuSum's
+    overflow handling on DECIMAL128 (aggregate/GpuSum-family,
+    sql-plugin aggregate package)."""
+
+    @staticmethod
+    def _dec_update(gid, col, num_groups):
+        from ..columnar import decimal128 as d128
+        hi, lo = d128.limbs_of(col)
+        sh, sl = d128.seg_sum128(hi, lo, gid, num_groups)
+        approx = _seg_sum(d128.d128_to_f64(hi, lo), gid, num_groups,
+                          jnp.float64)
+        n = _seg_sum(col.validity.astype(jnp.int64), gid, num_groups)
+        return {"sum_hi": sh, "sum_lo": sl.astype(jnp.int64),
+                "approx": approx, "count": n}
+
+    @staticmethod
+    def _dec_merge(gid, states, num_groups):
+        from ..columnar import decimal128 as d128
+        hi = states["sum_hi"]
+        lo = states["sum_lo"].astype(jnp.uint64)
+        sh, sl = d128.seg_sum128(hi, lo, gid, num_groups)
+        approx = _seg_sum(states["approx"], gid, num_groups)
+        n = _seg_sum(states["count"], gid, num_groups)
+        return {"sum_hi": sh, "sum_lo": sl.astype(jnp.int64),
+                "approx": approx, "count": n}
+
+
+class Sum(AggregateFunction, _Decimal128SumMixin):
+    """Spark sum: long for integrals, double for floats, decimal widened
+    to p+10 (two-limb accumulator when that exceeds long-backed range);
+    empty/all-null group -> null; decimal overflow -> null (non-ANSI)."""
 
     name = "sum"
 
     def data_type(self, schema: Schema) -> dt.DType:
         t = self.children[0].data_type(schema)
         if isinstance(t, dt.DecimalType):
-            return dt.DecimalType(min(t.precision + 10, 18), t.scale)
+            return _sum_decimal_type(t)
         if t.is_integral:
             return dt.INT64
         return dt.FLOAT64
 
     def state_schema(self, schema: Schema) -> List:
-        return [("sum", self.data_type(schema)), ("count", dt.INT64)]
+        out_t = self.data_type(schema)
+        if isinstance(out_t, dt.DecimalType) and out_t.is_wide:
+            return [("sum_hi", dt.INT64), ("sum_lo", dt.INT64),
+                    ("approx", dt.FLOAT64), ("count", dt.INT64)]
+        return [("sum", out_t), ("count", dt.INT64)]
 
     def update(self, gid, col: Column, num_groups: int, live,
                **kw) -> State:
         out_t = self._out_t(col)
+        if isinstance(out_t, dt.DecimalType) and out_t.is_wide:
+            return self._dec_update(gid, col, num_groups)
         phys = out_t.physical
         vals = jnp.where(col.validity, col.data.astype(phys), jnp.zeros((), phys))
         s = _seg_sum(vals, gid, num_groups)
@@ -116,16 +168,24 @@ class Sum(AggregateFunction):
     def _out_t(self, col: Column) -> dt.DType:
         t = col.dtype
         if isinstance(t, dt.DecimalType):
-            return dt.DecimalType(min(t.precision + 10, 18), t.scale)
+            return _sum_decimal_type(t)
         if t.is_integral or isinstance(t, dt.BooleanType):
             return dt.INT64
         return dt.FLOAT64
 
     def merge(self, gid, states: State, num_groups: int) -> State:
+        if "sum_hi" in states:
+            return self._dec_merge(gid, states, num_groups)
         return {"sum": _seg_sum(states["sum"], gid, num_groups),
                 "count": _seg_sum(states["count"], gid, num_groups)}
 
     def finalize(self, states: State) -> tuple:
+        if "sum_hi" in states:
+            hi = states["sum_hi"]
+            lo = states["sum_lo"].astype(jnp.uint64)
+            ok = (states["count"] > 0) & \
+                (jnp.abs(states["approx"]) < _WRAP_GUARD)
+            return (hi, lo), ok
         return states["sum"], states["count"] > 0
 
 
@@ -171,89 +231,141 @@ class CountStar(AggregateFunction):
         return states["count"], jnp.ones_like(states["count"], jnp.bool_)
 
 
-class Min(AggregateFunction):
+class _MinMaxBase(AggregateFunction):
+    """Shared min/max; decimal128 inputs reduce lexicographically over
+    (biased hi, lo) limb pairs (columnar/decimal128.py seg_minmax128)."""
+
+    largest = False
+
+    @property
+    def _key(self) -> str:
+        return "max" if self.largest else "min"
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return self.children[0].data_type(schema)
+
+    def state_schema(self, schema: Schema) -> List:
+        t = self.data_type(schema)
+        if isinstance(t, dt.DecimalType) and t.is_wide:
+            return [(self._key + "_hi", dt.INT64),
+                    (self._key + "_lo", dt.INT64), ("seen", dt.BOOL)]
+        return [(self._key, t), ("seen", dt.BOOL)]
+
+    def _wide_reduce(self, gid, hi, lo, valid, num_groups):
+        from ..columnar import decimal128 as d128
+        bh, bl = d128.seg_minmax128(hi, lo, valid, gid, num_groups,
+                                    self.largest)
+        seen = _seg_sum(valid.astype(jnp.int32), gid, num_groups) > 0
+        return {self._key + "_hi": bh, self._key + "_lo":
+                bl.astype(jnp.int64), "seen": seen}
+
+    def update(self, gid, col: Column, num_groups: int, live,
+               **kw) -> State:
+        if isinstance(col.dtype, dt.DecimalType) and col.dtype.is_wide:
+            from ..columnar import decimal128 as d128
+            hi, lo = d128.limbs_of(col)
+            return self._wide_reduce(gid, hi, lo, col.validity, num_groups)
+        fill = dt.max_value(col.dtype) if not self.largest else \
+            dt.min_value(col.dtype)
+        vals = jnp.where(col.validity, col.data,
+                         jnp.asarray(fill, col.data.dtype))
+        red = _seg_max if self.largest else _seg_min
+        return {self._key: red(vals, gid, num_groups, fill),
+                "seen": _seg_sum(col.validity.astype(jnp.int32), gid,
+                                 num_groups) > 0}
+
+    def merge(self, gid, states: State, num_groups: int) -> State:
+        if self._key + "_hi" in states:
+            hi = states[self._key + "_hi"]
+            lo = states[self._key + "_lo"].astype(jnp.uint64)
+            return self._wide_reduce(gid, hi, lo, states["seen"],
+                                     num_groups)
+        fill = _phys_extreme(states[self._key].dtype,
+                             largest=not self.largest)
+        vals = jnp.where(states["seen"], states[self._key],
+                         jnp.asarray(fill, states[self._key].dtype))
+        red = _seg_max if self.largest else _seg_min
+        return {self._key: red(vals, gid, num_groups, fill),
+                "seen": _seg_sum(states["seen"].astype(jnp.int32), gid,
+                                 num_groups) > 0}
+
+    def finalize(self, states: State) -> tuple:
+        if self._key + "_hi" in states:
+            return (states[self._key + "_hi"],
+                    states[self._key + "_lo"].astype(jnp.uint64)), \
+                states["seen"]
+        return states[self._key], states["seen"]
+
+
+class Min(_MinMaxBase):
     name = "min"
-
-    def data_type(self, schema: Schema) -> dt.DType:
-        return self.children[0].data_type(schema)
-
-    def state_schema(self, schema: Schema) -> List:
-        return [("min", self.data_type(schema)), ("seen", dt.BOOL)]
-
-    def update(self, gid, col: Column, num_groups: int, live,
-               **kw) -> State:
-        fill = dt.max_value(col.dtype)
-        vals = jnp.where(col.validity, col.data,
-                         jnp.asarray(fill, col.data.dtype))
-        return {"min": _seg_min(vals, gid, num_groups, fill),
-                "seen": _seg_sum(col.validity.astype(jnp.int32), gid, num_groups) > 0}
-
-    def merge(self, gid, states: State, num_groups: int) -> State:
-        fill = _phys_extreme(states["min"].dtype, largest=True)
-        vals = jnp.where(states["seen"], states["min"],
-                         jnp.asarray(fill, states["min"].dtype))
-        return {"min": _seg_min(vals, gid, num_groups, fill),
-                "seen": _seg_sum(states["seen"].astype(jnp.int32), gid, num_groups) > 0}
-
-    def finalize(self, states: State) -> tuple:
-        return states["min"], states["seen"]
+    largest = False
 
 
-class Max(AggregateFunction):
+class Max(_MinMaxBase):
     name = "max"
-
-    def data_type(self, schema: Schema) -> dt.DType:
-        return self.children[0].data_type(schema)
-
-    def state_schema(self, schema: Schema) -> List:
-        return [("max", self.data_type(schema)), ("seen", dt.BOOL)]
-
-    def update(self, gid, col: Column, num_groups: int, live,
-               **kw) -> State:
-        fill = dt.min_value(col.dtype)
-        vals = jnp.where(col.validity, col.data,
-                         jnp.asarray(fill, col.data.dtype))
-        return {"max": _seg_max(vals, gid, num_groups, fill),
-                "seen": _seg_sum(col.validity.astype(jnp.int32), gid, num_groups) > 0}
-
-    def merge(self, gid, states: State, num_groups: int) -> State:
-        fill = _phys_extreme(states["max"].dtype, largest=False)
-        vals = jnp.where(states["seen"], states["max"],
-                         jnp.asarray(fill, states["max"].dtype))
-        return {"max": _seg_max(vals, gid, num_groups, fill),
-                "seen": _seg_sum(states["seen"].astype(jnp.int32), gid, num_groups) > 0}
-
-    def finalize(self, states: State) -> tuple:
-        return states["max"], states["seen"]
+    largest = True
 
 
-class Average(AggregateFunction):
-    """avg — double result (decimal avg flows through double for now)."""
+class Average(AggregateFunction, _Decimal128SumMixin):
+    """avg — double result; decimal input yields the Spark decimal
+    result type decimal(p+4, s+4) computed exactly: a 128-bit sum state
+    divided by the count with HALF_UP at the +4 scale."""
 
     name = "avg"
 
     def data_type(self, schema: Schema) -> dt.DType:
+        t = self.children[0].data_type(schema)
+        if isinstance(t, dt.DecimalType):
+            return dt.adjust_decimal_precision(t.precision + 4, t.scale + 4)
         return dt.FLOAT64
 
     def state_schema(self, schema: Schema) -> List:
+        t = self.children[0].data_type(schema)
+        if isinstance(t, dt.DecimalType):
+            # scale lift from the sum state (input scale) to the result
+            # scale — +4 normally, less when adjustPrecisionScale trims
+            # the result scale (never negative: adjusted scale >= s);
+            # the sum buffer overflows at decimal(min(p+10,38)) like
+            # Spark's Average sum attribute
+            self._avg_up = self.data_type(schema).scale - t.scale
+            self._sum_prec = _sum_decimal_type(t).precision
+            return [("sum_hi", dt.INT64), ("sum_lo", dt.INT64),
+                    ("approx", dt.FLOAT64), ("count", dt.INT64)]
         return [("sum", dt.FLOAT64), ("count", dt.INT64)]
 
     def update(self, gid, col: Column, num_groups: int, live,
                **kw) -> State:
-        x = col.data.astype(jnp.float64)
         if isinstance(col.dtype, dt.DecimalType):
-            x = x / (10.0 ** col.dtype.scale)
+            return self._dec_update(gid, col, num_groups)
+        x = col.data.astype(jnp.float64)
         vals = jnp.where(col.validity, x, 0.0)
         return {"sum": _seg_sum(vals, gid, num_groups),
                 "count": _seg_sum(col.validity.astype(jnp.int64), gid, num_groups)}
 
     def merge(self, gid, states: State, num_groups: int) -> State:
+        if "sum_hi" in states:
+            return self._dec_merge(gid, states, num_groups)
         return {"sum": _seg_sum(states["sum"], gid, num_groups),
                 "count": _seg_sum(states["count"], gid, num_groups)}
 
     def finalize(self, states: State) -> tuple:
         n = states["count"]
         ok = n > 0
+        if "sum_hi" in states:
+            from ..columnar import decimal128 as d128
+            hi = states["sum_hi"]
+            lo = states["sum_lo"].astype(jnp.uint64)
+            safe_n = jnp.where(ok, n, jnp.int64(1))
+            nh, nl = d128.d128_from_i64(safe_n)
+            # q = sum * 10^(result scale - input scale) / count, HALF_UP
+            # (Spark Average.evaluateExpression on decimals); the lift is
+            # cached by state_schema, which the exec always calls first
+            qh, ql, ovf = d128.d128_div_exact(hi, lo, nh, nl,
+                                              self._avg_up)
+            ok = ok & ~ovf & (jnp.abs(states["approx"]) < _WRAP_GUARD) & \
+                d128.d128_fits_precision(hi, lo, self._sum_prec)
+            return (qh, ql), ok
         return states["sum"] / jnp.where(ok, n, 1).astype(jnp.float64), ok
 
 

@@ -12,25 +12,48 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from ..columnar import decimal128 as d128
 from ..columnar import dtypes as dt
+from ..columnar.decimal128 import Decimal128Column
 from ..columnar.vector import ColumnVector, ColumnarBatch
 from .core import Expression, Schema, make_result, merged_validity
 
 
 def _decimal_result(op: str, a: dt.DecimalType, b: dt.DecimalType) -> dt.DecimalType:
-    """Spark DecimalPrecision result types (capped at long-backed p=18)."""
-    p1, s1, p2, s2 = a.precision, a.scale, b.precision, b.scale
-    if op in ("add", "sub"):
-        scale = max(s1, s2)
-        prec = max(p1 - s1, p2 - s2) + scale + 1
-    elif op == "mul":
-        scale = s1 + s2
-        prec = p1 + p2 + 1
-    else:
-        raise TypeError(f"decimal {op} unsupported")
-    prec = min(prec, dt.DecimalType.MAX_LONG_PRECISION)
-    scale = min(scale, prec)
-    return dt.DecimalType(prec, scale)
+    """Spark DecimalPrecision result types (dtypes.decimal_result_type;
+    full decimal128 range, allowPrecisionLoss semantics)."""
+    return dt.decimal_result_type(op, a, b)
+
+
+def _is_narrow_fast(left, right, out_t: dt.DecimalType) -> bool:
+    """Both operands long-backed and the result fits long-backed: the
+    plain int64 lane path is exact (result precision accounts for the
+    carry / product width)."""
+    return (not isinstance(left, Decimal128Column)
+            and not isinstance(right, Decimal128Column)
+            and not out_t.is_wide)
+
+
+def _lift_rescaled(col, to_scale: int):
+    """(hi, lo, upscale_overflow) of a decimal column rescaled to
+    ``to_scale``. Scale reduction (result scale adjusted below an
+    operand scale by adjustPrecisionScale) rounds HALF_UP, matching the
+    implicit cast Spark inserts to the result type."""
+    hi, lo = d128.limbs_of(col)
+    k = to_scale - col.dtype.scale
+    if k == 0:
+        return hi, lo, jnp.zeros(hi.shape, jnp.bool_)
+    if k < 0:
+        hi, lo = d128.d128_div_pow10_half_up(hi, lo, -k)
+        return hi, lo, jnp.zeros(hi.shape, jnp.bool_)
+    hi, lo, ovf = d128.d128_mul_pow10(hi, lo, k)
+    return hi, lo, ovf
+
+
+def _finish_decimal(hi, lo, validity, ok, out_t: dt.DecimalType):
+    """Overflow->null (non-ANSI Spark) + precision bound check."""
+    ok = ok & d128.d128_fits_precision(hi, lo, out_t.precision)
+    return d128.build_decimal_column(hi, lo, validity & ok, out_t)
 
 
 class BinaryArithmetic(Expression):
@@ -51,15 +74,14 @@ class BinaryArithmetic(Expression):
     def _decimal_type(self, lt, rt) -> dt.DType:
         raise TypeError(f"{self.op_name} does not support decimals")
 
-    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+    def eval(self, batch: ColumnarBatch):
         left = self.children[0].eval(batch)
         right = self.children[1].eval(batch)
         out_t = self.data_type(batch.schema())
         validity = merged_validity(left, right)
-        if isinstance(out_t, dt.DecimalType):
-            data, validity = self._compute_decimal(
-                left, right, out_t, validity)
-            return make_result(data, validity, out_t)
+        if isinstance(out_t, dt.DecimalType) or \
+                isinstance(left.dtype, dt.DecimalType):
+            return self._eval_decimal(left, right, out_t, validity)
         phys = out_t.physical
         a = left.data.astype(phys)
         b = right.data.astype(phys)
@@ -69,7 +91,7 @@ class BinaryArithmetic(Expression):
     def _compute(self, a, b, validity, out_t):
         raise NotImplementedError
 
-    def _compute_decimal(self, left, right, out_t, validity):
+    def _eval_decimal(self, left, right, out_t, validity):
         raise TypeError(f"{self.op_name} does not support decimals")
 
 
@@ -81,7 +103,28 @@ def _rescale(data, from_scale: int, to_scale: int):
     return data
 
 
-class Add(BinaryArithmetic):
+class _AddSubBase(BinaryArithmetic):
+    _sub = False
+
+    def _eval_decimal(self, left, right, out_t, validity):
+        if _is_narrow_fast(left, right, out_t):
+            a = _rescale(left.data, left.dtype.scale, out_t.scale)
+            b = _rescale(right.data, right.dtype.scale, out_t.scale)
+            data = a - b if self._sub else a + b
+            return make_result(data, validity, out_t)
+        ah, al, o1 = _lift_rescaled(left, out_t.scale)
+        bh, bl, o2 = _lift_rescaled(right, out_t.scale)
+        if self._sub:
+            rh, rl = d128.d128_sub(ah, al, bh, bl)
+        else:
+            rh, rl = d128.d128_add(ah, al, bh, bl)
+        # a 128-bit wrap on the add itself always lands outside the
+        # precision bound (|a|,|b| < 10^38 and 2*10^38 - 2^128 < -10^38),
+        # so the fits check catches it.
+        return _finish_decimal(rh, rl, validity, ~(o1 | o2), out_t)
+
+
+class Add(_AddSubBase):
     op_name = "+"
 
     def _compute(self, a, b, validity, out_t):
@@ -90,25 +133,16 @@ class Add(BinaryArithmetic):
     def _decimal_type(self, lt, rt):
         return _decimal_result("add", lt, rt)
 
-    def _compute_decimal(self, left, right, out_t, validity):
-        a = _rescale(left.data, left.dtype.scale, out_t.scale)
-        b = _rescale(right.data, right.dtype.scale, out_t.scale)
-        return a + b, validity
 
-
-class Subtract(BinaryArithmetic):
+class Subtract(_AddSubBase):
     op_name = "-"
+    _sub = True
 
     def _compute(self, a, b, validity, out_t):
         return a - b, validity
 
     def _decimal_type(self, lt, rt):
         return _decimal_result("sub", lt, rt)
-
-    def _compute_decimal(self, left, right, out_t, validity):
-        a = _rescale(left.data, left.dtype.scale, out_t.scale)
-        b = _rescale(right.data, right.dtype.scale, out_t.scale)
-        return a - b, validity
 
 
 class Multiply(BinaryArithmetic):
@@ -120,39 +154,67 @@ class Multiply(BinaryArithmetic):
     def _decimal_type(self, lt, rt):
         return _decimal_result("mul", lt, rt)
 
-    def _compute_decimal(self, left, right, out_t, validity):
-        raw = left.data * right.data  # scale s1+s2
+    def _eval_decimal(self, left, right, out_t, validity):
         raw_scale = left.dtype.scale + right.dtype.scale
-        return _rescale(raw, raw_scale, out_t.scale), validity
+        if _is_narrow_fast(left, right, out_t) and raw_scale == out_t.scale:
+            # p1+p2+1 <= 18 so the int64 product cannot overflow
+            return make_result(left.data * right.data, validity, out_t)
+        ah, al = d128.limbs_of(left)
+        bh, bl = d128.limbs_of(right)
+        rh, rl, ovf = d128.d128_mul_exact(ah, al, bh, bl,
+                                          raw_scale - out_t.scale)
+        return _finish_decimal(rh, rl, validity, ~ovf, out_t)
 
 
 class Divide(BinaryArithmetic):
-    """Spark Divide: non-decimal result is always double; x/0 -> null."""
+    """Spark Divide: non-decimal result is always double, decimal /
+    decimal is exact decimal division (HALF_UP at the result scale);
+    x/0 -> null in either mode."""
 
     op_name = "/"
 
     def _result_type(self, lt, rt):
         return dt.FLOAT64
 
-    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+    def eval(self, batch: ColumnarBatch):
         left = self.children[0].eval(batch)
         right = self.children[1].eval(batch)
+        out_t = self.data_type(batch.schema())
         validity = merged_validity(left, right)
+        if isinstance(out_t, dt.DecimalType):
+            return self._eval_decimal(left, right, out_t, validity)
         a = left.data.astype(jnp.float64)
         b = right.data.astype(jnp.float64)
-        if isinstance(left.dtype, dt.DecimalType):
-            a = a / (10.0 ** left.dtype.scale)
-        if isinstance(right.dtype, dt.DecimalType):
-            b = b / (10.0 ** right.dtype.scale)
         validity = validity & (b != 0.0)
         data = jnp.where(b != 0.0, a / jnp.where(b == 0.0, 1.0, b), 0.0)
         return make_result(data, validity, dt.FLOAT64)
 
     def _decimal_type(self, lt, rt):
-        # Simplified: decimal division flows through double (cast back if
-        # a decimal result is required). Full decimal division lands with
-        # the decimal128 work.
-        return dt.FLOAT64
+        return _decimal_result("div", lt, rt)
+
+    def _eval_decimal(self, left, right, out_t, validity):
+        lt, rt = left.dtype, right.dtype
+        ah, al = d128.limbs_of(left)
+        bh, bl = d128.limbs_of(right)
+        nonzero = (bh != 0) | (bl != 0)
+        validity = validity & nonzero
+        safe_bl = jnp.where(nonzero, bl, jnp.uint64(1))
+        up = out_t.scale - lt.scale + rt.scale
+        rh, rl, ovf = d128.d128_div_exact(ah, al, bh, safe_bl, up)
+        return _finish_decimal(rh, rl, validity, ~ovf, out_t)
+
+
+def _decimal_divmod_aligned(left, right, validity):
+    """Common-scale 128-bit truncating divmod for long-backed decimal
+    operands (alignment cannot overflow: |v| < 10^18 * 10^18 < 2^127).
+    Returns (qh, ql, rh, rl, bh, bl, validity&nonzero, scale)."""
+    s = max(left.dtype.scale, right.dtype.scale)
+    ah, al, _ = _lift_rescaled(left, s)
+    bh, bl, _ = _lift_rescaled(right, s)
+    nonzero = (bh != 0) | (bl != 0)
+    safe_bl = jnp.where(nonzero, bl, jnp.uint64(1))
+    qh, ql, rh, rl = d128.d128_div_trunc(ah, al, bh, safe_bl)
+    return qh, ql, rh, rl, bh, safe_bl, validity & nonzero, s
 
 
 class IntegralDivide(BinaryArithmetic):
@@ -162,6 +224,18 @@ class IntegralDivide(BinaryArithmetic):
 
     def _result_type(self, lt, rt):
         return dt.INT64
+
+    def _decimal_type(self, lt, rt):
+        # wide operands are excluded at tagging (plan/overrides.py sig)
+        return dt.INT64
+
+    def _eval_decimal(self, left, right, out_t, validity):
+        qh, ql, _, _, _, _, validity, _ = _decimal_divmod_aligned(
+            left, right, validity)
+        # quotient must fit a long; out-of-range -> null (non-ANSI)
+        fits = (qh == jnp.where(ql.astype(jnp.int64) < 0, jnp.int64(-1),
+                                jnp.int64(0)))
+        return make_result(ql.astype(jnp.int64), validity & fits, dt.INT64)
 
     def _compute(self, a, b, validity, out_t):
         zero = b == 0
@@ -193,6 +267,18 @@ class Remainder(BinaryArithmetic):
 
     op_name = "%"
 
+    def _decimal_type(self, lt, rt):
+        # wide operands are excluded at tagging (plan/overrides.py sig)
+        return _decimal_result("mod", lt, rt)
+
+    def _eval_decimal(self, left, right, out_t, validity):
+        _, _, rh, rl, _, _, validity, s = _decimal_divmod_aligned(
+            left, right, validity)
+        if out_t.scale != s:  # mod result scale is max(s1,s2) pre-adjust
+            rh, rl = d128.d128_div_pow10_half_up(rh, rl, s - out_t.scale)
+        return _finish_decimal(rh, rl, validity,
+                               jnp.ones(rh.shape, jnp.bool_), out_t)
+
     def _compute(self, a, b, validity, out_t):
         if jnp.issubdtype(a.dtype, jnp.floating):
             zero = b == 0.0
@@ -209,6 +295,23 @@ class Pmod(BinaryArithmetic):
     """pmod(a, b): positive modulus."""
 
     op_name = "pmod"
+
+    def _decimal_type(self, lt, rt):
+        # wide operands are excluded at tagging (plan/overrides.py sig)
+        return _decimal_result("mod", lt, rt)
+
+    def _eval_decimal(self, left, right, out_t, validity):
+        _, _, rh, rl, bh, bl, validity, s = _decimal_divmod_aligned(
+            left, right, validity)
+        abh, abl = d128.d128_abs(bh, bl)
+        ph, pl = d128.d128_add(rh, rl, abh, abl)
+        neg = rh < 0
+        rh = jnp.where(neg, ph, rh)
+        rl = jnp.where(neg, pl, rl)
+        if out_t.scale != s:
+            rh, rl = d128.d128_div_pow10_half_up(rh, rl, s - out_t.scale)
+        return _finish_decimal(rh, rl, validity,
+                               jnp.ones(rh.shape, jnp.bool_), out_t)
 
     def _compute(self, a, b, validity, out_t):
         zero = b == 0
@@ -229,6 +332,9 @@ class UnaryMinus(Expression):
 
     def eval(self, batch: ColumnarBatch) -> ColumnVector:
         c = self.children[0].eval(batch)
+        if isinstance(c, Decimal128Column):
+            nh, nl = d128.d128_neg(c.hi, c.lo)
+            return d128.build_decimal_column(nh, nl, c.validity, c.dtype)
         return make_result(-c.data, c.validity, c.dtype)
 
 
@@ -246,6 +352,9 @@ class Abs(Expression):
 
     def eval(self, batch: ColumnarBatch) -> ColumnVector:
         c = self.children[0].eval(batch)
+        if isinstance(c, Decimal128Column):
+            ah, al = d128.d128_abs(c.hi, c.lo)
+            return d128.build_decimal_column(ah, al, c.validity, c.dtype)
         return make_result(jnp.abs(c.data), c.validity, c.dtype)
 
 

@@ -40,6 +40,14 @@ class Cast(Expression):
         to = self.to
         if src == to:
             return
+
+        def wide(t):
+            return isinstance(t, dt.DecimalType) and t.is_wide
+        # decimal128 <-> string needs device 128-bit formatting/parsing;
+        # CPU fallback (GpuCast.scala keeps these on specialized kernels)
+        if (wide(src) and isinstance(to, dt.StringType)) or \
+                (isinstance(src, dt.StringType) and wide(to)):
+            raise TypeError(f"cast {src} -> {to} falls back to CPU")
         numericish = lambda t: (t.is_numeric or isinstance(t, (dt.BooleanType,))
                                 or isinstance(t, dt.DecimalType))
         if numericish(src) and numericish(to):
@@ -64,6 +72,8 @@ class Cast(Expression):
 
 
 def cast_column(c: Column, to: dt.DType) -> Column:
+    from ..columnar import decimal128 as d128
+    from ..columnar.decimal128 import Decimal128Column
     src = c.dtype
     if src == to:
         return c
@@ -76,34 +86,48 @@ def cast_column(c: Column, to: dt.DType) -> Column:
         from . import strings
         return strings.cast_to_string(c)
 
-    data, validity = c.data, c.validity
+    validity = c.validity
 
     # unwrap decimal source to a scaled representation first
     if isinstance(src, dt.DecimalType):
         if isinstance(to, dt.DecimalType):
             return _rescale_decimal(c, to)
+        hi, lo = d128.limbs_of(c)
         if to.is_floating:
-            out = data.astype(jnp.float64) / (10.0 ** src.scale)
+            out = d128.d128_to_f64(hi, lo) / (10.0 ** src.scale)
             return make_result(out.astype(to.physical), validity, to)
         if to.is_integral:
-            out = data // (10 ** src.scale)  # truncation toward -inf on positive scales
-            neg_fix = (data < 0) & (data % (10 ** src.scale) != 0)
-            out = out + neg_fix.astype(out.dtype)  # truncate toward zero
-            return _narrow_int(out, validity, to)
+            # truncate toward zero, then bound-check the target width
+            # (out-of-range -> null, GpuCast non-ANSI behavior)
+            th, tl = d128.d128_div_pow10_trunc(hi, lo, src.scale)
+            v = tl.astype(jnp.int64)
+            in64 = th == jnp.where(v < 0, jnp.int64(-1), jnp.int64(0))
+            lo_b = int(dt.min_value(to))
+            hi_b = int(dt.max_value(to))
+            in_range = in64 & (v >= lo_b) & (v <= hi_b)
+            return make_result(v.astype(to.physical), validity & in_range, to)
         if isinstance(to, dt.BooleanType):
-            return make_result(data != 0, validity, to)
+            return make_result((hi != 0) | (lo != 0), validity, to)
         raise TypeError(f"cast {src} -> {to}")
+
+    data = c.data
 
     if isinstance(to, dt.DecimalType):
         if src.is_integral or isinstance(src, dt.BooleanType):
-            unscaled = data.astype(jnp.int64) * (10 ** to.scale)
-            ok = _fits_precision(unscaled, to)
-            return make_result(unscaled, validity & ok, to)
+            hi, lo = d128.d128_from_i64(data.astype(jnp.int64))
+            hi, lo, ovf = d128.d128_mul_pow10(hi, lo, to.scale)
+            ok = ~ovf & d128.d128_fits_precision(hi, lo, to.precision)
+            return d128.build_decimal_column(hi, lo, validity & ok, to)
         if src.is_floating:
             scaled = data.astype(jnp.float64) * (10.0 ** to.scale)
-            rounded = jnp.sign(scaled) * jnp.floor(jnp.abs(scaled) + 0.5)
-            ok = jnp.isfinite(scaled) & (jnp.abs(rounded) < 10.0 ** min(to.precision, 18))
-            unscaled = jnp.where(ok, rounded, 0.0).astype(jnp.int64)
+            ok = jnp.isfinite(scaled) & \
+                (jnp.abs(scaled) < 10.0 ** to.precision)
+            safe = jnp.where(ok, scaled, 0.0)
+            if to.is_wide:
+                hi, lo = d128.f64_to_d128(safe)
+                return d128.build_decimal_column(hi, lo, validity & ok, to)
+            rounded = jnp.sign(safe) * jnp.floor(jnp.abs(safe) + 0.5)
+            unscaled = rounded.astype(jnp.int64)
             return make_result(unscaled, validity & ok, to)
         raise TypeError(f"cast {src} -> {to}")
 
@@ -153,14 +177,27 @@ def _fits_precision(unscaled, to: dt.DecimalType):
     return jnp.abs(unscaled) < bound
 
 
-def _rescale_decimal(c: ColumnVector, to: dt.DecimalType) -> ColumnVector:
+def _rescale_decimal(c, to: dt.DecimalType):
+    """decimal(p1,s1) -> decimal(p2,s2): rescale (HALF_UP on scale
+    reduction) + null on precision overflow, across any mix of
+    long-backed and decimal128 operand/result widths."""
+    from ..columnar import decimal128 as d128
+    from ..columnar.decimal128 import Decimal128Column
     src: dt.DecimalType = c.dtype  # type: ignore[assignment]
-    data = c.data
-    if to.scale > src.scale:
-        data = data * (10 ** (to.scale - src.scale))
-    elif to.scale < src.scale:
-        p = 10 ** (src.scale - to.scale)
-        half = p // 2
-        data = jnp.sign(data) * ((jnp.abs(data) + half) // p)  # HALF_UP
-    ok = _fits_precision(data, to)
-    return make_result(data, c.validity & ok, to)
+    upscale_safe = (to.scale <= src.scale or
+                    src.precision + (to.scale - src.scale) <= 18)
+    if not isinstance(c, Decimal128Column) and not to.is_wide and \
+            upscale_safe:
+        data = c.data
+        if to.scale > src.scale:
+            data = data * (10 ** (to.scale - src.scale))
+        elif to.scale < src.scale:
+            p = 10 ** (src.scale - to.scale)
+            half = p // 2
+            data = jnp.sign(data) * ((jnp.abs(data) + half) // p)  # HALF_UP
+        ok = _fits_precision(data, to)
+        return make_result(data, c.validity & ok, to)
+    hi, lo = d128.limbs_of(c)
+    hi, lo, ovf = d128.d128_rescale(hi, lo, src.scale, to.scale)
+    ok = ~ovf & d128.d128_fits_precision(hi, lo, to.precision)
+    return d128.build_decimal_column(hi, lo, c.validity & ok, to)

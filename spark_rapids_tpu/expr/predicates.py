@@ -49,6 +49,40 @@ def string_lt(a: StringColumn, b: StringColumn):
     return jnp.where(any_diff, a_byte < b_byte, False)
 
 
+def _wide_cmp_lanes(left, right):
+    """(lt, eq) lane pairs for comparisons involving a decimal128
+    column: both sides lifted to limbs at the common scale. Lanes whose
+    scale-up overflows 128 bits compare via the float64 approximation
+    instead (only reachable at extreme scale gaps)."""
+    from ..columnar import decimal128 as d128
+    ls = left.dtype.scale if isinstance(left.dtype, dt.DecimalType) else 0
+    rs = right.dtype.scale if isinstance(right.dtype, dt.DecimalType) else 0
+    s = max(ls, rs)
+
+    def lift(col, scale):
+        if isinstance(col.dtype, dt.DecimalType):
+            hi, lo = d128.limbs_of(col)
+        else:
+            hi, lo = d128.d128_from_i64(col.data.astype(jnp.int64))
+        approx = d128.d128_to_f64(hi, lo) / (10.0 ** scale)
+        hi, lo, ovf = d128.d128_mul_pow10(hi, lo, s - scale)
+        return hi, lo, ovf, approx
+
+    ah, al, o1, fa = lift(left, ls)
+    bh, bl, o2, fb = lift(right, rs)
+    any_ovf = o1 | o2
+    lt_exact = d128.d128_lt(ah, al, bh, bl)
+    eq_exact = d128.d128_eq(ah, al, bh, bl)
+    lt = jnp.where(any_ovf, fa < fb, lt_exact)
+    eq = jnp.where(any_ovf, fa == fb, eq_exact)
+    return lt, eq
+
+
+def _is_wide_col(col) -> bool:
+    from ..columnar.decimal128 import Decimal128Column
+    return isinstance(col, Decimal128Column)
+
+
 class BinaryComparison(Expression):
     def data_type(self, schema: Schema) -> dt.DType:
         return dt.BOOL
@@ -59,10 +93,28 @@ class BinaryComparison(Expression):
         validity = merged_validity(left, right)
         if isinstance(left, StringColumn) or isinstance(right, StringColumn):
             data = self._compare_strings(left, right)
+        elif _is_wide_col(left) or _is_wide_col(right):
+            other = right if _is_wide_col(left) else left
+            if not isinstance(other.dtype, dt.DecimalType) and \
+                    other.dtype.is_floating:
+                from ..columnar import decimal128 as d128
+
+                def as_f64(c):
+                    if _is_wide_col(c):
+                        return d128.d128_to_f64(c.hi, c.lo) / \
+                            (10.0 ** c.dtype.scale)
+                    return c.data.astype(jnp.float64)
+                data = self._compare(as_f64(left), as_f64(right))
+            else:
+                lt, eq = _wide_cmp_lanes(left, right)
+                data = self._compare128(lt, eq)
         else:
             a, b = self._aligned(left, right)
             data = self._compare(a, b)
         return make_result(data, validity, dt.BOOL)
+
+    def _compare128(self, lt, eq):
+        raise NotImplementedError
 
     @staticmethod
     def _aligned(left, right):
@@ -116,6 +168,9 @@ class EqualTo(BinaryComparison):
     def _compare(self, a, b):
         return _nan_safe_eq(a, b)
 
+    def _compare128(self, lt, eq):
+        return eq
+
     def _compare_strings(self, a, b):
         return string_eq(a, b)
 
@@ -123,6 +178,9 @@ class EqualTo(BinaryComparison):
 class LessThan(BinaryComparison):
     def _compare(self, a, b):
         return _nan_safe_lt(a, b)
+
+    def _compare128(self, lt, eq):
+        return lt
 
     def _compare_strings(self, a, b):
         return string_lt(a, b)
@@ -132,6 +190,10 @@ class GreaterThan(BinaryComparison):
     def _compare(self, a, b):
         return _nan_safe_lt(b, a)
 
+    def _compare128(self, lt, eq):
+        import jax.numpy as jnp
+        return ~lt & ~eq
+
     def _compare_strings(self, a, b):
         return string_lt(b, a)
 
@@ -140,6 +202,9 @@ class LessThanOrEqual(BinaryComparison):
     def _compare(self, a, b):
         return ~_nan_safe_lt(b, a)
 
+    def _compare128(self, lt, eq):
+        return lt | eq
+
     def _compare_strings(self, a, b):
         return ~string_lt(b, a)
 
@@ -147,6 +212,9 @@ class LessThanOrEqual(BinaryComparison):
 class GreaterThanOrEqual(BinaryComparison):
     def _compare(self, a, b):
         return ~_nan_safe_lt(a, b)
+
+    def _compare128(self, lt, eq):
+        return ~lt
 
     def _compare_strings(self, a, b):
         return ~string_lt(a, b)
@@ -168,6 +236,20 @@ class EqualNullSafe(Expression):
         both_valid = left.validity & right.validity
         if isinstance(left, StringColumn):
             eq = string_eq(left, right)
+        elif _is_wide_col(left) or _is_wide_col(right):
+            other = right if _is_wide_col(left) else left
+            if not isinstance(other.dtype, dt.DecimalType) and \
+                    other.dtype.is_floating:
+                from ..columnar import decimal128 as d128
+
+                def as_f64(c):
+                    if _is_wide_col(c):
+                        return d128.d128_to_f64(c.hi, c.lo) / \
+                            (10.0 ** c.dtype.scale)
+                    return c.data.astype(jnp.float64)
+                eq = _nan_safe_eq(as_f64(left), as_f64(right))
+            else:
+                _, eq = _wide_cmp_lanes(left, right)
         else:
             eq = _nan_safe_eq(left.data, right.data)
         data = both_null | (both_valid & eq)

@@ -4,7 +4,8 @@ The boundary between pyarrow's decoded buffers and the framework's
 columnar model (the role cudf-java's Table.readParquet return plays in
 the reference): every Arrow type maps to the same physical lanes the
 device uses (date32 -> int32 days, timestamp -> int64 micros UTC,
-decimal128(p<=18) -> scaled int64, strings -> object array).
+decimal128(p<=18) -> scaled int64, wider decimals -> python-int
+object lanes, strings -> object array).
 """
 
 from __future__ import annotations
@@ -40,9 +41,6 @@ def arrow_type_to_dtype(t: pa.DataType) -> dt.DType:
     if pa.types.is_timestamp(t):
         return dt.TIMESTAMP
     if pa.types.is_decimal(t):
-        if t.precision > 18:
-            raise TypeError(
-                f"decimal precision {t.precision} > 18 not supported yet")
         return dt.DecimalType(t.precision, t.scale)
     if pa.types.is_list(t) or pa.types.is_large_list(t):
         return dt.ArrayType(arrow_type_to_dtype(t.value_type))
@@ -114,11 +112,15 @@ def _chunked_to_column(arr: pa.ChunkedArray) -> HostColumn:
                          for v in arr.to_pylist()], dtype=object)
         return HostColumn(vals, mask, out_t)
     if isinstance(out_t, dt.DecimalType):
-        # unscaled int64 lanes
-        ints = pa.compute.cast(arr, pa.decimal128(38, out_t.scale))
-        vals = np.array([0 if v is None else int(v.scaleb(out_t.scale)
-                                                 .to_integral_value())
-                         for v in ints.to_pylist()], dtype=np.int64)
+        # unscaled lanes: int64 for long-backed, python ints (object)
+        # for decimal128 — matching host_table.py's encodings
+        raw = [0 if v is None else
+               int(v.scaleb(out_t.scale).to_integral_value())
+               for v in arr.to_pylist()]
+        if out_t.is_wide:
+            vals = np.array(raw, dtype=object)
+        else:
+            vals = np.array(raw, dtype=np.int64)
         return HostColumn(vals, mask, out_t)
     if out_t == dt.DATE:
         vals = np.asarray(pa.compute.cast(arr, pa.int32())

@@ -397,6 +397,23 @@ def concat_columns(cols: Sequence[Column], caps: Sequence[int], counts,
     """Concatenate the live prefixes of columns into one column."""
     if isinstance(cols[0], StringColumn):
         return _concat_strings(cols, caps, counts, out_capacity)
+    from ..columnar.decimal128 import Decimal128Column
+    if isinstance(cols[0], Decimal128Column):
+        hi = jnp.zeros(out_capacity, jnp.int64)
+        lo = jnp.zeros(out_capacity, jnp.uint64)
+        validity = jnp.zeros(out_capacity, jnp.bool_)
+        offset = jnp.int32(0)
+        for c, cap, n in zip(cols, caps, counts):
+            idx = jnp.arange(out_capacity, dtype=jnp.int32) - offset
+            in_range = (idx >= 0) & (idx < n)
+            take = jnp.clip(idx, 0, cap - 1)
+            hi = jnp.where(in_range, jnp.take(c.hi, take), hi)
+            lo = jnp.where(in_range, jnp.take(c.lo, take), lo)
+            validity = jnp.where(in_range, jnp.take(c.validity, take),
+                                 validity)
+            offset = offset + (n.astype(jnp.int32)
+                               if hasattr(n, "astype") else n)
+        return Decimal128Column(hi, lo, validity, cols[0].dtype)
     phys = cols[0].data.dtype
     data = jnp.zeros(out_capacity, phys)
     validity = jnp.zeros(out_capacity, jnp.bool_)

@@ -170,10 +170,19 @@ def partition_batch(batch: ColumnarBatch, part_ids: jnp.ndarray,
             pb = jnp.where(valid[:, :, None], pb, jnp.zeros((), jnp.uint8))
             cols_out.append((pb, pl, pv))
         else:
-            data = jnp.take(c.data, row)
-            data = jnp.where(valid, data, jnp.zeros((), data.dtype))
-            v = valid & jnp.take(c.validity, row)
-            cols_out.append((data, v))
+            from ..columnar.decimal128 import Decimal128Column
+            if isinstance(c, Decimal128Column):
+                hi = jnp.where(valid, jnp.take(c.hi, row),
+                               jnp.zeros((), jnp.int64))
+                lo = jnp.where(valid, jnp.take(c.lo, row),
+                               jnp.zeros((), jnp.uint64))
+                v = valid & jnp.take(c.validity, row)
+                cols_out.append((hi, lo, v))
+            else:
+                data = jnp.take(c.data, row)
+                data = jnp.where(valid, data, jnp.zeros((), data.dtype))
+                v = valid & jnp.take(c.validity, row)
+                cols_out.append((data, v))
     return PartitionedBatch(cols_out, batch.names,
                             [c.dtype for c in batch.columns],
                             jnp.minimum(counts, S), S)
@@ -232,6 +241,16 @@ def flatten_partitions(pb: PartitionedBatch,
             flat_l = jnp.where(keep, flat_l, 0)
             flat_v = flat_v & keep
             cols.append(string_from_padded(flat_b, flat_l, flat_v))
+        elif isinstance(dtype, dt.DecimalType) and dtype.is_wide:
+            from ..columnar.decimal128 import Decimal128Column
+            hi, lo, valid = spec
+            h = jnp.take(hi.reshape(cap), order)
+            l = jnp.take(lo.reshape(cap), order)
+            v = jnp.take(valid.reshape(cap), order) & \
+                jnp.take(slot_valid, order)
+            h = jnp.where(v, h, jnp.zeros((), jnp.int64))
+            l = jnp.where(v, l, jnp.zeros((), jnp.uint64))
+            cols.append(Decimal128Column(h, l, v, dtype))
         else:
             data, valid = spec
             d = jnp.take(data.reshape(cap), order)

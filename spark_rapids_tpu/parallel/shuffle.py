@@ -135,8 +135,17 @@ def all_gather_batch(batch: ColumnarBatch, num_shards: int,
             cols.append(string_from_padded(padded, lens, valid,
                                            char_capacity=n * c.char_capacity))
         else:
-            data = jnp.take(ag(c.data), order)
+            from ..columnar.decimal128 import Decimal128Column
             valid = keep & jnp.take(ag(c.validity), order)
+            if isinstance(c, Decimal128Column):
+                hi = jnp.take(ag(c.hi), order)
+                lo = jnp.take(ag(c.lo), order)
+                cols.append(Decimal128Column(
+                    jnp.where(valid, hi, jnp.zeros((), jnp.int64)),
+                    jnp.where(valid, lo, jnp.zeros((), jnp.uint64)),
+                    valid, c.dtype))
+                continue
+            data = jnp.take(ag(c.data), order)
             cols.append(ColumnVector(
                 jnp.where(valid, data, jnp.zeros((), data.dtype)),
                 valid, c.dtype))

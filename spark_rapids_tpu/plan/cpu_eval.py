@@ -97,6 +97,8 @@ def _literal(expr, table):
         return np.full(n, str(expr.value), dtype=object), np.ones(n, bool)
     from ..columnar.vector import _to_physical
     v = _to_physical(expr.value, t)
+    if isinstance(t, dt.DecimalType) and t.is_wide:
+        return np.array([v] * n, dtype=object), np.ones(n, bool)
     return (np.full(n, v, dtype=np.dtype(t.physical)), np.ones(n, bool))
 
 
@@ -112,6 +114,56 @@ def _rescale_np(data, from_scale: int, to_scale: int):
     return data
 
 
+def _obj_ints(a) -> np.ndarray:
+    """Lanes as python ints (exact, arbitrary precision)."""
+    if a.dtype == object:
+        return a
+    return np.array([int(x) for x in a], dtype=object)
+
+
+def _half_up_obj(vals, k: int):
+    """vals / 10^k with HALF_UP on python-int lanes."""
+    if k <= 0:
+        return vals
+    p = 10 ** k
+    half = p // 2
+    return np.array([(abs(int(v)) + half) // p * (1 if v >= 0 else -1)
+                     for v in vals], dtype=object)
+
+
+_I128_MAX = 2 ** 127  # device two-limb intermediate bound
+
+
+def _decimal_arith_obj(a, b, mask, op, lt, rt, out_t):
+    """Exact decimal arithmetic on python-int lanes, mirroring the
+    device decimal128 path including its overflow->null behavior: the
+    result nulls when it exceeds 10^precision, and (add/sub only) when a
+    scale-aligned operand exceeds the 128-bit intermediate range."""
+    a = _obj_ints(a)
+    b = _obj_ints(b)
+    if op in ("add", "sub"):
+        def align(v, fs):
+            if out_t.scale >= fs:
+                return v * 10 ** (out_t.scale - fs)
+            return _half_up_obj(v, fs - out_t.scale)
+        a2 = align(a, lt.scale)
+        b2 = align(b, rt.scale)
+        inter_ok = np.array([abs(int(x)) < _I128_MAX for x in a2], bool) & \
+            np.array([abs(int(x)) < _I128_MAX for x in b2], bool)
+        out = a2 - b2 if op == "sub" else a2 + b2
+        mask = mask & inter_ok
+    else:  # mul
+        raw = a * b
+        out = _half_up_obj(raw, lt.scale + rt.scale - out_t.scale)
+    bound = 10 ** out_t.precision
+    fits = np.array([abs(int(v)) < bound for v in out], bool)
+    mask = mask & fits
+    out = np.where(mask, out, 0)
+    if not out_t.is_wide:
+        out = np.array([int(v) for v in out], dtype=np.int64)
+    return out, mask
+
+
 def _binary_arith(expr, table, op):
     lt = expr.children[0].data_type(table.schema())
     rt = expr.children[1].data_type(table.schema())
@@ -120,6 +172,10 @@ def _binary_arith(expr, table, op):
     b, bm = _ev(expr.children[1], table)
     mask = am & bm
     if isinstance(out_t, dt.DecimalType):
+        wide = out_t.is_wide or lt.is_wide or rt.is_wide
+        if wide:
+            out, mask = _decimal_arith_obj(a, b, mask, op, lt, rt, out_t)
+            return out, mask
         a = _rescale_np(a.astype(np.int64), lt.scale, out_t.scale) \
             if op != "mul" else a.astype(np.int64)
         b = _rescale_np(b.astype(np.int64), rt.scale, out_t.scale) \
@@ -163,14 +219,37 @@ def _mul(e, t):
 def _div(expr, table):
     lt = expr.children[0].data_type(table.schema())
     rt = expr.children[1].data_type(table.schema())
+    out_t = expr.data_type(table.schema())
     a, am = _ev(expr.children[0], table)
     b, bm = _ev(expr.children[1], table)
+    if isinstance(out_t, dt.DecimalType):
+        # exact decimal division, HALF_UP at the result scale
+        a = _obj_ints(a)
+        b = _obj_ints(b)
+        mask = am & bm & np.array([int(x) != 0 for x in b], bool)
+        up = out_t.scale - lt.scale + rt.scale
+        bound = 10 ** out_t.precision
+        out = np.zeros(len(a), dtype=object)
+        for i in range(len(a)):
+            if not mask[i]:
+                out[i] = 0
+                continue
+            n = abs(int(a[i])) * 10 ** up
+            d = abs(int(b[i]))
+            q, r = divmod(n, d)
+            if 2 * r >= d:
+                q += 1
+            if (int(a[i]) < 0) != (int(b[i]) < 0):
+                q = -q
+            if abs(q) >= bound or abs(q) >= _I128_MAX:
+                mask[i] = False
+                q = 0
+            out[i] = q
+        if not out_t.is_wide:
+            out = np.array([int(v) for v in out], dtype=np.int64)
+        return out, mask
     a = a.astype(np.float64)
     b = b.astype(np.float64)
-    if isinstance(lt, dt.DecimalType):
-        a = a / (10.0 ** lt.scale)
-    if isinstance(rt, dt.DecimalType):
-        b = b / (10.0 ** rt.scale)
     mask = am & bm & (b != 0.0)
     with np.errstate(divide="ignore", invalid="ignore"):
         out = np.where(b != 0.0, a / np.where(b == 0.0, 1.0, b), 0.0)
@@ -190,8 +269,39 @@ def _trunc_mod_np(a, b):
     return r - np.where(adjust, b, np.zeros(1, b.dtype))
 
 
+def _decimal_divmod_obj(expr, table):
+    """Common-scale exact truncating divmod for decimal operands.
+    Returns (q, r, |b| at the common scale, mask, scale)."""
+    lt = expr.children[0].data_type(table.schema())
+    rt = expr.children[1].data_type(table.schema())
+    a, am = _ev(expr.children[0], table)
+    b, bm = _ev(expr.children[1], table)
+    s = max(lt.scale, rt.scale)
+    a = _obj_ints(a) * (10 ** (s - lt.scale))
+    b = _obj_ints(b) * (10 ** (s - rt.scale))
+    mask = am & bm & np.array([int(x) != 0 for x in b], bool)
+    n = len(a)
+    q = np.zeros(n, dtype=object)
+    r = np.zeros(n, dtype=object)
+    for i in range(n):
+        if not mask[i]:
+            continue
+        qq, rr = divmod(abs(int(a[i])), abs(int(b[i])))
+        q[i] = qq if (int(a[i]) < 0) == (int(b[i]) < 0) else -qq
+        r[i] = rr if int(a[i]) >= 0 else -rr
+    return q, r, np.array([abs(int(x)) for x in b], dtype=object), mask, s
+
+
 @_reg(A.IntegralDivide)
 def _idiv(expr, table):
+    lt = expr.children[0].data_type(table.schema())
+    if isinstance(lt, dt.DecimalType):
+        q, _, _, mask, _ = _decimal_divmod_obj(expr, table)
+        fits = np.array([-(2 ** 63) <= int(v) < 2 ** 63 for v in q], bool)
+        mask = mask & fits
+        out = np.array([int(v) if f else 0 for v, f in zip(q, fits)],
+                       dtype=np.int64)
+        return _zero_nulls(out, mask), mask
     a, am = _ev(expr.children[0], table)
     b, bm = _ev(expr.children[1], table)
     mask = am & bm & (b != 0)
@@ -203,9 +313,28 @@ def _idiv(expr, table):
     return _zero_nulls(q.astype(np.int64), mask), mask
 
 
+def _decimal_mod_result(expr, table, positive: bool):
+    out_t = expr.data_type(table.schema())
+    _, r, babs, mask, s = _decimal_divmod_obj(expr, table)
+    if positive:
+        r = np.array([int(v) + int(ab) if int(v) < 0 else int(v)
+                      for v, ab in zip(r, babs)], dtype=object)
+    if out_t.scale != s:
+        r = _half_up_obj(r, s - out_t.scale)
+    bound = 10 ** out_t.precision
+    fits = np.array([abs(int(v)) < bound for v in r], bool)
+    mask = mask & fits
+    r = np.where(mask, r, 0)
+    if not out_t.is_wide:
+        r = np.array([int(v) for v in r], dtype=np.int64)
+    return r, mask
+
+
 @_reg(A.Remainder)
 def _rem(expr, table):
     out_t = expr.data_type(table.schema())
+    if isinstance(out_t, dt.DecimalType):
+        return _decimal_mod_result(expr, table, positive=False)
     phys = np.dtype(out_t.physical)
     a, am = _ev(expr.children[0], table)
     b, bm = _ev(expr.children[1], table)
@@ -223,6 +352,8 @@ def _rem(expr, table):
 @_reg(A.Pmod)
 def _pmod(expr, table):
     out_t = expr.data_type(table.schema())
+    if isinstance(out_t, dt.DecimalType):
+        return _decimal_mod_result(expr, table, positive=True)
     phys = np.dtype(out_t.physical)
     a, am = _ev(expr.children[0], table)
     b, bm = _ev(expr.children[1], table)
@@ -297,9 +428,20 @@ def _aligned_np(expr, table):
     if l_dec or r_dec:
         lf = (not l_dec) and lt.is_floating
         rf = (not r_dec) and rt.is_floating
+        wide = (l_dec and lt.is_wide) or (r_dec and rt.is_wide)
         if lf or rf:
-            a = a.astype(np.float64) / (10.0 ** lt.scale if l_dec else 1.0)
-            b = b.astype(np.float64) / (10.0 ** rt.scale if r_dec else 1.0)
+            fa = np.array([float(x) for x in a]) if a.dtype == object \
+                else a.astype(np.float64)
+            fb = np.array([float(x) for x in b]) if b.dtype == object \
+                else b.astype(np.float64)
+            a = fa / (10.0 ** lt.scale if l_dec else 1.0)
+            b = fb / (10.0 ** rt.scale if r_dec else 1.0)
+        elif wide:
+            ls = lt.scale if l_dec else 0
+            rs = rt.scale if r_dec else 0
+            s = max(ls, rs)
+            a = _obj_ints(a) * (10 ** (s - ls))
+            b = _obj_ints(b) * (10 ** (s - rs))
         else:
             ls = lt.scale if l_dec else 0
             rs = rt.scale if r_dec else 0
@@ -472,6 +614,16 @@ def _coerce_to(values, mask, from_t, to_t, n):
     if to_t == dt.STRING or from_t == dt.STRING:
         return values, mask
     if isinstance(to_t, dt.DecimalType):
+        wide = to_t.is_wide or (isinstance(from_t, dt.DecimalType)
+                                and from_t.is_wide)
+        if wide:
+            v = _obj_ints(values)
+            fs = from_t.scale if isinstance(from_t, dt.DecimalType) else 0
+            if to_t.scale >= fs:
+                v = v * (10 ** (to_t.scale - fs))
+            else:
+                v = _half_up_obj(v, fs - to_t.scale)
+            return v, mask
         if isinstance(from_t, dt.DecimalType):
             return _rescale_np(values.astype(np.int64), from_t.scale,
                                to_t.scale), mask
@@ -486,6 +638,8 @@ def _select_eval(expr, table, branches, default):
     n = table.num_rows
     if out_t == dt.STRING:
         out = np.full(n, "", dtype=object)
+    elif isinstance(out_t, dt.DecimalType) and out_t.is_wide:
+        out = np.zeros(n, dtype=object)
     else:
         out = np.zeros(n, np.dtype(out_t.physical))
     out_mask = np.zeros(n, bool)
@@ -524,6 +678,8 @@ def _coalesce(expr, table):
     n = table.num_rows
     if out_t == dt.STRING:
         out = np.full(n, "", dtype=object)
+    elif isinstance(out_t, dt.DecimalType) and out_t.is_wide:
+        out = np.zeros(n, dtype=object)
     else:
         out = np.zeros(n, np.dtype(out_t.physical))
     out_mask = np.zeros(n, bool)
@@ -607,7 +763,10 @@ def _floor(expr, table):
     a, m = _ev(expr.children[0], table)
     t = expr.children[0].data_type(table.schema())
     if isinstance(t, dt.DecimalType):
-        out = a.astype(np.int64) // np.int64(10 ** t.scale)
+        if a.dtype == object:
+            out = np.array([int(v) // 10 ** t.scale for v in a], np.int64)
+        else:
+            out = a.astype(np.int64) // np.int64(10 ** t.scale)
         return _zero_nulls(out, m), m
     return _zero_nulls(np.floor(a.astype(np.float64)).astype(np.int64), m), m
 
@@ -617,7 +776,11 @@ def _ceil(expr, table):
     a, m = _ev(expr.children[0], table)
     t = expr.children[0].data_type(table.schema())
     if isinstance(t, dt.DecimalType):
-        out = -((-a.astype(np.int64)) // np.int64(10 ** t.scale))
+        if a.dtype == object:
+            out = np.array([-((-int(v)) // 10 ** t.scale) for v in a],
+                           np.int64)
+        else:
+            out = -((-a.astype(np.int64)) // np.int64(10 ** t.scale))
         return _zero_nulls(out, m), m
     return _zero_nulls(np.ceil(a.astype(np.float64)).astype(np.int64), m), m
 
@@ -666,6 +829,13 @@ def _round_common(expr, table, half_even: bool):
         drop = t.scale - target
         if drop <= 0:
             return a, m
+        if a.dtype == object:
+            pp = 10 ** drop
+            hf = pp // 2
+            out = np.array([(abs(int(v)) + hf) // pp *
+                            (1 if int(v) >= 0 else -1) for v in a],
+                           dtype=object)
+            return np.where(m, out, 0), m
         p = np.int64(10 ** drop)
         half = p // 2
         av = a.astype(np.int64)
@@ -1028,32 +1198,58 @@ def _cast(expr, table):
         for i in range(n):
             out[i] = _value_to_string(a[i], from_t) if m[i] else ""
         return out, m
-    # decimal source
+    # decimal source (exact python-int lanes; HALF_UP rescale, matching
+    # the device decimal128 path and GpuCast decimal semantics)
     if isinstance(from_t, dt.DecimalType):
-        real = a.astype(np.float64) / (10.0 ** from_t.scale)
+        av = _obj_ints(a)
         if isinstance(to_t, dt.DecimalType):
-            out = _rescale_np(a.astype(np.int64), from_t.scale, to_t.scale)
-            lim = np.int64(10 ** min(to_t.precision, 18))
-            ok = np.abs(out) < lim
+            if to_t.scale >= from_t.scale:
+                out = av * (10 ** (to_t.scale - from_t.scale))
+            else:
+                out = _half_up_obj(av, from_t.scale - to_t.scale)
+            bound = 10 ** to_t.precision
+            ok = np.array([abs(int(v)) < bound and abs(int(v)) < _I128_MAX
+                           for v in out], bool)
             m = m & ok
-            return _zero_nulls(out, m), m
+            out = np.where(m, out, 0)
+            if not to_t.is_wide:
+                out = np.array([int(v) for v in out], dtype=np.int64)
+            return out, m
         if to_t.is_floating:
+            real = np.array([float(int(v)) for v in av]) / \
+                (10.0 ** from_t.scale)
             return _zero_nulls(real.astype(np.dtype(to_t.physical)), m), m
-        return _zero_nulls(np.trunc(real).astype(np.dtype(to_t.physical)),
-                           m), m
+        if to_t == dt.BOOL:
+            return _zero_nulls(
+                np.array([int(v) != 0 for v in av], bool), m), m
+        # integral target: truncate toward zero, null outside the range
+        p = 10 ** from_t.scale
+        tv = np.array([abs(int(v)) // p * (1 if int(v) >= 0 else -1)
+                       for v in av], dtype=object)
+        lo_b, hi_b = int(dt.min_value(to_t)), int(dt.max_value(to_t))
+        ok = np.array([lo_b <= int(v) <= hi_b for v in tv], bool)
+        m = m & ok
+        out = np.array([int(v) if k else 0 for v, k in zip(tv, ok)],
+                       dtype=np.dtype(to_t.physical))
+        return out, m
     # numeric -> decimal
     if isinstance(to_t, dt.DecimalType):
+        bound = 10 ** to_t.precision
         if from_t.is_floating:
-            scaled = np.round(a.astype(np.float64) * 10 ** to_t.scale)
-            ok = np.isfinite(scaled) & (np.abs(scaled) < 10 ** min(
-                to_t.precision, 18))
+            scaled = a.astype(np.float64) * 10.0 ** to_t.scale
+            ok = np.isfinite(scaled) & (np.abs(scaled) < float(bound))
             m = m & ok
-            out = np.where(ok, scaled, 0).astype(np.int64)
-            return _zero_nulls(out, m), m
-        out = a.astype(np.int64) * np.int64(10 ** to_t.scale)
-        lim = np.int64(10 ** min(to_t.precision, 18))
-        ok = np.abs(out) < lim
-        m = m & ok
+            safe = np.where(ok, scaled, 0.0)
+            vals = [int(np.sign(x)) * int(np.floor(abs(x) + 0.5))
+                    for x in safe]
+        else:
+            vals = [int(x) * 10 ** to_t.scale for x in a]
+            ok = np.array([abs(v) < bound for v in vals], bool)
+            m = m & ok
+            vals = [v if k else 0 for v, k in zip(vals, ok)]
+        if to_t.is_wide:
+            return np.array(vals, dtype=object), m
+        out = np.array([int(v) for v in vals], dtype=np.int64)
         return _zero_nulls(out, m), m
     # timestamp <-> date
     if from_t == dt.TIMESTAMP and to_t == dt.DATE:

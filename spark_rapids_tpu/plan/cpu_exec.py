@@ -235,7 +235,9 @@ def _group_ids(key_cols: List[HostColumn], n: int):
     for i in range(n):
         k = tuple((None if not c.mask[i]
                    else (c.values[i] if c.dtype == dt.STRING
-                         else c.values[i].item()))
+                         else (c.values[i].item()
+                               if hasattr(c.values[i], "item")
+                               else c.values[i])))
                   for c in key_cols)
         g = seen.get(k)
         if g is None:
@@ -285,7 +287,12 @@ def _agg_cpu(fn: Agg.AggregateFunction, values: Optional[np.ndarray],
         return float(np.percentile(x, fn.percentage * 100)), True
     if isinstance(fn, Agg.Sum):
         if isinstance(out_t, dt.DecimalType):
-            return int(valid_v.astype(np.int64).sum()), True
+            # exact arbitrary-precision oracle; overflow -> null like
+            # the device 128-bit accumulator
+            total = sum(int(x) for x in valid_v)
+            if abs(total) >= 10 ** out_t.precision:
+                return 0, False
+            return total, True
         if out_t == dt.INT64:
             return int(valid_v.astype(np.int64).sum()), True
         return float(valid_v.astype(np.float64).sum()), True
@@ -304,9 +311,26 @@ def _agg_cpu(fn: Agg.AggregateFunction, values: Optional[np.ndarray],
                     True)
         return (x.max() if want_max else x.min()), True
     if isinstance(fn, Agg.Average):
-        x = valid_v.astype(np.float64)
         if isinstance(in_dtype, dt.DecimalType):
-            x = x / (10.0 ** in_dtype.scale)
+            # exact decimal average at the (possibly adjusted) result
+            # scale, HALF_UP; sum-buffer overflow -> null (the buffer is
+            # decimal(min(p+10,38)), like the device accumulator)
+            total = sum(int(x) for x in valid_v)
+            sum_prec = min(in_dtype.precision + 10,
+                           dt.DecimalType.MAX_PRECISION)
+            if abs(total) >= 10 ** sum_prec:
+                return 0, False
+            n_v = len(valid_v)
+            num = abs(total) * 10 ** (out_t.scale - in_dtype.scale)
+            q, r = divmod(num, n_v)
+            if 2 * r >= n_v:
+                q += 1
+            if total < 0:
+                q = -q
+            if abs(q) >= 10 ** out_t.precision:
+                return 0, False
+            return q, True
+        x = valid_v.astype(np.float64)
         return float(x.sum() / len(x)), True
     if isinstance(fn, Agg._M2Base):
         x = valid_v.astype(np.float64)
@@ -370,6 +394,9 @@ def _aggregate_table(table: HostTable, plan: Aggregate) -> HostTable:
             arr = np.empty(len(vals), dtype=object)
             for i, (v, ok) in enumerate(zip(vals, valids)):
                 arr[i] = v if ok else []
+        elif isinstance(out_t, dt.DecimalType) and out_t.is_wide:
+            arr = np.array([int(v) if ok else 0
+                            for v, ok in zip(vals, valids)], dtype=object)
         else:
             arr = np.array([v if ok else 0 for v, ok in zip(vals, valids)],
                            dtype=np.dtype(out_t.physical))

@@ -90,10 +90,14 @@ class HostTable:
         return f"HostTable[{cols}](n={self.num_rows})"
 
 
+def _wide_decimal(t) -> bool:
+    return isinstance(t, dt.DecimalType) and t.is_wide
+
+
 def empty_like(schema: Schema) -> HostTable:
     cols = []
     for _, t in schema:
-        if t == dt.STRING or t.is_nested:
+        if t == dt.STRING or t.is_nested or _wide_decimal(t):
             cols.append(HostColumn(np.empty(0, object), np.empty(0, bool), t))
         else:
             cols.append(HostColumn(np.empty(0, np.dtype(t.physical)),
@@ -128,6 +132,13 @@ def from_pydict(data: dict, schema: Schema) -> HostTable:
         elif t == dt.STRING:
             values = np.array([v if v is not None else "" for v in raw],
                               dtype=object)
+        elif _wide_decimal(t):
+            # decimal128 host lanes are python ints (exact, unbounded) —
+            # the oracle's arbitrary-precision mirror of the two-limb
+            # device encoding (columnar/decimal128.py)
+            values = np.array(
+                [_to_physical(v, t) if v is not None else 0 for v in raw],
+                dtype=object)
         else:
             phys = np.dtype(t.physical)
             values = np.array(
@@ -169,6 +180,11 @@ def table_to_batch(table: HostTable,
             cols.append(column_from_numpy(
                 np.asarray(c.values, dtype=object), cap,
                 dtype=dt.STRING, mask=c.mask))
+        elif _wide_decimal(c.dtype):
+            # host lanes are already unscaled ints: build limbs directly
+            from ..columnar.decimal128 import from_unscaled_ints
+            cols.append(from_unscaled_ints(list(c.values), cap, c.dtype,
+                                           mask=c.mask))
         else:
             cols.append(column_from_numpy(c.values, cap, dtype=c.dtype,
                                           mask=c.mask))

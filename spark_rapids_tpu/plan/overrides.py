@@ -98,8 +98,8 @@ def _expr(cls, sig: ts.TypeSig, extra=None):
 
 # --- expression rules ------------------------------------------------------
 
-_expr(E.ColumnRef, ts.all_basic)
-_expr(E.Alias, ts.all_basic + ts.TypeSig(ts.ARRAY, ts.STRUCT))
+_expr(E.ColumnRef, ts.all_basic_128)
+_expr(E.Alias, ts.all_basic_128 + ts.TypeSig(ts.ARRAY, ts.STRUCT))
 
 
 def device_type_ok(t: dt.DType) -> Optional[str]:
@@ -116,35 +116,36 @@ def device_type_ok(t: dt.DType) -> Optional[str]:
         return None
     if isinstance(t, dt.MapType):
         return f"type {t} not supported on TPU yet"
-    return ts.all_basic.reason_if_unsupported(t, "column")
+    return ts.all_basic_128.reason_if_unsupported(t, "column")
 
 
 def _tag_literal(meta: ExprMeta):
     t = meta.expr.data_type(meta.schema)
-    reason = ts.all_basic.reason_if_unsupported(t, "literal")
+    reason = ts.all_basic_128.reason_if_unsupported(t, "literal")
     if reason:
         meta.will_not_work_on_tpu(reason)
 
 
-_expr(E.Literal, ts.all_basic, _tag_literal)
+_expr(E.Literal, ts.all_basic_128, _tag_literal)
 
-for _cls in (A.Add, A.Subtract, A.Multiply):
-    _expr(_cls, ts.numeric)
-for _cls in (A.Divide, A.IntegralDivide, A.Remainder, A.Pmod):
+for _cls in (A.Add, A.Subtract, A.Multiply, A.Divide):
+    _expr(_cls, ts.numeric_all)
+# mod/div on decimal128 needs >128-bit scale alignment: CPU fallback
+for _cls in (A.IntegralDivide, A.Remainder, A.Pmod):
     _expr(_cls, ts.numeric)
 for _cls in (A.UnaryMinus, A.UnaryPositive, A.Abs):
-    _expr(_cls, ts.numeric)
+    _expr(_cls, ts.numeric_all)
 for _cls in (A.Least, A.Greatest):
     _expr(_cls, ts.numeric_no_decimal + ts.TypeSig(
         ts.DATE, ts.TIMESTAMP, ts.BOOLEAN))
 
 for _cls in (P.EqualTo, P.LessThan, P.GreaterThan, P.LessThanOrEqual,
              P.GreaterThanOrEqual, P.EqualNullSafe):
-    _expr(_cls, ts.comparable)
+    _expr(_cls, ts.comparable + ts.decimal128)
 for _cls in (P.And, P.Or, P.Not):
     _expr(_cls, ts.TypeSig(ts.BOOLEAN))
 for _cls in (P.IsNull, P.IsNotNull):
-    _expr(_cls, ts.all_basic)
+    _expr(_cls, ts.all_basic_128)
 _expr(P.IsNaN, ts.fp)
 _expr(P.InSet, ts.comparable)
 
@@ -160,7 +161,7 @@ def _tag_cast(meta: ExprMeta):
         meta.will_not_work_on_tpu(f"cast: {e}")
 
 
-_expr(C.Cast, ts.all_basic, _tag_cast)
+_expr(C.Cast, ts.all_basic_128, _tag_cast)
 
 for _cls in list(cpu_eval._MATH_FNS) + [M.Log, M.Log2, M.Log10, M.Floor,
                                         M.Ceil, M.Pow, M.Atan2, M.Hypot,
@@ -270,15 +271,23 @@ def _tag_explode(meta: ExprMeta):
 
 _expr(CX.Explode, _nested_ok, _tag_explode)
 
-for _cls in (Agg.Count, Agg.CountStar, Agg.First, Agg.Last):
+for _cls in (Agg.First, Agg.Last):
     _expr(_cls, ts.comparable)
-for _cls in (Agg.Sum, Agg.Average, Agg.VariancePop, Agg.VarianceSamp,
+for _cls in (Agg.Count, Agg.CountStar):
+    _expr(_cls, ts.comparable + ts.decimal128)
+# sum/avg on decimal128 run on the two-limb segmented accumulator
+# (expr/aggregates.py _Decimal128SumMixin); variance family stays
+# double-only like the reference's GpuM2
+for _cls in (Agg.Sum, Agg.Average):
+    _expr(_cls, ts.numeric_all)
+for _cls in (Agg.VariancePop, Agg.VarianceSamp,
              Agg.StddevPop, Agg.StddevSamp):
     _expr(_cls, ts.numeric)
 # min/max: the sort-based group kernel needs a physical extreme fill,
 # which strings don't have yet -> CPU fallback for string min/max
 for _cls in (Agg.Min, Agg.Max):
-    _expr(_cls, ts.numeric + ts.TypeSig(ts.BOOLEAN, ts.DATE, ts.TIMESTAMP))
+    _expr(_cls, ts.numeric_all + ts.TypeSig(ts.BOOLEAN, ts.DATE,
+                                            ts.TIMESTAMP))
 
 
 # --- exec rules ------------------------------------------------------------
@@ -304,6 +313,10 @@ def _tag_join(meta: PlanMeta):
             f"keyless {plan.join_type} join not supported on TPU yet")
 
 
+def _wide_decimal(t: dt.DType) -> bool:
+    return isinstance(t, dt.DecimalType) and t.is_wide
+
+
 def _tag_agg(meta: PlanMeta):
     plan: Aggregate = meta.plan
     in_schema = plan.children[0].schema
@@ -312,6 +325,10 @@ def _tag_agg(meta: PlanMeta):
         if t.is_nested:
             meta.will_not_work_on_tpu(
                 f"group-by key of type {t} not supported on TPU yet")
+        if _wide_decimal(t):
+            meta.will_not_work_on_tpu(
+                "group-by key of type decimal128 not supported on TPU "
+                "yet (two-limb sort keys)")
 
 
 def _tag_file_scan(meta: PlanMeta):
@@ -338,6 +355,18 @@ def _no_nested_inputs(what: str):
     return tag
 
 
+def _tag_sort(meta: PlanMeta):
+    _no_nested_inputs("sort")(meta)
+    plan = meta.plan
+    in_schema = plan.children[0].schema
+    for f in plan.order:
+        if _wide_decimal(f.expr.data_type(in_schema)):
+            meta.will_not_work_on_tpu(
+                "sort key of type decimal128 not supported on TPU yet "
+                "(two-limb sort keys)")
+            return
+
+
 def _tag_window(meta: PlanMeta):
     from ..expr.window import (Lag, Lead, DenseRank, NTile, PercentRank,
                                Rank, RowNumber)
@@ -345,17 +374,40 @@ def _tag_window(meta: PlanMeta):
     in_schema = plan.children[0].schema
     supported_rank = (RowNumber, Rank, DenseRank, PercentRank, NTile,
                       Lead, Lag)
+    spec0 = plan.window_exprs[0][0].spec if plan.window_exprs else None
+    if spec0 is not None:
+        key_exprs = list(spec0.partition_by) + \
+            [o.expr for o in spec0.order_fields]
+        for e in key_exprs:
+            if _wide_decimal(e.data_type(in_schema)):
+                meta.will_not_work_on_tpu(
+                    "window partition/order key of type decimal128 not "
+                    "supported on TPU yet")
+                return
     for we, name in plan.window_exprs:
         fn = we.func
         if isinstance(fn, supported_rank):
             continue
         if isinstance(fn, (Agg.Sum, Agg.Count, Agg.CountStar, Agg.Average)):
-            pass
+            out_t = fn.data_type(in_schema) \
+                if not isinstance(fn, Agg.CountStar) else dt.INT64
+            in_wide = any(_wide_decimal(c.data_type(in_schema))
+                          for c in fn.children)
+            if in_wide or _wide_decimal(out_t):
+                meta.will_not_work_on_tpu(
+                    f"window {name}: decimal128 aggregation windows "
+                    "not on TPU yet")
+                continue
         elif isinstance(fn, (Agg.Min, Agg.Max)):
-            if fn.children and fn.children[0].data_type(in_schema) == \
-                    dt.STRING:
+            t0 = fn.children[0].data_type(in_schema) if fn.children else None
+            if t0 == dt.STRING:
                 meta.will_not_work_on_tpu(
                     f"window {name}: string min/max not on TPU yet")
+                continue
+            if t0 is not None and _wide_decimal(t0):
+                meta.will_not_work_on_tpu(
+                    f"window {name}: decimal128 aggregation windows "
+                    "not on TPU yet")
                 continue
         else:
             meta.will_not_work_on_tpu(
@@ -377,6 +429,21 @@ def _tag_window(meta: PlanMeta):
 def _tag_join_all(meta: PlanMeta):
     _tag_join(meta)
     _no_nested_inputs("join")(meta)
+    plan: Join = meta.plan
+    lschema = plan.children[0].schema
+    rschema = plan.children[1].schema
+    for e in plan.left_keys:
+        if _wide_decimal(e.data_type(lschema)):
+            meta.will_not_work_on_tpu(
+                "join key of type decimal128 not supported on TPU yet "
+                "(two-limb hash keys)")
+            return
+    for e in plan.right_keys:
+        if _wide_decimal(e.data_type(rschema)):
+            meta.will_not_work_on_tpu(
+                "join key of type decimal128 not supported on TPU yet "
+                "(two-limb hash keys)")
+            return
 
 
 def _register_exec_rules():
@@ -392,7 +459,7 @@ def _register_exec_rules():
         Limit: ExecRule(Limit),
         Union: ExecRule(Union, _no_nested_inputs("union")),
         Expand: ExecRule(Expand, _no_nested_inputs("expand")),
-        Sort: ExecRule(Sort, _no_nested_inputs("sort")),
+        Sort: ExecRule(Sort, _tag_sort),
         Aggregate: ExecRule(Aggregate, _tag_agg),
         Join: ExecRule(Join, _tag_join_all),
         Window: ExecRule(Window, _tag_window),

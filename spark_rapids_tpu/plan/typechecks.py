@@ -25,13 +25,14 @@ STRING = "STRING"
 DATE = "DATE"
 TIMESTAMP = "TIMESTAMP"
 DECIMAL_64 = "DECIMAL_64"  # long-backed decimal, precision <= 18
+DECIMAL_128 = "DECIMAL_128"  # two-limb decimal, precision 19..38
 NULL = "NULL"
 ARRAY = "ARRAY"
 STRUCT = "STRUCT"
 MAP = "MAP"
 
 ALL_TAGS = [BOOLEAN, BYTE, SHORT, INT, LONG, FLOAT, DOUBLE, STRING, DATE,
-            TIMESTAMP, DECIMAL_64, NULL, ARRAY, STRUCT, MAP]
+            TIMESTAMP, DECIMAL_64, DECIMAL_128, NULL, ARRAY, STRUCT, MAP]
 
 
 def tag_of(t: dt.DType) -> str:
@@ -56,7 +57,7 @@ def tag_of(t: dt.DType) -> str:
     if isinstance(t, dt.TimestampType):
         return TIMESTAMP
     if isinstance(t, dt.DecimalType):
-        return DECIMAL_64
+        return DECIMAL_128 if t.precision > 18 else DECIMAL_64
     if isinstance(t, dt.NullType):
         return NULL
     if isinstance(t, dt.ArrayType):
@@ -90,9 +91,6 @@ class TypeSig:
     def reason_if_unsupported(self, t: dt.DType,
                               what: str) -> Optional[str]:
         if self.supports(t):
-            if isinstance(t, dt.DecimalType) and t.precision > 18:
-                return (f"{what}: decimal precision {t.precision} > 18 "
-                        "(decimal128 not yet supported)")
             return None
         return f"{what}: type {t} not supported on TPU"
 
@@ -103,9 +101,12 @@ class TypeSig:
 # common signatures
 integral = TypeSig(BYTE, SHORT, INT, LONG)
 fp = TypeSig(FLOAT, DOUBLE)
+decimal128 = TypeSig(DECIMAL_128)
 numeric = integral + fp + TypeSig(DECIMAL_64)
+numeric_all = numeric + decimal128
 numeric_no_decimal = integral + fp
 comparable = numeric + TypeSig(BOOLEAN, STRING, DATE, TIMESTAMP)
 orderable = comparable
 all_basic = comparable + TypeSig(NULL)
+all_basic_128 = all_basic + decimal128
 none_sig = TypeSig()

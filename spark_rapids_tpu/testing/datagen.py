@@ -165,10 +165,27 @@ class DecimalGen(DataGen):
         self.precision, self.scale = precision, scale
 
     def _values(self, n, rng):
-        lim = 10 ** min(self.precision, 15)
-        unscaled = rng.integers(-lim + 1, lim, n)
-        return [decimal.Decimal(int(u)).scaleb(-self.scale)
-                for u in unscaled]
+        p = self.precision
+        if p <= 15:
+            unscaled = [int(u) for u in
+                        rng.integers(-(10 ** p) + 1, 10 ** p, n)]
+        else:
+            # compose full-precision unscaled ints from 15-digit chunks
+            # (rng.integers is int64-bounded)
+            chunks = []
+            digits = p
+            while digits > 0:
+                step = min(digits, 15)
+                chunks.append((step, rng.integers(0, 10 ** step, n)))
+                digits -= step
+            signs = rng.integers(0, 2, n)
+            unscaled = []
+            for i in range(n):
+                v = 0
+                for step, arr in chunks:
+                    v = v * 10 ** step + int(arr[i])
+                unscaled.append(-v if signs[i] else v)
+        return [decimal.Decimal(u).scaleb(-self.scale) for u in unscaled]
 
 
 def gen_table(gens: dict, n: int = 256, seed: int = 0):
