@@ -148,4 +148,5 @@ def orders_spec(scale_rows: int) -> TableSpec:
         ColumnSpec("o_orderpriority", dt.STRING, "choice",
                    choices=["1-URGENT", "2-HIGH", "3-MEDIUM",
                             "4-NOT SPECIFIED", "5-LOW"]),
+        ColumnSpec("o_shippriority", dt.INT32, "choice", choices=[0]),
     ], scale_rows)

@@ -27,10 +27,12 @@ from .transitions import CpuPhysical, DeviceToHostBridge
 
 
 class TpuSession:
-    """Entry point (SparkSession analogue). Holds the active conf."""
+    """Entry point (SparkSession analogue). Holds the active conf and
+    the temp-view catalog backing ``sql()``."""
 
     def __init__(self, conf: Optional[SrtConf] = None):
         self.conf = conf or active_conf()
+        self._catalog: Dict[str, "DataFrame"] = {}
 
     # --- constructors ---
     def create_dataframe(self, data: Dict[str, list],
@@ -38,6 +40,23 @@ class TpuSession:
         if schema is None:
             schema = _infer_schema(data)
         return DataFrame(self, L.LocalRelation(data, schema))
+
+    # --- SQL frontend (sql/parser.py; the Catalyst seam analogue) ---
+    def create_or_replace_temp_view(self, name: str, df: "DataFrame"
+                                    ) -> None:
+        self._catalog[name.lower()] = df
+
+    def table(self, name: str) -> "DataFrame":
+        try:
+            return self._catalog[name.lower()]
+        except KeyError:
+            raise KeyError(f"table or view {name!r} not found; register "
+                           "with create_or_replace_temp_view")
+
+    def sql(self, text: str) -> "DataFrame":
+        """Run a SQL SELECT over registered temp views."""
+        from ..sql import parse_sql
+        return parse_sql(self, text)
 
     def range(self, start: int, end: Optional[int] = None,
               step: int = 1) -> "DataFrame":

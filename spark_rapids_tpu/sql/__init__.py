@@ -1,0 +1,22 @@
+"""SQL string frontend: ``session.sql("SELECT ...")``.
+
+The reference accelerates SQL text through Spark's Catalyst stack and
+hooks physical planning at the columnOverrides seam
+(GpuOverrides.scala:4515 GpuQueryStagePrepOverrides /
+:4312 wrapAndTagPlan). This package is that frontend re-built for the
+TPU engine: a hand-written lexer + recursive-descent parser lowers a
+SQL SELECT dialect onto the same logical-plan/DataFrame layer the
+Python DSL uses (plan/session.py), so everything downstream — the
+tag-then-convert overrides driver, staged exchanges, CPU fallback —
+is shared with the DSL path.
+
+Dialect (grows as needed): SELECT [DISTINCT] with expressions/aliases,
+FROM with table refs, comma joins, and INNER/LEFT/RIGHT/FULL/CROSS
+JOIN ... ON, WHERE, GROUP BY (names or ordinals), HAVING, ORDER BY
+[ASC|DESC] [NULLS FIRST|LAST] (names, aliases, or ordinals), LIMIT,
+UNION [ALL], scalar/aggregate function calls, CASE WHEN, CAST, BETWEEN,
+IN, LIKE, IS [NOT] NULL, EXTRACT, date/timestamp/interval literals,
+and derived tables (subqueries in FROM).
+"""
+
+from .parser import SqlError, parse_sql  # noqa: F401

@@ -1,0 +1,302 @@
+"""SQL string frontend: parsing, analysis, and execution parity with
+the DataFrame DSL / CPU oracle.
+
+The headline contract (VERDICT round-1 item 6): TPC-H q1/q3/q6 run from
+their actual SQL text through session.sql() and match the DSL results.
+"""
+
+import datetime
+
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.models import tpch
+from spark_rapids_tpu.plan import TpuSession
+from spark_rapids_tpu.sql import SqlError
+from spark_rapids_tpu.testing import (IntGen, StringGen, DoubleGen,
+                                      assert_tpu_cpu_equal_df, gen_table)
+
+
+@pytest.fixture(scope="module")
+def session(tmp_path_factory):
+    s = TpuSession()
+    data_dir = str(tmp_path_factory.mktemp("tpch_sql"))
+    tables = tpch.tpch_tables(s, data_dir, scale_rows=20_000)
+    for name, df in tables.items():
+        s.create_or_replace_temp_view(name, df)
+    s._test_tables = tables
+    return s
+
+
+def _close(a, b, tol=1e-6):
+    if a is None or b is None:
+        return a is b
+    if isinstance(a, float) or isinstance(b, float):
+        return abs(a - b) <= tol * max(abs(b), 1.0)
+    return a == b
+
+
+def assert_same(got: dict, want: dict):
+    assert set(got) == set(want), (got.keys(), want.keys())
+    for k in want:
+        assert len(got[k]) == len(want[k]), k
+        for a, b in zip(got[k], want[k]):
+            assert _close(a, b), (k, a, b)
+
+
+TPCH_Q6 = """
+select sum(l_extendedprice * l_discount) as revenue
+from lineitem
+where l_shipdate >= date '1994-01-01'
+  and l_shipdate < date '1994-01-01' + interval '1' year
+  and l_discount between 0.05 and 0.07
+  and l_quantity < 24
+"""
+
+TPCH_Q1 = """
+select l_returnflag, l_linestatus,
+       sum(l_quantity) as sum_qty,
+       sum(l_extendedprice) as sum_base_price,
+       sum(l_extendedprice * (1 - l_discount)) as sum_disc_price,
+       sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) as sum_charge,
+       avg(l_quantity) as avg_qty,
+       avg(l_extendedprice) as avg_price,
+       avg(l_discount) as avg_disc,
+       count(*) as count_order
+from lineitem
+where l_shipdate <= date '1998-12-01' - interval '90' day
+group by l_returnflag, l_linestatus
+order by l_returnflag, l_linestatus
+"""
+
+TPCH_Q3 = """
+select l_orderkey,
+       sum(l_extendedprice * (1 - l_discount)) as revenue,
+       o_orderdate, o_shippriority
+from customer, orders, lineitem
+where c_mktsegment = 'BUILDING'
+  and c_custkey = o_custkey
+  and l_orderkey = o_orderkey
+  and o_orderdate < date '1995-03-15'
+  and l_shipdate > date '1995-03-15'
+group by l_orderkey, o_orderdate, o_shippriority
+order by revenue desc, o_orderdate
+limit 10
+"""
+
+
+def test_tpch_q6_from_sql_text(session):
+    got = session.sql(TPCH_Q6).to_pydict()
+    want = tpch.q6(session._test_tables["lineitem"]).to_pydict()
+    assert _close(got["revenue"][0], want["revenue"][0])
+
+
+def test_tpch_q1_from_sql_text(session):
+    got = session.sql(TPCH_Q1).to_pydict()
+    want = tpch.q1(session._test_tables["lineitem"]).to_pydict()
+    assert_same(got, want)
+
+
+def test_tpch_q3_from_sql_text(session):
+    t = session._test_tables
+    got = session.sql(TPCH_Q3).to_pydict()
+    want = tpch.q3(t["customer"], t["orders"], t["lineitem"]).to_pydict()
+    # DSL q3 groups by (o_orderkey, o_orderdate); o_shippriority is a
+    # constant so revenues must agree pairwise in sorted order
+    assert len(got["revenue"]) == len(want["revenue"]) == 10
+    for a, b in zip(got["revenue"], want["revenue"]):
+        assert _close(a, b)
+
+
+def test_q6_differential(session):
+    assert_tpu_cpu_equal_df(session.sql(TPCH_Q6))
+
+
+def test_q1_differential(session):
+    assert_tpu_cpu_equal_df(session.sql(TPCH_Q1))
+
+
+# --- language feature coverage --------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny(session):
+    data, schema = gen_table(
+        {"k": IntGen(lo=0, hi=5), "v": IntGen(lo=-100, hi=100),
+         "f": DoubleGen(no_special=True, lo=-50, hi=50),
+         "s": StringGen(max_len=6)}, 200, seed=3)
+    df = session.create_dataframe(data, schema)
+    session.create_or_replace_temp_view("tiny", df)
+    data2, schema2 = gen_table(
+        {"k": IntGen(lo=0, hi=8), "w": IntGen(lo=0, hi=9)}, 60, seed=5)
+    session.create_or_replace_temp_view(
+        "other", session.create_dataframe(data2, schema2))
+    return df
+
+
+def test_select_star_where(session, tiny):
+    assert_tpu_cpu_equal_df(session.sql(
+        "select * from tiny where v > 0 and k <> 2"))
+
+
+def test_projection_expressions(session, tiny):
+    assert_tpu_cpu_equal_df(session.sql("""
+        select k + 1 as k1, v * 2 v2, abs(v) av, -v neg,
+               case when v > 0 then 'pos' when v < 0 then 'neg'
+                    else 'zero' end as sgn,
+               cast(v as double) vd, cast(f as int) fi,
+               coalesce(s, 'none') cs, upper(s) us,
+               substring(s, 1, 2) ss, length(s) ls
+        from tiny"""))
+
+
+def test_predicates(session, tiny):
+    assert_tpu_cpu_equal_df(session.sql(
+        "select * from tiny where v in (1, 2, 3) or s like 'a%'"))
+    assert_tpu_cpu_equal_df(session.sql(
+        "select * from tiny where v not between 0 and 10"))
+    assert_tpu_cpu_equal_df(session.sql(
+        "select * from tiny where s is not null and not (v = 0)"))
+
+
+def test_group_by_having_ordinals(session, tiny):
+    assert_tpu_cpu_equal_df(session.sql("""
+        select k, sum(v) sv, count(*) n, avg(f) af
+        from tiny group by 1 having sum(v) > 0 order by 1"""))
+
+
+def test_agg_arithmetic_over_aggregates(session, tiny):
+    assert_tpu_cpu_equal_df(session.sql("""
+        select k, sum(v) / count(*) as ratio, max(v) - min(v) spread
+        from tiny group by k order by k"""))
+
+
+def test_explicit_join_on(session, tiny):
+    assert_tpu_cpu_equal_df(session.sql("""
+        select t.k, t.v, o.w from tiny t
+        join other o on t.k = o.k
+        where o.w > 2 order by t.k, t.v, o.w"""))
+
+
+def test_left_join(session, tiny):
+    assert_tpu_cpu_equal_df(session.sql("""
+        select t.k, t.v, o.w from tiny t
+        left join other o on t.k = o.k
+        order by t.k, t.v, o.w"""))
+
+
+def test_subquery_in_from(session, tiny):
+    assert_tpu_cpu_equal_df(session.sql("""
+        select k, total from
+          (select k, sum(v) as total from tiny group by k) agged
+        where total > 0 order by k"""))
+
+
+def test_union_all_and_distinct(session, tiny):
+    assert_tpu_cpu_equal_df(session.sql(
+        "select k from tiny union all select k from other"))
+    assert_tpu_cpu_equal_df(session.sql(
+        "select distinct k from tiny order by k"))
+
+
+def test_order_by_expression_not_in_output(session, tiny):
+    got = session.sql(
+        "select s from tiny where v > 90 order by v desc, s").to_pydict()
+    assert len(got["s"]) > 0
+
+
+def test_limit_and_ordinal_order(session, tiny):
+    got = session.sql(
+        "select k, v from tiny order by 2 desc, 1 limit 5").to_pydict()
+    assert len(got["v"]) == 5
+    vs = [v for v in got["v"] if v is not None]
+    assert vs == sorted(vs, reverse=True)
+
+
+def test_scalar_select_without_from(session):
+    got = session.sql("select 1 + 2 as three, 'x' as s").to_pydict()
+    assert got["three"] == [3] and got["s"] == ["x"]
+
+
+def test_date_literals_and_functions(session, tiny):
+    got = session.sql("""
+        select year(date '1994-02-01') y, month(date '1994-02-01') m,
+               date '1994-01-31' + interval '1' day d
+        """).to_pydict()
+    assert got["y"] == [1994] and got["m"] == [2]
+    assert got["d"] == [datetime.date(1994, 2, 1)]
+
+
+def test_error_messages(session, tiny):
+    with pytest.raises(SqlError, match="not found"):
+        session.sql("select nope from tiny")
+    with pytest.raises(SqlError, match="unknown function"):
+        session.sql("select frobnicate(v) from tiny")
+    with pytest.raises(KeyError, match="not found"):
+        session.sql("select * from missing_table")
+    with pytest.raises(SqlError):
+        session.sql("select from tiny")
+
+
+# --- review regression coverage --------------------------------------------
+
+def test_duplicate_column_names_across_join(session):
+    a = session.create_dataframe({"k": [1, 2], "v": [100, 200]},
+                                 [("k", dt.INT32), ("v", dt.INT32)])
+    b = session.create_dataframe({"k": [1, 2], "v": [-1, -2]},
+                                 [("k", dt.INT32), ("v", dt.INT32)])
+    session.create_or_replace_temp_view("dup_a", a)
+    session.create_or_replace_temp_view("dup_b", b)
+    got = session.sql("""
+        select a.v as av, b.v as bv from dup_a a
+        join dup_b b on a.k = b.k order by a.k""").to_pydict()
+    assert got["av"] == [100, 200] and got["bv"] == [-1, -2]
+    with pytest.raises(SqlError, match="ambiguous"):
+        session.sql("select v from dup_a a join dup_b b on a.k = b.k")
+
+
+def test_where_not_pushed_into_outer_join_null_side(session):
+    l = session.create_dataframe({"k": [1, 2]}, [("k", dt.INT32)])
+    r = session.create_dataframe({"k": [1], "w": [3]},
+                                 [("k", dt.INT32), ("w", dt.INT32)])
+    session.create_or_replace_temp_view("push_l", l)
+    session.create_or_replace_temp_view("push_r", r)
+    got = session.sql("""
+        select push_l.k, w from push_l
+        left join push_r on push_l.k = push_r.k
+        where w > 5""").to_pydict()
+    assert got["k"] == []  # null-extended rows must NOT pass WHERE
+
+
+def test_outer_join_residual_on_rejected(session):
+    with pytest.raises(SqlError, match="non-equi ON"):
+        session.sql("""
+            select push_l.k from push_l
+            left join push_r on push_l.k = push_r.k and w > 5""")
+
+
+def test_order_by_aggregate_not_in_select(session, tiny):
+    got = session.sql("""
+        select k, avg(v) a from tiny group by k
+        order by sum(v) desc limit 3""").to_pydict()
+    assert len(got["k"]) == 3
+    assert list(got.keys()) == ["k", "a"]  # hidden sort column dropped
+
+
+def test_case_when_over_aggregate(session, tiny):
+    assert_tpu_cpu_equal_df(session.sql("""
+        select k, case when sum(v) > 10 then 'big' else 'small' end tag
+        from tiny group by k order by k"""))
+
+
+def test_subquery_without_alias(session, tiny):
+    got = session.sql("""
+        select k from (select k, v from tiny) where v > 90
+        order by k""").to_pydict()
+    assert len(got["k"]) > 0
+
+
+def test_group_by_ordinal_out_of_range(session, tiny):
+    with pytest.raises(SqlError, match="position"):
+        session.sql("select k from tiny group by 3")
+    with pytest.raises(SqlError, match="position"):
+        session.sql("select k from tiny group by 0")
