@@ -238,6 +238,21 @@ BROADCAST_THRESHOLD_ROWS = conf("srt.sql.broadcastRowThreshold") \
          "because batch capacities are row-bucketed)") \
     .check(_positive).integer(100_000)
 
+JOIN_SUB_PARTITION_ROWS = conf("srt.sql.join.subPartitionRows") \
+    .doc("Join build sides above this many rows are hash-split into "
+         "sub-partitions and joined pair-wise so the build working set "
+         "stays bounded instead of requiring the whole side in one "
+         "device batch. (spark.rapids.sql.test.subPartitioning / "
+         "GpuSubPartitionHashJoin.scala)") \
+    .check(_positive).integer(1 << 22)
+
+AGG_MERGE_PARTITION_ROWS = conf("srt.sql.agg.mergePartitionRows") \
+    .doc("Aggregate merge passes whose concatenated partial rows exceed "
+         "this are hash-re-partitioned by group key and merged bucket "
+         "by bucket (the reference's re-partition merge fallback, "
+         "GpuAggregateExec.scala:711,792).") \
+    .check(_positive).integer(1 << 22)
+
 SHUFFLE_COMPRESS = conf("srt.shuffle.compression.codec") \
     .doc("Codec for serialized shuffle buffers: NONE, LZ4 (native "
          "codec), or ZSTD. "

@@ -89,6 +89,7 @@ class HashAggregateExec(TpuExec):
                 self._packed_schema.append((_state_col_name(i, sname), stype))
         self._jit_update = jax.jit(self._update)
         self._jit_merge = jax.jit(self._merge_finalize)
+        self._split_cache = {}
 
     @property
     def output_schema(self) -> Schema:
@@ -190,9 +191,13 @@ class HashAggregateExec(TpuExec):
             yield partial
 
     def _merge_partition(self, ctx: ExecContext, partials,
-                         agg_time: Metric) -> Optional[ColumnarBatch]:
-        """Concat + merge one partition's packed partials. Returns None
-        for an empty grouped partition."""
+                         agg_time: Metric) -> Iterator[ColumnarBatch]:
+        """Concat + merge one partition's packed partials; yields one
+        batch normally, several when the merge set exceeds
+        srt.sql.agg.mergePartitionRows and gets re-partitioned by key
+        hash (disjoint key buckets merge independently — the
+        reference's re-partition fallback, GpuAggregateExec.scala:711)."""
+        from ..conf import AGG_MERGE_PARTITION_ROWS
         from ..memory.spill import SpillableBatch, SpillPriority
         held: List = []
         total = 0
@@ -203,18 +208,95 @@ class HashAggregateExec(TpuExec):
                 total += int(p.num_rows)
                 held.append(SpillableBatch(p, SpillPriority.ACTIVE_ON_DECK))
             if not held:
-                if self.group_exprs:
-                    return None
-                return self._empty_global_result()
+                if not self.group_exprs:
+                    yield self._empty_global_result()
+                return
+            threshold = ctx.conf.get(AGG_MERGE_PARTITION_ROWS)
+            if total > threshold and self.group_exprs:
+                yield from self._repartition_merge(ctx, held, total,
+                                                   threshold, agg_time)
+                return
             cap = choose_capacity(max(total, 1))
             batches = [sb.get() for sb in held]
             with ctx.semaphore, NvtxTimer(agg_time, "agg.merge"):
                 merged_in = (batches[0] if len(batches) == 1
                              else K.concat_batches(batches, cap))
-                return self._jit_merge(merged_in)
+                yield self._jit_merge(merged_in)
         finally:
             for sb in held:
                 sb.close()
+
+    def _split_fn(self, num_parts: int):
+        """jit'd group-key hash bucket filter over packed partials
+        (ops/kernels.py bucket_compact — same primitive the
+        sub-partition join uses)."""
+        if num_parts not in self._split_cache:
+            names = list(self._key_names)
+
+            def run(batch, p):
+                return K.bucket_compact(
+                    batch, [batch.column(n) for n in names], num_parts, p)
+            self._split_cache[num_parts] = jax.jit(run)
+        return self._split_cache[num_parts]
+
+    def _repack(self, ctx: ExecContext, batch: ColumnarBatch
+                ) -> ColumnarBatch:
+        """Shrink a compacted bucket to its tight capacity (compact
+        keeps the source capacity; without this the fallback would
+        inflate the merge set ~P times)."""
+        n = int(batch.num_rows)
+        cap = choose_capacity(max(n, 8))
+        if cap >= batch.capacity:
+            return batch
+        key = ("repack", batch.capacity, cap)
+        if key not in self._split_cache:
+            self._split_cache[key] = jax.jit(
+                lambda b: K.slice_batch(b, 0, b.num_rows, cap))
+        with ctx.semaphore:
+            return self._split_cache[key](batch)
+
+    def _repartition_merge(self, ctx: ExecContext, held, total: int,
+                           threshold: int, agg_time: Metric
+                           ) -> Iterator[ColumnarBatch]:
+        m = ctx.metrics_for(self.exec_id)
+        parts_m = m.setdefault("aggMergePartitions",
+                               Metric("aggMergePartitions", Metric.DEBUG))
+        P = max(2, -(-total // max(threshold, 1)))
+        parts_m.add(P)
+        split = self._split_fn(P)
+        from ..memory.spill import SpillableBatch, SpillPriority
+        # bucket every partial once; buckets spill while waiting
+        buckets: List[List[SpillableBatch]] = [[] for _ in range(P)]
+        bucket_rows = [0] * P
+        try:
+            for sb in held:
+                batch = sb.get()
+                for p in range(P):
+                    with ctx.semaphore:
+                        sub = split(batch, jnp.int32(p))
+                    n = int(sub.num_rows)
+                    if n:
+                        sub = self._repack(ctx, sub)
+                        bucket_rows[p] += n
+                        buckets[p].append(SpillableBatch(
+                            sub, SpillPriority.ACTIVE_ON_DECK))
+                sb.close()
+            for p in range(P):
+                if not buckets[p]:
+                    continue
+                cap = choose_capacity(bucket_rows[p])
+                batches = [b.get() for b in buckets[p]]
+                with ctx.semaphore, NvtxTimer(agg_time, "agg.merge"):
+                    merged_in = (batches[0] if len(batches) == 1
+                                 else K.concat_batches(batches, cap))
+                    yield self._jit_merge(merged_in)
+                for b in buckets[p]:
+                    b.close()
+                buckets[p] = []
+        finally:
+            for bs in buckets:
+                for b in bs:
+                    b.close()
 
     def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
         m = ctx.metrics_for(self.exec_id)
@@ -224,21 +306,18 @@ class HashAggregateExec(TpuExec):
             yield from self._partial_stream(ctx, agg_time)
             return
         if self.mode == FINAL:
-            # partition-wise merge: one output batch per child partition
+            # partition-wise merge: >=1 output batch per child partition
             saw_any = False
             for part in self.children[0].execute_partitioned(ctx):
-                out = self._merge_partition(ctx, part, agg_time)
-                if out is not None:
+                for out in self._merge_partition(ctx, part, agg_time):
                     saw_any = True
                     yield out
             if not saw_any and not self.group_exprs:
                 yield self._empty_global_result()
             return
         # COMPLETE: partial + merge fused in one stage
-        out = self._merge_partition(
+        yield from self._merge_partition(
             ctx, self._partial_stream(ctx, agg_time), agg_time)
-        if out is not None:
-            yield out
 
     def _empty_global_result(self) -> ColumnarBatch:
         cap = 8

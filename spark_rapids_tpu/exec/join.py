@@ -9,8 +9,12 @@ when it exceeds the static output capacity the exec re-runs with the
 reported size's capacity bucket (so the second attempt always fits) —
 the TPU equivalent of the reference's SplitAndRetryOOM join contract.
 _MAX_GROWTH_STEPS is a safety net against a kernel under-reporting, not
-a working-set bound; sub-partitioning oversized build sides
-(GpuSubPartitionHashJoin) is not yet implemented.
+a working-set bound. Build sides above srt.sql.join.subPartitionRows
+are hash-split into sub-partitions and joined pair-wise
+(GpuSubPartitionHashJoin.scala): both sides are bucketed by the SAME
+key hash so matching rows co-locate, each sub-build is spillable while
+idle, and every probe row lands in exactly one bucket (outer-join
+preservation holds per bucket).
 """
 
 from __future__ import annotations
@@ -183,9 +187,159 @@ class _HashJoinBase(TpuExec):
                 probe.names + [n for n, _ in build_schema], probe.num_rows)
             yield self._reorder_columns(out)
 
+    def _join_pair(self, ctx: ExecContext, probe: ColumnarBatch,
+                   build: ColumnarBatch, retries: Metric
+                   ) -> ColumnarBatch:
+        """One probe batch against one build batch, with capacity
+        growth retry."""
+        n_probe = int(probe.num_rows)
+        # initial guess: every probe row matches ~1 build row
+        out_cap = choose_capacity(max(n_probe, 16))
+        for step in range(_MAX_GROWTH_STEPS + 1):
+            with ctx.semaphore:
+                out, total = self._join_fn(out_cap)(probe, build)
+            total = int(total)
+            if total <= out_cap:
+                return self._reorder_columns(out)
+            retries.add(1)
+            out_cap = choose_capacity(total)
+        raise RuntimeError(
+            f"join expansion {total} exceeded capacity after "
+            f"{_MAX_GROWTH_STEPS} growth steps")
+
+    def _split_fn(self, num_parts: int, side: str):
+        """jit'd key-hash bucket filter (ops/kernels.py bucket_compact):
+        (batch, p) -> rows of bucket p, same capacity."""
+        key = ("split", num_parts, side)
+        if key not in self._jit_cache:
+            exprs = self._probe_key_exprs if side == "probe" \
+                else self._build_key_exprs
+
+            def run(batch, p):
+                return K.bucket_compact(
+                    batch, [e.eval(batch) for e in exprs], num_parts, p)
+            self._jit_cache[key] = jax.jit(run)
+        return self._jit_cache[key]
+
+    def _repack(self, ctx: ExecContext, batch: ColumnarBatch
+                ) -> ColumnarBatch:
+        """Shrink a compacted batch to its tight capacity bucket —
+        compact() preserves the source capacity, so without this the
+        sub-partition machinery would multiply, not bound, memory."""
+        n = int(batch.num_rows)
+        cap = choose_capacity(max(n, 8))
+        if cap >= batch.capacity:
+            return batch
+        key = ("repack", batch.capacity, cap)
+        if key not in self._jit_cache:
+            self._jit_cache[key] = jax.jit(
+                lambda b: K.slice_batch(b, 0, b.num_rows, cap))
+        with ctx.semaphore:
+            return self._jit_cache[key](batch)
+
+    def _sub_partition_join(self, ctx: ExecContext, probe_stream,
+                            build_holder: List[ColumnarBatch], threshold: int
+                            ) -> Iterator[ColumnarBatch]:
+        """GpuSubPartitionHashJoin: bucket BOTH sides by the same key
+        hash, then join bucket-pairs so each sub-build is materialized
+        once. ``build_holder`` transfers ownership of the concatenated
+        build (the caller's reference is dropped so it can be freed as
+        soon as bucketing finishes). An inner-join bucket still over
+        budget (single hot key defeats key hashing) is row-chunked;
+        other join types record the skew and run the bucket whole."""
+        from ..memory.spill import SpillableBatch, SpillPriority
+        m = ctx.metrics_for(self.exec_id)
+        retries = m.setdefault("joinOverflowRetries",
+                               Metric("joinOverflowRetries", Metric.DEBUG))
+        parts_m = m.setdefault("joinSubPartitions",
+                               Metric("joinSubPartitions", Metric.DEBUG))
+        skew_m = m.setdefault("joinSubPartitionSkew",
+                              Metric("joinSubPartitionSkew", Metric.DEBUG))
+        build = build_holder.pop()
+        P = max(2, -(-int(build.num_rows) // max(threshold, 1)))
+        parts_m.add(P)
+        sub_builds: List[Optional[SpillableBatch]] = []
+        split_b = self._split_fn(P, "build")
+        for p in range(P):
+            with ctx.semaphore:
+                sub = split_b(build, jnp.int32(p))
+            if int(sub.num_rows) == 0:
+                sub_builds.append(None)
+                continue
+            sub = self._repack(ctx, sub)
+            sub_builds.append(SpillableBatch(sub,
+                                             SpillPriority.ACTIVE_ON_DECK))
+        del build, sub
+
+        # bucket the whole probe stream first, so each sub-build is
+        # unspilled exactly once (not once per probe batch)
+        split_p = self._split_fn(P, "probe")
+        probe_buckets: List[List[SpillableBatch]] = [[] for _ in range(P)]
+        try:
+            for probe in probe_stream:
+                if int(probe.num_rows) == 0:
+                    continue
+                for p in range(P):
+                    with ctx.semaphore:
+                        sub = split_p(probe, jnp.int32(p))
+                    if int(sub.num_rows) == 0:
+                        continue
+                    sub = self._repack(ctx, sub)
+                    probe_buckets[p].append(SpillableBatch(
+                        sub, SpillPriority.ACTIVE_ON_DECK))
+            for p in range(P):
+                if not probe_buckets[p]:
+                    continue
+                sb = sub_builds[p]
+                if sb is None:
+                    for psb in probe_buckets[p]:
+                        yield from self._empty_result(
+                            iter([psb.get()]), ctx)
+                        psb.close()
+                    probe_buckets[p] = []
+                    continue
+                bucket_build = sb.get()
+                n_build = int(bucket_build.num_rows)
+                if n_build > threshold:
+                    skew_m.add(1)
+                if n_build > threshold and self.join_type == INNER:
+                    # hot-key bucket: arbitrary row chunks are correct
+                    # for inner joins (matches are a disjoint union)
+                    chunks = -(-n_build // threshold)
+                    chunk_cap = choose_capacity(threshold)
+                    ck = ("chunk", bucket_build.capacity, chunk_cap)
+                    if ck not in self._jit_cache:
+                        self._jit_cache[ck] = jax.jit(
+                            lambda b, s: K.slice_batch(b, s, threshold,
+                                                       chunk_cap))
+                    for ci in range(chunks):
+                        with ctx.semaphore:
+                            chunk = self._jit_cache[ck](
+                                bucket_build, jnp.int32(ci * threshold))
+                        for psb in probe_buckets[p]:
+                            yield self._join_pair(ctx, psb.get(), chunk,
+                                                  retries)
+                else:
+                    for psb in probe_buckets[p]:
+                        yield self._join_pair(ctx, psb.get(), bucket_build,
+                                              retries)
+                for psb in probe_buckets[p]:
+                    psb.close()
+                probe_buckets[p] = []
+                sb.close()
+                sub_builds[p] = None
+        finally:
+            for sb in sub_builds:
+                if sb is not None:
+                    sb.close()
+            for bucket in probe_buckets:
+                for psb in bucket:
+                    psb.close()
+
     def _join_partition(self, ctx: ExecContext, probe_stream,
                         build_stream) -> Iterator[ColumnarBatch]:
         """Join one (probe partition, build partition) pair."""
+        from ..conf import JOIN_SUB_PARTITION_ROWS
         m = ctx.metrics_for(self.exec_id)
         retries = m.setdefault("joinOverflowRetries",
                                Metric("joinOverflowRetries", Metric.DEBUG))
@@ -193,25 +347,18 @@ class _HashJoinBase(TpuExec):
         if build is None:
             yield from self._empty_result(probe_stream, ctx)
             return
+        threshold = ctx.conf.get(JOIN_SUB_PARTITION_ROWS)
+        if int(build.num_rows) > threshold and (self.left_keys or
+                                                self.right_keys):
+            holder = [build]
+            del build
+            yield from self._sub_partition_join(ctx, probe_stream, holder,
+                                                threshold)
+            return
         for probe in probe_stream:
-            n_probe = int(probe.num_rows)
-            if n_probe == 0:
+            if int(probe.num_rows) == 0:
                 continue
-            # initial guess: every probe row matches ~1 build row
-            out_cap = choose_capacity(max(n_probe, 16))
-            for step in range(_MAX_GROWTH_STEPS + 1):
-                with ctx.semaphore:
-                    out, total = self._join_fn(out_cap)(probe, build)
-                total = int(total)
-                if total <= out_cap:
-                    break
-                retries.add(1)
-                out_cap = choose_capacity(total)
-            else:
-                raise RuntimeError(
-                    f"join expansion {total} exceeded capacity after "
-                    f"{_MAX_GROWTH_STEPS} growth steps")
-            yield self._reorder_columns(out)
+            yield self._join_pair(ctx, probe, build, retries)
 
     def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
         yield from self._join_partition(ctx, self._probe_stream(ctx),

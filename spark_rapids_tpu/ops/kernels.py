@@ -54,6 +54,24 @@ def filter_batch(batch: ColumnarBatch, cond: ColumnVector) -> ColumnarBatch:
     return compact(batch, cond.data & cond.validity)
 
 
+def bucket_compact(batch: ColumnarBatch, key_cols, num_parts: int,
+                   p) -> ColumnarBatch:
+    """Rows whose key-hash bucket equals ``p``, compacted.
+
+    The hash-bucketing primitive shared by sub-partition joins and the
+    aggregate re-partition merge fallback: both sides of a join (or all
+    partials of a merge) bucket with the SAME chain (seed 7 — distinct
+    from the shuffle partitioner's seed 42 so shuffle and sub-partition
+    bucketing stay uncorrelated), so equal keys always co-locate.
+    """
+    from ..expr import hashing as H
+    h = jnp.full((batch.capacity,), 7, jnp.uint32)
+    for c in key_cols:
+        h = H.murmur3_column(c, h)
+    bucket = (h % jnp.uint32(num_parts)).astype(jnp.int32)
+    return compact(batch, (bucket == p) & batch.live_mask())
+
+
 # ---------------------------------------------------------------------------
 # Sort
 # ---------------------------------------------------------------------------
