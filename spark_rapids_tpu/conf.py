@@ -260,6 +260,29 @@ SHUFFLE_COMPRESS = conf("srt.shuffle.compression.codec") \
          "reference)") \
     .check_values(["NONE", "LZ4", "ZSTD"]).string("NONE")
 
+SESSION_TIMEZONE = conf("srt.sql.session.timeZone") \
+    .doc("Session timezone id used by timezone-aware SQL functions "
+         "(spark.sql.session.timeZone). Conversions run on device "
+         "against materialized transition tables (GpuTimeZoneDB "
+         "analogue, expr/timezone.py).") \
+    .string("UTC")
+
+PARQUET_REBASE_READ = conf("srt.sql.parquet.datetimeRebaseModeInRead") \
+    .doc("How to treat pre-1582-10-15 dates/timestamps in parquet "
+         "reads: CORRECTED (as written, proleptic Gregorian), LEGACY "
+         "(rebase from the hybrid Julian calendar), EXCEPTION (fail). "
+         "(spark.sql.parquet.datetimeRebaseModeInRead, "
+         "datetimeRebaseUtils.scala)") \
+    .check_values(["CORRECTED", "LEGACY", "EXCEPTION"]) \
+    .string("CORRECTED")
+
+PARQUET_REBASE_WRITE = conf("srt.sql.parquet.datetimeRebaseModeInWrite") \
+    .doc("Calendar for pre-1582-10-15 dates/timestamps in parquet "
+         "writes: CORRECTED, LEGACY (rebase to hybrid Julian), or "
+         "EXCEPTION. (spark.sql.parquet.datetimeRebaseModeInWrite)") \
+    .check_values(["CORRECTED", "LEGACY", "EXCEPTION"]) \
+    .string("CORRECTED")
+
 METRICS_LEVEL = conf("srt.sql.metrics.level") \
     .doc("Operator metric detail: ESSENTIAL, MODERATE, DEBUG. "
          "(spark.rapids.sql.metrics.level, GpuExec.scala:36-49)") \

@@ -228,8 +228,46 @@ def read_file_to_tables(path: str, fmt: str, schema: Schema,
         sl = table.slice(start, max_rows)
         if sl.num_rows == 0 and start > 0:
             break
-        out.append(arrow_to_host_table(sl))
+        ht = arrow_to_host_table(sl)
+        if fmt in ("parquet", "orc"):
+            _apply_read_rebase(ht, options)
+        out.append(ht)
     return out
+
+
+def _apply_read_rebase(ht: HostTable, options: dict) -> None:
+    """datetimeRebaseModeInRead (datetimeRebaseUtils.scala): LEGACY
+    rebases pre-1582-10-15 date/timestamp lanes from the hybrid Julian
+    calendar the file was written with; EXCEPTION refuses them."""
+    from ..expr import timezone as TZ
+    mode = options.get("datetimeRebaseMode", "CORRECTED")
+    if mode == "CORRECTED":
+        return
+    for name, col in zip(ht.names, ht.columns):
+        if isinstance(col.dtype, dt.DateType):
+            old_mask = col.values < TZ._GREGORIAN_CUTOVER_DAYS
+            if not old_mask.any():
+                continue
+            if mode == "EXCEPTION":
+                raise ValueError(
+                    f"column {name!r} has dates before 1582-10-15; set "
+                    "datetimeRebaseMode=LEGACY or CORRECTED "
+                    "(spark.sql.parquet.datetimeRebaseModeInRead)")
+            col.values = TZ.rebase_julian_to_gregorian_days(
+                col.values).astype(col.values.dtype)
+        elif isinstance(col.dtype, dt.TimestampType):
+            old_mask = col.values < TZ._CUTOVER_US
+            if not old_mask.any():
+                continue
+            if mode == "EXCEPTION":
+                raise ValueError(
+                    f"column {name!r} has timestamps before 1582-10-15; "
+                    "set datetimeRebaseMode=LEGACY or CORRECTED")
+            col.values = TZ.rebase_julian_to_gregorian_micros(col.values)
+        elif col.dtype.is_nested:
+            col.values = TZ.rebase_nested_lanes(
+                col.values, col.dtype, to_gregorian=True,
+                check_only=(mode == "EXCEPTION"))
 
 
 def _conform(table: "pa.Table", schema: Schema) -> "pa.Table":
@@ -277,7 +315,13 @@ class FileSourceScanExec(TpuExec):
         conf = ctx.conf
         reader = conf.get(READER_TYPE).upper()
         max_rows = conf.get(MAX_READER_BATCH_SIZE_ROWS)
-        args = (self.scan.fmt, self._schema, self.scan.options,
+        # resolve conf-driven per-read settings HERE (the session conf
+        # is a thread-local; pool worker threads must not consult it)
+        from ..conf import PARQUET_REBASE_READ
+        options = dict(self.scan.options)
+        options.setdefault("datetimeRebaseMode",
+                           conf.get(PARQUET_REBASE_READ))
+        args = (self.scan.fmt, self._schema, options,
                 self._arrow_filter, max_rows)
         if reader == "MULTITHREADED" and len(self.scan.paths) > 1:
             threads = conf.get(READER_THREADS)

@@ -58,11 +58,56 @@ _EXT = {"parquet": ".parquet", "orc": ".orc", "csv": ".csv",
         "json": ".json"}
 
 
+def _apply_write_rebase(table: HostTable, options: dict) -> HostTable:
+    """datetimeRebaseModeInWrite: LEGACY converts pre-1582-10-15 lanes
+    to the hybrid Julian calendar before encoding; EXCEPTION refuses
+    them (datetimeRebaseUtils.scala write side)."""
+    from ..columnar import dtypes as dt
+    from ..expr import timezone as TZ
+    mode = options.get("datetimeRebaseMode", "CORRECTED")
+    if mode == "CORRECTED":
+        return table
+    from ..plan.host_table import HostColumn, HostTable as HT
+    cols = list(table.columns)
+    for i, col in enumerate(cols):
+        if isinstance(col.dtype, dt.DateType):
+            if not (col.values < TZ._GREGORIAN_CUTOVER_DAYS).any():
+                continue
+            if mode == "EXCEPTION":
+                raise ValueError(
+                    f"column {table.names[i]!r} has dates before "
+                    "1582-10-15; set datetimeRebaseModeInWrite to "
+                    "LEGACY or CORRECTED")
+            cols[i] = HostColumn(
+                TZ.rebase_gregorian_to_julian_days(col.values)
+                .astype(col.values.dtype), col.mask, col.dtype)
+        elif isinstance(col.dtype, dt.TimestampType):
+            if not (col.values < TZ._CUTOVER_US).any():
+                continue
+            if mode == "EXCEPTION":
+                raise ValueError(
+                    f"column {table.names[i]!r} has timestamps before "
+                    "1582-10-15; set datetimeRebaseModeInWrite to "
+                    "LEGACY or CORRECTED")
+            cols[i] = HostColumn(
+                TZ.rebase_gregorian_to_julian_micros(col.values),
+                col.mask, col.dtype)
+        elif col.dtype.is_nested:
+            cols[i] = HostColumn(
+                TZ.rebase_nested_lanes(col.values, col.dtype,
+                                       to_gregorian=False,
+                                       check_only=(mode == "EXCEPTION")),
+                col.mask, col.dtype)
+    return HT(cols, list(table.names))
+
+
 def write_host_table(table: HostTable, path: str, fmt: str,
                      partition_by: Optional[List[str]] = None,
                      mode: str = "error",
                      options: Optional[dict] = None) -> WriteStats:
     options = options or {}
+    if fmt in ("parquet", "orc"):
+        table = _apply_write_rebase(table, options)
     stats = WriteStats()
     exists = (bool(os.listdir(path)) if os.path.isdir(path)
               else os.path.exists(path))
@@ -136,8 +181,12 @@ class DataFrameWriter:
 
     def _write(self, path: str, fmt: str) -> WriteStats:
         table = self.df.session.execute(self.df.plan)
+        from ..conf import PARQUET_REBASE_WRITE
+        options = dict(self._options)
+        options.setdefault("datetimeRebaseMode",
+                           self.df.session.conf.get(PARQUET_REBASE_WRITE))
         return write_host_table(table, path, fmt, self._partition_by,
-                                self._mode, self._options)
+                                self._mode, options)
 
     def parquet(self, path: str) -> WriteStats:
         return self._write(path, "parquet")

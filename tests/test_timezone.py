@@ -1,0 +1,255 @@
+"""Timezone conversions + Julian/Gregorian rebase.
+
+Reference: GpuTimeZoneDB (device transition tables) and
+datetimeRebaseUtils.scala (parquet LEGACY calendar rebase).
+"""
+
+import datetime
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.expr import timezone as TZ
+from spark_rapids_tpu.expr.core import col
+from spark_rapids_tpu.plan import TpuSession
+from spark_rapids_tpu.testing import (TimestampGen, assert_tpu_cpu_equal_df,
+                                      gen_table)
+
+ZONES = ["America/Los_Angeles", "Europe/Berlin", "Asia/Kolkata",
+         "Australia/Sydney", "UTC"]
+
+PRE1900 = -2840140800  # 1880-01-01 UTC, inside the LMT era
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession()
+
+
+# --- transition tables vs zoneinfo ------------------------------------------
+
+@pytest.mark.parametrize("zone", ZONES)
+def test_transition_table_matches_zoneinfo(zone):
+    import zoneinfo
+    trans, offs = TZ.zone_transitions(zone)
+    tz = zoneinfo.ZoneInfo(zone)
+    rng = np.random.RandomState(hash(zone) % (2 ** 31))
+    for sec in rng.randint(-2208988800, 4102444800, 200):
+        us = int(sec) * 1_000_000
+        idx = np.searchsorted(trans, us, side="right") - 1
+        inst = TZ._EPOCH + datetime.timedelta(microseconds=us)
+        want = int(inst.astimezone(tz).utcoffset().total_seconds()) * 1_000_000
+        assert offs[idx] == want, (zone, us)
+
+
+@pytest.mark.parametrize("zone", ZONES)
+def test_from_to_utc_differential(session, zone):
+    from spark_rapids_tpu.expr.timezone import (FromUTCTimestamp,
+                                                ToUTCTimestamp)
+    df = session.create_dataframe(
+        *_ts_data(seed=hash(zone) % 97))
+    assert_tpu_cpu_equal_df(df.select(
+        FromUTCTimestamp(col("t"), zone).alias("local"),
+        ToUTCTimestamp(col("t"), zone).alias("utc")))
+
+
+def _ts_data(seed):
+    data, schema = gen_table({"t": TimestampGen()}, 256, seed)
+    return data, schema
+
+
+def test_from_utc_known_values(session):
+    from spark_rapids_tpu.expr.timezone import FromUTCTimestamp
+    # 2024-07-01 12:00 UTC is 05:00 in LA (PDT, -7) and 14:00 in Berlin
+    t = datetime.datetime(2024, 7, 1, 12, 0, tzinfo=datetime.timezone.utc)
+    df = session.create_dataframe({"t": [t]}, [("t", dt.TIMESTAMP)])
+    la = df.select(FromUTCTimestamp(col("t"), "America/Los_Angeles")
+                   .alias("x")).to_pydict()["x"][0]
+    assert la.hour == 5
+    de = df.select(FromUTCTimestamp(col("t"), "Europe/Berlin")
+                   .alias("x")).to_pydict()["x"][0]
+    assert de.hour == 14
+
+
+def test_roundtrip_away_from_transitions(session):
+    from spark_rapids_tpu.expr.timezone import (FromUTCTimestamp,
+                                                ToUTCTimestamp)
+    t = datetime.datetime(2023, 1, 15, 6, 30, tzinfo=datetime.timezone.utc)
+    df = session.create_dataframe({"t": [t]}, [("t", dt.TIMESTAMP)])
+    out = df.select(
+        ToUTCTimestamp(FromUTCTimestamp(col("t"), "Asia/Kolkata"),
+                       "Asia/Kolkata").alias("x")).to_pydict()["x"][0]
+    assert out == t
+
+
+def test_unknown_zone_fails_at_plan_time(session):
+    from spark_rapids_tpu.expr.timezone import FromUTCTimestamp
+    with pytest.raises(Exception):
+        FromUTCTimestamp(col("t"), "Not/AZone")
+
+
+def test_sql_tz_functions(session):
+    df = session.create_dataframe(
+        {"t": [datetime.datetime(2024, 7, 1, 12, 0,
+                                 tzinfo=datetime.timezone.utc)]},
+        [("t", dt.TIMESTAMP)])
+    session.create_or_replace_temp_view("tzt", df)
+    got = session.sql(
+        "select from_utc_timestamp(t, 'America/Los_Angeles') l, "
+        "to_utc_timestamp(t, 'Asia/Kolkata') u from tzt").to_pydict()
+    assert got["l"][0].hour == 5
+    assert got["u"][0].hour == 6 and got["u"][0].minute == 30
+
+
+# --- rebase ------------------------------------------------------------------
+
+def test_rebase_cutover_alignment():
+    # Julian 1582-10-05 and Gregorian 1582-10-15 are the same instant
+    jd = TZ._ymd_to_days_julian(np.array([1582]), np.array([10]),
+                                np.array([5]))
+    gd = TZ._ymd_to_days_gregorian(np.array([1582]), np.array([10]),
+                                   np.array([15]))
+    assert jd[0] == gd[0] == TZ._GREGORIAN_CUTOVER_DAYS
+
+
+def test_rebase_roundtrip_and_identity():
+    days = np.arange(-400000, -141427, 911, dtype=np.int64)
+    rb = TZ.rebase_julian_to_gregorian_days(days)
+    assert (TZ.rebase_gregorian_to_julian_days(rb) == days).all()
+    modern = np.array([0, 10_000, -100_000], np.int64)
+    assert (TZ.rebase_julian_to_gregorian_days(modern) == modern).all()
+    us = days * 86_400_000_000 + 12_345
+    rus = TZ.rebase_julian_to_gregorian_micros(us)
+    assert (TZ.rebase_gregorian_to_julian_micros(rus) == us).all()
+
+
+def test_parquet_legacy_rebase_roundtrip(session, tmp_path):
+    # write LEGACY then read LEGACY (session-conf driven, no globals):
+    # values come back unchanged; a CORRECTED read shows shifted lanes
+    old_dates = [datetime.date(1400, 3, 1), datetime.date(1000, 1, 1),
+                 datetime.date(2020, 6, 15)]
+    path = str(tmp_path / "legacy")
+    from spark_rapids_tpu.conf import SrtConf
+    legacy = SrtConf({"srt.sql.parquet.datetimeRebaseModeInWrite": "LEGACY",
+                      "srt.sql.parquet.datetimeRebaseModeInRead": "LEGACY"})
+    s2 = TpuSession(legacy)
+    df2 = s2.create_dataframe({"d": old_dates}, [("d", dt.DATE)])
+    df2.write.parquet(path)
+    back = s2.read.parquet(path).to_pydict()
+    assert back["d"] == old_dates
+    # CORRECTED read of the LEGACY file: ancient dates shift by the
+    # Julian/Gregorian calendar gap (9 days at year 1400)
+    raw = session.read.parquet(path).to_pydict()
+    assert raw["d"][2] == datetime.date(2020, 6, 15)
+    assert raw["d"][0] != old_dates[0]
+
+
+def test_parquet_rebase_exception_mode(tmp_path):
+    from spark_rapids_tpu.conf import SrtConf
+    exc = TpuSession(SrtConf(
+        {"srt.sql.parquet.datetimeRebaseModeInWrite": "EXCEPTION"}))
+    df = exc.create_dataframe({"d": [datetime.date(1200, 1, 1)]},
+                              [("d", dt.DATE)])
+    path = str(tmp_path / "exc")
+    with pytest.raises(ValueError, match="1582"):
+        df.write.parquet(path)
+
+
+def test_writer_option_overrides_conf(session, tmp_path):
+    # per-write option wins over the session conf
+    old_dates = [datetime.date(1400, 3, 1)]
+    df = session.create_dataframe({"d": old_dates}, [("d", dt.DATE)])
+    path = str(tmp_path / "opt")
+    df.write.option("datetimeRebaseMode", "LEGACY").parquet(path)
+    back = (session.read
+            .option("datetimeRebaseMode", "LEGACY").parquet(path)
+            .to_pydict())
+    assert back["d"] == old_dates
+
+
+def test_pre1900_lmt_offsets():
+    import zoneinfo
+    trans, offs = TZ.zone_transitions("America/Los_Angeles")
+    tz = zoneinfo.ZoneInfo("America/Los_Angeles")
+    for sec in (PRE1900, PRE1900 + 86400 * 365 * 5):
+        us = sec * 1_000_000
+        idx = np.searchsorted(trans, us, side="right") - 1
+        inst = TZ._EPOCH + datetime.timedelta(microseconds=us)
+        want = int(inst.astimezone(tz).utcoffset()
+                   .total_seconds()) * 1_000_000
+        assert offs[idx] == want  # LMT -28378s, not the 1900s -28800
+
+
+def test_session_timezone_drives_sql_hour():
+    from spark_rapids_tpu.conf import SrtConf
+    s = TpuSession(SrtConf({"srt.sql.session.timeZone": "Asia/Kolkata"}))
+    df = s.create_dataframe(
+        {"t": [datetime.datetime(2024, 7, 1, 12, 0,
+                                 tzinfo=datetime.timezone.utc)]},
+        [("t", dt.TIMESTAMP)])
+    s.create_or_replace_temp_view("tzs", df)
+    got = s.sql("select hour(t) h, minute(t) m from tzs").to_pydict()
+    assert (got["h"][0], got["m"][0]) == (17, 30)  # UTC+5:30
+
+
+def test_nested_legacy_rebase_roundtrip(tmp_path):
+    from spark_rapids_tpu.conf import SrtConf
+    s = TpuSession(SrtConf(
+        {"srt.sql.parquet.datetimeRebaseModeInWrite": "LEGACY",
+         "srt.sql.parquet.datetimeRebaseModeInRead": "LEGACY"}))
+    vals = [[datetime.date(1400, 3, 1), datetime.date(2020, 6, 15)], None]
+    df = s.create_dataframe({"a": vals},
+                            [("a", dt.ArrayType(dt.DATE))])
+    path = str(tmp_path / "nested_legacy")
+    df.write.parquet(path)
+    back = s.read.parquet(path).to_pydict()
+    assert back["a"] == vals
+
+
+def test_fixed_offset_zones(session):
+    from spark_rapids_tpu.expr.timezone import FromUTCTimestamp
+    t = datetime.datetime(2024, 7, 1, 12, 0, tzinfo=datetime.timezone.utc)
+    df = session.create_dataframe({"t": [t]}, [("t", dt.TIMESTAMP)])
+    got = df.select(
+        FromUTCTimestamp(col("t"), "+05:30").alias("a"),
+        FromUTCTimestamp(col("t"), "GMT-8").alias("b")).to_pydict()
+    assert (got["a"][0].hour, got["a"][0].minute) == (17, 30)
+    assert got["b"][0].hour == 4
+    assert_tpu_cpu_equal_df(df.select(
+        FromUTCTimestamp(col("t"), "+05:30").alias("a")))
+
+
+def test_fixed_offset_session_timezone_sql():
+    from spark_rapids_tpu.conf import SrtConf
+    s = TpuSession(SrtConf({"srt.sql.session.timeZone": "+05:30"}))
+    df = s.create_dataframe(
+        {"t": [datetime.datetime(2024, 7, 1, 12, 0,
+                                 tzinfo=datetime.timezone.utc)]},
+        [("t", dt.TIMESTAMP)])
+    s.create_or_replace_temp_view("tzf", df)
+    got = s.sql("select hour(t) h from tzf").to_pydict()
+    assert got["h"] == [17]
+
+
+def test_session_timezone_date_fields_on_timestamp():
+    from spark_rapids_tpu.conf import SrtConf
+    s = TpuSession(SrtConf({"srt.sql.session.timeZone":
+                            "Australia/Sydney"}))
+    # 2020-12-31 18:00 UTC is 2021-01-01 05:00 in Sydney (AEDT +11)
+    df = s.create_dataframe(
+        {"t": [datetime.datetime(2020, 12, 31, 18, 0,
+                                 tzinfo=datetime.timezone.utc)]},
+        [("t", dt.TIMESTAMP)])
+    s.create_or_replace_temp_view("tzy", df)
+    got = s.sql("select year(t) y, month(t) m, day(t) d from tzy"
+                ).to_pydict()
+    assert (got["y"][0], got["m"][0], got["d"][0]) == (2021, 1, 1)
+
+
+def test_far_future_matches_oracle(session):
+    from spark_rapids_tpu.expr.timezone import FromUTCTimestamp
+    t = datetime.datetime(2250, 7, 1, 12, 0, tzinfo=datetime.timezone.utc)
+    df = session.create_dataframe({"t": [t]}, [("t", dt.TIMESTAMP)])
+    assert_tpu_cpu_equal_df(df.select(
+        FromUTCTimestamp(col("t"), "America/New_York").alias("x")))
