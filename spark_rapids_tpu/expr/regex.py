@@ -101,12 +101,23 @@ _ESCAPE_LITERALS = {"t": "\t", "n": "\n", "r": "\r", "f": "\f",
                     "a": "\a", "e": "\x1b", "0": "\0"}
 
 
+class _Group(_Node):
+    """Capturing group (index is 1-based like Java)."""
+
+    def __init__(self, child: _Node, idx: int):
+        self.child = child
+        self.idx = idx
+
+
 class _Parser:
     def __init__(self, pattern: str):
         self.p = pattern
         self.i = 0
         self.anchored_start = False
         self.anchored_end = False
+        self.n_groups = 0
+        self.has_alternation = False
+        self.has_lazy = False
 
     def fail(self, why: str):
         raise RegexUnsupported(
@@ -141,7 +152,10 @@ class _Parser:
         while self.peek() == "|":
             self.next()
             options.append(self.sequence(top))
-        return options[0] if len(options) == 1 else _Alt(options)
+        if len(options) > 1:
+            self.has_alternation = True
+            return _Alt(options)
+        return options[0]
 
     def sequence(self, top: bool = False) -> _Node:
         parts: List[_Node] = []
@@ -178,6 +192,7 @@ class _Parser:
                 break
             if self.peek() == "?":  # lazy: same language for matching
                 self.next()
+                self.has_lazy = True
         return atom
 
     def bounded_rep(self, atom: _Node) -> _Node:
@@ -202,18 +217,23 @@ class _Parser:
     def atom(self) -> _Node:
         ch = self.next()
         if ch == "(":
+            capturing = True
             if self.peek() == "?":
                 self.next()
                 nxt = self.peek()
                 if nxt == ":":
                     self.next()
+                    capturing = False
                 else:
                     self.fail("lookaround/named groups not supported")
+            if capturing:
+                self.n_groups += 1
+                idx = self.n_groups
             node = self.alternation()
             if self.peek() != ")":
                 self.fail("unbalanced '('")
             self.next()
-            return node
+            return _Group(node, idx) if capturing else node
         if ch == "[":
             return _Lit(self.char_class())
         if ch == ".":
@@ -300,8 +320,12 @@ class CompiledRegex:
         parser = _Parser(pattern)
         ast = parser.parse()
         self.pattern = pattern
+        self.ast = ast
         self.anchored_start = parser.anchored_start
         self.anchored_end = parser.anchored_end
+        self.n_groups = parser.n_groups
+        self.has_alternation = parser.has_alternation
+        self.has_lazy = parser.has_lazy
 
         # Thompson build over epsilon edges
         self.eps: List[Set[int]] = [set()]
@@ -367,6 +391,8 @@ class CompiledRegex:
                 end = self._build(opt, fork)
                 self.eps[end].add(out)
             return out
+        if isinstance(node, _Group):
+            return self._build(node.child, entry)
         if isinstance(node, _Rep):
             cur = entry
             for _ in range(node.lo):
@@ -441,6 +467,305 @@ def _simulate(rx: CompiledRegex, col: StringColumn):
     return matched
 
 
+# ---------------------------------------------------------------------------
+# match spans + capture extraction + replace (submatch machinery)
+#
+# The reference transpiles extract/replace onto cuDF's capture-aware
+# regex engine (RegexParser.scala:713 + cudf extract_re / replace_re).
+# The TPU design avoids per-thread backtracking entirely:
+#
+#   starts[p]  : one reversed-NFA pass over the reversed padded view
+#                marks every position where SOME match begins,
+#   p*         : leftmost such position (Java's leftmost rule),
+#   q*         : one anchored forward pass seeded at p* takes the
+#                LAST position where accept is active (longest match),
+#   groups     : for top-level-group patterns, each group boundary is
+#                max(forward-reachable prefix ends ∩ backward-feasible
+#                suffix starts) — the greedy split point.
+#
+# Leftmost-longest equals Java's leftmost-greedy for the patterns the
+# tagging admits (alternation-free, lazy-free); anything else falls
+# back to CPU `re`, mirroring transpile-or-fallback.
+# ---------------------------------------------------------------------------
+
+def _reverse_ast(node: _Node) -> _Node:
+    if isinstance(node, _Lit):
+        return node
+    if isinstance(node, _Cat):
+        return _Cat([_reverse_ast(p) for p in reversed(node.parts)])
+    if isinstance(node, _Alt):
+        return _Alt([_reverse_ast(o) for o in node.options])
+    if isinstance(node, _Rep):
+        return _Rep(_reverse_ast(node.child), node.lo, node.hi)
+    if isinstance(node, _Group):
+        return _Group(_reverse_ast(node.child), node.idx)
+    raise AssertionError(type(node))
+
+
+class _SubAutomaton:
+    """Epsilon-closed NFA for an AST fragment (no anchors)."""
+
+    def __init__(self, ast: _Node):
+        self.eps: List[Set[int]] = [set()]
+        self.byte_edges: List[Tuple[int, int, np.ndarray]] = []
+        start = self._new_state()
+        accept = self._build(ast, start)
+        self.n_states = len(self.eps)
+        if self.n_states > _MAX_STATES:
+            raise RegexUnsupported(
+                f"sub-automaton: {self.n_states} states > {_MAX_STATES}")
+        S = self.n_states
+        closure = np.eye(S, dtype=bool)
+        for s in range(S):
+            stack = [s]
+            while stack:
+                t = stack.pop()
+                for u in self.eps[t]:
+                    if not closure[s, u]:
+                        closure[s, u] = True
+                        stack.append(u)
+        self.closure = closure
+        classes: List[np.ndarray] = []
+        trans: List[Tuple[int, int, int]] = []
+        for (f, t, bs) in self.byte_edges:
+            for cid, c in enumerate(classes):
+                if np.array_equal(c, bs):
+                    break
+            else:
+                cid = len(classes)
+                classes.append(bs)
+            trans.append((f, cid, t))
+        self.classes = np.stack(classes) if classes else \
+            np.zeros((0, 256), bool)
+        self.transitions = trans
+        self.start = start
+        self.accept = accept
+        self.start_set = closure[start]
+
+    _new_state = CompiledRegex._new_state
+    _build = CompiledRegex._build
+
+
+def _step(auto, active, byte):
+    """One NFA byte step + epsilon closure. active:(cap,S)."""
+    import jax.numpy as jnp
+    cap = active.shape[0]
+    classes = jnp.asarray(auto.classes)
+    hit = classes[:, byte] if auto.classes.shape[0] else \
+        jnp.zeros((0, cap), jnp.bool_)
+    nxt = jnp.zeros_like(active)
+    for (f, cid, t) in auto.transitions:
+        nxt = nxt.at[:, t].set(nxt[:, t] | (active[:, f] & hit[cid]))
+    closure = jnp.asarray(auto.closure)
+    return (nxt.astype(jnp.float32) @ closure.astype(jnp.float32)) > 0
+
+
+def _find_starts(rx_rev: _SubAutomaton, padded, lens,
+                 end_anchored: bool = False):
+    """(cap, W+1) bool: a match of the ORIGINAL pattern starts at p.
+
+    Runs the reversed automaton right-to-left: a reversed match ending
+    at p (scanning leftward) is an original match starting at p. With
+    ``end_anchored`` the reversed run is seeded only at the string end,
+    so only matches ending exactly at len count."""
+    import jax.numpy as jnp
+    cap, W = padded.shape
+    starts = jnp.zeros((cap, W + 1), jnp.bool_)
+    start_set = jnp.asarray(rx_rev.start_set)
+    active = jnp.zeros((cap, rx_rev.n_states), jnp.bool_)
+    # scan j = W-1 .. 0; position p consumes bytes p..q-1, so after
+    # consuming byte j the reversed run has reached position j
+    acc = rx_rev.accept
+    empty_ok = bool(rx_rev.start_set[acc])
+    for j in range(W - 1, -1, -1):
+        in_str = j < lens
+        seed = (j + 1 == lens) if end_anchored else in_str
+        active = active | (start_set[None, :] & seed[:, None])
+        byte = padded[:, j].astype(jnp.int32)
+        active = _step(rx_rev, active, byte) & in_str[:, None]
+        starts = starts.at[:, j].set(active[:, acc])
+    pos = jnp.arange(W + 1, dtype=jnp.int32)
+    if empty_ok:
+        # the empty match starts at its own end position too
+        if end_anchored:
+            starts = starts | (pos[None, :] == lens[:, None])
+        else:
+            starts = starts | (pos[None, :] <= lens[:, None])
+    return starts
+
+
+def _forward_reach(auto: _SubAutomaton, padded, lens, seed_pos):
+    """(cap, W+1) bool: positions where `auto` can END, having started
+    exactly at per-row position seed_pos. reach[:, j] == accept active
+    after consuming bytes seed_pos..j-1."""
+    import jax.numpy as jnp
+    cap, W = padded.shape
+    start_set = jnp.asarray(auto.start_set)
+    acc = auto.accept
+    reach = jnp.zeros((cap, W + 1), jnp.bool_)
+    active = jnp.zeros((cap, auto.n_states), jnp.bool_)
+    seeded0 = seed_pos == 0
+    active = active | (start_set[None, :] & seeded0[:, None])
+    reach = reach.at[:, 0].set(active[:, acc])
+    for j in range(W):
+        in_str = j < lens
+        byte = padded[:, j].astype(jnp.int32)
+        active = _step(auto, active, byte) & in_str[:, None]
+        seeded = seed_pos == (j + 1)
+        active = active | (start_set[None, :] & seeded[:, None])
+        reach = reach.at[:, j + 1].set(active[:, acc])
+    return reach
+
+
+def _backward_reach(auto_rev: _SubAutomaton, padded, lens, end_pos):
+    """(cap, W+1) bool: positions p from which `auto` (given reversed)
+    can match ending exactly at per-row end_pos."""
+    import jax.numpy as jnp
+    cap, W = padded.shape
+    start_set = jnp.asarray(auto_rev.start_set)
+    acc = auto_rev.accept
+    reach = jnp.zeros((cap, W + 1), jnp.bool_)
+    active = jnp.zeros((cap, auto_rev.n_states), jnp.bool_)
+    seeded_end = end_pos == W
+    active = active | (start_set[None, :] & seeded_end[:, None])
+    reach = reach.at[:, W].set(active[:, acc])
+    for j in range(W - 1, -1, -1):
+        byte = padded[:, j].astype(jnp.int32)
+        active = _step(auto_rev, active, byte)
+        seeded = end_pos == j
+        active = active | (start_set[None, :] & seeded[:, None])
+        reach = reach.at[:, j].set(active[:, acc])
+    return reach
+
+
+def _leftmost(mask, limit):
+    """Per-row smallest index with mask true (W+1 when none)."""
+    import jax.numpy as jnp
+    cap, W1 = mask.shape
+    pos = jnp.arange(W1, dtype=jnp.int32)
+    big = jnp.int32(W1)
+    cand = jnp.where(mask & (pos[None, :] <= limit[:, None]), pos[None, :],
+                     big)
+    return jnp.min(cand, axis=1)
+
+
+def _rightmost(mask, limit):
+    """Per-row largest index <= limit with mask true (-1 when none)."""
+    import jax.numpy as jnp
+    cap, W1 = mask.shape
+    pos = jnp.arange(W1, dtype=jnp.int32)
+    cand = jnp.where(mask & (pos[None, :] <= limit[:, None]), pos[None, :],
+                     jnp.int32(-1))
+    return jnp.max(cand, axis=1)
+
+
+def _cached_autos(rx: CompiledRegex):
+    """(forward, reversed) sub-automatons, built once per pattern."""
+    if not hasattr(rx, "_fwd_auto"):
+        rx._fwd_auto = _SubAutomaton(rx.ast)
+        rx._rev_auto = _SubAutomaton(_reverse_ast(rx.ast))
+    return rx._fwd_auto, rx._rev_auto
+
+
+def first_match_span(rx: CompiledRegex, col: StringColumn):
+    """(found, start, end) of the leftmost-longest match per row."""
+    import jax.numpy as jnp
+    padded = col.padded()
+    lens = col.lengths()
+    fwd, rev = _cached_autos(rx)
+    starts = _find_starts(rev, padded, lens,
+                          end_anchored=rx.anchored_end)
+    if rx.anchored_start:
+        starts = starts & (jnp.arange(starts.shape[1],
+                                      dtype=jnp.int32)[None, :] == 0)
+    p = _leftmost(starts, lens)
+    found = p <= lens
+    p_safe = jnp.where(found, p, 0)
+    ends = _forward_reach(fwd, padded, lens, p_safe)
+    if rx.anchored_end:
+        ends = ends & (jnp.arange(ends.shape[1],
+                                  dtype=jnp.int32)[None, :] ==
+                       lens[:, None])
+    q = _rightmost(ends, lens)
+    found = found & (q >= 0)
+    return found, p_safe, jnp.where(found, q, 0)
+
+
+def _top_level_segments(rx: CompiledRegex):
+    """Split the pattern into top-level segments for group boundary
+    resolution; every capturing group must be a direct child of the
+    top-level concatenation. Returns [(ast, group_idx|None)]."""
+    ast = rx.ast
+    parts = ast.parts if isinstance(ast, _Cat) else [ast]
+    segs = []
+    for part in parts:
+        if isinstance(part, _Group):
+            if _contains_group(part.child):
+                raise RegexUnsupported("nested capture groups")
+            segs.append((part.child, part.idx))
+        else:
+            if _contains_group(part):
+                raise RegexUnsupported(
+                    "capture group under quantifier/alternation")
+            segs.append((part, None))
+    return segs
+
+
+def _contains_group(node: _Node) -> bool:
+    if isinstance(node, _Group):
+        return True
+    if isinstance(node, _Cat):
+        return any(_contains_group(p) for p in node.parts)
+    if isinstance(node, _Alt):
+        return any(_contains_group(o) for o in node.options)
+    if isinstance(node, _Rep):
+        return _contains_group(node.child)
+    return False
+
+
+def extract_group_spans(rx: CompiledRegex, col: StringColumn,
+                        group: int):
+    """(found, g_start, g_end) for capture group ``group`` of the
+    leftmost-longest match (greedy segment splits)."""
+    import jax.numpy as jnp
+    found, p, q = first_match_span(rx, col)
+    if group == 0:
+        return found, p, q
+    segs = _top_level_segments(rx)
+    padded = col.padded()
+    lens = col.lengths()
+    # boundary[i] = split position after segment i; boundary[-1] = p,
+    # boundary[len-1] = q. Greedy: each segment takes the largest split
+    # where the remaining suffix still matches ending at q.
+    target = None
+    for i, (_, gidx) in enumerate(segs):
+        if gidx == group:
+            target = i
+    if target is None:
+        raise RegexUnsupported(f"group {group} not found")
+    if not hasattr(rx, "_seg_autos"):
+        rx._seg_autos = {}
+    bound = p
+    g_start = p
+    for i, (seg_ast, gidx) in enumerate(segs):
+        if i not in rx._seg_autos:
+            suffix_parts = [a for a, _ in segs[i + 1:]]
+            rx._seg_autos[i] = (
+                _SubAutomaton(seg_ast),
+                _SubAutomaton(_reverse_ast(_Cat(suffix_parts))))
+        seg_auto, suffix_rev = rx._seg_autos[i]
+        prefix_reach = _forward_reach(seg_auto, padded, lens, bound)
+        feasible = _backward_reach(suffix_rev, padded, lens, q)
+        nxt = _rightmost(prefix_reach & feasible, lens)
+        nxt = jnp.where(found, jnp.maximum(nxt, 0).astype(jnp.int32),
+                        jnp.int32(0))
+        if gidx == group:
+            g_start = bound
+            return found, g_start, nxt
+        bound = nxt
+    raise AssertionError("unreached")
+
+
 class RLike(Expression):
     """rlike / regexp_like: unanchored regex search (GpuRLike)."""
 
@@ -466,10 +791,51 @@ class RLike(Expression):
         return f"{self.children[0]!r} RLIKE {self.pattern!r}"
 
 
+def check_submatch_supported(pattern: str, group: int = 0) -> CompiledRegex:
+    """Plan-time gate for device extract/replace: the span machinery is
+    leftmost-LONGEST, which equals Java's leftmost-greedy only without
+    alternation or lazy quantifiers; capture groups must sit directly in
+    the top-level concatenation. Raises RegexUnsupported -> CPU."""
+    rx = transpile(pattern)
+    if rx.has_alternation:
+        raise RegexUnsupported(
+            f"regex {pattern!r}: alternation changes leftmost-greedy vs "
+            "leftmost-longest; extract/replace falls back")
+    if rx.has_lazy:
+        raise RegexUnsupported(
+            f"regex {pattern!r}: lazy quantifiers in extract/replace "
+            "fall back")
+    if group > 0:
+        _top_level_segments(rx)  # raises for nested/quantified groups
+        if group > rx.n_groups:
+            raise RegexUnsupported(
+                f"regex {pattern!r} has no group {group}")
+    return rx
+
+
+def _substring_from_spans(col: StringColumn, found, start, end):
+    """Row substrings s[start:end] as a new StringColumn (empty when not
+    found — Spark regexp_extract's no-match result is '')."""
+    import jax.numpy as jnp
+    padded = col.padded()
+    cap, W = padded.shape
+    out_len = jnp.where(found, end - start, 0).astype(jnp.int32)
+    k = jnp.arange(W, dtype=jnp.int32)
+    src = start[:, None] + k[None, :]
+    out = jnp.where(k[None, :] < out_len[:, None],
+                    jnp.take_along_axis(
+                        padded, jnp.clip(src, 0, W - 1), axis=1),
+                    jnp.zeros((), jnp.uint8))
+    from .strings import pack_padded
+    return pack_padded(out, out_len, col.validity, W)
+
+
 class RegExpExtract(Expression):
-    """regexp_extract(str, pattern, group) — capture-group extraction
-    needs submatch tracking the NFA simulation doesn't do yet; planner
-    always falls back to CPU (python re) for this one."""
+    """regexp_extract(str, pattern, group): device capture extraction
+    via span finding + greedy segment splits (see module header). The
+    tagging pass admits only patterns check_submatch_supported accepts;
+    others run on CPU `re` (transpile-or-fallback,
+    RegexParser.scala:713 + cuDF extract_re in the reference)."""
 
     def __init__(self, child: Expression, pattern: str, group: int = 1):
         super().__init__(child)
@@ -479,15 +845,110 @@ class RegExpExtract(Expression):
     def data_type(self, schema: Schema) -> dt.DType:
         return dt.STRING
 
+    def eval(self, batch: ColumnarBatch) -> StringColumn:
+        c = self.children[0].eval(batch)
+        rx = check_submatch_supported(self.pattern, self.group)
+        found, gs, ge = extract_group_spans(rx, c, self.group)
+        return _substring_from_spans(c, found, gs, ge)
+
 
 class RegExpReplace(Expression):
-    """regexp_replace(str, pattern, replacement) — CPU fallback, as
-    above."""
+    """regexp_replace(str, pattern, replacement): replaces every
+    non-overlapping leftmost match (Java replaceAll, including empty
+    matches). One reversed pass finds all match starts; a while_loop
+    selects matches left to right (each iteration resolves one match
+    per row via an anchored forward pass), then the output assembles
+    with the same contribution-scatter StringReplace uses."""
 
     def __init__(self, child: Expression, pattern: str, replacement: str):
         super().__init__(child)
         self.pattern = pattern
         self.replacement = replacement
+        if "$" in replacement or "\\" in replacement:
+            # group references in the replacement need per-match group
+            # spans; CPU fallback handles them
+            self._repl_refs = True
+        else:
+            self._repl_refs = False
 
     def data_type(self, schema: Schema) -> dt.DType:
         return dt.STRING
+
+    def eval(self, batch: ColumnarBatch) -> StringColumn:
+        import jax
+        import jax.numpy as jnp
+        c = self.children[0].eval(batch)
+        rx = check_submatch_supported(self.pattern, 0)
+        fwd, rev = _cached_autos(rx)
+        padded = c.padded()
+        cap, W = padded.shape
+        lens = c.lengths()
+        starts = _find_starts(rev, padded, lens,
+                              end_anchored=rx.anchored_end)
+        if rx.anchored_start:
+            starts = starts & (jnp.arange(W + 1,
+                                          dtype=jnp.int32)[None, :] == 0)
+
+        def body(state):
+            cursor, starts_sel, in_match, done = state
+            p = _leftmost(starts & (jnp.arange(W + 1, dtype=jnp.int32)
+                                    [None, :] >= cursor[:, None]), lens)
+            row_live = (p <= lens) & ~done
+            p_safe = jnp.where(row_live, p, 0)
+            ends = _forward_reach(fwd, padded, lens, p_safe)
+            if rx.anchored_end:
+                ends = ends & (jnp.arange(W + 1, dtype=jnp.int32)
+                               [None, :] == lens[:, None])
+            q = _rightmost(ends, lens)
+            row_live = row_live & (q >= p_safe)
+            q_safe = jnp.where(row_live, q, 0)
+            starts_sel = starts_sel.at[
+                jnp.arange(cap), p_safe].set(
+                starts_sel[jnp.arange(cap), p_safe] | row_live)
+            pos = jnp.arange(W, dtype=jnp.int32)
+            covered = (pos[None, :] >= p_safe[:, None]) & \
+                (pos[None, :] < q_safe[:, None]) & row_live[:, None]
+            in_match = in_match | covered
+            new_cursor = jnp.where(
+                row_live,
+                q_safe + (q_safe == p_safe).astype(jnp.int32),
+                cursor)
+            done = done | ~row_live
+            return new_cursor, starts_sel, in_match, done
+
+        def cond(state):
+            return ~jnp.all(state[3])
+
+        init = (jnp.zeros(cap, jnp.int32),
+                jnp.zeros((cap, W + 1), jnp.bool_),
+                jnp.zeros((cap, W), jnp.bool_),
+                jnp.zeros(cap, jnp.bool_))
+        _, starts_sel, in_match, _ = jax.lax.while_loop(cond, body, init)
+
+        repl = np.frombuffer(self.replacement.encode("utf-8"), np.uint8)
+        nr = len(repl)
+        # contribution per position 0..W (position W only carries an
+        # end-of-string empty match's replacement)
+        pos = jnp.arange(W + 1, dtype=jnp.int32)
+        keep = jnp.concatenate(
+            [~in_match, jnp.zeros((cap, 1), jnp.bool_)], axis=1) & \
+            (pos[None, :] < lens[:, None])
+        contrib = starts_sel.astype(jnp.int32) * nr + keep.astype(jnp.int32)
+        out_pos = jnp.cumsum(contrib, axis=1) - contrib
+        out_len = jnp.sum(contrib, axis=1)
+        from ..columnar.vector import round_pow2
+        # worst case: an empty match (nr bytes) at every position 0..W
+        # plus every original byte kept
+        out_w = round_pow2(max(W * (nr + 1) + nr, 8))
+        out = jnp.zeros((cap, out_w), jnp.uint8)
+        rows = jnp.arange(cap)[:, None]
+        for off in range(nr):
+            tgt = jnp.clip(out_pos + off, 0, out_w - 1)
+            out = out.at[rows, tgt].max(
+                jnp.where(starts_sel, jnp.uint8(repl[off]), 0))
+        lit_tgt = jnp.clip(out_pos[:, :W] + nr * starts_sel[:, :W], 0,
+                           out_w - 1)
+        out = out.at[rows, lit_tgt].max(
+            jnp.where(keep[:, :W], padded, 0))
+        from .strings import pack_padded
+        return pack_padded(out, out_len, c.validity, out_w)

@@ -714,6 +714,64 @@ def _nvl2(expr, table):
     return _select_eval(expr, table, [(IsNotNull(x), a)], b)
 
 
+# --- timezone conversions (independent per-row zoneinfo oracle) -----------
+
+def _tz_oracle(name: str):
+    import datetime
+    import zoneinfo
+    from ..expr.timezone import _fixed_offset_us
+    fixed = _fixed_offset_us(name)
+    if fixed is not None:
+        return datetime.timezone(
+            datetime.timedelta(microseconds=fixed))
+    return zoneinfo.ZoneInfo(name)
+
+
+def _utc_offset_us(tz, us: int) -> int:
+    import datetime
+    from ..expr import timezone as TZX
+    # clamp to the device transition tables' probe horizon (1800..2200):
+    # past it the device freezes on the last known offset, so the oracle
+    # asks zoneinfo for the horizon instant instead of the raw one
+    lo = int((TZX._PROBE_START - TZX._EPOCH).total_seconds()) * 1_000_000
+    hi = int((TZX._PROBE_END - TZX._EPOCH).total_seconds()) * 1_000_000 - 1
+    us = max(lo, min(int(us), hi))
+    inst = datetime.datetime(1970, 1, 1, tzinfo=datetime.timezone.utc) + \
+        datetime.timedelta(microseconds=us)
+    return int(inst.astimezone(tz).utcoffset().total_seconds()) * 1_000_000
+
+
+def _reg_tz():
+    from ..expr import timezone as TZX
+
+    @_reg(TZX.FromUTCTimestamp)
+    def _from_utc(expr, table):
+        a, m = _ev(expr.children[0], table)
+        tz = _tz_oracle(expr.zone)
+        out = np.array([int(v) + _utc_offset_us(tz, v) if mk else 0
+                        for v, mk in zip(a, m)], np.int64)
+        return out, m
+
+    @_reg(TZX.ToUTCTimestamp)
+    def _to_utc(expr, table):
+        # mirror the device's two-step offset resolution, but with
+        # per-row zoneinfo lookups (independent of the transition-table
+        # builder the device uses)
+        a, m = _ev(expr.children[0], table)
+        tz = _tz_oracle(expr.zone)
+        out = np.zeros(len(a), np.int64)
+        for i, (v, mk) in enumerate(zip(a, m)):
+            if not mk:
+                continue
+            o1 = _utc_offset_us(tz, v)
+            o2 = _utc_offset_us(tz, int(v) - o1)
+            out[i] = int(v) - o2
+        return out, m
+
+
+_reg_tz()
+
+
 # ---------------------------------------------------------------------------
 # math
 # ---------------------------------------------------------------------------
@@ -1016,8 +1074,12 @@ def _days_to_ymd(days):
 def _date_field(fn):
     def ev(expr, table):
         a, m = _ev(expr.children[0], table)
-        y, mo, dnum = _days_to_ymd(a.astype(np.int64))
-        out = fn(a.astype(np.int64), y, mo, dnum).astype(np.int32)
+        t = expr.children[0].data_type(table.schema())
+        days = a.astype(np.int64)
+        if isinstance(t, dt.TimestampType):
+            days = np.floor_divide(days, 86_400_000_000)
+        y, mo, dnum = _days_to_ymd(days)
+        out = fn(days, y, mo, dnum).astype(np.int32)
         return _zero_nulls(out, m), m
     return ev
 
@@ -1449,12 +1511,45 @@ def _regexp_extract(expr, table):
     return np.where(m, out, ""), m
 
 
+def _java_replacement(repl: str):
+    """Java replacement syntax -> python re template: $N / ${N} are
+    group refs, backslash escapes the next char (incl. literal $)."""
+    out = []
+    i = 0
+    while i < len(repl):
+        ch = repl[i]
+        if ch == "\\" and i + 1 < len(repl):
+            nxt = repl[i + 1]
+            out.append("\\\\" if nxt == "\\" else nxt.replace(
+                "\\", "\\\\"))
+            i += 2
+            continue
+        if ch == "$" and i + 1 < len(repl):
+            j = i + 1
+            if repl[j] == "{":
+                k = repl.find("}", j)
+                out.append("\\g<" + repl[j + 1:k] + ">")
+                i = k + 1
+                continue
+            digits = ""
+            while j < len(repl) and repl[j].isdigit():
+                digits += repl[j]
+                j += 1
+            if digits:
+                out.append("\\g<" + digits + ">")
+                i = j
+                continue
+        out.append(ch if ch != "\\" else "\\\\")
+        i += 1
+    return "".join(out)
+
+
 @_reg(RX.RegExpReplace)
 def _regexp_replace(expr, table):
     import re
     a, m = _ev(expr.children[0], table)
     prog = _java_like_re(expr.pattern)
-    repl = expr.replacement
+    repl = _java_replacement(expr.replacement)
     out = np.array([prog.sub(repl, x) for x in a], dtype=object) \
         if len(a) else np.empty(0, object)
     return np.where(m, out, ""), m

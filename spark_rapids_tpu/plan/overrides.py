@@ -200,25 +200,57 @@ def _tag_rlike(meta: ExprMeta):
         meta.will_not_work_on_tpu(f"rlike: {e}")
 
 
+def _tag_regexp_extract(meta: ExprMeta):
+    from ..expr.regex import RegexUnsupported, check_submatch_supported
+    try:
+        check_submatch_supported(meta.expr.pattern, meta.expr.group)
+    except RegexUnsupported as e:
+        meta.will_not_work_on_tpu(f"regexp_extract: {e}")
+
+
+def _tag_regexp_replace(meta: ExprMeta):
+    from ..expr.regex import RegexUnsupported, check_submatch_supported
+    if meta.expr._repl_refs:
+        meta.will_not_work_on_tpu(
+            "regexp_replace: group references in the replacement run "
+            "on CPU")
+        return
+    try:
+        check_submatch_supported(meta.expr.pattern, 0)
+    except RegexUnsupported as e:
+        meta.will_not_work_on_tpu(f"regexp_replace: {e}")
+
+
 def _register_regex_rules():
     from ..expr import regex as RX
     _EXPR_RULES[RX.RLike] = ExprRule(RX.RLike, ts.TypeSig(ts.STRING),
                                      _tag_rlike)
-    # extract/replace need submatch tracking: CPU-only for now — no rule
-    # registered means the tagging pass routes them to the CPU engine.
+    # extract/replace run on device via span finding + greedy segment
+    # splits (expr/regex.py submatch machinery); patterns outside that
+    # envelope tag to CPU `re` (transpile-or-fallback)
+    _EXPR_RULES[RX.RegExpExtract] = ExprRule(
+        RX.RegExpExtract, ts.TypeSig(ts.STRING), _tag_regexp_extract)
+    _EXPR_RULES[RX.RegExpReplace] = ExprRule(
+        RX.RegExpReplace, ts.TypeSig(ts.STRING), _tag_regexp_replace)
 
 
 _register_regex_rules()
 
+# date fields accept timestamps too (micros -> days in _to_days)
 for _cls in (D.Year, D.Month, D.DayOfMonth, D.Quarter, D.DayOfWeek,
              D.WeekDay, D.DayOfYear, D.LastDay):
-    _expr(_cls, ts.TypeSig(ts.DATE))
+    _expr(_cls, ts.TypeSig(ts.DATE, ts.TIMESTAMP))
 for _cls in (D.Hour, D.Minute, D.Second, D.UnixTimestampToSeconds):
     _expr(_cls, ts.TypeSig(ts.TIMESTAMP))
 for _cls in (D.DateAdd, D.DateSub, D.DateDiff):
     _expr(_cls, ts.TypeSig(ts.DATE) + ts.integral)
 _expr(D.AddMonths, ts.TypeSig(ts.DATE) + ts.integral)
 _expr(D.FromUnixTime, ts.integral)
+
+from ..expr import timezone as TZX  # noqa: E402
+
+for _cls in (TZX.FromUTCTimestamp, TZX.ToUTCTimestamp):
+    _expr(_cls, ts.TypeSig(ts.TIMESTAMP))
 _expr(D.MakeDate, ts.integral)
 _expr(D.TruncDate, ts.TypeSig(ts.DATE, ts.STRING))
 

@@ -390,6 +390,12 @@ class Parser:
             if pat.kind != "STRING":
                 raise SqlError("LIKE pattern must be a string literal")
             return LikeA(e, pat.value, neg)
+        if self.accept_kw("rlike", "regexp"):
+            pat = self.next()
+            if pat.kind != "STRING":
+                raise SqlError("RLIKE pattern must be a string literal")
+            return FnA("rlike", [e, LitA(pat.value)]) if not neg else \
+                UnA("not", FnA("rlike", [e, LitA(pat.value)]))
         if neg:
             raise SqlError("dangling NOT before non-predicate")
         if self.accept_kw("is"):
@@ -624,8 +630,13 @@ class _Scope:
     column names), and this mapping resolves qualified references to the
     right copy."""
 
-    def __init__(self, entries):
+    def __init__(self, entries, types=None):
         self.entries = list(entries)
+        #: internal column name -> DType (for type-dependent lowering)
+        self.types = dict(types or {})
+
+    def type_schema(self):
+        return list(self.types.items())
 
     def resolve(self, name: str, qualifier: Optional[str]) -> str:
         if qualifier is not None:
@@ -736,7 +747,8 @@ class Analyzer:
             # SELECT without FROM: single-row relation
             base = self.session.create_dataframe({"__one": [1]},
                                                  [("__one", dt.INT32)])
-            scope = _Scope([("", [("__one", "__one")])])
+            scope = _Scope([("", [("__one", "__one")])],
+                           {"__one": dt.INT32})
             return self._finish(base, scope, s)
 
         entries = []           # [(alias, DataFrame)]
@@ -751,10 +763,11 @@ class Analyzer:
                 seen_names[n.lower()] = seen_names.get(n.lower(), 0) + 1
         scope_entries = []
         renamed_entries = []
+        type_map = {}
         for alias, df in entries:
             cols = []
             renames = []
-            for n, _ in df.schema:
+            for n, t in df.schema:
                 if seen_names[n.lower()] > 1:
                     internal = f"__{alias}__{n}"
                     renames.append(Alias(col(n), internal))
@@ -762,12 +775,13 @@ class Analyzer:
                 else:
                     renames.append(col(n))
                     cols.append((n, n))
+                type_map[cols[-1][1]] = t
             if any(isinstance(r, Alias) for r in renames):
                 df = df.select(*renames)
             scope_entries.append((alias, cols))
             renamed_entries.append((alias, df))
         entries = renamed_entries
-        scope = _Scope(scope_entries)
+        scope = _Scope(scope_entries, type_map)
 
         conjuncts = self._conjuncts(s.where)
         used = [False] * len(conjuncts)
@@ -797,7 +811,8 @@ class Analyzer:
                 if tabs == {alias.lower()} and alias.lower() in preserved:
                     preds.append(c)
                     used[ci] = True
-            sub = _Scope([e for e in scope.entries if e[0] == alias])
+            sub = _Scope([e for e in scope.entries if e[0] == alias],
+                         scope.types)
             for p in preds:
                 df = df.filter(self.lower(p, sub))
             table_df[alias.lower()] = df
@@ -809,13 +824,14 @@ class Analyzer:
 
         def current_scope():
             return _Scope([(a, cs) for a, cs in scope.entries
-                           if a.lower() in joined_aliases])
+                           if a.lower() in joined_aliases], scope.types)
 
         def equi_keys(on_conjs, other_alias):
             """Split conjuncts into equi key pairs vs residual."""
             lk, rk, residual = [], [], []
             right_scope = _Scope([(a, cs) for a, cs in scope.entries
-                                  if a.lower() == other_alias])
+                                  if a.lower() == other_alias],
+                                 scope.types)
             left_scope = current_scope()
             for c in on_conjs:
                 if isinstance(c, BinA) and c.op == "=":
@@ -1214,6 +1230,32 @@ class Analyzer:
                 raise SqlError(f"{name}(DISTINCT ...) not supported yet")
             return _AGG_FNS[name](self.lower(ast.args[0], scope))
         args = [self.lower(a, scope) for a in ast.args]
+        _TS_FIELD_FNS = ("hour", "minute", "second", "year", "month",
+                         "day", "dayofmonth", "quarter", "dayofweek",
+                         "dayofyear", "weekday", "last_day")
+        if name in _TS_FIELD_FNS:
+            # field extraction follows the session timezone
+            # (spark.sql.session.timeZone) when the input is a
+            # timestamp; date inputs and UTC sessions skip the convert
+            from ..conf import SESSION_TIMEZONE
+            self._arity(ast, 1)
+            zone = self.session.conf.get(SESSION_TIMEZONE)
+            arg = args[0]
+            is_ts = name in ("hour", "minute", "second")
+            if not is_ts:
+                try:
+                    is_ts = isinstance(arg.data_type(scope.type_schema()),
+                                       dt.TimestampType)
+                except Exception:
+                    is_ts = False
+            if is_ts and zone not in ("UTC", "GMT", "+00:00", "Z"):
+                from ..expr import timezone as TZX
+                try:
+                    arg = TZX.FromUTCTimestamp(arg, zone)
+                except Exception as e:
+                    raise SqlError(
+                        f"session timezone {zone!r}: {e}")
+            return _UNARY_FNS[name](arg)
         if name in _UNARY_FNS:
             self._arity(ast, 1)
             return _UNARY_FNS[name](args[0])
@@ -1271,6 +1313,36 @@ class Analyzer:
         if name == "trunc":
             fmt = self._lit_value(ast.args[1], "trunc format")
             return D.TruncDate(args[0], lit(fmt))
+        if name == "regexp_extract":
+            from ..expr import regex as RX
+            if len(ast.args) not in (2, 3):
+                raise SqlError("regexp_extract expects 2 or 3 arguments, "
+                               f"got {len(ast.args)}")
+            pat = self._lit_value(ast.args[1], "pattern")
+            grp = self._lit_value(ast.args[2], "group") \
+                if len(ast.args) > 2 else 1
+            return RX.RegExpExtract(args[0], pat, grp)
+        if name == "regexp_replace":
+            from ..expr import regex as RX
+            self._arity(ast, 3)
+            return RX.RegExpReplace(
+                args[0], self._lit_value(ast.args[1], "pattern"),
+                self._lit_value(ast.args[2], "replacement"))
+        if name in ("rlike", "regexp_like", "regexp"):
+            from ..expr import regex as RX
+            self._arity(ast, 2)
+            return RX.RLike(args[0], self._lit_value(ast.args[1],
+                                                     "pattern"))
+        if name in ("from_utc_timestamp", "to_utc_timestamp"):
+            from ..expr import timezone as TZX
+            self._arity(ast, 2)
+            zone = self._lit_value(ast.args[1], "timezone")
+            cls = TZX.FromUTCTimestamp if name == "from_utc_timestamp" \
+                else TZX.ToUTCTimestamp
+            try:
+                return cls(args[0], zone)
+            except Exception as e:
+                raise SqlError(f"{name}: {e}")
         raise SqlError(f"unknown function {name!r}")
 
     def _arity(self, ast: FnA, n: int):

@@ -1,0 +1,134 @@
+"""Device regexp_extract / regexp_replace (NFA span + segment-split
+submatch machinery) — differential vs the CPU python-re oracle.
+
+Reference: RegexParser.scala transpile targets + cuDF extract_re /
+replace_re. Patterns outside the device envelope (alternation, lazy,
+nested groups, replacement group refs) must tag to CPU fallback.
+"""
+
+import pytest
+
+from spark_rapids_tpu.expr import regex as RX
+from spark_rapids_tpu.expr.core import col
+from spark_rapids_tpu.plan import TpuSession
+from spark_rapids_tpu.testing import (StringGen, assert_falls_back_to_cpu,
+                                      assert_tpu_cpu_equal_df, gen_table)
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession()
+
+
+def make_df(session, gen, n=200, seed=0):
+    data, schema = gen_table({"s": gen}, n, seed)
+    return session.create_dataframe(data, schema)
+
+
+
+
+@pytest.mark.parametrize("pattern,group", [
+    (r"\d+", 0),
+    (r"(\d+)", 1),
+    (r"([a-c]+)\d", 1),
+    (r"(\w+)-(\d+)", 2),
+    (r"x(\d*)y", 1),
+    (r"^(\w+)", 1),
+])
+def test_extract_differential(session, pattern, group):
+    df = make_df(session, StringGen(max_len=10), seed=hash(pattern) % 89)
+    assert_tpu_cpu_equal_df(df.select(
+        RX.RegExpExtract(col("s"), pattern, group).alias("g")))
+
+
+def test_extract_known_values(session):
+    df = session.create_dataframe(
+        {"s": ["abc-123", "x9y", "no digits", None, "7", ""]})
+    out = df.select(
+        RX.RegExpExtract(col("s"), r"(\w+)-(\d+)", 2).alias("g2"),
+        RX.RegExpExtract(col("s"), r"\d+", 0).alias("whole")).to_pydict()
+    assert out["g2"] == ["123", "", "", None, "", ""]
+    assert out["whole"] == ["123", "9", "", None, "7", ""]
+
+
+@pytest.mark.parametrize("pattern,repl", [
+    (r"\d+", "#"),
+    (r"\d", ""),
+    (r"[aeiou]+", "<>"),
+    (r"\s+", "_"),
+    (r"x*", "!"),          # empty matches: Java replaceAll semantics
+])
+def test_replace_differential(session, pattern, repl):
+    df = make_df(session, StringGen(max_len=10), seed=hash(pattern) % 83)
+    assert_tpu_cpu_equal_df(df.select(
+        RX.RegExpReplace(col("s"), pattern, repl).alias("r")))
+
+
+def test_replace_known_values(session):
+    df = session.create_dataframe({"s": ["a1b22c333", "", "xyz", None]})
+    out = df.select(
+        RX.RegExpReplace(col("s"), r"\d+", "#").alias("r"),
+        RX.RegExpReplace(col("s"), r"q*", "-").alias("e")).to_pydict()
+    assert out["r"] == ["a#b#c#", "", "xyz", None]
+    # java: "xyz".replaceAll("q*", "-") == "-x-y-z-"
+    assert out["e"][2] == "-x-y-z-"
+    assert out["e"][1] == "-"
+
+
+def test_anchored_extract(session):
+    df = session.create_dataframe({"s": ["abc", "zabc", "ab", ""]})
+    out = df.select(
+        RX.RegExpExtract(col("s"), r"^a(\w)c$", 1).alias("g")).to_pydict()
+    assert out["g"] == ["b", "", "", ""]
+
+
+def test_anchored_replace_end(session):
+    df = session.create_dataframe({"s": ["aba", "ab", "ba"]})
+    out = df.select(
+        RX.RegExpReplace(col("s"), r"a$", "X").alias("r")).to_pydict()
+    assert out["r"] == ["abX", "ab", "bX"]
+
+
+def test_unsupported_patterns_fall_back(session):
+    df = make_df(session, StringGen(max_len=8))
+    # alternation: leftmost-greedy != leftmost-longest -> CPU
+    assert_falls_back_to_cpu(df.select(
+        RX.RegExpExtract(col("s"), r"(a|ab)", 1).alias("g")))
+    # lazy quantifier -> CPU
+    assert_falls_back_to_cpu(df.select(
+        RX.RegExpReplace(col("s"), r"a+?", "x").alias("r")))
+    # nested capture groups -> CPU
+    assert_falls_back_to_cpu(df.select(
+        RX.RegExpExtract(col("s"), r"((a)b)", 2).alias("g")))
+    # replacement group refs -> CPU
+    assert_falls_back_to_cpu(df.select(
+        RX.RegExpReplace(col("s"), r"(a)", "$1$1").alias("r")))
+    # fallback results still correct
+    assert_tpu_cpu_equal_df(df.select(
+        RX.RegExpExtract(col("s"), r"(a|ab)", 1).alias("g")))
+
+
+def test_sql_regexp_functions(session):
+    df = session.create_dataframe({"s": ["item-42", "none"]})
+    session.create_or_replace_temp_view("rx", df)
+    got = session.sql(
+        "select regexp_extract(s, '(\\w+)-(\\d+)', 2) n, "
+        "regexp_replace(s, '\\d+', '#') r, "
+        "s rlike '\\d' has_d from rx").to_pydict()
+    assert got["n"] == ["42", ""]
+    assert got["r"] == ["item-#", "none"]
+    assert got["has_d"] == [True, False]
+
+
+def test_replacement_group_refs_cpu_java_syntax(session):
+    # $1 refs fall back to CPU, which must implement JAVA replacement
+    # syntax (python re's \1 templates differ)
+    df = session.create_dataframe({"s": ["abc", "xyz"]})
+    out = df.select(
+        RX.RegExpReplace(col("s"), r"(a)(b)", "$2$1").alias("r"),
+        RX.RegExpReplace(col("s"), r"(x)", "<${1}>").alias("br"),
+        RX.RegExpReplace(col("s"), r"(c)", "\\$1").alias("esc")
+    ).to_pydict()
+    assert out["r"] == ["bac", "xyz"]
+    assert out["br"] == ["abc", "<x>yz"]
+    assert out["esc"] == ["ab$1", "xyz"]  # \$ = literal dollar

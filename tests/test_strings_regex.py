@@ -118,9 +118,11 @@ def test_unsupported_regex_falls_back(session):
     assert_falls_back_to_cpu(q, "rlike")
 
 
-def test_regexp_extract_replace_cpu(session):
-    """extract/replace are CPU-engine expressions (no TPU rule yet):
-    results must flow through fallback and match python re."""
+def test_regexp_extract_replace_on_device(session):
+    """extract/replace now run on the TPU span/segment machinery for
+    supported patterns (tests/test_regex_submatch.py covers breadth);
+    results match python re and no fallback is taken."""
+    from spark_rapids_tpu.testing import assert_tpu_cpu_equal_df
     df = session.create_dataframe(
         {"s": ["foo123bar", "no digits", "9x8", None]})
     q = df.select(
@@ -129,7 +131,7 @@ def test_regexp_extract_replace_cpu(session):
     out = q.collect()
     assert [r["ex"] for r in out] == ["123", "", "9", None]
     assert [r["rp"] for r in out] == ["foo#bar", "no digits", "#x#", None]
-    assert_falls_back_to_cpu(q, "no TPU")
+    assert_tpu_cpu_equal_df(q)
 
 
 def test_anchor_with_alternation_falls_back(session):
