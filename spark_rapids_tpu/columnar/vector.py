@@ -245,9 +245,13 @@ class ColumnarBatch:
         duplicated): string columns keep their tight byte buffers."""
         cap = indices.shape[0]
         valid = live_mask(cap, new_num_rows)
-        cols = [c.gather(indices, valid, unique=unique)
-                if isinstance(c, StringColumn) else c.gather(indices, valid)
-                for c in self.columns]
+
+        def g(c):
+            from .nested import ListColumn
+            if isinstance(c, (StringColumn, ListColumn)):
+                return c.gather(indices, valid, unique=unique)
+            return c.gather(indices, valid)
+        cols = [g(c) for c in self.columns]
         return ColumnarBatch(cols, self.names, new_num_rows)
 
     def schema(self):

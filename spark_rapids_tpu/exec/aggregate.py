@@ -42,8 +42,12 @@ def _state_col_name(agg_index: int, state_name: str) -> str:
 
 def make_agg_result(data, validity, out_t: dt.DType):
     """Finalized aggregate -> output column. Decimal aggregates with
-    128-bit states finalize to (hi, lo) limb tuples; everything else is
-    a plain lane array."""
+    128-bit states finalize to (hi, lo) limb tuples, string min/max to
+    a StringColumn; everything else is a plain lane array."""
+    from ..columnar.nested import ListColumn
+    from ..columnar.vector import StringColumn
+    if isinstance(data, (StringColumn, ListColumn)):
+        return data.with_validity(data.validity & validity)
     if isinstance(data, tuple):
         from ..columnar import decimal128 as d128
         hi, lo = data
@@ -138,24 +142,36 @@ class HashAggregateExec(TpuExec):
         for kc, name in zip(key_batch.columns, self._key_names):
             cols.append(kc)
             names.append(name)
+        from ..columnar.nested import ListColumn
+        from ..columnar.vector import StringColumn
         for i, ((fn, _), sschema) in enumerate(
                 zip(self.agg_exprs, self._state_schemas)):
             for sname, stype in sschema:
                 arr = states[i][sname]
-                if arr.dtype == jnp.bool_:
-                    data = arr & lm
+                if isinstance(arr, (StringColumn, ListColumn)):
+                    # Column-valued state (string min/max): the column
+                    # itself is the buffer; validity carries "seen"
+                    cols.append(arr.with_validity(arr.validity & lm))
+                elif arr.dtype == jnp.bool_:
+                    cols.append(ColumnVector(arr & lm, lm, stype))
                 else:
                     data = jnp.where(lm, arr, jnp.zeros((), arr.dtype))
-                cols.append(ColumnVector(data, lm, stype))
+                    cols.append(ColumnVector(data, lm, stype))
                 names.append(_state_col_name(i, sname))
         return ColumnarBatch(cols, names, num_groups)
 
     def _unpack(self, batch: ColumnarBatch):
+        from ..columnar.nested import ListColumn
+        from ..columnar.vector import StringColumn
         key_cols = [batch.column(n) for n in self._key_names]
         states = []
         for i, sschema in enumerate(self._state_schemas):
-            states.append({sname: batch.column(_state_col_name(i, sname)).data
-                           for sname, _ in sschema})
+            d = {}
+            for sname, _ in sschema:
+                c = batch.column(_state_col_name(i, sname))
+                d[sname] = c if isinstance(c, (StringColumn, ListColumn)) \
+                    else c.data
+            states.append(d)
         return key_cols, states
 
     # --- phase 2: merge partials + finalize ---
@@ -326,6 +342,23 @@ class HashAggregateExec(TpuExec):
         for i, (fn, name) in enumerate(self.agg_exprs):
             zero_states = {}
             for sname, stype in self._state_schemas[i]:
+                if stype == dt.STRING:
+                    from ..columnar.vector import StringColumn
+                    zero_states[sname] = StringColumn(
+                        jnp.zeros(cap + 1, jnp.int32),
+                        jnp.zeros(8, jnp.uint8),
+                        jnp.zeros(cap, jnp.bool_), pad_bucket=8)
+                    continue
+                if isinstance(stype, dt.ArrayType):
+                    from ..columnar.nested import ListColumn
+                    from ..columnar.vector import ColumnVector
+                    et = stype.element_type
+                    zero_states[sname] = ListColumn(
+                        jnp.zeros(cap + 1, jnp.int32),
+                        ColumnVector(jnp.zeros(8, et.physical),
+                                     jnp.zeros(8, jnp.bool_), et),
+                        jnp.ones(cap, jnp.bool_), et)
+                    continue
                 phys = stype.physical
                 zero_states[sname] = jnp.zeros(cap, phys)
             data, ok = fn.finalize(zero_states)

@@ -78,6 +78,97 @@ def _prev_differs(cols: Sequence[Column]) -> jnp.ndarray:
     return ~eq
 
 
+def _seg_lower_bound(keys, seg_start, seg_end, query):
+    """Per-row first index j in [seg_start_i, seg_end_i] with
+    keys[j] >= query_i (vectorized binary search, ~log2(cap) steps)."""
+    cap = keys.shape[0]
+    lo = seg_start
+    hi = seg_end + 1  # exclusive
+    steps = max(cap.bit_length(), 1)
+    for _ in range(steps):
+        mid = (lo + hi) // 2
+        ge = jnp.take(keys, jnp.clip(mid, 0, cap - 1)) >= query
+        go_left = ge & (lo < hi)
+        hi = jnp.where(go_left, mid, hi)
+        lo = jnp.where(~ge & (lo < hi), mid + 1, lo)
+    return lo
+
+
+def _seg_upper_bound(keys, seg_start, seg_end, query):
+    """Per-row last index j in [seg_start_i, seg_end_i] with
+    keys[j] <= query_i (hi_i = lower_bound(> query) - 1)."""
+    cap = keys.shape[0]
+    lo = seg_start
+    hi = seg_end + 1
+    steps = max(cap.bit_length(), 1)
+    for _ in range(steps):
+        mid = (lo + hi) // 2
+        gt = jnp.take(keys, jnp.clip(mid, 0, cap - 1)) > query
+        go_left = gt & (lo < hi)
+        hi = jnp.where(go_left, mid, hi)
+        lo = jnp.where(~gt & (lo < hi), mid + 1, lo)
+    return lo - 1
+
+
+def _range_sum(vals, lo_i, hi_i, cap, width_empty):
+    """Frame sums via prefix-sum differences, IEEE-safe for floats: a
+    +/-inf or NaN anywhere in the partition must only poison frames
+    that actually CONTAIN it (a naive cumsum difference yields inf-inf
+    = NaN for every frame after the value)."""
+    def diff(ps, zero):
+        top = ps[jnp.clip(hi_i, 0, cap - 1)]
+        bot = jnp.where(lo_i > 0, ps[jnp.clip(lo_i - 1, 0, cap - 1)], zero)
+        return top - bot
+
+    if not jnp.issubdtype(vals.dtype, jnp.floating):
+        out = diff(jnp.cumsum(vals), jnp.zeros((), vals.dtype))
+        return jnp.where(width_empty, 0, out)
+    finite = jnp.isfinite(vals)
+    base = diff(jnp.cumsum(jnp.where(finite, vals, 0.0)),
+                jnp.zeros((), vals.dtype))
+
+    def present(mask):
+        return diff(jnp.cumsum(mask.astype(jnp.int32)), 0) > 0
+    pos_inf = present(vals == jnp.inf)
+    neg_inf = present(vals == -jnp.inf)
+    has_nan = present(jnp.isnan(vals))
+    out = jnp.where(pos_inf & ~neg_inf, jnp.inf,
+                    jnp.where(neg_inf & ~pos_inf, -jnp.inf, base))
+    out = jnp.where(has_nan | (pos_inf & neg_inf), jnp.nan, out)
+    return jnp.where(width_empty, 0.0, out)
+
+
+def _range_count(cnt_vals, lo_i, hi_i, cap, width_empty):
+    ccnt = jnp.cumsum(cnt_vals)
+    top = ccnt[jnp.clip(hi_i, 0, cap - 1)]
+    bot = jnp.where(lo_i > 0, ccnt[jnp.clip(lo_i - 1, 0, cap - 1)], 0)
+    return jnp.where(width_empty, 0, top - bot)
+
+
+def _rmq(vals, lo_i, hi_i, cap, op, out_t):
+    """Range min/max query via a doubling sparse table: O(cap log cap)
+    build, two gathers per query."""
+    fill = dt.max_value(out_t) if op is jnp.minimum else dt.min_value(out_t)
+    levels = [vals]
+    span = 1
+    while span < cap:
+        prev = levels[-1]
+        shifted = jnp.concatenate(
+            [prev[span:], jnp.full((span,), fill, prev.dtype)])
+        levels.append(op(prev, shifted))
+        span *= 2
+    table = jnp.stack(levels)                       # (L, cap)
+    w = jnp.maximum(hi_i - lo_i + 1, 1)
+    kk = jnp.floor(jnp.log2(w.astype(jnp.float64))).astype(jnp.int32)
+    pow2 = jnp.left_shift(jnp.int32(1), kk)
+    flat = table.reshape(-1)
+    a = jnp.take(flat, jnp.clip(kk * cap + lo_i, 0, flat.shape[0] - 1))
+    b = jnp.take(flat, jnp.clip(kk * cap + hi_i - pow2 + 1, 0,
+                                flat.shape[0] - 1))
+    out = op(a, b)
+    return jnp.where(hi_i < lo_i, jnp.asarray(fill, vals.dtype), out)
+
+
 class WindowExec(TpuExec):
     """Computes window columns for expressions sharing one
     (partition_by, order_by) spec; appends them to the child schema."""
@@ -217,12 +308,14 @@ class WindowExec(TpuExec):
         if isinstance(fn, AggregateFunction):
             return self._window_aggregate(fn, we.spec.frame, sorted_batch,
                                           idx, s_live, new_part, gid,
-                                          seg_start, counts, run_end, cap)
+                                          seg_start, counts, run_end, cap,
+                                          spec=we.spec)
         raise NotImplementedError(type(fn).__name__)
 
     def _window_aggregate(self, fn: AggregateFunction, frame: WindowFrame,
                           sorted_batch, idx, s_live, new_part, gid,
-                          seg_start, counts, run_end, cap) -> Column:
+                          seg_start, counts, run_end, cap,
+                          spec=None) -> Column:
         in_schema = sorted_batch.schema()
         if isinstance(fn, CountStar):
             vals = s_live.astype(jnp.int64)
@@ -287,10 +380,55 @@ class WindowExec(TpuExec):
                 # the value at their run's LAST row (SQL peer semantics)
                 acc = jnp.take(acc, run_end)
                 ncnt = jnp.take(ncnt, run_end)
-        else:
+        elif frame.row_based:
             return self._sliding(fn, frame, agg_vals, cnt_vals, idx,
                                  seg_start, counts, cap, out_t, op, s_live)
+        else:
+            return self._range_sliding(fn, frame, spec, sorted_batch,
+                                       agg_vals, cnt_vals, seg_start,
+                                       counts, cap, out_t, op, s_live)
 
+        return self._finalize_agg(fn, acc, ncnt, s_live, out_t)
+
+    def _range_sliding(self, fn, frame, spec, sorted_batch, agg_vals,
+                       cnt_vals, seg_start, counts, cap, out_t, op,
+                       s_live):
+        """RANGE BETWEEN x PRECEDING AND y FOLLOWING with value offsets
+        (GpuWindowExec bounded-range frames): per-row frame bounds are
+        binary searches over the partition-sorted order key; add-monoids
+        then use prefix-sum differences and min/max a doubling sparse
+        table (O(log n) RMQ — the two-kernel trick cuDF's range windows
+        use becomes searchsorted + gather here)."""
+        of = spec.order_fields[0]
+        key_col = of.expr.eval(sorted_batch)
+        k = key_col.data.astype(jnp.float64)
+        if isinstance(key_col.dtype, dt.DecimalType):
+            # decimal lanes are scaled ints; frame offsets are logical
+            # values — scale them to the same fixed-point basis
+            factor = float(10 ** key_col.dtype.scale)
+            frame = WindowFrame(
+                None if frame.lo is None else frame.lo * factor,
+                None if frame.hi is None else frame.hi * factor,
+                row_based=False)
+        if not of.ascending:
+            k = -k
+        # null order keys are their own peer group at the sort's null
+        # end: map them to +/-inf so their frames cover exactly the run
+        null_end = jnp.where(of.nulls_first, -jnp.inf, jnp.inf)
+        k = jnp.where(key_col.validity, k, null_end)
+        seg_end = seg_start + counts.astype(jnp.int32) - 1
+        lo_val = k + frame.lo if frame.lo is not None else None
+        hi_val = k + frame.hi if frame.hi is not None else None
+        lo_i = seg_start if lo_val is None else _seg_lower_bound(
+            k, seg_start, seg_end, lo_val)
+        hi_i = seg_end if hi_val is None else _seg_upper_bound(
+            k, seg_start, seg_end, hi_val)
+        width_empty = hi_i < lo_i
+        if op is jnp.add:
+            acc = _range_sum(agg_vals, lo_i, hi_i, cap, width_empty)
+        else:
+            acc = _rmq(agg_vals, lo_i, hi_i, cap, op, out_t)
+        ncnt = _range_count(cnt_vals, lo_i, hi_i, cap, width_empty)
         return self._finalize_agg(fn, acc, ncnt, s_live, out_t)
 
     def _sliding(self, fn, frame, agg_vals, cnt_vals, idx, seg_start,
@@ -306,14 +444,8 @@ class WindowExec(TpuExec):
             jnp.minimum(idx + hi, seg_end)
         width_empty = hi_i < lo_i
         if op is jnp.add:
-            csum = jnp.cumsum(agg_vals)
-            ccnt = jnp.cumsum(cnt_vals)
-            def rng_sum(ps, at_lo, at_hi):
-                top = ps[jnp.clip(at_hi, 0, cap - 1)]
-                bot = jnp.where(at_lo > 0, ps[jnp.clip(at_lo - 1, 0, cap - 1)], 0)
-                return top - bot
-            acc = jnp.where(width_empty, 0, rng_sum(csum, lo_i, hi_i))
-            ncnt = jnp.where(width_empty, 0, rng_sum(ccnt, lo_i, hi_i))
+            acc = _range_sum(agg_vals, lo_i, hi_i, cap, width_empty)
+            ncnt = _range_count(cnt_vals, lo_i, hi_i, cap, width_empty)
         else:
             if lo is None or hi is None:
                 raise NotImplementedError(

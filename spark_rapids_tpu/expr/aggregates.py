@@ -233,7 +233,11 @@ class CountStar(AggregateFunction):
 
 class _MinMaxBase(AggregateFunction):
     """Shared min/max; decimal128 inputs reduce lexicographically over
-    (biased hi, lo) limb pairs (columnar/decimal128.py seg_minmax128)."""
+    (biased hi, lo) limb pairs (columnar/decimal128.py seg_minmax128);
+    string inputs reduce via a global sort rank (the value's position
+    in a stable sort is an order-isomorphic int64 key, so segmented
+    min/max of ranks picks the right ROW and the string is gathered
+    from it — no fixed-width encoding of the value in the state)."""
 
     largest = False
 
@@ -251,6 +255,25 @@ class _MinMaxBase(AggregateFunction):
                     (self._key + "_lo", dt.INT64), ("seen", dt.BOOL)]
         return [(self._key, t), ("seen", dt.BOOL)]
 
+    def _string_reduce(self, gid, col, num_groups):
+        from ..ops.kernels import sort_indices
+        cap = col.capacity
+        perm = sort_indices([col], [True], [False], col.validity)
+        rank = jnp.zeros(cap, jnp.int32).at[perm].set(
+            jnp.arange(cap, dtype=jnp.int32))
+        if self.largest:
+            keyed = jnp.where(col.validity, rank, jnp.int32(-1))
+            sel = _seg_max(keyed, gid, num_groups, -1)
+            found = sel >= 0
+        else:
+            big = jnp.int32(cap)
+            keyed = jnp.where(col.validity, rank, big)
+            sel = _seg_min(keyed, gid, num_groups, big)
+            found = sel < big
+        rows = jnp.take(perm, jnp.clip(sel, 0, cap - 1))
+        out = col.gather(rows, found)
+        return {self._key: out, "seen": found}
+
     def _wide_reduce(self, gid, hi, lo, valid, num_groups):
         from ..columnar import decimal128 as d128
         bh, bl = d128.seg_minmax128(hi, lo, valid, gid, num_groups,
@@ -261,6 +284,9 @@ class _MinMaxBase(AggregateFunction):
 
     def update(self, gid, col: Column, num_groups: int, live,
                **kw) -> State:
+        from ..columnar.vector import StringColumn
+        if isinstance(col, StringColumn):
+            return self._string_reduce(gid, col, num_groups)
         if isinstance(col.dtype, dt.DecimalType) and col.dtype.is_wide:
             from ..columnar import decimal128 as d128
             hi, lo = d128.limbs_of(col)
@@ -275,6 +301,11 @@ class _MinMaxBase(AggregateFunction):
                                  num_groups) > 0}
 
     def merge(self, gid, states: State, num_groups: int) -> State:
+        from ..columnar.vector import StringColumn
+        if isinstance(states.get(self._key), StringColumn):
+            sc = states[self._key].with_validity(
+                states[self._key].validity & states["seen"])
+            return self._string_reduce(gid, sc, num_groups)
         if self._key + "_hi" in states:
             hi = states[self._key + "_hi"]
             lo = states[self._key + "_lo"].astype(jnp.uint64)
@@ -290,6 +321,9 @@ class _MinMaxBase(AggregateFunction):
                                  num_groups) > 0}
 
     def finalize(self, states: State) -> tuple:
+        from ..columnar.vector import StringColumn
+        if isinstance(states.get(self._key), StringColumn):
+            return states[self._key], states["seen"]
         if self._key + "_hi" in states:
             return (states[self._key + "_hi"],
                     states[self._key + "_lo"].astype(jnp.uint64)), \
@@ -529,22 +563,123 @@ class Last(First):
 
 
 class CollectList(AggregateFunction):
-    """collect_list — gathers group values into an array column.
-
-    Array-typed outputs have no device representation yet (SURVEY §7
-    hard-part #2 nested types), so no TPU rule is registered: operators
-    containing collects run on the CPU engine (tagged fallback), like
-    the reference before cuDF grew list support.
-    """
+    """collect_list — gathers group values into an array column, on
+    device (aggregate/GpuCollectList via cuDF list aggregations in the
+    reference). The sort-based group kernel hands update() key-sorted
+    rows, so each group's values are CONTIGUOUS: the list state is just
+    (cumulative group counts, compacted values) — a ListColumn whose
+    child never exceeds the batch capacity. The merge pass relabels
+    offsets the same way (group rows stay contiguous after the merge
+    sort), so no per-element shuffling ever happens."""
 
     name = "collect_list"
 
     def data_type(self, schema: Schema) -> dt.DType:
         return dt.ArrayType(self.children[0].data_type(schema))
 
+    def state_schema(self, schema: Schema) -> List:
+        return [("list", self.data_type(schema))]
+
+    def _elem_type(self, col: Column) -> dt.DType:
+        return col.dtype
+
+    def _build_state(self, gid, col, num_groups, eligible):
+        """(counts per group, values compacted in current row order) ->
+        ListColumn state."""
+        from ..columnar.nested import ListColumn
+        cap = col.capacity
+        counts = _seg_sum(eligible.astype(jnp.int32), gid, num_groups)
+        offsets = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32), jnp.cumsum(counts, dtype=jnp.int32)])
+        order = jnp.argsort(~eligible, stable=True).astype(jnp.int32)
+        n = jnp.sum(eligible).astype(jnp.int32)
+        from ..columnar.vector import live_mask
+        child = col.gather(order, live_mask(cap, n))
+        return ListColumn(offsets, child,
+                          jnp.ones(num_groups, jnp.bool_),
+                          self._elem_type(col))
+
+    def update(self, gid, col: Column, num_groups: int, live,
+               **kw) -> State:
+        # nulls are dropped (Spark collect_list/collect_set semantics)
+        return {"list": self._build_state(gid, col, num_groups,
+                                          col.validity & live)}
+
+    def merge(self, gid, states: State, num_groups: int) -> State:
+        from ..columnar.nested import ListColumn
+        lc: "ListColumn" = states["list"]
+        lens = jnp.where(lc.validity, lc.lengths(), 0)
+        counts = _seg_sum(lens.astype(jnp.int32), gid, num_groups)
+        offsets = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32), jnp.cumsum(counts, dtype=jnp.int32)])
+        # rows of one group are contiguous in merge-sorted order, and
+        # gather() repacked the child row-major: relabeling offsets IS
+        # the concatenation
+        return {"list": ListColumn(offsets, lc.child,
+                                   jnp.ones(num_groups, jnp.bool_),
+                                   lc.dtype.element_type)}
+
+    def finalize(self, states: State):
+        lc = states["list"]
+        return lc, jnp.ones(lc.capacity, jnp.bool_)
+
 
 class CollectSet(CollectList):
+    """collect_set — like collect_list but value-deduplicated; output
+    order is value-sorted (Spark leaves set order undefined)."""
+
     name = "collect_set"
+
+    def update(self, gid, col: Column, num_groups: int, live,
+               **kw) -> State:
+        from ..columnar.vector import ColumnVector
+        from ..ops import kernels as K
+        eligible = col.validity & live
+        gcol = ColumnVector(gid.astype(jnp.int32), eligible, dt.INT32)
+        perm = K.sort_indices([gcol, col], [True, True], [True, True],
+                              eligible)
+        g_s = jnp.take(gid, perm)
+        col_s = col.gather(perm, jnp.take(eligible, perm))
+        dup = K._adjacent_equal(col_s) & \
+            jnp.concatenate([jnp.zeros(1, jnp.bool_), g_s[1:] == g_s[:-1]])
+        elig_s = jnp.take(eligible, perm) & ~dup
+        return {"list": self._build_state(g_s, col_s, num_groups, elig_s)}
+
+    def merge(self, gid, states: State, num_groups: int) -> State:
+        from ..columnar.nested import ListColumn
+        from ..columnar.vector import ColumnVector
+        from ..ops import kernels as K
+        lc: "ListColumn" = states["list"]
+        merged = super().merge(gid, states, num_groups)["list"]
+        # element-level dedupe: flatten (egid, value), sort, drop
+        # adjacent duplicates, rebuild counts
+        child = merged.child
+        ccap = child.capacity
+        pos = jnp.arange(ccap, dtype=jnp.int32)
+        total = merged.offsets[num_groups]
+        alive = pos < total
+        egid = jnp.searchsorted(merged.offsets[1:], pos,
+                                side="right").astype(jnp.int32)
+        gcol = ColumnVector(egid, alive, dt.INT32)
+        cv = child.with_validity(child.validity & alive) \
+            if hasattr(child, "with_validity") else child
+        perm = K.sort_indices([gcol, cv], [True, True], [True, True],
+                              alive)
+        g_s = jnp.take(egid, perm)
+        c_s = cv.gather(perm, jnp.take(alive, perm))
+        dup = K._adjacent_equal(c_s) & \
+            jnp.concatenate([jnp.zeros(1, jnp.bool_), g_s[1:] == g_s[:-1]])
+        keep = jnp.take(alive, perm) & ~dup
+        counts = _seg_sum(keep.astype(jnp.int32), g_s, num_groups)
+        offsets = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32), jnp.cumsum(counts, dtype=jnp.int32)])
+        order = jnp.argsort(~keep, stable=True).astype(jnp.int32)
+        from ..columnar.vector import live_mask
+        n = jnp.sum(keep).astype(jnp.int32)
+        new_child = c_s.gather(order, live_mask(ccap, n))
+        return {"list": ListColumn(offsets, new_child,
+                                   jnp.ones(num_groups, jnp.bool_),
+                                   merged.dtype.element_type)}
 
 
 class Percentile(AggregateFunction):

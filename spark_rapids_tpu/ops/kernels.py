@@ -236,8 +236,12 @@ def group_merge(batch: ColumnarBatch, key_cols: Sequence[Column],
         batch, key_cols)
     merged = []
     for states, fn in zip(agg_states, agg_fns):
-        sorted_states = {k: jnp.take(v, perm, axis=0)
-                         for k, v in states.items()}
+        def _sort_state(v):
+            from ..columnar.nested import ListColumn
+            if isinstance(v, (StringColumn, ListColumn)):
+                return v.gather(perm, live_s, unique=True)
+            return jnp.take(v, perm, axis=0)
+        sorted_states = {k: _sort_state(v) for k, v in states.items()}
         merged.append(fn.merge(gid, sorted_states, cap))
     return key_batch, merged, num_groups
 
@@ -415,6 +419,9 @@ def concat_columns(cols: Sequence[Column], caps: Sequence[int], counts,
     """Concatenate the live prefixes of columns into one column."""
     if isinstance(cols[0], StringColumn):
         return _concat_strings(cols, caps, counts, out_capacity)
+    from ..columnar.nested import ListColumn
+    if isinstance(cols[0], ListColumn):
+        return _concat_lists(cols, caps, counts, out_capacity)
     from ..columnar.decimal128 import Decimal128Column
     if isinstance(cols[0], Decimal128Column):
         hi = jnp.zeros(out_capacity, jnp.int64)
@@ -444,6 +451,36 @@ def concat_columns(cols: Sequence[Column], caps: Sequence[int], counts,
         validity = jnp.where(in_range, jnp.take(c.validity, take), validity)
         offset = offset + n.astype(jnp.int32) if hasattr(n, "astype") else offset + n
     return ColumnVector(data, validity, cols[0].dtype)
+
+
+def _concat_lists(cols, caps, counts, out_capacity: int):
+    """Concatenate COMPACT ListColumns (elements stored in row order
+    with no gaps — the layout every builder in this codebase produces):
+    children concatenate as columns, row offsets relabel by cumsum of
+    gathered lengths. Dead/invalid rows must carry zero-length extents,
+    the same invariant StringColumn concat relies on."""
+    from ..columnar.nested import ListColumn
+    lens = jnp.zeros(out_capacity, jnp.int32)
+    validity = jnp.zeros(out_capacity, jnp.bool_)
+    offset = jnp.int32(0)
+    for c, cap, n in zip(cols, caps, counts):
+        idx = jnp.arange(out_capacity, dtype=jnp.int32) - offset
+        nn = n.astype(jnp.int32) if hasattr(n, "astype") else jnp.int32(n)
+        in_range = (idx >= 0) & (idx < nn)
+        take = jnp.clip(idx, 0, cap - 1)
+        lens = jnp.where(in_range, jnp.take(c.lengths(), take), lens)
+        validity = jnp.where(in_range, jnp.take(c.validity, take),
+                             validity)
+        offset = offset + nn
+    offsets = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(lens, dtype=jnp.int32)])
+    child_cap = sum(c.child_capacity for c in cols)
+    elem_counts = [c.offsets[c.capacity] for c in cols]
+    child = concat_columns([c.child for c in cols],
+                           [c.child_capacity for c in cols],
+                           elem_counts, child_cap)
+    return ListColumn(offsets, child, validity,
+                      cols[0].dtype.element_type, cols[0].pad_bucket)
 
 
 def _concat_strings(cols: Sequence[StringColumn], caps, counts,
@@ -506,7 +543,7 @@ def slice_batch(batch: ColumnarBatch, start: int, length,
     """
     idx = jnp.arange(out_capacity, dtype=jnp.int32) + start
     n = jnp.minimum(length, jnp.maximum(batch.num_rows - start, 0))
-    return batch.gather(idx, n)
+    return batch.gather(idx, n, unique=True)
 
 
 def local_limit(batch: ColumnarBatch, n: int) -> ColumnarBatch:

@@ -451,6 +451,26 @@ def _window_table(table: HostTable, plan: Window) -> HostTable:
         else:
             vals = np.zeros(n, np.dtype(out_t.physical))
         mask = np.zeros(n, bool)
+        # hoisted RANGE-frame machinery (one column eval per window, not
+        # per row): value offsets scale to decimal keys' fixed point
+        frame0 = we.spec.frame
+        kval = range_lo = range_hi = None
+        if frame0 is not None and not frame0.row_based and \
+                not (frame0.is_running or frame0.is_unbounded) and \
+                we.spec.order_fields:
+            of = we.spec.order_fields[0]
+            kcol = cpu_eval.evaluate(of.expr, table)
+            sign = 1.0 if of.ascending else -1.0
+            knull = -np.inf if of.nulls_first else np.inf
+
+            def kval(r, _kcol=kcol, _sign=sign, _knull=knull):
+                if not _kcol.mask[r]:
+                    return _knull
+                return _sign * float(_kcol.values[r])
+            scale = 10 ** kcol.dtype.scale \
+                if isinstance(kcol.dtype, dt.DecimalType) else 1
+            range_lo = None if frame0.lo is None else frame0.lo * scale
+            range_hi = None if frame0.hi is None else frame0.hi * scale
         if fn.children:
             in_col = cpu_eval.evaluate(fn.children[0], table)
         else:
@@ -506,10 +526,26 @@ def _window_table(table: HostTable, plan: Window) -> HostTable:
                             while hi + 1 < cnt and order_tuple(
                                     rows[hi + 1]) == order_tuple(rows[pos]):
                                 hi += 1
-                    else:
+                    elif frame.row_based:
                         lo = 0 if frame.lo is None else max(pos + frame.lo, 0)
                         hi = cnt - 1 if frame.hi is None else \
                             min(pos + frame.hi, cnt - 1)
+                    else:
+                        # RANGE with value offsets over the single
+                        # numeric order key; null keys form their own
+                        # peer group. kval/range_off are hoisted per
+                        # window (see below the function list).
+                        me = kval(rows[pos])
+                        lo_v = me + range_lo if range_lo is not None \
+                            else -np.inf
+                        hi_v = me + range_hi if range_hi is not None \
+                            else np.inf
+                        lo = 0
+                        while lo < cnt and kval(rows[lo]) < lo_v:
+                            lo += 1
+                        hi = cnt - 1
+                        while hi >= 0 and kval(rows[hi]) > hi_v:
+                            hi -= 1
                     frame_rows = np.asarray(rows[lo:hi + 1], np.int64) \
                         if hi >= lo else np.zeros(0, np.int64)
                     v, ok = _agg_cpu(

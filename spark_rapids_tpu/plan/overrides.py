@@ -305,6 +305,12 @@ _expr(CX.Explode, _nested_ok, _tag_explode)
 
 for _cls in (Agg.First, Agg.Last):
     _expr(_cls, ts.comparable)
+# collect_list/set build ListColumn states on device; set dedupe sorts
+# elements, so string sets stay on CPU (char-buffer churn)
+_expr(Agg.CollectList, ts.numeric + ts.TypeSig(ts.BOOLEAN, ts.DATE,
+                                               ts.TIMESTAMP, ts.STRING))
+_expr(Agg.CollectSet, ts.numeric + ts.TypeSig(ts.BOOLEAN, ts.DATE,
+                                              ts.TIMESTAMP))
 for _cls in (Agg.Count, Agg.CountStar):
     _expr(_cls, ts.comparable + ts.decimal128)
 # sum/avg on decimal128 run on the two-limb segmented accumulator
@@ -315,11 +321,11 @@ for _cls in (Agg.Sum, Agg.Average):
 for _cls in (Agg.VariancePop, Agg.VarianceSamp,
              Agg.StddevPop, Agg.StddevSamp):
     _expr(_cls, ts.numeric)
-# min/max: the sort-based group kernel needs a physical extreme fill,
-# which strings don't have yet -> CPU fallback for string min/max
+# min/max cover strings via sort-rank selection (expr/aggregates.py
+# _string_reduce)
 for _cls in (Agg.Min, Agg.Max):
     _expr(_cls, ts.numeric_all + ts.TypeSig(ts.BOOLEAN, ts.DATE,
-                                            ts.TIMESTAMP))
+                                            ts.TIMESTAMP, ts.STRING))
 
 
 # --- exec rules ------------------------------------------------------------
@@ -448,9 +454,19 @@ def _tag_window(meta: PlanMeta):
         frame = we.spec.frame
         if frame is not None and not frame.row_based and not (
                 frame.is_running or frame.is_unbounded):
-            meta.will_not_work_on_tpu(
-                f"window {name}: general RANGE frames not on TPU yet")
-        if frame is not None and isinstance(fn, (Agg.Min, Agg.Max)) and \
+            # bounded RANGE frames: one numeric/date/timestamp order key
+            # (binary-searchable values; exec/window.py _range_sliding)
+            ofs = we.spec.order_fields
+            kt = ofs[0].expr.data_type(in_schema) if len(ofs) == 1 else None
+            key_ok = (kt is not None and not _wide_decimal(kt) and (
+                kt.is_numeric or
+                isinstance(kt, (dt.DateType, dt.TimestampType))))
+            if not key_ok:
+                meta.will_not_work_on_tpu(
+                    f"window {name}: RANGE frames need a single "
+                    "numeric/date order key on TPU")
+        if frame is not None and frame.row_based and \
+                isinstance(fn, (Agg.Min, Agg.Max)) and \
                 not (frame.is_running or frame.is_unbounded) and \
                 (frame.lo is None or frame.hi is None):
             meta.will_not_work_on_tpu(
@@ -533,8 +549,14 @@ def _build_tpu_exec(plan: LogicalPlan, children: List[TpuExec],
                         global_sort=plan.is_global)
     if isinstance(plan, Aggregate):
         # staged (GpuAggregateExec partial -> exchange -> final); the
-        # ensure_distribution pass places the exchange between them
-        from ..exec.aggregate import FINAL, PARTIAL
+        # ensure_distribution pass places the exchange between them.
+        # collect_list/set carry ListColumn states the exchange
+        # partitioner doesn't pack yet -> single-stage COMPLETE
+        from ..exec.aggregate import COMPLETE, FINAL, PARTIAL
+        if any(isinstance(fn, Agg.CollectList)
+               for fn, _ in plan.agg_exprs):
+            return HashAggregateExec(children[0], plan.group_exprs,
+                                     plan.agg_exprs, mode=COMPLETE)
         partial = HashAggregateExec(children[0], plan.group_exprs,
                                     plan.agg_exprs, mode=PARTIAL)
         return HashAggregateExec(partial, plan.group_exprs, plan.agg_exprs,

@@ -194,3 +194,107 @@ def test_default_frame_is_range_running(session):
     by_v = {r["v"]: r["rs"] for r in out}
     assert by_v == {1: 3, 2: 3, 3: 10, 4: 10}
     assert_tpu_cpu_equal_df(df.select("v", Sum(col("v")).over(w).alias("rs")))
+
+
+# --- general RANGE frames (value-offset bounds) -----------------------------
+
+def test_range_frame_sum_avg(session):
+    from spark_rapids_tpu.expr.aggregates import Average, Count, Sum
+    from spark_rapids_tpu.expr.window import WindowFrame, WindowSpec
+    from spark_rapids_tpu.testing import IntGen, gen_table
+    data, schema = gen_table({"p": IntGen(lo=0, hi=3),
+                              "o": IntGen(lo=0, hi=50),
+                              "v": IntGen(lo=-20, hi=20)}, 200, seed=29)
+    df = session.create_dataframe(data, schema)
+    spec = WindowSpec(partition_by=[col("p")], order_fields=[col("o")],
+                      frame=WindowFrame(-5, 3, row_based=False))
+    assert_tpu_cpu_equal_df(df.select(
+        col("p"), col("o"),
+        Sum(col("v")).over(spec).alias("s"),
+        Count(col("v")).over(spec).alias("n"),
+        Average(col("v")).over(spec).alias("a")))
+
+
+def test_range_frame_min_max(session):
+    from spark_rapids_tpu.expr.aggregates import Max, Min
+    from spark_rapids_tpu.expr.window import WindowFrame, WindowSpec
+    from spark_rapids_tpu.testing import IntGen, gen_table
+    data, schema = gen_table({"p": IntGen(lo=0, hi=3),
+                              "o": IntGen(lo=0, hi=40),
+                              "v": IntGen(lo=-50, hi=50)}, 200, seed=31)
+    df = session.create_dataframe(data, schema)
+    spec = WindowSpec(partition_by=[col("p")], order_fields=[col("o")],
+                      frame=WindowFrame(-10, 0, row_based=False))
+    assert_tpu_cpu_equal_df(df.select(
+        col("p"), col("o"),
+        Min(col("v")).over(spec).alias("mn"),
+        Max(col("v")).over(spec).alias("mx")))
+
+
+def test_range_frame_unbounded_preceding_value_following(session):
+    from spark_rapids_tpu.expr.aggregates import Max, Sum
+    from spark_rapids_tpu.expr.window import UNBOUNDED, WindowFrame, \
+        WindowSpec
+    from spark_rapids_tpu.testing import IntGen, gen_table
+    data, schema = gen_table({"p": IntGen(lo=0, hi=2),
+                              "o": IntGen(lo=0, hi=30),
+                              "v": IntGen(lo=-9, hi=9)}, 150, seed=37)
+    df = session.create_dataframe(data, schema)
+    spec = WindowSpec(partition_by=[col("p")], order_fields=[col("o")],
+                      frame=WindowFrame(UNBOUNDED, 2, row_based=False))
+    assert_tpu_cpu_equal_df(df.select(
+        col("p"), col("o"),
+        Sum(col("v")).over(spec).alias("s"),
+        Max(col("v")).over(spec).alias("mx")))
+
+
+def test_range_frame_desc_and_nulls(session):
+    from spark_rapids_tpu.expr.aggregates import Sum
+    from spark_rapids_tpu.expr.window import WindowFrame, WindowSpec
+    from spark_rapids_tpu.plan.logical import SortField
+    from spark_rapids_tpu.testing import IntGen, gen_table
+    data, schema = gen_table({"p": IntGen(lo=0, hi=2),
+                              "o": IntGen(lo=0, hi=25),
+                              "v": IntGen(lo=-9, hi=9)}, 150, seed=41)
+    df = session.create_dataframe(data, schema)
+    spec = WindowSpec(partition_by=[col("p")],
+                      order_fields=[SortField(col("o"), ascending=False)],
+                      frame=WindowFrame(-4, 4, row_based=False))
+    assert_tpu_cpu_equal_df(df.select(
+        col("p"), col("o"), Sum(col("v")).over(spec).alias("s")))
+
+
+def test_range_frame_inf_isolation(session):
+    # an inf in the partition must only poison frames containing it
+    from spark_rapids_tpu.expr.aggregates import Sum
+    from spark_rapids_tpu.expr.window import WindowFrame, WindowSpec
+    df = session.create_dataframe(
+        {"p": [1] * 6, "o": [0, 10, 20, 30, 40, 50],
+         "v": [1.0, float("inf"), 2.0, 3.0, 4.0, 5.0]})
+    spec = WindowSpec(partition_by=[col("p")], order_fields=[col("o")],
+                      frame=WindowFrame(-5, 5, row_based=False))
+    out = df.select(col("o"), Sum(col("v")).over(spec).alias("s"))
+    got = dict(zip(out.to_pydict()["o"], out.to_pydict()["s"]))
+    assert got[30] == 3.0 and got[50] == 5.0  # frames without the inf
+    assert got[10] == float("inf")
+    assert_tpu_cpu_equal_df(out)
+
+
+def test_range_frame_decimal_key(session):
+    import decimal
+    from spark_rapids_tpu.columnar import dtypes as dt
+    from spark_rapids_tpu.expr.aggregates import Sum
+    from spark_rapids_tpu.expr.window import WindowFrame, WindowSpec
+    df = session.create_dataframe(
+        {"o": [decimal.Decimal("1.00"), decimal.Decimal("2.50"),
+               decimal.Decimal("6.00"), decimal.Decimal("7.25")],
+         "v": [1, 10, 100, 1000]},
+        [("o", dt.DecimalType(10, 2)), ("v", dt.INT64)])
+    # RANGE 2 PRECEDING..CURRENT over logical values, not scaled lanes
+    spec = WindowSpec(order_fields=[col("o")],
+                      frame=WindowFrame(-2, 0, row_based=False))
+    out = df.select(col("v"), Sum(col("v")).over(spec).alias("s"))
+    got = dict(zip(out.to_pydict()["v"], out.to_pydict()["s"]))
+    assert got[1] == 1 and got[10] == 11
+    assert got[100] == 100 and got[1000] == 1100
+    assert_tpu_cpu_equal_df(out)
