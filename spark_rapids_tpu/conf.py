@@ -260,6 +260,30 @@ SHUFFLE_COMPRESS = conf("srt.shuffle.compression.codec") \
          "reference)") \
     .check_values(["NONE", "LZ4", "ZSTD"]).string("NONE")
 
+ADAPTIVE_ENABLED = conf("srt.sql.adaptive.enabled") \
+    .doc("Adaptive query execution: re-plan stages on runtime shuffle "
+         "statistics — coalesce small reduce partitions and switch "
+         "shuffled joins to broadcast when the materialized build side "
+         "is small. (spark.sql.adaptive.enabled; "
+         "GpuQueryStagePrepOverrides / GpuCustomShuffleReaderExec)") \
+    .commonly_used().boolean(True)
+
+ADAPTIVE_MIN_PARTITION_ROWS = conf(
+    "srt.sql.adaptive.coalescePartitions.minPartitionRows") \
+    .doc("AQE merges adjacent reduce partitions until each group holds "
+         "at least this many rows "
+         "(spark.sql.adaptive.coalescePartitions.minPartitionSize, rows "
+         "here because batch capacities are row-bucketed).") \
+    .check(_positive).integer(1 << 16)
+
+ADAPTIVE_BROADCAST_ROWS = conf("srt.sql.adaptive.autoBroadcastJoinRows") \
+    .doc("A shuffled join whose materialized build side has at most "
+         "this many rows switches to broadcast at runtime, skipping "
+         "the probe-side shuffle (spark.sql.adaptive."
+         "autoBroadcastJoinThreshold). 0 falls back to "
+         "srt.sql.broadcastRowThreshold.") \
+    .integer(0)
+
 SESSION_TIMEZONE = conf("srt.sql.session.timeZone") \
     .doc("Session timezone id used by timezone-aware SQL functions "
          "(spark.sql.session.timeZone). Conversions run on device "

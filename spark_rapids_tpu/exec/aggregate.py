@@ -314,6 +314,23 @@ class HashAggregateExec(TpuExec):
                 for b in bs:
                     b.close()
 
+    def _child_partitions(self, ctx: ExecContext):
+        """Child partition streams; with AQE on and an exchange child,
+        small reduce partitions group together before the merge
+        (CoalesceShufflePartitions over the FINAL aggregate)."""
+        from ..conf import ADAPTIVE_ENABLED, ADAPTIVE_MIN_PARTITION_ROWS
+        from .exchange import ShuffleExchangeExec
+        child = self.children[0]
+        if ctx.conf.get(ADAPTIVE_ENABLED) and \
+                not self.preserve_partitioning and \
+                isinstance(child, ShuffleExchangeExec):
+            counts = child.materialized_row_counts(ctx)
+            groups = child.coalesce_groups(
+                counts, ctx.conf.get(ADAPTIVE_MIN_PARTITION_ROWS))
+            if len(groups) < len(counts):
+                return child.execute_partition_groups(ctx, groups)
+        return child.execute_partitioned(ctx)
+
     def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
         m = ctx.metrics_for(self.exec_id)
         agg_time = m.setdefault("aggTime", Metric("aggTime", Metric.MODERATE,
@@ -323,8 +340,9 @@ class HashAggregateExec(TpuExec):
             return
         if self.mode == FINAL:
             # partition-wise merge: >=1 output batch per child partition
+            # (AQE coalesces small shuffle partitions into one merge)
             saw_any = False
-            for part in self.children[0].execute_partitioned(ctx):
+            for part in self._child_partitions(ctx):
                 for out in self._merge_partition(ctx, part, agg_time):
                     saw_any = True
                     yield out

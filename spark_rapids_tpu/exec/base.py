@@ -206,6 +206,11 @@ class TpuExec:
 
     _counter = [0]
 
+    #: set by the planner when a partition-wise parent consumes this
+    #: node's advertised partitioning without a re-exchange: AQE
+    #: transforms that change the partition count must stand down
+    preserve_partitioning = False
+
     def __init__(self, *children: "TpuExec"):
         self.children: List[TpuExec] = list(children)
         TpuExec._counter[0] += 1

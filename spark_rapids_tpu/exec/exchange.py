@@ -285,8 +285,61 @@ class ShuffleExchangeExec(TpuExec):
         self._write(ctx)
         yield from mgr.read_partition(self.shuffle_id, reduce_id)
 
+    # --- AQE surface (GpuCustomShuffleReaderExec analogue) ---
+    def materialized_row_counts(self, ctx: ExecContext) -> List[int]:
+        """Write the map side (idempotent) and return rows per reduce
+        partition — the MapOutputStatistics AQE decisions read."""
+        mgr = self.manager or shuffle_manager()
+        self._write(ctx)
+        return mgr.partition_row_counts(self.shuffle_id)
+
+    @staticmethod
+    def coalesce_groups(counts: List[int], min_rows: int) -> List[List[int]]:
+        """Greedy adjacent grouping: each group reaches min_rows (the
+        last group may not). CoalesceShufflePartitions' strategy."""
+        groups: List[List[int]] = []
+        cur: List[int] = []
+        acc = 0
+        for i, c in enumerate(counts):
+            cur.append(i)
+            acc += c
+            if acc >= min_rows:
+                groups.append(cur)
+                cur, acc = [], 0
+        if cur:
+            if groups:
+                groups[-1].extend(cur)
+            else:
+                groups.append(cur)
+        return groups
+
+    def execute_partition_groups(self, ctx: ExecContext,
+                                 groups: List[List[int]]):
+        """One iterator per partition GROUP (a disjoint union of hash
+        partitions keeps keys clustered, so group-wise consumers stay
+        correct)."""
+        mgr = self.manager or shuffle_manager()
+        self._write(ctx)
+        m = ctx.metrics_for(self.exec_id)
+        m.setdefault("adaptiveCoalescedPartitions",
+                     Metric("adaptiveCoalescedPartitions",
+                            Metric.MODERATE)).add(
+            max(mgr.num_partitions(self.shuffle_id) - len(groups), 0))
+
+        def read_group(g):
+            for reduce_id in g:
+                yield from mgr.read_partition(self.shuffle_id, reduce_id)
+        try:
+            for g in groups:
+                yield read_group(g)
+        finally:
+            mgr.unregister_shuffle(self.shuffle_id)
+
     def execute_partitioned(self, ctx: ExecContext):
-        """One iterator per reduce partition, in partition order."""
+        """One iterator per reduce partition, in partition order.
+        AQE coalescing is CONSUMER-driven (execute_partition_groups):
+        a consumer with two partitioned inputs must apply the SAME
+        grouping to both, so the exchange never groups on its own."""
         mgr = self.manager or shuffle_manager()
         self._write(ctx)
         n_parts = mgr.num_partitions(self.shuffle_id)

@@ -386,14 +386,75 @@ class ShuffledHashJoinExec(_HashJoinBase):
             else self.children[1]
         return probe.output_partitioning
 
+    def _adaptive_broadcast(self, ctx: ExecContext):
+        """Runtime join-strategy switch (the AQE decision the reference
+        takes via GpuQueryStagePrepOverrides + Spark's
+        DynamicJoinSelection): once the build side's exchange has
+        materialized, a small actual row count downgrades the
+        partitioned join to a broadcast-style single stream — the
+        probe-side exchange is BYPASSED entirely (its map phase never
+        runs). Returns (probe_stream, build_stream) or None."""
+        from ..conf import (ADAPTIVE_BROADCAST_ROWS, ADAPTIVE_ENABLED,
+                            BROADCAST_THRESHOLD_ROWS)
+        from .exchange import ShuffleExchangeExec
+        if not ctx.conf.get(ADAPTIVE_ENABLED) or \
+                self.preserve_partitioning:
+            return None
+        build_child = self.children[1] if self.build_side == "right" \
+            else self.children[0]
+        probe_child = self.children[0] if self.build_side == "right" \
+            else self.children[1]
+        if not isinstance(build_child, ShuffleExchangeExec) or \
+                not isinstance(probe_child, ShuffleExchangeExec):
+            return None
+        threshold = ctx.conf.get(ADAPTIVE_BROADCAST_ROWS) or \
+            ctx.conf.get(BROADCAST_THRESHOLD_ROWS)
+        counts = build_child.materialized_row_counts(ctx)
+        if sum(counts) > threshold:
+            return None
+        m = ctx.metrics_for(self.exec_id)
+        m.setdefault("adaptiveBroadcastJoins",
+                     Metric("adaptiveBroadcastJoins",
+                            Metric.MODERATE)).add(1)
+
+        def build_stream():
+            for part in build_child.execute_partitioned(ctx):
+                yield from part
+        # the probe exchange's CHILD streams directly: its shuffle work
+        # is skipped (never registered, nothing to unregister)
+        return probe_child.children[0].execute(ctx), build_stream()
+
     def _zipped_partitions(self, ctx: ExecContext):
         """Pairwise (probe, build) partition streams. zip_longest (not
         zip) so both child generators are driven to exhaustion in order
         — an exchange unregisters its shuffle in a finally that must run
-        only after its last partition has been consumed."""
+        only after its last partition has been consumed. With AQE on
+        and both children exchanges, small reduce partitions coalesce
+        with ONE grouping applied to both sides (keys stay aligned)."""
         import itertools
-        left_parts = self.children[0].execute_partitioned(ctx)
-        right_parts = self.children[1].execute_partitioned(ctx)
+        from ..conf import ADAPTIVE_ENABLED, ADAPTIVE_MIN_PARTITION_ROWS
+        from .exchange import ShuffleExchangeExec
+        l, r = self.children[0], self.children[1]
+        if ctx.conf.get(ADAPTIVE_ENABLED) and \
+                not self.preserve_partitioning and \
+                isinstance(l, ShuffleExchangeExec) and \
+                isinstance(r, ShuffleExchangeExec):
+            lc = l.materialized_row_counts(ctx)
+            rc = r.materialized_row_counts(ctx)
+            if len(lc) == len(rc):
+                combined = [a + b for a, b in zip(lc, rc)]
+                groups = ShuffleExchangeExec.coalesce_groups(
+                    combined, ctx.conf.get(ADAPTIVE_MIN_PARTITION_ROWS))
+                if len(groups) < len(combined):
+                    left_parts = l.execute_partition_groups(ctx, groups)
+                    right_parts = r.execute_partition_groups(ctx, groups)
+                    for lp, rp in itertools.zip_longest(left_parts,
+                                                        right_parts):
+                        yield ((lp, rp) if self.build_side == "right"
+                               else (rp, lp))
+                    return
+        left_parts = l.execute_partitioned(ctx)
+        right_parts = r.execute_partitioned(ctx)
         for lp, rp in itertools.zip_longest(left_parts, right_parts):
             if lp is None or rp is None:
                 raise RuntimeError(
@@ -401,10 +462,15 @@ class ShuffledHashJoinExec(_HashJoinBase):
             yield ((lp, rp) if self.build_side == "right" else (rp, lp))
 
     def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
-        for probe, build in self._zipped_partitions(ctx):
-            yield from self._join_partition(ctx, probe, build)
+        for part in self.execute_partitioned(ctx):
+            yield from part
 
     def execute_partitioned(self, ctx: ExecContext):
+        switched = self._adaptive_broadcast(ctx)
+        if switched is not None:
+            probe_stream, build_stream = switched
+            yield self._join_partition(ctx, probe_stream, build_stream)
+            return
         for probe, build in self._zipped_partitions(ctx):
             yield self._join_partition(ctx, probe, build)
 

@@ -127,6 +127,9 @@ class ShuffleManager:
         self.host_store = HostBlockStore()
         self._pool = cf.ThreadPoolExecutor(max_workers=num_threads)
         self._registered: Dict[int, int] = {}  # shuffle_id -> num_parts
+        #: (shuffle_id, reduce_id) -> rows written (AQE statistics — the
+        #: MapOutputStatistics the reference's AQE reads from Spark)
+        self._part_rows: Dict[Tuple[int, int], int] = {}
         self.write_metrics = ShuffleWriteMetrics()
         self._lock = threading.Lock()
 
@@ -140,6 +143,15 @@ class ShuffleManager:
         self.host_store.remove_shuffle(shuffle_id)
         with self._lock:
             self._registered.pop(shuffle_id, None)
+            for k in [k for k in self._part_rows if k[0] == shuffle_id]:
+                del self._part_rows[k]
+
+    def partition_row_counts(self, shuffle_id: int) -> List[int]:
+        """Rows per reduce partition (valid once the map side wrote)."""
+        n = self.num_partitions(shuffle_id)
+        with self._lock:
+            return [self._part_rows.get((shuffle_id, r), 0)
+                    for r in range(n)]
 
     def num_partitions(self, shuffle_id: int) -> int:
         return self._registered[shuffle_id]
@@ -150,9 +162,11 @@ class ShuffleManager:
         """One map task's output: partitions[i] goes to reduce i."""
         t0 = time.perf_counter_ns()
         futures = []
+        local_rows: Dict[int, int] = {}
         for reduce_id, batch in enumerate(partitions):
             if batch is None or int(batch.num_rows) == 0:
                 continue
+            local_rows[reduce_id] = int(batch.num_rows)
             block = (shuffle_id, map_id, reduce_id)
             if self.mode == "CACHE_ONLY":
                 self.catalog.add(block, batch)
@@ -163,6 +177,10 @@ class ShuffleManager:
                     self._serialize_one, block, batch))
         for f in futures:
             f.result()
+        with self._lock:
+            for reduce_id, rows in local_rows.items():
+                key = (shuffle_id, reduce_id)
+                self._part_rows[key] = self._part_rows.get(key, 0) + rows
         self.write_metrics.write_time_ns += time.perf_counter_ns() - t0
 
     def _serialize_one(self, block: BlockId, batch: ColumnarBatch) -> None:

@@ -672,6 +672,18 @@ def _to_physical(meta: PlanMeta, conf: SrtConf):
 
 # --- EnsureRequirements: place exchanges ----------------------------------
 
+def _pin_partitioning(node: TpuExec) -> None:
+    """Disable partition-count-changing AQE transforms in ``node`` and
+    every descendant down to (and including) the first exchange — a
+    partition-wise parent depends on the advertised layout."""
+    from ..exec.exchange import ShuffleExchangeExec
+    node.preserve_partitioning = True
+    if isinstance(node, ShuffleExchangeExec):
+        return
+    for c in node.children:
+        _pin_partitioning(c)
+
+
 def ensure_distribution(node: TpuExec, conf: SrtConf) -> TpuExec:
     """Insert shuffle/broadcast exchanges wherever a child's output
     partitioning does not satisfy its parent's required distribution
@@ -699,6 +711,12 @@ def ensure_distribution(node: TpuExec, conf: SrtConf) -> TpuExec:
     out_children = []
     for child, req in zip(node.children, reqs):
         if child.output_partitioning.satisfies(req):
+            # the parent will consume this child partition-wise WITHOUT
+            # a re-exchange: AQE transforms inside the child (partition
+            # coalescing, adaptive broadcast) must not change its
+            # partition count/grouping
+            if isinstance(req, ClusteredDistribution):
+                _pin_partitioning(child)
             out_children.append(child)
         elif isinstance(req, BroadcastDistribution):
             out_children.append(BroadcastExchangeExec(child))

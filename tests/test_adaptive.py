@@ -1,0 +1,140 @@
+"""Adaptive query execution: runtime shuffle statistics drive partition
+coalescing and join-strategy switching.
+
+Reference: Spark AQE hooks (GpuQueryStagePrepOverrides,
+GpuCustomShuffleReaderExec, DynamicJoinSelection) — here the exchange
+exposes MapOutputStatistics-style row counts, the FINAL aggregate and
+shuffled join consume coalesced partition groups (one grouping applied
+to BOTH join sides), and a small materialized build side downgrades a
+shuffled join to a broadcast-style stream that skips the probe shuffle.
+"""
+
+import pytest
+
+from spark_rapids_tpu.conf import SrtConf
+from spark_rapids_tpu.expr.aggregates import Count, Sum
+from spark_rapids_tpu.expr.core import col
+from spark_rapids_tpu.plan import TpuSession
+from spark_rapids_tpu.testing import (IntGen, assert_tpu_cpu_equal_df,
+                                      gen_table)
+
+
+def make_session(**extra):
+    base = {"srt.shuffle.partitions": 8,
+            "srt.sql.broadcastRowThreshold": 1,  # force shuffled joins
+            "srt.sql.adaptive.coalescePartitions.minPartitionRows": "64"}
+    base.update(extra)
+    return TpuSession(SrtConf(base))
+
+
+def make_df(s, gens, n, seed=0):
+    data, schema = gen_table(gens, n, seed)
+    return s.create_dataframe(data, schema)
+
+
+def _run_with_metrics(df):
+    from spark_rapids_tpu.exec.base import ExecContext
+    from spark_rapids_tpu.plan import overrides
+    from spark_rapids_tpu.plan.host_table import batch_to_table, \
+        concat_tables, empty_like
+    physical = overrides.apply_overrides(df.plan, df.session.conf)
+    ctx = ExecContext(df.session.conf)
+    tables = [batch_to_table(b) for b in physical.execute(ctx)
+              if int(b.num_rows) > 0]
+    out = concat_tables(tables) if tables else empty_like(df.plan.schema)
+    merged = {}
+    for em in ctx.metrics.values():
+        for name, metric in em.items():
+            merged[name] = merged.get(name, 0) + metric.value
+    return out, merged
+
+
+def test_aggregate_partition_coalescing(monkeypatch):
+    s = make_session()
+    df = make_df(s, {"k": IntGen(lo=0, hi=40), "v": IntGen()}, 200, seed=3)
+    q = df.group_by(col("k")).agg(Sum(col("v")).alias("sv"),
+                                  Count(col("v")).alias("n"))
+    assert_tpu_cpu_equal_df(q)
+    _, metrics = _run_with_metrics(q)
+    # 200 rows over 8 partitions of a 64-row budget -> groups merged
+    assert metrics.get("adaptiveCoalescedPartitions", 0) >= 4
+
+
+def test_join_coordinated_coalescing():
+    s = make_session()
+    left = make_df(s, {"k": IntGen(lo=0, hi=60), "v": IntGen()}, 200,
+                   seed=5)
+    right = make_df(s, {"k": IntGen(lo=0, hi=60), "w": IntGen()}, 150,
+                    seed=7)
+    # build side above the adaptive broadcast threshold -> stays a
+    # partitioned join but with coalesced, ALIGNED groups
+    q = left.join(right, ([col("k")], [col("k")]), how="inner")
+    assert_tpu_cpu_equal_df(q)
+    q2 = left.join(right, ([col("k")], [col("k")]), how="left")
+    assert_tpu_cpu_equal_df(q2)
+
+
+def test_adaptive_broadcast_switch():
+    s = make_session(**{"srt.sql.adaptive.autoBroadcastJoinRows": "1000"})
+    left = make_df(s, {"k": IntGen(lo=0, hi=30), "v": IntGen()}, 400,
+                   seed=9)
+    right = make_df(s, {"k": IntGen(lo=0, hi=30), "w": IntGen()}, 50,
+                    seed=11)
+    q = left.join(right, ([col("k")], [col("k")]), how="inner")
+    out, metrics = _run_with_metrics(q)
+    assert metrics.get("adaptiveBroadcastJoins", 0) == 1
+    assert_tpu_cpu_equal_df(q)
+    # and the probe side's shuffle never wrote anything
+    assert metrics.get("shuffleWriteRows", 0) <= 50
+
+
+def test_adaptive_off_matches(monkeypatch):
+    s = make_session(**{"srt.sql.adaptive.enabled": "false"})
+    left = make_df(s, {"k": IntGen(lo=0, hi=30), "v": IntGen()}, 200,
+                   seed=13)
+    right = make_df(s, {"k": IntGen(lo=0, hi=30), "w": IntGen()}, 60,
+                    seed=15)
+    assert_tpu_cpu_equal_df(
+        left.join(right, ([col("k")], [col("k")]), how="inner"))
+    df = make_df(s, {"k": IntGen(lo=0, hi=40), "v": IntGen()}, 200,
+                 seed=17)
+    assert_tpu_cpu_equal_df(df.group_by(col("k")).agg(
+        Sum(col("v")).alias("sv")))
+
+
+def test_coalesce_groups_shapes():
+    from spark_rapids_tpu.exec.exchange import ShuffleExchangeExec
+    g = ShuffleExchangeExec.coalesce_groups([10, 10, 50, 5, 5, 100], 60)
+    # greedy adjacent: [10,10,50]=70, then [5,5,100]=110
+    assert g == [[0, 1, 2], [3, 4, 5]]
+    assert ShuffleExchangeExec.coalesce_groups([100, 200], 50) == \
+        [[0], [1]]
+    # trailing small tail folds into the last group
+    assert ShuffleExchangeExec.coalesce_groups([100, 5], 50) == [[0, 1]]
+    assert ShuffleExchangeExec.coalesce_groups([1, 2], 50) == [[0, 1]]
+
+
+def test_stacked_joins_pin_partitioning():
+    # (A join B) join C reuses the inner join's hash partitioning with
+    # no re-exchange: AQE must NOT change the inner join's partition
+    # count (coalescing/broadcast switch stand down under the pin)
+    s = make_session(**{"srt.sql.adaptive.autoBroadcastJoinRows": "1000"})
+    a = make_df(s, {"k": IntGen(lo=0, hi=25), "v": IntGen()}, 200, seed=19)
+    b = make_df(s, {"k": IntGen(lo=0, hi=25), "w": IntGen()}, 40, seed=21)
+    c = make_df(s, {"k": IntGen(lo=0, hi=25), "x": IntGen()}, 60, seed=23)
+    q = (a.join(b, ([col("k")], [col("k")]), how="inner")
+          .join(c, ([col("k")], [col("k")]), how="inner"))
+    assert_tpu_cpu_equal_df(q)
+    q2 = (a.join(b, ([col("k")], [col("k")]), how="left")
+           .join(c, ([col("k")], [col("k")]), how="left"))
+    assert_tpu_cpu_equal_df(q2)
+
+
+def test_agg_over_join_pin():
+    s = make_session()
+    a = make_df(s, {"k": IntGen(lo=0, hi=30), "v": IntGen()}, 300, seed=25)
+    b = make_df(s, {"k": IntGen(lo=0, hi=30), "w": IntGen()}, 80, seed=27)
+    q = (a.join(b, ([col("k")], [col("k")]), how="inner")
+          .group_by(col("k")).agg(Sum(col("v")).alias("sv"),
+                                  Count(col("w")).alias("n")))
+    assert_tpu_cpu_equal_df(q)
