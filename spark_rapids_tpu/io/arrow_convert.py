@@ -132,7 +132,8 @@ def _chunked_to_column(arr: pa.ChunkedArray) -> HostColumn:
                           .fill_null(0)).astype(np.int64)
         return HostColumn(vals, mask, out_t)
     phys = np.dtype(out_t.physical)
-    vals = np.asarray(arr.fill_null(0)).astype(phys, copy=False)
+    fill = False if out_t == dt.BOOL else 0
+    vals = np.asarray(arr.fill_null(fill)).astype(phys, copy=False)
     return HostColumn(np.ascontiguousarray(vals), mask, out_t)
 
 

@@ -48,3 +48,15 @@ class DataFrameReader:
     def json(self, *paths, schema: Optional[List] = None):
         return self._scan(list(paths) if len(paths) > 1 else paths[0],
                           "json", schema)
+
+    def avro(self, *paths, schema: Optional[List] = None):
+        return self._scan(list(paths) if len(paths) > 1 else paths[0],
+                          "avro", schema)
+
+    def hive_text(self, *paths, schema: Optional[List] = None,
+                  sep: str = "\x01"):
+        """Hive default-delimited text (ctrl-A separated, no header)."""
+        self._options.setdefault("sep", sep)
+        self._options.setdefault("header", False)
+        return self._scan(list(paths) if len(paths) > 1 else paths[0],
+                          "hivetext", schema)

@@ -39,7 +39,7 @@ from ..plan.host_table import HostTable, concat_tables, table_to_batch
 from ..plan.logical import LogicalPlan
 from .arrow_convert import arrow_schema_to_schema, arrow_to_host_table
 
-FORMATS = ("parquet", "orc", "csv", "json")
+FORMATS = ("parquet", "orc", "csv", "json", "avro", "hivetext")
 
 
 def expand_paths(path_or_paths) -> List[str]:
@@ -108,9 +108,13 @@ class FileScan(LogicalPlan):
         self.options = options or {}
         self.pushed_filter = pushed_filter
         if schema is None:
-            arrow_schema = infer_file_schema(self.paths[0], fmt,
-                                             self.options)
-            schema = arrow_schema_to_schema(arrow_schema)
+            if fmt == "avro":
+                from .avro import infer_avro_schema
+                schema = infer_avro_schema(self.paths[0])
+            else:
+                arrow_schema = infer_file_schema(self.paths[0], fmt,
+                                                 self.options)
+                schema = arrow_schema_to_schema(arrow_schema)
         self._schema = list(schema)
 
     @property
@@ -208,7 +212,19 @@ def read_file_to_tables(path: str, fmt: str, schema: Schema,
     to the DECLARED schema: positional rename when file column names
     differ (e.g. headerless CSV) and per-column cast to declared dtypes."""
     names = [n for n, _ in schema]
-    if fmt == "parquet":
+    if fmt == "avro":
+        # from-scratch container decode (io/avro.py); route through
+        # arrow so the shared _conform rename/cast applies like every
+        # other format
+        from .arrow_convert import host_table_to_arrow
+        from .avro import read_avro_file
+        table = host_table_to_arrow(read_avro_file(path))
+    elif fmt == "hivetext":
+        opts = dict(options)
+        opts.setdefault("sep", "\x01")
+        opts.setdefault("header", False)
+        table = _read_csv(path, opts)
+    elif fmt == "parquet":
         import pyarrow.dataset as ds
         dataset = ds.dataset(path, format="parquet")
         cols = names if set(names) <= set(dataset.schema.names) else None

@@ -49,13 +49,25 @@ def _write_one(table: pa.Table, path: str, fmt: str,
         with open(path, "w") as f:
             for r in rows:
                 f.write(jsonlib.dumps(r, default=str) + "\n")
+    elif fmt == "avro":
+        # pyarrow has no avro writer: go through the from-scratch
+        # container writer (io/avro.py)
+        from .arrow_convert import arrow_to_host_table
+        from .avro import write_avro_file
+        write_avro_file(arrow_to_host_table(table), path,
+                        codec=options.get("compression", "deflate"))
+    elif fmt == "hivetext":
+        import pyarrow.csv as pacsv
+        opts = pacsv.WriteOptions(include_header=False,
+                                  delimiter=options.get("sep", "\x01"))
+        pacsv.write_csv(table, path, write_options=opts)
     else:
         raise ValueError(fmt)
     return os.path.getsize(path)
 
 
 _EXT = {"parquet": ".parquet", "orc": ".orc", "csv": ".csv",
-        "json": ".json"}
+        "json": ".json", "avro": ".avro", "hivetext": ".txt"}
 
 
 def _apply_write_rebase(table: HostTable, options: dict) -> HostTable:
@@ -193,6 +205,12 @@ class DataFrameWriter:
 
     def orc(self, path: str) -> WriteStats:
         return self._write(path, "orc")
+
+    def avro(self, path: str) -> WriteStats:
+        return self._write(path, "avro")
+
+    def hive_text(self, path: str) -> WriteStats:
+        return self._write(path, "hivetext")
 
     def csv(self, path: str) -> WriteStats:
         return self._write(path, "csv")

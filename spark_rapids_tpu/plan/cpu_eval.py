@@ -772,6 +772,213 @@ def _reg_tz():
 _reg_tz()
 
 
+# --- JSON (independent sequential span walker as the oracle for the
+# device byte-scan kernel; same raw-span envelope, see expr/json.py) --------
+
+def _json_skip_ws(s, i):
+    while i < len(s) and s[i] in " \t\n\r":
+        i += 1
+    return i
+
+
+def _json_value_end(s, i):
+    """End index (exclusive) of the JSON value starting at i."""
+    import json
+    if i >= len(s):
+        return None
+    c = s[i]
+    if c == '"':
+        j = i + 1
+        while j < len(s):
+            if s[j] == "\\":
+                j += 2
+                continue
+            if s[j] == '"':
+                return j + 1
+            j += 1
+        return None
+    if c in "{[":
+        depth = 0
+        j = i
+        in_str = False
+        while j < len(s):
+            ch = s[j]
+            if in_str:
+                if ch == "\\":
+                    j += 2
+                    continue
+                if ch == '"':
+                    in_str = False
+            elif ch == '"':
+                in_str = True
+            elif ch in "{[":
+                depth += 1
+            elif ch in "}]":
+                depth -= 1
+                if depth == 0:
+                    return j + 1
+            j += 1
+        return None
+    j = i
+    while j < len(s) and s[j] not in ",}] \t\n\r":
+        j += 1
+    return j
+
+
+def _json_get_path(s, segments):
+    """Raw span of the value at the path; None when missing/invalid."""
+    import json
+    i = _json_skip_ws(s, 0)
+    end = _json_value_end(s, i)
+    if end is None:
+        return None
+    for kind, arg in segments:
+        i = _json_skip_ws(s, i)
+        if kind == "key":
+            if i >= len(s) or s[i] != "{":
+                return None
+            j = i + 1
+            found = None
+            while True:
+                j = _json_skip_ws(s, j)
+                if j >= len(s) or s[j] == "}":
+                    break
+                ke = _json_value_end(s, j)
+                if ke is None:
+                    return None
+                try:
+                    key = json.loads(s[j:ke])
+                except ValueError:
+                    return None
+                j = _json_skip_ws(s, ke)
+                if j >= len(s) or s[j] != ":":
+                    return None
+                j = _json_skip_ws(s, j + 1)
+                ve = _json_value_end(s, j)
+                if ve is None:
+                    return None
+                if key == arg:
+                    found = (j, ve)
+                    break
+                j = _json_skip_ws(s, ve)
+                if j < len(s) and s[j] == ",":
+                    j += 1
+            if found is None:
+                return None
+            i, end = found
+        else:
+            if i >= len(s) or s[i] != "[":
+                return None
+            j = _json_skip_ws(s, i + 1)
+            n = 0
+            found = None
+            while j < len(s) and s[j] != "]":
+                ve = _json_value_end(s, j)
+                if ve is None:
+                    return None
+                if n == arg:
+                    found = (j, ve)
+                    break
+                n += 1
+                j = _json_skip_ws(s, ve)
+                if j < len(s) and s[j] == ",":
+                    j = _json_skip_ws(s, j + 1)
+            if found is None:
+                return None
+            i, end = found
+    span = s[i:end]
+    if span == "null":
+        return None
+    if span.startswith('"'):
+        # manual simple-escape decode matching the device kernel
+        # (\uXXXX passes through un-decoded on both engines)
+        body = span[1:-1]
+        out = []
+        k = 0
+        esc_map = {'"': '"', "\\": "\\", "/": "/", "n": "\n",
+                   "t": "\t", "r": "\r", "b": "\b", "f": "\f"}
+        while k < len(body):
+            c = body[k]
+            if c == "\\" and k + 1 < len(body) and \
+                    body[k + 1] in esc_map:
+                out.append(esc_map[body[k + 1]])
+                k += 2
+                continue
+            out.append(c)
+            k += 1
+        return "".join(out)
+    return span
+
+
+def _reg_json():
+    from ..expr import json as JX
+
+    @_reg(JX.GetJsonObject)
+    def _gjo(expr, table):
+        a, m = _ev(expr.children[0], table)
+        out = np.empty(len(a), dtype=object)
+        mask = np.zeros(len(a), bool)
+        for i, (s, mk) in enumerate(zip(a, m)):
+            v = _json_get_path(s, expr.segments) if mk else None
+            out[i] = v if v is not None else ""
+            mask[i] = mk and v is not None
+        return out, mask
+
+    @_reg(JX.JsonToStructs)
+    def _from_json(expr, table):
+        import json
+        a, m = _ev(expr.children[0], table)
+        out = np.empty(len(a), dtype=object)
+        mask = np.zeros(len(a), bool)
+        fields = expr.struct_schema.fields
+        for i, (s, mk) in enumerate(zip(a, m)):
+            if not mk:
+                continue
+            try:
+                obj = json.loads(s)
+            except ValueError:
+                continue
+            if not isinstance(obj, dict):
+                continue
+            out[i] = {n: _json_coerce(obj.get(n), t) for n, t in fields}
+            mask[i] = True
+        return out, mask
+
+    @_reg(JX.StructsToJson)
+    def _to_json(expr, table):
+        import json
+        a, m = _ev(expr.children[0], table)
+        out = np.empty(len(a), dtype=object)
+        for i, (v, mk) in enumerate(zip(a, m)):
+            out[i] = json.dumps(v, separators=(",", ":"),
+                                default=str) if mk else ""
+        return out, m
+
+
+def _json_coerce(v, t):
+    if v is None:
+        return None
+    try:
+        if t == dt.STRING:
+            return v if isinstance(v, str) else                 __import__("json").dumps(v, separators=(",", ":"))
+        if t.is_integral:
+            return int(v)
+        if t.is_floating:
+            return float(v)
+        if isinstance(t, dt.BooleanType):
+            return bool(v)
+        if isinstance(t, dt.ArrayType):
+            return [_json_coerce(x, t.element_type) for x in v]
+        if isinstance(t, dt.StructType):
+            return {n: _json_coerce(v.get(n), ft) for n, ft in t.fields}
+    except (TypeError, ValueError):
+        return None
+    return None
+
+
+_reg_json()
+
+
 # ---------------------------------------------------------------------------
 # math
 # ---------------------------------------------------------------------------

@@ -254,6 +254,12 @@ for _cls in (TZX.FromUTCTimestamp, TZX.ToUTCTimestamp):
 _expr(D.MakeDate, ts.integral)
 _expr(D.TruncDate, ts.TypeSig(ts.DATE, ts.STRING))
 
+from ..expr import json as JX  # noqa: E402
+
+_expr(JX.GetJsonObject, ts.TypeSig(ts.STRING))
+# from_json/to_json: CPU engine (no device JSON tokenizer yet) — no
+# rule registered routes them to cpu_eval
+
 _expr(H.Murmur3Hash, ts.comparable)
 _expr(H.XxHash64, ts.comparable)
 

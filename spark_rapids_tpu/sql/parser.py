@@ -1313,6 +1313,42 @@ class Analyzer:
         if name == "trunc":
             fmt = self._lit_value(ast.args[1], "trunc format")
             return D.TruncDate(args[0], lit(fmt))
+        if name == "get_json_object":
+            from ..expr import json as JX
+            self._arity(ast, 2)
+            try:
+                return JX.GetJsonObject(
+                    args[0], self._lit_value(ast.args[1], "JSON path"))
+            except TypeError as e:
+                raise SqlError(str(e))
+        if name == "from_json":
+            from ..expr import json as JX
+            self._arity(ast, 2)
+            schema_s = self._lit_value(ast.args[1], "schema")
+            fields = []
+            # split on commas OUTSIDE parens (decimal(10,2) stays whole)
+            parts, depth_, cur = [], 0, []
+            for ch in schema_s:
+                if ch == "(":
+                    depth_ += 1
+                elif ch == ")":
+                    depth_ -= 1
+                if ch == "," and depth_ == 0:
+                    parts.append("".join(cur))
+                    cur = []
+                else:
+                    cur.append(ch)
+            if cur:
+                parts.append("".join(cur))
+            for part in parts:
+                fname, _, ftype = part.strip().partition(" ")
+                fields.append((fname, Parser(ftype.strip()).parse_type()))
+            return JX.JsonToStructs(args[0],
+                                    dt.StructType(tuple(fields)))
+        if name == "to_json":
+            from ..expr import json as JX
+            self._arity(ast, 1)
+            return JX.StructsToJson(args[0])
         if name == "regexp_extract":
             from ..expr import regex as RX
             if len(ast.args) not in (2, 3):

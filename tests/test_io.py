@@ -181,3 +181,63 @@ def test_user_schema_casts_parquet(session, tmp_path):
     assert sorted(r["a"] for r in rows) == [1, 2]
     # and the physical lanes really are int64 (sum works on device)
     assert back.agg(Sum(col("a")).alias("s")).collect()[0]["s"] == 3
+
+
+# --- avro (from-scratch container codec) + hive text ------------------------
+
+def test_avro_roundtrip(session, tmp_path):
+    import datetime
+    from spark_rapids_tpu.columnar import dtypes as dt
+    data = {"i": [1, None, 3], "s": ["a", "b", None],
+            "f": [1.5, None, -2.25],
+            "d": [datetime.date(2020, 1, 2), None,
+                  datetime.date(1999, 12, 31)],
+            "t": [datetime.datetime(2021, 6, 1, 12, 30,
+                                    tzinfo=datetime.timezone.utc),
+                  None, None],
+            "b": [True, False, None]}
+    schema = [("i", dt.INT64), ("s", dt.STRING), ("f", dt.FLOAT64),
+              ("d", dt.DATE), ("t", dt.TIMESTAMP), ("b", dt.BOOL)]
+    df = session.create_dataframe(data, schema)
+    path = str(tmp_path / "t.avro")
+    import os
+    os.makedirs(str(tmp_path / "av"), exist_ok=True)
+    df.write.avro(str(tmp_path / "av"))
+    back = session.read.avro(str(tmp_path / "av")).to_pydict()
+    assert back == data
+
+
+def test_avro_deflate_and_null_codecs(session, tmp_path):
+    from spark_rapids_tpu.io.avro import read_avro_file, write_avro_file
+    from spark_rapids_tpu.plan.host_table import from_pydict, to_pydict
+    from spark_rapids_tpu.columnar import dtypes as dt
+    data = {"x": list(range(500)), "y": [f"row{i}" for i in range(500)]}
+    schema = [("x", dt.INT64), ("y", dt.STRING)]
+    ht = from_pydict(data, schema)
+    for codec in ("null", "deflate"):
+        p = str(tmp_path / f"c_{codec}.avro")
+        write_avro_file(ht, p, codec=codec)
+        assert to_pydict(read_avro_file(p)) == data
+
+
+def test_avro_query_through_engine(session, tmp_path):
+    from spark_rapids_tpu.columnar import dtypes as dt
+    from spark_rapids_tpu.expr.aggregates import Sum
+    data = {"k": [1, 2, 1, 2, 1], "v": [10, 20, 30, 40, 50]}
+    df = session.create_dataframe(data, [("k", dt.INT32), ("v", dt.INT64)])
+    out_dir = str(tmp_path / "q")
+    df.write.avro(out_dir)
+    q = (session.read.avro(out_dir)
+         .group_by(col("k")).agg(Sum(col("v")).alias("sv")))
+    assert_tpu_cpu_equal_df(q)
+
+
+def test_hive_text_roundtrip(session, tmp_path):
+    from spark_rapids_tpu.columnar import dtypes as dt
+    data = {"a": [1, 2, 3], "s": ["x", "yy", "zzz"]}
+    schema = [("a", dt.INT64), ("s", dt.STRING)]
+    df = session.create_dataframe(data, schema)
+    out_dir = str(tmp_path / "ht")
+    df.write.hive_text(out_dir)
+    back = session.read.hive_text(out_dir, schema=schema).to_pydict()
+    assert back == data

@@ -1,0 +1,133 @@
+"""JSON expressions: device get_json_object vs the sequential span
+oracle, plus CPU-engine from_json/to_json."""
+
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.expr.core import col
+from spark_rapids_tpu.expr.json import (GetJsonObject, JsonPathUnsupported,
+                                        JsonToStructs, StructsToJson,
+                                        parse_json_path)
+from spark_rapids_tpu.plan import TpuSession
+from spark_rapids_tpu.testing import (assert_falls_back_to_cpu,
+                                      assert_tpu_cpu_equal_df)
+
+DOCS = [
+    '{"a": 1, "b": "two", "c": [1, 2, 3]}',
+    '{"a": {"x": 10, "y": "deep"}, "b": null}',
+    '{"b": "only b"}',
+    '[5, 6, {"a": 7}]',
+    '{"a": "with \\"quote\\" and \\n newline"}',
+    '  {"a" : 42.50 , "list": [{"k": "v0"}, {"k": "v1"}]}  ',
+    'not json at all',
+    "",
+    None,
+    '{"a": true, "t": false}',
+]
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession()
+
+
+@pytest.fixture(scope="module")
+def df(session):
+    return session.create_dataframe({"j": DOCS}, [("j", dt.STRING)])
+
+
+def test_parse_json_path():
+    assert parse_json_path("$.a.b") == [("key", "a"), ("key", "b")]
+    assert parse_json_path("$.a[2]") == [("key", "a"), ("index", 2)]
+    assert parse_json_path("$['x y'][0]") == [("key", "x y"),
+                                              ("index", 0)]
+    with pytest.raises(JsonPathUnsupported):
+        parse_json_path("$.*")
+    with pytest.raises(JsonPathUnsupported):
+        parse_json_path("a.b")
+
+
+@pytest.mark.parametrize("path", [
+    "$.a", "$.b", "$.c", "$.a.x", "$.a.y", "$.c[1]", "$.c[5]", "$[0]",
+    "$[2].a", "$.list[1].k", "$.missing", "$.t",
+])
+def test_get_json_object_differential(session, df, path):
+    assert_tpu_cpu_equal_df(df.select(
+        GetJsonObject(col("j"), path).alias("v")))
+
+
+def test_get_json_object_known_values(session, df):
+    out = df.select(
+        GetJsonObject(col("j"), "$.a").alias("a"),
+        GetJsonObject(col("j"), "$.c[1]").alias("c1")).to_pydict()
+    assert out["a"][0] == "1"
+    assert out["a"][1] == '{"x": 10, "y": "deep"}'  # raw span
+    assert out["a"][2] is None
+    assert out["a"][4] == 'with "quote" and \n newline'
+    assert out["a"][5] == "42.50"  # raw number text preserved
+    assert out["a"][6] is None and out["a"][7] is None
+    assert out["a"][9] == "true"
+    assert out["c1"][0] == "2"
+    # null JSON value -> SQL NULL
+    outb = df.select(GetJsonObject(col("j"), "$.b").alias("b")).to_pydict()
+    assert outb["b"][1] is None
+    assert outb["b"][2] == "only b"
+
+
+def test_from_json_to_json_cpu(session, df):
+    schema = dt.StructType((("a", dt.INT64), ("b", dt.STRING)))
+    q = df.select(JsonToStructs(col("j"), schema).alias("s"))
+    assert_falls_back_to_cpu(q)
+    out = q.to_pydict()
+    assert out["s"][0] == {"a": 1, "b": "two"}
+    assert out["s"][2] == {"a": None, "b": "only b"}
+    assert out["s"][6] is None  # invalid json -> null struct
+    q2 = df.select(StructsToJson(
+        JsonToStructs(col("j"), schema)).alias("t"))
+    out2 = q2.to_pydict()
+    assert out2["t"][0] == '{"a":1,"b":"two"}'
+
+
+def test_sql_json_functions(session, df):
+    session.create_or_replace_temp_view("jt", df)
+    got = session.sql("""
+        select get_json_object(j, '$.a.x') ax,
+               from_json(j, 'a int, b string') st,
+               to_json(from_json(j, 'a int, b string')) rt
+        from jt""").to_pydict()
+    assert got["ax"][1] == "10"
+    assert got["st"][0] == {"a": 1, "b": "two"}
+    assert got["rt"][0] == '{"a":1,"b":"two"}'
+
+
+def test_key_shadowed_by_string_value(session):
+    # a string VALUE equal to the key must not shadow the real key
+    df = session.create_dataframe(
+        {"j": ['{"x": "key", "key": 5}', '{"key": "x"}']},
+        [("j", dt.STRING)])
+    out = df.select(GetJsonObject(col("j"), "$.key").alias("v")).to_pydict()
+    assert out["v"] == ["5", "x"]
+    assert_tpu_cpu_equal_df(df.select(
+        GetJsonObject(col("j"), "$.key").alias("v")))
+
+
+def test_unicode_escape_envelope(session):
+    # \uXXXX passes through un-decoded on BOTH engines (documented
+    # envelope deviation from Spark's full Jackson decode)
+    df = session.create_dataframe(
+        {"j": ['{"a": "pre\\u0041post", "b": "x\\\\y"}']},
+        [("j", dt.STRING)])
+    q = df.select(GetJsonObject(col("j"), "$.a").alias("a"),
+                  GetJsonObject(col("j"), "$.b").alias("b"))
+    out = q.to_pydict()
+    assert out["a"] == ["pre\\u0041post"]
+    assert out["b"] == ["x\\y"]
+    assert_tpu_cpu_equal_df(q)
+
+
+def test_from_json_decimal_schema(session, df):
+    session.create_or_replace_temp_view("jt2", df)
+    got = session.sql(
+        "select from_json(j, 'a decimal(10,2), b string') st from jt2"
+    ).to_pydict()
+    assert got["st"][2] == {"a": None, "b": "only b"}
