@@ -332,6 +332,15 @@ MESH_DATA_AXIS = conf("srt.mesh.dataAxis") \
     .doc("Name of the mesh axis partitions are sharded over.") \
     .internal().string("data")
 
+PALLAS_ENABLED = conf("srt.sql.pallas.enabled") \
+    .doc("Execute eligible global filter+aggregate pipelines as fused "
+         "pallas TPU kernels (one HBM pass, no filtered intermediate). "
+         "On TPU the fused kernel computes float sums in float32 with "
+         "float64 cross-tile combination — the same corner-case "
+         "deviation class as spark.rapids.sql.variableFloatAgg.enabled; "
+         "on CPU (interpret mode) arithmetic stays float64-exact.") \
+    .boolean(True)
+
 OPTIMIZER_ENABLED = conf("srt.sql.optimizer.enabled") \
     .doc("Cost-based optimizer: keep plans below the row threshold on "
          "the CPU engine where device compile/transfer overhead "

@@ -94,6 +94,9 @@ class HashAggregateExec(TpuExec):
         self._jit_update = jax.jit(self._update)
         self._jit_merge = jax.jit(self._merge_finalize)
         self._split_cache = {}
+        from . import pallas_agg
+        self._pallas_gate = pallas_agg.pallas_eligible(self)
+        self._pallas_cache = {}
 
     @property
     def output_schema(self) -> Schema:
@@ -335,6 +338,11 @@ class HashAggregateExec(TpuExec):
         m = ctx.metrics_for(self.exec_id)
         agg_time = m.setdefault("aggTime", Metric("aggTime", Metric.MODERATE,
                                                   "ns"))
+        if self.mode in (PARTIAL, COMPLETE):
+            fused = self._pallas_stream_or_none(ctx, agg_time)
+            if fused is not None:
+                yield from fused
+                return
         if self.mode == PARTIAL:
             yield from self._partial_stream(ctx, agg_time)
             return
@@ -352,6 +360,81 @@ class HashAggregateExec(TpuExec):
         # COMPLETE: partial + merge fused in one stage
         yield from self._merge_partition(
             ctx, self._partial_stream(ctx, agg_time), agg_time)
+
+    # --- fused pallas path (global aggregates over simple numerics) ---
+    def _pallas_stream_or_none(self, ctx: ExecContext, agg_time: Metric):
+        """Fused filter+aggregate via ops/pallas_kernels.tile_reduce —
+        one HBM pass per batch, no filtered intermediate. None keeps the
+        stock XLA path (gate miss, conf off, or warmup lowering
+        failure)."""
+        from ..conf import PALLAS_ENABLED
+        from . import pallas_agg
+        if not self._pallas_gate or not ctx.conf.get(PALLAS_ENABLED) \
+                or self._pallas_cache.get("failed"):
+            return None
+        from .basic import CoalesceBatchesExec, FilterExec
+        source, pred = self.children[0], None
+        node = source
+        while isinstance(node, CoalesceBatchesExec):
+            node = node.children[0]
+        if isinstance(node, FilterExec) and \
+                pallas_agg.pred_safe(node.condition, self.input_schema):
+            source, pred = node.children[0], node.condition
+        key = id(pred)
+        entry = self._pallas_cache.get(key)
+        if entry is None:
+            plan = pallas_agg.build_plan(self, pred)
+            fn = jax.jit(plan.batch_fn())
+            if not self._pallas_warmup(plan, fn):
+                self._pallas_cache["failed"] = True
+                return None
+            entry = self._pallas_cache[key] = (plan, fn)
+        plan, fn = entry
+
+        def stream():
+            m = ctx.metrics_for(self.exec_id)
+            pb = m.setdefault("pallasBatches",
+                              Metric("pallasBatches", Metric.DEBUG))
+            totals = plan.init_totals()
+            saw = False
+            for batch in source.execute(ctx):
+                if int(batch.num_rows) == 0:
+                    continue
+                saw = True
+                with ctx.semaphore, NvtxTimer(agg_time, "agg.pallas"):
+                    partials = fn(batch)
+                plan.combine(totals, partials)
+                pb.add(1)
+            if not saw:
+                if self.mode == COMPLETE:
+                    yield self._empty_global_result()
+                return
+            packed = self._pack(ColumnarBatch([], [], jnp.int32(1)),
+                                plan.states(totals), jnp.int32(1), 8)
+            if self.mode == PARTIAL:
+                yield packed
+            else:
+                with ctx.semaphore:
+                    yield self._jit_merge(packed)
+        return stream()
+
+    def _pallas_warmup(self, plan, fn) -> bool:
+        """Compile-check the fused kernel on a tiny synthetic batch so a
+        Mosaic lowering gap falls back BEFORE the child stream is
+        consumed."""
+        schema_d = dict(self.input_schema)
+        cols, names = [], []
+        for n in plan.ref_names:
+            t = schema_d[n]
+            cols.append(ColumnVector(jnp.zeros(8, t.physical),
+                                     jnp.zeros(8, jnp.bool_), t))
+            names.append(n)
+        try:
+            out = fn(ColumnarBatch(cols, names, jnp.int32(0)))
+            jax.block_until_ready(out)
+            return True
+        except Exception:  # pragma: no cover - backend specific
+            return False
 
     def _empty_global_result(self) -> ColumnarBatch:
         cap = 8

@@ -1,0 +1,322 @@
+"""Fused filter+aggregate lowering onto the pallas tile_reduce kernel.
+
+A global (no grouping keys) HashAggregateExec whose aggregates — and,
+when its child is a FilterExec, the filter predicate too — are simple
+numeric expressions executes here as ONE pallas pass per input batch:
+predicate, projections, and partial reduction all evaluate in VMEM, so
+each input column crosses HBM exactly once and no filtered intermediate
+batch is ever materialized. This is the TPU counterpart of the
+reference's fused cuDF reduction path for q6-shaped queries
+(GpuAggregateExec.scala AggHelper update pass over a filtered iterator).
+
+Numerics: on TPU the kernel computes in float32 (float64 inputs and
+float64 literals are demoted before tracing — Mosaic has no f64), with
+per-tile partials combined in emulated float64 outside the kernel; on
+CPU (pallas interpret mode, used by the test lane) everything stays
+float64, so differential tests check the exact Spark semantics. The
+float32 tile arithmetic on TPU is the same class of deviation the
+reference ships behind spark.rapids.sql.variableFloatAgg.enabled.
+
+The gate is static and conservative: unsupported aggregate/expression
+shapes simply keep the stock XLA path. A one-time warmup compile on a
+tiny synthetic batch guards against Mosaic lowering gaps at runtime —
+if it fails, the exec permanently falls back before consuming its child.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import dtypes as dt
+from ..columnar.vector import ColumnVector, ColumnarBatch
+from ..expr import aggregates as Agg
+from ..expr import arithmetic as A
+from ..expr import core as E
+from ..expr import predicates as Pr
+from ..expr.cast import Cast
+from ..ops import pallas_kernels as PK
+
+_SAFE_NODES = (
+    E.ColumnRef, E.Literal, E.Alias, Cast,
+    A.Add, A.Subtract, A.Multiply, A.Divide, A.UnaryMinus,
+    A.UnaryPositive, A.Abs, A.Least, A.Greatest,
+    Pr.EqualTo, Pr.LessThan, Pr.GreaterThan, Pr.LessThanOrEqual,
+    Pr.GreaterThanOrEqual, Pr.EqualNullSafe, Pr.And, Pr.Or, Pr.Not,
+    Pr.IsNull, Pr.IsNotNull, Pr.IsNaN, Pr.InSet,
+)
+_SAFE_DTYPES = (dt.BOOL, dt.INT8, dt.INT16, dt.INT32, dt.DATE,
+                dt.FLOAT32, dt.FLOAT64)
+_FLOATY = (dt.FLOAT32, dt.FLOAT64)
+# min/max must be exact in a float32 lane on TPU: floats are closed
+# under min/max, DATE/INT16/INT8 values are < 2^24
+_MINMAX_DTYPES = (dt.FLOAT32, dt.FLOAT64, dt.DATE, dt.INT8, dt.INT16)
+
+
+def _expr_safe(expr: E.Expression, schema, no_f64: bool = False) -> bool:
+    """``schema`` is the Schema list ([(name, dtype)]) data_type wants.
+    ``no_f64`` additionally rejects any float64-typed subexpression —
+    used for TPU filter predicates, where demoting to float32 would
+    change which ROWS pass (not just low-order sum bits, the only
+    deviation srt.sql.pallas.enabled's contract covers)."""
+    if not isinstance(expr, _SAFE_NODES):
+        return False
+    if isinstance(expr, E.Literal) and expr.value is None:
+        return False
+    try:
+        t = expr.data_type(schema)
+        if t not in _SAFE_DTYPES or (no_f64 and t == dt.FLOAT64):
+            return False
+    except Exception:
+        return False
+    return all(_expr_safe(c, schema, no_f64) for c in expr.children)
+
+
+def _demote_f64(expr: E.Expression) -> E.Expression:
+    """float64 -> float32 rewrite for the TPU kernel trace (Mosaic has
+    no f64). Column data itself is cast outside the kernel; this fixes
+    the literals/casts inside the tree so no f64 op is ever traced."""
+    if isinstance(expr, E.Literal) and expr.dtype == dt.FLOAT64:
+        return E.Literal(float(np.float32(expr.value)), dt.FLOAT32)
+    if isinstance(expr, Cast) and expr.to == dt.FLOAT64:
+        return Cast(_demote_f64(expr.children[0]), dt.FLOAT32, expr.ansi)
+    kids = [_demote_f64(c) for c in expr.children]
+    if all(a is b for a, b in zip(kids, expr.children)):
+        return expr
+    clone = copy.copy(expr)
+    clone.children = kids
+    return clone
+
+
+def _collect_refs(exprs, names: set) -> None:
+    for e in exprs:
+        if isinstance(e, E.ColumnRef):
+            names.add(e.name)
+        _collect_refs(e.children, names)
+
+
+class _KernelBatch(ColumnarBatch):
+    """Shim batch for tracing expressions inside the kernel: live_mask
+    comes from a block input instead of an iota (Mosaic-unfriendly)."""
+
+    def __init__(self, columns, names, num_rows, live):
+        super().__init__(columns, names, num_rows)
+        self._live = live
+
+    def live_mask(self):
+        return self._live
+
+
+class PallasAggPlan:
+    """Static lowering of (pred, agg_exprs) onto tile_reduce outputs."""
+
+    def __init__(self, agg_exprs, input_schema, pred: Optional[E.Expression]):
+        self.input_schema = input_schema
+        self.pred = pred
+        schema = list(input_schema)
+        demote = PK.on_tpu()
+        self._prep = _demote_f64 if demote else (lambda e: e)
+        self.kinds: List[str] = []
+        # per agg: list of (state_name, slot_index, state_dtype)
+        self.agg_slots: List[List[Tuple[str, int, dt.DType]]] = []
+        self._builders: List[Callable] = []
+        refs: set = set()
+        if pred is not None:
+            _collect_refs([pred], refs)
+        for fn, _name in agg_exprs:
+            in_t = (fn.children[0].data_type(schema)
+                    if fn.children else None)
+            slots = []
+            if isinstance(fn, (Agg.Sum, Agg.Average)):
+                slots.append(("sum", self._slot(PK.SUM), dt.FLOAT64))
+                slots.append(("count", self._slot(PK.SUM), dt.INT64))
+                self._builders.append(self._masked_sum(fn))
+            elif isinstance(fn, Agg.CountStar):
+                slots.append(("count", self._slot(PK.SUM), dt.INT64))
+                self._builders.append(self._count_star())
+            elif isinstance(fn, Agg.Count):
+                slots.append(("count", self._slot(PK.SUM), dt.INT64))
+                self._builders.append(self._count(fn))
+            elif isinstance(fn, (Agg.Min, Agg.Max)):
+                kind = PK.MAX if fn.largest else PK.MIN
+                slots.append((fn._key, self._slot(kind), in_t))
+                slots.append(("seen", self._slot(PK.SUM), dt.BOOL))
+                self._builders.append(self._minmax(fn, kind))
+            else:
+                raise AssertionError(type(fn))
+            self.agg_slots.append(slots)
+        _collect_refs([fn for fn, _ in agg_exprs], refs)
+        self.ref_names = sorted(refs)
+
+    def _slot(self, kind: str) -> int:
+        self.kinds.append(kind)
+        return len(self.kinds) - 1
+
+    # --- per-aggregate value builders (traced inside the kernel) ---
+    def _masked_sum(self, fn):
+        expr = self._prep(fn.children[0])
+
+        def build(batch, mask):
+            c = expr.eval(batch)
+            m = mask & c.validity
+            zero = jnp.zeros((), c.data.dtype)
+            return [jnp.where(m, c.data, zero), m.astype(jnp.float32)]
+        return build
+
+    def _count_star(self):
+        def build(batch, mask):
+            return [mask.astype(jnp.float32)]
+        return build
+
+    def _count(self, fn):
+        expr = self._prep(fn.children[0])
+
+        def build(batch, mask):
+            c = expr.eval(batch)
+            return [(mask & c.validity).astype(jnp.float32)]
+        return build
+
+    def _minmax(self, fn, kind):
+        expr = self._prep(fn.children[0])
+
+        def build(batch, mask):
+            c = expr.eval(batch)
+            m = mask & c.validity
+            fill = jnp.asarray(PK.reduce_identity(kind, c.data.dtype),
+                               c.data.dtype)
+            return [jnp.where(m, c.data, fill), m.astype(jnp.float32)]
+        return build
+
+    # --- the fused per-batch function (jit this) ---
+    def batch_fn(self):
+        schema_d = dict(self.input_schema)  # name -> dtype lookup
+        names = self.ref_names
+        demote = PK.on_tpu()
+        pred = self._prep(self.pred) if self.pred is not None else None
+        builders = self._builders
+        kinds = self.kinds
+
+        def shim_dtype(t: dt.DType) -> dt.DType:
+            return dt.FLOAT32 if demote and t == dt.FLOAT64 else t
+
+        col_dtypes = [shim_dtype(schema_d[n]) for n in names]
+
+        def run(batch: ColumnarBatch):
+            arrays = []
+            for n, st in zip(names, col_dtypes):
+                c = batch.column(n)
+                data = c.data
+                if demote and data.dtype == jnp.float64:
+                    data = data.astype(jnp.float32)
+                arrays.append(data)
+                arrays.append(c.validity.astype(jnp.uint8))
+            arrays.append(batch.live_mask().astype(jnp.uint8))
+
+            def row_fn(blocks):
+                tile = blocks[-1].shape[0]
+                cols = []
+                for i, (n, st) in enumerate(zip(names, col_dtypes)):
+                    cols.append(ColumnVector(blocks[2 * i],
+                                             blocks[2 * i + 1] != 0, st))
+                live = blocks[-1] != 0
+                kb = _KernelBatch(cols, list(names), tile, live)
+                mask = live
+                if pred is not None:
+                    pc = pred.eval(kb)
+                    mask = mask & pc.data & pc.validity
+                vals = []
+                for b in builders:
+                    vals.extend(b(kb, mask))
+                return vals
+
+            return PK.tile_reduce(arrays, row_fn, kinds)
+        return run
+
+    # --- host-side accumulation -> packed agg states ---
+    def init_totals(self) -> List[float]:
+        return [PK.reduce_identity(k, jnp.float64) if k != PK.SUM else 0.0
+                for k in self.kinds]
+
+    def combine(self, totals: List[float], partials) -> None:
+        for i, (k, p) in enumerate(zip(self.kinds, partials)):
+            v = float(p)
+            if k == PK.SUM:
+                totals[i] += v
+            elif np.isnan(v) or np.isnan(totals[i]):
+                # match the XLA lane: scatter-min/max propagates NaN
+                # (python min/max would drop it order-dependently)
+                totals[i] = float("nan")
+            elif k == PK.MIN:
+                totals[i] = min(totals[i], v)
+            else:
+                totals[i] = max(totals[i], v)
+
+    def states(self, totals: List[float], cap: int = 8) -> List[dict]:
+        """Accumulated scalars -> per-aggregate state dicts shaped for
+        HashAggregateExec._pack (cap-length arrays, group 0 live)."""
+        out = []
+        for slots in self.agg_slots:
+            d = {}
+            for sname, idx, stype in slots:
+                v = totals[idx]
+                phys = stype.physical
+                if stype == dt.BOOL:
+                    arr = np.zeros(cap, bool)
+                    arr[0] = v > 0
+                else:
+                    arr = np.zeros(cap, phys)
+                    if np.issubdtype(phys, np.integer) and \
+                            not np.isfinite(v):
+                        # zero-row min/max of a float-lane reduction:
+                        # the +/-inf identity can't enter an int buffer,
+                        # and seen=False keeps it from escaping anyway
+                        pass
+                    else:
+                        # real inf/NaN totals must flow through — the
+                        # XLA lane returns inf for sum(col with inf)
+                        arr[0] = np.asarray(v).astype(phys)
+                d[sname] = jnp.asarray(arr)
+            out.append(d)
+        return out
+
+
+def pallas_eligible(agg_exec) -> bool:
+    """The static gate; False keeps the stock XLA path. (The actual
+    PallasAggPlan is built lazily at execute time via build_plan, once
+    the fused-or-not predicate is resolved.)"""
+    if agg_exec.group_exprs:
+        return False
+    schema = list(agg_exec.input_schema)
+    for fn, _name in agg_exec.agg_exprs:
+        try:
+            if isinstance(fn, (Agg.Sum, Agg.Average)):
+                if fn.children[0].data_type(schema) not in _FLOATY:
+                    return False
+            elif isinstance(fn, (Agg.Min, Agg.Max)):
+                if fn.children[0].data_type(schema) not in _MINMAX_DTYPES:
+                    return False
+            elif isinstance(fn, (Agg.CountStar, Agg.Count)):
+                pass
+            else:
+                return False
+        except Exception:
+            return False
+        if not all(_expr_safe(c, schema) for c in fn.children):
+            return False
+    return True
+
+
+def build_plan(agg_exec, pred: Optional[E.Expression]) -> PallasAggPlan:
+    return PallasAggPlan(agg_exec.agg_exprs, agg_exec.input_schema, pred)
+
+
+def pred_safe(pred: E.Expression, input_schema) -> bool:
+    """Filter predicates must keep exact row selection: on TPU (where
+    the kernel would demote f64 to f32) any float64 subexpression keeps
+    the filter un-fused — the aggregate still runs in pallas over the
+    FilterExec's output."""
+    return _expr_safe(pred, list(input_schema), no_f64=PK.on_tpu())

@@ -1,0 +1,126 @@
+"""Pallas TPU kernels: fused single-HBM-pass reductions.
+
+The XLA operator pipeline materializes intermediates between filter and
+aggregate: ``FilterExec`` compacts passing rows into a fresh batch
+(argsort + gather = several HBM round-trips) before ``HashAggregateExec``
+reduces them. For the hottest reduction shape — scan -> filter -> global
+aggregate, the TPC-H q6 spine of BASELINE.md config 1 — that traffic is
+the whole cost: the aggregate output is a handful of scalars.
+
+``tile_reduce`` fuses predicate evaluation, projection, and partial
+aggregation into ONE pallas kernel: each row tile is DMA'd HBM->VMEM
+once, the predicate and aggregate inputs evaluate on the VPU in VMEM,
+and only per-tile partial scalars are written back. Cross-tile reduction
+happens outside the kernel (a few hundred elements) in float64, which
+both avoids a grid-accumulator dependence and improves numerics over a
+single running float32 accumulator.
+
+This is the TPU analogue of the fused cuDF reduction kernels behind the
+reference's aggregate update pass (SURVEY §2.9; GpuAggregateExec.scala
+AggHelper update); kernel structure follows the row-tile grid pattern of
+/opt/skills/guides/pallas_guide.md. The exec-side wiring lives in
+exec/aggregate.py (_PallasAggPlan).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+TILE_ROWS = 8 * 1024
+
+SUM = "sum"
+MIN = "min"
+MAX = "max"
+
+
+def on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def reduce_identity(kind: str, dtype) -> float:
+    """Identity element a masked-out lane must carry."""
+    if kind == SUM:
+        return 0.0
+    if jnp.issubdtype(dtype, jnp.floating):
+        return float(jnp.inf if kind == MIN else -jnp.inf)
+    info = jnp.iinfo(dtype)
+    return info.max if kind == MIN else info.min
+
+
+def _tile_kernel(row_fn: Callable, kinds: Sequence[str], out_dtype):
+    n_out = len(kinds)
+
+    def kernel(*refs):
+        in_refs, out_ref = refs[:-1], refs[-1]
+        blocks = [r[...] for r in in_refs]
+        vals = row_fn(blocks)
+        assert len(vals) == n_out, (len(vals), n_out)
+        row = jnp.zeros((1, 128), out_dtype)
+        for j, (v, kind) in enumerate(zip(vals, kinds)):
+            if kind == SUM:
+                r = jnp.sum(v.astype(out_dtype))
+            elif kind == MIN:
+                r = jnp.min(v).astype(out_dtype)
+            else:
+                r = jnp.max(v).astype(out_dtype)
+            row = row.at[0, j].set(r)
+        # (8, 128) is the smallest legal f32 output tile; replicate the
+        # partial row across sublanes and read sublane 0 outside.
+        out_ref[...] = jnp.broadcast_to(row, (8, 128))
+
+    return kernel
+
+
+def tile_reduce(inputs: Sequence[jax.Array], row_fn: Callable,
+                kinds: Sequence[str], out_dtype=None,
+                tile_rows: int = TILE_ROWS,
+                interpret: Optional[bool] = None) -> List[jax.Array]:
+    """Fused masked reduction over row tiles.
+
+    ``inputs``: same-length 1-D arrays (column data / validity / live
+    masks). ``row_fn(blocks) -> [vals...]`` maps one tile's blocks to
+    ``len(kinds)`` pre-masked 1-D value arrays — excluded rows must
+    already carry the kind's identity (0 for sum, +/-inf for min/max);
+    the tail padding this function appends is all-zeros, so mask inputs
+    pad to False and masked values pad to the identity via row_fn.
+
+    Returns one scalar per kind: per-tile partials from the kernel,
+    reduced across tiles here (sums in float64 when x64 is live).
+    """
+    if interpret is None:
+        interpret = not on_tpu()
+    if out_dtype is None:
+        out_dtype = jnp.float32 if on_tpu() else jnp.float64
+    n = inputs[0].shape[0]
+    tiles = max(1, -(-n // tile_rows))
+    padded = tiles * tile_rows
+    ins = [jnp.pad(a, (0, padded - n)) if padded != n else a
+           for a in inputs]
+    assert len(kinds) <= 128, "one (1,128) partial row per tile"
+
+    out = pl.pallas_call(
+        _tile_kernel(row_fn, kinds, out_dtype),
+        grid=(tiles,),
+        in_specs=[pl.BlockSpec((tile_rows,), lambda i: (i,))
+                  for _ in ins],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((tiles * 8, 128), out_dtype),
+        interpret=interpret,
+    )(*ins)
+    out = out[::8]
+
+    acc_t = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    results = []
+    for j, kind in enumerate(kinds):
+        col = out[:, j]
+        if kind == SUM:
+            results.append(jnp.sum(col.astype(acc_t)))
+        elif kind == MIN:
+            results.append(jnp.min(col))
+        else:
+            results.append(jnp.max(col))
+    return results
