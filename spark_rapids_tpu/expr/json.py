@@ -350,6 +350,17 @@ class GetJsonObject(Expression):
         vs, ve = _value_span(padded, inside, depth, ws, start, lens)
         vs = jnp.where(start < lens, vs, limit)
         ve = jnp.where(start < lens, ve, limit)
+        # truncated/unterminated documents are invalid (the CPU oracle's
+        # _json_value_end returns None for them): after the last byte the
+        # structural depth must be back to 0 and no string may be open
+        last = jnp.clip(lens - 1, 0, W - 1)
+        final_depth = jnp.take_along_axis(depth, last[:, None],
+                                          axis=1)[:, 0]
+        open_str = jnp.take_along_axis(inside, last[:, None],
+                                       axis=1)[:, 0]
+        well_formed = (lens == 0) | ((final_depth == 0) & ~open_str)
+        vs = jnp.where(well_formed, vs, limit)
+        ve = jnp.where(well_formed, ve, limit)
         for kind, arg in self.segments:
             if kind == "key":
                 vs, ve = _narrow_key(masks, arg, vs, ve, limit)

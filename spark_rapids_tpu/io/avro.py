@@ -103,6 +103,12 @@ def _field_dtype(sch) -> Tuple[dt.DType, bool]:
         if lt in ("timestamp-micros", "timestamp-millis") and \
                 base == "long":
             return dt.TIMESTAMP, False
+        if lt is not None and lt != "uuid":
+            # decimal / time-* / unknown logical types must NOT silently
+            # decode as their base type (decimal bytes would become
+            # mojibake strings); raising keeps the documented contract
+            # that unsupported schema features fall back to CPU
+            raise AvroUnsupported(f"unsupported logicalType {lt!r}")
         if base == "array":
             et, _ = _field_dtype(sch["items"])
             if et == dt.STRING or et.is_nested:
@@ -303,7 +309,6 @@ def infer_avro_schema(path: str) -> List[Tuple[str, dt.DType]]:
 
 def write_avro_file(table: HostTable, path: str,
                     codec: str = "deflate") -> None:
-    from ..columnar.vector import from_physical
     if codec not in ("null", "deflate"):
         raise AvroUnsupported(
             f"avro write codec {codec!r} not supported (null/deflate)")

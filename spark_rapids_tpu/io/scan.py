@@ -71,6 +71,15 @@ def infer_file_schema(path: str, fmt: str, options: dict) -> pa.Schema:
     if fmt == "json":
         table = _read_json(path, options)
         return table.schema
+    if fmt == "hivetext":
+        # headerless by definition: Hive's LazySimpleSerDe names columns
+        # positionally and types default to string
+        sep = options.get("sep", "\x01")
+        with open(path, "r", errors="replace") as f:
+            first = f.readline().rstrip("\n")
+        n = len(first.split(sep)) if first else 1
+        return pa.schema([pa.field(f"_c{i}", pa.string())
+                          for i in range(n)])
     raise ValueError(f"unknown format {fmt}")
 
 
@@ -83,6 +92,23 @@ def _read_csv(path: str, options: dict, head_only: bool = False) -> pa.Table:
     conv_opts = pacsv.ConvertOptions(
         null_values=[options.get("nullValue", "")],
         strings_can_be_null=True)
+    return pacsv.read_csv(path, read_options=read_opts,
+                          parse_options=parse_opts,
+                          convert_options=conv_opts)
+
+
+def _read_hivetext(path: str, options: dict) -> pa.Table:
+    """Hive LazySimpleSerDe text: delimiter-separated, NO quoting or
+    escaping of the delimiter, nulls as \\N. (CSV quoting rules would
+    corrupt values containing quote characters and turn empty strings
+    into nulls.)"""
+    import pyarrow.csv as pacsv
+    read_opts = pacsv.ReadOptions(autogenerate_column_names=True)
+    parse_opts = pacsv.ParseOptions(
+        delimiter=options.get("sep", "\x01"),
+        quote_char=False, escape_char=False)
+    conv_opts = pacsv.ConvertOptions(null_values=["\\N"],
+                                     strings_can_be_null=True)
     return pacsv.read_csv(path, read_options=read_opts,
                           parse_options=parse_opts,
                           convert_options=conv_opts)
@@ -220,10 +246,7 @@ def read_file_to_tables(path: str, fmt: str, schema: Schema,
         from .avro import read_avro_file
         table = host_table_to_arrow(read_avro_file(path))
     elif fmt == "hivetext":
-        opts = dict(options)
-        opts.setdefault("sep", "\x01")
-        opts.setdefault("header", False)
-        table = _read_csv(path, opts)
+        table = _read_hivetext(path, options)
     elif fmt == "parquet":
         import pyarrow.dataset as ds
         dataset = ds.dataset(path, format="parquet")

@@ -57,10 +57,20 @@ def _write_one(table: pa.Table, path: str, fmt: str,
         write_avro_file(arrow_to_host_table(table), path,
                         codec=options.get("compression", "deflate"))
     elif fmt == "hivetext":
-        import pyarrow.csv as pacsv
-        opts = pacsv.WriteOptions(include_header=False,
-                                  delimiter=options.get("sep", "\x01"))
-        pacsv.write_csv(table, path, write_options=opts)
+        # LazySimpleSerDe semantics: raw delimiter-joined fields (no
+        # CSV quoting — Hive reads quote characters literally), null as
+        # \N, lowercase booleans; empty strings stay empty strings
+        sep = options.get("sep", "\x01")
+
+        def cell(v):
+            if v is None:
+                return "\\N"
+            if isinstance(v, bool):
+                return "true" if v else "false"
+            return str(v)
+        with open(path, "w") as f:
+            for r in table.to_pylist():
+                f.write(sep.join(cell(v) for v in r.values()) + "\n")
     else:
         raise ValueError(fmt)
     return os.path.getsize(path)

@@ -967,11 +967,25 @@ def _json_coerce(v, t):
             return float(v)
         if isinstance(t, dt.BooleanType):
             return bool(v)
+        if isinstance(t, dt.DecimalType):
+            from decimal import ROUND_HALF_UP, Decimal
+            d = Decimal(str(v)).quantize(Decimal(1).scaleb(-t.scale),
+                                         rounding=ROUND_HALF_UP)
+            # overflow past the declared precision -> null (Spark)
+            if abs(d) >= Decimal(1).scaleb(t.precision - t.scale):
+                return None
+            return d
+        if isinstance(t, dt.DateType):
+            import datetime
+            return datetime.date.fromisoformat(v)
+        if isinstance(t, dt.TimestampType):
+            import datetime
+            return datetime.datetime.fromisoformat(v)
         if isinstance(t, dt.ArrayType):
             return [_json_coerce(x, t.element_type) for x in v]
         if isinstance(t, dt.StructType):
             return {n: _json_coerce(v.get(n), ft) for n, ft in t.fields}
-    except (TypeError, ValueError):
+    except (TypeError, ValueError, ArithmeticError):
         return None
     return None
 

@@ -241,3 +241,44 @@ def test_hive_text_roundtrip(session, tmp_path):
     df.write.hive_text(out_dir)
     back = session.read.hive_text(out_dir, schema=schema).to_pydict()
     assert back == data
+
+
+def test_hive_text_preserves_empty_and_quotes(session, tmp_path):
+    """LazySimpleSerDe semantics: empty string is NOT null (null is \\N)
+    and quote characters are literal data, not CSV quoting."""
+    from spark_rapids_tpu.columnar import dtypes as dt
+    data = {"s": ['a"b', "", None, "x,y"], "n": [1, 2, None, 4]}
+    schema = [("s", dt.STRING), ("n", dt.INT64)]
+    df = session.create_dataframe(data, schema)
+    out_dir = str(tmp_path / "htq")
+    df.write.hive_text(out_dir)
+    back = session.read.hive_text(out_dir, schema=schema).to_pydict()
+    assert back == data
+
+
+def test_hive_text_schema_inference(session, tmp_path):
+    """hive_text() without a schema infers _c0.. string columns."""
+    from spark_rapids_tpu.columnar import dtypes as dt
+    df = session.create_dataframe({"a": [1, 2], "b": ["x", "y"]},
+                                  [("a", dt.INT64), ("b", dt.STRING)])
+    out_dir = str(tmp_path / "hti")
+    df.write.hive_text(out_dir)
+    back = session.read.hive_text(out_dir)
+    assert [n for n, _ in back.schema] == ["_c0", "_c1"]
+    got = back.to_pydict()
+    assert got["_c0"] == ["1", "2"] and got["_c1"] == ["x", "y"]
+
+
+def test_avro_unknown_logical_type_raises(tmp_path):
+    """decimal/time logical types must raise AvroUnsupported (clear CPU
+    fallback), not silently decode base types into garbage."""
+    import json as jsonlib
+
+    import pytest
+
+    from spark_rapids_tpu.io.avro import AvroUnsupported, schema_from_avro
+    sch = {"type": "record", "name": "r", "fields": [
+        {"name": "d", "type": {"type": "bytes", "logicalType": "decimal",
+                               "precision": 10, "scale": 2}}]}
+    with pytest.raises(AvroUnsupported):
+        schema_from_avro(sch)

@@ -131,3 +131,33 @@ def test_from_json_decimal_schema(session, df):
         "select from_json(j, 'a decimal(10,2), b string') st from jt2"
     ).to_pydict()
     assert got["st"][2] == {"a": None, "b": "only b"}
+
+
+def test_truncated_documents_are_null(session):
+    """Unterminated docs must be SQL null on BOTH engines (the device
+    kernel checks end-of-input depth/string state; the oracle's
+    _json_value_end returns None)."""
+    docs = ['{"a": 1', '{"a": "abc', '[1, 2', '{"a": {"b": 1}',
+            '{"a": 1}', '"done"']
+    d = session.create_dataframe({"j": docs}, [("j", dt.STRING)])
+    assert_tpu_cpu_equal_df(d.select(
+        GetJsonObject(col("j"), "$.a").alias("v")))
+    vals = d.select(GetJsonObject(col("j"), "$.a").alias("v")) \
+        .to_pydict()["v"]
+    assert vals == [None, None, None, None, "1", None]
+
+
+def test_from_json_decimal_and_date(session):
+    docs = ['{"d": 42.5, "dt": "2021-03-04"}',
+            '{"d": 1e30, "dt": "oops"}', "{}"]
+    d = session.create_dataframe({"j": docs}, [("j", dt.STRING)])
+    out = d.select(JsonToStructs(
+        col("j"), dt.StructType([("d", dt.DecimalType(10, 2)),
+                                 ("dt", dt.DATE)])).alias("s")) \
+        .to_pydict()["s"]
+    import datetime
+    from decimal import Decimal
+    assert out[0] == {"d": Decimal("42.50"),
+                      "dt": datetime.date(2021, 3, 4)}
+    assert out[1] == {"d": None, "dt": None}  # overflow / bad date
+    assert out[2] == {"d": None, "dt": None}
