@@ -332,6 +332,12 @@ MESH_DATA_AXIS = conf("srt.mesh.dataAxis") \
     .doc("Name of the mesh axis partitions are sharded over.") \
     .internal().string("data")
 
+PYTHON_WORKERS_MAX = conf("srt.python.workers.max") \
+    .doc("Maximum pooled Python worker processes for vectorized pandas "
+         "UDFs (ArrowEvalPython). Workers are reused across batches and "
+         "queries. (python/rapids/daemon.py worker pool role)") \
+    .check(_positive).integer(4)
+
 PALLAS_ENABLED = conf("srt.sql.pallas.enabled") \
     .doc("Execute eligible global filter+aggregate pipelines as fused "
          "pallas TPU kernels (one HBM pass, no filtered intermediate). "

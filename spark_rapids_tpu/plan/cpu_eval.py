@@ -1825,6 +1825,42 @@ def _register_python_udf():
 _register_python_udf()
 
 
+def _register_pandas_udf():
+    from ..udf.pandas_udf import PandasUDF
+
+    @_reg(PandasUDF)
+    def _pandas_udf_eval(expr, table):
+        """Vectorized CPU evaluation with the same Arrow<->pandas
+        conversions the worker path uses, so fallback plans and
+        ArrowEvalPythonExec agree on null/dtype behavior."""
+        import pyarrow as pa
+
+        from ..io.arrow_convert import (_chunked_to_column,
+                                        dtype_to_arrow_type,
+                                        host_table_to_arrow)
+        from .host_table import HostColumn, HostTable
+        schema = table.schema()
+        cols, names = [], []
+        for i, c in enumerate(expr.children):
+            v, m = _ev(c, table)
+            cols.append(HostColumn(v, m, c.data_type(schema)))
+            names.append(f"a{i}")
+        arrow = host_table_to_arrow(HostTable(cols, names))
+        args = [arrow.column(i).to_pandas() for i in range(len(cols))]
+        res = expr.fn(*args)
+        arr = pa.chunked_array([pa.Array.from_pandas(
+            res, type=dtype_to_arrow_type(expr.return_type))])
+        if len(arr) != table.num_rows:
+            raise ValueError(
+                f"pandas UDF returned {len(arr)} rows for "
+                f"{table.num_rows} input rows")
+        out = _chunked_to_column(arr)
+        return out.values, out.mask
+
+
+_register_pandas_udf()
+
+
 # ---------------------------------------------------------------------------
 # bitwise
 # ---------------------------------------------------------------------------

@@ -102,6 +102,16 @@ _expr(E.ColumnRef, ts.all_basic_128)
 _expr(E.Alias, ts.all_basic_128 + ts.TypeSig(ts.ARRAY, ts.STRUCT))
 
 
+def _register_pandas_udf_rule():
+    # vectorized UDFs stay in device plans: the Project conversion
+    # extracts them into ArrowEvalPythonExec (GpuExtractPythonUDFs role)
+    from ..udf.pandas_udf import PandasUDF
+    _expr(PandasUDF, ts.all_basic)
+
+
+_register_pandas_udf_rule()
+
+
 def device_type_ok(t: dt.DType) -> Optional[str]:
     """Recursive device support for a column type (TypeSig nested
     checks): arrays/structs of supported types flow through
@@ -539,6 +549,14 @@ def _build_tpu_exec(plan: LogicalPlan, children: List[TpuExec],
         # host-resident leaves enter the device through the transition
         return HostToDeviceExec(CpuPhysical(plan, []))
     if isinstance(plan, Project):
+        from ..udf.pandas_udf import extract_pandas_udfs
+        exprs, pyudfs = extract_pandas_udfs(plan.exprs)
+        if pyudfs:
+            # GpuExtractPythonUDFs role: UDFs evaluate in a pooled
+            # python worker between the child and the projection
+            from ..exec.python_exec import ArrowEvalPythonExec
+            return ProjectExec(
+                ArrowEvalPythonExec(children[0], pyudfs), exprs)
         return ProjectExec(children[0], plan.exprs)
     if isinstance(plan, Filter):
         return FilterExec(children[0], plan.condition)

@@ -13,9 +13,16 @@ Functions the compiler can't translate raise ``UdfCompileError``; the
 engine interprets row-by-row — the exact compile-or-fallback contract
 of the reference (LogicalPlanRules falls back to leaving the original
 UDF in place).
+
+``pandas_udf`` is the vectorized escape hatch: the plan stays on
+device and the UDF columns detour through Arrow IPC to pooled Python
+worker processes (pandas_udf.py + worker.py + exec/python_exec.py —
+the reference's execution/python/ + rapids daemon subsystem).
 """
 
 from .compiler import UdfCompileError, compile_udf
+from .pandas_udf import PandasUDF, pandas_udf
 from .python_udf import PythonUDF, udf
 
-__all__ = ["compile_udf", "udf", "UdfCompileError", "PythonUDF"]
+__all__ = ["compile_udf", "udf", "UdfCompileError", "PythonUDF",
+           "pandas_udf", "PandasUDF"]

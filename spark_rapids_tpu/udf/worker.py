@@ -1,0 +1,253 @@
+"""Python worker management for vectorized (pandas) UDFs.
+
+Rebuild of the reference's Python-worker subsystem (SURVEY §2.8:
+python/rapids/daemon.py worker forking; GpuArrowEvalPythonExec's Arrow
+stream protocol in sql-plugin/.../execution/python/): vectorized UDF
+input batches stream to long-lived out-of-process Python workers as
+Arrow IPC and the results stream back.
+
+The TPU design keeps the same process model — workers are plain CPython
+processes that import only pyarrow/pandas (never jax, and never this
+package's __init__, so a hung accelerator runtime or a crashing UDF
+cannot take the engine down) — but replaces the daemon's forked-socket
+negotiation with a length-prefixed frame protocol over stdin/stdout
+pipes, which needs no port management and works identically under test
+runners and notebooks.
+
+Protocol (big-endian u32 length prefix per frame):
+
+  engine -> worker, per job:  frame 1 = cloudpickle job spec
+                                 [(fn, n_args, result_field), ...]
+                              frame 2 = Arrow IPC stream of the input
+                                 table (UDF argument columns, grouped
+                                 in spec order)
+  worker -> engine:           one frame, b'O' + Arrow IPC result table
+                              or        b'E' + utf-8 traceback
+  engine -> worker:           zero-length frame = exit
+
+Workers are pooled and reused across jobs/execs (the daemon's worker
+reuse); a worker that dies mid-job is discarded and its stderr tail
+surfaces in the engine error.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import queue
+import struct
+import subprocess
+import sys
+import threading
+from typing import List, Optional, Tuple
+
+_FRAME_LEN = struct.Struct(">I")
+
+
+def _write_frame(pipe, payload: bytes) -> None:
+    pipe.write(_FRAME_LEN.pack(len(payload)))
+    pipe.write(payload)
+    pipe.flush()
+
+
+def _read_frame(pipe) -> Optional[bytes]:
+    head = pipe.read(4)
+    if len(head) < 4:
+        return None
+    (n,) = _FRAME_LEN.unpack(head)
+    buf = b""
+    while len(buf) < n:
+        chunk = pipe.read(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class PythonWorkerError(RuntimeError):
+    """A UDF raised inside the worker (traceback attached) or the
+    worker process died."""
+
+
+class PythonWorker:
+    """One pooled worker process."""
+
+    def __init__(self):
+        env = dict(os.environ)
+        # workers never touch jax; scrub accelerator env so a stray
+        # import in user UDF code stays on CPU
+        env["JAX_PLATFORMS"] = "cpu"
+        self.proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, env=env)
+
+    def run_job(self, spec_blob: bytes, arrow_blob: bytes) -> bytes:
+        """Returns the result Arrow IPC bytes; raises PythonWorkerError
+        on UDF failure or worker death."""
+        try:
+            _write_frame(self.proc.stdin, spec_blob)
+            _write_frame(self.proc.stdin, arrow_blob)
+            reply = _read_frame(self.proc.stdout)
+        except (BrokenPipeError, OSError) as e:
+            reply = None
+        if reply is None:
+            err = b""
+            try:
+                self.proc.kill()
+                err = self.proc.stderr.read() or b""
+            except OSError:
+                pass
+            raise PythonWorkerError(
+                "python worker died: " + err[-2000:].decode(
+                    "utf-8", "replace"))
+        if reply[:1] == b"E":
+            raise PythonWorkerError(reply[1:].decode("utf-8", "replace"))
+        return reply[1:]
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def close(self) -> None:
+        try:
+            if self.alive():
+                _write_frame(self.proc.stdin, b"")
+                self.proc.wait(timeout=2)
+        except (OSError, subprocess.TimeoutExpired):
+            self.proc.kill()
+
+
+class PythonWorkerPool:
+    """Bounded worker pool with reuse (daemon.py's role)."""
+
+    def __init__(self, max_workers: int = 4):
+        self.max_workers = max_workers
+        self._idle: "queue.Queue[PythonWorker]" = queue.Queue()
+        self._count = 0
+        self._lock = threading.Lock()
+        self.closed = False
+
+    def acquire(self) -> PythonWorker:
+        while True:
+            try:
+                w = self._idle.get_nowait()
+            except queue.Empty:
+                break
+            if w.alive():
+                return w
+            with self._lock:
+                self._count -= 1
+        with self._lock:
+            if self._count < self.max_workers:
+                self._count += 1
+                return PythonWorker()
+        w = self._idle.get()  # block for a released worker
+        if w.alive():
+            return w
+        with self._lock:
+            self._count -= 1
+        return self.acquire()
+
+    def release(self, w: PythonWorker, broken: bool = False) -> None:
+        if broken or not w.alive() or self.closed:
+            w.close()
+            with self._lock:
+                self._count -= 1
+            return
+        self._idle.put(w)
+
+    def run_job(self, spec_blob: bytes, arrow_blob: bytes) -> bytes:
+        w = self.acquire()
+        try:
+            out = w.run_job(spec_blob, arrow_blob)
+        except PythonWorkerError:
+            self.release(w, broken=True)
+            raise
+        self.release(w)
+        return out
+
+    def close(self) -> None:
+        self.closed = True
+        while True:
+            try:
+                self._idle.get_nowait().close()
+            except queue.Empty:
+                break
+
+
+_POOL: Optional[PythonWorkerPool] = None
+_POOL_LOCK = threading.Lock()
+
+
+def worker_pool() -> PythonWorkerPool:
+    global _POOL
+    with _POOL_LOCK:
+        if _POOL is None or _POOL.closed:
+            from ..conf import PYTHON_WORKERS_MAX, active_conf
+            _POOL = PythonWorkerPool(
+                active_conf().get(PYTHON_WORKERS_MAX))
+            atexit.register(_POOL.close)
+        return _POOL
+
+
+def make_job_spec(udfs) -> bytes:
+    """[(fn, n_args, arrow_result_field)] -> wire blob."""
+    import cloudpickle
+    return cloudpickle.dumps(udfs)
+
+
+# ---------------------------------------------------------------------------
+# worker-side main: executed as a SCRIPT (sys.executable <this file>),
+# never as part of the package — stdlib + pyarrow + pandas only
+# ---------------------------------------------------------------------------
+
+def _worker_main() -> None:  # pragma: no cover - subprocess body
+    import io
+    import pickle
+    import traceback
+
+    import pyarrow as pa
+
+    stdin = sys.stdin.buffer
+    stdout = sys.stdout.buffer
+    while True:
+        spec_blob = _read_frame(stdin)
+        if not spec_blob:
+            return
+        arrow_blob = _read_frame(stdin)
+        if arrow_blob is None:
+            return
+        try:
+            udfs = pickle.loads(spec_blob)  # cloudpickle-compatible
+            with pa.ipc.open_stream(io.BytesIO(arrow_blob)) as rd:
+                table = rd.read_all()
+            out_fields, out_arrays = [], []
+            col = 0
+            for fn, n_args, field in udfs:
+                args = [table.column(col + k).to_pandas()
+                        for k in range(n_args)]
+                col += n_args
+                res = fn(*args)
+                arr = pa.Array.from_pandas(res, type=field.type) \
+                    if not isinstance(res, (pa.Array, pa.ChunkedArray)) \
+                    else res
+                if len(arr) != table.num_rows:
+                    raise ValueError(
+                        f"pandas UDF returned {len(arr)} rows for "
+                        f"{table.num_rows} input rows")
+                out_fields.append(field)
+                out_arrays.append(arr)
+            out = pa.table(dict(zip([f.name for f in out_fields],
+                                    out_arrays)))
+            sink = io.BytesIO()
+            with pa.ipc.new_stream(sink, out.schema) as wr:
+                wr.write_table(out)
+            _write_frame(stdout, b"O" + sink.getvalue())
+        except BaseException:
+            _write_frame(
+                stdout,
+                b"E" + traceback.format_exc().encode("utf-8", "replace"))
+
+
+if __name__ == "__main__":  # pragma: no cover
+    _worker_main()
