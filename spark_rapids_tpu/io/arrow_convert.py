@@ -16,7 +16,11 @@ import numpy as np
 import pyarrow as pa
 
 from ..columnar import dtypes as dt
-from ..plan.host_table import HostColumn, HostTable
+
+# NOTE: plan.host_table imports stay function-local: importing it at
+# module scope runs plan/__init__ -> session -> overrides -> io.scan,
+# which circles back into this module when the io package is imported
+# first (e.g. `import spark_rapids_tpu.io.avro`).
 
 
 def arrow_type_to_dtype(t: pa.DataType) -> dt.DType:
@@ -92,7 +96,8 @@ def arrow_schema_to_schema(schema: pa.Schema) -> List:
     return [(f.name, arrow_type_to_dtype(f.type)) for f in schema]
 
 
-def _chunked_to_column(arr: pa.ChunkedArray) -> HostColumn:
+def _chunked_to_column(arr: pa.ChunkedArray) -> "HostColumn":
+    from ..plan.host_table import HostColumn
     if isinstance(arr, pa.ChunkedArray):
         arr = arr.combine_chunks()
     t = arr.type
@@ -137,13 +142,14 @@ def _chunked_to_column(arr: pa.ChunkedArray) -> HostColumn:
     return HostColumn(np.ascontiguousarray(vals), mask, out_t)
 
 
-def arrow_to_host_table(table: pa.Table) -> HostTable:
+def arrow_to_host_table(table: pa.Table) -> "HostTable":
+    from ..plan.host_table import HostTable
     cols = [_chunked_to_column(table.column(i))
             for i in range(table.num_columns)]
     return HostTable(cols, list(table.column_names))
 
 
-def host_table_to_arrow(table: HostTable) -> pa.Table:
+def host_table_to_arrow(table: "HostTable") -> pa.Table:
     arrays = []
     for c in table.columns:
         at = dtype_to_arrow_type(c.dtype)

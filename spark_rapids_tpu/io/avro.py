@@ -223,6 +223,220 @@ def _encode_value(out: bytearray, v, sch) -> None:
 
 
 # ---------------------------------------------------------------------------
+# generic datum codec (full type system, python objects)
+#
+# The columnar read_avro_file path above stays restricted to shapes the
+# device layout supports; this generic reader/writer handles ARBITRARY
+# schemas (nested records, maps, enums, fixed, multi-branch unions) as
+# plain python values. It exists for metadata-bearing formats — Iceberg
+# manifests and manifest lists are nested-record avro (io/iceberg.py).
+# ---------------------------------------------------------------------------
+
+def _named_types(sch, reg=None) -> dict:
+    """Collect named type definitions (record/enum/fixed) for reference
+    resolution."""
+    reg = reg if reg is not None else {}
+    if isinstance(sch, list):
+        for s in sch:
+            _named_types(s, reg)
+    elif isinstance(sch, dict):
+        t = sch.get("type")
+        if t in ("record", "enum", "fixed") and "name" in sch:
+            reg[sch["name"]] = sch
+        if t == "record":
+            for f in sch.get("fields", ()):
+                _named_types(f["type"], reg)
+        elif t == "array":
+            _named_types(sch.get("items"), reg)
+        elif t == "map":
+            _named_types(sch.get("values"), reg)
+        elif isinstance(t, (dict, list)):
+            _named_types(t, reg)
+    return reg
+
+
+_PRIMITIVES = ("null", "boolean", "int", "long", "float", "double",
+               "string", "bytes")
+
+
+def _decode_datum(buf, sch, reg):
+    if isinstance(sch, str) and sch not in _PRIMITIVES:
+        sch = reg[sch]  # named type reference
+    if isinstance(sch, list):
+        return _decode_datum(buf, sch[_read_long(buf)], reg)
+    if isinstance(sch, dict):
+        t = sch.get("type")
+        if t == "record":
+            return {f["name"]: _decode_datum(buf, f["type"], reg)
+                    for f in sch["fields"]}
+        if t == "enum":
+            return sch["symbols"][_read_long(buf)]
+        if t == "fixed":
+            return buf.read(sch["size"])
+        if t == "array":
+            out = []
+            while True:
+                n = _read_long(buf)
+                if n == 0:
+                    return out
+                if n < 0:
+                    _read_long(buf)
+                    n = -n
+                for _ in range(n):
+                    out.append(_decode_datum(buf, sch["items"], reg))
+        if t == "map":
+            out = {}
+            while True:
+                n = _read_long(buf)
+                if n == 0:
+                    return out
+                if n < 0:
+                    _read_long(buf)
+                    n = -n
+                for _ in range(n):
+                    k = _read_bytes(buf).decode("utf-8")
+                    out[k] = _decode_datum(buf, sch["values"], reg)
+        return _decode_datum(buf, t, reg)
+    if sch == "null":
+        return None
+    if sch == "boolean":
+        return buf.read(1)[0] != 0
+    if sch in ("int", "long"):
+        return _read_long(buf)
+    if sch == "float":
+        return struct.unpack("<f", buf.read(4))[0]
+    if sch == "double":
+        return struct.unpack("<d", buf.read(8))[0]
+    if sch == "string":
+        return _read_bytes(buf).decode("utf-8")
+    if sch == "bytes":
+        return _read_bytes(buf)
+    raise AvroUnsupported(f"decode datum: {sch!r}")
+
+
+def _encode_datum(out: bytearray, v, sch, reg) -> None:
+    if isinstance(sch, str) and sch not in _PRIMITIVES:
+        sch = reg[sch]
+    if isinstance(sch, list):
+        # pick the first branch the value fits: None -> null, else the
+        # first non-null branch (sufficient for metadata writing)
+        if v is None and "null" in sch:
+            _write_long(out, sch.index("null"))
+            return
+        for i, branch in enumerate(sch):
+            if branch != "null":
+                _write_long(out, i)
+                _encode_datum(out, v, branch, reg)
+                return
+        raise AvroUnsupported(f"no union branch for {v!r} in {sch!r}")
+    if isinstance(sch, dict):
+        t = sch.get("type")
+        if t == "record":
+            for f in sch["fields"]:
+                _encode_datum(out, v.get(f["name"]), f["type"], reg)
+            return
+        if t == "enum":
+            _write_long(out, sch["symbols"].index(v))
+            return
+        if t == "fixed":
+            assert len(v) == sch["size"]
+            out.extend(v)
+            return
+        if t == "array":
+            if v:
+                _write_long(out, len(v))
+                for x in v:
+                    _encode_datum(out, x, sch["items"], reg)
+            _write_long(out, 0)
+            return
+        if t == "map":
+            if v:
+                _write_long(out, len(v))
+                for k, x in v.items():
+                    _write_bytes(out, k.encode("utf-8"))
+                    _encode_datum(out, x, sch["values"], reg)
+            _write_long(out, 0)
+            return
+        _encode_datum(out, v, t, reg)
+        return
+    if sch == "null":
+        return
+    if sch == "boolean":
+        out.append(1 if v else 0)
+    elif sch in ("int", "long"):
+        _write_long(out, int(v))
+    elif sch == "float":
+        out.extend(struct.pack("<f", float(v)))
+    elif sch == "double":
+        out.extend(struct.pack("<d", float(v)))
+    elif sch == "string":
+        _write_bytes(out, str(v).encode("utf-8"))
+    elif sch == "bytes":
+        _write_bytes(out, bytes(v))
+    else:
+        raise AvroUnsupported(f"encode datum: {sch!r}")
+
+
+def read_avro_records(path: str) -> List[dict]:
+    """Read an ENTIRE container of arbitrary-schema records as python
+    dicts (generic datum reader). For metadata files, not data paths."""
+    with open(path, "rb") as f:
+        buf = io.BytesIO(f.read())
+    schema, codec, sync = read_avro_header(buf)
+    reg = _named_types(schema)
+    out: List[dict] = []
+    while True:
+        head = buf.read(1)
+        if not head:
+            break
+        buf.seek(-1, io.SEEK_CUR)
+        count = _read_long(buf)
+        size = _read_long(buf)
+        block = buf.read(size)
+        if codec == "deflate":
+            block = zlib.decompress(block, -15)
+        bbuf = io.BytesIO(block)
+        for _ in range(count):
+            out.append(_decode_datum(bbuf, schema, reg))
+        if buf.read(16) != sync:
+            raise AvroUnsupported("sync marker mismatch")
+    return out
+
+
+def write_avro_records(records: List[dict], schema: dict, path: str,
+                       codec: str = "null") -> None:
+    """Write arbitrary-schema records (generic datum writer)."""
+    if codec not in ("null", "deflate"):
+        raise AvroUnsupported(f"codec {codec!r}")
+    reg = _named_types(schema)
+    sync = os.urandom(16)
+    out = bytearray()
+    out.extend(_MAGIC)
+    meta = {"avro.schema": json.dumps(schema).encode("utf-8"),
+            "avro.codec": codec.encode("utf-8")}
+    _write_long(out, len(meta))
+    for k, v in meta.items():
+        _write_bytes(out, k.encode("utf-8"))
+        _write_bytes(out, v)
+    _write_long(out, 0)
+    out.extend(sync)
+    block = bytearray()
+    for r in records:
+        _encode_datum(block, r, schema, reg)
+    payload = bytes(block)
+    if codec == "deflate":
+        co = zlib.compressobj(wbits=-15)
+        payload = co.compress(payload) + co.flush()
+    if records:
+        _write_long(out, len(records))
+        _write_long(out, len(payload))
+        out.extend(payload)
+        out.extend(sync)
+    with open(path, "wb") as f:
+        f.write(bytes(out))
+
+
+# ---------------------------------------------------------------------------
 # container framing
 # ---------------------------------------------------------------------------
 

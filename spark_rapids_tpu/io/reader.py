@@ -53,6 +53,26 @@ class DataFrameReader:
         return self._scan(list(paths) if len(paths) > 1 else paths[0],
                           "avro", schema)
 
+    def iceberg(self, path: str, snapshot_id: Optional[int] = None,
+                as_of_timestamp_ms: Optional[int] = None):
+        """Iceberg table directory (io/iceberg.py): snapshot-selected
+        live parquet files feed the standard multi-file scan; time
+        travel via snapshot_id / as_of_timestamp_ms."""
+        from .iceberg import iceberg_scan
+        opts = dict(self._options)
+        if snapshot_id is not None:
+            opts["snapshot_id"] = snapshot_id
+        if as_of_timestamp_ms is not None:
+            opts["as_of_timestamp_ms"] = as_of_timestamp_ms
+        files, schema = iceberg_scan(path, opts)
+        if not files:
+            return self.session.create_dataframe(
+                {n: [] for n, _ in schema}, schema)
+        from ..plan.session import DataFrame
+        return DataFrame(self.session,
+                         FileScan(files, "parquet", schema,
+                                  dict(self._options)))
+
     def hive_text(self, *paths, schema: Optional[List] = None,
                   sep: str = "\x01"):
         """Hive default-delimited text (ctrl-A separated, no header)."""

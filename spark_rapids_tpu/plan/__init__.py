@@ -13,10 +13,19 @@ test oracle).
 from .logical import (Aggregate, Distinct, Expand, Filter, Join, Limit,
                       LocalRelation, LogicalPlan, Project, Range, Sort,
                       Union)
-from .session import DataFrame, TpuSession
 
 __all__ = [
     "LogicalPlan", "LocalRelation", "Project", "Filter", "Aggregate",
     "Join", "Sort", "Limit", "Union", "Expand", "Range", "Distinct",
     "DataFrame", "TpuSession",
 ]
+
+
+def __getattr__(name):
+    # session (and through it overrides -> io.scan) loads lazily so leaf
+    # modules like plan.host_table can be imported from the io package
+    # without a circular import (PEP 562)
+    if name in ("DataFrame", "TpuSession"):
+        from .session import DataFrame, TpuSession
+        return {"DataFrame": DataFrame, "TpuSession": TpuSession}[name]
+    raise AttributeError(name)
