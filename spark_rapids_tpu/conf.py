@@ -332,6 +332,32 @@ MESH_DATA_AXIS = conf("srt.mesh.dataAxis") \
     .doc("Name of the mesh axis partitions are sharded over.") \
     .internal().string("data")
 
+URI_REWRITE_RULES = conf("srt.io.uriRewrite") \
+    .doc("Ordered 'FROM->TO;FROM2->TO2' prefix rewrite rules applied to "
+         "scan paths before file resolution — mount-style remote-store "
+         "acceleration (spark.rapids.alluxio.pathsToReplace role).") \
+    .string("")
+
+FILECACHE_ENABLED = conf("srt.filecache.enabled") \
+    .doc("Cache scanned input files on local disk with LRU eviction "
+         "(spark.rapids.filecache.enabled role).") \
+    .boolean(False)
+
+FILECACHE_DIR = conf("srt.filecache.dir") \
+    .doc("Directory for the scan file cache.") \
+    .string("/tmp/srt_filecache")
+
+FILECACHE_MAX_SIZE = conf("srt.filecache.maxSize") \
+    .doc("File-cache capacity in bytes; least-recently-used files are "
+         "evicted past this size.") \
+    .bytes_(1 << 30)
+
+FILECACHE_LOCAL_FS = conf("srt.filecache.useForLocalFiles") \
+    .doc("Also cache local-filesystem files (the reference caches only "
+         "remote filesystems by default; this knob exists for tests and "
+         "for slow network mounts that look local).") \
+    .boolean(False)
+
 PYTHON_WORKERS_MAX = conf("srt.python.workers.max") \
     .doc("Maximum pooled Python worker processes for vectorized pandas "
          "UDFs (ArrowEvalPython). Workers are reused across batches and "

@@ -1,0 +1,149 @@
+"""Scan-side file cache + remote-store URI rewriting.
+
+Reference surface (SURVEY §2.6):
+- the file cache (sql-plugin filecache package, spark.rapids.filecache.*):
+  caches remote input files on fast local disk so repeated scans skip
+  the object store,
+- Alluxio integration (AlluxioUtils.scala,
+  spark.rapids.alluxio.pathsToReplace): rewrites scheme/prefix pairs so
+  reads land on a co-located caching store.
+
+TPU rebuild: one module provides both seams.
+
+- ``rewrite_uri`` applies ordered ``FROM->TO`` prefix rules
+  (srt.io.uriRewrite) at scan-path resolution — the
+  alluxio.pathsToReplace contract, usable for any mount-style remote
+  accelerator.
+- ``FileCache`` copies input files into a bounded local directory keyed
+  by (path, size, mtime) with LRU eviction (srt.filecache.enabled /
+  .dir / .maxSize). Local files pass straight through unless the cache
+  is forced (test knob), mirroring the reference's
+  "only cache remote filesystems" default. Hit/miss counts are exposed
+  for metrics and tests.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import threading
+from collections import OrderedDict
+from typing import Optional, Tuple
+
+_LOCAL_SCHEMES = ("file://",)
+
+
+def rewrite_uri(path: str, rules: str) -> str:
+    """Apply 'FROM->TO;FROM2->TO2' prefix rules (first match wins)."""
+    if not rules:
+        return path
+    for rule in rules.split(";"):
+        rule = rule.strip()
+        if not rule or "->" not in rule:
+            continue
+        src, dst = (s.strip() for s in rule.split("->", 1))
+        if src and path.startswith(src):
+            return dst + path[len(src):]
+    return path
+
+
+def _strip_scheme(path: str) -> str:
+    for s in _LOCAL_SCHEMES:
+        if path.startswith(s):
+            return path[len(s):]
+    return path
+
+
+class FileCache:
+    """Bounded local copy cache with LRU eviction."""
+
+    def __init__(self, cache_dir: str, max_bytes: int,
+                 cache_local: bool = False):
+        self.cache_dir = cache_dir
+        self.max_bytes = max_bytes
+        self.cache_local = cache_local
+        os.makedirs(cache_dir, exist_ok=True)
+        self._lock = threading.Lock()
+        # key -> (local_path, size); insertion order = LRU order
+        self._entries: "OrderedDict[str, Tuple[str, int]]" = OrderedDict()
+        self._used = 0
+        self.hits = 0
+        self.misses = 0
+
+    def _key(self, path: str, st: os.stat_result) -> str:
+        raw = f"{path}:{st.st_size}:{st.st_mtime_ns}"
+        return hashlib.sha256(raw.encode()).hexdigest()[:32]
+
+    def get_local(self, path: str) -> str:
+        """Local path for reading ``path`` — the cached copy when
+        caching applies, the original otherwise. Stale entries (source
+        changed size/mtime) miss naturally via the key."""
+        src = _strip_scheme(path)
+        if not self.cache_local:
+            return src
+        st = os.stat(src)
+        key = self._key(src, st)
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                return ent[0]
+            self.misses += 1
+        local = os.path.join(self.cache_dir,
+                             key + "_" + os.path.basename(src))
+        shutil.copyfile(src, local)
+        size = os.path.getsize(local)
+        with self._lock:
+            self._entries[key] = (local, size)
+            self._used += size
+            while self._used > self.max_bytes and len(self._entries) > 1:
+                _, (old_path, old_size) = self._entries.popitem(last=False)
+                self._used -= old_size
+                try:
+                    os.unlink(old_path)
+                except OSError:
+                    pass
+        return local
+
+
+_CACHE: Optional[FileCache] = None
+_CACHE_KEY = None
+_CACHE_LOCK = threading.Lock()
+
+
+def resolve_read_path(path: str, conf=None) -> str:
+    """The single scan-side choke point: URI rewrite, then the file
+    cache when enabled."""
+    from ..conf import (FILECACHE_DIR, FILECACHE_ENABLED,
+                        FILECACHE_LOCAL_FS, FILECACHE_MAX_SIZE,
+                        URI_REWRITE_RULES, active_conf)
+    conf = conf or active_conf()
+    path = rewrite_uri(path, conf.get(URI_REWRITE_RULES))
+    if not conf.get(FILECACHE_ENABLED):
+        return _strip_scheme(path)
+    global _CACHE, _CACHE_KEY
+    key = (conf.get(FILECACHE_DIR), conf.get(FILECACHE_MAX_SIZE),
+           conf.get(FILECACHE_LOCAL_FS))
+    with _CACHE_LOCK:
+        if _CACHE is None or _CACHE_KEY != key:
+            _CACHE = FileCache(key[0], key[1], cache_local=key[2])
+            _CACHE_KEY = key
+        cache = _CACHE
+    return cache.get_local(path)
+
+
+def cache_stats() -> dict:
+    with _CACHE_LOCK:
+        if _CACHE is None:
+            return {"hits": 0, "misses": 0, "entries": 0, "bytes": 0}
+        return {"hits": _CACHE.hits, "misses": _CACHE.misses,
+                "entries": len(_CACHE._entries), "bytes": _CACHE._used}
+
+
+def reset_cache() -> None:
+    global _CACHE, _CACHE_KEY
+    with _CACHE_LOCK:
+        _CACHE = None
+        _CACHE_KEY = None

@@ -28,7 +28,8 @@ class DataFrameReader:
     def _scan(self, paths, fmt: str, schema: Optional[List] = None):
         from ..plan.session import DataFrame
         return DataFrame(self.session,
-                         FileScan(paths, fmt, schema, dict(self._options)))
+                         FileScan(paths, fmt, schema, dict(self._options),
+                                  conf=self.session.conf))
 
     def parquet(self, *paths, schema: Optional[List] = None):
         return self._scan(list(paths) if len(paths) > 1 else paths[0],

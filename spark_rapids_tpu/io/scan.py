@@ -42,9 +42,15 @@ from .arrow_convert import arrow_schema_to_schema, arrow_to_host_table
 FORMATS = ("parquet", "orc", "csv", "json", "avro", "hivetext")
 
 
-def expand_paths(path_or_paths) -> List[str]:
-    paths = ([path_or_paths] if isinstance(path_or_paths, str)
-             else list(path_or_paths))
+def expand_paths(path_or_paths, conf=None) -> List[str]:
+    from .filecache import rewrite_uri
+    raw = ([path_or_paths] if isinstance(path_or_paths, str)
+           else list(path_or_paths))
+    from ..conf import URI_REWRITE_RULES, active_conf
+    rules = (conf or active_conf()).get(URI_REWRITE_RULES)
+    paths = [rewrite_uri(p, rules) for p in raw]
+    paths = [p[len("file://"):] if p.startswith("file://") else p
+             for p in paths]
     out: List[str] = []
     for p in paths:
         if os.path.isdir(p):
@@ -124,10 +130,11 @@ class FileScan(LogicalPlan):
 
     def __init__(self, paths, fmt: str, schema: Optional[List] = None,
                  options: Optional[dict] = None,
-                 pushed_filter: Optional[E.Expression] = None):
+                 pushed_filter: Optional[E.Expression] = None,
+                 conf=None):
         super().__init__()
         assert fmt in FORMATS, fmt
-        self.paths = expand_paths(paths)
+        self.paths = expand_paths(paths, conf)
         if not self.paths:
             raise FileNotFoundError(f"no files match {paths!r}")
         self.fmt = fmt
@@ -233,10 +240,14 @@ def to_arrow_filter(expr: E.Expression):
 
 def read_file_to_tables(path: str, fmt: str, schema: Schema,
                         options: dict, arrow_filter,
-                        max_rows: int) -> List[HostTable]:
+                        max_rows: int, conf=None) -> List[HostTable]:
     """Decode one file on the host into row-sliced HostTables conforming
     to the DECLARED schema: positional rename when file column names
-    differ (e.g. headerless CSV) and per-column cast to declared dtypes."""
+    differ (e.g. headerless CSV) and per-column cast to declared dtypes.
+    ``conf`` must be passed explicitly from pool worker threads (the
+    active conf is a thread-local)."""
+    from .filecache import resolve_read_path
+    path = resolve_read_path(path, conf)
     names = [n for n, _ in schema]
     if fmt == "avro":
         # from-scratch container decode (io/avro.py); route through
@@ -361,7 +372,7 @@ class FileSourceScanExec(TpuExec):
         options.setdefault("datetimeRebaseMode",
                            conf.get(PARQUET_REBASE_READ))
         args = (self.scan.fmt, self._schema, options,
-                self._arrow_filter, max_rows)
+                self._arrow_filter, max_rows, conf)
         if reader == "MULTITHREADED" and len(self.scan.paths) > 1:
             threads = conf.get(READER_THREADS)
             with cf.ThreadPoolExecutor(max_workers=threads) as pool:

@@ -1,0 +1,75 @@
+"""Scan file cache + URI rewriting (io/filecache.py)."""
+
+import os
+
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.conf import SrtConf
+from spark_rapids_tpu.io.filecache import (FileCache, cache_stats,
+                                           reset_cache, rewrite_uri)
+from spark_rapids_tpu.plan import TpuSession
+
+
+def test_rewrite_uri_rules():
+    rules = "s3://bucket/a->/mnt/a; gs://x -> /mnt/x"
+    assert rewrite_uri("s3://bucket/a/f.parquet", rules) == \
+        "/mnt/a/f.parquet"
+    assert rewrite_uri("gs://x/q", rules) == "/mnt/x/q"
+    assert rewrite_uri("/local/p", rules) == "/local/p"
+    assert rewrite_uri("/local/p", "") == "/local/p"
+
+
+def test_uri_rewrite_through_scan(tmp_path):
+    data_dir = tmp_path / "warehouse"
+    data_dir.mkdir()
+    session = TpuSession(SrtConf({
+        "srt.io.uriRewrite": f"s3://bucket/wh->{data_dir}"}))
+    df = session.create_dataframe({"v": [1.0, 2.0]})
+    df.write.parquet(str(data_dir / "t"))
+    back = session.read.parquet("s3://bucket/wh/t").to_pydict()
+    assert back == {"v": [1.0, 2.0]}
+
+
+def test_file_cache_lru(tmp_path):
+    cdir = str(tmp_path / "cache")
+    files = []
+    for i in range(3):
+        p = str(tmp_path / f"f{i}.bin")
+        with open(p, "wb") as f:
+            f.write(bytes([i]) * 1000)
+        files.append(p)
+    cache = FileCache(cdir, max_bytes=2500, cache_local=True)
+    l0 = cache.get_local(files[0])
+    assert open(l0, "rb").read() == bytes([0]) * 1000
+    assert cache.get_local(files[0]) == l0 and cache.hits == 1
+    cache.get_local(files[1])
+    cache.get_local(files[2])  # over 2500 bytes -> f0 evicted
+    assert not os.path.exists(l0)
+    # f0 misses again, f2 still cached
+    cache.get_local(files[0])
+    assert cache.misses == 4 and cache.hits == 1
+    # source mutation invalidates via (size, mtime) key
+    with open(files[2], "wb") as f:
+        f.write(b"x" * 999)
+    l2b = cache.get_local(files[2])
+    assert open(l2b, "rb").read() == b"x" * 999
+
+
+def test_cache_through_scan(tmp_path):
+    reset_cache()
+    cdir = str(tmp_path / "cache")
+    session = TpuSession(SrtConf({
+        "srt.filecache.enabled": True,
+        "srt.filecache.useForLocalFiles": True,
+        "srt.filecache.dir": cdir}))
+    df = session.create_dataframe({"v": [1.0, 2.0, 3.0]})
+    out = str(tmp_path / "t")
+    df.write.parquet(out)
+    assert session.read.parquet(out).collect() is not None
+    s1 = cache_stats()
+    assert s1["misses"] >= 1 and s1["entries"] >= 1
+    session.read.parquet(out).collect()
+    s2 = cache_stats()
+    assert s2["hits"] > s1["hits"]
+    reset_cache()
