@@ -191,6 +191,10 @@ class ExecContext:
         self.semaphore = device_semaphore()
         self.metrics: Dict[str, Dict[str, Metric]] = {}
         self.timer_stack: list = []
+        #: current reduce-partition index for context expressions
+        #: (spark_partition_id / monotonically_increasing_id); operators
+        #: that stream one partition at a time set this while iterating
+        self.partition_id = 0
 
     def metrics_for(self, exec_id: str) -> Dict[str, Metric]:
         return self.metrics.setdefault(exec_id, {})

@@ -49,7 +49,12 @@ class BatchScanExec(TpuExec):
 
 
 class ProjectExec(TpuExec):
-    """Tiered projection (GpuProjectExec / GpuTieredProject)."""
+    """Tiered projection (GpuProjectExec / GpuTieredProject).
+
+    Context expressions (expr/misc.py) make this operator
+    position-aware: (row_offset, partition_id) pass as traced scalars —
+    one compiled program for every batch — and eager-only trees
+    (input_file_name, uuid, raise_error) evaluate un-jitted."""
 
     def __init__(self, child: TpuExec, exprs: Sequence[Expression]):
         super().__init__(child)
@@ -57,21 +62,35 @@ class ProjectExec(TpuExec):
         in_schema = child.output_schema
         self._schema = [(output_name(e, i), e.data_type(in_schema))
                         for i, e in enumerate(self.exprs)]
+        from ..expr.misc import contains_eager
+        self._eager = contains_eager(self.exprs)
         self._jit = jax.jit(self._project)
+        self._jit_ctx = self._project_ctx if self._eager \
+            else jax.jit(self._project_ctx)
 
     def _project(self, batch: ColumnarBatch) -> ColumnarBatch:
         cols = [e.eval(batch) for e in self.exprs]
         return ColumnarBatch(cols, [n for n, _ in self._schema],
                              batch.num_rows)
 
+    def _project_ctx(self, batch: ColumnarBatch, row_offset,
+                     partition_id) -> ColumnarBatch:
+        from ..expr.misc import traced_context
+        with traced_context(row_offset, partition_id):
+            return self._project(batch)
+
     @property
     def output_schema(self) -> Schema:
         return self._schema
 
     def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        offset = 0
         for batch in self.children[0].execute(ctx):
             with ctx.semaphore:
-                yield self._jit(batch)
+                out = self._jit_ctx(batch, jnp.int64(offset),
+                                    jnp.int32(ctx.partition_id))
+            offset += int(batch.num_rows)
+            yield out
 
     def node_description(self) -> str:
         return f"Project[{', '.join(n for n, _ in self._schema)}]"

@@ -32,6 +32,12 @@ from .mathfns import (Acos, Asin, Atan, Atan2, BRound, Cbrt, Ceil, Cos, Cosh,
 from .predicates import (And, EqualNullSafe, EqualTo, GreaterThan,
                          GreaterThanOrEqual, InSet, IsNaN, IsNotNull, IsNull,
                          LessThan, LessThanOrEqual, Not, Or)
+from .misc import (InputFileBlockLength, InputFileBlockStart, InputFileName,
+                   MonotonicallyIncreasingID, RaiseError, RaiseErrorException,
+                   SparkPartitionID, Uuid, Version, input_file_block_length,
+                   input_file_block_start, input_file_name,
+                   monotonically_increasing_id, raise_error,
+                   spark_partition_id, uuid_expr, version)
 from .strings import (Concat, Contains, EndsWith, Length, Like, Lower,
                       OctetLength, StartsWith, StringTrim, StringTrimLeft,
                       StringTrimRight, Substring, Upper)

@@ -363,7 +363,8 @@ class FileSourceScanExec(TpuExec):
 
     def _host_tables(self, ctx: ExecContext) -> Iterator[HostTable]:
         conf = ctx.conf
-        reader = conf.get(READER_TYPE).upper()
+        reader = self.scan.options.get("_reader_override") or \
+            conf.get(READER_TYPE).upper()
         max_rows = conf.get(MAX_READER_BATCH_SIZE_ROWS)
         # resolve conf-driven per-read settings HERE (the session conf
         # is a thread-local; pool worker threads must not consult it)
@@ -383,16 +384,19 @@ class FileSourceScanExec(TpuExec):
                 pending = deque()
                 paths = iter(self.scan.paths)
                 for p in paths:
-                    pending.append(pool.submit(read_file_to_tables, p,
-                                               *args))
+                    pending.append((p, pool.submit(read_file_to_tables,
+                                                   p, *args)))
                     if len(pending) >= window:
                         break
                 while pending:
-                    yield from pending.popleft().result()  # submission order
+                    fp, fut = pending.popleft()
+                    for t in fut.result():  # submission order
+                        yield fp, t
                     nxt = next(paths, None)
                     if nxt is not None:
-                        pending.append(pool.submit(read_file_to_tables,
-                                                   nxt, *args))
+                        pending.append((nxt,
+                                        pool.submit(read_file_to_tables,
+                                                    nxt, *args)))
         elif reader == "COALESCING" and len(self.scan.paths) > 1:
             pending: List[HostTable] = []
             rows = 0
@@ -401,21 +405,24 @@ class FileSourceScanExec(TpuExec):
                     pending.append(t)
                     rows += t.num_rows
                     if rows >= max_rows:
-                        yield concat_tables(pending)
+                        yield None, concat_tables(pending)
                         pending, rows = [], 0
             if pending:
-                yield concat_tables(pending)
+                yield None, concat_tables(pending)
         else:
             for p in self.scan.paths:
-                yield from read_file_to_tables(p, *args)
+                for t in read_file_to_tables(p, *args):
+                    yield p, t
 
     def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
         m = ctx.metrics_for(self.exec_id)
         scan_time = m.setdefault("scanTime", Metric("scanTime",
                                                     Metric.MODERATE, "ns"))
         import time
+        from ..expr.misc import set_input_file
         empty = True
-        for table in self._host_tables(ctx):
+        sizes = {}
+        for path, table in self._host_tables(ctx):
             t0 = time.perf_counter_ns()
             if table.num_rows == 0 and not empty:
                 continue
@@ -423,6 +430,18 @@ class FileSourceScanExec(TpuExec):
             with ctx.semaphore:  # held only for the upload
                 batch = table_to_batch(table)
             scan_time.add(time.perf_counter_ns() - t0)
+            # file context for input_file_name()/blocks: whole-file
+            # reads report (0, file_size); coalesced multi-file batches
+            # have no single file (empty name, Spark contract)
+            if path is not None:
+                if path not in sizes:
+                    try:
+                        sizes[path] = os.path.getsize(path)
+                    except OSError:
+                        sizes[path] = 0
+                set_input_file(path, 0, sizes[path])
+            else:
+                set_input_file(None)
             yield batch
 
     def node_description(self) -> str:

@@ -1861,6 +1861,61 @@ def _register_pandas_udf():
 _register_pandas_udf()
 
 
+def _register_misc_exprs():
+    from ..expr import misc as MX
+
+    @_reg(MX.MonotonicallyIncreasingID)
+    def _mono_id(expr, table):
+        n = table.num_rows
+        return np.arange(n, dtype=np.int64), np.ones(n, bool)
+
+    @_reg(MX.SparkPartitionID)
+    def _part_id(expr, table):
+        n = table.num_rows
+        return np.zeros(n, np.int32), np.ones(n, bool)
+
+    @_reg(MX.InputFileName)
+    def _input_file(expr, table):
+        n = table.num_rows
+        name = MX.current_input_file()[0]
+        return np.full(n, name, dtype=object), np.ones(n, bool)
+
+    @_reg(MX.InputFileBlockStart)
+    def _block_start(expr, table):
+        n = table.num_rows
+        return np.full(n, MX.current_input_file()[1], np.int64), \
+            np.ones(n, bool)
+
+    @_reg(MX.InputFileBlockLength)
+    def _block_len(expr, table):
+        n = table.num_rows
+        return np.full(n, MX.current_input_file()[2], np.int64), \
+            np.ones(n, bool)
+
+    @_reg(MX.Uuid)
+    def _uuid(expr, table):
+        import uuid
+        n = table.num_rows
+        return np.array([str(uuid.uuid4()) for _ in range(n)],
+                        dtype=object), np.ones(n, bool)
+
+    @_reg(MX.RaiseError)
+    def _raise(expr, table):
+        if table.num_rows > 0:
+            raise MX.RaiseErrorException(expr.message)
+        return np.array([], dtype=object), np.zeros(0, bool)
+
+    @_reg(MX.Version)
+    def _version(expr, table):
+        from .. import __version__
+        n = table.num_rows
+        return np.full(n, f"spark_rapids_tpu {__version__}",
+                       dtype=object), np.ones(n, bool)
+
+
+_register_misc_exprs()
+
+
 # ---------------------------------------------------------------------------
 # bitwise
 # ---------------------------------------------------------------------------

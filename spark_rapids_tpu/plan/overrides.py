@@ -112,6 +112,20 @@ def _register_pandas_udf_rule():
 _register_pandas_udf_rule()
 
 
+def _register_misc_rules():
+    # execution-context expressions (expr/misc.py): leaf exprs, no
+    # input types to check; eager-only ones are handled by Project
+    from ..expr import misc as MX
+    for cls in (MX.MonotonicallyIncreasingID, MX.SparkPartitionID,
+                MX.InputFileName, MX.InputFileBlockStart,
+                MX.InputFileBlockLength, MX.Uuid, MX.RaiseError,
+                MX.Version):
+        _expr(cls, ts.all_basic)
+
+
+_register_misc_rules()
+
+
 def device_type_ok(t: dt.DType) -> Optional[str]:
     """Recursive device support for a column type (TypeSig nested
     checks): arrays/structs of supported types flow through
@@ -791,6 +805,30 @@ def push_down_filters(plan: LogicalPlan) -> None:
             plan.children[i] = c.with_pushed_filter(plan.condition)
 
 
+def _force_perfile_for_input_file(plan: LogicalPlan) -> None:
+    """InputFileBlockRule (GpuOverrides.scala InputFileBlockRule role):
+    input_file_name()/input_file_block_* need a single source file per
+    batch, so scans below such expressions must not use the coalescing
+    (file-mixing) reader. Marks every FileScan in the subtree."""
+    from ..expr.misc import contains_input_file
+    from ..io.scan import FileScan
+
+    def mark(node: LogicalPlan) -> None:
+        if isinstance(node, FileScan):
+            node.options["_reader_override"] = "PERFILE"
+        for c in node.children:
+            mark(c)
+
+    def walk(node: LogicalPlan) -> None:
+        exprs = [e for e, _ in node.expressions_with_schemas()]
+        if contains_input_file(exprs):
+            mark(node)
+        for c in node.children:
+            walk(c)
+
+    walk(plan)
+
+
 def apply_overrides(plan: LogicalPlan, conf: Optional[SrtConf] = None):
     """wrap -> tag -> convert (GpuOverrides.applyWithContext equivalent).
 
@@ -799,6 +837,7 @@ def apply_overrides(plan: LogicalPlan, conf: Optional[SrtConf] = None):
     """
     conf = conf or active_conf()
     push_down_filters(plan)
+    _force_perfile_for_input_file(plan)
     meta = PlanMeta(plan)
     meta.tag_for_tpu()
     from .cost import apply_cost_model

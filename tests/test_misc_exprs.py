@@ -1,0 +1,115 @@
+"""Execution-context expressions (expr/misc.py): mono-id, partition id,
+input_file_name/blocks (+ PERFILE forcing rule), uuid, raise_error,
+version."""
+
+import os
+import re
+
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.conf import SrtConf
+from spark_rapids_tpu.expr import (col, input_file_block_length,
+                                   input_file_block_start, input_file_name,
+                                   monotonically_increasing_id, raise_error,
+                                   spark_partition_id, uuid_expr, version)
+from spark_rapids_tpu.expr.misc import RaiseErrorException
+from spark_rapids_tpu.plan import TpuSession
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession()
+
+
+def test_monotonically_increasing_id(session):
+    df = session.create_dataframe({"v": list(range(10))})
+    out = df.select(col("v"),
+                    monotonically_increasing_id().alias("id")) \
+        .to_pydict()
+    # partition 0: ids are the row positions, strictly increasing
+    assert out["id"] == list(range(10))
+
+
+def test_mono_id_offsets_across_batches(session):
+    # small batch size forces multiple batches through one Project
+    s = TpuSession(SrtConf({"srt.sql.batchSizeRows": 4}))
+    df = s.create_dataframe({"v": list(range(10))})
+    out = df.select(monotonically_increasing_id().alias("id")) \
+        .to_pydict()
+    assert out["id"] == list(range(10))
+
+
+def test_spark_partition_id(session):
+    df = session.create_dataframe({"v": [1, 2, 3]})
+    out = df.select(spark_partition_id().alias("p")).to_pydict()
+    assert out["p"] == [0, 0, 0]
+
+
+def test_uuid_unique_and_valid(session):
+    df = session.create_dataframe({"v": list(range(8))})
+    out = df.select(uuid_expr().alias("u")).to_pydict()["u"]
+    assert len(set(out)) == 8
+    pat = re.compile(
+        r"^[0-9a-f]{8}-[0-9a-f]{4}-4[0-9a-f]{3}-[89ab][0-9a-f]{3}-"
+        r"[0-9a-f]{12}$")
+    for u in out:
+        assert pat.match(u), u
+
+
+def test_version(session):
+    df = session.create_dataframe({"v": [1]})
+    out = df.select(version().alias("v")).to_pydict()["v"]
+    assert out[0].startswith("spark_rapids_tpu ")
+
+
+def test_raise_error(session):
+    df = session.create_dataframe({"v": [1, 2]})
+    with pytest.raises(RaiseErrorException, match="boom"):
+        df.select(raise_error("boom").alias("e")).collect()
+
+
+def test_input_file_name_and_blocks(session, tmp_path):
+    df = session.create_dataframe({"v": [1.0, 2.0, 3.0, 4.0]})
+    out_dir = str(tmp_path / "t")
+    df.write.parquet(out_dir)
+    q = session.read.parquet(out_dir).select(
+        col("v"), input_file_name().alias("f"),
+        input_file_block_start().alias("bs"),
+        input_file_block_length().alias("bl"))
+    got = q.to_pydict()
+    assert all(f.endswith(".parquet") and out_dir in f for f in got["f"])
+    assert all(b == 0 for b in got["bs"])
+    for f, bl in zip(got["f"], got["bl"]):
+        assert bl == os.path.getsize(f)
+
+
+def test_input_file_forces_perfile_reader(session, tmp_path):
+    """InputFileBlockRule role: the coalescing reader must stand down
+    so batches never mix files."""
+    s = TpuSession(SrtConf({
+        "srt.sql.format.parquet.reader.type": "COALESCING"}))
+    d1 = s.create_dataframe({"v": [1.0]})
+    out_dir = str(tmp_path / "many")
+    os.makedirs(out_dir)
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    for i in range(3):
+        pq.write_table(pa.table({"v": [float(i)]}),
+                       os.path.join(out_dir, f"p{i}.parquet"))
+    q = s.read.parquet(out_dir).select(
+        col("v"), input_file_name().alias("f"))
+    got = q.to_pydict()
+    # every row names its own file -> 3 distinct names
+    assert len(set(got["f"])) == 3
+    # without input_file_name the same session conf coalesces (control)
+    q2 = s.read.parquet(out_dir).select(col("v"))
+    assert sorted(q2.to_pydict()["v"]) == [0.0, 1.0, 2.0]
+
+
+def test_input_file_name_empty_without_scan(session):
+    from spark_rapids_tpu.expr.misc import set_input_file
+    set_input_file(None)
+    df = session.create_dataframe({"v": [1]})
+    out = df.select(input_file_name().alias("f")).to_pydict()
+    assert out["f"] == [""]
