@@ -358,6 +358,19 @@ FILECACHE_LOCAL_FS = conf("srt.filecache.useForLocalFiles") \
          "for slow network mounts that look local).") \
     .boolean(False)
 
+JOIN_BLOOM_ENABLED = conf("srt.sql.join.bloomFilter.enabled") \
+    .doc("Build a bloom filter over the materialized build side of "
+         "inner/semi hash joins and pre-filter probe batches with it "
+         "(GpuBloomFilterAggregate/MightContain runtime-filter role). "
+         "Pays one hash pass per side; wins when most probe rows have "
+         "no match.") \
+    .boolean(True)
+
+JOIN_BLOOM_MIN_PROBE_ROWS = conf("srt.sql.join.bloomFilter.minProbeRows") \
+    .doc("Skip the bloom pre-filter when a probe batch is smaller than "
+         "this (filter overhead would exceed the join saving).") \
+    .check(_positive).integer(4096)
+
 PYTHON_WORKERS_MAX = conf("srt.python.workers.max") \
     .doc("Maximum pooled Python worker processes for vectorized pandas "
          "UDFs (ArrowEvalPython). Workers are reused across batches and "

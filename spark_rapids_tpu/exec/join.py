@@ -336,6 +336,57 @@ class _HashJoinBase(TpuExec):
                 for psb in bucket:
                     psb.close()
 
+    def _bloom_prefilter(self, ctx: ExecContext, probe_stream,
+                         build: ColumnarBatch):
+        """Runtime bloom join filter (GpuBloomFilterAggregate /
+        GpuBloomFilterMightContain role): drop probe rows whose keys
+        cannot be in the build side BEFORE the gather-map join. Sound
+        only where dropped probe rows produce no output — inner and
+        left-semi."""
+        from ..conf import JOIN_BLOOM_ENABLED, JOIN_BLOOM_MIN_PROBE_ROWS
+        from ..ops import bloom as B
+        if not ctx.conf.get(JOIN_BLOOM_ENABLED) or \
+                self.join_type not in (INNER, LEFT_SEMI) or \
+                not (self.left_keys or self.right_keys):
+            return probe_stream
+        min_rows = ctx.conf.get(JOIN_BLOOM_MIN_PROBE_ROWS)
+        num_bits = B.choose_num_bits(int(build.num_rows))
+        bkey = ("bloom_build", num_bits)
+        if bkey not in self._jit_cache:
+            bexprs = self._build_key_exprs
+
+            def mk(b):
+                return B.build_bloom([e.eval(b) for e in bexprs],
+                                     b.live_mask(), num_bits)
+            self._jit_cache[bkey] = jax.jit(mk)
+        with ctx.semaphore:
+            bits = self._jit_cache[bkey](build)
+        pkey = ("bloom_probe", num_bits)
+        if pkey not in self._jit_cache:
+            pexprs = self._probe_key_exprs
+
+            def probe_fn(bits_, b):
+                from ..columnar.vector import ColumnVector
+                keep = B.might_contain(bits_, [e.eval(b) for e in pexprs])
+                cond = ColumnVector(keep, jnp.ones_like(keep), dt.BOOL)
+                return K.filter_batch(b, cond)
+            self._jit_cache[pkey] = jax.jit(probe_fn)
+        m = ctx.metrics_for(self.exec_id)
+        dropped = m.setdefault("bloomFilteredRows",
+                               Metric("bloomFilteredRows", Metric.DEBUG))
+
+        def filtered():
+            for probe in probe_stream:
+                n = int(probe.num_rows)
+                if n < min_rows:
+                    yield probe
+                    continue
+                with ctx.semaphore:
+                    out = self._jit_cache[pkey](bits, probe)
+                dropped.add(n - int(out.num_rows))
+                yield out
+        return filtered()
+
     def _join_partition(self, ctx: ExecContext, probe_stream,
                         build_stream) -> Iterator[ColumnarBatch]:
         """Join one (probe partition, build partition) pair."""
@@ -347,6 +398,7 @@ class _HashJoinBase(TpuExec):
         if build is None:
             yield from self._empty_result(probe_stream, ctx)
             return
+        probe_stream = self._bloom_prefilter(ctx, probe_stream, build)
         threshold = ctx.conf.get(JOIN_SUB_PARTITION_ROWS)
         if int(build.num_rows) > threshold and (self.left_keys or
                                                 self.right_keys):

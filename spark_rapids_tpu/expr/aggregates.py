@@ -698,3 +698,193 @@ class Percentile(AggregateFunction):
 
     def data_type(self, schema: Schema) -> dt.DType:
         return dt.FLOAT64
+
+
+class ApproxPercentile(AggregateFunction):
+    """approx_percentile(col, p[, accuracy]) on device via a t-digest
+    style centroid sketch (GpuApproximatePercentile + cuDF t-digest in
+    the reference; SURVEY §2.5 aggregate exprs).
+
+    State per group: up to K (mean, weight) centroids held as a pair of
+    ListColumn states, built with the same compact-contiguous layout as
+    collect_list. The update pass buckets each group's value-sorted rows
+    into K equi-quantile ranges (uniform scale function — the reference
+    marks approx_percentile incompat vs CPU Spark for the same reason:
+    sketch results are approximate); the merge pass concatenates
+    centroid lists and re-compresses by weighted quantile position;
+    finalize picks the first centroid whose cumulative weight reaches
+    p * N. Rank error is bounded by ~W/K per merge level.
+    """
+
+    name = "approx_percentile"
+
+    def __init__(self, child: Expression, percentage, accuracy: int = 10000):
+        super().__init__(child)
+        self.is_array = isinstance(percentage, (list, tuple))
+        pcts = list(percentage) if self.is_array else [percentage]
+        for p in pcts:
+            if not 0.0 <= p <= 1.0:
+                raise ValueError("percentage must be in [0, 1]")
+        self.percentages = pcts
+        self.accuracy = accuracy
+        # centroid budget: enough for ~1/K rank resolution, bounded so
+        # states stay cheap (Spark's accuracy=1/err maps the same idea
+        # onto Greenwald-Khanna summary size)
+        self.K = int(min(512, max(32, accuracy // 64)))
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.ArrayType(dt.FLOAT64) if self.is_array else dt.FLOAT64
+
+    def state_schema(self, schema: Schema) -> List:
+        return [("means", dt.ArrayType(dt.FLOAT64)),
+                ("weights", dt.ArrayType(dt.FLOAT64))]
+
+    @staticmethod
+    def _centroid_lists(g_s, e_s, v_s, w_s, bucket, cap, num_groups):
+        """Rows sorted by (group, value), eligible first: collapse
+        (group, bucket) runs into centroids and pack them as per-group
+        lists. Returns (means ListColumn, weights ListColumn)."""
+        from ..columnar.nested import ListColumn
+        from ..columnar.vector import live_mask
+        idx = jnp.arange(cap, dtype=jnp.int32)
+        prev_g = jnp.concatenate([jnp.full(1, -1, g_s.dtype), g_s[:-1]])
+        prev_b = jnp.concatenate([jnp.full(1, -1, bucket.dtype),
+                                  bucket[:-1]])
+        boundary = e_s & ((idx == 0) | (g_s != prev_g) |
+                          (bucket != prev_b))
+        cid = jnp.maximum(jnp.cumsum(boundary.astype(jnp.int32)) - 1, 0)
+        wsum = _seg_sum(jnp.where(e_s, w_s, 0.0), cid, cap)
+        mwsum = _seg_sum(jnp.where(e_s, v_s * w_s, 0.0), cid, cap)
+        mean = mwsum / jnp.maximum(wsum, 1e-300)
+        n_cent = jnp.sum(boundary).astype(jnp.int32)
+        child_live = live_mask(cap, n_cent)
+        means_child = ColumnVector(jnp.where(child_live, mean, 0.0),
+                                   child_live, dt.FLOAT64)
+        w_child = ColumnVector(jnp.where(child_live, wsum, 0.0),
+                               child_live, dt.FLOAT64)
+        cpg = _seg_sum(boundary.astype(jnp.int32), g_s, num_groups)
+        offsets = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32), jnp.cumsum(cpg, dtype=jnp.int32)])
+        ones = jnp.ones(num_groups, jnp.bool_)
+        return (ListColumn(offsets, means_child, ones, dt.FLOAT64),
+                ListColumn(offsets, w_child, ones, dt.FLOAT64))
+
+    def update(self, gid, col: Column, num_groups: int, live,
+               **kw) -> State:
+        from ..columnar.vector import ColumnVector as CV
+        from ..ops import kernels as K_
+        cap = col.capacity
+        elig = col.validity & live
+        v64 = col.data.astype(jnp.float64)
+        vcol = CV(v64, elig, dt.FLOAT64)
+        gcol = CV(gid.astype(jnp.int32), elig, dt.INT32)
+        perm = K_.sort_indices([gcol, vcol], [True, True], [True, True],
+                               elig)
+        g_s = jnp.take(gid, perm)
+        e_s = jnp.take(elig, perm)
+        v_s = jnp.take(v64, perm)
+        idx = jnp.arange(cap, dtype=jnp.int32)
+        counts = _seg_sum(e_s.astype(jnp.int32), g_s, num_groups)
+        offsets = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32), jnp.cumsum(counts,
+                                                 dtype=jnp.int32)])
+        rank = idx - jnp.take(offsets, g_s)
+        n_g = jnp.maximum(jnp.take(counts, g_s), 1)
+        bucket = (rank.astype(jnp.int64) * self.K) // n_g.astype(jnp.int64)
+        means, weights = self._centroid_lists(
+            g_s, e_s, v_s, jnp.ones(cap, jnp.float64),
+            bucket.astype(jnp.int32), cap, num_groups)
+        return {"means": means, "weights": weights}
+
+    def merge(self, gid, states: State, num_groups: int) -> State:
+        from ..columnar.vector import ColumnVector as CV
+        from ..ops import kernels as K_
+        means, weights = states["means"], states["weights"]
+        cap = means.capacity
+        # 1. concat per group by offset relabel (collect_list merge
+        #    invariant: child stays row-major compact after gather)
+        lens = jnp.where(means.validity, means.lengths(), 0)
+        counts = _seg_sum(lens.astype(jnp.int32), gid, num_groups)
+        offsets = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32), jnp.cumsum(counts,
+                                                 dtype=jnp.int32)])
+        m_child, w_child = means.child, weights.child
+        ccap = m_child.capacity
+        pos = jnp.arange(ccap, dtype=jnp.int32)
+        total = offsets[num_groups]
+        alive = pos < total
+        egid = jnp.clip(jnp.searchsorted(offsets[1:], pos,
+                                         side="right"), 0,
+                        num_groups - 1).astype(jnp.int32)
+        # 2. sort centroids by (group, mean)
+        gcol = CV(egid, alive, dt.INT32)
+        mcol = CV(m_child.data, alive, dt.FLOAT64)
+        permc = K_.sort_indices([gcol, mcol], [True, True], [True, True],
+                                alive)
+        g_c = jnp.take(egid, permc)
+        a_c = jnp.take(alive, permc)
+        m_c = jnp.take(m_child.data, permc)
+        w_c = jnp.where(a_c, jnp.take(w_child.data, permc), 0.0)
+        # 3. weighted equi-quantile re-bucketing
+        W_g = _seg_sum(w_c, g_c, num_groups)
+        cnt_g = _seg_sum(a_c.astype(jnp.int32), g_c, num_groups)
+        offs2 = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32), jnp.cumsum(cnt_g,
+                                                 dtype=jnp.int32)])
+        cum = jnp.cumsum(w_c)
+        cwx = cum - w_c  # exclusive prefix
+        start = jnp.take(offs2, g_c)
+        base = jnp.take(jnp.concatenate([jnp.zeros(1, jnp.float64),
+                                         cum]), start)
+        mid = (cwx - base) + w_c * 0.5
+        Wrow = jnp.maximum(jnp.take(W_g, g_c), 1e-300)
+        bucket = jnp.clip((mid / Wrow * self.K).astype(jnp.int32),
+                          0, self.K - 1)
+        means2, weights2 = self._centroid_lists(
+            g_c, a_c, m_c, w_c, bucket, ccap, num_groups)
+        return {"means": means2, "weights": weights2}
+
+    def finalize(self, states: State):
+        means, weights = states["means"], states["weights"]
+        cap = means.capacity
+        m_child, w_child = means.child, weights.child
+        ccap = m_child.capacity
+        pos = jnp.arange(ccap, dtype=jnp.int32)
+        offsets = means.offsets
+        total = offsets[cap]
+        alive = pos < total
+        egid = jnp.clip(jnp.searchsorted(offsets[1:], pos, side="right"),
+                        0, cap - 1).astype(jnp.int32)
+        w = jnp.where(alive, w_child.data, 0.0)
+        W_g = _seg_sum(w, egid, cap)
+        cum = jnp.cumsum(w)
+        base = jnp.take(jnp.concatenate(
+            [jnp.zeros(1, jnp.float64), jnp.cumsum(W_g)[:-1]]), egid)
+        cw_in = cum - base  # inclusive cumulative weight within group
+        outs = []
+        for p in self.percentages:
+            t = jnp.take(W_g, egid) * p
+            cand = alive & (cw_in >= t - 1e-9)
+            selpos = _seg_min(jnp.where(cand, pos, ccap), egid, cap,
+                              ccap)
+            val = jnp.take(m_child.data,
+                           jnp.clip(selpos, 0, max(ccap - 1, 0)))
+            outs.append(jnp.where(selpos < ccap, val, 0.0))
+        ok = W_g > 0
+        if not self.is_array:
+            return outs[0], ok
+        from ..columnar.nested import ListColumn
+        P = len(self.percentages)
+        # null groups carry ZERO-length extents (ListColumn invariant),
+        # so compact the per-group value rows to the ok-group prefix
+        stacked = jnp.stack(outs, axis=1)  # (cap, P)
+        order = jnp.argsort(~ok, stable=True)
+        gathered = jnp.take(stacked, order, axis=0).reshape(cap * P)
+        n_ok = jnp.sum(ok).astype(jnp.int32)
+        child_live = jnp.arange(cap * P, dtype=jnp.int32) < n_ok * P
+        child = ColumnVector(jnp.where(child_live, gathered, 0.0),
+                             child_live, dt.FLOAT64)
+        out_offsets = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32),
+             jnp.cumsum(jnp.where(ok, P, 0).astype(jnp.int32))])
+        return ListColumn(out_offsets, child, ok, dt.FLOAT64), ok

@@ -235,3 +235,28 @@ class XxHash64(Expression):
         for c in cols:
             h = xxhash64_column(c, h)
         return make_result(bits.u64_to_i64(h), batch.live_mask(), dt.INT64)
+
+
+class BloomFilterMightContain(Expression):
+    """might_contain(bloom_filter, expr) over a host-built filter
+    (GpuBloomFilterMightContain.scala). ``bits`` is the bool[num_bits]
+    lane filter from ops/bloom.py build_bloom; null inputs yield null
+    (Spark's contract), non-null inputs yield the probe result."""
+
+    def __init__(self, child: Expression, bits):
+        super().__init__(child)
+        import numpy as _np
+        self.bits = _np.asarray(bits, dtype=bool)
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.BOOL
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        from ..ops import bloom as B
+        c = self.children[0].eval(batch)
+        hit = B.might_contain(jnp.asarray(self.bits), [c])
+        return make_result(hit, c.validity & batch.live_mask(), dt.BOOL)
+
+    def __repr__(self):
+        return f"might_contain(<{self.bits.shape[0]} bits>, " \
+               f"{self.children[0]!r})"

@@ -1,0 +1,92 @@
+"""Bloom filter kernels + runtime join filtering.
+
+Reference surface: GpuBloomFilterAggregate.scala /
+GpuBloomFilterMightContain.scala (SURVEY §2.5 aggregate exprs) — Spark
+injects a bloom-filter build over the small join side and a
+might_contain probe over the big side (runtime row-level join
+filtering). The TPU rebuild keeps the same double-hashing scheme
+(k probe positions h1 + i*h2, Spark BloomFilterImpl's structure) but
+stores the filter as a bool[num_bits] lane array instead of packed
+int64 words: XLA scatter-set and gather are the natural TPU ops, there
+is no atomic-OR to emulate, and num_bits stays modest (8-16 bits/key).
+
+Two consumption paths:
+- exec/join.py pre-filters inner/semi probe batches against a filter
+  built from the materialized build side (the planner-injected runtime
+  filter role),
+- expr BloomFilterMightContain(child, filter) for direct use.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar.vector import Column
+
+DEFAULT_BITS_PER_KEY = 10
+NUM_HASHES = 6
+MIN_BITS = 1 << 10
+MAX_BITS = 1 << 24
+
+
+def choose_num_bits(num_keys: int,
+                    bits_per_key: int = DEFAULT_BITS_PER_KEY) -> int:
+    n = max(num_keys, 1) * bits_per_key
+    bits = 1
+    while bits < n:
+        bits <<= 1
+    return min(max(bits, MIN_BITS), MAX_BITS)
+
+
+def _double_hash(key_cols: Sequence[Column]):
+    """(h1, h2) 32-bit hash pair per row; h2 forced odd so the probe
+    sequence cycles through distinct positions (classic double
+    hashing)."""
+    from ..expr import hashing as H
+    cap = key_cols[0].capacity
+    h1 = jnp.full((cap,), 0x9E3779B9, jnp.uint32)
+    h2 = jnp.full((cap,), 0x85EBCA6B, jnp.uint32)
+    for c in key_cols:
+        h1 = H.murmur3_column(c, h1)
+        h2 = H.murmur3_column(c, h2)
+    return h1, h2 | jnp.uint32(1)
+
+
+def _any_null(key_cols: Sequence[Column]):
+    nn = jnp.ones(key_cols[0].capacity, jnp.bool_)
+    for c in key_cols:
+        nn = nn & c.validity
+    return ~nn
+
+
+def build_bloom(key_cols: Sequence[Column], live, num_bits: int
+                ) -> jnp.ndarray:
+    """bool[num_bits] filter over the live non-null key rows."""
+    h1, h2 = _double_hash(key_cols)
+    ok = live & ~_any_null(key_cols)
+    bits = jnp.zeros(num_bits, jnp.bool_)
+    mask = jnp.uint32(num_bits - 1)  # num_bits is a power of two
+    for i in range(NUM_HASHES):
+        pos = (h1 + jnp.uint32(i) * h2) & mask
+        # scatter-max of the row predicate: excluded rows contribute
+        # False (identity), so no slot-routing is needed for them
+        bits = bits.at[pos].max(ok)
+    return bits
+
+
+def might_contain(bits: jnp.ndarray, key_cols: Sequence[Column]
+                  ) -> jnp.ndarray:
+    """bool[cap] probe: True = possibly present. Null keys return False
+    (they cannot match an inner/semi join; expression-level semantics
+    layer null handling on top)."""
+    h1, h2 = _double_hash(key_cols)
+    num_bits = bits.shape[0]
+    mask = jnp.uint32(num_bits - 1)
+    hit = jnp.ones(key_cols[0].capacity, jnp.bool_)
+    for i in range(NUM_HASHES):
+        pos = (h1 + jnp.uint32(i) * h2) & mask
+        hit = hit & jnp.take(bits, pos)
+    return hit & ~_any_null(key_cols)

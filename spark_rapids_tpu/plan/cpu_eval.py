@@ -1916,6 +1916,30 @@ def _register_misc_exprs():
 _register_misc_exprs()
 
 
+def _register_bloom():
+    from ..expr.hashing import BloomFilterMightContain
+
+    @_reg(BloomFilterMightContain)
+    def _might_contain(expr, table):
+        # the probe hash chain is jnp math; run the device kernel over a
+        # host-built column so CPU fallback and device agree bit-exactly
+        import jax.numpy as jnp
+
+        from ..columnar.vector import column_from_numpy
+        from ..ops import bloom as B
+        schema = table.schema()
+        v, m = _ev(expr.children[0], table)
+        n = table.num_rows
+        c = column_from_numpy(np.asarray(v), max(n, 1),
+                              dtype=expr.children[0].data_type(schema),
+                              mask=m)
+        hit = np.asarray(B.might_contain(jnp.asarray(expr.bits), [c]))[:n]
+        return hit, m.copy()
+
+
+_register_bloom()
+
+
 # ---------------------------------------------------------------------------
 # bitwise
 # ---------------------------------------------------------------------------

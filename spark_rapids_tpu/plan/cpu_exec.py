@@ -280,6 +280,16 @@ def _agg_cpu(fn: Agg.AggregateFunction, values: Optional[np.ndarray],
         return vals, True  # collect of empty group = empty array
     if len(valid_v) == 0:
         return 0, False
+    if isinstance(fn, Agg.ApproxPercentile):
+        # oracle: exact nearest-rank (smallest value whose cumulative
+        # count reaches ceil(p*N)) — the limit the device sketch
+        # approaches as K -> N
+        x = np.sort(valid_v.astype(np.float64))
+        outs = []
+        for p in fn.percentages:
+            r = max(int(np.ceil(p * len(x))) - 1, 0)
+            outs.append(float(x[min(r, len(x) - 1)]))
+        return (outs if fn.is_array else outs[0]), True
     if isinstance(fn, Agg.Percentile):
         x = valid_v.astype(np.float64)
         if isinstance(in_dtype, dt.DecimalType):

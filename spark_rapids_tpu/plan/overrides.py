@@ -112,6 +112,15 @@ def _register_pandas_udf_rule():
 _register_pandas_udf_rule()
 
 
+def _register_bloom_rule():
+    from ..expr.hashing import BloomFilterMightContain
+    _expr(BloomFilterMightContain,
+          ts.integral + ts.TypeSig(ts.DATE, ts.TIMESTAMP, ts.STRING))
+
+
+_register_bloom_rule()
+
+
 def _register_misc_rules():
     # execution-context expressions (expr/misc.py): leaf exprs, no
     # input types to check; eager-only ones are handled by Project
@@ -351,6 +360,9 @@ for _cls in (Agg.Sum, Agg.Average):
 for _cls in (Agg.VariancePop, Agg.VarianceSamp,
              Agg.StddevPop, Agg.StddevSamp):
     _expr(_cls, ts.numeric)
+# t-digest sketch states (ListColumn centroids) on device; exact
+# Percentile remains CPU-only (not decomposable into bounded states)
+_expr(Agg.ApproxPercentile, ts.numeric)
 # min/max cover strings via sort-rank selection (expr/aggregates.py
 # _string_reduce)
 for _cls in (Agg.Min, Agg.Max):
@@ -591,8 +603,11 @@ def _build_tpu_exec(plan: LogicalPlan, children: List[TpuExec],
         # collect_list/set carry ListColumn states the exchange
         # partitioner doesn't pack yet -> single-stage COMPLETE
         from ..exec.aggregate import COMPLETE, FINAL, PARTIAL
-        if any(isinstance(fn, Agg.CollectList)
+        if any(isinstance(fn, (Agg.CollectList, Agg.ApproxPercentile))
                for fn, _ in plan.agg_exprs):
+            # ListColumn-state aggregates run single-stage: the
+            # partition/shuffle layer moves primitive lanes only (list
+            # states would need a padded wire view like strings)
             return HashAggregateExec(children[0], plan.group_exprs,
                                      plan.agg_exprs, mode=COMPLETE)
         partial = HashAggregateExec(children[0], plan.group_exprs,
