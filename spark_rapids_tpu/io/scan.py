@@ -238,17 +238,43 @@ def to_arrow_filter(expr: E.Expression):
 # host-side file reading (no device semaphore held)
 # ---------------------------------------------------------------------------
 
-def read_file_to_tables(path: str, fmt: str, schema: Schema,
-                        options: dict, arrow_filter,
-                        max_rows: int, conf=None) -> List[HostTable]:
+def iter_file_tables(path: str, fmt: str, schema: Schema,
+                     options: dict, arrow_filter,
+                     max_rows: int, conf=None) -> Iterator[HostTable]:
     """Decode one file on the host into row-sliced HostTables conforming
     to the DECLARED schema: positional rename when file column names
     differ (e.g. headerless CSV) and per-column cast to declared dtypes.
+
+    Parquet streams CHUNKED: the dataset scanner yields <= max_rows
+    record batches row-group-incrementally, so a single file larger than
+    host memory never fully materializes (GpuParquetScan chunked-reader
+    role, GpuParquetScan.scala:254). Other formats decode whole (their
+    readers are not incremental) and slice.
+
     ``conf`` must be passed explicitly from pool worker threads (the
     active conf is a thread-local)."""
     from .filecache import resolve_read_path
     path = resolve_read_path(path, conf)
     names = [n for n, _ in schema]
+    if fmt == "parquet":
+        import pyarrow.dataset as ds
+        dataset = ds.dataset(path, format="parquet")
+        cols = names if set(names) <= set(dataset.schema.names) else None
+        scanner = dataset.scanner(columns=cols, filter=arrow_filter,
+                                  batch_size=max_rows)
+        saw = False
+        for rb in scanner.to_batches():
+            if rb.num_rows == 0:
+                continue
+            saw = True
+            ht = arrow_to_host_table(
+                _conform(pa.Table.from_batches([rb]), schema))
+            _apply_read_rebase(ht, options)
+            yield ht
+        if not saw:
+            yield arrow_to_host_table(
+                _conform(dataset.schema.empty_table(), schema))
+        return
     if fmt == "avro":
         # from-scratch container decode (io/avro.py); route through
         # arrow so the shared _conform rename/cast applies like every
@@ -258,11 +284,6 @@ def read_file_to_tables(path: str, fmt: str, schema: Schema,
         table = host_table_to_arrow(read_avro_file(path))
     elif fmt == "hivetext":
         table = _read_hivetext(path, options)
-    elif fmt == "parquet":
-        import pyarrow.dataset as ds
-        dataset = ds.dataset(path, format="parquet")
-        cols = names if set(names) <= set(dataset.schema.names) else None
-        table = dataset.to_table(columns=cols, filter=arrow_filter)
     elif fmt == "orc":
         import pyarrow.orc as orc
         f = orc.ORCFile(path)
@@ -273,16 +294,23 @@ def read_file_to_tables(path: str, fmt: str, schema: Schema,
     else:
         table = _read_json(path, options)
     table = _conform(table, schema)
-    out = []
     for start in range(0, max(table.num_rows, 1), max_rows):
         sl = table.slice(start, max_rows)
         if sl.num_rows == 0 and start > 0:
             break
         ht = arrow_to_host_table(sl)
-        if fmt in ("parquet", "orc"):
+        if fmt == "orc":
             _apply_read_rebase(ht, options)
-        out.append(ht)
-    return out
+        yield ht
+
+
+def read_file_to_tables(path: str, fmt: str, schema: Schema,
+                        options: dict, arrow_filter,
+                        max_rows: int, conf=None) -> List[HostTable]:
+    """Materialized form of iter_file_tables — the thread-pool reader
+    needs whole-file futures."""
+    return list(iter_file_tables(path, fmt, schema, options,
+                                 arrow_filter, max_rows, conf))
 
 
 def _apply_read_rebase(ht: HostTable, options: dict) -> None:
@@ -401,7 +429,7 @@ class FileSourceScanExec(TpuExec):
             pending: List[HostTable] = []
             rows = 0
             for p in self.scan.paths:
-                for t in read_file_to_tables(p, *args):
+                for t in iter_file_tables(p, *args):
                     pending.append(t)
                     rows += t.num_rows
                     if rows >= max_rows:
@@ -411,7 +439,7 @@ class FileSourceScanExec(TpuExec):
                 yield None, concat_tables(pending)
         else:
             for p in self.scan.paths:
-                for t in read_file_to_tables(p, *args):
+                for t in iter_file_tables(p, *args):
                     yield p, t
 
     def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:

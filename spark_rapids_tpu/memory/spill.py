@@ -1,4 +1,4 @@
-"""Tiered spill: device (HBM) → host (numpy) → disk.
+"""Tiered spill: device (HBM) → host (native pool) → disk.
 
 Rebuild of the reference's spill framework (SURVEY §2.3):
 RapidsBufferCatalog.scala (handle-based registry, synchronousSpill:589,
@@ -6,10 +6,15 @@ acquire:461), RapidsDeviceMemoryStore / RapidsHostMemoryStore /
 RapidsDiskStore, SpillableColumnarBatch.scala, SpillPriorities.scala.
 
 TPU mapping: a "device buffer" is the set of jax.Arrays inside a
-ColumnarBatch; spilling to host is ``jax.device_get`` into numpy,
-disk tier is an .npz file. Re-materialization is ``jnp.asarray`` back
-into HBM. All bytes are accounted against the shared MemoryBudget so
-spilling actually relieves device pressure.
+ColumnarBatch; spilling to host copies the leaves into slabs of the
+native C++ HostMemoryPool (native/tputable.cpp — the pinned-host-pool
+role of RapidsHostMemoryStore), so host spill space is a real bounded
+allocation: pool exhaustion cascades older host entries to disk, and if
+space still cannot be found the entry bypasses the pool (plain numpy)
+under the same byte-limit accounting. Disk tier is an .npz file.
+Re-materialization is ``jnp.asarray`` back into HBM. All device bytes
+are accounted against the shared MemoryBudget so spilling actually
+relieves device pressure.
 """
 
 from __future__ import annotations
@@ -49,6 +54,67 @@ def _tree_to_host(batch: ColumnarBatch):
     return host, treedef
 
 
+class _PooledLeaves:
+    """Array leaves packed into one native-pool slab."""
+
+    __slots__ = ("pool", "ptr", "total", "metas", "scalars", "nleaves")
+
+    def __init__(self, pool, ptr: int, total: int, metas, scalars,
+                 nleaves: int):
+        self.pool = pool
+        self.ptr = ptr
+        self.total = total
+        self.metas = metas      # [(leaf_idx, offset, shape, dtype)]
+        self.scalars = scalars  # {leaf_idx: value}
+        self.nleaves = nleaves
+
+    @classmethod
+    def pack(cls, pool, host_leaves) -> Optional["_PooledLeaves"]:
+        import ctypes
+        arrays = [(i, x) for i, x in enumerate(host_leaves)
+                  if isinstance(x, np.ndarray)]
+        scalars = {i: x for i, x in enumerate(host_leaves)
+                   if not isinstance(x, np.ndarray)}
+        total = sum(int(a.nbytes) for _, a in arrays)
+        ptr = pool.alloc(max(total, 1))
+        if ptr is None:
+            return None
+        buf = (ctypes.c_char * max(total, 1)).from_address(ptr)
+        metas = []
+        off = 0
+        for i, a in arrays:
+            n = int(a.nbytes)
+            if n:
+                view = np.frombuffer(buf, dtype=np.uint8, count=n,
+                                     offset=off)
+                view[:] = np.ascontiguousarray(a).view(np.uint8).ravel()
+            metas.append((i, off, a.shape, a.dtype))
+            off += n
+        return cls(pool, ptr, total, metas, scalars, len(host_leaves))
+
+    def unpack(self):
+        import ctypes
+        buf = (ctypes.c_char * max(self.total, 1)).from_address(self.ptr)
+        leaves = [None] * self.nleaves
+        for i, v in self.scalars.items():
+            leaves[i] = v
+        for i, off, shape, dtype in self.metas:
+            count = int(np.prod(shape)) if shape else 1
+            nbytes = count * dtype.itemsize
+            if nbytes:
+                arr = np.frombuffer(buf, dtype=dtype, count=count,
+                                    offset=off).reshape(shape)
+            else:
+                arr = np.zeros(shape, dtype)
+            leaves[i] = arr
+        return leaves
+
+    def free(self) -> None:
+        if self.ptr:
+            self.pool.free(self.ptr)
+            self.ptr = 0
+
+
 def _tree_to_device(host_leaves, treedef) -> ColumnarBatch:
     dev = [jnp.asarray(x) if isinstance(x, np.ndarray) else x
            for x in host_leaves]
@@ -63,9 +129,9 @@ class SpillableBatch:
     ``close()`` releases whatever tier holds it.
     """
 
-    __slots__ = ("_batch", "_host", "_treedef", "_path", "_nbytes",
-                 "priority", "_lock", "_catalog", "handle", "closed",
-                 "_scalars", "_nleaves", "_num_rows")
+    __slots__ = ("_batch", "_host", "_pooled", "_treedef", "_path",
+                 "_nbytes", "priority", "_lock", "_catalog", "handle",
+                 "closed", "_scalars", "_nleaves", "_num_rows")
 
     def __init__(self, batch: ColumnarBatch,
                  priority: SpillPriority = SpillPriority.ACTIVE_ON_DECK,
@@ -76,6 +142,7 @@ class SpillableBatch:
         self._batch: Optional[ColumnarBatch] = batch
         self._num_rows = int(batch.num_rows)
         self._host = None
+        self._pooled: Optional[_PooledLeaves] = None
         self._treedef = None
         self._path: Optional[str] = None
         self.priority = priority
@@ -91,7 +158,7 @@ class SpillableBatch:
     def tier(self) -> str:
         if self._batch is not None:
             return "device"
-        if self._host is not None:
+        if self._host is not None or self._pooled is not None:
             return "host"
         if self._path is not None:
             return "disk"
@@ -107,7 +174,13 @@ class SpillableBatch:
             if self._batch is None or self.closed:
                 return 0
             t0 = _time.perf_counter_ns()
-            self._host, self._treedef = _tree_to_host(self._batch)
+            host, self._treedef = _tree_to_host(self._batch)
+            # host tier backing: native pool slab when space can be
+            # found (cascading older host entries to disk), else plain
+            # numpy under the same byte accounting
+            self._pooled = self._catalog.try_pool_pack(host)
+            if self._pooled is None:
+                self._host = host
             self._batch = None
             self._catalog.budget.release(self._nbytes)
             from .budget import task_context
@@ -119,20 +192,26 @@ class SpillableBatch:
     def spill_to_disk(self) -> int:
         """Host → disk. Returns host bytes freed."""
         with self._lock:
-            if self._host is None or self.closed:
+            if (self._host is None and self._pooled is None) or \
+                    self.closed:
                 return 0
+            host = self._host if self._host is not None \
+                else self._pooled.unpack()
             fd, path = tempfile.mkstemp(suffix=".npz",
                                         dir=self._catalog.spill_dir)
             os.close(fd)
-            arrays = {f"a{i}": x for i, x in enumerate(self._host)
+            arrays = {f"a{i}": x for i, x in enumerate(host)
                       if isinstance(x, np.ndarray)}
-            scalars = {i: x for i, x in enumerate(self._host)
+            scalars = {i: x for i, x in enumerate(host)
                        if not isinstance(x, np.ndarray)}
             np.savez(path, **arrays)
             self._path = path
             self._scalars = scalars
-            self._nleaves = len(self._host)
+            self._nleaves = len(host)
             self._host = None
+            if self._pooled is not None:
+                self._pooled.free()
+                self._pooled = None
             return self._nbytes
 
     def get(self) -> ColumnarBatch:
@@ -156,7 +235,8 @@ class SpillableBatch:
             if self._batch is not None:  # raced with another get()
                 self._catalog.budget.release(self._nbytes)
                 return self._batch
-            if self._host is None and self._path is not None:
+            if self._host is None and self._pooled is None and \
+                    self._path is not None:
                 data = np.load(self._path)
                 leaves = []
                 for i in range(self._nleaves):
@@ -167,7 +247,14 @@ class SpillableBatch:
                 self._host = leaves
                 os.unlink(self._path)
                 self._path = None
-            self._batch = _tree_to_device(self._host, self._treedef)
+            if self._pooled is not None:
+                host = self._pooled.unpack()
+                self._batch = _tree_to_device(host, self._treedef)
+                del host  # pool views die before the slab frees
+                self._pooled.free()
+                self._pooled = None
+            else:
+                self._batch = _tree_to_device(self._host, self._treedef)
             self._host = None
             return self._batch
 
@@ -180,6 +267,9 @@ class SpillableBatch:
                 self._catalog.budget.release(self._nbytes)
                 self._batch = None
             self._host = None
+            if self._pooled is not None:
+                self._pooled.free()
+                self._pooled = None
             if self._path is not None:
                 try:
                     os.unlink(self._path)
@@ -216,6 +306,32 @@ class SpillCatalog:
         self._entries: Dict[int, SpillableBatch] = {}
         self._next = 0
         self._lock = threading.Lock()
+        self.host_pool = None
+        from ..native import native_available
+        if native_available():
+            from ..native import HostMemoryPool
+            self.host_pool = HostMemoryPool(self.host_limit)
+
+    def try_pool_pack(self, host_leaves) -> Optional[_PooledLeaves]:
+        """Pack spilled leaves into the native host pool; exhaustion
+        cascades existing host-tier entries to disk
+        (RapidsHostMemoryStore's spill-on-alloc-failure contract).
+        None = caller keeps a plain numpy fallback."""
+        if self.host_pool is None:
+            return None
+        pooled = _PooledLeaves.pack(self.host_pool, host_leaves)
+        if pooled is not None:
+            return pooled
+        with self._lock:
+            victims = sorted(
+                (e for e in self._entries.values() if e.tier == "host"),
+                key=lambda e: (e.priority, -e.nbytes))
+        for v in victims:
+            v.spill_to_disk()
+            pooled = _PooledLeaves.pack(self.host_pool, host_leaves)
+            if pooled is not None:
+                return pooled
+        return None
 
     def register(self, sb: SpillableBatch) -> int:
         with self._lock:

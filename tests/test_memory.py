@@ -135,3 +135,54 @@ def test_host_limit_overflows_to_disk(catalog):
     assert sb.tier == "disk"
     assert batch_to_pydict(sb.get()) == expected
     sb.close()
+
+
+def test_host_tier_uses_native_pool():
+    """Spilled host bytes live in the native HostMemoryPool when the
+    library is available; pool exhaustion cascades older host entries
+    to disk (RapidsHostMemoryStore contract)."""
+    import numpy as np
+    import pytest
+
+    from spark_rapids_tpu.native import native_available
+    if not native_available():
+        pytest.skip("native library not built")
+    from spark_rapids_tpu.columnar import dtypes as dt
+    from spark_rapids_tpu.columnar.vector import ColumnarBatch, ColumnVector
+    from spark_rapids_tpu.memory.budget import MemoryBudget
+    from spark_rapids_tpu.memory.spill import (SpillCatalog, SpillableBatch,
+                                               reset_spill_catalog)
+
+    def mkbatch(n, seed):
+        import jax.numpy as jnp
+        rng = np.random.default_rng(seed)
+        vals = rng.uniform(0, 1, n)
+        col = ColumnVector(jnp.asarray(vals), jnp.ones(n, jnp.bool_),
+                           dt.FLOAT64)
+        return ColumnarBatch([col], ["v"], n), vals
+
+    # pool sized for ~2 batches of 1024 f64 rows (plus masks)
+    cat = reset_spill_catalog(budget=MemoryBudget(1 << 30),
+                              host_limit=24 * 1024)
+    assert cat.host_pool is not None
+    b1, v1 = mkbatch(1024, 1)
+    b2, v2 = mkbatch(1024, 2)
+    b3, v3 = mkbatch(1024, 3)
+    s1 = SpillableBatch(b1, catalog=cat)
+    s2 = SpillableBatch(b2, catalog=cat)
+    s3 = SpillableBatch(b3, catalog=cat)
+    s1.spill_to_host()
+    in_use_1 = cat.host_pool.stats()["in_use"]
+    assert in_use_1 >= 1024 * 8
+    s2.spill_to_host()
+    # third spill exhausts the pool -> s1 or s2 cascades to disk
+    s3.spill_to_host()
+    tiers = sorted([s1.tier, s2.tier, s3.tier])
+    assert "disk" in tiers and "host" in tiers
+    # all three round-trip intact
+    for s, v in ((s1, v1), (s2, v2), (s3, v3)):
+        got = np.asarray(s.get().columns[0].data)
+        assert np.array_equal(got, v)
+        s.close()
+    assert cat.host_pool.stats()["in_use"] == 0
+    reset_spill_catalog()
