@@ -358,6 +358,14 @@ FILECACHE_LOCAL_FS = conf("srt.filecache.useForLocalFiles") \
          "for slow network mounts that look local).") \
     .boolean(False)
 
+WINDOW_BATCHED_RUNNING = conf("srt.sql.window.batchedRunning.enabled") \
+    .doc("Stream running-frame window functions (rank family, ROWS "
+         "unbounded-preceding..current-row aggregates) batch-at-a-time "
+         "over a sorted child with carried state instead of "
+         "materializing whole partitions "
+         "(GpuRunningWindowExec/BatchedRunningWindowFixer role).") \
+    .boolean(True)
+
 JOIN_BLOOM_ENABLED = conf("srt.sql.join.bloomFilter.enabled") \
     .doc("Build a bloom filter over the materialized build side of "
          "inner/semi hash joins and pre-filter probe batches with it "

@@ -501,3 +501,263 @@ class WindowExec(TpuExec):
         fns = ", ".join(type(we.func).__name__
                         for we, _ in self.window_exprs)
         return f"Window[{fns}]"
+
+
+# ---------------------------------------------------------------------------
+# batched running windows (GpuRunningWindowExec + BatchedRunningWindowFixer,
+# GpuWindowExec.scala:236-292)
+# ---------------------------------------------------------------------------
+
+def running_compatible(window_exprs, in_schema) -> bool:
+    """True when every expression can stream batch-at-a-time over a
+    (partition, order)-sorted child with carried state: rank family, or
+    ROWS running (unbounded-preceding..current-row) sum/min/max/count/
+    avg over plain numeric inputs. RANGE running is excluded — its peer
+    rows share the value at the run's LAST row, which can live in the
+    next batch (needs lookahead); decimal inputs carry two-limb states
+    the scalar fixer cannot hold."""
+    for we, _name in window_exprs:
+        fn = we.func
+        if isinstance(fn, (RowNumber, Rank, DenseRank)):
+            continue
+        frame = we.spec.frame
+        if isinstance(fn, (Sum, Count, CountStar, Min, Max, Average)) \
+                and frame is not None and frame.is_running \
+                and frame.row_based:
+            if fn.children:
+                t = fn.children[0].data_type(in_schema)
+                if isinstance(t, dt.DecimalType) or t == dt.STRING \
+                        or t.is_nested:
+                    return False
+            continue
+        return False
+    return True
+
+
+class BatchedRunningWindowExec(TpuExec):
+    """Running-frame windows over an already (partition, order)-sorted
+    stream in O(batch) memory: each batch computes its within-batch
+    segmented scans, then the FIRST partition-run is fixed up with
+    state carried from the previous batch (rank/row-number bases,
+    running accumulator and count), exactly the reference's
+    BatchedRunningWindowFixer contract. The planner places a SortExec
+    below; output rows stream in sorted order (Spark's window makes no
+    ordering promise, and this matches the reference's running path)."""
+
+    def __init__(self, child: TpuExec,
+                 window_exprs: Sequence[Tuple[WindowExpression, str]]):
+        super().__init__(child)
+        self.window_exprs = list(window_exprs)
+        spec = window_exprs[0][0].spec
+        self.partition_by = spec.partition_by
+        self.order_by = spec.order_fields
+        in_schema = child.output_schema
+        self._schema = list(in_schema) + [
+            (name, we.data_type(in_schema))
+            for we, name in self.window_exprs]
+        self._in_schema = in_schema
+        self._jit = jax.jit(self._compute)
+
+    @property
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    # --- carried state -----------------------------------------------
+    def _agg_acc_dtype(self, fn):
+        if isinstance(fn, (Count, CountStar)):
+            return jnp.int64
+        if isinstance(fn, Average):
+            return jnp.float64
+        t = fn.data_type(self._in_schema)
+        return t.physical
+
+    def _zero_state(self):
+        """Structure-stable pytree: 1-row tail key columns + per-fn
+        scalars. has_tail gates every fixup."""
+        def zero_col(e):
+            t = e.data_type(self._in_schema)
+            if t == dt.STRING:
+                return StringColumn(jnp.zeros(2, jnp.int32),
+                                    jnp.zeros(8, jnp.uint8),
+                                    jnp.zeros(1, jnp.bool_), pad_bucket=8)
+            return ColumnVector(jnp.zeros(1, t.physical),
+                                jnp.zeros(1, jnp.bool_), t)
+        fns = []
+        for we, _ in self.window_exprs:
+            fn = we.func
+            fns.append({
+                "acc": jnp.zeros((), self._agg_acc_dtype(fn))
+                if isinstance(fn, (Sum, Count, CountStar, Min, Max,
+                                   Average)) else jnp.zeros((), jnp.int64),
+                "cnt": jnp.zeros((), jnp.int64),
+                "rank": jnp.zeros((), jnp.int64),
+                "dense": jnp.zeros((), jnp.int64),
+            })
+        return {
+            "has_tail": jnp.zeros((), jnp.bool_),
+            "rows": jnp.zeros((), jnp.int64),  # rows so far in partition
+            "tail_part": [zero_col(e) for e in self.partition_by],
+            "tail_order": [zero_col(o.expr) for o in self.order_by],
+            "fns": fns,
+        }
+
+    # --- the per-batch kernel ----------------------------------------
+    def _compute(self, batch: ColumnarBatch, state):
+        cap = batch.capacity
+        n = batch.num_rows
+        idx = jnp.arange(cap, dtype=jnp.int32)
+        s_live = idx < n
+        part_cols = [e.eval(batch) for e in self.partition_by]
+        order_cols = [o.expr.eval(batch) for o in self.order_by]
+
+        new_part = (_prev_differs(part_cols) if part_cols
+                    else jnp.zeros(cap, jnp.bool_)) | (idx == 0)
+        gid = jnp.cumsum(new_part.astype(jnp.int32)) - 1
+        seg_start = _seg_start_idx(new_part)
+        new_order = new_part | (_prev_differs(order_cols)
+                                if order_cols else jnp.zeros(cap, jnp.bool_))
+        run_start = jax.lax.associative_scan(
+            jnp.maximum, jnp.where(new_order, idx, 0))
+        rn = (idx - seg_start + 1).astype(jnp.int64)
+
+        zero_i = jnp.zeros(1, jnp.int32)
+        def row0_equal(cols, tails):
+            if not cols:
+                return jnp.ones((), jnp.bool_)
+            return K._keys_equal(cols, zero_i, tails, zero_i)[0]
+        cont = state["has_tail"] & (n > 0) & \
+            row0_equal(part_cols, state["tail_part"])
+        cont_order = cont & row0_equal(order_cols, state["tail_order"])
+        in_seg0 = (gid == 0) & s_live
+        prev_rows = jnp.where(cont, state["rows"], 0)
+
+        out_cols: List[Column] = []
+        new_fns = []
+        last = jnp.clip(n - 1, 0, cap - 1)
+
+        for (we, _name), fst in zip(self.window_exprs, state["fns"]):
+            fn = we.func
+            if isinstance(fn, RowNumber):
+                out = jnp.where(in_seg0, rn + prev_rows, rn)
+                out_cols.append(make_result(out.astype(jnp.int32),
+                                            s_live, dt.INT32))
+                nf = dict(fst)
+                new_fns.append(nf)
+                continue
+            if isinstance(fn, (Rank, DenseRank)):
+                if isinstance(fn, Rank):
+                    rank = (run_start - seg_start + 1).astype(jnp.int64)
+                    # rows continuing the tail's ORDER run keep its rank;
+                    # later runs of the continued partition shift by the
+                    # carried partition row count
+                    in_first_run = run_start == 0
+                    fixed = jnp.where(in_first_run & cont_order,
+                                      fst["rank"], rank + prev_rows)
+                    out = jnp.where(in_seg0 & cont, fixed, rank)
+                    out_cols.append(make_result(out.astype(jnp.int32),
+                                                s_live, dt.INT32))
+                    nf = dict(fst)
+                    nf["rank"] = jnp.take(out, last)
+                    new_fns.append(nf)
+                else:
+                    dr = _seg_scan(jnp.add, new_order.astype(jnp.int64),
+                                   new_part)
+                    fixed = dr + fst["dense"] - \
+                        jnp.where(cont_order, 1, 0)
+                    out = jnp.where(in_seg0 & cont, fixed, dr)
+                    out_cols.append(make_result(out.astype(jnp.int32),
+                                                s_live, dt.INT32))
+                    nf = dict(fst)
+                    nf["dense"] = jnp.take(out, last)
+                    new_fns.append(nf)
+                continue
+            # running aggregates
+            out_t = fn.data_type(self._in_schema) \
+                if not isinstance(fn, CountStar) else dt.INT64
+            if isinstance(fn, CountStar):
+                valid_in = s_live
+                vals = s_live.astype(jnp.int64)
+            else:
+                col = fn.children[0].eval(batch)
+                valid_in = col.validity
+                vals = col.data
+            acc_t = self._agg_acc_dtype(fn)
+            cnt_vals = (valid_in & s_live).astype(jnp.int64)
+            if isinstance(fn, (Count, CountStar)):
+                agg_vals = cnt_vals
+                op = jnp.add
+            elif isinstance(fn, Min):
+                op = jnp.minimum
+                fill = dt.max_value(out_t)
+                agg_vals = jnp.where(valid_in & s_live, vals.astype(acc_t),
+                                     jnp.asarray(fill, acc_t))
+            elif isinstance(fn, Max):
+                op = jnp.maximum
+                fill = dt.min_value(out_t)
+                agg_vals = jnp.where(valid_in & s_live, vals.astype(acc_t),
+                                     jnp.asarray(fill, acc_t))
+            else:  # Sum / Average
+                op = jnp.add
+                agg_vals = jnp.where(valid_in & s_live,
+                                     vals.astype(acc_t),
+                                     jnp.zeros((), acc_t))
+            acc = _seg_scan(op, agg_vals, new_part)
+            ncnt = _seg_scan(jnp.add, cnt_vals, new_part)
+            prev_acc = fst["acc"]
+            prev_cnt = jnp.where(cont, fst["cnt"], 0)
+            if op is jnp.add:
+                fix = acc + jnp.where(cont, prev_acc,
+                                      jnp.zeros((), acc.dtype))
+            else:
+                fix = jnp.where(cont & (prev_cnt > 0),
+                                op(acc, prev_acc), acc)
+            acc = jnp.where(in_seg0, fix, acc)
+            ncnt = jnp.where(in_seg0, ncnt + prev_cnt, ncnt)
+            has_vals = ncnt > 0
+            if isinstance(fn, (Count, CountStar)):
+                out_cols.append(make_result(acc.astype(jnp.int64),
+                                            s_live, dt.INT64))
+            elif isinstance(fn, Average):
+                avg = acc / jnp.where(has_vals, ncnt, 1).astype(jnp.float64)
+                out_cols.append(make_result(avg, has_vals & s_live,
+                                            dt.FLOAT64))
+            else:
+                out_cols.append(make_result(acc.astype(out_t.physical),
+                                            has_vals & s_live, out_t))
+            nf = dict(fst)
+            nf["acc"] = jnp.take(acc, last).astype(acc_t)
+            nf["cnt"] = jnp.take(ncnt, last)
+            new_fns.append(nf)
+
+        # carried tail = last live row's keys + its row_number
+        one_valid = jnp.asarray([True])
+        last_arr = jnp.asarray([0], jnp.int32) + last
+        new_state = {
+            "has_tail": state["has_tail"] | (n > 0),
+            "rows": jnp.where(
+                n > 0,
+                jnp.take(jnp.where(in_seg0, rn + prev_rows, rn), last),
+                state["rows"]),
+            "tail_part": [c.gather(last_arr, one_valid)
+                          for c in part_cols],
+            "tail_order": [c.gather(last_arr, one_valid)
+                           for c in order_cols],
+            "fns": new_fns,
+        }
+        out = ColumnarBatch(list(batch.columns) + out_cols,
+                            [nm for nm, _ in self._schema], n)
+        return out, new_state
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        state = self._zero_state()
+        for batch in self.children[0].execute(ctx):
+            if int(batch.num_rows) == 0:
+                continue
+            with ctx.semaphore:
+                out, state = self._jit(batch, state)
+            yield out
+
+    def node_description(self) -> str:
+        fns = ", ".join(type(we.func).__name__
+                        for we, _ in self.window_exprs)
+        return f"BatchedRunningWindow[{fns}]"

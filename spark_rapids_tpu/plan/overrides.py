@@ -616,7 +616,22 @@ def _build_tpu_exec(plan: LogicalPlan, children: List[TpuExec],
                                  mode=FINAL,
                                  input_schema=plan.children[0].schema)
     if isinstance(plan, Window):
-        from ..exec.window import WindowExec
+        from ..conf import WINDOW_BATCHED_RUNNING
+        from ..exec.window import (BatchedRunningWindowExec, WindowExec,
+                                   running_compatible)
+        in_schema = plan.children[0].schema
+        if conf.get(WINDOW_BATCHED_RUNNING) and \
+                running_compatible(plan.window_exprs, in_schema):
+            # running-only windows stream batch-at-a-time over a sorted
+            # child with carried state (GpuRunningWindowExec role)
+            spec = plan.window_exprs[0][0].spec
+            orders = ([SortOrder(e, True, True)
+                       for e in spec.partition_by] +
+                      [SortOrder(o.expr, o.ascending, o.nulls_first)
+                       for o in spec.order_fields])
+            sorted_child = SortExec(children[0], orders)
+            return BatchedRunningWindowExec(sorted_child,
+                                            plan.window_exprs)
         return WindowExec(children[0], plan.window_exprs)
     from .logical import Generate
     if isinstance(plan, Generate):

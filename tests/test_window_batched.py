@@ -1,0 +1,94 @@
+"""Batched running windows (exec/window.py BatchedRunningWindowExec):
+carried-state fixup across batch boundaries vs the whole-partition
+WindowExec oracle."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.conf import SrtConf
+from spark_rapids_tpu.expr import col
+from spark_rapids_tpu.expr.aggregates import Average, Count, Max, Min, Sum
+from spark_rapids_tpu.expr.window import (DenseRank, Rank, RowNumber,
+                                          Window, WindowFrame)
+from spark_rapids_tpu.plan import TpuSession, overrides
+
+ROWS_RUNNING = WindowFrame(None, 0, row_based=True)
+
+
+def _select(df):
+    w = Window.partition_by("k").order_by("o").with_frame(ROWS_RUNNING)
+    return df.select(
+        "k", "o", "v",
+        RowNumber().over(w).alias("rn"),
+        Rank().over(w).alias("rk"),
+        DenseRank().over(w).alias("dr"),
+        Sum(col("v")).over(w).alias("s"),
+        Min(col("v")).over(w).alias("mn"),
+        Max(col("v")).over(w).alias("mx"),
+        Count(col("v")).over(w).alias("c"),
+        Average(col("v")).over(w).alias("av"))
+
+
+def _data(n, n_keys, seed=0):
+    rng = np.random.default_rng(seed)
+    ks = rng.integers(0, n_keys, n)
+    os_ = rng.integers(0, 6, n)
+    vs = rng.uniform(0, 10, n)
+    vlist = [None if i % 5 == 0 else float(v)
+             for i, v in enumerate(vs)]
+    return {"k": ks.tolist(), "o": os_.tolist(), "v": vlist}
+
+
+def _run(data, conf):
+    s = TpuSession(conf)
+    q = _select(s.create_dataframe(dict(data)))
+    rows = q.collect()
+    return sorted(rows, key=lambda r: (r["k"], r["o"], r["rn"]))
+
+
+def test_batched_matches_whole_partition():
+    data = _data(3000, 7, seed=1)
+    small = SrtConf({"srt.sql.batchSizeRows": 256,
+                     "srt.sql.window.batchedRunning.enabled": True})
+    off = SrtConf({"srt.sql.window.batchedRunning.enabled": False})
+    rows_b = _run(data, small)
+    rows_w = _run(data, off)
+    assert len(rows_b) == len(rows_w)
+    for a, b in zip(rows_b, rows_w):
+        for k in ("k", "o", "rn", "rk", "dr", "s", "mn", "mx", "c",
+                  "av"):
+            va, vb = a[k], b[k]
+            if isinstance(va, float) and vb is not None:
+                assert va == pytest.approx(vb, rel=1e-12), (k, a, b)
+            else:
+                assert va == vb, (k, a, b)
+
+
+def test_planner_picks_batched_exec():
+    conf = SrtConf({})
+    s = TpuSession(conf)
+    df = s.create_dataframe(_data(50, 3))
+    q = _select(df)
+    tree = overrides.apply_overrides(q.plan, conf).tree_string()
+    assert "BatchedRunningWindow" in tree and "Sort" in tree, tree
+    # RANGE frames keep the whole-partition exec
+    w_range = Window.partition_by("k").order_by("o").with_frame(
+        WindowFrame(None, 0, row_based=False))
+    q2 = df.select("k", Sum(col("v")).over(w_range).alias("s"))
+    tree2 = overrides.apply_overrides(q2.plan, conf).tree_string()
+    assert "BatchedRunningWindow" not in tree2, tree2
+
+
+def test_single_partition_spanning_all_batches():
+    """One partition split across many batches: the pure carried-state
+    regime."""
+    n = 1000
+    data = {"k": [1] * n, "o": list(range(n)),
+            "v": [float(i % 13) for i in range(n)]}
+    conf = SrtConf({"srt.sql.batchSizeRows": 64})
+    rows = _run(data, conf)
+    assert [r["rn"] for r in rows] == list(range(1, n + 1))
+    assert [r["rk"] for r in rows] == list(range(1, n + 1))
+    run_sum = np.cumsum([float(i % 13) for i in range(n)])
+    got = [r["s"] for r in rows]
+    assert got == pytest.approx(run_sum.tolist())
