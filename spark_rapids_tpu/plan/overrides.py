@@ -893,13 +893,45 @@ def tag_only(plan: LogicalPlan) -> PlanMeta:
 # --- supported-ops doc-gen (TypeChecks.scala doc generation) ---------------
 
 def generate_supported_ops_doc() -> str:
-    lines = ["# Supported expressions on TPU", "",
-             "| Expression | Supported input types |", "|---|---|"]
+    """Reference-style per-op support matrices (TypeChecks doc-gen ->
+    docs/supported_ops.md): one row per expression, one column per type
+    tag. The cells come straight from each registered rule's TypeSig —
+    the SAME object the tagging pass enforces at plan time, so the doc
+    cannot over-promise relative to the planner."""
+    tags = ts.ALL_TAGS
+    short = {ts.BOOLEAN: "BOOL", ts.BYTE: "I8", ts.SHORT: "I16",
+             ts.INT: "I32", ts.LONG: "I64", ts.FLOAT: "F32",
+             ts.DOUBLE: "F64", ts.STRING: "STR", ts.DATE: "DATE",
+             ts.TIMESTAMP: "TS", ts.DECIMAL_64: "DEC64",
+             ts.DECIMAL_128: "DEC128", ts.NULL: "NULL",
+             ts.ARRAY: "ARR", ts.STRUCT: "STRUCT", ts.MAP: "MAP"}
+    header = "| Expression | " + " | ".join(short[t] for t in tags) + " |"
+    sep = "|---" * (len(tags) + 1) + "|"
+    lines = [
+        "# Supported ops on TPU", "",
+        "Generated from the expression/exec rule registries "
+        "(`spark_rapids_tpu/plan/overrides.py`) — do not edit. The "
+        "matrices render the exact TypeSig objects the tagging pass "
+        "enforces, so plan-time behavior and this document cannot "
+        "diverge.", "",
+        "`S` = supported input type on device; `NS` = the containing "
+        "operator falls back to the CPU engine for that input type.",
+        "", "## Expressions", "", header, sep]
     for cls in sorted(_EXPR_RULES, key=lambda c: c.__name__):
         rule = _EXPR_RULES[cls]
-        lines.append(f"| {cls.__name__} | "
-                     f"{', '.join(sorted(rule.sig.tags))} |")
-    lines += ["", "# Supported operators on TPU", ""]
+        cells = [" S " if t in rule.sig.tags else "NS" for t in tags]
+        lines.append(f"| {cls.__name__} | " + " | ".join(cells) + " |")
+    lines += [
+        "", "## Operators", "",
+        "Column types flowing THROUGH an operator follow "
+        "`device_type_ok` (all basic types + decimal128; arrays and "
+        "structs of those through project/filter/generate; maps on "
+        "CPU). Operator-specific key restrictions are tagged at plan "
+        "time (e.g. no nested/decimal128 group-by or join keys).", "",
+        "| Operator | Notes |", "|---|---|"]
     for cls in sorted(_EXEC_RULES, key=lambda c: c.__name__):
-        lines.append(f"- {cls.__name__}")
+        rule = _EXEC_RULES[cls]
+        desc = (rule.description or (cls.__doc__ or "").strip()
+                .split("\n")[0])
+        lines.append(f"| {cls.__name__} | {desc} |")
     return "\n".join(lines) + "\n"
