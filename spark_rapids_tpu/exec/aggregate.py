@@ -325,6 +325,7 @@ class HashAggregateExec(TpuExec):
         from .exchange import ShuffleExchangeExec
         child = self.children[0]
         if ctx.conf.get(ADAPTIVE_ENABLED) and \
+                ctx.cluster is None and \
                 not self.preserve_partitioning and \
                 isinstance(child, ShuffleExchangeExec):
             counts = child.materialized_row_counts(ctx)
@@ -354,7 +355,10 @@ class HashAggregateExec(TpuExec):
                 for out in self._merge_partition(ctx, part, agg_time):
                     saw_any = True
                     yield out
-            if not saw_any and not self.group_exprs:
+            if not saw_any and not self.group_exprs and \
+                    (ctx.cluster is None or ctx.cluster.owns_first()):
+                # cluster mode: exactly ONE worker emits the global
+                # empty-input row (count()=0, sum()=null)
                 yield self._empty_global_result()
             return
         # COMPLETE: partial + merge fused in one stage

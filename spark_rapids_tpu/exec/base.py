@@ -195,6 +195,9 @@ class ExecContext:
         #: (spark_partition_id / monotonically_increasing_id); operators
         #: that stream one partition at a time set this while iterating
         self.partition_id = 0
+        #: multi-host execution context (parallel/cluster.py
+        #: ClusterTaskContext); None = single-process run
+        self.cluster = None
 
     def metrics_for(self, exec_id: str) -> Dict[str, Metric]:
         return self.metrics.setdefault(exec_id, {})

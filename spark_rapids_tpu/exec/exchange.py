@@ -146,10 +146,10 @@ class ShuffleExchangeExec(TpuExec):
 
     # --- range bounds (GpuRangePartitioner.sketch: sample to the
     # driver, sort, take quantile bounds) ---
-    def _compute_bounds(self, ctx: ExecContext,
-                        batches: List[ColumnarBatch], num_parts: int):
-        """Sample the buffered child, return per-key bound Columns with
-        (num_parts - 1) rows, device-resident."""
+    def _sample_rows(self, ctx: ExecContext,
+                     batches: List[ColumnarBatch],
+                     num_parts: int) -> List[tuple]:
+        """Host-side sample row tuples of the sort keys."""
         orders = self.sort_orders
         per_batch = max(1, (num_parts * 40) // max(len(batches), 1))
         samples: List[tuple] = []  # row tuples of physical values
@@ -169,6 +169,22 @@ class ShuffleExchangeExec(TpuExec):
                 samples.append(tuple(
                     (None if not cols[k][1][i] else cols[k][0][i])
                     for k in range(len(orders))))
+        return samples
+
+    def _compute_bounds(self, ctx: ExecContext,
+                        batches: List[ColumnarBatch], num_parts: int):
+        """Sample the buffered child, return per-key bound Columns with
+        (num_parts - 1) rows, device-resident. Under a cluster context
+        the local sketch all-gathers through the driver first
+        (GpuRangePartitioner.sketch sends samples to the driver), so
+        every worker derives IDENTICAL bounds and range partitions stay
+        globally consistent."""
+        orders = self.sort_orders
+        samples = self._sample_rows(ctx, batches, num_parts)
+        if ctx.cluster is not None:
+            gathered = ctx.cluster.gather(("bounds", self.shuffle_id),
+                                          samples)
+            samples = [t for lst in gathered if lst for t in lst]
         if not samples:
             samples = [tuple(None for _ in orders)]
 
@@ -339,10 +355,27 @@ class ShuffleExchangeExec(TpuExec):
         """One iterator per reduce partition, in partition order.
         AQE coalescing is CONSUMER-driven (execute_partition_groups):
         a consumer with two partitioned inputs must apply the SAME
-        grouping to both, so the exchange never groups on its own."""
+        grouping to both, so the exchange never groups on its own.
+
+        Under a cluster context (parallel/cluster.py), the map side
+        writes LOCAL blocks, a driver barrier makes every worker's maps
+        visible, and only this worker's contiguous block of reduce
+        partitions streams back — each partition fetched from ALL peers
+        over the shuffle transport (RapidsShuffleIterator role)."""
         mgr = self.manager or shuffle_manager()
         self._write(ctx)
         n_parts = mgr.num_partitions(self.shuffle_id)
+        if ctx.cluster is not None:
+            from ..parallel.transport import fetch_all_partitions
+            ctx.cluster.barrier(self.shuffle_id)
+            peers = ctx.cluster.peers
+
+            def remote_read(reduce_id):
+                yield from fetch_all_partitions(peers, self.shuffle_id,
+                                                reduce_id)
+            for reduce_id in ctx.cluster.assigned(n_parts):
+                yield remote_read(reduce_id)
+            return
         try:
             for reduce_id in range(n_parts):
                 yield mgr.read_partition(self.shuffle_id, reduce_id)
