@@ -358,6 +358,21 @@ FILECACHE_LOCAL_FS = conf("srt.filecache.useForLocalFiles") \
          "for slow network mounts that look local).") \
     .boolean(False)
 
+EXTRA_PLUGINS = conf("srt.plugins") \
+    .doc("Comma-separated 'pkg.module:attr' entries loaded at "
+         "initialize: each attr is called with the active conf "
+         "(spark.rapids.sql.plugins / RapidsPluginUtils "
+         "loadExtraPlugins role).") \
+    .string("")
+
+LEAK_DETECTION = conf("srt.memory.leakDetection.enabled") \
+    .doc("Track the creation stack of every SpillableBatch and report "
+         "entries still registered at shutdown/reset "
+         "(MemoryCleaner/RapidsBufferCatalog leak-detection role). "
+         "Adds per-allocation traceback capture cost; test/debug "
+         "tool.") \
+    .boolean(False)
+
 WINDOW_BATCHED_RUNNING = conf("srt.sql.window.batchedRunning.enabled") \
     .doc("Stream running-frame window functions (rank family, ROWS "
          "unbounded-preceding..current-row aggregates) batch-at-a-time "

@@ -303,3 +303,52 @@ class CoalesceBatchesExec(TpuExec):
 
     def node_description(self) -> str:
         return f"CoalesceBatches[target={self.target_rows or 'conf'}]"
+
+
+def sample_keep_mask(row_offset, capacity: int, fraction: float,
+                     seed: int):
+    """Deterministic Bernoulli keep-mask: murmur3 of the stream-global
+    row position under ``seed`` compared against fraction * 2^32. The
+    SAME function drives the device exec and the CPU engine, so
+    fallback sampling is bit-identical (GpuSampleExec role)."""
+    from ..columnar import dtypes as dt_
+    from ..expr import hashing as H
+    pos = jnp.arange(capacity, dtype=jnp.int64) + jnp.int64(row_offset)
+    col = ColumnVector(pos, jnp.ones(capacity, jnp.bool_), dt_.INT64)
+    h = H.murmur3_column(col, jnp.uint32(seed))
+    threshold = jnp.uint32(min(int(fraction * (1 << 32)), (1 << 32) - 1))
+    if fraction >= 1.0:
+        return jnp.ones(capacity, jnp.bool_)
+    return h < threshold
+
+
+class SampleExec(TpuExec):
+    """WHERE-style Bernoulli sampling by position hash (GpuSampleExec,
+    basicPhysicalOperators.scala)."""
+
+    def __init__(self, child: TpuExec, fraction: float, seed: int):
+        super().__init__(child)
+        self.fraction = fraction
+        self.seed = seed
+        self._jit = jax.jit(self._sample)
+
+    def _sample(self, batch: ColumnarBatch, row_offset):
+        keep = sample_keep_mask(row_offset, batch.capacity,
+                                self.fraction, self.seed)
+        cond = ColumnVector(keep, jnp.ones_like(keep), dt.BOOL)
+        return K.filter_batch(batch, cond)
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        offset = 0
+        for batch in self.children[0].execute(ctx):
+            with ctx.semaphore:
+                out = self._jit(batch, jnp.int64(offset))
+            offset += int(batch.num_rows)
+            yield out
+
+    def node_description(self) -> str:
+        return f"Sample[{self.fraction}, seed={self.seed}]"

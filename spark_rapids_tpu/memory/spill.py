@@ -131,7 +131,8 @@ class SpillableBatch:
 
     __slots__ = ("_batch", "_host", "_pooled", "_treedef", "_path",
                  "_nbytes", "priority", "_lock", "_catalog", "handle",
-                 "closed", "_scalars", "_nleaves", "_num_rows")
+                 "closed", "_scalars", "_nleaves", "_num_rows",
+                 "creation_stack")
 
     def __init__(self, batch: ColumnarBatch,
                  priority: SpillPriority = SpillPriority.ACTIVE_ON_DECK,
@@ -148,6 +149,10 @@ class SpillableBatch:
         self.priority = priority
         self._lock = threading.Lock()
         self.closed = False
+        self.creation_stack: Optional[str] = None
+        if self._catalog.leak_detection:
+            import traceback
+            self.creation_stack = "".join(traceback.format_stack(limit=12))
         self.handle = self._catalog.register(self)
 
     @property
@@ -307,6 +312,8 @@ class SpillCatalog:
         self._next = 0
         self._lock = threading.Lock()
         self.host_pool = None
+        from ..conf import LEAK_DETECTION
+        self.leak_detection = conf.get(LEAK_DETECTION)
         from ..native import native_available
         if native_available():
             from ..native import HostMemoryPool
@@ -378,6 +385,30 @@ class SpillCatalog:
             if used <= self.host_limit:
                 break
             used -= e.spill_to_disk()
+
+    def leak_report(self) -> List[dict]:
+        """Entries still registered — each is a leaked (never-closed)
+        spillable. With srt.memory.leakDetection.enabled the creation
+        stack pinpoints the owner (MemoryCleaner.scala role: the
+        reference dumps leaked RapidsBuffers at executor shutdown)."""
+        with self._lock:
+            entries = list(self._entries.values())
+        return [{"handle": e.handle, "tier": e.tier,
+                 "nbytes": e.nbytes, "priority": int(e.priority),
+                 "creation_stack": e.creation_stack}
+                for e in entries if not e.closed]
+
+    def log_leaks(self) -> int:
+        import logging
+        leaks = self.leak_report()
+        log = logging.getLogger("spark_rapids_tpu.memory")
+        for lk in leaks:
+            log.warning(
+                "LEAKED SpillableBatch handle=%s tier=%s bytes=%d%s",
+                lk["handle"], lk["tier"], lk["nbytes"],
+                ("\n" + lk["creation_stack"])
+                if lk["creation_stack"] else "")
+        return len(leaks)
 
     def stats(self) -> Dict[str, int]:
         with self._lock:

@@ -30,6 +30,8 @@ from __future__ import annotations
 from typing import List, Optional, Sequence
 
 import jax
+
+from .. import shims as _shims
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
@@ -184,6 +186,6 @@ def distributed_aggregate(agg_exec, mesh: Mesh,
         return _expand_shard(out)
 
     return jax.jit(
-        jax.shard_map(shard_step, mesh=mesh,
+        _shims.shard_map()(shard_step, mesh=mesh,
                       in_specs=P(DATA_AXIS), out_specs=P(DATA_AXIS),
                       check_vma=False))

@@ -1940,6 +1940,44 @@ def _register_bloom():
 _register_bloom()
 
 
+def _register_device_identical():
+    """Expressions whose semantics ARE a deterministic jnp chain (hash
+    functions, date truncation): the CPU engine evaluates the device
+    kernel over host-built columns, so fallback is bit-identical and
+    there is no second implementation to drift."""
+    from ..columnar.vector import ColumnarBatch, column_from_numpy
+    from ..expr.datetime import TruncDate
+    from ..expr.hashing import Murmur3Hash, XxHash64
+
+    def _device_eval(expr, table):
+        import copy
+        schema = table.schema()
+        n = table.num_rows
+        cap = max(n, 1)
+        cols, names = [], []
+        for i, c in enumerate(expr.children):
+            v, m = _ev(c, table)
+            cols.append(column_from_numpy(np.asarray(v), cap,
+                                          dtype=c.data_type(schema),
+                                          mask=m))
+            names.append(f"a{i}")
+        batch = ColumnarBatch(cols, names, n)
+        # rebind child refs positionally so expr.eval sees our columns
+        clone = copy.copy(expr)
+        clone.children = [E.col(f"a{i}")
+                          for i in range(len(expr.children))]
+        out = clone.eval(batch)
+        vals = np.asarray(out.data)[:n]
+        mask = np.asarray(out.validity)[:n]
+        return vals, mask
+
+    for cls in (Murmur3Hash, XxHash64, TruncDate):
+        _EVALUATORS[cls] = _device_eval
+
+
+_register_device_identical()
+
+
 # ---------------------------------------------------------------------------
 # bitwise
 # ---------------------------------------------------------------------------

@@ -70,6 +70,15 @@ def apply_cpu_node(plan: LogicalPlan,
     if isinstance(plan, Limit):
         child = children[0]
         return child.take(np.arange(min(plan.n, child.num_rows)))
+    from .logical import Sample
+    if isinstance(plan, Sample):
+        # the device exec's exact position-hash (bit-identical fallback)
+        from ..exec.basic import sample_keep_mask
+        child = children[0]
+        n = child.num_rows
+        keep = np.asarray(sample_keep_mask(0, max(n, 1), plan.fraction,
+                                           plan.seed))[:n]
+        return child.select_rows(keep)
     if isinstance(plan, Union):
         return concat_tables([_normalize(c, [n for n, _ in plan.schema])
                               for c in children])

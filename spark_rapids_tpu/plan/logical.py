@@ -232,6 +232,27 @@ class Limit(LogicalPlan):
         return f"Limit[{self.n}]"
 
 
+class Sample(LogicalPlan):
+    """Bernoulli sample by deterministic position hash (GpuSampleExec
+    role; same hash on device and CPU engine, so fallback is
+    bit-identical)."""
+
+    def __init__(self, child: LogicalPlan, fraction: float,
+                 seed: int = 42):
+        super().__init__(child)
+        if not 0.0 <= fraction <= 1.0:
+            raise ValueError("sample fraction must be in [0, 1]")
+        self.fraction = fraction
+        self.seed = seed
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def node_description(self) -> str:
+        return f"Sample[{self.fraction}, seed={self.seed}]"
+
+
 class Union(LogicalPlan):
     def __init__(self, *children: LogicalPlan):
         super().__init__(*children)

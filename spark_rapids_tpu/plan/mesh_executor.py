@@ -101,7 +101,8 @@ class MeshQueryExecutor:
             out = fn(env)
             return jax.tree_util.tree_map(lambda x: x[None], out)
 
-        step = jax.jit(jax.shard_map(
+        from ..shims import shard_map as _shard_map
+        step = jax.jit(_shard_map()(
             shard_step, mesh=self.mesh,
             in_specs=tuple(P(self.axis) for _ in range(n_leaves)),
             out_specs=P(self.axis), check_vma=False))

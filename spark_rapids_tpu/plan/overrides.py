@@ -38,7 +38,8 @@ from ..expr import predicates as P
 from ..expr import strings as S
 from . import cpu_eval, typechecks as ts
 from .logical import (Aggregate, Expand, Filter, Join, Limit, LocalRelation,
-                      LogicalPlan, Project, Range, Sort, Union, Window)
+                      LogicalPlan, Project, Range, Sample, Sort, Union,
+                      Window)
 from .meta import ExprMeta, PlanMeta
 from .transitions import (CpuPhysical, DeviceToHostBridge, HostToDeviceExec)
 
@@ -550,6 +551,7 @@ def _register_exec_rules():
         Union: ExecRule(Union, _no_nested_inputs("union")),
         Expand: ExecRule(Expand, _no_nested_inputs("expand")),
         Sort: ExecRule(Sort, _tag_sort),
+        Sample: ExecRule(Sample),
         Aggregate: ExecRule(Aggregate, _tag_agg),
         Join: ExecRule(Join, _tag_join_all),
         Window: ExecRule(Window, _tag_window),
@@ -574,6 +576,9 @@ def _build_tpu_exec(plan: LogicalPlan, children: List[TpuExec],
     if isinstance(plan, (LocalRelation, Range)) :
         # host-resident leaves enter the device through the transition
         return HostToDeviceExec(CpuPhysical(plan, []))
+    if isinstance(plan, Sample):
+        from ..exec.basic import SampleExec
+        return SampleExec(children[0], plan.fraction, plan.seed)
     if isinstance(plan, Project):
         from ..udf.pandas_udf import extract_pandas_udfs
         exprs, pyudfs = extract_pandas_udfs(plan.exprs)

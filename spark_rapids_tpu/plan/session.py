@@ -284,6 +284,10 @@ class DataFrame:
     def limit(self, n: int) -> "DataFrame":
         return DataFrame(self.session, L.Limit(self.plan, n))
 
+    def sample(self, fraction: float, seed: int = 42) -> "DataFrame":
+        return DataFrame(self.session, L.Sample(self.plan, fraction,
+                                                seed))
+
     def union(self, other: "DataFrame") -> "DataFrame":
         return DataFrame(self.session, L.Union(self.plan, other.plan))
 

@@ -92,6 +92,9 @@ def initialize(conf_obj: Optional[SrtConf] = None) -> DeviceInfo:
                           device_kind=getattr(dev, "device_kind", "?"),
                           num_local_devices=len(devices),
                           hbm_bytes=hbm)
+        from .shims import load_extra_plugins
+        _STATE["extra_plugins"] = load_extra_plugins(conf_obj
+                                                     or active_conf())
         _STATE["initialized"] = True
         _STATE["info"] = info
         log.info("spark_rapids_tpu initialized: %s", info)
@@ -100,6 +103,13 @@ def initialize(conf_obj: Optional[SrtConf] = None) -> DeviceInfo:
 
 def shutdown() -> None:
     with _LOCK:
+        from .memory.spill import _CATALOG
+        if _CATALOG is not None:
+            n = _CATALOG.log_leaks()
+            if n:
+                log.warning("%d spillable batches leaked (enable "
+                            "srt.memory.leakDetection.enabled for "
+                            "creation stacks)", n)
         _STATE["initialized"] = False
         _STATE["info"] = None
 

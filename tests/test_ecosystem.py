@@ -89,3 +89,49 @@ def test_ml_export_device_arrays(session):
     assert isinstance(f1, jax.Array)
     assert np.asarray(f1)[:3].tolist() == [1.0, 2.0, 3.0]
     assert np.asarray(f1_valid)[:3].all()
+
+
+def test_api_validation_no_orphans():
+    """tools/api_check.py (api_validation role): every declared
+    expression is planner-reachable."""
+    import subprocess
+    import sys
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "api_check.py"),
+         "--strict"], env=env, capture_output=True, text=True)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_shim_registry_resolves_shard_map():
+    from spark_rapids_tpu.shims import SHIMS, shard_map
+    fn = shard_map()
+    assert callable(fn)
+    # resolution is cached
+    assert shard_map() is fn
+    # unknown capability raises with diagnostics
+    import pytest
+    with pytest.raises(ImportError, match="no shim"):
+        SHIMS.resolve("does_not_exist")
+
+
+def test_extra_plugin_loader(tmp_path, monkeypatch):
+    import sys
+
+    from spark_rapids_tpu.conf import SrtConf
+    from spark_rapids_tpu.shims import load_extra_plugins
+    mod = tmp_path / "my_srt_plugin.py"
+    mod.write_text(
+        "LOADED = []\n"
+        "def init_plugin(conf):\n"
+        "    LOADED.append(conf.get_raw('srt.sql.enabled')\n"
+        "                  if hasattr(conf, 'get_raw') else True)\n"
+        "    return 'plugin-object'\n")
+    monkeypatch.syspath_prepend(str(tmp_path))
+    conf = SrtConf({"srt.plugins": "my_srt_plugin:init_plugin"})
+    out = load_extra_plugins(conf)
+    assert out == ["plugin-object"]
+    import my_srt_plugin
+    assert my_srt_plugin.LOADED

@@ -306,3 +306,25 @@ def test_collect_list_multi_batch(session):
     df = s.read.parquet(os.path.join(d, "t"))
     assert_tpu_cpu_equal_df(df.group_by(col("k")).agg(
         CollectList(col("v")).alias("vals")))
+
+
+def test_sample_exec():
+    """Deterministic position-hash Bernoulli sampling (GpuSampleExec
+    role): stable across runs, batch-size independent, fraction
+    approximately honored."""
+    import numpy as np
+
+    from spark_rapids_tpu.conf import SrtConf
+    from spark_rapids_tpu.plan.session import TpuSession
+    s1 = TpuSession(SrtConf({}))
+    df = s1.create_dataframe({"v": list(range(20_000))})
+    a = df.sample(0.3, seed=11).to_pydict()["v"]
+    assert a == df.sample(0.3, seed=11).to_pydict()["v"]
+    assert abs(len(a) / 20_000 - 0.3) < 0.02
+    # batch-size independent: global position hash, not per-batch RNG
+    s2 = TpuSession(SrtConf({"srt.sql.batchSizeRows": 512}))
+    df2 = s2.create_dataframe({"v": list(range(20_000))})
+    b = df2.sample(0.3, seed=11).to_pydict()["v"]
+    assert a == b
+    assert df.sample(0.0, seed=1).collect() == []
+    assert len(df.sample(1.0, seed=1).to_pydict()["v"]) == 20_000

@@ -186,3 +186,35 @@ def test_host_tier_uses_native_pool():
         s.close()
     assert cat.host_pool.stats()["in_use"] == 0
     reset_spill_catalog()
+
+
+def test_leak_detection_report():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from spark_rapids_tpu.columnar import dtypes as dt
+    from spark_rapids_tpu.columnar.vector import ColumnarBatch, ColumnVector
+    from spark_rapids_tpu.conf import SrtConf, set_active_conf
+    from spark_rapids_tpu.memory.budget import MemoryBudget
+    from spark_rapids_tpu.memory.spill import (SpillableBatch,
+                                               reset_spill_catalog)
+    set_active_conf(SrtConf({"srt.memory.leakDetection.enabled": True}))
+    try:
+        cat = reset_spill_catalog(budget=MemoryBudget(1 << 30))
+        col = ColumnVector(jnp.zeros(8), jnp.ones(8, jnp.bool_),
+                           dt.FLOAT64)
+        leaked = SpillableBatch(ColumnarBatch([col], ["v"], 8),
+                                catalog=cat)
+        closed = SpillableBatch(ColumnarBatch([col], ["v"], 8),
+                                catalog=cat)
+        closed.close()
+        report = cat.leak_report()
+        assert len(report) == 1
+        assert report[0]["handle"] == leaked.handle
+        assert "test_leak_detection_report" in report[0]["creation_stack"]
+        assert cat.log_leaks() == 1
+        leaked.close()
+        assert cat.leak_report() == []
+    finally:
+        set_active_conf(SrtConf({}))
+        reset_spill_catalog()

@@ -1,0 +1,93 @@
+"""API validation tool (SURVEY §2.8 component 91: the reference's
+api_validation module cross-checks plugin coverage against the Spark
+API surface).
+
+Here the contract is internal-consistency: every Expression subclass
+the package defines must be reachable by the planner — either a
+registered device rule (plan/overrides.py ExprRule) or a CPU-engine
+evaluator (plan/cpu_eval.py), and ideally both (device rule without a
+CPU evaluator breaks fallback). Run:
+
+    python tools/api_check.py          # report
+    python tools/api_check.py --strict # non-zero exit on gaps
+"""
+import importlib
+import inspect
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+EXPR_MODULES = [
+    "spark_rapids_tpu.expr.arithmetic", "spark_rapids_tpu.expr.bitwise",
+    "spark_rapids_tpu.expr.cast", "spark_rapids_tpu.expr.collections",
+    "spark_rapids_tpu.expr.conditional", "spark_rapids_tpu.expr.core",
+    "spark_rapids_tpu.expr.datetime", "spark_rapids_tpu.expr.hashing",
+    "spark_rapids_tpu.expr.json", "spark_rapids_tpu.expr.mathfns",
+    "spark_rapids_tpu.expr.misc", "spark_rapids_tpu.expr.predicates",
+    "spark_rapids_tpu.expr.strings", "spark_rapids_tpu.expr.timezone",
+    "spark_rapids_tpu.expr.aggregates", "spark_rapids_tpu.expr.window",
+]
+
+# declared-abstract/base/marker classes with no standalone evaluation
+EXEMPT = {
+    "Expression", "BinaryArithmetic", "_AddSubBase", "BinaryComparison",
+    "AggregateFunction", "WindowFunction", "WindowExpression",
+    "_MinMaxBase", "_M2Base", "_InputFileBlock", "_EagerExpression",
+    "_Decimal128SumMixin",
+}
+
+
+def collect():
+    from spark_rapids_tpu.expr.core import Expression
+    from spark_rapids_tpu.plan import cpu_eval, overrides
+    declared = {}
+    for mod_name in EXPR_MODULES:
+        mod = importlib.import_module(mod_name)
+        for name, obj in vars(mod).items():
+            if inspect.isclass(obj) and issubclass(obj, Expression) \
+                    and obj.__module__ == mod_name \
+                    and name not in EXEMPT \
+                    and not name.startswith("_"):  # impl base classes
+                declared[f"{mod_name.rsplit('.', 1)[1]}.{name}"] = obj
+    from spark_rapids_tpu.expr.aggregates import AggregateFunction
+    from spark_rapids_tpu.expr.window import WindowFunction
+    device = set()
+    cpu = set()
+    for key, cls in declared.items():
+        if overrides.expr_rule_for(cls) is not None:
+            device.add(key)
+        if cls in cpu_eval._EVALUATORS:
+            cpu.add(key)
+        # aggregates, window functions, and generators evaluate
+        # through dedicated exec machinery (cpu_exec.py), not the
+        # scalar evaluator registries
+        from spark_rapids_tpu.expr.collections import Explode
+        if issubclass(cls, (AggregateFunction, WindowFunction, Explode)):
+            cpu.add(key)
+    return declared, device, cpu
+
+
+def main(strict: bool = False) -> int:
+    declared, device, cpu = collect()
+    orphans = sorted(k for k in declared if k not in device
+                     and k not in cpu)
+    device_only = sorted(k for k in declared
+                         if k in device and k not in cpu)
+    print(f"expressions declared: {len(declared)}")
+    print(f"  with device rule:   {len(device)}")
+    print(f"  with CPU evaluator: {len(cpu)}")
+    if device_only:
+        print(f"\ndevice rule but NO CPU fallback ({len(device_only)}):")
+        for k in device_only:
+            print(f"  - {k}")
+    if orphans:
+        print(f"\nORPHANS — unreachable by the planner ({len(orphans)}):")
+        for k in orphans:
+            print(f"  - {k}")
+    return 1 if strict and orphans else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(strict="--strict" in sys.argv))
