@@ -42,15 +42,19 @@ from .arrow_convert import arrow_schema_to_schema, arrow_to_host_table
 FORMATS = ("parquet", "orc", "csv", "json", "avro", "hivetext")
 
 
-def expand_paths(path_or_paths, conf=None) -> List[str]:
+def _rewritten_roots(path_or_paths, conf=None) -> List[str]:
     from .filecache import rewrite_uri
     raw = ([path_or_paths] if isinstance(path_or_paths, str)
            else list(path_or_paths))
     from ..conf import URI_REWRITE_RULES, active_conf
     rules = (conf or active_conf()).get(URI_REWRITE_RULES)
     paths = [rewrite_uri(p, rules) for p in raw]
-    paths = [p[len("file://"):] if p.startswith("file://") else p
-             for p in paths]
+    return [p[len("file://"):] if p.startswith("file://") else p
+            for p in paths]
+
+
+def expand_paths(path_or_paths, conf=None) -> List[str]:
+    paths = _rewritten_roots(path_or_paths, conf)
     out: List[str] = []
     for p in paths:
         if os.path.isdir(p):
@@ -62,6 +66,61 @@ def expand_paths(path_or_paths, conf=None) -> List[str]:
         else:
             out.append(p)
     return out
+
+
+HIVE_NULL_PART = "__HIVE_DEFAULT_PARTITION__"
+
+
+def discover_partitions(roots: List[str], files: List[str]):
+    """Hive-style key=value directory partitioning (the reference reads
+    these through Spark's PartitioningAwareFileIndex; partition columns
+    surface as constant columns per file, SURVEY §2.6).
+
+    Returns (partition_schema, per-file value dicts) with types inferred
+    int64 -> float64 -> string like Spark's partition inference."""
+    from urllib.parse import unquote
+    values: List[dict] = []
+    key_order: List[str] = []
+    for f in files:
+        root = next((r for r in roots
+                     if f.startswith(r.rstrip(os.sep) + os.sep)), None)
+        vals = {}
+        if root is not None:
+            rel = os.path.relpath(f, root)
+            for seg in rel.split(os.sep)[:-1]:
+                if "=" in seg:
+                    k, _, v = seg.partition("=")
+                    v = unquote(v)
+                    vals[k] = None if v == HIVE_NULL_PART else v
+                    if k not in key_order:
+                        key_order.append(k)
+        values.append(vals)
+    if not key_order:
+        return [], values
+
+    def infer(strs):
+        present = [v for v in strs if v is not None]
+        try:
+            for v in present:
+                int(v)
+            return dt.INT64, int
+        except ValueError:
+            pass
+        try:
+            for v in present:
+                float(v)
+            return dt.FLOAT64, float
+        except ValueError:
+            return dt.STRING, str
+    schema = []
+    for k in key_order:
+        col = [v.get(k) for v in values]
+        t, conv = infer(col)
+        for d in values:
+            if k in d and d[k] is not None:
+                d[k] = conv(d[k])
+        schema.append((k, t))
+    return schema, values
 
 
 def infer_file_schema(path: str, fmt: str, options: dict) -> pa.Schema:
@@ -140,6 +199,8 @@ class FileScan(LogicalPlan):
         self.fmt = fmt
         self.options = options or {}
         self.pushed_filter = pushed_filter
+        self.partition_schema, self._part_values = discover_partitions(
+            _rewritten_roots(paths, conf), self.paths)
         if schema is None:
             if fmt == "avro":
                 from .avro import infer_avro_schema
@@ -148,7 +209,78 @@ class FileScan(LogicalPlan):
                 arrow_schema = infer_file_schema(self.paths[0], fmt,
                                                  self.options)
                 schema = arrow_schema_to_schema(arrow_schema)
+            names = [n for n, _ in schema]
+            schema = list(schema) + [(k, t) for k, t in
+                                     self.partition_schema
+                                     if k not in names]
         self._schema = list(schema)
+
+    def partition_values_for(self, path: str) -> dict:
+        try:
+            return self._part_values[self.paths.index(path)]
+        except (ValueError, IndexError):
+            return {}
+
+    def pruned_paths(self) -> List[str]:
+        """Static partition pruning: pushed-filter conjuncts that
+        reference ONLY partition columns evaluate per file on its
+        partition values; non-passing files never open (the
+        PartitionPruning role; runtime row-level pruning is the join
+        bloom filter in exec/join.py)."""
+        if self.pushed_filter is None or not self.partition_schema:
+            return self.paths
+        import numpy as np
+
+        from ..expr import predicates as P
+        from ..plan import cpu_eval
+        from ..plan.host_table import HostColumn, HostTable
+        part_names = {k for k, _ in self.partition_schema}
+
+        def conjuncts(e):
+            if isinstance(e, P.And):
+                return conjuncts(e.children[0]) + conjuncts(e.children[1])
+            return [e]
+
+        def refs(e, out):
+            from ..expr import core as E_
+            if isinstance(e, E_.ColumnRef):
+                out.add(e.name)
+            for c in e.children:
+                refs(c, out)
+            return out
+
+        applicable = [c for c in conjuncts(self.pushed_filter)
+                      if refs(c, set()) and refs(c, set()) <= part_names]
+        if not applicable:
+            return self.paths
+        keep = []
+        for path, vals in zip(self.paths, self._part_values):
+            cols, names = [], []
+            for k, t in self.partition_schema:
+                v = vals.get(k)
+                mask = np.array([v is not None])
+                if t == dt.STRING:
+                    arr = np.array([v if v is not None else ""],
+                                   dtype=object)
+                else:
+                    arr = np.array([v if v is not None else 0],
+                                   dtype=np.dtype(t.physical))
+                cols.append(HostColumn(arr, mask, t))
+                names.append(k)
+            row = HostTable(cols, names)
+            ok = True
+            for c in applicable:
+                try:
+                    res = cpu_eval.evaluate(c, row)
+                except Exception:
+                    continue  # unevaluable conjunct: keep the file
+                if not (len(res.values) and res.mask[0]
+                        and bool(res.values[0])):
+                    ok = False
+                    break
+            if ok:
+                keep.append(path)
+        return keep
 
     @property
     def schema(self) -> Schema:
@@ -160,6 +292,8 @@ class FileScan(LogicalPlan):
         out.paths, out.fmt, out.options = self.paths, self.fmt, self.options
         out.pushed_filter = f
         out._schema = self._schema
+        out.partition_schema = self.partition_schema
+        out._part_values = self._part_values
         return out
 
     def node_description(self) -> str:
@@ -172,6 +306,26 @@ class FileScan(LogicalPlan):
 # ---------------------------------------------------------------------------
 # predicate pushdown: Expression -> pyarrow.dataset filter
 # ---------------------------------------------------------------------------
+
+def _drop_partition_conjuncts(expr: E.Expression, part_names):
+    """Remove AND-conjuncts that reference any partition column; None
+    when nothing survives."""
+    def refs(e, out):
+        if isinstance(e, E.ColumnRef):
+            out.add(e.name)
+        for c in e.children:
+            refs(c, out)
+        return out
+    if isinstance(expr, P.And):
+        l = _drop_partition_conjuncts(expr.children[0], part_names)
+        r = _drop_partition_conjuncts(expr.children[1], part_names)
+        if l is None:
+            return r
+        if r is None:
+            return l
+        return P.And(l, r)
+    return None if refs(expr, set()) & part_names else expr
+
 
 def to_arrow_filter(expr: E.Expression):
     """Best-effort translation; None = not translatable (no pushdown).
@@ -238,9 +392,29 @@ def to_arrow_filter(expr: E.Expression):
 # host-side file reading (no device semaphore held)
 # ---------------------------------------------------------------------------
 
+def _with_partition_cols(table: "pa.Table", schema: Schema,
+                         pvalues: Optional[dict]) -> "pa.Table":
+    """Append constant partition-value columns (hive-style layout keeps
+    them in the directory names, not the file)."""
+    if not pvalues:
+        return table
+    from .arrow_convert import dtype_to_arrow_type
+    for name, t in schema:
+        if name in table.column_names or name not in pvalues:
+            continue
+        at = dtype_to_arrow_type(t)
+        v = pvalues[name]
+        arr = (pa.nulls(table.num_rows, at) if v is None
+               else pa.array([v] * table.num_rows, type=at))
+        table = table.append_column(pa.field(name, at), arr)
+    return table
+
+
 def iter_file_tables(path: str, fmt: str, schema: Schema,
                      options: dict, arrow_filter,
-                     max_rows: int, conf=None) -> Iterator[HostTable]:
+                     max_rows: int, conf=None,
+                     partition_values: Optional[dict] = None
+                     ) -> Iterator[HostTable]:
     """Decode one file on the host into row-sliced HostTables conforming
     to the DECLARED schema: positional rename when file column names
     differ (e.g. headerless CSV) and per-column cast to declared dtypes.
@@ -267,13 +441,15 @@ def iter_file_tables(path: str, fmt: str, schema: Schema,
             if rb.num_rows == 0:
                 continue
             saw = True
-            ht = arrow_to_host_table(
-                _conform(pa.Table.from_batches([rb]), schema))
+            t = _with_partition_cols(pa.Table.from_batches([rb]),
+                                     schema, partition_values)
+            ht = arrow_to_host_table(_conform(t, schema))
             _apply_read_rebase(ht, options)
             yield ht
         if not saw:
-            yield arrow_to_host_table(
-                _conform(dataset.schema.empty_table(), schema))
+            yield arrow_to_host_table(_conform(
+                _with_partition_cols(dataset.schema.empty_table(),
+                                     schema, partition_values), schema))
         return
     if fmt == "avro":
         # from-scratch container decode (io/avro.py); route through
@@ -293,7 +469,8 @@ def iter_file_tables(path: str, fmt: str, schema: Schema,
         table = _read_csv(path, options)
     else:
         table = _read_json(path, options)
-    table = _conform(table, schema)
+    table = _conform(_with_partition_cols(table, schema,
+                                          partition_values), schema)
     for start in range(0, max(table.num_rows, 1), max_rows):
         sl = table.slice(start, max_rows)
         if sl.num_rows == 0 and start > 0:
@@ -306,11 +483,14 @@ def iter_file_tables(path: str, fmt: str, schema: Schema,
 
 def read_file_to_tables(path: str, fmt: str, schema: Schema,
                         options: dict, arrow_filter,
-                        max_rows: int, conf=None) -> List[HostTable]:
+                        max_rows: int, conf=None,
+                        partition_values: Optional[dict] = None
+                        ) -> List[HostTable]:
     """Materialized form of iter_file_tables — the thread-pool reader
     needs whole-file futures."""
     return list(iter_file_tables(path, fmt, schema, options,
-                                 arrow_filter, max_rows, conf))
+                                 arrow_filter, max_rows, conf,
+                                 partition_values))
 
 
 def _apply_read_rebase(ht: HostTable, options: dict) -> None:
@@ -382,8 +562,15 @@ class FileSourceScanExec(TpuExec):
         super().__init__()
         self.scan = scan
         self._schema = scan.schema
-        self._arrow_filter = (to_arrow_filter(scan.pushed_filter)
-                              if scan.pushed_filter is not None else None)
+        # partition columns live in directory names, not the files —
+        # conjuncts over them must not reach the pyarrow file filter
+        # (they drive pruned_paths instead)
+        pushed = scan.pushed_filter
+        if pushed is not None and scan.partition_schema:
+            part = {k for k, _ in scan.partition_schema}
+            pushed = _drop_partition_conjuncts(pushed, part)
+        self._arrow_filter = (to_arrow_filter(pushed)
+                              if pushed is not None else None)
 
     @property
     def output_schema(self) -> Schema:
@@ -402,7 +589,17 @@ class FileSourceScanExec(TpuExec):
                            conf.get(PARQUET_REBASE_READ))
         args = (self.scan.fmt, self._schema, options,
                 self._arrow_filter, max_rows, conf)
-        if reader == "MULTITHREADED" and len(self.scan.paths) > 1:
+        scan_paths = self.scan.pruned_paths()
+        pruned = len(self.scan.paths) - len(scan_paths)
+        if pruned:
+            m = ctx.metrics_for(self.exec_id)
+            m.setdefault("partitionsPruned",
+                         Metric("partitionsPruned",
+                                Metric.MODERATE)).add(pruned)
+
+        def pv(p):
+            return self.scan.partition_values_for(p)
+        if reader == "MULTITHREADED" and len(scan_paths) > 1:
             threads = conf.get(READER_THREADS)
             with cf.ThreadPoolExecutor(max_workers=threads) as pool:
                 # bounded in-flight window (2x threads) so decoded tables
@@ -410,10 +607,10 @@ class FileSourceScanExec(TpuExec):
                 from collections import deque
                 window = threads * 2
                 pending = deque()
-                paths = iter(self.scan.paths)
+                paths = iter(scan_paths)
                 for p in paths:
                     pending.append((p, pool.submit(read_file_to_tables,
-                                                   p, *args)))
+                                                   p, *args, pv(p))))
                     if len(pending) >= window:
                         break
                 while pending:
@@ -424,12 +621,13 @@ class FileSourceScanExec(TpuExec):
                     if nxt is not None:
                         pending.append((nxt,
                                         pool.submit(read_file_to_tables,
-                                                    nxt, *args)))
-        elif reader == "COALESCING" and len(self.scan.paths) > 1:
+                                                    nxt, *args,
+                                                    pv(nxt))))
+        elif reader == "COALESCING" and len(scan_paths) > 1:
             pending: List[HostTable] = []
             rows = 0
-            for p in self.scan.paths:
-                for t in iter_file_tables(p, *args):
+            for p in scan_paths:
+                for t in iter_file_tables(p, *args, pv(p)):
                     pending.append(t)
                     rows += t.num_rows
                     if rows >= max_rows:
@@ -438,8 +636,8 @@ class FileSourceScanExec(TpuExec):
             if pending:
                 yield None, concat_tables(pending)
         else:
-            for p in self.scan.paths:
-                for t in iter_file_tables(p, *args):
+            for p in scan_paths:
+                for t in iter_file_tables(p, *args, pv(p)):
                     yield p, t
 
     def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:

@@ -50,9 +50,10 @@ def apply_cpu_node(plan: LogicalPlan,
     if isinstance(plan, FileScan):
         from ..io.scan import read_file_to_tables
         tables = []
-        for p in plan.paths:
+        for p in plan.pruned_paths():
             tables.extend(read_file_to_tables(
-                p, plan.fmt, plan.schema, plan.options, None, 1 << 30))
+                p, plan.fmt, plan.schema, plan.options, None, 1 << 30,
+                partition_values=plan.partition_values_for(p)))
         return concat_tables(tables) if tables else empty_like(plan.schema)
     if isinstance(plan, Range):
         n = max(0, -(-(plan.end - plan.start) // plan.step))
