@@ -74,6 +74,14 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     return buf
 
 
+class WorkerLost(RuntimeError):
+    """A worker process died mid-dialogue (connection closed)."""
+
+    def __init__(self, worker_id: int):
+        super().__init__(f"worker {worker_id} lost")
+        self.worker_id = worker_id
+
+
 class ClusterTaskContext:
     """Per-worker execution context handed to the exec layer via
     ExecContext.cluster."""
@@ -189,7 +197,14 @@ class ClusterWorker:
                 msg = _recv_msg(s)
                 if msg is None or msg["type"] == "shutdown":
                     return
-                if msg["type"] == "job":
+                if msg["type"] == "reset":
+                    # failed-attempt cleanup before a retry: drop every
+                    # shuffle's blocks (stale state must not leak into
+                    # the re-run)
+                    for sid in list(self.manager._registered):
+                        self.manager.unregister_shuffle(sid)
+                    _send_msg(s, {"type": "reset_done"})
+                elif msg["type"] == "job":
                     try:
                         rows = self._run_job(msg)
                         _send_msg(s, {"type": "result", "rows": rows})
@@ -330,28 +345,64 @@ class ClusterDriver:
                 f"{len(self._workers)}/{self.num_workers} workers "
                 "registered")
 
-    def run(self, logical_plan, conf_settings: Optional[dict] = None
-            ) -> List[dict]:
+    def run(self, logical_plan, conf_settings: Optional[dict] = None,
+            max_retries: int = 2) -> List[dict]:
         """Execute one plan across the cluster; returns merged rows in
-        worker order (= partition order for sorted plans)."""
-        import cloudpickle
+        worker order (= partition order for sorted plans).
+
+        Failure recovery (SURVEY §5 failure detection / shuffle retry):
+        a lost worker aborts the attempt; the driver prunes dead
+        workers, breaks any waiting barriers, resets survivors' shuffle
+        state, and re-runs the whole job on the surviving set (map
+        inputs re-shard automatically because sharding derives from
+        worker_id/num_workers). Deterministic worker ERRORS do not
+        retry — they reproduce."""
         self.wait_for_workers()
+        last: Optional[BaseException] = None
+        for _attempt in range(max_retries + 1):
+            try:
+                return self._run_once(logical_plan, conf_settings)
+            except WorkerLost as e:
+                last = e
+                self._recover()
+                if not self._workers:
+                    break
+        raise RuntimeError(
+            f"job failed after worker losses: {last}") from last
+
+    def _run_once(self, logical_plan, conf_settings) -> List[dict]:
+        import cloudpickle
         self._barriers.clear()
         self._gathers.clear()
-        peers = [ep for _, ep in self._workers]
+        workers = list(self._workers)
+        n = len(workers)
+        self.num_workers = n
+        peers = [ep for _, ep in workers]
         blob = cloudpickle.dumps(logical_plan)
-        for w, (sock, _ep) in enumerate(self._workers):
-            _send_msg(sock, {"type": "job", "plan": blob,
-                             "conf": dict(conf_settings or {}),
-                             "worker_id": w,
-                             "num_workers": self.num_workers,
-                             "peers": peers})
-        results: List[Optional[List[dict]]] = [None] * self.num_workers
-        for w, (sock, _ep) in enumerate(self._workers):
-            reply = _recv_msg(sock)
+        for w, (sock, _ep) in enumerate(workers):
+            try:
+                _send_msg(sock, {"type": "job", "plan": blob,
+                                 "conf": dict(conf_settings or {}),
+                                 "worker_id": w,
+                                 "num_workers": n,
+                                 "peers": peers})
+            except OSError:
+                raise WorkerLost(w)
+        results: List[Optional[List[dict]]] = [None] * n
+        for w, (sock, _ep) in enumerate(workers):
+            try:
+                reply = _recv_msg(sock)
+            except OSError:
+                reply = None
             if reply is None:
-                raise RuntimeError(f"worker {w} died mid-job")
+                raise WorkerLost(w)
             if reply["type"] == "error":
+                if "barrier" in reply["error"] or \
+                        "gather" in reply["error"] or \
+                        "peer closed" in reply["error"] or \
+                        "refused" in reply["error"]:
+                    # collateral of a lost peer, not a plan error
+                    raise WorkerLost(w)
                 raise RuntimeError(
                     f"worker {w} failed:\n{reply['error']}")
             results[w] = reply["rows"]
@@ -359,6 +410,39 @@ class ClusterDriver:
         for rows in results:
             out.extend(rows or [])
         return out
+
+    def _recover(self) -> None:
+        """Prune dead workers, unblock stuck barriers, reset
+        survivors."""
+        for b in self._barriers.values():
+            try:
+                b.abort()
+            except Exception:
+                pass
+        self._barriers.clear()
+        self._gathers.clear()
+        alive = []
+        for sock, ep in self._workers:
+            try:
+                _send_msg(sock, {"type": "reset"})
+                # drain stale replies of the aborted attempt (a worker
+                # stuck at a now-aborted barrier first reports its job
+                # error, THEN processes the reset)
+                sock.settimeout(150)
+                try:
+                    for _ in range(8):
+                        reply = _recv_msg(sock)
+                        if reply is None:
+                            break
+                        if reply.get("type") == "reset_done":
+                            alive.append((sock, ep))
+                            break
+                finally:
+                    sock.settimeout(None)
+            except OSError:
+                pass
+        self._workers = alive
+        self.num_workers = len(alive)
 
     def shutdown(self) -> None:
         for sock, _ep in self._workers:
