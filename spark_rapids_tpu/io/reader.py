@@ -54,6 +54,12 @@ class DataFrameReader:
         return self._scan(list(paths) if len(paths) > 1 else paths[0],
                           "avro", schema)
 
+    def delta(self, path: str, version_as_of: Optional[int] = None):
+        """Standard-format Delta Lake table (io/delta_format.py):
+        _delta_log JSON/checkpoint replay with time travel."""
+        from .delta_format import read_delta
+        return read_delta(self.session, path, version_as_of)
+
     def iceberg(self, path: str, snapshot_id: Optional[int] = None,
                 as_of_timestamp_ms: Optional[int] = None):
         """Iceberg table directory (io/iceberg.py): snapshot-selected

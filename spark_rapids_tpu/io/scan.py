@@ -190,7 +190,7 @@ class FileScan(LogicalPlan):
     def __init__(self, paths, fmt: str, schema: Optional[List] = None,
                  options: Optional[dict] = None,
                  pushed_filter: Optional[E.Expression] = None,
-                 conf=None):
+                 conf=None, partition_info=None):
         super().__init__()
         assert fmt in FORMATS, fmt
         self.paths = expand_paths(paths, conf)
@@ -199,8 +199,17 @@ class FileScan(LogicalPlan):
         self.fmt = fmt
         self.options = options or {}
         self.pushed_filter = pushed_filter
-        self.partition_schema, self._part_values = discover_partitions(
-            _rewritten_roots(paths, conf), self.paths)
+        if partition_info is not None:
+            # table formats (Delta/Iceberg) carry partition values in
+            # their metadata instead of (only) the directory layout
+            pschema, by_path = partition_info
+            self.partition_schema = list(pschema)
+            self._part_values = [dict(by_path.get(p, {}))
+                                 for p in self.paths]
+        else:
+            self.partition_schema, self._part_values = \
+                discover_partitions(_rewritten_roots(paths, conf),
+                                    self.paths)
         if schema is None:
             if fmt == "avro":
                 from .avro import infer_avro_schema

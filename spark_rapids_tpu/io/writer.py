@@ -213,6 +213,14 @@ class DataFrameWriter:
     def parquet(self, path: str) -> WriteStats:
         return self._write(path, "parquet")
 
+    def delta(self, path: str) -> int:
+        """Standard-format Delta Lake commit (io/delta_format.py);
+        returns the committed version."""
+        from .delta_format import write_delta_table
+        table = self.df.session.execute(self.df.plan)
+        return write_delta_table(table, path, self._partition_by,
+                                 self._mode)
+
     def orc(self, path: str) -> WriteStats:
         return self._write(path, "orc")
 
