@@ -20,6 +20,8 @@
 
 #include <cstdint>
 #include <cstring>
+#include <fcntl.h>
+#include <unistd.h>
 #include <cstdlib>
 #include <mutex>
 #include <map>
@@ -322,6 +324,83 @@ void hostpool_stats(void* pool, int64_t* out4) {
     out4[1] = p->peak;
     out4[2] = p->alloc_count;
     out4[3] = p->fail_count;
+}
+
+// --------------------------------------------------------------------------
+// direct-I/O spill file transfer (the GDS-spill role: device buffers
+// stream to/from NVMe without bouncing through the page cache; here the
+// "device buffer" is a host slab the engine packed, and O_DIRECT skips
+// the kernel page cache so large spills neither evict hot pages nor get
+// double-buffered). Falls back to buffered I/O when O_DIRECT is refused
+// (tmpfs, some filesystems) — callers cannot tell apart and need not.
+// --------------------------------------------------------------------------
+
+int64_t direct_write_file(const char* path, const uint8_t* data,
+                          int64_t size) {
+    int flags = O_WRONLY | O_CREAT | O_TRUNC;
+#ifdef O_DIRECT
+    int fd = open(path, flags | O_DIRECT, 0600);
+    if (fd < 0)
+#else
+    int fd = -1;
+#endif
+        fd = open(path, flags, 0600);
+    if (fd < 0) return -1;
+    const int64_t ALIGN_IO = 4096;
+    int64_t aligned = size / ALIGN_IO * ALIGN_IO;
+    int64_t off = 0;
+    // aligned body: the engine's pool slabs are 4K-aligned, so the
+    // bulk transfer qualifies for O_DIRECT
+    while (off < aligned) {
+        ssize_t w = write(fd, data + off, aligned - off);
+        if (w <= 0) { close(fd); return -1; }
+        off += w;
+    }
+    if (off < size) {
+        // unaligned tail: drop O_DIRECT for the last partial block
+#ifdef O_DIRECT
+        int f2 = fcntl(fd, F_GETFL);
+        if (f2 >= 0) fcntl(fd, F_SETFL, f2 & ~O_DIRECT);
+#endif
+        while (off < size) {
+            ssize_t w = write(fd, data + off, size - off);
+            if (w <= 0) { close(fd); return -1; }
+            off += w;
+        }
+    }
+    if (close(fd) != 0) return -1;
+    return size;
+}
+
+int64_t direct_read_file(const char* path, uint8_t* out, int64_t size) {
+    int fd = -1;
+#ifdef O_DIRECT
+    fd = open(path, O_RDONLY | O_DIRECT);
+    if (fd < 0)
+#endif
+        fd = open(path, O_RDONLY);
+    if (fd < 0) return -1;
+    const int64_t ALIGN_IO = 4096;
+    int64_t aligned = size / ALIGN_IO * ALIGN_IO;
+    int64_t off = 0;
+    while (off < aligned) {
+        ssize_t r = read(fd, out + off, aligned - off);
+        if (r <= 0) { close(fd); return -1; }
+        off += r;
+    }
+    if (off < size) {
+#ifdef O_DIRECT
+        int f2 = fcntl(fd, F_GETFL);
+        if (f2 >= 0) fcntl(fd, F_SETFL, f2 & ~O_DIRECT);
+#endif
+        while (off < size) {
+            ssize_t r = read(fd, out + off, size - off);
+            if (r <= 0) { close(fd); return -1; }
+            off += r;
+        }
+    }
+    close(fd);
+    return size;
 }
 
 }  // extern "C"

@@ -132,7 +132,7 @@ class SpillableBatch:
     __slots__ = ("_batch", "_host", "_pooled", "_treedef", "_path",
                  "_nbytes", "priority", "_lock", "_catalog", "handle",
                  "closed", "_scalars", "_nleaves", "_num_rows",
-                 "creation_stack")
+                 "creation_stack", "_slab")
 
     def __init__(self, batch: ColumnarBatch,
                  priority: SpillPriority = SpillPriority.ACTIVE_ON_DECK,
@@ -146,6 +146,7 @@ class SpillableBatch:
         self._pooled: Optional[_PooledLeaves] = None
         self._treedef = None
         self._path: Optional[str] = None
+        self._slab = None  # (metas, scalars, nleaves, total) for .slab
         self.priority = priority
         self._lock = threading.Lock()
         self.closed = False
@@ -195,11 +196,32 @@ class SpillableBatch:
             return self._nbytes
 
     def spill_to_disk(self) -> int:
-        """Host → disk. Returns host bytes freed."""
+        """Host → disk. Returns host bytes freed.
+
+        Pool-slab entries stream RAW via the native O_DIRECT writer
+        (GDS-spill role: bulk spills bypass the page cache and need no
+        npz re-serialization); numpy-fallback entries keep the .npz
+        path."""
         with self._lock:
             if (self._host is None and self._pooled is None) or \
                     self.closed:
                 return 0
+            if self._pooled is not None:
+                from ..native import direct_write
+                fd, path = tempfile.mkstemp(
+                    suffix=".slab", dir=self._catalog.spill_dir)
+                os.close(fd)
+                if direct_write(path, self._pooled.ptr,
+                                max(self._pooled.total, 1)):
+                    self._path = path
+                    self._slab = (self._pooled.metas,
+                                  self._pooled.scalars,
+                                  self._pooled.nleaves,
+                                  self._pooled.total)
+                    self._pooled.free()
+                    self._pooled = None
+                    return self._nbytes
+                os.unlink(path)  # direct write failed: npz fallback
             host = self._host if self._host is not None \
                 else self._pooled.unpack()
             fd, path = tempfile.mkstemp(suffix=".npz",
@@ -242,14 +264,17 @@ class SpillableBatch:
                 return self._batch
             if self._host is None and self._pooled is None and \
                     self._path is not None:
-                data = np.load(self._path)
-                leaves = []
-                for i in range(self._nleaves):
-                    if i in self._scalars:
-                        leaves.append(self._scalars[i])
-                    else:
-                        leaves.append(data[f"a{i}"])
-                self._host = leaves
+                if self._slab is not None:
+                    self._host = self._load_slab()
+                else:
+                    data = np.load(self._path)
+                    leaves = []
+                    for i in range(self._nleaves):
+                        if i in self._scalars:
+                            leaves.append(self._scalars[i])
+                        else:
+                            leaves.append(data[f"a{i}"])
+                    self._host = leaves
                 os.unlink(self._path)
                 self._path = None
             if self._pooled is not None:
@@ -262,6 +287,32 @@ class SpillableBatch:
                 self._batch = _tree_to_device(self._host, self._treedef)
             self._host = None
             return self._batch
+
+    def _load_slab(self):
+        """Read a raw .slab spill back (O_DIRECT when the 4K-aligned
+        buffer qualifies, buffered otherwise) and rebuild leaves."""
+        from ..native import direct_read
+        metas, scalars, nleaves, total = self._slab
+        # 4096-aligned destination so O_DIRECT reads qualify
+        raw = np.empty(max(total, 1) + 4096, np.uint8)
+        off = (-raw.ctypes.data) % 4096
+        buf = raw[off:off + max(total, 1)]
+        if not direct_read(self._path, buf.ctypes.data, max(total, 1)):
+            buf = np.fromfile(self._path, np.uint8, count=total)
+        leaves = [None] * nleaves
+        for i, v in scalars.items():
+            leaves[i] = v
+        for i, offset, shape, dtype in metas:
+            count = int(np.prod(shape)) if shape else 1
+            nbytes = count * dtype.itemsize
+            if nbytes:
+                arr = np.frombuffer(buf.data, dtype=dtype, count=count,
+                                    offset=offset).reshape(shape)
+            else:
+                arr = np.zeros(shape, dtype)
+            leaves[i] = arr
+        self._slab = None
+        return leaves
 
     def close(self) -> None:
         with self._lock:

@@ -8,6 +8,9 @@ rebuilds happen only when the source changes) and exposes:
   conversion (CudfUnsafeRow / RowConversion role)
 - HostMemoryPool — aligned slab allocator with alloc-failure signaling
   (HostAlloc / PinnedMemoryPool role)
+- direct_write / direct_read — O_DIRECT spill-file transfer (the
+  GDS-spill role: bulk spills bypass the page cache; buffered fallback
+  when the filesystem refuses O_DIRECT)
 
 SURVEY §2.9: these are the framework's native equivalents of the
 reference's external C++/CUDA artifacts.
@@ -200,6 +203,20 @@ class HostMemoryPool:
             self.close()
         except Exception:
             pass
+
+
+def direct_write(path: str, ptr: int, size: int) -> bool:
+    """Write ``size`` bytes at address ``ptr`` to ``path`` with
+    O_DIRECT when the filesystem allows (GDS-spill role)."""
+    lib = _lib()
+    buf = ctypes.cast(ptr, ctypes.POINTER(ctypes.c_uint8))
+    return lib.direct_write_file(path.encode(), buf, size) == size
+
+
+def direct_read(path: str, ptr: int, size: int) -> bool:
+    lib = _lib()
+    buf = ctypes.cast(ptr, ctypes.POINTER(ctypes.c_uint8))
+    return lib.direct_read_file(path.encode(), buf, size) == size
 
 
 def native_available() -> bool:

@@ -218,3 +218,37 @@ def test_leak_detection_report():
     finally:
         set_active_conf(SrtConf({}))
         reset_spill_catalog()
+
+
+def test_slab_direct_io_disk_tier():
+    """Pool-backed host entries spill to disk as raw O_DIRECT slabs and
+    round-trip (GDS-spill role)."""
+    import numpy as np
+    import pytest
+
+    from spark_rapids_tpu.native import native_available
+    if not native_available():
+        pytest.skip("native library not built")
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.columnar import dtypes as dt
+    from spark_rapids_tpu.columnar.vector import ColumnarBatch, ColumnVector
+    from spark_rapids_tpu.memory.budget import MemoryBudget
+    from spark_rapids_tpu.memory.spill import (SpillableBatch,
+                                               reset_spill_catalog)
+    cat = reset_spill_catalog(budget=MemoryBudget(1 << 30),
+                              host_limit=1 << 20)
+    rng = np.random.default_rng(5)
+    vals = rng.uniform(0, 1, 4096)
+    col = ColumnVector(jnp.asarray(vals), jnp.ones(4096, jnp.bool_),
+                       dt.FLOAT64)
+    sb = SpillableBatch(ColumnarBatch([col], ["v"], 4096), catalog=cat)
+    sb.spill_to_host()
+    assert sb.tier == "host" and sb._pooled is not None
+    sb.spill_to_disk()
+    assert sb.tier == "disk" and sb._path.endswith(".slab")
+    got = np.asarray(sb.get().columns[0].data)
+    assert np.array_equal(got, vals)
+    sb.close()
+    assert cat.host_pool.stats()["in_use"] == 0
+    reset_spill_catalog()
