@@ -381,6 +381,35 @@ WINDOW_BATCHED_RUNNING = conf("srt.sql.window.batchedRunning.enabled") \
          "(GpuRunningWindowExec/BatchedRunningWindowFixer role).") \
     .boolean(True)
 
+JOIN_BLOOM_BITS_PER_KEY = conf("srt.sql.join.bloomFilter.bitsPerKey") \
+    .doc("Bloom filter sizing: bits per build-side key (rounded up to a "
+         "power of two, clamped to [2^10, 2^24] bits).") \
+    .check(_positive).integer(10)
+
+JOIN_GROWTH_STEPS = conf("srt.sql.join.outputGrowthSteps") \
+    .doc("Max output-capacity doublings for a join whose true match "
+         "count overflows the estimate before the probe batch splits "
+         "(SplitAndRetryOOM contract).") \
+    .check(_positive).integer(4)
+
+RANGE_SAMPLE_SIZE = conf("srt.shuffle.sample.sizePerPartition") \
+    .doc("Range-partitioner sketch size: sample rows per output "
+         "partition used to derive bounds "
+         "(spark.sql.execution.rangeExchange.sampleSizePerPartition).") \
+    .check(_positive).integer(40)
+
+CLUSTER_BARRIER_TIMEOUT = conf("srt.cluster.barrierTimeoutSec") \
+    .doc("Seconds a cluster worker waits on a driver shuffle barrier / "
+         "gather before treating the attempt as failed.") \
+    .check(_positive).integer(120)
+
+PALLAS_TILE_ROWS = conf("srt.sql.pallas.tileRows") \
+    .doc("Row-tile size for fused pallas reductions (one HBM->VMEM DMA "
+         "per tile; must be a multiple of 1024).") \
+    .check(lambda v: None if v % 1024 == 0 and v > 0
+           else "must be a positive multiple of 1024") \
+    .integer(8192)
+
 JOIN_BLOOM_ENABLED = conf("srt.sql.join.bloomFilter.enabled") \
     .doc("Build a bloom filter over the materialized build side of "
          "inner/semi hash joins and pre-filter probe batches with it "

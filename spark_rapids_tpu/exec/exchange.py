@@ -150,8 +150,11 @@ class ShuffleExchangeExec(TpuExec):
                      batches: List[ColumnarBatch],
                      num_parts: int) -> List[tuple]:
         """Host-side sample row tuples of the sort keys."""
+        from ..conf import RANGE_SAMPLE_SIZE
         orders = self.sort_orders
-        per_batch = max(1, (num_parts * 40) // max(len(batches), 1))
+        per_part = ctx.conf.get(RANGE_SAMPLE_SIZE)
+        per_batch = max(1, (num_parts * per_part)
+                        // max(len(batches), 1))
         samples: List[tuple] = []  # row tuples of physical values
         for b in batches:
             n = int(b.num_rows)

@@ -192,10 +192,12 @@ class _HashJoinBase(TpuExec):
                    ) -> ColumnarBatch:
         """One probe batch against one build batch, with capacity
         growth retry."""
+        from ..conf import JOIN_GROWTH_STEPS
         n_probe = int(probe.num_rows)
+        max_steps = ctx.conf.get(JOIN_GROWTH_STEPS)
         # initial guess: every probe row matches ~1 build row
         out_cap = choose_capacity(max(n_probe, 16))
-        for step in range(_MAX_GROWTH_STEPS + 1):
+        for step in range(max_steps + 1):
             with ctx.semaphore:
                 out, total = self._join_fn(out_cap)(probe, build)
             total = int(total)
@@ -205,7 +207,7 @@ class _HashJoinBase(TpuExec):
             out_cap = choose_capacity(total)
         raise RuntimeError(
             f"join expansion {total} exceeded capacity after "
-            f"{_MAX_GROWTH_STEPS} growth steps")
+            f"{max_steps} growth steps")
 
     def _split_fn(self, num_parts: int, side: str):
         """jit'd key-hash bucket filter (ops/kernels.py bucket_compact):
@@ -349,8 +351,10 @@ class _HashJoinBase(TpuExec):
                 self.join_type not in (INNER, LEFT_SEMI) or \
                 not (self.left_keys or self.right_keys):
             return probe_stream
+        from ..conf import JOIN_BLOOM_BITS_PER_KEY
         min_rows = ctx.conf.get(JOIN_BLOOM_MIN_PROBE_ROWS)
-        num_bits = B.choose_num_bits(int(build.num_rows))
+        num_bits = B.choose_num_bits(
+            int(build.num_rows), ctx.conf.get(JOIN_BLOOM_BITS_PER_KEY))
         bkey = ("bloom_build", num_bits)
         if bkey not in self._jit_cache:
             bexprs = self._build_key_exprs

@@ -233,7 +233,10 @@ class PallasAggPlan:
                     vals.extend(b(kb, mask))
                 return vals
 
-            return PK.tile_reduce(arrays, row_fn, kinds)
+            from ..conf import PALLAS_TILE_ROWS, active_conf
+            return PK.tile_reduce(arrays, row_fn, kinds,
+                                  tile_rows=active_conf()
+                                  .get(PALLAS_TILE_ROWS))
         return run
 
     # --- host-side accumulation -> packed agg states ---

@@ -103,10 +103,15 @@ class ClusterTaskContext:
     def owns_first(self) -> bool:
         return self.worker_id == 0
 
+    def _timeout(self) -> int:
+        from ..conf import CLUSTER_BARRIER_TIMEOUT, active_conf
+        return active_conf().get(CLUSTER_BARRIER_TIMEOUT)
+
     def barrier(self, shuffle_id: int) -> None:
         """Block until every worker's map side for shuffle_id is
         written (driver-released)."""
-        with socket.create_connection(self.driver_addr, timeout=120) as s:
+        with socket.create_connection(self.driver_addr,
+                                      timeout=self._timeout()) as s:
             _send_msg(s, {"type": "barrier", "shuffle_id": shuffle_id,
                           "worker": self.worker_id})
             reply = _recv_msg(s)
@@ -117,7 +122,8 @@ class ClusterTaskContext:
         """All-gather a picklable payload across workers through the
         driver (GpuRangePartitioner.sketch-to-driver role); returns the
         payloads in worker order."""
-        with socket.create_connection(self.driver_addr, timeout=120) as s:
+        with socket.create_connection(self.driver_addr,
+                                      timeout=self._timeout()) as s:
             _send_msg(s, {"type": "gather", "key": key,
                           "worker": self.worker_id, "payload": payload})
             reply = _recv_msg(s)
