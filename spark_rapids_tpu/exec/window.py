@@ -474,13 +474,35 @@ class WindowExec(TpuExec):
             return make_result(acc.astype(phys), has_vals & s_live, out_t)
         return make_result(acc, has_vals & s_live, out_t)
 
-    # --- streaming shell (global materialization, like SortExec) ---
+    def required_child_distributions(self):
+        """Partitioned windows cluster by the partition keys
+        (GpuWindowExec requiredChildDistribution): each reduce
+        partition holds whole window partitions, so the exec
+        materializes one PARTITION at a time instead of the whole
+        input — and the same clustering is what the mesh lowering
+        rides."""
+        from ..plan.distribution import (ClusteredDistribution,
+                                         UnspecifiedDistribution)
+        if self.partition_by:
+            return [ClusteredDistribution(self.partition_by)]
+        return [UnspecifiedDistribution()]
+
+    @property
+    def output_partitioning(self):
+        return self.children[0].output_partitioning
+
+    # --- streaming shell: one materialization per child partition ---
     def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        for part in self.children[0].execute_partitioned(ctx):
+            yield from self._window_partition(ctx, part)
+
+    def _window_partition(self, ctx: ExecContext,
+                          stream) -> Iterator[ColumnarBatch]:
         from ..memory.spill import SpillableBatch, SpillPriority
         runs: List[SpillableBatch] = []
         total = 0
         try:
-            for b in self.children[0].execute(ctx):
+            for b in stream:
                 if int(b.num_rows) == 0:
                     continue
                 total += int(b.num_rows)

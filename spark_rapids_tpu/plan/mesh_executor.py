@@ -149,8 +149,20 @@ class MeshQueryExecutor:
             return lambda env: env[key]
 
         if isinstance(node, ProjectExec):
+            if node._eager:
+                raise UnsupportedMeshLowering(
+                    "eager projection (uuid/input_file/raise_error)")
             child = self._lower(node.children[0])
-            return lambda env: node._project(child(env))
+
+            def proj_fn(env):
+                b = child(env)
+                # context expressions see shard-unique positions:
+                # partition_id = shard index, row offsets disjoint
+                idx = lax.axis_index(ax)
+                return node._project_ctx(
+                    b, idx.astype(jnp.int64) * b.capacity,
+                    idx.astype(jnp.int32))
+            return proj_fn
 
         if isinstance(node, FilterExec):
             child = self._lower(node.children[0])
@@ -196,6 +208,31 @@ class MeshQueryExecutor:
             # shard order == partition order == global order
             return lambda env: node._sort_one(child(env))
 
+        from ..exec.basic import SampleExec
+        if isinstance(node, SampleExec):
+            child = self._lower(node.children[0])
+
+            def sample_fn(env):
+                b = child(env)
+                off = lax.axis_index(ax).astype(jnp.int64) * b.capacity
+                return node._sample(b, off)
+            return sample_fn
+
+        if isinstance(node, ExpandExec):
+            child = self._lower(node.children[0])
+            fns = [node._make_project(p) for p in node.projections]
+
+            def expand_fn(env):
+                b = child(env)
+                outs = [fn(b) for fn in fns]
+                cap = round_pow2(sum(o.capacity for o in outs))
+                return K.concat_batches(outs, cap)
+            return expand_fn
+
+        from ..exec.window import BatchedRunningWindowExec, WindowExec
+        if isinstance(node, (WindowExec, BatchedRunningWindowExec)):
+            return self._lower_window(node)
+
         if isinstance(node, LocalLimitExec):
             child = self._lower(node.children[0])
 
@@ -206,6 +243,39 @@ class MeshQueryExecutor:
             return limit_fn
 
         raise UnsupportedMeshLowering(type(node).__name__)
+
+    def _lower_window(self, node):
+        """Window partitions co-locate via hash all-to-all on the
+        partition keys, then the whole-partition segmented-scan kernel
+        runs shard-locally (GpuWindowExec's clustered-distribution
+        contract on the mesh). The batched-running variant re-uses the
+        same kernel here — per shard the data is ONE batch, so the
+        carried-state machinery is unnecessary (its sort child is
+        skipped: the kernel re-sorts internally)."""
+        from ..exec.window import BatchedRunningWindowExec, WindowExec
+        ax, n = self.axis, self.n
+        inner = node.children[0]
+        if isinstance(node, BatchedRunningWindowExec) and \
+                isinstance(inner, SortExec):
+            inner = inner.children[0]
+        child = self._lower(inner)
+        kernel = WindowExec(inner, node.window_exprs) \
+            if isinstance(node, BatchedRunningWindowExec) else node
+        if not node.partition_by:
+            def global_fn(env):
+                g = all_gather_batch(child(env), n, ax)
+                return _mask_to_shard0(kernel._compute(g), ax)
+            return global_fn
+        keys = node.partition_by
+
+        def win_fn(env):
+            b = child(env)
+            kc = [e.eval(b) for e in keys]
+            pids = hash_partition_ids(kc, n)
+            pb = partition_batch(b, pids, n)
+            local = flatten_partitions(all_to_all_partitions(pb, ax))
+            return kernel._compute(local)
+        return win_fn
 
     def _lower_shuffle(self, node: ShuffleExchangeExec):
         ax, n = self.axis, self.n

@@ -158,3 +158,72 @@ def test_mesh_full_q3_shape(mesh, tmp_path):
     df = q3(t["customer"], t["orders"], t["lineitem"])
     phys = overrides.apply_overrides(df.plan, conf)
     _assert_same(run_on_mesh(phys, mesh, conf), df, ordered=True)
+
+
+def test_window_on_mesh():
+    """Windows lower to hash all-to-all on the partition keys + the
+    shard-local whole-partition kernel; results match local
+    execution."""
+    import numpy as np
+
+    from spark_rapids_tpu import parallel as par
+    from spark_rapids_tpu.conf import SrtConf
+    from spark_rapids_tpu.columnar.vector import batch_to_pydict
+    from spark_rapids_tpu.expr import col
+    from spark_rapids_tpu.expr.aggregates import Sum
+    from spark_rapids_tpu.expr.window import (RowNumber, Window,
+                                              WindowFrame)
+    from spark_rapids_tpu.plan import TpuSession, overrides
+    from spark_rapids_tpu.plan.mesh_executor import run_on_mesh
+
+    mesh = par.data_mesh(8)
+    conf = SrtConf({"srt.shuffle.partitions": 8})
+    session = TpuSession(conf)
+    rng = np.random.default_rng(4)
+    n = 256
+    df = session.create_dataframe({
+        "k": rng.integers(0, 10, n).tolist(),
+        "o": rng.integers(0, 50, n).tolist(),
+        "v": rng.uniform(0, 5, n).tolist(),
+    })
+    w = Window.partition_by("k").order_by("o").with_frame(
+        WindowFrame(None, 0, row_based=True))
+    q = df.select("k", "o", "v", RowNumber().over(w).alias("rn"),
+                  Sum(col("v")).over(w).alias("s"))
+    physical = overrides.apply_overrides(q.plan, conf)
+    out = run_on_mesh(physical, mesh, conf)
+    got = []
+    for b in out:
+        d = batch_to_pydict(b)
+        got.extend(zip(d["k"], d["o"], d["rn"],
+                       [round(x, 9) for x in d["s"]]))
+    want = [(r["k"], r["o"], r["rn"], round(r["s"], 9))
+            for r in q.collect()]
+    assert sorted(got) == sorted(want)
+
+
+def test_sample_and_mono_id_on_mesh():
+    import numpy as np
+
+    from spark_rapids_tpu import parallel as par
+    from spark_rapids_tpu.conf import SrtConf
+    from spark_rapids_tpu.columnar.vector import batch_to_pydict
+    from spark_rapids_tpu.expr import monotonically_increasing_id
+    from spark_rapids_tpu.plan import TpuSession, overrides
+    from spark_rapids_tpu.plan.mesh_executor import run_on_mesh
+
+    mesh = par.data_mesh(8)
+    conf = SrtConf({})
+    session = TpuSession(conf)
+    df = session.create_dataframe({"v": list(range(400))})
+    q = df.sample(0.5, seed=3).select(
+        "v", monotonically_increasing_id().alias("id"))
+    physical = overrides.apply_overrides(q.plan, conf)
+    out = run_on_mesh(physical, mesh, conf)
+    ids, vs = [], []
+    for b in out:
+        d = batch_to_pydict(b)
+        ids.extend(d["id"])
+        vs.extend(d["v"])
+    assert len(set(ids)) == len(ids)  # shard-unique ids
+    assert 100 < len(vs) < 300  # ~50% sample
