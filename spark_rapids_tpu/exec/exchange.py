@@ -58,7 +58,13 @@ def partition_slice(pb: PartitionedBatch, i: int) -> ColumnarBatch:
     S = pb.slot_capacity
     cols = []
     for spec, dtype in zip(pb.columns, pb.dtypes):
-        if dtype == dt.STRING:
+        if isinstance(dtype, dt.ArrayType):
+            from ..parallel.partition import list_from_packed
+            lens, valid, cdata, cok, e_counts = spec
+            cols.append(list_from_packed(lens[i], valid[i], cdata[i],
+                                         cok[i], e_counts[i],
+                                         dtype.element_type))
+        elif dtype == dt.STRING:
             padded, lens, valid = spec
             cols.append(string_from_padded(padded[i], lens[i], valid[i]))
         elif isinstance(dtype, dt.DecimalType) and dtype.is_wide:

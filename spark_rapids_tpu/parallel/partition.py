@@ -159,8 +159,46 @@ def partition_batch(batch: ColumnarBatch, part_ids: jnp.ndarray,
     row = jnp.take(order, jnp.clip(srcpos, 0, cap - 1))      # (P, S)
     valid = j[None, :] < jnp.minimum(counts, S)[:, None]     # (P, S)
 
+    from ..columnar.nested import ListColumn
     cols_out = []
     for c in batch.columns:
+        if isinstance(c, ListColumn):
+            # lists shuffle as (lens, validity) row planes plus a child
+            # plane packed row-major PER PARTITION: every element gets
+            # its row's destination, then the same dense-pack as rows
+            # runs on the element axis (no fixed-width truncation, so
+            # collect-style states of any length survive)
+            lens_all = jnp.where(c.validity & live, c.lengths(), 0)
+            pl = jnp.where(valid, jnp.take(lens_all, row), 0)
+            pv = valid & jnp.take(c.validity, row)
+            ccap = c.child_capacity
+            epos = jnp.arange(ccap, dtype=jnp.int32)
+            erow = jnp.clip(jnp.searchsorted(c.offsets[1:], epos,
+                                             side="right"),
+                            0, cap - 1).astype(jnp.int32)
+            e_live = epos < c.offsets[cap]
+            e_pid = jnp.where(e_live & jnp.take(live, erow),
+                              jnp.take(part_ids, erow),
+                              jnp.int32(num_parts))
+            # elements of one row stay contiguous and rows keep their
+            # relative order inside a partition: sort by (pid, position)
+            e_order = jnp.argsort(e_pid, stable=True).astype(jnp.int32)
+            e_counts = jnp.zeros(num_parts + 1, jnp.int32).at[
+                jnp.clip(e_pid, 0, num_parts)].add(1)[:num_parts]
+            e_offsets = jnp.concatenate(
+                [jnp.zeros(1, jnp.int32),
+                 jnp.cumsum(e_counts, dtype=jnp.int32)])
+            j2 = jnp.arange(ccap, dtype=jnp.int32)
+            esrc = e_offsets[:num_parts, None] + j2[None, :]
+            etake = jnp.take(e_order, jnp.clip(esrc, 0, ccap - 1))
+            e_valid = j2[None, :] < e_counts[:, None]       # (P, Sc)
+            cdata = jnp.where(e_valid,
+                              jnp.take(c.child.data, etake),
+                              jnp.zeros((), c.child.data.dtype))
+            cok = e_valid & jnp.take(c.child.validity, etake)
+            cols_out.append((pl, pv, cdata, cok,
+                             jnp.minimum(e_counts, ccap)))
+            continue
         if isinstance(c, StringColumn):
             padded = c.padded()                              # (cap, W)
             lens = c.lengths()
@@ -186,6 +224,25 @@ def partition_batch(batch: ColumnarBatch, part_ids: jnp.ndarray,
     return PartitionedBatch(cols_out, batch.names,
                             [c.dtype for c in batch.columns],
                             jnp.minimum(counts, S), S)
+
+
+def list_from_packed(lens: jnp.ndarray, validity: jnp.ndarray,
+                     child_vals: jnp.ndarray, child_ok: jnp.ndarray,
+                     n_elems, element_type):
+    """Rebuild a ListColumn from the packed shuffle layout: row lens +
+    validity, and child elements packed row-major with ``n_elems``
+    live."""
+    from ..columnar.nested import ListColumn
+    from ..columnar.vector import ColumnVector
+    offsets = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(lens, dtype=jnp.int32)])
+    pos = jnp.arange(child_vals.shape[0], dtype=jnp.int32)
+    live = pos < n_elems
+    child = ColumnVector(
+        jnp.where(live & child_ok, child_vals,
+                  jnp.zeros((), child_vals.dtype)),
+        live & child_ok, element_type)
+    return ListColumn(offsets, child, validity, element_type)
 
 
 def string_from_padded(padded: jnp.ndarray, lens: jnp.ndarray,
@@ -231,6 +288,29 @@ def flatten_partitions(pb: PartitionedBatch,
 
     cols: List[Column] = []
     for spec, dtype in zip(pb.columns, pb.dtypes):
+        if isinstance(dtype, dt.ArrayType):
+            lens, valid, cdata, cok, e_counts = spec
+            flat_l = jnp.take(lens.reshape(cap), order)
+            flat_v = jnp.take(valid.reshape(cap), order)
+            keep = jnp.take(slot_valid, order)
+            flat_l = jnp.where(keep, flat_l, 0)
+            flat_v = flat_v & keep
+            # child planes: compact each partition's live element run,
+            # partition-major (matches the row flattening order)
+            P_, Sc = cdata.shape
+            je = jnp.arange(Sc, dtype=jnp.int32)
+            e_slot_valid = (je[None, :] < e_counts[:, None]).reshape(
+                P_ * Sc)
+            e_order = jnp.argsort(~e_slot_valid,
+                                  stable=True).astype(jnp.int32)
+            flat_cd = jnp.take(cdata.reshape(P_ * Sc), e_order)
+            flat_co = jnp.take(cok.reshape(P_ * Sc), e_order) & \
+                jnp.take(e_slot_valid, e_order)
+            n_elems = jnp.sum(e_counts).astype(jnp.int32)
+            cols.append(list_from_packed(flat_l, flat_v, flat_cd,
+                                         flat_co, n_elems,
+                                         dtype.element_type))
+            continue
         if dtype == dt.STRING:
             padded, lens, valid = spec
             w = padded.shape[-1]

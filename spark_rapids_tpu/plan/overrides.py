@@ -608,11 +608,19 @@ def _build_tpu_exec(plan: LogicalPlan, children: List[TpuExec],
         # collect_list/set carry ListColumn states the exchange
         # partitioner doesn't pack yet -> single-stage COMPLETE
         from ..exec.aggregate import COMPLETE, FINAL, PARTIAL
-        if any(isinstance(fn, (Agg.CollectList, Agg.ApproxPercentile))
-               for fn, _ in plan.agg_exprs):
-            # ListColumn-state aggregates run single-stage: the
-            # partition/shuffle layer moves primitive lanes only (list
-            # states would need a padded wire view like strings)
+
+        def _single_stage(fn) -> bool:
+            # list states shuffle via the packed child-plane layout
+            # (parallel/partition.py), but only for PRIMITIVE elements;
+            # string/nested-element collects stay single-stage
+            if isinstance(fn, (Agg.CollectList, Agg.ApproxPercentile)):
+                if isinstance(fn, Agg.ApproxPercentile):
+                    return False
+                t = fn.children[0].data_type(plan.children[0].schema)
+                return t == dt.STRING or t.is_nested or \
+                    (isinstance(t, dt.DecimalType) and t.is_wide)
+            return False
+        if any(_single_stage(fn) for fn, _ in plan.agg_exprs):
             return HashAggregateExec(children[0], plan.group_exprs,
                                      plan.agg_exprs, mode=COMPLETE)
         partial = HashAggregateExec(children[0], plan.group_exprs,

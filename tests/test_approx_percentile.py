@@ -91,3 +91,40 @@ def test_distributed_plan(session):
     for k in range(5):
         exact = np.quantile(vs[ks == k], 0.5)
         assert abs(out[k] - exact) < 3.0, (k, out[k], exact)
+
+
+def test_staged_plan_with_exchange():
+    """List states (t-digest centroids) survive the planner-inserted
+    partition/shuffle layer (packed child-plane wire format)."""
+    conf = SrtConf({"srt.shuffle.partitions": 4,
+                    "srt.sql.batchSizeRows": 512})
+    s = TpuSession(conf)
+    rng = np.random.default_rng(9)
+    ks = rng.integers(0, 6, 4000)
+    vs = rng.uniform(0, 100, 4000)
+    df = s.create_dataframe({"k": ks.tolist(), "v": vs.tolist()})
+    q = df.group_by("k").agg(ApproxPercentile(col("v"), 0.5).alias("m"))
+    tree = overrides.apply_overrides(q.plan, conf).tree_string()
+    assert "ShuffleExchange" in tree and "partial" in tree, tree
+    out = {r["k"]: r["m"] for r in q.collect()}
+    for k in range(6):
+        exact = np.quantile(vs[ks == k], 0.5)
+        assert abs(out[k] - exact) < 3.0, (k, out[k], exact)
+
+
+def test_staged_collect_list():
+    from spark_rapids_tpu.expr.aggregates import CollectList
+    conf = SrtConf({"srt.shuffle.partitions": 3,
+                    "srt.sql.batchSizeRows": 64})
+    s = TpuSession(conf)
+    n = 500
+    ks = [i % 7 for i in range(n)]
+    vs = [float(i) for i in range(n)]
+    df = s.create_dataframe({"k": ks, "v": vs})
+    q = df.group_by("k").agg(CollectList(col("v")).alias("xs"))
+    tree = overrides.apply_overrides(q.plan, conf).tree_string()
+    assert "ShuffleExchange" in tree, tree
+    out = {r["k"]: sorted(r["xs"]) for r in q.collect()}
+    for k in range(7):
+        want = sorted(v for kk, v in zip(ks, vs) if kk == k)
+        assert out[k] == want, k
