@@ -61,3 +61,36 @@ def test_mortgage_etl(session, tmp_path):
     # ML hand-off
     arrs = feats.to_device_arrays()
     assert arrs.num_rows > 0 and "ever_90" in arrs
+
+
+class TestTpcds:
+    """TPC-DS-shaped breadth (models/tpcds.py) — differential vs the
+    CPU oracle (BASELINE config 2's operator coverage)."""
+
+    @pytest.fixture(scope="class")
+    def tables(self, tmp_path_factory):
+        from spark_rapids_tpu.models import tpcds
+        from spark_rapids_tpu.plan import TpuSession
+        session = TpuSession()
+        d = str(tmp_path_factory.mktemp("tpcds"))
+        return tpcds.tpcds_tables(session, d, scale_rows=30_000)
+
+    def test_q3(self, tables):
+        from spark_rapids_tpu.models import tpcds
+        assert_tpu_cpu_equal_df(tpcds.q3(
+            tables["store_sales"], tables["date_dim"], tables["item"]))
+
+    def test_q42(self, tables):
+        from spark_rapids_tpu.models import tpcds
+        assert_tpu_cpu_equal_df(tpcds.q42(
+            tables["store_sales"], tables["date_dim"], tables["item"]))
+
+    def test_q55(self, tables):
+        from spark_rapids_tpu.models import tpcds
+        assert_tpu_cpu_equal_df(tpcds.q55(
+            tables["store_sales"], tables["date_dim"], tables["item"]))
+
+    def test_q68r(self, tables):
+        from spark_rapids_tpu.models import tpcds
+        assert_tpu_cpu_equal_df(tpcds.q68r(
+            tables["store_sales"], tables["date_dim"], tables["item"]))
