@@ -118,6 +118,21 @@ class CastA(Ast):
         self.e, self.to = e, to
 
 
+class OverA(Ast):
+    """fn OVER (PARTITION BY ... ORDER BY ... [ROWS|RANGE BETWEEN ...])"""
+
+    def __init__(self, fn, partition, order, frame):
+        self.fn = fn
+        self.partition = partition    # [Ast]
+        self.order = order            # [(Ast, asc, nulls_first)]
+        self.frame = frame            # (row_based, lo, hi) | None
+
+
+class ScalarSubqueryA(Ast):
+    def __init__(self, stmt):
+        self.stmt = stmt
+
+
 class TableRefA:
     def __init__(self, name, alias):
         self.name = name
@@ -321,6 +336,47 @@ class Parser:
                 break
         return out
 
+    def _maybe_over(self, fn: FnA) -> Ast:
+        if not self.at_kw("over"):
+            return fn
+        self.next()
+        self.expect_op("(")
+        partition = []
+        order = []
+        frame = None
+        if self.accept_kw("partition"):
+            self.expect_kw("by")
+            partition.append(self.parse_expr())
+            while self.accept_op(","):
+                partition.append(self.parse_expr())
+        if self.at_kw("order"):
+            order = self.parse_order_by()
+        kind = self.accept_kw("rows", "range")
+        if kind:
+            self.expect_kw("between")
+            lo = self._parse_frame_bound()
+            self.expect_kw("and")
+            hi = self._parse_frame_bound()
+            frame = (kind == "rows", lo, hi)
+        self.expect_op(")")
+        return OverA(fn, partition, order, frame)
+
+    def _parse_frame_bound(self):
+        """UNBOUNDED PRECEDING/FOLLOWING | CURRENT ROW | n PRECEDING |
+        n FOLLOWING -> None or signed int offset."""
+        if self.accept_kw("unbounded"):
+            self.next()  # preceding / following
+            return None
+        if self.accept_kw("current"):
+            self.expect_kw("row")
+            return 0
+        t = self.next()
+        if t.kind != "NUMBER":
+            raise SqlError(f"bad frame bound {t.value!r}")
+        n = int(t.value)
+        which = self.next().value.lower()
+        return -n if which == "preceding" else n
+
     def parse_table_ref(self):
         if self.accept_op("("):
             stmt = self.parse_select_core()
@@ -442,6 +498,14 @@ class Parser:
             return LitA(t.value)
         if t.kind == "OP" and t.value == "(":
             self.next()
+            if self.at_kw("select"):
+                stmt = self.parse_select_core()
+                while self.at_kw("union"):
+                    self.next()
+                    all_ = bool(self.accept_kw("all"))
+                    stmt = UnionA(stmt, self.parse_select_core(), all_)
+                self.expect_op(")")
+                return ScalarSubqueryA(stmt)
             e = self.parse_expr()
             self.expect_op(")")
             return e
@@ -505,16 +569,16 @@ class Parser:
             self.next()
             if self.accept_op("*"):
                 self.expect_op(")")
-                return FnA(lower, [], star=True)
+                return self._maybe_over(FnA(lower, [], star=True))
             if self.at_op(")"):
                 self.next()
-                return FnA(lower, [])
+                return self._maybe_over(FnA(lower, []))
             distinct = bool(self.accept_kw("distinct"))
             args = [self.parse_expr()]
             while self.accept_op(","):
                 args.append(self.parse_expr())
             self.expect_op(")")
-            return FnA(lower, args, distinct=distinct)
+            return self._maybe_over(FnA(lower, args, distinct=distinct))
         # qualified name / star
         if self.at_op("."):
             self.next()
@@ -971,6 +1035,17 @@ class Analyzer:
             bool(group_asts) or \
             (s.having is not None)
 
+        def _has_window(e) -> bool:
+            from ..expr.window import WindowExpression
+            if isinstance(e, WindowExpression):
+                return True
+            return any(_has_window(c) for c in e.children)
+        if has_agg and any(_has_window(e) for e in lowered):
+            raise SqlError(
+                "window functions over aggregated output are not "
+                "supported in one SELECT; aggregate in a subquery "
+                "first (SELECT ... OVER(...) FROM (SELECT ...))")
+
         if not has_agg:
             pre_sort = []
             post_sort = []
@@ -1118,6 +1193,24 @@ class Analyzer:
     def lower(self, ast: Ast, scope: _Scope) -> Expression:
         if isinstance(ast, ColA):
             return col(scope.resolve(ast.name, ast.qualifier))
+        if isinstance(ast, ScalarSubqueryA):
+            # scalar subquery: execute now, inline the value (the
+            # uncorrelated-subquery path of SURVEY §2.4 #43; correlated
+            # subqueries are not supported)
+            sub = self.analyze(ast.stmt)
+            rows = sub.collect()
+            if len(sub.schema) != 1:
+                raise SqlError("scalar subquery must return one column")
+            if len(rows) > 1:
+                raise SqlError("scalar subquery returned more than one "
+                               "row")
+            name = sub.schema[0][0]
+            value = rows[0][name] if rows else None
+            from ..expr.core import Literal
+            return Literal(value, sub.schema[0][1]) \
+                if value is not None else Literal(None, sub.schema[0][1])
+        if isinstance(ast, OverA):
+            return self._lower_over(ast, scope)
         if isinstance(ast, LitA):
             return lit(ast.value)
         if isinstance(ast, IntervalA):
@@ -1216,6 +1309,55 @@ class Analyzer:
         if iv.unit in ("year",):
             return D.AddMonths(base, lit(n * 12))
         raise SqlError(f"unsupported interval unit {iv.unit!r}")
+
+    def _lower_over(self, ast: OverA, scope) -> Expression:
+        from ..expr import window as W
+        from ..plan.logical import SortField
+        fn = ast.fn
+        name = fn.name
+        args = [self.lower(a, scope) for a in fn.args]
+        if name == "row_number":
+            func = W.RowNumber()
+        elif name == "rank":
+            func = W.Rank()
+        elif name == "dense_rank":
+            func = W.DenseRank()
+        elif name == "percent_rank":
+            func = W.PercentRank()
+        elif name == "ntile":
+            from .parser import LitA as _L
+            if not fn.args or not isinstance(fn.args[0], LitA):
+                raise SqlError("ntile(n) needs an integer literal")
+            func = W.NTile(int(fn.args[0].value))
+        elif name in ("lead", "lag"):
+            off = 1
+            default = None
+            if len(fn.args) >= 2:
+                if not isinstance(fn.args[1], LitA):
+                    raise SqlError(f"{name} offset must be a literal")
+                off = int(fn.args[1].value)
+            if len(fn.args) >= 3:
+                if not isinstance(fn.args[2], LitA):
+                    raise SqlError(f"{name} default must be a literal")
+                default = fn.args[2].value
+            cls = W.Lead if name == "lead" else W.Lag
+            func = cls(args[0], off, default)
+        elif name in _AGG_FNS or name in ("count",):
+            func = self._lower_fn(fn, scope)
+            if not isinstance(func, Agg.AggregateFunction):
+                raise SqlError(f"{name} is not a window function")
+        else:
+            raise SqlError(f"unsupported window function {name!r}")
+        spec = W.WindowSpec(
+            [self.lower(p, scope) for p in ast.partition],
+            [SortField(self.lower(o, scope), asc,
+                       asc if nf is None else nf)
+             for o, asc, nf in ast.order])
+        if ast.frame is not None:
+            row_based, lo, hi = ast.frame
+            spec = spec.with_frame(W.WindowFrame(lo, hi,
+                                                 row_based=row_based))
+        return func.over(spec)
 
     def _lower_fn(self, ast: FnA, scope) -> Expression:
         name = ast.name

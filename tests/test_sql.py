@@ -300,3 +300,61 @@ def test_group_by_ordinal_out_of_range(session, tiny):
         session.sql("select k from tiny group by 3")
     with pytest.raises(SqlError, match="position"):
         session.sql("select k from tiny group by 0")
+
+
+class TestWindowsAndSubqueries:
+    """OVER(...) clauses and scalar subqueries in session.sql()."""
+
+    @pytest.fixture(scope="class")
+    def wsession(self):
+        s = TpuSession()
+        df = s.create_dataframe({
+            "k": ["a", "a", "a", "b", "b"],
+            "o": [1, 2, 3, 1, 2],
+            "v": [10.0, 20.0, 30.0, 5.0, 15.0],
+        })
+        s.create_or_replace_temp_view("t", df)
+        return s
+
+    def test_row_number_and_running_sum(self, wsession):
+        out = wsession.sql(
+            "SELECT k, o, row_number() OVER (PARTITION BY k ORDER BY o)"
+            " AS rn, sum(v) OVER (PARTITION BY k ORDER BY o ROWS BETWEEN"
+            " UNBOUNDED PRECEDING AND CURRENT ROW) AS rs FROM t"
+            " ORDER BY k, o").collect()
+        assert [(r["k"], r["o"], r["rn"], r["rs"]) for r in out] == [
+            ("a", 1, 1, 10.0), ("a", 2, 2, 30.0), ("a", 3, 3, 60.0),
+            ("b", 1, 1, 5.0), ("b", 2, 2, 20.0)]
+
+    def test_rank_desc_and_lead(self, wsession):
+        out = wsession.sql(
+            "SELECT k, v, rank() OVER (PARTITION BY k ORDER BY v DESC)"
+            " AS r, lead(v, 1) OVER (PARTITION BY k ORDER BY o) AS nx"
+            " FROM t ORDER BY k, v").collect()
+        by = {(r["k"], r["v"]): r for r in out}
+        assert by[("a", 30.0)]["r"] == 1
+        assert by[("a", 10.0)]["r"] == 3
+        assert by[("a", 10.0)]["nx"] == 20.0
+        assert by[("a", 30.0)]["nx"] is None
+
+    def test_scalar_subquery(self, wsession):
+        out = wsession.sql(
+            "SELECT k, v FROM t WHERE v > (SELECT avg(v) FROM t)"
+            " ORDER BY v").collect()
+        # avg = 16.0
+        assert [(r["k"], r["v"]) for r in out] == [("a", 20.0),
+                                                   ("a", 30.0)]
+
+    def test_window_over_aggregate_rejected(self, wsession):
+        import pytest as _pt
+
+        from spark_rapids_tpu.sql.parser import SqlError
+        with _pt.raises(SqlError, match="window"):
+            wsession.sql("SELECT k, rank() OVER (ORDER BY sum(v)) "
+                         "FROM t GROUP BY k")
+        # the documented workaround parses and runs
+        out = wsession.sql(
+            "SELECT k, sv, rank() OVER (ORDER BY sv DESC) AS r FROM "
+            "(SELECT k, sum(v) AS sv FROM t GROUP BY k) s "
+            "ORDER BY r").collect()
+        assert [(r["k"], r["r"]) for r in out] == [("a", 1), ("b", 2)]
