@@ -358,6 +358,14 @@ FILECACHE_LOCAL_FS = conf("srt.filecache.useForLocalFiles") \
          "for slow network mounts that look local).") \
     .boolean(False)
 
+DEBUG_DUMP_PATH = conf("srt.debug.dumpPath") \
+    .doc("When set, each operator keeps its most recent output batch "
+         "and an execution failure dumps them all (plus the plan tree "
+         "and error) under this directory as parquet for offline "
+         "replay (DumpUtils.scala crash-dump role). Debug tool: holds "
+         "one extra batch per operator alive.") \
+    .string("")
+
 EXTRA_PLUGINS = conf("srt.plugins") \
     .doc("Comma-separated 'pkg.module:attr' entries loaded at "
          "initialize: each attr is called with the active conf "

@@ -7,6 +7,8 @@ computeNumPermits :106), GpuMetric ESSENTIAL/MODERATE/DEBUG levels.
 
 from __future__ import annotations
 
+import os
+
 import threading
 import time
 from typing import Dict, Iterator, List, Optional, Sequence
@@ -198,6 +200,37 @@ class ExecContext:
         #: multi-host execution context (parallel/cluster.py
         #: ClusterTaskContext); None = single-process run
         self.cluster = None
+        #: crash-dump ring (srt.debug.dumpPath): exec_id -> last batch
+        self.last_batches: Dict[str, tuple] = {}
+        self._dumped = False
+
+    def dump_crash(self, failing_exec, error: BaseException,
+                   dump_dir: str) -> Optional[str]:
+        """Write every operator's last output batch + the plan tree +
+        the error under dump_dir (once per query) so the failure
+        replays offline (DumpUtils crash-dump role). Returns the dump
+        directory."""
+        if self._dumped:
+            return None
+        self._dumped = True
+        import time as _time
+
+        from ..utils.dump import dump_batch
+        out = os.path.join(dump_dir,
+                           f"crash-{int(_time.time() * 1e3)}")
+        os.makedirs(out, exist_ok=True)
+        with open(os.path.join(out, "plan.txt"), "w") as f:
+            f.write(failing_exec.tree_string() + "\n\n")
+            f.write(f"failing operator: "
+                    f"{failing_exec.node_description()}\n")
+            f.write(f"error: {type(error).__name__}: {error}\n")
+        for exec_id, (desc, batch) in list(self.last_batches.items()):
+            safe = exec_id.replace("#", "_")
+            try:
+                dump_batch(batch, out, prefix=safe)
+            except Exception:
+                pass  # best-effort: a corrupt batch may be the cause
+        return out
 
     def metrics_for(self, exec_id: str) -> Dict[str, Metric]:
         return self.metrics.setdefault(exec_id, {})
@@ -260,6 +293,8 @@ class TpuExec:
                                Metric("numOutputBatches", Metric.MODERATE))
         optime = m.setdefault("opTime", Metric("opTime", Metric.MODERATE,
                                                "ns"))
+        from ..conf import DEBUG_DUMP_PATH
+        dump_dir = ctx.conf.get(DEBUG_DUMP_PATH)
         it = iter(self.do_execute(ctx))
         while True:
             with SelfTimer(ctx.timer_stack, optime, self.exec_id):
@@ -267,8 +302,15 @@ class TpuExec:
                     batch = next(it)
                 except StopIteration:
                     return
+                except BaseException as e:
+                    if dump_dir:
+                        ctx.dump_crash(self, e, dump_dir)
+                    raise
             rows.add(int(batch.num_rows))
             batches.add(1)
+            if dump_dir:
+                ctx.last_batches[self.exec_id] = \
+                    (self.node_description(), batch)
             yield batch
 
     def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:

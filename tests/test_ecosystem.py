@@ -135,3 +135,36 @@ def test_extra_plugin_loader(tmp_path, monkeypatch):
     assert out == ["plugin-object"]
     import my_srt_plugin
     assert my_srt_plugin.LOADED
+
+
+def test_crash_dump_and_replay(tmp_path):
+    """srt.debug.dumpPath: a failing operator dumps every operator's
+    last batch + the plan + the error; dumps replay through the reader
+    (DumpUtils crash-dump role)."""
+    import pytest
+
+    from spark_rapids_tpu.conf import SrtConf
+    from spark_rapids_tpu.expr import col, raise_error
+    from spark_rapids_tpu.expr.misc import RaiseErrorException
+    from spark_rapids_tpu.plan import TpuSession
+    dump_dir = str(tmp_path / "dumps")
+    conf = SrtConf({"srt.debug.dumpPath": dump_dir})
+    s = TpuSession(conf)
+    df = s.create_dataframe({"v": [1.0, 2.0, 3.0]})
+    # first projection succeeds (its batch is retained), second raises
+    q = df.select((col("v") * 2).alias("w")) \
+        .select("w", raise_error("kaboom").alias("e"))
+    with pytest.raises(RaiseErrorException):
+        q.collect()
+    crashes = os.listdir(dump_dir)
+    assert len(crashes) == 1
+    crash = os.path.join(dump_dir, crashes[0])
+    files = sorted(os.listdir(crash))
+    assert "plan.txt" in files
+    plan_txt = open(os.path.join(crash, "plan.txt")).read()
+    assert "kaboom" in plan_txt and "Project" in plan_txt
+    parquets = [f for f in files if f.endswith(".parquet")]
+    assert parquets  # upstream operator batches captured
+    from spark_rapids_tpu.utils.dump import load_dump
+    replay = load_dump(TpuSession(), os.path.join(crash, parquets[0]))
+    assert replay.collect()  # loads and executes
