@@ -282,8 +282,10 @@ class ClusterDriver:
     """Coordinates registration, shuffle barriers, and job execution
     across workers."""
 
-    def __init__(self, num_workers: int, host: str = "127.0.0.1"):
+    def __init__(self, num_workers: int, host: str = "127.0.0.1",
+                 barrier_timeout: float = 120.0):
         self.num_workers = num_workers
+        self.barrier_timeout = barrier_timeout
         self._workers: List[Tuple[socket.socket, str]] = []
         self._registered = threading.Event()
         self._barriers: Dict = {}
@@ -332,7 +334,7 @@ class ClusterDriver:
             if b is None:
                 b = self._barriers[shuffle_id] = threading.Barrier(
                     self.num_workers)
-        b.wait(timeout=120)
+        b.wait(timeout=self.barrier_timeout)
 
     def _gather(self, key, worker: int, payload) -> List:
         with self._block:
@@ -342,7 +344,7 @@ class ClusterDriver:
                     "data": {},
                     "barrier": threading.Barrier(self.num_workers)}
         g["data"][worker] = payload
-        g["barrier"].wait(timeout=120)
+        g["barrier"].wait(timeout=self.barrier_timeout)
         return [g["data"].get(w) for w in range(self.num_workers)]
 
     def wait_for_workers(self, timeout: float = 60.0) -> None:
@@ -433,10 +435,11 @@ class ClusterDriver:
                 _send_msg(sock, {"type": "reset"})
                 # drain stale replies of the aborted attempt (a worker
                 # stuck at a now-aborted barrier first reports its job
-                # error, THEN processes the reset)
-                sock.settimeout(150)
+                # error, THEN processes the reset); budget covers a full
+                # worker-side barrier timeout plus slack
+                sock.settimeout(self.barrier_timeout * 2 + 60)
                 try:
-                    for _ in range(8):
+                    for _ in range(32):
                         reply = _recv_msg(sock)
                         if reply is None:
                             break

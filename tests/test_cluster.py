@@ -114,20 +114,22 @@ def test_worker_loss_recovery(dataset):
     """Losing a worker between jobs re-runs on the survivors
     (failure-detection/recovery role, SURVEY §5): results stay correct
     because sharding re-derives from the surviving worker set."""
-    driver = ClusterDriver(num_workers=3)
+    driver = ClusterDriver(num_workers=3, barrier_timeout=20)
     procs = launch_local_workers(driver, 3)
+    job_conf = {"srt.shuffle.partitions": 4,
+                "srt.cluster.barrierTimeoutSec": 20}
     try:
         driver.wait_for_workers(timeout=90)
         session = TpuSession(SrtConf({}))
         plan = _logical(session, dataset,
                         lambda f, d: f.group_by("k").agg(
                             Alias(CountStar(), "c")))
-        first = driver.run(plan, {"srt.shuffle.partitions": 4})
+        first = driver.run(plan, job_conf)
         assert len(first) == 50
         # kill one worker; the next job must still produce full results
         procs[1].kill()
         procs[1].wait(timeout=10)
-        rows = driver.run(plan, {"srt.shuffle.partitions": 4})
+        rows = driver.run(plan, job_conf)
         assert driver.num_workers == 2
         got = {r["k"]: r["c"] for r in rows}
         want = {r["k"]: r["c"] for r in first}
