@@ -110,6 +110,9 @@ class ClusterTaskContext:
     def barrier(self, shuffle_id: int) -> None:
         """Block until every worker's map side for shuffle_id is
         written (driver-released)."""
+        if os.environ.get("SRT_CLUSTER_DEBUG"):
+            print(f"[w{self.worker_id}] barrier {shuffle_id}",
+                  file=sys.stderr, flush=True)
         with socket.create_connection(self.driver_addr,
                                       timeout=self._timeout()) as s:
             _send_msg(s, {"type": "barrier", "shuffle_id": shuffle_id,
@@ -122,6 +125,9 @@ class ClusterTaskContext:
         """All-gather a picklable payload across workers through the
         driver (GpuRangePartitioner.sketch-to-driver role); returns the
         payloads in worker order."""
+        if os.environ.get("SRT_CLUSTER_DEBUG"):
+            print(f"[w{self.worker_id}] gather {key}",
+                  file=sys.stderr, flush=True)
         with socket.create_connection(self.driver_addr,
                                       timeout=self._timeout()) as s:
             _send_msg(s, {"type": "gather", "key": key,
@@ -480,12 +486,20 @@ def launch_local_workers(driver: ClusterDriver, n: int,
         os.path.abspath(__file__))))
     base_env["PYTHONPATH"] = root + os.pathsep + \
         base_env.get("PYTHONPATH", "")
-    for _ in range(n):
+    import tempfile
+    for i in range(n):
+        # NEVER leave workers on an undrained PIPE: XLA's per-compile
+        # cache warnings are large, and a full 64K pipe blocks the
+        # worker mid-write (a deadlock that worsens as the compile
+        # cache grows). Logs go to files for post-mortem instead.
+        log_path = os.path.join(tempfile.gettempdir(),
+                                f"srt_worker_{os.getpid()}_{i}.log")
+        log_f = open(log_path, "wb")
         procs.append(subprocess.Popen(
             [sys.executable, "-m", "spark_rapids_tpu.parallel.cluster",
              "--driver", f"{host}:{port}"],
-            env=base_env, stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE))
+            env=base_env, stdout=log_f, stderr=subprocess.STDOUT))
+        log_f.close()
     return procs
 
 
