@@ -4,17 +4,26 @@ collect — vs a single-process pandas CPU baseline running the same
 queries over the same parquet files (the stand-in for CPU Spark until a
 real cluster baseline is captured). BASELINE.md config 1.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
-``value`` is q6 end-to-end throughput in Mrows/s over the lineitem
-table; ``vs_baseline`` is the speedup over the pandas baseline (>1 =
-faster). Extra keys carry q1/q3 wall-clocks, the kernel-only q6 number
-(so regressions are attributable to kernels vs the pipeline around
-them), effective scan bandwidth, and a measured-roofline HBM utilization
-estimate for the kernel pipeline.
+Prints JSON lines as stages complete; the LAST line is the full record:
+{"metric", "value", "unit", "vs_baseline", ...}. ``value`` is q6
+end-to-end throughput in Mrows/s over the lineitem table;
+``vs_baseline`` is the speedup over the pandas baseline (>1 = faster).
+Earlier lines are prefixes of the same record (so a timeout kill still
+leaves the q6 number on stdout). Extra keys carry q1/q3 wall-clocks,
+the kernel-only q6 number (so regressions are attributable to kernels
+vs the pipeline around them), effective scan bandwidth, and a
+measured-roofline HBM utilization estimate for the kernel pipeline.
+
+Budget discipline (the round-2 bench TIMED OUT, rc=124, and recorded
+nothing): the backend probe is capped at 30s, the parquet inputs are
+generated once into a repo-local cache that persists across runs, every
+XLA compile round-trips the persistent compilation cache, and a
+wall-clock budget (SRT_BENCH_BUDGET, default 240s) skips the remaining
+stages — emitting what completed — rather than overrunning.
 
 Environment knobs: SRT_BENCH_SCALE (lineitem rows, default 6,000,000 =
-SF1-shaped), SRT_BENCH_ITERS, SRT_BENCH_DIR (parquet cache; data is
-generated once per scale and reused).
+SF1-shaped; auto-reduced to 1.5M on the CPU fallback backend),
+SRT_BENCH_ITERS, SRT_BENCH_DIR (parquet cache), SRT_BENCH_BUDGET.
 """
 
 import json
@@ -24,10 +33,9 @@ import time
 
 import numpy as np
 
-SCALE = int(os.environ.get("SRT_BENCH_SCALE", 6_000_000))
-ITERS = int(os.environ.get("SRT_BENCH_ITERS", 3))
-DATA_DIR = os.environ.get("SRT_BENCH_DIR",
-                          f"/tmp/srt_bench_sf_{SCALE}")
+T_START = time.monotonic()
+BUDGET = float(os.environ.get("SRT_BENCH_BUDGET", 240))
+ITERS = int(os.environ.get("SRT_BENCH_ITERS", 2))
 KERNEL_ROWS = 1 << 22
 KERNEL_ITERS = 10
 
@@ -37,22 +45,41 @@ Q6_BYTES_PER_ROW = 8 * 3 + 4
 
 
 def log(msg: str) -> None:
-    print(msg, file=sys.stderr, flush=True)
+    print(f"[{time.monotonic() - T_START:6.1f}s] {msg}",
+          file=sys.stderr, flush=True)
 
 
-def ensure_data():
-    """Generate (once) lineitem/orders/customer parquet at SCALE."""
+def left(label: str, need: float = 15.0) -> bool:
+    """True if at least ``need`` seconds of budget remain."""
+    rem = BUDGET - (time.monotonic() - T_START)
+    if rem < need:
+        log(f"budget exhausted before {label} ({rem:.0f}s left)")
+        return False
+    return True
+
+
+RESULT = {"metric": "tpch_q6_e2e_throughput", "value": None,
+          "unit": "Mrows/s", "vs_baseline": None}
+
+
+def emit(final: bool = False) -> None:
+    RESULT["partial"] = not final
+    print(json.dumps(RESULT), flush=True)
+
+
+def ensure_data(scale: int, data_dir: str) -> dict:
+    """Generate (once) lineitem/orders/customer parquet at ``scale``."""
     from spark_rapids_tpu.datagen import generate_table, lineitem_spec, \
         orders_spec
     from spark_rapids_tpu.models.tpch import customer_spec
-    specs = (lineitem_spec(SCALE), orders_spec(max(SCALE // 4, 1)),
-             customer_spec(max(SCALE // 40, 1)))
+    specs = (lineitem_spec(scale), orders_spec(max(scale // 4, 1)),
+             customer_spec(max(scale // 40, 1)))
     for spec in specs:
-        out = os.path.join(DATA_DIR, spec.name)
+        out = os.path.join(data_dir, spec.name)
         if not (os.path.isdir(out) and os.listdir(out)):
             log(f"generating {spec.name} ({spec.num_rows} rows)...")
             generate_table(None, spec, out, chunk_rows=1 << 20)
-    return {s.name: os.path.join(DATA_DIR, s.name) for s in specs}
+    return {s.name: os.path.join(data_dir, s.name) for s in specs}
 
 
 def _best(fn, iters):
@@ -208,7 +235,7 @@ def measured_peak_bw_gbs() -> float:
     return (2 * 4 * n) / t / 1e9  # read + write
 
 
-def _ensure_live_backend(probe_timeout_s: int = 180) -> None:
+def _ensure_live_backend(probe_timeout_s: int = 30) -> None:
     """The axon TPU tunnel can wedge so hard that jax backend init
     hangs forever. Probe it in a THROWAWAY subprocess first; if the
     probe hangs or fails, fall back to the CPU backend so the bench
@@ -235,47 +262,73 @@ def _ensure_live_backend(probe_timeout_s: int = 180) -> None:
 
 def main():
     _ensure_live_backend()
-    paths = ensure_data()
-    log("pandas baselines...")
-    cpu = {name: _best(lambda fn=fn: fn(paths), max(ITERS - 1, 1))
-           for name, fn in (("q6", pandas_q6), ("q1", pandas_q1),
-                            ("q3", pandas_q3))}
-    log(f"pandas: {cpu}")
+    # the package import must precede ANY jax backend touch: the axon
+    # plugin force-sets jax_platforms at import and only the package
+    # re-asserts a JAX_PLATFORMS=cpu request before backends initialize
+    import spark_rapids_tpu  # noqa: F401
+    import jax
+    backend = jax.default_backend()
+    RESULT["backend"] = backend
+
+    scale = int(os.environ.get("SRT_BENCH_SCALE", 0))
+    if not scale:
+        # the CPU fallback runs the same honest pipeline but ~50x
+        # slower than the chip; shrink so the bench fits the budget
+        # (the recorded "rows" keeps the number interpretable)
+        scale = 6_000_000 if backend != "cpu" else 1_500_000
+    data_dir = os.environ.get(
+        "SRT_BENCH_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".bench_cache", f"sf_{scale}"))
+    RESULT["rows"] = scale
+
+    paths = ensure_data(scale, data_dir)
+    log("data ready")
 
     session = framework_session()
     queries = framework_queries(session, paths)
-    tpu = {}
-    for name in ("q6", "q1", "q3"):
-        queries[name]()  # warm: compile + populate caches
-        tpu[name] = _best(queries[name], ITERS)
-        log(f"framework {name}: {tpu[name]:.3f}s "
-            f"(pandas {cpu[name]:.3f}s, {cpu[name] / tpu[name]:.2f}x)")
 
-    kq6 = kernel_q6_seconds()
-    peak = measured_peak_bw_gbs()
-    kernel_mrows = KERNEL_ROWS / kq6 / 1e6
-    kernel_bytes_s = KERNEL_ROWS * (4 * 4) / kq6  # 4 f32/i32 cols
-    e2e_mrows = SCALE / tpu["q6"] / 1e6
-    scan_gbs = SCALE * Q6_BYTES_PER_ROW / tpu["q6"] / 1e9
+    # --- q6: the headline number, first so a timeout still records it
+    queries["q6"]()  # warm: compile + populate caches
+    q6_s = _best(queries["q6"], ITERS)
+    cpu_q6 = _best(lambda: pandas_q6(paths), 1)
+    RESULT.update({
+        "value": round(scale / q6_s / 1e6, 2),
+        "q6_s": round(q6_s, 4),
+        "vs_baseline": round(cpu_q6 / q6_s, 3),
+        "q6_effective_gb_s": round(
+            scale * Q6_BYTES_PER_ROW / q6_s / 1e9, 2),
+    })
+    log(f"q6: {q6_s:.3f}s (pandas {cpu_q6:.3f}s)")
+    emit()
 
-    import jax
-    print(json.dumps({
-        "metric": "tpch_q6_e2e_throughput",
-        "backend": jax.default_backend(),
-        "value": round(e2e_mrows, 2),
-        "unit": "Mrows/s",
-        "vs_baseline": round(cpu["q6"] / tpu["q6"], 3),
-        "rows": SCALE,
-        "q6_s": round(tpu["q6"], 4),
-        "q1_s": round(tpu["q1"], 4),
-        "q3_s": round(tpu["q3"], 4),
-        "q1_vs_baseline": round(cpu["q1"] / tpu["q1"], 3),
-        "q3_vs_baseline": round(cpu["q3"] / tpu["q3"], 3),
-        "q6_kernel_mrows_s": round(kernel_mrows, 1),
-        "q6_effective_gb_s": round(scan_gbs, 2),
-        "kernel_hbm_util_est": round(kernel_bytes_s / 1e9 / peak, 4),
-        "measured_peak_gb_s": round(peak, 1),
-    }))
+    # --- q1/q3 breadth numbers
+    for name, baseline in (("q1", pandas_q1), ("q3", pandas_q3)):
+        if not left(name, need=60):
+            break
+        queries[name]()
+        t = _best(queries[name], max(ITERS - 1, 1))
+        c = _best(lambda: baseline(paths), 1)
+        RESULT[f"{name}_s"] = round(t, 4)
+        RESULT[f"{name}_vs_baseline"] = round(c / t, 3)
+        log(f"{name}: {t:.3f}s (pandas {c:.3f}s)")
+        emit()
+
+    # --- kernel-only q6 + measured roofline (HBM utilization estimate)
+    if backend == "cpu":
+        global KERNEL_ITERS
+        KERNEL_ITERS = 3  # ~3.5s/iter on the CPU fallback
+    if left("kernel metrics", need=60):
+        kq6 = kernel_q6_seconds()
+        peak = measured_peak_bw_gbs()
+        kernel_bytes_s = KERNEL_ROWS * (4 * 4) / kq6  # 4 f32/i32 cols
+        RESULT.update({
+            "q6_kernel_mrows_s": round(KERNEL_ROWS / kq6 / 1e6, 1),
+            "kernel_hbm_util_est": round(kernel_bytes_s / 1e9 / peak, 4),
+            "measured_peak_gb_s": round(peak, 1),
+        })
+        log(f"kernel q6: {kq6 * 1e3:.2f}ms, peak {peak:.0f} GB/s")
+    emit(final=True)
 
 
 if __name__ == "__main__":

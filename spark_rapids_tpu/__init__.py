@@ -46,10 +46,45 @@ if _os.environ.get("JAX_PLATFORMS") == "cpu":
 # disable.
 if "JAX_COMPILATION_CACHE_DIR" not in _os.environ:
     # per-uid path: a fixed shared /tmp name would let another local
-    # user pre-create (denying the cache) or poison cached executables
+    # user pre-create (denying the cache) or poison cached executables.
+    # The dir is also fingerprinted by CPU features: XLA:CPU persists
+    # AOT machine code keyed only by HLO, so an entry written on a host
+    # with (say) AMX loaded on a host without it warns per-load and
+    # risks SIGILL.
+    def _machine_tag() -> str:
+        # cpuinfo flags don't capture XLA's pseudo target features
+        # (prefer-no-scatter etc.), so same-machine loads can still
+        # warn; the tag only prevents CROSS-machine/jaxlib reuse where
+        # mismatched AOT code could genuinely SIGILL
+        import hashlib
+        import platform
+        tag = platform.machine()
+        try:
+            import jaxlib
+            tag += f"-{jaxlib.__version__}"
+        except Exception:
+            pass
+        try:
+            with open("/proc/cpuinfo") as f:
+                for line in f:
+                    if line.startswith("flags"):
+                        tag += hashlib.sha1(
+                            " ".join(sorted(line.split()))
+                            .encode()).hexdigest()[:10]
+                        break
+        except OSError:
+            pass
+        return tag
+
+    _uid = _os.getuid() if hasattr(_os, "getuid") else 0
     _jax.config.update(
         "jax_compilation_cache_dir",
-        f"/tmp/srt_jax_cache-{_os.getuid() if hasattr(_os, 'getuid') else 0}")
-    _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        f"/tmp/srt_jax_cache-{_uid}-{_machine_tag()}")
+    # persist EVERY compile: the engine builds fresh jit wrappers per
+    # query plan, so the in-memory pjit cache never carries across
+    # collect() calls — sub-0.5s compiles (most operator kernels on
+    # CPU; many on TPU) must round-trip the disk cache or every query
+    # pays full recompilation
+    _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
 
 from . import columnar  # noqa: F401,E402

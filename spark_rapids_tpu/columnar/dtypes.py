@@ -224,6 +224,14 @@ class MapType(DType):
     def is_nested(self) -> bool:
         return True
 
+    @property
+    def element_type(self) -> "DType":
+        """The physical entry type — maps ARE list<struct<key,value>>,
+        so list machinery that asks for the element type keeps working
+        on map-typed columns."""
+        return StructType((("key", self.key_type),
+                           ("value", self.value_type)))
+
 
 # Singletons (Spark-style)
 BOOL = BooleanType()

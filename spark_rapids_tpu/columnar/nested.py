@@ -35,12 +35,20 @@ class ListColumn:
     __slots__ = ("offsets", "child", "validity", "dtype", "pad_bucket")
 
     def __init__(self, offsets: jax.Array, child, validity: jax.Array,
-                 element_type: dt.DType, pad_bucket: int = 16):
+                 element_type: dt.DType, pad_bucket: int = 16,
+                 map_type: Optional[dt.MapType] = None):
         self.offsets = offsets
         self.child = child
         self.validity = validity
-        self.dtype = dt.ArrayType(element_type)
+        # maps ARE list<struct<key,value>> physically; map_type keeps
+        # the logical map-ness through transformations so host
+        # round-trips rebuild dicts (GpuColumnVector's LIST-backed MAP)
+        self.dtype = map_type or dt.ArrayType(element_type)
         self.pad_bucket = pad_bucket
+
+    @property
+    def _map_type(self) -> Optional[dt.MapType]:
+        return self.dtype if isinstance(self.dtype, dt.MapType) else None
 
     @property
     def capacity(self) -> int:
@@ -55,7 +63,8 @@ class ListColumn:
 
     def with_validity(self, validity: jax.Array) -> "ListColumn":
         return ListColumn(self.offsets, self.child, validity,
-                          self.dtype.element_type, self.pad_bucket)
+                          self.dtype.element_type, self.pad_bucket,
+                          map_type=self._map_type)
 
     def element_lanes(self):
         """Dense (capacity, pad_bucket) view of a primitive child:
@@ -111,21 +120,29 @@ class ListColumn:
         new_child = self.child.gather(
             jnp.clip(src_idx, 0, self.child_capacity - 1), elem_valid)
         return ListColumn(new_offsets, new_child, validity,
-                          self.dtype.element_type, self.pad_bucket)
+                          self.dtype.element_type, self.pad_bucket,
+                          map_type=self._map_type)
 
     def to_numpy(self, num_rows: Optional[int] = None):
-        """Host copy: object array of python lists (logical values)."""
+        """Host copy: object array of python lists (logical values);
+        map-typed columns rebuild dicts from their entry structs."""
         from .vector import from_physical
         n = self.capacity if num_rows is None else int(num_rows)
         offs = np.asarray(self.offsets)
         child_vals, child_mask = self.child.to_numpy()
         et = self.dtype.element_type
+        as_map = self._map_type is not None
         out = np.empty(n, dtype=object)
         for i in range(n):
             lo, hi = int(offs[i]), int(offs[i + 1])
-            out[i] = [
+            items = [
                 (_child_value(child_vals, child_mask, j, et))
                 for j in range(lo, hi)]
+            if as_map:
+                out[i] = {e["key"]: e["value"] for e in items
+                          if e is not None}
+            else:
+                out[i] = items
         return out, np.asarray(self.validity)[:n]
 
     def __repr__(self):
@@ -200,13 +217,15 @@ class StructColumn:
 
 def _lc_flatten(v: ListColumn):
     return ((v.offsets, v.child, v.validity),
-            (v.dtype.element_type, v.pad_bucket))
+            (v.dtype, v.pad_bucket))
 
 
 def _lc_unflatten(aux, children):
-    et, pad = aux
+    dtype, pad = aux
     offsets, child, validity = children
-    return ListColumn(offsets, child, validity, et, pad)
+    mt = dtype if isinstance(dtype, dt.MapType) else None
+    return ListColumn(offsets, child, validity, dtype.element_type,
+                      pad, map_type=mt)
 
 
 jax.tree_util.register_pytree_node(ListColumn, _lc_flatten, _lc_unflatten)
@@ -238,15 +257,18 @@ def nested_column_from_pylist(values, capacity: int, dtype: dt.DType,
     valid = np.array([v is not None for v in values], dtype=bool) \
         if mask is None else np.asarray(mask, dtype=bool)
     if isinstance(dtype, dt.MapType):
-        # map = list<struct<key,value>>: values are dicts
-        as_lists = [None if v is None else
-                    [{"key": k, "value": val} for k, val in v.items()]
-                    for v in values]
+        # map = list<struct<key,value>>: values are dicts (or pair
+        # sequences, the form pyarrow's to_pylist yields for pa.map_)
+        def entries(v):
+            pairs = v.items() if isinstance(v, dict) else v
+            return [{"key": k, "value": val} for k, val in pairs]
+        as_lists = [None if v is None else entries(v) for v in values]
         inner = dt.StructType((("key", dtype.key_type),
                                ("value", dtype.value_type)))
         lc = nested_column_from_pylist(as_lists, capacity,
                                        dt.ArrayType(inner), valid)
-        return lc
+        return ListColumn(lc.offsets, lc.child, lc.validity, inner,
+                          lc.pad_bucket, map_type=dtype)
     if isinstance(dtype, dt.ArrayType):
         lens = np.array([0 if v is None else len(v) for v in values],
                         dtype=np.int32)

@@ -329,6 +329,36 @@ def choose_capacity(n: int, minimum: int = 8) -> int:
     return round_pow2(n, minimum)
 
 
+def _encode_strings(values, valid: np.ndarray, n: int):
+    """utf-8 encode a host string column -> (lengths[int32], bytes).
+    Invalid/None slots encode as zero-length. The hot path hands the
+    whole column to pyarrow (C-speed layout) instead of per-row Python
+    encode; anything pyarrow rejects (mixed/str-coercible objects)
+    falls back to the per-row loop."""
+    import pyarrow as pa
+    vals = values.tolist() if isinstance(values, np.ndarray) else list(values)
+    if not valid.all():
+        vals = [v if (m and v is not None) else None
+                for v, m in zip(vals, valid)]
+    try:
+        arr = pa.array(vals, type=pa.string(), from_pandas=True)
+    except (pa.lib.ArrowInvalid, pa.lib.ArrowTypeError):
+        encoded = [b"" if not valid[i] or vals[i] is None
+                   else str(vals[i]).encode("utf-8") for i in range(n)]
+        lens = np.fromiter((len(e) for e in encoded), dtype=np.int32,
+                           count=n)
+        return lens, np.frombuffer(b"".join(encoded), dtype=np.uint8)
+    off_buf, data_buf = arr.buffers()[1], arr.buffers()[2]
+    off = np.frombuffer(off_buf, dtype=np.int32)[
+        arr.offset:arr.offset + n + 1]
+    lens = np.diff(off)
+    data = (np.frombuffer(data_buf, dtype=np.uint8)[off[0]:off[n]]
+            if data_buf is not None and n else np.empty(0, np.uint8))
+    # null slots in an arrow array built from python lists carry
+    # zero-length extents already, matching the engine invariant
+    return lens.astype(np.int32), data
+
+
 def column_from_numpy(values: np.ndarray, capacity: int,
                       dtype: Optional[dt.DType] = None,
                       mask: Optional[np.ndarray] = None) -> Column:
@@ -340,9 +370,7 @@ def column_from_numpy(values: np.ndarray, capacity: int,
     valid = np.ones(n, dtype=bool) if mask is None else np.asarray(mask, dtype=bool)
 
     if dtype == dt.STRING:
-        encoded = [b"" if not valid[i] or values[i] is None else str(values[i]).encode("utf-8")
-                   for i in range(n)]
-        lens = np.fromiter((len(e) for e in encoded), dtype=np.int32, count=n)
+        lens, data = _encode_strings(values, valid, n)
         offsets = np.zeros(capacity + 1, dtype=np.int32)
         offsets[1:n + 1] = np.cumsum(lens)
         offsets[n + 1:] = offsets[n]
@@ -350,7 +378,7 @@ def column_from_numpy(values: np.ndarray, capacity: int,
         char_cap = max(_round_up(total, 128), 128)
         chars = np.zeros(char_cap, dtype=np.uint8)
         if total:
-            chars[:total] = np.frombuffer(b"".join(encoded), dtype=np.uint8)
+            chars[:total] = data[:total]
         validity = np.zeros(capacity, dtype=bool)
         validity[:n] = valid
         max_len = int(lens.max()) if n else 0

@@ -437,6 +437,20 @@ PYTHON_WORKERS_MAX = conf("srt.python.workers.max") \
          "queries. (python/rapids/daemon.py worker pool role)") \
     .check(_positive).integer(4)
 
+DPP_ENABLED = conf("srt.sql.dpp.enabled") \
+    .doc("Runtime dynamic partition pruning: when a broadcast join's "
+         "probe side scans a partitioned table on a partition column, "
+         "the materialized build side's distinct keys prune the scan's "
+         "file list before any probe file opens "
+         "(GpuSubqueryBroadcastExec / DynamicPruningExpression role).") \
+    .boolean(True)
+
+PYTHON_UDF_TIMEOUT = conf("srt.python.udf.timeoutSec") \
+    .doc("Seconds a single pandas-UDF batch may run in a worker before "
+         "the worker is killed and the job fails (guards against hung "
+         "UDFs wedging the engine; 0 disables).") \
+    .check(lambda v: v >= 0).integer(600)
+
 PALLAS_ENABLED = conf("srt.sql.pallas.enabled") \
     .doc("Execute eligible global filter+aggregate pipelines as fused "
          "pallas TPU kernels (one HBM pass, no filtered intermediate). "

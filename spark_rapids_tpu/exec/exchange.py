@@ -123,30 +123,36 @@ class ShuffleExchangeExec(TpuExec):
         return self.num_partitions or ctx.conf.get(SHUFFLE_PARTITIONS)
 
     def _partition_fn(self, num_parts: int, bounds=None):
+        """Jitted batch -> [partition batches]. The slice-out of every
+        partition lives INSIDE the jit: partitioning plus N slices is
+        one XLA program per batch structure instead of hundreds of
+        eager dispatches per map batch."""
         key = (num_parts, bounds is not None)
         if key not in self._jit_cache:
+            def slices(pb: PartitionedBatch):
+                return [partition_slice(pb, i) for i in range(num_parts)]
             if self.sort_orders:
                 orders = self.sort_orders
 
-                def run(batch: ColumnarBatch, bnds) -> PartitionedBatch:
+                def run(batch: ColumnarBatch, bnds):
                     keys = [o.expr.eval(batch) for o in orders]
                     pids = range_partition_ids(
                         keys, bnds,
                         [o.ascending for o in orders],
                         [o.nulls_first for o in orders])
-                    return partition_batch(batch, pids, num_parts)
+                    return slices(partition_batch(batch, pids, num_parts))
                 self._jit_cache[key] = jax.jit(run)
             elif self.key_exprs:
-                def run(batch: ColumnarBatch) -> PartitionedBatch:
+                def run(batch: ColumnarBatch):
                     keys = [e.eval(batch) for e in self.key_exprs]
                     pids = hash_partition_ids(keys, num_parts)
-                    return partition_batch(batch, pids, num_parts)
+                    return slices(partition_batch(batch, pids, num_parts))
                 self._jit_cache[key] = jax.jit(run)
             else:
-                def run(batch: ColumnarBatch) -> PartitionedBatch:
+                def run(batch: ColumnarBatch):
                     pids = round_robin_partition_ids(batch.capacity,
                                                      num_parts)
-                    return partition_batch(batch, pids, num_parts)
+                    return slices(partition_batch(batch, pids, num_parts))
                 self._jit_cache[key] = jax.jit(run)
         return self._jit_cache[key]
 
@@ -267,8 +273,9 @@ class ShuffleExchangeExec(TpuExec):
                 for batch in self.children[0].execute(ctx):
                     if int(batch.num_rows) == 0:
                         continue
-                    held.append(SpillableBatch(batch,
-                                               SpillPriority.ACTIVE_ON_DECK))
+                    held.append(SpillableBatch(
+                        K.compact_for_transfer(batch),
+                        SpillPriority.ACTIVE_ON_DECK))
                 batches = [sb.get() for sb in held]
                 bounds, n_bounds = self._compute_bounds(ctx, batches,
                                                         n_parts)
@@ -276,9 +283,11 @@ class ShuffleExchangeExec(TpuExec):
                 for batch in batches:
                     t0 = time.perf_counter_ns()
                     with ctx.semaphore:
-                        pb = fn(batch, bounds)
-                        parts = [partition_slice(pb, i)
-                                 for i in range(n_parts)]
+                        # per-slice compaction: each slice carries the
+                        # full input capacity (static worst-case skew
+                        # bound) but typically holds ~1/P of the rows
+                        parts = [K.compact_for_transfer(p)
+                                 for p in fn(batch, bounds)]
                     part_time.add(time.perf_counter_ns() - t0)
                     write_rows.add(int(batch.num_rows))
                     mgr.write_map_output(self.shuffle_id, map_id, parts)
@@ -287,14 +296,14 @@ class ShuffleExchangeExec(TpuExec):
                 for sb in held:
                     sb.close()
             return
-        fn = self._partition_fn(n_parts)
         for batch in self.children[0].execute(ctx):
             if int(batch.num_rows) == 0:
                 continue
             t0 = time.perf_counter_ns()
             with ctx.semaphore:
-                pb = fn(batch)
-                parts = [partition_slice(pb, i) for i in range(n_parts)]
+                batch = K.compact_for_transfer(batch)
+                fn = self._partition_fn(n_parts)
+                parts = [K.compact_for_transfer(p) for p in fn(batch)]
             part_time.add(time.perf_counter_ns() - t0)
             write_rows.add(int(batch.num_rows))
             mgr.write_map_output(self.shuffle_id, map_id, parts)
@@ -353,6 +362,7 @@ class ShuffleExchangeExec(TpuExec):
 
         def read_group(g):
             for reduce_id in g:
+                ctx.partition_id = reduce_id
                 yield from mgr.read_partition(self.shuffle_id, reduce_id)
         try:
             for g in groups:
@@ -380,14 +390,22 @@ class ShuffleExchangeExec(TpuExec):
             peers = ctx.cluster.peers
 
             def remote_read(reduce_id):
+                ctx.partition_id = reduce_id
                 yield from fetch_all_partitions(peers, self.shuffle_id,
                                                 reduce_id)
             for reduce_id in ctx.cluster.assigned(n_parts):
                 yield remote_read(reduce_id)
+            # no unregister here: PEERS fetch this worker's blocks until
+            # the whole job completes — the driver's post-job reset (or
+            # failure-path reset) frees them (cluster.py _run_once)
             return
+
+        def local_read(reduce_id):
+            ctx.partition_id = reduce_id
+            yield from mgr.read_partition(self.shuffle_id, reduce_id)
         try:
             for reduce_id in range(n_parts):
-                yield mgr.read_partition(self.shuffle_id, reduce_id)
+                yield local_read(reduce_id)
         finally:
             mgr.unregister_shuffle(self.shuffle_id)
 

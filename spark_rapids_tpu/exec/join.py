@@ -391,6 +391,74 @@ class _HashJoinBase(TpuExec):
                 yield out
         return filtered()
 
+    # set True on broadcast joins: their build side fully materializes
+    # BEFORE the probe's first scan file opens, so its keys can prune
+    # partitioned probe scans (shuffled joins run the probe map phase
+    # first — too late to prune)
+    _dpp_capable = False
+
+    def _dpp_scans(self, node, name: str):
+        """Partitioned FileSourceScanExecs below ``node`` that column
+        ``name`` passes through UNCHANGED (conservative walk — any node
+        that might rename/compute the column stops the descent)."""
+        from ..io.scan import FileSourceScanExec
+        from .basic import (CoalesceBatchesExec, FilterExec, LocalLimitExec,
+                            ProjectExec)
+        if isinstance(node, FileSourceScanExec):
+            if any(k == name for k, _ in node.scan.partition_schema):
+                yield node
+            return
+        if isinstance(node, ProjectExec):
+            from ..expr.core import Alias, ColumnRef
+            for e, (out_name, _) in zip(node.exprs, node.output_schema):
+                if out_name != name:
+                    continue
+                inner = e.children[0] if isinstance(e, Alias) else e
+                if isinstance(inner, ColumnRef) and inner.name == name:
+                    yield from self._dpp_scans(node.children[0], name)
+                return
+            return
+        if isinstance(node, (FilterExec, CoalesceBatchesExec,
+                             LocalLimitExec)):
+            yield from self._dpp_scans(node.children[0], name)
+            return
+        # unknown/multi-child operator: don't assume pass-through
+
+    def _runtime_partition_prune(self, ctx: ExecContext,
+                                 build: ColumnarBatch) -> None:
+        """Runtime DPP (GpuSubqueryBroadcastExec:1-299 +
+        GpuDynamicPruningExpression role): the materialized build
+        side's distinct join-key values become a partition-value filter
+        on probe-side partitioned scans."""
+        from ..conf import DPP_ENABLED
+        from ..expr.core import ColumnRef
+        if not self._dpp_capable or not ctx.conf.get(DPP_ENABLED):
+            return
+        if self.join_type not in (INNER, LEFT_SEMI):
+            # outer/anti joins PRESERVE unmatched probe rows — pruning
+            # their files would drop them
+            return
+        probe_child = self.children[0] if self.build_side == "right" \
+            else self.children[1]
+        for pk, bk in zip(self._probe_key_exprs, self._build_key_exprs):
+            if not isinstance(pk, ColumnRef):
+                continue
+            scans = list(self._dpp_scans(probe_child, pk.name))
+            if not scans:
+                continue
+            kcol = bk.eval(build)
+            vals, mask = kcol.to_numpy(int(build.num_rows))
+            keys = {v.item() if hasattr(v, "item") else v
+                    for v, ok in zip(vals, mask) if ok}
+            m = ctx.metrics_for(self.exec_id)
+            m.setdefault("dppFilters",
+                         Metric("dppFilters", Metric.MODERATE)).add(
+                len(scans))
+            for s in scans:
+                f = dict(s.runtime_part_filter or {})
+                f[pk.name] = keys
+                s.runtime_part_filter = f
+
     def _join_partition(self, ctx: ExecContext, probe_stream,
                         build_stream) -> Iterator[ColumnarBatch]:
         """Join one (probe partition, build partition) pair."""
@@ -402,6 +470,7 @@ class _HashJoinBase(TpuExec):
         if build is None:
             yield from self._empty_result(probe_stream, ctx)
             return
+        self._runtime_partition_prune(ctx, build)
         probe_stream = self._bloom_prefilter(ctx, probe_stream, build)
         threshold = ctx.conf.get(JOIN_SUB_PARTITION_ROWS)
         if int(build.num_rows) > threshold and (self.left_keys or
@@ -453,8 +522,12 @@ class ShuffledHashJoinExec(_HashJoinBase):
         from ..conf import (ADAPTIVE_BROADCAST_ROWS, ADAPTIVE_ENABLED,
                             BROADCAST_THRESHOLD_ROWS)
         from .exchange import ShuffleExchangeExec
+        # cluster mode: materialized_row_counts and execute_partitioned
+        # here see only THIS worker's assigned reduce partitions; a
+        # local downgrade decision would drop other workers' build rows
+        # (mirrors HashAggregateExec._child_partitions gating)
         if not ctx.conf.get(ADAPTIVE_ENABLED) or \
-                self.preserve_partitioning:
+                self.preserve_partitioning or ctx.cluster is not None:
             return None
         build_child = self.children[1] if self.build_side == "right" \
             else self.children[0]
@@ -492,6 +565,7 @@ class ShuffledHashJoinExec(_HashJoinBase):
         from .exchange import ShuffleExchangeExec
         l, r = self.children[0], self.children[1]
         if ctx.conf.get(ADAPTIVE_ENABLED) and \
+                ctx.cluster is None and \
                 not self.preserve_partitioning and \
                 isinstance(l, ShuffleExchangeExec) and \
                 isinstance(r, ShuffleExchangeExec):
@@ -541,6 +615,8 @@ class BroadcastHashJoinExec(_HashJoinBase):
     BroadcastExchangeExec; the probe side streams through unexchanged.
     Under a mesh the build side is replicated to every device
     (all_gather)."""
+
+    _dpp_capable = True
 
     def required_child_distributions(self):
         from ..plan.distribution import (BroadcastDistribution,

@@ -6,11 +6,21 @@ in the submodules (inventory mirrors SURVEY §2.5).
 """
 
 from . import aggregates, arithmetic, cast, collections, conditional, core, \
-    datetime, hashing, mathfns, predicates, strings
+    datetime, hashing, higher_order, mathfns, predicates, strings
 from .collections import (ArrayContains, ArrayMax, ArrayMin, CreateArray,
                           CreateNamedStruct, ElementAt, Explode,
                           GetArrayItem, GetStructField, Size, SortArray,
                           array, explode, explode_outer, posexplode, struct)
+from .higher_order import (ArrayAggregate, ArrayExists, ArrayFilter,
+                           ArrayForAll, ArrayTransform, CreateMap,
+                           GetMapValue, LambdaVariable, MapContainsKey,
+                           MapEntries, MapFilter, MapFromArrays, MapKeys,
+                           MapValues, TransformKeys, TransformValues,
+                           aggregate, create_map, exists, filter_, forall,
+                           get_map_value, map_contains_key, map_entries,
+                           map_filter, map_from_arrays, map_keys,
+                           map_values, transform, transform_keys,
+                           transform_values)
 from .aggregates import (AggregateFunction, Average, Count, CountStar, First,
                          Last, Max, Min, StddevPop, StddevSamp, Sum,
                          VariancePop, VarianceSamp)

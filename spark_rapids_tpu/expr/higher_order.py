@@ -1,0 +1,842 @@
+"""Higher-order functions (lambda expressions over arrays/maps) and the
+map expression surface.
+
+Rebuild of the reference's higherOrderFunctions.scala (GpuLambdaFunction,
+GpuNamedLambdaVariable, GpuArrayTransform :221, GpuArrayExists :352,
+GpuArrayFilter :412, GpuTransformKeys :450, GpuTransformValues :516,
+GpuMapFilter :559) and GpuMapUtils.scala (map_keys/map_values/entries,
+GpuGetMapValue, GpuElementAt-on-map).
+
+TPU lowering: a lambda body is an ordinary Expression evaluated over the
+ELEMENT LANES of the list — the dense ``(capacity, pad_bucket)`` view
+from ``ListColumn.element_lanes`` flattened row-major to one synthetic
+batch of ``capacity * pad_bucket`` rows. The lambda variable becomes a
+plain column of that batch; outer-scope columns the body references are
+gathered (repeated per lane) into it. One ``eval`` of the body then
+computes the lambda for every element of every row at once — no per-row
+loop, and XLA fuses the whole thing. ``aggregate`` is the exception: it
+folds sequentially over lanes with ``lax.scan`` (the accumulator chain
+is inherently sequential), with the merge body traced ONCE.
+
+Maps are list<struct<key,value>> (columnar/nested.py:240), so every map
+function lowers to list machinery over the key/value children.
+
+Null semantics follow Spark:
+- transform of a null array -> null; lambda sees null elements,
+- exists: true if any true, else null if any null result, else false
+  (3-valued, matching Spark's ArrayExists with followThreeValuedLogic),
+- filter drops elements whose predicate is null or false,
+- aggregate threads nulls through the merge lambda,
+- element_at(map, k) of a missing key -> null.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar import dtypes as dt
+from ..columnar.nested import ListColumn, StructColumn
+from ..columnar.vector import (Column, ColumnVector, ColumnarBatch,
+                               round_pow2)
+from .core import Expression, Schema, make_result
+
+_VAR_IDS = itertools.count()
+
+
+class LambdaVariable(Expression):
+    """A named lambda parameter (GpuNamedLambdaVariable). Its dtype is
+    bound by the enclosing higher-order function when IT is typed
+    against the outer schema; eval reads the synthetic lane batch."""
+
+    def __init__(self, name: Optional[str] = None):
+        super().__init__()
+        self.name = name or f"lambda_x#{next(_VAR_IDS)}"
+        self._dtype: Optional[dt.DType] = None
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        if self._dtype is None:
+            raise TypeError(
+                f"unbound lambda variable {self.name} (typed outside "
+                f"its higher-order function?)")
+        return self._dtype
+
+    def references(self) -> set:
+        return set()  # bound, not free — never demanded from the input
+
+    def eval(self, batch: ColumnarBatch) -> Column:
+        return batch.column(self.name)
+
+    def __repr__(self):
+        return self.name
+
+
+def _outer_refs(body: Expression, vars_: Sequence[LambdaVariable]) -> set:
+    bound = {v.name for v in vars_}
+    refs = set()
+
+    def walk(e: Expression):
+        if isinstance(e, LambdaVariable):
+            return
+        from .core import ColumnRef
+        if isinstance(e, ColumnRef):
+            refs.add(e.name)
+        for c in e.children:
+            walk(c)
+    walk(body)
+    return refs - bound
+
+
+class HigherOrderFunction(Expression):
+    """Common machinery: lane-batch construction + lambda binding."""
+
+    #: subclasses list their lambda vars here (in binding order)
+    lambda_vars: Sequence[LambdaVariable] = ()
+
+    def references(self) -> set:
+        refs = set()
+        for c in self.children:
+            refs |= c.references()
+        return refs - {v.name for v in self.lambda_vars}
+
+    # --- typing helpers ---
+    def _array_type(self, schema: Schema) -> dt.ArrayType:
+        t = self.children[0].data_type(schema)
+        if isinstance(t, dt.MapType):
+            return dt.ArrayType(dt.StructType(
+                (("key", t.key_type), ("value", t.value_type))))
+        if not isinstance(t, dt.ArrayType):
+            raise TypeError(f"{type(self).__name__} expects an array, "
+                            f"got {t}")
+        return t
+
+    # --- lane-batch construction ---
+    def _lane_batch(self, batch: ColumnarBatch, lc: ListColumn,
+                    bindings: dict) -> ColumnarBatch:
+        """The synthetic element-level batch: ``capacity*pad_bucket``
+        rows, lambda-var columns from ``bindings``, plus any outer
+        columns the body references (gathered so row i's value repeats
+        across row i's lanes)."""
+        cap, w = lc.capacity, lc.pad_bucket
+        n = cap * w
+        names, cols = [], []
+        for name, column in bindings.items():
+            names.append(name)
+            cols.append(column)
+        outer = set()
+        for body in self._bodies():
+            outer |= _outer_refs(body, self.lambda_vars)
+        if outer:
+            rows = jnp.repeat(jnp.arange(cap, dtype=jnp.int32), w)
+            live = jnp.repeat(batch.live_mask(), w)
+            sub = batch.select([c for c in batch.names if c in outer])
+            expanded = sub.gather(rows, batch.num_rows * w)
+            # gather marks rows >= new_num_rows dead; lanes interleave
+            # so re-validate from the source row liveness instead
+            for name, column in zip(expanded.names, expanded.columns):
+                src = batch.column(name)
+                v = jnp.take(src.validity,
+                             jnp.clip(rows, 0, cap - 1)) & live
+                names.append(name)
+                cols.append(column.with_validity(v)
+                            if not isinstance(column, ColumnVector)
+                            else make_result(column.data, v, column.dtype))
+        return ColumnarBatch(cols, names, n)
+
+    def _bodies(self) -> Sequence[Expression]:
+        raise NotImplementedError
+
+    def _element_binding(self, lc: ListColumn, var: LambdaVariable,
+                         idx_var: Optional[LambdaVariable] = None) -> dict:
+        vals, lane_ok, elem_ok = lc.element_lanes()
+        cap, w = lc.capacity, lc.pad_bucket
+        bind = {var.name: ColumnVector(
+            vals.reshape(cap * w), elem_ok.reshape(cap * w),
+            lc.dtype.element_type)}
+        if idx_var is not None:
+            k = jnp.tile(jnp.arange(w, dtype=jnp.int32), cap)
+            bind[idx_var.name] = ColumnVector(
+                k, lane_ok.reshape(cap * w), dt.INT32)
+        return bind, lane_ok
+
+
+def _lanes_to_list(lc: ListColumn, new_vals: jnp.ndarray,
+                   new_ok: jnp.ndarray, element_type: dt.DType,
+                   offsets: Optional[jnp.ndarray] = None,
+                   child_cap: Optional[int] = None) -> ListColumn:
+    """Repack a (capacity, pad_bucket) lane block into a flat-child
+    ListColumn with the given offsets (defaults: the source's — same
+    lengths). Lanes must already be left-compacted per row."""
+    cap, w = new_vals.shape
+    offs = lc.offsets if offsets is None else offsets
+    ccap = child_cap or lc.child_capacity
+    pos = jnp.arange(ccap, dtype=jnp.int32)
+    row = jnp.searchsorted(offs[1:], pos, side="right").astype(jnp.int32)
+    row_c = jnp.clip(row, 0, cap - 1)
+    within = jnp.clip(pos - jnp.take(offs, row_c), 0, w - 1)
+    data = new_vals[row_c, within]
+    okv = new_ok[row_c, within] & (pos < offs[cap])
+    data = jnp.where(okv, data, jnp.zeros((), data.dtype))
+    child = ColumnVector(data, okv, element_type)
+    return ListColumn(offs, child, lc.validity, element_type,
+                      lc.pad_bucket)
+
+
+class ArrayTransform(HigherOrderFunction):
+    """transform(arr, x -> body) / transform(arr, (x, i) -> body)
+    (higherOrderFunctions.scala GpuArrayTransform:221)."""
+
+    def __init__(self, child: Expression, var: LambdaVariable,
+                 body: Expression,
+                 idx_var: Optional[LambdaVariable] = None):
+        super().__init__(child, body)
+        self.var = var
+        self.idx_var = idx_var
+        self.lambda_vars = (var,) + ((idx_var,) if idx_var else ())
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        at = self._array_type(schema)
+        self.var._dtype = at.element_type
+        if self.idx_var:
+            self.idx_var._dtype = dt.INT32
+        return dt.ArrayType(self.children[1].data_type(schema))
+
+    def _bodies(self):
+        return (self.children[1],)
+
+    def eval(self, batch: ColumnarBatch) -> ListColumn:
+        lc: ListColumn = self.children[0].eval(batch)
+        self.var._dtype = lc.dtype.element_type
+        bind, lane_ok = self._element_binding(lc, self.var, self.idx_var)
+        lanes = self._lane_batch(batch, lc, bind)
+        out = self.children[1].eval(lanes)
+        cap, w = lc.capacity, lc.pad_bucket
+        vals = out.data.reshape(cap, w)
+        ok = (out.validity.reshape(cap, w)) & lane_ok
+        return _lanes_to_list(lc, vals, ok, out.dtype)
+
+    def __repr__(self):
+        v = f"({self.var!r}, {self.idx_var!r})" if self.idx_var \
+            else repr(self.var)
+        return f"transform({self.children[0]!r}, {v} -> " \
+               f"{self.children[1]!r})"
+
+
+class ArrayExists(HigherOrderFunction):
+    """exists(arr, x -> pred) with Spark's three-valued logic
+    (GpuArrayExists:352): TRUE if any element satisfies, else NULL if
+    any predicate result was null, else FALSE."""
+
+    def __init__(self, child: Expression, var: LambdaVariable,
+                 body: Expression):
+        super().__init__(child, body)
+        self.var = var
+        self.lambda_vars = (var,)
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        at = self._array_type(schema)
+        self.var._dtype = at.element_type
+        return dt.BOOL
+
+    def _bodies(self):
+        return (self.children[1],)
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        lc: ListColumn = self.children[0].eval(batch)
+        self.var._dtype = lc.dtype.element_type
+        bind, lane_ok = self._element_binding(lc, self.var)
+        lanes = self._lane_batch(batch, lc, bind)
+        out = self.children[1].eval(lanes)
+        cap, w = lc.capacity, lc.pad_bucket
+        pred = out.data.reshape(cap, w)
+        pok = out.validity.reshape(cap, w)
+        any_true = jnp.any(lane_ok & pok & pred, axis=1)
+        any_null = jnp.any(lane_ok & ~pok, axis=1)
+        return make_result(any_true,
+                           lc.validity & (any_true | ~any_null),
+                           dt.BOOL)
+
+
+class ArrayForAll(ArrayExists):
+    """forall(arr, x -> pred): FALSE if any false, else NULL if any
+    null, else TRUE."""
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        lc: ListColumn = self.children[0].eval(batch)
+        self.var._dtype = lc.dtype.element_type
+        bind, lane_ok = self._element_binding(lc, self.var)
+        lanes = self._lane_batch(batch, lc, bind)
+        out = self.children[1].eval(lanes)
+        cap, w = lc.capacity, lc.pad_bucket
+        pred = out.data.reshape(cap, w)
+        pok = out.validity.reshape(cap, w)
+        any_false = jnp.any(lane_ok & pok & ~pred, axis=1)
+        any_null = jnp.any(lane_ok & ~pok, axis=1)
+        return make_result(~any_false,
+                           lc.validity & (any_false | ~any_null),
+                           dt.BOOL)
+
+
+class ArrayFilter(HigherOrderFunction):
+    """filter(arr, x -> pred) (GpuArrayFilter:412): keep elements whose
+    predicate is true-and-not-null; list lengths shrink."""
+
+    def __init__(self, child: Expression, var: LambdaVariable,
+                 body: Expression):
+        super().__init__(child, body)
+        self.var = var
+        self.lambda_vars = (var,)
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        at = self._array_type(schema)
+        self.var._dtype = at.element_type
+        self.children[1].data_type(schema)  # type the body
+        return at
+
+    def _bodies(self):
+        return (self.children[1],)
+
+    def eval(self, batch: ColumnarBatch) -> ListColumn:
+        lc: ListColumn = self.children[0].eval(batch)
+        self.var._dtype = lc.dtype.element_type
+        bind, lane_ok = self._element_binding(lc, self.var)
+        lanes = self._lane_batch(batch, lc, bind)
+        out = self.children[1].eval(lanes)
+        cap, w = lc.capacity, lc.pad_bucket
+        keep = lane_ok & (out.data & out.validity).reshape(cap, w)
+        vals, _, elem_ok = lc.element_lanes()
+        # left-compact kept lanes: stable argsort on ~keep
+        order = jnp.argsort(~keep, axis=1, stable=True)
+        vals_c = jnp.take_along_axis(vals, order, axis=1)
+        ok_c = jnp.take_along_axis(elem_ok & keep, order, axis=1)
+        lens = jnp.sum(keep, axis=1, dtype=jnp.int32)
+        lens = jnp.where(lc.validity, lens, 0)
+        offsets = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32), jnp.cumsum(lens, dtype=jnp.int32)])
+        return _lanes_to_list(lc, vals_c, ok_c, lc.dtype.element_type,
+                              offsets=offsets)
+
+
+class ArrayAggregate(HigherOrderFunction):
+    """aggregate(arr, zero, (acc, x) -> merge[, acc -> finish])
+    (higherOrderFunctions.scala GpuArrayAggregate role): sequential
+    fold over the lanes with lax.scan — the merge body traces ONCE and
+    runs ``pad_bucket`` times, each step advancing every row's
+    accumulator in parallel."""
+
+    def __init__(self, child: Expression, zero: Expression,
+                 acc_var: LambdaVariable, elem_var: LambdaVariable,
+                 merge: Expression,
+                 finish: Optional[Expression] = None):
+        children = [child, zero, merge] + ([finish] if finish else [])
+        super().__init__(*children)
+        self.acc_var = acc_var
+        self.elem_var = elem_var
+        self.has_finish = finish is not None
+        self.lambda_vars = (acc_var, elem_var)
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        at = self._array_type(schema)
+        self.elem_var._dtype = at.element_type
+        self.acc_var._dtype = self.children[1].data_type(schema)
+        merged = self.children[2].data_type(schema)
+        if merged != self.acc_var._dtype:
+            # Spark coerces; here the merge body must preserve acc type
+            self.acc_var._dtype = merged
+        if self.has_finish:
+            return self.children[3].data_type(schema)
+        return merged
+
+    def _bodies(self):
+        return tuple(self.children[2:])
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        import numpy as np
+        lc: ListColumn = self.children[0].eval(batch)
+        self.elem_var._dtype = lc.dtype.element_type
+        zero = self.children[1].eval(batch)
+        # accumulator dtype = the MERGE body's result type (bound by
+        # data_type during planning), not the zero's: acc+x*0.5 over an
+        # int zero must fold in double, and the scan carry's physical
+        # dtype is fixed across steps
+        acc_t = self.acc_var._dtype or zero.dtype
+        if acc_t != zero.dtype:
+            zero = make_result(
+                zero.data.astype(np.dtype(acc_t.physical)),
+                zero.validity, acc_t)
+        self.acc_var._dtype = acc_t
+        vals, lane_ok, elem_ok = lc.element_lanes()
+        cap, w = lc.capacity, lc.pad_bucket
+        merge = self.children[2]
+        outer = _outer_refs(merge, self.lambda_vars)
+        if outer:
+            raise RuntimeError(
+                "aggregate() merge lambda referencing outer columns is "
+                "not lowered on TPU (planner should have fallen back)")
+        et = lc.dtype.element_type
+        names = [self.acc_var.name, self.elem_var.name]
+
+        def step(carry, xs):
+            acc_data, acc_ok = carry
+            x_data, x_ok, l_ok = xs
+            b = ColumnarBatch(
+                [ColumnVector(acc_data, acc_ok, acc_t),
+                 ColumnVector(x_data, x_ok, et)], names, cap)
+            out = merge.eval(b)
+            nd = jnp.where(l_ok, out.data.astype(acc_data.dtype),
+                           acc_data)
+            nk = jnp.where(l_ok, out.validity, acc_ok)
+            return (nd, nk), None
+
+        xs = (vals.T, elem_ok.T, lane_ok.T)  # (w, cap)
+        (acc_data, acc_ok), _ = jax.lax.scan(
+            step, (zero.data, zero.validity), xs)
+        result = make_result(acc_data, acc_ok & lc.validity, acc_t)
+        if self.has_finish:
+            b = ColumnarBatch([result], [self.acc_var.name], cap)
+            out = self.children[3].eval(b)
+            return make_result(out.data, out.validity & lc.validity,
+                               out.dtype)
+        return result
+
+
+# ---------------------------------------------------------------------------
+# map expressions (GpuMapUtils.scala; maps are list<struct<key,value>>)
+# ---------------------------------------------------------------------------
+
+def _map_type(expr: Expression, schema: Schema) -> dt.MapType:
+    t = expr.data_type(schema)
+    if not isinstance(t, dt.MapType):
+        raise TypeError(f"expected map input, got {t}")
+    return t
+
+
+def _entries(col) -> ListColumn:
+    assert isinstance(col, ListColumn) and \
+        isinstance(col.child, StructColumn), f"not a map column: {col}"
+    return col
+
+
+def _key_list(lc: ListColumn, key_type: dt.DType) -> ListColumn:
+    return ListColumn(lc.offsets, lc.child.field("key"), lc.validity,
+                      key_type, lc.pad_bucket)
+
+
+def _value_list(lc: ListColumn, value_type: dt.DType) -> ListColumn:
+    return ListColumn(lc.offsets, lc.child.field("value"), lc.validity,
+                      value_type, lc.pad_bucket)
+
+
+class MapKeys(Expression):
+    """map_keys(m) (GpuMapUtils getKeysAsListView)."""
+
+    def __init__(self, child: Expression):
+        super().__init__(child)
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.ArrayType(_map_type(self.children[0], schema).key_type)
+
+    def eval(self, batch: ColumnarBatch) -> ListColumn:
+        lc = _entries(self.children[0].eval(batch))
+        return _key_list(lc, lc.child.dtype.fields[0][1])
+
+
+class MapValues(Expression):
+    """map_values(m) (GpuMapUtils getValuesAsListView)."""
+
+    def __init__(self, child: Expression):
+        super().__init__(child)
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.ArrayType(
+            _map_type(self.children[0], schema).value_type)
+
+    def eval(self, batch: ColumnarBatch) -> ListColumn:
+        lc = _entries(self.children[0].eval(batch))
+        return _value_list(lc, lc.child.dtype.fields[1][1])
+
+
+class MapEntries(Expression):
+    """map_entries(m) -> array<struct<key,value>> (the physical layout,
+    re-typed)."""
+
+    def __init__(self, child: Expression):
+        super().__init__(child)
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        mt = _map_type(self.children[0], schema)
+        return dt.ArrayType(dt.StructType(
+            (("key", mt.key_type), ("value", mt.value_type))))
+
+    def eval(self, batch: ColumnarBatch) -> ListColumn:
+        return _entries(self.children[0].eval(batch))
+
+
+class GetMapValue(Expression):
+    """m[key] / element_at(m, key): the value of the FIRST matching key,
+    null if absent (GpuGetMapValue / GpuElementAt on maps). Primitive
+    keys lower as a lane equality + argmax; string keys compare padded
+    lanes bytewise."""
+
+    def __init__(self, child: Expression, key: Expression):
+        super().__init__(child, key)
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return _map_type(self.children[0], schema).value_type
+
+    def eval(self, batch: ColumnarBatch):
+        lc = _entries(self.children[0].eval(batch))
+        needle = self.children[1].eval(batch)
+        key_child = lc.child.field("key")
+        key_t = lc.child.dtype.fields[0][1]
+        keys = ListColumn(lc.offsets, key_child, lc.validity, key_t,
+                          lc.pad_bucket)
+        vals, lane_ok, elem_ok = keys.element_lanes()
+        hit = elem_ok & (vals == needle.data[:, None])
+        found = jnp.any(hit, axis=1)
+        first = jnp.argmax(hit, axis=1).astype(jnp.int32)
+        ok = lc.validity & needle.validity & found
+        src = jnp.clip(lc.offsets[:-1] + first, 0,
+                       lc.child_capacity - 1)
+        value_child = lc.child.field("value")
+        return value_child.gather(src, ok)
+
+
+class MapContainsKey(Expression):
+    """map_contains_key(m, k)."""
+
+    def __init__(self, child: Expression, key: Expression):
+        super().__init__(child, key)
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        _map_type(self.children[0], schema)
+        return dt.BOOL
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        lc = _entries(self.children[0].eval(batch))
+        needle = self.children[1].eval(batch)
+        key_child = lc.child.field("key")
+        key_t = lc.child.dtype.fields[0][1]
+        keys = ListColumn(lc.offsets, key_child, lc.validity, key_t,
+                          lc.pad_bucket)
+        vals, _, elem_ok = keys.element_lanes()
+        found = jnp.any(elem_ok & (vals == needle.data[:, None]), axis=1)
+        return make_result(found, lc.validity & needle.validity, dt.BOOL)
+
+
+class TransformValues(HigherOrderFunction):
+    """transform_values(m, (k, v) -> body) (GpuTransformValues:516):
+    keys unchanged, values mapped."""
+
+    def __init__(self, child: Expression, key_var: LambdaVariable,
+                 val_var: LambdaVariable, body: Expression):
+        super().__init__(child, body)
+        self.key_var = key_var
+        self.val_var = val_var
+        self.lambda_vars = (key_var, val_var)
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        mt = _map_type(self.children[0], schema)
+        self.key_var._dtype = mt.key_type
+        self.val_var._dtype = mt.value_type
+        return dt.MapType(mt.key_type,
+                          self.children[1].data_type(schema))
+
+    def _bodies(self):
+        return (self.children[1],)
+
+    def _eval_mapped(self, batch: ColumnarBatch, map_keys: bool):
+        lc = _entries(self.children[0].eval(batch))
+        key_t = lc.child.dtype.fields[0][1]
+        val_t = lc.child.dtype.fields[1][1]
+        self.key_var._dtype, self.val_var._dtype = key_t, val_t
+        keys = _key_list(lc, key_t)
+        values = _value_list(lc, val_t)
+        kv, k_lane, k_ok = keys.element_lanes()
+        vv, lane_ok, v_ok = values.element_lanes()
+        cap, w = lc.capacity, lc.pad_bucket
+        n = cap * w
+        bind = {self.key_var.name: ColumnVector(
+                    kv.reshape(n), k_ok.reshape(n), key_t),
+                self.val_var.name: ColumnVector(
+                    vv.reshape(n), v_ok.reshape(n), val_t)}
+        lanes = self._lane_batch(batch, lc, bind)
+        out = self.children[1].eval(lanes)
+        new_vals = out.data.reshape(cap, w)
+        new_ok = out.validity.reshape(cap, w) & lane_ok
+        if map_keys:
+            new_keys = _lanes_to_list(lc, new_vals, new_ok, out.dtype)
+            st = dt.StructType((("key", out.dtype), ("value", val_t)))
+            child = StructColumn([new_keys.child,
+                                  lc.child.field("value")],
+                                 lc.child.validity, st)
+        else:
+            new_values = _lanes_to_list(lc, new_vals, new_ok, out.dtype)
+            st = dt.StructType((("key", key_t), ("value", out.dtype)))
+            child = StructColumn([lc.child.field("key"),
+                                  new_values.child],
+                                 lc.child.validity, st)
+        return ListColumn(lc.offsets, child, lc.validity, st,
+                          lc.pad_bucket)
+
+    def eval(self, batch: ColumnarBatch) -> ListColumn:
+        return self._eval_mapped(batch, map_keys=False)
+
+
+class TransformKeys(TransformValues):
+    """transform_keys(m, (k, v) -> body) (GpuTransformKeys:450). Spark
+    raises on null new keys; here a null result key nulls the entry
+    (documented deviation — the planner can force CPU via conf)."""
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        mt = _map_type(self.children[0], schema)
+        self.key_var._dtype = mt.key_type
+        self.val_var._dtype = mt.value_type
+        return dt.MapType(self.children[1].data_type(schema),
+                          mt.value_type)
+
+    def eval(self, batch: ColumnarBatch) -> ListColumn:
+        return self._eval_mapped(batch, map_keys=True)
+
+
+class MapFilter(HigherOrderFunction):
+    """map_filter(m, (k, v) -> pred) (GpuMapFilter:559)."""
+
+    def __init__(self, child: Expression, key_var: LambdaVariable,
+                 val_var: LambdaVariable, body: Expression):
+        super().__init__(child, body)
+        self.key_var = key_var
+        self.val_var = val_var
+        self.lambda_vars = (key_var, val_var)
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        mt = _map_type(self.children[0], schema)
+        self.key_var._dtype = mt.key_type
+        self.val_var._dtype = mt.value_type
+        self.children[1].data_type(schema)
+        return mt
+
+    def _bodies(self):
+        return (self.children[1],)
+
+    def eval(self, batch: ColumnarBatch) -> ListColumn:
+        lc = _entries(self.children[0].eval(batch))
+        key_t = lc.child.dtype.fields[0][1]
+        val_t = lc.child.dtype.fields[1][1]
+        self.key_var._dtype, self.val_var._dtype = key_t, val_t
+        keys = _key_list(lc, key_t)
+        values = _value_list(lc, val_t)
+        kv, _, k_ok = keys.element_lanes()
+        vv, lane_ok, v_ok = values.element_lanes()
+        cap, w = lc.capacity, lc.pad_bucket
+        n = cap * w
+        bind = {self.key_var.name: ColumnVector(
+                    kv.reshape(n), k_ok.reshape(n), key_t),
+                self.val_var.name: ColumnVector(
+                    vv.reshape(n), v_ok.reshape(n), val_t)}
+        lanes = self._lane_batch(batch, lc, bind)
+        out = self.children[1].eval(lanes)
+        keep = lane_ok & (out.data & out.validity).reshape(cap, w)
+        order = jnp.argsort(~keep, axis=1, stable=True)
+        kv_c = jnp.take_along_axis(kv, order, axis=1)
+        ko_c = jnp.take_along_axis(k_ok & keep, order, axis=1)
+        vv_c = jnp.take_along_axis(vv, order, axis=1)
+        vo_c = jnp.take_along_axis(v_ok & keep, order, axis=1)
+        lens = jnp.where(lc.validity,
+                         jnp.sum(keep, axis=1, dtype=jnp.int32), 0)
+        offsets = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32), jnp.cumsum(lens, dtype=jnp.int32)])
+        new_keys = _lanes_to_list(lc, kv_c, ko_c, key_t, offsets=offsets)
+        new_vals = _lanes_to_list(lc, vv_c, vo_c, val_t, offsets=offsets)
+        st = lc.child.dtype
+        entry_ok = new_keys.child.validity | new_vals.child.validity
+        child = StructColumn([new_keys.child, new_vals.child],
+                             entry_ok, st)
+        return ListColumn(offsets, child, lc.validity, st, lc.pad_bucket)
+
+
+class CreateMap(Expression):
+    """map(k1, v1, k2, v2, ...) (GpuCreateMap)."""
+
+    def __init__(self, *children: Expression):
+        assert len(children) % 2 == 0 and children, \
+            "map() needs key/value pairs"
+        super().__init__(*children)
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        from .conditional import _common_type
+        kt = _common_type([c.data_type(schema)
+                           for c in self.children[0::2]])
+        vt = _common_type([c.data_type(schema)
+                           for c in self.children[1::2]])
+        return dt.MapType(kt, vt)
+
+    def eval(self, batch: ColumnarBatch) -> ListColumn:
+        from .collections import CreateArray
+        keys = CreateArray(*self.children[0::2]).eval(batch)
+        vals = CreateArray(*self.children[1::2]).eval(batch)
+        st = dt.StructType((("key", keys.dtype.element_type),
+                            ("value", vals.dtype.element_type)))
+        entry_ok = keys.child.validity | vals.child.validity
+        child = StructColumn([keys.child, vals.child], entry_ok, st)
+        return ListColumn(keys.offsets, child, keys.validity, st,
+                          keys.pad_bucket)
+
+
+class MapFromArrays(Expression):
+    """map_from_arrays(keys, values) (GpuMapFromArrays role)."""
+
+    def __init__(self, keys: Expression, values: Expression):
+        super().__init__(keys, values)
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        kt = self.children[0].data_type(schema)
+        vt = self.children[1].data_type(schema)
+        if not (isinstance(kt, dt.ArrayType) and
+                isinstance(vt, dt.ArrayType)):
+            raise TypeError("map_from_arrays needs two arrays")
+        return dt.MapType(kt.element_type, vt.element_type)
+
+    def eval(self, batch: ColumnarBatch) -> ListColumn:
+        keys: ListColumn = self.children[0].eval(batch)
+        vals: ListColumn = self.children[1].eval(batch)
+        st = dt.StructType((("key", keys.dtype.element_type),
+                            ("value", vals.dtype.element_type)))
+        # zip by position: key i pairs value i; extents must match —
+        # mismatched rows null out (Spark raises; documented deviation)
+        same = keys.lengths() == vals.lengths()
+        validity = keys.validity & vals.validity & same
+        # align the value child onto the key child's offsets
+        kv, k_lane, k_ok = keys.element_lanes()
+        vv, v_lane, v_ok = vals.element_lanes()
+        w = max(keys.pad_bucket, vals.pad_bucket)
+        cap = keys.capacity
+
+        def widen(a, width):
+            if a.shape[1] == width:
+                return a
+            pad = width - a.shape[1]
+            return jnp.pad(a, ((0, 0), (0, pad)))
+        kv, k_ok = widen(kv, w), widen(k_ok, w)
+        vv, v_ok = widen(vv, w), widen(v_ok, w)
+        lens = jnp.where(validity, keys.lengths(), 0)
+        offsets = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32), jnp.cumsum(lens, dtype=jnp.int32)])
+        base = ListColumn(offsets, keys.child, validity,
+                          keys.dtype.element_type, w)
+        nk = _lanes_to_list(base, kv, k_ok, keys.dtype.element_type,
+                            offsets=offsets,
+                            child_cap=keys.child_capacity)
+        nv = _lanes_to_list(base, vv, v_ok, vals.dtype.element_type,
+                            offsets=offsets,
+                            child_cap=keys.child_capacity)
+        entry_ok = nk.child.validity | nv.child.validity
+        child = StructColumn([nk.child, nv.child], entry_ok, st)
+        return ListColumn(offsets, child, validity, st, w)
+
+
+# ---------------------------------------------------------------------------
+# python-lambda API (the DataFrame-side sugar)
+# ---------------------------------------------------------------------------
+
+def _one_arg(fn: Callable) -> tuple:
+    v = LambdaVariable()
+    return v, fn(v)
+
+
+def transform(arr, fn: Callable) -> ArrayTransform:
+    """transform(col, x -> expr) or (x, i) -> expr by arity."""
+    import inspect
+    from .core import _lit
+    arity = len(inspect.signature(fn).parameters)
+    if arity == 2:
+        x, i = LambdaVariable(), LambdaVariable()
+        return ArrayTransform(_lit(arr), x, _lit(fn(x, i)), idx_var=i)
+    x, body = _one_arg(fn)
+    return ArrayTransform(_lit(arr), x, _lit(body))
+
+
+def exists(arr, fn: Callable) -> ArrayExists:
+    from .core import _lit
+    x, body = _one_arg(fn)
+    return ArrayExists(_lit(arr), x, _lit(body))
+
+
+def forall(arr, fn: Callable) -> ArrayForAll:
+    from .core import _lit
+    x, body = _one_arg(fn)
+    return ArrayForAll(_lit(arr), x, _lit(body))
+
+
+def filter_(arr, fn: Callable) -> ArrayFilter:
+    from .core import _lit
+    x, body = _one_arg(fn)
+    return ArrayFilter(_lit(arr), x, _lit(body))
+
+
+def aggregate(arr, zero, merge: Callable,
+              finish: Optional[Callable] = None) -> ArrayAggregate:
+    from .core import _lit
+    acc, x = LambdaVariable(), LambdaVariable()
+    fin = None
+    if finish is not None:
+        facc = acc  # finish sees the same accumulator variable
+        fin = _lit(finish(facc))
+    return ArrayAggregate(_lit(arr), _lit(zero), acc, x,
+                          _lit(merge(acc, x)), fin)
+
+
+def map_keys(m) -> MapKeys:
+    from .core import _lit
+    return MapKeys(_lit(m))
+
+
+def map_values(m) -> MapValues:
+    from .core import _lit
+    return MapValues(_lit(m))
+
+
+def map_entries(m) -> MapEntries:
+    from .core import _lit
+    return MapEntries(_lit(m))
+
+
+def map_contains_key(m, k) -> MapContainsKey:
+    from .core import _lit
+    return MapContainsKey(_lit(m), _lit(k))
+
+
+def get_map_value(m, k) -> GetMapValue:
+    from .core import _lit
+    return GetMapValue(_lit(m), _lit(k))
+
+
+def transform_values(m, fn: Callable) -> TransformValues:
+    from .core import _lit
+    k, v = LambdaVariable(), LambdaVariable()
+    return TransformValues(_lit(m), k, v, _lit(fn(k, v)))
+
+
+def transform_keys(m, fn: Callable) -> TransformKeys:
+    from .core import _lit
+    k, v = LambdaVariable(), LambdaVariable()
+    return TransformKeys(_lit(m), k, v, _lit(fn(k, v)))
+
+
+def map_filter(m, fn: Callable) -> MapFilter:
+    from .core import _lit
+    k, v = LambdaVariable(), LambdaVariable()
+    return MapFilter(_lit(m), k, v, _lit(fn(k, v)))
+
+
+def create_map(*kv) -> CreateMap:
+    from .core import _lit
+    return CreateMap(*[_lit(e) for e in kv])
+
+
+def map_from_arrays(keys, values) -> MapFromArrays:
+    from .core import _lit
+    return MapFromArrays(_lit(keys), _lit(values))

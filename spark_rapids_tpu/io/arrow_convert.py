@@ -106,11 +106,14 @@ def _chunked_to_column(arr: pa.ChunkedArray) -> "HostColumn":
     mask = np.asarray(arr.is_valid())
     if out_t.is_nested:
         # LOGICAL python values (lists/dicts); pyarrow to_pylist already
-        # yields date/Decimal/datetime objects for nested leaves
+        # yields date/Decimal/datetime objects for nested leaves. Maps
+        # arrive as pair-lists from pa.map_ — the engine's logical map
+        # form is dict (host_table_to_arrow round-trips it back).
         items = arr.to_pylist()
+        as_map = isinstance(out_t, dt.MapType)
         vals = np.empty(n, dtype=object)
         for i, v in enumerate(items):
-            vals[i] = v
+            vals[i] = dict(v) if (as_map and v is not None) else v
         return HostColumn(vals, mask, out_t)
     if out_t == dt.STRING:
         vals = np.array([v if v is not None else ""

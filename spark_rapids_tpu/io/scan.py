@@ -571,6 +571,11 @@ class FileSourceScanExec(TpuExec):
         super().__init__()
         self.scan = scan
         self._schema = scan.schema
+        #: runtime dynamic partition pruning (GpuDynamicPruningExpression
+        #: role): {partition column -> allowed values}, installed by a
+        #: broadcast join after its build side materializes and BEFORE
+        #: this scan's first file opens
+        self.runtime_part_filter: Optional[dict] = None
         # partition columns live in directory names, not the files —
         # conjuncts over them must not reach the pyarrow file filter
         # (they drive pruned_paths instead)
@@ -605,6 +610,17 @@ class FileSourceScanExec(TpuExec):
             m.setdefault("partitionsPruned",
                          Metric("partitionsPruned",
                                 Metric.MODERATE)).add(pruned)
+        if self.runtime_part_filter:
+            before = len(scan_paths)
+            scan_paths = [
+                p for p in scan_paths
+                if all(self.scan.partition_values_for(p).get(k) in vals
+                       for k, vals in self.runtime_part_filter.items())]
+            m = ctx.metrics_for(self.exec_id)
+            m.setdefault("dppPrunedFiles",
+                         Metric("dppPrunedFiles",
+                                Metric.MODERATE)).add(
+                before - len(scan_paths))
 
         def pv(p):
             return self.scan.partition_values_for(p)

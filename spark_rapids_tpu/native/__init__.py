@@ -84,6 +84,15 @@ def _lib() -> ctypes.CDLL:
             lib.rows_to_columns.argtypes = [
                 u8p, ctypes.c_int64, ctypes.c_int64, i32p, i32p,
                 ctypes.c_int32, u8pp, u8pp]
+            # int64 size/return: default c_int truncation silently broke
+            # >=2GiB O_DIRECT spills (the size compare always failed and
+            # fell back to buffered npz)
+            lib.direct_write_file.restype = ctypes.c_int64
+            lib.direct_write_file.argtypes = [ctypes.c_char_p, u8p,
+                                              ctypes.c_int64]
+            lib.direct_read_file.restype = ctypes.c_int64
+            lib.direct_read_file.argtypes = [ctypes.c_char_p, u8p,
+                                             ctypes.c_int64]
             _LIB = lib
         return _LIB
 

@@ -520,9 +520,8 @@ def _concat_strings(cols: Sequence[StringColumn], caps, counts,
     return StringColumn(offsets, chars, validity, pad_bucket=pad)
 
 
-def concat_batches(batches: Sequence[ColumnarBatch],
-                   out_capacity: int) -> ColumnarBatch:
-    """Concatenate batches (same schema) into one batch of out_capacity."""
+def _concat_batches_impl(batches: Sequence[ColumnarBatch],
+                         out_capacity: int) -> ColumnarBatch:
     counts = [b.num_rows for b in batches]
     total = sum(int(c) if isinstance(c, int) else c for c in counts)
     caps = [b.capacity for b in batches]
@@ -532,6 +531,49 @@ def concat_batches(batches: Sequence[ColumnarBatch],
         cols = [b.columns[ci] for b in batches]
         out_cols.append(concat_columns(cols, caps, counts, out_capacity))
     return ColumnarBatch(out_cols, names, total)
+
+
+# one jit wrapper per output capacity; jax's trace cache inside each
+# wrapper keys on the input pytree structure (schemas, per-batch
+# capacities), with num_rows as TRACED leaves so varying live counts
+# never retrace. Without this every concat dispatched hundreds of tiny
+# eager XLA ops per call — the dominant cost of warm group-by queries.
+_CONCAT_JIT: dict = {}
+
+
+def concat_batches(batches: Sequence[ColumnarBatch],
+                   out_capacity: int) -> ColumnarBatch:
+    """Concatenate batches (same schema) into one batch of out_capacity."""
+    fn = _CONCAT_JIT.get(out_capacity)
+    if fn is None:
+        fn = jax.jit(lambda bs, cap=out_capacity:
+                     _concat_batches_impl(bs, cap))
+        _CONCAT_JIT[out_capacity] = fn
+    return fn(list(batches))
+
+
+_COMPACT_JIT: dict = {}
+
+
+def compact_for_transfer(batch: ColumnarBatch,
+                         slack: int = 4) -> ColumnarBatch:
+    """Shrink a sparse batch to a small power-of-two capacity before it
+    crosses a serialization/transfer boundary (shuffle write, broadcast,
+    collect). Operators keep their input's static capacity, so a
+    partial aggregate of a 512k-row batch emits a 512k-capacity batch
+    with a handful of live groups — serializing THAT pulls the whole
+    padded capacity off the device. Only compacts when it saves at
+    least ``slack``×; costs one host sync of the (scalar) row count."""
+    from ..columnar.vector import choose_capacity
+    n = int(batch.num_rows)
+    cap = choose_capacity(n)
+    if cap * slack > batch.capacity:
+        return batch
+    fn = _COMPACT_JIT.get(cap)
+    if fn is None:
+        fn = jax.jit(lambda b, c=cap: slice_batch(b, 0, b.num_rows, c))
+        _COMPACT_JIT[cap] = fn
+    return fn(batch)
 
 
 def slice_batch(batch: ColumnarBatch, start: int, length,

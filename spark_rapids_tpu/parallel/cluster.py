@@ -250,6 +250,10 @@ class ClusterWorker:
                   f"{physical.tree_string()}", file=sys.stderr, flush=True)
         ctx = ExecContext(conf)
         ctx.cluster = cluster
+        # distinct per-worker default so monotonically_increasing_id /
+        # spark_partition_id stay unique when no exchange streams reduce
+        # partitions (exchanges overwrite this with the global reduce id)
+        ctx.partition_id = cluster.worker_id
         rows: List[dict] = []
         for batch in physical.execute(ctx):
             if int(batch.num_rows) == 0:
@@ -420,6 +424,18 @@ class ClusterDriver:
                 raise RuntimeError(
                     f"worker {w} failed:\n{reply['error']}")
             results[w] = reply["rows"]
+        # post-job cleanup: peers are done fetching once every worker
+        # has returned, so drop all shuffle blocks now — without this a
+        # long-lived worker accumulates every past job's map outputs
+        # (only the failure path used to reset). Best-effort: the job
+        # already succeeded, a worker dying here is the next run's
+        # problem.
+        for sock, _ep in workers:
+            try:
+                _send_msg(sock, {"type": "reset"})
+                _recv_msg(sock)  # reset_done (keeps protocol in sync)
+            except OSError:
+                pass
         out: List[dict] = []
         for rows in results:
             out.extend(rows or [])

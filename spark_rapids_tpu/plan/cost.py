@@ -1,36 +1,71 @@
-"""Cost-based optimizer: keep tiny plans off the accelerator.
+"""Cost-based optimizer: a dual CPU/device cost model over plan
+sections.
 
 Rebuild of CostBasedOptimizer.scala (SURVEY §2.2: CpuCostModel :284 /
-GpuCostModel :334). The reference estimates per-operator CPU vs GPU
-cost plus row<->columnar transition overhead and re-tags sections where
-the accelerator isn't worth it. Here the dominant fixed cost is XLA
-compilation + host->HBM transfer, so the model is: device execution
-pays off once estimated rows clear a threshold; below it, plans whose
-inputs are all host-resident already (local data, tiny files) are
-tagged back to the CPU engine.
+GpuCostModel :334). The reference estimates per-operator CPU and GPU
+costs plus row<->columnar transition overhead and forces plan SECTIONS
+back to the CPU where the accelerator isn't worth it. The TPU model has
+the same shape with different constants: the dominant device fixed cost
+is XLA compilation + host->HBM transfer; per-row device throughput is
+orders of magnitude higher than the interpreted CPU engine's.
+
+Model:
+- ``estimate_rows``   — static cardinality (file sizes, literals,
+  default selectivities), the CostBasedOptimizer's RowCountPlanVisitor
+  analogue.
+- ``row_width_bytes`` — schema-derived bytes/row.
+- CPU cost of a subtree  = Σ rows·width·CPU_W[op]
+- device cost            = Σ rows·width·DEV_W[op]
+                           + DEVICE_FIXED per op   (compile/dispatch)
+                           + TRANSFER·(leaf input bytes + output bytes)
+- ``apply_cost_model`` walks top-down: a subtree whose device cost
+  (including the transfers its placement implies) beats CPU stays on
+  the device; otherwise the NODE is tagged CPU and its children are
+  reconsidered independently — so a tiny dim-table scan feeding a
+  broadcast join can stay on CPU while the fact side runs on device,
+  exactly the sectioning CostBasedOptimizer performs.
+
+Everything is off unless srt.sql.optimizer.enabled is set (matching
+spark.rapids.sql.optimizer.enabled's default-off posture).
 """
 
 from __future__ import annotations
 
 import os
-from typing import Optional
+from typing import Optional, Tuple
 
+from ..columnar import dtypes as dt
 from ..conf import OPTIMIZER_ENABLED, OPTIMIZER_ROW_THRESHOLD, SrtConf
 from .logical import (Aggregate, Expand, Filter, Join, Limit,
                       LocalRelation, LogicalPlan, Project, Range, Sort,
                       Union, Window)
 from .meta import PlanMeta
 
-# relative per-row op weights (CostBasedOptimizer default coefficients)
-_OP_WEIGHT = {
+# per-row-byte work factors. CPU = the interpreted numpy engine
+# (cpu_eval); DEV = XLA device kernels. Ratios matter, not absolutes:
+# the unit is "cost of moving one byte through a projection on CPU".
+_CPU_W = {
     Project: 1.0, Filter: 1.0, Limit: 0.1, Union: 0.2, Expand: 2.0,
-    Sort: 4.0, Aggregate: 4.0, Join: 6.0, Window: 8.0, Range: 0.1,
-    LocalRelation: 0.1,
+    Sort: 12.0, Aggregate: 6.0, Join: 10.0, Window: 20.0, Range: 0.1,
+    LocalRelation: 0.05,
 }
+_DEV_W = {
+    Project: 0.02, Filter: 0.02, Limit: 0.01, Union: 0.02, Expand: 0.04,
+    Sort: 0.30, Aggregate: 0.15, Join: 0.25, Window: 0.40, Range: 0.01,
+    LocalRelation: 0.05,
+}
+#: fixed device cost per operator (compile amortization + dispatch),
+#: in the same byte-cost unit (~ bytes of CPU projection work one
+#: compile is worth). Dominates for small plans.
+_DEVICE_FIXED = 64 * 1024
+#: host<->device transfer cost per byte, relative to CPU projection
+#: (PCIe/DMA streams; far cheaper than interpreted per-row CPU work)
+_TRANSFER_W = 0.1
 
 
 def estimate_rows(plan: LogicalPlan) -> float:
-    """Cardinality estimation (static, like the reference's)."""
+    """Cardinality estimation (static, like the reference's
+    RowCountPlanVisitor)."""
     from ..io.scan import FileScan
     if isinstance(plan, LocalRelation):
         vals = next(iter(plan.data.values()), [])
@@ -59,16 +94,95 @@ def estimate_rows(plan: LogicalPlan) -> float:
     return child_rows[0] if child_rows else 0.0
 
 
+def row_width_bytes(schema) -> float:
+    """Estimated bytes/row of a schema (strings/nested are guesses —
+    the reference costs columns the same way)."""
+    total = 0.0
+    for _, t in schema:
+        if t == dt.STRING:
+            total += 24.0
+        elif t.is_nested:
+            total += 64.0
+        elif isinstance(t, dt.DecimalType) and t.is_wide:
+            total += 16.0
+        else:
+            try:
+                import numpy as np
+                total += np.dtype(t.physical).itemsize
+            except Exception:
+                total += 8.0
+    return max(total, 1.0)
+
+
+def _subtree_costs(plan: LogicalPlan) -> Tuple[float, float, float]:
+    """(cpu_cost, device_compute_cost, output_bytes) of the subtree —
+    device cost EXCLUDES boundary transfers (added by the caller, which
+    knows where the section boundaries land)."""
+    rows = estimate_rows(plan)
+    try:
+        width = row_width_bytes(plan.schema)
+    except Exception:
+        width = 8.0
+    bytes_out = rows * width
+    cpu = _CPU_W.get(type(plan), 1.0) * bytes_out
+    dev = _DEV_W.get(type(plan), 0.05) * bytes_out + _DEVICE_FIXED
+    for c in plan.children:
+        ccpu, cdev, _ = _subtree_costs(c)
+        cpu += ccpu
+        dev += cdev
+    return cpu, dev, bytes_out
+
+
+def _leaf_input_bytes(plan: LogicalPlan) -> float:
+    """Bytes entering the subtree from host-resident sources (files,
+    local data) — the H2D upload a device placement pays."""
+    from ..io.scan import FileScan
+    if isinstance(plan, (LocalRelation, FileScan)):
+        rows = estimate_rows(plan)
+        try:
+            return rows * row_width_bytes(plan.schema)
+        except Exception:
+            return rows * 8.0
+    return sum(_leaf_input_bytes(c) for c in plan.children)
+
+
+def device_vs_cpu(plan: LogicalPlan) -> Tuple[float, float]:
+    """(cpu_cost, device_cost) of running the WHOLE subtree on each
+    engine, device cost including its boundary transfers."""
+    cpu, dev, bytes_out = _subtree_costs(plan)
+    dev += _TRANSFER_W * (_leaf_input_bytes(plan) + bytes_out)
+    return cpu, dev
+
+
+# floor-gate weights (round-1 heuristic, unchanged so the gate's
+# behavior is stable across rounds)
+_OP_WEIGHT = {
+    Project: 1.0, Filter: 1.0, Limit: 0.1, Union: 0.2, Expand: 2.0,
+    Sort: 4.0, Aggregate: 4.0, Join: 6.0, Window: 8.0, Range: 0.1,
+    LocalRelation: 0.1,
+}
+
+
 def total_cost_rows(plan: LogicalPlan) -> float:
-    """Weighted row-volume of the whole tree."""
+    """Weighted row-volume of the whole tree (the round-1 heuristic,
+    kept as the coarse floor gate)."""
     w = _OP_WEIGHT.get(type(plan), 1.0)
     return w * estimate_rows(plan) + sum(total_cost_rows(c)
                                          for c in plan.children)
 
 
 def apply_cost_model(meta: PlanMeta, conf: SrtConf) -> None:
-    """Tag the whole plan off the device when it's too small to pay for
-    compile + transfer (the reference's 'force sections back to CPU')."""
+    """Force plan sections back to the CPU engine where the dual model
+    says the device doesn't pay (CostBasedOptimizer.optimize role).
+
+    Two stages, both conservative:
+    1. floor gate — the whole plan below the row threshold goes CPU
+       (device compile/transfer overhead dominates tiny plans no matter
+       the shape);
+    2. section refinement — top-down: a node whose subtree wins on
+       device is left alone; a losing node is tagged CPU and each child
+       subtree is reconsidered on its own (it may still win once its
+       own boundary transfers are priced)."""
     if not conf.get(OPTIMIZER_ENABLED):
         return
     threshold = conf.get(OPTIMIZER_ROW_THRESHOLD)
@@ -78,6 +192,19 @@ def apply_cost_model(meta: PlanMeta, conf: SrtConf) -> None:
                   f"cost model: estimated work {cost:.0f} rows < "
                   f"threshold {threshold} (device compile/transfer "
                   "overhead dominates)")
+        return
+    _refine(meta)
+
+
+def _refine(meta: PlanMeta) -> None:
+    cpu, dev = device_vs_cpu(meta.plan)
+    if dev < cpu:
+        return  # whole subtree stays on device
+    meta.will_not_work_on_tpu(
+        f"cost model: CPU {cpu:.2e} < device {dev:.2e} for "
+        f"{type(meta.plan).__name__} section")
+    for c in meta.child_plans:
+        _refine(c)
 
 
 def _tag_tree(meta: PlanMeta, reason: str) -> None:

@@ -2234,3 +2234,306 @@ def _register_collections():
 
 
 _register_collections()
+
+
+def _register_higher_order():
+    """CPU oracle for lambda expressions (higherOrderFunctions.scala
+    surface): lambda bodies evaluate over a FLAT element-level table —
+    one row per element, lambda-var columns plus outer columns repeated
+    per element — then results regroup by the original list lengths.
+    The same lowering shape as the device path, at numpy speed."""
+    from ..expr import higher_order as HO
+    from .host_table import HostColumn, HostTable
+
+    def _phys_col(values, t: dt.DType) -> HostColumn:
+        mask = np.array([v is not None for v in values], dtype=bool)
+        phys = [_physical_scalar(v, t) for v in values]
+        if t == dt.STRING or t.is_nested or \
+                (isinstance(t, dt.DecimalType) and t.is_wide):
+            return HostColumn(_obj_array(phys), mask, t)
+        return HostColumn(np.array(phys, dtype=np.dtype(t.physical)),
+                          mask, t)
+
+    def _flat_eval(body, table, lens, bindings):
+        """bindings: [(name, logical-values list, dtype)]; returns
+        logical results, one per element."""
+        cols, names = [], []
+        for name, vals, t in bindings:
+            names.append(name)
+            cols.append(_phys_col(vals, t))
+        outer = HO._outer_refs(body, [])  # all free ColumnRefs in body
+        outer -= set(names)
+        for cname in outer:
+            src = table.column(cname)
+            rep_m = np.repeat(src.mask, lens)
+            rep_vals = np.repeat(src.values, lens)
+            names.append(cname)
+            cols.append(HostColumn(rep_vals, rep_m, src.dtype))
+        flat = HostTable(cols, names)
+        out = evaluate(body, flat)
+        rt = body.data_type(flat.schema())
+        return [(_logical_of(out.values, out.mask, i, rt))
+                for i in range(len(out.values))]
+
+    def _elements_of(arr, am):
+        lens = np.array([len(arr[i]) if am[i] else 0
+                         for i in range(len(arr))], dtype=np.int64)
+        flat = []
+        for i in range(len(arr)):
+            if am[i]:
+                flat.extend(arr[i])
+        return lens, flat
+
+    @_reg(HO.LambdaVariable)
+    def _lambda_var(expr, table):
+        c = table.column(expr.name)
+        return c.values, c.mask
+
+    @_reg(HO.ArrayTransform)
+    def _transform(expr, table):
+        expr.data_type(table.schema())  # bind lambda var dtypes
+        arr, am = _ev(expr.children[0], table)
+        lens, flat = _elements_of(arr, am)
+        binds = [(expr.var.name, flat, expr.var._dtype)]
+        if expr.idx_var is not None:
+            idx = [k for n in lens for k in range(n)]
+            binds.append((expr.idx_var.name, idx, dt.INT32))
+        res = _flat_eval(expr.children[1], table, lens, binds)
+        out = np.empty(len(arr), dtype=object)
+        pos = 0
+        for i in range(len(arr)):
+            if am[i]:
+                out[i] = res[pos:pos + lens[i]]
+                pos += lens[i]
+            else:
+                out[i] = None
+        return out, am.copy()
+
+    def _pred_rows(expr, table):
+        expr.data_type(table.schema())
+        arr, am = _ev(expr.children[0], table)
+        lens, flat = _elements_of(arr, am)
+        binds = [(expr.var.name, flat, expr.var._dtype)]
+        res = _flat_eval(expr.children[1], table, lens, binds)
+        return arr, am, lens, res
+
+    @_reg(HO.ArrayExists)
+    def _exists(expr, table):
+        arr, am, lens, res = _pred_rows(expr, table)
+        n = len(arr)
+        out = np.zeros(n, bool)
+        mask = np.zeros(n, bool)
+        pos = 0
+        for i in range(n):
+            if not am[i]:
+                continue
+            window = res[pos:pos + lens[i]]
+            pos += lens[i]
+            any_true = any(v is True for v in window)
+            any_null = any(v is None for v in window)
+            out[i] = any_true
+            mask[i] = any_true or not any_null
+        return out, mask
+
+    @_reg(HO.ArrayForAll)
+    def _forall(expr, table):
+        arr, am, lens, res = _pred_rows(expr, table)
+        n = len(arr)
+        out = np.zeros(n, bool)
+        mask = np.zeros(n, bool)
+        pos = 0
+        for i in range(n):
+            if not am[i]:
+                continue
+            window = res[pos:pos + lens[i]]
+            pos += lens[i]
+            any_false = any(v is False for v in window)
+            any_null = any(v is None for v in window)
+            out[i] = not any_false
+            mask[i] = any_false or not any_null
+        return out, mask
+
+    @_reg(HO.ArrayFilter)
+    def _filter(expr, table):
+        arr, am, lens, res = _pred_rows(expr, table)
+        n = len(arr)
+        out = np.empty(n, dtype=object)
+        pos = 0
+        for i in range(n):
+            if not am[i]:
+                out[i] = None
+                continue
+            window = res[pos:pos + lens[i]]
+            pos += lens[i]
+            out[i] = [e for e, keep in zip(arr[i], window)
+                      if keep is True]
+        return out, am.copy()
+
+    @_reg(HO.ArrayAggregate)
+    def _aggregate(expr, table):
+        schema = table.schema()
+        rt = expr.data_type(schema)
+        arr, am = _ev(expr.children[0], table)
+        zero = evaluate(expr.children[1], table)
+        zt = expr.children[1].data_type(schema)
+        acc_t = expr.acc_var._dtype or zt
+        et = expr.elem_var._dtype
+        n = len(arr)
+        merge = expr.children[2]
+        finish = expr.children[3] if expr.has_finish else None
+        vals, mask = [], np.zeros(n, bool)
+        for i in range(n):
+            if not am[i]:
+                vals.append(_physical_scalar(None, rt))
+                continue
+            acc = _logical_of(zero.values, zero.mask, i, zt)
+            for x in arr[i]:
+                one = HostTable(
+                    [_phys_col([acc], acc_t), _phys_col([x], et)],
+                    [expr.acc_var.name, expr.elem_var.name])
+                r = evaluate(merge, one)
+                acc = _logical_of(r.values, r.mask, 0, acc_t)
+            if finish is not None:
+                one = HostTable([_phys_col([acc], acc_t)],
+                                [expr.acc_var.name])
+                r = evaluate(finish, one)
+                acc = _logical_of(r.values, r.mask, 0, rt)
+            mask[i] = acc is not None
+            vals.append(_physical_scalar(acc, rt))
+        if rt == dt.STRING or rt.is_nested:
+            return _obj_array(vals), mask
+        return np.array(vals, dtype=np.dtype(rt.physical)), mask
+
+    # --- maps (logical value = dict) ---
+
+    @_reg(HO.MapKeys)
+    def _map_keys(expr, table):
+        mv, mm = _ev(expr.children[0], table)
+        out = _obj_array([list(mv[i].keys()) if mm[i] else None
+                          for i in range(len(mv))])
+        return out, mm.copy()
+
+    @_reg(HO.MapValues)
+    def _map_values(expr, table):
+        mv, mm = _ev(expr.children[0], table)
+        out = _obj_array([list(mv[i].values()) if mm[i] else None
+                          for i in range(len(mv))])
+        return out, mm.copy()
+
+    @_reg(HO.MapEntries)
+    def _map_entries(expr, table):
+        mv, mm = _ev(expr.children[0], table)
+        out = _obj_array([
+            [{"key": k, "value": v} for k, v in mv[i].items()]
+            if mm[i] else None for i in range(len(mv))])
+        return out, mm.copy()
+
+    @_reg(HO.GetMapValue)
+    def _get_map_value(expr, table):
+        schema = table.schema()
+        vt = expr.data_type(schema)
+        kt = expr.children[1].data_type(schema)
+        mv, mm = _ev(expr.children[0], table)
+        kc = evaluate(expr.children[1], table)
+        n = len(mv)
+        vals, mask = [], np.zeros(n, bool)
+        for i in range(n):
+            v = None
+            if mm[i] and kc.mask[i]:
+                key = _logical_of(kc.values, kc.mask, i, kt)
+                v = mv[i].get(key)
+            mask[i] = v is not None
+            vals.append(_physical_scalar(v, vt))
+        if vt == dt.STRING or vt.is_nested:
+            return _obj_array(vals), mask
+        return np.array(vals, dtype=np.dtype(vt.physical)), mask
+
+    @_reg(HO.MapContainsKey)
+    def _map_contains(expr, table):
+        schema = table.schema()
+        kt = expr.children[1].data_type(schema)
+        mv, mm = _ev(expr.children[0], table)
+        kc = evaluate(expr.children[1], table)
+        n = len(mv)
+        out = np.zeros(n, bool)
+        mask = np.zeros(n, bool)
+        for i in range(n):
+            if mm[i] and kc.mask[i]:
+                key = _logical_of(kc.values, kc.mask, i, kt)
+                out[i] = key in mv[i]
+                mask[i] = True
+        return out, mask
+
+    def _map_lambda(expr, table, fn):
+        expr.data_type(table.schema())  # bind var dtypes
+        mv, mm = _ev(expr.children[0], table)
+        n = len(mv)
+        keys = [k for i in range(n) if mm[i] for k in mv[i].keys()]
+        vals = [v for i in range(n) if mm[i] for v in mv[i].values()]
+        lens = np.array([len(mv[i]) if mm[i] else 0 for i in range(n)],
+                        dtype=np.int64)
+        binds = [(expr.key_var.name, keys, expr.key_var._dtype),
+                 (expr.val_var.name, vals, expr.val_var._dtype)]
+        res = _flat_eval(expr.children[1], table, lens, binds)
+        out = np.empty(n, dtype=object)
+        pos = 0
+        for i in range(n):
+            if not mm[i]:
+                out[i] = None
+                continue
+            window = res[pos:pos + lens[i]]
+            pos += lens[i]
+            out[i] = fn(mv[i], window)
+        return out, mm.copy()
+
+    @_reg(HO.TransformValues)
+    def _transform_values(expr, table):
+        return _map_lambda(
+            expr, table,
+            lambda m, rs: dict(zip(m.keys(), rs)))
+
+    @_reg(HO.TransformKeys)
+    def _transform_keys(expr, table):
+        return _map_lambda(
+            expr, table,
+            lambda m, rs: dict(zip(rs, m.values())))
+
+    @_reg(HO.MapFilter)
+    def _map_filter(expr, table):
+        return _map_lambda(
+            expr, table,
+            lambda m, rs: {k: v for (k, v), keep in zip(m.items(), rs)
+                           if keep is True})
+
+    @_reg(HO.CreateMap)
+    def _create_map(expr, table):
+        schema = table.schema()
+        mt = expr.data_type(schema)
+        n = table.num_rows
+        keys = [evaluate(c, table) for c in expr.children[0::2]]
+        vals = [evaluate(c, table) for c in expr.children[1::2]]
+        out = _obj_array([
+            {_logical_of(k.values, k.mask, i, mt.key_type):
+             _logical_of(v.values, v.mask, i, mt.value_type)
+             for k, v in zip(keys, vals)
+             if k.mask[i]}
+            for i in range(n)])
+        return out, np.ones(n, bool)
+
+    @_reg(HO.MapFromArrays)
+    def _map_from_arrays(expr, table):
+        kv, km = _ev(expr.children[0], table)
+        vv, vm = _ev(expr.children[1], table)
+        n = len(kv)
+        out = np.empty(n, dtype=object)
+        mask = np.zeros(n, bool)
+        for i in range(n):
+            if km[i] and vm[i] and len(kv[i]) == len(vv[i]):
+                out[i] = dict(zip(kv[i], vv[i]))
+                mask[i] = True
+            else:
+                out[i] = None
+        return out, mask
+
+
+_register_higher_order()

@@ -43,6 +43,17 @@ class ExprMeta(BaseMeta):
 
     def tag_for_tpu(self) -> None:
         from . import overrides
+        # type the tree root-first BEFORE descending: higher-order
+        # functions bind their lambda variables' dtypes in data_type,
+        # and children (which reference those variables) tag after. A
+        # type error here means the expression can't be planned at all
+        # — fall back instead of crashing the planner.
+        try:
+            self.expr.data_type(self.schema)
+        except Exception as e:
+            self.will_not_work_on_tpu(
+                f"cannot type {type(self.expr).__name__}: {e}")
+            return
         for c in self.child_exprs:
             c.tag_for_tpu()
         rule = overrides.expr_rule_for(type(self.expr))

@@ -149,7 +149,11 @@ def device_type_ok(t: dt.DType) -> Optional[str]:
                 return reason
         return None
     if isinstance(t, dt.MapType):
-        return f"type {t} not supported on TPU yet"
+        for part in (t.key_type, t.value_type):
+            reason = device_type_ok(part)
+            if reason:
+                return reason
+        return None
     return ts.all_basic_128.reason_if_unsupported(t, "column")
 
 
@@ -333,6 +337,75 @@ _expr(CX.ArrayMax, _nested_ok, _primitive_elements)
 _expr(CX.SortArray, _nested_ok, _primitive_elements)
 _expr(CX.CreateNamedStruct, ts.all_basic)
 _expr(CX.GetStructField, ts.TypeSig(ts.STRUCT))
+
+
+# --- higher-order functions + maps ---
+from ..expr import higher_order as HO  # noqa: E402
+
+_hof_ok = ts.all_basic + ts.TypeSig(ts.ARRAY, ts.STRUCT, ts.MAP)
+
+
+def _lambda_primitive_elements(meta: ExprMeta):
+    """Lane-lowered lambdas need primitive (non-string, non-nested)
+    element/key/value types on device; everything else falls back
+    (the reference runs these through cuDF's list lowering —
+    higherOrderFunctions.scala TypeSigs gate similarly)."""
+    parts = []
+    for child in meta.expr.children:
+        t = child.data_type(meta.schema)
+        if isinstance(t, dt.MapType):
+            parts += [t.key_type, t.value_type]
+        elif isinstance(t, dt.ArrayType):
+            parts.append(t.element_type)
+    for p in parts:
+        if p.is_nested or p == dt.STRING:
+            meta.will_not_work_on_tpu(
+                f"{type(meta.expr).__name__}: element type {p} needs "
+                "lane lowering not yet on TPU")
+    # lambda RESULT must also be a primitive lane type
+    from ..expr.higher_order import (ArrayFilter, ArrayTransform,
+                                     MapFilter, TransformKeys,
+                                     TransformValues)
+    if isinstance(meta.expr, (ArrayTransform, TransformKeys,
+                              TransformValues)):
+        rt = meta.expr.children[1].data_type(meta.schema)
+        if rt.is_nested or rt == dt.STRING:
+            meta.will_not_work_on_tpu(
+                f"{type(meta.expr).__name__}: lambda result type {rt} "
+                "needs lane lowering not yet on TPU")
+
+
+def _no_outer_refs_in_aggregate(meta: ExprMeta):
+    from ..expr.higher_order import _outer_refs
+    expr: HO.ArrayAggregate = meta.expr
+    for body in expr._bodies():
+        if _outer_refs(body, expr.lambda_vars):
+            meta.will_not_work_on_tpu(
+                "aggregate() lambda referencing outer columns runs on "
+                "CPU (scan-carried outer state not lowered)")
+            return
+    _lambda_primitive_elements(meta)
+
+
+_expr(HO.LambdaVariable, ts.all_basic)
+_expr(HO.ArrayTransform, _hof_ok, _lambda_primitive_elements)
+_expr(HO.ArrayExists, _hof_ok, _lambda_primitive_elements)
+_expr(HO.ArrayForAll, _hof_ok, _lambda_primitive_elements)
+_expr(HO.ArrayFilter, _hof_ok, _lambda_primitive_elements)
+_expr(HO.ArrayAggregate, _hof_ok, _no_outer_refs_in_aggregate)
+_expr(HO.MapKeys, ts.TypeSig(ts.MAP))
+_expr(HO.MapValues, ts.TypeSig(ts.MAP))
+_expr(HO.MapEntries, ts.TypeSig(ts.MAP))
+_expr(HO.GetMapValue, ts.TypeSig(ts.MAP) + ts.all_basic,
+      _lambda_primitive_elements)
+_expr(HO.MapContainsKey, ts.TypeSig(ts.MAP) + ts.all_basic,
+      _lambda_primitive_elements)
+_expr(HO.TransformValues, ts.TypeSig(ts.MAP), _lambda_primitive_elements)
+_expr(HO.TransformKeys, ts.TypeSig(ts.MAP), _lambda_primitive_elements)
+_expr(HO.MapFilter, ts.TypeSig(ts.MAP), _lambda_primitive_elements)
+_expr(HO.CreateMap, ts.numeric + ts.TypeSig(ts.BOOLEAN, ts.DATE,
+                                            ts.TIMESTAMP))
+_expr(HO.MapFromArrays, ts.TypeSig(ts.ARRAY), _lambda_primitive_elements)
 
 
 def _tag_explode(meta: ExprMeta):
@@ -895,11 +968,16 @@ def apply_overrides(plan: LogicalPlan, conf: Optional[SrtConf] = None):
     return _ensure_physical(_to_physical(meta, conf), conf)
 
 
-def tag_only(plan: LogicalPlan) -> PlanMeta:
+def tag_only(plan: LogicalPlan,
+             conf: Optional[SrtConf] = None) -> PlanMeta:
     """Tagging pass without conversion (explain-only mode — the
-    reference's spark.rapids.sql.mode=explainOnly)."""
+    reference's spark.rapids.sql.mode=explainOnly). Applies the cost
+    model too when a conf enables it, so explain output matches what
+    apply_overrides would actually plan."""
     meta = PlanMeta(plan)
     meta.tag_for_tpu()
+    from .cost import apply_cost_model
+    apply_cost_model(meta, conf or active_conf())
     return meta
 
 

@@ -73,40 +73,74 @@ class PythonWorker:
     """One pooled worker process."""
 
     def __init__(self):
+        import tempfile
         env = dict(os.environ)
         # workers never touch jax; scrub accelerator env so a stray
         # import in user UDF code stays on CPU
         env["JAX_PLATFORMS"] = "cpu"
+        # stderr goes to an unbounded temp FILE, not a pipe: a pipe
+        # that nobody drains wedges the worker after ~64KB of warnings
+        # (the undrained-pipe deadlock class); the file is read back
+        # only for the death-message tail
+        self._err = tempfile.TemporaryFile(prefix="srt_udf_err_")
+        self._expired = False
         self.proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__)],
             stdin=subprocess.PIPE, stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE, env=env)
+            stderr=self._err, env=env)
 
-    def run_job(self, spec_blob: bytes, arrow_blob: bytes) -> bytes:
+    def _stderr_tail(self, n: int = 2000) -> bytes:
+        try:
+            self._err.seek(0, os.SEEK_END)
+            size = self._err.tell()
+            self._err.seek(max(0, size - n))
+            return self._err.read()
+        except (OSError, ValueError):
+            return b""
+
+    def run_job(self, spec_blob: bytes, arrow_blob: bytes,
+                timeout: Optional[float] = None) -> bytes:
         """Returns the result Arrow IPC bytes; raises PythonWorkerError
-        on UDF failure or worker death."""
+        on UDF failure, worker death, or timeout (the worker is killed
+        so _read_frame always returns instead of blocking forever)."""
+        timer = None
+        timed_out = [False]
+        if timeout:
+            def _expire():
+                timed_out[0] = True
+                self._expired = True  # never pool a killed worker
+                self.proc.kill()
+            timer = threading.Timer(timeout, _expire)
+            timer.start()
         try:
             _write_frame(self.proc.stdin, spec_blob)
             _write_frame(self.proc.stdin, arrow_blob)
             reply = _read_frame(self.proc.stdout)
-        except (BrokenPipeError, OSError) as e:
+        except (BrokenPipeError, OSError):
             reply = None
+        finally:
+            if timer is not None:
+                timer.cancel()
         if reply is None:
-            err = b""
             try:
                 self.proc.kill()
-                err = self.proc.stderr.read() or b""
             except OSError:
                 pass
+            why = (f"python worker timed out after {timeout}s"
+                   if timed_out[0] else "python worker died")
             raise PythonWorkerError(
-                "python worker died: " + err[-2000:].decode(
+                why + ": " + self._stderr_tail().decode(
                     "utf-8", "replace"))
         if reply[:1] == b"E":
             raise PythonWorkerError(reply[1:].decode("utf-8", "replace"))
         return reply[1:]
 
     def alive(self) -> bool:
-        return self.proc.poll() is None
+        # _expired guards the race where the timeout timer killed the
+        # process just as a reply landed: poll() can still say alive
+        # for a moment, and pooling the dying worker would fail the
+        # NEXT job spuriously
+        return self.proc.poll() is None and not self._expired
 
     def close(self) -> None:
         try:
@@ -115,6 +149,10 @@ class PythonWorker:
                 self.proc.wait(timeout=2)
         except (OSError, subprocess.TimeoutExpired):
             self.proc.kill()
+        try:
+            self._err.close()
+        except OSError:
+            pass
 
 
 class PythonWorkerPool:
@@ -157,9 +195,11 @@ class PythonWorkerPool:
         self._idle.put(w)
 
     def run_job(self, spec_blob: bytes, arrow_blob: bytes) -> bytes:
+        from ..conf import PYTHON_UDF_TIMEOUT, active_conf
+        timeout = active_conf().get(PYTHON_UDF_TIMEOUT) or None
         w = self.acquire()
         try:
-            out = w.run_job(spec_blob, arrow_blob)
+            out = w.run_job(spec_blob, arrow_blob, timeout=timeout)
         except PythonWorkerError:
             self.release(w, broken=True)
             raise
@@ -209,7 +249,12 @@ def _worker_main() -> None:  # pragma: no cover - subprocess body
     import pyarrow as pa
 
     stdin = sys.stdin.buffer
-    stdout = sys.stdout.buffer
+    # The frame protocol owns the ORIGINAL stdout fd; user UDFs that
+    # print() (or C libs writing to fd 1) must not corrupt it. Dup the
+    # fd for the protocol, then point fd 1 — and sys.stdout, which
+    # wraps fd 1 — at stderr.
+    stdout = os.fdopen(os.dup(sys.stdout.fileno()), "wb")
+    os.dup2(sys.stderr.fileno(), sys.stdout.fileno())
     while True:
         spec_blob = _read_frame(stdin)
         if not spec_blob:

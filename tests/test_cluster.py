@@ -99,6 +99,34 @@ def test_broadcast_join_replicated_build(cluster, dataset):
     assert got == oracle
 
 
+def test_shuffled_join_across_workers(cluster, dataset):
+    """SHUFFLED hash join (broadcast disabled by a tiny threshold) with
+    AQE left at its default of enabled: the adaptive broadcast downgrade
+    and partition-coalescing paths must stay OFF under a cluster context
+    — a worker deciding from its local-only row counts would drop other
+    workers' build rows."""
+    session = TpuSession(SrtConf({}))
+    plan = _logical(
+        session, dataset,
+        lambda f, d: f.join(d, "k").group_by("name").agg(
+            Alias(Sum(col("v")), "s"),
+            Alias(CountStar(), "c")))
+    job_conf = {"srt.shuffle.partitions": 4,
+                "srt.sql.broadcastRowThreshold": 1}
+    rows = cluster.run(plan, job_conf)
+    oracle_session = TpuSession(SrtConf(job_conf))
+    oracle = {r["name"]: r for r in oracle_session.read
+              .parquet(dataset["fact"]).join(
+                  oracle_session.read.parquet(dataset["dim"]), "k")
+              .group_by("name").agg(Alias(Sum(col("v")), "s"),
+                                    Alias(CountStar(), "c")).collect()}
+    got = {r["name"]: r for r in rows}
+    assert set(got) == set(oracle)
+    for name, r in got.items():
+        assert r["c"] == oracle[name]["c"]
+        assert r["s"] == pytest.approx(oracle[name]["s"], rel=1e-9)
+
+
 def test_global_sort_order_preserved(cluster, dataset):
     session = TpuSession(SrtConf({}))
     fact = session.read.parquet(dataset["fact"])
