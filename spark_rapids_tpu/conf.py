@@ -437,6 +437,27 @@ PYTHON_WORKERS_MAX = conf("srt.python.workers.max") \
          "queries. (python/rapids/daemon.py worker pool role)") \
     .check(_positive).integer(4)
 
+PARQUET_NATIVE_DECODE = conf("srt.sql.format.parquet.nativeDecode.enabled") \
+    .doc("Decode eligible parquet column chunks (fixed-width types, "
+         "Snappy/uncompressed, PLAIN/RLE_DICTIONARY, v1 pages) in the "
+         "native C++ runtime without the GIL; ineligible columns and "
+         "files fall back to pyarrow per column/file. "
+         "(GpuParquetScan.scala:2624 device-decode role, host-native "
+         "stage.)") \
+    .boolean(True)
+
+SHUFFLE_FETCH_MAX_CONCURRENT = conf("srt.shuffle.fetch.maxConcurrent") \
+    .doc("Peers fetched in parallel per reduce partition over the TCP "
+         "shuffle transport (RapidsShuffleClient maxInFlight role).") \
+    .check(_positive).integer(4)
+
+SHUFFLE_FETCH_IN_FLIGHT_BYTES = conf("srt.shuffle.fetch.inFlightBytes") \
+    .doc("Byte budget for fetched-but-not-yet-consumed shuffle blocks "
+         "per reduce partition (BounceBufferManager window role): "
+         "producers stall when the window is full, bounding reduce "
+         "fan-in host memory.") \
+    .check(_positive).integer(128 * 1024 * 1024)
+
 DPP_ENABLED = conf("srt.sql.dpp.enabled") \
     .doc("Runtime dynamic partition pruning: when a broadcast join's "
          "probe side scans a partitioned table on a partition column, "

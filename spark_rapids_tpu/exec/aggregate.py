@@ -433,6 +433,13 @@ class HashAggregateExec(TpuExec):
             cols.append(ColumnVector(jnp.zeros(8, t.physical),
                                      jnp.zeros(8, jnp.bool_), t))
             names.append(n)
+        for n in getattr(plan, "str_names", ()):
+            from ..columnar.vector import StringColumn
+            cols.append(StringColumn(jnp.zeros(9, jnp.int32),
+                                     jnp.zeros(8, jnp.uint8),
+                                     jnp.zeros(8, jnp.bool_),
+                                     pad_bucket=8))
+            names.append(n)
         try:
             out = fn(ColumnarBatch(cols, names, jnp.int32(0)))
             jax.block_until_ready(out)

@@ -57,12 +57,128 @@ _FLOATY = (dt.FLOAT32, dt.FLOAT64)
 _MINMAX_DTYPES = (dt.FLOAT32, dt.FLOAT64, dt.DATE, dt.INT8, dt.INT16)
 
 
+class _PaddedStrPred(E.Expression):
+    """Kernel-side string predicate over the padded byte-lane view —
+    the string-predicate kernel family (reference: cuDF string
+    comparison kernels feeding filtered reductions). The referenced
+    column's (tile, W) char block + lengths + validity ride the kernel
+    batch's ``str_lanes``; comparison is pure VPU byte arithmetic in
+    VMEM, so dim-filter predicates like cd_gender='M' fuse into the
+    single-pass reduction."""
+
+    def __init__(self, name: str, choices: Sequence[bytes],
+                 prefix: bool = False):
+        super().__init__()
+        self.name = name
+        self.choices = [bytes(c) for c in choices]
+        self.prefix = prefix
+
+    def data_type(self, schema) -> dt.DType:
+        return dt.BOOL
+
+    def references(self) -> set:
+        return {self.name}
+
+    def eval(self, batch) -> ColumnVector:
+        chars, lens, valid = batch.str_lanes[self.name]
+        tile, w = chars.shape
+        hit = jnp.zeros(tile, jnp.bool_)
+        for lit in self.choices:
+            m = len(lit)
+            if m > w:
+                continue  # longer than any string in this batch
+            eq = jnp.ones(tile, jnp.bool_)
+            for j in range(m):  # m is tiny (literal length)
+                # python-int scalars: array constants can't be
+                # captured inside a pallas kernel trace
+                eq = eq & (chars[:, j].astype(jnp.int32) == lit[j])
+            if self.prefix:
+                eq = eq & (lens >= m)
+            else:
+                eq = eq & (lens == m)
+            hit = hit | eq
+        return ColumnVector(hit, valid, dt.BOOL)
+
+    def __repr__(self):
+        op = "startswith" if self.prefix else "in"
+        return f"{self.name} {op} {self.choices!r}"
+
+
+class _PaddedStrNull(E.Expression):
+    """IS [NOT] NULL over a kernel-batch string column."""
+
+    def __init__(self, name: str, negated: bool):
+        super().__init__()
+        self.name = name
+        self.negated = negated
+
+    def data_type(self, schema) -> dt.DType:
+        return dt.BOOL
+
+    def references(self) -> set:
+        return {self.name}
+
+    def eval(self, batch) -> ColumnVector:
+        _, _, valid = batch.str_lanes[self.name]
+        data = valid if self.negated else ~valid
+        return ColumnVector(data, jnp.ones_like(valid), dt.BOOL)
+
+
+def _rewrite_string_preds(pred: E.Expression, schema):
+    """Replace eligible string predicate subtrees (col = 'lit',
+    col IN ('a','b'), startswith, IS [NOT] NULL) with kernel-lane
+    nodes; returns (rewritten, {string column names}) — or (pred,
+    set()) unchanged when nothing matched."""
+    from ..expr import strings as S
+    schema_d = dict(schema)
+    found: set = set()
+
+    def is_str_ref(e):
+        return isinstance(e, E.ColumnRef) and \
+            schema_d.get(e.name) == dt.STRING
+
+    def rw(e: E.Expression):
+        if isinstance(e, Pr.EqualTo):
+            l, r = e.children
+            if is_str_ref(l) and isinstance(r, E.Literal) and \
+                    isinstance(r.value, str):
+                found.add(l.name)
+                return _PaddedStrPred(l.name, [r.value.encode()])
+            if is_str_ref(r) and isinstance(l, E.Literal) and \
+                    isinstance(l.value, str):
+                found.add(r.name)
+                return _PaddedStrPred(r.name, [l.value.encode()])
+        if isinstance(e, Pr.InSet) and is_str_ref(e.children[0]) and \
+                all(isinstance(v, str) for v in e.values):
+            found.add(e.children[0].name)
+            return _PaddedStrPred(e.children[0].name,
+                                  [v.encode() for v in e.values])
+        if isinstance(e, S.StartsWith) and is_str_ref(e.children[0]):
+            found.add(e.children[0].name)
+            return _PaddedStrPred(e.children[0].name,
+                                  [e.prefix.encode()], prefix=True)
+        if isinstance(e, (Pr.IsNull, Pr.IsNotNull)) and \
+                is_str_ref(e.children[0]):
+            found.add(e.children[0].name)
+            return _PaddedStrNull(e.children[0].name,
+                                  isinstance(e, Pr.IsNotNull))
+        if not e.children:
+            return e
+        out = copy.copy(e)
+        out.children = [rw(c) for c in e.children]
+        return out
+
+    return rw(pred), found
+
+
 def _expr_safe(expr: E.Expression, schema, no_f64: bool = False) -> bool:
     """``schema`` is the Schema list ([(name, dtype)]) data_type wants.
     ``no_f64`` additionally rejects any float64-typed subexpression —
     used for TPU filter predicates, where demoting to float32 would
     change which ROWS pass (not just low-order sum bits, the only
     deviation srt.sql.pallas.enabled's contract covers)."""
+    if isinstance(expr, (_PaddedStrPred, _PaddedStrNull)):
+        return True  # pure byte-lane VPU arithmetic, exact
     if not isinstance(expr, _SAFE_NODES):
         return False
     if isinstance(expr, E.Literal) and expr.value is None:
@@ -116,8 +232,12 @@ class PallasAggPlan:
 
     def __init__(self, agg_exprs, input_schema, pred: Optional[E.Expression]):
         self.input_schema = input_schema
-        self.pred = pred
         schema = list(input_schema)
+        self.str_names: List[str] = []
+        if pred is not None:
+            pred, snames = _rewrite_string_preds(pred, schema)
+            self.str_names = sorted(snames)
+        self.pred = pred
         demote = PK.on_tpu()
         self._prep = _demote_f64 if demote else (lambda e: e)
         self.kinds: List[str] = []
@@ -127,6 +247,7 @@ class PallasAggPlan:
         refs: set = set()
         if pred is not None:
             _collect_refs([pred], refs)
+            refs -= set(self.str_names)  # ride str_lanes, not columns
         for fn, _name in agg_exprs:
             in_t = (fn.children[0].data_type(schema)
                     if fn.children else None)
@@ -205,6 +326,8 @@ class PallasAggPlan:
 
         col_dtypes = [shim_dtype(schema_d[n]) for n in names]
 
+        str_names = self.str_names
+
         def run(batch: ColumnarBatch):
             arrays = []
             for n, st in zip(names, col_dtypes):
@@ -214,6 +337,12 @@ class PallasAggPlan:
                     data = data.astype(jnp.float32)
                 arrays.append(data)
                 arrays.append(c.validity.astype(jnp.uint8))
+            n_scalar = len(arrays)
+            for sn in str_names:
+                sc = batch.column(sn)
+                arrays.append(sc.padded())              # (cap, W) u8
+                arrays.append(sc.lengths().astype(jnp.int32))
+                arrays.append(sc.validity.astype(jnp.uint8))
             arrays.append(batch.live_mask().astype(jnp.uint8))
 
             def row_fn(blocks):
@@ -224,6 +353,12 @@ class PallasAggPlan:
                                              blocks[2 * i + 1] != 0, st))
                 live = blocks[-1] != 0
                 kb = _KernelBatch(cols, list(names), tile, live)
+                kb.str_lanes = {}
+                for k, sn in enumerate(str_names):
+                    chars = blocks[n_scalar + 3 * k]
+                    lens = blocks[n_scalar + 3 * k + 1]
+                    valid = blocks[n_scalar + 3 * k + 2] != 0
+                    kb.str_lanes[sn] = (chars, lens, valid)
                 mask = live
                 if pred is not None:
                     pc = pred.eval(kb)
@@ -321,5 +456,8 @@ def pred_safe(pred: E.Expression, input_schema) -> bool:
     """Filter predicates must keep exact row selection: on TPU (where
     the kernel would demote f64 to f32) any float64 subexpression keeps
     the filter un-fused — the aggregate still runs in pallas over the
-    FilterExec's output."""
-    return _expr_safe(pred, list(input_schema), no_f64=PK.on_tpu())
+    FilterExec's output. String predicate subtrees are judged AFTER
+    their byte-lane rewrite (the string-predicate kernel family)."""
+    rewritten, _ = _rewrite_string_preds(pred, list(input_schema))
+    return _expr_safe(rewritten, list(input_schema),
+                      no_f64=PK.on_tpu())

@@ -472,7 +472,12 @@ class MapEntries(Expression):
             (("key", mt.key_type), ("value", mt.value_type))))
 
     def eval(self, batch: ColumnarBatch) -> ListColumn:
-        return _entries(self.children[0].eval(batch))
+        lc = _entries(self.children[0].eval(batch))
+        # RE-TYPE to a plain array<struct>: keeping the MapType dtype
+        # would make host collection rebuild dicts, diverging from the
+        # declared entry-list type (and the CPU oracle)
+        return ListColumn(lc.offsets, lc.child, lc.validity,
+                          lc.child.dtype, lc.pad_bucket)
 
 
 class GetMapValue(Expression):

@@ -224,8 +224,24 @@ class _Parser:
                 if nxt == ":":
                     self.next()
                     capturing = False
+                elif nxt == "<" or nxt == "P":
+                    # named group (Java (?<name>...) / python (?P<name>)):
+                    # captures by POSITION like Spark's regexp_extract —
+                    # the name is only syntax
+                    if nxt == "P":
+                        self.next()
+                    if self.peek() != "<":
+                        self.fail("lookaround not supported")
+                    self.next()
+                    if self.peek() in ("=", "!"):
+                        self.fail("lookbehind not supported")
+                    while self.peek() not in (">", None):
+                        self.next()
+                    if self.peek() != ">":
+                        self.fail("unterminated group name")
+                    self.next()
                 else:
-                    self.fail("lookaround/named groups not supported")
+                    self.fail("lookaround not supported")
             if capturing:
                 self.n_groups += 1
                 idx = self.n_groups
@@ -317,8 +333,33 @@ class CompiledRegex:
     """Epsilon-free NFA + metadata, ready for vector simulation."""
 
     def __init__(self, pattern: str):
-        parser = _Parser(pattern)
+        # \b at the pattern EDGES compiles to boundary conditions on
+        # seed/accept positions in the vector simulation (interior \b
+        # still rejects -> CPU fallback, matching transpile-or-fallback)
+        self.word_start = False
+        self.word_end = False
+        body = pattern
+        if body.startswith(r"\b"):
+            self.word_start = True
+            body = body[2:]
+        if body.endswith("b"):
+            k = 0
+            j = len(body) - 2
+            while j >= 0 and body[j] == "\\":
+                k += 1
+                j -= 1
+            if k % 2 == 1:  # odd backslashes: the final 'b' is \b
+                self.word_end = True
+                body = body[:-2]
+        if (self.word_start or self.word_end) and not body:
+            raise RegexUnsupported(f"regex {pattern!r}: bare \\b")
+        parser = _Parser(body)
         ast = parser.parse()
+        if (self.word_start or self.word_end) and isinstance(ast, _Alt):
+            # like anchors: Java scopes an edge \b per branch under a
+            # top-level alternation; our flags are simulation-global
+            raise RegexUnsupported(
+                f"regex {pattern!r}: \\b with top-level alternation")
         self.pattern = pattern
         self.ast = ast
         self.anchored_start = parser.anchored_start
@@ -439,7 +480,23 @@ def _simulate(rx: CompiledRegex, col: StringColumn):
     classes = jnp.asarray(rx.classes)          # (C, 256)
     accept = rx.accept
 
+    # wordness lanes for \b edge conditions: a seed at position p is a
+    # boundary iff wordness(s[p-1]) != wordness(s[p]) (virtual non-word
+    # outside the string); a match END at c is a boundary iff
+    # wordness(s[c-1]) != wordness(s[c])
+    if rx.word_start or rx.word_end:
+        b = padded
+        isw = (((b >= ord("a")) & (b <= ord("z"))) |
+               ((b >= ord("A")) & (b <= ord("Z"))) |
+               ((b >= ord("0")) & (b <= ord("9"))) |
+               (b == ord("_")))
+        isw = isw & (jnp.arange(W)[None, :] < lens[:, None])
+
     active = jnp.broadcast_to(start_set, (cap, rx.n_states))
+    if rx.word_start:
+        # seeding at position 0: boundary iff the first byte is word
+        active = active & (isw[:, 0][:, None] if W else
+                           jnp.zeros((cap, 1), jnp.bool_))
     # empty-prefix accept (0 bytes consumed)
     matched = active[:, accept] & (
         (lens == 0) if rx.anchored_end else jnp.ones(cap, jnp.bool_))
@@ -458,11 +515,20 @@ def _simulate(rx: CompiledRegex, col: StringColumn):
         if not rx.anchored_start:
             # unanchored search: re-seed the start states at every
             # position (match may begin anywhere)
-            nxt = nxt | (start_set[None, :] & in_str[:, None])
+            seed_ok = in_str
+            if rx.word_start:
+                nxt_w = isw[:, j + 1] if j + 1 < W else \
+                    jnp.zeros(cap, jnp.bool_)
+                seed_ok = seed_ok & (nxt_w != isw[:, j])
+            nxt = nxt | (start_set[None, :] & seed_ok[:, None])
         active = nxt
         consumed = j + 1
         at_end = consumed == lens
         ok = at_end if rx.anchored_end else (consumed <= lens)
+        if rx.word_end:
+            nxt_w = isw[:, j + 1] if j + 1 < W else \
+                jnp.zeros(cap, jnp.bool_)
+            ok = ok & (isw[:, j] != nxt_w)
         matched = matched | (active[:, accept] & ok)
     return matched
 
@@ -670,6 +736,11 @@ def _cached_autos(rx: CompiledRegex):
 def first_match_span(rx: CompiledRegex, col: StringColumn):
     """(found, start, end) of the leftmost-longest match per row."""
     import jax.numpy as jnp
+    if rx.word_start or rx.word_end:
+        # \b is lowered only in the boolean simulation (RLike); span
+        # machinery (extract/replace) falls back to CPU
+        raise RegexUnsupported(
+            f"regex {rx.pattern!r}: \\b spans not lowered")
     padded = col.padded()
     lens = col.lengths()
     fwd, rev = _cached_autos(rx)
@@ -797,6 +868,11 @@ def check_submatch_supported(pattern: str, group: int = 0) -> CompiledRegex:
     alternation or lazy quantifiers; capture groups must sit directly in
     the top-level concatenation. Raises RegexUnsupported -> CPU."""
     rx = transpile(pattern)
+    if rx.word_start or rx.word_end:
+        # \b lowers only in the boolean simulation (RLike); span
+        # machinery must fall back at PLAN time, not raise mid-query
+        raise RegexUnsupported(
+            f"regex {pattern!r}: \\b in extract/replace falls back")
     if rx.has_alternation:
         raise RegexUnsupported(
             f"regex {pattern!r}: alternation changes leftmost-greedy vs "

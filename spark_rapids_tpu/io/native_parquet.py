@@ -1,0 +1,217 @@
+"""Native parquet decode path (GpuParquetScan.scala:2624 Table.readParquet
+role, stage 1: host-native).
+
+pyarrow parses the thrift FOOTER (metadata only); each eligible column
+chunk's raw bytes then decode in the C++ runtime
+(native/parquet_decode.cpp — page headers, Snappy, PLAIN +
+RLE_DICTIONARY, definition levels) straight into numpy buffers without
+the GIL, so a scan's decode work parallelizes across reader-pool
+threads while the consumer uploads previous chunks to the device.
+Columns outside the native envelope (strings, nested, v2 pages,
+unsupported codecs) decode through pyarrow per row group — eligibility
+is per COLUMN, not per file.
+
+Used by io/scan.iter_file_tables when srt.sql.format.parquet.
+nativeDecode.enabled is on (default); any error falls back to the
+pyarrow path wholesale, keeping results identical.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from ..columnar import dtypes as dt
+from ..plan.host_table import HostColumn, HostTable
+
+# parquet physical type -> (wire id for the C++ decoder, numpy dtype)
+_PHYS = {
+    "INT32": (1, np.dtype(np.int32)),
+    "INT64": (2, np.dtype(np.int64)),
+    "FLOAT": (4, np.dtype(np.float32)),
+    "DOUBLE": (5, np.dtype(np.float64)),
+}
+_CODECS = {"UNCOMPRESSED": 0, "SNAPPY": 1}
+_OK_ENCODINGS = {"PLAIN", "RLE", "PLAIN_DICTIONARY", "RLE_DICTIONARY",
+                 "BIT_PACKED"}
+
+
+def _declared_ok(t: dt.DType) -> bool:
+    """Declared dtypes whose host lanes are plain fixed-width ints or
+    floats (timestamps excluded: their unit normalization lives in the
+    arrow path)."""
+    if t in (dt.STRING, dt.TIMESTAMP) or t.is_nested:
+        return False
+    if isinstance(t, dt.DecimalType):
+        return not t.is_wide
+    return True
+
+
+class _ChunkPlan:
+    __slots__ = ("col_idx", "phys_id", "np_dtype", "codec", "max_def",
+                 "offset", "length", "scratch")
+
+    def __init__(self, col_idx, phys_id, np_dtype, codec, max_def,
+                 offset, length, scratch):
+        self.col_idx = col_idx
+        self.phys_id = phys_id
+        self.np_dtype = np_dtype
+        self.codec = codec
+        self.max_def = max_def
+        self.offset = offset
+        self.length = length
+        self.scratch = scratch
+
+
+def _plan_chunk(pf: "pq.ParquetFile", rg: int, col_idx: int,
+                declared: dt.DType) -> Optional[_ChunkPlan]:
+    """Eligibility check for one (row group, column); None -> pyarrow."""
+    if not _declared_ok(declared):
+        return None
+    ct = pf.metadata.row_group(rg).column(col_idx)
+    phys = _PHYS.get(ct.physical_type)
+    if phys is None:
+        return None
+    codec = _CODECS.get(ct.compression)
+    if codec is None:
+        return None
+    if not set(ct.encodings) <= _OK_ENCODINGS:
+        return None
+    sc = pf.schema.column(col_idx)
+    if sc.max_repetition_level != 0 or sc.max_definition_level > 1:
+        return None
+    offset = ct.data_page_offset
+    if ct.has_dictionary_page and ct.dictionary_page_offset is not None:
+        offset = min(offset, ct.dictionary_page_offset)
+    # scratch: one uncompressed page + parked dictionary; the chunk's
+    # total uncompressed size bounds both
+    scratch = max(int(ct.total_uncompressed_size) * 2, 1 << 16)
+    return _ChunkPlan(col_idx, phys[0], phys[1], codec,
+                      sc.max_definition_level, offset,
+                      int(ct.total_compressed_size), scratch)
+
+
+def _decode_native(fh, plan: _ChunkPlan, rows: int):
+    """-> (values ndarray, validity bool ndarray) or None on any
+    decoder error (falls back)."""
+    from ..native import parquet_decode_chunk
+    fh.seek(plan.offset)
+    chunk = fh.read(plan.length)
+    values = np.zeros(rows, plan.np_dtype)
+    validity = np.zeros(rows, np.uint8)
+    scratch = np.empty(plan.scratch, np.uint8)
+    got = parquet_decode_chunk(chunk, plan.codec, plan.phys_id, rows,
+                               plan.max_def, values, validity, scratch)
+    if got != rows:
+        return None
+    return values, validity.astype(bool)
+
+
+def _to_host_column(values: np.ndarray, validity: np.ndarray,
+                    declared: dt.DType) -> HostColumn:
+    phys = np.dtype(declared.physical)
+    if values.dtype != phys:
+        # e.g. file INT32 under a declared bigint/decimal(…,s)<=18
+        values = values.astype(phys)
+    return HostColumn(values, validity, declared)
+
+
+def _decode_row_group(pf, fh, rg: int, rows: int, want, file_cols,
+                      declared):
+    native: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+    fallback: List[str] = []
+    for name in want:
+        plan = _plan_chunk(pf, rg, file_cols[name], declared[name])
+        out = _decode_native(fh, plan, rows) if plan else None
+        if out is None:
+            fallback.append(name)
+        else:
+            native[name] = out
+    fb_table = None
+    if fallback:
+        from .arrow_convert import arrow_to_host_table
+        fb_table = arrow_to_host_table(
+            pf.read_row_group(rg, columns=fallback))
+    cols, names = [], []
+    for name in want:
+        names.append(name)
+        if name in native:
+            v, m = native[name]
+            cols.append(_to_host_column(v, m, declared[name]))
+        else:
+            src = fb_table.column(name)
+            if src.dtype != declared[name]:
+                raise ValueError(
+                    f"column {name}: file type {src.dtype} != "
+                    f"declared {declared[name]}")
+            cols.append(src)
+    return cols, names
+
+
+def iter_row_group_tables_native(
+        path: str, schema, options: dict, max_rows: int,
+        partition_values: Optional[dict]) -> Iterator[HostTable]:
+    """Row-group-chunked HostTables with per-column native decode.
+    Raises on structural mismatch — the caller catches and reruns the
+    pyarrow path."""
+    from .scan import _apply_read_rebase
+    declared: Dict[str, dt.DType] = dict(schema)
+    part_names = set((partition_values or {}).keys())
+    pf = pq.ParquetFile(path)
+    file_cols = {c: i for i, c in enumerate(pf.schema_arrow.names)}
+    want = [n for n, _ in schema
+            if n in file_cols and n not in part_names]
+    if pf.metadata.num_row_groups == 0:
+        raise ValueError("no row groups")  # fallback handles empties
+    with open(path, "rb") as fh:
+        for rg in range(pf.metadata.num_row_groups):
+            rows = pf.metadata.row_group(rg).num_rows
+            try:
+                cols, names = _decode_row_group(pf, fh, rg, rows, want,
+                                                file_cols, declared)
+            except Exception:
+                # per-ROW-GROUP fallback: earlier row groups already
+                # streamed out, so this one must be recovered in place
+                # (never re-read the whole file — that would duplicate)
+                from .arrow_convert import arrow_to_host_table
+                from .scan import _conform
+                fb = arrow_to_host_table(_conform(
+                    pf.read_row_group(rg, columns=want),
+                    [(n, declared[n]) for n in want]))
+                cols = [fb.column(n) for n in want]
+                names = list(want)
+            # partition columns materialize as constant host columns
+            # (no arrow round-trip); declared order is by construction
+            by_name = dict(zip(names, cols))
+            out_cols, out_names = [], []
+            for name, t in schema:
+                out_names.append(name)
+                if name in by_name:
+                    out_cols.append(by_name[name])
+                    continue
+                if name not in part_names:
+                    raise ValueError(f"column {name} missing from file")
+                v = (partition_values or {}).get(name)
+                mask = np.full(rows, v is not None)
+                if t == dt.STRING:
+                    vals = np.full(rows, v if v is not None else "",
+                                   dtype=object)
+                else:
+                    phys = np.dtype(t.physical)
+                    vals = np.full(rows, v if v is not None else 0,
+                                   dtype=phys)
+                out_cols.append(HostColumn(vals, mask, t))
+            ht = HostTable(out_cols, out_names)
+            _apply_read_rebase(ht, options)
+            for start in range(0, rows, max_rows):
+                if start == 0 and rows <= max_rows:
+                    yield ht
+                    break
+                end = min(start + max_rows, rows)
+                yield HostTable(
+                    [HostColumn(c.values[start:end],
+                                c.mask[start:end], c.dtype)
+                     for c in ht.columns], list(ht.names))

@@ -305,6 +305,13 @@ class FileScan(LogicalPlan):
         out._part_values = self._part_values
         return out
 
+    def with_schema(self, keep: "Schema") -> "FileScan":
+        """Column-pruned COPY (ColumnPruning: scans are shared across
+        DataFrames, so the original must stay intact)."""
+        out = self.with_pushed_filter(self.pushed_filter)
+        out._schema = list(keep)
+        return out
+
     def node_description(self) -> str:
         pushed = f", pushed={self.pushed_filter!r}" \
             if self.pushed_filter is not None else ""
@@ -440,6 +447,44 @@ def iter_file_tables(path: str, fmt: str, schema: Schema,
     path = resolve_read_path(path, conf)
     names = [n for n, _ in schema]
     if fmt == "parquet":
+        from ..conf import PARQUET_NATIVE_DECODE, active_conf
+        c = conf or active_conf()
+        use_native = c.get(PARQUET_NATIVE_DECODE)
+        if use_native and \
+                PARQUET_NATIVE_DECODE.key not in c._settings:
+            # default-on only when a real accelerator consumes the
+            # batches: the native path decodes EVERY row (the device
+            # filter is ~free on TPU); on the CPU-emulation backend
+            # pyarrow's row-level filter pushdown wins, so the default
+            # follows the backend (explicit setting always honored)
+            import jax
+            use_native = jax.default_backend() != "cpu"
+        if use_native:
+            # native column-chunk decode (C++, GIL-free). Fallback to
+            # the arrow path happens ONLY before the first table is
+            # yielded (setup/footer surprises); after that, per-row-
+            # group recovery inside the native iterator keeps the
+            # stream alive — re-running the whole file here would
+            # duplicate rows already emitted. The pushed arrow filter
+            # is a row-level pruning OPTIMIZATION only — the Filter
+            # node above the scan stays (push_down_filters), so
+            # skipping it in the native path is correct.
+            from .native_parquet import iter_row_group_tables_native
+            failed = False
+            first = None
+            try:
+                it = iter_row_group_tables_native(
+                    path, schema, options, max_rows, partition_values)
+                first = next(it, None)
+            except Exception:
+                failed = True
+            if not failed and first is not None:
+                yield first
+                yield from it
+                return
+            # failed, or the file produced nothing (e.g. empty row
+            # groups): the arrow path below also emits the schema-only
+            # empty table contract
         import pyarrow.dataset as ds
         dataset = ds.dataset(path, format="parquet")
         cols = names if set(names) <= set(dataset.schema.names) else None

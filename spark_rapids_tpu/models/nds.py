@@ -1,0 +1,694 @@
+"""NDS (TPC-DS derived) schema + a 24-query power-run subset as SQL
+text (BASELINE.md config 2 breadth; reference integration_tests run the
+99-query suite the same way — SQL text against generated tables).
+
+The specs generate the columns the query subset touches, with realistic
+key ranges, skew, and null probabilities; the query texts keep each
+original query's STRUCTURE (join graph, predicate shapes, aggregation
+and window patterns, set operations) in the engine's SQL dialect.
+``register_nds`` generates the tables once into a directory and
+registers them as temp views; every query then runs via
+``session.sql(NDS_QUERIES[qid])`` and is checked differentially against
+the CPU oracle in tests/test_nds_queries.py.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict
+
+from ..columnar import dtypes as dt
+from ..datagen import ColumnSpec, TableSpec, generate_table
+
+# --- schema ---------------------------------------------------------------
+
+_DAYS = 730          # two years of date_dim
+_ITEMS = 2000
+_STORES = 20
+_CUSTOMERS = 5000
+_ADDRESSES = 2500
+_DEMOS = 1000
+_HDEMOS = 144
+_PROMOS = 50
+_WAREHOUSES = 5
+
+
+def _sales_money(name, lo=1.0, hi=500.0, null_prob=0.02):
+    return ColumnSpec(name, dt.FLOAT64, "uniform", lo=lo, hi=hi,
+                      null_prob=null_prob)
+
+
+def nds_specs(scale_rows: int):
+    """TableSpecs for the query subset's column surface."""
+    ss = TableSpec("store_sales", [
+        ColumnSpec("ss_sold_date_sk", dt.INT64, "uniform", lo=1,
+                   hi=_DAYS, null_prob=0.01),
+        ColumnSpec("ss_item_sk", dt.INT64, "uniform", lo=1, hi=_ITEMS),
+        ColumnSpec("ss_customer_sk", dt.INT64, "zipf",
+                   cardinality=_CUSTOMERS, null_prob=0.02),
+        ColumnSpec("ss_cdemo_sk", dt.INT64, "uniform", lo=1, hi=_DEMOS,
+                   null_prob=0.02),
+        ColumnSpec("ss_hdemo_sk", dt.INT64, "uniform", lo=1, hi=_HDEMOS,
+                   null_prob=0.02),
+        ColumnSpec("ss_addr_sk", dt.INT64, "uniform", lo=1,
+                   hi=_ADDRESSES, null_prob=0.02),
+        ColumnSpec("ss_store_sk", dt.INT64, "uniform", lo=1, hi=_STORES,
+                   null_prob=0.01),
+        ColumnSpec("ss_promo_sk", dt.INT64, "uniform", lo=1, hi=_PROMOS,
+                   null_prob=0.05),
+        ColumnSpec("ss_ticket_number", dt.INT64, "seq"),
+        ColumnSpec("ss_quantity", dt.INT64, "uniform", lo=1, hi=100),
+        _sales_money("ss_wholesale_cost", 1.0, 100.0),
+        _sales_money("ss_list_price", 1.0, 200.0),
+        _sales_money("ss_sales_price", 1.0, 200.0),
+        _sales_money("ss_ext_discount_amt", 0.0, 100.0),
+        _sales_money("ss_ext_sales_price"),
+        _sales_money("ss_ext_wholesale_cost"),
+        _sales_money("ss_ext_list_price", 1.0, 1000.0),
+        _sales_money("ss_ext_tax", 0.0, 50.0),
+        _sales_money("ss_coupon_amt", 0.0, 50.0),
+        _sales_money("ss_net_paid"),
+        ColumnSpec("ss_net_profit", dt.FLOAT64, "normal", mean=20.0,
+                   std=40.0, null_prob=0.02),
+    ], scale_rows)
+    sr = TableSpec("store_returns", [
+        ColumnSpec("sr_returned_date_sk", dt.INT64, "uniform", lo=1,
+                   hi=_DAYS, null_prob=0.01),
+        ColumnSpec("sr_item_sk", dt.INT64, "uniform", lo=1, hi=_ITEMS),
+        ColumnSpec("sr_customer_sk", dt.INT64, "zipf",
+                   cardinality=_CUSTOMERS, null_prob=0.02),
+        ColumnSpec("sr_ticket_number", dt.INT64, "uniform", lo=1,
+                   hi=max(scale_rows, 1)),
+        ColumnSpec("sr_store_sk", dt.INT64, "uniform", lo=1, hi=_STORES,
+                   null_prob=0.01),
+        ColumnSpec("sr_cdemo_sk", dt.INT64, "uniform", lo=1, hi=_DEMOS,
+                   null_prob=0.02),
+        ColumnSpec("sr_reason_sk", dt.INT64, "uniform", lo=1, hi=30,
+                   null_prob=0.02),
+        ColumnSpec("sr_return_quantity", dt.INT64, "uniform", lo=1,
+                   hi=40, null_prob=0.02),
+        _sales_money("sr_return_amt", 1.0, 300.0),
+        _sales_money("sr_net_loss", 1.0, 150.0),
+    ], max(scale_rows // 10, 10))
+    cs = TableSpec("catalog_sales", [
+        ColumnSpec("cs_sold_date_sk", dt.INT64, "uniform", lo=1,
+                   hi=_DAYS, null_prob=0.01),
+        ColumnSpec("cs_ship_date_sk", dt.INT64, "uniform", lo=1,
+                   hi=_DAYS, null_prob=0.01),
+        ColumnSpec("cs_item_sk", dt.INT64, "uniform", lo=1, hi=_ITEMS),
+        ColumnSpec("cs_bill_customer_sk", dt.INT64, "zipf",
+                   cardinality=_CUSTOMERS, null_prob=0.02),
+        ColumnSpec("cs_warehouse_sk", dt.INT64, "uniform", lo=1,
+                   hi=_WAREHOUSES, null_prob=0.02),
+        ColumnSpec("cs_promo_sk", dt.INT64, "uniform", lo=1, hi=_PROMOS,
+                   null_prob=0.05),
+        ColumnSpec("cs_call_center_sk", dt.INT64, "uniform", lo=1, hi=6,
+                   null_prob=0.02),
+        ColumnSpec("cs_ship_mode_sk", dt.INT64, "uniform", lo=1, hi=20,
+                   null_prob=0.02),
+        ColumnSpec("cs_quantity", dt.INT64, "uniform", lo=1, hi=100),
+        _sales_money("cs_wholesale_cost", 1.0, 100.0),
+        _sales_money("cs_list_price", 1.0, 300.0),
+        _sales_money("cs_sales_price", 1.0, 300.0),
+        _sales_money("cs_ext_discount_amt", 0.0, 100.0),
+        _sales_money("cs_ext_sales_price"),
+        _sales_money("cs_ext_wholesale_cost"),
+        ColumnSpec("cs_net_profit", dt.FLOAT64, "normal", mean=25.0,
+                   std=50.0, null_prob=0.02),
+    ], max(scale_rows // 2, 10))
+    ws = TableSpec("web_sales", [
+        ColumnSpec("ws_sold_date_sk", dt.INT64, "uniform", lo=1,
+                   hi=_DAYS, null_prob=0.01),
+        ColumnSpec("ws_item_sk", dt.INT64, "uniform", lo=1, hi=_ITEMS),
+        ColumnSpec("ws_bill_customer_sk", dt.INT64, "zipf",
+                   cardinality=_CUSTOMERS, null_prob=0.02),
+        ColumnSpec("ws_web_site_sk", dt.INT64, "uniform", lo=1, hi=12,
+                   null_prob=0.01),
+        ColumnSpec("ws_promo_sk", dt.INT64, "uniform", lo=1, hi=_PROMOS,
+                   null_prob=0.05),
+        ColumnSpec("ws_quantity", dt.INT64, "uniform", lo=1, hi=100),
+        _sales_money("ws_wholesale_cost", 1.0, 100.0),
+        _sales_money("ws_sales_price", 1.0, 300.0),
+        _sales_money("ws_ext_discount_amt", 0.0, 100.0),
+        _sales_money("ws_ext_sales_price"),
+        _sales_money("ws_ext_wholesale_cost"),
+        _sales_money("ws_net_paid"),
+        ColumnSpec("ws_net_profit", dt.FLOAT64, "normal", mean=25.0,
+                   std=50.0, null_prob=0.02),
+    ], max(scale_rows // 4, 10))
+    inv = TableSpec("inventory", [
+        ColumnSpec("inv_date_sk", dt.INT64, "uniform", lo=1, hi=_DAYS),
+        ColumnSpec("inv_item_sk", dt.INT64, "uniform", lo=1, hi=_ITEMS),
+        ColumnSpec("inv_warehouse_sk", dt.INT64, "uniform", lo=1,
+                   hi=_WAREHOUSES),
+        ColumnSpec("inv_quantity_on_hand", dt.INT64, "uniform", lo=0,
+                   hi=1000, null_prob=0.02),
+    ], max(scale_rows // 4, 10))
+    dd = TableSpec("date_dim", [
+        ColumnSpec("d_date_sk", dt.INT64, "seq"),
+        ColumnSpec("d_date", dt.DATE, "uniform", lo=10000, hi=10730),
+        ColumnSpec("d_year", dt.INT64, "choice", choices=[1998, 1999]),
+        ColumnSpec("d_moy", dt.INT64, "uniform", lo=1, hi=12),
+        ColumnSpec("d_dom", dt.INT64, "uniform", lo=1, hi=28),
+        ColumnSpec("d_qoy", dt.INT64, "uniform", lo=1, hi=4),
+        ColumnSpec("d_dow", dt.INT64, "uniform", lo=0, hi=6),
+        ColumnSpec("d_month_seq", dt.INT64, "uniform", lo=1176,
+                   hi=1224),
+        ColumnSpec("d_week_seq", dt.INT64, "uniform", lo=5100, hi=5204),
+        ColumnSpec("d_day_name", dt.STRING, "choice",
+                   choices=["Sunday", "Monday", "Tuesday", "Wednesday",
+                            "Thursday", "Friday", "Saturday"]),
+    ], _DAYS)
+    it = TableSpec("item", [
+        ColumnSpec("i_item_sk", dt.INT64, "seq"),
+        ColumnSpec("i_item_id", dt.STRING, "seq", fmt="ITEM{:011d}"),
+        ColumnSpec("i_item_desc", dt.STRING, "uniform", lo=1, hi=500,
+                   fmt="description of item number {} with detail"),
+        ColumnSpec("i_brand_id", dt.INT64, "uniform", lo=1, hi=50),
+        ColumnSpec("i_brand", dt.STRING, "uniform", lo=1, hi=50,
+                   fmt="brand#{}"),
+        ColumnSpec("i_class_id", dt.INT64, "uniform", lo=1, hi=16),
+        ColumnSpec("i_class", dt.STRING, "uniform", lo=1, hi=16,
+                   fmt="class{}"),
+        ColumnSpec("i_category_id", dt.INT64, "uniform", lo=1, hi=10),
+        ColumnSpec("i_category", dt.STRING, "choice",
+                   choices=["Books", "Children", "Electronics", "Home",
+                            "Jewelry", "Men", "Music", "Shoes",
+                            "Sports", "Women"]),
+        ColumnSpec("i_manufact_id", dt.INT64, "uniform", lo=1, hi=20),
+        ColumnSpec("i_manufact", dt.STRING, "uniform", lo=1, hi=20,
+                   fmt="manufact{}"),
+        ColumnSpec("i_manager_id", dt.INT64, "uniform", lo=1, hi=10),
+        _sales_money("i_current_price", 1.0, 100.0),
+        _sales_money("i_wholesale_cost", 1.0, 80.0),
+        ColumnSpec("i_color", dt.STRING, "choice",
+                   choices=["red", "blue", "green", "black", "white",
+                            "plum", "navy", "orchid", "chiffon"]),
+        ColumnSpec("i_size", dt.STRING, "choice",
+                   choices=["small", "medium", "large", "extra large",
+                            "petite", "economy"]),
+    ], _ITEMS)
+    st = TableSpec("store", [
+        ColumnSpec("s_store_sk", dt.INT64, "seq"),
+        ColumnSpec("s_store_id", dt.STRING, "seq", fmt="STORE{:08d}"),
+        ColumnSpec("s_store_name", dt.STRING, "uniform", lo=1,
+                   hi=_STORES, fmt="store{}"),
+        ColumnSpec("s_state", dt.STRING, "choice",
+                   choices=["TN", "CA", "TX", "NY", "WA", "GA"]),
+        ColumnSpec("s_county", dt.STRING, "uniform", lo=1, hi=8,
+                   fmt="county{}"),
+        ColumnSpec("s_city", dt.STRING, "uniform", lo=1, hi=12,
+                   fmt="city{}"),
+        ColumnSpec("s_gmt_offset", dt.FLOAT64, "choice",
+                   choices=[-5.0, -6.0, -7.0, -8.0]),
+        ColumnSpec("s_number_employees", dt.INT64, "uniform", lo=200,
+                   hi=300),
+    ], _STORES)
+    cu = TableSpec("customer", [
+        ColumnSpec("c_customer_sk", dt.INT64, "seq"),
+        ColumnSpec("c_customer_id", dt.STRING, "seq", fmt="CUST{:011d}"),
+        ColumnSpec("c_first_name", dt.STRING, "uniform", lo=1, hi=400,
+                   fmt="first{}", null_prob=0.02),
+        ColumnSpec("c_last_name", dt.STRING, "uniform", lo=1, hi=600,
+                   fmt="last{}", null_prob=0.02),
+        ColumnSpec("c_current_addr_sk", dt.INT64, "uniform", lo=1,
+                   hi=_ADDRESSES),
+        ColumnSpec("c_current_cdemo_sk", dt.INT64, "uniform", lo=1,
+                   hi=_DEMOS, null_prob=0.02),
+        ColumnSpec("c_current_hdemo_sk", dt.INT64, "uniform", lo=1,
+                   hi=_HDEMOS, null_prob=0.02),
+        ColumnSpec("c_birth_year", dt.INT64, "uniform", lo=1930,
+                   hi=1992, null_prob=0.02),
+        ColumnSpec("c_birth_month", dt.INT64, "uniform", lo=1, hi=12,
+                   null_prob=0.02),
+    ], _CUSTOMERS)
+    ca = TableSpec("customer_address", [
+        ColumnSpec("ca_address_sk", dt.INT64, "seq"),
+        ColumnSpec("ca_state", dt.STRING, "choice",
+                   choices=["TN", "CA", "TX", "NY", "WA", "GA", "KY",
+                            "OH", "VA"], null_prob=0.01),
+        ColumnSpec("ca_city", dt.STRING, "uniform", lo=1, hi=60,
+                   fmt="city{}"),
+        ColumnSpec("ca_county", dt.STRING, "uniform", lo=1, hi=30,
+                   fmt="county{}"),
+        ColumnSpec("ca_country", dt.STRING, "choice",
+                   choices=["United States"]),
+        ColumnSpec("ca_gmt_offset", dt.FLOAT64, "choice",
+                   choices=[-5.0, -6.0, -7.0, -8.0]),
+        ColumnSpec("ca_zip", dt.STRING, "uniform", lo=10000, hi=99999,
+                   fmt="{}"),
+    ], _ADDRESSES)
+    cd = TableSpec("customer_demographics", [
+        ColumnSpec("cd_demo_sk", dt.INT64, "seq"),
+        ColumnSpec("cd_gender", dt.STRING, "choice", choices=["M", "F"]),
+        ColumnSpec("cd_marital_status", dt.STRING, "choice",
+                   choices=["M", "S", "D", "W", "U"]),
+        ColumnSpec("cd_education_status", dt.STRING, "choice",
+                   choices=["Primary", "Secondary", "College",
+                            "2 yr Degree", "4 yr Degree", "Advanced "
+                            "Degree", "Unknown"]),
+        ColumnSpec("cd_purchase_estimate", dt.INT64, "uniform", lo=500,
+                   hi=10000),
+        ColumnSpec("cd_credit_rating", dt.STRING, "choice",
+                   choices=["Low Risk", "Good", "High Risk",
+                            "Unknown"]),
+        ColumnSpec("cd_dep_count", dt.INT64, "uniform", lo=0, hi=6),
+    ], _DEMOS)
+    hd = TableSpec("household_demographics", [
+        ColumnSpec("hd_demo_sk", dt.INT64, "seq"),
+        ColumnSpec("hd_income_band_sk", dt.INT64, "uniform", lo=1,
+                   hi=20),
+        ColumnSpec("hd_buy_potential", dt.STRING, "choice",
+                   choices=[">10000", "5001-10000", "1001-5000",
+                            "501-1000", "0-500", "Unknown"]),
+        ColumnSpec("hd_dep_count", dt.INT64, "uniform", lo=0, hi=9),
+        ColumnSpec("hd_vehicle_count", dt.INT64, "uniform", lo=0, hi=4),
+    ], _HDEMOS)
+    pr = TableSpec("promotion", [
+        ColumnSpec("p_promo_sk", dt.INT64, "seq"),
+        ColumnSpec("p_channel_email", dt.STRING, "choice",
+                   choices=["Y", "N"]),
+        ColumnSpec("p_channel_event", dt.STRING, "choice",
+                   choices=["Y", "N"]),
+        ColumnSpec("p_channel_dmail", dt.STRING, "choice",
+                   choices=["Y", "N"]),
+        ColumnSpec("p_channel_tv", dt.STRING, "choice",
+                   choices=["Y", "N"]),
+    ], _PROMOS)
+    wh = TableSpec("warehouse", [
+        ColumnSpec("w_warehouse_sk", dt.INT64, "seq"),
+        ColumnSpec("w_warehouse_name", dt.STRING, "uniform", lo=1,
+                   hi=_WAREHOUSES, fmt="warehouse{}"),
+        ColumnSpec("w_state", dt.STRING, "choice",
+                   choices=["TN", "CA", "TX"]),
+    ], _WAREHOUSES)
+    return [ss, sr, cs, ws, inv, dd, it, st, cu, ca, cd, hd, pr, wh]
+
+
+def register_nds(session, data_dir: str, scale_rows: int = 20_000):
+    """Generate (once) + register every table as a temp view."""
+    for spec in nds_specs(scale_rows):
+        out = os.path.join(data_dir, spec.name)
+        if not (os.path.isdir(out) and os.listdir(out)):
+            generate_table(session, spec, out, chunk_rows=1 << 18)
+        session.create_or_replace_temp_view(
+            spec.name, session.read.parquet(out))
+
+
+# --- the query subset ------------------------------------------------------
+# Keys are NDS query ids; texts keep each query's structural shape
+# (join graph, predicates, aggregation/window/set-op patterns) in this
+# engine's SQL dialect. Substitution parameters are fixed choices.
+
+NDS_QUERIES: Dict[str, str] = {
+    # 3-way star join, grouped sum, sort (q3)
+    "q3": """
+        SELECT d_year, i_brand_id AS brand_id, i_brand AS brand,
+               SUM(ss_ext_sales_price) AS sum_agg
+        FROM store_sales
+        JOIN date_dim ON ss_sold_date_sk = d_date_sk
+        JOIN item ON ss_item_sk = i_item_sk
+        WHERE i_manufact_id = 7 AND d_moy = 11
+        GROUP BY d_year, i_brand_id, i_brand
+        ORDER BY d_year, sum_agg DESC, brand_id
+        LIMIT 100""",
+    # demographics + promotion star join (q7)
+    "q7": """
+        SELECT i_item_id,
+               AVG(ss_quantity) AS agg1, AVG(ss_list_price) AS agg2,
+               AVG(ss_coupon_amt) AS agg3, AVG(ss_sales_price) AS agg4
+        FROM store_sales
+        JOIN customer_demographics ON ss_cdemo_sk = cd_demo_sk
+        JOIN date_dim ON ss_sold_date_sk = d_date_sk
+        JOIN item ON ss_item_sk = i_item_sk
+        JOIN promotion ON ss_promo_sk = p_promo_sk
+        WHERE cd_gender = 'M' AND cd_marital_status = 'S'
+          AND cd_education_status = 'College'
+          AND (p_channel_email = 'N' OR p_channel_event = 'N')
+          AND d_year = 1998
+        GROUP BY i_item_id
+        ORDER BY i_item_id
+        LIMIT 100""",
+    # window ratio inside category (q12 shape, web channel)
+    "q12": """
+        SELECT i_item_id, i_item_desc, i_category, i_class,
+               i_current_price,
+               SUM(ws_ext_sales_price) AS itemrevenue,
+               SUM(ws_ext_sales_price) * 100.0 /
+                 SUM(SUM(ws_ext_sales_price))
+                   OVER (PARTITION BY i_class) AS revenueratio
+        FROM web_sales
+        JOIN item ON ws_item_sk = i_item_sk
+        JOIN date_dim ON ws_sold_date_sk = d_date_sk
+        WHERE i_category IN ('Sports', 'Books', 'Home')
+          AND d_year = 1999 AND d_moy BETWEEN 2 AND 3
+        GROUP BY i_item_id, i_item_desc, i_category, i_class,
+                 i_current_price
+        ORDER BY i_category, i_class, i_item_id, i_item_desc,
+                 revenueratio
+        LIMIT 100""",
+    # customer/address join with geography filter (q15 shape)
+    "q15": """
+        SELECT ca_zip, SUM(cs_sales_price) AS sum_sales
+        FROM catalog_sales
+        JOIN customer ON cs_bill_customer_sk = c_customer_sk
+        JOIN customer_address ON c_current_addr_sk = ca_address_sk
+        JOIN date_dim ON cs_sold_date_sk = d_date_sk
+        WHERE (ca_state IN ('CA', 'WA', 'GA')
+               OR cs_sales_price > 250.0)
+          AND d_qoy = 1 AND d_year = 1999
+        GROUP BY ca_zip
+        ORDER BY ca_zip
+        LIMIT 100""",
+    # brand revenue by manager/month with store join (q19 shape)
+    "q19": """
+        SELECT i_brand_id AS brand_id, i_brand AS brand,
+               i_manufact_id, i_manufact,
+               SUM(ss_ext_sales_price) AS ext_price
+        FROM date_dim
+        JOIN store_sales ON d_date_sk = ss_sold_date_sk
+        JOIN item ON ss_item_sk = i_item_sk
+        JOIN customer ON ss_customer_sk = c_customer_sk
+        JOIN customer_address ON c_current_addr_sk = ca_address_sk
+        JOIN store ON ss_store_sk = s_store_sk
+        WHERE i_manager_id = 8 AND d_moy = 11 AND d_year = 1998
+          AND ca_state <> s_state
+        GROUP BY i_brand_id, i_brand, i_manufact_id, i_manufact
+        ORDER BY ext_price DESC, brand_id, i_manufact_id
+        LIMIT 100""",
+    # catalog window ratio (q20)
+    "q20": """
+        SELECT i_item_id, i_item_desc, i_category, i_class,
+               i_current_price,
+               SUM(cs_ext_sales_price) AS itemrevenue,
+               SUM(cs_ext_sales_price) * 100.0 /
+                 SUM(SUM(cs_ext_sales_price))
+                   OVER (PARTITION BY i_class) AS revenueratio
+        FROM catalog_sales
+        JOIN item ON cs_item_sk = i_item_sk
+        JOIN date_dim ON cs_sold_date_sk = d_date_sk
+        WHERE i_category IN ('Jewelry', 'Shoes', 'Electronics')
+          AND d_year = 1999 AND d_moy BETWEEN 2 AND 3
+        GROUP BY i_item_id, i_item_desc, i_category, i_class,
+                 i_current_price
+        ORDER BY i_category, i_class, i_item_id, i_item_desc,
+                 revenueratio
+        LIMIT 100""",
+    # inventory before/after CASE pivot (q21 shape)
+    "q21": """
+        SELECT w_warehouse_name, i_item_id,
+               SUM(CASE WHEN d_moy < 6 THEN inv_quantity_on_hand
+                        ELSE 0 END) AS inv_before,
+               SUM(CASE WHEN d_moy >= 6 THEN inv_quantity_on_hand
+                        ELSE 0 END) AS inv_after
+        FROM inventory
+        JOIN warehouse ON inv_warehouse_sk = w_warehouse_sk
+        JOIN item ON inv_item_sk = i_item_sk
+        JOIN date_dim ON inv_date_sk = d_date_sk
+        WHERE i_current_price BETWEEN 0.99 AND 50.49
+          AND d_year = 1999
+        GROUP BY w_warehouse_name, i_item_id
+        HAVING SUM(CASE WHEN d_moy >= 6 THEN inv_quantity_on_hand
+                        ELSE 0 END) > 0
+        ORDER BY w_warehouse_name, i_item_id
+        LIMIT 100""",
+    # sales + returns chain (q25 shape: ss -> sr by ticket+item)
+    "q25": """
+        SELECT i_item_id, i_item_desc, s_store_id, s_store_name,
+               SUM(ss_net_profit) AS store_sales_profit,
+               SUM(sr_net_loss) AS store_returns_loss
+        FROM store_sales
+        JOIN store_returns ON ss_ticket_number = sr_ticket_number
+                          AND ss_item_sk = sr_item_sk
+        JOIN date_dim ON ss_sold_date_sk = d_date_sk
+        JOIN store ON ss_store_sk = s_store_sk
+        JOIN item ON ss_item_sk = i_item_sk
+        WHERE d_moy = 4 AND d_year = 1999
+        GROUP BY i_item_id, i_item_desc, s_store_id, s_store_name
+        ORDER BY i_item_id, i_item_desc, s_store_id, s_store_name
+        LIMIT 100""",
+    # demographics-filtered catalog aggregates (q26)
+    "q26": """
+        SELECT i_item_id,
+               AVG(cs_quantity) AS agg1, AVG(cs_list_price) AS agg2,
+               AVG(cs_sales_price) AS agg4
+        FROM catalog_sales
+        JOIN customer_demographics ON cs_bill_customer_sk = cd_demo_sk
+        JOIN date_dim ON cs_sold_date_sk = d_date_sk
+        JOIN item ON cs_item_sk = i_item_sk
+        WHERE cd_gender = 'F' AND cd_marital_status = 'W'
+          AND cd_education_status = 'Primary' AND d_year = 1998
+        GROUP BY i_item_id
+        ORDER BY i_item_id
+        LIMIT 100""",
+    # inventory availability window (q37 shape)
+    "q37": """
+        SELECT i_item_id, i_item_desc, i_current_price
+        FROM item
+        JOIN inventory ON inv_item_sk = i_item_sk
+        JOIN date_dim ON d_date_sk = inv_date_sk
+        WHERE i_current_price BETWEEN 20.0 AND 50.0
+          AND inv_quantity_on_hand BETWEEN 100 AND 500
+          AND i_manufact_id IN (3, 8, 17, 19)
+          AND d_year = 1999
+        GROUP BY i_item_id, i_item_desc, i_current_price
+        ORDER BY i_item_id
+        LIMIT 100""",
+    # catalog sales +/- returns-style CASE by warehouse (q40 shape)
+    "q40": """
+        SELECT w_state, i_item_id,
+               SUM(CASE WHEN d_moy < 6 THEN cs_sales_price
+                        ELSE 0.0 END) AS sales_before,
+               SUM(CASE WHEN d_moy >= 6 THEN cs_sales_price
+                        ELSE 0.0 END) AS sales_after
+        FROM catalog_sales
+        JOIN warehouse ON cs_warehouse_sk = w_warehouse_sk
+        JOIN item ON cs_item_sk = i_item_sk
+        JOIN date_dim ON cs_sold_date_sk = d_date_sk
+        WHERE i_current_price BETWEEN 0.99 AND 1.49 OR d_year = 1999
+        GROUP BY w_state, i_item_id
+        ORDER BY w_state, i_item_id
+        LIMIT 100""",
+    # single-month category revenue (q42)
+    "q42": """
+        SELECT d_year, i_category_id, i_category,
+               SUM(ss_ext_sales_price) AS total_sales
+        FROM date_dim
+        JOIN store_sales ON d_date_sk = ss_sold_date_sk
+        JOIN item ON ss_item_sk = i_item_sk
+        WHERE d_moy = 12 AND d_year = 1998
+        GROUP BY d_year, i_category_id, i_category
+        ORDER BY total_sales DESC, d_year, i_category_id, i_category
+        LIMIT 100""",
+    # day-of-week pivot per store (q43)
+    "q43": """
+        SELECT s_store_name, s_store_id,
+               SUM(CASE WHEN d_day_name = 'Sunday'
+                        THEN ss_sales_price ELSE 0.0 END) AS sun_sales,
+               SUM(CASE WHEN d_day_name = 'Monday'
+                        THEN ss_sales_price ELSE 0.0 END) AS mon_sales,
+               SUM(CASE WHEN d_day_name = 'Friday'
+                        THEN ss_sales_price ELSE 0.0 END) AS fri_sales,
+               SUM(CASE WHEN d_day_name = 'Saturday'
+                        THEN ss_sales_price ELSE 0.0 END) AS sat_sales
+        FROM date_dim
+        JOIN store_sales ON d_date_sk = ss_sold_date_sk
+        JOIN store ON ss_store_sk = s_store_sk
+        WHERE s_gmt_offset = -5.0 AND d_year = 1998
+        GROUP BY s_store_name, s_store_id
+        ORDER BY s_store_name, s_store_id
+        LIMIT 100""",
+    # demographic buckets with CASE counts (q48 shape)
+    "q48": """
+        SELECT SUM(ss_quantity) AS total_quantity
+        FROM store_sales
+        JOIN store ON s_store_sk = ss_store_sk
+        JOIN customer_demographics ON cd_demo_sk = ss_cdemo_sk
+        JOIN customer_address ON ss_addr_sk = ca_address_sk
+        JOIN date_dim ON ss_sold_date_sk = d_date_sk
+        WHERE d_year = 1999
+          AND ((cd_marital_status = 'M'
+                AND cd_education_status = '4 yr Degree'
+                AND ss_sales_price BETWEEN 100.0 AND 150.0)
+            OR (cd_marital_status = 'D'
+                AND cd_education_status = '2 yr Degree'
+                AND ss_sales_price BETWEEN 50.0 AND 100.0)
+            OR (cd_marital_status = 'S'
+                AND cd_education_status = 'College'
+                AND ss_sales_price BETWEEN 150.0 AND 200.0))""",
+    # brand revenue slice (q52)
+    "q52": """
+        SELECT d_year, i_brand_id AS brand_id, i_brand AS brand,
+               SUM(ss_ext_sales_price) AS ext_price
+        FROM date_dim
+        JOIN store_sales ON d_date_sk = ss_sold_date_sk
+        JOIN item ON ss_item_sk = i_item_sk
+        WHERE i_manager_id = 1 AND d_moy = 11 AND d_year = 1999
+        GROUP BY d_year, i_brand_id, i_brand
+        ORDER BY d_year, ext_price DESC, brand_id
+        LIMIT 100""",
+    # manager slice (q55)
+    "q55": """
+        SELECT i_brand_id AS brand_id, i_brand AS brand,
+               SUM(ss_ext_sales_price) AS ext_price
+        FROM date_dim
+        JOIN store_sales ON d_date_sk = ss_sold_date_sk
+        JOIN item ON ss_item_sk = i_item_sk
+        WHERE i_manager_id = 4 AND d_moy = 11 AND d_year = 1999
+        GROUP BY i_brand_id, i_brand
+        ORDER BY ext_price DESC, brand_id
+        LIMIT 100""",
+    # ship-lag CASE buckets (q62 shape)
+    "q62": """
+        SELECT w_warehouse_name,
+               SUM(CASE WHEN cs_ship_date_sk - cs_sold_date_sk <= 30
+                        THEN 1 ELSE 0 END) AS d30,
+               SUM(CASE WHEN cs_ship_date_sk - cs_sold_date_sk > 30
+                         AND cs_ship_date_sk - cs_sold_date_sk <= 60
+                        THEN 1 ELSE 0 END) AS d60,
+               SUM(CASE WHEN cs_ship_date_sk - cs_sold_date_sk > 60
+                        THEN 1 ELSE 0 END) AS dmore
+        FROM catalog_sales
+        JOIN warehouse ON cs_warehouse_sk = w_warehouse_sk
+        JOIN date_dim ON cs_ship_date_sk = d_date_sk
+        WHERE d_year = 1999
+        GROUP BY w_warehouse_name
+        ORDER BY w_warehouse_name
+        LIMIT 100""",
+    # customer ticket rollup then top-by-window (q68 family shape)
+    "q68": """
+        SELECT c_last_name, c_first_name, ca_city, bought_city,
+               ss_ticket_number, extended_price, extended_tax,
+               list_price
+        FROM (SELECT ss_ticket_number, ss_customer_sk,
+                     ca_city AS bought_city,
+                     SUM(ss_ext_sales_price) AS extended_price,
+                     SUM(ss_ext_list_price) AS list_price,
+                     SUM(ss_ext_tax) AS extended_tax
+              FROM store_sales
+              JOIN date_dim ON ss_sold_date_sk = d_date_sk
+              JOIN store ON ss_store_sk = s_store_sk
+              JOIN household_demographics ON ss_hdemo_sk = hd_demo_sk
+              JOIN customer_address ON ss_addr_sk = ca_address_sk
+              WHERE d_dom BETWEEN 1 AND 2
+                AND (hd_dep_count = 4 OR hd_vehicle_count = 3)
+                AND d_year = 1999
+                AND s_city IN ('city1', 'city2')
+              GROUP BY ss_ticket_number, ss_customer_sk, ca_city) dn
+        JOIN customer ON ss_customer_sk = c_customer_sk
+        JOIN customer_address ON c_current_addr_sk = ca_address_sk
+        WHERE ca_city <> bought_city
+        ORDER BY c_last_name, ss_ticket_number
+        LIMIT 100""",
+    # store/demographic hour-style counts (q79 shape)
+    "q79": """
+        SELECT c_last_name, c_first_name,
+               SUBSTRING(s_city, 1, 30) AS city_part,
+               ss_ticket_number, amt, profit
+        FROM (SELECT ss_ticket_number, ss_customer_sk, s_city,
+                     SUM(ss_coupon_amt) AS amt,
+                     SUM(ss_net_profit) AS profit
+              FROM store_sales
+              JOIN date_dim ON ss_sold_date_sk = d_date_sk
+              JOIN store ON ss_store_sk = s_store_sk
+              JOIN household_demographics ON ss_hdemo_sk = hd_demo_sk
+              WHERE (hd_dep_count = 6 OR hd_vehicle_count > 2)
+                AND d_dow = 1 AND d_year = 1998
+                AND s_number_employees BETWEEN 200 AND 295
+              GROUP BY ss_ticket_number, ss_customer_sk, s_city) ms
+        JOIN customer ON ss_customer_sk = c_customer_sk
+        ORDER BY c_last_name, c_first_name, city_part, profit
+        LIMIT 100""",
+    # inventory window by item price band (q82 = q37 over store)
+    "q82": """
+        SELECT i_item_id, i_item_desc, i_current_price
+        FROM item
+        JOIN inventory ON inv_item_sk = i_item_sk
+        JOIN date_dim ON d_date_sk = inv_date_sk
+        JOIN store_sales ON ss_item_sk = i_item_sk
+        WHERE i_current_price BETWEEN 30.0 AND 60.0
+          AND inv_quantity_on_hand BETWEEN 100 AND 500
+          AND i_manufact_id IN (2, 6, 12, 17)
+        GROUP BY i_item_id, i_item_desc, i_current_price
+        ORDER BY i_item_id
+        LIMIT 100""",
+    # half-hour-style count over hdemo/store slice (q96 shape)
+    "q96": """
+        SELECT COUNT(*) AS cnt
+        FROM store_sales
+        JOIN household_demographics ON ss_hdemo_sk = hd_demo_sk
+        JOIN store ON ss_store_sk = s_store_sk
+        WHERE hd_dep_count = 3 AND s_store_name = 'store7'""",
+    # window ratio over store channel (q98)
+    "q98": """
+        SELECT i_item_id, i_item_desc, i_category, i_class,
+               i_current_price,
+               SUM(ss_ext_sales_price) AS itemrevenue,
+               SUM(ss_ext_sales_price) * 100.0 /
+                 SUM(SUM(ss_ext_sales_price))
+                   OVER (PARTITION BY i_class) AS revenueratio
+        FROM store_sales
+        JOIN item ON ss_item_sk = i_item_sk
+        JOIN date_dim ON ss_sold_date_sk = d_date_sk
+        WHERE i_category IN ('Men', 'Music', 'Women')
+          AND d_year = 1998 AND d_moy BETWEEN 5 AND 6
+        GROUP BY i_item_id, i_item_desc, i_category, i_class,
+                 i_current_price
+        ORDER BY i_category, i_class, i_item_id, i_item_desc,
+                 revenueratio
+        LIMIT 100""",
+    # ship-lag buckets, web channel (q99 = q62 over ws) -> by month
+    "q99": """
+        SELECT d_moy,
+               SUM(CASE WHEN ws_quantity < 40 THEN 1 ELSE 0 END)
+                 AS small_q,
+               SUM(CASE WHEN ws_quantity BETWEEN 40 AND 70
+                        THEN 1 ELSE 0 END) AS mid_q,
+               SUM(CASE WHEN ws_quantity > 70 THEN 1 ELSE 0 END)
+                 AS big_q
+        FROM web_sales
+        JOIN date_dim ON ws_sold_date_sk = d_date_sk
+        WHERE d_year = 1999
+        GROUP BY d_moy
+        ORDER BY d_moy""",
+    # channel union rollup (q5 family shape: UNION ALL of channels)
+    "q5u": """
+        SELECT channel, SUM(sales) AS total_sales,
+               SUM(profit) AS total_profit
+        FROM (SELECT 'store channel' AS channel,
+                     ss_ext_sales_price AS sales,
+                     ss_net_profit AS profit
+              FROM store_sales
+              JOIN date_dim ON ss_sold_date_sk = d_date_sk
+              WHERE d_year = 1999
+              UNION ALL
+              SELECT 'catalog channel' AS channel,
+                     cs_ext_sales_price AS sales,
+                     cs_net_profit AS profit
+              FROM catalog_sales
+              JOIN date_dim ON cs_sold_date_sk = d_date_sk
+              WHERE d_year = 1999
+              UNION ALL
+              SELECT 'web channel' AS channel,
+                     ws_ext_sales_price AS sales,
+                     ws_net_profit AS profit
+              FROM web_sales
+              JOIN date_dim ON ws_sold_date_sk = d_date_sk
+              WHERE d_year = 1999) all_channels
+        GROUP BY channel
+        ORDER BY channel""",
+    # rank window over aggregated revenue (q67 family shape)
+    "q67r": """
+        SELECT d_year, i_category, revenue, rk
+        FROM (SELECT d_year, i_category,
+                     SUM(ss_ext_sales_price) AS revenue,
+                     RANK() OVER (PARTITION BY d_year
+                                  ORDER BY SUM(ss_ext_sales_price)
+                                  DESC) AS rk
+              FROM store_sales
+              JOIN date_dim ON ss_sold_date_sk = d_date_sk
+              JOIN item ON ss_item_sk = i_item_sk
+              GROUP BY d_year, i_category) ranked
+        WHERE rk <= 5
+        ORDER BY d_year, rk, i_category""",
+}

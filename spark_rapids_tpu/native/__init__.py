@@ -29,7 +29,8 @@ import numpy as np
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
-_SRC = os.path.join(_REPO_ROOT, "native", "tputable.cpp")
+_SRCS = [os.path.join(_REPO_ROOT, "native", "tputable.cpp"),
+         os.path.join(_REPO_ROOT, "native", "parquet_decode.cpp")]
 _BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
 
 _LIB = None
@@ -37,15 +38,18 @@ _LIB_LOCK = threading.Lock()
 
 
 def _build_lib() -> str:
-    with open(_SRC, "rb") as f:
-        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    h = hashlib.sha256()
+    for src in _SRCS:
+        with open(src, "rb") as f:
+            h.update(f.read())
+    digest = h.hexdigest()[:16]
     os.makedirs(_BUILD_DIR, exist_ok=True)
     so = os.path.join(_BUILD_DIR, f"libtputable-{digest}.so")
     if not os.path.exists(so):
         tmp = so + ".tmp"
         subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp,
-             _SRC],
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp]
+            + _SRCS,
             check=True, capture_output=True)
         os.replace(tmp, so)
     return so
@@ -93,6 +97,11 @@ def _lib() -> ctypes.CDLL:
             lib.direct_read_file.restype = ctypes.c_int64
             lib.direct_read_file.argtypes = [ctypes.c_char_p, u8p,
                                              ctypes.c_int64]
+            lib.parquet_decode_chunk.restype = ctypes.c_int64
+            lib.parquet_decode_chunk.argtypes = [
+                u8p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_int64, ctypes.c_int32, u8p, ctypes.c_int64,
+                u8p, u8p, ctypes.c_int64]
             _LIB = lib
         return _LIB
 
@@ -226,6 +235,22 @@ def direct_read(path: str, ptr: int, size: int) -> bool:
     lib = _lib()
     buf = ctypes.cast(ptr, ctypes.POINTER(ctypes.c_uint8))
     return lib.direct_read_file(path.encode(), buf, size) == size
+
+
+def parquet_decode_chunk(chunk: bytes, codec: int, phys_type: int,
+                         num_rows: int, max_def_level: int,
+                         values: np.ndarray, validity: np.ndarray,
+                         scratch: np.ndarray) -> int:
+    """Decode one parquet column chunk's pages into ``values`` (dense
+    fixed-width rows, zeros under nulls) + ``validity`` (u8/row).
+    Returns rows decoded; negative = malformed(-1) / unsupported(-2) /
+    buffer too small(-3) — the caller falls back to pyarrow."""
+    lib = _lib()
+    buf = np.frombuffer(chunk, dtype=np.uint8)
+    return lib.parquet_decode_chunk(
+        _u8ptr(buf), len(chunk), codec, phys_type, num_rows,
+        max_def_level, _u8ptr(values), values.nbytes,
+        _u8ptr(validity), _u8ptr(scratch), scratch.nbytes)
 
 
 def native_available() -> bool:

@@ -98,15 +98,27 @@ def tile_reduce(inputs: Sequence[jax.Array], row_fn: Callable,
     n = inputs[0].shape[0]
     tiles = max(1, -(-n // tile_rows))
     padded = tiles * tile_rows
-    ins = [jnp.pad(a, (0, padded - n)) if padded != n else a
-           for a in inputs]
+    ins = []
+    specs = []
+    for a in inputs:
+        if a.ndim == 2:
+            # lane-block input (padded string chars): rows tile with
+            # the grid, the byte axis rides whole into VMEM
+            w = a.shape[1]
+            if padded != n:
+                a = jnp.pad(a, ((0, padded - n), (0, 0)))
+            specs.append(pl.BlockSpec((tile_rows, w), lambda i: (i, 0)))
+        else:
+            if padded != n:
+                a = jnp.pad(a, (0, padded - n))
+            specs.append(pl.BlockSpec((tile_rows,), lambda i: (i,)))
+        ins.append(a)
     assert len(kinds) <= 128, "one (1,128) partial row per tile"
 
     out = pl.pallas_call(
         _tile_kernel(row_fn, kinds, out_dtype),
         grid=(tiles,),
-        in_specs=[pl.BlockSpec((tile_rows,), lambda i: (i,))
-                  for _ in ins],
+        in_specs=specs,
         out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((tiles * 8, 128), out_dtype),
         interpret=interpret,

@@ -94,23 +94,27 @@ class ShuffleBlockClient:
         self.port = int(port)
         self.timeout_s = timeout_s
 
-    def fetch_raw(self, shuffle_id: int,
-                  reduce_id: int) -> List[Tuple[int, bytes]]:
+    def stream_raw(self, shuffle_id: int,
+                   reduce_id: int) -> Iterator[Tuple[int, bytes]]:
+        """STREAM blocks one at a time in map order — the socket's TCP
+        window is the only read-ahead, so a huge partition never
+        buffers whole in this process (WindowedBlockIterator role)."""
         with socket.create_connection((self.host, self.port),
                                       timeout=self.timeout_s) as sock:
             sock.sendall(_REQ.pack(MAGIC, shuffle_id, reduce_id))
             count = struct.unpack("<I", _recv_exact(sock, 4))[0]
-            out = []
             for _ in range(count):
                 map_id, length = _BLOCK_HDR.unpack(
                     _recv_exact(sock, _BLOCK_HDR.size))
-                out.append((map_id, _recv_exact(sock, length)))
-            return out
+                yield map_id, _recv_exact(sock, length)
+
+    def fetch_raw(self, shuffle_id: int,
+                  reduce_id: int) -> List[Tuple[int, bytes]]:
+        return list(self.stream_raw(shuffle_id, reduce_id))
 
     def fetch_partition(self, shuffle_id: int,
                         reduce_id: int) -> Iterator[ColumnarBatch]:
-        for _map_id, data in sorted(self.fetch_raw(shuffle_id,
-                                                   reduce_id)):
+        for _map_id, data in self.stream_raw(shuffle_id, reduce_id):
             yield deserialize_batch(data)
 
 
@@ -124,10 +128,115 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return buf
 
 
+class ByteBudget:
+    """Bounded in-flight byte accounting for concurrent fetches — the
+    BounceBufferManager role: producers block while the window is full,
+    so reduce fan-in memory is capped regardless of partition sizes.
+    A single block larger than the whole budget is still admitted
+    (alone) so progress is always possible."""
+
+    def __init__(self, limit: int):
+        self.limit = max(int(limit), 1)
+        self._used = 0
+        self.peak = 0
+        self._cv = threading.Condition()
+
+    def acquire(self, n: int) -> None:
+        with self._cv:
+            while self._used > 0 and self._used + n > self.limit:
+                self._cv.wait()
+            self._used += n
+            self.peak = max(self.peak, self._used)
+
+    def release(self, n: int) -> None:
+        with self._cv:
+            self._used -= n
+            self._cv.notify_all()
+
+
 def fetch_all_partitions(endpoints: List[str], shuffle_id: int,
-                         reduce_id: int) -> Iterator[ColumnarBatch]:
+                         reduce_id: int,
+                         max_concurrent: Optional[int] = None,
+                         in_flight_bytes: Optional[int] = None,
+                         budget: Optional[ByteBudget] = None
+                         ) -> Iterator[ColumnarBatch]:
     """Reduce-side iterator over every peer's blocks for one partition
-    (RapidsShuffleIterator role)."""
-    for ep in endpoints:
-        yield from ShuffleBlockClient(ep).fetch_partition(shuffle_id,
-                                                          reduce_id)
+    (RapidsShuffleIterator role): up to ``max_concurrent`` peers fetch
+    in parallel threads, blocks stage through a ``ByteBudget``-bounded
+    hand-off, and each deserializes on the consuming thread. Block
+    order is preserved per peer (map order); cross-peer order is
+    arrival order, which no consumer depends on (partition contents
+    are set-semantics until a downstream sort)."""
+    from ..conf import (SHUFFLE_FETCH_IN_FLIGHT_BYTES,
+                        SHUFFLE_FETCH_MAX_CONCURRENT, active_conf)
+    conf = active_conf()
+    if max_concurrent is None:
+        max_concurrent = conf.get(SHUFFLE_FETCH_MAX_CONCURRENT)
+    if in_flight_bytes is None:
+        in_flight_bytes = conf.get(SHUFFLE_FETCH_IN_FLIGHT_BYTES)
+    if len(endpoints) <= 1 or max_concurrent <= 1:
+        for ep in endpoints:
+            yield from ShuffleBlockClient(ep).fetch_partition(
+                shuffle_id, reduce_id)
+        return
+
+    import queue as _q
+    budget = budget or ByteBudget(in_flight_bytes)
+    outq: "_q.Queue" = _q.Queue()
+    _DONE = object()
+    stop = threading.Event()
+
+    def worker(ep: str) -> None:
+        try:
+            for _map_id, data in ShuffleBlockClient(ep).stream_raw(
+                    shuffle_id, reduce_id):
+                if stop.is_set():
+                    return
+                budget.acquire(len(data))
+                outq.put(("block", data))
+        except BaseException as e:  # surfaced on the consumer side
+            outq.put(("error", e))
+        finally:
+            outq.put(("done", None))
+
+    threads = []
+    pending = list(endpoints)
+    live = 0
+    try:
+        while pending and live < max_concurrent:
+            t = threading.Thread(target=worker, args=(pending.pop(0),),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+            live += 1
+        done = 0
+        error = None
+        total = len(endpoints)
+        while done < total:
+            kind, payload = outq.get()
+            if kind == "done":
+                done += 1
+                if pending:
+                    t = threading.Thread(target=worker,
+                                         args=(pending.pop(0),),
+                                         daemon=True)
+                    t.start()
+                    threads.append(t)
+                continue
+            if kind == "error":
+                error = payload
+                continue
+            data = payload
+            try:
+                batch = deserialize_batch(data)
+            finally:
+                budget.release(len(data))
+            yield batch
+        if error is not None:
+            raise error
+    finally:
+        stop.set()
+        # unblock any producer stuck on a full budget
+        with budget._cv:
+            budget._used = 0
+            budget._cv.notify_all()

@@ -193,18 +193,26 @@ def apply_cost_model(meta: PlanMeta, conf: SrtConf) -> None:
                   f"threshold {threshold} (device compile/transfer "
                   "overhead dominates)")
         return
-    _refine(meta)
+    _refine(meta, threshold)
 
 
-def _refine(meta: PlanMeta) -> None:
+def _refine(meta: PlanMeta, threshold: float) -> None:
     cpu, dev = device_vs_cpu(meta.plan)
     if dev < cpu:
         return  # whole subtree stays on device
+    # the dual model may only force a section back to CPU when the
+    # section is ALSO small by the user's own threshold scale — above
+    # it, the row-threshold contract ("big enough = device") wins, so
+    # enabling the optimizer can never strand large work on the CPU
+    if total_cost_rows(meta.plan) >= threshold:
+        for c in meta.child_plans:
+            _refine(c, threshold)
+        return
     meta.will_not_work_on_tpu(
-        f"cost model: CPU {cpu:.2e} < device {dev:.2e} for "
+        f"cost model: CPU {cpu:.2e} < device {dev:.2e} for small "
         f"{type(meta.plan).__name__} section")
     for c in meta.child_plans:
-        _refine(c)
+        _refine(c, threshold)
 
 
 def _tag_tree(meta: PlanMeta, reason: str) -> None:

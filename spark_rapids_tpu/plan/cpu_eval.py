@@ -822,7 +822,10 @@ def _json_value_end(s, i):
     j = i
     while j < len(s) and s[j] not in ",}] \t\n\r":
         j += 1
-    return j
+    # a zero-length "scalar" means the cursor sat on a delimiter —
+    # malformed JSON (e.g. '{"k": ]}'), not an empty value; fuzz lane
+    # caught the '' vs null divergence vs the device scanner
+    return j if j > i else None
 
 
 def _json_get_path(s, segments):

@@ -143,24 +143,47 @@ def _extract_windows(plan: L.LogicalPlan, exprs):
     """Pull WindowExpressions out of a projection list into Window nodes
     (the analyzer step Spark performs for window functions in select):
     one Window node per distinct (partition_by, order_by) spec, chained;
-    the projection then references the produced columns by name."""
+    the projection then references the produced columns by name. Window
+    expressions NESTED inside larger expressions (the TPC-DS
+    ``sum(x)*100/sum(sum(x)) over (...)`` ratio shape) extract the same
+    way — the surrounding arithmetic stays in the projection and reads
+    the generated column."""
+    from ..expr import conditional as Cond
     from ..expr.window import WindowExpression
     groups = {}  # spec signature -> [(WindowExpression, gen_name)]
+    counter = [0]
+
+    def pull(e):
+        if isinstance(e, WindowExpression):
+            # always a fresh internal name: a user alias may collide
+            # with an input column, and name lookup resolves
+            # first-match
+            gen = f"__w{counter[0]}"
+            counter[0] += 1
+            sig = (repr(e.spec.partition_by),
+                   repr([(repr(o.expr), o.ascending, o.nulls_first)
+                         for o in e.spec.order_fields]))
+            groups.setdefault(sig, []).append((e, gen))
+            return col(gen)
+        if isinstance(e, Cond.CaseWhen):
+            return Cond.CaseWhen(
+                [(pull(c), pull(v)) for c, v in e.branches],
+                pull(e.otherwise) if e.otherwise is not None else None)
+        if not e.children:
+            return e
+        out = e.__class__.__new__(e.__class__)
+        out.__dict__.update(e.__dict__)
+        out.children = [pull(c) for c in e.children]
+        return out
+
     out_exprs = []
     for i, e in enumerate(exprs):
-        inner = e.children[0] if isinstance(e, Alias) else e
-        if isinstance(inner, WindowExpression):
-            # always a fresh internal name: a user alias may collide with
-            # an input column, and name lookup resolves first-match
-            gen = f"__w{i}"
-            user = e.name if isinstance(e, Alias) else f"_w{i}"
-            sig = (repr(inner.spec.partition_by),
-                   repr([ (repr(o.expr), o.ascending, o.nulls_first)
-                          for o in inner.spec.order_fields]))
-            groups.setdefault(sig, []).append((inner, gen))
-            out_exprs.append(Alias(col(gen), user))
+        if isinstance(e, Alias):
+            out_exprs.append(Alias(pull(e.children[0]), e.name))
+        elif isinstance(e, WindowExpression):
+            out_exprs.append(Alias(pull(e), f"_w{i}"))
         else:
-            out_exprs.append(e)
+            out_exprs.append(pull(e))
     for _, wexprs in groups.items():
         plan = L.Window(plan, wexprs)
     return plan, out_exprs

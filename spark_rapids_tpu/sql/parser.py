@@ -1035,17 +1035,6 @@ class Analyzer:
             bool(group_asts) or \
             (s.having is not None)
 
-        def _has_window(e) -> bool:
-            from ..expr.window import WindowExpression
-            if isinstance(e, WindowExpression):
-                return True
-            return any(_has_window(c) for c in e.children)
-        if has_agg and any(_has_window(e) for e in lowered):
-            raise SqlError(
-                "window functions over aggregated output are not "
-                "supported in one SELECT; aggregate in a subquery "
-                "first (SELECT ... OVER(...) FROM (SELECT ...))")
-
         if not has_agg:
             pre_sort = []
             post_sort = []
@@ -1075,6 +1064,30 @@ class Analyzer:
             for k, kn in zip(keys, key_names):
                 if repr(e) == repr(k):
                     return col(kn)
+            from ..expr.window import WindowExpression
+            if isinstance(e, WindowExpression):
+                # window OVER aggregated output (SUM(SUM(x)) OVER
+                # (PARTITION BY k), RANK() OVER (ORDER BY SUM(x))):
+                # Spark evaluates the window AFTER the aggregate, so
+                # only the window function's OPERANDS and the spec's
+                # partition/order expressions get substituted — the
+                # window function itself stays, applied over the
+                # aggregate's rows
+                nf = e.func.__class__.__new__(e.func.__class__)
+                nf.__dict__.update(e.func.__dict__)
+                nf.children = [replace(c) for c in e.func.children]
+                spec = e.spec.__class__.__new__(e.spec.__class__)
+                spec.__dict__.update(e.spec.__dict__)
+                spec.partition_by = [replace(p)
+                                     for p in e.spec.partition_by]
+                new_orders = []
+                for o in e.spec.order_fields:
+                    no = o.__class__.__new__(o.__class__)
+                    no.__dict__.update(o.__dict__)
+                    no.expr = replace(o.expr)
+                    new_orders.append(no)
+                spec.order_fields = new_orders
+                return WindowExpression(nf, spec)
             if isinstance(e, Agg.AggregateFunction):
                 for fn, n in agg_fns:
                     if repr(fn) == repr(e):

@@ -129,7 +129,8 @@ def test_aggregate_with_finish(arrays_df):
 def test_map_keys_values_entries(maps_df):
     df = maps_df.select(
         Alias(map_keys(col("m")), "ks"),
-        Alias(map_values(col("m")), "vs"))
+        Alias(map_values(col("m")), "vs"),
+        Alias(map_entries(col("m")), "es"))
     assert_tpu_cpu_equal_df(df)
 
 

@@ -1,0 +1,33 @@
+"""NDS (TPC-DS derived) query subset, end-to-end as SQL text through
+session.sql, differential device-vs-CPU (BASELINE.md config 2; the
+reference proves breadth the same way with its 99-query
+integration_tests suite)."""
+
+import pytest
+
+from spark_rapids_tpu.conf import SrtConf
+from spark_rapids_tpu.models.nds import NDS_QUERIES, register_nds
+from spark_rapids_tpu.plan.session import TpuSession
+from spark_rapids_tpu.testing import assert_tpu_cpu_equal_df
+
+
+@pytest.fixture(scope="module")
+def nds_session(tmp_path_factory):
+    root = tmp_path_factory.mktemp("nds")
+    session = TpuSession(SrtConf({"srt.shuffle.partitions": 4}))
+    register_nds(session, str(root), scale_rows=20_000)
+    return session
+
+
+@pytest.mark.parametrize("qid", sorted(NDS_QUERIES))
+def test_nds_query_differential(nds_session, qid):
+    df = nds_session.sql(NDS_QUERIES[qid])
+    # ORDER BY ... LIMIT makes row ORDER part of the contract for most
+    # of these; still compare as unordered sets of rows because ties
+    # under LIMIT are nondeterministic across engines
+    assert_tpu_cpu_equal_df(df, approx_float=1e-6)
+
+
+def test_nds_query_count():
+    assert len(NDS_QUERIES) >= 20, \
+        "the NDS subset must cover at least 20 queries"

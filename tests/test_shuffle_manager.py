@@ -177,6 +177,45 @@ def test_tcp_block_transport():
         server.close()
 
 
+def test_windowed_fetch_bounds_inflight_bytes():
+    """Many blocks across several peers with a tiny in-flight budget:
+    peak staged bytes must stay within budget + one block (the
+    BounceBufferManager window contract), and every row must arrive."""
+    from spark_rapids_tpu.parallel.transport import (ByteBudget,
+                                                     ShuffleBlockServer,
+                                                     fetch_all_partitions)
+    mgr = _mgr("MULTITHREADED", "NONE")
+    mgr.register_shuffle(11, 1)
+    n_maps = 12
+    rows_per_block = 2000  # ~16KB+ serialized per block
+    for m in range(n_maps):
+        mgr.write_map_output(
+            11, m,
+            [batch_from_pydict({"v": list(range(m * rows_per_block,
+                                                (m + 1) *
+                                                rows_per_block))})])
+    servers = [ShuffleBlockServer(mgr) for _ in range(3)]
+    try:
+        block_size = 0
+        for b in mgr.host_store.blocks_for_reduce(11, 0):
+            block_size = max(block_size, len(mgr.host_store.get(b)))
+        limit = block_size * 2  # window of ~2 blocks
+        budget = ByteBudget(limit)
+        got = []
+        for batch in fetch_all_partitions(
+                [s.endpoint for s in servers], 11, 0,
+                max_concurrent=3, in_flight_bytes=limit, budget=budget):
+            got.extend(batch_to_pydict(batch)["v"])
+        want = list(range(n_maps * rows_per_block)) * 3
+        assert sorted(got) == sorted(want)
+        # the window held: at most budget + one oversize admission
+        assert budget.peak <= limit + block_size, \
+            f"peak {budget.peak} exceeded window {limit}+{block_size}"
+    finally:
+        for s in servers:
+            s.close()
+
+
 def test_tcp_transport_with_heartbeat_registry():
     """Endpoint discovery through the heartbeat manager, then fetch."""
     from spark_rapids_tpu.parallel.transport import (ShuffleBlockServer,

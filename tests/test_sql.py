@@ -345,14 +345,21 @@ class TestWindowsAndSubqueries:
         assert [(r["k"], r["v"]) for r in out] == [("a", 20.0),
                                                    ("a", 30.0)]
 
-    def test_window_over_aggregate_rejected(self, wsession):
-        import pytest as _pt
-
-        from spark_rapids_tpu.sql.parser import SqlError
-        with _pt.raises(SqlError, match="window"):
-            wsession.sql("SELECT k, rank() OVER (ORDER BY sum(v)) "
-                         "FROM t GROUP BY k")
-        # the documented workaround parses and runs
+    def test_window_over_aggregate(self, wsession):
+        """Window functions over aggregated output in ONE select —
+        Spark evaluates the window after the aggregate (the TPC-DS
+        q12/q98 sum(sum(x)) over (...) ratio shape)."""
+        out = wsession.sql(
+            "SELECT k, rank() OVER (ORDER BY sum(v) DESC) AS r "
+            "FROM t GROUP BY k ORDER BY r").collect()
+        assert [(r["k"], r["r"]) for r in out] == [("a", 1), ("b", 2)]
+        # nested inside arithmetic too
+        out = wsession.sql(
+            "SELECT k, sum(v) * 100.0 / sum(sum(v)) OVER () AS pct "
+            "FROM t GROUP BY k ORDER BY k").collect()
+        assert [r["k"] for r in out] == ["a", "b"]
+        assert sum(r["pct"] for r in out) == pytest.approx(100.0)
+        # the subquery form still works
         out = wsession.sql(
             "SELECT k, sv, rank() OVER (ORDER BY sv DESC) AS r FROM "
             "(SELECT k, sum(v) AS sv FROM t GROUP BY k) s "

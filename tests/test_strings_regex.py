@@ -112,10 +112,53 @@ def test_unsupported_regex_falls_back(session):
     with pytest.raises(RegexUnsupported):
         transpile(r"a(?=b)")  # lookahead
     with pytest.raises(RegexUnsupported):
-        transpile(r"\bword\b")  # word boundary
+        transpile(r"a\bb")  # interior word boundary
     df = make_df(session, n=32)
     q = df.select(RLike(col("s"), r"a(?=b)").alias("m"))
     assert_falls_back_to_cpu(q, "rlike")
+
+
+def test_word_boundary_edges_match_python_re(session):
+    """Edge \\b lowers into boundary conditions on seed/accept
+    positions; every combination checked against python re."""
+    import re as _re
+    subjects = ["ab cd", "abcd", " ab ", "ab", "xaby", "ab.cd",
+                "0ab_cd1", "", None, "a b", "_ab", "ab_", "cab",
+                "ab,", ",ab", "aab ab"]
+    df = session.create_dataframe({"s": subjects},
+                                  schema=[("s", dt.STRING)])
+    for pat in [r"\bab", r"ab\b", r"\bab\b", r"\bcd", r"cd\b",
+                r"\b[ab]+", r"[cd]+\b", r"\ba.\b"]:
+        out = df.select(RLike(col("s"), pat).alias("m")).collect()
+        want = [None if s is None else _re.search(pat, s) is not None
+                for s in subjects]
+        assert [r["m"] for r in out] == want, pat
+
+
+def test_word_boundary_extract_falls_back_at_plan_time(session):
+    """\\b patterns in extract/replace must tag CPU fallback during
+    planning, never raise mid-execution."""
+    from spark_rapids_tpu.expr.regex import (RegExpExtract,
+                                             check_submatch_supported)
+    with pytest.raises(RegexUnsupported):
+        check_submatch_supported(r"\bab")
+    df = session.create_dataframe({"s": ["ab cd", "xaby", None]},
+                                  schema=[("s", dt.STRING)])
+    out = df.select(
+        RegExpExtract(col("s"), r"\bab", 0).alias("e")).collect()
+    assert [r["e"] for r in out] == ["ab", "", None]
+
+
+def test_named_groups_capture_by_position(session):
+    """(?<name>...) / (?P<name>...) parse as positional captures
+    (Spark's regexp_extract is positional regardless of names)."""
+    from spark_rapids_tpu.expr.regex import RegExpExtract
+    df = session.create_dataframe({"s": ["ab12", "zz99", "q", None]},
+                                  schema=[("s", dt.STRING)])
+    out = df.select(
+        RegExpExtract(col("s"), r"(?<letters>[a-z]+)(\d+)", 2)
+        .alias("d")).collect()
+    assert [r["d"] for r in out] == ["12", "99", "", None]
 
 
 def test_regexp_extract_replace_on_device(session):

