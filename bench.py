@@ -328,6 +328,30 @@ def main():
             "measured_peak_gb_s": round(peak, 1),
         })
         log(f"kernel q6: {kq6 * 1e3:.2f}ms, peak {peak:.0f} GB/s")
+    # --- NDS mini power-run (BASELINE config 2 breadth evidence):
+    # every query from the 24-query subset once, total wall recorded
+    if left("nds power run", need=60):
+        try:
+            from spark_rapids_tpu.models.nds import (NDS_QUERIES,
+                                                     register_nds)
+            nds_dir = os.path.join(os.path.dirname(data_dir), "nds_8k")
+            nds_sess = framework_session()
+            register_nds(nds_sess, nds_dir, scale_rows=8000)
+            t0 = time.perf_counter()
+            done = 0
+            for qid in sorted(NDS_QUERIES):
+                if not left(f"nds {qid}", need=20):
+                    break
+                nds_sess.sql(NDS_QUERIES[qid]).collect()
+                done += 1
+            RESULT["nds_queries_run"] = done
+            RESULT["nds_total_s"] = round(time.perf_counter() - t0, 2)
+            log(f"nds power run: {done}/{len(NDS_QUERIES)} queries in "
+                f"{RESULT['nds_total_s']}s")
+            emit()
+        except Exception as e:  # breadth stage must never kill the bench
+            log(f"nds power run failed: {e}")
+
     emit(final=True)
 
 

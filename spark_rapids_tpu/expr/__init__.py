@@ -7,10 +7,14 @@ in the submodules (inventory mirrors SURVEY §2.5).
 
 from . import aggregates, arithmetic, cast, collections, conditional, core, \
     datetime, hashing, higher_order, mathfns, predicates, strings
-from .collections import (ArrayContains, ArrayMax, ArrayMin, CreateArray,
-                          CreateNamedStruct, ElementAt, Explode,
-                          GetArrayItem, GetStructField, Size, SortArray,
-                          array, explode, explode_outer, posexplode, struct)
+from .collections import (ArrayContains, ArrayDistinct, ArrayExcept,
+                          ArrayIntersect, ArrayMax, ArrayMin,
+                          ArrayPosition, ArrayRemove, ArrayRepeat,
+                          ArrayReverse, ArraysOverlap, ArrayUnion,
+                          CreateArray, CreateNamedStruct, ElementAt,
+                          Explode, GetArrayItem, GetStructField, Size,
+                          Slice, SortArray, array, explode,
+                          explode_outer, posexplode, struct)
 from .higher_order import (ArrayAggregate, ArrayExists, ArrayFilter,
                            ArrayForAll, ArrayTransform, CreateMap,
                            GetMapValue, LambdaVariable, MapContainsKey,

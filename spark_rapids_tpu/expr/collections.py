@@ -255,6 +255,428 @@ class SortArray(Expression):
                 f"{'asc' if self.ascending else 'desc'})")
 
 
+def _first_occurrence(vals, elem_ok, lane_ok):
+    """(cap, W) bool: lane k is the FIRST occurrence of its value in
+    its row (null elements count as one value). The per-row W x W
+    equality triangle — W is the static pad bucket, so this stays a
+    dense VPU op."""
+    same = (vals[:, :, None] == vals[:, None, :])
+    both_null = (~elem_ok[:, :, None] & lane_ok[:, :, None] &
+                 ~elem_ok[:, None, :] & lane_ok[:, None, :])
+    eq = (same & elem_ok[:, :, None] & elem_ok[:, None, :]) | both_null
+    w = vals.shape[1]
+    earlier = jnp.tril(jnp.ones((w, w), jnp.bool_), k=-1)
+    dup = jnp.any(eq & earlier[None, :, :], axis=2)
+    return lane_ok & ~dup
+
+
+def _lanes_repack(lc: ListColumn, vals, keep, new_ok,
+                  element_type: dt.DType) -> ListColumn:
+    """Left-compact kept lanes into a fresh ListColumn (new lengths =
+    per-row keep counts). Shared by every lane-filtering function."""
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    vals_c = jnp.take_along_axis(vals, order, axis=1)
+    ok_c = jnp.take_along_axis(new_ok & keep, order, axis=1)
+    lens = jnp.where(lc.validity,
+                     jnp.sum(keep, axis=1, dtype=jnp.int32), 0)
+    offsets = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(lens, dtype=jnp.int32)])
+    from .higher_order import _lanes_to_list
+    base = ListColumn(offsets, lc.child, lc.validity, element_type,
+                      lc.pad_bucket)
+    return _lanes_to_list(base, vals_c, ok_c, element_type,
+                          offsets=offsets,
+                          child_cap=lc.child_capacity)
+
+
+class _LaneBinaryBase(Expression):
+    """Shared typing for (array, array) -> ... functions."""
+
+    def __init__(self, left: Expression, right: Expression):
+        super().__init__(left, right)
+
+    def _elem_type(self, schema: Schema) -> dt.DType:
+        lt = _element_type(self.children[0], schema)
+        rt = _element_type(self.children[1], schema)
+        if lt != rt:
+            lt = dt.promote(lt, rt)
+        return lt
+
+    def _lanes2(self, batch):
+        a: ListColumn = self.children[0].eval(batch)
+        b: ListColumn = self.children[1].eval(batch)
+        av, al, ao = a.element_lanes()
+        bv, bl, bo = b.element_lanes()
+        if av.dtype != bv.dtype:
+            phys = jnp.promote_types(av.dtype, bv.dtype)
+            av, bv = av.astype(phys), bv.astype(phys)
+        return a, b, av, al, ao, bv, bl, bo
+
+
+class ArrayDistinct(Expression):
+    """array_distinct: first occurrence kept, order preserved
+    (collectionOperations.scala GpuArrayDistinct role)."""
+
+    def __init__(self, child: Expression):
+        super().__init__(child)
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.ArrayType(_element_type(self.children[0], schema))
+
+    def eval(self, batch: ColumnarBatch) -> ListColumn:
+        lc: ListColumn = self.children[0].eval(batch)
+        vals, lane_ok, elem_ok = lc.element_lanes()
+        keep = _first_occurrence(vals, elem_ok, lane_ok)
+        return _lanes_repack(lc, vals, keep, elem_ok,
+                             lc.dtype.element_type)
+
+
+class ArrayUnion(_LaneBinaryBase):
+    """array_union(a, b): distinct elements of a then b's unseen ones
+    (GpuArrayUnion role)."""
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.ArrayType(self._elem_type(schema))
+
+    def eval(self, batch: ColumnarBatch) -> ListColumn:
+        from ..columnar.vector import round_pow2
+        a, b, av, al, ao, bv, bl, bo = self._lanes2(batch)
+        vals = jnp.concatenate([av, bv], axis=1)
+        lane_ok = jnp.concatenate([al, bl], axis=1)
+        elem_ok = jnp.concatenate([ao, bo], axis=1)
+        keep = _first_occurrence(vals, elem_ok, lane_ok)
+        validity = a.validity & b.validity
+        et = dt.promote(a.dtype.element_type, b.dtype.element_type) \
+            if a.dtype.element_type != b.dtype.element_type \
+            else a.dtype.element_type
+        order = jnp.argsort(~keep, axis=1, stable=True)
+        vals_c = jnp.take_along_axis(vals, order, axis=1)
+        ok_c = jnp.take_along_axis(elem_ok & keep, order, axis=1)
+        lens = jnp.where(validity,
+                         jnp.sum(keep, axis=1, dtype=jnp.int32), 0)
+        offsets = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32), jnp.cumsum(lens, dtype=jnp.int32)])
+        cap_needed = round_pow2(max(
+            a.child_capacity + b.child_capacity, 8))
+        from .higher_order import _lanes_to_list
+        base = ListColumn(offsets, a.child, validity, et, vals.shape[1])
+        return _lanes_to_list(base, vals_c, ok_c, et,
+                              offsets=offsets, child_cap=cap_needed)
+
+
+class _MembershipBinary(_LaneBinaryBase):
+    """a's lanes tested for membership in b."""
+
+    def _member(self, batch):
+        a, b, av, al, ao, bv, bl, bo = self._lanes2(batch)
+        hit = jnp.any(
+            (av[:, :, None] == bv[:, None, :]) &
+            ao[:, :, None] & bo[:, None, :], axis=2)
+        a_null_in_b = jnp.any(bl & ~bo, axis=1)  # b has a null elem
+        return a, b, av, al, ao, hit, a_null_in_b
+
+
+class ArrayIntersect(_MembershipBinary):
+    """array_intersect: distinct a-elements present in b
+    (GpuArrayIntersect role; null kept when both sides have null)."""
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.ArrayType(self._elem_type(schema))
+
+    def eval(self, batch: ColumnarBatch) -> ListColumn:
+        a, b, av, al, ao, hit, b_has_null = self._member(batch)
+        first = _first_occurrence(av, ao, al)
+        keep = first & ((ao & hit) |
+                        (~ao & al & b_has_null[:, None]))
+        out = _lanes_repack(a, av, keep, ao, a.dtype.element_type)
+        return out.with_validity(a.validity & b.validity)
+
+
+class ArrayExcept(_MembershipBinary):
+    """array_except: distinct a-elements absent from b."""
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.ArrayType(self._elem_type(schema))
+
+    def eval(self, batch: ColumnarBatch) -> ListColumn:
+        a, b, av, al, ao, hit, b_has_null = self._member(batch)
+        first = _first_occurrence(av, ao, al)
+        keep = first & ((ao & ~hit) |
+                        (~ao & al & ~b_has_null[:, None]))
+        out = _lanes_repack(a, av, keep, ao, a.dtype.element_type)
+        return out.with_validity(a.validity & b.validity)
+
+
+class ArraysOverlap(_MembershipBinary):
+    """arrays_overlap: true if a common non-null element exists; null
+    when none found but either side holds a null element (3VL,
+    GpuArraysOverlap)."""
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        self._elem_type(schema)
+        return dt.BOOL
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        a, b, av, al, ao, bv, bl, bo = self._lanes2(batch)
+        hit = jnp.any(
+            (av[:, :, None] == bv[:, None, :]) &
+            ao[:, :, None] & bo[:, None, :], axis=(1, 2))
+        a_has_null = jnp.any(al & ~ao, axis=1)
+        b_has_null = jnp.any(bl & ~bo, axis=1)
+        both_nonempty = jnp.any(al, axis=1) & jnp.any(bl, axis=1)
+        # Spark: no common element -> null iff both non-empty and
+        # either side holds a null element; else false
+        nullish = both_nonempty & (a_has_null | b_has_null)
+        ok = a.validity & b.validity & (hit | ~nullish)
+        return make_result(hit, ok, dt.BOOL)
+
+
+class ArrayRemove(Expression):
+    """array_remove(arr, v): drop elements equal to v; null elements
+    stay (Spark semantics); null v -> null result."""
+
+    def __init__(self, child: Expression, value: Expression):
+        super().__init__(child, value)
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.ArrayType(_element_type(self.children[0], schema))
+
+    def eval(self, batch: ColumnarBatch) -> ListColumn:
+        lc: ListColumn = self.children[0].eval(batch)
+        v = self.children[1].eval(batch)
+        vals, lane_ok, elem_ok = lc.element_lanes()
+        eq = elem_ok & (vals == v.data[:, None])
+        keep = lane_ok & ~eq
+        out = _lanes_repack(lc, vals, keep, elem_ok,
+                            lc.dtype.element_type)
+        return out.with_validity(lc.validity & v.validity)
+
+
+class ArrayPosition(Expression):
+    """array_position(arr, v): 1-based first index, 0 when absent
+    (GpuArrayPosition); null inputs -> null."""
+
+    def __init__(self, child: Expression, value: Expression):
+        super().__init__(child, value)
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        _element_type(self.children[0], schema)
+        return dt.INT64
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        lc: ListColumn = self.children[0].eval(batch)
+        v = self.children[1].eval(batch)
+        vals, lane_ok, elem_ok = lc.element_lanes()
+        hit = elem_ok & (vals == v.data[:, None])
+        found = jnp.any(hit, axis=1)
+        first = jnp.argmax(hit, axis=1).astype(jnp.int64) + 1
+        pos = jnp.where(found, first, jnp.int64(0))
+        return make_result(pos, lc.validity & v.validity, dt.INT64)
+
+
+class Slice(Expression):
+    """slice(arr, start, length): 1-based; negative start counts from
+    the end (GpuSlice). start=0 -> error in Spark; here -> null."""
+
+    def __init__(self, child: Expression, start: Expression,
+                 length: Expression):
+        super().__init__(child, start, length)
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.ArrayType(_element_type(self.children[0], schema))
+
+    def eval(self, batch: ColumnarBatch) -> ListColumn:
+        lc: ListColumn = self.children[0].eval(batch)
+        s = self.children[1].eval(batch)
+        n = self.children[2].eval(batch)
+        vals, lane_ok, elem_ok = lc.element_lanes()
+        lens = lc.lengths()
+        start = s.data.astype(jnp.int32)
+        zero_based = jnp.where(start > 0, start - 1, lens + start)
+        count = jnp.maximum(n.data.astype(jnp.int32), 0)
+        k = jnp.arange(lc.pad_bucket, dtype=jnp.int32)[None, :]
+        sel = (k >= zero_based[:, None]) & \
+              (k < (zero_based + count)[:, None]) & lane_ok
+        ok_in = (start != 0) & s.validity & n.validity & \
+            (n.data >= 0)
+        out = _lanes_repack(lc, vals, sel, elem_ok,
+                            lc.dtype.element_type)
+        return out.with_validity(lc.validity & ok_in)
+
+
+class ArrayReverse(Expression):
+    """reverse(array) — element order flipped per row (GpuReverse)."""
+
+    def __init__(self, child: Expression):
+        super().__init__(child)
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.ArrayType(_element_type(self.children[0], schema))
+
+    def eval(self, batch: ColumnarBatch) -> ListColumn:
+        lc: ListColumn = self.children[0].eval(batch)
+        vals, lane_ok, elem_ok = lc.element_lanes()
+        lens = lc.lengths()
+        k = jnp.arange(lc.pad_bucket, dtype=jnp.int32)[None, :]
+        src = jnp.clip(lens[:, None] - 1 - k, 0, lc.pad_bucket - 1)
+        rv = jnp.take_along_axis(vals, src, axis=1)
+        rok = jnp.take_along_axis(elem_ok, src, axis=1) & lane_ok
+        from .higher_order import _lanes_to_list
+        return _lanes_to_list(lc, rv, rok, lc.dtype.element_type)
+
+
+class ArrayRepeat(Expression):
+    """array_repeat(v, n) with a LITERAL count (static shapes need a
+    bound; dynamic counts fall back to CPU via the planner tag)."""
+
+    def __init__(self, value: Expression, count: Expression):
+        super().__init__(value, count)
+
+    def _count(self):
+        from .core import Literal
+        c = self.children[1]
+        return c.value if isinstance(c, Literal) else None
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return dt.ArrayType(self.children[0].data_type(schema))
+
+    def eval(self, batch: ColumnarBatch) -> ListColumn:
+        from ..columnar.vector import round_pow2
+        n = self._count()
+        if n is None:
+            raise RuntimeError("array_repeat with non-literal count "
+                               "must run on CPU (planner tag)")
+        n = max(int(n), 0)
+        v = self.children[0].eval(batch)
+        cap = batch.capacity
+        live = batch.live_mask() & v.validity
+        vals = jnp.broadcast_to(v.data[:, None], (cap, max(n, 1)))
+        ok = jnp.broadcast_to((v.validity & live)[:, None],
+                              (cap, max(n, 1)))
+        if n == 0:
+            ok = jnp.zeros_like(ok)
+        lens = jnp.where(batch.live_mask(), jnp.int32(n), 0)
+        offsets = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32), jnp.cumsum(lens, dtype=jnp.int32)])
+        child_cap = round_pow2(max(cap * max(n, 1), 8))
+        from .higher_order import _lanes_to_list
+        base = ListColumn(offsets, ColumnVector(
+            jnp.zeros(child_cap, v.data.dtype),
+            jnp.zeros(child_cap, jnp.bool_), v.dtype),
+            batch.live_mask(), v.dtype, round_pow2(max(n, 1)))
+        return _lanes_to_list(base, vals, ok, v.dtype,
+                              offsets=offsets, child_cap=child_cap)
+
+
+class _CpuOnlyCollection(Expression):
+    """Collection functions whose device lowering needs ragged/nested
+    lane shapes not yet built — the planner tags them CPU (the
+    reference gates the same ops per-type via TypeSig); the CPU engine
+    (plan/cpu_eval.py) carries execution."""
+
+    def eval(self, batch: ColumnarBatch):
+        raise RuntimeError(
+            f"{type(self).__name__} must run on the CPU engine "
+            "(planner tag)")
+
+
+class Flatten(_CpuOnlyCollection):
+    """flatten(array<array<T>>) -> array<T> (GpuFlattenArray)."""
+
+    def __init__(self, child: Expression):
+        super().__init__(child)
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        t = self.children[0].data_type(schema)
+        if not (isinstance(t, dt.ArrayType) and
+                isinstance(t.element_type, dt.ArrayType)):
+            raise TypeError(f"flatten of {t}")
+        return t.element_type
+
+
+class ArraysZip(_CpuOnlyCollection):
+    """arrays_zip(a, b, ...) -> array<struct> (GpuArraysZip)."""
+
+    def __init__(self, *children: Expression):
+        super().__init__(*children)
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        fields = []
+        for i, c in enumerate(self.children):
+            t = c.data_type(schema)
+            if not isinstance(t, dt.ArrayType):
+                raise TypeError(f"arrays_zip of {t}")
+            fields.append((str(i), t.element_type))
+        return dt.ArrayType(dt.StructType(tuple(fields)))
+
+
+class ArrayJoin(_CpuOnlyCollection):
+    """array_join(array<string>, sep[, null_replacement])
+    (GpuArrayJoin)."""
+
+    def __init__(self, child: Expression, sep: str,
+                 null_replacement: Optional[str] = None):
+        super().__init__(child)
+        self.sep = sep
+        self.null_replacement = null_replacement
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        t = self.children[0].data_type(schema)
+        if not (isinstance(t, dt.ArrayType) and
+                t.element_type == dt.STRING):
+            raise TypeError(f"array_join of {t}")
+        return dt.STRING
+
+
+class ZipWith(_CpuOnlyCollection):
+    """zip_with(a, b, (x, y) -> f) (higherOrderFunctions.scala
+    GpuZipWith role)."""
+
+    def __init__(self, left: Expression, right: Expression,
+                 x_var, y_var, body: Expression):
+        super().__init__(left, right, body)
+        self.x_var = x_var
+        self.y_var = y_var
+
+    def references(self) -> set:
+        refs = set()
+        for c in self.children:
+            refs |= c.references()
+        return refs - {self.x_var.name, self.y_var.name}
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        lt = self.children[0].data_type(schema)
+        rt = self.children[1].data_type(schema)
+        if not (isinstance(lt, dt.ArrayType) and
+                isinstance(rt, dt.ArrayType)):
+            raise TypeError("zip_with needs two arrays")
+        self.x_var._dtype = lt.element_type
+        self.y_var._dtype = rt.element_type
+        return dt.ArrayType(self.children[2].data_type(schema))
+
+
+class MapConcat(_CpuOnlyCollection):
+    """map_concat(m1, m2, ...) — later maps win duplicate keys
+    (Spark 3.x LAST_WIN policy; GpuMapConcat)."""
+
+    def __init__(self, *children: Expression):
+        super().__init__(*children)
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        ts_ = [c.data_type(schema) for c in self.children]
+        for t in ts_:
+            if not isinstance(t, dt.MapType):
+                raise TypeError(f"map_concat of {t}")
+        return ts_[0]
+
+
+def zip_with(a, b, fn):
+    from .core import _lit
+    from .higher_order import LambdaVariable
+    x, y = LambdaVariable(), LambdaVariable()
+    return ZipWith(_lit(a), _lit(b), x, y, _lit(fn(x, y)))
+
+
 class CreateNamedStruct(Expression):
     """named_struct(n1, v1, ...) (complexTypeCreator.scala
     GpuCreateNamedStruct)."""

@@ -101,6 +101,33 @@ _ESCAPE_LITERALS = {"t": "\t", "n": "\n", "r": "\r", "f": "\f",
                     "a": "\a", "e": "\x1b", "0": "\0"}
 
 
+def _rng(lo: str, hi: str) -> np.ndarray:
+    out = np.zeros(256, bool)
+    out[ord(lo):ord(hi) + 1] = True
+    return out
+
+
+_POSIX_CLASSES = {
+    "Lower": _rng("a", "z"),
+    "Upper": _rng("A", "Z"),
+    "Alpha": _rng("a", "z") | _rng("A", "Z"),
+    "Digit": _rng("0", "9"),
+    "Alnum": _rng("a", "z") | _rng("A", "Z") | _rng("0", "9"),
+    "XDigit": _rng("0", "9") | _rng("a", "f") | _rng("A", "F"),
+    "Space": _class_of(" \t\n\x0b\f\r"),
+    "Punct": _class_of("!\"#$%&'()*+,-./:;<=>?@[\\]^_`{|}~"),
+    "Print": _rng(" ", "~"),
+    "Graph": _rng("!", "~"),
+    "Blank": _class_of(" \t"),
+    "Cntrl": _rng("\x00", "\x1f") | _class_of("\x7f"),
+    "ASCII": _rng("\x00", "\x7f"),
+    # the Unicode names java also accepts, ASCII interpretation
+    "L": _rng("a", "z") | _rng("A", "Z"),
+    "N": _rng("0", "9"),
+    "Nd": _rng("0", "9"),
+}
+
+
 class _Group(_Node):
     """Capturing group (index is 1-based like Java)."""
 
@@ -272,7 +299,24 @@ class _Parser:
             return _ESCAPE_CLASSES[ch].copy()
         if ch in _ESCAPE_LITERALS:
             return _class_of(_ESCAPE_LITERALS[ch])
-        if ch in "bBAzZGpPk123456789":
+        if ch in "pP":
+            # \p{Name} POSIX/ASCII classes (the reference transpiler's
+            # supported subset, RegexParser.scala): byte classes over
+            # the ASCII range, \P = complement
+            if self.peek() != "{":
+                self.fail(f"\\{ch} needs {{Name}}")
+            self.next()
+            name = ""
+            while self.peek() not in ("}", None):
+                name += self.next()
+            if self.peek() != "}":
+                self.fail("unterminated \\p{")
+            self.next()
+            cls = _POSIX_CLASSES.get(name)
+            if cls is None:
+                self.fail(f"\\p{{{name}}} not supported")
+            return ~cls if ch == "P" else cls.copy()
+        if ch in "bBAzZGk123456789":
             self.fail(f"\\{ch} not supported")
         if ch == "x":
             hex2 = self.p[self.i:self.i + 2]

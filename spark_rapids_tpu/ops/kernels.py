@@ -178,6 +178,12 @@ def group_ids(sorted_keys: Sequence[Column], live) -> Tuple[jnp.ndarray, jnp.nda
     return gid.astype(jnp.int32), num_groups, boundary
 
 
+# NOTE: a hash-cluster shortcut (sort group keys by murmur3 instead of
+# rank chains) was tried and REVERTED: two distinct keys colliding on
+# the 32-bit hash can interleave under the stable sort, splitting a
+# group into duplicate output rows — silent corruption at ~2M-key
+# scale. The exact rank sort is already cheap for strings (packed
+# uint64 words, one argsort per 8 pad bytes, _rank_keys above).
 def _sorted_group_prelude(batch: ColumnarBatch, key_cols: Sequence[Column]):
     """Shared sort/group-id machinery for update and merge passes.
 

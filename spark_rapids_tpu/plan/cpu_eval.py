@@ -2208,6 +2208,270 @@ def _register_collections():
                 else (non_null + nulls)
         return out, am.copy()
 
+    def _list2(expr, table):
+        av, am = _ev(expr.children[0], table)
+        bv, bm = _ev(expr.children[1], table)
+        return av, am, bv, bm
+
+    def _dedup_first(items):
+        seen, out = [], []
+        for e in items:
+            if e not in seen:
+                seen.append(e)
+                out.append(e)
+        return out
+
+    @_reg(CX.ArrayDistinct)
+    def _distinct(expr, table):
+        v, m = _ev(expr.children[0], table)
+        out = _obj_array([_dedup_first(v[i]) if m[i] else None
+                          for i in range(len(v))])
+        return out, m.copy()
+
+    @_reg(CX.ArrayUnion)
+    def _union(expr, table):
+        av, am, bv, bm = _list2(expr, table)
+        n = len(av)
+        out = np.empty(n, dtype=object)
+        mask = am & bm
+        for i in range(n):
+            out[i] = _dedup_first(list(av[i]) + list(bv[i])) \
+                if mask[i] else None
+        return out, mask
+
+    @_reg(CX.ArrayIntersect)
+    def _intersect(expr, table):
+        av, am, bv, bm = _list2(expr, table)
+        n = len(av)
+        out = np.empty(n, dtype=object)
+        mask = am & bm
+        for i in range(n):
+            out[i] = _dedup_first([e for e in av[i] if e in bv[i]]) \
+                if mask[i] else None
+        return out, mask
+
+    @_reg(CX.ArrayExcept)
+    def _except(expr, table):
+        av, am, bv, bm = _list2(expr, table)
+        n = len(av)
+        out = np.empty(n, dtype=object)
+        mask = am & bm
+        for i in range(n):
+            out[i] = _dedup_first([e for e in av[i]
+                                   if e not in bv[i]]) \
+                if mask[i] else None
+        return out, mask
+
+    @_reg(CX.ArraysOverlap)
+    def _overlap(expr, table):
+        av, am, bv, bm = _list2(expr, table)
+        n = len(av)
+        out = np.zeros(n, bool)
+        mask = np.zeros(n, bool)
+        for i in range(n):
+            if not (am[i] and bm[i]):
+                continue
+            hit = any(e is not None and e in bv[i] for e in av[i])
+            nullish = bool(av[i]) and bool(bv[i]) and \
+                (None in av[i] or None in bv[i])
+            out[i] = hit
+            mask[i] = hit or not nullish
+        return out, mask
+
+    @_reg(CX.ArrayRemove)
+    def _remove(expr, table):
+        schema = table.schema()
+        et = expr.children[0].data_type(schema).element_type
+        av, am = _ev(expr.children[0], table)
+        vc = evaluate(expr.children[1], table)
+        n = len(av)
+        out = np.empty(n, dtype=object)
+        mask = np.zeros(n, bool)
+        for i in range(n):
+            if am[i] and vc.mask[i]:
+                want = _logical_of(vc.values, vc.mask, i, et)
+                out[i] = [e for e in av[i]
+                          if e is None or e != want]
+                mask[i] = True
+            else:
+                out[i] = None
+        return out, mask
+
+    @_reg(CX.ArrayPosition)
+    def _position(expr, table):
+        schema = table.schema()
+        et = expr.children[0].data_type(schema).element_type
+        av, am = _ev(expr.children[0], table)
+        vc = evaluate(expr.children[1], table)
+        n = len(av)
+        out = np.zeros(n, np.int64)
+        mask = np.zeros(n, bool)
+        for i in range(n):
+            if am[i] and vc.mask[i]:
+                want = _logical_of(vc.values, vc.mask, i, et)
+                mask[i] = True
+                for k, e in enumerate(av[i]):
+                    if e is not None and e == want:
+                        out[i] = k + 1
+                        break
+        return out, mask
+
+    @_reg(CX.Slice)
+    def _slice(expr, table):
+        av, am = _ev(expr.children[0], table)
+        sc = evaluate(expr.children[1], table)
+        nc = evaluate(expr.children[2], table)
+        n = len(av)
+        out = np.empty(n, dtype=object)
+        mask = np.zeros(n, bool)
+        for i in range(n):
+            if not (am[i] and sc.mask[i] and nc.mask[i]):
+                out[i] = None
+                continue
+            s, ln = int(sc.values[i]), int(nc.values[i])
+            if s == 0 or ln < 0:
+                out[i] = None
+                continue
+            z = s - 1 if s > 0 else len(av[i]) + s
+            # window [z, z+ln) intersected with the valid index range
+            out[i] = list(av[i][max(z, 0):max(z + ln, 0)])
+            mask[i] = True
+        return out, mask
+
+    @_reg(CX.ArrayReverse)
+    def _arr_reverse(expr, table):
+        v, m = _ev(expr.children[0], table)
+        out = _obj_array([list(reversed(v[i])) if m[i] else None
+                          for i in range(len(v))])
+        return out, m.copy()
+
+    @_reg(CX.ArrayRepeat)
+    def _repeat(expr, table):
+        schema = table.schema()
+        et = expr.children[0].data_type(schema)
+        vc = evaluate(expr.children[0], table)
+        nc = evaluate(expr.children[1], table)
+        n = table.num_rows
+        out = np.empty(n, dtype=object)
+        mask = np.zeros(n, bool)
+        for i in range(n):
+            if not nc.mask[i]:
+                out[i] = None
+                continue
+            k = max(int(nc.values[i]), 0)
+            e = _logical_of(vc.values, vc.mask, i, et)
+            out[i] = [e] * k
+            mask[i] = True
+        return out, mask
+
+    @_reg(CX.Flatten)
+    def _flatten(expr, table):
+        v, m = _ev(expr.children[0], table)
+        n = len(v)
+        out = np.empty(n, dtype=object)
+        mask = np.zeros(n, bool)
+        for i in range(n):
+            if not m[i] or any(e is None for e in v[i]):
+                out[i] = None  # null inner array -> null (Spark)
+                continue
+            out[i] = [x for inner in v[i] for x in inner]
+            mask[i] = True
+        return out, mask
+
+    @_reg(CX.ArraysZip)
+    def _arrays_zip(expr, table):
+        cols = [_ev(c, table) for c in expr.children]
+        n = len(cols[0][0])
+        out = np.empty(n, dtype=object)
+        mask = np.zeros(n, bool)
+        for i in range(n):
+            if not all(m[i] for _, m in cols):
+                out[i] = None
+                continue
+            ln = max((len(v[i]) for v, _ in cols), default=0)
+            out[i] = [
+                {str(j): (v[i][k] if k < len(v[i]) else None)
+                 for j, (v, _) in enumerate(cols)}
+                for k in range(ln)]
+            mask[i] = True
+        return out, mask
+
+    @_reg(CX.ArrayJoin)
+    def _array_join(expr, table):
+        v, m = _ev(expr.children[0], table)
+        n = len(v)
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            if not m[i]:
+                out[i] = ""
+                continue
+            parts = [e if e is not None else expr.null_replacement
+                     for e in v[i]]
+            out[i] = expr.sep.join(p for p in parts if p is not None)
+        return out, m.copy()
+
+    @_reg(CX.ZipWith)
+    def _zip_with(expr, table):
+        schema = table.schema()
+        expr.data_type(schema)  # bind lambda var dtypes
+        av, am = _ev(expr.children[0], table)
+        bv, bm = _ev(expr.children[1], table)
+        body = expr.children[2]
+        xt, yt = expr.x_var._dtype, expr.y_var._dtype
+        from ..expr import higher_order as HO
+        from .host_table import HostColumn, HostTable
+        n = len(av)
+        out = np.empty(n, dtype=object)
+        mask = np.zeros(n, bool)
+        lens = np.array([max(len(av[i]), len(bv[i]))
+                         if am[i] and bm[i] else 0
+                         for i in range(n)], dtype=np.int64)
+        xs, ys = [], []
+        for i in range(n):
+            for k in range(lens[i]):
+                xs.append(av[i][k] if k < len(av[i]) else None)
+                ys.append(bv[i][k] if k < len(bv[i]) else None)
+
+        def pc(vals, t):
+            mk = np.array([v is not None for v in vals], bool)
+            ph = [_physical_scalar(v, t) for v in vals]
+            if t == dt.STRING or t.is_nested:
+                return HostColumn(_obj_array(ph), mk, t)
+            return HostColumn(np.array(ph, dtype=np.dtype(t.physical)),
+                              mk, t)
+        flat = HostTable([pc(xs, xt), pc(ys, yt)],
+                         [expr.x_var.name, expr.y_var.name])
+        res = evaluate(body, flat)
+        rt = body.data_type(flat.schema())
+        vals = [_logical_of(res.values, res.mask, i, rt)
+                for i in range(len(res.values))]
+        pos = 0
+        for i in range(n):
+            if am[i] and bm[i]:
+                out[i] = vals[pos:pos + lens[i]]
+                pos += lens[i]
+                mask[i] = True
+            else:
+                out[i] = None
+        return out, mask
+
+    @_reg(CX.MapConcat)
+    def _map_concat(expr, table):
+        cols = [_ev(c, table) for c in expr.children]
+        n = len(cols[0][0])
+        out = np.empty(n, dtype=object)
+        mask = np.zeros(n, bool)
+        for i in range(n):
+            if not all(m[i] for _, m in cols):
+                out[i] = None
+                continue
+            merged = {}
+            for v, _ in cols:
+                merged.update(v[i])  # last map wins duplicates
+            out[i] = merged
+            mask[i] = True
+        return out, mask
+
     @_reg(CX.CreateNamedStruct)
     def _named_struct(expr, table):
         schema = table.schema()

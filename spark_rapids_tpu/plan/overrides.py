@@ -337,6 +337,43 @@ _expr(CX.ArrayMax, _nested_ok, _primitive_elements)
 _expr(CX.SortArray, _nested_ok, _primitive_elements)
 _expr(CX.CreateNamedStruct, ts.all_basic)
 _expr(CX.GetStructField, ts.TypeSig(ts.STRUCT))
+_expr(CX.ArrayDistinct, _nested_ok, _primitive_elements)
+_expr(CX.ArrayUnion, _nested_ok, _primitive_elements)
+_expr(CX.ArrayIntersect, _nested_ok, _primitive_elements)
+_expr(CX.ArrayExcept, _nested_ok, _primitive_elements)
+_expr(CX.ArraysOverlap, _nested_ok, _primitive_elements)
+_expr(CX.ArrayRemove, _nested_ok, _primitive_elements)
+_expr(CX.ArrayPosition, _nested_ok, _primitive_elements)
+_expr(CX.Slice, _nested_ok, _primitive_elements)
+_expr(CX.ArrayReverse, _nested_ok, _primitive_elements)
+
+
+def _tag_array_repeat(meta: ExprMeta):
+    from ..expr.core import Literal
+    if not isinstance(meta.expr.children[1], Literal):
+        meta.will_not_work_on_tpu(
+            "array_repeat: non-literal count needs dynamic list "
+            "extents (static-shape device lowering); runs on CPU")
+    t = meta.expr.children[0].data_type(meta.schema)
+    if t.is_nested or t == dt.STRING:
+        meta.will_not_work_on_tpu(
+            f"array_repeat of {t} needs lane lowering not yet on TPU")
+
+
+_expr(CX.ArrayRepeat, ts.all_basic + ts.TypeSig(ts.ARRAY),
+      _tag_array_repeat)
+
+
+def _cpu_only_collection(meta: ExprMeta):
+    meta.will_not_work_on_tpu(
+        f"{type(meta.expr).__name__}: ragged/nested lane lowering not "
+        "yet on TPU; runs on the CPU engine")
+
+
+for _cls in (CX.Flatten, CX.ArraysZip, CX.ArrayJoin, CX.ZipWith,
+             CX.MapConcat):
+    _expr(_cls, ts.all_basic + ts.TypeSig(ts.ARRAY, ts.STRUCT, ts.MAP),
+          _cpu_only_collection)
 
 
 # --- higher-order functions + maps ---

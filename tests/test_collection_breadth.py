@@ -1,0 +1,66 @@
+"""Extended collection surface: flatten / arrays_zip / array_join /
+zip_with / map_concat (CPU-engine backed, planner-tagged) — semantics
+per collectionOperations.scala + higherOrderFunctions.scala."""
+
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.expr import col
+from spark_rapids_tpu.expr.collections import (ArrayJoin, ArraysZip,
+                                               Flatten, MapConcat,
+                                               zip_with)
+from spark_rapids_tpu.expr.core import Alias
+from spark_rapids_tpu.plan.session import TpuSession
+
+
+@pytest.fixture()
+def df():
+    s = TpuSession()
+    return s.create_dataframe(
+        {"aa": [[[1, 2], [3]], [], None, [[4], None]],
+         "x": [[1, 2], [3], None, [4, 5]],
+         "y": [[10, 20, 30], [40], [50], None],
+         "ss": [["a", None, "c"], [], None, ["z"]],
+         "m1": [{1: 1}, {2: 2}, None, {3: 3}],
+         "m2": [{1: 9}, {}, {5: 5}, {4: 4}]},
+        schema=[("aa", dt.ArrayType(dt.ArrayType(dt.INT64))),
+                ("x", dt.ArrayType(dt.INT64)),
+                ("y", dt.ArrayType(dt.INT64)),
+                ("ss", dt.ArrayType(dt.STRING)),
+                ("m1", dt.MapType(dt.INT64, dt.INT64)),
+                ("m2", dt.MapType(dt.INT64, dt.INT64))])
+
+
+def test_flatten(df):
+    r = df.select(Alias(Flatten(col("aa")), "f")).collect()
+    assert [x["f"] for x in r] == [[1, 2, 3], [], None, None]
+
+
+def test_arrays_zip_pads_with_nulls(df):
+    r = df.select(Alias(ArraysZip(col("x"), col("y")), "z")).collect()
+    assert r[0]["z"] == [{"0": 1, "1": 10}, {"0": 2, "1": 20},
+                         {"0": None, "1": 30}]
+    assert r[2]["z"] is None and r[3]["z"] is None
+
+
+def test_array_join_null_replacement(df):
+    r = df.select(
+        Alias(ArrayJoin(col("ss"), ",", null_replacement="?"), "j"),
+        Alias(ArrayJoin(col("ss"), "-"), "k")).collect()
+    assert [x["j"] for x in r] == ["a,?,c", "", None, "z"]
+    assert r[0]["k"] == "a-c"  # nulls dropped without replacement
+
+
+def test_zip_with(df):
+    r = df.select(
+        Alias(zip_with(col("x"), col("y"), lambda a, b: a + b),
+              "zw")).collect()
+    assert r[0]["zw"] == [11, 22, None]
+    assert r[1]["zw"] == [43]
+    assert r[2]["zw"] is None and r[3]["zw"] is None
+
+
+def test_map_concat_last_wins(df):
+    r = df.select(Alias(MapConcat(col("m1"), col("m2")),
+                        "mc")).collect()
+    assert [x["mc"] for x in r] == [{1: 9}, {2: 2}, None, {3: 3, 4: 4}]

@@ -44,6 +44,64 @@ def arrays_df(session):
         schema=[("a", dt.ArrayType(dt.INT64)), ("x", dt.INT64)])
 
 
+def test_array_set_functions(arrays_df, session):
+    """array_distinct/union/intersect/except/overlap/remove/position/
+    slice/reverse differential (collectionOperations.scala family)."""
+    from spark_rapids_tpu.expr import (ArrayDistinct, ArrayExcept,
+                                       ArrayIntersect, ArrayPosition,
+                                       ArrayRemove, ArrayReverse,
+                                       ArraysOverlap, ArrayUnion, Slice)
+    rng = np.random.default_rng(23)
+    rows_a, rows_b = [], []
+    for _ in range(150):
+        def mk():
+            r = rng.random()
+            if r < 0.1:
+                return None
+            if r < 0.2:
+                return []
+            return [int(v) if rng.random() > 0.2 else None
+                    for v in rng.integers(-5, 6,
+                                          int(rng.integers(1, 7)))]
+        rows_a.append(mk())
+        rows_b.append(mk())
+    df = session.create_dataframe(
+        {"a": rows_a, "b": rows_b,
+         "v": [int(v) for v in rng.integers(-5, 6, 150)],
+         "s": [int(v) for v in rng.integers(-3, 4, 150)],
+         "n": [int(v) for v in rng.integers(0, 4, 150)]},
+        schema=[("a", dt.ArrayType(dt.INT64)),
+                ("b", dt.ArrayType(dt.INT64)),
+                ("v", dt.INT64), ("s", dt.INT64), ("n", dt.INT64)])
+    from spark_rapids_tpu.testing import assert_tpu_cpu_equal_df
+    assert_tpu_cpu_equal_df(df.select(
+        Alias(ArrayDistinct(col("a")), "d"),
+        Alias(ArrayUnion(col("a"), col("b")), "u"),
+        Alias(ArrayIntersect(col("a"), col("b")), "i"),
+        Alias(ArrayExcept(col("a"), col("b")), "e"),
+        Alias(ArraysOverlap(col("a"), col("b")), "o"),
+        Alias(ArrayRemove(col("a"), col("v")), "r"),
+        Alias(ArrayPosition(col("a"), col("v")), "p"),
+        Alias(ArrayReverse(col("a")), "rev")))
+    # slice: start!=0 (0 is Spark's error case; this engine nulls it)
+    df2 = df.filter(col("s") != lit(0))
+    assert_tpu_cpu_equal_df(df2.select(
+        Alias(Slice(col("a"), col("s"), col("n")), "sl")))
+
+
+def test_array_repeat(session):
+    from spark_rapids_tpu.expr import ArrayRepeat
+    df = session.create_dataframe(
+        {"v": [1, None, 3], "x": [0, 1, 2]},
+        schema=[("v", dt.INT64), ("x", dt.INT64)])
+    from spark_rapids_tpu.testing import assert_tpu_cpu_equal_df
+    # literal count -> device; column count -> CPU fallback, both match
+    assert_tpu_cpu_equal_df(df.select(
+        Alias(ArrayRepeat(col("v"), lit(3)), "r")))
+    assert_tpu_cpu_equal_df(df.select(
+        Alias(ArrayRepeat(col("v"), col("x")), "r")))
+
+
 def test_size_item_contains(arrays_df):
     df = arrays_df.select(
         col("x"),

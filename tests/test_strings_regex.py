@@ -135,6 +135,27 @@ def test_word_boundary_edges_match_python_re(session):
         assert [r["m"] for r in out] == want, pat
 
 
+def test_posix_classes_match_translated_re(session):
+    r"""\p{Name} POSIX/ASCII classes (RegexParser.scala subset) checked
+    against python re with translated equivalents."""
+    subj = ["abc", "A1 ", "!?.", "", None, "x9y", "TAB\there"]
+    df = session.create_dataframe({"s": subj},
+                                  schema=[("s", dt.STRING)])
+    cases = [(r"\p{Alpha}+", "[A-Za-z]+"), (r"\p{Digit}", "[0-9]"),
+             (r"^\p{Upper}", "^[A-Z]"),
+             (r"\P{Alpha}", "[^A-Za-z]"),
+             (r"[\p{Lower}0-9]+$", "[a-z0-9]+$"),
+             (r"\p{Space}", r"[ \t\n\x0b\f\r]")]
+    for pat, ref in cases:
+        got = [r["m"] for r in
+               df.select(RLike(col("s"), pat).alias("m")).collect()]
+        want = [None if x is None else re.search(ref, x) is not None
+                for x in subj]
+        assert got == want, pat
+    with pytest.raises(RegexUnsupported):
+        transpile(r"\p{IsGreek}")  # unknown name still rejects
+
+
 def test_word_boundary_extract_falls_back_at_plan_time(session):
     """\\b patterns in extract/replace must tag CPU fallback during
     planning, never raise mid-execution."""
