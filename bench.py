@@ -269,6 +269,18 @@ def main():
     import jax
     backend = jax.default_backend()
     RESULT["backend"] = backend
+    if backend == "cpu":
+        # tunnel down right now: carry the round's last-good TPU record
+        # (tools/tpu_watch.py refreshes it whenever the tunnel is up) so
+        # chip evidence survives a dead tunnel at bench time
+        lg = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "BENCH_TPU_last_good.json")
+        if os.path.exists(lg):
+            try:
+                with open(lg) as f:
+                    RESULT["tpu_last_good"] = json.load(f)
+            except Exception:
+                pass
 
     scale = int(os.environ.get("SRT_BENCH_SCALE", 0))
     if not scale:

@@ -109,10 +109,9 @@ class ListColumn:
         new_offsets = jnp.concatenate(
             [jnp.zeros((1,), jnp.int32),
              jnp.cumsum(lens, dtype=jnp.int32)])
+        from .vector import rows_from_offsets
         pos = jnp.arange(child_cap, dtype=jnp.int32)
-        row = jnp.searchsorted(new_offsets[1:], pos,
-                               side="right").astype(jnp.int32)
-        row_c = jnp.clip(row, 0, out_cap - 1)
+        row_c = rows_from_offsets(new_offsets[:-1], lens, child_cap)
         within = pos - jnp.take(new_offsets, row_c)
         src_idx = jnp.take(starts, row_c) + within
         total = new_offsets[out_cap]

@@ -37,6 +37,40 @@ def live_mask(capacity: int, num_rows) -> jax.Array:
     return jnp.arange(capacity, dtype=jnp.int32) < num_rows
 
 
+def rows_from_offsets(starts: jax.Array, lens: jax.Array,
+                      out_size: int) -> jax.Array:
+    """Owning row per flat element position.
+
+    Row r owns positions [starts[r], starts[r]+lens[r]); spans are
+    contiguous and ascending (the Arrow offsets invariant). Returns
+    int32[out_size] with positions past the last span mapping to the
+    last row (callers mask with a total-length check). Implemented as
+    scatter-max + cummax — two linear passes, replacing the
+    searchsorted formulation whose log-factor passes dominated every
+    string repack at batch scale."""
+    n = starts.shape[0]
+    # only rows that own at least one byte mark their start; at a shared
+    # start position the non-empty row is the max index by construction
+    mark = jnp.full(out_size, -1, jnp.int32).at[
+        jnp.where(lens > 0, starts, out_size)].max(
+        jnp.arange(n, dtype=jnp.int32), mode="drop")
+    row = jax.lax.cummax(mark)
+    return jnp.clip(row, 0, n - 1)
+
+
+def compaction_indices(keep: jax.Array) -> jax.Array:
+    """Stable-compaction gather map: entry j (for j < sum(keep)) is the
+    position of the j-th kept row; tail entries are 0 (callers mask dead
+    output rows, so the duplicated row-0 gather is harmless). cumsum +
+    scatter — replaces ``argsort(~keep)`` whose full sort cost dominated
+    every filter/compact on batches at capacity scale."""
+    cap = keep.shape[0]
+    slot = jnp.cumsum(keep.astype(jnp.int32)) - 1
+    return jnp.zeros(cap, jnp.int32).at[
+        jnp.where(keep, slot, cap)].set(
+        jnp.arange(cap, dtype=jnp.int32), mode="drop")
+
+
 class ColumnVector:
     """A flat primitive column: data buffer + validity mask.
 
@@ -169,11 +203,10 @@ class StringColumn:
         lens = jnp.where(ends <= nbytes_cap, lens, 0)
         new_offsets = jnp.concatenate(
             [jnp.zeros((1,), jnp.int32), jnp.cumsum(lens, dtype=jnp.int32)])
-        # Scatter-free repack: for each output byte position find its row via
-        # searchsorted, then index into the source chars buffer.
+        # Repack: for each output byte position find its row (linear
+        # scatter+cummax scan), then index into the source chars buffer.
         pos = jnp.arange(nbytes_cap, dtype=jnp.int32)
-        row = jnp.searchsorted(new_offsets[1:], pos, side="right").astype(jnp.int32)
-        row_c = jnp.clip(row, 0, out_cap - 1)
+        row_c = rows_from_offsets(new_offsets[:-1], lens, nbytes_cap)
         within = pos - jnp.take(new_offsets, row_c)
         src = jnp.take(starts, row_c) + within
         total = new_offsets[out_cap]

@@ -316,6 +316,15 @@ class TpuExec:
     def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
         raise NotImplementedError
 
+    def reset_for_rerun(self) -> None:
+        """Clear one-shot per-run state before a cached physical tree is
+        re-executed (plan/plan_cache.py). Compile caches (jit wrappers)
+        must survive — they are the point of caching the tree; stateful
+        nodes (shuffle writes, broadcast materialization) override."""
+        for c in self.children:
+            if isinstance(c, TpuExec):
+                c.reset_for_rerun()
+
     # --- plan tree utilities ---
     def tree_string(self, indent: int = 0) -> str:
         line = "  " * indent + "* " + self.node_description()

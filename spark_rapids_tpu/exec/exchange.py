@@ -102,6 +102,13 @@ class ShuffleExchangeExec(TpuExec):
         self._written = False
         self._jit_cache = {}
 
+    def reset_for_rerun(self) -> None:
+        super().reset_for_rerun()
+        # fresh shuffle id: the previous run's blocks are owned by the
+        # old id (and may already be cleaned up)
+        self.shuffle_id = next_shuffle_id()
+        self._written = False
+
     @property
     def output_schema(self) -> Schema:
         return self.children[0].output_schema
@@ -452,6 +459,10 @@ class BroadcastExchangeExec(TpuExec):
     def __init__(self, child: TpuExec):
         super().__init__(child)
         self._materialized: Optional[ColumnarBatch] = None
+
+    def reset_for_rerun(self) -> None:
+        super().reset_for_rerun()
+        self._materialized = None
 
     @property
     def output_schema(self) -> Schema:

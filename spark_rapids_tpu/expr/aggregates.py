@@ -60,6 +60,11 @@ class AggregateFunction(Expression):
     """Base; children[0] (if any) is the input expression."""
 
     name = "agg"
+    #: True when update/merge require rows of a group to be CONTIGUOUS
+    #: in key-sorted order (collect_list's offset-relabel invariant);
+    #: the group kernel then skips the sort-free hash-claim fast path
+    #: (ops/kernels.py _prelude_fast) and uses the exact sort.
+    needs_sorted_groups = False
 
     def data_type(self, schema: Schema) -> dt.DType:
         raise NotImplementedError
@@ -573,6 +578,7 @@ class CollectList(AggregateFunction):
     sort), so no per-element shuffling ever happens."""
 
     name = "collect_list"
+    needs_sorted_groups = True
 
     def data_type(self, schema: Schema) -> dt.DType:
         return dt.ArrayType(self.children[0].data_type(schema))
@@ -689,6 +695,7 @@ class Percentile(AggregateFunction):
     t-digest sketches; that is the planned device path)."""
 
     name = "percentile"
+    needs_sorted_groups = True
 
     def __init__(self, child: Expression, percentage: float):
         super().__init__(child)
@@ -717,6 +724,7 @@ class ApproxPercentile(AggregateFunction):
     """
 
     name = "approx_percentile"
+    needs_sorted_groups = True
 
     def __init__(self, child: Expression, percentage, accuracy: int = 10000):
         super().__init__(child)

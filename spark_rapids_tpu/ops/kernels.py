@@ -34,7 +34,8 @@ import jax.numpy as jnp
 
 from ..columnar import dtypes as dt
 from ..columnar.vector import (Column, ColumnVector, ColumnarBatch,
-                               StringColumn, live_mask)
+                               StringColumn, compaction_indices, live_mask,
+                               round_pow2, rows_from_offsets)
 
 # ---------------------------------------------------------------------------
 # Filter
@@ -45,7 +46,7 @@ def compact(batch: ColumnarBatch, keep: jnp.ndarray) -> ColumnarBatch:
     """Keep rows where ``keep`` (restricted to live rows), preserving order."""
     keep = keep & batch.live_mask()
     n = jnp.sum(keep).astype(jnp.int32)
-    idx = jnp.argsort(~keep, stable=True).astype(jnp.int32)
+    idx = compaction_indices(keep)
     return batch.gather(idx, n, unique=True)
 
 
@@ -178,35 +179,197 @@ def group_ids(sorted_keys: Sequence[Column], live) -> Tuple[jnp.ndarray, jnp.nda
     return gid.astype(jnp.int32), num_groups, boundary
 
 
-# NOTE: a hash-cluster shortcut (sort group keys by murmur3 instead of
-# rank chains) was tried and REVERTED: two distinct keys colliding on
-# the 32-bit hash can interleave under the stable sort, splitting a
-# group into duplicate output rows — silent corruption at ~2M-key
-# scale. The exact rank sort is already cheap for strings (packed
-# uint64 words, one argsort per 8 pad bytes, _rank_keys above).
-def _sorted_group_prelude(batch: ColumnarBatch, key_cols: Sequence[Column]):
-    """Shared sort/group-id machinery for update and merge passes.
+def _gather_rows(col: Column, idx: jnp.ndarray, valid) -> Column:
+    """Permutation/compaction row gather (each source row used at most
+    once among valid slots) — string/list columns keep tight buffers."""
+    from ..columnar.nested import ListColumn
+    if isinstance(col, (StringColumn, ListColumn)):
+        return col.gather(idx, valid, unique=True)
+    return col.gather(idx, valid)
 
-    Returns (perm, live_s, gid_safe, num_groups, key_batch). Dead rows
-    are routed to a scratch gid just past the live groups so their
-    (zeroed) values never pollute a real group. Order-sensitive
-    aggregates recover each sorted row's original position from ``perm``.
-    """
+
+def _keys_eq_pairs(col: Column, ia: jnp.ndarray, ib: jnp.ndarray
+                   ) -> jnp.ndarray:
+    """Null-safe key equality of row pairs (ia[k], ib[k]) without
+    gathering the column: strings compare via their packed big-endian
+    words (dense take, no byte repack), floats collapse NaNs so
+    NaN == NaN for grouping (Spark normalizes NaN group keys)."""
+    va = jnp.take(col.validity, ia)
+    vb = jnp.take(col.validity, ib)
+    if isinstance(col, StringColumn):
+        data_eq = jnp.take(col.lengths(), ia) == jnp.take(col.lengths(), ib)
+        for w in _rank_keys(col):
+            data_eq = data_eq & (jnp.take(w, ia) == jnp.take(w, ib))
+    else:
+        da = jnp.take(col.data, ia)
+        db = jnp.take(col.data, ib)
+        if jnp.issubdtype(da.dtype, jnp.floating):
+            data_eq = (da == db) | (jnp.isnan(da) & jnp.isnan(db))
+        else:
+            data_eq = da == db
+    return (va == vb) & (~va | data_eq)
+
+
+def _group_ids_from_eq(eq_prev: jnp.ndarray, live) -> Tuple:
+    """(gid, num_groups, boundary) from a rows-equal-previous mask over
+    key-sorted rows."""
+    cap = live.shape[0]
+    boundary = live & ~eq_prev
+    boundary = jnp.where(jnp.arange(cap) == 0, live, boundary)
+    gid = (jnp.cumsum(boundary.astype(jnp.int32)) - 1).clip(0)
+    num_groups = jnp.sum(boundary).astype(jnp.int32)
+    return gid.astype(jnp.int32), num_groups, boundary
+
+
+def _key_batch(key_cols, key_rows, cap, num_groups) -> ColumnarBatch:
+    klm = live_mask(cap, num_groups)
+    key_out = [_gather_rows(c, key_rows, klm) for c in key_cols]
+    return ColumnarBatch(
+        key_out, [f"k{i}" for i in range(len(key_out))], num_groups)
+
+
+def _prelude_exact(batch: ColumnarBatch, key_cols: Sequence[Column]):
+    """Sort-based grouping (the always-correct fallback): rank-chain
+    sort, adjacent-equality boundaries, one key gather per group."""
     live = batch.live_mask()
     cap = batch.capacity
     perm = sort_indices(key_cols, [True] * len(key_cols),
                         [True] * len(key_cols), live)
     live_s = jnp.take(live, perm)
-    keys_s = [c.gather(perm, live_s) for c in key_cols]
-    gid, num_groups, boundary = group_ids(keys_s, live_s)
+    prev = jnp.concatenate([perm[:1], perm[:-1]])
+    eq = jnp.ones(cap, jnp.bool_)
+    for c in key_cols:
+        eq = eq & _keys_eq_pairs(c, perm, prev)
+    eq = eq & (jnp.arange(cap) != 0)
+    gid, num_groups, boundary = _group_ids_from_eq(eq, live_s)
     # scratch slot for dead rows; num_groups == cap implies no dead rows
     gid_safe = jnp.where(live_s, gid,
                          jnp.minimum(num_groups, cap - 1).astype(jnp.int32))
-    bpos = jnp.argsort(~boundary, stable=True).astype(jnp.int32)
-    key_out = [c.gather(bpos, live_mask(cap, num_groups)) for c in keys_s]
-    key_batch = ColumnarBatch(
-        key_out, [f"k{i}" for i in range(len(key_out))], num_groups)
-    return perm, live_s, gid_safe, num_groups, key_batch
+    key_rows = jnp.take(perm, compaction_indices(boundary))
+    return perm, live_s, gid_safe, num_groups, \
+        _key_batch(key_cols, key_rows, cap, num_groups)
+
+
+# multiplicative mixers for the claim rounds (odd 64-bit constants from
+# splitmix64/xxhash); one claim table per round
+_CLAIM_MIXERS = (0x9E3779B97F4A7C15, 0xC2B2AE3D27D4EB4F,
+                 0x165667B19E3779F9, 0x27D4EB2F165667C5)
+
+
+def _prelude_fast(batch: ColumnarBatch, key_cols: Sequence[Column]):
+    """Sort-free hash-claim grouping.
+
+    Rows claim hash-table slots by scatter-min of a 64-bit key hash
+    (one table per round; losers retry under a fresh mixer). Winners of
+    one slot share a gid. Exactness is enforced by comparing every
+    row's TRUE key against its slot representative — a 64-bit collision
+    or an unclaimed row flips ``ok`` and the caller falls back to the
+    sort path. Rows stay in original order (perm = iota), so this is
+    only valid for scatter-style aggregates (see needs_sorted_groups).
+
+    This replaces cuDF's iterative open-addressing hash groupby
+    (GpuAggregateExec.scala:175's cudf groupBy) with a bounded-round,
+    branch-free formulation XLA can fuse: every round is a scatter-min
+    + gathers over static shapes.
+    """
+    from ..expr import hashing as H
+    live = batch.live_mask()
+    cap = batch.capacity
+    h1 = jnp.full((cap,), 0x3C6EF372, jnp.uint32)
+    h2 = jnp.full((cap,), 0xA54FF53A, jnp.uint32)
+    for c in key_cols:
+        h1 = H.murmur3_column(c, h1)
+        h2 = H.murmur3_column(c, h2)
+        # murmur3_column leaves h unchanged on null rows; fold the
+        # validity bit in so null patterns hash apart from values
+        h1 = jnp.where(c.validity, h1, h1 ^ jnp.uint32(0x9E3779B9))
+        h2 = jnp.where(c.validity, h2,
+                       h2 * jnp.uint32(2654435761) + jnp.uint32(1))
+    h = (h1.astype(jnp.uint64) << 32) | h2.astype(jnp.uint64)
+    INF = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+    h = jnp.minimum(h, INF - 1)  # INF is the empty-slot sentinel
+    T = round_pow2(cap)
+    log2T = T.bit_length() - 1
+    arange = jnp.arange(cap, dtype=jnp.int32)
+
+    def one_round(mix, state):
+        unresolved, gid, key_rows, offset = state
+        slot = ((h * jnp.uint64(mix)) >> jnp.uint64(64 - log2T)
+                ).astype(jnp.int32)
+        tbl = jnp.full(T, INF, jnp.uint64).at[slot].min(
+            jnp.where(unresolved, h, INF))
+        won = unresolved & (jnp.take(tbl, slot) == h)
+        occ = tbl != INF
+        slot_gid = offset + jnp.cumsum(occ.astype(jnp.int32)) - 1
+        rep_tbl = jnp.full(T, cap, jnp.int32).at[slot].min(
+            jnp.where(won, arange, cap))
+        gid = jnp.where(won, jnp.take(slot_gid, slot), gid)
+        key_rows = key_rows.at[jnp.where(occ, slot_gid, cap)].set(
+            rep_tbl, mode="drop")
+        offset = offset + jnp.sum(occ).astype(jnp.int32)
+        return unresolved & ~won, gid, key_rows, offset
+
+    state = one_round(_CLAIM_MIXERS[0],
+                      (live, jnp.zeros(cap, jnp.int32),
+                       jnp.zeros(cap, jnp.int32), jnp.int32(0)))
+
+    def more_rounds(s):
+        for mix in _CLAIM_MIXERS[1:]:
+            s = one_round(mix, s)
+        return s
+
+    # contested slots are the exception (low-cardinality groupings
+    # resolve fully in round 1): skip rounds 2..R when nothing is left
+    state = jax.lax.cond(jnp.any(state[0]), more_rounds, lambda s: s,
+                         state)
+    unresolved, gid, key_rows, num_groups = state
+    # exactness check: every live row's true key must equal its slot
+    # representative's (collisions merge distinct keys; catch them here)
+    rep = jnp.take(key_rows, jnp.clip(gid, 0, cap - 1))
+    eq = jnp.ones(cap, jnp.bool_)
+    for c in key_cols:
+        eq = eq & _keys_eq_pairs(c, arange, rep)
+    ok = (~jnp.any(unresolved)) & (~jnp.any(live & ~eq))
+    gid_safe = jnp.where(live, gid,
+                         jnp.minimum(num_groups, cap - 1).astype(jnp.int32))
+    return ok, (arange, live, gid_safe, num_groups,
+                _key_batch(key_cols, key_rows, cap, num_groups))
+
+
+def _use_hash_grouping(batch: ColumnarBatch, key_cols, agg_fns) -> bool:
+    """Static (trace-time) gate for the hash-claim fast path: needs
+    grouping keys, scatter-safe aggregates, hashable key types and a
+    batch big enough for the claim table to pay for itself."""
+    return bool(key_cols) and batch.capacity >= 1024 and \
+        all(not getattr(fn, "needs_sorted_groups", False)
+            for fn in agg_fns) and \
+        all(isinstance(c, (StringColumn, ColumnVector)) for c in key_cols)
+
+
+def _sorted_group_prelude(batch: ColumnarBatch, key_cols: Sequence[Column],
+                          allow_hash: bool = False):
+    """Sort-path grouping machinery for update and merge passes (the
+    hash-claim fast path is dispatched by group_aggregate/group_merge
+    directly so they can also skip the input gathers; ``allow_hash`` is
+    kept for signature compatibility and ignored).
+
+    Returns (perm, live_s, gid_safe, num_groups, key_batch). Dead rows
+    are routed to a scratch gid just past the live groups so their
+    (zeroed) values never pollute a real group. Order-sensitive
+    aggregates recover each row's original position from ``perm``.
+    """
+    del allow_hash
+    live = batch.live_mask()
+    cap = batch.capacity
+    if not key_cols:
+        # global aggregate: live rows are a prefix already — no sort
+        gid, num_groups, _ = group_ids([], live)
+        gid_safe = jnp.where(
+            live, gid, jnp.minimum(num_groups,
+                                   max(cap - 1, 0)).astype(jnp.int32))
+        return (jnp.arange(cap, dtype=jnp.int32), live, gid_safe,
+                num_groups, ColumnarBatch([], [], num_groups))
+    return _prelude_exact(batch, key_cols)
 
 
 def group_aggregate(batch: ColumnarBatch, key_cols: Sequence[Column],
@@ -216,14 +379,30 @@ def group_aggregate(batch: ColumnarBatch, key_cols: Sequence[Column],
     states. ``row_offset`` is the stream-global position of this batch's
     row 0, consumed by order-sensitive aggregates (first/last)."""
     cap = batch.capacity
-    perm, live_s, gid, num_groups, key_batch = _sorted_group_prelude(
-        batch, key_cols)
-    states = []
-    for inp, fn in zip(agg_inputs, agg_fns):
-        col_s = inp.gather(perm, live_s) if inp is not None else None
-        states.append(fn.update(gid, col_s, cap, live_s,
-                                row_offset=row_offset, perm=perm))
-    return key_batch, states
+
+    def body(prelude, fast: bool):
+        perm, live_s, gid, num_groups, key_batch = prelude
+        states = []
+        for inp, fn in zip(agg_inputs, agg_fns):
+            if inp is None:
+                col_s = None
+            elif fast:
+                # hash path: rows untouched, perm is the identity —
+                # skip the (pure-overhead) identity gathers
+                col_s = inp
+            else:
+                col_s = _gather_rows(inp, perm, live_s)
+            states.append(fn.update(gid, col_s, cap, live_s,
+                                    row_offset=row_offset,
+                                    perm=None if fast else perm))
+        return key_batch, states
+
+    if not _use_hash_grouping(batch, key_cols, agg_fns):
+        return body(_sorted_group_prelude(batch, key_cols, False), False)
+    ok, fast_prelude = _prelude_fast(batch, key_cols)
+    return jax.lax.cond(
+        ok, lambda _: body(fast_prelude, True),
+        lambda _: body(_prelude_exact(batch, key_cols), False), None)
 
 
 def group_merge(batch: ColumnarBatch, key_cols: Sequence[Column],
@@ -238,18 +417,29 @@ def group_merge(batch: ColumnarBatch, key_cols: Sequence[Column],
     their zeroed states cannot corrupt the last real group.
     """
     cap = batch.capacity
-    perm, live_s, gid, num_groups, key_batch = _sorted_group_prelude(
-        batch, key_cols)
-    merged = []
-    for states, fn in zip(agg_states, agg_fns):
+
+    def body(prelude, fast: bool):
+        perm, live_s, gid, num_groups, key_batch = prelude
+
         def _sort_state(v):
             from ..columnar.nested import ListColumn
+            if fast:
+                return v  # identity perm: states already row-aligned
             if isinstance(v, (StringColumn, ListColumn)):
                 return v.gather(perm, live_s, unique=True)
             return jnp.take(v, perm, axis=0)
-        sorted_states = {k: _sort_state(v) for k, v in states.items()}
-        merged.append(fn.merge(gid, sorted_states, cap))
-    return key_batch, merged, num_groups
+        merged = []
+        for states, fn in zip(agg_states, agg_fns):
+            sorted_states = {k: _sort_state(v) for k, v in states.items()}
+            merged.append(fn.merge(gid, sorted_states, cap))
+        return key_batch, merged, num_groups
+
+    if not _use_hash_grouping(batch, key_cols, agg_fns):
+        return body(_sorted_group_prelude(batch, key_cols, False), False)
+    ok, fast_prelude = _prelude_fast(batch, key_cols)
+    return jax.lax.cond(
+        ok, lambda _: body(fast_prelude, True),
+        lambda _: body(_prelude_exact(batch, key_cols), False), None)
 
 
 # ---------------------------------------------------------------------------
@@ -332,8 +522,7 @@ def join_gather_maps(probe_keys: Sequence[Column], build_keys: Sequence[Column],
         [jnp.zeros(1, jnp.int32), jnp.cumsum(counts, dtype=jnp.int32)])
     total_cand = offsets[-1]
     pos = jnp.arange(out_capacity, dtype=jnp.int32)
-    probe_row = jnp.searchsorted(offsets[1:], pos, side="right").astype(jnp.int32)
-    probe_row = jnp.clip(probe_row, 0, probe_keys[0].capacity - 1)
+    probe_row = rows_from_offsets(offsets[:-1], counts, out_capacity)
     within = pos - jnp.take(offsets, probe_row)
     build_sorted_pos = jnp.take(lo, probe_row) + within
     build_row = jnp.take(order, jnp.clip(build_sorted_pos, 0, cap_b - 1))
@@ -351,7 +540,7 @@ def inner_join(probe: ColumnarBatch, build: ColumnarBatch,
     total lets the host detect output-capacity overflow."""
     p_idx, b_idx, pair_valid, total_cand, _ = join_gather_maps(
         probe_keys, build_keys, probe.live_mask(), build.live_mask(), out_capacity)
-    compact_idx = jnp.argsort(~pair_valid, stable=True).astype(jnp.int32)
+    compact_idx = compaction_indices(pair_valid)
     n_out = jnp.sum(pair_valid).astype(jnp.int32)
     p_take = jnp.take(p_idx, compact_idx)
     b_take = jnp.take(b_idx, compact_idx)
@@ -382,8 +571,8 @@ def left_join(probe: ColumnarBatch, build: ColumnarBatch,
     n_unmatched = jnp.sum(unmatched).astype(jnp.int32)
     n_out = n_pairs + n_unmatched
 
-    pair_order = jnp.argsort(~pair_valid, stable=True).astype(jnp.int32)
-    un_order = jnp.argsort(~unmatched, stable=True).astype(jnp.int32)
+    pair_order = compaction_indices(pair_valid)
+    un_order = compaction_indices(unmatched)
     pos = jnp.arange(out_capacity, dtype=jnp.int32)
     from_pairs = pos < n_pairs
     p_take = jnp.where(from_pairs,
@@ -505,8 +694,7 @@ def _concat_strings(cols: Sequence[StringColumn], caps, counts,
         [jnp.zeros(1, jnp.int32), jnp.cumsum(lens, dtype=jnp.int32)])
     char_cap = sum(c.char_capacity for c in cols)
     pos = jnp.arange(char_cap, dtype=jnp.int32)
-    row = jnp.searchsorted(offsets[1:], pos, side="right").astype(jnp.int32)
-    row_c = jnp.clip(row, 0, out_capacity - 1)
+    row_c = rows_from_offsets(offsets[:-1], lens, char_cap)
     within = pos - jnp.take(offsets, row_c)
     # map row -> source column and source row
     byte = jnp.zeros(char_cap, jnp.uint8)
@@ -625,9 +813,7 @@ def explode_batch(batch: ColumnarBatch, list_col, element_name: str,
         [jnp.zeros(1, jnp.int32), jnp.cumsum(eff, dtype=jnp.int32)])
     total = out_offsets[cap]
     pos = jnp.arange(out_capacity, dtype=jnp.int32)
-    row = jnp.searchsorted(out_offsets[1:], pos,
-                           side="right").astype(jnp.int32)
-    row_c = jnp.clip(row, 0, cap - 1)
+    row_c = rows_from_offsets(out_offsets[:-1], eff, out_capacity)
     within = pos - jnp.take(out_offsets, row_c)
     n_out = jnp.minimum(total, out_capacity)
     gathered = batch.gather(row_c, n_out)

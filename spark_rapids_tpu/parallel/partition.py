@@ -148,12 +148,25 @@ def partition_batch(batch: ColumnarBatch, part_ids: jnp.ndarray,
     S = slot_capacity or cap
     live = batch.live_mask()
     pid = jnp.where(live, part_ids, jnp.int32(num_parts))
-    order = jnp.argsort(pid, stable=True).astype(jnp.int32)
     counts_all = jnp.zeros(num_parts + 1, jnp.int32).at[
         jnp.clip(pid, 0, num_parts)].add(1)
     counts = counts_all[:num_parts]
     offsets = jnp.concatenate(
         [jnp.zeros(1, jnp.int32), jnp.cumsum(counts, dtype=jnp.int32)])
+    if num_parts <= 32:
+        # counting sort: per-partition rank via one cumsum per partition
+        # — replaces the full argsort whose cost dominated partitioning
+        within = jnp.zeros(cap, jnp.int32)
+        for p in range(num_parts):
+            is_p = pid == p
+            within = jnp.where(
+                is_p, jnp.cumsum(is_p.astype(jnp.int32)) - 1, within)
+        slot = jnp.take(offsets, jnp.clip(pid, 0, num_parts - 1)) + within
+        order = jnp.zeros(cap, jnp.int32).at[
+            jnp.where(pid < num_parts, slot, cap)].set(
+            jnp.arange(cap, dtype=jnp.int32), mode="drop")
+    else:
+        order = jnp.argsort(pid, stable=True).astype(jnp.int32)
     j = jnp.arange(S, dtype=jnp.int32)
     srcpos = offsets[:num_parts, None] + j[None, :]          # (P, S)
     row = jnp.take(order, jnp.clip(srcpos, 0, cap - 1))      # (P, S)
@@ -255,11 +268,11 @@ def string_from_padded(padded: jnp.ndarray, lens: jnp.ndarray,
     """
     n, w = padded.shape
     nbytes = char_capacity or n * w
+    from ..columnar.vector import rows_from_offsets
     offsets = jnp.concatenate(
         [jnp.zeros(1, jnp.int32), jnp.cumsum(lens, dtype=jnp.int32)])
     pos = jnp.arange(nbytes, dtype=jnp.int32)
-    rowid = jnp.searchsorted(offsets[1:], pos, side="right").astype(jnp.int32)
-    row_c = jnp.clip(rowid, 0, n - 1)
+    row_c = rows_from_offsets(offsets[:-1], lens, nbytes)
     within = pos - jnp.take(offsets, row_c)
     total = offsets[n]
     chars = jnp.where(
@@ -278,13 +291,15 @@ def flatten_partitions(pb: PartitionedBatch,
     exchanged counts describe the blocks now held). Rows are compacted so
     the output is a standard live-prefix batch of capacity P*S.
     """
+    from ..columnar.vector import compaction_indices, live_mask
     P, S = pb.num_parts, pb.slot_capacity
     counts = pb.counts if received_counts is None else received_counts
     cap = P * S
     j = jnp.arange(S, dtype=jnp.int32)
     slot_valid = (j[None, :] < counts[:, None]).reshape(cap)
     n = jnp.sum(jnp.minimum(counts, S)).astype(jnp.int32)
-    order = jnp.argsort(~slot_valid, stable=True).astype(jnp.int32)
+    order = compaction_indices(slot_valid)
+    keep = live_mask(cap, n)  # compacted output: live rows are a prefix
 
     cols: List[Column] = []
     for spec, dtype in zip(pb.columns, pb.dtypes):
@@ -292,7 +307,6 @@ def flatten_partitions(pb: PartitionedBatch,
             lens, valid, cdata, cok, e_counts = spec
             flat_l = jnp.take(lens.reshape(cap), order)
             flat_v = jnp.take(valid.reshape(cap), order)
-            keep = jnp.take(slot_valid, order)
             flat_l = jnp.where(keep, flat_l, 0)
             flat_v = flat_v & keep
             # child planes: compact each partition's live element run,
@@ -301,12 +315,11 @@ def flatten_partitions(pb: PartitionedBatch,
             je = jnp.arange(Sc, dtype=jnp.int32)
             e_slot_valid = (je[None, :] < e_counts[:, None]).reshape(
                 P_ * Sc)
-            e_order = jnp.argsort(~e_slot_valid,
-                                  stable=True).astype(jnp.int32)
+            n_elems = jnp.sum(e_counts).astype(jnp.int32)
+            e_order = compaction_indices(e_slot_valid)
             flat_cd = jnp.take(cdata.reshape(P_ * Sc), e_order)
             flat_co = jnp.take(cok.reshape(P_ * Sc), e_order) & \
-                jnp.take(e_slot_valid, e_order)
-            n_elems = jnp.sum(e_counts).astype(jnp.int32)
+                live_mask(P_ * Sc, n_elems)
             cols.append(list_from_packed(flat_l, flat_v, flat_cd,
                                          flat_co, n_elems,
                                          dtype.element_type))
@@ -317,7 +330,6 @@ def flatten_partitions(pb: PartitionedBatch,
             flat_b = jnp.take(padded.reshape(cap, w), order, axis=0)
             flat_l = jnp.take(lens.reshape(cap), order)
             flat_v = jnp.take(valid.reshape(cap), order)
-            keep = jnp.take(slot_valid, order)
             flat_l = jnp.where(keep, flat_l, 0)
             flat_v = flat_v & keep
             cols.append(string_from_padded(flat_b, flat_l, flat_v))
@@ -326,15 +338,14 @@ def flatten_partitions(pb: PartitionedBatch,
             hi, lo, valid = spec
             h = jnp.take(hi.reshape(cap), order)
             l = jnp.take(lo.reshape(cap), order)
-            v = jnp.take(valid.reshape(cap), order) & \
-                jnp.take(slot_valid, order)
+            v = jnp.take(valid.reshape(cap), order) & keep
             h = jnp.where(v, h, jnp.zeros((), jnp.int64))
             l = jnp.where(v, l, jnp.zeros((), jnp.uint64))
             cols.append(Decimal128Column(h, l, v, dtype))
         else:
             data, valid = spec
             d = jnp.take(data.reshape(cap), order)
-            v = jnp.take(valid.reshape(cap), order) & jnp.take(slot_valid, order)
+            v = jnp.take(valid.reshape(cap), order) & keep
             d = jnp.where(v, d, jnp.zeros((), d.dtype))
             cols.append(ColumnVector(d, v, dtype))
     return ColumnarBatch(cols, pb.names, n)

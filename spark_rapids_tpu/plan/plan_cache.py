@@ -1,0 +1,127 @@
+"""Physical-plan cache: structural keys for logical plans.
+
+Every ``collect()`` used to re-run apply_overrides and build fresh exec
+instances, so each exec's ``jax.jit`` wrappers were new objects and the
+in-memory pjit cache never carried across collects — a warm TPC-H query
+spent more wall-clock re-tracing jaxprs than computing (the persistent
+XLA compile cache only removes the *compile*, not the trace). The
+reference has no analogue because Spark caches compiled RDD DAGs per
+Dataset; here the session memoizes ``logical plan -> physical plan`` on
+a STRUCTURAL key so re-built-but-identical DataFrames (bench loops, SQL
+re-parses) reuse the exec tree and its traced jits.
+
+Key rules (conservative by construction):
+- encodes node/expression class names + full ``__dict__`` contents
+  recursively; children positionally,
+- file scans fold in (path, mtime, size) per file so data edits
+  invalidate,
+- ANY value the encoder does not recognize raises Uncachable and the
+  query simply runs uncached (never a wrong reuse: unknown values can
+  not silently alias),
+- re-execution of a cached tree calls ``reset_for_rerun`` on every exec
+  so one-shot state (shuffle writes, broadcast materialization) is
+  rebuilt.
+"""
+
+from __future__ import annotations
+
+import datetime
+import decimal
+import os
+
+from ..columnar import dtypes as dt
+
+
+class Uncachable(Exception):
+    """Plan contains state the structural key cannot encode safely."""
+
+
+_PRIMS = (str, int, float, bool, bytes, type(None), complex,
+          datetime.date, datetime.datetime, datetime.timedelta,
+          decimal.Decimal)
+
+_MAX_ITEMS = 4096  # bail on huge embedded literals (LocalRelation data)
+
+
+def _enc(v, depth: int = 0):
+    if depth > 64:
+        raise Uncachable("nesting too deep")
+    if isinstance(v, _PRIMS):
+        return (type(v).__name__, repr(v))
+    if isinstance(v, dt.DType):
+        return ("dtype", type(v).__name__,
+                tuple(sorted((k, _enc(x, depth + 1))
+                             for k, x in vars(v).items())))
+    if isinstance(v, (list, tuple)):
+        if len(v) > _MAX_ITEMS:
+            raise Uncachable("sequence too large")
+        return (type(v).__name__,) + tuple(_enc(x, depth + 1) for x in v)
+    if isinstance(v, dict):
+        if len(v) > _MAX_ITEMS:
+            raise Uncachable("dict too large")
+        return ("dict",) + tuple(
+            sorted((_enc(k, depth + 1), _enc(x, depth + 1))
+                   for k, x in v.items()))
+    if isinstance(v, (set, frozenset)):
+        if len(v) > _MAX_ITEMS:
+            raise Uncachable("set too large")
+        return ("set",) + tuple(sorted(_enc(x, depth + 1) for x in v))
+    from ..expr.core import Expression
+    from .logical import LogicalPlan, SortField
+    if isinstance(v, (LogicalPlan, Expression, SortField)):
+        return _enc_node(v, depth + 1)
+    raise Uncachable(f"unencodable {type(v).__name__}")
+
+
+def _enc_node(node, depth: int):
+    from .logical import LogicalPlan
+    items = []
+    for k, val in sorted(vars(node).items()):
+        if k == "children":
+            continue
+        items.append((k, _enc(val, depth)))
+    key = (type(node).__module__, type(node).__name__, tuple(items),
+           tuple(_enc(c, depth) for c in getattr(node, "children", ())))
+    if isinstance(node, LogicalPlan) and hasattr(node, "paths"):
+        # file scan: fold file identity in so on-disk edits invalidate
+        stats = []
+        for p in node.paths:
+            try:
+                st = os.stat(p)
+                stats.append((p, int(st.st_mtime_ns), st.st_size))
+            except OSError:
+                raise Uncachable("unstatable scan path")
+        key = key + (tuple(stats),)
+    return key
+
+
+def plan_cache_key(plan, conf):
+    """Hashable structural key for (logical plan, conf), or None when
+    the plan is not safely cachable."""
+    try:
+        conf_key = tuple(sorted(
+            (k, _enc(v)) for k, v in conf._settings.items()))
+        return (_enc(plan), conf_key)
+    except Uncachable:
+        return None
+    except Exception:
+        return None
+
+
+class PhysicalPlanCache:
+    """Small FIFO memo of structural key -> physical plan."""
+
+    def __init__(self, max_entries: int = 32):
+        self.max_entries = max_entries
+        self._entries: dict = {}
+
+    def get(self, key):
+        return self._entries.get(key)
+
+    def put(self, key, physical) -> None:
+        if len(self._entries) >= self.max_entries:
+            self._entries.pop(next(iter(self._entries)))
+        self._entries[key] = physical
+
+    def clear(self) -> None:
+        self._entries.clear()

@@ -33,6 +33,8 @@ class TpuSession:
     def __init__(self, conf: Optional[SrtConf] = None):
         self.conf = conf or active_conf()
         self._catalog: Dict[str, "DataFrame"] = {}
+        from .plan_cache import PhysicalPlanCache
+        self._plan_cache = PhysicalPlanCache()
 
     # --- constructors ---
     def create_dataframe(self, data: Dict[str, list],
@@ -71,7 +73,24 @@ class TpuSession:
 
     # --- execution ---
     def execute(self, plan: L.LogicalPlan) -> HostTable:
-        physical = overrides.apply_overrides(plan, self.conf)
+        """Run a logical plan to a host table.
+
+        Physical plans are memoized on a structural key (plan_cache.py)
+        so repeated collects of an identical query — even through fresh
+        DataFrame objects — reuse the exec tree and its traced jits;
+        without this every collect re-traced every jaxpr (the dominant
+        warm-query cost)."""
+        from .plan_cache import plan_cache_key
+        key = plan_cache_key(plan, self.conf)
+        physical = self._plan_cache.get(key) if key is not None else None
+        if physical is None:
+            physical = overrides.apply_overrides(plan, self.conf)
+            # only fully-device plans cache: CPU/bridge nodes hold no
+            # reset protocol for their one-shot state
+            if key is not None and isinstance(physical, TpuExec):
+                self._plan_cache.put(key, physical)
+        elif isinstance(physical, TpuExec):
+            physical.reset_for_rerun()
         ctx = ExecContext(self.conf)
         if isinstance(physical, TpuExec):
             tables = [batch_to_table(b) for b in physical.execute(ctx)

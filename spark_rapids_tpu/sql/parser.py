@@ -45,6 +45,20 @@ class Ast:
     pass
 
 
+def _ast_repr(a) -> str:
+    """Canonical structural repr for AST equality (GROUP BY dedupe,
+    correlated-conjunct matching)."""
+    if isinstance(a, Ast) or type(a).__name__ in (
+            "TableRefA", "SubqueryA", "JoinA", "SelectA", "UnionA",
+            "SetOpA"):
+        items = sorted(vars(a).items())
+        body = ", ".join(f"{k}={_ast_repr(v)}" for k, v in items)
+        return f"{type(a).__name__}({body})"
+    if isinstance(a, (list, tuple)):
+        return "[" + ", ".join(_ast_repr(x) for x in a) + "]"
+    return repr(a)
+
+
 class ColA(Ast):
     def __init__(self, name, qualifier=None):
         self.name = name
@@ -133,6 +147,48 @@ class ScalarSubqueryA(Ast):
         self.stmt = stmt
 
 
+class _PreLowered(Ast):
+    """AST leaf carrying an already-lowered Expression (injected by the
+    subquery rewrites); ``lower`` unwraps it."""
+
+    def __init__(self, expr):
+        self.expr = expr
+
+
+def _and_all(conjs):
+    out = None
+    for c in conjs:
+        out = c if out is None else BinA("and", out, c)
+    return out
+
+
+class _GroupingMarker(Expression):
+    """GROUPING(key) placeholder; the aggregate-lowering replace() pass
+    resolves it to a bit of __grouping_id (0 for plain GROUP BY)."""
+
+    def __init__(self, child: Expression):
+        super().__init__(child)
+
+    def data_type(self, schema) -> dt.DType:
+        return dt.INT64
+
+
+class ExistsA(Ast):
+    """EXISTS (subquery) — possibly correlated."""
+
+    def __init__(self, stmt):
+        self.stmt = stmt
+
+
+class InSubqueryA(Ast):
+    """expr IN (subquery) — possibly correlated."""
+
+    def __init__(self, e, stmt, neg):
+        self.e = e
+        self.stmt = stmt
+        self.neg = neg
+
+
 class TableRefA:
     def __init__(self, name, alias):
         self.name = name
@@ -159,9 +215,14 @@ class SelectA:
         self.from_: List[JoinA] = []
         self.where: Optional[Ast] = None
         self.group_by: List[Ast] = []
+        #: GROUPING SETS / ROLLUP / CUBE: list of grouping sets, each a
+        #: list of indexes into group_by; None = plain GROUP BY
+        self.group_sets: Optional[List[List[int]]] = None
         self.having: Optional[Ast] = None
         self.order_by: List[Tuple[Ast, bool, Optional[bool]]] = []
         self.limit: Optional[int] = None
+        #: WITH name AS (...) bindings visible to this statement
+        self.ctes: List[Tuple[str, "Ast"]] = []
 
 
 class UnionA:
@@ -169,6 +230,18 @@ class UnionA:
         self.left, self.right, self.all = left, right, all_
         self.order_by: List = []
         self.limit = None
+        self.ctes: List = []
+
+
+class SetOpA:
+    """INTERSECT / EXCEPT (set semantics follow ``all``)."""
+
+    def __init__(self, op, left, right, all_):
+        self.op = op            # "intersect" | "except"
+        self.left, self.right, self.all = left, right, all_
+        self.order_by: List = []
+        self.limit = None
+        self.ctes: List = []
 
 
 # ---------------------------------------------------------------------------
@@ -223,30 +296,83 @@ class Parser:
 
     # --- statements ---
     def parse_statement(self):
-        stmt = self.parse_select_core()
-        while self.at_kw("union"):
-            self.next()
-            all_ = bool(self.accept_kw("all"))
-            self.accept_kw("distinct")
-            right = self.parse_select_core()
-            u = UnionA(stmt, right, all_)
-            # a trailing ORDER BY/LIMIT binds to the whole set expression,
-            # not the last branch
-            if isinstance(right, SelectA):
-                u.order_by, right.order_by = right.order_by, []
-                u.limit, right.limit = right.limit, None
-            stmt = u
-        # trailing ORDER BY / LIMIT apply to the whole set expression
-        if self.at_kw("order"):
-            ob = self.parse_order_by()
-            stmt.order_by = ob
-        if self.accept_kw("limit"):
-            stmt.limit = int(self.next().value)
+        stmt = self.parse_set_expr()
         self.accept_op(";")
         if self.peek().kind != "EOF":
             raise SqlError(f"unexpected trailing input "
                            f"{self.peek().value!r} @{self.peek().pos}")
         return stmt
+
+    def parse_set_expr(self):
+        """[WITH ...] select-term {UNION|EXCEPT [ALL] select-term}
+        with INTERSECT binding tighter (SQL precedence), then trailing
+        ORDER BY / LIMIT on the whole set expression."""
+        ctes = []
+        if self.accept_kw("with"):
+            while True:
+                name = self.next().value
+                self.expect_kw("as")
+                self.expect_op("(")
+                sub = self.parse_set_expr()
+                self.expect_op(")")
+                ctes.append((name, sub))
+                if not self.accept_op(","):
+                    break
+        stmt = self.parse_intersect_term()
+        while self.at_kw("union", "except", "minus"):
+            op = self.next().value.lower()
+            all_ = bool(self.accept_kw("all"))
+            self.accept_kw("distinct")
+            right = self.parse_intersect_term()
+            if op == "union":
+                u = UnionA(stmt, right, all_)
+            else:
+                u = SetOpA("except", stmt, right, all_)
+            self._hoist_order_limit(u, right)
+            stmt = u
+        # trailing ORDER BY / LIMIT apply to the whole set expression
+        if self.at_kw("order"):
+            stmt.order_by = self.parse_order_by()
+        if self.accept_kw("limit"):
+            stmt.limit = int(self.next().value)
+        stmt.ctes = ctes + getattr(stmt, "ctes", [])
+        return stmt
+
+    # select-terms that came from "( ... )": their ORDER BY/LIMIT are
+    # legitimately inner and must NOT hoist to the set expression
+    _parenthesized: set = None
+
+    def _hoist_order_limit(self, u, right) -> None:
+        """A trailing ORDER BY/LIMIT greedily parsed into the LAST
+        unparenthesized branch binds to the whole set expression."""
+        if id(right) in (self._parenthesized or ()):
+            return
+        if isinstance(right, (SelectA, UnionA, SetOpA)):
+            u.order_by, right.order_by = right.order_by, []
+            u.limit, right.limit = right.limit, None
+
+    def parse_intersect_term(self):
+        stmt = self.parse_select_term()
+        while self.at_kw("intersect"):
+            self.next()
+            all_ = bool(self.accept_kw("all"))
+            self.accept_kw("distinct")
+            right = self.parse_select_term()
+            u = SetOpA("intersect", stmt, right, all_)
+            self._hoist_order_limit(u, right)
+            stmt = u
+        return stmt
+
+    def parse_select_term(self):
+        if self.at_op("("):
+            self.next()
+            inner = self.parse_set_expr()
+            self.expect_op(")")
+            if self._parenthesized is None:
+                self._parenthesized = set()
+            self._parenthesized.add(id(inner))
+            return inner
+        return self.parse_select_core()
 
     def parse_select_core(self) -> SelectA:
         self.expect_kw("select")
@@ -263,7 +389,8 @@ class Parser:
                 alias = self.next().value
             elif self.peek().kind == "IDENT" and not self.at_kw(
                     "from", "where", "group", "having", "order", "limit",
-                    "union", "inner", "left", "right", "full", "cross",
+                    "union", "except", "minus", "intersect",
+                    "inner", "left", "right", "full", "cross",
                     "join", "on"):
                 alias = self.next().value
             s.items.append((item, alias))
@@ -300,10 +427,7 @@ class Parser:
         if self.at_kw("group"):
             self.next()
             self.expect_kw("by")
-            while True:
-                s.group_by.append(self.parse_expr())
-                if not self.accept_op(","):
-                    break
+            self._parse_group_by(s)
         if self.accept_kw("having"):
             s.having = self.parse_expr()
         if self.at_kw("order") and self._lookahead_is_order_by():
@@ -311,6 +435,85 @@ class Parser:
         if self.accept_kw("limit"):
             s.limit = int(self.next().value)
         return s
+
+    def _parse_group_by(self, s: SelectA) -> None:
+        """Plain exprs, optionally mixed with ONE of ROLLUP(...),
+        CUBE(...), GROUPING SETS((...),...). ``s.group_by`` collects the
+        distinct key exprs in order; ``s.group_sets`` (when non-plain)
+        holds index lists into group_by per output grouping set, with
+        plain exprs present in every set."""
+        base: List[Ast] = []
+        construct = None  # (kind, [expr or [exprs]])
+        while True:
+            if self.at_kw("rollup", "cube"):
+                if construct is not None:
+                    raise SqlError("multiple ROLLUP/CUBE/GROUPING SETS "
+                                   "constructs in one GROUP BY are not "
+                                   "supported")
+                kind = self.next().value.lower()
+                self.expect_op("(")
+                exprs = [self.parse_expr()]
+                while self.accept_op(","):
+                    exprs.append(self.parse_expr())
+                self.expect_op(")")
+                construct = (kind, exprs)
+            elif self.at_kw("grouping"):
+                if construct is not None:
+                    raise SqlError("multiple ROLLUP/CUBE/GROUPING SETS "
+                                   "constructs in one GROUP BY are not "
+                                   "supported")
+                self.next()
+                self.expect_kw("sets")
+                self.expect_op("(")
+                sets = []
+                while True:
+                    if self.accept_op("("):
+                        grp = []
+                        if not self.at_op(")"):
+                            grp.append(self.parse_expr())
+                            while self.accept_op(","):
+                                grp.append(self.parse_expr())
+                        self.expect_op(")")
+                        sets.append(grp)
+                    else:
+                        sets.append([self.parse_expr()])
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+                construct = ("sets", sets)
+            else:
+                base.append(self.parse_expr())
+            if not self.accept_op(","):
+                break
+        if construct is None:
+            s.group_by = base
+            return
+        kind, payload = construct
+        if kind == "rollup":
+            variable = [payload[:i] for i in range(len(payload), -1, -1)]
+        elif kind == "cube":
+            variable = []
+            n = len(payload)
+            for m in range((1 << n) - 1, -1, -1):
+                variable.append([payload[i] for i in range(n)
+                                 if m & (1 << (n - 1 - i))])
+        else:
+            variable = payload
+        # distinct keys in first-appearance order; sets as index lists
+        keys: List[Ast] = list(base)
+
+        def key_idx(e: Ast) -> int:
+            for i, k in enumerate(keys):
+                if _ast_repr(k) == _ast_repr(e):
+                    return i
+            keys.append(e)
+            return len(keys) - 1
+        base_idx = [key_idx(e) for e in base]
+        sets_idx = []
+        for grp in variable:
+            sets_idx.append(base_idx + [key_idx(e) for e in grp])
+        s.group_by = keys
+        s.group_sets = sets_idx
 
     def _lookahead_is_order_by(self) -> bool:
         t = self.toks[self.i + 1]
@@ -379,16 +582,13 @@ class Parser:
 
     def parse_table_ref(self):
         if self.accept_op("("):
-            stmt = self.parse_select_core()
-            while self.at_kw("union"):
-                self.next()
-                all_ = bool(self.accept_kw("all"))
-                stmt = UnionA(stmt, self.parse_select_core(), all_)
+            stmt = self.parse_set_expr()
             self.expect_op(")")
             if self.accept_kw("as"):
                 alias = self.next().value
             elif self.peek().kind == "IDENT" and not self.at_kw(
                     "where", "group", "having", "order", "limit", "union",
+                    "except", "minus", "intersect",
                     "inner", "left", "right", "full", "cross", "join",
                     "on"):
                 alias = self.next().value
@@ -401,6 +601,7 @@ class Parser:
             alias = self.next().value
         elif self.peek().kind == "IDENT" and not self.at_kw(
                 "where", "group", "having", "order", "limit", "union",
+                "except", "minus", "intersect",
                 "inner", "left", "right", "full", "cross", "join", "on"):
             alias = self.next().value
         return TableRefA(name, alias)
@@ -427,6 +628,12 @@ class Parser:
         return self.parse_predicate()
 
     def parse_predicate(self) -> Ast:
+        if self.at_kw("exists"):
+            self.next()
+            self.expect_op("(")
+            stmt = self.parse_set_expr()
+            self.expect_op(")")
+            return ExistsA(stmt)
         e = self.parse_additive()
         neg = bool(self.accept_kw("not"))
         if self.accept_kw("between"):
@@ -436,6 +643,10 @@ class Parser:
             return BetweenA(e, lo, hi, neg)
         if self.accept_kw("in"):
             self.expect_op("(")
+            if self.at_kw("select", "with"):
+                stmt = self.parse_set_expr()
+                self.expect_op(")")
+                return InSubqueryA(e, stmt, neg)
             items = [self.parse_expr()]
             while self.accept_op(","):
                 items.append(self.parse_expr())
@@ -498,12 +709,8 @@ class Parser:
             return LitA(t.value)
         if t.kind == "OP" and t.value == "(":
             self.next()
-            if self.at_kw("select"):
-                stmt = self.parse_select_core()
-                while self.at_kw("union"):
-                    self.next()
-                    all_ = bool(self.accept_kw("all"))
-                    stmt = UnionA(stmt, self.parse_select_core(), all_)
+            if self.at_kw("select", "with"):
+                stmt = self.parse_set_expr()
                 self.expect_op(")")
                 return ScalarSubqueryA(stmt)
             e = self.parse_expr()
@@ -738,26 +945,80 @@ class _Scope:
 class Analyzer:
     def __init__(self, session):
         self.session = session
+        #: WITH-binding scopes, innermost last (CTEs see earlier CTEs)
+        self._cte_frames: List[dict] = []
 
     # --- entry ---
     def analyze(self, stmt):
-        if isinstance(stmt, UnionA):
+        ctes = getattr(stmt, "ctes", [])
+        frame = {}
+        if ctes:
+            self._cte_frames.append(frame)
+            for name, sub in ctes:
+                frame[name.lower()] = self.analyze(sub)
+        try:
+            return self._analyze_body(stmt)
+        finally:
+            if ctes:
+                self._cte_frames.pop()
+
+    def _analyze_body(self, stmt):
+        if isinstance(stmt, (UnionA, SetOpA)):
             left = self.analyze_select(stmt.left) if \
                 isinstance(stmt.left, SelectA) else self.analyze(stmt.left)
             right = self.analyze_select(stmt.right) if \
                 isinstance(stmt.right, SelectA) else self.analyze(stmt.right)
-            df = left.union(right)
-            if not stmt.all:
-                df = df.distinct()
+            if isinstance(stmt, UnionA):
+                df = left.union(right)
+                if not stmt.all:
+                    df = df.distinct()
+            else:
+                df = self._set_op(left, right, stmt.op, stmt.all)
             df = self._order_limit(df, stmt.order_by, stmt.limit,
                                    scope=None)
             return df
         return self.analyze_select(stmt)
 
+    def _set_op(self, left, right, op: str, all_: bool):
+        """INTERSECT / EXCEPT via tagged union + group-by (group keys
+        treat NULLs as equal — exactly SQL set-op semantics). The
+        reference accelerates these through Spark's rewrite onto
+        joins/aggregates; this IS that rewrite, engine-side."""
+        if all_:
+            raise SqlError(f"{op.upper()} ALL is not supported")
+        if len(left.schema) != len(right.schema):
+            raise SqlError(f"{op.upper()} branches have different "
+                           "column counts")
+        lnames = [n for n, _ in left.schema]
+        right2 = right.select(*[Alias(col(rn), ln)
+                                for (ln, _), (rn, _) in
+                                zip(left.schema, right.schema)])
+        ltag = left.select(*([col(n) for n in lnames] +
+                             [Alias(lit(1), "__setl"),
+                              Alias(lit(0), "__setr")]))
+        rtag = right2.select(*([col(n) for n in lnames] +
+                               [Alias(lit(0), "__setl"),
+                                Alias(lit(1), "__setr")]))
+        u = ltag.union(rtag)
+        from ..plan.session import GroupedData
+        g = GroupedData(u, [col(n) for n in lnames]).agg(
+            Alias(Agg.Sum(col("__setl")), "__cl"),
+            Alias(Agg.Sum(col("__setr")), "__cr"))
+        if op == "intersect":
+            g = g.filter(P.And(P.GreaterThan(col("__cl"), lit(0)),
+                               P.GreaterThan(col("__cr"), lit(0))))
+        else:
+            g = g.filter(P.And(P.GreaterThan(col("__cl"), lit(0)),
+                               P.EqualTo(col("__cr"), lit(0))))
+        return g.select(*[col(n) for n in lnames])
+
     # --- FROM resolution + join planning ---
     def _resolve_ref(self, ref):
         if isinstance(ref, SubqueryA):
             return ref.alias, self.analyze(ref.stmt)
+        for frame in reversed(self._cte_frames):
+            if ref.name.lower() in frame:
+                return ref.alias, frame[ref.name.lower()]
         df = self.session.table(ref.name)
         return ref.alias, df
 
@@ -847,7 +1108,16 @@ class Analyzer:
         entries = renamed_entries
         scope = _Scope(scope_entries, type_map)
 
-        conjuncts = self._conjuncts(s.where)
+        # conjuncts holding subquery predicates (EXISTS / IN (SELECT) /
+        # correlated scalar comparisons) lower via joins after the base
+        # join tree is built; everything else flows the normal path
+        all_conjuncts = self._conjuncts(s.where)
+        conjuncts, subq_preds = [], []
+        for c in all_conjuncts:
+            if self._has_subquery_pred(c):
+                subq_preds.append(c)
+            else:
+                conjuncts.append(c)
         used = [False] * len(conjuncts)
 
         # WHERE predicates may only be pushed below the joins into
@@ -1004,7 +1274,353 @@ class Analyzer:
         for ci, c in enumerate(conjuncts):
             if not used[ci]:
                 current = current.filter(self.lower(c, full_scope))
+        for c in subq_preds:
+            current = self._apply_subquery_pred(current, full_scope, c)
         return self._finish(current, full_scope, s)
+
+    # --- subquery predicates (EXISTS / IN (SELECT) / correlated scalar) ---
+    _subq_n = 0
+
+    def _has_subquery_pred(self, a) -> bool:
+        if isinstance(a, (ExistsA, InSubqueryA)):
+            return True
+        if isinstance(a, ScalarSubqueryA):
+            return self._is_correlated(a.stmt)
+        for v in vars(a).values() if isinstance(a, Ast) else ():
+            if isinstance(v, Ast) and self._has_subquery_pred(v):
+                return True
+            if isinstance(v, (list, tuple)):
+                for x in v:
+                    if isinstance(x, Ast) and self._has_subquery_pred(x):
+                        return True
+                    if isinstance(x, tuple):
+                        for y in x:
+                            if isinstance(y, Ast) and \
+                                    self._has_subquery_pred(y):
+                                return True
+        return False
+
+    def _inner_scope_of(self, stmt) -> Optional[_Scope]:
+        """Resolution scope of a subquery's own FROM (schemas only).
+        Memoized per stmt object: correlation classification asks for
+        it repeatedly and derived-table refs are costly to resolve."""
+        cache = getattr(self, "_inner_scope_cache", None)
+        if cache is None:
+            cache = self._inner_scope_cache = {}
+        if id(stmt) in cache:
+            return cache[id(stmt)]
+        if not isinstance(stmt, SelectA) or not stmt.from_:
+            scope = None
+        else:
+            entries, types = [], {}
+            for j in stmt.from_:
+                alias, df = self._resolve_ref(j.ref)
+                cols = [(n, n) for n, _ in df.schema]
+                types.update({n: t for n, t in df.schema})
+                entries.append((alias, cols))
+            scope = _Scope(entries, types)
+        cache[id(stmt)] = scope
+        return scope
+
+    def _is_correlated(self, stmt) -> bool:
+        """Does the subquery's WHERE reference columns outside its own
+        FROM scope?"""
+        inner = self._inner_scope_of(stmt)
+        if inner is None:
+            return False
+        for c in self._conjuncts(stmt.where):
+            if self._outer_refs(c, inner):
+                return True
+        return False
+
+    def _outer_refs(self, ast, inner_scope: _Scope) -> bool:
+        """True when ``ast`` references a column the inner scope cannot
+        resolve (i.e. a correlated outer reference)."""
+        found = [False]
+
+        def walk(a):
+            if found[0]:
+                return
+            if isinstance(a, ColA):
+                try:
+                    inner_scope.resolve(a.name, a.qualifier)
+                except SqlError:
+                    found[0] = True
+                except KeyError:
+                    found[0] = True
+                return
+            if isinstance(a, (ScalarSubqueryA, ExistsA, InSubqueryA)):
+                return  # nested subqueries resolve their own scopes
+            if isinstance(a, Ast):
+                for v in vars(a).items():
+                    _walk_val(v[1])
+
+        def _walk_val(v):
+            if isinstance(v, Ast):
+                walk(v)
+            elif isinstance(v, (list, tuple)):
+                for x in v:
+                    _walk_val(x)
+        walk(ast)
+        return found[0]
+
+    def _correlation_split(self, stmt: "SelectA", outer_scope: _Scope):
+        """Split a subquery's WHERE into (inner conjuncts, correlation
+        pairs [(outer_ast, inner_ast)], outer-only conjuncts). Raises
+        for non-equi correlation (the reference inherits the same
+        limitation from Spark's rewrite to joins)."""
+        inner = self._inner_scope_of(stmt)
+        if inner is None:
+            raise SqlError("correlated subquery needs a FROM clause")
+        inner_c, pairs, outer_c = [], [], []
+        for c in self._conjuncts(stmt.where):
+            if not self._outer_refs(c, inner):
+                inner_c.append(c)
+                continue
+            if isinstance(c, BinA) and c.op == "=":
+                l_out = self._outer_refs(c.l, inner)
+                r_out = self._outer_refs(c.r, inner)
+                if l_out and not r_out:
+                    pairs.append((c.l, c.r))
+                    continue
+                if r_out and not l_out:
+                    pairs.append((c.r, c.l))
+                    continue
+            if not self._outer_refs_any_inner(c, inner):
+                outer_c.append(c)
+                continue
+            raise SqlError("only equi-correlated subquery predicates "
+                           "are supported")
+        return inner_c, pairs, outer_c
+
+    def _outer_refs_any_inner(self, ast, inner_scope: _Scope) -> bool:
+        """Does ``ast`` reference ANY column the inner scope resolves?"""
+        found = [False]
+
+        def walk(a):
+            if found[0] or not isinstance(a, Ast):
+                return
+            if isinstance(a, ColA):
+                try:
+                    inner_scope.resolve(a.name, a.qualifier)
+                    found[0] = True
+                except (SqlError, KeyError):
+                    pass
+                return
+            for v in vars(a).values():
+                if isinstance(v, Ast):
+                    walk(v)
+                elif isinstance(v, (list, tuple)):
+                    for x in v:
+                        if isinstance(x, Ast):
+                            walk(x)
+        walk(ast)
+        return found[0]
+
+    def _plan_semi_source(self, stmt: "SelectA", outer_scope: _Scope,
+                          value_ast: Optional[Ast]):
+        """Build (sub_df, left_key_exprs, right_key_names) for an
+        EXISTS/IN predicate; ``value_ast`` is the outer expression of an
+        IN (its match column is the subquery's single select item)."""
+        if not isinstance(stmt, SelectA):
+            if value_ast is None:
+                raise SqlError("EXISTS over set operations is not "
+                               "supported")
+            # uncorrelated IN over a set expression
+            sub_df = self.analyze(stmt)
+            if len(sub_df.schema) != 1:
+                raise SqlError("IN subquery must return one column")
+            n = Analyzer._subq_n = Analyzer._subq_n + 1
+            key = f"__sqv{n}"
+            sub_df = sub_df.select(
+                Alias(col(sub_df.schema[0][0]), key))
+            return sub_df, [self.lower(value_ast, outer_scope)], [key]
+        inner_c, pairs, outer_c = self._correlation_split(
+            stmt, outer_scope)
+        if outer_c:
+            raise SqlError("outer-only conjunct inside subquery not "
+                           "supported")
+        if (stmt.group_by or stmt.having) and pairs:
+            raise SqlError("correlated subquery with GROUP BY/HAVING "
+                           "not supported in EXISTS/IN")
+        n = Analyzer._subq_n = Analyzer._subq_n + 1
+        s2 = SelectA()
+        s2.from_ = stmt.from_
+        s2.where = _and_all(inner_c)
+        s2.group_by = list(stmt.group_by)
+        s2.having = stmt.having
+        items = []
+        left_keys, right_names = [], []
+        if value_ast is not None:
+            if len(stmt.items) != 1 or isinstance(stmt.items[0][0],
+                                                  StarA):
+                raise SqlError("IN subquery must select exactly one "
+                               "column")
+            vname = f"__sqv{n}"
+            items.append((stmt.items[0][0], vname))
+            left_keys.append(self.lower(value_ast, outer_scope))
+            right_names.append(vname)
+        for i, (o_ast, i_ast) in enumerate(pairs):
+            kname = f"__sqk{n}_{i}"
+            items.append((i_ast, kname))
+            left_keys.append(self.lower(o_ast, outer_scope))
+            right_names.append(kname)
+        if not items:
+            # uncorrelated EXISTS: non-emptiness only
+            items.append((LitA(1), f"__sq1_{n}"))
+            right_names, left_keys = [], []
+        s2.items = items
+        sub_df = self.analyze_select(s2)
+        return sub_df, left_keys, right_names
+
+    def _apply_subquery_pred(self, df, scope: _Scope, ast):
+        """Lower one WHERE conjunct containing subquery predicates onto
+        joins (the engine-side version of Spark's RewritePredicate
+        Subquery, whose output the reference accelerates as
+        GpuBroadcastHashJoin left-semi/anti)."""
+        neg = False
+        inner = ast
+        while isinstance(inner, UnA) and inner.op == "not":
+            neg = not neg
+            inner = inner.e
+        if isinstance(inner, ExistsA):
+            sub_df, lk, rk = self._plan_semi_source(inner.stmt, scope,
+                                                    None)
+            if not lk:
+                # uncorrelated: EXISTS is a plan-time boolean
+                nonempty = len(sub_df.limit(1).collect()) > 0
+                keep = nonempty != neg
+                return df if keep else df.filter(
+                    P.EqualTo(lit(1), lit(0)))
+            return df.join(sub_df, (lk, [col(n) for n in rk]),
+                           how="left_anti" if neg else "left_semi")
+        if isinstance(inner, InSubqueryA):
+            effective_neg = neg != inner.neg
+            sub_df, lk, rk = self._plan_semi_source(inner.stmt, scope,
+                                                    inner.e)
+            if effective_neg:
+                return self._apply_not_in(df, scope, inner, sub_df, lk,
+                                          rk)
+            return df.join(sub_df, (lk, [col(n) for n in rk]),
+                           how="left_semi")
+        if neg:
+            raise SqlError("NOT over this subquery predicate shape is "
+                           "not supported")
+        return self._apply_general_subquery_expr(df, scope, ast)
+
+    def _apply_not_in(self, df, scope, inner: "InSubqueryA", sub_df, lk,
+                      rk):
+        """NOT IN (subquery) with SQL null semantics: any NULL in the
+        subquery result ⇒ no row qualifies; a NULL probe value only
+        qualifies when the subquery is empty (GpuBroadcastNestedLoopJoin
+        null-aware anti join in the reference)."""
+        if len(lk) > 1:
+            raise SqlError("correlated NOT IN is not supported")
+        vname = rk[0]
+        from ..plan.session import GroupedData
+        agg = GroupedData(sub_df, []).agg(
+            Alias(Agg.CountStar(), "__n"),
+            Alias(Agg.Count(col(vname)), "__nn"))
+        row = agg.collect()[0]
+        total, nonnull = row["__n"], row["__nn"]
+        if total == 0:
+            return df                     # NOT IN ∅ is TRUE
+        if nonnull < total:
+            return df.filter(P.EqualTo(lit(1), lit(0)))  # NULL ⇒ empty
+        out = df.join(sub_df, (lk, [col(n) for n in rk]),
+                      how="left_anti")
+        return out.filter(P.Not(P.IsNull(lk[0])))
+
+    def _apply_general_subquery_expr(self, df, scope: _Scope, ast):
+        """Subquery predicates nested under OR (q10/q35 shape: EXISTS
+        (...) OR EXISTS (...)) lower as existence-join markers, plus
+        correlated scalar subqueries rewritten to grouped-aggregate
+        joins; the rewritten conjunct then filters normally."""
+        out_names = [n for n, _ in df.schema]
+        repl: dict = {}
+
+        def rewrite(a):
+            nonlocal df
+            if isinstance(a, ExistsA):
+                sub_df, lk, rk = self._plan_semi_source(a.stmt, scope,
+                                                        None)
+                if not lk:
+                    nonempty = len(sub_df.limit(1).collect()) > 0
+                    return LitA(nonempty)
+                n = Analyzer._subq_n = Analyzer._subq_n + 1
+                marker = f"__exists{n}"
+                sub_m = sub_df.select(
+                    *[Alias(col(k), k) for k in rk] +
+                    [Alias(lit(True), marker)]).distinct()
+                df = df.join(sub_m, (lk, [col(k) for k in rk]),
+                             how="left_outer")
+                return _PreLowered(Cond.Coalesce(col(marker),
+                                                 lit(False)))
+            if isinstance(a, InSubqueryA):
+                if a.neg:
+                    raise SqlError("NOT IN under OR is not supported")
+                sub_df, lk, rk = self._plan_semi_source(a.stmt, scope,
+                                                        a.e)
+                n = Analyzer._subq_n = Analyzer._subq_n + 1
+                marker = f"__exists{n}"
+                sub_m = sub_df.select(
+                    *[Alias(col(k), k) for k in rk] +
+                    [Alias(lit(True), marker)]).distinct()
+                df = df.join(sub_m, (lk, [col(k) for k in rk]),
+                             how="left_outer")
+                return _PreLowered(Cond.Coalesce(col(marker),
+                                                 lit(False)))
+            if isinstance(a, ScalarSubqueryA) and \
+                    self._is_correlated(a.stmt):
+                # correlated scalar: rewrite to a grouped aggregate
+                # joined on the correlation keys; no match ⇒ NULL ⇒
+                # the comparison is UNKNOWN and the row filters out,
+                # exactly SQL semantics
+                stmt = a.stmt
+                if not isinstance(stmt, SelectA) or len(stmt.items) != 1:
+                    raise SqlError("correlated scalar subquery must "
+                                   "select one expression")
+                inner_c, pairs, outer_c = self._correlation_split(
+                    stmt, scope)
+                if outer_c or not pairs or stmt.group_by:
+                    raise SqlError("unsupported correlated scalar "
+                                   "subquery shape")
+                n = Analyzer._subq_n = Analyzer._subq_n + 1
+                s2 = SelectA()
+                s2.from_ = stmt.from_
+                s2.where = _and_all(inner_c)
+                s2.group_by = [i_ast for _, i_ast in pairs]
+                vname = f"__scv{n}"
+                knames = [f"__sck{n}_{i}" for i in range(len(pairs))]
+                s2.items = [(i_ast, kn)
+                            for (_, i_ast), kn in zip(pairs, knames)] + \
+                    [(stmt.items[0][0], vname)]
+                sub_df = self.analyze_select(s2)
+                lk = [self.lower(o_ast, scope) for o_ast, _ in pairs]
+                df = df.join(sub_df, (lk, [col(k) for k in knames]),
+                             how="left_outer")
+                return _PreLowered(col(vname))
+            if not isinstance(a, Ast):
+                return a
+            clone = a.__class__.__new__(a.__class__)
+            for k, v in vars(a).items():
+                if isinstance(v, Ast):
+                    setattr(clone, k, rewrite(v))
+                elif isinstance(v, list):
+                    setattr(clone, k, [
+                        rewrite(x) if isinstance(x, Ast) else
+                        (tuple(rewrite(y) if isinstance(y, Ast) else y
+                               for y in x) if isinstance(x, tuple) else x)
+                        for x in v])
+                else:
+                    setattr(clone, k, v)
+            return clone
+
+        new_ast = rewrite(ast)
+        cond = self.lower(new_ast, scope)
+        df = df.filter(cond)
+        # drop the helper columns the joins added
+        return df.select(*[col(n) for n in out_names])
 
     # --- SELECT/GROUP BY/HAVING/ORDER BY lowering ---
     def _finish(self, df, scope: _Scope, s: SelectA):
@@ -1054,13 +1670,59 @@ class Analyzer:
             return out
 
         # aggregate path: split aggs out of select/having/order exprs
-        keys = [self.lower(g, scope) for g in group_asts]
-        key_names = [output_name(k, i) for i, k in enumerate(keys)]
+        keys_src = [self.lower(g, scope) for g in group_asts]
+        n_keys = len(keys_src)
+        if s.group_sets is None:
+            keys = keys_src
+            key_names = [output_name(k, i) for i, k in enumerate(keys)]
+        else:
+            # GROUPING SETS / ROLLUP / CUBE: pre-expand each row once
+            # per grouping set (key slots NULLed where absent + a
+            # grouping-id), then group by (keys..., __grouping_id) so
+            # subtotal NULLs never merge with natural NULL key values —
+            # GpuExpandExec's role in the reference
+            from ..plan import logical as L
+            in_names = [n for n, _ in df.schema]
+            key_names = [f"__gk{i}" for i in range(n_keys)]
+            in_schema = df.schema
+            projections = []
+            for idxs in s.group_sets:
+                gid_val = 0
+                proj = [col(n) for n in in_names]
+                for i, ke in enumerate(keys_src):
+                    if i in idxs:
+                        proj.append(ke)
+                    else:
+                        proj.append(Literal(None,
+                                            ke.data_type(in_schema)))
+                        gid_val |= 1 << (n_keys - 1 - i)
+                proj.append(lit(gid_val))
+                projections.append(proj)
+            df = type(df)(df.session, L.Expand(
+                df.plan, projections,
+                in_names + key_names + ["__grouping_id"]))
+            keys = [col(kn) for kn in key_names] + [col("__grouping_id")]
+            key_names = list(key_names) + ["__grouping_id"]
         agg_fns: List[Tuple[Agg.AggregateFunction, str]] = []
 
         def replace(e: Expression) -> Expression:
             """Replace aggregate subtrees with refs to computed columns,
             and group-key subtrees with refs to key output columns."""
+            if isinstance(e, _GroupingMarker):
+                if s.group_sets is None:
+                    return lit(0)
+                from ..expr import bitwise as B_
+                for i, k in enumerate(keys_src):
+                    if repr(e.children[0]) == repr(k):
+                        return B_.BitwiseAnd(
+                            B_.ShiftRight(col("__grouping_id"),
+                                          lit(n_keys - 1 - i)),
+                            lit(1))
+                raise SqlError("GROUPING() argument is not a grouping "
+                               "key")
+            for k, kn in zip(keys_src, key_names):
+                if repr(e) == repr(k):
+                    return col(kn)
             for k, kn in zip(keys, key_names):
                 if repr(e) == repr(k):
                     return col(kn)
@@ -1204,6 +1866,11 @@ class Analyzer:
 
     # --- expression lowering ---
     def lower(self, ast: Ast, scope: _Scope) -> Expression:
+        if isinstance(ast, _PreLowered):
+            return ast.expr
+        if isinstance(ast, (ExistsA, InSubqueryA)):
+            raise SqlError("EXISTS / IN (SELECT ...) is only supported "
+                           "in WHERE conjuncts")
         if isinstance(ast, ColA):
             return col(scope.resolve(ast.name, ast.qualifier))
         if isinstance(ast, ScalarSubqueryA):
@@ -1374,6 +2041,10 @@ class Analyzer:
 
     def _lower_fn(self, ast: FnA, scope) -> Expression:
         name = ast.name
+        if name == "grouping":
+            if len(ast.args) != 1:
+                raise SqlError("GROUPING takes one argument")
+            return _GroupingMarker(self.lower(ast.args[0], scope))
         if name == "count":
             if ast.star or not ast.args:
                 return Agg.CountStar()
