@@ -346,18 +346,26 @@ def main():
         try:
             from spark_rapids_tpu.models.nds import (NDS_QUERIES,
                                                      register_nds)
-            nds_dir = os.path.join(os.path.dirname(data_dir), "nds_8k")
+            nds_scale = int(os.environ.get("SRT_BENCH_NDS_SCALE",
+                                           8000))
+            nds_dir = os.path.join(os.path.dirname(data_dir),
+                                   f"nds_{nds_scale}")
             nds_sess = framework_session()
-            register_nds(nds_sess, nds_dir, scale_rows=8000)
+            register_nds(nds_sess, nds_dir, scale_rows=nds_scale)
             t0 = time.perf_counter()
             done = 0
+            per_q = {}
             for qid in sorted(NDS_QUERIES):
                 if not left(f"nds {qid}", need=20):
                     break
+                tq = time.perf_counter()
                 nds_sess.sql(NDS_QUERIES[qid]).collect()
+                per_q[qid] = round(time.perf_counter() - tq, 2)
                 done += 1
             RESULT["nds_queries_run"] = done
+            RESULT["nds_scale_rows"] = nds_scale
             RESULT["nds_total_s"] = round(time.perf_counter() - t0, 2)
+            RESULT["nds_per_query_s"] = per_q
             log(f"nds power run: {done}/{len(NDS_QUERIES)} queries in "
                 f"{RESULT['nds_total_s']}s")
             emit()

@@ -253,6 +253,14 @@ AGG_MERGE_PARTITION_ROWS = conf("srt.sql.agg.mergePartitionRows") \
          "GpuAggregateExec.scala:711,792).") \
     .check(_positive).integer(1 << 22)
 
+SORT_OOC_ROWS = conf("srt.sql.sort.oocRowBudget") \
+    .doc("Sort partitions whose total rows exceed this merge their "
+         "spilled sorted runs with a bounded-memory k-way chunk merge "
+         "instead of one full-size concat+sort — device residency "
+         "stays O(budget) regardless of partition size (the "
+         "out-of-core iterator of GpuSortExec.scala:242).") \
+    .check(_positive).integer(1 << 22)
+
 SHUFFLE_COMPRESS = conf("srt.shuffle.compression.codec") \
     .doc("Codec for serialized shuffle buffers: NONE, LZ4 (native "
          "codec), or ZSTD. "

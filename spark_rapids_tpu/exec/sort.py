@@ -1,12 +1,17 @@
-"""Sort exec: in-core full sort + spillable out-of-core merge.
+"""Sort exec: in-core full sort + true out-of-core k-way chunk merge.
 
 Rebuild of GpuSortExec.scala (:86, out-of-core iterator :242) and
-SortUtils.scala. Each input batch is sorted on device; if more than one
-batch arrives the sorted runs are concatenated and re-sorted at full
-size (a single argsort chain is the XLA-friendly formulation — the
-pairwise merge tree of the reference exists to bound GPU memory, which
-here is the spill framework's job: runs wait on the spill tier until
-the final pass).
+SortUtils.scala. Each input batch is sorted on device into a run. A
+partition whose total rows fit ``srt.sql.sort.oocRowBudget`` merges
+with one concat + argsort (the XLA-friendly fast path). Bigger
+partitions run the out-of-core iterator: runs are split into spilled
+C-row chunks, and a host-driven loop repeatedly loads the chunk whose
+first row is globally smallest (device-ordered head comparison), sorts
+it against the bounded carry, and emits every row that can no longer
+be preceded by an unloaded row (rows ordered <= the minimum pending
+chunk head — the same bound logic as the reference's out-of-core merge
+pending/sorted queues). Device residency stays O(budget): one chunk +
+the carry, with runs parked in the spill tier.
 """
 
 from __future__ import annotations
@@ -14,11 +19,14 @@ from __future__ import annotations
 from typing import Iterator, List, Optional, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
 
-from ..columnar.vector import ColumnarBatch, choose_capacity
+from ..columnar import dtypes as dt
+from ..columnar.vector import (ColumnVector, ColumnarBatch,
+                               choose_capacity, live_mask)
 from ..expr.core import Expression
 from ..ops import kernels as K
-from .base import ExecContext, Schema, TpuExec
+from .base import ExecContext, Metric, Schema, TpuExec
 
 
 class SortOrder:
@@ -67,30 +75,242 @@ class SortExec(TpuExec):
 
     def _sort_partition(self, ctx: ExecContext,
                         stream) -> Iterator[ColumnarBatch]:
-        """Buffer one partition (spillable), concat, sort — the
-        out-of-core shape of GpuSortExec.scala:242 with the spill tier
-        holding the runs."""
+        """Buffer one partition (spillable) and sort it: one concat +
+        sort when it fits the in-core budget, the out-of-core chunk
+        merge (GpuSortExec.scala:242) when it does not."""
+        from ..conf import SORT_OOC_ROWS
         from ..memory.spill import SpillableBatch, SpillPriority
         runs: List[SpillableBatch] = []
         total = 0
+        max_run = 0
         try:
+            from ..memory.retry import with_retry_no_split
             for batch in stream:
                 if int(batch.num_rows) == 0:
                     continue
                 total += int(batch.num_rows)
-                runs.append(SpillableBatch(batch,
-                                           SpillPriority.ACTIVE_ON_DECK))
+                max_run = max(max_run, batch.capacity)
+                runs.append(with_retry_no_split(
+                    lambda b=batch: SpillableBatch(
+                        b, SpillPriority.ACTIVE_ON_DECK)))
             if not runs:
                 return
-            cap = choose_capacity(total)
-            batches = [sb.get() for sb in runs]
-            with ctx.semaphore:
-                merged = (batches[0] if len(batches) == 1
-                          else K.concat_batches(batches, cap))
-                yield self._jit_sort(merged)
+            budget = max(ctx.conf.get(SORT_OOC_ROWS), max_run)
+            if total <= budget:
+                cap = choose_capacity(total)
+                batches = [sb.get() for sb in runs]
+                with ctx.semaphore:
+                    merged = (batches[0] if len(batches) == 1
+                              else K.concat_batches(batches, cap))
+                    yield self._jit_sort(merged)
+                return
+            yield from self._ooc_merge(ctx, runs, budget)
         finally:
             for sb in runs:
                 sb.close()
+
+    # --- out-of-core merge ------------------------------------------------
+
+    def _head_row(self, batch: ColumnarBatch, run_idx: int
+                  ) -> ColumnarBatch:
+        """First row of a (sorted) device batch + a __run tag column,
+        in an 8-capacity batch — the merge loop's pending-head token."""
+        head = K.slice_batch(batch, 0, 1, 8)
+        tag = ColumnVector(jnp.full(8, run_idx, jnp.int32),
+                           live_mask(8, head.num_rows), dt.INT32)
+        return ColumnarBatch(head.columns + [tag],
+                             head.names + ["__run"], head.num_rows)
+
+    def _dead_head(self, like: ColumnarBatch) -> ColumnarBatch:
+        z = K.slice_batch(like, 0, 0, 8)
+        return ColumnarBatch(z.columns, z.names, jnp.int32(0))
+
+    def _ooc_merge(self, ctx: ExecContext, runs, budget: int
+                   ) -> Iterator[ColumnarBatch]:
+        """Bounded-memory k-way merge of spilled sorted runs.
+
+        Each run is sorted and split into spilled C-row chunks with
+        C = budget // (2*k); every chunk's HEAD ROW is captured at
+        split time (tiny, stays device-resident). When k is too large
+        for the bound (C would hit its floor), runs cascade: groups of
+        runs merge into longer spilled runs first, so the final pass
+        always satisfies carry <= k*C <= budget/2."""
+        from ..memory.retry import with_retry_no_split
+        from ..memory.spill import SpillableBatch, SpillPriority
+        k = len(runs)
+        floor_c = 256
+        max_k = max(2, budget // (2 * floor_c))
+        # 1. sort + split every input run
+        split: List[Tuple[List, List]] = []   # (chunk sbs, chunk heads)
+        for sb in runs:
+            with ctx.semaphore:
+                run = with_retry_no_split(
+                    lambda sb=sb: self._jit_sort(sb.get()))
+            sb.close()
+            split.append(self._split_run(ctx, run, budget,
+                                         max(min(k, max_k), 2)))
+        # 2. cascade while too many runs for the residency bound:
+        # groups merge into one longer run whose emitted pieces are
+        # re-split to C-row chunks (pieces can be up to budget-sized)
+        while len(split) > max_k:
+            group, split = split[:max_k], split[max_k:]
+            combined_chunks: List = []
+            combined_heads: List = []
+            for piece in self._merge_chunklists(ctx, group, budget):
+                parts, hlist = self._split_run(ctx, piece, budget,
+                                               max_k)
+                combined_chunks.extend(parts)
+                combined_heads.extend(hlist)
+            split.append((combined_chunks, combined_heads))
+        yield from self._merge_chunklists(ctx, split, budget)
+
+    def _split_run(self, ctx: ExecContext, run: ColumnarBatch,
+                   budget: int, k: int):
+        """Split a sorted device run into spilled C-row chunks plus
+        their (device-resident, 8-cap) head rows."""
+        from ..memory.retry import with_retry_no_split
+        from ..memory.spill import SpillableBatch, SpillPriority
+        C = max(256, budget // (2 * k))
+        chunk_cap = choose_capacity(C)
+        n = int(run.num_rows)
+        parts, part_heads = [], []
+        for start in range(0, max(n, 1), C):
+            with ctx.semaphore:
+                piece = K.slice_batch(run, start, jnp.int32(C),
+                                      chunk_cap)
+                part_heads.append(self._head_row(piece, 0))
+            parts.append(with_retry_no_split(
+                lambda p=piece: SpillableBatch(
+                    p, SpillPriority.ACTIVE_ON_DECK)))
+        return parts, part_heads
+
+    def _merge_chunklists(self, ctx: ExecContext, split, budget: int
+                          ) -> Iterator[ColumnarBatch]:
+        """Merge k chunklists ((spilled chunks, head rows) per run).
+
+        Loop invariant: every emitted row orders <= the first row of
+        every unloaded chunk, so the concatenation of emitted batches
+        is globally sorted. The carry holds rows that may still be
+        preceded by unloaded rows; per run at most one chunk of rows
+        can be parked there, so carry <= k*C <= budget/2 and device
+        residency stays O(budget)."""
+        from ..memory.retry import with_retry_no_split
+        m = ctx.metrics_for(self.exec_id)
+        peak_m = m.setdefault("sortOocPeakRows",
+                              Metric("sortOocPeakRows", Metric.DEBUG))
+        k = len(split)
+        chunks = [parts for parts, _ in split]
+        all_heads = []
+        for ri, (_, hlist) in enumerate(split):
+            # re-tag heads with this merge's run index
+            all_heads.append([
+                ColumnarBatch(h.columns[:-1] + [ColumnVector(
+                    jnp.full(8, ri, jnp.int32), h.columns[-1].validity,
+                    dt.INT32)], h.names, h.num_rows) for h in hlist])
+        next_chunk = [0] * k
+        heads: List[Optional[ColumnarBatch]] = [
+            hl[0] if hl else None for hl in all_heads]
+        schema_like = next(h for h in heads if h is not None)
+        carry: Optional[ColumnarBatch] = None
+
+        def pending() -> List[ColumnarBatch]:
+            return [h if h is not None else self._dead_head(schema_like)
+                    for h in heads]
+
+        try:
+            while True:
+                live_heads = [h for h in heads if h is not None]
+                if not live_heads:
+                    if carry is not None and int(carry.num_rows) > 0:
+                        yield carry
+                    return
+                # pick the run whose pending chunk head is smallest
+                # (device comparison — exact sort semantics)
+                with ctx.semaphore:
+                    hb = K.concat_batches(pending(), 8 * k)
+                    hs = self._jit_sort_heads(hb)
+                r = int(hs.column("__run").data[0])
+                i = next_chunk[r]
+                chunk = with_retry_no_split(chunks[r][i].get)
+                chunks[r][i].close()
+                next_chunk[r] += 1
+                heads[r] = all_heads[r][next_chunk[r]] \
+                    if next_chunk[r] < len(chunks[r]) else None
+                # merge the chunk into the carry and emit the safe
+                # prefix (rows ordered <= every pending head); pure
+                # compute over already-held batches, so RetryOOM just
+                # re-runs it after a synchronous spill
+
+                def merge_step(carry=carry, chunk=chunk):
+                    with ctx.semaphore:
+                        if carry is None:
+                            return self._jit_sort(chunk)
+                        cap = choose_capacity(
+                            int(carry.num_rows) + int(chunk.num_rows))
+                        return self._jit_sort(K.concat_batches(
+                            [carry, chunk], cap))
+                merged = with_retry_no_split(merge_step)
+                peak_m.set(max(peak_m.value, int(merged.num_rows)))
+                live_heads = [h for h in heads if h is not None]
+                if not live_heads:
+                    carry = merged
+                    continue
+                with ctx.semaphore:
+                    hb = K.concat_batches(pending(), 8 * k)
+                    hs = self._jit_sort_heads(hb)
+                    bound = K.slice_batch(hs, 0, 1, 8)
+                    n_le = self._jit_safe_prefix(merged, bound)
+                n = int(n_le)
+                if n > 0:
+                    with ctx.semaphore:
+                        out = K.slice_batch(merged, 0, jnp.int32(n),
+                                            choose_capacity(n))
+                        rest = int(merged.num_rows) - n
+                        carry = K.slice_batch(
+                            merged, jnp.int32(n),
+                            jnp.int32(max(rest, 0)),
+                            choose_capacity(max(rest, 1)))
+                    yield out
+                else:
+                    carry = merged
+        finally:
+            for parts in chunks:
+                for p in parts:
+                    p.close()
+
+    def _jit_sort_heads(self, hb: ColumnarBatch) -> ColumnarBatch:
+        if not hasattr(self, "_sort_heads_fn"):
+            def run(b):
+                key_cols = [o.expr.eval(b) for o in self.order]
+                return K.sort_batch(b, key_cols,
+                                    [o.ascending for o in self.order],
+                                    [o.nulls_first for o in self.order])
+            self._sort_heads_fn = jax.jit(run)
+        return self._sort_heads_fn(hb)
+
+    def _jit_safe_prefix(self, merged: ColumnarBatch,
+                         bound: ColumnarBatch):
+        """Count of merged rows ordering <= the bound row (they form a
+        prefix of the sorted batch; range_partition_ids shares the sort
+        comparator exactly, so 'strictly after bound' == unsafe)."""
+        if not hasattr(self, "_safe_prefix_fn"):
+            from ..parallel.partition import range_partition_ids
+
+            def run(mb, bb):
+                keys = [o.expr.eval(mb) for o in self.order]
+                bkeys = [o.expr.eval(bb) for o in self.order]
+                bkeys = [c.gather(jnp.zeros(1, jnp.int32),
+                                  live_mask(1, bb.num_rows))
+                         if hasattr(c, "chars") else
+                         type(c)(c.data[:1], c.validity[:1], c.dtype)
+                         for c in bkeys]
+                pid = range_partition_ids(
+                    keys, bkeys, [o.ascending for o in self.order],
+                    [o.nulls_first for o in self.order])
+                return jnp.sum((pid == 0) & mb.live_mask()
+                               ).astype(jnp.int32)
+            self._safe_prefix_fn = jax.jit(run)
+        return self._safe_prefix_fn(merged, bound)
 
     def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
         if not self.global_sort:

@@ -102,8 +102,12 @@ class _PooledLeaves:
             count = int(np.prod(shape)) if shape else 1
             nbytes = count * dtype.itemsize
             if nbytes:
+                # COPY out of the pooled slab: jax.device_put on the CPU
+                # backend can be zero-copy, so a view here would alias
+                # pool memory that free() hands to the NEXT spill —
+                # silent corruption of any batch still referencing it
                 arr = np.frombuffer(buf, dtype=dtype, count=count,
-                                    offset=off).reshape(shape)
+                                    offset=off).reshape(shape).copy()
             else:
                 arr = np.zeros(shape, dtype)
             leaves[i] = arr

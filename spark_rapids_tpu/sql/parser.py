@@ -2049,7 +2049,14 @@ class Analyzer:
             if ast.star or not ast.args:
                 return Agg.CountStar()
             if ast.distinct:
-                raise SqlError("COUNT(DISTINCT ...) not supported yet")
+                # COUNT(DISTINCT x) = size(collect_set(x)): collect_set
+                # drops nulls and dedups — exactly distinct-count
+                # semantics; the aggregate-split pass substitutes the
+                # inner CollectSet and Size applies post-aggregation
+                from ..expr import collections as Coll
+                return Cast(Coll.Size(
+                    Agg.CollectSet(self.lower(ast.args[0], scope))),
+                    dt.INT64)
             return Agg.Count(self.lower(ast.args[0], scope))
         if name in _AGG_FNS:
             if ast.distinct:
