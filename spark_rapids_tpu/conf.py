@@ -292,6 +292,14 @@ ADAPTIVE_BROADCAST_ROWS = conf("srt.sql.adaptive.autoBroadcastJoinRows") \
          "srt.sql.broadcastRowThreshold.") \
     .integer(0)
 
+ADAPTIVE_SKEW_ROWS = conf("srt.sql.adaptive.skewJoin.partitionRows") \
+    .doc("A reduce partition whose PROBE side exceeds this many rows "
+         "in a shuffled join splits into map-slices joined separately "
+         "against the full build partition (spark.sql.adaptive."
+         "skewJoin.skewedPartitionThreshold; the "
+         "GpuCustomShuffleReaderExec skewed-partition-spec role).") \
+    .check(_positive).integer(1 << 20)
+
 SESSION_TIMEZONE = conf("srt.sql.session.timeZone") \
     .doc("Session timezone id used by timezone-aware SQL functions "
          "(spark.sql.session.timeZone). Conversions run on device "

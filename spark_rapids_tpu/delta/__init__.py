@@ -15,7 +15,9 @@ instead of a plugin into someone else's. Same architecture:
   atomically.
 """
 
-from .log import CommitConflict, TransactionLog
+from .log import (CommitConflict, MetadataChangedConflict,
+                  TransactionLog)
 from .table import AcidTable
 
-__all__ = ["AcidTable", "TransactionLog", "CommitConflict"]
+__all__ = ["AcidTable", "TransactionLog", "CommitConflict",
+           "MetadataChangedConflict"]

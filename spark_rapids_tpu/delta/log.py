@@ -26,6 +26,11 @@ class CommitConflict(RuntimeError):
     """Another writer committed the version this transaction targeted."""
 
 
+class MetadataChangedConflict(CommitConflict):
+    """A concurrent transaction changed the table metadata/schema —
+    not retryable (Delta's MetadataChangedException role)."""
+
+
 class TransactionLog:
     def __init__(self, table_path: str):
         self.table_path = table_path

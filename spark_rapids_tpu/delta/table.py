@@ -24,7 +24,7 @@ from ..plan import logical as L
 from ..plan.host_table import HostTable, concat_tables, empty_like
 from ..io.scan import FileScan
 from ..io.writer import write_host_table
-from .log import CommitConflict, TransactionLog
+from .log import CommitConflict, MetadataChangedConflict, TransactionLog
 
 
 def _schema_to_json(schema) -> str:
@@ -105,15 +105,44 @@ class AcidTable:
         return [{"add": {"path": fname, "numRecords": table.num_rows,
                          "dataChange": True}}]
 
+    def _winner_actions(self, read_v: int) -> List[dict]:
+        """All actions committed by OTHER writers after our snapshot."""
+        out: List[dict] = []
+        for v in self.log.versions():
+            if v > read_v:
+                out.extend(self.log.read_actions(v))
+        return out
+
+    def _check_conflict(self, read_v: int, operation: str) -> None:
+        """The optimistic-concurrency conflict matrix
+        (GpuOptimisticTransaction / Delta's ConflictChecker):
+
+        - winner changed METADATA (schema evolution) -> abort: our
+          actions were computed against the old schema
+          (MetadataChangedException role),
+        - winner only APPENDED -> safe to recompute/replay (appends
+          never invalidate a read file set),
+        - winner REMOVED files -> a rewrite recomputes from the new
+          head (the retry loop re-reads), which preserves
+          serializability because build_actions is a pure function of
+          the current snapshot."""
+        for a in self._winner_actions(read_v):
+            if "metaData" in a:
+                raise MetadataChangedConflict(
+                    f"{operation}: a concurrent transaction changed "
+                    "the table schema; re-run against the new schema")
+
     def _commit_blind(self, actions: List[dict], operation: str,
                       retries: int = 3) -> int:
         """Snapshot-independent commits (append): retrying the same
-        actions against a newer head is safe."""
+        actions against a newer head is safe — unless the schema
+        changed underneath."""
         for attempt in range(retries + 1):
             read_v = self.log.latest_version()
             try:
                 return self.log.commit(read_v, actions, operation)
             except CommitConflict:
+                self._check_conflict(read_v, operation)
                 if attempt == retries:
                     raise
         raise AssertionError("unreachable")
@@ -130,6 +159,7 @@ class AcidTable:
             try:
                 return self.log.commit(read_v, actions, operation)
             except CommitConflict:
+                self._check_conflict(read_v, operation)
                 if attempt == retries:
                     raise
         raise AssertionError("unreachable")
@@ -186,7 +216,8 @@ class AcidTable:
     def merge(self, source, on: Sequence[str],
               when_matched_update: Optional[Dict[str, Expression]] = None,
               when_matched_delete: bool = False,
-              when_not_matched_insert: bool = True) -> int:
+              when_not_matched_insert: bool = True,
+              schema_evolution: bool = False) -> int:
         """MERGE INTO target USING source ON target.k = source.k
         (GpuMergeIntoCommand shape):
 
@@ -195,7 +226,13 @@ class AcidTable:
           columns prefixed 'src_'}),
         - matched + delete: matched target rows drop,
         - not matched + insert: source rows absent from the target
-          insert (columns matched by name).
+          insert (columns matched by name),
+        - ``schema_evolution``: source columns missing from the target
+          APPEND to the schema (delta.schema.autoMerge role,
+          MergeIntoCommandMeta's canMergeSchema path); existing rows
+          read NULL for the new columns and the commit carries the
+          metaData update — which is exactly what aborts concurrent
+          writers through the conflict matrix.
         """
         if when_matched_update and when_matched_delete:
             raise ValueError("update and delete are mutually exclusive")
@@ -215,6 +252,25 @@ class AcidTable:
         def build(read_v: int) -> List[dict]:
             target_df = self.to_df(version=read_v)
             schema = self.schema(read_v)
+            meta_actions: List[dict] = []
+            if schema_evolution:
+                known = {n for n, _ in schema}
+                new_cols = [(n, t) for n, t in source.schema
+                            if n not in known]
+                if new_cols:
+                    schema = list(schema) + new_cols
+                    meta_actions.append({"metaData": {
+                        "schemaString": _schema_to_json(schema),
+                        "partitionColumns": [],
+                    }})
+            else:
+                extra = [n for n in source.columns
+                         if n not in {s for s, _ in schema}]
+                if extra:
+                    raise ValueError(
+                        f"MERGE source columns {extra} not in the "
+                        "target schema (pass schema_evolution=True)")
+            target_names = {n for n, _ in self.schema(read_v)}
             if when_matched_delete:
                 matched_part = None  # matched rows vanish
             elif when_matched_update:
@@ -222,7 +278,9 @@ class AcidTable:
                                 "inner")
                 projected = []
                 for name, t in schema:
-                    e = when_matched_update.get(name, col(name))
+                    default = col(name) if name in target_names \
+                        else col(f"src_{name}")  # evolved col: source
+                    e = when_matched_update.get(name, default)
                     if e.data_type(joined.schema) != t:
                         e = e.cast(t)
                     projected.append(Alias(e, name))
@@ -230,9 +288,20 @@ class AcidTable:
             else:
                 matched_part = None
 
-            # target rows with no source match survive unchanged
-            unmatched_target = L.Join(target_df.plan, src_renamed.plan,
-                                      lk, rk, "left_anti")
+            if matched_part is None and not when_matched_delete:
+                # no matched clause: EVERY target row survives
+                # unchanged (insert-only merge)
+                unmatched_target = target_df.plan
+            else:
+                # target rows with no source match survive unchanged
+                unmatched_target = L.Join(target_df.plan,
+                                          src_renamed.plan,
+                                          lk, rk, "left_anti")
+            if len(schema) > len(self.schema(read_v)):
+                # evolved columns read NULL on surviving rows
+                unmatched_target = L.Project(unmatched_target, [
+                    col(n) if n in target_names else
+                    Alias(lit(None, t), n) for n, t in schema])
             parts = [unmatched_target]
             if matched_part is not None:
                 parts.append(matched_part)
@@ -252,7 +321,7 @@ class AcidTable:
                 parts.append(L.Project(unmatched_src, insert_cols))
             plan = parts[0] if len(parts) == 1 else L.Union(*parts)
             table = self.session.execute(plan)
-            return self._remove_all_current(read_v) + \
+            return meta_actions + self._remove_all_current(read_v) + \
                 self._write_files(table)
         return self._commit_rewrite(build, "MERGE")
 

@@ -217,6 +217,7 @@ class HashAggregateExec(TpuExec):
         hash (disjoint key buckets merge independently — the
         reference's re-partition fallback, GpuAggregateExec.scala:711)."""
         from ..conf import AGG_MERGE_PARTITION_ROWS
+        from ..memory.retry import with_retry_no_split
         from ..memory.spill import SpillableBatch, SpillPriority
         held: List = []
         total = 0
@@ -225,7 +226,9 @@ class HashAggregateExec(TpuExec):
                 if int(p.num_rows) == 0:
                     continue
                 total += int(p.num_rows)
-                held.append(SpillableBatch(p, SpillPriority.ACTIVE_ON_DECK))
+                held.append(with_retry_no_split(
+                    lambda b=p: SpillableBatch(
+                        b, SpillPriority.ACTIVE_ON_DECK)))
             if not held:
                 if not self.group_exprs:
                     yield self._empty_global_result()
@@ -236,11 +239,17 @@ class HashAggregateExec(TpuExec):
                                                    threshold, agg_time)
                 return
             cap = choose_capacity(max(total, 1))
-            batches = [sb.get() for sb in held]
-            with ctx.semaphore, NvtxTimer(agg_time, "agg.merge"):
-                merged_in = (batches[0] if len(batches) == 1
-                             else K.concat_batches(batches, cap))
-                yield self._jit_merge(merged_in)
+
+            def merge_all():
+                batches = [sb.get() for sb in held]
+                with ctx.semaphore, NvtxTimer(agg_time, "agg.merge"):
+                    merged_in = (batches[0] if len(batches) == 1
+                                 else K.concat_batches(batches, cap))
+                    return self._jit_merge(merged_in)
+            # RetryOOM mid-merge: spill + re-run (the merge is a pure
+            # function of the held spillables — RmmRapidsRetryIterator
+            # withRetryNoSplit contract)
+            yield with_retry_no_split(merge_all)
         finally:
             for sb in held:
                 sb.close()
@@ -297,18 +306,26 @@ class HashAggregateExec(TpuExec):
                     if n:
                         sub = self._repack(ctx, sub)
                         bucket_rows[p] += n
-                        buckets[p].append(SpillableBatch(
-                            sub, SpillPriority.ACTIVE_ON_DECK))
+                        from ..memory.retry import with_retry_no_split
+                        buckets[p].append(with_retry_no_split(
+                            lambda b=sub: SpillableBatch(
+                                b, SpillPriority.ACTIVE_ON_DECK)))
                 sb.close()
             for p in range(P):
                 if not buckets[p]:
                     continue
                 cap = choose_capacity(bucket_rows[p])
-                batches = [b.get() for b in buckets[p]]
-                with ctx.semaphore, NvtxTimer(agg_time, "agg.merge"):
-                    merged_in = (batches[0] if len(batches) == 1
-                                 else K.concat_batches(batches, cap))
-                    yield self._jit_merge(merged_in)
+
+                def merge_bucket(p=p, cap=cap):
+                    batches = [b.get() for b in buckets[p]]
+                    with ctx.semaphore, NvtxTimer(agg_time,
+                                                  "agg.merge"):
+                        merged_in = (batches[0] if len(batches) == 1
+                                     else K.concat_batches(batches,
+                                                           cap))
+                        return self._jit_merge(merged_in)
+                from ..memory.retry import with_retry_no_split
+                yield with_retry_no_split(merge_bucket)
                 for b in buckets[p]:
                     b.close()
                 buckets[p] = []
@@ -325,9 +342,11 @@ class HashAggregateExec(TpuExec):
         from .exchange import ShuffleExchangeExec
         child = self.children[0]
         if ctx.conf.get(ADAPTIVE_ENABLED) and \
-                ctx.cluster is None and \
                 not self.preserve_partitioning and \
                 isinstance(child, ShuffleExchangeExec):
+            # cluster-safe: counts are gathered GLOBAL statistics, so
+            # every worker computes the same groups and streams its own
+            # contiguous block of them
             counts = child.materialized_row_counts(ctx)
             groups = child.coalesce_groups(
                 counts, ctx.conf.get(ADAPTIVE_MIN_PARTITION_ROWS))

@@ -108,6 +108,7 @@ class ShuffleExchangeExec(TpuExec):
         # old id (and may already be cleaned up)
         self.shuffle_id = next_shuffle_id()
         self._written = False
+        self._global_counts = None
 
     @property
     def output_schema(self) -> Schema:
@@ -277,43 +278,63 @@ class ShuffleExchangeExec(TpuExec):
             from ..memory.spill import SpillableBatch, SpillPriority
             held = []
             try:
+                from ..memory.retry import with_retry_no_split
                 for batch in self.children[0].execute(ctx):
                     if int(batch.num_rows) == 0:
                         continue
-                    held.append(SpillableBatch(
-                        K.compact_for_transfer(batch),
-                        SpillPriority.ACTIVE_ON_DECK))
-                batches = [sb.get() for sb in held]
+                    held.append(with_retry_no_split(
+                        lambda b=batch: SpillableBatch(
+                            K.compact_for_transfer(b),
+                            SpillPriority.ACTIVE_ON_DECK)))
+                batches = with_retry_no_split(
+                    lambda: [sb.get() for sb in held])
                 bounds, n_bounds = self._compute_bounds(ctx, batches,
                                                         n_parts)
                 fn = self._partition_fn(n_parts, bounds=True)
                 for batch in batches:
                     t0 = time.perf_counter_ns()
-                    with ctx.semaphore:
-                        # per-slice compaction: each slice carries the
-                        # full input capacity (static worst-case skew
-                        # bound) but typically holds ~1/P of the rows
-                        parts = [K.compact_for_transfer(p)
-                                 for p in fn(batch, bounds)]
+
+                    def write_one(batch=batch, map_id=map_id,
+                                  bounds=bounds):
+                        # replay-safe: block writes overwrite by
+                        # (shuffle, map, reduce)
+                        with ctx.semaphore:
+                            # per-slice compaction: each slice carries
+                            # the full input capacity (static
+                            # worst-case skew bound) but typically
+                            # holds ~1/P of the rows
+                            parts = [K.compact_for_transfer(p)
+                                     for p in fn(batch, bounds)]
+                        mgr.write_map_output(self.shuffle_id, map_id,
+                                             parts)
+                    with_retry_no_split(write_one)
                     part_time.add(time.perf_counter_ns() - t0)
                     write_rows.add(int(batch.num_rows))
-                    mgr.write_map_output(self.shuffle_id, map_id, parts)
                     map_id += 1
             finally:
                 for sb in held:
                     sb.close()
             return
+        from ..memory.retry import with_retry_no_split
         for batch in self.children[0].execute(ctx):
             if int(batch.num_rows) == 0:
                 continue
             t0 = time.perf_counter_ns()
-            with ctx.semaphore:
-                batch = K.compact_for_transfer(batch)
-                fn = self._partition_fn(n_parts)
-                parts = [K.compact_for_transfer(p) for p in fn(batch)]
+
+            def write_one(batch=batch, map_id=map_id):
+                # partition + block write re-runs cleanly on RetryOOM:
+                # blocks are keyed (shuffle, map, reduce) so a replay
+                # overwrites, never duplicates
+                with ctx.semaphore:
+                    b = K.compact_for_transfer(batch)
+                    fn = self._partition_fn(n_parts)
+                    parts = [K.compact_for_transfer(p)
+                             for p in fn(b)]
+                mgr.write_map_output(self.shuffle_id, map_id, parts)
+                return int(b.num_rows)
+            rows_written = with_retry_no_split(write_one)
             part_time.add(time.perf_counter_ns() - t0)
-            write_rows.add(int(batch.num_rows))
-            mgr.write_map_output(self.shuffle_id, map_id, parts)
+            write_rows.add(rows_written)
             map_id += 1
 
     # kept for existing callers/tests
@@ -329,10 +350,27 @@ class ShuffleExchangeExec(TpuExec):
     # --- AQE surface (GpuCustomShuffleReaderExec analogue) ---
     def materialized_row_counts(self, ctx: ExecContext) -> List[int]:
         """Write the map side (idempotent) and return rows per reduce
-        partition — the MapOutputStatistics AQE decisions read."""
+        partition — the MapOutputStatistics AQE decisions read.
+
+        Cluster mode: local counts all-gather through the driver and
+        sum, so every worker computes IDENTICAL global statistics (the
+        fix for round-2's divergent-coalescing bug — decisions must be
+        a pure function of global state, never of local map outputs).
+        The gather itself is a barrier: by the time it returns, every
+        worker's map side is written."""
         mgr = self.manager or shuffle_manager()
         self._write(ctx)
-        return mgr.partition_row_counts(self.shuffle_id)
+        counts = mgr.partition_row_counts(self.shuffle_id)
+        if ctx.cluster is not None:
+            cached = getattr(self, "_global_counts", None)
+            if cached is not None:
+                return cached
+            all_counts = ctx.cluster.gather(
+                ("aqe_counts", self.shuffle_id), counts)
+            counts = [sum(c[i] for c in all_counts)
+                      for i in range(len(counts))]
+            self._global_counts = counts
+        return counts
 
     @staticmethod
     def coalesce_groups(counts: List[int], min_rows: int) -> List[List[int]]:
@@ -355,10 +393,19 @@ class ShuffleExchangeExec(TpuExec):
         return groups
 
     def execute_partition_groups(self, ctx: ExecContext,
-                                 groups: List[List[int]]):
+                                 groups: List[List[int]],
+                                 map_mod: Optional[dict] = None):
         """One iterator per partition GROUP (a disjoint union of hash
         partitions keeps keys clustered, so group-wise consumers stay
-        correct)."""
+        correct). ``map_mod``: {group_index: (s, S)} restricts that
+        group's reads to map outputs with map_id % S == s — the skew
+        split primitive (GpuCustomShuffleReaderExec's skewed partition
+        specs slice a reduce partition by map ranges the same way).
+
+        Cluster mode: ``groups`` must be identical on every worker (a
+        pure function of the gathered global stats); this worker then
+        streams only its contiguous block of GROUPS, fetching each
+        partition from all peers."""
         mgr = self.manager or shuffle_manager()
         self._write(ctx)
         m = ctx.metrics_for(self.exec_id)
@@ -366,14 +413,30 @@ class ShuffleExchangeExec(TpuExec):
                      Metric("adaptiveCoalescedPartitions",
                             Metric.MODERATE)).add(
             max(mgr.num_partitions(self.shuffle_id) - len(groups), 0))
+        if ctx.cluster is not None:
+            from ..parallel.transport import fetch_all_partitions
+            ctx.cluster.barrier(self.shuffle_id)
+            peers = ctx.cluster.peers
 
-        def read_group(g):
+            def remote_group(gi, g):
+                mm = (map_mod or {}).get(gi)
+                for reduce_id in g:
+                    ctx.partition_id = reduce_id
+                    yield from fetch_all_partitions(
+                        peers, self.shuffle_id, reduce_id, map_mod=mm)
+            for gi in ctx.cluster.assigned(len(groups)):
+                yield remote_group(gi, groups[gi])
+            return
+
+        def read_group(gi, g):
+            mm = (map_mod or {}).get(gi)
             for reduce_id in g:
                 ctx.partition_id = reduce_id
-                yield from mgr.read_partition(self.shuffle_id, reduce_id)
+                yield from mgr.read_partition(self.shuffle_id,
+                                              reduce_id, map_mod=mm)
         try:
-            for g in groups:
-                yield read_group(g)
+            for gi, g in enumerate(groups):
+                yield read_group(gi, g)
         finally:
             mgr.unregister_shuffle(self.shuffle_id)
 

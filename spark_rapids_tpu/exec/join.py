@@ -269,8 +269,10 @@ class _HashJoinBase(TpuExec):
                 sub_builds.append(None)
                 continue
             sub = self._repack(ctx, sub)
-            sub_builds.append(SpillableBatch(sub,
-                                             SpillPriority.ACTIVE_ON_DECK))
+            from ..memory.retry import with_retry_no_split
+            sub_builds.append(with_retry_no_split(
+                lambda s=sub: SpillableBatch(
+                    s, SpillPriority.ACTIVE_ON_DECK)))
         del build, sub
 
         # bucket the whole probe stream first, so each sub-build is
@@ -287,8 +289,10 @@ class _HashJoinBase(TpuExec):
                     if int(sub.num_rows) == 0:
                         continue
                     sub = self._repack(ctx, sub)
-                    probe_buckets[p].append(SpillableBatch(
-                        sub, SpillPriority.ACTIVE_ON_DECK))
+                    from ..memory.retry import with_retry_no_split
+                    probe_buckets[p].append(with_retry_no_split(
+                        lambda s=sub: SpillableBatch(
+                            s, SpillPriority.ACTIVE_ON_DECK)))
             for p in range(P):
                 if not probe_buckets[p]:
                     continue
@@ -300,7 +304,8 @@ class _HashJoinBase(TpuExec):
                         psb.close()
                     probe_buckets[p] = []
                     continue
-                bucket_build = sb.get()
+                from ..memory.retry import with_retry_no_split
+                bucket_build = with_retry_no_split(sb.get)
                 n_build = int(bucket_build.num_rows)
                 if n_build > threshold:
                     skew_m.add(1)
@@ -522,12 +527,8 @@ class ShuffledHashJoinExec(_HashJoinBase):
         from ..conf import (ADAPTIVE_BROADCAST_ROWS, ADAPTIVE_ENABLED,
                             BROADCAST_THRESHOLD_ROWS)
         from .exchange import ShuffleExchangeExec
-        # cluster mode: materialized_row_counts and execute_partitioned
-        # here see only THIS worker's assigned reduce partitions; a
-        # local downgrade decision would drop other workers' build rows
-        # (mirrors HashAggregateExec._child_partitions gating)
         if not ctx.conf.get(ADAPTIVE_ENABLED) or \
-                self.preserve_partitioning or ctx.cluster is not None:
+                self.preserve_partitioning:
             return None
         build_child = self.children[1] if self.build_side == "right" \
             else self.children[0]
@@ -547,10 +548,23 @@ class ShuffledHashJoinExec(_HashJoinBase):
                             Metric.MODERATE)).add(1)
 
         def build_stream():
+            if ctx.cluster is not None:
+                # broadcast semantics: EVERY worker needs the FULL
+                # build side — fetch all reduce partitions from all
+                # peers (materialized_row_counts' gather already
+                # synchronized the map writes)
+                from ..parallel.transport import fetch_all_partitions
+                peers = ctx.cluster.peers
+                for reduce_id in range(len(counts)):
+                    yield from fetch_all_partitions(
+                        peers, build_child.shuffle_id, reduce_id)
+                return
             for part in build_child.execute_partitioned(ctx):
                 yield from part
         # the probe exchange's CHILD streams directly: its shuffle work
-        # is skipped (never registered, nothing to unregister)
+        # is skipped (never registered, nothing to unregister); in
+        # cluster mode that child is this worker's scan shard, which is
+        # exactly the broadcast-join probe distribution
         return probe_child.children[0].execute(ctx), build_stream()
 
     def _zipped_partitions(self, ctx: ExecContext):
@@ -561,27 +575,66 @@ class ShuffledHashJoinExec(_HashJoinBase):
         and both children exchanges, small reduce partitions coalesce
         with ONE grouping applied to both sides (keys stay aligned)."""
         import itertools
-        from ..conf import ADAPTIVE_ENABLED, ADAPTIVE_MIN_PARTITION_ROWS
+        from ..conf import (ADAPTIVE_ENABLED,
+                            ADAPTIVE_MIN_PARTITION_ROWS,
+                            ADAPTIVE_SKEW_ROWS)
         from .exchange import ShuffleExchangeExec
         l, r = self.children[0], self.children[1]
         if ctx.conf.get(ADAPTIVE_ENABLED) and \
-                ctx.cluster is None and \
                 not self.preserve_partitioning and \
                 isinstance(l, ShuffleExchangeExec) and \
                 isinstance(r, ShuffleExchangeExec):
+            # cluster-safe: materialized_row_counts gathers GLOBAL
+            # stats, so every worker derives identical groups/slices
             lc = l.materialized_row_counts(ctx)
             rc = r.materialized_row_counts(ctx)
             if len(lc) == len(rc):
+                probe_is_left = self.build_side == "right"
+                probe_counts = lc if probe_is_left else rc
                 combined = [a + b for a, b in zip(lc, rc)]
                 groups = ShuffleExchangeExec.coalesce_groups(
                     combined, ctx.conf.get(ADAPTIVE_MIN_PARTITION_ROWS))
-                if len(groups) < len(combined):
-                    left_parts = l.execute_partition_groups(ctx, groups)
-                    right_parts = r.execute_partition_groups(ctx, groups)
-                    for lp, rp in itertools.zip_longest(left_parts,
-                                                        right_parts):
-                        yield ((lp, rp) if self.build_side == "right"
-                               else (rp, lp))
+                skew_rows = ctx.conf.get(ADAPTIVE_SKEW_ROWS)
+                # skew split: a group that is ONE oversized partition
+                # splits the PROBE side into map slices, each joined
+                # against the full build partition. Only valid when
+                # the join never emits unmatched BUILD rows (slices
+                # would emit them once each).
+                can_split = self.join_type in (
+                    "inner", "left_outer", "left_semi", "left_anti") \
+                    if probe_is_left else self.join_type == "inner"
+                out_groups: list = []
+                probe_mod: dict = {}
+                build_groups: list = []
+                n_skewed = 0
+                for g in groups:
+                    pc = sum(probe_counts[i] for i in g)
+                    if can_split and len(g) == 1 and pc > skew_rows:
+                        S = min(-(-pc // skew_rows), 16)
+                        n_skewed += 1
+                        for s in range(S):
+                            probe_mod[len(out_groups)] = (s, S)
+                            out_groups.append(g)
+                            build_groups.append(g)
+                    else:
+                        out_groups.append(g)
+                        build_groups.append(g)
+                if len(out_groups) != len(combined) or probe_mod:
+                    if n_skewed:
+                        m = ctx.metrics_for(self.exec_id)
+                        m.setdefault(
+                            "skewedJoinPartitions",
+                            Metric("skewedJoinPartitions",
+                                   Metric.MODERATE)).add(n_skewed)
+                    probe_x, build_x = (l, r) if probe_is_left \
+                        else (r, l)
+                    probe_parts = probe_x.execute_partition_groups(
+                        ctx, out_groups, map_mod=probe_mod)
+                    build_parts = build_x.execute_partition_groups(
+                        ctx, build_groups)
+                    for pp, bp in itertools.zip_longest(probe_parts,
+                                                        build_parts):
+                        yield (pp, bp)
                     return
         left_parts = l.execute_partitioned(ctx)
         right_parts = r.execute_partitioned(ctx)

@@ -498,6 +498,7 @@ class WindowExec(TpuExec):
 
     def _window_partition(self, ctx: ExecContext,
                           stream) -> Iterator[ColumnarBatch]:
+        from ..memory.retry import with_retry_no_split
         from ..memory.spill import SpillableBatch, SpillPriority
         runs: List[SpillableBatch] = []
         total = 0
@@ -506,15 +507,21 @@ class WindowExec(TpuExec):
                 if int(b.num_rows) == 0:
                     continue
                 total += int(b.num_rows)
-                runs.append(SpillableBatch(b, SpillPriority.ACTIVE_ON_DECK))
+                runs.append(with_retry_no_split(
+                    lambda x=b: SpillableBatch(
+                        x, SpillPriority.ACTIVE_ON_DECK)))
             if not runs:
                 return
-            batches = [sb.get() for sb in runs]
             cap = choose_capacity(total)
-            with ctx.semaphore:
-                merged = (batches[0] if len(batches) == 1
-                          else K.concat_batches(batches, cap))
-                yield self._jit(merged)
+
+            def compute():
+                batches = [sb.get() for sb in runs]
+                with ctx.semaphore:
+                    merged = (batches[0] if len(batches) == 1
+                              else K.concat_batches(batches, cap))
+                    return self._jit(merged)
+            # RetryOOM: spill + re-run (pure over the held spillables)
+            yield with_retry_no_split(compute)
         finally:
             for sb in runs:
                 sb.close()

@@ -21,6 +21,7 @@ from __future__ import annotations
 from typing import List, Optional, Sequence
 
 import jax.numpy as jnp
+import numpy as np
 
 from ..columnar import dtypes as dt
 from ..columnar.nested import ListColumn, StructColumn
@@ -580,8 +581,13 @@ class _CpuOnlyCollection(Expression):
             "(planner tag)")
 
 
-class Flatten(_CpuOnlyCollection):
-    """flatten(array<array<T>>) -> array<T> (GpuFlattenArray)."""
+class Flatten(Expression):
+    """flatten(array<array<T>>) -> array<T> (GpuFlattenArray).
+
+    Device lane: the compact list-of-list layout makes this (almost) an
+    offsets relabel — row i's flat length is the inner-offsets span of
+    its outer extent; the child repacks with one ranges-gather. A NULL
+    inner array nulls the whole result row (Spark semantics)."""
 
     def __init__(self, child: Expression):
         super().__init__(child)
@@ -593,9 +599,44 @@ class Flatten(_CpuOnlyCollection):
             raise TypeError(f"flatten of {t}")
         return t.element_type
 
+    def eval(self, batch: ColumnarBatch) -> ListColumn:
+        outer = self.children[0].eval(batch)
+        inner: ListColumn = outer.child
+        cap = outer.capacity
+        live = batch.live_mask()
+        # any NULL inner array in the extent => NULL output row
+        bad_pref = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32),
+             jnp.cumsum((~inner.validity).astype(jnp.int32))])
+        o0 = outer.offsets[:-1]
+        o1 = outer.offsets[1:]
+        any_null = (jnp.take(bad_pref, o1) - jnp.take(bad_pref, o0)) > 0
+        validity = outer.validity & live & ~any_null
+        starts = jnp.take(inner.offsets, o0)
+        lens = jnp.where(validity,
+                         jnp.take(inner.offsets, o1) - starts, 0)
+        offsets = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32), jnp.cumsum(lens, dtype=jnp.int32)])
+        child_cap = inner.child_capacity
+        from ..columnar.vector import rows_from_offsets
+        pos = jnp.arange(child_cap, dtype=jnp.int32)
+        row_c = rows_from_offsets(offsets[:-1], lens, child_cap)
+        within = pos - jnp.take(offsets, row_c)
+        src = jnp.take(starts, row_c) + within
+        elem_ok = pos < offsets[cap]
+        child = inner.child.gather(
+            jnp.clip(src, 0, child_cap - 1), elem_ok)
+        return ListColumn(offsets, child, validity,
+                          self.data_type(batch.schema()).element_type,
+                          outer.pad_bucket * inner.pad_bucket)
 
-class ArraysZip(_CpuOnlyCollection):
-    """arrays_zip(a, b, ...) -> array<struct> (GpuArraysZip)."""
+
+class ArraysZip(Expression):
+    """arrays_zip(a, b, ...) -> array<struct> (GpuArraysZip).
+
+    Device lane: output length per row is the MAX input length; field j
+    of element (row, pos) gathers input j's element when pos is in
+    range, else null — one flat-position pass per field."""
 
     def __init__(self, *children: Expression):
         super().__init__(*children)
@@ -609,10 +650,50 @@ class ArraysZip(_CpuOnlyCollection):
             fields.append((str(i), t.element_type))
         return dt.ArrayType(dt.StructType(tuple(fields)))
 
+    def eval(self, batch: ColumnarBatch) -> ListColumn:
+        from ..columnar.nested import StructColumn
+        from ..columnar.vector import round_pow2, rows_from_offsets
+        lists = [c.eval(batch) for c in self.children]
+        cap = batch.capacity
+        live = batch.live_mask()
+        validity = live
+        for lc in lists:
+            validity = validity & lc.validity  # Spark: any null -> null
+        lens = jnp.zeros(cap, jnp.int32)
+        for lc in lists:
+            lens = jnp.maximum(lens, lc.lengths())
+        lens = jnp.where(validity, lens, 0)
+        offsets = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32), jnp.cumsum(lens, dtype=jnp.int32)])
+        # sum(max(len_i)) <= sum_i(total elements of input i): the sum
+        # of child capacities is a hard bound on the zipped total
+        child_cap = round_pow2(
+            max(sum(lc.child_capacity for lc in lists), 8))
+        pos = jnp.arange(child_cap, dtype=jnp.int32)
+        row_c = rows_from_offsets(offsets[:-1], lens, child_cap)
+        within = pos - jnp.take(offsets, row_c)
+        elem_ok = pos < offsets[cap]
+        fields = []
+        ftypes = []
+        for lc in lists:
+            in_range = elem_ok & (within < jnp.take(lc.lengths(), row_c))
+            src = jnp.take(lc.offsets[:-1], row_c) + within
+            fields.append(lc.child.gather(
+                jnp.clip(src, 0, lc.child_capacity - 1), in_range))
+            ftypes.append(lc.dtype.element_type)
+        st = dt.StructType(tuple((str(i), t)
+                                 for i, t in enumerate(ftypes)))
+        child = StructColumn(fields, elem_ok, st)
+        return ListColumn(offsets, child, validity, st,
+                          max(lc.pad_bucket for lc in lists))
 
-class ArrayJoin(_CpuOnlyCollection):
+
+class ArrayJoin(Expression):
     """array_join(array<string>, sep[, null_replacement])
-    (GpuArrayJoin)."""
+    (GpuArrayJoin). Device lane: per-element effective byte extents
+    (element bytes + separator except after the last kept element;
+    null elements replaced or skipped per Spark), then one
+    byte-position pass assembles the output chars."""
 
     def __init__(self, child: Expression, sep: str,
                  null_replacement: Optional[str] = None):
@@ -627,10 +708,95 @@ class ArrayJoin(_CpuOnlyCollection):
             raise TypeError(f"array_join of {t}")
         return dt.STRING
 
+    def eval(self, batch: ColumnarBatch):
+        from ..columnar.vector import StringColumn, rows_from_offsets
+        lc = self.children[0].eval(batch)
+        sc: StringColumn = lc.child
+        cap = lc.capacity
+        ccap = lc.child_capacity
+        live = batch.live_mask()
+        validity = lc.validity & live
+        sep = jnp.asarray(
+            np.frombuffer(self.sep.encode(), np.uint8).copy())
+        sep_len = sep.shape[0]
+        repl = None
+        if self.null_replacement is not None:
+            repl = jnp.asarray(np.frombuffer(
+                self.null_replacement.encode(), np.uint8).copy())
+        # per ELEMENT: kept? effective byte length?
+        epos = jnp.arange(ccap, dtype=jnp.int32)
+        erow = rows_from_offsets(lc.offsets[:-1], lc.lengths(), ccap)
+        e_in = (epos < lc.offsets[cap]) & jnp.take(validity, erow)
+        e_valid = sc.validity & e_in
+        if repl is None:
+            kept = e_valid
+            body_len = jnp.where(kept, sc.lengths(), 0)
+        else:
+            kept = e_in
+            body_len = jnp.where(e_valid, sc.lengths(),
+                                 jnp.int32(repl.shape[0]))
+            body_len = jnp.where(kept, body_len, 0)
+        # rank of kept element within its row + kept count per row
+        kept_i = kept.astype(jnp.int32)
+        kpref = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32), jnp.cumsum(kept_i)])
+        row_base = jnp.take(kpref, jnp.take(lc.offsets[:-1], erow))
+        krank = jnp.take(kpref, epos) - row_base     # rank among kept
+        kcnt = (jnp.take(kpref, jnp.take(lc.offsets[1:], erow)) -
+                row_base)
+        is_last = kept & (krank == kcnt - 1)
+        ext_len = jnp.where(kept, body_len +
+                            jnp.where(is_last, 0, sep_len), 0)
+        e_offsets = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32),
+             jnp.cumsum(ext_len, dtype=jnp.int32)])
+        out_lens_pref = jnp.take(e_offsets, lc.offsets)
+        out_lens = out_lens_pref[1:] - out_lens_pref[:-1]
+        out_lens = jnp.where(validity, out_lens, 0)
+        out_offsets = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32),
+             jnp.cumsum(out_lens, dtype=jnp.int32)])
+        # assemble: one pass over output byte positions
+        from ..columnar.vector import round_pow2
+        nbytes = round_pow2(max(int(sc.char_capacity) +
+                                (sep_len + (repl.shape[0] if repl is
+                                            not None else 0)) *
+                                max(ccap, 1), 128))
+        bpos = jnp.arange(nbytes, dtype=jnp.int32)
+        belem = rows_from_offsets(e_offsets[:-1], ext_len, nbytes)
+        bwithin = bpos - jnp.take(e_offsets, belem)
+        in_body = bwithin < jnp.take(body_len, belem)
+        src_valid = jnp.take(e_valid, belem)
+        body_src = jnp.take(sc.offsets[:-1], belem) + bwithin
+        body_byte = jnp.take(sc.chars,
+                             jnp.clip(body_src, 0, sc.char_capacity - 1))
+        if repl is not None:
+            rb = jnp.take(repl, jnp.clip(bwithin, 0,
+                                         max(repl.shape[0] - 1, 0)))
+            body_byte = jnp.where(src_valid, body_byte, rb)
+        sep_byte = jnp.take(
+            sep, jnp.clip(bwithin - jnp.take(body_len, belem), 0,
+                          max(sep_len - 1, 0))) if sep_len else \
+            jnp.zeros((), jnp.uint8)
+        byte = jnp.where(in_body, body_byte, sep_byte)
+        # remap element-space positions into compact output positions:
+        # element extents are already contiguous in row order, so the
+        # e_offsets space IS the output space restricted to live rows
+        total = out_offsets[cap]
+        chars = jnp.where(bpos < total, byte, jnp.zeros((), jnp.uint8))
+        repl_len = int(repl.shape[0]) if repl is not None else 0
+        per_elem = max(sc.pad_bucket, repl_len) + sep_len
+        return StringColumn(out_offsets, chars, validity,
+                            pad_bucket=round_pow2(
+                                max(lc.pad_bucket * per_elem, 8)))
 
-class ZipWith(_CpuOnlyCollection):
+
+class ZipWith(Expression):
     """zip_with(a, b, (x, y) -> f) (higherOrderFunctions.scala
-    GpuZipWith role)."""
+    GpuZipWith role). Device lane: both inputs lower to aligned
+    (capacity, pad) element lanes (the shorter side's missing lanes
+    bind as null), the lambda body evaluates over the lane batch, and
+    the result repacks at max-length extents."""
 
     def __init__(self, left: Expression, right: Expression,
                  x_var, y_var, body: Expression):
@@ -653,6 +819,62 @@ class ZipWith(_CpuOnlyCollection):
         self.x_var._dtype = lt.element_type
         self.y_var._dtype = rt.element_type
         return dt.ArrayType(self.children[2].data_type(schema))
+
+    def eval(self, batch: ColumnarBatch) -> ListColumn:
+        from ..columnar.vector import round_pow2
+        from .higher_order import _lanes_to_list
+        la = self.children[0].eval(batch)
+        lb = self.children[1].eval(batch)
+        self.data_type(batch.schema())  # bind lambda var dtypes
+        cap = batch.capacity
+        w = max(la.pad_bucket, lb.pad_bucket)
+        live = batch.live_mask()
+        validity = live & la.validity & lb.validity
+        lens = jnp.where(validity,
+                         jnp.maximum(la.lengths(), lb.lengths()), 0)
+
+        def lanes(lc):
+            vals, lane_ok, elem_ok = lc.element_lanes()
+            if lc.pad_bucket < w:
+                padm = ((0, 0), (0, w - lc.pad_bucket))
+                vals = jnp.pad(vals, padm)
+                elem_ok = jnp.pad(elem_ok, padm)
+            return vals, elem_ok
+        va, oa = lanes(la)
+        vb, ob = lanes(lb)
+        k = jnp.arange(w, dtype=jnp.int32)[None, :]
+        lane_ok = (k < lens[:, None]) & validity[:, None]
+        n = cap * w
+        xcol = ColumnVector(va.reshape(n), (oa & lane_ok).reshape(n),
+                            la.dtype.element_type)
+        ycol = ColumnVector(vb.reshape(n), (ob & lane_ok).reshape(n),
+                            lb.dtype.element_type)
+        lane_batch = ColumnarBatch([xcol, ycol],
+                                   [self.x_var.name, self.y_var.name],
+                                   n)
+        # outer column references inside the body
+        from .higher_order import _outer_refs
+        outer = _outer_refs(self.children[2],
+                            (self.x_var, self.y_var))
+        if outer:
+            rows = jnp.repeat(jnp.arange(cap, dtype=jnp.int32), w)
+            sub = batch.select([c for c in batch.names if c in outer])
+            expanded = sub.gather(rows, batch.num_rows * w)
+            lane_batch = ColumnarBatch(
+                lane_batch.columns + expanded.columns,
+                lane_batch.names + expanded.names, n)
+        out = self.children[2].eval(lane_batch)
+        offsets = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32), jnp.cumsum(lens, dtype=jnp.int32)])
+        child_cap = round_pow2(max(cap * w, 8))
+        base = ListColumn(offsets, ColumnVector(
+            jnp.zeros(child_cap, out.data.dtype),
+            jnp.zeros(child_cap, jnp.bool_), out.dtype),
+            validity, out.dtype, w)
+        return _lanes_to_list(
+            base, out.data.reshape(cap, w),
+            (out.validity & lane_ok.reshape(n)).reshape(cap, w),
+            out.dtype, offsets=offsets, child_cap=child_cap)
 
 
 class MapConcat(_CpuOnlyCollection):

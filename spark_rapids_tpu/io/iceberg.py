@@ -135,32 +135,69 @@ class IcebergTable:
                 return os.path.join(self.root, loc[i + 1:])
         return loc
 
-    def data_files(self, snapshot: Optional[dict]) -> List[str]:
-        """Live parquet paths for a snapshot (ADDED+EXISTING entries of
-        its data manifests)."""
+    def _field_names_by_id(self) -> dict:
+        if "schemas" in self.meta:
+            sid = self.meta.get("current-schema-id", 0)
+            schema = next(s for s in self.meta["schemas"]
+                          if s.get("schema-id", 0) == sid)
+        else:
+            schema = self.meta["schema"]
+        return {f["id"]: f["name"] for f in schema["fields"]
+                if "id" in f}
+
+    def data_files(self, snapshot: Optional[dict]):
+        """Live file sets for a snapshot: (data parquet paths,
+        position-delete paths, [(equality-delete path, column names)]).
+
+        v2 row-level deletes (merge-on-read) are applied by the reader:
+        position deletes filter rows at decode by (file, pos), equality
+        deletes lower onto device LEFT ANTI joins — the GpuDeleteFilter
+        role (sql-plugin/.../iceberg/data/GpuDeleteFilter.java)."""
         from .avro import read_avro_records
         if snapshot is None:
-            return []
+            return [], [], []
         mlist = self._resolve(snapshot["manifest-list"])
-        files: List[str] = []
+        files: List[Tuple[str, int]] = []      # (path, data sequence)
+        pos_deletes: List[str] = []
+        eq_deletes: List[Tuple[str, List[str], int]] = []
+        by_id = self._field_names_by_id()
+
+        def seq_of(entry, m):
+            # None = no sequence metadata (v1-style manifests): data is
+            # treated as older than every delete, deletes as applying
+            # to everything — the safe legacy interpretation
+            s = entry.get("sequence_number")
+            if s is None:
+                s = m.get("sequence_number")
+            return int(s) if s is not None else None
         for m in read_avro_records(mlist):
             # v2 manifest-list rows carry content: 0=data, 1=deletes
             if m.get("content", 0) == 1:
-                deletes = self._live_entries(
-                    self._resolve(m["manifest_path"]))
-                if deletes:
-                    raise IcebergUnsupported(
-                        "row-level delete files (merge-on-read) are not "
-                        "supported; compact the table (rewrite_data_files)"
-                        " or read an older snapshot")
+                for entry in self._live_entry_records(
+                        self._resolve(m["manifest_path"])):
+                    df = entry["data_file"]
+                    p = self._resolve(df["file_path"])
+                    # data_file.content: 1=position deletes, 2=equality
+                    if df.get("content", 1) == 2:
+                        ids = df.get("equality_ids") or []
+                        try:
+                            cols = [by_id[i] for i in ids]
+                        except KeyError:
+                            raise IcebergUnsupported(
+                                f"equality delete ids {ids} not in the "
+                                "current schema")
+                        eq_deletes.append((p, cols, seq_of(entry, m)))
+                    else:
+                        pos_deletes.append(p)
                 continue
-            files.extend(self._live_entries(
-                self._resolve(m["manifest_path"])))
-        return files
+            for x in self._live_entry_records(
+                    self._resolve(m["manifest_path"])):
+                files.append((self._resolve(x["data_file"]["file_path"]),
+                              seq_of(x, m)))
+        return files, pos_deletes, eq_deletes
 
-    def _live_entries(self, manifest_path: str) -> List[str]:
+    def _live_entry_records(self, manifest_path: str):
         from .avro import read_avro_records
-        out = []
         for entry in read_avro_records(manifest_path):
             if entry.get("status", 1) == STATUS_DELETED:
                 continue
@@ -169,8 +206,7 @@ class IcebergTable:
             if fmt != "PARQUET":
                 raise IcebergUnsupported(
                     f"iceberg data file format {fmt} (parquet only)")
-            out.append(self._resolve(df["file_path"]))
-        return out
+            yield entry
 
 
 def load_table(path: str) -> IcebergTable:
@@ -200,10 +236,35 @@ def load_table(path: str) -> IcebergTable:
 
 
 def iceberg_scan(path: str, options: dict):
-    """-> (parquet_paths, schema) for FileScan; empty tables produce an
-    empty-relation schema with zero files."""
+    """-> (parquet_paths, schema, pos_delete_map, eq_deletes) for the
+    reader; empty tables produce an empty-relation schema with zero
+    files. ``pos_delete_map``: {abs data path: sorted int64 positions}
+    built by reading the (small) position-delete parquet files host-side
+    — decode-time row filtering applies them. ``eq_deletes``:
+    [(delete parquet path, [column names])] — the reader lowers each
+    onto a device LEFT ANTI join."""
     table = load_table(path)
     snap = table.snapshot(
         snapshot_id=options.get("snapshot_id"),
         as_of_timestamp_ms=options.get("as_of_timestamp_ms"))
-    return table.data_files(snap), table.schema
+    file_seqs, pos_paths, eq_deletes = table.data_files(snap)
+    files = [p for p, _ in file_seqs]
+    pos_map = {}
+    if pos_paths:
+        import numpy as np
+        import pyarrow.parquet as pq
+        known = {os.path.abspath(f) for f in files}
+        for p in pos_paths:
+            t = pq.read_table(p, columns=["file_path", "pos"])
+            fps = t.column("file_path").to_pylist()
+            poss = t.column("pos").to_pylist()
+            for fp, pos in zip(fps, poss):
+                # resolve the writer's URI through the table re-rooting
+                # (NOT by basename: distinct files can share names
+                # across partition directories)
+                key = os.path.abspath(table._resolve(str(fp)))
+                if key in known:
+                    pos_map.setdefault(key, []).append(int(pos))
+        pos_map = {k: np.array(sorted(v), dtype=np.int64)
+                   for k, v in pos_map.items()}
+    return file_seqs, table.schema, pos_map, eq_deletes

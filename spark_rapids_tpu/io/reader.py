@@ -71,14 +71,53 @@ class DataFrameReader:
             opts["snapshot_id"] = snapshot_id
         if as_of_timestamp_ms is not None:
             opts["as_of_timestamp_ms"] = as_of_timestamp_ms
-        files, schema = iceberg_scan(path, opts)
-        if not files:
+        file_seqs, schema, pos_map, eq_deletes = iceberg_scan(path, opts)
+        if not file_seqs:
             return self.session.create_dataframe(
                 {n: [] for n, _ in schema}, schema)
         from ..plan.session import DataFrame
-        return DataFrame(self.session,
-                         FileScan(files, "parquet", schema,
-                                  dict(self._options)))
+        from ..expr.core import col
+
+        def scan_df(paths):
+            scan_opts = dict(self._options)
+            if pos_map:
+                # decode-time (file, pos) row filtering — the position
+                # half of the merge-on-read delete contract
+                scan_opts["__iceberg_pos_deletes"] = pos_map
+            return DataFrame(self.session, FileScan(
+                paths, "parquet", schema, scan_opts))
+
+        def anti(df, dpath, cols):
+            # equality deletes: device LEFT ANTI join per delete file
+            # (GpuDeleteFilter.java role). Iceberg writes delete rows
+            # from committed data, so keys are non-null in practice.
+            ddf = DataFrame(self.session, FileScan(
+                [dpath], "parquet", [(n, t) for n, t in schema
+                                     if n in cols], {}))
+            return df.join(ddf, ([col(c) for c in cols],
+                                 [col(c) for c in cols]),
+                           how="left_anti")
+        if not eq_deletes:
+            return scan_df([p for p, _ in file_seqs])
+        # Iceberg spec: an equality delete applies only to data files
+        # with a STRICTLY SMALLER data sequence number (rows re-added
+        # after the delete survive). Partition the scan by applicable
+        # delete set; each group anti-joins its own deletes.
+        from collections import defaultdict
+        groups = defaultdict(list)   # applicable delete idx tuple -> paths
+        for p, seq in file_seqs:
+            applicable = tuple(
+                i for i, (_, _, dseq) in enumerate(eq_deletes)
+                if dseq is None or seq is None or seq < dseq)
+            groups[applicable].append(p)
+        out = None
+        for applicable, paths in sorted(groups.items()):
+            part = scan_df(paths)
+            for i in applicable:
+                dpath, cols, _ = eq_deletes[i]
+                part = anti(part, dpath, cols)
+            out = part if out is None else out.union(part)
+        return out
 
     def hive_text(self, *paths, schema: Optional[List] = None,
                   sep: str = "\x01"):

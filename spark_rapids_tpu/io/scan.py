@@ -444,12 +444,41 @@ def iter_file_tables(path: str, fmt: str, schema: Schema,
     ``conf`` must be passed explicitly from pool worker threads (the
     active conf is a thread-local)."""
     from .filecache import resolve_read_path
+    pos_deletes = (options or {}).get("__iceberg_pos_deletes")
+    if pos_deletes is not None:
+        import os as _os
+        dels = pos_deletes.get(_os.path.abspath(path))
+        if dels is not None and len(dels):
+            # iceberg merge-on-read position deletes: drop rows whose
+            # in-file position is in the delete set, preserving order
+            # (chunked stream => track the running file offset)
+            import numpy as np
+            opts2 = {k: v for k, v in options.items()
+                     if k != "__iceberg_pos_deletes"}
+            # positions are RAW in-file row numbers: no row-level
+            # filter pushdown and no native row-group pruning may run
+            # underneath (the plan's Filter node still applies)
+            opts2["__force_arrow_decode"] = True
+            offset = 0
+            for ht in iter_file_tables(path, fmt, schema, opts2,
+                                       None, max_rows, conf,
+                                       partition_values):
+                n = ht.num_rows
+                hit = dels[(dels >= offset) & (dels < offset + n)]
+                offset += n
+                if len(hit):
+                    mask = np.ones(n, bool)
+                    mask[hit - (offset - n)] = False
+                    ht = ht.select_rows(mask)
+                yield ht
+            return
     path = resolve_read_path(path, conf)
     names = [n for n, _ in schema]
     if fmt == "parquet":
         from ..conf import PARQUET_NATIVE_DECODE, active_conf
         c = conf or active_conf()
-        use_native = c.get(PARQUET_NATIVE_DECODE)
+        use_native = c.get(PARQUET_NATIVE_DECODE) and \
+            not (options or {}).get("__force_arrow_decode")
         if use_native and \
                 PARQUET_NATIVE_DECODE.key not in c._settings:
             # default-on only when a real accelerator consumes the

@@ -73,6 +73,7 @@ class TaskContext:
         self._inject_split_after = num_allocs_before
 
     def on_alloc_attempt(self) -> None:
+        self.alloc_attempts = getattr(self, "alloc_attempts", 0) + 1
         if self._inject_retry_after is not None:
             if self._inject_retry_after == 0:
                 self._inject_retry_after = None

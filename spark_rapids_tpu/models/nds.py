@@ -1795,7 +1795,8 @@ NDS_QUERIES: Dict[str, str] = {
               GROUP BY ss_ticket_number, ss_customer_sk) dj
         JOIN customer ON ss_customer_sk = c_customer_sk
         WHERE cnt BETWEEN 1 AND 5
-        ORDER BY cnt DESC, c_last_name ASC NULLS LAST
+        ORDER BY cnt DESC, c_last_name ASC NULLS LAST,
+                 c_first_name ASC NULLS LAST, ss_ticket_number
         LIMIT 100""",
     # channel counts over null-extended union (q76 shape)
     "q76": """

@@ -218,8 +218,9 @@ class ClusterWorker:
                     _send_msg(s, {"type": "reset_done"})
                 elif msg["type"] == "job":
                     try:
-                        rows = self._run_job(msg)
-                        _send_msg(s, {"type": "result", "rows": rows})
+                        rows, metrics = self._run_job(msg)
+                        _send_msg(s, {"type": "result", "rows": rows,
+                                      "metrics": metrics})
                     except BaseException as e:  # surface to driver
                         import traceback
                         _send_msg(s, {"type": "error",
@@ -265,7 +266,9 @@ class ClusterWorker:
         if debug:
             print(f"[w{cluster.worker_id}] rows={len(rows)}",
                   file=sys.stderr, flush=True)
-        return rows
+        metrics = {eid: {m.name: m.value for m in md.values()}
+                   for eid, md in ctx.metrics.items()}
+        return rows, metrics
 
     def close(self) -> None:
         self.server.close()
@@ -407,6 +410,9 @@ class ClusterDriver:
             except OSError:
                 raise WorkerLost(w)
         results: List[Optional[List[dict]]] = [None] * n
+        #: per-worker {exec_id: {metric: value}} of the last successful
+        #: job — AQE tests read skew/coalesce counters through this
+        worker_metrics: List[dict] = [{} for _ in range(n)]
         for w, (sock, _ep) in enumerate(workers):
             try:
                 reply = _recv_msg(sock)
@@ -424,6 +430,7 @@ class ClusterDriver:
                 raise RuntimeError(
                     f"worker {w} failed:\n{reply['error']}")
             results[w] = reply["rows"]
+            worker_metrics[w] = reply.get("metrics", {})
         # post-job cleanup: peers are done fetching once every worker
         # has returned, so drop all shuffle blocks now — without this a
         # long-lived worker accumulates every past job's map outputs
@@ -436,6 +443,7 @@ class ClusterDriver:
                 _recv_msg(sock)  # reset_done (keeps protocol in sync)
             except OSError:
                 pass
+        self.last_metrics = worker_metrics
         out: List[dict] = []
         for rows in results:
             out.extend(rows or [])

@@ -50,12 +50,21 @@ class ShuffleBlockCatalog:
     def add(self, block: BlockId, batch: ColumnarBatch) -> None:
         sb = SpillableBatch(batch, SpillPriority.SHUFFLE_OUTPUT)
         with self._lock:
-            self._blocks.setdefault(block, []).append(sb)
+            old = self._blocks.get(block)
+            # a replayed map task (OOM retry) OVERWRITES its block —
+            # appending would duplicate the partition's rows
+            self._blocks[block] = [sb]
+        if old:
+            for prev in old:
+                prev.close()
 
     def get(self, block: BlockId) -> List[ColumnarBatch]:
+        from ..memory.retry import with_retry_no_split
         with self._lock:
             sbs = list(self._blocks.get(block, []))
-        return [sb.get() for sb in sbs]
+        # rematerializing a spilled block reserves device budget; OOM
+        # here spills other blocks and retries (pure re-read)
+        return with_retry_no_split(lambda: [sb.get() for sb in sbs])
 
     def blocks_for_reduce(self, shuffle_id: int,
                           reduce_id: int) -> List[BlockId]:
@@ -129,7 +138,9 @@ class ShuffleManager:
         self._registered: Dict[int, int] = {}  # shuffle_id -> num_parts
         #: (shuffle_id, reduce_id) -> rows written (AQE statistics — the
         #: MapOutputStatistics the reference's AQE reads from Spark)
-        self._part_rows: Dict[Tuple[int, int], int] = {}
+        #: rows per (shuffle, map, reduce): replays overwrite their
+        #: own map's contribution instead of double-counting
+        self._part_rows: Dict[Tuple[int, int, int], int] = {}
         self.write_metrics = ShuffleWriteMetrics()
         self._lock = threading.Lock()
 
@@ -149,9 +160,12 @@ class ShuffleManager:
     def partition_row_counts(self, shuffle_id: int) -> List[int]:
         """Rows per reduce partition (valid once the map side wrote)."""
         n = self.num_partitions(shuffle_id)
+        out = [0] * n
         with self._lock:
-            return [self._part_rows.get((shuffle_id, r), 0)
-                    for r in range(n)]
+            for (sid, _mid, rid), v in self._part_rows.items():
+                if sid == shuffle_id and rid < n:
+                    out[rid] += v
+        return out
 
     def num_partitions(self, shuffle_id: int) -> int:
         return self._registered[shuffle_id]
@@ -179,8 +193,7 @@ class ShuffleManager:
             f.result()
         with self._lock:
             for reduce_id, rows in local_rows.items():
-                key = (shuffle_id, reduce_id)
-                self._part_rows[key] = self._part_rows.get(key, 0) + rows
+                self._part_rows[(shuffle_id, map_id, reduce_id)] = rows
         self.write_metrics.write_time_ns += time.perf_counter_ns() - t0
 
     def _serialize_one(self, block: BlockId, batch: ColumnarBatch) -> None:
@@ -193,15 +206,21 @@ class ShuffleManager:
             self.write_metrics.bytes_written += len(data)
 
     # --- read path ---
-    def read_partition(self, shuffle_id: int,
-                       reduce_id: int) -> Iterator[ColumnarBatch]:
-        """All map outputs for one reduce partition, in map order."""
+    def read_partition(self, shuffle_id: int, reduce_id: int,
+                       map_mod=None) -> Iterator[ColumnarBatch]:
+        """All map outputs for one reduce partition, in map order.
+        ``map_mod=(s, S)`` keeps only blocks with map_id % S == s — a
+        skewed reduce partition splits into S disjoint map slices."""
+        def keep(map_id: int) -> bool:
+            return map_mod is None or map_id % map_mod[1] == map_mod[0]
         if self.mode == "CACHE_ONLY":
             for block in self.catalog.blocks_for_reduce(shuffle_id,
                                                         reduce_id):
-                yield from self.catalog.get(block)
+                if keep(block[1]):
+                    yield from self.catalog.get(block)
             return
-        blocks = self.host_store.blocks_for_reduce(shuffle_id, reduce_id)
+        blocks = [b for b in self.host_store.blocks_for_reduce(
+            shuffle_id, reduce_id) if keep(b[1])]
         futures = [self._pool.submit(self._deserialize_one, b)
                    for b in blocks]
         for f in futures:

@@ -158,8 +158,8 @@ def fetch_all_partitions(endpoints: List[str], shuffle_id: int,
                          reduce_id: int,
                          max_concurrent: Optional[int] = None,
                          in_flight_bytes: Optional[int] = None,
-                         budget: Optional[ByteBudget] = None
-                         ) -> Iterator[ColumnarBatch]:
+                         budget: Optional[ByteBudget] = None,
+                         map_mod=None) -> Iterator[ColumnarBatch]:
     """Reduce-side iterator over every peer's blocks for one partition
     (RapidsShuffleIterator role): up to ``max_concurrent`` peers fetch
     in parallel threads, blocks stage through a ``ByteBudget``-bounded
@@ -174,10 +174,17 @@ def fetch_all_partitions(endpoints: List[str], shuffle_id: int,
         max_concurrent = conf.get(SHUFFLE_FETCH_MAX_CONCURRENT)
     if in_flight_bytes is None:
         in_flight_bytes = conf.get(SHUFFLE_FETCH_IN_FLIGHT_BYTES)
+    def keep(map_id: int) -> bool:
+        # skew split: client-side map-slice filter ((s, S) keeps
+        # map_id % S == s); blocks outside the slice are dropped before
+        # deserialization
+        return map_mod is None or map_id % map_mod[1] == map_mod[0]
     if len(endpoints) <= 1 or max_concurrent <= 1:
         for ep in endpoints:
-            yield from ShuffleBlockClient(ep).fetch_partition(
-                shuffle_id, reduce_id)
+            for map_id, data in ShuffleBlockClient(ep).stream_raw(
+                    shuffle_id, reduce_id):
+                if keep(map_id):
+                    yield deserialize_batch(data)
         return
 
     import queue as _q
@@ -188,10 +195,12 @@ def fetch_all_partitions(endpoints: List[str], shuffle_id: int,
 
     def worker(ep: str) -> None:
         try:
-            for _map_id, data in ShuffleBlockClient(ep).stream_raw(
+            for map_id, data in ShuffleBlockClient(ep).stream_raw(
                     shuffle_id, reduce_id):
                 if stop.is_set():
                     return
+                if not keep(map_id):
+                    continue
                 budget.acquire(len(data))
                 outq.put(("block", data))
         except BaseException as e:  # surfaced on the consumer side

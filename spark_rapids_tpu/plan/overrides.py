@@ -370,10 +370,37 @@ def _cpu_only_collection(meta: ExprMeta):
         "yet on TPU; runs on the CPU engine")
 
 
-for _cls in (CX.Flatten, CX.ArraysZip, CX.ArrayJoin, CX.ZipWith,
-             CX.MapConcat):
-    _expr(_cls, ts.all_basic + ts.TypeSig(ts.ARRAY, ts.STRUCT, ts.MAP),
-          _cpu_only_collection)
+def _tag_zip_with(meta: ExprMeta):
+    # lane evaluation binds the lambda vars as primitive element lanes;
+    # the lambda RESULT must be primitive too (the repack builds a
+    # flat ColumnVector child)
+    for child in meta.expr.children[:2]:
+        t = child.data_type(meta.schema)
+        et = t.element_type if isinstance(t, dt.ArrayType) else t
+        if et.is_nested or et == dt.STRING:
+            meta.will_not_work_on_tpu(
+                f"zip_with over {et} elements needs non-primitive lane "
+                "lowering; runs on CPU")
+    out_t = meta.expr.data_type(meta.schema)  # binds lambda var dtypes
+    rt = out_t.element_type if isinstance(out_t, dt.ArrayType) else out_t
+    if rt.is_nested or rt == dt.STRING:
+        meta.will_not_work_on_tpu(
+            f"zip_with producing {rt} needs non-primitive lane "
+            "lowering; runs on CPU")
+
+
+_expr(CX.Flatten, ts.all_basic + ts.TypeSig(ts.ARRAY, ts.STRUCT, ts.MAP),
+      None)
+_expr(CX.ArraysZip,
+      ts.all_basic + ts.TypeSig(ts.ARRAY, ts.STRUCT, ts.MAP), None)
+_expr(CX.ArrayJoin,
+      ts.all_basic + ts.TypeSig(ts.ARRAY, ts.STRUCT, ts.MAP), None)
+_expr(CX.ZipWith,
+      ts.all_basic + ts.TypeSig(ts.ARRAY, ts.STRUCT, ts.MAP),
+      _tag_zip_with)
+_expr(CX.MapConcat,
+      ts.all_basic + ts.TypeSig(ts.ARRAY, ts.STRUCT, ts.MAP),
+      _cpu_only_collection)
 
 
 # --- higher-order functions + maps ---

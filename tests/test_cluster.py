@@ -169,3 +169,83 @@ def test_worker_loss_recovery(dataset):
                 p.wait(timeout=10)
             except Exception:
                 p.kill()
+
+
+@pytest.fixture(scope="module")
+def skew_dataset(tmp_path_factory):
+    """Fact table with one hot key (90% of rows) for skew-join AQE."""
+    root = tmp_path_factory.mktemp("cluster_skew")
+    session = TpuSession(SrtConf({}))
+    rng = np.random.default_rng(13)
+    n = 16_000
+    keys = np.where(rng.random(n) < 0.9, 7, rng.integers(0, 50, n))
+    fact = session.create_dataframe({
+        "k": keys.tolist(),
+        "v": rng.uniform(0, 10, n).tolist(),
+    })
+    fact_dir = str(root / "fact")
+    fact.write.parquet(fact_dir)
+    dim = session.create_dataframe({
+        "k": list(range(50)),
+        "name": [f"n{i}" for i in range(50)],
+    })
+    dim_dir = str(root / "dim")
+    dim.write.parquet(dim_dir)
+    return {"fact": fact_dir, "dim": dim_dir, "n": n}
+
+
+def test_cluster_skewed_join_adaptive(cluster, skew_dataset):
+    """AQE stays ON under the cluster: global gathered stats drive a
+    skew split of the hot reduce partition, and results still match the
+    single-process oracle (VERDICT r3 #7)."""
+    session = TpuSession(SrtConf({}))
+    conf = {"srt.shuffle.partitions": 4,
+            "srt.sql.broadcastRowThreshold": 1,
+            "srt.sql.adaptive.skewJoin.partitionRows": 1000,
+            "srt.sql.adaptive.coalescePartitions.minPartitionRows": 1}
+    plan = _logical(session, skew_dataset,
+                    lambda f, d: f.join(d, ([col("k")], [col("k")]),
+                                        how="inner"))
+    rows = cluster.run(plan, conf)
+    # the skewed partition must actually have been split somewhere
+    skewed = sum(v.get("skewedJoinPartitions", 0)
+                 for wm in cluster.last_metrics for v in wm.values())
+    assert skewed >= 1, cluster.last_metrics
+    # oracle: single process, adaptive off
+    oracle_sess = TpuSession(SrtConf(
+        {"srt.sql.adaptive.enabled": False,
+         "srt.sql.broadcastRowThreshold": 1}))
+    f = oracle_sess.read.parquet(skew_dataset["fact"])
+    d = oracle_sess.read.parquet(skew_dataset["dim"])
+    expect = f.join(d, ([col("k")], [col("k")]), how="inner").collect()
+    assert len(rows) == len(expect)
+    got_v = sorted(round(r["v"], 6) for r in rows)
+    exp_v = sorted(round(r["v"], 6) for r in expect)
+    assert got_v == exp_v
+
+
+def test_cluster_adaptive_coalesce_aggregate(cluster, dataset):
+    """Adaptive coalescing under the cluster: global stats, identical
+    groups on every worker, correct grouped results."""
+    session = TpuSession(SrtConf({}))
+    conf = {"srt.shuffle.partitions": 8,
+            "srt.sql.adaptive.coalescePartitions.minPartitionRows":
+                1 << 16}
+    plan = _logical(session, dataset,
+                    lambda f, d: f.group_by("k").agg(
+                        Alias(Sum(col("v")), "s"),
+                        Alias(CountStar(), "c")))
+    rows = cluster.run(plan, conf)
+    expect = {r["k"]: r for r in TpuSession(SrtConf({})).read
+              .parquet(dataset["fact"]).group_by("k")
+              .agg(Alias(Sum(col("v")), "s"),
+                   Alias(CountStar(), "c")).collect()}
+    assert len(rows) == len(expect)
+    for r in rows:
+        e = expect[r["k"]]
+        assert r["c"] == e["c"]
+        assert abs(r["s"] - e["s"]) < 1e-6
+    coalesced = sum(v.get("adaptiveCoalescedPartitions", 0)
+                    for wm in cluster.last_metrics
+                    for v in wm.values())
+    assert coalesced >= 1, cluster.last_metrics

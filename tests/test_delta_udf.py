@@ -261,3 +261,89 @@ def test_ml_export_carries_num_rows(session):
     assert arrs.num_rows == 3
     data, valid = arrs["x"]
     assert data.shape[0] >= 3  # capacity padded; slice to num_rows
+
+
+def test_merge_insert_only_keeps_matched(session, tmp_path):
+    """MERGE with only an insert clause must leave matched target rows
+    untouched (they are not part of any WHEN clause)."""
+    t = make_table(session, tmp_path, "t_insonly")
+    src = session.create_dataframe({
+        "id": [3, 9], "v": [99.0, 90.0], "tag": ["x", "z"]})
+    t.merge(src, on=["id"], when_not_matched_insert=True)
+    r = rows(t)
+    assert [x["id"] for x in r] == [1, 2, 3, 4, 9]
+    assert r[2]["v"] == 30.0          # matched row unchanged
+    assert r[4]["tag"] == "z"         # new row inserted
+
+
+def test_merge_schema_evolution(session, tmp_path):
+    """MERGE with schema_evolution=True appends new source columns to
+    the schema; existing rows read NULL (delta.schema.autoMerge /
+    MergeIntoCommandMeta canMergeSchema role) — VERDICT r3 #8."""
+    t = make_table(session, tmp_path, "t_evo")
+    src = session.create_dataframe({
+        "id": [2, 9], "v": [2.5, 9.5], "tag": ["m", "n"],
+        "extra": [200, 900]})
+    with pytest.raises(ValueError, match="schema_evolution"):
+        t.merge(src, on=["id"],
+                when_matched_update={"v": col("src_v"),
+                                     "extra": col("src_extra")})
+    t.merge(src, on=["id"],
+            when_matched_update={"v": col("src_v"),
+                                 "extra": col("src_extra")},
+            schema_evolution=True)
+    assert [n for n, _ in t.schema()] == ["id", "v", "tag", "extra"]
+    r = rows(t)
+    assert [x["id"] for x in r] == [1, 2, 3, 4, 9]
+    assert r[0]["extra"] is None      # pre-existing row: NULL
+    assert r[1]["v"] == 2.5 and r[1]["extra"] == 200
+    assert r[4]["extra"] == 900       # inserted with evolved column
+
+
+def test_concurrent_schema_change_aborts_writer(session, tmp_path):
+    """Two-writer conflict: writer B (update) loses the race to writer
+    A's schema-changing MERGE -> MetadataChangedConflict, never a
+    silent retry against the wrong schema."""
+    from spark_rapids_tpu.delta.log import MetadataChangedConflict
+    t = make_table(session, tmp_path, "t_conflict")
+    # writer B prepares an update against the CURRENT version, but A's
+    # schema-evolving merge commits first (simulated interleaving:
+    # patch B's commit to fire A's commit right before)
+    t_b = AcidTable.for_path(session, t.path)
+    orig_commit = t_b.log.commit
+    fired = {"done": False}
+
+    def racing_commit(read_v, actions, op):
+        if not fired["done"]:
+            fired["done"] = True
+            src = session.create_dataframe({
+                "id": [1], "v": [1.5], "tag": ["a"], "extra": [7]})
+            t.merge(src, on=["id"],
+                    when_matched_update={"v": col("src_v")},
+                    schema_evolution=True)
+        return orig_commit(read_v, actions, op)
+    t_b.log.commit = racing_commit
+    with pytest.raises(MetadataChangedConflict):
+        t_b.update({"v": col("v") * lit(2.0)})
+
+
+def test_concurrent_append_vs_rewrite_recomputes(session, tmp_path):
+    """Append vs rewrite: the losing rewrite recomputes against the new
+    head so the appended rows are included (no lost update)."""
+    t = make_table(session, tmp_path, "t_appendrace")
+    t_b = AcidTable.for_path(session, t.path)
+    orig_commit = t_b.log.commit
+    fired = {"done": False}
+
+    def racing_commit(read_v, actions, op):
+        if not fired["done"]:
+            fired["done"] = True
+            t.append(session.create_dataframe({
+                "id": [10], "v": [100.0], "tag": ["q"]}))
+        return orig_commit(read_v, actions, op)
+    t_b.log.commit = racing_commit
+    t_b.update({"v": col("v") * lit(2.0)})
+    r = rows(t_b)
+    assert [x["id"] for x in r] == [1, 2, 3, 4, 10]
+    # the appended row went through the recomputed UPDATE too
+    assert r[4]["v"] == 200.0

@@ -156,10 +156,85 @@ def test_schema_from_metadata(tmp_path):
     assert t.format_version == 2
 
 
-def test_delete_files_raise(session, tmp_path):
-    root = build_table(str(tmp_path / "tbl"), with_delete_manifest=True)
-    with pytest.raises(IcebergUnsupported, match="delete"):
-        session.read.iceberg(root, snapshot_id=2).collect()
+DELETE_MANIFEST_SCHEMA = {
+    "type": "record", "name": "manifest_entry", "fields": [
+        {"name": "status", "type": "int"},
+        {"name": "snapshot_id", "type": ["null", "long"]},
+        {"name": "data_file", "type": {
+            "type": "record", "name": "r2d", "fields": [
+                {"name": "content", "type": "int"},
+                {"name": "file_path", "type": "string"},
+                {"name": "file_format", "type": "string"},
+                {"name": "partition", "type": {
+                    "type": "record", "name": "r102d", "fields": []}},
+                {"name": "record_count", "type": "long"},
+                {"name": "file_size_in_bytes", "type": "long"},
+                {"name": "equality_ids",
+                 "type": ["null", {"type": "array", "items": "int"}]},
+            ]}},
+    ]}
+
+
+def _delete_entry(path, content, equality_ids=None, rows=1):
+    return {"status": 1, "snapshot_id": 2,
+            "data_file": {"content": content, "file_path": path,
+                          "file_format": "PARQUET", "partition": {},
+                          "record_count": rows,
+                          "file_size_in_bytes": 64,
+                          "equality_ids": equality_ids}}
+
+
+def _add_delete_manifest(root, entries, name="mdel.avro"):
+    mdir = os.path.join(root, "metadata")
+    p = os.path.join(mdir, name)
+    write_avro_records(entries, DELETE_MANIFEST_SCHEMA, p)
+    # splice the delete manifest into snapshot 2's manifest list
+    lpath = os.path.join(mdir, "snap-2.avro")
+    mans = list(read_avro_records(lpath))
+    mans.append(_manifest_file(f"metadata/{name}", content=1))
+    write_avro_records(mans, MANIFEST_LIST_SCHEMA, lpath)
+
+
+def test_position_deletes_applied(session, tmp_path):
+    """v2 merge-on-read position deletes filter (file, pos) rows at
+    decode (GpuDeleteFilter.java role) — VERDICT r3 #8."""
+    root = build_table(str(tmp_path / "tbl"))
+    pq.write_table(pa.table({
+        "file_path": ["s3://bucket/warehouse/tbl/data/f1.parquet"],
+        "pos": pa.array([0], pa.int64())}),
+        os.path.join(root, "data", "pdel.parquet"))
+    _add_delete_manifest(root, [_delete_entry("data/pdel.parquet", 1)])
+    rows = session.read.iceberg(root, snapshot_id=2).collect()
+    got = sorted((r["k"], r["v"]) for r in rows)
+    assert got == [("b", 2), ("c", 3)]   # ("a", 1) position-deleted
+
+
+def test_equality_deletes_applied(session, tmp_path):
+    """v2 equality deletes lower onto a device LEFT ANTI join."""
+    root = build_table(str(tmp_path / "tbl"))
+    pq.write_table(pa.table({"k": ["a", "c"]}),
+                   os.path.join(root, "data", "edel.parquet"))
+    _add_delete_manifest(root, [_delete_entry(
+        "data/edel.parquet", 2, equality_ids=[1])])
+    rows = session.read.iceberg(root, snapshot_id=2).collect()
+    got = sorted((r["k"], r["v"]) for r in rows)
+    assert got == [("b", 2)]
+
+
+def test_mixed_deletes_applied(session, tmp_path):
+    root = build_table(str(tmp_path / "tbl"))
+    pq.write_table(pa.table({
+        "file_path": ["s3://bucket/warehouse/tbl/data/f2.parquet"],
+        "pos": pa.array([0], pa.int64())}),
+        os.path.join(root, "data", "pdel.parquet"))
+    pq.write_table(pa.table({"k": ["b"]}),
+                   os.path.join(root, "data", "edel.parquet"))
+    _add_delete_manifest(root, [
+        _delete_entry("data/pdel.parquet", 1),
+        _delete_entry("data/edel.parquet", 2, equality_ids=[1])])
+    rows = session.read.iceberg(root, snapshot_id=2).collect()
+    got = sorted((r["k"], r["v"]) for r in rows)
+    assert got == [("a", 1)]
 
 
 def test_non_parquet_data_raises(session, tmp_path):
@@ -196,3 +271,52 @@ def test_empty_table(session, tmp_path):
     df = session.read.iceberg(root)
     assert df.collect() == []
     assert [n for n, _ in df.schema] == ["k", "v"]
+
+
+def test_equality_delete_sequence_numbers(session, tmp_path):
+    """Equality deletes apply only to data files with a strictly
+    smaller data sequence number: rows re-added AFTER the delete
+    survive (Iceberg v2 sequence-number semantics)."""
+    import copy
+    root = build_table(str(tmp_path / "tbl"))
+    mdir = os.path.join(root, "metadata")
+    # f3 re-adds k='a' AFTER the delete
+    pq.write_table(pa.table({"k": ["a"], "v": [99]}),
+                   os.path.join(root, "data", "f3.parquet"))
+    pq.write_table(pa.table({"k": ["a"]}),
+                   os.path.join(root, "data", "edel.parquet"))
+    seq_manifest_schema = copy.deepcopy(MANIFEST_SCHEMA)
+    seq_manifest_schema["fields"].insert(
+        2, {"name": "sequence_number", "type": ["null", "long"]})
+    seq_delete_schema = copy.deepcopy(DELETE_MANIFEST_SCHEMA)
+    seq_delete_schema["fields"].insert(
+        2, {"name": "sequence_number", "type": ["null", "long"]})
+    e3 = _entry("data/f3.parquet")
+    e3["sequence_number"] = 5            # added AFTER the delete (seq 3)
+    write_avro_records([e3], seq_manifest_schema,
+                       os.path.join(mdir, "m3seq.avro"))
+    d = _delete_entry("data/edel.parquet", 2, equality_ids=[1])
+    d["sequence_number"] = 3
+    write_avro_records([d], seq_delete_schema,
+                       os.path.join(mdir, "mdelseq.avro"))
+    # old data manifests get sequence 1 via the manifest-list row
+    lpath = os.path.join(mdir, "snap-2.avro")
+    mans = list(read_avro_records(lpath))
+    seq_list_schema = copy.deepcopy(MANIFEST_LIST_SCHEMA)
+    seq_list_schema["fields"].append(
+        {"name": "sequence_number", "type": ["null", "long"]})
+    for m in mans:
+        m["sequence_number"] = 1
+    mans.append({"manifest_path": "metadata/m3seq.avro",
+                 "manifest_length": 64, "partition_spec_id": 0,
+                 "content": 0, "added_snapshot_id": 2,
+                 "sequence_number": 5})
+    mans.append({"manifest_path": "metadata/mdelseq.avro",
+                 "manifest_length": 64, "partition_spec_id": 0,
+                 "content": 1, "added_snapshot_id": 2,
+                 "sequence_number": 3})
+    write_avro_records(mans, seq_list_schema, lpath)
+    rows = session.read.iceberg(root, snapshot_id=2).collect()
+    got = sorted((r["k"], r["v"]) for r in rows)
+    # seq-1 'a' deleted by the seq-3 delete; the seq-5 re-add survives
+    assert got == [("a", 99), ("b", 2), ("c", 3)]

@@ -13,9 +13,13 @@ from spark_rapids_tpu.testing import assert_tpu_cpu_equal_df
 
 @pytest.fixture(scope="module")
 def nds_session(tmp_path_factory):
+    import os
     root = tmp_path_factory.mktemp("nds")
     session = TpuSession(SrtConf({"srt.shuffle.partitions": 4}))
-    register_nds(session, str(root), scale_rows=20_000)
+    # SRT_NDS_TEST_SCALE=100000 runs the full-scale differential proof
+    # (VERDICT r3 #4); default stays CI-sized
+    scale = int(os.environ.get("SRT_NDS_TEST_SCALE", 20_000))
+    register_nds(session, str(root), scale_rows=scale)
     return session
 
 

@@ -1,0 +1,160 @@
+"""Per-operator OOM-retry suite (VERDICT r3 #6): the reference drives
+forceRetryOOM through sort/aggregate/join/window/shuffle via
+RmmSparkRetrySuiteBase (tests/.../RmmSparkRetrySuiteBase.scala:27);
+here the TaskContext injection hooks (memory/budget.py) fire RetryOOM
+inside each operator's spill-allocation path and the with_retry
+machinery must absorb it — results identical to the uninjected run and
+retry_count advanced. Each test fails if the operator's retry wrap is
+removed (the injected OOM would propagate)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.conf import SrtConf
+from spark_rapids_tpu.expr.aggregates import CountStar, Sum
+from spark_rapids_tpu.expr.core import Alias, col
+from spark_rapids_tpu.memory.budget import (reset_task_context,
+                                            task_context)
+from spark_rapids_tpu.plan import TpuSession
+
+N = 4000
+
+
+@pytest.fixture()
+def session(tmp_path):
+    """Tiny device budget: spillables actually SPILL, so re-gets go
+    through budget.reserve and every injected offset lands inside a
+    with_retry-wrapped allocation (an unwrapped one fails the test)."""
+    from spark_rapids_tpu.memory.budget import MemoryBudget
+    from spark_rapids_tpu.memory.spill import reset_spill_catalog
+    reset_task_context()
+    reset_spill_catalog(budget=MemoryBudget(1 << 18),
+                        spill_dir=str(tmp_path))
+    yield TpuSession(SrtConf({"srt.shuffle.partitions": 4}))
+    reset_spill_catalog(budget=MemoryBudget(1 << 40),
+                        spill_dir=str(tmp_path))
+
+
+def _data(session, n=N, seed=0):
+    rng = np.random.default_rng(seed)
+    return session.create_dataframe({
+        "k": rng.integers(0, 40, n).tolist(),
+        "v": rng.uniform(0, 10, n).tolist(),
+    })
+
+
+def _inject_each_alloc(run, probes=12):
+    """Run once clean for the oracle (counting spill-path allocations),
+    then re-run with RetryOOM injected at offsets spread over the FULL
+    allocation range — so late paths (final merges, last-bucket joins)
+    get hit, not just the first few creates. Every injected run must
+    match the oracle; at least one injection must go through a retry."""
+    reset_task_context()
+    oracle = run()
+    total = getattr(task_context(), "alloc_attempts", 0)
+    assert total > 0, "query never touched the spill allocation path"
+    offsets = sorted({(total - 1) * i // max(probes - 1, 1)
+                      for i in range(probes)})
+    hit = 0
+    for at in offsets:
+        reset_task_context()
+        task_context().force_retry_oom(num_allocs_before=at)
+        got = run()
+        assert got == oracle, f"divergence with OOM injected at {at}"
+        if task_context().retry_count:
+            hit += 1
+    assert hit == len(offsets), \
+        f"only {hit}/{len(offsets)} injections reached a retry wrap " \
+        "(an unwrapped allocation swallowed or dodged the OOM)"
+    return oracle
+
+
+def test_aggregate_merge_retry(session):
+    df = _data(session)
+    grouped = df.group_by("k").agg(Alias(Sum(col("v")), "s"),
+                                   Alias(CountStar(), "c"))
+
+    def run():
+        return sorted(((r["k"], round(r["s"], 9), r["c"])
+                       for r in grouped.collect()))
+    _inject_each_alloc(run)
+
+
+def test_aggregate_repartition_merge_retry(session):
+    s2 = TpuSession(SrtConf({"srt.shuffle.partitions": 2,
+                             "srt.sql.agg.mergePartitionRows": 256}))
+    df = _data(s2)
+    grouped = df.group_by("k").agg(Alias(Sum(col("v")), "s"))
+
+    def run():
+        return sorted(((r["k"], round(r["s"], 9))
+                       for r in grouped.collect()))
+    _inject_each_alloc(run)
+
+
+def test_sub_partition_join_retry(session):
+    s2 = TpuSession(SrtConf({"srt.shuffle.partitions": 2,
+                             "srt.sql.join.subPartitionRows": 512,
+                             "srt.sql.broadcastRowThreshold": 1}))
+    fact = _data(s2, seed=3)
+    dim = s2.create_dataframe({"k": list(range(40)),
+                               "w": [i * 3 for i in range(40)]})
+    joined = fact.join(dim, ([col("k")], [col("k")]), how="inner")
+
+    def run():
+        return sorted(((r["k"], round(r["v"], 9), r["w"])
+                       for r in joined.collect()))
+    _inject_each_alloc(run)
+
+
+def test_window_batch_retry(session):
+    from spark_rapids_tpu.expr.window import WindowSpec
+    df = _data(session, seed=5)
+    spec = WindowSpec(partition_by=[col("k")],
+                      order_fields=[])
+    w = df.select(col("k"), col("v"),
+                  Alias(Sum(col("v")).over(spec), "ws"))
+
+    def run():
+        return sorted(((r["k"], round(r["v"], 9), round(r["ws"], 9))
+                       for r in w.collect()))
+    _inject_each_alloc(run)
+
+
+def test_shuffle_write_retry(session):
+    df = _data(session, seed=7)
+    out = df.sort("v")   # range exchange: spillable buffering + write
+
+    def run():
+        return [round(r["v"], 9) for r in out.collect()]
+    _inject_each_alloc(run)
+
+
+def test_merge_step_retry_after_spill(session):
+    """Directly falsifies the agg merge-step wrap: partials are FORCED
+    to the spill tier, so the merge's sb.get() must reserve (and the
+    injected OOM lands inside merge_all — removing its with_retry
+    makes this fail)."""
+    from spark_rapids_tpu.memory.spill import spill_catalog
+    df = _data(session, n=2000, seed=11)
+    grouped = df.group_by("k").agg(Alias(Sum(col("v")), "s"))
+    reset_task_context()
+    oracle = sorted(((r["k"], round(r["s"], 9))
+                     for r in grouped.collect()))
+
+    # run with injection at EVERY alloc while aggressively spilling
+    for at in range(0, 40, 3):
+        reset_task_context()
+        spill_catalog().synchronous_spill(1 << 40)
+        task_context().force_retry_oom(num_allocs_before=at)
+        got = sorted(((r["k"], round(r["s"], 9))
+                      for r in grouped.collect()))
+        assert got == oracle, f"divergence at {at}"
+
+
+def test_ooc_sort_retry_is_covered():
+    """OOC sort has its own injected-OOM test
+    (tests/test_ooc_sort.py::test_ooc_sort_survives_injected_retry_oom)
+    — assert it exists so the five-path contract stays visible."""
+    import tests.test_ooc_sort as m
+    assert hasattr(m, "test_ooc_sort_survives_injected_retry_oom")
