@@ -1,29 +1,48 @@
 // Native parquet column-chunk decoder (the GpuParquetScan.scala:2624
 // Table.readParquet role, host-native stage): decodes one column
-// chunk's pages — Snappy or uncompressed, PLAIN or RLE_DICTIONARY
-// encoded, v1 data pages, fixed-width physical types — straight into a
-// caller-provided (pool-slab) values buffer + byte validity, without
-// the GIL. Footer/metadata parsing stays in python (pyarrow reads the
-// thrift footer; only PAGE headers are parsed here). Anything outside
-// this envelope returns an error code and the caller falls back to
-// pyarrow for that column.
+// chunk's pages — Snappy/GZIP/ZSTD or uncompressed; PLAIN,
+// RLE_DICTIONARY, or DELTA_BINARY_PACKED encoded; v1 AND v2 data
+// pages; fixed-width physical types — straight into a caller-provided
+// (pool-slab) values buffer + byte validity, without the GIL.
+// Footer/metadata parsing stays in python (pyarrow reads the thrift
+// footer; only PAGE headers are parsed here). Anything outside this
+// envelope returns an error code and the caller falls back to pyarrow
+// for that column.
 //
 // Page header thrift-compact subset:
 //   PageHeader{1:type 2:uncompressed_size 3:compressed_size
 //              5:DataPageHeader{1:num_values 2:encoding
 //                               3:def_level_encoding ...}
-//              7:DictionaryPageHeader{1:num_values 2:encoding}}
-// Unknown fields (statistics, crc, v2 headers) are skipped generically;
-// a v2 DATA page aborts with UNSUPPORTED.
+//              7:DictionaryPageHeader{1:num_values 2:encoding}
+//              8:DataPageHeaderV2{1:num_values 2:num_nulls 3:num_rows
+//                                 4:encoding 5:def_len 6:rep_len
+//                                 7:is_compressed}}
+// Unknown fields (statistics, crc) are skipped generically.
 
 #include <cstdint>
 #include <cstring>
+
+#include <zlib.h>
+#include <zstd.h>
 
 namespace {
 
 // ---------------------------------------------------------------------------
 // snappy block decompression (format: varint length; literal/copy tags)
 // ---------------------------------------------------------------------------
+
+bool snappy_decompress(const uint8_t* src, int64_t n, uint8_t* dst,
+                       int64_t dst_cap, int64_t* out_len);
+}  // namespace
+
+// shared with orc_decode.cpp (same libtputable.so)
+extern "C" bool srt_snappy_decompress(const uint8_t* src, int64_t n,
+                                      uint8_t* dst, int64_t dst_cap,
+                                      int64_t* out_len) {
+  return snappy_decompress(src, n, dst, dst_cap, out_len);
+}
+
+namespace {
 
 bool snappy_decompress(const uint8_t* src, int64_t n, uint8_t* dst,
                        int64_t dst_cap, int64_t* out_len) {
@@ -162,7 +181,145 @@ struct PageHeader {
   int32_t num_values = 0;
   int32_t encoding = -1;         // 0=PLAIN 3=RLE 8=RLE_DICTIONARY ...
   int32_t def_encoding = -1;
+  // v2-only fields
+  int32_t num_nulls = 0;
+  int32_t def_len = 0;
+  int32_t rep_len = 0;
+  bool v2_compressed = true;     // v2 default: values are compressed
 };
+
+// ---------------------------------------------------------------------------
+// generic decompressors (system zlib / zstd; snappy is hand-rolled)
+// ---------------------------------------------------------------------------
+
+bool gzip_inflate(const uint8_t* src, int64_t n, uint8_t* dst,
+                  int64_t dst_cap, int64_t* out_len) {
+  z_stream zs;
+  std::memset(&zs, 0, sizeof(zs));
+  // 15+32: accept both zlib and gzip wrappers (parquet uses gzip)
+  if (inflateInit2(&zs, 15 + 32) != Z_OK) return false;
+  zs.next_in = const_cast<Bytef*>(src);
+  zs.avail_in = (uInt)n;
+  zs.next_out = dst;
+  zs.avail_out = (uInt)dst_cap;
+  int rc = inflate(&zs, Z_FINISH);
+  *out_len = (int64_t)zs.total_out;
+  inflateEnd(&zs);
+  return rc == Z_STREAM_END;
+}
+
+bool zstd_inflate(const uint8_t* src, int64_t n, uint8_t* dst,
+                  int64_t dst_cap, int64_t* out_len) {
+  size_t got = ZSTD_decompress(dst, (size_t)dst_cap, src, (size_t)n);
+  if (ZSTD_isError(got)) return false;
+  *out_len = (int64_t)got;
+  return true;
+}
+
+// codec: 0=UNCOMPRESSED 1=SNAPPY 2=GZIP 3=ZSTD
+bool decompress_codec(int32_t codec, const uint8_t* src, int64_t n,
+                      uint8_t* dst, int64_t dst_cap, int64_t* out_len) {
+  switch (codec) {
+    case 1: return snappy_decompress(src, n, dst, dst_cap, out_len);
+    case 2: return gzip_inflate(src, n, dst, dst_cap, out_len);
+    case 3: return zstd_inflate(src, n, dst, dst_cap, out_len);
+    default: return false;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// DELTA_BINARY_PACKED (encoding 5): zigzag first value, per-block
+// min-delta + per-miniblock bit widths
+// ---------------------------------------------------------------------------
+
+struct DeltaReader {
+  const uint8_t* p;
+  int64_t n;
+  int64_t i = 0;
+  bool ok = true;
+
+  uint64_t varint() {
+    uint64_t v = 0;
+    int shift = 0;
+    while (i < n) {
+      uint8_t b = p[i++];
+      v |= uint64_t(b & 0x7f) << shift;
+      if (!(b & 0x80)) return v;
+      shift += 7;
+      if (shift > 63) break;
+    }
+    ok = false;
+    return 0;
+  }
+  int64_t zigzag() {
+    uint64_t u = varint();
+    return (int64_t)(u >> 1) ^ -(int64_t)(u & 1);
+  }
+};
+
+// Decode ``count`` int64 values (INT32 files widen losslessly; the
+// caller narrows) into out[]. Consumes one complete DELTA_BINARY_PACKED
+// stream.
+bool delta_binary_decode(const uint8_t* p, int64_t n, int64_t count,
+                         int64_t* out) {
+  DeltaReader r{p, n};
+  int64_t block_size = (int64_t)r.varint();
+  int64_t mb_per_block = (int64_t)r.varint();
+  int64_t total = (int64_t)r.varint();
+  int64_t first = r.zigzag();
+  if (!r.ok || block_size <= 0 || mb_per_block <= 0) return false;
+  if (block_size % (mb_per_block * 8) != 0) return false;
+  if (total < count) return false;
+  int64_t per_mb = block_size / mb_per_block;
+  int64_t o = 0;
+  if (o < count) out[o++] = first;
+  int64_t prev = first;
+  int64_t remaining = total - 1;
+  while (o < count && remaining > 0 && r.ok) {
+    int64_t min_delta = r.zigzag();
+    if (r.i + mb_per_block > r.n) return false;
+    const uint8_t* widths = r.p + r.i;
+    r.i += mb_per_block;
+    for (int64_t mb = 0; mb < mb_per_block; mb++) {
+      int bw = widths[mb];
+      if (bw > 64) return false;
+      int64_t in_mb = per_mb;
+      // every miniblock is fully present in the stream, but only
+      // ``remaining`` of its values are real
+      int64_t bytes = (per_mb * bw + 7) / 8;
+      if (r.i + bytes > r.n) {
+        // trailing miniblocks may be absent once all values are done
+        if (remaining <= 0) break;
+        return false;
+      }
+      const uint8_t* mbp = r.p + r.i;
+      uint64_t window = 0;
+      int have = 0;
+      int64_t bi = 0;
+      for (int64_t k = 0; k < in_mb; k++) {
+        uint64_t uv = 0;
+        if (bw > 0) {
+          while (have < bw) {
+            window |= (uint64_t)mbp[bi++] << have;
+            have += 8;
+          }
+          uv = bw == 64 ? window
+                        : (window & ((uint64_t(1) << bw) - 1));
+          window >>= bw;
+          have -= bw;
+        }
+        if (remaining > 0) {
+          prev = prev + min_delta + (int64_t)uv;
+          remaining--;
+          if (o < count) out[o++] = prev;
+        }
+      }
+      r.i += bytes;
+      if (remaining <= 0 && o >= count) break;
+    }
+  }
+  return o == count;
+}
 
 // parse one PageHeader starting at r.i; leaves r.i just past it
 bool parse_page_header(TReader& r, PageHeader* h) {
@@ -198,6 +355,32 @@ bool parse_page_header(TReader& r, PageHeader* h) {
               if (fid == 5) h->def_encoding = (int32_t)r.zigzag();
               else r.skip_value(st);
               break;
+            default: r.skip_value(st); break;
+          }
+        }
+        break;
+      }
+      case 8: {  // DataPageHeaderV2
+        if (t != 12) { r.skip_value(t); break; }
+        int16_t sfid = 0;
+        while (r.ok) {
+          if (r.i >= r.n) return false;
+          uint8_t sb = r.p[r.i++];
+          if (sb == 0) break;
+          uint8_t st = sb & 0x0f;
+          uint8_t sdelta = sb >> 4;
+          if (sdelta == 0) sfid = (int16_t)r.zigzag();
+          else sfid += sdelta;
+          if (st == 1 || st == 2) {  // bool packed in type nibble
+            if (sfid == 7) h->v2_compressed = (st == 1);
+            continue;
+          }
+          switch (sfid) {
+            case 1: h->num_values = (int32_t)r.zigzag(); break;
+            case 2: h->num_nulls = (int32_t)r.zigzag(); break;
+            case 4: h->encoding = (int32_t)r.zigzag(); break;
+            case 5: h->def_len = (int32_t)r.zigzag(); break;
+            case 6: h->rep_len = (int32_t)r.zigzag(); break;
             default: r.skip_value(st); break;
           }
         }
@@ -359,72 +542,117 @@ extern "C" int64_t parquet_decode_chunk(
     int64_t page_len = h.compressed_size;
     i += h.compressed_size;
 
-    // decompress into the scratch HEAD if needed (tail holds the dict)
+    // decompress into the scratch HEAD if needed (tail holds the dict).
+    // v2 pages keep their level sections UNCOMPRESSED ahead of the
+    // (possibly compressed) values; split before inflating.
     const int64_t head_cap = scratch_cap - dict_bytes;
-    if (codec == 1) {
-      int64_t got = 0;
-      if (h.uncompressed_size > head_cap) return -3;
-      if (!snappy_decompress(page, page_len, scratch, head_cap,
-                             &got) ||
-          got != h.uncompressed_size)
-        return -1;
-      page = scratch;
-      page_len = got;
-    } else if (codec != 0) {
-      return -2;
-    }
-
-    if (h.type == 2) {  // dictionary page: PLAIN values
-      if (h.encoding != 0 && h.encoding != 2) return -2;
-      int64_t bytes = (int64_t)h.num_values * elem;
-      if (bytes > page_len) return -1;
-      if (bytes * 2 > scratch_cap) return -3;
-      // park it at the END of scratch so data pages can reuse the head
-      dict = scratch + scratch_cap - bytes;
-      std::memmove(dict, page, bytes);
-      dict_count = h.num_values;
-      dict_bytes = bytes;
-      continue;
-    }
-    if (h.type != 0) return -2;  // v2 pages -> fallback
-
-    // v1 data page: [def levels (if max_def>0): u32 len + RLE] [values]
     const uint8_t* body = page;
     int64_t body_len = page_len;
     int64_t nvals = h.num_values;
-    if (row + nvals > num_rows) return -1;
-
-    // definition levels -> validity (whole-page run decode)
     int64_t non_null = nvals;
-    if (max_def_level > 0) {
-      if (h.def_encoding != 3) return -2;  // RLE only
-      if (body_len < 4) return -1;
-      uint32_t dl_len = body[0] | (uint32_t(body[1]) << 8) |
-                        (uint32_t(body[2]) << 16) |
-                        (uint32_t(body[3]) << 24);
-      if (4 + (int64_t)dl_len > body_len) return -1;
-      uint32_t* lvls = new uint32_t[nvals];
-      if (!rle_decode_all(body + 4, (int64_t)dl_len,
-                          bit_width_for(max_def_level), lvls, nvals)) {
+    uint8_t* dst = nullptr;
+
+    if (h.type == 3) {  // v2 data page
+      if (h.rep_len != 0) return -2;  // flat schema only
+      if (h.def_len < 0 || (int64_t)h.def_len > page_len) return -1;
+      if (row + nvals > num_rows) return -1;
+      // levels first (always uncompressed)
+      if (max_def_level > 0) {
+        uint32_t* lvls = new uint32_t[nvals > 0 ? nvals : 1];
+        if (!rle_decode_all(page, h.def_len,
+                            bit_width_for(max_def_level), lvls,
+                            nvals)) {
+          delete[] lvls;
+          return -1;
+        }
+        non_null = 0;
+        for (int64_t k = 0; k < nvals; k++) {
+          uint8_t v = lvls[k] == (uint32_t)max_def_level;
+          out_valid[row + k] = v;
+          non_null += v;
+        }
         delete[] lvls;
-        return -1;
+      } else {
+        if (h.def_len != 0 && max_def_level == 0) {
+          // writer may emit a trivial RLE stream; skip it
+        }
+        std::memset(out_valid + row, 1, nvals);
       }
-      non_null = 0;
-      for (int64_t k = 0; k < nvals; k++) {
-        uint8_t v = lvls[k] == (uint32_t)max_def_level;
-        out_valid[row + k] = v;
-        non_null += v;
+      body = page + h.def_len;
+      body_len = page_len - h.def_len;
+      if (codec != 0 && h.v2_compressed) {
+        int64_t got = 0;
+        int64_t want = h.uncompressed_size - h.def_len - h.rep_len;
+        if (want < 0 || want > head_cap) return want < 0 ? -1 : -3;
+        if (!decompress_codec(codec, body, body_len, scratch,
+                              head_cap, &got) ||
+            got != want)
+          return -1;
+        body = scratch;
+        body_len = got;
       }
-      delete[] lvls;
-      body += 4 + dl_len;
-      body_len -= 4 + (int64_t)dl_len;
     } else {
-      std::memset(out_valid + row, 1, nvals);
+      if (codec != 0) {
+        int64_t got = 0;
+        if (h.uncompressed_size > head_cap) return -3;
+        if (!decompress_codec(codec, page, page_len, scratch,
+                              head_cap, &got) ||
+            got != h.uncompressed_size)
+          return -1;
+        page = scratch;
+        page_len = got;
+      }
+
+      if (h.type == 2) {  // dictionary page: PLAIN values
+        if (h.encoding != 0 && h.encoding != 2) return -2;
+        int64_t bytes = (int64_t)h.num_values * elem;
+        if (bytes > page_len) return -1;
+        if (bytes * 2 > scratch_cap) return -3;
+        // park at the END of scratch so data pages reuse the head
+        dict = scratch + scratch_cap - bytes;
+        std::memmove(dict, page, bytes);
+        dict_count = h.num_values;
+        dict_bytes = bytes;
+        continue;
+      }
+      if (h.type != 0) return -2;
+
+      // v1 data page: [def levels (if max_def>0): u32 len+RLE][values]
+      body = page;
+      body_len = page_len;
+      if (row + nvals > num_rows) return -1;
+      if (max_def_level > 0) {
+        if (h.def_encoding != 3) return -2;  // RLE only
+        if (body_len < 4) return -1;
+        uint32_t dl_len = body[0] | (uint32_t(body[1]) << 8) |
+                          (uint32_t(body[2]) << 16) |
+                          (uint32_t(body[3]) << 24);
+        if (4 + (int64_t)dl_len > body_len) return -1;
+        uint32_t* lvls = new uint32_t[nvals > 0 ? nvals : 1];
+        if (!rle_decode_all(body + 4, (int64_t)dl_len,
+                            bit_width_for(max_def_level), lvls,
+                            nvals)) {
+          delete[] lvls;
+          return -1;
+        }
+        non_null = 0;
+        for (int64_t k = 0; k < nvals; k++) {
+          uint8_t v = lvls[k] == (uint32_t)max_def_level;
+          out_valid[row + k] = v;
+          non_null += v;
+        }
+        delete[] lvls;
+        body += 4 + dl_len;
+        body_len -= 4 + (int64_t)dl_len;
+      } else {
+        std::memset(out_valid + row, 1, nvals);
+      }
     }
 
-    // values: PLAIN(0) or RLE_DICTIONARY(8)/PLAIN_DICTIONARY(2)
+    // values: PLAIN(0), RLE_DICTIONARY(8)/PLAIN_DICTIONARY(2), or
+    // DELTA_BINARY_PACKED(5) for integer types
     if ((row + nvals) * elem > out_values_cap) return -3;
-    uint8_t* dst = out_values + row * elem;
+    dst = out_values + row * elem;
     if (h.encoding == 0) {
       if (non_null * elem > body_len) return -1;
       if (max_def_level == 0 || non_null == nvals) {
@@ -452,6 +680,26 @@ extern "C" int64_t parquet_decode_chunk(
           : gather_dict<8>(dst, dict, dict_count, idx, vmask, nvals);
       delete[] idx;
       if (!ok) return -1;
+    } else if (h.encoding == 5) {
+      if (phys_type != 1 && phys_type != 2) return -2;  // ints only
+      int64_t* deltas = new int64_t[non_null > 0 ? non_null : 1];
+      if (!delta_binary_decode(body, body_len, non_null, deltas)) {
+        delete[] deltas;
+        return -1;
+      }
+      int64_t s = 0;
+      if (elem == 4) {
+        int32_t* d32 = reinterpret_cast<int32_t*>(dst);
+        for (int64_t k = 0; k < nvals; k++)
+          d32[k] = (max_def_level == 0 || out_valid[row + k])
+                       ? (int32_t)deltas[s++] : 0;
+      } else {
+        int64_t* d64 = reinterpret_cast<int64_t*>(dst);
+        for (int64_t k = 0; k < nvals; k++)
+          d64[k] = (max_def_level == 0 || out_valid[row + k])
+                       ? deltas[s++] : 0;
+      }
+      delete[] deltas;
     } else {
       return -2;
     }

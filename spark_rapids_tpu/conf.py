@@ -462,6 +462,14 @@ PARQUET_NATIVE_DECODE = conf("srt.sql.format.parquet.nativeDecode.enabled") \
          "stage.)") \
     .boolean(True)
 
+ORC_NATIVE_DECODE = conf("srt.sql.format.orc.nativeDecode.enabled") \
+    .doc("Decode eligible ORC files (flat numeric schemas, "
+         "DIRECT_V2/RLEv2 with PRESENT streams, "
+         "NONE/ZLIB/SNAPPY/ZSTD) in the native C++ runtime; anything "
+         "outside the envelope falls back to pyarrow per file. "
+         "(GpuOrcScan.scala device-decode role, host-native stage.)") \
+    .boolean(True)
+
 SHUFFLE_FETCH_MAX_CONCURRENT = conf("srt.shuffle.fetch.maxConcurrent") \
     .doc("Peers fetched in parallel per reduce partition over the TCP "
          "shuffle transport (RapidsShuffleClient maxInFlight role).") \

@@ -1,0 +1,303 @@
+"""Native ORC column reader (VERDICT r3 #5; GpuOrcScan.scala's device
+decode role, ~2740 LoC in the reference).
+
+Division of labor: this module parses the COLD metadata path — ORC
+postscript, footer, and stripe footers are protobuf messages, walked
+with a ~60-line varint reader — and the HOT byte loops run in C++
+(native/orc_decode.cpp): compression deframing (zlib/snappy/zstd with
+ORC's 3-byte chunk headers), PRESENT boolean RLE, and integer RLEv2
+(SHORT_REPEAT / DIRECT / DELTA / PATCHED_BASE).
+
+Envelope: flat schemas of int/long/double/float columns with optional
+PRESENT streams, DIRECT(_V2) encodings, NONE/ZLIB/SNAPPY/ZSTD
+compression. Anything else -> None and the caller falls back to the
+pyarrow ORC reader for the file (same contract as native_parquet).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..columnar import dtypes as dt
+from ..plan.host_table import HostColumn, HostTable
+
+# orc proto CompressionKind -> native codec id
+_CODECS = {0: 0, 1: 1, 2: 2, 5: 3}  # NONE, ZLIB, SNAPPY, ZSTD
+
+# orc Type.Kind
+_K_INT = 3       # int32
+_K_LONG = 4
+_K_FLOAT = 5
+_K_DOUBLE = 6
+_K_SHORT = 2
+_K_STRUCT = 12
+
+_NUMERIC_KINDS = {_K_SHORT, _K_INT, _K_LONG, _K_FLOAT, _K_DOUBLE}
+
+
+class _Pb:
+    """Minimal protobuf wire-format walker."""
+
+    def __init__(self, data: bytes):
+        self.d = data
+        self.i = 0
+
+    def varint(self) -> int:
+        v = 0
+        s = 0
+        while True:
+            b = self.d[self.i]
+            self.i += 1
+            v |= (b & 0x7F) << s
+            if not b & 0x80:
+                return v
+            s += 7
+
+    def fields(self):
+        """Yield (field_number, wire_type, value) until exhausted;
+        value is int for varint, bytes for length-delimited."""
+        while self.i < len(self.d):
+            key = self.varint()
+            fn, wt = key >> 3, key & 7
+            if wt == 0:
+                yield fn, wt, self.varint()
+            elif wt == 2:
+                n = self.varint()
+                v = self.d[self.i:self.i + n]
+                self.i += n
+                yield fn, wt, v
+            elif wt == 5:
+                v = self.d[self.i:self.i + 4]
+                self.i += 4
+                yield fn, wt, v
+            elif wt == 1:
+                v = self.d[self.i:self.i + 8]
+                self.i += 8
+                yield fn, wt, v
+            else:
+                raise ValueError(f"orc: unsupported wire type {wt}")
+
+
+class _OrcMeta:
+    def __init__(self, path: str):
+        self.path = path
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            tail_len = min(size, 16 * 1024)
+            f.seek(size - tail_len)
+            tail = f.read(tail_len)
+            ps_len0 = tail[-1]
+            # wide/many-stripe footers exceed the first guess: re-read
+            # exactly what the postscript says (a clamped negative
+            # slice would silently truncate the footer)
+            ps_probe = _Pb(tail[-1 - ps_len0:-1])
+            probe_footer_len = 0
+            for fn_, _, v_ in ps_probe.fields():
+                if fn_ == 1:
+                    probe_footer_len = v_
+                    break
+            need = 1 + ps_len0 + probe_footer_len
+            if need > tail_len:
+                tail_len = min(size, need)
+                f.seek(size - tail_len)
+                tail = f.read(tail_len)
+        ps_len = tail[-1]
+        ps = _Pb(tail[-1 - ps_len:-1])
+        self.footer_len = 0
+        self.compression = 0
+        self.block_size = 256 * 1024
+        for fn, wt, v in ps.fields():
+            if fn == 1:
+                self.footer_len = v
+            elif fn == 2:
+                self.compression = v
+            elif fn == 3:
+                self.block_size = v
+        if self.compression not in _CODECS:
+            raise ValueError("orc: unsupported compression")
+        self.codec = _CODECS[self.compression]
+        foot_comp = tail[-1 - ps_len - self.footer_len:-1 - ps_len]
+        footer = _deframe(foot_comp, self.codec,
+                          max(self.footer_len * 30, 1 << 16))
+        self.stripes: List[Tuple[int, int, int, int, int]] = []
+        self.types: List[Tuple[int, List[int], List[str]]] = []
+        self.num_rows = 0
+        pb = _Pb(footer)
+        for fn, wt, v in pb.fields():
+            if fn == 3:        # StripeInformation
+                off = ilen = dlen = flen = rows = 0
+                for sfn, _, sv in _Pb(v).fields():
+                    if sfn == 1:
+                        off = sv
+                    elif sfn == 2:
+                        ilen = sv
+                    elif sfn == 3:
+                        dlen = sv
+                    elif sfn == 4:
+                        flen = sv
+                    elif sfn == 5:
+                        rows = sv
+                self.stripes.append((off, ilen, dlen, flen, rows))
+            elif fn == 4:      # Type
+                kind = 0
+                subs: List[int] = []
+                names: List[str] = []
+                for sfn, swt, sv in _Pb(v).fields():
+                    if sfn == 1:
+                        kind = sv
+                    elif sfn == 2:
+                        if swt == 0:
+                            subs.append(sv)
+                        else:  # packed
+                            p = _Pb(sv)
+                            while p.i < len(sv):
+                                subs.append(p.varint())
+                    elif sfn == 3:
+                        names.append(sv.decode())
+                self.types.append((kind, subs, names))
+            elif fn == 6:
+                self.num_rows = v
+
+
+def _deframe(data: bytes, codec: int, cap: int) -> bytes:
+    from ..native import orc_deframe
+    out = np.empty(cap, np.uint8)
+    got = orc_deframe(np.frombuffer(data, np.uint8), codec, out)
+    if got < 0:
+        raise ValueError(f"orc deframe failed ({got})")
+    return out[:got].tobytes()
+
+
+def _stripe_footer(meta: _OrcMeta, fh, stripe) -> Dict:
+    off, ilen, dlen, flen, rows = stripe
+    fh.seek(off + ilen + dlen)
+    raw = fh.read(flen)
+    footer = _deframe(raw, meta.codec, max(flen * 30, 1 << 16))
+    streams = []   # (kind, column, length)
+    encodings = []  # kind per column
+    for fn, wt, v in _Pb(footer).fields():
+        if fn == 1:
+            kind = col = length = 0
+            for sfn, _, sv in _Pb(v).fields():
+                if sfn == 1:
+                    kind = sv
+                elif sfn == 2:
+                    col = sv
+                elif sfn == 3:
+                    length = sv
+            streams.append((kind, col, length))
+        elif fn == 2:
+            ek = 0
+            for sfn, _, sv in _Pb(v).fields():
+                if sfn == 1:
+                    ek = sv
+            encodings.append(ek)
+    return {"streams": streams, "encodings": encodings}
+
+
+def read_orc_native(path: str, schema) -> Optional[HostTable]:
+    """Decode a whole ORC file natively -> HostTable, or None when the
+    file is outside the native envelope (pyarrow fallback)."""
+    from ..native import orc_bool_rle, orc_rlev2
+    try:
+        meta = _OrcMeta(path)
+    except Exception:
+        return None
+    if not meta.types or meta.types[0][0] != _K_STRUCT:
+        return None
+    root_kind, subs, names = meta.types[0]
+    by_name = {n: ci for n, ci in zip(names, subs)}
+    want = [n for n, _ in schema]
+    for n in want:
+        if n not in by_name:
+            return None
+        kind = meta.types[by_name[n]][0]
+        if kind not in _NUMERIC_KINDS:
+            return None
+    cols: Dict[str, List[np.ndarray]] = {n: [] for n in want}
+    masks: Dict[str, List[np.ndarray]] = {n: [] for n in want}
+    try:
+        with open(path, "rb") as fh:
+            for stripe in meta.stripes:
+                off, ilen, dlen, flen, rows = stripe
+                sf = _stripe_footer(meta, fh, stripe)
+                # stream offsets accumulate in footer order from the
+                # STRIPE START (row-index streams come first and are
+                # part of the walk)
+                pos = off
+                offsets = {}
+                for kind, col, length in sf["streams"]:
+                    offsets[(kind, col)] = (pos, length)
+                    pos += length
+                for n in want:
+                    ci = by_name[n]
+                    enc = sf["encodings"][ci] if ci < len(
+                        sf["encodings"]) else 0
+                    tkind = meta.types[ci][0]
+                    # PRESENT stream (kind 0)
+                    valid = np.ones(rows, np.uint8)
+                    if (0, ci) in offsets:
+                        spos, slen = offsets[(0, ci)]
+                        fh.seek(spos)
+                        raw = _deframe(fh.read(slen), meta.codec,
+                                       max(slen * 30, 1 << 14))
+                        got = orc_bool_rle(
+                            np.frombuffer(raw, np.uint8), valid, rows)
+                        if got != rows:
+                            return None
+                    nn = int(valid.sum())
+                    # DATA stream (kind 1)
+                    if (1, ci) not in offsets:
+                        if nn:
+                            return None
+                        data_nn = np.zeros(0, np.int64)
+                        raw = b""
+                    else:
+                        spos, slen = offsets[(1, ci)]
+                        fh.seek(spos)
+                        raw = _deframe(
+                            fh.read(slen), meta.codec,
+                            max(slen * 40, rows * 8 + (1 << 14)))
+                    if tkind in (_K_SHORT, _K_INT, _K_LONG):
+                        if enc not in (0, 2):
+                            return None
+                        if enc == 0:
+                            return None  # RLEv1: fall back
+                        vals = np.zeros(max(nn, 1), np.int64)
+                        got = orc_rlev2(np.frombuffer(raw, np.uint8),
+                                        1, vals, nn)
+                        if got != nn:
+                            return None
+                        data_nn = vals[:nn]
+                    elif tkind == _K_DOUBLE:
+                        if len(raw) < nn * 8:
+                            return None
+                        data_nn = np.frombuffer(raw[:nn * 8],
+                                                np.float64).copy()
+                    else:  # float
+                        if len(raw) < nn * 4:
+                            return None
+                        data_nn = np.frombuffer(
+                            raw[:nn * 4], np.float32).astype(np.float64)
+                    full = np.zeros(rows, np.float64 if tkind in
+                                    (_K_DOUBLE, _K_FLOAT) else np.int64)
+                    full[valid.astype(bool)] = data_nn
+                    cols[n].append(full)
+                    masks[n].append(valid.astype(bool))
+    except Exception:
+        return None
+    out_cols = []
+    for n, declared in schema:
+        vals = np.concatenate(cols[n]) if cols[n] else np.zeros(0)
+        mask = np.concatenate(masks[n]) if masks[n] else \
+            np.zeros(0, bool)
+        phys = np.dtype(declared.physical)
+        if vals.dtype != phys:
+            vals = vals.astype(phys)
+        out_cols.append(HostColumn(vals, mask, declared))
+    return HostTable(out_cols, [n for n, _ in schema])

@@ -34,9 +34,9 @@ _PHYS = {
     "FLOAT": (4, np.dtype(np.float32)),
     "DOUBLE": (5, np.dtype(np.float64)),
 }
-_CODECS = {"UNCOMPRESSED": 0, "SNAPPY": 1}
+_CODECS = {"UNCOMPRESSED": 0, "SNAPPY": 1, "GZIP": 2, "ZSTD": 3}
 _OK_ENCODINGS = {"PLAIN", "RLE", "PLAIN_DICTIONARY", "RLE_DICTIONARY",
-                 "BIT_PACKED"}
+                 "BIT_PACKED", "DELTA_BINARY_PACKED"}
 
 
 def _declared_ok(t: dt.DType) -> bool:

@@ -452,7 +452,6 @@ def iter_file_tables(path: str, fmt: str, schema: Schema,
             # iceberg merge-on-read position deletes: drop rows whose
             # in-file position is in the delete set, preserving order
             # (chunked stream => track the running file offset)
-            import numpy as np
             opts2 = {k: v for k, v in options.items()
                      if k != "__iceberg_pos_deletes"}
             # positions are RAW in-file row numbers: no row-level
@@ -544,6 +543,25 @@ def iter_file_tables(path: str, fmt: str, schema: Schema,
     elif fmt == "hivetext":
         table = _read_hivetext(path, options)
     elif fmt == "orc":
+        from ..conf import ORC_NATIVE_DECODE, active_conf
+        if (conf or active_conf()).get(ORC_NATIVE_DECODE) and \
+                not partition_values:
+            from .native_orc import read_orc_native
+            ht_native = read_orc_native(path, schema)
+            if ht_native is not None:
+                if ht_native.num_rows <= max_rows:
+                    # common case: no copy, yield the decoded table
+                    _apply_read_rebase(ht_native, options)
+                    yield ht_native
+                    return
+                for start in range(0, ht_native.num_rows, max_rows):
+                    idx = np.arange(
+                        start, min(start + max_rows,
+                                   ht_native.num_rows))
+                    ht = ht_native.take(idx)
+                    _apply_read_rebase(ht, options)
+                    yield ht
+                return
         import pyarrow.orc as orc
         f = orc.ORCFile(path)
         cols = names if set(names) <= set(f.schema.names) else None

@@ -30,7 +30,8 @@ import numpy as np
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 _SRCS = [os.path.join(_REPO_ROOT, "native", "tputable.cpp"),
-         os.path.join(_REPO_ROOT, "native", "parquet_decode.cpp")]
+         os.path.join(_REPO_ROOT, "native", "parquet_decode.cpp"),
+         os.path.join(_REPO_ROOT, "native", "orc_decode.cpp")]
 _BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
 
 _LIB = None
@@ -49,7 +50,7 @@ def _build_lib() -> str:
         tmp = so + ".tmp"
         subprocess.run(
             ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp]
-            + _SRCS,
+            + _SRCS + ["-lz", "-lzstd"],
             check=True, capture_output=True)
         os.replace(tmp, so)
     return so
@@ -102,6 +103,17 @@ def _lib() -> ctypes.CDLL:
                 u8p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
                 ctypes.c_int64, ctypes.c_int32, u8p, ctypes.c_int64,
                 u8p, u8p, ctypes.c_int64]
+            lib.orc_deframe.restype = ctypes.c_int64
+            lib.orc_deframe.argtypes = [u8p, ctypes.c_int64,
+                                        ctypes.c_int32, u8p,
+                                        ctypes.c_int64]
+            lib.orc_bool_rle.restype = ctypes.c_int64
+            lib.orc_bool_rle.argtypes = [u8p, ctypes.c_int64, u8p,
+                                         ctypes.c_int64]
+            lib.orc_rlev2.restype = ctypes.c_int64
+            lib.orc_rlev2.argtypes = [
+                u8p, ctypes.c_int64, ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
             _LIB = lib
         return _LIB
 
@@ -259,3 +271,29 @@ def native_available() -> bool:
         return True
     except Exception:
         return False
+
+
+def orc_deframe(src: np.ndarray, codec: int, dst: np.ndarray) -> int:
+    """ORC compression deframing (3-byte chunk headers over
+    zlib/snappy/zstd); returns decompressed length or negative error."""
+    lib = _lib()
+    return int(lib.orc_deframe(_u8ptr(src), len(src), codec,
+                               _u8ptr(dst), len(dst)))
+
+
+def orc_bool_rle(src: np.ndarray, out_valid: np.ndarray,
+                 count: int) -> int:
+    """PRESENT stream decode: byte-RLE bit bytes -> one u8 per value."""
+    lib = _lib()
+    return int(lib.orc_bool_rle(_u8ptr(src), len(src),
+                                _u8ptr(out_valid), count))
+
+
+def orc_rlev2(src: np.ndarray, is_signed: int, out: np.ndarray,
+              count: int) -> int:
+    """Integer RLEv2 decode into an int64 array."""
+    import ctypes as _ct
+    lib = _lib()
+    return int(lib.orc_rlev2(
+        _u8ptr(src), len(src), is_signed,
+        out.ctypes.data_as(_ct.POINTER(_ct.c_int64)), count))

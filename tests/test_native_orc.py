@@ -1,0 +1,102 @@
+"""Native ORC reader (VERDICT r3 #5; native/orc_decode.cpp +
+io/native_orc.py — GpuOrcScan.scala device-decode role): protobuf
+metadata walk + C++ deframe/RLEv2/bool-RLE, differential against both
+the raw written data and the engine's pyarrow fallback path."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+from pyarrow import orc
+
+import spark_rapids_tpu  # noqa: F401 (platform setup)
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.conf import SrtConf
+from spark_rapids_tpu.io.native_orc import read_orc_native
+from spark_rapids_tpu.plan import TpuSession
+
+SCHEMA = [("a", dt.INT64), ("b", dt.INT32), ("c", dt.FLOAT64),
+          ("d", dt.INT64)]
+
+
+def _write(tmp_path, comp, n=30_000, seed=1):
+    rng = np.random.default_rng(seed)
+    i64 = rng.integers(-10**12, 10**12, n)
+    i32 = rng.integers(-10**6, 10**6, n).astype(np.int32)
+    f64 = rng.random(n) * 1e6
+    seq = np.arange(n) * 5 - 1000
+    mask = rng.random(n) < 0.15
+    t = pa.table({
+        "a": pa.array(np.where(mask, 0, i64), mask=mask),
+        "b": pa.array(i32),
+        "c": pa.array(f64),
+        "d": pa.array(seq),
+    })
+    p = str(tmp_path / f"t_{comp}.orc")
+    orc.write_table(t, p, compression=comp)
+    return p, i64, i32, f64, seq, mask
+
+
+@pytest.mark.parametrize("comp", ["UNCOMPRESSED", "ZLIB", "SNAPPY",
+                                  "ZSTD"])
+def test_native_orc_roundtrip(tmp_path, comp):
+    p, i64, i32, f64, seq, mask = _write(tmp_path, comp)
+    ht = read_orc_native(p, SCHEMA)
+    assert ht is not None, "file must be inside the native envelope"
+    assert ht.num_rows == len(i64)
+    assert np.array_equal(ht.column("a").mask, ~mask)
+    assert np.array_equal(ht.column("a").values[~mask], i64[~mask])
+    assert np.array_equal(ht.column("b").values, i32)
+    assert np.allclose(ht.column("c").values, f64)
+    assert np.array_equal(ht.column("d").values, seq)
+
+
+def test_native_orc_matches_pyarrow_path(tmp_path):
+    """Engine differential: native decode vs the pyarrow fallback must
+    return identical query results."""
+    from spark_rapids_tpu.expr.aggregates import Sum
+    from spark_rapids_tpu.expr.core import Alias, col
+    p, *_ = _write(tmp_path, "ZLIB", n=20_000, seed=3)
+
+    def q(df):
+        return sorted(
+            (r["b"], round(r["s"], 6))
+            for r in df.group_by("b").agg(
+                Alias(Sum(col("c")), "s")).collect())
+    on = TpuSession(SrtConf(
+        {"srt.sql.format.orc.nativeDecode.enabled": True}))
+    off = TpuSession(SrtConf(
+        {"srt.sql.format.orc.nativeDecode.enabled": False}))
+    got_on = q(on.read.orc(p, schema=SCHEMA))
+    got_off = q(off.read.orc(p, schema=SCHEMA))
+    assert got_on == got_off and len(got_on) > 0
+
+
+def test_native_orc_string_falls_back(tmp_path):
+    """String columns are outside the envelope: None (pyarrow path),
+    never wrong results."""
+    t = pa.table({"s": pa.array(["x", "y", None]),
+                  "v": pa.array([1, 2, 3], pa.int64())})
+    p = str(tmp_path / "s.orc")
+    orc.write_table(t, p)
+    assert read_orc_native(p, [("s", dt.STRING), ("v", dt.INT64)]) \
+        is None
+    # and the engine still reads it correctly via the fallback
+    sess = TpuSession(SrtConf({}))
+    rows = sess.read.orc(p, schema=[("s", dt.STRING),
+                                    ("v", dt.INT64)]).collect()
+    assert [r["v"] for r in rows] == [1, 2, 3]
+    assert [r["s"] for r in rows] == ["x", "y", None]
+
+
+def test_native_orc_patched_base(tmp_path):
+    """Sparse huge outliers force PATCHED_BASE runs; entry widths round
+    to closestFixedBits(gap+patch) per the spec."""
+    rng = np.random.default_rng(3)
+    v = rng.integers(0, 100, 50_000)
+    out_idx = rng.choice(50_000, 300, replace=False)
+    v[out_idx] = rng.integers(10**14, 10**15, 300)
+    p = str(tmp_path / "pb.orc")
+    orc.write_table(pa.table({"x": pa.array(v)}), p, compression="ZLIB")
+    ht = read_orc_native(p, [("x", dt.INT64)])
+    assert ht is not None
+    assert np.array_equal(ht.column("x").values, v)
