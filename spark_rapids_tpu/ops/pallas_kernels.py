@@ -136,3 +136,99 @@ def tile_reduce(inputs: Sequence[jax.Array], row_fn: Callable,
         else:
             results.append(jnp.max(col))
     return results
+
+
+# ---------------------------------------------------------------------------
+# grouped aggregation: one-hot matmul segmented reduction (family #2)
+# ---------------------------------------------------------------------------
+
+GROUP_BUCKETS = 1024
+#: smaller row tile than tile_reduce: the (tile, B) one-hot must fit
+#: VMEM — 2048x1024 f32 = 8 MiB, within the ~16 MB/core budget
+#: (pallas_guide.md); 8192 rows would need 32 MiB and fail Mosaic
+GROUP_TILE_ROWS = 2048
+#: tiles per float32 accumulator block: bounds in-kernel running-sum
+#: round-off; blocks reduce OUTSIDE in float64 (same numerics contract
+#: as tile_reduce's per-tile partials)
+GROUP_ACC_TILES = 64
+
+
+def tile_group_reduce(gid: jax.Array, values: Sequence[jax.Array],
+                      num_buckets: int = GROUP_BUCKETS,
+                      tile_rows: int = GROUP_TILE_ROWS,
+                      interpret: Optional[bool] = None
+                      ) -> List[jax.Array]:
+    """Fused grouped SUM: one HBM pass, segmented reduction as a
+    ONE-HOT MATMUL so the per-tile reduction runs on the MXU instead of
+    a scatter (TPU scatters serialize; a (tile, B) one-hot against a
+    (tile, V) value block is exactly the systolic array's shape). The
+    XLA scatter-based path (ops/kernels.py group fns) stays the
+    fallback for large key domains.
+
+    ``gid``: int32[n] bucket ids in [0, num_buckets); masked-out rows
+    must carry values == 0 (sum identity) — their gid may be anything
+    in range. ``values``: 1-D float arrays. Returns one
+    float64-accumulated array of shape [num_buckets] per value column;
+    the caller maps buckets back to group keys.
+
+    Kernel structure: grid over row tiles; every GROUP_ACC_TILES tiles
+    share one (num_buckets, 128) float32 accumulator block (init on the
+    block's first tile, += on the rest — the sequential-TPU-grid
+    revisit pattern); blocks reduce outside in float64 so round-off is
+    bounded per 64-tile window instead of growing with the partition.
+    """
+    if interpret is None:
+        interpret = not on_tpu()
+    nv = len(values)
+    assert nv <= 128, "one accumulator lane column per value column"
+    assert num_buckets % 8 == 0, "sublane-aligned bucket count"
+    n = gid.shape[0]
+    tiles = max(1, -(-n // tile_rows))
+    padded = tiles * tile_rows
+    if padded != n:
+        # pad rows to a full tile: gid 0 with zero values (sum identity)
+        gid = jnp.pad(gid, (0, padded - n))
+        values = [jnp.pad(v, (0, padded - n)) for v in values]
+    blocks_n = -(-tiles // GROUP_ACC_TILES)
+
+    def kernel(gid_ref, *refs):
+        val_refs, out_ref = refs[:-1], refs[-1]
+        i = pl.program_id(0)
+        g = gid_ref[...]
+        # (tile_rows, B) one-hot on the fly; MXU contracts over rows
+        oh = (g[:, None] ==
+              jax.lax.broadcasted_iota(jnp.int32, (1, num_buckets), 1)
+              ).astype(jnp.float32)
+        vmat = jnp.stack(
+            [r[...].astype(jnp.float32) for r in val_refs], axis=1)
+        if nv < 128:
+            vmat = jnp.pad(vmat, ((0, 0), (0, 128 - nv)))
+        part = jax.lax.dot_general(
+            oh, vmat, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)   # (B, 128)
+
+        @pl.when(i % GROUP_ACC_TILES == 0)
+        def _init():
+            out_ref[...] = part
+
+        @pl.when(i % GROUP_ACC_TILES != 0)
+        def _acc():
+            out_ref[...] += part
+
+    specs = [pl.BlockSpec((tile_rows,), lambda i: (i,))]
+    specs += [pl.BlockSpec((tile_rows,), lambda i: (i,))
+              for _ in values]
+    out = pl.pallas_call(
+        kernel,
+        grid=(tiles,),
+        in_specs=specs,
+        out_specs=pl.BlockSpec((num_buckets, 128),
+                               lambda i: (i // GROUP_ACC_TILES, 0)),
+        out_shape=jax.ShapeDtypeStruct((blocks_n * num_buckets, 128),
+                                       jnp.float32),
+        interpret=interpret,
+    )(gid, *values)
+    acc_t = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    out = out.reshape(blocks_n, num_buckets, 128).astype(acc_t)
+    out = jnp.sum(out, axis=0)
+    return [out[:, j] for j in range(nv)]

@@ -184,3 +184,34 @@ def test_fused_int_sum_falls_back():
     rows, ctx = _run(df.agg(Alias(Sum(col("v")), "s")).plan, conf)
     assert _metric(ctx, "pallasBatches") == 0
     assert rows == [{"s": 3 * big + 3}]
+
+
+def test_tile_group_reduce_matches_numpy():
+    """Grouped one-hot-matmul sums == numpy scatter-add oracle."""
+    import numpy as np
+    import jax.numpy as jnp
+    from spark_rapids_tpu.ops.pallas_kernels import tile_group_reduce
+    rng = np.random.default_rng(0)
+    n = 40_000
+    gid = rng.integers(0, 37, n).astype(np.int32)
+    v1 = rng.random(n).astype(np.float32)
+    v2 = (rng.random(n) * 10).astype(np.float32)
+    outs = tile_group_reduce(jnp.asarray(gid),
+                             [jnp.asarray(v1), jnp.asarray(v2)])
+    e1 = np.zeros(1024); np.add.at(e1, gid, v1)
+    e2 = np.zeros(1024); np.add.at(e2, gid, v2)
+    assert np.allclose(np.asarray(outs[0]), e1, rtol=1e-4)
+    assert np.allclose(np.asarray(outs[1]), e2, rtol=1e-4)
+
+
+def test_tile_group_reduce_ragged_tail():
+    import numpy as np
+    import jax.numpy as jnp
+    from spark_rapids_tpu.ops.pallas_kernels import tile_group_reduce
+    rng = np.random.default_rng(1)
+    n = 8 * 1024 + 333   # forces tail padding
+    gid = rng.integers(0, 5, n).astype(np.int32)
+    v = rng.random(n).astype(np.float32)
+    (out,) = tile_group_reduce(jnp.asarray(gid), [jnp.asarray(v)])
+    e = np.zeros(1024); np.add.at(e, gid, v)
+    assert np.allclose(np.asarray(out), e, rtol=1e-4)

@@ -93,6 +93,29 @@ def run_smoke(attempt: int) -> None:
     log(f"smoke: passed={rec['passed']}")
 
 
+def run_roofline() -> None:
+    try:
+        r = subprocess.run(
+            [sys.executable, "tools/roofline.py"], capture_output=True,
+            env=tpu_env(), cwd=ROOT, timeout=900)
+        lines = [ln for ln in r.stdout.decode("utf-8", "replace")
+                 .splitlines() if ln.startswith("{")]
+        if lines:
+            rec = json.loads(lines[-1])
+            if rec.get("backend") != "cpu":
+                with open(os.path.join(ROOT,
+                                       "ROOFLINE_TPU_last_good.json"),
+                          "w") as f:
+                    json.dump(rec, f, indent=1)
+                log("roofline: TPU table saved")
+            else:
+                log("roofline: ran on cpu fallback; not recorded")
+        else:
+            log(f"roofline: no output (rc={r.returncode})")
+    except Exception as e:
+        log(f"roofline failed: {e}")
+
+
 def run_bench() -> bool:
     env = tpu_env()
     env["SRT_BENCH_BUDGET"] = env.get("SRT_BENCH_BUDGET", "600")
@@ -135,6 +158,7 @@ def main() -> None:
         if up:
             run_smoke(attempt)
             run_bench()
+            run_roofline()
             # a good record exists; keep refreshing but back off hard
             time.sleep(max(INTERVAL_S * 3, 1800))
         else:
