@@ -1,64 +1,346 @@
-"""Cached batch serializer: df.cache() as compressed host blocks.
+"""Cached batch serializer: df.cache() as compressed columnar blocks.
 
-Rebuild of ParquetCachedBatchSerializer.scala (SURVEY §2.8, 1407 LoC):
-the reference stores df.cache() data as parquet-encoded blobs that the
-GPU can (de)compress; here cached plans materialize once into the
-framework's own wire format (parallel/serializer.py) with the native
-LZ4 codec — compressed host memory, re-uploaded in capacity-bucketed
-batches on each reuse.
+Rebuild of ParquetCachedBatchSerializer.scala (SURVEY §2.8, 1407 LoC).
+The reference stores df.cache() data as parquet-encoded blobs the GPU
+(de)compresses, reads back a pruned column subset when the plan above
+the cache only needs some attributes, and keeps the blobs under host
+memory management. The TPU-native equivalent here:
+
+- each cached batch is serialized **per column** through the
+  framework's own wire format (parallel/serializer.py) with the native
+  LZ4 codec — so a projection over the cache decompresses only the
+  columns it references (the parquet-blob column-pruning role,
+  ParquetCachedBatchSerializer.scala "selectedAttributes" path);
+- blocks live in a `_BlockStore` under `srt.cache.hostLimitBytes`;
+  overflow tiers to a single append-only spill file on disk and reads
+  stream back on demand (the host-memory-management role);
+- `prune_scan_columns` (plan/overrides.py) narrows a CachedRelation
+  exactly like a FileScan, via `with_schema`;
+- `DataFrame.unpersist()` releases memory + disk and unregisters from
+  the session's cache registry (leak accounting).
+
+Nested (list/struct) and decimal128 columns don't have a flat wire
+encoding, so each cached column is one recursive FRAME: leaf frames are
+single-column wire batches (parallel/serializer.py); a list frame is a
+lengths leaf + a child frame over the packed elements; a struct frame
+is a validity leaf + named field frames; a decimal128 frame is hi/lo
+int64 leaves. Every column of every type is therefore independently
+compressed AND independently prunable.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+import os
+import struct
+import tempfile
+import threading
+from typing import Dict, List, Optional, Tuple
 
-from .columnar.vector import ColumnarBatch
+import numpy as np
+
+from .columnar import dtypes as dt
+from .columnar.vector import ColumnarBatch, ColumnVector
+from .conf import CACHE_HOST_LIMIT_BYTES
 from .plan import logical as L
-from .plan.host_table import batch_to_table, table_to_batch
+from .plan.host_table import table_to_batch
 from .parallel.serializer import deserialize_batch, serialize_batch
 
 
-class CachedRelation(L.LogicalPlan):
-    """Leaf node holding the materialized, compressed result."""
+# --- recursive column frames ----------------------------------------------
 
-    def __init__(self, blocks: List[bytes], schema, num_rows: int):
+def _leaf(col, name: str, n, codec: str) -> bytes:
+    blob = serialize_batch(ColumnarBatch([col], [name], n),
+                           compress=True, codec=codec)
+    return struct.pack("<BI", 0, len(blob)) + blob
+
+
+def _encode_column(name: str, col, n: int, codec: str) -> bytes:
+    """One frame: kind byte + payload (see module docstring)."""
+    from .columnar.decimal128 import Decimal128Column
+    from .columnar.nested import ListColumn, StructColumn
+    import jax.numpy as jnp
+    if isinstance(col, ListColumn):
+        lens = jnp.where(col.validity, col.lengths(), 0).astype(jnp.int32)
+        lcol = ColumnVector(lens, col.validity, dt.INT32)
+        live = int(np.asarray(col.offsets)[int(n)])
+        is_map = 1 if isinstance(col.dtype, dt.MapType) else 0
+        return (struct.pack("<BB", 1, is_map)
+                + _leaf(lcol, name, n, codec)
+                + _encode_column(name + "#child", col.child, live, codec))
+    if isinstance(col, StructColumn):
+        head = struct.pack("<BH", 2, len(col.children))
+        vcol = ColumnVector(col.validity,
+                            jnp.ones_like(col.validity), dt.BOOL)
+        parts = [head, _leaf(vcol, name, n, codec)]
+        for (fname, _ft), child in zip(col.dtype.fields, col.children):
+            nb = fname.encode("utf-8")
+            parts.append(struct.pack("<H", len(nb)) + nb)
+            parts.append(_encode_column(fname, child, n, codec))
+        return b"".join(parts)
+    if isinstance(col, Decimal128Column):
+        tag = f"{col.dtype.precision},{col.dtype.scale}".encode()
+        hi = ColumnVector(col.hi, col.validity, dt.INT64)
+        lo_i = jnp.asarray(np.asarray(col.lo).view(np.int64))
+        lo = ColumnVector(lo_i, col.validity, dt.INT64)
+        return (struct.pack("<BH", 3, len(tag)) + tag
+                + _leaf(hi, name, n, codec) + _leaf(lo, name, n, codec))
+    return _leaf(col, name, n, codec)
+
+
+def _decode_column(view, pos: int = 0):
+    """Inverse of _encode_column: -> (column, name, num_rows, pos)."""
+    from .columnar.decimal128 import Decimal128Column
+    from .columnar.nested import ListColumn, StructColumn
+    import jax.numpy as jnp
+    kind = view[pos]
+    pos += 1
+    if kind == 0:
+        (ln,) = struct.unpack_from("<I", view, pos)
+        pos += 4
+        b = deserialize_batch(bytes(view[pos:pos + ln]))
+        return b.columns[0], b.names[0], int(b.num_rows), pos + ln
+    if kind == 1:
+        is_map = view[pos]
+        pos += 1
+        lcol, name, n, pos = _decode_column(view, pos)
+        child, _cn, _live, pos = _decode_column(view, pos)
+        offsets = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32),
+             jnp.cumsum(lcol.data.astype(jnp.int32), dtype=jnp.int32)])
+        map_type = None
+        if is_map:
+            fs = child.dtype.fields
+            map_type = dt.MapType(fs[0][1], fs[1][1])
+        return (ListColumn(offsets, child, lcol.validity, child.dtype,
+                           map_type=map_type), name, n, pos)
+    if kind == 2:
+        (nfields,) = struct.unpack_from("<H", view, pos)
+        pos += 2
+        vcol, name, n, pos = _decode_column(view, pos)
+        kids, fields = [], []
+        for _ in range(nfields):
+            (ln,) = struct.unpack_from("<H", view, pos)
+            pos += 2
+            fname = bytes(view[pos:pos + ln]).decode("utf-8")
+            pos += ln
+            child, _cn, _n2, pos = _decode_column(view, pos)
+            kids.append(child)
+            fields.append((fname, child.dtype))
+        validity = vcol.data.astype(bool) & vcol.validity
+        return (StructColumn(kids, validity, dt.StructType(fields)),
+                name, n, pos)
+    if kind == 3:
+        (ln,) = struct.unpack_from("<H", view, pos)
+        pos += 2
+        p, s = bytes(view[pos:pos + ln]).decode().split(",")
+        pos += ln
+        hi, name, n, pos = _decode_column(view, pos)
+        lo, _n2, _n3, pos = _decode_column(view, pos)
+        lo_u = jnp.asarray(np.asarray(lo.data).view(np.uint64))
+        return (Decimal128Column(hi.data, lo_u, hi.validity,
+                                 dt.DecimalType(int(p), int(s))),
+                name, n, pos)
+    raise ValueError(f"bad cache frame kind {kind}")
+
+
+class _Block:
+    """One compressed chunk; in host memory, at [off, off+len) on disk,
+    or released (``off == _RELEASED``)."""
+
+    __slots__ = ("data", "off", "length")
+
+    def __init__(self, data: bytes):
+        self.data: Optional[bytes] = data
+        self.off = -1
+        self.length = len(data)
+
+
+_RELEASED = -2
+
+
+class _BlockStore:
+    """SESSION-shared block arena: one host-memory budget across every
+    cached DataFrame, with a disk overflow tier.
+
+    Keeps blocks in memory up to ``limit`` bytes TOTAL (caching N
+    DataFrames shares one budget — the reference's cached-batch blobs
+    are likewise under one host memory manager); older blocks overflow
+    to one append-only spill file, read back per-block on demand.
+    ``release(blocks)`` (df.unpersist) frees the memory immediately and
+    tombstones the blocks — later reads raise instead of returning
+    stale bytes; the spill file unlinks once its last live block is
+    released."""
+
+    def __init__(self, limit: int, spill_dir: Optional[str] = None):
+        self.limit = limit
+        self._dir = spill_dir
+        self._mem: List[_Block] = []     # FIFO of in-memory blocks
+        self._mem_bytes = 0
+        self._file = None
+        self._file_path: Optional[str] = None
+        self._file_end = 0
+        self._disk_live = 0
+        self._lock = threading.Lock()
+
+    def put(self, payload: bytes) -> _Block:
+        b = _Block(payload)
+        with self._lock:
+            self._mem.append(b)
+            self._mem_bytes += b.length
+            self._enforce_limit()
+        return b
+
+    def _enforce_limit(self) -> None:
+        while self._mem_bytes > self.limit and self._mem:
+            victim = self._mem.pop(0)
+            if self._file is None:
+                fd, self._file_path = tempfile.mkstemp(
+                    prefix="srt_cache_", suffix=".blocks", dir=self._dir)
+                self._file = os.fdopen(fd, "wb+")
+            self._file.seek(self._file_end)
+            self._file.write(victim.data)
+            victim.off = self._file_end
+            self._file_end += victim.length
+            self._mem_bytes -= victim.length
+            self._disk_live += 1
+            victim.data = None
+        if self._file is not None:
+            self._file.flush()
+
+    def read(self, b: _Block) -> bytes:
+        with self._lock:
+            if b.off == _RELEASED:
+                raise RuntimeError(
+                    "cached block read after unpersist() released it")
+            if b.data is not None:
+                return b.data
+            self._file.seek(b.off)
+            return self._file.read(b.length)
+
+    def release(self, blocks) -> None:
+        """Free one relation's blocks (df.unpersist): drop in-memory
+        payloads now, tombstone everything, unlink the spill file when
+        its last live block goes."""
+        with self._lock:
+            for b in blocks:
+                if b.off == _RELEASED:
+                    continue
+                if b.data is not None:
+                    try:
+                        self._mem.remove(b)
+                        self._mem_bytes -= b.length
+                    except ValueError:
+                        pass
+                    b.data = None
+                elif b.off >= 0:
+                    self._disk_live -= 1
+                b.off = _RELEASED
+            if self._file is not None and self._disk_live <= 0:
+                self._file.close()
+                try:
+                    os.unlink(self._file_path)
+                except OSError:
+                    pass
+                self._file = None
+                self._file_path = None
+                self._file_end = 0
+
+    def stats(self) -> Dict[str, int]:
+        return {"mem_bytes": self._mem_bytes,
+                "disk_bytes": self._file_end,
+                "blocks_mem": sum(1 for b in self._mem),
+                }
+
+
+class CachedRelation(L.LogicalPlan):
+    """Leaf node over the materialized, compressed, prunable cache.
+
+    ``chunks`` is one dict per cached batch: column name -> _Block,
+    every column (nested and decimal128 included) as its own recursive
+    frame. Narrowed copies produced by ``with_schema`` share the
+    chunks + store; only the schema (the decode column set) differs.
+    """
+
+    def __init__(self, store: _BlockStore,
+                 chunks: List[Dict[str, _Block]], schema,
+                 num_rows: int, session=None):
         super().__init__()
-        self.blocks = blocks
+        self.store = store
+        self.chunks = chunks
         self._schema = list(schema)
         self.num_rows = num_rows
+        self._session = session
 
     @property
     def schema(self):
         return self._schema
 
+    def with_schema(self, keep) -> "CachedRelation":
+        """Pruned view decoding only ``keep`` (ColumnPruning hook)."""
+        return CachedRelation(self.store, self.chunks, keep,
+                              self.num_rows, self._session)
+
     def batches(self) -> List[ColumnarBatch]:
-        return [deserialize_batch(b) for b in self.blocks]
+        out = []
+        for chunk in self.chunks:
+            cols, names, nrows = [], [], 0
+            for name, _t in self._schema:
+                col, _n, nrows, _pos = _decode_column(
+                    memoryview(self.store.read(chunk[name])))
+                cols.append(col)
+                names.append(name)
+            out.append(ColumnarBatch(cols, names, nrows))
+        return out
+
+    def unpersist(self) -> None:
+        self.store.release([b for c in self.chunks for b in c.values()])
+        if self._session is not None:
+            self._session._cached_relations = [
+                r for r in getattr(self._session, "_cached_relations", [])
+                if r.chunks is not self.chunks]
 
     def node_description(self) -> str:
-        nbytes = sum(len(b) for b in self.blocks)
+        st = self.store.stats()
         return (f"CachedRelation[{self.num_rows} rows, "
-                f"{len(self.blocks)} blocks, {nbytes}B]")
+                f"{len(self.chunks)} batches, {len(self._schema)} cols, "
+                f"mem={st['mem_bytes']}B disk={st['disk_bytes']}B]")
 
 
 def cache_dataframe(df):
-    """Materialize df's plan once; return a DataFrame over the cache."""
+    """Materialize df's plan once; return a DataFrame over the cache.
+
+    InMemoryRelation + ParquetCachedBatchSerializer.convertToColumnarIfNeeded
+    role: one pass over the child plan, per-column compressed blocks,
+    re-batched by srt.sql.batchSizeRows on reuse.
+    """
     from .native import native_available
     from .plan.session import DataFrame
-    codec = "lz4" if native_available() else "zstd"
-    table = df.session.execute(df.plan)
-    # one block per target batch size so reuse re-batches sanely
     from .conf import BATCH_SIZE_ROWS
-    per = df.session.conf.get(BATCH_SIZE_ROWS)
-    import numpy as np
-    blocks = []
+    codec = "lz4" if native_available() else "zstd"
+    session = df.session
+    table = session.execute(df.plan)
+    per = session.conf.get(BATCH_SIZE_ROWS)
+    # ONE store per session: every cached DataFrame shares the
+    # srt.cache.hostLimitBytes budget
+    store = getattr(session, "_cache_store", None)
+    if store is None:
+        store = _BlockStore(session.conf.get(CACHE_HOST_LIMIT_BYTES))
+        session._cache_store = store
+    schema = list(df.plan.schema)
+    chunks: List[Dict[str, _Block]] = []
     n = table.num_rows
     for start in range(0, max(n, 1), per):
-        chunk = table.take(np.arange(start, min(start + per, n)))
-        if chunk.num_rows == 0 and start > 0:
+        idx = np.arange(start, min(start + per, n))
+        if len(idx) == 0 and start > 0:
             break
-        blocks.append(serialize_batch(table_to_batch(chunk),
-                                      compress=True, codec=codec))
-    rel = CachedRelation(blocks, df.plan.schema, n)
-    return DataFrame(df.session, rel)
-
-
+        batch = table_to_batch(table.take(idx))
+        chunk: Dict[str, _Block] = {}
+        for name, col in zip(batch.names, batch.columns):
+            chunk[name] = store.put(
+                _encode_column(name, col, int(batch.num_rows), codec))
+        chunks.append(chunk)
+    rel = CachedRelation(store, chunks, schema, n, session)
+    if not hasattr(session, "_cached_relations"):
+        session._cached_relations = []
+    session._cached_relations.append(rel)
+    return DataFrame(session, rel)

@@ -156,6 +156,12 @@ BATCH_SIZE_BYTES = conf("srt.sql.batchSizeBytes") \
          "(spark.rapids.sql.batchSizeBytes)") \
     .check(_positive).bytes_(1 << 30)
 
+CACHE_HOST_LIMIT_BYTES = conf("srt.cache.hostLimitBytes") \
+    .doc("Host-memory budget for df.cache() compressed blocks; overflow "
+         "tiers to an append-only disk file read back per block. "
+         "(ParquetCachedBatchSerializer host blob management)") \
+    .check(_positive).bytes_(256 << 20)
+
 CONCURRENT_TASKS = conf("srt.sql.concurrentTpuTasks") \
     .doc("Number of host threads allowed to submit device work "
          "concurrently. (spark.rapids.sql.concurrentGpuTasks, "

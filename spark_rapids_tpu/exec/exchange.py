@@ -262,6 +262,10 @@ class ShuffleExchangeExec(TpuExec):
         if self._written:
             return
         self._written = True
+        # per-run drain budget: the planner counts how many tree edges
+        # drain this exchange (a subtree shared by the two halves of a
+        # full-outer union drains twice); blocks free on the LAST drain
+        self._consumers = getattr(self, "_planned_consumers", 1)
         mgr = self.manager or shuffle_manager()
         n_parts = self._effective_parts(ctx)
         mgr.register_shuffle(self.shuffle_id, n_parts)
@@ -336,6 +340,16 @@ class ShuffleExchangeExec(TpuExec):
             part_time.add(time.perf_counter_ns() - t0)
             write_rows.add(rows_written)
             map_id += 1
+
+    def _release(self, mgr) -> None:
+        """One consumer finished a full drain. Shared subtrees (the two
+        halves of a full-outer union both reference this instance) mean
+        multiple drains per run; only the last one frees the blocks —
+        an eager unregister would break the sibling's re-read (the
+        round-4 FULL OUTER JOIN + AQE KeyError)."""
+        self._consumers = getattr(self, "_consumers", 1) - 1
+        if self._consumers <= 0:
+            mgr.unregister_shuffle(self.shuffle_id)
 
     # kept for existing callers/tests
     def write(self, ctx: ExecContext) -> None:
@@ -438,7 +452,7 @@ class ShuffleExchangeExec(TpuExec):
             for gi, g in enumerate(groups):
                 yield read_group(gi, g)
         finally:
-            mgr.unregister_shuffle(self.shuffle_id)
+            self._release(mgr)
 
     def execute_partitioned(self, ctx: ExecContext):
         """One iterator per reduce partition, in partition order.
@@ -477,7 +491,7 @@ class ShuffleExchangeExec(TpuExec):
             for reduce_id in range(n_parts):
                 yield local_read(reduce_id)
         finally:
-            mgr.unregister_shuffle(self.shuffle_id)
+            self._release(mgr)
 
     def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
         """Single-stream execution: write all map outputs, then stream

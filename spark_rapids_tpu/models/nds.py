@@ -1,4 +1,4 @@
-"""NDS (TPC-DS derived) schema + a 24-query power-run subset as SQL
+"""NDS (TPC-DS derived) schema + the full 99-query power run as SQL
 text (BASELINE.md config 2 breadth; reference integration_tests run the
 99-query suite the same way — SQL text against generated tables).
 
@@ -119,6 +119,12 @@ def nds_specs(scale_rows: int):
         _sales_money("cs_ext_sales_price"),
         _sales_money("cs_ext_wholesale_cost"),
         _sales_money("cs_ext_ship_cost", 0.0, 80.0),
+        _sales_money("cs_ext_list_price", 1.0, 1000.0),
+        _sales_money("cs_coupon_amt", 0.0, 50.0),
+        ColumnSpec("cs_catalog_page_sk", dt.INT64, "uniform", lo=1,
+                   hi=40, null_prob=0.02),
+        ColumnSpec("cs_sold_time_sk", dt.INT64, "uniform", lo=1,
+                   hi=1000, null_prob=0.01),
         ColumnSpec("cs_net_profit", dt.FLOAT64, "normal", mean=25.0,
                    std=50.0, null_prob=0.02),
     ], max(scale_rows // 2, 10))
@@ -152,6 +158,9 @@ def nds_specs(scale_rows: int):
         _sales_money("ws_ext_wholesale_cost"),
         _sales_money("ws_net_paid"),
         _sales_money("ws_ext_ship_cost", 0.0, 80.0),
+        _sales_money("ws_list_price", 1.0, 300.0),
+        ColumnSpec("ws_ship_hdemo_sk", dt.INT64, "uniform", lo=1,
+                   hi=_HDEMOS, null_prob=0.02),
         ColumnSpec("ws_net_profit", dt.FLOAT64, "normal", mean=25.0,
                    std=50.0, null_prob=0.02),
     ], max(scale_rows // 4, 10))
@@ -777,46 +786,6 @@ NDS_QUERIES: Dict[str, str] = {
         WHERE d_year = 1999
         GROUP BY d_moy
         ORDER BY d_moy""",
-    # channel union rollup (q5 family shape: UNION ALL of channels)
-    "q5u": """
-        SELECT channel, SUM(sales) AS total_sales,
-               SUM(profit) AS total_profit
-        FROM (SELECT 'store channel' AS channel,
-                     ss_ext_sales_price AS sales,
-                     ss_net_profit AS profit
-              FROM store_sales
-              JOIN date_dim ON ss_sold_date_sk = d_date_sk
-              WHERE d_year = 1999
-              UNION ALL
-              SELECT 'catalog channel' AS channel,
-                     cs_ext_sales_price AS sales,
-                     cs_net_profit AS profit
-              FROM catalog_sales
-              JOIN date_dim ON cs_sold_date_sk = d_date_sk
-              WHERE d_year = 1999
-              UNION ALL
-              SELECT 'web channel' AS channel,
-                     ws_ext_sales_price AS sales,
-                     ws_net_profit AS profit
-              FROM web_sales
-              JOIN date_dim ON ws_sold_date_sk = d_date_sk
-              WHERE d_year = 1999) all_channels
-        GROUP BY channel
-        ORDER BY channel""",
-    # rank window over aggregated revenue (q67 family shape)
-    "q67r": """
-        SELECT d_year, i_category, revenue, rk
-        FROM (SELECT d_year, i_category,
-                     SUM(ss_ext_sales_price) AS revenue,
-                     RANK() OVER (PARTITION BY d_year
-                                  ORDER BY SUM(ss_ext_sales_price)
-                                  DESC) AS rk
-              FROM store_sales
-              JOIN date_dim ON ss_sold_date_sk = d_date_sk
-              JOIN item ON ss_item_sk = i_item_sk
-              GROUP BY d_year, i_category) ranked
-        WHERE rk <= 5
-        ORDER BY d_year, rk, i_category""",
     # CTE + correlated scalar: customers returning >1.2x the store avg
     "q1": """
         WITH customer_total_return AS (
@@ -1943,4 +1912,1225 @@ NDS_QUERIES: Dict[str, str] = {
               JOIN date_dim ON ws_sold_date_sk = d_date_sk
               JOIN customer ON ws_bill_customer_sk = c_customer_sk
               WHERE d_month_seq BETWEEN 1176 AND 1187) cool_cust""",
+    # 3-channel year-over-year customer growth, 6-way CTE self-join
+    # (q4)
+    "q4": """
+        WITH year_total AS (
+            SELECT c_customer_id AS customer_id, d_year AS dyear,
+                   SUM((ss_ext_list_price - ss_ext_wholesale_cost
+                        - ss_ext_discount_amt) / 2) AS year_total,
+                   's' AS sale_type
+            FROM customer
+            JOIN store_sales ON c_customer_sk = ss_customer_sk
+            JOIN date_dim ON ss_sold_date_sk = d_date_sk
+            GROUP BY c_customer_id, d_year
+            UNION ALL
+            SELECT c_customer_id AS customer_id, d_year AS dyear,
+                   SUM((cs_ext_list_price - cs_ext_wholesale_cost
+                        - cs_ext_discount_amt) / 2) AS year_total,
+                   'c' AS sale_type
+            FROM customer
+            JOIN catalog_sales ON c_customer_sk = cs_bill_customer_sk
+            JOIN date_dim ON cs_sold_date_sk = d_date_sk
+            GROUP BY c_customer_id, d_year
+            UNION ALL
+            SELECT c_customer_id AS customer_id, d_year AS dyear,
+                   SUM((ws_ext_sales_price - ws_ext_wholesale_cost
+                        - ws_ext_discount_amt) / 2) AS year_total,
+                   'w' AS sale_type
+            FROM customer
+            JOIN web_sales ON c_customer_sk = ws_bill_customer_sk
+            JOIN date_dim ON ws_sold_date_sk = d_date_sk
+            GROUP BY c_customer_id, d_year)
+        SELECT t_s_secyear.customer_id
+        FROM year_total t_s_firstyear
+        JOIN year_total t_s_secyear
+          ON t_s_secyear.customer_id = t_s_firstyear.customer_id
+        JOIN year_total t_c_firstyear
+          ON t_s_firstyear.customer_id = t_c_firstyear.customer_id
+        JOIN year_total t_c_secyear
+          ON t_s_firstyear.customer_id = t_c_secyear.customer_id
+        JOIN year_total t_w_firstyear
+          ON t_s_firstyear.customer_id = t_w_firstyear.customer_id
+        JOIN year_total t_w_secyear
+          ON t_s_firstyear.customer_id = t_w_secyear.customer_id
+        WHERE t_s_firstyear.sale_type = 's'
+          AND t_c_firstyear.sale_type = 'c'
+          AND t_w_firstyear.sale_type = 'w'
+          AND t_s_secyear.sale_type = 's'
+          AND t_c_secyear.sale_type = 'c'
+          AND t_w_secyear.sale_type = 'w'
+          AND t_s_firstyear.dyear = 1998
+          AND t_s_secyear.dyear = 1999
+          AND t_c_firstyear.dyear = 1998
+          AND t_c_secyear.dyear = 1999
+          AND t_w_firstyear.dyear = 1998
+          AND t_w_secyear.dyear = 1999
+          AND t_s_firstyear.year_total > 0
+          AND t_c_firstyear.year_total > 0
+          AND t_w_firstyear.year_total > 0
+          AND t_c_secyear.year_total / t_c_firstyear.year_total >
+              t_s_secyear.year_total / t_s_firstyear.year_total
+          AND t_c_secyear.year_total / t_c_firstyear.year_total >
+              t_w_secyear.year_total / t_w_firstyear.year_total
+        ORDER BY t_s_secyear.customer_id
+        LIMIT 100""",
+    # per-channel sales+returns union, ROLLUP(channel, id) (q5)
+    "q5": """
+        WITH ssr AS (
+            SELECT s_store_id AS id, SUM(sales_price) AS sales,
+                   SUM(return_amt) AS returns_amt,
+                   SUM(profit) - SUM(net_loss) AS profit
+            FROM (SELECT ss_store_sk AS store_sk,
+                         ss_sold_date_sk AS date_sk,
+                         ss_ext_sales_price AS sales_price,
+                         ss_net_profit AS profit,
+                         0.0 AS return_amt, 0.0 AS net_loss
+                  FROM store_sales
+                  UNION ALL
+                  SELECT sr_store_sk AS store_sk,
+                         sr_returned_date_sk AS date_sk,
+                         0.0 AS sales_price, 0.0 AS profit,
+                         sr_return_amt AS return_amt,
+                         sr_net_loss AS net_loss
+                  FROM store_returns) salesreturns
+            JOIN date_dim ON date_sk = d_date_sk
+            JOIN store ON store_sk = s_store_sk
+            WHERE d_year = 1998 AND d_moy = 8
+            GROUP BY s_store_id),
+        csr AS (
+            SELECT cp_catalog_page_id AS id, SUM(sales_price) AS sales,
+                   SUM(return_amt) AS returns_amt,
+                   SUM(profit) - SUM(net_loss) AS profit
+            FROM (SELECT cs_catalog_page_sk AS page_sk,
+                         cs_sold_date_sk AS date_sk,
+                         cs_ext_sales_price AS sales_price,
+                         cs_net_profit AS profit,
+                         0.0 AS return_amt, 0.0 AS net_loss
+                  FROM catalog_sales
+                  UNION ALL
+                  SELECT cr_catalog_page_sk AS page_sk,
+                         cr_returned_date_sk AS date_sk,
+                         0.0 AS sales_price, 0.0 AS profit,
+                         cr_return_amount AS return_amt,
+                         cr_net_loss AS net_loss
+                  FROM catalog_returns) salesreturns
+            JOIN date_dim ON date_sk = d_date_sk
+            JOIN catalog_page ON page_sk = cp_catalog_page_sk
+            WHERE d_year = 1998 AND d_moy = 8
+            GROUP BY cp_catalog_page_id),
+        wsr AS (
+            SELECT web_site_id AS id, SUM(sales_price) AS sales,
+                   SUM(return_amt) AS returns_amt,
+                   SUM(profit) - SUM(net_loss) AS profit
+            FROM (SELECT ws_web_site_sk AS site_sk,
+                         ws_sold_date_sk AS date_sk,
+                         ws_ext_sales_price AS sales_price,
+                         ws_net_profit AS profit,
+                         0.0 AS return_amt, 0.0 AS net_loss
+                  FROM web_sales
+                  UNION ALL
+                  SELECT ws_web_site_sk AS site_sk,
+                         wr_returned_date_sk AS date_sk,
+                         0.0 AS sales_price, 0.0 AS profit,
+                         wr_return_amt AS return_amt,
+                         wr_net_loss AS net_loss
+                  FROM web_returns
+                  JOIN web_sales ON wr_item_sk = ws_item_sk
+                       AND wr_order_number = ws_order_number)
+                 salesreturns
+            JOIN date_dim ON date_sk = d_date_sk
+            JOIN web_site ON site_sk = web_site_sk
+            WHERE d_year = 1998 AND d_moy = 8
+            GROUP BY web_site_id)
+        SELECT channel, id, SUM(sales) AS sales,
+               SUM(returns_amt) AS returns_amt, SUM(profit) AS profit
+        FROM (SELECT 'store channel' AS channel, id, sales,
+                     returns_amt, profit
+              FROM ssr
+              UNION ALL
+              SELECT 'catalog channel' AS channel, id, sales,
+                     returns_amt, profit
+              FROM csr
+              UNION ALL
+              SELECT 'web channel' AS channel, id, sales,
+                     returns_amt, profit
+              FROM wsr) x
+        GROUP BY ROLLUP (channel, id)
+        ORDER BY channel NULLS LAST, id NULLS LAST
+        LIMIT 100""",
+    # cross-channel INTERSECT of brand/class/category + avg-sales
+    # gate + ROLLUP (q14)
+    "q14": """
+        WITH cross_items AS (
+            SELECT i_item_sk AS item_sk
+            FROM item
+            JOIN (SELECT iss.i_brand_id AS brand_id,
+                         iss.i_class_id AS class_id,
+                         iss.i_category_id AS category_id
+                  FROM store_sales
+                  JOIN item iss ON ss_item_sk = iss.i_item_sk
+                  JOIN date_dim d1 ON ss_sold_date_sk = d1.d_date_sk
+                  WHERE d1.d_year = 1999
+                  INTERSECT
+                  SELECT ics.i_brand_id AS brand_id,
+                         ics.i_class_id AS class_id,
+                         ics.i_category_id AS category_id
+                  FROM catalog_sales
+                  JOIN item ics ON cs_item_sk = ics.i_item_sk
+                  JOIN date_dim d2 ON cs_sold_date_sk = d2.d_date_sk
+                  WHERE d2.d_year = 1999
+                  INTERSECT
+                  SELECT iws.i_brand_id AS brand_id,
+                         iws.i_class_id AS class_id,
+                         iws.i_category_id AS category_id
+                  FROM web_sales
+                  JOIN item iws ON ws_item_sk = iws.i_item_sk
+                  JOIN date_dim d3 ON ws_sold_date_sk = d3.d_date_sk
+                  WHERE d3.d_year = 1999) x
+              ON i_brand_id = brand_id AND i_class_id = class_id
+                 AND i_category_id = category_id),
+        avg_sales AS (
+            SELECT AVG(quantity * list_price) AS average_sales
+            FROM (SELECT ss_quantity AS quantity,
+                         ss_list_price AS list_price
+                  FROM store_sales
+                  JOIN date_dim ON ss_sold_date_sk = d_date_sk
+                  WHERE d_year = 1999
+                  UNION ALL
+                  SELECT cs_quantity AS quantity,
+                         cs_list_price AS list_price
+                  FROM catalog_sales
+                  JOIN date_dim ON cs_sold_date_sk = d_date_sk
+                  WHERE d_year = 1999
+                  UNION ALL
+                  SELECT ws_quantity AS quantity,
+                         ws_list_price AS list_price
+                  FROM web_sales
+                  JOIN date_dim ON ws_sold_date_sk = d_date_sk
+                  WHERE d_year = 1999) y)
+        SELECT channel, i_brand_id, i_class_id, i_category_id,
+               SUM(sales) AS sum_sales, SUM(number_sales) AS num_sales
+        FROM (SELECT 'store' AS channel, i_brand_id, i_class_id,
+                     i_category_id,
+                     SUM(ss_quantity * ss_list_price) AS sales,
+                     COUNT(*) AS number_sales
+              FROM store_sales
+              JOIN item ON ss_item_sk = i_item_sk
+              JOIN date_dim ON ss_sold_date_sk = d_date_sk
+              WHERE ss_item_sk IN (SELECT item_sk FROM cross_items)
+                AND d_year = 1999 AND d_moy = 11
+              GROUP BY i_brand_id, i_class_id, i_category_id
+              UNION ALL
+              SELECT 'catalog' AS channel, i_brand_id, i_class_id,
+                     i_category_id,
+                     SUM(cs_quantity * cs_list_price) AS sales,
+                     COUNT(*) AS number_sales
+              FROM catalog_sales
+              JOIN item ON cs_item_sk = i_item_sk
+              JOIN date_dim ON cs_sold_date_sk = d_date_sk
+              WHERE cs_item_sk IN (SELECT item_sk FROM cross_items)
+                AND d_year = 1999 AND d_moy = 11
+              GROUP BY i_brand_id, i_class_id, i_category_id
+              UNION ALL
+              SELECT 'web' AS channel, i_brand_id, i_class_id,
+                     i_category_id,
+                     SUM(ws_quantity * ws_list_price) AS sales,
+                     COUNT(*) AS number_sales
+              FROM web_sales
+              JOIN item ON ws_item_sk = i_item_sk
+              JOIN date_dim ON ws_sold_date_sk = d_date_sk
+              WHERE ws_item_sk IN (SELECT item_sk FROM cross_items)
+                AND d_year = 1999 AND d_moy = 11
+              GROUP BY i_brand_id, i_class_id, i_category_id) z
+        WHERE sales > (SELECT average_sales FROM avg_sales)
+        GROUP BY ROLLUP (channel, i_brand_id, i_class_id,
+                         i_category_id)
+        ORDER BY channel NULLS LAST, i_brand_id NULLS LAST,
+                 i_class_id NULLS LAST, i_category_id NULLS LAST
+        LIMIT 100""",
+    # frequent items + best customers CTEs gating catalog/web sales
+    # (q23)
+    "q23": """
+        WITH frequent_ss_items AS (
+            SELECT ss_item_sk AS item_sk
+            FROM store_sales
+            JOIN date_dim ON ss_sold_date_sk = d_date_sk
+            WHERE d_year = 1998
+            GROUP BY ss_item_sk
+            HAVING COUNT(*) > 4),
+        customer_totals AS (
+            SELECT ss_customer_sk AS customer_sk,
+                   SUM(ss_quantity * ss_sales_price) AS csales
+            FROM store_sales
+            JOIN date_dim ON ss_sold_date_sk = d_date_sk
+            WHERE d_year = 1998
+            GROUP BY ss_customer_sk),
+        best_ss_customer AS (
+            SELECT customer_sk
+            FROM customer_totals
+            WHERE csales > 0.5 *
+                  (SELECT MAX(csales) FROM customer_totals))
+        SELECT SUM(sales) AS total_catalog_web
+        FROM (SELECT cs_quantity * cs_list_price AS sales
+              FROM catalog_sales
+              JOIN date_dim ON cs_sold_date_sk = d_date_sk
+              WHERE d_year = 1998 AND d_moy = 3
+                AND cs_item_sk IN
+                    (SELECT item_sk FROM frequent_ss_items)
+                AND cs_bill_customer_sk IN
+                    (SELECT customer_sk FROM best_ss_customer)
+              UNION ALL
+              SELECT ws_quantity * ws_list_price AS sales
+              FROM web_sales
+              JOIN date_dim ON ws_sold_date_sk = d_date_sk
+              WHERE d_year = 1998 AND d_moy = 3
+                AND ws_item_sk IN
+                    (SELECT item_sk FROM frequent_ss_items)
+                AND ws_bill_customer_sk IN
+                    (SELECT customer_sk FROM best_ss_customer)) x""",
+    # store-sales net-paid by color vs 5%-of-average gate (q24)
+    "q24": """
+        WITH ssales AS (
+            SELECT c_last_name, c_first_name, s_store_name, i_color,
+                   SUM(ss_net_paid) AS netpaid
+            FROM store_sales
+            JOIN store_returns ON ss_ticket_number = sr_ticket_number
+                 AND ss_item_sk = sr_item_sk
+            JOIN store ON ss_store_sk = s_store_sk
+            JOIN item ON ss_item_sk = i_item_sk
+            JOIN customer ON ss_customer_sk = c_customer_sk
+            JOIN customer_address ON c_current_addr_sk = ca_address_sk
+            WHERE s_state = 'TN' AND ca_state <> s_state
+            GROUP BY c_last_name, c_first_name, s_store_name, i_color)
+        SELECT c_last_name, c_first_name, s_store_name, paid
+        FROM (SELECT c_last_name, c_first_name, s_store_name,
+                     SUM(netpaid) AS paid
+              FROM ssales
+              WHERE i_color = 'plum'
+              GROUP BY c_last_name, c_first_name, s_store_name)
+             by_store
+        WHERE paid > (SELECT 0.05 * AVG(netpaid) FROM ssales)
+        ORDER BY c_last_name, c_first_name, s_store_name
+        LIMIT 100""",
+    # per-channel worst return ratios, dual RANK, union (q49)
+    "q49": """
+        SELECT channel, item, return_ratio, return_rank,
+               currency_rank
+        FROM (
+            SELECT 'web' AS channel, item, return_ratio, return_rank,
+                   currency_rank
+            FROM (SELECT item, return_ratio, currency_ratio,
+                         RANK() OVER (ORDER BY return_ratio, item)
+                             AS return_rank,
+                         RANK() OVER (ORDER BY currency_ratio, item)
+                             AS currency_rank
+                  FROM (SELECT ws_item_sk AS item,
+                               SUM(COALESCE(wr_return_quantity, 0)) *
+                                   1.0 / SUM(ws_quantity)
+                                   AS return_ratio,
+                               SUM(COALESCE(wr_return_amt, 0.0)) /
+                                   SUM(ws_net_paid) AS currency_ratio
+                        FROM web_sales
+                        LEFT JOIN web_returns
+                          ON ws_order_number = wr_order_number
+                             AND ws_item_sk = wr_item_sk
+                        JOIN date_dim ON ws_sold_date_sk = d_date_sk
+                        WHERE d_year = 1999 AND d_moy = 12
+                          AND ws_net_profit > 1
+                        GROUP BY ws_item_sk) in_web) w
+            WHERE return_rank <= 10 OR currency_rank <= 10
+            UNION ALL
+            SELECT 'catalog' AS channel, item, return_ratio,
+                   return_rank, currency_rank
+            FROM (SELECT item, return_ratio, currency_ratio,
+                         RANK() OVER (ORDER BY return_ratio, item)
+                             AS return_rank,
+                         RANK() OVER (ORDER BY currency_ratio, item)
+                             AS currency_rank
+                  FROM (SELECT cs_item_sk AS item,
+                               SUM(COALESCE(cr_return_quantity, 0)) *
+                                   1.0 / SUM(cs_quantity)
+                                   AS return_ratio,
+                               SUM(COALESCE(cr_return_amount, 0.0)) /
+                                   SUM(cs_ext_sales_price)
+                                   AS currency_ratio
+                        FROM catalog_sales
+                        LEFT JOIN catalog_returns
+                          ON cs_order_number = cr_order_number
+                             AND cs_item_sk = cr_item_sk
+                        JOIN date_dim ON cs_sold_date_sk = d_date_sk
+                        WHERE d_year = 1999 AND d_moy = 12
+                          AND cs_net_profit > 1
+                        GROUP BY cs_item_sk) in_cat) c
+            WHERE return_rank <= 10 OR currency_rank <= 10
+            UNION ALL
+            SELECT 'store' AS channel, item, return_ratio,
+                   return_rank, currency_rank
+            FROM (SELECT item, return_ratio, currency_ratio,
+                         RANK() OVER (ORDER BY return_ratio, item)
+                             AS return_rank,
+                         RANK() OVER (ORDER BY currency_ratio, item)
+                             AS currency_rank
+                  FROM (SELECT ss_item_sk AS item,
+                               SUM(COALESCE(sr_return_quantity, 0)) *
+                                   1.0 / SUM(ss_quantity)
+                                   AS return_ratio,
+                               SUM(COALESCE(sr_return_amt, 0.0)) /
+                                   SUM(ss_net_paid) AS currency_ratio
+                        FROM store_sales
+                        LEFT JOIN store_returns
+                          ON ss_ticket_number = sr_ticket_number
+                             AND ss_item_sk = sr_item_sk
+                        JOIN date_dim ON ss_sold_date_sk = d_date_sk
+                        WHERE d_year = 1999 AND d_moy = 12
+                          AND ss_net_profit > 1
+                        GROUP BY ss_item_sk) in_store) s
+            WHERE return_rank <= 10 OR currency_rank <= 10) channels
+        ORDER BY channel, return_rank, currency_rank, item
+        LIMIT 100""",
+    # catalog/web buyers' store revenue segmented into $50 bands
+    # (q54)
+    "q54": """
+        WITH my_customers AS (
+            SELECT c_customer_sk, c_current_addr_sk
+            FROM (SELECT cs_sold_date_sk AS sold_date_sk,
+                         cs_bill_customer_sk AS customer_sk,
+                         cs_item_sk AS item_sk
+                  FROM catalog_sales
+                  UNION ALL
+                  SELECT ws_sold_date_sk AS sold_date_sk,
+                         ws_bill_customer_sk AS customer_sk,
+                         ws_item_sk AS item_sk
+                  FROM web_sales) cs_or_ws_sales
+            JOIN item ON item_sk = i_item_sk
+            JOIN date_dim ON sold_date_sk = d_date_sk
+            JOIN customer ON c_customer_sk = customer_sk
+            WHERE i_category = 'Women' AND i_class = 'class3'
+              AND d_year = 1998 AND d_moy = 12
+            GROUP BY c_customer_sk, c_current_addr_sk),
+        my_revenue AS (
+            SELECT c_customer_sk,
+                   SUM(ss_ext_sales_price) AS revenue
+            FROM my_customers
+            JOIN store_sales ON c_customer_sk = ss_customer_sk
+            JOIN customer_address
+                 ON c_current_addr_sk = ca_address_sk
+            JOIN store ON ca_state = s_state
+            JOIN date_dim ON ss_sold_date_sk = d_date_sk
+            WHERE d_month_seq BETWEEN 1200 AND 1202
+            GROUP BY c_customer_sk)
+        SELECT segment, COUNT(*) AS num_customers,
+               segment * 50 AS segment_base
+        FROM (SELECT CAST(revenue / 50 AS INT) AS segment
+              FROM my_revenue) segments
+        GROUP BY segment
+        ORDER BY segment, num_customers
+        LIMIT 100""",
+    # same-week item revenue within 10% across 3 channels (q58)
+    "q58": """
+        WITH ss_items AS (
+            SELECT i_item_id AS item_id,
+                   SUM(ss_ext_sales_price) AS ss_item_rev
+            FROM store_sales
+            JOIN item ON ss_item_sk = i_item_sk
+            JOIN date_dim ON ss_sold_date_sk = d_date_sk
+            WHERE d_week_seq = 5150
+            GROUP BY i_item_id),
+        cs_items AS (
+            SELECT i_item_id AS item_id,
+                   SUM(cs_ext_sales_price) AS cs_item_rev
+            FROM catalog_sales
+            JOIN item ON cs_item_sk = i_item_sk
+            JOIN date_dim ON cs_sold_date_sk = d_date_sk
+            WHERE d_week_seq = 5150
+            GROUP BY i_item_id),
+        ws_items AS (
+            SELECT i_item_id AS item_id,
+                   SUM(ws_ext_sales_price) AS ws_item_rev
+            FROM web_sales
+            JOIN item ON ws_item_sk = i_item_sk
+            JOIN date_dim ON ws_sold_date_sk = d_date_sk
+            WHERE d_week_seq = 5150
+            GROUP BY i_item_id)
+        SELECT ss_items.item_id, ss_item_rev, cs_item_rev,
+               ws_item_rev,
+               (ss_item_rev + cs_item_rev + ws_item_rev) / 3
+                   AS average
+        FROM ss_items
+        JOIN cs_items ON ss_items.item_id = cs_items.item_id
+        JOIN ws_items ON ss_items.item_id = ws_items.item_id
+        WHERE ss_item_rev >= 0.9 * cs_item_rev
+          AND ss_item_rev <= 1.1 * cs_item_rev
+          AND ss_item_rev >= 0.9 * ws_item_rev
+          AND ss_item_rev <= 1.1 * ws_item_rev
+        ORDER BY ss_items.item_id, ss_item_rev
+        LIMIT 100""",
+    # category revenue by item across 3 channels in one geography
+    # (q60)
+    "q60": """
+        WITH ss_t AS (
+            SELECT i_item_id, SUM(ss_ext_sales_price) AS total_sales
+            FROM store_sales
+            JOIN item ON ss_item_sk = i_item_sk
+            JOIN date_dim ON ss_sold_date_sk = d_date_sk
+            JOIN customer_address ON ss_addr_sk = ca_address_sk
+            WHERE i_category = 'Music' AND d_year = 1999 AND d_moy = 9
+              AND ca_gmt_offset = -5.0
+            GROUP BY i_item_id),
+        cs_t AS (
+            SELECT i_item_id, SUM(cs_ext_sales_price) AS total_sales
+            FROM catalog_sales
+            JOIN item ON cs_item_sk = i_item_sk
+            JOIN date_dim ON cs_sold_date_sk = d_date_sk
+            JOIN customer ON cs_bill_customer_sk = c_customer_sk
+            JOIN customer_address
+                 ON c_current_addr_sk = ca_address_sk
+            WHERE i_category = 'Music' AND d_year = 1999 AND d_moy = 9
+              AND ca_gmt_offset = -5.0
+            GROUP BY i_item_id),
+        ws_t AS (
+            SELECT i_item_id, SUM(ws_ext_sales_price) AS total_sales
+            FROM web_sales
+            JOIN item ON ws_item_sk = i_item_sk
+            JOIN date_dim ON ws_sold_date_sk = d_date_sk
+            JOIN customer ON ws_bill_customer_sk = c_customer_sk
+            JOIN customer_address
+                 ON c_current_addr_sk = ca_address_sk
+            WHERE i_category = 'Music' AND d_year = 1999 AND d_moy = 9
+              AND ca_gmt_offset = -5.0
+            GROUP BY i_item_id)
+        SELECT i_item_id, SUM(total_sales) AS total_sales
+        FROM (SELECT i_item_id, total_sales FROM ss_t
+              UNION ALL
+              SELECT i_item_id, total_sales FROM cs_t
+              UNION ALL
+              SELECT i_item_id, total_sales FROM ws_t) x
+        GROUP BY i_item_id
+        ORDER BY i_item_id, total_sales
+        LIMIT 100""",
+    # manager monthly sales vs windowed average deviation (q63)
+    "q63": """
+        SELECT manager_id, sum_sales, avg_monthly_sales
+        FROM (SELECT i_manager_id AS manager_id,
+                     SUM(ss_sales_price) AS sum_sales,
+                     AVG(SUM(ss_sales_price)) OVER
+                         (PARTITION BY i_manager_id)
+                         AS avg_monthly_sales
+              FROM item
+              JOIN store_sales ON ss_item_sk = i_item_sk
+              JOIN date_dim ON ss_sold_date_sk = d_date_sk
+              JOIN store ON ss_store_sk = s_store_sk
+              WHERE d_month_seq BETWEEN 1176 AND 1187
+                AND ((i_category IN ('Books', 'Children',
+                                     'Electronics')
+                      AND i_class IN ('class1', 'class2', 'class3'))
+                     OR (i_category IN ('Women', 'Music', 'Men')
+                         AND i_class IN ('class4', 'class5',
+                                         'class6')))
+              GROUP BY i_manager_id, d_moy) tmp1
+        WHERE CASE WHEN avg_monthly_sales > 0
+                   THEN ABS(sum_sales - avg_monthly_sales) /
+                        avg_monthly_sales
+                   ELSE NULL END > 0.1
+        ORDER BY manager_id, avg_monthly_sales, sum_sales
+        LIMIT 100""",
+    # returned-catalog-item store sales, two-year self-join on
+    # item+store (q64)
+    "q64": """
+        WITH cs_ui AS (
+            SELECT cs_item_sk AS u_item_sk
+            FROM catalog_sales
+            JOIN catalog_returns ON cs_item_sk = cr_item_sk
+                 AND cs_order_number = cr_order_number
+            GROUP BY cs_item_sk
+            HAVING SUM(cs_ext_list_price) >
+                   2 * SUM(cr_return_amount)),
+        cross_sales AS (
+            SELECT i_item_id AS product_name, i_item_sk AS item_sk,
+                   s_store_name, s_city, d_year AS syear,
+                   COUNT(*) AS cnt,
+                   SUM(ss_wholesale_cost) AS s1,
+                   SUM(ss_list_price) AS s2,
+                   SUM(ss_coupon_amt) AS s3
+            FROM store_sales
+            JOIN store_returns ON ss_ticket_number = sr_ticket_number
+                 AND ss_item_sk = sr_item_sk
+            JOIN cs_ui ON ss_item_sk = u_item_sk
+            JOIN date_dim ON ss_sold_date_sk = d_date_sk
+            JOIN store ON ss_store_sk = s_store_sk
+            JOIN customer ON ss_customer_sk = c_customer_sk
+            JOIN household_demographics ON ss_hdemo_sk = hd_demo_sk
+            JOIN income_band
+                 ON hd_income_band_sk = ib_income_band_sk
+            JOIN item ON ss_item_sk = i_item_sk
+            WHERE i_color IN ('plum', 'navy', 'orchid', 'chiffon')
+              AND ib_lower_bound >= 0
+            GROUP BY i_item_id, i_item_sk, s_store_name, s_city,
+                     d_year)
+        SELECT cs1.product_name, cs1.s_store_name, cs1.syear,
+               cs1.cnt AS cnt1, cs2.syear AS syear2, cs2.cnt AS cnt2,
+               cs1.s1, cs1.s2, cs1.s3,
+               cs2.s1 AS s1_2, cs2.s2 AS s2_2, cs2.s3 AS s3_2
+        FROM cross_sales cs1
+        JOIN cross_sales cs2 ON cs1.item_sk = cs2.item_sk
+             AND cs1.s_store_name = cs2.s_store_name
+             AND cs1.s_city = cs2.s_city
+        WHERE cs1.syear = 1998 AND cs2.syear = 1999
+          AND cs2.cnt <= cs1.cnt
+        ORDER BY cs1.product_name, cs1.s_store_name, cs2.cnt,
+                 cs1.s1, cs2.s1
+        LIMIT 100""",
+    # warehouse shipping pivot by month, web+catalog union (q66;
+    # 6-month pivot of the original's 12)
+    "q66": """
+        SELECT w_warehouse_name, w_warehouse_sq_ft, w_city, w_state,
+               ship_carriers, year_,
+               SUM(m1_sales) AS jan_sales, SUM(m2_sales) AS feb_sales,
+               SUM(m3_sales) AS mar_sales, SUM(m4_sales) AS apr_sales,
+               SUM(m5_sales) AS may_sales, SUM(m6_sales) AS jun_sales,
+               SUM(m1_net) AS jan_net, SUM(m2_net) AS feb_net,
+               SUM(m3_net) AS mar_net
+        FROM (
+            SELECT w_warehouse_name, w_warehouse_sq_ft, w_city,
+                   w_state, 'UPS,FEDEX' AS ship_carriers,
+                   d_year AS year_,
+                   SUM(CASE WHEN d_moy = 1 THEN ws_ext_sales_price *
+                       ws_quantity ELSE 0 END) AS m1_sales,
+                   SUM(CASE WHEN d_moy = 2 THEN ws_ext_sales_price *
+                       ws_quantity ELSE 0 END) AS m2_sales,
+                   SUM(CASE WHEN d_moy = 3 THEN ws_ext_sales_price *
+                       ws_quantity ELSE 0 END) AS m3_sales,
+                   SUM(CASE WHEN d_moy = 4 THEN ws_ext_sales_price *
+                       ws_quantity ELSE 0 END) AS m4_sales,
+                   SUM(CASE WHEN d_moy = 5 THEN ws_ext_sales_price *
+                       ws_quantity ELSE 0 END) AS m5_sales,
+                   SUM(CASE WHEN d_moy = 6 THEN ws_ext_sales_price *
+                       ws_quantity ELSE 0 END) AS m6_sales,
+                   SUM(CASE WHEN d_moy = 1 THEN ws_net_paid *
+                       ws_quantity ELSE 0 END) AS m1_net,
+                   SUM(CASE WHEN d_moy = 2 THEN ws_net_paid *
+                       ws_quantity ELSE 0 END) AS m2_net,
+                   SUM(CASE WHEN d_moy = 3 THEN ws_net_paid *
+                       ws_quantity ELSE 0 END) AS m3_net
+            FROM web_sales
+            JOIN warehouse ON ws_warehouse_sk = w_warehouse_sk
+            JOIN date_dim ON ws_sold_date_sk = d_date_sk
+            JOIN time_dim ON ws_sold_time_sk = t_time_sk
+            JOIN ship_mode ON ws_ship_mode_sk = sm_ship_mode_sk
+            WHERE d_year = 1999 AND t_hour BETWEEN 8 AND 17
+              AND sm_carrier IN ('UPS', 'FEDEX')
+            GROUP BY w_warehouse_name, w_warehouse_sq_ft, w_city,
+                     w_state, d_year
+            UNION ALL
+            SELECT w_warehouse_name, w_warehouse_sq_ft, w_city,
+                   w_state, 'UPS,FEDEX' AS ship_carriers,
+                   d_year AS year_,
+                   SUM(CASE WHEN d_moy = 1 THEN cs_sales_price *
+                       cs_quantity ELSE 0 END) AS m1_sales,
+                   SUM(CASE WHEN d_moy = 2 THEN cs_sales_price *
+                       cs_quantity ELSE 0 END) AS m2_sales,
+                   SUM(CASE WHEN d_moy = 3 THEN cs_sales_price *
+                       cs_quantity ELSE 0 END) AS m3_sales,
+                   SUM(CASE WHEN d_moy = 4 THEN cs_sales_price *
+                       cs_quantity ELSE 0 END) AS m4_sales,
+                   SUM(CASE WHEN d_moy = 5 THEN cs_sales_price *
+                       cs_quantity ELSE 0 END) AS m5_sales,
+                   SUM(CASE WHEN d_moy = 6 THEN cs_sales_price *
+                       cs_quantity ELSE 0 END) AS m6_sales,
+                   SUM(CASE WHEN d_moy = 1 THEN cs_net_profit *
+                       cs_quantity ELSE 0 END) AS m1_net,
+                   SUM(CASE WHEN d_moy = 2 THEN cs_net_profit *
+                       cs_quantity ELSE 0 END) AS m2_net,
+                   SUM(CASE WHEN d_moy = 3 THEN cs_net_profit *
+                       cs_quantity ELSE 0 END) AS m3_net
+            FROM catalog_sales
+            JOIN warehouse ON cs_warehouse_sk = w_warehouse_sk
+            JOIN date_dim ON cs_sold_date_sk = d_date_sk
+            JOIN time_dim ON cs_sold_time_sk = t_time_sk
+            JOIN ship_mode ON cs_ship_mode_sk = sm_ship_mode_sk
+            WHERE d_year = 1999 AND t_hour BETWEEN 8 AND 17
+              AND sm_carrier IN ('UPS', 'FEDEX')
+            GROUP BY w_warehouse_name, w_warehouse_sq_ft, w_city,
+                     w_state, d_year) x
+        GROUP BY w_warehouse_name, w_warehouse_sq_ft, w_city,
+                 w_state, ship_carriers, year_
+        ORDER BY w_warehouse_name, w_warehouse_sq_ft, w_city,
+                 w_state, year_
+        LIMIT 100""",
+    # 4-level ROLLUP + per-category RANK over sumsales (q67)
+    "q67": """
+        SELECT i_category, i_class, i_brand, s_store_id, sumsales, rk
+        FROM (SELECT i_category, i_class, i_brand, s_store_id,
+                     sumsales,
+                     RANK() OVER (PARTITION BY i_category
+                                  ORDER BY sumsales DESC) AS rk
+              FROM (SELECT i_category, i_class, i_brand, s_store_id,
+                           SUM(ss_sales_price * ss_quantity)
+                               AS sumsales
+                    FROM store_sales
+                    JOIN date_dim ON ss_sold_date_sk = d_date_sk
+                    JOIN store ON ss_store_sk = s_store_sk
+                    JOIN item ON ss_item_sk = i_item_sk
+                    WHERE d_month_seq BETWEEN 1176 AND 1187
+                    GROUP BY ROLLUP (i_category, i_class, i_brand,
+                                     s_store_id)) dw1) dw2
+        WHERE rk <= 10
+        ORDER BY i_category NULLS LAST, i_class NULLS LAST,
+                 i_brand NULLS LAST, s_store_id NULLS LAST, rk
+        LIMIT 100""",
+    # store/web year-over-year net-paid growth (q74)
+    "q74": """
+        WITH year_total AS (
+            SELECT c_customer_id AS customer_id,
+                   c_first_name AS customer_first_name,
+                   d_year AS dyear,
+                   SUM(ss_net_paid) AS year_total, 's' AS sale_type
+            FROM customer
+            JOIN store_sales ON c_customer_sk = ss_customer_sk
+            JOIN date_dim ON ss_sold_date_sk = d_date_sk
+            GROUP BY c_customer_id, c_first_name, d_year
+            UNION ALL
+            SELECT c_customer_id AS customer_id,
+                   c_first_name AS customer_first_name,
+                   d_year AS dyear,
+                   SUM(ws_net_paid) AS year_total, 'w' AS sale_type
+            FROM customer
+            JOIN web_sales ON c_customer_sk = ws_bill_customer_sk
+            JOIN date_dim ON ws_sold_date_sk = d_date_sk
+            GROUP BY c_customer_id, c_first_name, d_year)
+        SELECT t_s_secyear.customer_id,
+               t_s_secyear.customer_first_name
+        FROM year_total t_s_firstyear
+        JOIN year_total t_s_secyear
+          ON t_s_secyear.customer_id = t_s_firstyear.customer_id
+        JOIN year_total t_w_firstyear
+          ON t_s_firstyear.customer_id = t_w_firstyear.customer_id
+        JOIN year_total t_w_secyear
+          ON t_s_firstyear.customer_id = t_w_secyear.customer_id
+        WHERE t_s_firstyear.sale_type = 's'
+          AND t_w_firstyear.sale_type = 'w'
+          AND t_s_secyear.sale_type = 's'
+          AND t_w_secyear.sale_type = 'w'
+          AND t_s_firstyear.dyear = 1998
+          AND t_s_secyear.dyear = 1999
+          AND t_w_firstyear.dyear = 1998
+          AND t_w_secyear.dyear = 1999
+          AND t_s_firstyear.year_total > 0
+          AND t_w_firstyear.year_total > 0
+          AND t_w_secyear.year_total / t_w_firstyear.year_total >
+              t_s_secyear.year_total / t_s_firstyear.year_total
+        ORDER BY t_s_secyear.customer_id,
+                 t_s_secyear.customer_first_name
+        LIMIT 100""",
+    # net-of-returns sales decline year-over-year, 3 channels (q75)
+    "q75": """
+        WITH all_sales AS (
+            SELECT d_year, i_brand_id, i_class_id, i_category_id,
+                   i_manufact_id, SUM(sales_cnt) AS sales_cnt,
+                   SUM(sales_amt) AS sales_amt
+            FROM (SELECT d_year, i_brand_id, i_class_id,
+                         i_category_id, i_manufact_id,
+                         cs_quantity -
+                             COALESCE(cr_return_quantity, 0)
+                             AS sales_cnt,
+                         cs_ext_sales_price -
+                             COALESCE(cr_return_amount, 0.0)
+                             AS sales_amt
+                  FROM catalog_sales
+                  JOIN item ON cs_item_sk = i_item_sk
+                  JOIN date_dim ON cs_sold_date_sk = d_date_sk
+                  LEFT JOIN catalog_returns
+                    ON cs_order_number = cr_order_number
+                       AND cs_item_sk = cr_item_sk
+                  WHERE i_category = 'Books'
+                  UNION ALL
+                  SELECT d_year, i_brand_id, i_class_id,
+                         i_category_id, i_manufact_id,
+                         ss_quantity -
+                             COALESCE(sr_return_quantity, 0)
+                             AS sales_cnt,
+                         ss_ext_sales_price -
+                             COALESCE(sr_return_amt, 0.0)
+                             AS sales_amt
+                  FROM store_sales
+                  JOIN item ON ss_item_sk = i_item_sk
+                  JOIN date_dim ON ss_sold_date_sk = d_date_sk
+                  LEFT JOIN store_returns
+                    ON ss_ticket_number = sr_ticket_number
+                       AND ss_item_sk = sr_item_sk
+                  WHERE i_category = 'Books'
+                  UNION ALL
+                  SELECT d_year, i_brand_id, i_class_id,
+                         i_category_id, i_manufact_id,
+                         ws_quantity -
+                             COALESCE(wr_return_quantity, 0)
+                             AS sales_cnt,
+                         ws_ext_sales_price -
+                             COALESCE(wr_return_amt, 0.0)
+                             AS sales_amt
+                  FROM web_sales
+                  JOIN item ON ws_item_sk = i_item_sk
+                  JOIN date_dim ON ws_sold_date_sk = d_date_sk
+                  LEFT JOIN web_returns
+                    ON ws_order_number = wr_order_number
+                       AND ws_item_sk = wr_item_sk
+                  WHERE i_category = 'Books') sales_detail
+            GROUP BY d_year, i_brand_id, i_class_id, i_category_id,
+                     i_manufact_id)
+        SELECT prev_yr.d_year AS prev_year,
+               curr_yr.d_year AS sales_year, curr_yr.i_brand_id,
+               curr_yr.i_class_id, curr_yr.i_category_id,
+               curr_yr.i_manufact_id,
+               prev_yr.sales_cnt AS prev_yr_cnt,
+               curr_yr.sales_cnt AS curr_yr_cnt,
+               curr_yr.sales_cnt - prev_yr.sales_cnt
+                   AS sales_cnt_diff,
+               curr_yr.sales_amt - prev_yr.sales_amt
+                   AS sales_amt_diff
+        FROM all_sales curr_yr
+        JOIN all_sales prev_yr
+          ON curr_yr.i_brand_id = prev_yr.i_brand_id
+             AND curr_yr.i_class_id = prev_yr.i_class_id
+             AND curr_yr.i_category_id = prev_yr.i_category_id
+             AND curr_yr.i_manufact_id = prev_yr.i_manufact_id
+        WHERE curr_yr.d_year = 1999 AND prev_yr.d_year = 1998
+          AND 1.0 * curr_yr.sales_cnt / prev_yr.sales_cnt < 0.9
+        ORDER BY sales_cnt_diff, sales_amt_diff, curr_yr.i_brand_id,
+                 curr_yr.i_class_id, curr_yr.i_category_id,
+                 curr_yr.i_manufact_id
+        LIMIT 100""",
+    # per-channel promo-gated sales/returns/profit, LEFT JOIN
+    # returns, ROLLUP(channel, id) (q80)
+    "q80": """
+        WITH ssr AS (
+            SELECT s_store_id AS id,
+                   SUM(ss_ext_sales_price) AS sales,
+                   SUM(COALESCE(sr_return_amt, 0.0)) AS returns_amt,
+                   SUM(ss_net_profit - COALESCE(sr_net_loss, 0.0))
+                       AS profit
+            FROM store_sales
+            LEFT JOIN store_returns
+              ON ss_ticket_number = sr_ticket_number
+                 AND ss_item_sk = sr_item_sk
+            JOIN date_dim ON ss_sold_date_sk = d_date_sk
+            JOIN store ON ss_store_sk = s_store_sk
+            JOIN item ON ss_item_sk = i_item_sk
+            JOIN promotion ON ss_promo_sk = p_promo_sk
+            WHERE d_year = 1998 AND i_current_price > 50
+              AND p_channel_tv = 'N'
+            GROUP BY s_store_id),
+        csr AS (
+            SELECT cp_catalog_page_id AS id,
+                   SUM(cs_ext_sales_price) AS sales,
+                   SUM(COALESCE(cr_return_amount, 0.0))
+                       AS returns_amt,
+                   SUM(cs_net_profit - COALESCE(cr_net_loss, 0.0))
+                       AS profit
+            FROM catalog_sales
+            LEFT JOIN catalog_returns
+              ON cs_order_number = cr_order_number
+                 AND cs_item_sk = cr_item_sk
+            JOIN date_dim ON cs_sold_date_sk = d_date_sk
+            JOIN catalog_page
+                 ON cs_catalog_page_sk = cp_catalog_page_sk
+            JOIN item ON cs_item_sk = i_item_sk
+            JOIN promotion ON cs_promo_sk = p_promo_sk
+            WHERE d_year = 1998 AND i_current_price > 50
+              AND p_channel_tv = 'N'
+            GROUP BY cp_catalog_page_id),
+        wsr AS (
+            SELECT web_site_id AS id,
+                   SUM(ws_ext_sales_price) AS sales,
+                   SUM(COALESCE(wr_return_amt, 0.0)) AS returns_amt,
+                   SUM(ws_net_profit - COALESCE(wr_net_loss, 0.0))
+                       AS profit
+            FROM web_sales
+            LEFT JOIN web_returns
+              ON ws_order_number = wr_order_number
+                 AND ws_item_sk = wr_item_sk
+            JOIN date_dim ON ws_sold_date_sk = d_date_sk
+            JOIN web_site ON ws_web_site_sk = web_site_sk
+            JOIN item ON ws_item_sk = i_item_sk
+            JOIN promotion ON ws_promo_sk = p_promo_sk
+            WHERE d_year = 1998 AND i_current_price > 50
+              AND p_channel_tv = 'N'
+            GROUP BY web_site_id)
+        SELECT channel, id, SUM(sales) AS sales,
+               SUM(returns_amt) AS returns_amt, SUM(profit) AS profit
+        FROM (SELECT 'store channel' AS channel, id, sales,
+                     returns_amt, profit
+              FROM ssr
+              UNION ALL
+              SELECT 'catalog channel' AS channel, id, sales,
+                     returns_amt, profit
+              FROM csr
+              UNION ALL
+              SELECT 'web channel' AS channel, id, sales,
+                     returns_amt, profit
+              FROM wsr) x
+        GROUP BY ROLLUP (channel, id)
+        ORDER BY channel NULLS LAST, id NULLS LAST
+        LIMIT 100""",
+    # catalog returners above 1.2x their state's average return
+    # (q81, correlated scalar subquery per state)
+    "q81": """
+        WITH customer_total_return AS (
+            SELECT cr_returning_customer_sk AS ctr_customer_sk,
+                   ca_state AS ctr_state,
+                   SUM(cr_return_amount) AS ctr_total_return
+            FROM catalog_returns
+            JOIN date_dim ON cr_returned_date_sk = d_date_sk
+            JOIN customer ON cr_returning_customer_sk = c_customer_sk
+            JOIN customer_address ON c_current_addr_sk = ca_address_sk
+            WHERE d_year = 1999
+            GROUP BY cr_returning_customer_sk, ca_state)
+        SELECT c_customer_id, c_first_name, c_last_name, ca_state,
+               ctr_total_return
+        FROM customer_total_return ctr1
+        JOIN customer ON ctr1.ctr_customer_sk = c_customer_sk
+        JOIN customer_address ON c_current_addr_sk = ca_address_sk
+        WHERE ctr1.ctr_total_return >
+              (SELECT AVG(ctr_total_return) * 1.2
+               FROM customer_total_return ctr2
+               WHERE ctr1.ctr_state = ctr2.ctr_state)
+        ORDER BY c_customer_id, c_first_name, c_last_name, ca_state,
+                 ctr_total_return
+        LIMIT 100""",
+    # same-weeks return quantity share across 3 channels (q83)
+    "q83": """
+        WITH sr_items AS (
+            SELECT i_item_id AS item_id,
+                   SUM(sr_return_quantity) AS sr_item_qty
+            FROM store_returns
+            JOIN item ON sr_item_sk = i_item_sk
+            JOIN date_dim ON sr_returned_date_sk = d_date_sk
+            WHERE d_week_seq IN (5150, 5175, 5200)
+            GROUP BY i_item_id),
+        cr_items AS (
+            SELECT i_item_id AS item_id,
+                   SUM(cr_return_quantity) AS cr_item_qty
+            FROM catalog_returns
+            JOIN item ON cr_item_sk = i_item_sk
+            JOIN date_dim ON cr_returned_date_sk = d_date_sk
+            WHERE d_week_seq IN (5150, 5175, 5200)
+            GROUP BY i_item_id),
+        wr_items AS (
+            SELECT i_item_id AS item_id,
+                   SUM(wr_return_quantity) AS wr_item_qty
+            FROM web_returns
+            JOIN item ON wr_item_sk = i_item_sk
+            JOIN date_dim ON wr_returned_date_sk = d_date_sk
+            WHERE d_week_seq IN (5150, 5175, 5200)
+            GROUP BY i_item_id)
+        SELECT sr_items.item_id, sr_item_qty,
+               sr_item_qty * 1.0 /
+                   (sr_item_qty + cr_item_qty + wr_item_qty) / 3.0 *
+                   100 AS sr_dev,
+               cr_item_qty,
+               cr_item_qty * 1.0 /
+                   (sr_item_qty + cr_item_qty + wr_item_qty) / 3.0 *
+                   100 AS cr_dev,
+               wr_item_qty,
+               wr_item_qty * 1.0 /
+                   (sr_item_qty + cr_item_qty + wr_item_qty) / 3.0 *
+                   100 AS wr_dev,
+               (sr_item_qty + cr_item_qty + wr_item_qty) / 3.0
+                   AS average
+        FROM sr_items
+        JOIN cr_items ON sr_items.item_id = cr_items.item_id
+        JOIN wr_items ON sr_items.item_id = wr_items.item_id
+        ORDER BY sr_items.item_id, sr_item_qty
+        LIMIT 100""",
+    # income-band city customers with store returns (q84)
+    "q84": """
+        SELECT c_customer_id AS customer_id, c_last_name,
+               c_first_name
+        FROM customer
+        JOIN customer_address ON c_current_addr_sk = ca_address_sk
+        JOIN customer_demographics
+             ON c_current_cdemo_sk = cd_demo_sk
+        JOIN household_demographics
+             ON c_current_hdemo_sk = hd_demo_sk
+        JOIN income_band ON hd_income_band_sk = ib_income_band_sk
+        JOIN store_returns ON sr_cdemo_sk = cd_demo_sk
+        WHERE ca_city = 'city5' AND ib_lower_bound >= 20000
+          AND ib_upper_bound <= 170000
+        ORDER BY c_customer_id
+        LIMIT 100""",
+    # 8 half-hour slot counts cross-joined (q88)
+    "q88": """
+        SELECT h8_30_to_9, h9_to_9_30, h9_30_to_10, h10_to_10_30,
+               h10_30_to_11, h11_to_11_30, h11_30_to_12,
+               h12_to_12_30
+        FROM
+        (SELECT COUNT(*) AS h8_30_to_9
+         FROM store_sales
+         JOIN household_demographics ON ss_hdemo_sk = hd_demo_sk
+         JOIN time_dim ON ss_sold_time_sk = t_time_sk
+         JOIN store ON ss_store_sk = s_store_sk
+         WHERE t_hour = 8 AND t_minute >= 30
+           AND ((hd_dep_count = 3 AND hd_vehicle_count <= 5)
+                OR (hd_dep_count = 0 AND hd_vehicle_count <= 2)
+                OR (hd_dep_count = 1 AND hd_vehicle_count <= 3))
+           AND s_store_name = 'store1') s1
+        CROSS JOIN
+        (SELECT COUNT(*) AS h9_to_9_30
+         FROM store_sales
+         JOIN household_demographics ON ss_hdemo_sk = hd_demo_sk
+         JOIN time_dim ON ss_sold_time_sk = t_time_sk
+         JOIN store ON ss_store_sk = s_store_sk
+         WHERE t_hour = 9 AND t_minute < 30
+           AND ((hd_dep_count = 3 AND hd_vehicle_count <= 5)
+                OR (hd_dep_count = 0 AND hd_vehicle_count <= 2)
+                OR (hd_dep_count = 1 AND hd_vehicle_count <= 3))
+           AND s_store_name = 'store1') s2
+        CROSS JOIN
+        (SELECT COUNT(*) AS h9_30_to_10
+         FROM store_sales
+         JOIN household_demographics ON ss_hdemo_sk = hd_demo_sk
+         JOIN time_dim ON ss_sold_time_sk = t_time_sk
+         JOIN store ON ss_store_sk = s_store_sk
+         WHERE t_hour = 9 AND t_minute >= 30
+           AND ((hd_dep_count = 3 AND hd_vehicle_count <= 5)
+                OR (hd_dep_count = 0 AND hd_vehicle_count <= 2)
+                OR (hd_dep_count = 1 AND hd_vehicle_count <= 3))
+           AND s_store_name = 'store1') s3
+        CROSS JOIN
+        (SELECT COUNT(*) AS h10_to_10_30
+         FROM store_sales
+         JOIN household_demographics ON ss_hdemo_sk = hd_demo_sk
+         JOIN time_dim ON ss_sold_time_sk = t_time_sk
+         JOIN store ON ss_store_sk = s_store_sk
+         WHERE t_hour = 10 AND t_minute < 30
+           AND ((hd_dep_count = 3 AND hd_vehicle_count <= 5)
+                OR (hd_dep_count = 0 AND hd_vehicle_count <= 2)
+                OR (hd_dep_count = 1 AND hd_vehicle_count <= 3))
+           AND s_store_name = 'store1') s4
+        CROSS JOIN
+        (SELECT COUNT(*) AS h10_30_to_11
+         FROM store_sales
+         JOIN household_demographics ON ss_hdemo_sk = hd_demo_sk
+         JOIN time_dim ON ss_sold_time_sk = t_time_sk
+         JOIN store ON ss_store_sk = s_store_sk
+         WHERE t_hour = 10 AND t_minute >= 30
+           AND ((hd_dep_count = 3 AND hd_vehicle_count <= 5)
+                OR (hd_dep_count = 0 AND hd_vehicle_count <= 2)
+                OR (hd_dep_count = 1 AND hd_vehicle_count <= 3))
+           AND s_store_name = 'store1') s5
+        CROSS JOIN
+        (SELECT COUNT(*) AS h11_to_11_30
+         FROM store_sales
+         JOIN household_demographics ON ss_hdemo_sk = hd_demo_sk
+         JOIN time_dim ON ss_sold_time_sk = t_time_sk
+         JOIN store ON ss_store_sk = s_store_sk
+         WHERE t_hour = 11 AND t_minute < 30
+           AND ((hd_dep_count = 3 AND hd_vehicle_count <= 5)
+                OR (hd_dep_count = 0 AND hd_vehicle_count <= 2)
+                OR (hd_dep_count = 1 AND hd_vehicle_count <= 3))
+           AND s_store_name = 'store1') s6
+        CROSS JOIN
+        (SELECT COUNT(*) AS h11_30_to_12
+         FROM store_sales
+         JOIN household_demographics ON ss_hdemo_sk = hd_demo_sk
+         JOIN time_dim ON ss_sold_time_sk = t_time_sk
+         JOIN store ON ss_store_sk = s_store_sk
+         WHERE t_hour = 11 AND t_minute >= 30
+           AND ((hd_dep_count = 3 AND hd_vehicle_count <= 5)
+                OR (hd_dep_count = 0 AND hd_vehicle_count <= 2)
+                OR (hd_dep_count = 1 AND hd_vehicle_count <= 3))
+           AND s_store_name = 'store1') s7
+        CROSS JOIN
+        (SELECT COUNT(*) AS h12_to_12_30
+         FROM store_sales
+         JOIN household_demographics ON ss_hdemo_sk = hd_demo_sk
+         JOIN time_dim ON ss_sold_time_sk = t_time_sk
+         JOIN store ON ss_store_sk = s_store_sk
+         WHERE t_hour = 12 AND t_minute < 30
+           AND ((hd_dep_count = 3 AND hd_vehicle_count <= 5)
+                OR (hd_dep_count = 0 AND hd_vehicle_count <= 2)
+                OR (hd_dep_count = 1 AND hd_vehicle_count <= 3))
+           AND s_store_name = 'store1') s8""",
+    # brand/store monthly sales vs windowed average (q89)
+    "q89": """
+        SELECT i_category, i_class, i_brand, s_store_name,
+               s_company_name, d_moy, sum_sales, avg_monthly_sales
+        FROM (SELECT i_category, i_class, i_brand, s_store_name,
+                     s_company_name, d_moy,
+                     SUM(ss_sales_price) AS sum_sales,
+                     AVG(SUM(ss_sales_price)) OVER
+                         (PARTITION BY i_category, i_brand,
+                                       s_store_name, s_company_name)
+                         AS avg_monthly_sales
+              FROM item
+              JOIN store_sales ON ss_item_sk = i_item_sk
+              JOIN date_dim ON ss_sold_date_sk = d_date_sk
+              JOIN store ON ss_store_sk = s_store_sk
+              WHERE d_year = 1999
+                AND ((i_category IN ('Books', 'Electronics',
+                                     'Sports')
+                      AND i_class IN ('class1', 'class2', 'class3'))
+                     OR (i_category IN ('Men', 'Jewelry', 'Women')
+                         AND i_class IN ('class4', 'class5',
+                                         'class6')))
+              GROUP BY i_category, i_class, i_brand, s_store_name,
+                       s_company_name, d_moy) tmp1
+        WHERE CASE WHEN avg_monthly_sales <> 0
+                   THEN ABS(sum_sales - avg_monthly_sales) /
+                        avg_monthly_sales
+                   ELSE NULL END > 0.1
+        ORDER BY sum_sales - avg_monthly_sales, s_store_name,
+                 i_category, i_class, i_brand, d_moy
+        LIMIT 100""",
+    # morning/evening web order ratio from two counts (q90)
+    "q90": """
+        SELECT amc * 1.0 / pmc AS am_pm_ratio
+        FROM (SELECT COUNT(*) AS amc
+              FROM web_sales
+              JOIN household_demographics
+                   ON ws_ship_hdemo_sk = hd_demo_sk
+              JOIN time_dim ON ws_sold_time_sk = t_time_sk
+              JOIN web_page ON ws_web_page_sk = wp_web_page_sk
+              WHERE t_hour BETWEEN 8 AND 9 AND hd_dep_count = 6
+                AND wp_char_count BETWEEN 2000 AND 6000) at_cnt
+        CROSS JOIN
+             (SELECT COUNT(*) AS pmc
+              FROM web_sales
+              JOIN household_demographics
+                   ON ws_ship_hdemo_sk = hd_demo_sk
+              JOIN time_dim ON ws_sold_time_sk = t_time_sk
+              JOIN web_page ON ws_web_page_sk = wp_web_page_sk
+              WHERE t_hour BETWEEN 19 AND 20 AND hd_dep_count = 6
+                AND wp_char_count BETWEEN 2000 AND 6000) pt_cnt
+        WHERE pmc > 0
+        ORDER BY am_pm_ratio
+        LIMIT 100""",
+    # call-center returns by demographic segment (q91)
+    "q91": """
+        SELECT cc_call_center_id, cc_name, cc_manager,
+               SUM(cr_net_loss) AS returns_loss
+        FROM call_center
+        JOIN catalog_returns
+             ON cr_call_center_sk = cc_call_center_sk
+        JOIN date_dim ON cr_returned_date_sk = d_date_sk
+        JOIN customer ON cr_returning_customer_sk = c_customer_sk
+        JOIN customer_demographics
+             ON c_current_cdemo_sk = cd_demo_sk
+        JOIN household_demographics
+             ON c_current_hdemo_sk = hd_demo_sk
+        JOIN customer_address ON c_current_addr_sk = ca_address_sk
+        WHERE d_year = 1998 AND d_moy = 11
+          AND ((cd_marital_status = 'M'
+                AND cd_education_status = 'Unknown')
+               OR (cd_marital_status = 'W'
+                   AND cd_education_status = 'Advanced Degree'))
+          AND hd_buy_potential = '0-500'
+          AND ca_gmt_offset = -7.0
+        GROUP BY cc_call_center_id, cc_name, cc_manager,
+                 cd_marital_status, cd_education_status
+        ORDER BY returns_loss DESC, cc_call_center_id, cc_name,
+                 cc_manager
+        LIMIT 100""",
+    # web excess-discount vs 1.3x per-item average (q92)
+    "q92": """
+        SELECT SUM(ws1.ws_ext_discount_amt) AS excess_discount_amount
+        FROM web_sales ws1
+        JOIN item ON ws1.ws_item_sk = i_item_sk
+        JOIN date_dim ON d_date_sk = ws1.ws_sold_date_sk
+        WHERE i_manufact_id = 7
+          AND d_year = 1999 AND d_moy BETWEEN 1 AND 4
+          AND ws1.ws_ext_discount_amt >
+              (SELECT 1.3 * AVG(ws2.ws_ext_discount_amt)
+               FROM web_sales ws2
+               WHERE ws2.ws_item_sk = ws1.ws_item_sk)
+        LIMIT 100""",
+    # actual sales net of reason-coded returns (q93)
+    "q93": """
+        SELECT ss_customer_sk, SUM(act_sales) AS sumsales
+        FROM (SELECT ss_customer_sk,
+                     CASE WHEN sr_return_quantity IS NOT NULL
+                          THEN (ss_quantity - sr_return_quantity) *
+                               ss_sales_price
+                          ELSE ss_quantity * ss_sales_price
+                          END AS act_sales
+              FROM store_sales
+              LEFT JOIN store_returns
+                ON sr_item_sk = ss_item_sk
+                   AND sr_ticket_number = ss_ticket_number
+              JOIN reason ON sr_reason_sk = r_reason_sk
+              WHERE r_reason_desc = 'reason 3') t
+        GROUP BY ss_customer_sk
+        ORDER BY sumsales, ss_customer_sk
+        LIMIT 100""",
+    # multi-warehouse shipped web orders, EXISTS + NOT EXISTS (q94)
+    "q94": """
+        SELECT COUNT(DISTINCT ws_order_number) AS order_count,
+               SUM(ws_ext_ship_cost) AS total_shipping_cost,
+               SUM(ws_net_profit) AS total_net_profit
+        FROM web_sales ws1
+        JOIN date_dim ON ws1.ws_ship_date_sk = d_date_sk
+        JOIN web_site ON ws1.ws_web_site_sk = web_site_sk
+        WHERE d_year = 1999 AND d_moy BETWEEN 2 AND 3
+          AND EXISTS (SELECT 1 FROM web_sales ws2
+                      WHERE ws1.ws_order_number = ws2.ws_order_number
+                        AND ws1.ws_warehouse_sk <>
+                            ws2.ws_warehouse_sk)
+          AND NOT EXISTS (SELECT 1 FROM web_returns wr1
+                          WHERE ws1.ws_order_number =
+                                wr1.wr_order_number)
+        LIMIT 100""",
+    # returned multi-warehouse web orders via ws_wh CTE (q95)
+    "q95": """
+        WITH ws_wh AS (
+            SELECT ws1.ws_order_number AS order_number
+            FROM web_sales ws1
+            JOIN web_sales ws2
+              ON ws1.ws_order_number = ws2.ws_order_number
+            WHERE ws1.ws_warehouse_sk <> ws2.ws_warehouse_sk
+            GROUP BY ws1.ws_order_number)
+        SELECT COUNT(DISTINCT ws_order_number) AS order_count,
+               SUM(ws_ext_ship_cost) AS total_shipping_cost,
+               SUM(ws_net_profit) AS total_net_profit
+        FROM web_sales ws1
+        JOIN date_dim ON ws1.ws_ship_date_sk = d_date_sk
+        JOIN web_site ON ws1.ws_web_site_sk = web_site_sk
+        WHERE d_year = 1999 AND d_moy BETWEEN 2 AND 3
+          AND ws1.ws_order_number IN
+              (SELECT order_number FROM ws_wh)
+          AND ws1.ws_order_number IN
+              (SELECT wr_order_number
+               FROM web_returns
+               JOIN ws_wh ON wr_order_number = order_number)
+        LIMIT 100""",
+    # store-vs-catalog customer-item overlap via FULL OUTER JOIN
+    # (q97)
+    "q97": """
+        WITH ssci AS (
+            SELECT ss_customer_sk AS customer_sk,
+                   ss_item_sk AS item_sk
+            FROM store_sales
+            JOIN date_dim ON ss_sold_date_sk = d_date_sk
+            WHERE d_month_seq BETWEEN 1190 AND 1200
+            GROUP BY ss_customer_sk, ss_item_sk),
+        csci AS (
+            SELECT cs_bill_customer_sk AS customer_sk,
+                   cs_item_sk AS item_sk
+            FROM catalog_sales
+            JOIN date_dim ON cs_sold_date_sk = d_date_sk
+            WHERE d_month_seq BETWEEN 1190 AND 1200
+            GROUP BY cs_bill_customer_sk, cs_item_sk)
+        SELECT SUM(CASE WHEN ssci.customer_sk IS NOT NULL
+                             AND csci.customer_sk IS NULL
+                        THEN 1 ELSE 0 END) AS store_only,
+               SUM(CASE WHEN ssci.customer_sk IS NULL
+                             AND csci.customer_sk IS NOT NULL
+                        THEN 1 ELSE 0 END) AS catalog_only,
+               SUM(CASE WHEN ssci.customer_sk IS NOT NULL
+                             AND csci.customer_sk IS NOT NULL
+                        THEN 1 ELSE 0 END) AS store_and_catalog
+        FROM ssci
+        FULL OUTER JOIN csci
+          ON ssci.customer_sk = csci.customer_sk
+             AND ssci.item_sk = csci.item_sk
+        LIMIT 100""",
 }

@@ -171,17 +171,29 @@ def tile_group_reduce(gid: jax.Array, values: Sequence[jax.Array],
     float64-accumulated array of shape [num_buckets] per value column;
     the caller maps buckets back to group keys.
 
-    Kernel structure: grid over row tiles; every GROUP_ACC_TILES tiles
-    share one (num_buckets, 128) float32 accumulator block (init on the
-    block's first tile, += on the rest — the sequential-TPU-grid
-    revisit pattern); blocks reduce outside in float64 so round-off is
-    bounded per 64-tile window instead of growing with the partition.
+    Kernel structure: one GRID-LESS pallas call per row tile (the MXU
+    one-hot matmul), driven by an outer ``lax.scan`` that carries the
+    accumulator at the wide dtype. Grid-less because (a) a sequential
+    accumulating grid needs the output-block revisit pattern, which
+    this environment's remote Mosaic compiler rejects, and (b) the
+    scan carry accumulates at float64, bounding round-off per TILE
+    rather than per GROUP_ACC_TILES window. The kernel body avoids
+    jnp operator sugar with Python-int operands: under x64 those
+    route through jitted jnp wrappers that type the scalar operand
+    int64, and Mosaic's in-kernel i64<->i32 convert recurses forever
+    (jax 0.9).
     """
     if interpret is None:
         interpret = not on_tpu()
     nv = len(values)
     assert nv <= 128, "one accumulator lane column per value column"
     assert num_buckets % 8 == 0, "sublane-aligned bucket count"
+    # cast OUTSIDE the kernel: Mosaic cannot lower the emulated
+    # f64->f32 (or i64->i32) convert inside a TPU kernel body — it
+    # recurses in _convert_element_type_lowering_rule; XLA handles the
+    # emulated conversion fine in the surrounding program
+    gid = gid.astype(jnp.int32)
+    values = [v.astype(jnp.float32) for v in values]
     n = gid.shape[0]
     tiles = max(1, -(-n // tile_rows))
     padded = tiles * tile_rows
@@ -189,46 +201,36 @@ def tile_group_reduce(gid: jax.Array, values: Sequence[jax.Array],
         # pad rows to a full tile: gid 0 with zero values (sum identity)
         gid = jnp.pad(gid, (0, padded - n))
         values = [jnp.pad(v, (0, padded - n)) for v in values]
-    blocks_n = -(-tiles // GROUP_ACC_TILES)
 
     def kernel(gid_ref, *refs):
         val_refs, out_ref = refs[:-1], refs[-1]
-        i = pl.program_id(0)
         g = gid_ref[...]
         # (tile_rows, B) one-hot on the fly; MXU contracts over rows
         oh = (g[:, None] ==
               jax.lax.broadcasted_iota(jnp.int32, (1, num_buckets), 1)
               ).astype(jnp.float32)
         vmat = jnp.stack(
-            [r[...].astype(jnp.float32) for r in val_refs], axis=1)
+            [v[...].astype(jnp.float32) for v in val_refs], axis=1)
         if nv < 128:
-            vmat = jnp.pad(vmat, ((0, 0), (0, 128 - nv)))
-        part = jax.lax.dot_general(
+            vmat = jax.lax.pad(vmat, jnp.float32(0),
+                               ((0, 0, 0), (0, 128 - nv, 0)))
+        out_ref[...] = jax.lax.dot_general(
             oh, vmat, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)   # (B, 128)
 
-        @pl.when(i % GROUP_ACC_TILES == 0)
-        def _init():
-            out_ref[...] = part
-
-        @pl.when(i % GROUP_ACC_TILES != 0)
-        def _acc():
-            out_ref[...] += part
-
-    specs = [pl.BlockSpec((tile_rows,), lambda i: (i,))]
-    specs += [pl.BlockSpec((tile_rows,), lambda i: (i,))
-              for _ in values]
-    out = pl.pallas_call(
+    tile_call = pl.pallas_call(
         kernel,
-        grid=(tiles,),
-        in_specs=specs,
-        out_specs=pl.BlockSpec((num_buckets, 128),
-                               lambda i: (i // GROUP_ACC_TILES, 0)),
-        out_shape=jax.ShapeDtypeStruct((blocks_n * num_buckets, 128),
-                                       jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((num_buckets, 128), jnp.float32),
         interpret=interpret,
-    )(gid, *values)
+    )
     acc_t = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
-    out = out.reshape(blocks_n, num_buckets, 128).astype(acc_t)
-    out = jnp.sum(out, axis=0)
+    gid_t = gid.reshape(tiles, tile_rows)
+    vals_t = [v.reshape(tiles, tile_rows) for v in values]
+
+    def step(acc, xs):
+        g, vs = xs
+        return acc + tile_call(g, *vs).astype(acc_t), None
+
+    acc0 = jnp.zeros((num_buckets, 128), acc_t)
+    out, _ = jax.lax.scan(step, acc0, (gid_t, vals_t))
     return [out[:, j] for j in range(nv)]

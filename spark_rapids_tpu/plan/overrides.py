@@ -992,7 +992,10 @@ def prune_scan_columns(plan: LogicalPlan) -> None:
     over a 16-column lineitem then decodes 4 columns instead of 16 —
     on the host-decode scan path this is the single largest I/O lever.
     Scans are replaced by narrowed COPIES (they're shared across
-    DataFrames)."""
+    DataFrames). CachedRelation prunes the same way — a projection over
+    df.cache() decompresses only the referenced column blocks
+    (ParquetCachedBatchSerializer selectedAttributes role)."""
+    from ..cache import CachedRelation
     from ..io.scan import FileScan
 
     def node_refs(node: LogicalPlan) -> set:
@@ -1006,7 +1009,7 @@ def prune_scan_columns(plan: LogicalPlan) -> None:
         # node's output; None = everything (no boundary seen yet)
         for i, c in enumerate(node.children):
             creq = _child_required(node, c, required)
-            if isinstance(c, FileScan):
+            if isinstance(c, (FileScan, CachedRelation)):
                 if creq is None:
                     continue
                 keep = [(n, t) for n, t in c.schema if n in creq]
@@ -1085,7 +1088,31 @@ def apply_overrides(plan: LogicalPlan, conf: Optional[SrtConf] = None):
         lines = meta.explain_lines(only_not_on_tpu=True)
         if lines:
             print("\n".join(lines))
-    return _ensure_physical(_to_physical(meta, conf), conf)
+    root = _ensure_physical(_to_physical(meta, conf), conf)
+    _count_exchange_consumers(root)
+    return root
+
+
+def _count_exchange_consumers(root) -> None:
+    """Count, per ShuffleExchangeExec INSTANCE, how many tree edges
+    drain it. Full expansion, no dedup: a subtree shared by the two
+    halves of a full-outer union (``_build_join``) really is drained
+    twice per run. The exchange frees its shuffle blocks only after
+    that many full drains (exec/exchange.py ``_release``)."""
+    from ..exec.exchange import ShuffleExchangeExec
+    counts: Dict[int, int] = {}
+    insts: Dict[int, object] = {}
+
+    def walk(n) -> None:
+        if isinstance(n, ShuffleExchangeExec):
+            counts[id(n)] = counts.get(id(n), 0) + 1
+            insts[id(n)] = n
+        for c in getattr(n, "children", []):
+            walk(c)
+
+    walk(root)
+    for k, x in insts.items():
+        x._planned_consumers = counts[k]
 
 
 def tag_only(plan: LogicalPlan,

@@ -379,6 +379,14 @@ class DataFrame:
         from ..cache import cache_dataframe
         return cache_dataframe(self)
 
+    def unpersist(self) -> "DataFrame":
+        """Release a cached DataFrame's blocks (memory + disk) and
+        unregister it from the session cache registry."""
+        from ..cache import CachedRelation
+        if isinstance(self.plan, CachedRelation):
+            self.plan.unpersist()
+        return self
+
     def to_device_arrays(self) -> "DeviceColumns":
         """Zero-copy ML export (ColumnarRdd.scala:42 role — the
         reference hands cuDF tables to XGBoost; here downstream jax ML

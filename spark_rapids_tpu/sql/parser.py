@@ -1366,13 +1366,15 @@ class Analyzer:
 
     def _correlation_split(self, stmt: "SelectA", outer_scope: _Scope):
         """Split a subquery's WHERE into (inner conjuncts, correlation
-        pairs [(outer_ast, inner_ast)], outer-only conjuncts). Raises
-        for non-equi correlation (the reference inherits the same
-        limitation from Spark's rewrite to joins)."""
+        pairs [(outer_ast, inner_ast)], outer-only conjuncts, residual
+        conjuncts). Residuals reference BOTH scopes non-equi (q94's
+        ``ws1.ws_warehouse_sk <> ws2.ws_warehouse_sk``); EXISTS lowers
+        them as a post-join filter (``_apply_exists_residual``), other
+        shapes reject them."""
         inner = self._inner_scope_of(stmt)
         if inner is None:
             raise SqlError("correlated subquery needs a FROM clause")
-        inner_c, pairs, outer_c = [], [], []
+        inner_c, pairs, outer_c, residuals = [], [], [], []
         for c in self._conjuncts(stmt.where):
             if not self._outer_refs(c, inner):
                 inner_c.append(c)
@@ -1389,9 +1391,8 @@ class Analyzer:
             if not self._outer_refs_any_inner(c, inner):
                 outer_c.append(c)
                 continue
-            raise SqlError("only equi-correlated subquery predicates "
-                           "are supported")
-        return inner_c, pairs, outer_c
+            residuals.append(c)
+        return inner_c, pairs, outer_c, residuals
 
     def _outer_refs_any_inner(self, ast, inner_scope: _Scope) -> bool:
         """Does ``ast`` reference ANY column the inner scope resolves?"""
@@ -1434,13 +1435,17 @@ class Analyzer:
             key = f"__sqv{n}"
             sub_df = sub_df.select(
                 Alias(col(sub_df.schema[0][0]), key))
-            return sub_df, [self.lower(value_ast, outer_scope)], [key]
-        inner_c, pairs, outer_c = self._correlation_split(
+            return (sub_df, [self.lower(value_ast, outer_scope)],
+                    [key], [])
+        inner_c, pairs, outer_c, residuals = self._correlation_split(
             stmt, outer_scope)
         if outer_c:
             raise SqlError("outer-only conjunct inside subquery not "
                            "supported")
-        if (stmt.group_by or stmt.having) and pairs:
+        if residuals and value_ast is not None:
+            raise SqlError("non-equi correlated predicates are only "
+                           "supported in EXISTS")
+        if (stmt.group_by or stmt.having) and (pairs or residuals):
             raise SqlError("correlated subquery with GROUP BY/HAVING "
                            "not supported in EXISTS/IN")
         n = Analyzer._subq_n = Analyzer._subq_n + 1
@@ -1465,13 +1470,52 @@ class Analyzer:
             items.append((i_ast, kname))
             left_keys.append(self.lower(o_ast, outer_scope))
             right_names.append(kname)
+        res_asts = []
+        if residuals:
+            # project every inner column a residual references under a
+            # fresh name and rewrite the residual to reference it; the
+            # EXISTS rewrite filters on it post-join
+            import copy
+            inner_scope = self._inner_scope_of(stmt)
+            mapping: dict = {}
+
+            def rw(a):
+                if isinstance(a, ColA):
+                    try:
+                        internal = inner_scope.resolve(a.name,
+                                                       a.qualifier)
+                    except (SqlError, KeyError):
+                        return a
+                    if internal not in mapping:
+                        fresh = f"__sqr{n}_{len(mapping)}"
+                        mapping[internal] = fresh
+                        items.append((ColA(a.name, a.qualifier), fresh))
+                    return ColA(mapping[internal], None)
+                if isinstance(a, (ScalarSubqueryA, ExistsA,
+                                  InSubqueryA)):
+                    raise SqlError("nested subquery inside a "
+                                   "correlated predicate is not "
+                                   "supported")
+                if not isinstance(a, Ast):
+                    return a
+                b = copy.copy(a)
+                for k, v in vars(a).items():
+                    if isinstance(v, Ast):
+                        setattr(b, k, rw(v))
+                    elif isinstance(v, (list, tuple)):
+                        setattr(b, k, type(v)(
+                            rw(x) if isinstance(x, Ast) else x
+                            for x in v))
+                return b
+
+            res_asts = [rw(c) for c in residuals]
         if not items:
             # uncorrelated EXISTS: non-emptiness only
             items.append((LitA(1), f"__sq1_{n}"))
             right_names, left_keys = [], []
         s2.items = items
         sub_df = self.analyze_select(s2)
-        return sub_df, left_keys, right_names
+        return sub_df, left_keys, right_names, res_asts
 
     def _apply_subquery_pred(self, df, scope: _Scope, ast):
         """Lower one WHERE conjunct containing subquery predicates onto
@@ -1484,8 +1528,11 @@ class Analyzer:
             neg = not neg
             inner = inner.e
         if isinstance(inner, ExistsA):
-            sub_df, lk, rk = self._plan_semi_source(inner.stmt, scope,
-                                                    None)
+            sub_df, lk, rk, res = self._plan_semi_source(
+                inner.stmt, scope, None)
+            if res:
+                return self._apply_exists_residual(
+                    df, scope, sub_df, lk, rk, res, neg)
             if not lk:
                 # uncorrelated: EXISTS is a plan-time boolean
                 nonempty = len(sub_df.limit(1).collect()) > 0
@@ -1496,8 +1543,8 @@ class Analyzer:
                            how="left_anti" if neg else "left_semi")
         if isinstance(inner, InSubqueryA):
             effective_neg = neg != inner.neg
-            sub_df, lk, rk = self._plan_semi_source(inner.stmt, scope,
-                                                    inner.e)
+            sub_df, lk, rk, _res = self._plan_semi_source(
+                inner.stmt, scope, inner.e)
             if effective_neg:
                 return self._apply_not_in(df, scope, inner, sub_df, lk,
                                           rk)
@@ -1507,6 +1554,38 @@ class Analyzer:
             raise SqlError("NOT over this subquery predicate shape is "
                            "not supported")
         return self._apply_general_subquery_expr(df, scope, ast)
+
+    def _apply_exists_residual(self, df, scope: _Scope, sub_df, lk, rk,
+                               res_asts, neg: bool):
+        """EXISTS whose correlation has non-equi conjuncts (q94's
+        ``ws1.ws_warehouse_sk <> ws2.ws_warehouse_sk``): tag each outer
+        row with a unique id, inner-join to the subquery on the equi
+        pairs, filter on the residual, and semi/anti-join the surviving
+        ids back. The reference plans this same shape as a conditional
+        existence join (GpuBroadcastHashJoinExec with a bound AST
+        condition)."""
+        from ..expr.misc import monotonically_increasing_id
+        n = Analyzer._subq_n = Analyzer._subq_n + 1
+        rid = f"__srid{n}"
+        out_names = [nm for nm, _t in df.schema]
+        df_id = df.with_column(rid, monotonically_increasing_id())
+        if lk:
+            joined = df_id.join(sub_df, (lk, [col(k) for k in rk]),
+                                how="inner")
+        else:
+            joined = df_id.cross_join(sub_df)
+        comb = _Scope(
+            scope.entries + [(f"__sub{n}",
+                              [(nm, nm) for nm, _t in sub_df.schema])],
+            {**scope.types, **dict(sub_df.schema)})
+        cond = None
+        for a in res_asts:
+            e = self.lower(a, comb)
+            cond = e if cond is None else P.And(cond, e)
+        matched = joined.filter(cond).select(Alias(col(rid), rid))
+        kept = df_id.join(matched, ([col(rid)], [col(rid)]),
+                          how="left_anti" if neg else "left_semi")
+        return kept.select(*[Alias(col(nm), nm) for nm in out_names])
 
     def _apply_not_in(self, df, scope, inner: "InSubqueryA", sub_df, lk,
                       rk):
@@ -1542,8 +1621,11 @@ class Analyzer:
         def rewrite(a):
             nonlocal df
             if isinstance(a, ExistsA):
-                sub_df, lk, rk = self._plan_semi_source(a.stmt, scope,
-                                                        None)
+                sub_df, lk, rk, res = self._plan_semi_source(
+                    a.stmt, scope, None)
+                if res:
+                    raise SqlError("non-equi correlated EXISTS under "
+                                   "OR is not supported")
                 if not lk:
                     nonempty = len(sub_df.limit(1).collect()) > 0
                     return LitA(nonempty)
@@ -1559,8 +1641,8 @@ class Analyzer:
             if isinstance(a, InSubqueryA):
                 if a.neg:
                     raise SqlError("NOT IN under OR is not supported")
-                sub_df, lk, rk = self._plan_semi_source(a.stmt, scope,
-                                                        a.e)
+                sub_df, lk, rk, _res = self._plan_semi_source(
+                    a.stmt, scope, a.e)
                 n = Analyzer._subq_n = Analyzer._subq_n + 1
                 marker = f"__exists{n}"
                 sub_m = sub_df.select(
@@ -1580,9 +1662,9 @@ class Analyzer:
                 if not isinstance(stmt, SelectA) or len(stmt.items) != 1:
                     raise SqlError("correlated scalar subquery must "
                                    "select one expression")
-                inner_c, pairs, outer_c = self._correlation_split(
-                    stmt, scope)
-                if outer_c or not pairs or stmt.group_by:
+                inner_c, pairs, outer_c, residuals = \
+                    self._correlation_split(stmt, scope)
+                if outer_c or residuals or not pairs or stmt.group_by:
                     raise SqlError("unsupported correlated scalar "
                                    "subquery shape")
                 n = Analyzer._subq_n = Analyzer._subq_n + 1

@@ -198,3 +198,48 @@ def test_skewed_left_join_split_matches_cpu():
                               "w": [f"w{i}" for i in range(20)]})
     df = fact.join(dim, ([col("k")], [col("k")]), how="left")
     assert_tpu_cpu_equal_df(df)
+
+
+def test_full_outer_join_shared_exchange_drains_twice():
+    """Full outer lowers to left_outer UNION null-extended anti with
+    BOTH joins sharing the child exchanges (overrides._build_join);
+    the second drain must still find the shuffle registered (the
+    consumer-refcounted release in exchange._release — an eager
+    unregister after the first drain raised KeyError here)."""
+    s = make_session()
+    import numpy as np
+    rng = np.random.default_rng(11)
+    left = s.create_dataframe({
+        "k": rng.integers(0, 40, 600).tolist(),
+        "a": rng.uniform(0, 1, 600).tolist()})
+    right = s.create_dataframe({
+        "k": rng.integers(20, 60, 600).tolist(),
+        "b": rng.uniform(0, 1, 600).tolist()})
+    la = left.group_by("k").agg(Sum(col("a")).alias("sa"))
+    rb = right.group_by("k").agg(Sum(col("b")).alias("sb"))
+    df = la.join(rb, ([col("k")], [col("k")]), how="full")
+    assert_tpu_cpu_equal_df(df)
+
+
+def test_full_outer_join_with_aqe_coalesce_global_agg():
+    """The exact q97 shape: grouped CTEs -> FULL OUTER JOIN -> global
+    aggregate, with AQE coalescing active above the shared exchanges."""
+    s = make_session()
+    import numpy as np
+    rng = np.random.default_rng(12)
+    df = s.create_dataframe({
+        "a": rng.integers(0, 30, 800).tolist(),
+        "c": [f"g{i % 7}" for i in range(800)],
+        "b": rng.normal(size=800).tolist()})
+    s.create_or_replace_temp_view("t97", df)
+    out = s.sql("""
+        WITH lo AS (SELECT a, c FROM t97 WHERE b > 0.3 GROUP BY a, c),
+             hi AS (SELECT a, c FROM t97 WHERE b < -0.3 GROUP BY a, c)
+        SELECT SUM(CASE WHEN lo.a IS NOT NULL AND hi.a IS NULL
+                        THEN 1 ELSE 0 END) AS lo_only,
+               SUM(CASE WHEN lo.a IS NULL AND hi.a IS NOT NULL
+                        THEN 1 ELSE 0 END) AS hi_only,
+               SUM(CASE WHEN lo.a IS NOT NULL AND hi.a IS NOT NULL
+                        THEN 1 ELSE 0 END) AS both_cnt
+        FROM lo FULL OUTER JOIN hi ON lo.a = hi.a AND lo.c = hi.c""")
+    assert_tpu_cpu_equal_df(out)

@@ -34,8 +34,10 @@ def test_cache_roundtrip_and_reuse(session):
 def test_cache_compresses(session):
     df = session.create_dataframe({"x": [7] * 10000})
     cached = df.cache()
-    nbytes = sum(len(b) for b in cached.plan.blocks)
+    nbytes = sum(b.length for chunk in cached.plan.chunks
+                 for b in chunk.values())
     assert nbytes < 10000 * 8 // 4  # constant column compresses well
+    cached.unpersist()
 
 
 def test_datagen_deterministic_chunks(session, tmp_path):

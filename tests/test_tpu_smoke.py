@@ -97,12 +97,15 @@ def test_spill_roundtrip_on_device():
     col = ColumnVector(jnp.asarray(vals), jnp.ones(1 << 16, jnp.bool_),
                        dt.FLOAT64)
     sb = SpillableBatch(ColumnarBatch([col], ["v"], 1 << 16), catalog=cat)
+    # the reference is the DEVICE's own representation: TPU f64 is
+    # emulated (~48-bit mantissa) and may drop low bits on the initial
+    # upload — the spill tiers themselves must be lossless from there
+    dev_vals = np.asarray(col.data)
     sb.spill_to_host()
     sb.spill_to_disk()
     back = np.asarray(sb.get().columns[0].data)
-    # emulated f64 round-trips bit-exactly through host/disk tiers
-    # (values only pass device<->host copies, no arithmetic)
-    assert np.array_equal(back, np.asarray(vals))
+    assert np.array_equal(back, dev_vals)
+    assert np.allclose(back, vals, rtol=1e-9)
     sb.close()
     reset_spill_catalog()
 
