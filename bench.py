@@ -18,7 +18,7 @@ Budget discipline (the round-2 bench TIMED OUT, rc=124, and recorded
 nothing): the backend probe is capped at 30s, the parquet inputs are
 generated once into a repo-local cache that persists across runs, every
 XLA compile round-trips the persistent compilation cache, and a
-wall-clock budget (SRT_BENCH_BUDGET, default 240s) skips the remaining
+wall-clock budget (SRT_BENCH_BUDGET, default 600s) skips the remaining
 stages — emitting what completed — rather than overrunning.
 
 Environment knobs: SRT_BENCH_SCALE (lineitem rows, default 6,000,000 =
@@ -34,7 +34,11 @@ import time
 import numpy as np
 
 T_START = time.monotonic()
-BUDGET = float(os.environ.get("SRT_BENCH_BUDGET", 240))
+# 600s default: headline queries land inside the first ~100s and every
+# later stage emits progressively, so a harness-side kill still leaves
+# a complete JSON record; the extra room lets the NDS sweep + the
+# delta-merge/mortgage stages (BASELINE configs 4-5) run on slow boxes
+BUDGET = float(os.environ.get("SRT_BENCH_BUDGET", 600))
 ITERS = int(os.environ.get("SRT_BENCH_ITERS", 2))
 KERNEL_ROWS = 1 << 22
 KERNEL_ITERS = 10
@@ -47,6 +51,31 @@ Q6_BYTES_PER_ROW = 8 * 3 + 4
 def log(msg: str) -> None:
     print(f"[{time.monotonic() - T_START:6.1f}s] {msg}",
           file=sys.stderr, flush=True)
+
+
+def _rss_fraction() -> float:
+    """This process's resident set as a fraction of the EFFECTIVE memory
+    limit — the cgroup limit when one applies (container sandboxes cap
+    far below host MemTotal), else host MemTotal. 0.0 when /proc is
+    unreadable (never triggers the purge)."""
+    try:
+        with open("/proc/self/statm") as f:
+            rss_kb = int(f.read().split()[1]) * \
+                (os.sysconf("SC_PAGE_SIZE") // 1024)
+        with open("/proc/meminfo") as f:
+            limit_kb = int(f.readline().split()[1])
+        for p in ("/sys/fs/cgroup/memory.max",
+                  "/sys/fs/cgroup/memory/memory.limit_in_bytes"):
+            try:
+                raw = open(p).read().strip()
+                if raw.isdigit():
+                    limit_kb = min(limit_kb, int(raw) // 1024)
+                break
+            except OSError:
+                continue
+        return rss_kb / max(limit_kb, 1)
+    except Exception:
+        return 0.0
 
 
 def left(label: str, need: float = 15.0) -> bool:
@@ -147,6 +176,24 @@ def pandas_q3(paths):
           ["revenue"].sum()
           .sort_values("revenue", ascending=False).head(10))
     return g
+
+
+def pandas_mortgage(mort_dir):
+    """Same per-loan feature ETL as models.mortgage.mortgage_etl, in
+    pandas: the config-5 CPU baseline."""
+    import pandas as pd
+    acq = pd.read_parquet(os.path.join(mort_dir, "acquisitions"))
+    perf = pd.read_parquet(os.path.join(mort_dir, "performance"))
+    perf["delinq_90"] = (perf["days_delinquent"] >= 90).astype("int64")
+    per_loan = perf.groupby("loan_id").agg(
+        n_reports=("loan_id", "count"),
+        n_delinq_90=("delinq_90", "sum"),
+        max_delinq=("days_delinquent", "max"),
+        avg_upb=("current_upb", "mean")).reset_index()
+    feats = per_loan.merge(acq, on="loan_id")
+    feats["ever_90"] = (feats["n_delinq_90"] > 0).astype("int64")
+    # the device-arrays hand-off analogue: materialize numeric ndarray
+    return feats.select_dtypes("number").to_numpy()
 
 
 # ---------------------------------------------------------------------------
@@ -340,14 +387,98 @@ def main():
             "measured_peak_gb_s": round(peak, 1),
         })
         log(f"kernel q6: {kq6 * 1e3:.2f}ms, peak {peak:.0f} GB/s")
+    # --- BASELINE config 4: Delta MERGE/UPDATE-heavy upsert ----------------
+    if left("delta merge", need=45):
+        try:
+            import shutil
+            import tempfile
+
+            import numpy as np
+
+            from spark_rapids_tpu.columnar import dtypes as dt
+            from spark_rapids_tpu.delta.table import AcidTable
+            from spark_rapids_tpu.expr.core import col, lit
+
+            n = max(scale // 40, 10_000)
+            half = n // 2
+            sess = framework_session()
+            tgt_dir = tempfile.mkdtemp(prefix="srt_delta_bench_")
+            try:
+                schema = [("k", dt.INT64), ("amount", dt.FLOAT64),
+                          ("flag", dt.INT32)]
+                tab = AcidTable.create(sess, tgt_dir, schema)
+                rng = np.random.default_rng(0)
+                base = sess.create_dataframe(
+                    {"k": list(range(n)),
+                     "amount": rng.uniform(0, 1e4, n).tolist(),
+                     "flag": [0] * n}, schema)
+                tab.append(base)
+                # upsert: half the keys match (update), half are new
+                src = sess.create_dataframe(
+                    {"k": list(range(half, n + half)),
+                     "amount": rng.uniform(0, 1e4, n).tolist(),
+                     "flag": [1] * n}, schema)
+                t0 = time.perf_counter()
+                tab.merge(src, on=["k"], when_matched_update={
+                    "amount": col("src_amount"), "flag": col("src_flag")})
+                tab.update({"flag": col("flag") + lit(2)},
+                           col("amount") > lit(5e3))
+                merge_s = time.perf_counter() - t0
+                RESULT["delta_merge_s"] = round(merge_s, 3)
+                RESULT["delta_merge_rows_s"] = round(
+                    2 * n / merge_s / 1e6, 3)  # target+source rows/s, M
+                log(f"delta merge+update ({n} target rows): "
+                    f"{merge_s:.2f}s")
+                emit()
+            finally:
+                shutil.rmtree(tgt_dir, ignore_errors=True)
+        except Exception as e:
+            log(f"delta merge bench failed: {e}")
+
+    # --- BASELINE config 5: Mortgage ETL -> device arrays (ML hand-off) ---
+    if left("mortgage etl", need=45):
+        try:
+            from spark_rapids_tpu.models.mortgage import (mortgage_etl,
+                                                          mortgage_tables)
+            n_loans = max(scale // 60, 5_000)
+            mort_dir = os.path.join(os.path.dirname(data_dir),
+                                    f"mortgage_{n_loans}")
+            sess = framework_session()
+            tables = mortgage_tables(sess, mort_dir, n_loans=n_loans)
+            perf_rows = n_loans * 12
+
+            def run_etl():
+                feats = mortgage_etl(tables["acquisitions"],
+                                     tables["performance"])
+                # ML hand-off: device-resident dense arrays
+                # (ColumnarRdd -> XGBoost role)
+                arrs = feats.to_device_arrays()
+                return arrs
+
+            run_etl()  # warm
+            etl_s = _best(run_etl, max(ITERS - 1, 1))
+            c = _best(lambda: pandas_mortgage(mort_dir), 1)
+            RESULT["mortgage_etl_s"] = round(etl_s, 3)
+            RESULT["mortgage_rows_s"] = round(perf_rows / etl_s / 1e6, 3)
+            RESULT["mortgage_vs_baseline"] = round(c / etl_s, 3)
+            log(f"mortgage etl ({perf_rows} perf rows): {etl_s:.2f}s "
+                f"(pandas {c:.2f}s)")
+            emit()
+        except Exception as e:
+            log(f"mortgage bench failed: {e}")
+
     # --- NDS mini power-run (BASELINE config 2 breadth evidence):
-    # every query from the 24-query subset once, total wall recorded
+    # the full 99-query suite swept once, total wall + per-query recorded
     if left("nds power run", need=60):
         try:
             from spark_rapids_tpu.models.nds import (NDS_QUERIES,
                                                      register_nds)
-            nds_scale = int(os.environ.get("SRT_BENCH_NDS_SCALE",
-                                           8000))
+            # chip lane runs the suite at 100k store_sales rows (the
+            # differential-proof scale); the 1-core CPU fallback keeps
+            # the toy scale so the sweep fits the budget
+            nds_scale = int(os.environ.get(
+                "SRT_BENCH_NDS_SCALE",
+                100_000 if backend != "cpu" else 8000))
             nds_dir = os.path.join(os.path.dirname(data_dir),
                                    f"nds_{nds_scale}")
             nds_sess = framework_session()
@@ -379,13 +510,16 @@ def main():
                     # leaves the completed queries on stdout
                     nds_snapshot()
                     emit()
-                if done % 5 == 0:
+                if done % 5 == 0 and _rss_fraction() > 0.35:
                     # in-memory jit/executable caches grow without
                     # bound across 70+ distinct heavy queries and can
                     # exhaust host RAM (LLVM 'Cannot allocate memory'
                     # -> SIGSEGV); the persistent DISK compile cache
-                    # keeps re-runs cheap, so dropping the in-memory
-                    # layer trades a little re-trace time for survival
+                    # keeps re-runs cheap, so when resident size nears
+                    # the host's memory drop the in-memory layer —
+                    # trading a little re-trace time for survival
+                    # (unconditional clearing cost ~30%+ of sweep time
+                    # on big-RAM boxes that never needed it)
                     nds_sess._plan_cache.clear()
                     jax.clear_caches()
                     gc.collect()

@@ -66,12 +66,24 @@ if "JAX_COMPILATION_CACHE_DIR" not in _os.environ:
             pass
         try:
             with open("/proc/cpuinfo") as f:
+                flags = model = ""
+                cores = 0
                 for line in f:
-                    if line.startswith("flags"):
-                        tag += hashlib.sha1(
-                            " ".join(sorted(line.split()))
-                            .encode()).hexdigest()[:10]
-                        break
+                    if line.startswith("flags") and not flags:
+                        flags = " ".join(sorted(line.split()))
+                    elif line.startswith("model name") and not model:
+                        model = line.strip()
+                    elif line.startswith("processor"):
+                        cores += 1
+                # flags ALONE under-discriminate: two boxes of the same
+                # CPU family report identical flags while XLA picks
+                # different pseudo target features (prefer-no-scatter on
+                # high-core parts) — loading the other box's AOT blobs
+                # then SIGSEGVs in cache deserialization (observed in
+                # round 4). Fold in model name + core count.
+                tag += hashlib.sha1(
+                    f"{flags}|{model}|{cores}".encode()
+                ).hexdigest()[:10]
         except OSError:
             pass
         return tag

@@ -34,7 +34,7 @@ import os
 import struct
 import tempfile
 import threading
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -248,7 +248,7 @@ class _BlockStore:
     def stats(self) -> Dict[str, int]:
         return {"mem_bytes": self._mem_bytes,
                 "disk_bytes": self._file_end,
-                "blocks_mem": sum(1 for b in self._mem),
+                "blocks_mem": len(self._mem),
                 }
 
 

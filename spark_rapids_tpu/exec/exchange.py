@@ -219,12 +219,11 @@ class ShuffleExchangeExec(TpuExec):
                     parts.append((null_rank if o.ascending else 2 - null_rank,
                                   0))
                 else:
-                    key = v
-                    if isinstance(v, (bytes, str)):
-                        key = _InvertibleStr(str(v), o.ascending)
-                        parts.append((1, key))
-                        continue
-                    parts.append((1, key if o.ascending else -key))
+                    key = str(v) if isinstance(v, (bytes, str)) else v
+                    # `-key` is not defined for str/bool/date/Decimal
+                    # sample values; flip comparisons instead
+                    parts.append((1, key if o.ascending
+                                  else _InvertedKey(key)))
             return parts
         samples.sort(key=sort_key)
         # quantile bounds: num_parts-1 cut rows
@@ -510,21 +509,20 @@ class ShuffleExchangeExec(TpuExec):
         return f"ShuffleExchange[{keys}, parts={n}]"
 
 
-class _InvertibleStr:
-    """String wrapper whose ordering can be flipped (descending bounds
-    sort on the host sampler)."""
+class _InvertedKey:
+    """Order-reversing wrapper for any comparable host sample value
+    (bool/date/Decimal have no unary minus; numpy bools raise on it)."""
 
-    __slots__ = ("s", "asc")
+    __slots__ = ("v",)
 
-    def __init__(self, s: str, asc: bool):
-        self.s = s
-        self.asc = asc
+    def __init__(self, v):
+        self.v = v
 
     def __lt__(self, other):
-        return (self.s < other.s) if self.asc else (self.s > other.s)
+        return other.v < self.v
 
     def __eq__(self, other):
-        return self.s == other.s
+        return self.v == other.v
 
 
 class BroadcastExchangeExec(TpuExec):

@@ -266,7 +266,17 @@ class PallasAggPlan:
                 kind = PK.MAX if fn.largest else PK.MIN
                 slots.append((fn._key, self._slot(kind), in_t))
                 slots.append(("seen", self._slot(PK.SUM), dt.BOOL))
-                self._builders.append(self._minmax(fn, kind))
+                is_float = in_t in (dt.FLOAT32, dt.FLOAT64)
+                if is_float:
+                    # Spark float order puts NaN GREATEST: the kernel
+                    # reduces non-NaN lanes only and this count
+                    # restores NaN afterwards (any-NaN => max is NaN;
+                    # all-NaN => min is NaN) — mirrors
+                    # _MinMaxBase._float_reduce
+                    slots.append(("_nan", self._slot(PK.SUM),
+                                  dt.FLOAT64))
+                self._builders.append(self._minmax(fn, kind,
+                                                   with_nan=is_float))
             else:
                 raise AssertionError(type(fn))
             self.agg_slots.append(slots)
@@ -301,7 +311,7 @@ class PallasAggPlan:
             return [(mask & c.validity).astype(jnp.float32)]
         return build
 
-    def _minmax(self, fn, kind):
+    def _minmax(self, fn, kind, with_nan: bool):
         expr = self._prep(fn.children[0])
 
         def build(batch, mask):
@@ -309,7 +319,13 @@ class PallasAggPlan:
             m = mask & c.validity
             fill = jnp.asarray(PK.reduce_identity(kind, c.data.dtype),
                                c.data.dtype)
-            return [jnp.where(m, c.data, fill), m.astype(jnp.float32)]
+            if not with_nan:
+                return [jnp.where(m, c.data, fill),
+                        m.astype(jnp.float32)]
+            nan = jnp.isnan(c.data)
+            return [jnp.where(m & ~nan, c.data, fill),
+                    m.astype(jnp.float32),
+                    (m & nan).astype(jnp.float32)]
         return build
 
     # --- the fused per-batch function (jit this) ---
@@ -385,8 +401,9 @@ class PallasAggPlan:
             if k == PK.SUM:
                 totals[i] += v
             elif np.isnan(v) or np.isnan(totals[i]):
-                # match the XLA lane: scatter-min/max propagates NaN
-                # (python min/max would drop it order-dependently)
+                # builders exclude NaN lanes from min/max slots, so a
+                # NaN here can only be a true sum overflow artifact —
+                # keep the propagate-NaN guard for safety
                 totals[i] = float("nan")
             elif k == PK.MIN:
                 totals[i] = min(totals[i], v)
@@ -399,7 +416,20 @@ class PallasAggPlan:
         out = []
         for slots in self.agg_slots:
             d = {}
+            aux = {sname: totals[idx] for sname, idx, _ in slots}
+            if "_nan" in aux:
+                # Spark NaN-greatest ordering, deferred from the kernel
+                key_name, key_idx, _t = slots[0]
+                kkind = self.kinds[key_idx]
+                nan_ct, seen_ct = aux["_nan"], aux["seen"]
+                if kkind == PK.MAX and nan_ct > 0:
+                    totals[key_idx] = float("nan")
+                elif kkind == PK.MIN and nan_ct > 0 and \
+                        seen_ct - nan_ct <= 0:
+                    totals[key_idx] = float("nan")
             for sname, idx, stype in slots:
+                if sname == "_nan":
+                    continue  # consumed above; not part of the state
                 v = totals[idx]
                 phys = stype.physical
                 if stype == dt.BOOL:

@@ -653,7 +653,11 @@ class BatchedRunningWindowExec(TpuExec):
         def row0_equal(cols, tails):
             if not cols:
                 return jnp.ones((), jnp.bool_)
-            return K._keys_equal(cols, zero_i, tails, zero_i)[0]
+            # grouping equality: a NULL partition key continues the
+            # NULL partition (join-style null!=null broke carried
+            # state exactly for null keys)
+            return K._keys_equal(cols, zero_i, tails, zero_i,
+                                 null_safe=True)[0]
         cont = state["has_tail"] & (n > 0) & \
             row0_equal(part_cols, state["tail_part"])
         cont_order = cont & row0_equal(order_cols, state["tail_order"])

@@ -287,6 +287,32 @@ class _MinMaxBase(AggregateFunction):
         return {self._key + "_hi": bh, self._key + "_lo":
                 bl.astype(jnp.int64), "seen": seen}
 
+    def _float_reduce(self, gid, data, valid, num_groups) -> State:
+        """Spark float ordering: NaN is the GREATEST value. Plain
+        scatter-min/max propagates NaN into every group it touches
+        (XLA min(NaN, x) = NaN), which inverts the contract for min —
+        reduce over non-NaN lanes and reinstate NaN only where the
+        ordering demands it (any-NaN for max, all-NaN for min)."""
+        fdt = data.dtype
+        nan_mask = jnp.isnan(data)
+        nan_v = jnp.asarray(jnp.nan, fdt)
+        if self.largest:
+            fill = jnp.asarray(-jnp.inf, fdt)
+            vals = jnp.where(valid & ~nan_mask, data, fill)
+            m = _seg_max(vals, gid, num_groups, fill)
+            any_nan = _seg_sum((valid & nan_mask).astype(jnp.int32),
+                               gid, num_groups) > 0
+            out = jnp.where(any_nan, nan_v, m)
+        else:
+            fill = jnp.asarray(jnp.inf, fdt)
+            vals = jnp.where(valid & ~nan_mask, data, fill)
+            m = _seg_min(vals, gid, num_groups, fill)
+            any_num = _seg_sum((valid & ~nan_mask).astype(jnp.int32),
+                               gid, num_groups) > 0
+            out = jnp.where(any_num, m, nan_v)
+        seen = _seg_sum(valid.astype(jnp.int32), gid, num_groups) > 0
+        return {self._key: out, "seen": seen}
+
     def update(self, gid, col: Column, num_groups: int, live,
                **kw) -> State:
         from ..columnar.vector import StringColumn
@@ -296,6 +322,9 @@ class _MinMaxBase(AggregateFunction):
             from ..columnar import decimal128 as d128
             hi, lo = d128.limbs_of(col)
             return self._wide_reduce(gid, hi, lo, col.validity, num_groups)
+        if jnp.issubdtype(col.data.dtype, jnp.floating):
+            return self._float_reduce(gid, col.data, col.validity,
+                                      num_groups)
         fill = dt.max_value(col.dtype) if not self.largest else \
             dt.min_value(col.dtype)
         vals = jnp.where(col.validity, col.data,
@@ -316,6 +345,10 @@ class _MinMaxBase(AggregateFunction):
             lo = states[self._key + "_lo"].astype(jnp.uint64)
             return self._wide_reduce(gid, hi, lo, states["seen"],
                                      num_groups)
+        if jnp.issubdtype(states[self._key].dtype, jnp.floating):
+            # partial states may BE NaN (all-NaN groups): same ordering
+            return self._float_reduce(gid, states[self._key],
+                                      states["seen"], num_groups)
         fill = _phys_extreme(states[self._key].dtype,
                              largest=not self.largest)
         vals = jnp.where(states["seen"], states[self._key],

@@ -57,6 +57,12 @@ class Cast(Expression):
                                 dt.StringType)):
             return
         if src.is_numeric and isinstance(to, dt.StringType):
+            if src.is_floating:
+                # Java's shortest-round-trip float formatting (Ryu) has
+                # no device lane; the reference marks float->string
+                # INCOMPAT for the same reason (GpuCast.scala
+                # castFloatingTypeToString divergence notes)
+                raise TypeError(f"cast {src} -> {to} falls back to CPU")
             return
         if isinstance(src, dt.StringType) and (
                 to.is_numeric or isinstance(to, (dt.DateType, dt.TimestampType,

@@ -98,23 +98,41 @@ def murmur3_column(col: Column, seed) -> jnp.ndarray:
 
 
 def _murmur3_string(col: StringColumn, seed) -> jnp.ndarray:
+    """Bit-exact Spark string murmur3, O(1) trace size.
+
+    The mixing recurrence is sequential over 4-byte blocks, so it rides
+    a single ``lax.scan`` over the word axis (one traced op regardless
+    of the pad width W). A per-``b`` Python loop here previously issued
+    W/4 distinctly-sliced ops — every eager call minted ~W fresh pjit
+    cache entries and dominated wide-string exchange partitioning
+    (q22-class NDS plans spent 30s+ hashing 8k rows)."""
+    from jax import lax
     padded = col.padded()  # (cap, W) uint8, zero-padded
     cap, w = padded.shape
     lens = col.lengths()
     h1 = jnp.broadcast_to(seed, (cap,)).astype(jnp.uint32)
-    # 4-byte little-endian blocks
     nblocks = w // 4
-    for b in range(nblocks):
-        word = (padded[:, 4 * b].astype(jnp.uint32)
-                | (padded[:, 4 * b + 1].astype(jnp.uint32) << 8)
-                | (padded[:, 4 * b + 2].astype(jnp.uint32) << 16)
-                | (padded[:, 4 * b + 3].astype(jnp.uint32) << 24))
-        use = lens >= (4 * b + 4)
-        h1 = jnp.where(use, _mix_h1(h1, _mix_k1(word)), h1)
-    # tail: each remaining byte individually mixed, sign-extended
-    for i in range(w):
-        in_tail = (i >= (lens // 4) * 4) & (i < lens)
-        byte = padded[:, i].astype(jnp.int8).astype(jnp.int32).astype(jnp.uint32)
+    if nblocks:
+        # all little-endian words at once: (cap, nblocks)
+        p32 = padded[:, :nblocks * 4].astype(jnp.uint32) \
+            .reshape(cap, nblocks, 4)
+        words = (p32[:, :, 0] | (p32[:, :, 1] << 8)
+                 | (p32[:, :, 2] << 16) | (p32[:, :, 3] << 24))
+        use = lens[:, None] >= \
+            (4 * jnp.arange(1, nblocks + 1, dtype=jnp.int32))
+
+        def mix_block(h, word_use):
+            word, u = word_use
+            return jnp.where(u, _mix_h1(h, _mix_k1(word)), h), None
+
+        h1, _ = lax.scan(mix_block, h1, (words.T, use.T))
+    # tail: the <=3 trailing bytes, sign-extended, in byte order
+    tail_start = (lens // 4) * 4
+    for j in range(min(3, w)):
+        idx = jnp.clip(tail_start + j, 0, w - 1)
+        byte = jnp.take_along_axis(padded, idx[:, None], axis=1)[:, 0]
+        byte = byte.astype(jnp.int8).astype(jnp.int32).astype(jnp.uint32)
+        in_tail = (tail_start + j) < lens
         h1 = jnp.where(in_tail, _mix_h1(h1, _mix_k1(byte)), h1)
     return _fmix_dynamic(h1, lens)
 

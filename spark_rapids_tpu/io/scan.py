@@ -431,6 +431,28 @@ def iter_file_tables(path: str, fmt: str, schema: Schema,
                      max_rows: int, conf=None,
                      partition_values: Optional[dict] = None
                      ) -> Iterator[HostTable]:
+    """Path-naming wrapper over :func:`_iter_file_tables`: any decode
+    error is re-raised with the failing file's path prepended (same
+    exception type, so callers' handling is unchanged) — the
+    GpuMultiFileReader contract that a multi-file task failure
+    identifies WHICH file broke."""
+    try:
+        yield from _iter_file_tables(path, fmt, schema, options,
+                                     arrow_filter, max_rows, conf,
+                                     partition_values)
+    except Exception as e:
+        if path not in str(e):
+            head = str(e.args[0]) if e.args else str(e)
+            e.args = (f"while reading {fmt} file {path}: {head}",
+                      ) + tuple(e.args[1:])
+        raise
+
+
+def _iter_file_tables(path: str, fmt: str, schema: Schema,
+                      options: dict, arrow_filter,
+                      max_rows: int, conf=None,
+                      partition_values: Optional[dict] = None
+                      ) -> Iterator[HostTable]:
     """Decode one file on the host into row-sliced HostTables conforming
     to the DECLARED schema: positional rename when file column names
     differ (e.g. headerless CSV) and per-column cast to declared dtypes.

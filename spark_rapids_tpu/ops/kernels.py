@@ -469,8 +469,13 @@ def _join_key_hash(cols: Sequence[Column], null_sentinel: int) -> jnp.ndarray:
 
 
 def _keys_equal(a_cols: Sequence[Column], a_idx, b_cols: Sequence[Column],
-                b_idx) -> jnp.ndarray:
-    """True key equality for candidate pairs (collision verification)."""
+                b_idx, null_safe: bool = False) -> jnp.ndarray:
+    """True key equality for candidate pairs (collision verification).
+
+    Default is JOIN equality (null matches nothing). ``null_safe=True``
+    gives grouping equality — null == null, NaN == NaN — for callers
+    comparing partition/group keys (e.g. the running-window carried-
+    state continuation check)."""
     ok = jnp.ones(a_idx.shape[0], jnp.bool_)
     for ca, cb in zip(a_cols, b_cols):
         va = jnp.take(ca.validity, a_idx)
@@ -493,7 +498,12 @@ def _keys_equal(a_cols: Sequence[Column], a_idx, b_cols: Sequence[Column],
                 da = da.astype(tgt)
                 db = db.astype(tgt)
             eq = da == db
-        ok = ok & va & vb & eq
+            if null_safe and jnp.issubdtype(da.dtype, jnp.floating):
+                eq = eq | (jnp.isnan(da) & jnp.isnan(db))
+        if null_safe:
+            ok = ok & ((va & vb & eq) | (~va & ~vb))
+        else:
+            ok = ok & va & vb & eq
     return ok
 
 

@@ -147,10 +147,6 @@ GROUP_BUCKETS = 1024
 #: VMEM — 2048x1024 f32 = 8 MiB, within the ~16 MB/core budget
 #: (pallas_guide.md); 8192 rows would need 32 MiB and fail Mosaic
 GROUP_TILE_ROWS = 2048
-#: tiles per float32 accumulator block: bounds in-kernel running-sum
-#: round-off; blocks reduce OUTSIDE in float64 (same numerics contract
-#: as tile_reduce's per-tile partials)
-GROUP_ACC_TILES = 64
 
 
 def tile_group_reduce(gid: jax.Array, values: Sequence[jax.Array],
@@ -177,7 +173,7 @@ def tile_group_reduce(gid: jax.Array, values: Sequence[jax.Array],
     accumulating grid needs the output-block revisit pattern, which
     this environment's remote Mosaic compiler rejects, and (b) the
     scan carry accumulates at float64, bounding round-off per TILE
-    rather than per GROUP_ACC_TILES window. The kernel body avoids
+    rather than per multi-tile window. The kernel body avoids
     jnp operator sugar with Python-int operands: under x64 those
     route through jitted jnp wrappers that type the scalar operand
     int64, and Mosaic's in-kernel i64<->i32 convert recurses forever

@@ -206,8 +206,14 @@ def _sort_keys(col: HostColumn, ascending: bool, nulls_first: bool):
     else:
         key = col.values
     if not ascending:
-        if np.issubdtype(np.asarray(key).dtype, np.floating):
+        arr = np.asarray(key)
+        if np.issubdtype(arr.dtype, np.floating):
             key = -key
+        elif arr.dtype == object:
+            # decimal128 unscaled ints exceed int64 — negate as python
+            # ints (object lanes already sort via python compare)
+            key = np.array([None if x is None else -x for x in key],
+                           dtype=object)
         else:
             key = -(key.astype(np.int64))
     return null_rank, key
@@ -233,6 +239,20 @@ def _sort_table(table: HostTable, order) -> HostTable:
 # aggregate
 # ---------------------------------------------------------------------------
 
+_NAN_KEY = object()  # canonical NaN grouping key: NaN == NaN in keys
+
+
+def _norm_key(v):
+    """Spark NormalizeFloatingNumbers for grouping/partition keys:
+    every NaN is THE NaN, -0.0 is 0.0."""
+    if isinstance(v, float):
+        if v != v:
+            return _NAN_KEY
+        if v == 0.0:
+            return 0.0
+    return v
+
+
 def _group_ids(key_cols: List[HostColumn], n: int):
     """Assign group ids; returns (gid array, representative row indices in
     first-seen order)."""
@@ -245,9 +265,9 @@ def _group_ids(key_cols: List[HostColumn], n: int):
     for i in range(n):
         k = tuple((None if not c.mask[i]
                    else (c.values[i] if c.dtype == dt.STRING
-                         else (c.values[i].item()
-                               if hasattr(c.values[i], "item")
-                               else c.values[i])))
+                         else _norm_key(c.values[i].item()
+                                        if hasattr(c.values[i], "item")
+                                        else c.values[i])))
                   for c in key_cols)
         g = seen.get(k)
         if g is None:

@@ -9,13 +9,14 @@ random generators). The CPU oracle is the numpy interpreter
 
 from .asserts import (assert_falls_back_to_cpu, assert_runs_on_tpu,
                       assert_tpu_cpu_equal, assert_tpu_cpu_equal_df)
-from .datagen import (BoolGen, DateGen, DecimalGen, DoubleGen, FloatGen,
-                      IntGen, LongGen, ShortGen, StringGen, TimestampGen,
-                      gen_table)
+from .datagen import (BoolGen, ByteGen, DateGen, DecimalGen, DoubleGen,
+                      FloatGen, IntGen, LongGen, ShortGen, StringGen,
+                      TimestampGen, gen_table)
 
 __all__ = [
     "assert_tpu_cpu_equal", "assert_tpu_cpu_equal_df",
     "assert_falls_back_to_cpu", "assert_runs_on_tpu",
-    "IntGen", "LongGen", "ShortGen", "DoubleGen", "FloatGen", "BoolGen",
-    "StringGen", "DateGen", "TimestampGen", "DecimalGen", "gen_table",
+    "IntGen", "LongGen", "ShortGen", "ByteGen", "DoubleGen", "FloatGen",
+    "BoolGen", "StringGen", "DateGen", "TimestampGen", "DecimalGen",
+    "gen_table",
 ]

@@ -140,6 +140,13 @@ class DateGen(DataGen):
     # bound to the int32-days-safe modern range)
     lo_days, hi_days = -25567, 47482  # 1900-01-01 .. 2100-01-01
 
+    def __init__(self, lo_days=None, hi_days=None, **kw):
+        super().__init__(**kw)
+        if lo_days is not None:
+            self.lo_days = lo_days
+        if hi_days is not None:
+            self.hi_days = hi_days
+
     def _values(self, n, rng):
         days = rng.integers(self.lo_days, self.hi_days, n)
         epoch = datetime.date(1970, 1, 1)

@@ -1,0 +1,256 @@
+"""Failure-path tests (VERDICT r3 weak #8): transport peer death
+mid-fetch, dead endpoints, in-flight budget enforcement, multi-file
+reader modes over corrupt/missing inputs, and writer mode semantics.
+
+Reference analogues: RapidsShuffleClient error propagation
+(RapidsShuffleClient.scala:90 transport error → task failure, never
+silent partial results), MultiFileCloudParquetPartitionReader
+surfacing per-file read failures on the task thread
+(GpuMultiFileReader.scala), and FileFormatWriter job-abort semantics.
+"""
+
+import os
+import socket
+import struct
+import threading
+
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.vector import batch_from_pydict
+from spark_rapids_tpu.conf import READER_TYPE, SrtConf
+from spark_rapids_tpu.parallel.serializer import serialize_batch
+from spark_rapids_tpu.parallel.shuffle_manager import ShuffleManager
+from spark_rapids_tpu.parallel.transport import (MAGIC, ByteBudget,
+                                                 ShuffleBlockClient,
+                                                 ShuffleBlockServer,
+                                                 fetch_all_partitions)
+from spark_rapids_tpu.plan import TpuSession
+
+
+def _mgr_with_blocks(shuffle_id=7, reduce_id=0, n_blocks=4, rows=50):
+    mgr = ShuffleManager(SrtConf({}))
+    for m in range(n_blocks):
+        b = batch_from_pydict(
+            {"i": list(range(m * rows, (m + 1) * rows))},
+            schema=[("i", dt.INT64)])
+        mgr.host_store.put((shuffle_id, m, reduce_id), serialize_batch(b))
+    return mgr
+
+
+# ---------------------------------------------------------------- transport
+
+def test_fetch_dead_endpoint_raises():
+    """A peer that never answers (connection refused) must surface an
+    error on the consuming thread — not yield a silently-short
+    partition."""
+    # grab a port nobody listens on
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead = "127.0.0.1:%d" % s.getsockname()[1]
+    s.close()
+    with pytest.raises(OSError):
+        list(fetch_all_partitions([dead], 7, 0, max_concurrent=1))
+
+
+def test_fetch_mixed_live_and_dead_endpoints_raises_after_drain():
+    """With one live and one dead peer the iterator must still raise:
+    partial data from the live peer is not a complete partition."""
+    mgr = _mgr_with_blocks()
+    srv = ShuffleBlockServer(mgr)
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead = "127.0.0.1:%d" % s.getsockname()[1]
+    s.close()
+    try:
+        got = []
+        with pytest.raises(OSError):
+            for b in fetch_all_partitions([srv.endpoint, dead], 7, 0,
+                                          max_concurrent=2):
+                got.append(b)
+        # live peer's blocks may have been yielded before the error —
+        # that is fine; the error must still terminate the iterator
+        assert len(got) <= 4
+    finally:
+        srv.close()
+
+
+class _TruncatingHandler(threading.Thread):
+    """A fake peer that advertises one block then dies mid-payload —
+    the peer-death-mid-fetch scenario."""
+
+    def __init__(self):
+        super().__init__(daemon=True)
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(1)
+        self.endpoint = "127.0.0.1:%d" % self.sock.getsockname()[1]
+
+    def run(self):
+        conn, _ = self.sock.accept()
+        conn.recv(12)  # request
+        conn.sendall(struct.pack("<I", 1))            # one block
+        conn.sendall(struct.pack("<IQ", 0, 1 << 20))  # promises 1 MiB
+        conn.sendall(b"x" * 100)                      # ...sends 100 B
+        conn.close()
+
+
+def test_peer_death_mid_block_raises_connection_error():
+    peer = _TruncatingHandler()
+    peer.start()
+    cli = ShuffleBlockClient(peer.endpoint, timeout_s=10.0)
+    with pytest.raises(ConnectionError, match="peer closed"):
+        list(cli.stream_raw(1, 0))
+
+
+def test_peer_death_mid_block_through_fetch_all():
+    peer = _TruncatingHandler()
+    peer.start()
+    with pytest.raises(ConnectionError):
+        list(fetch_all_partitions([peer.endpoint], 1, 0, max_concurrent=1))
+
+
+def test_byte_budget_bounds_in_flight_bytes():
+    """Concurrent fetch from many peers must keep staged (fetched but
+    not yet consumed) bytes under the configured window."""
+    mgrs = [_mgr_with_blocks(n_blocks=6, rows=400) for _ in range(3)]
+    servers = [ShuffleBlockServer(m) for m in mgrs]
+    block_len = len(mgrs[0].host_store.get((7, 0, 0)))
+    budget = ByteBudget(block_len * 2)  # window of ~2 blocks
+    try:
+        n = 0
+        for b in fetch_all_partitions([s.endpoint for s in servers], 7, 0,
+                                      max_concurrent=3, budget=budget):
+            n += b.num_rows
+        assert n == 3 * 6 * 400
+        # ByteBudget admits an oversized block alone, otherwise caps at
+        # limit: peak can exceed limit by at most one block
+        assert budget.peak <= budget.limit + block_len
+    finally:
+        for s in servers:
+            s.close()
+
+
+def test_fetch_all_empty_endpoint_list_yields_nothing():
+    assert list(fetch_all_partitions([], 7, 0)) == []
+
+
+# ------------------------------------------------------- multi-file readers
+
+@pytest.fixture(scope="module")
+def good_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("pqfail")
+    sess = TpuSession()
+    for i in range(3):
+        df = sess.create_dataframe(
+            {"k": list(range(i * 10, i * 10 + 10)),
+             "v": [float(x) for x in range(10)]},
+            [("k", dt.INT64), ("v", dt.FLOAT64)])
+        df.write.mode("append").parquet(str(d))
+    return str(d)
+
+
+@pytest.mark.parametrize("reader", ["PERFILE", "COALESCING",
+                                    "MULTITHREADED"])
+def test_corrupt_file_surfaces_error(good_dir, tmp_path, reader):
+    """A corrupt file among good ones must fail the scan in every
+    reader mode — never silently drop the file's rows."""
+    import shutil
+    d = tmp_path / "mix"
+    shutil.copytree(good_dir, d)
+    files = sorted(p for p in os.listdir(d) if p.endswith(".parquet"))
+    # truncate the middle file to garbage that still has the magic
+    victim = d / files[1]
+    raw = victim.read_bytes()
+    victim.write_bytes(raw[: len(raw) // 3])
+    s = TpuSession(SrtConf({READER_TYPE.key: reader}))
+    with pytest.raises(Exception):
+        s.read.parquet(str(d)).collect()
+
+
+@pytest.mark.parametrize("reader", ["PERFILE", "COALESCING",
+                                    "MULTITHREADED"])
+def test_file_deleted_between_plan_and_execute(good_dir, tmp_path, reader):
+    """Files vanishing between planning and execution (external table
+    mutation) must raise, matching Spark's FileNotFoundException."""
+    import shutil
+    d = tmp_path / "vanish"
+    shutil.copytree(good_dir, d)
+    s = TpuSession(SrtConf({READER_TYPE.key: reader}))
+    df = s.read.parquet(str(d))
+    files = sorted(p for p in os.listdir(d) if p.endswith(".parquet"))
+    os.remove(d / files[-1])
+    with pytest.raises(Exception):
+        df.collect()
+
+
+def test_corrupt_file_error_names_the_file(good_dir, tmp_path):
+    """The error should identify which file failed (multi-file readers
+    wrap per-file errors with the path — GpuMultiFileReader behavior)."""
+    import shutil
+    d = tmp_path / "named"
+    shutil.copytree(good_dir, d)
+    files = sorted(p for p in os.listdir(d) if p.endswith(".parquet"))
+    victim = d / files[0]
+    victim.write_bytes(b"PAR1 this is not a parquet file PAR1")
+    s = TpuSession(SrtConf({READER_TYPE.key: "MULTITHREADED"}))
+    with pytest.raises(Exception) as ei:
+        s.read.parquet(str(d)).collect()
+    assert files[0] in str(ei.value) or "parquet" in str(ei.value).lower()
+
+
+# ----------------------------------------------------------------- writers
+
+def test_write_error_mode_refuses_nonempty_dir(tmp_path):
+    sess = TpuSession()
+    df = sess.create_dataframe({"a": [1, 2]}, [("a", dt.INT64)])
+    out = tmp_path / "w"
+    df.write.parquet(str(out))
+    with pytest.raises(FileExistsError):
+        df.write.parquet(str(out))
+
+
+def test_overwrite_removes_stale_partitions(tmp_path):
+    """Overwrite must not leave stale files from a previous layout
+    behind (partition k=9 from run 1 must be gone after run 2)."""
+    sess = TpuSession()
+    out = tmp_path / "w"
+    df1 = sess.create_dataframe({"k": [9, 9], "v": [1, 2]},
+                                [("k", dt.INT64), ("v", dt.INT64)])
+    df1.write.partition_by("k").parquet(str(out))
+    assert (out / "k=9").exists()
+    df2 = sess.create_dataframe({"k": [1, 1], "v": [3, 4]},
+                                [("k", dt.INT64), ("v", dt.INT64)])
+    df2.write.mode("overwrite").partition_by("k").parquet(str(out))
+    assert not (out / "k=9").exists()
+    back = sess.read.parquet(str(out)).collect()
+    assert sorted(r["v"] for r in back) == [3, 4]
+
+
+def test_append_never_clobbers_existing_files(tmp_path):
+    """Two appends with identical data must leave 2x rows: file names
+    carry a per-job uuid so jobs cannot overwrite each other."""
+    sess = TpuSession()
+    out = tmp_path / "w"
+    df = sess.create_dataframe({"a": list(range(5))}, [("a", dt.INT64)])
+    df.write.mode("append").parquet(str(out))
+    df.write.mode("append").parquet(str(out))
+    assert sess.read.parquet(str(out)).count() == 10
+
+
+def test_failed_write_does_not_half_overwrite(tmp_path):
+    """If the new data errors during encode (EXCEPTION rebase mode over
+    pre-Gregorian dates), an overwrite must fail BEFORE destroying the
+    existing output."""
+    import datetime
+    sess = TpuSession()
+    out = tmp_path / "w"
+    ok = sess.create_dataframe({"a": [1, 2, 3]}, [("a", dt.INT64)])
+    ok.write.parquet(str(out))
+    bad = sess.create_dataframe(
+        {"d": [datetime.date(1400, 1, 1)]}, [("d", dt.DATE)])
+    with pytest.raises(ValueError, match="1582"):
+        (bad.write.mode("overwrite")
+         .option("datetimeRebaseMode", "EXCEPTION").parquet(str(out)))
+    # original data survived the failed overwrite
+    assert sess.read.parquet(str(out)).count() == 3

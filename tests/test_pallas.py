@@ -215,3 +215,42 @@ def test_tile_group_reduce_ragged_tail():
     (out,) = tile_group_reduce(jnp.asarray(gid), [jnp.asarray(v)])
     e = np.zeros(1024); np.add.at(e, gid, v)
     assert np.allclose(np.asarray(out), e, rtol=1e-4)
+
+
+def test_fused_minmax_nan_ordering():
+    """Spark orders NaN greatest: min skips NaN (unless all-NaN), max
+    returns NaN when any NaN survives the filter — on BOTH the pallas
+    and the XLA lanes, and they must agree."""
+    import math
+
+    data = {"v": [5.0, float("nan"), -3.0, None, float("nan"), 12.5],
+            "w": [1.0] * 6}
+
+    def make(conf):
+        session = TpuSession(conf)
+        df = session.create_dataframe({k: list(v) for k, v in data.items()})
+        return df.filter(col("w") > 0.0).agg(
+            Alias(Min(col("v")), "mn"), Alias(Max(col("v")), "mx"))
+
+    for conf in (SrtConf({"srt.sql.pallas.enabled": True}),
+                 SrtConf({"srt.sql.pallas.enabled": False})):
+        rows, _ = _run(make(conf).plan, conf)
+        (r,) = rows
+        assert r["mn"] == -3.0, r
+        assert math.isnan(r["mx"]), r
+
+    # all-NaN group: min and max are both NaN
+    data_nan = {"v": [float("nan"), float("nan")], "w": [1.0, 1.0]}
+
+    def make_nan(conf):
+        session = TpuSession(conf)
+        df = session.create_dataframe(
+            {k: list(v) for k, v in data_nan.items()})
+        return df.filter(col("w") > 0.0).agg(
+            Alias(Min(col("v")), "mn"), Alias(Max(col("v")), "mx"))
+
+    for conf in (SrtConf({"srt.sql.pallas.enabled": True}),
+                 SrtConf({"srt.sql.pallas.enabled": False})):
+        rows, _ = _run(make_nan(conf).plan, conf)
+        (r,) = rows
+        assert math.isnan(r["mn"]) and math.isnan(r["mx"]), r

@@ -298,3 +298,45 @@ def test_range_frame_decimal_key(session):
     assert got[1] == 1 and got[10] == 11
     assert got[100] == 100 and got[1000] == 1100
     assert_tpu_cpu_equal_df(out)
+
+
+def test_null_partition_key_forms_one_partition(session):
+    """NULL partition keys group into ONE partition (grouping equality,
+    not join equality). Regression: the running-window carried-state
+    continuation used null!=null and restarted accumulators at every
+    batch/shuffle-partition boundary of the NULL partition."""
+    import math
+    from spark_rapids_tpu.columnar import dtypes as dt
+    from spark_rapids_tpu.expr.window import RowNumber, WindowFrame
+    sess = TpuSession()
+    n = 64
+    df = sess.create_dataframe(
+        {"p": [None if i % 3 == 0 else i % 4 for i in range(n)],
+         "o": list(range(n)),
+         "v": [float(i) for i in range(n)]},
+        [("p", dt.INT64), ("o", dt.INT64), ("v", dt.FLOAT64)])
+    w = Window.partition_by("p").order_by("o").with_frame(
+        WindowFrame(None, 0, row_based=True))
+    wr = Window.partition_by("p").order_by("o")
+    assert_tpu_cpu_equal_df(df.select(
+        col("p"), col("o"),
+        Sum(col("v")).over(w).alias("rs"),
+        RowNumber().over(wr).alias("rn")))
+
+
+def test_nan_partition_key_groups_with_nan(session):
+    """NaN partition keys are one partition (Spark normalizes NaN in
+    grouping keys)."""
+    from spark_rapids_tpu.columnar import dtypes as dt
+    from spark_rapids_tpu.expr.window import WindowFrame
+    sess = TpuSession()
+    nan = float("nan")
+    df = sess.create_dataframe(
+        {"p": [nan, 1.0, nan, 1.0, nan, 2.0],
+         "o": [1, 2, 3, 4, 5, 6],
+         "v": [10.0, 20.0, 30.0, 40.0, 50.0, 60.0]},
+        [("p", dt.FLOAT64), ("o", dt.INT64), ("v", dt.FLOAT64)])
+    w = Window.partition_by("p").order_by("o").with_frame(
+        WindowFrame(None, 0, row_based=True))
+    assert_tpu_cpu_equal_df(df.select(
+        col("p"), col("o"), Sum(col("v")).over(w).alias("rs")))
