@@ -421,11 +421,27 @@ def nds_specs(scale_rows: int):
 
 
 def register_nds(session, data_dir: str, scale_rows: int = 20_000):
-    """Generate (once) + register every table as a temp view."""
+    """Generate (once) + register every table as a temp view.
+
+    Generation is crash-safe for concurrent/resumed processes (the
+    chunked test harness reuses one data dir across subprocesses): each
+    table materializes into a scratch dir that is os.rename'd into
+    place only when complete, so a killed generator leaves no
+    partially-filled table for the next process to silently accept."""
     for spec in nds_specs(scale_rows):
         out = os.path.join(data_dir, spec.name)
         if not (os.path.isdir(out) and os.listdir(out)):
-            generate_table(session, spec, out, chunk_rows=1 << 18)
+            tmp = out + ".generating"
+            import shutil
+            shutil.rmtree(tmp, ignore_errors=True)
+            generate_table(session, spec, tmp, chunk_rows=1 << 18)
+            try:
+                os.rename(tmp, out)
+            except OSError:
+                # lost a generate race: another process completed it
+                if not (os.path.isdir(out) and os.listdir(out)):
+                    raise
+                shutil.rmtree(tmp, ignore_errors=True)
         session.create_or_replace_temp_view(
             spec.name, session.read.parquet(out))
 

@@ -25,6 +25,66 @@ from . import overrides
 from .host_table import HostTable, batch_to_table, concat_tables, empty_like, to_pydict
 from .transitions import CpuPhysical, DeviceToHostBridge
 
+#: re-check the map count only every N executes (reading
+#: /proc/self/maps is O(mappings) — cheap, but not free per query).
+#: 1-2 NDS-scale queries can add several thousand mappings when the
+#: persistent cache is warm (deserialization is fast), so the window
+#: must stay small.
+import os as _os
+import sys as _sys
+
+try:
+    _MMAP_CHECK_EVERY = max(
+        1, int(_os.environ.get("SRT_MMAP_CHECK_EVERY", 2)))
+except ValueError:
+    _MMAP_CHECK_EVERY = 2
+_mmap_counter = [0]
+
+
+def _mmap_guard(session) -> None:
+    """Self-defense against memory-mapping exhaustion (SURVEY §5
+    failure-detection role; observed live in round 4): every compiled
+    XLA executable holds mmap'd code pages, the engine mints fresh jit
+    wrappers per plan, and long many-query processes (the 99-query NDS
+    suite) accumulate mappings monotonically until the kernel's
+    vm.max_map_count (65530 default) is hit — at which point jaxlib
+    SIGSEGVs inside whatever allocation crosses the line (compile OR
+    cache-load). When usage nears the limit, drop every in-memory
+    executable (the persistent disk cache keeps recompiles cheap) and
+    the session's plan cache (its exec trees pin traced jits)."""
+    _mmap_counter[0] += 1
+    if _mmap_counter[0] % _MMAP_CHECK_EVERY:
+        return
+    try:
+        with open("/proc/self/maps", "rb") as f:
+            used = sum(1 for _ in f)
+        with open("/proc/sys/vm/max_map_count", "rb") as f:
+            limit = int(f.read())
+    except OSError:  # non-Linux: nothing to defend against
+        return
+    try:
+        frac = float(_os.environ.get("SRT_MMAP_GUARD_FRACTION", 0.5))
+    except ValueError:
+        frac = 0.5
+    debug = _os.environ.get("SRT_MMAP_GUARD_DEBUG")
+    if used < frac * limit:
+        if debug:
+            print(f"[mmap_guard] used={used} limit={limit} (ok)",
+                  file=_sys.stderr, flush=True)
+        return
+    import gc
+
+    import jax
+    session._plan_cache.clear()
+    jax.clear_caches()
+    gc.collect()
+    if debug:
+        with open("/proc/self/maps", "rb") as f:
+            after = sum(1 for _ in f)
+        print(f"[mmap_guard] used={used} -> {after} after clear "
+              f"(limit {limit})",
+              file=_sys.stderr, flush=True)
+
 
 class TpuSession:
     """Entry point (SparkSession analogue). Holds the active conf and
@@ -80,6 +140,7 @@ class TpuSession:
         DataFrame objects — reuse the exec tree and its traced jits;
         without this every collect re-traced every jaxpr (the dominant
         warm-query cost)."""
+        _mmap_guard(self)
         from .plan_cache import plan_cache_key
         key = plan_cache_key(plan, self.conf)
         physical = self._plan_cache.get(key) if key is not None else None

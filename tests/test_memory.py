@@ -252,3 +252,31 @@ def test_slab_direct_io_disk_tier():
     sb.close()
     assert cat.host_pool.stats()["in_use"] == 0
     reset_spill_catalog()
+
+
+def test_mmap_guard_clears_executable_caches(monkeypatch):
+    """The map-count self-defense (session._mmap_guard) must fire when
+    mapping usage crosses the threshold: plan cache emptied + jax
+    in-memory executables dropped. Round-4 regression: 99-query
+    processes exhausted vm.max_map_count and SIGSEGVed inside jaxlib."""
+    from spark_rapids_tpu.columnar import dtypes as dt
+    from spark_rapids_tpu.expr.aggregates import CountStar
+    from spark_rapids_tpu.plan import session as S
+    from spark_rapids_tpu.plan.session import TpuSession
+
+    sess = TpuSession()
+    df = sess.create_dataframe({"a": [1, 2, 3]}, [("a", dt.INT64)])
+    df.group_by("a").agg(CountStar().alias("n")).collect()
+    assert len(sess._plan_cache._entries) >= 1, "plan cache not warmed"
+    monkeypatch.setenv("SRT_MMAP_GUARD_FRACTION", "0.0")
+    monkeypatch.setattr(S, "_MMAP_CHECK_EVERY", 1)
+    cleared = []
+    import jax
+    real_clear = jax.clear_caches
+    monkeypatch.setattr(jax, "clear_caches",
+                        lambda: (cleared.append(1), real_clear()))
+    df.group_by("a").agg(CountStar().alias("n")).collect()
+    assert cleared, "guard did not fire with fraction=0"
+    # the guard's clear is what is under test: plan cache must be
+    # empty-or-rebuilt-from-scratch (at most the just-executed plan)
+    assert len(sess._plan_cache._entries) <= 1
