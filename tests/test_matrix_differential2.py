@@ -1,0 +1,156 @@
+"""Second CPU≡TPU differential matrix tier: COUNT(DISTINCT) x dtype,
+higher-order functions x element dtype, mixed-width join keys, and
+composed multi-operator pipelines (the reference's integration tests
+cover operator COMPOSITIONS, not just single ops — e.g.
+hash_aggregate_test.py's join+agg shapes)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.expr import exists, filter_, transform
+from spark_rapids_tpu.expr.aggregates import CountStar, Max, Min, Sum
+from spark_rapids_tpu.expr.core import Alias, col
+from spark_rapids_tpu.plan import TpuSession
+from spark_rapids_tpu.testing import (DateGen, DecimalGen, DoubleGen,
+                                      IntGen, LongGen, StringGen,
+                                      assert_tpu_cpu_equal_df, gen_table)
+
+N = 96
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession()
+
+
+def make_df(session, gens, n=N, seed=0):
+    data, schema = gen_table(gens, n, seed)
+    return session.create_dataframe(data, schema)
+
+
+# ------------------------------------------ COUNT(DISTINCT) x dtype (SQL)
+
+DISTINCT_GENS = {
+    "int32": lambda: IntGen(lo=0, hi=12),
+    "int64": lambda: LongGen(lo=-6, hi=6),
+    "string": lambda: StringGen(max_len=2),
+    "date": lambda: DateGen(lo_days=0, hi_days=10),
+    "decimal": lambda: DecimalGen(precision=9, scale=2, null_prob=0.3),
+}
+
+
+@pytest.mark.parametrize("vt", list(DISTINCT_GENS))
+def test_count_distinct_matrix(session, vt):
+    df = make_df(session, {"k": IntGen(lo=0, hi=3),
+                           "v": DISTINCT_GENS[vt]()}, seed=91)
+    session.create_or_replace_temp_view("t_cd", df)
+    assert_tpu_cpu_equal_df(
+        session.sql("SELECT COUNT(DISTINCT v) AS cd, COUNT(*) AS n "
+                    "FROM t_cd"))
+    assert_tpu_cpu_equal_df(
+        session.sql("SELECT k, COUNT(DISTINCT v) AS cd FROM t_cd "
+                    "GROUP BY k"))
+
+
+# ---------------------------------------------- HOF x element dtype
+
+def _arrays_df(session, elem, seed):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for _ in range(120):
+        r = rng.random()
+        if r < 0.1:
+            rows.append(None)
+        elif r < 0.2:
+            rows.append([])
+        else:
+            n = int(rng.integers(1, 7))
+            if elem == dt.INT64:
+                vals = [int(v) for v in rng.integers(-50, 50, n)]
+            else:
+                vals = [float(v) for v in rng.uniform(-5, 5, n)]
+            rows.append([None if rng.random() < 0.15 else v
+                         for v in vals])
+    return session.create_dataframe(
+        {"a": rows, "x": list(range(120))},
+        schema=[("a", dt.ArrayType(elem)), ("x", dt.INT64)])
+
+
+@pytest.mark.parametrize("elem", [dt.INT64, dt.FLOAT64],
+                         ids=["int64", "float64"])
+def test_hof_element_dtype_matrix(session, elem):
+    df = _arrays_df(session, elem, seed=17)
+    two = 2 if elem == dt.INT64 else 2.0
+    assert_tpu_cpu_equal_df(df.select(
+        Alias(transform(col("a"), lambda v: v + v), "dbl"),
+        Alias(filter_(col("a"), lambda v: v > two), "flt"),
+        Alias(exists(col("a"), lambda v: v > two), "ex")),
+        approx_float=1e-9)
+
+
+def test_hof_composed_with_agg(session):
+    """HOF output feeding an aggregate — composition across operator
+    families."""
+    df = _arrays_df(session, dt.INT64, seed=19)
+    from spark_rapids_tpu.expr.collections import Size
+    stage = df.select(
+        col("x"),
+        Alias(Size(filter_(col("a"), lambda v: v > 0)), "npos"))
+    assert_tpu_cpu_equal_df(
+        stage.group_by("npos").agg(CountStar().alias("n")))
+
+
+# ------------------------------------------- mixed-width join keys
+
+def test_join_mixed_width_keys(session):
+    """int32 keys on one side, int64 on the other (expression-keyed
+    join via the (left_exprs, right_exprs) form): values equal across
+    widths must match."""
+    left = make_df(session, {"k32": IntGen(lo=0, hi=15, null_prob=0.1),
+                             "l": IntGen()}, seed=93)
+    right = make_df(session, {"k64": LongGen(lo=0, hi=15,
+                                             null_prob=0.1),
+                              "r": IntGen()}, n=48, seed=94)
+    joined = left.join(right, on=([col("k32")], [col("k64")]))
+    assert_tpu_cpu_equal_df(joined)
+
+
+# --------------------------------------------- composed pipelines
+
+@pytest.mark.parametrize("vt", ["int64", "float64", "decimal"])
+def test_join_then_agg_then_sort(session, vt):
+    gen = {"int64": lambda: LongGen(lo=-100, hi=100),
+           "float64": lambda: DoubleGen(no_special=True),
+           "decimal": lambda: DecimalGen(precision=12, scale=2)}[vt]
+    fact = make_df(session, {"k": IntGen(lo=0, hi=8, null_prob=0.1),
+                             "v": gen()}, n=128, seed=95)
+    dim = make_df(session, {"k": IntGen(lo=0, hi=8, null_prob=0.0),
+                            "name": StringGen(max_len=4)},
+                  n=9, seed=96)
+    out = (fact.join(dim, on="k")
+           .group_by("name").agg(Sum(col("v")).alias("s"),
+                                 Min(col("v")).alias("mn"),
+                                 Max(col("v")).alias("mx"),
+                                 CountStar().alias("n")))
+    assert_tpu_cpu_equal_df(out, approx_float=1e-6)
+
+
+def test_union_distinct_then_join(session):
+    a = make_df(session, {"k": IntGen(lo=0, hi=10), "v": IntGen()},
+                seed=97)
+    b = make_df(session, {"k": IntGen(lo=5, hi=15), "v": IntGen()},
+                n=64, seed=98)
+    keys = a.union(b).select(col("k")).distinct()
+    dim = make_df(session, {"k": IntGen(lo=0, hi=15, null_prob=0.0),
+                            "w": DoubleGen(no_special=True)},
+                  n=16, seed=99)
+    assert_tpu_cpu_equal_df(keys.join(dim, on="k", how="left"))
+
+
+def test_agg_then_self_join(session):
+    """Aggregate result joined back to detail rows (q28-family shape)."""
+    df = make_df(session, {"k": IntGen(lo=0, hi=6, null_prob=0.0),
+                           "v": LongGen(lo=0, hi=1000)}, seed=101)
+    totals = df.group_by("k").agg(Sum(col("v")).alias("total"))
+    assert_tpu_cpu_equal_df(df.join(totals, on="k"))
