@@ -531,8 +531,19 @@ def d128_div_exact(ah, al, bh, bl, up_scale: int):
 
 
 def d128_to_f64(h, l):
-    """Approximate float64 value of the signed 128-bit integer."""
-    return h.astype(jnp.float64) * (2.0 ** 64) + l.astype(jnp.float64)
+    """Approximate float64 value of the signed 128-bit integer.
+
+    Convert SIGN-MAGNITUDE, not h*2^64+l directly: for small negative
+    values (h = -1, l = 2^64 - v) the direct form cancels two ~2^64
+    floats whose difference is far below their ulp (2048 at 2^64), so
+    e.g. -350 rounded to exactly 0.0 (round-4 bug: every small negative
+    decimal cast to double collapsed to zero)."""
+    neg = h < 0
+    nh, nl = d128_neg(h, l)
+    mh = jnp.where(neg, nh, h)
+    ml = jnp.where(neg, nl, l)
+    m = mh.astype(jnp.float64) * (2.0 ** 64) + ml.astype(jnp.float64)
+    return jnp.where(neg, -m, m)
 
 
 def f64_to_d128(x):

@@ -56,17 +56,62 @@ def _finish_decimal(hi, lo, validity, ok, out_t: dt.DecimalType):
     return d128.build_decimal_column(hi, lo, validity & ok, out_t)
 
 
+#: Spark's exact decimal representation of each integral type
+#: (DecimalType.forType): the implicit-coercion target for
+#: integral-op-decimal arithmetic.
+_INTEGRAL_DECIMAL = {dt.INT8: (3, 0), dt.INT16: (5, 0),
+                     dt.INT32: (10, 0), dt.INT64: (20, 0)}
+
+
+def decimal_coerced_children(expr: Expression, schema: Schema):
+    """Spark's DecimalPrecision implicit coercion for mixed
+    decimal/non-decimal binary arithmetic: the integral side casts to
+    its exact decimal type (int -> decimal(10,0), bigint ->
+    decimal(20,0), ...); against a float/double the DECIMAL side casts
+    to double. Shared by the device eval AND the CPU oracle so both
+    engines resolve the same promoted tree."""
+    left, right = expr.children[0], expr.children[1]
+    lt, rt = left.data_type(schema), right.data_type(schema)
+    ldec = isinstance(lt, dt.DecimalType)
+    rdec = isinstance(rt, dt.DecimalType)
+    if ldec == rdec:
+        return left, right
+    from .cast import Cast
+    other_t = rt if ldec else lt
+    if other_t in _INTEGRAL_DECIMAL:
+        wrapped = Cast(right if ldec else left,
+                       dt.DecimalType(*_INTEGRAL_DECIMAL[other_t]))
+        return (left, wrapped) if ldec else (wrapped, right)
+    if getattr(other_t, "is_floating", False):
+        if ldec:
+            return Cast(left, dt.FLOAT64), right
+        return left, Cast(right, dt.FLOAT64)
+    raise TypeError(
+        f"decimal {expr.op_name} {other_t}: no implicit coercion "
+        "(Spark coerces integral and floating operands only)")
+
+
 class BinaryArithmetic(Expression):
     op_name = "?"
 
-    def data_type(self, schema: Schema) -> dt.DType:
-        lt = self.children[0].data_type(schema)
-        rt = self.children[1].data_type(schema)
-        if isinstance(lt, dt.DecimalType) and isinstance(rt, dt.DecimalType):
+    def coerced_children(self, schema: Schema):
+        """The children this op ACTUALLY computes on, after implicit
+        type coercion (DecimalPrecision; ops with narrower inputTypes
+        override and add their own casts — IntegralDivide). Both
+        engines (device eval and the CPU oracle) must evaluate THESE,
+        never raw ``self.children``."""
+        return decimal_coerced_children(self, schema)
+
+    def _out_type(self, lt: dt.DType, rt: dt.DType) -> dt.DType:
+        if isinstance(lt, dt.DecimalType) and \
+                isinstance(rt, dt.DecimalType):
             return self._decimal_type(lt, rt)
-        if isinstance(lt, dt.DecimalType) or isinstance(rt, dt.DecimalType):
-            raise TypeError("implicit decimal/non-decimal arithmetic needs a cast")
         return self._result_type(lt, rt)
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        left, right = self.coerced_children(schema)
+        return self._out_type(left.data_type(schema),
+                              right.data_type(schema))
 
     def _result_type(self, lt: dt.DType, rt: dt.DType) -> dt.DType:
         return dt.promote(lt, rt)
@@ -75,9 +120,10 @@ class BinaryArithmetic(Expression):
         raise TypeError(f"{self.op_name} does not support decimals")
 
     def eval(self, batch: ColumnarBatch):
-        left = self.children[0].eval(batch)
-        right = self.children[1].eval(batch)
-        out_t = self.data_type(batch.schema())
+        lc, rc = self.coerced_children(batch.schema())
+        left = lc.eval(batch)
+        right = rc.eval(batch)
+        out_t = self._out_type(left.dtype, right.dtype)
         validity = merged_validity(left, right)
         if isinstance(out_t, dt.DecimalType) or \
                 isinstance(left.dtype, dt.DecimalType):
@@ -177,9 +223,10 @@ class Divide(BinaryArithmetic):
         return dt.FLOAT64
 
     def eval(self, batch: ColumnarBatch):
-        left = self.children[0].eval(batch)
-        right = self.children[1].eval(batch)
-        out_t = self.data_type(batch.schema())
+        lc, rc = self.coerced_children(batch.schema())
+        left = lc.eval(batch)
+        right = rc.eval(batch)
+        out_t = self._out_type(left.dtype, right.dtype)
         validity = merged_validity(left, right)
         if isinstance(out_t, dt.DecimalType):
             return self._eval_decimal(left, right, out_t, validity)
@@ -228,6 +275,22 @@ class IntegralDivide(BinaryArithmetic):
     def _decimal_type(self, lt, rt):
         # wide operands are excluded at tagging (plan/overrides.py sig)
         return dt.INT64
+
+    def coerced_children(self, schema: Schema):
+        """Spark IntegralDivide inputType is (LongType, DecimalType):
+        the analyzer casts FLOATING operands to long BEFORE dividing
+        (CAST(0.5 AS DOUBLE) becomes 0 -> x div 0 is NULL), and
+        integral operands widen to long (so INT_MIN div -1 = 2^31,
+        no 32-bit wrap)."""
+        from .cast import Cast
+        left, right = decimal_coerced_children(self, schema)
+        lt = left.data_type(schema)
+        rt = right.data_type(schema)
+        if getattr(lt, "is_floating", False):
+            left = Cast(left, dt.INT64)
+        if getattr(rt, "is_floating", False):
+            right = Cast(right, dt.INT64)
+        return left, right
 
     def _eval_decimal(self, left, right, out_t, validity):
         qh, ql, _, _, _, _, validity, _ = _decimal_divmod_aligned(

@@ -164,12 +164,22 @@ def _decimal_arith_obj(a, b, mask, op, lt, rt, out_t):
     return out, mask
 
 
+def _coerced(expr, table):
+    """(left_child, right_child, left_t, right_t) after the op's
+    implicit coercion (DecimalPrecision + per-op inputType casts, e.g.
+    IntegralDivide's float->long) — the ONE preamble every binary-
+    arithmetic oracle evaluator must share (per-evaluator copies are
+    exactly where float-mix paths got missed)."""
+    lc, rc = expr.coerced_children(table.schema())
+    return lc, rc, lc.data_type(table.schema()), \
+        rc.data_type(table.schema())
+
+
 def _binary_arith(expr, table, op):
-    lt = expr.children[0].data_type(table.schema())
-    rt = expr.children[1].data_type(table.schema())
+    lc, rc, lt, rt = _coerced(expr, table)
     out_t = expr.data_type(table.schema())
-    a, am = _ev(expr.children[0], table)
-    b, bm = _ev(expr.children[1], table)
+    a, am = _ev(lc, table)
+    b, bm = _ev(rc, table)
     mask = am & bm
     if isinstance(out_t, dt.DecimalType):
         wide = out_t.is_wide or lt.is_wide or rt.is_wide
@@ -217,11 +227,10 @@ def _mul(e, t):
 
 @_reg(A.Divide)
 def _div(expr, table):
-    lt = expr.children[0].data_type(table.schema())
-    rt = expr.children[1].data_type(table.schema())
+    lc, rc, lt, rt = _coerced(expr, table)
     out_t = expr.data_type(table.schema())
-    a, am = _ev(expr.children[0], table)
-    b, bm = _ev(expr.children[1], table)
+    a, am = _ev(lc, table)
+    b, bm = _ev(rc, table)
     if isinstance(out_t, dt.DecimalType):
         # exact decimal division, HALF_UP at the result scale
         a = _obj_ints(a)
@@ -272,10 +281,9 @@ def _trunc_mod_np(a, b):
 def _decimal_divmod_obj(expr, table):
     """Common-scale exact truncating divmod for decimal operands.
     Returns (q, r, |b| at the common scale, mask, scale)."""
-    lt = expr.children[0].data_type(table.schema())
-    rt = expr.children[1].data_type(table.schema())
-    a, am = _ev(expr.children[0], table)
-    b, bm = _ev(expr.children[1], table)
+    lc, rc, lt, rt = _coerced(expr, table)
+    a, am = _ev(lc, table)
+    b, bm = _ev(rc, table)
     s = max(lt.scale, rt.scale)
     a = _obj_ints(a) * (10 ** (s - lt.scale))
     b = _obj_ints(b) * (10 ** (s - rt.scale))
@@ -294,16 +302,16 @@ def _decimal_divmod_obj(expr, table):
 
 @_reg(A.IntegralDivide)
 def _idiv(expr, table):
-    lt = expr.children[0].data_type(table.schema())
-    if isinstance(lt, dt.DecimalType):
+    lc, rc, lt, rt = _coerced(expr, table)
+    if isinstance(lt, dt.DecimalType):  # coerced: both-or-neither
         q, _, _, mask, _ = _decimal_divmod_obj(expr, table)
         fits = np.array([-(2 ** 63) <= int(v) < 2 ** 63 for v in q], bool)
         mask = mask & fits
         out = np.array([int(v) if f else 0 for v, f in zip(q, fits)],
                        dtype=np.int64)
         return _zero_nulls(out, mask), mask
-    a, am = _ev(expr.children[0], table)
-    b, bm = _ev(expr.children[1], table)
+    a, am = _ev(lc, table)
+    b, bm = _ev(rc, table)
     mask = am & bm & (b != 0)
     safe = np.where(b == 0, np.ones(1, b.dtype), b)
     if np.issubdtype(a.dtype, np.floating):
@@ -336,8 +344,9 @@ def _rem(expr, table):
     if isinstance(out_t, dt.DecimalType):
         return _decimal_mod_result(expr, table, positive=False)
     phys = np.dtype(out_t.physical)
-    a, am = _ev(expr.children[0], table)
-    b, bm = _ev(expr.children[1], table)
+    lc, rc, _lt, _rt = _coerced(expr, table)
+    a, am = _ev(lc, table)
+    b, bm = _ev(rc, table)
     a = a.astype(phys)
     b = b.astype(phys)
     mask = am & bm & (b != 0)
@@ -355,8 +364,9 @@ def _pmod(expr, table):
     if isinstance(out_t, dt.DecimalType):
         return _decimal_mod_result(expr, table, positive=True)
     phys = np.dtype(out_t.physical)
-    a, am = _ev(expr.children[0], table)
-    b, bm = _ev(expr.children[1], table)
+    lc, rc, _lt, _rt = _coerced(expr, table)
+    a, am = _ev(lc, table)
+    b, bm = _ev(rc, table)
     a = a.astype(phys)
     b = b.astype(phys)
     mask = am & bm & (b != 0)

@@ -192,6 +192,14 @@ class DecimalGen(DataGen):
                 for step, arr in chunks:
                     v = v * 10 ** step + int(arr[i])
                 unscaled.append(-v if signs[i] else v)
+        # uniform over +/-10^p almost never samples small magnitudes
+        # (P(|v|<1000) ~ 1e-9 at p=12), which hid a negative-small-value
+        # cast bug for a round; plant unit-scale specials explicitly
+        for s in (0, 1, -1, 7, -350):
+            if abs(s) >= 10 ** p:
+                continue  # respect the declared precision bound
+            if n and rng.random() < 0.5:
+                unscaled[int(rng.integers(0, n))] = s
         return [decimal.Decimal(u).scaleb(-self.scale) for u in unscaled]
 
 

@@ -1,0 +1,180 @@
+"""Third differential matrix tier: decimal arithmetic over a
+precision/scale lattice (Spark's result-type rules are the subtle part
+— decimalArithmeticOperations tests in the reference) and datetime
+field/arithmetic functions over edge-case date/timestamp gens."""
+
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.expr.core import col, lit
+from spark_rapids_tpu.expr.datetime import (AddMonths, DateAdd, DateDiff,
+                                            DateSub, DayOfMonth, DayOfWeek,
+                                            DayOfYear, Hour, LastDay,
+                                            Minute, Month, Quarter, Second,
+                                            TruncDate, WeekDay, Year)
+from spark_rapids_tpu.plan import TpuSession
+from spark_rapids_tpu.testing import (DateGen, DecimalGen, IntGen,
+                                      TimestampGen,
+                                      assert_tpu_cpu_equal_df, gen_table)
+
+N = 96
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession()
+
+
+def make_df(session, gens, n=N, seed=0):
+    data, schema = gen_table(gens, n, seed)
+    return session.create_dataframe(data, schema)
+
+
+# ------------------------------------- decimal arithmetic (p,s) lattice
+
+DEC_PAIRS = [
+    # (left precision/scale, right precision/scale)
+    ((7, 2), (7, 2)),      # same type
+    ((10, 0), (10, 4)),    # scale mismatch
+    ((5, 2), (12, 6)),     # width + scale mismatch
+    ((18, 2), (18, 2)),    # at the 64-bit edge
+    ((20, 4), (10, 2)),    # wide (128-bit) left
+    ((24, 6), (24, 6)),    # wide both
+    ((38, 10), (7, 2)),    # max precision left
+]
+
+
+@pytest.mark.parametrize("op", ["add", "sub", "mul"])
+@pytest.mark.parametrize(
+    "lp,rp", DEC_PAIRS,
+    ids=[f"{a[0]}_{a[1]}x{b[0]}_{b[1]}" for a, b in DEC_PAIRS])
+def test_decimal_arithmetic_lattice(session, op, lp, rp):
+    df = make_df(session, {
+        "a": DecimalGen(precision=lp[0], scale=lp[1]),
+        "b": DecimalGen(precision=rp[0], scale=rp[1]),
+    }, seed=111)
+    e = {"add": col("a") + col("b"), "sub": col("a") - col("b"),
+         "mul": col("a") * col("b")}[op]
+    assert_tpu_cpu_equal_df(df.select(e.alias("r")))
+
+
+@pytest.mark.parametrize(
+    "p,s", [(7, 2), (18, 4), (24, 6)],
+    ids=["dec64_narrow", "dec64_edge", "dec128"])
+def test_decimal_vs_integer_arithmetic(session, p, s):
+    df = make_df(session, {"a": DecimalGen(precision=p, scale=s),
+                           "i": IntGen(lo=-50, hi=50)}, seed=112)
+    assert_tpu_cpu_equal_df(df.select(
+        (col("a") + col("i")).alias("ai"),
+        (col("a") * col("i")).alias("am")))
+
+
+def test_decimal_unary_and_compare(session):
+    df = make_df(session, {"a": DecimalGen(precision=12, scale=3),
+                           "b": DecimalGen(precision=12, scale=3)},
+                 seed=113)
+    assert_tpu_cpu_equal_df(df.select(
+        (-col("a")).alias("neg"),
+        (col("a") > col("b")).alias("gt"),
+        (col("a") == col("b")).alias("eq")))
+
+
+# --------------------------------------------- datetime field matrix
+
+DATE_FIELDS = {
+    "year": Year, "month": Month, "day": DayOfMonth,
+    "quarter": Quarter, "dayofweek": DayOfWeek, "weekday": WeekDay,
+    "dayofyear": DayOfYear,
+}
+
+
+@pytest.mark.parametrize("fld", list(DATE_FIELDS))
+def test_date_field_matrix(session, fld):
+    df = make_df(session, {"d": DateGen()}, seed=121)
+    assert_tpu_cpu_equal_df(
+        df.select(DATE_FIELDS[fld](col("d")).alias("f")))
+
+
+@pytest.mark.parametrize("fld", ["hour", "minute", "second"])
+def test_time_field_matrix(session, fld):
+    df = make_df(session, {"t": TimestampGen()}, seed=122)
+    cls = {"hour": Hour, "minute": Minute, "second": Second}[fld]
+    assert_tpu_cpu_equal_df(df.select(cls(col("t")).alias("f")))
+
+
+def test_date_arithmetic_matrix(session):
+    df = make_df(session, {"d": DateGen(), "d2": DateGen(),
+                           "n": IntGen(lo=-400, hi=400, null_prob=0.1)},
+                 seed=123)
+    assert_tpu_cpu_equal_df(df.select(
+        DateAdd(col("d"), col("n")).alias("dadd"),
+        DateSub(col("d"), col("n")).alias("dsub"),
+        DateDiff(col("d"), col("d2")).alias("ddiff"),
+        AddMonths(col("d"), col("n")).alias("am"),
+        LastDay(col("d")).alias("ld")))
+
+
+@pytest.mark.parametrize("unit", ["YEAR", "MONTH", "WEEK"])
+def test_trunc_date_matrix(session, unit):
+    df = make_df(session, {"d": DateGen()}, seed=124)
+    assert_tpu_cpu_equal_df(
+        df.select(TruncDate(col("d"), unit).alias("t")))
+
+
+def test_decimal_int_implicit_coercion_sql(session):
+    """SELECT dec + int works without an explicit cast (Spark's
+    DecimalPrecision implicit promotion; round-4 addition)."""
+    import decimal
+    df = make_df(session, {"a": DecimalGen(precision=9, scale=2),
+                           "i": IntGen(lo=-100, hi=100)}, seed=131)
+    session.create_or_replace_temp_view("t_coerce", df)
+    assert_tpu_cpu_equal_df(session.sql(
+        "SELECT a + i AS s, a * i AS m, a / (i + 200) AS d "
+        "FROM t_coerce"))
+    out = session.sql("SELECT a + i AS s FROM t_coerce").collect()
+    assert any(isinstance(r["s"], decimal.Decimal)
+               for r in out if r["s"] is not None)
+
+
+def test_decimal_float_coerces_to_double(session):
+    """decimal op double follows Spark: the DECIMAL side becomes
+    double (result is double, not decimal)."""
+    df = make_df(session, {"a": DecimalGen(precision=9, scale=2),
+                           "f": DecimalGen(precision=5, scale=1)},
+                 seed=132)
+    from spark_rapids_tpu.expr.cast import Cast
+    dbl = Cast(col("f"), dt.FLOAT64)
+    e = (col("a") + dbl)
+    schema = [("a", dt.DecimalType(9, 2)), ("f", dt.DecimalType(5, 1))]
+    assert e.data_type(schema) == dt.FLOAT64
+    assert_tpu_cpu_equal_df(df.select(e.alias("r")), approx_float=1e-9)
+
+
+@pytest.mark.parametrize("op", ["mod", "pmod", "idiv"])
+def test_decimal_float_mix_mod_family(session, op):
+    """float-decimal mixes through %, pmod, div: coercion turns both
+    sides double; the oracle must evaluate the SAME coerced tree (a
+    round-4 review catch: uncoerced oracle lanes computed on unscaled
+    decimal ints)."""
+    from spark_rapids_tpu.expr.arithmetic import (IntegralDivide, Pmod,
+                                                  Remainder)
+    from spark_rapids_tpu.expr.cast import Cast
+    df = make_df(session, {"a": DecimalGen(precision=9, scale=2),
+                           "f": DecimalGen(precision=5, scale=1)},
+                 seed=141)
+    dbl = Cast(col("f"), dt.FLOAT64)
+    cls = {"mod": Remainder, "pmod": Pmod, "idiv": IntegralDivide}[op]
+    assert_tpu_cpu_equal_df(
+        df.select(cls(col("a"), dbl).alias("r")), approx_float=1e-9)
+
+
+def test_decimal_int_mod_family(session):
+    from spark_rapids_tpu.expr.arithmetic import (IntegralDivide, Pmod,
+                                                  Remainder)
+    df = make_df(session, {"a": DecimalGen(precision=9, scale=2),
+                           "i": IntGen(lo=-50, hi=50)}, seed=142)
+    nz = col("i") + lit(51)  # nonzero divisor
+    assert_tpu_cpu_equal_df(df.select(
+        Remainder(col("a"), nz).alias("m"),
+        Pmod(col("a"), nz).alias("pm"),
+        IntegralDivide(col("a"), nz).alias("q")))
