@@ -154,3 +154,31 @@ def test_agg_then_self_join(session):
                            "v": LongGen(lo=0, hi=1000)}, seed=101)
     totals = df.group_by("k").agg(Sum(col("v")).alias("total"))
     assert_tpu_cpu_equal_df(df.join(totals, on="k"))
+
+# --------------------------- SQL ORDER BY null-ordering x direction
+
+@pytest.mark.parametrize("direction", ["ASC", "DESC"])
+@pytest.mark.parametrize("nulls", ["FIRST", "LAST"])
+@pytest.mark.parametrize("vt", ["int64", "string", "float64"])
+def test_sql_order_by_nulls_matrix(session, vt, nulls, direction):
+    gen = {"int64": lambda: LongGen(lo=-50, hi=50, null_prob=0.25),
+           "string": lambda: StringGen(max_len=3, null_prob=0.25),
+           "float64": lambda: DoubleGen(null_prob=0.25)}[vt]()
+    df = make_df(session, {"v": gen, "x": IntGen(null_prob=0.0)},
+                 seed=103)
+    session.create_or_replace_temp_view("t_nulls", df)
+    q = session.sql(
+        f"SELECT v FROM t_nulls ORDER BY v {direction} NULLS {nulls}")
+    out = q.collect()
+    # verify the null block position explicitly on the device lane
+    null_pos = [i for i, r in enumerate(out) if r["v"] is None]
+    if null_pos:
+        if nulls == "FIRST":
+            assert null_pos == list(range(len(null_pos))), null_pos[:5]
+        else:
+            n = len(out)
+            assert null_pos == list(range(n - len(null_pos), n)), \
+                null_pos[:5]
+    # strict-order differential: only `v` is selected, so tied rows
+    # are identical and full-order comparison is well-defined
+    assert_tpu_cpu_equal_df(q, ignore_order=False)
