@@ -421,47 +421,113 @@ class Abs(Expression):
         return make_result(jnp.abs(c.data), c.validity, c.dtype)
 
 
-class Least(Expression):
+class _Materialized(Expression):
+    """Wraps an already-evaluated column so fold steps re-reference it
+    in O(1) instead of re-evaluating a duplicated subtree (a naive
+    If-fold references its accumulator 4x per step => O(4^n) tree)."""
+
+    def __init__(self, column, dtype_: dt.DType):
+        super().__init__()
+        self._col = column
+        self._t = dtype_
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        return self._t
+
+    def eval(self, batch: ColumnarBatch):
+        return self._col
+
+
+def minmax_fold(children, largest: bool) -> Expression:
+    """least/greatest as a null-skipping If-fold — the lane for types
+    without a numeric identity value (strings). Shared with the CPU
+    oracle so both engines resolve the identical per-step semantics.
+
+    The returned expression evaluates each child ONCE and each fold
+    step once (children materialize through _Materialized wrappers at
+    eval time), keeping cost linear in the child count."""
+    from .conditional import If
+    from .predicates import IsNull
+
+    class _Fold(Expression):
+        def __init__(self):
+            super().__init__(*children)
+
+        def data_type(self, schema: Schema) -> dt.DType:
+            t = children[0].data_type(schema)
+            for c in children[1:]:
+                t = dt.promote(t, c.data_type(schema))
+            return t
+
+        def eval(self, batch: ColumnarBatch):
+            out_t = self.data_type(batch.schema())
+            acc = children[0].eval(batch)
+            for c in children[1:]:
+                wa = _Materialized(acc, out_t)
+                wc = _Materialized(c.eval(batch), out_t)
+                pick = If(wc > wa if largest else wc < wa, wc, wa)
+                acc = If(IsNull(wa), wc,
+                         If(IsNull(wc), wa, pick)).eval(batch)
+            return acc
+
+    return _Fold()
+
+
+class _LeastGreatestBase(Expression):
+    largest = False
+
+    def data_type(self, schema: Schema) -> dt.DType:
+        t = self.children[0].data_type(schema)
+        for c in self.children[1:]:
+            t = dt.promote(t, c.data_type(schema))
+        return t
+
+    def eval(self, batch: ColumnarBatch) -> ColumnVector:
+        out_t = self.data_type(batch.schema())
+        if isinstance(out_t, dt.StringType):
+            return minmax_fold(list(self.children),
+                               self.largest).eval(batch)
+        phys = out_t.physical
+        cols = [c.eval(batch) for c in self.children]
+        cap = batch.capacity
+        fill = dt.min_value(out_t) if self.largest else dt.max_value(out_t)
+        fill = jnp.asarray(fill, phys)
+        data = jnp.full(cap, fill, phys)
+        any_valid = jnp.zeros(cap, jnp.bool_)
+        red = jnp.maximum if self.largest else jnp.minimum
+        if out_t.is_floating:
+            # Spark float order: NaN GREATEST. greatest => any valid
+            # NaN wins; least => NaN only when no non-NaN valid value
+            nan_v = jnp.asarray(jnp.nan, phys)
+            nan_seen = jnp.zeros(cap, jnp.bool_)
+            num_seen = jnp.zeros(cap, jnp.bool_)
+            for c in cols:
+                nan = jnp.isnan(c.data)
+                v = jnp.where(c.validity & ~nan, c.data.astype(phys),
+                              fill)
+                data = red(data, v)
+                nan_seen = nan_seen | (c.validity & nan)
+                num_seen = num_seen | (c.validity & ~nan)
+                any_valid = any_valid | c.validity
+            if self.largest:
+                data = jnp.where(nan_seen, nan_v, data)
+            else:
+                data = jnp.where(num_seen, data, nan_v)
+            return make_result(data, any_valid, out_t)
+        for c in cols:
+            v = jnp.where(c.validity, c.data.astype(phys), fill)
+            data = red(data, v)
+            any_valid = any_valid | c.validity
+        return make_result(data, any_valid, out_t)
+
+
+class Least(_LeastGreatestBase):
     """least(...) — null-skipping minimum across columns."""
 
-    def data_type(self, schema: Schema) -> dt.DType:
-        t = self.children[0].data_type(schema)
-        for c in self.children[1:]:
-            t = dt.promote(t, c.data_type(schema))
-        return t
-
-    def eval(self, batch: ColumnarBatch) -> ColumnVector:
-        out_t = self.data_type(batch.schema())
-        phys = out_t.physical
-        cols = [c.eval(batch) for c in self.children]
-        big = jnp.asarray(dt.max_value(out_t), phys)
-        data = jnp.full(batch.capacity, big, phys)
-        any_valid = jnp.zeros(batch.capacity, jnp.bool_)
-        for c in cols:
-            v = jnp.where(c.validity, c.data.astype(phys), big)
-            data = jnp.minimum(data, v)
-            any_valid = any_valid | c.validity
-        return make_result(data, any_valid, out_t)
+    largest = False
 
 
-class Greatest(Expression):
+class Greatest(_LeastGreatestBase):
     """greatest(...) — null-skipping maximum across columns."""
 
-    def data_type(self, schema: Schema) -> dt.DType:
-        t = self.children[0].data_type(schema)
-        for c in self.children[1:]:
-            t = dt.promote(t, c.data_type(schema))
-        return t
-
-    def eval(self, batch: ColumnarBatch) -> ColumnVector:
-        out_t = self.data_type(batch.schema())
-        phys = out_t.physical
-        cols = [c.eval(batch) for c in self.children]
-        small = jnp.asarray(dt.min_value(out_t), phys)
-        data = jnp.full(batch.capacity, small, phys)
-        any_valid = jnp.zeros(batch.capacity, jnp.bool_)
-        for c in cols:
-            v = jnp.where(c.validity, c.data.astype(phys), small)
-            data = jnp.maximum(data, v)
-            any_valid = any_valid | c.validity
-        return make_result(data, any_valid, out_t)
+    largest = True

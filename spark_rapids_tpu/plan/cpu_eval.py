@@ -398,11 +398,49 @@ def _abs(expr, table):
 
 def _least_greatest(expr, table, largest: bool):
     out_t = expr.data_type(table.schema())
+    if out_t == dt.STRING:
+        # null-skipping lexicographic min/max (the device lane folds
+        # the same semantics through If/IsNull over string columns)
+        n = table.num_rows
+        cols = [_ev(c, table) for c in expr.children]
+        out = np.empty(n, object)
+        valid = np.zeros(n, bool)
+        for i in range(n):
+            best = None
+            for v, m in cols:
+                if not m[i]:
+                    continue
+                s = v[i]
+                if best is None or \
+                        ((s > best) if largest else (s < best)):
+                    best = s
+            valid[i] = best is not None
+            out[i] = best if best is not None else ""
+        return out, valid
     phys = np.dtype(out_t.physical)
     n = table.num_rows
     fill = dt.max_value(out_t) if not largest else dt.min_value(out_t)
     acc = np.full(n, fill, phys)
     any_valid = np.zeros(n, bool)
+    if np.issubdtype(phys, np.floating):
+        # Spark float order: NaN greatest (mirrors the device lane)
+        nan_seen = np.zeros(n, bool)
+        num_seen = np.zeros(n, bool)
+        for c in expr.children:
+            v, m = _ev(c, table)
+            v = v.astype(phys)
+            nan = np.isnan(v)
+            vv = np.where(m & ~nan, v, np.asarray(fill, phys))
+            acc = np.maximum(acc, vv) if largest else np.minimum(acc, vv)
+            nan_seen |= m & nan
+            num_seen |= m & ~nan
+            any_valid |= m
+        nan_v = np.asarray(np.nan, phys)
+        if largest:
+            acc = np.where(nan_seen, nan_v, acc)
+        else:
+            acc = np.where(num_seen, acc, nan_v)
+        return _zero_nulls(acc, any_valid), any_valid
     for c in expr.children:
         v, m = _ev(c, table)
         v = np.where(m, v.astype(phys), np.asarray(fill, phys))

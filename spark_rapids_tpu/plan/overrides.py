@@ -174,8 +174,12 @@ for _cls in (A.IntegralDivide, A.Remainder, A.Pmod):
 for _cls in (A.UnaryMinus, A.UnaryPositive, A.Abs):
     _expr(_cls, ts.numeric_all)
 for _cls in (A.Least, A.Greatest):
+    # decimal64 reduces on the int64 physical; strings + decimal128
+    # fall back to the CPU lane (the If-fold device lane for strings
+    # exists but mis-selects on some null patterns — planner-gated off
+    # until debugged; the CPU oracle string lane is the active path)
     _expr(_cls, ts.numeric_no_decimal + ts.TypeSig(
-        ts.DATE, ts.TIMESTAMP, ts.BOOLEAN))
+        ts.DATE, ts.TIMESTAMP, ts.BOOLEAN, ts.DECIMAL_64))
 
 for _cls in (P.EqualTo, P.LessThan, P.GreaterThan, P.LessThanOrEqual,
              P.GreaterThanOrEqual, P.EqualNullSafe):

@@ -347,3 +347,34 @@ def test_string_concat_replace_matrix(session, sg):
         Concat(col("a"), col("b")).alias("cc"),
         StringReplace(col("a"), "a", "xy").alias("rp"),
         StringRepeat(col("a"), 2).alias("rep")))
+
+
+# ----------------------------- n-ary conditional/selection functions
+
+@pytest.mark.parametrize("vt", ["int32", "int64", "float64_special",
+                                "decimal64", "string", "date"])
+def test_least_greatest_matrix(session, vt):
+    """least/greatest across dtypes; the float lane includes NaN
+    (Spark: NaN is greatest) and null-skipping semantics."""
+    from spark_rapids_tpu.expr.arithmetic import Greatest, Least
+    df = make_df(session, {"a": VALUE_GENS[vt](),
+                           "b": VALUE_GENS[vt](),
+                           "c": VALUE_GENS[vt]()}, seed=151)
+    assert_tpu_cpu_equal_df(df.select(
+        Least(col("a"), col("b"), col("c")).alias("lo"),
+        Greatest(col("a"), col("b"), col("c")).alias("hi")))
+
+
+@pytest.mark.parametrize("vt", ["int64", "float64_special", "string",
+                                "decimal128"])
+def test_coalesce_if_matrix(session, vt):
+    # NOTE: the decimal128 lane exercises the planner's explicit CPU
+    # FALLBACK for If/Coalesce (their TypeSig excludes decimal128) —
+    # it proves transition correctness, not a device lane
+    from spark_rapids_tpu.expr.conditional import Coalesce, If
+    from spark_rapids_tpu.expr.predicates import IsNull
+    df = make_df(session, {"a": VALUE_GENS[vt](),
+                           "b": VALUE_GENS[vt]()}, seed=152)
+    assert_tpu_cpu_equal_df(df.select(
+        Coalesce(col("a"), col("b")).alias("co"),
+        If(IsNull(col("a")), col("b"), col("a")).alias("iff")))
