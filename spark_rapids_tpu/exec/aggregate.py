@@ -28,8 +28,16 @@ from ..columnar.vector import (ColumnVector, ColumnarBatch, choose_capacity,
                                live_mask)
 from ..expr.aggregates import AggregateFunction
 from ..expr.core import Expression, make_result, output_name
+from ..jit_registry import shared_fn_jit, shared_method_jit
 from ..ops import kernels as K
 from .base import ExecContext, Metric, NvtxTimer, Schema, TpuExec
+
+
+def _key_bucket_split_builder(key_names, num_parts):
+    def run(batch, p):
+        return K.bucket_compact(
+            batch, [batch.column(n) for n in key_names], num_parts, p)
+    return run
 
 PARTIAL = "partial"
 FINAL = "final"
@@ -91,8 +99,11 @@ class HashAggregateExec(TpuExec):
         for i, sschema in enumerate(self._state_schemas):
             for sname, stype in sschema:
                 self._packed_schema.append((_state_col_name(i, sname), stype))
-        self._jit_update = jax.jit(self._update)
-        self._jit_merge = jax.jit(self._merge_finalize)
+        agg_fields = ("group_exprs", "agg_exprs", "_key_names",
+                      "_state_schemas", "_result_schema", "_packed_schema")
+        self._jit_update = shared_method_jit(self, "_update", agg_fields)
+        self._jit_merge = shared_method_jit(self, "_merge_finalize",
+                                            agg_fields)
         self._split_cache = {}
         from . import pallas_agg
         self._pallas_gate = pallas_agg.pallas_eligible(self)
@@ -259,12 +270,8 @@ class HashAggregateExec(TpuExec):
         (ops/kernels.py bucket_compact — same primitive the
         sub-partition join uses)."""
         if num_parts not in self._split_cache:
-            names = list(self._key_names)
-
-            def run(batch, p):
-                return K.bucket_compact(
-                    batch, [batch.column(n) for n in names], num_parts, p)
-            self._split_cache[num_parts] = jax.jit(run)
+            self._split_cache[num_parts] = shared_fn_jit(
+                _key_bucket_split_builder, list(self._key_names), num_parts)
         return self._split_cache[num_parts]
 
     def _repack(self, ctx: ExecContext, batch: ColumnarBatch
@@ -276,12 +283,8 @@ class HashAggregateExec(TpuExec):
         cap = choose_capacity(max(n, 8))
         if cap >= batch.capacity:
             return batch
-        key = ("repack", batch.capacity, cap)
-        if key not in self._split_cache:
-            self._split_cache[key] = jax.jit(
-                lambda b: K.slice_batch(b, 0, b.num_rows, cap))
         with ctx.semaphore:
-            return self._split_cache[key](batch)
+            return K.repack_to(batch, cap)
 
     def _repartition_merge(self, ctx: ExecContext, held, total: int,
                            threshold: int, agg_time: Metric

@@ -21,6 +21,7 @@ from ..columnar import dtypes as dt
 from ..columnar.vector import (ColumnVector, ColumnarBatch, choose_capacity,
                                live_mask)
 from ..expr.core import Expression, output_name
+from ..jit_registry import shared_fn_jit, shared_method_jit
 from ..ops import kernels as K
 from .base import ExecContext, NvtxTimer, Schema, TpuExec
 
@@ -64,9 +65,10 @@ class ProjectExec(TpuExec):
                         for i, e in enumerate(self.exprs)]
         from ..expr.misc import contains_eager
         self._eager = contains_eager(self.exprs)
-        self._jit = jax.jit(self._project)
+        self._jit = shared_method_jit(self, "_project", ("exprs", "_schema"))
         self._jit_ctx = self._project_ctx if self._eager \
-            else jax.jit(self._project_ctx)
+            else shared_method_jit(self, "_project_ctx",
+                                   ("exprs", "_schema"))
 
     def _project(self, batch: ColumnarBatch) -> ColumnarBatch:
         cols = [e.eval(batch) for e in self.exprs]
@@ -102,7 +104,7 @@ class FilterExec(TpuExec):
     def __init__(self, child: TpuExec, condition: Expression):
         super().__init__(child)
         self.condition = condition
-        self._jit = jax.jit(self._filter)
+        self._jit = shared_method_jit(self, "_filter", ("condition",))
 
     def _filter(self, batch: ColumnarBatch) -> ColumnarBatch:
         cond = self.condition.eval(batch)
@@ -129,7 +131,7 @@ class LocalLimitExec(TpuExec):
         self.limit = limit
         # limit passed as a traced scalar: one compile per capacity
         # bucket, not one per distinct remaining-count
-        self._jit = jax.jit(K.local_limit)
+        self._jit = shared_fn_jit(_local_limit_builder)
 
     @property
     def output_schema(self) -> Schema:
@@ -147,6 +149,10 @@ class LocalLimitExec(TpuExec):
 
     def node_description(self) -> str:
         return f"LocalLimit[{self.limit}]"
+
+
+def _local_limit_builder():
+    return K.local_limit
 
 
 class UnionExec(TpuExec):
@@ -191,14 +197,8 @@ class ExpandExec(TpuExec):
                 if p[i].data_type(in_schema) != t:
                     p[i] = Cast(p[i], t)
         self._schema = list(zip(names, unified))
-        self._jits = [jax.jit(self._make_project(p)) for p in self.projections]
-
-    def _make_project(self, exprs):
-        def run(batch):
-            cols = [e.eval(batch) for e in exprs]
-            return ColumnarBatch(cols, [n for n, _ in self._schema],
-                                 batch.num_rows)
-        return run
+        self._jits = [shared_fn_jit(_expand_project_builder, p, list(names))
+                      for p in self.projections]
 
     @property
     def output_schema(self) -> Schema:
@@ -212,6 +212,13 @@ class ExpandExec(TpuExec):
 
     def node_description(self) -> str:
         return f"Expand[{len(self.projections)} projections]"
+
+
+def _expand_project_builder(exprs, names):
+    def run(batch):
+        cols = [e.eval(batch) for e in exprs]
+        return ColumnarBatch(cols, list(names), batch.num_rows)
+    return run
 
 
 class RangeExec(TpuExec):
@@ -330,7 +337,7 @@ class SampleExec(TpuExec):
         super().__init__(child)
         self.fraction = fraction
         self.seed = seed
-        self._jit = jax.jit(self._sample)
+        self._jit = shared_method_jit(self, "_sample", ("fraction", "seed"))
 
     def _sample(self, batch: ColumnarBatch, row_offset):
         keep = sample_keep_mask(row_offset, batch.capacity,

@@ -36,6 +36,7 @@ from ..columnar.vector import (ColumnVector, ColumnarBatch, StringColumn,
                                choose_capacity)
 from ..conf import SHUFFLE_PARTITIONS
 from ..expr.core import Expression
+from ..jit_registry import shared_fn_jit
 from ..ops import kernels as K
 from ..parallel.partition import (PartitionedBatch, hash_partition_ids,
                                   partition_batch, range_partition_ids,
@@ -75,6 +76,38 @@ def partition_slice(pb: PartitionedBatch, i: int) -> ColumnarBatch:
             data, valid = spec
             cols.append(ColumnVector(data[i], valid[i], dtype))
     return ColumnarBatch(cols, pb.names, pb.counts[i])
+
+
+def _partition_slices(pb: PartitionedBatch, num_parts: int):
+    return [partition_slice(pb, i) for i in range(num_parts)]
+
+
+def _range_partition_builder(orders, num_parts):
+    def run(batch: ColumnarBatch, bnds):
+        keys = [o.expr.eval(batch) for o in orders]
+        pids = range_partition_ids(
+            keys, bnds, [o.ascending for o in orders],
+            [o.nulls_first for o in orders])
+        return _partition_slices(partition_batch(batch, pids, num_parts),
+                                 num_parts)
+    return run
+
+
+def _hash_partition_builder(key_exprs, num_parts):
+    def run(batch: ColumnarBatch):
+        keys = [e.eval(batch) for e in key_exprs]
+        pids = hash_partition_ids(keys, num_parts)
+        return _partition_slices(partition_batch(batch, pids, num_parts),
+                                 num_parts)
+    return run
+
+
+def _rr_partition_builder(num_parts):
+    def run(batch: ColumnarBatch):
+        pids = round_robin_partition_ids(batch.capacity, num_parts)
+        return _partition_slices(partition_batch(batch, pids, num_parts),
+                                 num_parts)
+    return run
 
 
 class ShuffleExchangeExec(TpuExec):
@@ -134,34 +167,20 @@ class ShuffleExchangeExec(TpuExec):
         """Jitted batch -> [partition batches]. The slice-out of every
         partition lives INSIDE the jit: partitioning plus N slices is
         one XLA program per batch structure instead of hundreds of
-        eager dispatches per map batch."""
+        eager dispatches per map batch. Shared process-wide via the jit
+        registry: every exchange over the same keys/orders and fan-out
+        reuses one traced fn."""
         key = (num_parts, bounds is not None)
         if key not in self._jit_cache:
-            def slices(pb: PartitionedBatch):
-                return [partition_slice(pb, i) for i in range(num_parts)]
             if self.sort_orders:
-                orders = self.sort_orders
-
-                def run(batch: ColumnarBatch, bnds):
-                    keys = [o.expr.eval(batch) for o in orders]
-                    pids = range_partition_ids(
-                        keys, bnds,
-                        [o.ascending for o in orders],
-                        [o.nulls_first for o in orders])
-                    return slices(partition_batch(batch, pids, num_parts))
-                self._jit_cache[key] = jax.jit(run)
+                self._jit_cache[key] = shared_fn_jit(
+                    _range_partition_builder, self.sort_orders, num_parts)
             elif self.key_exprs:
-                def run(batch: ColumnarBatch):
-                    keys = [e.eval(batch) for e in self.key_exprs]
-                    pids = hash_partition_ids(keys, num_parts)
-                    return slices(partition_batch(batch, pids, num_parts))
-                self._jit_cache[key] = jax.jit(run)
+                self._jit_cache[key] = shared_fn_jit(
+                    _hash_partition_builder, self.key_exprs, num_parts)
             else:
-                def run(batch: ColumnarBatch):
-                    pids = round_robin_partition_ids(batch.capacity,
-                                                     num_parts)
-                    return slices(partition_batch(batch, pids, num_parts))
-                self._jit_cache[key] = jax.jit(run)
+                self._jit_cache[key] = shared_fn_jit(
+                    _rr_partition_builder, num_parts)
         return self._jit_cache[key]
 
     # --- range bounds (GpuRangePartitioner.sketch: sample to the

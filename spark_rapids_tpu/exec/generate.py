@@ -17,10 +17,19 @@ import jax
 from ..columnar import dtypes as dt
 from ..columnar.vector import ColumnarBatch, choose_capacity
 from ..expr.collections import Explode
+from ..jit_registry import shared_fn_jit
 from ..ops import kernels as K
 from .base import ExecContext, Metric, Schema, TpuExec
 
 _MAX_GROWTH_STEPS = 4
+
+
+def _explode_builder(generator, element_name, pos_name, out_cap):
+    def run(batch: ColumnarBatch):
+        lc = generator.children[0].eval(batch)
+        return K.explode_batch(batch, lc, element_name, out_cap,
+                               outer=generator.outer, pos_name=pos_name)
+    return run
 
 
 class GenerateExec(TpuExec):
@@ -44,14 +53,9 @@ class GenerateExec(TpuExec):
 
     def _fn(self, out_cap: int):
         if out_cap not in self._jit_cache:
-            gen = self.generator
-
-            def run(batch: ColumnarBatch):
-                lc = gen.children[0].eval(batch)
-                return K.explode_batch(batch, lc, self.element_name,
-                                       out_cap, outer=gen.outer,
-                                       pos_name=self.pos_name)
-            self._jit_cache[out_cap] = jax.jit(run)
+            self._jit_cache[out_cap] = shared_fn_jit(
+                _explode_builder, self.generator, self.element_name,
+                self.pos_name, out_cap)
         return self._jit_cache[out_cap]
 
     def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from ..columnar import dtypes as dt
 from ..columnar.vector import ColumnarBatch, choose_capacity
 from ..expr.core import Expression
+from ..jit_registry import shared_fn_jit
 from ..ops import kernels as K
 from .base import ExecContext, Metric, Schema, TpuExec
 
@@ -42,6 +43,59 @@ CROSS = "cross"
 # Output capacity growth is bounded: past this many doublings the probe
 # batch gets split instead (GpuSubPartitionHashJoin analogue).
 _MAX_GROWTH_STEPS = 4
+
+
+# --- module-level jit builders (shared process-wide via jit_registry:
+# every join over the same keys/type/capacity reuses one traced fn) ---
+
+def _join_run_builder(join_type, probe_keys, build_keys, out_capacity):
+    def run(probe, build):
+        pk = [e.eval(probe) for e in probe_keys]
+        bk = [e.eval(build) for e in build_keys]
+        if join_type in (LEFT_SEMI, LEFT_ANTI):
+            out, total = K.semi_anti_join(
+                probe, bk, pk, build.live_mask(),
+                anti=(join_type == LEFT_ANTI),
+                scratch_capacity=out_capacity)
+        elif join_type == INNER:
+            out, total = K.inner_join(probe, build, pk, bk, out_capacity)
+        else:  # LEFT_OUTER / RIGHT_OUTER: probe is preserved side
+            out, total = K.left_join(probe, build, pk, bk, out_capacity)
+        return out, total
+    return run
+
+
+def _bucket_split_builder(exprs, num_parts):
+    def run(batch, p):
+        return K.bucket_compact(
+            batch, [e.eval(batch) for e in exprs], num_parts, p)
+    return run
+
+
+def _chunk_slice_builder(length, cap):
+    def run(b, s):
+        return K.slice_batch(b, s, length, cap)
+    return run
+
+
+def _bloom_build_builder(exprs, num_bits):
+    from ..ops import bloom as B
+
+    def mk(b):
+        return B.build_bloom([e.eval(b) for e in exprs],
+                             b.live_mask(), num_bits)
+    return mk
+
+
+def _bloom_probe_builder(exprs):
+    from ..columnar.vector import ColumnVector
+    from ..ops import bloom as B
+
+    def probe_fn(bits_, b):
+        keep = B.might_contain(bits_, [e.eval(b) for e in exprs])
+        cond = ColumnVector(keep, jnp.ones_like(keep), dt.BOOL)
+        return K.filter_batch(b, cond)
+    return probe_fn
 
 
 class _HashJoinBase(TpuExec):
@@ -101,27 +155,13 @@ class _HashJoinBase(TpuExec):
         return [e.eval(batch) for e in exprs]
 
     def _join_fn(self, out_capacity: int):
-        """jit per output capacity; cached so capacities reuse traces."""
+        """jit per output capacity; cached per instance, shared
+        process-wide (registry) across joins with equal keys/type."""
         key = out_capacity
         if key not in self._jit_cache:
-            jt = self.join_type
-
-            def run(probe, build):
-                pk = self._key_cols(probe, self._probe_key_exprs)
-                bk = self._key_cols(build, self._build_key_exprs)
-                if jt in (LEFT_SEMI, LEFT_ANTI):
-                    out, total = K.semi_anti_join(
-                        probe, bk, pk, build.live_mask(),
-                        anti=(jt == LEFT_ANTI),
-                        scratch_capacity=out_capacity)
-                elif jt == INNER:
-                    out, total = K.inner_join(probe, build, pk, bk,
-                                              out_capacity)
-                else:  # LEFT_OUTER / RIGHT_OUTER: probe is preserved side
-                    out, total = K.left_join(probe, build, pk, bk,
-                                             out_capacity)
-                return out, total
-            self._jit_cache[key] = jax.jit(run)
+            self._jit_cache[key] = shared_fn_jit(
+                _join_run_builder, self.join_type, self._probe_key_exprs,
+                self._build_key_exprs, out_capacity)
         return self._jit_cache[key]
 
     @property
@@ -216,11 +256,8 @@ class _HashJoinBase(TpuExec):
         if key not in self._jit_cache:
             exprs = self._probe_key_exprs if side == "probe" \
                 else self._build_key_exprs
-
-            def run(batch, p):
-                return K.bucket_compact(
-                    batch, [e.eval(batch) for e in exprs], num_parts, p)
-            self._jit_cache[key] = jax.jit(run)
+            self._jit_cache[key] = shared_fn_jit(
+                _bucket_split_builder, exprs, num_parts)
         return self._jit_cache[key]
 
     def _repack(self, ctx: ExecContext, batch: ColumnarBatch
@@ -232,12 +269,8 @@ class _HashJoinBase(TpuExec):
         cap = choose_capacity(max(n, 8))
         if cap >= batch.capacity:
             return batch
-        key = ("repack", batch.capacity, cap)
-        if key not in self._jit_cache:
-            self._jit_cache[key] = jax.jit(
-                lambda b: K.slice_batch(b, 0, b.num_rows, cap))
         with ctx.semaphore:
-            return self._jit_cache[key](batch)
+            return K.repack_to(batch, cap)
 
     def _sub_partition_join(self, ctx: ExecContext, probe_stream,
                             build_holder: List[ColumnarBatch], threshold: int
@@ -316,9 +349,8 @@ class _HashJoinBase(TpuExec):
                     chunk_cap = choose_capacity(threshold)
                     ck = ("chunk", bucket_build.capacity, chunk_cap)
                     if ck not in self._jit_cache:
-                        self._jit_cache[ck] = jax.jit(
-                            lambda b, s: K.slice_batch(b, s, threshold,
-                                                       chunk_cap))
+                        self._jit_cache[ck] = shared_fn_jit(
+                            _chunk_slice_builder, threshold, chunk_cap)
                     for ci in range(chunks):
                         with ctx.semaphore:
                             chunk = self._jit_cache[ck](
@@ -362,24 +394,14 @@ class _HashJoinBase(TpuExec):
             int(build.num_rows), ctx.conf.get(JOIN_BLOOM_BITS_PER_KEY))
         bkey = ("bloom_build", num_bits)
         if bkey not in self._jit_cache:
-            bexprs = self._build_key_exprs
-
-            def mk(b):
-                return B.build_bloom([e.eval(b) for e in bexprs],
-                                     b.live_mask(), num_bits)
-            self._jit_cache[bkey] = jax.jit(mk)
+            self._jit_cache[bkey] = shared_fn_jit(
+                _bloom_build_builder, self._build_key_exprs, num_bits)
         with ctx.semaphore:
             bits = self._jit_cache[bkey](build)
         pkey = ("bloom_probe", num_bits)
         if pkey not in self._jit_cache:
-            pexprs = self._probe_key_exprs
-
-            def probe_fn(bits_, b):
-                from ..columnar.vector import ColumnVector
-                keep = B.might_contain(bits_, [e.eval(b) for e in pexprs])
-                cond = ColumnVector(keep, jnp.ones_like(keep), dt.BOOL)
-                return K.filter_batch(b, cond)
-            self._jit_cache[pkey] = jax.jit(probe_fn)
+            self._jit_cache[pkey] = shared_fn_jit(
+                _bloom_probe_builder, self._probe_key_exprs)
         m = ctx.metrics_for(self.exec_id)
         dropped = m.setdefault("bloomFilteredRows",
                                Metric("bloomFilteredRows", Metric.DEBUG))

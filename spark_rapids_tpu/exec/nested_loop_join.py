@@ -25,6 +25,7 @@ from ..columnar import dtypes as dt
 from ..columnar.vector import (ColumnVector, ColumnarBatch, StringColumn,
                                choose_capacity, live_mask)
 from ..expr.core import Expression
+from ..jit_registry import shared_fn_jit
 from ..ops import kernels as K
 from .base import ExecContext, Metric, Schema, TpuExec
 
@@ -56,6 +57,20 @@ def _replicate_pair(probe: ColumnarBatch, build: ColumnarBatch,
                          jnp.int32(tile_cap)), valid
 
 
+def _tile_run_builder(condition, tile_cap):
+    def run(probe, build, probe_rows, tile_start, build_count):
+        paired, valid = _replicate_pair(
+            probe, build, probe_rows, tile_start, tile_cap, build_count)
+        if condition is not None:
+            cond = condition.eval(paired)
+            keep = cond.data & cond.validity & valid
+        else:
+            keep = valid
+        keep_col = ColumnVector(keep, jnp.ones_like(keep), dt.BOOL)
+        return K.filter_batch(paired, keep_col)
+    return run
+
+
 class BroadcastNestedLoopJoinExec(TpuExec):
     """inner/cross nested-loop join with an arbitrary condition.
 
@@ -85,18 +100,8 @@ class BroadcastNestedLoopJoinExec(TpuExec):
     def _tile_fn(self, tile_cap: int, probe_cap: int):
         key = (tile_cap, probe_cap)
         if key not in self._jit_cache:
-            def run(probe, build, probe_rows, tile_start, build_count):
-                paired, valid = _replicate_pair(
-                    probe, build, probe_rows, tile_start, tile_cap,
-                    build_count)
-                if self.condition is not None:
-                    cond = self.condition.eval(paired)
-                    keep = cond.data & cond.validity & valid
-                else:
-                    keep = valid
-                keep_col = ColumnVector(keep, jnp.ones_like(keep), dt.BOOL)
-                return K.filter_batch(paired, keep_col)
-            self._jit_cache[key] = jax.jit(run)
+            self._jit_cache[key] = shared_fn_jit(
+                _tile_run_builder, self.condition, tile_cap)
         return self._jit_cache[key]
 
     def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:

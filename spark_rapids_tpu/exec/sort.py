@@ -25,8 +25,27 @@ from ..columnar import dtypes as dt
 from ..columnar.vector import (ColumnVector, ColumnarBatch,
                                choose_capacity, live_mask)
 from ..expr.core import Expression
+from ..jit_registry import shared_fn_jit, shared_method_jit
 from ..ops import kernels as K
 from .base import ExecContext, Metric, Schema, TpuExec
+
+
+def _safe_prefix_builder(order):
+    from ..parallel.partition import range_partition_ids
+
+    def run(mb, bb):
+        keys = [o.expr.eval(mb) for o in order]
+        bkeys = [o.expr.eval(bb) for o in order]
+        bkeys = [c.gather(jnp.zeros(1, jnp.int32),
+                          live_mask(1, bb.num_rows))
+                 if hasattr(c, "chars") else
+                 type(c)(c.data[:1], c.validity[:1], c.dtype)
+                 for c in bkeys]
+        pid = range_partition_ids(
+            keys, bkeys, [o.ascending for o in order],
+            [o.nulls_first for o in order])
+        return jnp.sum((pid == 0) & mb.live_mask()).astype(jnp.int32)
+    return run
 
 
 class SortOrder:
@@ -46,7 +65,7 @@ class SortExec(TpuExec):
         super().__init__(child)
         self.order = list(order)
         self.global_sort = global_sort
-        self._jit_sort = jax.jit(self._sort_one)
+        self._jit_sort = shared_method_jit(self, "_sort_one", ("order",))
 
     def _sort_one(self, batch: ColumnarBatch) -> ColumnarBatch:
         key_cols = [o.expr.eval(batch) for o in self.order]
@@ -279,14 +298,9 @@ class SortExec(TpuExec):
                     p.close()
 
     def _jit_sort_heads(self, hb: ColumnarBatch) -> ColumnarBatch:
-        if not hasattr(self, "_sort_heads_fn"):
-            def run(b):
-                key_cols = [o.expr.eval(b) for o in self.order]
-                return K.sort_batch(b, key_cols,
-                                    [o.ascending for o in self.order],
-                                    [o.nulls_first for o in self.order])
-            self._sort_heads_fn = jax.jit(run)
-        return self._sort_heads_fn(hb)
+        # same registry key as _jit_sort (identical program; the trace
+        # cache keys on the head batch's own structure)
+        return self._jit_sort(hb)
 
     def _jit_safe_prefix(self, merged: ColumnarBatch,
                          bound: ColumnarBatch):
@@ -294,22 +308,8 @@ class SortExec(TpuExec):
         prefix of the sorted batch; range_partition_ids shares the sort
         comparator exactly, so 'strictly after bound' == unsafe)."""
         if not hasattr(self, "_safe_prefix_fn"):
-            from ..parallel.partition import range_partition_ids
-
-            def run(mb, bb):
-                keys = [o.expr.eval(mb) for o in self.order]
-                bkeys = [o.expr.eval(bb) for o in self.order]
-                bkeys = [c.gather(jnp.zeros(1, jnp.int32),
-                                  live_mask(1, bb.num_rows))
-                         if hasattr(c, "chars") else
-                         type(c)(c.data[:1], c.validity[:1], c.dtype)
-                         for c in bkeys]
-                pid = range_partition_ids(
-                    keys, bkeys, [o.ascending for o in self.order],
-                    [o.nulls_first for o in self.order])
-                return jnp.sum((pid == 0) & mb.live_mask()
-                               ).astype(jnp.int32)
-            self._safe_prefix_fn = jax.jit(run)
+            self._safe_prefix_fn = shared_fn_jit(
+                _safe_prefix_builder, self.order)
         return self._safe_prefix_fn(merged, bound)
 
     def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
@@ -342,10 +342,10 @@ class TopNExec(TpuExec):
         super().__init__(child)
         self.order = list(order)
         self.limit = limit
-        self._jit_topn = jax.jit(self._topn)
-        self._jit_shrink = jax.jit(
-            lambda b: K.slice_batch(b, 0, b.num_rows,
-                                    choose_capacity(self.limit)))
+        self._jit_topn = shared_method_jit(self, "_topn",
+                                           ("order", "limit"))
+        shrink_cap = choose_capacity(self.limit)
+        self._jit_shrink = lambda b: K.repack_to(b, shrink_cap)
 
     def _topn(self, batch: ColumnarBatch) -> ColumnarBatch:
         key_cols = [o.expr.eval(batch) for o in self.order]

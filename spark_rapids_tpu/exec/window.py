@@ -33,6 +33,7 @@ from ..columnar.vector import (Column, ColumnVector, ColumnarBatch,
 from ..expr.aggregates import (AggregateFunction, Average, Count, CountStar,
                                Max, Min, Sum)
 from ..expr.core import Expression, make_result
+from ..jit_registry import shared_method_jit
 from ..expr.window import (Lag, Lead, DenseRank, NTile, PercentRank, Rank,
                            RowNumber, WindowExpression, WindowFrame)
 from ..ops import kernels as K
@@ -190,7 +191,9 @@ class WindowExec(TpuExec):
         self._schema = list(in_schema) + [
             (name, we.data_type(in_schema))
             for we, name in self.window_exprs]
-        self._jit = jax.jit(self._compute)
+        self._jit = shared_method_jit(
+            self, "_compute",
+            ("window_exprs", "partition_by", "order_by", "_schema"))
 
     @property
     def output_schema(self) -> Schema:
@@ -585,7 +588,10 @@ class BatchedRunningWindowExec(TpuExec):
             (name, we.data_type(in_schema))
             for we, name in self.window_exprs]
         self._in_schema = in_schema
-        self._jit = jax.jit(self._compute)
+        self._jit = shared_method_jit(
+            self, "_compute",
+            ("window_exprs", "partition_by", "order_by", "_schema",
+             "_in_schema"))
 
     @property
     def output_schema(self) -> Schema:

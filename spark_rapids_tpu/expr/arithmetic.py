@@ -79,8 +79,20 @@ def decimal_coerced_children(expr: Expression, schema: Schema):
     from .cast import Cast
     other_t = rt if ldec else lt
     if other_t in _INTEGRAL_DECIMAL:
-        wrapped = Cast(right if ldec else left,
-                       dt.DecimalType(*_INTEGRAL_DECIMAL[other_t]))
+        other = right if ldec else left
+        from .core import Literal
+        if isinstance(other, Literal) and other.value is not None:
+            # Spark DecimalPrecision.nondecimalAndDecimal uses the
+            # TIGHT DecimalType.fromLiteral for literal operands
+            # (precision = significant digits of the value, scale 0) —
+            # the attribute-width forType mapping below would widen the
+            # result type and move the overflow-null boundary near
+            # precision 38.
+            digits = max(1, len(str(abs(int(other.value)))))
+            target = dt.DecimalType(digits, 0)
+        else:
+            target = dt.DecimalType(*_INTEGRAL_DECIMAL[other_t])
+        wrapped = Cast(other, target)
         return (left, wrapped) if ldec else (wrapped, right)
     if getattr(other_t, "is_floating", False):
         if ldec:

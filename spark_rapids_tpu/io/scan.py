@@ -442,6 +442,20 @@ def iter_file_tables(path: str, fmt: str, schema: Schema,
                                      partition_values)
     except Exception as e:
         if path not in str(e):
+            if isinstance(e, OSError):
+                # OSError renders str() from errno/strerror/filename,
+                # not args — mutating args would silently drop the
+                # prefix; raise a same-type replacement (errno and
+                # filename preserved so errno-branching callers are
+                # unaffected)
+                if e.errno is not None:
+                    ne = type(e)(
+                        e.errno,
+                        f"while reading {fmt} file {path}: "
+                        f"{e.strerror or e}", e.filename)
+                else:
+                    ne = type(e)(f"while reading {fmt} file {path}: {e}")
+                raise ne.with_traceback(e.__traceback__) from e
             head = str(e.args[0]) if e.args else str(e)
             e.args = (f"while reading {fmt} file {path}: {head}",
                       ) + tuple(e.args[1:])

@@ -1,0 +1,147 @@
+"""Process-wide shared-kernel jit registry.
+
+Each exec instance used to mint its own ``jax.jit`` wrappers in
+``__init__``, so two structurally identical operators — the same
+projection over the same schema in two different queries, the same
+hash-partition function over the same table, the same join probe shape
+— each paid a full trace + lower even though the persistent XLA cache
+deduped the *compile*. Across a 99-query NDS sweep that re-trace cost
+dominates wall-clock on the CPU lane (docs/PERF_NOTES.md). The registry
+maps a STRUCTURAL key -> one jitted callable shared process-wide, so
+trace/lower happens once per distinct (program, shapes) rather than
+once per plan node.
+
+Two entry points:
+
+- ``shared_method_jit(obj, method, fields)`` — jit a *detached* bound
+  method: a shell instance carrying only ``fields`` (copied off
+  ``obj``) backs the traced function, so the registry never pins an
+  exec tree (children, scan batches, broadcast state) in memory, and
+  the key covers exactly the state the method may read. A field the
+  method needs but that isn't listed fails loudly (AttributeError at
+  trace time) — never a silent alias.
+- ``shared_fn_jit(builder, *key_args)`` — jit ``builder(*key_args)``
+  where ``builder`` is a MODULE-LEVEL factory whose output depends only
+  on its arguments; the key is the builder's qualified name plus the
+  structural encoding of ``key_args``.
+
+Anything the structural encoder (plan/plan_cache._enc) cannot encode
+falls back to a private ``jax.jit`` — unshared, never wrong.
+
+Reference role: the spark-rapids plugin loads/caches each cuDF kernel
+once per JVM, not once per operator instance
+(sql-plugin/src/main/scala/.../GpuOverrides.scala module-level kernel
+dispatch); here the shared unit is the traced jaxpr.
+
+Disable with ``SRT_JIT_REGISTRY=0`` (every call falls back to a
+private ``jax.jit``) when isolating trace-level bugs.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Dict, Sequence
+
+import jax
+
+_REGISTRY: Dict = {}
+_LOCK = threading.Lock()
+_STATS = {"hits": 0, "misses": 0, "uncached": 0}
+
+_ENABLED = os.environ.get("SRT_JIT_REGISTRY", "1") != "0"
+
+# Soft cap: parameterized workloads (distinct literals, growing
+# out_capacity buckets) mint unbounded distinct keys; past the cap the
+# oldest entries are evicted FIFO (re-registration later is only a
+# re-trace, never wrong). dict preserves insertion order.
+_MAX_ENTRIES = int(os.environ.get("SRT_JIT_REGISTRY_MAX", 8192))
+
+
+def _put(key, fn) -> None:
+    while len(_REGISTRY) >= _MAX_ENTRIES:
+        _REGISTRY.pop(next(iter(_REGISTRY)))
+    _REGISTRY[key] = fn
+
+
+def _encode(parts):
+    """Structural key for ``parts`` or None when not safely encodable."""
+    from .plan.plan_cache import Uncachable, _enc
+    try:
+        return _enc(parts)
+    except Uncachable:
+        return None
+    except Exception:
+        return None
+
+
+def shared_method_jit(obj, method_name: str, fields: Sequence[str],
+                      extra=(), **jit_kwargs) -> Callable:
+    """Shared jit of ``type(obj).<method_name>`` bound to a detached
+    shell holding only ``fields`` (copied from ``obj``).
+
+    ``extra`` folds additional hashables (e.g. a static capacity) into
+    the key when the method's builder varies on them.
+    """
+    cls = type(obj)
+    enc = _encode([getattr(obj, f) for f in fields]) if _ENABLED else None
+    if enc is None:
+        _STATS["uncached"] += 1
+        return jax.jit(getattr(obj, method_name), **jit_kwargs)
+    key = (cls.__module__, cls.__qualname__, method_name, tuple(fields),
+           enc, tuple(extra),
+           tuple(sorted(jit_kwargs.items())) if jit_kwargs else ())
+    with _LOCK:
+        fn = _REGISTRY.get(key)
+        if fn is not None:
+            _STATS["hits"] += 1
+            return fn
+        shell = object.__new__(cls)
+        for f in fields:
+            setattr(shell, f, getattr(obj, f))
+        fn = jax.jit(getattr(shell, method_name), **jit_kwargs)
+        _put(key, fn)
+        _STATS["misses"] += 1
+    return fn
+
+
+def shared_fn_jit(builder: Callable, *key_args, **jit_kwargs) -> Callable:
+    """Shared jit of ``builder(*key_args)``.
+
+    ``builder`` must be module-level and pure: its returned function
+    may depend only on ``key_args`` (and module globals that never
+    change). Closures defined inside methods must NOT be passed here —
+    refactor them into module-level factories first.
+    """
+    enc = _encode(list(key_args)) if _ENABLED else None
+    if enc is None:
+        _STATS["uncached"] += 1
+        return jax.jit(builder(*key_args), **jit_kwargs)
+    key = (builder.__module__,
+           getattr(builder, "__qualname__", builder.__name__), enc,
+           tuple(sorted(jit_kwargs.items())) if jit_kwargs else ())
+    with _LOCK:
+        fn = _REGISTRY.get(key)
+        if fn is not None:
+            _STATS["hits"] += 1
+            return fn
+        fn = jax.jit(builder(*key_args), **jit_kwargs)
+        _put(key, fn)
+        _STATS["misses"] += 1
+    return fn
+
+
+def stats() -> dict:
+    s = dict(_STATS)
+    s["entries"] = len(_REGISTRY)
+    return s
+
+
+def clear() -> None:
+    """Drop every shared wrapper (next use re-registers). The mmap
+    guard (plan/session.py) calls jax.clear_caches(), which empties the
+    wrappers' trace caches in place — that alone releases the compiled
+    executables, so this is only for tests needing a cold registry."""
+    with _LOCK:
+        _REGISTRY.clear()
+        _STATS.update(hits=0, misses=0, uncached=0)

@@ -431,7 +431,10 @@ def register_nds(session, data_dir: str, scale_rows: int = 20_000):
     for spec in nds_specs(scale_rows):
         out = os.path.join(data_dir, spec.name)
         if not (os.path.isdir(out) and os.listdir(out)):
-            tmp = out + ".generating"
+            # per-process scratch: two concurrent generators must never
+            # share (or rmtree) each other's in-progress dir — whichever
+            # os.rename lands first wins, the loser discards its copy
+            tmp = f"{out}.generating.{os.getpid()}"
             import shutil
             shutil.rmtree(tmp, ignore_errors=True)
             generate_table(session, spec, tmp, chunk_rows=1 << 18)

@@ -773,6 +773,15 @@ def compact_for_transfer(batch: ColumnarBatch,
     cap = choose_capacity(n)
     if cap * slack > batch.capacity:
         return batch
+    return repack_to(batch, cap)
+
+
+def repack_to(batch: ColumnarBatch, cap: int) -> ColumnarBatch:
+    """Rows [0, num_rows) re-laid into a fresh batch of capacity
+    ``cap`` — one process-wide jit per target capacity (the trace cache
+    inside each wrapper keys on the input batch structure). Shared by
+    every repack site: join/aggregate sub-partition shrink, transfer
+    compaction."""
     fn = _COMPACT_JIT.get(cap)
     if fn is None:
         fn = jax.jit(lambda b, c=cap: slice_batch(b, 0, b.num_rows, c))

@@ -66,9 +66,12 @@ def _enc(v, depth: int = 0):
         if len(v) > _MAX_ITEMS:
             raise Uncachable("set too large")
         return ("set",) + tuple(sorted(_enc(x, depth + 1) for x in v))
+    from ..exec.sort import SortOrder
     from ..expr.core import Expression
+    from ..expr.window import WindowFrame, WindowSpec
     from .logical import LogicalPlan, SortField
-    if isinstance(v, (LogicalPlan, Expression, SortField)):
+    if isinstance(v, (LogicalPlan, Expression, SortField, SortOrder,
+                      WindowSpec, WindowFrame)):
         return _enc_node(v, depth + 1)
     raise Uncachable(f"unencodable {type(v).__name__}")
 

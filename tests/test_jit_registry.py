@@ -1,0 +1,87 @@
+"""Shared-kernel jit registry: wrapper identity, isolation, no pinning.
+
+The registry's contract (spark_rapids_tpu/jit_registry.py): structurally
+equal programs share ONE jax.jit wrapper process-wide; unequal or
+unencodable programs never alias; shared wrappers must not pin exec
+trees (scan batches) in memory.
+"""
+
+import gc
+import weakref
+
+import jax.numpy as jnp
+import pytest
+
+from spark_rapids_tpu import jit_registry
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.vector import (ColumnVector, ColumnarBatch,
+                                              live_mask)
+from spark_rapids_tpu.exec.basic import BatchScanExec, FilterExec, ProjectExec
+from spark_rapids_tpu.expr.core import col, lit
+
+
+def _scan(n=8, cap=8):
+    data = jnp.arange(cap, dtype=jnp.int64)
+    lm = live_mask(cap, n)
+    b = ColumnarBatch([ColumnVector(data, lm, dt.INT64)], ["x"], n)
+    return BatchScanExec([b], [("x", dt.INT64)])
+
+
+def test_equal_programs_share_one_wrapper():
+    p1 = ProjectExec(_scan(), [(col("x") + lit(1)).alias("y")])
+    p2 = ProjectExec(_scan(), [(col("x") + lit(1)).alias("y")])
+    assert p1._jit is p2._jit
+
+
+def test_different_programs_do_not_alias():
+    p1 = ProjectExec(_scan(), [(col("x") + lit(1)).alias("y")])
+    p2 = ProjectExec(_scan(), [(col("x") + lit(2)).alias("y")])
+    assert p1._jit is not p2._jit
+
+
+def test_filter_shares_on_equal_condition():
+    f1 = FilterExec(_scan(), col("x") > lit(3))
+    f2 = FilterExec(_scan(), col("x") > lit(3))
+    f3 = FilterExec(_scan(), col("x") > lit(4))
+    assert f1._jit is f2._jit
+    assert f1._jit is not f3._jit
+
+
+def test_shared_wrapper_does_not_pin_exec_tree():
+    scan = _scan()
+    ref = weakref.ref(scan)
+    p = ProjectExec(scan, [(col("x") + lit(100)).alias("y")])
+    del scan, p
+    gc.collect()
+    assert ref() is None, "registry must not keep the exec tree alive"
+
+
+def test_shared_wrapper_computes_correctly_for_second_instance():
+    # the wrapper registered by the FIRST instance serves the second;
+    # results must depend only on the (equal) expression tree
+    p1 = ProjectExec(_scan(), [(col("x") * lit(3)).alias("y")])
+    p2 = ProjectExec(_scan(), [(col("x") * lit(3)).alias("y")])
+    b = next(iter(p2.children[0]._batches))
+    out = p2._jit(b)
+    vals, mask = out.column("y").to_numpy(out.num_rows)
+    assert list(vals[:4]) == [0, 3, 6, 9]
+
+
+def test_uncachable_falls_back_to_private_jit():
+    class Opaque:  # _enc cannot encode this
+        pass
+
+    def builder(_o):
+        return lambda x: x + 1
+
+    before = jit_registry.stats()["uncached"]
+    f1 = jit_registry.shared_fn_jit(builder, Opaque())
+    f2 = jit_registry.shared_fn_jit(builder, Opaque())
+    assert f1 is not f2
+    assert jit_registry.stats()["uncached"] >= before + 2
+    assert int(f1(jnp.int32(1))) == 2
+
+
+def test_stats_shape():
+    s = jit_registry.stats()
+    assert set(s) >= {"hits", "misses", "uncached", "entries"}
