@@ -511,6 +511,18 @@ PALLAS_ENABLED = conf("srt.sql.pallas.enabled") \
          "on CPU (interpret mode) arithmetic stays float64-exact.") \
     .boolean(True)
 
+PALLAS_GROUPED_ENABLED = conf("srt.sql.pallas.groupedAgg.enabled") \
+    .doc("Execute eligible grouped aggregations (sum/avg over floats, "
+         "count) through the one-hot MXU pallas kernel "
+         "(ops/pallas_kernels.tile_group_reduce) when a batch resolves "
+         "to <= 1024 groups via the hash-claim prelude; larger key "
+         "domains and non-sum-decomposable aggregates keep the XLA "
+         "scatter path inside the same traced program. Active on TPU "
+         "(or with SRT_PALLAS_GROUPED_FORCE=1, the CPU interpret-mode "
+         "test lane). Float sums share srt.sql.pallas.enabled's "
+         "variableFloatAgg-class deviation on TPU.") \
+    .boolean(True)
+
 OPTIMIZER_ENABLED = conf("srt.sql.optimizer.enabled") \
     .doc("Cost-based optimizer: keep plans below the row threshold on "
          "the CPU engine where device compile/transfer overhead "

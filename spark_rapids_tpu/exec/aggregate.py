@@ -107,6 +107,7 @@ class HashAggregateExec(TpuExec):
         self._split_cache = {}
         from . import pallas_agg
         self._pallas_gate = pallas_agg.pallas_eligible(self)
+        self._pallas_grouped_gate = pallas_agg.grouped_eligible(self)
         self._pallas_cache = {}
 
     @property
@@ -136,10 +137,14 @@ class HashAggregateExec(TpuExec):
         return UnknownPartitioning(1)
 
     # --- phase 1: partial aggregation of one raw batch ---
-    def _update(self, batch: ColumnarBatch, row_offset) -> ColumnarBatch:
+    def _eval_update_inputs(self, batch: ColumnarBatch):
         key_cols = [e.eval(batch) for e in self.group_exprs]
         agg_in = [fn.children[0].eval(batch) if fn.children else None
                   for fn, _ in self.agg_exprs]
+        return key_cols, agg_in
+
+    def _update(self, batch: ColumnarBatch, row_offset) -> ColumnarBatch:
+        key_cols, agg_in = self._eval_update_inputs(batch)
         key_batch, states = K.group_aggregate(
             batch, key_cols, agg_in, [fn for fn, _ in self.agg_exprs],
             row_offset=row_offset)
@@ -209,16 +214,66 @@ class HashAggregateExec(TpuExec):
         names = [n for n, _ in self._result_schema]
         return ColumnarBatch(out_cols, names, num_groups)
 
+    # --- grouped pallas lane (one-hot MXU matmul partials) ---
+    def _update_pallas(self, batch: ColumnarBatch, row_offset):
+        """_update with the grouped pallas lane compiled in: the
+        <= 1024-group hash-claim fast case takes the one-hot MXU
+        kernel, everything else the stock scatter/sort path — one
+        traced program, lax.cond dispatch. Returns (packed, used)."""
+        key_cols, agg_in = self._eval_update_inputs(batch)
+        key_batch, states, used = K.group_aggregate_pallas(
+            batch, key_cols, agg_in, [fn for fn, _ in self.agg_exprs],
+            row_offset=row_offset)
+        return self._pack(key_batch, states, key_batch.num_rows,
+                          batch.capacity), used
+
+    def _grouped_pallas_fn(self, ctx: ExecContext):
+        """The jitted grouped-lane update, or None (gate miss, either
+        pallas conf off, wrong platform, or Mosaic warmup failure).
+        srt.sql.pallas.enabled is the master switch owning the
+        f32-tile deviation contract; groupedAgg.enabled scopes this
+        lane alone."""
+        from ..conf import PALLAS_ENABLED, PALLAS_GROUPED_ENABLED
+        from . import pallas_agg
+        if not self._pallas_grouped_gate \
+                or not ctx.conf.get(PALLAS_ENABLED) \
+                or not ctx.conf.get(PALLAS_GROUPED_ENABLED) \
+                or not pallas_agg.grouped_lane_on() \
+                or not pallas_agg.grouped_kernel_ok():
+            return None
+        fn = self._pallas_cache.get("grouped_update")
+        if fn is None:
+            agg_fields = ("group_exprs", "agg_exprs", "_key_names",
+                          "_state_schemas", "_result_schema",
+                          "_packed_schema")
+            fn = self._pallas_cache["grouped_update"] = shared_method_jit(
+                self, "_update_pallas", agg_fields)
+        return fn
+
     def _partial_stream(self, ctx: ExecContext, agg_time: Metric
                         ) -> Iterator[ColumnarBatch]:
         row_offset = 0
+        grouped_fn = self._grouped_pallas_fn(ctx)
+        used_flags: List = []
         for batch in self.children[0].execute(ctx):
             if int(batch.num_rows) == 0:
                 continue
             with ctx.semaphore, NvtxTimer(agg_time, "agg.update"):
-                partial = self._jit_update(batch, jnp.int64(row_offset))
+                if grouped_fn is not None:
+                    partial, used = grouped_fn(batch,
+                                               jnp.int64(row_offset))
+                    # no per-batch sync: flags settle with the stream
+                    used_flags.append(used)
+                else:
+                    partial = self._jit_update(batch,
+                                               jnp.int64(row_offset))
             row_offset += int(batch.num_rows)
             yield partial
+        if used_flags:
+            m = ctx.metrics_for(self.exec_id)
+            pb = m.setdefault("pallasBatches",
+                              Metric("pallasBatches", Metric.DEBUG))
+            pb.add(sum(int(u) for u in used_flags))
 
     def _merge_partition(self, ctx: ExecContext, partials,
                          agg_time: Metric) -> Iterator[ColumnarBatch]:

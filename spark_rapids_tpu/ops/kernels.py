@@ -405,6 +405,130 @@ def group_aggregate(batch: ColumnarBatch, key_cols: Sequence[Column],
         lambda _: body(_prelude_exact(batch, key_cols), False), None)
 
 
+def pallas_group_fns_ok(agg_inputs: Sequence[Optional[Column]],
+                        agg_fns: Sequence) -> bool:
+    """Static gate for the MXU one-hot grouped lane: sum-decomposable
+    aggregates only (the one-hot matmul is a segmented SUM), float
+    inputs for sum/avg (integer sums must stay exact int64 — the f32
+    tile arithmetic may drop low bits, the deviation the reference
+    ships behind variableFloatAgg for floats ONLY)."""
+    from ..expr import aggregates as Agg
+    lanes = 0
+    for inp, fn in zip(agg_inputs, agg_fns):
+        if isinstance(fn, (Agg.Sum, Agg.Average)):
+            if type(fn) not in (Agg.Sum, Agg.Average):
+                return False  # subclasses may widen state
+            if inp is None or inp.dtype not in (dt.FLOAT32, dt.FLOAT64) \
+                    or not isinstance(inp, ColumnVector):
+                return False
+            lanes += 2  # value + count
+        elif isinstance(fn, Agg.CountStar) and type(fn) is Agg.CountStar:
+            lanes += 1
+        elif isinstance(fn, Agg.Count) and type(fn) is Agg.Count:
+            if inp is None:
+                return False
+            lanes += 1
+        else:
+            return False
+    # one accumulator lane column per value column in the kernel —
+    # wider aggregations degrade to the XLA path, never crash
+    return lanes <= 128
+
+
+def group_aggregate_pallas(batch: ColumnarBatch, key_cols: Sequence[Column],
+                           agg_inputs: Sequence[Optional[Column]],
+                           agg_fns: Sequence, row_offset=0,
+                           num_buckets: int = 1024,
+                           interpret: Optional[bool] = None
+                           ) -> Tuple[ColumnarBatch, List[dict], jnp.ndarray]:
+    """Grouped update pass with the pallas one-hot MXU lane.
+
+    Same contract as :func:`group_aggregate` plus a traced ``used``
+    flag. When the hash-claim prelude resolves exactly AND the batch
+    has at most ``num_buckets`` groups, per-bucket partials come from
+    ``ops/pallas_kernels.tile_group_reduce`` (a (tile, B) one-hot
+    contracted on the MXU — no scatters); otherwise the stock
+    scatter/sort path runs inside the same ``lax.cond``. Mirrors the
+    reference's device hash groupby being THE aggregate path
+    (GpuAggregateExec.scala:175) rather than a special case.
+
+    Callers gate with :func:`pallas_group_fns_ok` — this function
+    assumes every aggregate is sum-decomposable.
+    """
+    cap = batch.capacity
+
+    def stock(prelude, fast: bool):
+        perm, live_s, gid, num_groups, key_batch = prelude
+        states = []
+        for inp, fn in zip(agg_inputs, agg_fns):
+            if inp is None:
+                col_s = None
+            elif fast:
+                col_s = inp
+            else:
+                col_s = _gather_rows(inp, perm, live_s)
+            states.append(fn.update(gid, col_s, cap, live_s,
+                                    row_offset=row_offset,
+                                    perm=None if fast else perm))
+        return key_batch, states
+
+    if not (_use_hash_grouping(batch, key_cols, agg_fns)
+            and cap >= num_buckets
+            and pallas_group_fns_ok(agg_inputs, agg_fns)):
+        kb, st = group_aggregate(batch, key_cols, agg_inputs, agg_fns,
+                                 row_offset)
+        return kb, st, jnp.bool_(False)
+
+    from ..expr import aggregates as Agg
+    ok, fast_prelude = _prelude_fast(batch, key_cols)
+    _, live, gid, num_groups, key_batch = fast_prelude
+    small = ok & (num_groups <= num_buckets)
+
+    def pallas_branch(_):
+        from . import pallas_kernels as PKn
+        # dead rows already land on the scratch gid (== num_groups,
+        # itself < num_buckets when this branch is taken) so their
+        # zeroed values accumulate into a never-live bucket
+        gid_c = jnp.minimum(gid, num_buckets - 1)
+        values = []
+        for inp, fn in zip(agg_inputs, agg_fns):
+            if isinstance(fn, (Agg.Sum, Agg.Average)):
+                m = live & inp.validity
+                values.append(jnp.where(m, inp.data, jnp.zeros((), inp.data.dtype)))
+                values.append(m.astype(jnp.float32))
+            elif isinstance(fn, Agg.CountStar):
+                values.append(live.astype(jnp.float32))
+            else:  # Count
+                values.append((live & inp.validity).astype(jnp.float32))
+        outs = PKn.tile_group_reduce(gid_c, values,
+                                     num_buckets=num_buckets,
+                                     interpret=interpret)
+        pad = cap - num_buckets
+
+        def to_cap(arr, dtype):
+            a = arr.astype(dtype)
+            return a if pad == 0 else jnp.pad(a, (0, pad))
+        states = []
+        i = 0
+        for inp, fn in zip(agg_inputs, agg_fns):
+            if isinstance(fn, (Agg.Sum, Agg.Average)):
+                states.append({"sum": to_cap(outs[i], jnp.float64),
+                               "count": to_cap(outs[i + 1], jnp.int64)})
+                i += 2
+            else:
+                states.append({"count": to_cap(outs[i], jnp.int64)})
+                i += 1
+        return key_batch, states
+
+    def fallback(_):
+        return jax.lax.cond(
+            ok, lambda __: stock(fast_prelude, True),
+            lambda __: stock(_prelude_exact(batch, key_cols), False), None)
+
+    kb, st = jax.lax.cond(small, pallas_branch, fallback, None)
+    return kb, st, small
+
+
 def group_merge(batch: ColumnarBatch, key_cols: Sequence[Column],
                 agg_states: Sequence[dict], agg_fns: Sequence
                 ) -> Tuple[ColumnarBatch, List[dict], jnp.ndarray]:

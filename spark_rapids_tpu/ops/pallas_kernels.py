@@ -187,9 +187,14 @@ def tile_group_reduce(gid: jax.Array, values: Sequence[jax.Array],
     # cast OUTSIDE the kernel: Mosaic cannot lower the emulated
     # f64->f32 (or i64->i32) convert inside a TPU kernel body — it
     # recurses in _convert_element_type_lowering_rule; XLA handles the
-    # emulated conversion fine in the surrounding program
+    # emulated conversion fine in the surrounding program.
+    # Interpret mode (the CPU differential lane) keeps float64 lanes so
+    # exact Spark semantics are testable — same contract as tile_reduce.
+    lane_t = jnp.float32
+    if interpret and jax.config.jax_enable_x64:
+        lane_t = jnp.float64
     gid = gid.astype(jnp.int32)
-    values = [v.astype(jnp.float32) for v in values]
+    values = [v.astype(lane_t) for v in values]
     n = gid.shape[0]
     tiles = max(1, -(-n // tile_rows))
     padded = tiles * tile_rows
@@ -204,19 +209,19 @@ def tile_group_reduce(gid: jax.Array, values: Sequence[jax.Array],
         # (tile_rows, B) one-hot on the fly; MXU contracts over rows
         oh = (g[:, None] ==
               jax.lax.broadcasted_iota(jnp.int32, (1, num_buckets), 1)
-              ).astype(jnp.float32)
+              ).astype(lane_t)
         vmat = jnp.stack(
-            [v[...].astype(jnp.float32) for v in val_refs], axis=1)
+            [v[...].astype(lane_t) for v in val_refs], axis=1)
         if nv < 128:
-            vmat = jax.lax.pad(vmat, jnp.float32(0),
+            vmat = jax.lax.pad(vmat, lane_t(0),
                                ((0, 0, 0), (0, 128 - nv, 0)))
         out_ref[...] = jax.lax.dot_general(
             oh, vmat, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)   # (B, 128)
+            preferred_element_type=lane_t)   # (B, 128)
 
     tile_call = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((num_buckets, 128), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((num_buckets, 128), lane_t),
         interpret=interpret,
     )
     acc_t = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
