@@ -101,9 +101,19 @@ class HashAggregateExec(TpuExec):
                 self._packed_schema.append((_state_col_name(i, sname), stype))
         agg_fields = ("group_exprs", "agg_exprs", "_key_names",
                       "_state_schemas", "_result_schema", "_packed_schema")
-        self._jit_update = shared_method_jit(self, "_update", agg_fields)
-        self._jit_merge = shared_method_jit(self, "_merge_finalize",
-                                            agg_fields)
+        from ..expr.misc import contains_eager
+        self._eager = contains_eager(
+            list(self.group_exprs) + [fn for fn, _ in self.agg_exprs])
+        if self._eager:
+            # ANSI guards / eager nodes inside keys or aggregate inputs
+            # need un-jitted evaluation to raise
+            self._jit_update = self._update
+            self._jit_merge = self._merge_finalize
+        else:
+            self._jit_update = shared_method_jit(self, "_update",
+                                                 agg_fields)
+            self._jit_merge = shared_method_jit(self, "_merge_finalize",
+                                                agg_fields)
         self._split_cache = {}
         from . import pallas_agg
         self._pallas_gate = pallas_agg.pallas_eligible(self)
@@ -235,7 +245,7 @@ class HashAggregateExec(TpuExec):
         lane alone."""
         from ..conf import PALLAS_ENABLED, PALLAS_GROUPED_ENABLED
         from . import pallas_agg
-        if not self._pallas_grouped_gate \
+        if self._eager or not self._pallas_grouped_gate \
                 or not ctx.conf.get(PALLAS_ENABLED) \
                 or not ctx.conf.get(PALLAS_GROUPED_ENABLED) \
                 or not pallas_agg.grouped_lane_on() \

@@ -104,7 +104,11 @@ class FilterExec(TpuExec):
     def __init__(self, child: TpuExec, condition: Expression):
         super().__init__(child)
         self.condition = condition
-        self._jit = shared_method_jit(self, "_filter", ("condition",))
+        from ..expr.misc import contains_eager
+        # eager conditions (ANSI guards, raise_error) must evaluate
+        # outside jit so data-dependent raises reach the caller
+        self._jit = self._filter if contains_eager([condition]) \
+            else shared_method_jit(self, "_filter", ("condition",))
 
     def _filter(self, batch: ColumnarBatch) -> ColumnarBatch:
         cond = self.condition.eval(batch)
@@ -197,8 +201,11 @@ class ExpandExec(TpuExec):
                 if p[i].data_type(in_schema) != t:
                     p[i] = Cast(p[i], t)
         self._schema = list(zip(names, unified))
-        self._jits = [shared_fn_jit(_expand_project_builder, p, list(names))
-                      for p in self.projections]
+        from ..expr.misc import contains_eager
+        self._jits = [
+            _expand_project_builder(p, list(names)) if contains_eager(p)
+            else shared_fn_jit(_expand_project_builder, p, list(names))
+            for p in self.projections]
 
     @property
     def output_schema(self) -> Schema:

@@ -172,12 +172,20 @@ class ShuffleExchangeExec(TpuExec):
         reuses one traced fn."""
         key = (num_parts, bounds is not None)
         if key not in self._jit_cache:
+            from ..expr.misc import contains_eager
+            eager = contains_eager(
+                list(self.key_exprs)
+                + [o.expr for o in self.sort_orders])
             if self.sort_orders:
-                self._jit_cache[key] = shared_fn_jit(
-                    _range_partition_builder, self.sort_orders, num_parts)
+                self._jit_cache[key] = _range_partition_builder(
+                    self.sort_orders, num_parts) if eager else \
+                    shared_fn_jit(_range_partition_builder,
+                                  self.sort_orders, num_parts)
             elif self.key_exprs:
-                self._jit_cache[key] = shared_fn_jit(
-                    _hash_partition_builder, self.key_exprs, num_parts)
+                self._jit_cache[key] = _hash_partition_builder(
+                    self.key_exprs, num_parts) if eager else \
+                    shared_fn_jit(_hash_partition_builder,
+                                  self.key_exprs, num_parts)
             else:
                 self._jit_cache[key] = shared_fn_jit(
                     _rr_partition_builder, num_parts)

@@ -53,9 +53,15 @@ class GenerateExec(TpuExec):
 
     def _fn(self, out_cap: int):
         if out_cap not in self._jit_cache:
-            self._jit_cache[out_cap] = shared_fn_jit(
-                _explode_builder, self.generator, self.element_name,
-                self.pos_name, out_cap)
+            from ..expr.misc import contains_eager
+            if contains_eager([self.generator]):
+                self._jit_cache[out_cap] = _explode_builder(
+                    self.generator, self.element_name, self.pos_name,
+                    out_cap)
+            else:
+                self._jit_cache[out_cap] = shared_fn_jit(
+                    _explode_builder, self.generator, self.element_name,
+                    self.pos_name, out_cap)
         return self._jit_cache[out_cap]
 
     def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:

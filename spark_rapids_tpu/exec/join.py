@@ -154,14 +154,26 @@ class _HashJoinBase(TpuExec):
     def _key_cols(self, batch: ColumnarBatch, exprs):
         return [e.eval(batch) for e in exprs]
 
+    def _eager_keys(self) -> bool:
+        from ..expr.misc import contains_eager
+        return contains_eager(list(self._probe_key_exprs)
+                              + list(self._build_key_exprs))
+
     def _join_fn(self, out_capacity: int):
         """jit per output capacity; cached per instance, shared
-        process-wide (registry) across joins with equal keys/type."""
+        process-wide (registry) across joins with equal keys/type.
+        Eager keys (ANSI guards) evaluate un-jitted."""
         key = out_capacity
         if key not in self._jit_cache:
-            self._jit_cache[key] = shared_fn_jit(
-                _join_run_builder, self.join_type, self._probe_key_exprs,
-                self._build_key_exprs, out_capacity)
+            if self._eager_keys():
+                self._jit_cache[key] = _join_run_builder(
+                    self.join_type, self._probe_key_exprs,
+                    self._build_key_exprs, out_capacity)
+            else:
+                self._jit_cache[key] = shared_fn_jit(
+                    _join_run_builder, self.join_type,
+                    self._probe_key_exprs, self._build_key_exprs,
+                    out_capacity)
         return self._jit_cache[key]
 
     @property
@@ -256,8 +268,13 @@ class _HashJoinBase(TpuExec):
         if key not in self._jit_cache:
             exprs = self._probe_key_exprs if side == "probe" \
                 else self._build_key_exprs
-            self._jit_cache[key] = shared_fn_jit(
-                _bucket_split_builder, exprs, num_parts)
+            from ..expr.misc import contains_eager
+            if contains_eager(exprs):
+                self._jit_cache[key] = _bucket_split_builder(exprs,
+                                                             num_parts)
+            else:
+                self._jit_cache[key] = shared_fn_jit(
+                    _bucket_split_builder, exprs, num_parts)
         return self._jit_cache[key]
 
     def _repack(self, ctx: ExecContext, batch: ColumnarBatch
@@ -392,16 +409,20 @@ class _HashJoinBase(TpuExec):
         min_rows = ctx.conf.get(JOIN_BLOOM_MIN_PROBE_ROWS)
         num_bits = B.choose_num_bits(
             int(build.num_rows), ctx.conf.get(JOIN_BLOOM_BITS_PER_KEY))
+        eager = self._eager_keys()
         bkey = ("bloom_build", num_bits)
         if bkey not in self._jit_cache:
-            self._jit_cache[bkey] = shared_fn_jit(
-                _bloom_build_builder, self._build_key_exprs, num_bits)
+            self._jit_cache[bkey] = _bloom_build_builder(
+                self._build_key_exprs, num_bits) if eager else \
+                shared_fn_jit(_bloom_build_builder,
+                              self._build_key_exprs, num_bits)
         with ctx.semaphore:
             bits = self._jit_cache[bkey](build)
         pkey = ("bloom_probe", num_bits)
         if pkey not in self._jit_cache:
-            self._jit_cache[pkey] = shared_fn_jit(
-                _bloom_probe_builder, self._probe_key_exprs)
+            self._jit_cache[pkey] = _bloom_probe_builder(
+                self._probe_key_exprs) if eager else \
+                shared_fn_jit(_bloom_probe_builder, self._probe_key_exprs)
         m = ctx.metrics_for(self.exec_id)
         dropped = m.setdefault("bloomFilteredRows",
                                Metric("bloomFilteredRows", Metric.DEBUG))

@@ -100,8 +100,14 @@ class BroadcastNestedLoopJoinExec(TpuExec):
     def _tile_fn(self, tile_cap: int, probe_cap: int):
         key = (tile_cap, probe_cap)
         if key not in self._jit_cache:
-            self._jit_cache[key] = shared_fn_jit(
-                _tile_run_builder, self.condition, tile_cap)
+            from ..expr.misc import contains_eager
+            if self.condition is not None and \
+                    contains_eager([self.condition]):
+                self._jit_cache[key] = _tile_run_builder(self.condition,
+                                                         tile_cap)
+            else:
+                self._jit_cache[key] = shared_fn_jit(
+                    _tile_run_builder, self.condition, tile_cap)
         return self._jit_cache[key]
 
     def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:

@@ -65,7 +65,11 @@ class SortExec(TpuExec):
         super().__init__(child)
         self.order = list(order)
         self.global_sort = global_sort
-        self._jit_sort = shared_method_jit(self, "_sort_one", ("order",))
+        from ..expr.misc import contains_eager
+        # eager sort keys (ANSI guards) evaluate outside jit
+        self._jit_sort = self._sort_one \
+            if contains_eager([o.expr for o in self.order]) \
+            else shared_method_jit(self, "_sort_one", ("order",))
 
     def _sort_one(self, batch: ColumnarBatch) -> ColumnarBatch:
         key_cols = [o.expr.eval(batch) for o in self.order]
@@ -308,8 +312,12 @@ class SortExec(TpuExec):
         prefix of the sorted batch; range_partition_ids shares the sort
         comparator exactly, so 'strictly after bound' == unsafe)."""
         if not hasattr(self, "_safe_prefix_fn"):
-            self._safe_prefix_fn = shared_fn_jit(
-                _safe_prefix_builder, self.order)
+            from ..expr.misc import contains_eager
+            if contains_eager([o.expr for o in self.order]):
+                self._safe_prefix_fn = _safe_prefix_builder(self.order)
+            else:
+                self._safe_prefix_fn = shared_fn_jit(
+                    _safe_prefix_builder, self.order)
         return self._safe_prefix_fn(merged, bound)
 
     def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
@@ -342,8 +350,10 @@ class TopNExec(TpuExec):
         super().__init__(child)
         self.order = list(order)
         self.limit = limit
-        self._jit_topn = shared_method_jit(self, "_topn",
-                                           ("order", "limit"))
+        from ..expr.misc import contains_eager
+        self._jit_topn = self._topn \
+            if contains_eager([o.expr for o in self.order]) \
+            else shared_method_jit(self, "_topn", ("order", "limit"))
         shrink_cap = choose_capacity(self.limit)
         self._jit_shrink = lambda b: K.repack_to(b, shrink_cap)
 

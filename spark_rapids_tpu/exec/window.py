@@ -191,9 +191,13 @@ class WindowExec(TpuExec):
         self._schema = list(in_schema) + [
             (name, we.data_type(in_schema))
             for we, name in self.window_exprs]
-        self._jit = shared_method_jit(
-            self, "_compute",
-            ("window_exprs", "partition_by", "order_by", "_schema"))
+        from ..expr.misc import contains_eager
+        self._jit = self._compute if contains_eager(
+            [we for we, _ in self.window_exprs] + list(self.partition_by)
+            + [o.expr for o in self.order_by]) \
+            else shared_method_jit(
+                self, "_compute",
+                ("window_exprs", "partition_by", "order_by", "_schema"))
 
     @property
     def output_schema(self) -> Schema:
@@ -588,10 +592,14 @@ class BatchedRunningWindowExec(TpuExec):
             (name, we.data_type(in_schema))
             for we, name in self.window_exprs]
         self._in_schema = in_schema
-        self._jit = shared_method_jit(
-            self, "_compute",
-            ("window_exprs", "partition_by", "order_by", "_schema",
-             "_in_schema"))
+        from ..expr.misc import contains_eager
+        self._jit = self._compute if contains_eager(
+            [we for we, _ in self.window_exprs] + list(self.partition_by)
+            + [o.expr for o in self.order_by]) \
+            else shared_method_jit(
+                self, "_compute",
+                ("window_exprs", "partition_by", "order_by", "_schema",
+                 "_in_schema"))
 
     @property
     def output_schema(self) -> Schema:

@@ -65,6 +65,8 @@ class AggregateFunction(Expression):
     #: the group kernel then skips the sort-free hash-claim fast path
     #: (ops/kernels.py _prelude_fast) and uses the exact sort.
     needs_sorted_groups = False
+    #: ANSI mode flag (expr/ansi.enable_ansi); consumed by Sum/Average
+    ansi = False
 
     def data_type(self, schema: Schema) -> dt.DType:
         raise NotImplementedError
@@ -140,7 +142,15 @@ class _Decimal128SumMixin:
 class Sum(AggregateFunction, _Decimal128SumMixin):
     """Spark sum: long for integrals, double for floats, decimal widened
     to p+10 (two-limb accumulator when that exceeds long-backed range);
-    empty/all-null group -> null; decimal overflow -> null (non-ANSI)."""
+    empty/all-null group -> null; decimal overflow -> null (non-ANSI).
+
+    ANSI mode (``ansi=True``, set by expr/ansi.enable_ansi): a long-sum
+    wrap or decimal-sum overflow raises SparkArithmeticException.
+    Wrap detection carries a float64 shadow sum — a wrapped int64 sum
+    differs from its float64 shadow by ~k*2^64, far beyond the shadow's
+    rounding error, so ``|approx - sum| > 2^62`` is decisive. The exec
+    runs eagerly under ANSI (exec/aggregate.py), so finalize may raise.
+    """
 
     name = "sum"
 
@@ -152,11 +162,18 @@ class Sum(AggregateFunction, _Decimal128SumMixin):
             return dt.INT64
         return dt.FLOAT64
 
+    def _ansi_int(self, out_t) -> bool:
+        return self.ansi and not isinstance(out_t, dt.DecimalType) \
+            and out_t.is_integral
+
     def state_schema(self, schema: Schema) -> List:
         out_t = self.data_type(schema)
         if isinstance(out_t, dt.DecimalType) and out_t.is_wide:
             return [("sum_hi", dt.INT64), ("sum_lo", dt.INT64),
                     ("approx", dt.FLOAT64), ("count", dt.INT64)]
+        if self._ansi_int(out_t):
+            return [("sum", out_t), ("count", dt.INT64),
+                    ("approx", dt.FLOAT64)]
         return [("sum", out_t), ("count", dt.INT64)]
 
     def update(self, gid, col: Column, num_groups: int, live,
@@ -168,6 +185,10 @@ class Sum(AggregateFunction, _Decimal128SumMixin):
         vals = jnp.where(col.validity, col.data.astype(phys), jnp.zeros((), phys))
         s = _seg_sum(vals, gid, num_groups)
         n = _seg_sum(col.validity.astype(jnp.int64), gid, num_groups)
+        if self._ansi_int(out_t):
+            return {"sum": s, "count": n,
+                    "approx": _seg_sum(vals.astype(jnp.float64), gid,
+                                       num_groups, jnp.float64)}
         return {"sum": s, "count": n}
 
     def _out_t(self, col: Column) -> dt.DType:
@@ -181,8 +202,12 @@ class Sum(AggregateFunction, _Decimal128SumMixin):
     def merge(self, gid, states: State, num_groups: int) -> State:
         if "sum_hi" in states:
             return self._dec_merge(gid, states, num_groups)
-        return {"sum": _seg_sum(states["sum"], gid, num_groups),
-                "count": _seg_sum(states["count"], gid, num_groups)}
+        out = {"sum": _seg_sum(states["sum"], gid, num_groups),
+               "count": _seg_sum(states["count"], gid, num_groups)}
+        if "approx" in states:
+            out["approx"] = _seg_sum(states["approx"], gid, num_groups,
+                                     jnp.float64)
+        return out
 
     def finalize(self, states: State) -> tuple:
         if "sum_hi" in states:
@@ -190,7 +215,19 @@ class Sum(AggregateFunction, _Decimal128SumMixin):
             lo = states["sum_lo"].astype(jnp.uint64)
             ok = (states["count"] > 0) & \
                 (jnp.abs(states["approx"]) < _WRAP_GUARD)
+            if self.ansi:
+                from . import errors as ERR
+                from .ansi import guard
+                guard((states["count"] > 0) & ~ok,
+                      ERR.SparkArithmeticException("Decimal sum overflow"))
             return (hi, lo), ok
+        if self.ansi and "approx" in states:
+            from . import errors as ERR
+            from .ansi import guard
+            diff = jnp.abs(states["approx"] -
+                           states["sum"].astype(jnp.float64))
+            guard((states["count"] > 0) & (diff > float(2 ** 62)),
+                  ERR.SparkArithmeticException(ERR.overflow_message("long")))
         return states["sum"], states["count"] > 0
 
 
@@ -435,8 +472,14 @@ class Average(AggregateFunction, _Decimal128SumMixin):
             # cached by state_schema, which the exec always calls first
             qh, ql, ovf = d128.d128_div_exact(hi, lo, nh, nl,
                                               self._avg_up)
+            had = ok
             ok = ok & ~ovf & (jnp.abs(states["approx"]) < _WRAP_GUARD) & \
                 d128.d128_fits_precision(hi, lo, self._sum_prec)
+            if self.ansi:
+                from . import errors as ERR
+                from .ansi import guard
+                guard(had & ~ok, ERR.SparkArithmeticException(
+                    "Decimal average overflow"))
             return (qh, ql), ok
         return states["sum"] / jnp.where(ok, n, 1).astype(jnp.float64), ok
 

@@ -1,0 +1,88 @@
+"""ANSI-mode plan rewrite + runtime guard helpers.
+
+``srt.sql.ansi.enabled`` flows planner -> expression tree here:
+``enable_ansi`` deep-clones an expression tree setting ``ansi=True`` on
+every node that owns an ANSI lane (Cast, binary/unary arithmetic,
+sum aggregates). An ansi-marked tree is EAGER (expr/misc.contains_eager
+— operators evaluate it outside jit), so data-dependent Python raises
+are legal: the guards below host-sync a traced error mask and raise the
+Spark error types. This trades jit fusion for exact error semantics,
+the same trade the reference makes by inserting device-side check
+kernels per ANSI op (GpuOverrides.scala:1113-1122: AnsiAdd/Subtract...
+wrap each arithmetic op with an overflow-check kernel launch).
+"""
+
+from __future__ import annotations
+
+import copy
+
+import jax
+import jax.numpy as jnp
+
+from . import errors as ERR
+
+
+def _owns_ansi_lane(expr) -> bool:
+    from .aggregates import Average, Sum
+    from .arithmetic import Abs, BinaryArithmetic, UnaryMinus
+    from .cast import Cast
+    return isinstance(expr, (Cast, BinaryArithmetic, UnaryMinus, Abs,
+                             Sum, Average))
+
+
+def enable_ansi(expr):
+    """Deep-cloned tree with ``ansi=True`` on every supported node."""
+    clone = copy.copy(expr)
+    clone.children = [enable_ansi(c) for c in expr.children]
+    if _owns_ansi_lane(clone):
+        clone.ansi = True
+    return clone
+
+
+def rewrite_plan(plan):
+    """Clone a LOGICAL plan with every embedded expression ansi-marked.
+
+    Generic over node fields: any Expression (or list/tuple of, or
+    SortField-like holding .expr) found in ``vars(node)`` is rewritten;
+    children recurse. Unknown containers are left alone — a field the
+    walk misses simply keeps non-ANSI (null/wrap) semantics rather than
+    corrupting the plan.
+    """
+    from .core import Expression
+
+    def rw_val(v):
+        if isinstance(v, Expression):
+            return enable_ansi(v)
+        if isinstance(v, list):
+            return [rw_val(x) for x in v]
+        if isinstance(v, tuple):
+            return tuple(rw_val(x) for x in v)
+        if hasattr(v, "expr") and isinstance(getattr(v, "expr", None),
+                                             Expression):
+            c = copy.copy(v)
+            c.expr = enable_ansi(v.expr)
+            return c
+        return v
+
+    node = copy.copy(plan)
+    for k, v in vars(plan).items():
+        if k == "children":
+            continue
+        setattr(node, k, rw_val(v))
+    node.children = [rewrite_plan(c) for c in getattr(plan, "children", ())]
+    return node
+
+
+def guard(mask, exc: Exception) -> None:
+    """Raise ``exc`` if any lane of ``mask`` is set.
+
+    Must run OUTSIDE jit (ansi trees are eager); tracing through here
+    is a wiring bug, failed loudly rather than silently dropping the
+    check.
+    """
+    if isinstance(mask, jax.core.Tracer):
+        raise AssertionError(
+            "ANSI guard reached under trace — ansi expression was "
+            "jitted; the operator must take the eager path")
+    if bool(jnp.any(mask)):
+        raise exc

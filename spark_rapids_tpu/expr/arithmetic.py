@@ -105,6 +105,11 @@ def decimal_coerced_children(expr: Expression, schema: Schema):
 
 class BinaryArithmetic(Expression):
     op_name = "?"
+    #: ANSI mode (spark.sql.ansi.enabled): set by expr/ansi.enable_ansi
+    #: at plan time; marked trees evaluate eagerly so the guards below
+    #: can raise (reference: GpuOverrides.scala:1113-1122 wraps each op
+    #: in an overflow-check kernel under ansiEnabled)
+    ansi = False
 
     def coerced_children(self, schema: Schema):
         """The children this op ACTUALLY computes on, after implicit
@@ -139,12 +144,35 @@ class BinaryArithmetic(Expression):
         validity = merged_validity(left, right)
         if isinstance(out_t, dt.DecimalType) or \
                 isinstance(left.dtype, dt.DecimalType):
-            return self._eval_decimal(left, right, out_t, validity)
+            res = self._eval_decimal(left, right, out_t, validity)
+            if self.ansi:
+                from . import errors as ERR
+                from .ansi import guard
+                guard(validity & ~res.validity, ERR.SparkArithmeticException(
+                    f"{self.op_name}: decimal overflow or division by "
+                    f"zero (ANSI mode)"))
+            return res
         phys = out_t.physical
         a = left.data.astype(phys)
         b = right.data.astype(phys)
-        data, validity = self._compute(a, b, validity, out_t)
-        return make_result(data, validity, out_t)
+        data, validity2 = self._compute(a, b, validity, out_t)
+        if self.ansi:
+            self._ansi_post(a, b, data, validity, validity2, out_t)
+        return make_result(data, validity2, out_t)
+
+    def _ansi_post(self, a, b, data, validity, validity2, out_t) -> None:
+        """Default ANSI check: any null INTRODUCED by the op (x/0,
+        x % 0) is an error instead of a null."""
+        from . import errors as ERR
+        from .ansi import guard
+        guard(validity & ~validity2,
+              ERR.SparkArithmeticException(ERR.DIVIDE_BY_ZERO))
+
+    def _ansi_int_overflow(self, ovf, validity, out_t) -> None:
+        from . import errors as ERR
+        from .ansi import guard
+        guard(ovf & validity, ERR.SparkArithmeticException(
+            ERR.overflow_message(str(out_t))))
 
     def _compute(self, a, b, validity, out_t):
         raise NotImplementedError
@@ -188,6 +216,12 @@ class Add(_AddSubBase):
     def _compute(self, a, b, validity, out_t):
         return a + b, validity
 
+    def _ansi_post(self, a, b, data, validity, validity2, out_t):
+        if out_t.is_integral:
+            # Math.addExact: same operand signs, flipped result sign
+            ovf = ((a >= 0) == (b >= 0)) & ((data >= 0) != (a >= 0))
+            self._ansi_int_overflow(ovf, validity, out_t)
+
     def _decimal_type(self, lt, rt):
         return _decimal_result("add", lt, rt)
 
@@ -199,6 +233,12 @@ class Subtract(_AddSubBase):
     def _compute(self, a, b, validity, out_t):
         return a - b, validity
 
+    def _ansi_post(self, a, b, data, validity, validity2, out_t):
+        if out_t.is_integral:
+            # Math.subtractExact: differing signs, result sign != a's
+            ovf = ((a >= 0) != (b >= 0)) & ((data >= 0) != (a >= 0))
+            self._ansi_int_overflow(ovf, validity, out_t)
+
     def _decimal_type(self, lt, rt):
         return _decimal_result("sub", lt, rt)
 
@@ -208,6 +248,18 @@ class Multiply(BinaryArithmetic):
 
     def _compute(self, a, b, validity, out_t):
         return a * b, validity
+
+    def _ansi_post(self, a, b, data, validity, validity2, out_t):
+        if out_t.is_integral:
+            # Math.multiplyExact: wrapped product fails the division
+            # round-trip; MIN * -1 wraps back to MIN and needs the
+            # explicit corner check
+            lo = jnp.iinfo(out_t.physical).min
+            nz = b != 0
+            safe_b = jnp.where(nz, b, jnp.ones((), b.dtype))
+            ovf = nz & (_trunc_div(data, safe_b) != a)
+            ovf = ovf | ((a == lo) & (b == -1)) | ((b == lo) & (a == -1))
+            self._ansi_int_overflow(ovf, validity, out_t)
 
     def _decimal_type(self, lt, rt):
         return _decimal_result("mul", lt, rt)
@@ -241,9 +293,20 @@ class Divide(BinaryArithmetic):
         out_t = self._out_type(left.dtype, right.dtype)
         validity = merged_validity(left, right)
         if isinstance(out_t, dt.DecimalType):
-            return self._eval_decimal(left, right, out_t, validity)
+            res = self._eval_decimal(left, right, out_t, validity)
+            if self.ansi:
+                from . import errors as ERR
+                from .ansi import guard
+                guard(validity & ~res.validity, ERR.SparkArithmeticException(
+                    "/: decimal overflow or division by zero (ANSI mode)"))
+            return res
         a = left.data.astype(jnp.float64)
         b = right.data.astype(jnp.float64)
+        if self.ansi:
+            from . import errors as ERR
+            from .ansi import guard
+            guard(validity & (b == 0.0),
+                  ERR.SparkArithmeticException(ERR.DIVIDE_BY_ZERO))
         validity = validity & (b != 0.0)
         data = jnp.where(b != 0.0, a / jnp.where(b == 0.0, 1.0, b), 0.0)
         return make_result(data, validity, dt.FLOAT64)
@@ -320,6 +383,13 @@ class IntegralDivide(BinaryArithmetic):
         q = jnp.trunc(a.astype(jnp.float64) / safe_b.astype(jnp.float64)) \
             if jnp.issubdtype(a.dtype, jnp.floating) else _trunc_div(a, safe_b)
         return q.astype(jnp.int64), validity
+
+    def _ansi_post(self, a, b, data, validity, validity2, out_t):
+        super()._ansi_post(a, b, data, validity, validity2, out_t)
+        if not jnp.issubdtype(a.dtype, jnp.floating):
+            lo = jnp.iinfo(jnp.int64).min
+            ovf = (a.astype(jnp.int64) == lo) & (b.astype(jnp.int64) == -1)
+            self._ansi_int_overflow(ovf, validity, dt.INT64)
 
 
 def _trunc_div(a, b):
@@ -402,6 +472,8 @@ class Pmod(BinaryArithmetic):
 
 
 class UnaryMinus(Expression):
+    ansi = False
+
     def data_type(self, schema: Schema) -> dt.DType:
         return self.children[0].data_type(schema)
 
@@ -410,6 +482,13 @@ class UnaryMinus(Expression):
         if isinstance(c, Decimal128Column):
             nh, nl = d128.d128_neg(c.hi, c.lo)
             return d128.build_decimal_column(nh, nl, c.validity, c.dtype)
+        if self.ansi and c.dtype.is_integral:
+            from . import errors as ERR
+            from .ansi import guard
+            lo = jnp.iinfo(c.dtype.physical).min
+            guard(c.validity & (c.data == lo),
+                  ERR.SparkArithmeticException(
+                      ERR.overflow_message(str(c.dtype))))
         return make_result(-c.data, c.validity, c.dtype)
 
 
@@ -422,6 +501,8 @@ class UnaryPositive(Expression):
 
 
 class Abs(Expression):
+    ansi = False
+
     def data_type(self, schema: Schema) -> dt.DType:
         return self.children[0].data_type(schema)
 
@@ -430,6 +511,13 @@ class Abs(Expression):
         if isinstance(c, Decimal128Column):
             ah, al = d128.d128_abs(c.hi, c.lo)
             return d128.build_decimal_column(ah, al, c.validity, c.dtype)
+        if self.ansi and c.dtype.is_integral:
+            from . import errors as ERR
+            from .ansi import guard
+            lo = jnp.iinfo(c.dtype.physical).min
+            guard(c.validity & (c.data == lo),
+                  ERR.SparkArithmeticException(
+                      ERR.overflow_message(str(c.dtype))))
         return make_result(jnp.abs(c.data), c.validity, c.dtype)
 
 

@@ -74,7 +74,45 @@ class Cast(Expression):
 
     def eval(self, batch: ColumnarBatch) -> Column:
         c = self.children[0].eval(batch)
-        return cast_column(c, self.to)
+        res = cast_column(c, self.to)
+        if self.ansi:
+            self._ansi_check(c, res)
+        return res
+
+    def _ansi_check(self, src: Column, res: Column) -> None:
+        """ANSI cast errors (GpuCast.scala:212-252 ansiMode): invalid
+        string parses and overflowing numeric casts raise instead of
+        producing null / wrapping. Runs eagerly (expr/ansi.py guard)."""
+        from . import errors as ERR
+        from .ansi import guard
+        to = self.to
+        if isinstance(src.dtype, dt.StringType):
+            exc_t = ERR.SparkDateTimeException if isinstance(
+                to, (dt.DateType, dt.TimestampType)) \
+                else ERR.SparkNumberFormatException
+            guard(src.validity & ~res.validity,
+                  exc_t(f"invalid input syntax for type {to} (ANSI "
+                        f"mode cast)"))
+            return
+        # null-on-overflow lanes (decimal rescale, etc.)
+        guard(src.validity & ~res.validity, ERR.SparkCastOverflowException(
+            f"cast to {to} causes overflow (ANSI mode)"))
+        # silent wrap/saturate lanes: range-check the SOURCE values
+        if getattr(to, "is_integral", False) and hasattr(src, "data") \
+                and getattr(src.dtype, "is_numeric", False) \
+                and not isinstance(src.dtype, dt.DecimalType):
+            info = jnp.iinfo(to.physical)
+            x = src.data
+            if jnp.issubdtype(x.dtype, jnp.floating):
+                bad = jnp.isnan(x) | (x < float(info.min)) | \
+                    (x >= float(info.max) + 1.0)
+            elif x.dtype.itemsize > jnp.dtype(to.physical).itemsize:
+                bad = (x < info.min) | (x > info.max)
+            else:
+                return
+            guard(src.validity & bad, ERR.SparkCastOverflowException(
+                f"casting {src.dtype} to {to} causes overflow "
+                f"(ANSI mode)"))
 
 
 def cast_column(c: Column, to: dt.DType) -> Column:

@@ -225,9 +225,10 @@ class Version(Expression):
 
 def contains_eager(exprs) -> bool:
     """Does any tree hold an eager-only node? (operators use this to
-    skip jit for the batch)."""
+    skip jit for the batch). ANSI-marked nodes are eager: their
+    error guards host-sync and raise (expr/ansi.py)."""
     def walk(e):
-        if isinstance(e, _EagerExpression):
+        if isinstance(e, _EagerExpression) or getattr(e, "ansi", False):
             return True
         return any(walk(c) for c in e.children)
     return any(walk(e) for e in exprs)

@@ -175,6 +175,13 @@ def _coerced(expr, table):
         rc.data_type(table.schema())
 
 
+def _ansi_raise_if(mask, exc) -> None:
+    """Oracle-side ANSI guard: mirrors expr/ansi.guard so both engines
+    raise the same error types (error-equality differential contract)."""
+    if bool(np.any(mask)):
+        raise exc
+
+
 def _binary_arith(expr, table, op):
     lc, rc, lt, rt = _coerced(expr, table)
     out_t = expr.data_type(table.schema())
@@ -184,8 +191,13 @@ def _binary_arith(expr, table, op):
     if isinstance(out_t, dt.DecimalType):
         wide = out_t.is_wide or lt.is_wide or rt.is_wide
         if wide:
-            out, mask = _decimal_arith_obj(a, b, mask, op, lt, rt, out_t)
-            return out, mask
+            out, omask = _decimal_arith_obj(a, b, mask, op, lt, rt, out_t)
+            if expr.ansi:
+                from ..expr import errors as ERR
+                _ansi_raise_if(mask & ~omask, ERR.SparkArithmeticException(
+                    f"{op}: decimal overflow or division by zero "
+                    f"(ANSI mode)"))
+            return out, omask
         a = _rescale_np(a.astype(np.int64), lt.scale, out_t.scale) \
             if op != "mul" else a.astype(np.int64)
         b = _rescale_np(b.astype(np.int64), rt.scale, out_t.scale) \
@@ -207,6 +219,15 @@ def _binary_arith(expr, table, op):
             out = a - b
         else:
             out = a * b
+    if expr.ansi and out_t.is_integral:
+        from ..expr import errors as ERR
+        ao, bo = a.astype(object), b.astype(object)
+        exact = {"add": ao + bo, "sub": ao - bo, "mul": ao * bo}[op]
+        info = np.iinfo(phys)
+        bad = mask & np.array(
+            [not (info.min <= int(v) <= info.max) for v in exact], bool)
+        _ansi_raise_if(bad, ERR.SparkArithmeticException(
+            ERR.overflow_message(str(out_t))))
     return _zero_nulls(out, mask), mask
 
 
@@ -256,9 +277,17 @@ def _div(expr, table):
             out[i] = q
         if not out_t.is_wide:
             out = np.array([int(v) for v in out], dtype=np.int64)
+        if expr.ansi:
+            from ..expr import errors as ERR
+            _ansi_raise_if(am & bm & ~mask, ERR.SparkArithmeticException(
+                "/: decimal overflow or division by zero (ANSI mode)"))
         return out, mask
     a = a.astype(np.float64)
     b = b.astype(np.float64)
+    if expr.ansi:
+        from ..expr import errors as ERR
+        _ansi_raise_if(am & bm & (b == 0.0),
+                       ERR.SparkArithmeticException(ERR.DIVIDE_BY_ZERO))
     mask = am & bm & (b != 0.0)
     with np.errstate(divide="ignore", invalid="ignore"):
         out = np.where(b != 0.0, a / np.where(b == 0.0, 1.0, b), 0.0)
@@ -280,14 +309,18 @@ def _trunc_mod_np(a, b):
 
 def _decimal_divmod_obj(expr, table):
     """Common-scale exact truncating divmod for decimal operands.
-    Returns (q, r, |b| at the common scale, mask, scale)."""
+    Returns (q, r, |b| at the common scale, mask, scale, base_mask)
+    where base_mask is the pre-division operand validity (am & bm) —
+    the ANSI guards diff it against the final mask to find
+    op-introduced nulls without re-evaluating the operands."""
     lc, rc, lt, rt = _coerced(expr, table)
     a, am = _ev(lc, table)
     b, bm = _ev(rc, table)
     s = max(lt.scale, rt.scale)
     a = _obj_ints(a) * (10 ** (s - lt.scale))
     b = _obj_ints(b) * (10 ** (s - rt.scale))
-    mask = am & bm & np.array([int(x) != 0 for x in b], bool)
+    base_mask = am & bm
+    mask = base_mask & np.array([int(x) != 0 for x in b], bool)
     n = len(a)
     q = np.zeros(n, dtype=object)
     r = np.zeros(n, dtype=object)
@@ -297,21 +330,36 @@ def _decimal_divmod_obj(expr, table):
         qq, rr = divmod(abs(int(a[i])), abs(int(b[i])))
         q[i] = qq if (int(a[i]) < 0) == (int(b[i]) < 0) else -qq
         r[i] = rr if int(a[i]) >= 0 else -rr
-    return q, r, np.array([abs(int(x)) for x in b], dtype=object), mask, s
+    return (q, r, np.array([abs(int(x)) for x in b], dtype=object),
+            mask, s, base_mask)
 
 
 @_reg(A.IntegralDivide)
 def _idiv(expr, table):
     lc, rc, lt, rt = _coerced(expr, table)
     if isinstance(lt, dt.DecimalType):  # coerced: both-or-neither
-        q, _, _, mask, _ = _decimal_divmod_obj(expr, table)
+        q, _, _, mask, _, base_mask = _decimal_divmod_obj(expr, table)
         fits = np.array([-(2 ** 63) <= int(v) < 2 ** 63 for v in q], bool)
         mask = mask & fits
         out = np.array([int(v) if f else 0 for v, f in zip(q, fits)],
                        dtype=np.int64)
+        if expr.ansi:
+            from ..expr import errors as ERR
+            _ansi_raise_if(base_mask & ~mask, ERR.SparkArithmeticException(
+                "div: division by zero or overflow (ANSI mode)"))
         return _zero_nulls(out, mask), mask
     a, am = _ev(lc, table)
     b, bm = _ev(rc, table)
+    if expr.ansi:
+        from ..expr import errors as ERR
+        _ansi_raise_if(am & bm & (b == 0),
+                       ERR.SparkArithmeticException(ERR.DIVIDE_BY_ZERO))
+        if not np.issubdtype(a.dtype, np.floating):
+            lo = np.iinfo(np.int64).min
+            _ansi_raise_if(am & bm & (a.astype(np.int64) == lo)
+                           & (b.astype(np.int64) == -1),
+                           ERR.SparkArithmeticException(
+                               ERR.overflow_message("long")))
     mask = am & bm & (b != 0)
     safe = np.where(b == 0, np.ones(1, b.dtype), b)
     if np.issubdtype(a.dtype, np.floating):
@@ -323,7 +371,7 @@ def _idiv(expr, table):
 
 def _decimal_mod_result(expr, table, positive: bool):
     out_t = expr.data_type(table.schema())
-    _, r, babs, mask, s = _decimal_divmod_obj(expr, table)
+    _, r, babs, mask, s, base_mask = _decimal_divmod_obj(expr, table)
     if positive:
         r = np.array([int(v) + int(ab) if int(v) < 0 else int(v)
                       for v, ab in zip(r, babs)], dtype=object)
@@ -332,6 +380,11 @@ def _decimal_mod_result(expr, table, positive: bool):
     bound = 10 ** out_t.precision
     fits = np.array([abs(int(v)) < bound for v in r], bool)
     mask = mask & fits
+    if expr.ansi:
+        from ..expr import errors as ERR
+        _ansi_raise_if(base_mask & ~mask, ERR.SparkArithmeticException(
+            f"{expr.op_name}: decimal overflow or division by zero "
+            f"(ANSI mode)"))
     r = np.where(mask, r, 0)
     if not out_t.is_wide:
         r = np.array([int(v) for v in r], dtype=np.int64)
@@ -349,6 +402,10 @@ def _rem(expr, table):
     b, bm = _ev(rc, table)
     a = a.astype(phys)
     b = b.astype(phys)
+    if expr.ansi:
+        from ..expr import errors as ERR
+        _ansi_raise_if(am & bm & (b == 0),
+                       ERR.SparkArithmeticException(ERR.DIVIDE_BY_ZERO))
     mask = am & bm & (b != 0)
     safe = np.where(b == 0, np.ones(1, b.dtype), b)
     if np.issubdtype(a.dtype, np.floating):
@@ -369,6 +426,10 @@ def _pmod(expr, table):
     b, bm = _ev(rc, table)
     a = a.astype(phys)
     b = b.astype(phys)
+    if expr.ansi:
+        from ..expr import errors as ERR
+        _ansi_raise_if(am & bm & (b == 0),
+                       ERR.SparkArithmeticException(ERR.DIVIDE_BY_ZERO))
     mask = am & bm & (b != 0)
     safe = np.where(b == 0, np.ones(1, b.dtype), b)
     if np.issubdtype(a.dtype, np.floating):
@@ -382,7 +443,15 @@ def _pmod(expr, table):
 @_reg(A.UnaryMinus)
 def _neg(expr, table):
     a, m = _ev(expr.children[0], table)
-    return _zero_nulls(-a, m), m
+    t = expr.children[0].data_type(table.schema())
+    if expr.ansi and getattr(t, "is_integral", False) \
+            and not isinstance(t, dt.DecimalType):
+        from ..expr import errors as ERR
+        _ansi_raise_if(m & (a == np.iinfo(a.dtype).min),
+                       ERR.SparkArithmeticException(
+                           ERR.overflow_message(str(t))))
+    with np.errstate(over="ignore"):
+        return _zero_nulls(-a, m), m
 
 
 @_reg(A.UnaryPositive)
@@ -393,7 +462,15 @@ def _pos(expr, table):
 @_reg(A.Abs)
 def _abs(expr, table):
     a, m = _ev(expr.children[0], table)
-    return _zero_nulls(np.abs(a), m), m
+    t = expr.children[0].data_type(table.schema())
+    if expr.ansi and getattr(t, "is_integral", False) \
+            and not isinstance(t, dt.DecimalType):
+        from ..expr import errors as ERR
+        _ansi_raise_if(m & (a == np.iinfo(a.dtype).min),
+                       ERR.SparkArithmeticException(
+                           ERR.overflow_message(str(t))))
+    with np.errstate(over="ignore"):
+        return _zero_nulls(np.abs(a), m), m
 
 
 def _least_greatest(expr, table, largest: bool):
@@ -1524,6 +1601,13 @@ def _cast(expr, table):
                 ok[i] = True
             except (ValueError, ArithmeticError):
                 ok[i] = False
+        if expr.ansi:
+            from ..expr import errors as ERR
+            exc_t = ERR.SparkDateTimeException if isinstance(
+                to_t, (dt.DateType, dt.TimestampType)) \
+                else ERR.SparkNumberFormatException
+            _ansi_raise_if(m & ~ok, exc_t(
+                f"invalid input syntax for type {to_t} (ANSI mode cast)"))
         m = m & ok
         return _zero_nulls(out, m), m
     # X -> string
@@ -1544,6 +1628,10 @@ def _cast(expr, table):
             bound = 10 ** to_t.precision
             ok = np.array([abs(int(v)) < bound and abs(int(v)) < _I128_MAX
                            for v in out], bool)
+            if expr.ansi:
+                from ..expr import errors as ERR
+                _ansi_raise_if(m & ~ok, ERR.SparkCastOverflowException(
+                    f"cast to {to_t} causes overflow (ANSI mode)"))
             m = m & ok
             out = np.where(m, out, 0)
             if not to_t.is_wide:
@@ -1562,6 +1650,11 @@ def _cast(expr, table):
                        for v in av], dtype=object)
         lo_b, hi_b = int(dt.min_value(to_t)), int(dt.max_value(to_t))
         ok = np.array([lo_b <= int(v) <= hi_b for v in tv], bool)
+        pre_m = m
+        if expr.ansi:
+            from ..expr import errors as ERR
+            _ansi_raise_if(pre_m & ~ok, ERR.SparkCastOverflowException(
+                f"cast to {to_t} causes overflow (ANSI mode)"))
         m = m & ok
         out = np.array([int(v) if k else 0 for v, k in zip(tv, ok)],
                        dtype=np.dtype(to_t.physical))
@@ -1572,15 +1665,18 @@ def _cast(expr, table):
         if from_t.is_floating:
             scaled = a.astype(np.float64) * 10.0 ** to_t.scale
             ok = np.isfinite(scaled) & (np.abs(scaled) < float(bound))
-            m = m & ok
             safe = np.where(ok, scaled, 0.0)
             vals = [int(np.sign(x)) * int(np.floor(abs(x) + 0.5))
                     for x in safe]
         else:
             vals = [int(x) * 10 ** to_t.scale for x in a]
             ok = np.array([abs(v) < bound for v in vals], bool)
-            m = m & ok
             vals = [v if k else 0 for v, k in zip(vals, ok)]
+        if expr.ansi:
+            from ..expr import errors as ERR
+            _ansi_raise_if(m & ~ok, ERR.SparkCastOverflowException(
+                f"cast to {to_t} causes overflow (ANSI mode)"))
+        m = m & ok
         if to_t.is_wide:
             return np.array(vals, dtype=object), m
         out = np.array([int(v) for v in vals], dtype=np.int64)
@@ -1595,11 +1691,27 @@ def _cast(expr, table):
         return _zero_nulls(out, m), m
     # numeric <-> numeric / bool
     phys = np.dtype(to_t.physical)
+    if expr.ansi and getattr(to_t, "is_integral", False) \
+            and getattr(from_t, "is_numeric", False) \
+            and not isinstance(from_t, dt.DecimalType):
+        from ..expr import errors as ERR
+        info = np.iinfo(phys)
+        if from_t.is_floating:
+            with np.errstate(invalid="ignore"):
+                bad = np.isnan(a) | (a < float(info.min)) | \
+                    (a >= float(info.max) + 1.0)
+        elif a.dtype.itemsize > phys.itemsize:
+            bad = (a < info.min) | (a > info.max)
+        else:
+            bad = np.zeros(len(a), bool)
+        _ansi_raise_if(m & bad, ERR.SparkCastOverflowException(
+            f"casting {from_t} to {to_t} causes overflow (ANSI mode)"))
     if from_t.is_floating and not (to_t.is_floating or to_t == dt.BOOL):
         with np.errstate(invalid="ignore"):
             out = np.trunc(a).astype(phys)
         return _zero_nulls(out, m), m
-    out = a.astype(phys)
+    with np.errstate(over="ignore"):
+        out = a.astype(phys)
     return _zero_nulls(out, m), m
 
 

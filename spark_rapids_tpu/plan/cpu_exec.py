@@ -331,9 +331,20 @@ def _agg_cpu(fn: Agg.AggregateFunction, values: Optional[np.ndarray],
             # the device 128-bit accumulator
             total = sum(int(x) for x in valid_v)
             if abs(total) >= 10 ** out_t.precision:
+                if fn.ansi:
+                    from ..expr import errors as ERR
+                    raise ERR.SparkArithmeticException(
+                        "Decimal sum overflow")
                 return 0, False
             return total, True
         if out_t == dt.INT64:
+            if fn.ansi:
+                exact = sum(int(x) for x in valid_v)
+                if not (-(2 ** 63) <= exact < 2 ** 63):
+                    from ..expr import errors as ERR
+                    raise ERR.SparkArithmeticException(
+                        ERR.overflow_message("long"))
+                return exact, True
             return int(valid_v.astype(np.int64).sum()), True
         return float(valid_v.astype(np.float64).sum()), True
     if isinstance(fn, Agg.Min) or isinstance(fn, Agg.Max):
@@ -359,6 +370,10 @@ def _agg_cpu(fn: Agg.AggregateFunction, values: Optional[np.ndarray],
             sum_prec = min(in_dtype.precision + 10,
                            dt.DecimalType.MAX_PRECISION)
             if abs(total) >= 10 ** sum_prec:
+                if fn.ansi:
+                    from ..expr import errors as ERR
+                    raise ERR.SparkArithmeticException(
+                        "Decimal average overflow")
                 return 0, False
             n_v = len(valid_v)
             num = abs(total) * 10 ** (out_t.scale - in_dtype.scale)
@@ -368,6 +383,10 @@ def _agg_cpu(fn: Agg.AggregateFunction, values: Optional[np.ndarray],
             if total < 0:
                 q = -q
             if abs(q) >= 10 ** out_t.precision:
+                if fn.ansi:
+                    from ..expr import errors as ERR
+                    raise ERR.SparkArithmeticException(
+                        "Decimal average overflow")
                 return 0, False
             return q, True
         x = valid_v.astype(np.float64)

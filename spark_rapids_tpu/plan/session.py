@@ -141,6 +141,13 @@ class TpuSession:
         without this every collect re-traced every jaxpr (the dominant
         warm-query cost)."""
         _mmap_guard(self)
+        if self.conf.ansi:
+            # srt.sql.ansi.enabled: clone the plan with every Cast /
+            # arithmetic / sum node ansi-marked so overflow and invalid
+            # casts raise (expr/ansi.py; the conf is part of the plan
+            # cache key, so ANSI and non-ANSI plans never alias)
+            from ..expr.ansi import rewrite_plan
+            plan = rewrite_plan(plan)
         from .plan_cache import plan_cache_key
         key = plan_cache_key(plan, self.conf)
         physical = self._plan_cache.get(key) if key is not None else None

@@ -1,0 +1,209 @@
+"""ANSI mode (srt.sql.ansi.enabled) — error-equality differential tier.
+
+Both engines must RAISE THE SAME ERROR TYPE for the same input (the
+reference's assert_gpu_and_cpu_error contract,
+integration_tests/.../asserts.py:644): the device lane through the
+session (plan rewrite -> eager ANSI expressions), the oracle through
+plan/cpu_eval + cpu_exec on the identical rewritten tree. Non-ANSI
+behavior (null/wrap) must be untouched.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.conf import SrtConf
+from spark_rapids_tpu.expr import col, lit
+from spark_rapids_tpu.expr.errors import (SparkArithmeticException,
+                                          SparkCastOverflowException,
+                                          SparkNumberFormatException)
+from spark_rapids_tpu.plan.session import TpuSession
+
+I64_MAX = 2 ** 63 - 1
+I32_MAX = 2 ** 31 - 1
+
+
+def _sessions():
+    return (TpuSession(SrtConf({"srt.sql.ansi.enabled": True})),
+            TpuSession(SrtConf({"srt.sql.ansi.enabled": False})))
+
+
+def _oracle_run(sql_df):
+    """Execute the SAME logical plan through the CPU interpreter."""
+    from spark_rapids_tpu.expr.ansi import rewrite_plan
+    from spark_rapids_tpu.plan.cpu_exec import execute_cpu
+    return execute_cpu(rewrite_plan(sql_df.plan))
+
+
+def _both_raise(make_df, exc):
+    """Device lane raises exc; oracle on the same plan raises exc;
+    non-ANSI session returns rows without raising."""
+    ansi_sess, plain_sess = _sessions()
+    with pytest.raises(exc):
+        make_df(ansi_sess).collect()
+    with pytest.raises(exc):
+        _oracle_run(make_df(plain_sess))
+    make_df(plain_sess).collect()  # non-ANSI must not raise
+
+
+# --- arithmetic overflow ---------------------------------------------------
+
+def test_long_add_overflow():
+    _both_raise(
+        lambda s: s.create_dataframe({"x": [I64_MAX, 5]})
+        .select((col("x") + lit(1)).alias("y")),
+        SparkArithmeticException)
+
+
+def test_long_subtract_overflow():
+    _both_raise(
+        lambda s: s.create_dataframe({"x": [-I64_MAX - 1, 5]})
+        .select((col("x") - lit(2)).alias("y")),
+        SparkArithmeticException)
+
+
+def test_long_multiply_overflow():
+    _both_raise(
+        lambda s: s.create_dataframe({"x": [I64_MAX // 2 + 1, 1]})
+        .select((col("x") * lit(2)).alias("y")),
+        SparkArithmeticException)
+
+
+def test_unary_minus_min_long():
+    _both_raise(
+        lambda s: s.create_dataframe({"x": [-(2 ** 63), 1]})
+        .select((-col("x")).alias("y")),
+        SparkArithmeticException)
+
+
+def test_divide_by_zero():
+    _both_raise(
+        lambda s: s.create_dataframe({"x": [1.5, 2.5], "d": [1.0, 0.0]})
+        .select((col("x") / col("d")).alias("y")),
+        SparkArithmeticException)
+
+
+def test_integral_divide_by_zero():
+    from spark_rapids_tpu.expr.arithmetic import IntegralDivide
+    ansi_sess, plain_sess = _sessions()
+    with pytest.raises(SparkArithmeticException):
+        ansi_sess.create_dataframe({"x": [10, 20], "d": [2, 0]}) \
+            .select(IntegralDivide(col("x"), col("d")).alias("y")) \
+            .collect()
+    rows = plain_sess.create_dataframe({"x": [10, 20], "d": [2, 0]}) \
+        .select(IntegralDivide(col("x"), col("d")).alias("y")).to_pandas()
+    assert rows["y"].isna()[1]
+
+
+def test_remainder_by_zero():
+    _both_raise(
+        lambda s: s.create_dataframe({"x": [10, 20], "d": [3, 0]})
+        .select((col("x") % col("d")).alias("y")),
+        SparkArithmeticException)
+
+
+# --- casts -----------------------------------------------------------------
+
+def _cast_df(s, vals, to):
+    from spark_rapids_tpu.expr.cast import Cast
+    return s.create_dataframe({"x": vals}).select(
+        Cast(col("x"), to).alias("y"))
+
+
+def test_cast_long_to_int_overflow():
+    from spark_rapids_tpu.columnar import dtypes as dt
+    _both_raise(lambda s: _cast_df(s, [I32_MAX + 10, 1], dt.INT32),
+                SparkCastOverflowException)
+
+
+def test_cast_float_nan_to_int():
+    from spark_rapids_tpu.columnar import dtypes as dt
+    _both_raise(lambda s: _cast_df(s, [float("nan"), 1.0], dt.INT64),
+                SparkCastOverflowException)
+
+
+def test_cast_float_out_of_range_to_int():
+    from spark_rapids_tpu.columnar import dtypes as dt
+    _both_raise(lambda s: _cast_df(s, [1e30, 1.0], dt.INT64),
+                SparkCastOverflowException)
+
+
+def test_cast_invalid_string_to_int():
+    from spark_rapids_tpu.columnar import dtypes as dt
+    _both_raise(lambda s: _cast_df(s, ["12", "not_a_number"], dt.INT64),
+                SparkNumberFormatException)
+
+
+def test_cast_valid_values_do_not_raise():
+    from spark_rapids_tpu.columnar import dtypes as dt
+    ansi_sess, _ = _sessions()
+    rows = _cast_df(ansi_sess, ["12", "34"], dt.INT64).to_pandas()
+    assert list(rows["y"]) == [12, 34]
+
+
+def test_null_inputs_do_not_raise():
+    # null -> null is NOT an ANSI error (only invalid VALUES are)
+    from spark_rapids_tpu.columnar import dtypes as dt
+    ansi_sess, _ = _sessions()
+    rows = _cast_df(ansi_sess, ["12", None], dt.INT64).to_pandas()
+    assert rows["y"].isna()[1]
+
+
+# --- aggregates ------------------------------------------------------------
+
+def test_sum_long_overflow():
+    from spark_rapids_tpu.expr.aggregates import Sum
+    _both_raise(
+        lambda s: s.create_dataframe(
+            {"g": [0, 0, 0], "x": [I64_MAX, I64_MAX, I64_MAX]})
+        .group_by(col("g")).agg(Sum(col("x")).alias("sx")),
+        SparkArithmeticException)
+
+
+def test_sum_no_overflow_exact():
+    from spark_rapids_tpu.expr.aggregates import Sum
+    ansi_sess, _ = _sessions()
+    df = ansi_sess.create_dataframe({"g": [0, 0, 1], "x": [5, 7, 9]})
+    rows = df.group_by(col("g")).agg(Sum(col("x")).alias("sx")).to_pandas()
+    assert sorted(rows["sx"]) == [9, 12]
+
+
+def test_order_by_overflow_raises():
+    # ANSI expressions in SORT keys must evaluate eagerly (not crash
+    # the trace) and raise on overflow
+    ansi_sess, plain_sess = _sessions()
+    with pytest.raises(SparkArithmeticException):
+        ansi_sess.create_dataframe({"x": [I64_MAX, 5]}) \
+            .sort((col("x") + lit(1)).alias("k")).collect()
+    rows = plain_sess.create_dataframe({"x": [I64_MAX, 5]}) \
+        .sort((col("x") + lit(1)).alias("k")).to_pandas()
+    assert len(rows) == 2
+
+
+def test_order_by_valid_expr_under_ansi():
+    ansi_sess, _ = _sessions()
+    rows = ansi_sess.create_dataframe({"x": [3, 1, 2]}) \
+        .sort((col("x") + lit(1)).alias("k")).to_pandas()
+    assert list(rows["x"]) == [1, 2, 3]
+
+
+def test_decimal_remainder_by_zero():
+    import decimal
+    _both_raise(
+        lambda s: s.create_dataframe(
+            {"x": [decimal.Decimal("1.50"), decimal.Decimal("2.25")],
+             "d": [decimal.Decimal("1.00"), decimal.Decimal("0.00")]})
+        .select((col("x") % col("d")).alias("y")),
+        SparkArithmeticException)
+
+
+# --- SQL surface -----------------------------------------------------------
+
+def test_sql_ansi_overflow():
+    ansi_sess, plain_sess = _sessions()
+    for s in (ansi_sess, plain_sess):
+        s.create_or_replace_temp_view(
+            "t", s.create_dataframe({"x": [I64_MAX, 1]}))
+    with pytest.raises(SparkArithmeticException):
+        ansi_sess.sql("SELECT x + 1 AS y FROM t").collect()
+    out = plain_sess.sql("SELECT x + 1 AS y FROM t").to_pandas()
+    assert len(out) == 2  # wrapped silently, non-ANSI
