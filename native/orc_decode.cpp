@@ -319,3 +319,27 @@ extern "C" int64_t orc_rlev2(const uint8_t* src, int64_t n,
   }
   return o;
 }
+
+// ORC DECIMAL data stream: unbounded base-128 varints, zigzag-signed
+// unscaled values (one per non-null row; scale rides the SECONDARY
+// stream). Values above 64 bits fail (-2) — the caller gates native
+// decode to precision <= 18 so that is a corrupt file, not a feature
+// gap. Returns values decoded or negative error.
+extern "C" int64_t orc_decimal64(const uint8_t* src, int64_t n,
+                                 int64_t* out, int64_t count) {
+  int64_t i = 0;
+  for (int64_t o = 0; o < count; o++) {
+    uint64_t v = 0;
+    int shift = 0;
+    while (true) {
+      if (i >= n) return -1;
+      uint8_t b = src[i++];
+      v |= (uint64_t)(b & 0x7f) << shift;
+      if (!(b & 0x80)) break;
+      shift += 7;
+      if (shift > 63) return -2;
+    }
+    out[o] = (int64_t)(v >> 1) ^ -(int64_t)(v & 1);
+  }
+  return count;
+}

@@ -257,6 +257,28 @@ struct DeltaReader {
   }
 };
 
+// Exact bit extraction for widths the 64-bit sliding window cannot
+// hold together with residual bits (bw > 56): assemble value k's bits
+// [k*bw, (k+1)*bw) from up to 9 bytes. Used by both delta decoders —
+// the window fast path would shift by >= 64 (UB) or drop carry bits.
+static inline uint64_t read_bits_at(const uint8_t* base, int64_t bit_pos,
+                                    int bw) {
+  uint64_t v = 0;
+  int got = 0;
+  int64_t byte = bit_pos >> 3;
+  int off = (int)(bit_pos & 7);
+  if (off) {
+    v = (uint64_t)(base[byte] >> off);
+    got = 8 - off;
+    byte++;
+  }
+  while (got < bw) {
+    v |= (uint64_t)base[byte++] << got;
+    got += 8;
+  }
+  return bw == 64 ? v : (v & ((uint64_t(1) << bw) - 1));
+}
+
 // Decode ``count`` int64 values (INT32 files widen losslessly; the
 // caller narrows) into out[]. Consumes one complete DELTA_BINARY_PACKED
 // stream.
@@ -298,13 +320,15 @@ bool delta_binary_decode(const uint8_t* p, int64_t n, int64_t count,
       int64_t bi = 0;
       for (int64_t k = 0; k < in_mb; k++) {
         uint64_t uv = 0;
-        if (bw > 0) {
+        if (bw > 56) {
+          // window path would need have+bw > 64 bits in flight
+          uv = read_bits_at(mbp, k * bw, bw);
+        } else if (bw > 0) {
           while (have < bw) {
             window |= (uint64_t)mbp[bi++] << have;
             have += 8;
           }
-          uv = bw == 64 ? window
-                        : (window & ((uint64_t(1) << bw) - 1));
+          uv = window & ((uint64_t(1) << bw) - 1);
           window >>= bw;
           have -= bw;
         }
@@ -319,6 +343,69 @@ bool delta_binary_decode(const uint8_t* p, int64_t n, int64_t count,
     }
   }
   return o == count;
+}
+
+// delta_binary_decode variant that decodes the stream's FULL value
+// count and reports how many input bytes it consumed — required by
+// DELTA_BYTE_ARRAY / DELTA_LENGTH_BYTE_ARRAY, whose pages concatenate
+// delta blocks with byte payloads. Trailing empty miniblocks carry
+// bit-width 0 in practice (parquet-mr and arrow writers), so walking
+// the advertised widths lands exactly on the next section.
+bool delta_binary_decode_ex(const uint8_t* p, int64_t n, int64_t count,
+                            int64_t* out, int64_t* consumed) {
+  DeltaReader r{p, n};
+  int64_t block_size = (int64_t)r.varint();
+  int64_t mb_per_block = (int64_t)r.varint();
+  int64_t total = (int64_t)r.varint();
+  int64_t first = r.zigzag();
+  if (!r.ok || block_size <= 0 || mb_per_block <= 0) return false;
+  if (block_size % (mb_per_block * 8) != 0) return false;
+  if (total != count) return false;
+  int64_t per_mb = block_size / mb_per_block;
+  int64_t o = 0;
+  if (o < count) out[o++] = first;
+  int64_t prev = first;
+  int64_t remaining = total - 1;
+  while (remaining > 0 && r.ok) {
+    int64_t min_delta = r.zigzag();
+    if (r.i + mb_per_block > r.n) return false;
+    const uint8_t* widths = r.p + r.i;
+    r.i += mb_per_block;
+    for (int64_t mb = 0; mb < mb_per_block; mb++) {
+      int bw = widths[mb];
+      if (bw > 64) return false;
+      int64_t bytes = (per_mb * bw + 7) / 8;
+      if (r.i + bytes > r.n) return false;
+      const uint8_t* mbp = r.p + r.i;
+      uint64_t window = 0;
+      int have = 0;
+      int64_t bi = 0;
+      for (int64_t k = 0; k < per_mb; k++) {
+        uint64_t uv = 0;
+        if (bw > 56) {
+          // window path would need have+bw > 64 bits in flight
+          uv = read_bits_at(mbp, k * bw, bw);
+        } else if (bw > 0) {
+          while (have < bw) {
+            window |= (uint64_t)mbp[bi++] << have;
+            have += 8;
+          }
+          uv = window & ((uint64_t(1) << bw) - 1);
+          window >>= bw;
+          have -= bw;
+        }
+        if (remaining > 0) {
+          prev = prev + min_delta + (int64_t)uv;
+          remaining--;
+          if (o < count) out[o++] = prev;
+        }
+      }
+      r.i += bytes;
+    }
+  }
+  if (!r.ok || o != count) return false;
+  *consumed = r.i;
+  return true;
 }
 
 // parse one PageHeader starting at r.i; leaves r.i just past it
@@ -700,6 +787,330 @@ extern "C" int64_t parquet_decode_chunk(
                        ? deltas[s++] : 0;
       }
       delete[] deltas;
+    } else if (h.encoding == 9) {
+      // BYTE_STREAM_SPLIT: k-th byte of every value stored together
+      if (non_null * elem > body_len) return -1;
+      uint8_t* packed = new uint8_t[(non_null > 0 ? non_null : 1) * elem];
+      for (int j = 0; j < elem; j++)
+        for (int64_t k = 0; k < non_null; k++)
+          packed[k * elem + j] = body[j * non_null + k];
+      if (max_def_level == 0 || non_null == nvals) {
+        std::memcpy(dst, packed, nvals * elem);
+      } else if (elem == 4) {
+        scatter_plain<4>(dst, packed, out_valid + row, nvals);
+      } else {
+        scatter_plain<8>(dst, packed, out_valid + row, nvals);
+      }
+      delete[] packed;
+    } else {
+      return -2;
+    }
+    row += nvals;
+  }
+  return row;
+}
+
+// ---------------------------------------------------------------------------
+// BYTE_ARRAY (string/binary) chunk decoder
+// ---------------------------------------------------------------------------
+// Encodings: PLAIN(0), PLAIN_DICTIONARY(2)/RLE_DICTIONARY(8),
+// DELTA_LENGTH_BYTE_ARRAY(6), DELTA_BYTE_ARRAY(7); v1 + v2 pages, all
+// supported codecs. Output: out_offsets[num_rows+1] (int32, offset 0
+// pre-seeded by caller) + out_bytes; null rows get empty slices.
+// Returns rows decoded or the same negative codes as
+// parquet_decode_chunk (-3 also covers out_bytes overflow — the caller
+// can retry with a bigger buffer).
+extern "C" int64_t parquet_decode_chunk_binary(
+    const uint8_t* chunk, int64_t chunk_len, int32_t codec,
+    int64_t num_rows, int32_t max_def_level,
+    int32_t* out_offsets, uint8_t* out_bytes, int64_t out_bytes_cap,
+    uint8_t* out_valid, uint8_t* scratch, int64_t scratch_cap) {
+  if (max_def_level > 1) return -2;
+
+  // decoded dictionary parked at the TAIL of scratch:
+  // [int32 ends[dict_count]] [bytes...] (ends are cumulative)
+  int32_t* dict_ends = nullptr;
+  const uint8_t* dict_bytes_p = nullptr;
+  int64_t dict_count = 0;
+  int64_t dict_tail = 0;  // bytes reserved at scratch tail
+
+  int64_t row = 0;
+  int64_t out_pos = 0;
+  out_offsets[0] = 0;
+  int64_t i = 0;
+  while (i < chunk_len && row < num_rows) {
+    TReader tr{chunk + i, chunk_len - i};
+    PageHeader h;
+    if (!parse_page_header(tr, &h)) return -1;
+    if (h.num_values < 0 || h.compressed_size < 0 ||
+        h.uncompressed_size < 0)
+      return -1;
+    i += tr.i;
+    if (i + h.compressed_size > chunk_len) return -1;
+    const uint8_t* page = chunk + i;
+    int64_t page_len = h.compressed_size;
+    i += h.compressed_size;
+
+    const int64_t head_cap = scratch_cap - dict_tail;
+    const uint8_t* body = page;
+    int64_t body_len = page_len;
+    int64_t nvals = h.num_values;
+    int64_t non_null = nvals;
+
+    if (h.type == 3) {  // v2 data page
+      if (h.rep_len != 0) return -2;
+      if (h.def_len < 0 || (int64_t)h.def_len > page_len) return -1;
+      if (row + nvals > num_rows) return -1;
+      if (max_def_level > 0) {
+        uint32_t* lvls = new uint32_t[nvals > 0 ? nvals : 1];
+        if (!rle_decode_all(page, h.def_len,
+                            bit_width_for(max_def_level), lvls, nvals)) {
+          delete[] lvls;
+          return -1;
+        }
+        non_null = 0;
+        for (int64_t k = 0; k < nvals; k++) {
+          uint8_t v = lvls[k] == (uint32_t)max_def_level;
+          out_valid[row + k] = v;
+          non_null += v;
+        }
+        delete[] lvls;
+      } else {
+        std::memset(out_valid + row, 1, nvals);
+      }
+      body = page + h.def_len;
+      body_len = page_len - h.def_len;
+      if (codec != 0 && h.v2_compressed) {
+        int64_t got = 0;
+        int64_t want = h.uncompressed_size - h.def_len - h.rep_len;
+        if (want < 0 || want > head_cap) return want < 0 ? -1 : -3;
+        if (!decompress_codec(codec, body, body_len, scratch, head_cap,
+                              &got) ||
+            got != want)
+          return -1;
+        body = scratch;
+        body_len = got;
+      }
+    } else {
+      if (codec != 0) {
+        int64_t got = 0;
+        if (h.uncompressed_size > head_cap) return -3;
+        if (!decompress_codec(codec, page, page_len, scratch, head_cap,
+                              &got) ||
+            got != h.uncompressed_size)
+          return -1;
+        page = scratch;
+        page_len = got;
+      }
+
+      if (h.type == 2) {  // dictionary page: PLAIN byte arrays
+        if (h.encoding != 0 && h.encoding != 2) return -2;
+        // first pass: total bytes
+        int64_t total_b = 0;
+        {
+          int64_t p2 = 0;
+          for (int64_t k = 0; k < h.num_values; k++) {
+            if (p2 + 4 > page_len) return -1;
+            uint32_t len = page[p2] | (uint32_t(page[p2 + 1]) << 8) |
+                           (uint32_t(page[p2 + 2]) << 16) |
+                           (uint32_t(page[p2 + 3]) << 24);
+            p2 += 4;
+            if (p2 + (int64_t)len > page_len) return -1;
+            p2 += len;
+            total_b += len;
+          }
+        }
+        int64_t need = (int64_t)h.num_values * 4 + total_b;
+        // dict must survive page decompression into the head
+        if (need * 2 > scratch_cap) return -3;
+        dict_tail = need;
+        uint8_t* tail = scratch + scratch_cap - need;
+        dict_ends = reinterpret_cast<int32_t*>(tail);
+        uint8_t* db = tail + (int64_t)h.num_values * 4;
+        int64_t p2 = 0, off = 0;
+        for (int64_t k = 0; k < h.num_values; k++) {
+          uint32_t len = page[p2] | (uint32_t(page[p2 + 1]) << 8) |
+                         (uint32_t(page[p2 + 2]) << 16) |
+                         (uint32_t(page[p2 + 3]) << 24);
+          p2 += 4;
+          std::memmove(db + off, page + p2, len);
+          p2 += len;
+          off += len;
+          dict_ends[k] = (int32_t)off;
+        }
+        dict_bytes_p = db;
+        dict_count = h.num_values;
+        continue;
+      }
+      if (h.type != 0) return -2;
+
+      body = page;
+      body_len = page_len;
+      if (row + nvals > num_rows) return -1;
+      if (max_def_level > 0) {
+        if (h.def_encoding != 3) return -2;
+        if (body_len < 4) return -1;
+        uint32_t dl_len = body[0] | (uint32_t(body[1]) << 8) |
+                          (uint32_t(body[2]) << 16) |
+                          (uint32_t(body[3]) << 24);
+        if (4 + (int64_t)dl_len > body_len) return -1;
+        uint32_t* lvls = new uint32_t[nvals > 0 ? nvals : 1];
+        if (!rle_decode_all(body + 4, (int64_t)dl_len,
+                            bit_width_for(max_def_level), lvls, nvals)) {
+          delete[] lvls;
+          return -1;
+        }
+        non_null = 0;
+        for (int64_t k = 0; k < nvals; k++) {
+          uint8_t v = lvls[k] == (uint32_t)max_def_level;
+          out_valid[row + k] = v;
+          non_null += v;
+        }
+        delete[] lvls;
+        body += 4 + dl_len;
+        body_len -= 4 + (int64_t)dl_len;
+      } else {
+        std::memset(out_valid + row, 1, nvals);
+      }
+    }
+
+    // emit one value's bytes; returns false on overflow
+    auto emit = [&](const uint8_t* src, int64_t len) -> bool {
+      // len is attacker-controlled (decoded from the page): compare
+      // without forming out_pos+len (int64 wrap would skip the check),
+      // and keep offsets representable in the int32 output array
+      if (len < 0 || len > out_bytes_cap - out_pos) return false;
+      if (out_pos + len > (int64_t)0x7fffffff) return false;
+      std::memcpy(out_bytes + out_pos, src, len);
+      out_pos += len;
+      return true;
+    };
+
+    if (h.encoding == 0) {  // PLAIN: [u32 len][bytes] per value
+      int64_t p2 = 0;
+      int64_t s = 0;
+      for (int64_t k = 0; k < nvals; k++) {
+        bool valid = max_def_level == 0 || out_valid[row + k];
+        if (valid) {
+          if (p2 + 4 > body_len) return -1;
+          uint32_t len = body[p2] | (uint32_t(body[p2 + 1]) << 8) |
+                         (uint32_t(body[p2 + 2]) << 16) |
+                         (uint32_t(body[p2 + 3]) << 24);
+          p2 += 4;
+          if ((int64_t)len > body_len - p2) return -1;
+          if (!emit(body + p2, len)) return -3;
+          p2 += len;
+          s++;
+        }
+        out_offsets[row + k + 1] = (int32_t)out_pos;
+      }
+      (void)s;
+    } else if (h.encoding == 8 || h.encoding == 2) {  // dictionary
+      if (dict_ends == nullptr) return -1;
+      if (body_len < 1) return -1;
+      int bw = body[0];
+      if (bw < 0 || bw > 32) return -1;
+      uint32_t* idx = new uint32_t[non_null > 0 ? non_null : 1];
+      if (!rle_decode_all(body + 1, body_len - 1, bw, idx, non_null)) {
+        delete[] idx;
+        return -1;
+      }
+      int64_t s = 0;
+      for (int64_t k = 0; k < nvals; k++) {
+        bool valid = max_def_level == 0 || out_valid[row + k];
+        if (valid) {
+          uint32_t ix = idx[s++];
+          if ((int64_t)ix >= dict_count) {
+            delete[] idx;
+            return -1;
+          }
+          int32_t start = ix == 0 ? 0 : dict_ends[ix - 1];
+          int32_t len = dict_ends[ix] - start;
+          if (!emit(dict_bytes_p + start, len)) {
+            delete[] idx;
+            return -3;
+          }
+        }
+        out_offsets[row + k + 1] = (int32_t)out_pos;
+      }
+      delete[] idx;
+    } else if (h.encoding == 6) {  // DELTA_LENGTH_BYTE_ARRAY
+      int64_t* lens = new int64_t[non_null > 0 ? non_null : 1];
+      int64_t consumed = 0;
+      if (non_null > 0 &&
+          !delta_binary_decode_ex(body, body_len, non_null, lens,
+                                  &consumed)) {
+        delete[] lens;
+        return -1;
+      }
+      int64_t p2 = consumed;
+      int64_t s = 0;
+      bool bad = false;
+      for (int64_t k = 0; k < nvals && !bad; k++) {
+        bool valid = max_def_level == 0 || out_valid[row + k];
+        if (valid) {
+          int64_t len = lens[s++];
+          if (len < 0 || len > body_len - p2) { bad = true; break; }
+          if (!emit(body + p2, len)) {
+            delete[] lens;
+            return -3;
+          }
+          p2 += len;
+        }
+        out_offsets[row + k + 1] = (int32_t)out_pos;
+      }
+      delete[] lens;
+      if (bad) return -1;
+    } else if (h.encoding == 7) {  // DELTA_BYTE_ARRAY (prefix sharing)
+      int64_t* pre = new int64_t[non_null > 0 ? non_null : 1];
+      int64_t* suf = new int64_t[non_null > 0 ? non_null : 1];
+      int64_t c1 = 0, c2 = 0;
+      bool ok = non_null == 0 ||
+                (delta_binary_decode_ex(body, body_len, non_null, pre,
+                                        &c1) &&
+                 delta_binary_decode_ex(body + c1, body_len - c1,
+                                        non_null, suf, &c2));
+      if (!ok) {
+        delete[] pre;
+        delete[] suf;
+        return -1;
+      }
+      int64_t p2 = c1 + c2;
+      int64_t s = 0;
+      int64_t prev_start = -1, prev_len = 0;
+      bool bad = false;
+      for (int64_t k = 0; k < nvals && !bad; k++) {
+        bool valid = max_def_level == 0 || out_valid[row + k];
+        if (valid) {
+          int64_t pl = pre[s], sl = suf[s];
+          s++;
+          if (pl < 0 || sl < 0 || pl > prev_len ||
+              (pl > 0 && prev_start < 0) || sl > body_len - p2) {
+            bad = true;
+            break;
+          }
+          if (pl > out_bytes_cap - out_pos ||
+              sl > out_bytes_cap - out_pos - pl ||
+              out_pos + pl + sl > (int64_t)0x7fffffff) {
+            delete[] pre;
+            delete[] suf;
+            return -3;
+          }
+          int64_t start = out_pos;
+          // prefix copies from the PREVIOUS decoded value in out_bytes
+          std::memmove(out_bytes + out_pos, out_bytes + prev_start, pl);
+          out_pos += pl;
+          std::memcpy(out_bytes + out_pos, body + p2, sl);
+          out_pos += sl;
+          p2 += sl;
+          prev_start = start;
+          prev_len = pl + sl;
+        }
+        out_offsets[row + k + 1] = (int32_t)out_pos;
+      }
+      delete[] pre;
+      delete[] suf;
+      if (bad) return -1;
     } else {
       return -2;
     }

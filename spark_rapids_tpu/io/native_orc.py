@@ -29,14 +29,28 @@ from ..plan.host_table import HostColumn, HostTable
 _CODECS = {0: 0, 1: 1, 2: 2, 5: 3}  # NONE, ZLIB, SNAPPY, ZSTD
 
 # orc Type.Kind
+_K_BOOL = 0
+_K_SHORT = 2
 _K_INT = 3       # int32
 _K_LONG = 4
 _K_FLOAT = 5
 _K_DOUBLE = 6
-_K_SHORT = 2
+_K_STRING = 7
+_K_TIMESTAMP = 9
 _K_STRUCT = 12
+_K_DECIMAL = 14
+_K_DATE = 15
+_K_VARCHAR = 16
+_K_CHAR = 17
 
 _NUMERIC_KINDS = {_K_SHORT, _K_INT, _K_LONG, _K_FLOAT, _K_DOUBLE}
+_STRING_KINDS = {_K_STRING, _K_VARCHAR, _K_CHAR}
+#: full native envelope (r5: strings incl. dictionary encoding, dates,
+#: decimal64, booleans joined the numeric kinds; timestamps still fall
+#: back — their seconds+nanos split stream needs the arrow path's
+#: unit handling)
+_OK_KINDS = _NUMERIC_KINDS | _STRING_KINDS | {_K_BOOL, _K_DECIMAL,
+                                              _K_DATE}
 
 
 class _Pb:
@@ -147,6 +161,7 @@ class _OrcMeta:
                 kind = 0
                 subs: List[int] = []
                 names: List[str] = []
+                precision = scale = 0
                 for sfn, swt, sv in _Pb(v).fields():
                     if sfn == 1:
                         kind = sv
@@ -159,7 +174,11 @@ class _OrcMeta:
                                 subs.append(p.varint())
                     elif sfn == 3:
                         names.append(sv.decode())
-                self.types.append((kind, subs, names))
+                    elif sfn == 5:
+                        precision = sv
+                    elif sfn == 6:
+                        scale = sv
+                self.types.append((kind, subs, names, precision, scale))
             elif fn == 6:
                 self.num_rows = v
 
@@ -179,7 +198,7 @@ def _stripe_footer(meta: _OrcMeta, fh, stripe) -> Dict:
     raw = fh.read(flen)
     footer = _deframe(raw, meta.codec, max(flen * 30, 1 << 16))
     streams = []   # (kind, column, length)
-    encodings = []  # kind per column
+    encodings = []  # (encoding kind, dictionary size) per column
     for fn, wt, v in _Pb(footer).fields():
         if fn == 1:
             kind = col = length = 0
@@ -192,34 +211,91 @@ def _stripe_footer(meta: _OrcMeta, fh, stripe) -> Dict:
                     length = sv
             streams.append((kind, col, length))
         elif fn == 2:
-            ek = 0
+            ek = dict_size = 0
             for sfn, _, sv in _Pb(v).fields():
                 if sfn == 1:
                     ek = sv
-            encodings.append(ek)
+                elif sfn == 2:
+                    dict_size = sv
+            encodings.append((ek, dict_size))
     return {"streams": streams, "encodings": encodings}
+
+
+def _kind_ok(tinfo, declared: dt.DType) -> bool:
+    """Is (file kind, declared dtype) inside the native envelope?"""
+    kind = tinfo[0]
+    if kind not in _OK_KINDS:
+        return False
+    if kind in _STRING_KINDS:
+        return declared == dt.STRING
+    if kind == _K_DECIMAL:
+        prec, scale = tinfo[3], tinfo[4]
+        return (isinstance(declared, dt.DecimalType)
+                and not declared.is_wide and 0 < prec <= 18
+                and declared.scale == scale)
+    if kind == _K_BOOL:
+        return declared == dt.BOOL
+    return not isinstance(declared, dt.StringType)
+
+
+def _rlev2_ints(raw: bytes, nn: int, signed: int) -> Optional[np.ndarray]:
+    from ..native import orc_rlev2
+    vals = np.zeros(max(nn, 1), np.int64)
+    got = orc_rlev2(np.frombuffer(raw, np.uint8), signed, vals, nn)
+    if got != nn:
+        return None
+    return vals[:nn]
+
+
+def _read_stream(fh, offsets, meta, kind: int, ci: int,
+                 cap_hint: int) -> Optional[bytes]:
+    if (kind, ci) not in offsets:
+        return None
+    spos, slen = offsets[(kind, ci)]
+    fh.seek(spos)
+    return _deframe(fh.read(slen), meta.codec,
+                    max(slen * 40, cap_hint))
+
+
+def _strings_from(lens: np.ndarray, blob: bytes) -> Optional[list]:
+    ends = np.cumsum(lens)
+    if len(ends) and ends[-1] > len(blob):
+        return None
+    out = []
+    start = 0
+    for e in ends:
+        out.append(blob[start:int(e)].decode("utf-8", "replace"))
+        start = int(e)
+    return out
 
 
 def read_orc_native(path: str, schema) -> Optional[HostTable]:
     """Decode a whole ORC file natively -> HostTable, or None when the
-    file is outside the native envelope (pyarrow fallback)."""
-    from ..native import orc_bool_rle, orc_rlev2
+    file is outside the native envelope (pyarrow fallback).
+
+    Envelope (GpuOrcScan.scala:421 decodes all these on device):
+    numerics, booleans, dates, decimal64 (precision <= 18), and
+    strings/char/varchar in DIRECT_V2 or DICTIONARY_V2 encodings;
+    NONE/ZLIB/SNAPPY/ZSTD compression. Timestamps and RLEv1 files fall
+    back to pyarrow.
+    """
+    from ..native import orc_bool_rle, orc_decimal64
     try:
         meta = _OrcMeta(path)
     except Exception:
         return None
     if not meta.types or meta.types[0][0] != _K_STRUCT:
         return None
-    root_kind, subs, names = meta.types[0]
+    _, subs, names = meta.types[0][0:3]
     by_name = {n: ci for n, ci in zip(names, subs)}
+    declared_by = dict(schema)
     want = [n for n, _ in schema]
     for n in want:
         if n not in by_name:
             return None
-        kind = meta.types[by_name[n]][0]
-        if kind not in _NUMERIC_KINDS:
+        if not _kind_ok(meta.types[by_name[n]], declared_by[n]):
             return None
-    cols: Dict[str, List[np.ndarray]] = {n: [] for n in want}
+    cols: Dict[str, list] = {n: [] for n in want}
     masks: Dict[str, List[np.ndarray]] = {n: [] for n in want}
     try:
         with open(path, "rb") as fh:
@@ -236,59 +312,118 @@ def read_orc_native(path: str, schema) -> Optional[HostTable]:
                     pos += length
                 for n in want:
                     ci = by_name[n]
-                    enc = sf["encodings"][ci] if ci < len(
-                        sf["encodings"]) else 0
-                    tkind = meta.types[ci][0]
+                    enc, dict_size = sf["encodings"][ci] if ci < len(
+                        sf["encodings"]) else (0, 0)
+                    tinfo = meta.types[ci]
+                    tkind = tinfo[0]
                     # PRESENT stream (kind 0)
                     valid = np.ones(rows, np.uint8)
-                    if (0, ci) in offsets:
-                        spos, slen = offsets[(0, ci)]
-                        fh.seek(spos)
-                        raw = _deframe(fh.read(slen), meta.codec,
-                                       max(slen * 30, 1 << 14))
+                    praw = _read_stream(fh, offsets, meta, 0, ci, 1 << 14)
+                    if praw is not None:
                         got = orc_bool_rle(
-                            np.frombuffer(raw, np.uint8), valid, rows)
+                            np.frombuffer(praw, np.uint8), valid, rows)
                         if got != rows:
                             return None
                     nn = int(valid.sum())
-                    # DATA stream (kind 1)
-                    if (1, ci) not in offsets:
-                        if nn:
+                    raw = _read_stream(fh, offsets, meta, 1, ci,
+                                       rows * 8 + (1 << 14))
+                    if raw is None:
+                        if nn and tkind not in _STRING_KINDS:
                             return None
-                        data_nn = np.zeros(0, np.int64)
                         raw = b""
-                    else:
-                        spos, slen = offsets[(1, ci)]
-                        fh.seek(spos)
-                        raw = _deframe(
-                            fh.read(slen), meta.codec,
-                            max(slen * 40, rows * 8 + (1 << 14)))
-                    if tkind in (_K_SHORT, _K_INT, _K_LONG):
-                        if enc not in (0, 2):
-                            return None
-                        if enc == 0:
+                    if tkind in (_K_SHORT, _K_INT, _K_LONG, _K_DATE):
+                        if enc != 2:
                             return None  # RLEv1: fall back
-                        vals = np.zeros(max(nn, 1), np.int64)
-                        got = orc_rlev2(np.frombuffer(raw, np.uint8),
-                                        1, vals, nn)
-                        if got != nn:
+                        data_nn = _rlev2_ints(raw, nn, 1)
+                        if data_nn is None:
                             return None
-                        data_nn = vals[:nn]
                     elif tkind == _K_DOUBLE:
                         if len(raw) < nn * 8:
                             return None
                         data_nn = np.frombuffer(raw[:nn * 8],
                                                 np.float64).copy()
-                    else:  # float
+                    elif tkind == _K_FLOAT:
                         if len(raw) < nn * 4:
                             return None
                         data_nn = np.frombuffer(
                             raw[:nn * 4], np.float32).astype(np.float64)
-                    full = np.zeros(rows, np.float64 if tkind in
-                                    (_K_DOUBLE, _K_FLOAT) else np.int64)
-                    full[valid.astype(bool)] = data_nn
+                    elif tkind == _K_BOOL:
+                        bits = np.zeros(max(nn, 1), np.uint8)
+                        got = orc_bool_rle(
+                            np.frombuffer(raw, np.uint8), bits, nn)
+                        if got != nn:
+                            return None
+                        data_nn = bits[:nn].astype(np.int64)
+                    elif tkind == _K_DECIMAL:
+                        vals = np.zeros(max(nn, 1), np.int64)
+                        got = orc_decimal64(
+                            np.frombuffer(raw, np.uint8), vals, nn)
+                        if got != nn:
+                            return None
+                        # SECONDARY (kind 5): per-value scale; the
+                        # declared scale matched the TYPE scale at the
+                        # gate, but writers may emit lower row scales
+                        sraw = _read_stream(fh, offsets, meta, 5, ci,
+                                            rows * 4 + (1 << 12))
+                        if sraw is None:
+                            return None
+                        scales = _rlev2_ints(sraw, nn, 1)
+                        if scales is None:
+                            return None
+                        up = declared_by[n].scale - scales
+                        if np.any(up < 0) or np.any(up > 18):
+                            return None
+                        mult = 10 ** up.astype(np.int64)
+                        # int64 wrap check: |v| must fit after scaling
+                        lim = (2 ** 63 - 1) // mult
+                        if np.any(np.abs(vals[:nn]) > lim):
+                            return None
+                        data_nn = vals[:nn] * mult
+                    elif tkind in _STRING_KINDS:
+                        lraw = _read_stream(fh, offsets, meta, 2, ci,
+                                            rows * 4 + (1 << 12))
+                        if enc == 2:  # DIRECT_V2: lengths + data bytes
+                            if lraw is None:
+                                return None
+                            lens = _rlev2_ints(lraw, nn, 0)
+                            if lens is None:
+                                return None
+                            strs = _strings_from(lens, raw)
+                            if strs is None:
+                                return None
+                            data_nn = strs
+                        elif enc == 3:  # DICTIONARY_V2
+                            draw = _read_stream(fh, offsets, meta, 3,
+                                                ci, rows * 4 + (1 << 12))
+                            if lraw is None or dict_size < 0:
+                                return None
+                            dlens = _rlev2_ints(lraw, dict_size, 0)
+                            if dlens is None:
+                                return None
+                            dstrs = _strings_from(dlens, draw or b"")
+                            if dstrs is None:
+                                return None
+                            idx = _rlev2_ints(raw, nn, 0)
+                            if idx is None or (nn and (
+                                    idx.min() < 0
+                                    or idx.max() >= max(dict_size, 1))):
+                                return None
+                            data_nn = [dstrs[int(i)] for i in idx]
+                        else:
+                            return None
+                    else:
+                        return None
+                    vb = valid.astype(bool)
+                    if tkind in _STRING_KINDS:
+                        full = np.full(rows, "", dtype=object)
+                        full[vb] = data_nn
+                    else:
+                        full = np.zeros(rows, np.float64 if tkind in
+                                        (_K_DOUBLE, _K_FLOAT)
+                                        else np.int64)
+                        full[vb] = data_nn
                     cols[n].append(full)
-                    masks[n].append(valid.astype(bool))
+                    masks[n].append(vb)
     except Exception:
         return None
     out_cols = []
@@ -296,8 +431,11 @@ def read_orc_native(path: str, schema) -> Optional[HostTable]:
         vals = np.concatenate(cols[n]) if cols[n] else np.zeros(0)
         mask = np.concatenate(masks[n]) if masks[n] else \
             np.zeros(0, bool)
-        phys = np.dtype(declared.physical)
-        if vals.dtype != phys:
-            vals = vals.astype(phys)
+        if declared != dt.STRING:
+            phys = np.dtype(declared.physical)
+            if vals.dtype != phys:
+                vals = vals.astype(phys)
+        elif vals.dtype != object:
+            vals = vals.astype(object)
         out_cols.append(HostColumn(vals, mask, declared))
     return HostTable(out_cols, [n for n, _ in schema])

@@ -34,16 +34,24 @@ _PHYS = {
     "FLOAT": (4, np.dtype(np.float32)),
     "DOUBLE": (5, np.dtype(np.float64)),
 }
+#: wire id marking the BYTE_ARRAY (string) lane, decoded by
+#: parquet_decode_chunk_binary into offsets + bytes
+_PHYS_BINARY = 100
 _CODECS = {"UNCOMPRESSED": 0, "SNAPPY": 1, "GZIP": 2, "ZSTD": 3}
 _OK_ENCODINGS = {"PLAIN", "RLE", "PLAIN_DICTIONARY", "RLE_DICTIONARY",
-                 "BIT_PACKED", "DELTA_BINARY_PACKED"}
+                 "BIT_PACKED", "DELTA_BINARY_PACKED", "BYTE_STREAM_SPLIT"}
+#: byte-array pages additionally cover the DELTA string family
+#: (Spark 3.3+ writers emit these with parquet.writer.version=v2;
+#: GpuParquetScan.scala supports them via cuDF)
+_OK_ENCODINGS_BINARY = _OK_ENCODINGS | {"DELTA_LENGTH_BYTE_ARRAY",
+                                        "DELTA_BYTE_ARRAY"}
 
 
 def _declared_ok(t: dt.DType) -> bool:
     """Declared dtypes whose host lanes are plain fixed-width ints or
-    floats (timestamps excluded: their unit normalization lives in the
-    arrow path)."""
-    if t in (dt.STRING, dt.TIMESTAMP) or t.is_nested:
+    floats, plus strings (timestamps excluded: their unit
+    normalization lives in the arrow path)."""
+    if t == dt.TIMESTAMP or t.is_nested:
         return False
     if isinstance(t, dt.DecimalType):
         return not t.is_wide
@@ -72,13 +80,20 @@ def _plan_chunk(pf: "pq.ParquetFile", rg: int, col_idx: int,
     if not _declared_ok(declared):
         return None
     ct = pf.metadata.row_group(rg).column(col_idx)
-    phys = _PHYS.get(ct.physical_type)
+    if ct.physical_type == "BYTE_ARRAY" and declared == dt.STRING:
+        phys = (_PHYS_BINARY, None)
+        ok_encs = _OK_ENCODINGS_BINARY
+    else:
+        if declared == dt.STRING:
+            return None
+        phys = _PHYS.get(ct.physical_type)
+        ok_encs = _OK_ENCODINGS
     if phys is None:
         return None
     codec = _CODECS.get(ct.compression)
     if codec is None:
         return None
-    if not set(ct.encodings) <= _OK_ENCODINGS:
+    if not set(ct.encodings) <= ok_encs:
         return None
     sc = pf.schema.column(col_idx)
     if sc.max_repetition_level != 0 or sc.max_definition_level > 1:
@@ -97,12 +112,35 @@ def _plan_chunk(pf: "pq.ParquetFile", rg: int, col_idx: int,
 def _decode_native(fh, plan: _ChunkPlan, rows: int):
     """-> (values ndarray, validity bool ndarray) or None on any
     decoder error (falls back)."""
-    from ..native import parquet_decode_chunk
+    from ..native import parquet_decode_chunk, parquet_decode_chunk_binary
     fh.seek(plan.offset)
     chunk = fh.read(plan.length)
-    values = np.zeros(rows, plan.np_dtype)
     validity = np.zeros(rows, np.uint8)
     scratch = np.empty(plan.scratch, np.uint8)
+    if plan.phys_id == _PHYS_BINARY:
+        offsets = np.zeros(rows + 1, np.int32)
+        # first guess: the chunk's uncompressed footprint bounds the
+        # string payload; -3 (overflow) retries once at 4x
+        cap = max(plan.scratch, 1 << 16)
+        for attempt in range(2):
+            out_bytes = np.empty(cap, np.uint8)
+            got = parquet_decode_chunk_binary(
+                chunk, plan.codec, rows, plan.max_def, offsets,
+                out_bytes, validity, scratch)
+            if got == -3 and attempt == 0:
+                cap *= 4
+                continue
+            break
+        if got != rows:
+            return None
+        blob = out_bytes.tobytes()
+        vals = np.empty(rows, object)
+        mv = validity.astype(bool)
+        for k in range(rows):
+            vals[k] = blob[offsets[k]:offsets[k + 1]].decode(
+                "utf-8", "replace") if mv[k] else ""
+        return vals, mv
+    values = np.zeros(rows, plan.np_dtype)
     got = parquet_decode_chunk(chunk, plan.codec, plan.phys_id, rows,
                                plan.max_def, values, validity, scratch)
     if got != rows:
@@ -112,6 +150,8 @@ def _decode_native(fh, plan: _ChunkPlan, rows: int):
 
 def _to_host_column(values: np.ndarray, validity: np.ndarray,
                     declared: dt.DType) -> HostColumn:
+    if declared == dt.STRING:
+        return HostColumn(values, validity, declared)
     phys = np.dtype(declared.physical)
     if values.dtype != phys:
         # e.g. file INT32 under a declared bigint/decimal(…,s)<=18
@@ -120,7 +160,7 @@ def _to_host_column(values: np.ndarray, validity: np.ndarray,
 
 
 def _decode_row_group(pf, fh, rg: int, rows: int, want, file_cols,
-                      declared):
+                      declared, options=None):
     native: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
     fallback: List[str] = []
     for name in want:
@@ -130,6 +170,9 @@ def _decode_row_group(pf, fh, rg: int, rows: int, want, file_cols,
             fallback.append(name)
         else:
             native[name] = out
+    stats = (options or {}).get("_decode_stats")
+    if stats is not None and fallback:
+        stats["host_columns"] += len(fallback)
     fb_table = None
     if fallback:
         from .arrow_convert import arrow_to_host_table
@@ -171,7 +214,8 @@ def iter_row_group_tables_native(
             rows = pf.metadata.row_group(rg).num_rows
             try:
                 cols, names = _decode_row_group(pf, fh, rg, rows, want,
-                                                file_cols, declared)
+                                                file_cols, declared,
+                                                options)
             except Exception:
                 # per-ROW-GROUP fallback: earlier row groups already
                 # streamed out, so this one must be recovered in place

@@ -114,6 +114,15 @@ def _lib() -> ctypes.CDLL:
             lib.orc_rlev2.argtypes = [
                 u8p, ctypes.c_int64, ctypes.c_int32,
                 ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
+            lib.orc_decimal64.restype = ctypes.c_int64
+            lib.orc_decimal64.argtypes = [
+                u8p, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
+            lib.parquet_decode_chunk_binary.restype = ctypes.c_int64
+            lib.parquet_decode_chunk_binary.argtypes = [
+                u8p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int64,
+                ctypes.c_int32, i32p, u8p, ctypes.c_int64, u8p, u8p,
+                ctypes.c_int64]
             _LIB = lib
         return _LIB
 
@@ -263,6 +272,35 @@ def parquet_decode_chunk(chunk: bytes, codec: int, phys_type: int,
         _u8ptr(buf), len(chunk), codec, phys_type, num_rows,
         max_def_level, _u8ptr(values), values.nbytes,
         _u8ptr(validity), _u8ptr(scratch), scratch.nbytes)
+
+
+def parquet_decode_chunk_binary(chunk: bytes, codec: int, num_rows: int,
+                                max_def_level: int, offsets: np.ndarray,
+                                out_bytes: np.ndarray,
+                                validity: np.ndarray,
+                                scratch: np.ndarray) -> int:
+    """Decode one BYTE_ARRAY column chunk into offsets[num_rows+1]
+    (int32) + concatenated bytes. PLAIN / dictionary /
+    DELTA_LENGTH_BYTE_ARRAY / DELTA_BYTE_ARRAY. Returns rows decoded;
+    -3 also signals out_bytes too small (caller may retry bigger)."""
+    import ctypes as _ct
+    lib = _lib()
+    buf = np.frombuffer(chunk, dtype=np.uint8)
+    return int(lib.parquet_decode_chunk_binary(
+        _u8ptr(buf), len(chunk), codec, num_rows, max_def_level,
+        offsets.ctypes.data_as(_ct.POINTER(_ct.c_int32)),
+        _u8ptr(out_bytes), out_bytes.nbytes, _u8ptr(validity),
+        _u8ptr(scratch), scratch.nbytes))
+
+
+def orc_decimal64(src: np.ndarray, out: np.ndarray, count: int) -> int:
+    """ORC decimal DATA stream: zigzag unbounded varints -> int64
+    unscaled values (precision <= 18)."""
+    import ctypes as _ct
+    lib = _lib()
+    return int(lib.orc_decimal64(
+        _u8ptr(src), len(src),
+        out.ctypes.data_as(_ct.POINTER(_ct.c_int64)), count))
 
 
 def native_available() -> bool:

@@ -222,7 +222,12 @@ def _binary_arith(expr, table, op):
     if expr.ansi and out_t.is_integral:
         from ..expr import errors as ERR
         ao, bo = a.astype(object), b.astype(object)
-        exact = {"add": ao + bo, "sub": ao - bo, "mul": ao * bo}[op]
+        if op == "add":
+            exact = ao + bo
+        elif op == "sub":
+            exact = ao - bo
+        else:
+            exact = ao * bo
         info = np.iinfo(phys)
         bad = mask & np.array(
             [not (info.min <= int(v) <= info.max) for v in exact], bool)

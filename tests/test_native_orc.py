@@ -71,21 +71,88 @@ def test_native_orc_matches_pyarrow_path(tmp_path):
     assert got_on == got_off and len(got_on) > 0
 
 
-def test_native_orc_string_falls_back(tmp_path):
-    """String columns are outside the envelope: None (pyarrow path),
-    never wrong results."""
-    t = pa.table({"s": pa.array(["x", "y", None]),
-                  "v": pa.array([1, 2, 3], pa.int64())})
+def test_native_orc_strings_decode(tmp_path):
+    """Strings are inside the envelope since r5 (direct + dictionary
+    encodings) — native decode must match the written data exactly."""
+    t = pa.table({"s": pa.array(["x", "yy", None, "", "zzz"]),
+                  "v": pa.array([1, 2, 3, 4, 5], pa.int64())})
     p = str(tmp_path / "s.orc")
     orc.write_table(t, p)
-    assert read_orc_native(p, [("s", dt.STRING), ("v", dt.INT64)]) \
-        is None
-    # and the engine still reads it correctly via the fallback
+    ht = read_orc_native(p, [("s", dt.STRING), ("v", dt.INT64)])
+    assert ht is not None
+    s = ht.column("s")
+    assert list(s.mask) == [True, True, False, True, True]
+    assert [v for v, m in zip(s.values, s.mask) if m] == \
+        ["x", "yy", "", "zzz"]
+    # and the engine end-to-end agrees
     sess = TpuSession(SrtConf({}))
     rows = sess.read.orc(p, schema=[("s", dt.STRING),
                                     ("v", dt.INT64)]).collect()
-    assert [r["v"] for r in rows] == [1, 2, 3]
-    assert [r["s"] for r in rows] == ["x", "y", None]
+    assert [r["v"] for r in rows] == [1, 2, 3, 4, 5]
+    assert [r["s"] for r in rows] == ["x", "yy", None, "", "zzz"]
+
+
+def test_native_orc_string_dictionary(tmp_path):
+    """Low-cardinality strings trigger ORC's DICTIONARY_V2 encoding."""
+    rng = np.random.default_rng(5)
+    choices = np.array(["CA", "TX", "NY", "FL"])
+    vals = choices[rng.integers(0, 4, 20_000)]
+    mask = rng.random(20_000) < 0.1
+    t = pa.table({"st": pa.array(np.where(mask, "", vals), mask=mask)})
+    p = str(tmp_path / "dict.orc")
+    orc.write_table(t, p, compression="ZLIB")
+    ht = read_orc_native(p, [("st", dt.STRING)])
+    assert ht is not None
+    c = ht.column("st")
+    assert (np.asarray(c.mask) == ~mask).all()
+    got = np.asarray([v for v, m in zip(c.values, c.mask) if m])
+    assert (got == vals[~mask]).all()
+
+
+def test_native_orc_date_decimal_bool(tmp_path):
+    import datetime
+    import decimal
+    days = [0, 1, 365, -100, 19000]
+    decs = [decimal.Decimal("1.25"), decimal.Decimal("-99.99"),
+            decimal.Decimal("0.01"), None, decimal.Decimal("12345.67")]
+    bools = [True, False, None, True, False]
+    t = pa.table({
+        "dt": pa.array([datetime.date(1970, 1, 1)
+                        + datetime.timedelta(days=d) for d in days]),
+        "dec": pa.array(decs, pa.decimal128(9, 2)),
+        "bl": pa.array(bools),
+    })
+    p = str(tmp_path / "ddb.orc")
+    orc.write_table(t, p)
+    schema = [("dt", dt.DATE), ("dec", dt.DecimalType(9, 2)),
+              ("bl", dt.BOOL)]
+    ht = read_orc_native(p, schema)
+    assert ht is not None
+    assert list(ht.column("dt").values) == days
+    dc = ht.column("dec")
+    assert list(dc.mask) == [True, True, True, False, True]
+    got = [int(v) for v, m in zip(dc.values, dc.mask) if m]
+    assert got == [125, -9999, 1, 1234567]
+    bc = ht.column("bl")
+    assert list(bc.mask) == [True, True, False, True, True]
+    assert [bool(v) for v, m in zip(bc.values, bc.mask) if m] == \
+        [True, False, True, False]
+    # engine end-to-end (differential vs the pyarrow path)
+    on = TpuSession(SrtConf({}))
+    off = TpuSession(SrtConf({"srt.sql.format.orc.nativeDecode.enabled":
+                              False}))
+    r_on = on.read.orc(p, schema=schema).collect()
+    r_off = off.read.orc(p, schema=schema).collect()
+    assert r_on == r_off
+
+
+def test_native_orc_timestamp_falls_back(tmp_path):
+    import datetime
+    t = pa.table({"ts": pa.array([datetime.datetime(2020, 1, 1),
+                                  datetime.datetime(2021, 6, 15)])})
+    p = str(tmp_path / "ts.orc")
+    orc.write_table(t, p)
+    assert read_orc_native(p, [("ts", dt.TIMESTAMP)]) is None
 
 
 def test_native_orc_patched_base(tmp_path):
@@ -100,3 +167,39 @@ def test_native_orc_patched_base(tmp_path):
     ht = read_orc_native(p, [("x", dt.INT64)])
     assert ht is not None
     assert np.array_equal(ht.column("x").values, v)
+
+
+def test_scan_decode_path_metric(tmp_path):
+    """Native-vs-host decode is VISIBLE per scan (VERDICT r4 weak #7):
+    an in-envelope file bumps scanNativeDecodedFiles, a fallback file
+    bumps scanHostDecodedFiles."""
+    import datetime
+    from spark_rapids_tpu.exec.base import ExecContext
+    from spark_rapids_tpu.plan import overrides
+
+    def run_scan(path, schema):
+        sess = TpuSession(SrtConf({}))
+        df = sess.read.orc(path, schema=schema)
+        conf = sess.conf
+        physical = overrides.apply_overrides(df.plan, conf)
+        ctx = ExecContext(conf)
+        for _ in physical.execute(ctx):
+            pass
+        return {name: ms[name].value for ms in ctx.metrics.values()
+                for name in ms
+                if name.startswith("scan") and "Decoded" in name}
+
+    native_t = pa.table({"v": pa.array([1, 2, 3], pa.int64())})
+    p1 = str(tmp_path / "native.orc")
+    orc.write_table(native_t, p1)
+    m1 = run_scan(p1, [("v", dt.INT64)])
+    assert m1.get("scanNativeDecodedFiles") == 1
+    assert "scanHostDecodedFiles" not in m1
+
+    import pyarrow as pa2
+    ts_t = pa2.table({"ts": pa2.array([datetime.datetime(2020, 1, 1)])})
+    p2 = str(tmp_path / "host.orc")
+    orc.write_table(ts_t, p2)
+    m2 = run_scan(p2, [("ts", dt.TIMESTAMP)])
+    assert m2.get("scanHostDecodedFiles") == 1
+    assert "scanNativeDecodedFiles" not in m2

@@ -85,15 +85,73 @@ def test_multi_row_group_and_slicing(tmp_path):
     assert rows == list(range(n))
 
 
-def test_string_columns_fall_back_per_column(tmp_path):
+def _native_dict_no_fallback(path, schema, monkeypatch):
+    """Force the NATIVE lane: any pyarrow fallback fails the test."""
+    from spark_rapids_tpu.io import arrow_convert
+
+    def boom(*a, **k):
+        raise AssertionError("fell back to pyarrow")
+    monkeypatch.setattr(arrow_convert, "arrow_to_host_table", boom)
+    return _native_dict(path, schema)
+
+
+def test_string_columns_decode_native(tmp_path, monkeypatch):
+    """BYTE_ARRAY strings are inside the native envelope since r5
+    (PLAIN + dictionary); the fallback must NOT fire."""
     table = pa.table({
         "s": pa.array(["a", None, "ccc"] * 100),
         "v": pa.array(range(300), type=pa.int64()),
     })
     p = _write(tmp_path, table)
-    got = _native_dict(p, [("s", dt.STRING), ("v", dt.INT64)])
+    got = _native_dict_no_fallback(
+        p, [("s", dt.STRING), ("v", dt.INT64)], monkeypatch)
     assert got["v"] == list(range(300))
     assert got["s"] == ["a", None, "ccc"] * 100
+
+
+@pytest.mark.parametrize("enc", ["DELTA_LENGTH_BYTE_ARRAY",
+                                 "DELTA_BYTE_ARRAY"])
+@pytest.mark.parametrize("codec", ["snappy", "zstd", "none"])
+def test_delta_string_encodings(tmp_path, enc, codec, monkeypatch):
+    """Spark 3.3+ v2 writers emit the DELTA string family
+    (GpuParquetScan.scala:2889-scale envelope)."""
+    rng = np.random.default_rng(11)
+    words = ["prefix_shared_" + str(i // 7) + "_suffix" + str(i)
+             for i in range(5000)]
+    vals = [None if rng.random() < 0.08 else words[i]
+            for i in range(5000)]
+    table = pa.table({"s": pa.array(vals)})
+    p = _write(tmp_path, table, use_dictionary=False,
+               column_encoding={"s": enc}, compression=codec,
+               data_page_version="2.0")
+    got = _native_dict_no_fallback(p, [("s", dt.STRING)], monkeypatch)
+    assert got["s"] == vals
+
+
+def test_delta_strings_v1_pages(tmp_path, monkeypatch):
+    vals = ["aa", "ab", "abc", None, "b", ""] * 500
+    table = pa.table({"s": pa.array(vals)})
+    p = _write(tmp_path, table, use_dictionary=False,
+               column_encoding={"s": "DELTA_BYTE_ARRAY"},
+               compression="snappy", data_page_version="1.0")
+    got = _native_dict_no_fallback(p, [("s", dt.STRING)], monkeypatch)
+    assert got["s"] == vals
+
+
+def test_byte_stream_split_floats(tmp_path, monkeypatch):
+    rng = np.random.default_rng(4)
+    f32 = rng.standard_normal(4000).astype(np.float32)
+    f64 = rng.standard_normal(4000)
+    table = pa.table({"a": pa.array(f32, pa.float32()),
+                      "b": pa.array(f64, pa.float64())})
+    p = _write(tmp_path, table, use_dictionary=False,
+               column_encoding={"a": "BYTE_STREAM_SPLIT",
+                                "b": "BYTE_STREAM_SPLIT"},
+               compression="zstd")
+    got = _native_dict_no_fallback(
+        p, [("a", dt.FLOAT32), ("b", dt.FLOAT64)], monkeypatch)
+    assert np.array_equal(np.array(got["a"], np.float32), f32)
+    assert np.array_equal(np.array(got["b"]), f64)
 
 
 def test_scan_end_to_end_matches_disabled(tmp_path):
