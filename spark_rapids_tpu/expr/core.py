@@ -194,7 +194,19 @@ def _infer_literal_dtype(value) -> dt.DType:
     if isinstance(value, bool):
         return dt.BOOL
     if isinstance(value, int):
-        return dt.INT32 if -(2**31) <= value < 2**31 else dt.INT64
+        if -(2**31) <= value < 2**31:
+            return dt.INT32
+        if -(2**63) <= value < 2**63:
+            return dt.INT64
+        # Spark types integral literals beyond long as DecimalType
+        # (Literal.apply on BigInt/BigDecimal); beyond 38 digits Spark
+        # fails analysis (DECIMAL_PRECISION_EXCEEDED) — mirror that
+        # rather than silently clamping to an unrepresentable type
+        digits = len(str(abs(value)))
+        if digits > 38:
+            raise TypeError(
+                f"integral literal needs precision {digits} > 38")
+        return dt.DecimalType(digits, 0)
     if isinstance(value, float):
         return dt.FLOAT64
     if isinstance(value, str):

@@ -516,6 +516,10 @@ def cast_from_string(c: StringColumn, to: dt.DType) -> Column:
     lens = c.lengths()
     if to.is_integral:
         val, ok = _parse_int(padded, lens)
+        # out-of-range for the TARGET width -> null (Spark castToInt:
+        # UTF8String.toInt returns failure, never wraps)
+        lo_b, hi_b = int(dt.min_value(to)), int(dt.max_value(to))
+        ok = ok & (val >= lo_b) & (val <= hi_b)
         data = val.astype(to.physical)
         return make_result(data, c.validity & ok, to)
     if to.is_floating:
@@ -559,12 +563,38 @@ def _parse_int(padded, lens):
     in_num = (k[None, :] >= dstart[:, None]) & (k[None, :] < end[:, None])
     digit = padded - jnp.uint8(48)
     is_digit = (padded >= 48) & (padded <= 57)
-    ok = nonempty & (end > dstart) & jnp.all(~in_num | is_digit, axis=1)
+    # UTF8String.toLong accepts one '.' — the fraction (all digits)
+    # truncates toward zero: '12.7' -> 12, '12.' -> 12
+    dot_mask = in_num & (padded == 46)
+    has_dot = jnp.any(dot_mask, axis=1)
+    dot_pos = jnp.where(has_dot, jnp.argmax(dot_mask, axis=1),
+                        end).astype(jnp.int32)
+    int_zone = in_num & (k[None, :] < dot_pos[:, None])
+    frac_zone = in_num & (k[None, :] > dot_pos[:, None])
+    ok = nonempty & (dot_pos > dstart) \
+        & (jnp.sum(dot_mask, axis=1) <= 1) \
+        & jnp.all(~int_zone | is_digit, axis=1) \
+        & jnp.all(~frac_zone | is_digit, axis=1)
+    in_num = int_zone
+    end = dot_pos
     val = jnp.zeros(cap, jnp.int64)
     for i in range(w):
         use = in_num[:, i]
         val = jnp.where(use, val * 10 + digit[:, i].astype(jnp.int64), val)
-    val = jnp.where(neg, -val, val)
+    # int64 wrap detection: significant digits (leading zeros don't
+    # count) beyond 18 can exceed 2^63-1; a 19-digit wrap flips the
+    # accumulated value negative, more digits always overflow.
+    # Long.MIN ("-9223372036854775808") wraps to exactly MIN with the
+    # negative sign applied, which IS representable — allow it.
+    nonzero = in_num & (digit != 0) & is_digit
+    any_sig = jnp.any(nonzero, axis=1)
+    first_sig = jnp.where(any_sig, jnp.argmax(nonzero, axis=1),
+                          end).astype(jnp.int32)
+    sig = jnp.where(any_sig, end - first_sig, 0)
+    wrapped = (sig == 19) & (val < 0)
+    min_long = wrapped & neg & (val == jnp.int64(-2 ** 63))
+    ok = ok & (sig <= 18) | (ok & (sig == 19) & (~wrapped | min_long))
+    val = jnp.where(neg & ~min_long, -val, val)
     return val, ok
 
 
@@ -619,6 +649,27 @@ def _parse_float(padded, lens):
     exp = ev - ndig_after_dot
     val = mant * jnp.power(10.0, exp.astype(jnp.float64))
     val = jnp.where(neg, -val, val)
+    # special literals (Cast.processFloatingPointSpecialLiterals,
+    # case-insensitive after trim): inf/infinity/nan with optional sign
+    lowered = jnp.where((padded >= 65) & (padded <= 90), padded + 32,
+                        padded)
+
+    def _match_at(s: bytes, from_pos):
+        arr = jnp.asarray(np.frombuffer(s, np.uint8))
+        n = len(s)
+        idx = from_pos[:, None] + jnp.arange(n, dtype=jnp.int32)[None, :]
+        got = jnp.take_along_axis(lowered, jnp.clip(idx, 0, w - 1),
+                                  axis=1)
+        return (end - from_pos == n) & jnp.all(got == arr[None, :],
+                                               axis=1)
+
+    is_inf = _match_at(b"inf", pos0) | _match_at(b"infinity", pos0)
+    is_nan = _match_at(b"nan", pos0) & ~has_sign
+    special = is_inf | is_nan
+    inf_v = jnp.where(neg, -jnp.inf, jnp.inf)
+    val = jnp.where(is_inf, inf_v, val)
+    val = jnp.where(is_nan, jnp.nan, val)
+    ok = ok | (nonempty & special)
     return val, ok
 
 
@@ -647,22 +698,32 @@ def _parse_bool(c: StringColumn, padded, lens):
 
 
 def _parse_date(padded, lens):
-    """yyyy-[m]m-[d]d (Spark's accepted date literal forms, no time part)."""
+    """Spark DateTimeUtils.stringToDate forms: ``yyyy``, ``yyyy-[m]m``,
+    ``yyyy-[m]m-[d]d`` with an ignored trailing ``T…``/`` …`` time
+    segment after a full date; whitespace-trimmed; REAL calendar
+    validation (2019-02-29 -> null, no rollover)."""
     cap, w = padded.shape
     is_digit = (padded >= 48) & (padded <= 57)
     is_dash = padded == 45
     k = jnp.arange(w, dtype=jnp.int32)[None, :]
-    in_str = k < lens[:, None]
+    start, end0, nonempty = _strip_bounds(padded, lens)
+    # the date part ends at the first 'T' or ' ' inside the trimmed
+    # region (Spark allows a trailing time segment)
+    in_trim = (k >= start[:, None]) & (k < end0[:, None])
+    t_mask = in_trim & ((padded == 84) | (padded == 32))
+    has_t = jnp.any(t_mask, axis=1)
+    end = jnp.where(has_t, jnp.argmax(t_mask, axis=1),
+                    end0).astype(jnp.int32)
+    in_str = (k >= start[:, None]) & (k < end[:, None])
     dash_mask = in_str & is_dash
-    # first and second dash positions
+    n_dash = jnp.sum(dash_mask, axis=1)
     first_dash = jnp.where(jnp.any(dash_mask, axis=1),
-                           jnp.argmax(dash_mask, axis=1), 0).astype(jnp.int32)
+                           jnp.argmax(dash_mask, axis=1),
+                           end).astype(jnp.int32)
     after = dash_mask & (k > first_dash[:, None])
     second_dash = jnp.where(jnp.any(after, axis=1),
-                            jnp.argmax(after, axis=1), 0).astype(jnp.int32)
-    ok = (jnp.sum(dash_mask, axis=1) == 2) & (first_dash == 4) & \
-        (second_dash > 5) & (second_dash <= 7) & (lens > second_dash) & \
-        (lens <= second_dash + 3)
+                            jnp.argmax(after, axis=1),
+                            end).astype(jnp.int32)
 
     def parse_span(lo, hi):
         v = jnp.zeros(cap, jnp.int32)
@@ -673,10 +734,30 @@ def _parse_date(padded, lens):
             good = good & jnp.where(use, is_digit[:, i], True)
         return v, good
 
-    y, gy = parse_span(jnp.zeros(cap, jnp.int32), first_dash)
+    y, gy = parse_span(start, first_dash)
     m, gm = parse_span(first_dash + 1, second_dash)
-    d, gd = parse_span(second_dash + 1, lens)
-    ok = ok & gy & gm & gd & (m >= 1) & (m <= 12) & (d >= 1) & (d <= 31)
+    d, gd = parse_span(second_dash + 1, end)
+    ylen = first_dash - start
+    mlen = second_dash - first_dash - 1
+    dlen = end - second_dash - 1
+    # segment-shape validity per dash count (year is 4 digits; month &
+    # day 1-2; a time suffix needs a COMPLETE date before it)
+    y_ok = gy & (ylen == 4)
+    shape0 = (n_dash == 0) & y_ok & ~has_t
+    shape1 = (n_dash == 1) & y_ok & gm & (mlen >= 1) & (mlen <= 2) \
+        & ~has_t
+    shape2 = (n_dash == 2) & y_ok & gm & gd & (mlen >= 1) & (mlen <= 2) \
+        & (dlen >= 1) & (dlen <= 2)
+    m = jnp.where(n_dash >= 1, m, 1)
+    d = jnp.where(n_dash >= 2, d, 1)
+    ok = nonempty & (shape0 | shape1 | shape2) & (m >= 1) & (m <= 12)
+    # real month lengths (proleptic Gregorian leap rule)
+    leap = ((y % 4 == 0) & (y % 100 != 0)) | (y % 400 == 0)
+    dim = jnp.asarray([31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31],
+                      jnp.int32)
+    max_d = jnp.take(dim, jnp.clip(m - 1, 0, 11))
+    max_d = jnp.where((m == 2) & leap, 29, max_d)
+    ok = ok & (d >= 1) & (d <= max_d)
     days = _days_from_civil(y.astype(jnp.int64), m.astype(jnp.int64),
                             d.astype(jnp.int64))
     return days, ok

@@ -355,6 +355,8 @@ def read_orc_native(path: str, schema) -> Optional[HostTable]:
                             return None
                         data_nn = bits[:nn].astype(np.int64)
                     elif tkind == _K_DECIMAL:
+                        if enc != 2:
+                            return None  # RLEv1 scale stream: fall back
                         vals = np.zeros(max(nn, 1), np.int64)
                         got = orc_decimal64(
                             np.frombuffer(raw, np.uint8), vals, nn)

@@ -133,7 +133,7 @@ def _decode_native(fh, plan: _ChunkPlan, rows: int):
             break
         if got != rows:
             return None
-        blob = out_bytes.tobytes()
+        blob = out_bytes[:int(offsets[rows])].tobytes()
         vals = np.empty(rows, object)
         mv = validity.astype(bool)
         for k in range(rows):

@@ -175,6 +175,52 @@ def _coerced(expr, table):
         rc.data_type(table.schema())
 
 
+def _spark_string_to_date(s: str) -> int:
+    """DateTimeUtils.stringToDate: yyyy | yyyy-[m]m | yyyy-[m]m-[d]d
+    (a trailing 'T…'/' …' time segment after a FULL date is ignored);
+    real calendar validation. Returns epoch days; raises ValueError on
+    any invalid form (caller maps to null / ANSI error)."""
+    import datetime
+    body = s
+    for cut in ("T", " "):
+        p = body.find(cut)
+        if p >= 0:
+            if body[:p].count("-") != 2:
+                raise ValueError(s)
+            body = body[:p]
+    parts = body.split("-")
+    if not 1 <= len(parts) <= 3 or len(parts[0]) != 4:
+        raise ValueError(s)
+    vals = []
+    for seg in parts:
+        if not seg.isdigit() or len(seg) == 0 or len(seg) > 4:
+            raise ValueError(s)
+        vals.append(int(seg))
+    y = vals[0]
+    mth = vals[1] if len(vals) > 1 else 1
+    d = vals[2] if len(vals) > 2 else 1
+    if len(vals) > 1 and len(parts[1]) > 2:
+        raise ValueError(s)
+    if len(vals) > 2 and len(parts[2]) > 2:
+        raise ValueError(s)
+    # proleptic Gregorian incl. year 0 (datetime.date rejects y < 1,
+    # but Spark's LocalDate and the device lane accept it)
+    if not 1 <= mth <= 12:
+        raise ValueError(s)
+    leap = (y % 4 == 0 and y % 100 != 0) or y % 400 == 0
+    dim = [31, 29 if leap else 28, 31, 30, 31, 30, 31, 31, 30, 31, 30,
+           31][mth - 1]
+    if not 1 <= d <= dim:
+        raise ValueError(s)
+    # Howard Hinnant's days_from_civil (same formula as the device)
+    yy = y - (mth <= 2)
+    era = (yy if yy >= 0 else yy - 399) // 400
+    yoe = yy - era * 400
+    doy = (153 * (mth + (-3 if mth > 2 else 9)) + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
 def _ansi_raise_if(mask, exc) -> None:
     """Oracle-side ANSI guard: mirrors expr/ansi.guard so both engines
     raise the same error types (error-equality differential contract)."""
@@ -1587,7 +1633,22 @@ def _cast(expr, table):
                     out[i] = int(decimal.Decimal(s)
                                  .scaleb(to_t.scale).to_integral_value())
                 elif to_t.is_floating:
-                    out[i] = float(s)
+                    # Cast.processFloatingPointSpecialLiterals: signed
+                    # inf/infinity + unsigned nan, case-insensitive;
+                    # python float() would reject 'Infinity'? (it
+                    # accepts 'inf'/'infinity'/'nan' — normalize anyway
+                    # so both engines share one rule)
+                    sl = s.lower()
+                    if sl in ("inf", "+inf", "infinity", "+infinity"):
+                        out[i] = np.inf
+                    elif sl in ("-inf", "-infinity"):
+                        out[i] = -np.inf
+                    elif sl == "nan":
+                        out[i] = np.nan
+                    elif sl in ("+nan", "-nan"):
+                        raise ValueError(s)  # Spark: nan takes no sign
+                    else:
+                        out[i] = float(s)
                 elif to_t == dt.BOOL:
                     sl = s.lower()
                     if sl in ("t", "true", "y", "yes", "1"):
@@ -1597,12 +1658,29 @@ def _cast(expr, table):
                     else:
                         raise ValueError(s)
                 elif to_t == dt.DATE:
-                    import datetime
-                    out[i] = (datetime.date.fromisoformat(s[:10])
-                              - datetime.date(1970, 1, 1)).days
+                    out[i] = _spark_string_to_date(s)
                 else:
-                    out[i] = int(float(s)) if ("." in s or "e" in s.lower()) \
-                        else int(s)
+                    # UTF8String.toLong semantics, mirrored exactly
+                    # with the device _parse_int: optional sign, ASCII
+                    # digits, one optional '.' with an all-digit
+                    # fraction that TRUNCATES (no float round-trip —
+                    # '1.9999999999999999' is 1, not 2); scientific
+                    # notation is invalid
+                    body = s
+                    sign = 1
+                    if body[:1] in ("+", "-"):
+                        sign = -1 if body[0] == "-" else 1
+                        body = body[1:]
+                    intpart, _, frac = body.partition(".")
+                    if not intpart or \
+                            not all("0" <= ch <= "9" for ch in intpart) \
+                            or not all("0" <= ch <= "9" for ch in frac):
+                        raise ValueError(s)
+                    iv = sign * int(intpart)
+                    info = np.iinfo(np.dtype(to_t.physical))
+                    if not info.min <= iv <= info.max:
+                        raise ValueError(s)  # out of range -> null
+                    out[i] = iv
                 ok[i] = True
             except (ValueError, ArithmeticError):
                 ok[i] = False
@@ -1712,9 +1790,19 @@ def _cast(expr, table):
         _ansi_raise_if(m & bad, ERR.SparkCastOverflowException(
             f"casting {from_t} to {to_t} causes overflow (ANSI mode)"))
     if from_t.is_floating and not (to_t.is_floating or to_t == dt.BOOL):
+        # Scala Double.toLong semantics: NaN -> 0, out-of-range
+        # saturates (np.trunc(...).astype alone is UB for both)
+        info = np.iinfo(phys)
         with np.errstate(invalid="ignore"):
-            out = np.trunc(a).astype(phys)
-        return _zero_nulls(out, m), m
+            x = np.where(np.isnan(a), 0.0, a)
+            t = np.trunc(x)
+            out = np.clip(t, float(info.min), float(info.max))
+            out = out.astype(phys)
+            # float64(int64.max) rounds UP to 2^63: clip leaves 2^63
+            # which astype wraps — pin explicitly
+            out = np.where(t >= float(info.max), info.max, out)
+            out = np.where(t <= float(info.min), info.min, out)
+        return _zero_nulls(out.astype(phys), m), m
     with np.errstate(over="ignore"):
         out = a.astype(phys)
     return _zero_nulls(out, m), m

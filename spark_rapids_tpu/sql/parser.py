@@ -687,11 +687,24 @@ class Parser:
         while True:
             op = self.accept_op("*", "/", "%")
             if not op:
+                if self.at_kw("div"):  # integral division keyword op
+                    self.next()
+                    e = BinA("div", e, self.parse_unary())
+                    continue
                 return e
             e = BinA(op, e, self.parse_unary())
 
     def parse_unary(self) -> Ast:
         if self.accept_op("-"):
+            t = self.peek()
+            if t.kind == "NUMBER":
+                # fold the sign into the literal (Spark AstBuilder does
+                # this so Long.MinValue is a VALID literal rather than
+                # -(9223372036854775808) overflowing to decimal)
+                self.next()
+                if "." in t.value or "e" in t.value.lower():
+                    return LitA(-float(t.value))
+                return LitA(-int(t.value))
             return UnA("-", self.parse_unary())
         if self.accept_op("+"):
             return self.parse_unary()
@@ -2041,6 +2054,8 @@ class Analyzer:
             return A.Multiply(l, r)
         if op == "/":
             return A.Divide(l, r)
+        if op == "div":
+            return A.IntegralDivide(l, r)
         if op == "%":
             return A.Remainder(l, r)
         if op == "||":
