@@ -241,11 +241,13 @@ class AcidTable:
         lk = [col(n) for n in on]
         rk = [col(f"src_{n}") for n in on]
 
-        # Delta contract: a target row may match at most one source row
-        from ..expr.aggregates import CountStar, Max
-        dup = source.group_by(*[col(n) for n in on]).agg(
-            Alias(CountStar(), "__n")).filter(col("__n") > 1)
-        if dup.count() > 0:
+        # Delta contract: a target row may match at most one source
+        # row. Validated HOST-side over the projected keys — a plain
+        # pandas duplicate check instead of a traced group-by+filter
+        # plan (the check is a guard, not a query; the old plan cost
+        # more cold trace/compile than the merge rewrite itself)
+        keys = source.select(*[col(n) for n in on]).to_pandas()
+        if len(keys) != len(keys.drop_duplicates()):
             raise ValueError(
                 "MERGE: multiple source rows matched the same key")
 
