@@ -195,11 +195,13 @@ def pandas_delta_merge(n, half):
                              "amount": rng.uniform(0, 1e4, n),
                              "flag": np.zeros(n, np.int32)})
         base.to_parquet(os.path.join(d, "t.parquet"))
-        t0 = time.perf_counter()
-        tgt = pd.read_parquet(os.path.join(d, "t.parquet"))
+        # source built OUTSIDE the timed region — the engine lane also
+        # constructs its source DataFrame before its timer starts
         src = pd.DataFrame({"k": np.arange(half, n + half),
                             "amount": rng.uniform(0, 1e4, n),
                             "flag": np.ones(n, np.int32)})
+        t0 = time.perf_counter()
+        tgt = pd.read_parquet(os.path.join(d, "t.parquet"))
         if src["k"].duplicated().any():
             raise ValueError("dup keys")
         merged = tgt.merge(src, on="k", how="outer",

@@ -242,12 +242,20 @@ class AcidTable:
         rk = [col(f"src_{n}") for n in on]
 
         # Delta contract: a target row may match at most one source
-        # row. Validated HOST-side over the projected keys — a plain
-        # pandas duplicate check instead of a traced group-by+filter
-        # plan (the check is a guard, not a query; the old plan cost
-        # more cold trace/compile than the merge rewrite itself)
-        keys = source.select(*[col(n) for n in on]).to_pandas()
-        if len(keys) != len(keys.drop_duplicates()):
+        # row. Validated HOST-side over the projected keys' PHYSICAL
+        # lanes (values + null mask as separate columns, so NULL stays
+        # distinct from NaN and from genuine zero, matching the old
+        # group-by's Spark grouping semantics) — a vectorized duplicate
+        # check instead of a traced group-by+filter plan, which cost
+        # more cold trace/compile than the merge rewrite itself.
+        import pandas as pd
+        key_ht = self.session.execute(
+            source.select(*[col(n) for n in on]).plan)
+        key_cols = {}
+        for i, c in enumerate(key_ht.columns):
+            key_cols[f"v{i}"] = c.values
+            key_cols[f"m{i}"] = c.mask
+        if pd.DataFrame(key_cols).duplicated().any():
             raise ValueError(
                 "MERGE: multiple source rows matched the same key")
 
