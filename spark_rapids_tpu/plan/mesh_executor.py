@@ -82,6 +82,12 @@ class MeshQueryExecutor:
         self.conf = conf or active_conf()
         self.join_growth = join_growth
         self._leaves: List[TpuExec] = []
+        #: traced sufficiency flags appended during lowering-closure
+        #: execution (join output capacity checks); returned from the
+        #: shard program so overflow FAILS the query instead of
+        #: silently dropping matches (single-stream joins grow-and-
+        #: retry on the host; a traced SPMD program cannot)
+        self._checks: List = []
 
     # ------------------------------------------------------------------
     # host side
@@ -98,16 +104,26 @@ class MeshQueryExecutor:
         def shard_step(*stacked):
             env = {id(leaf): jax.tree_util.tree_map(lambda x: x[0], st)
                    for leaf, st in zip(self._leaves, stacked)}
+            self._checks = []
             out = fn(env)
-            return jax.tree_util.tree_map(lambda x: x[None], out)
+            ok = jnp.ones((), jnp.bool_)
+            for c in self._checks:
+                ok = ok & c
+            return jax.tree_util.tree_map(lambda x: x[None], (out, ok))
 
         from ..shims import shard_map as _shard_map
         step = jax.jit(_shard_map()(
             shard_step, mesh=self.mesh,
             in_specs=tuple(P(self.axis) for _ in range(n_leaves)),
             out_specs=P(self.axis), check_vma=False))
-        res = step(*stacks)
+        res, ok = step(*stacks)
         jax.block_until_ready(jax.tree_util.tree_leaves(res))
+        if not bool(jnp.all(ok)):
+            raise RuntimeError(
+                "mesh join output overflowed its static capacity "
+                "(matches > probe_capacity * join_growth) — results "
+                "would silently drop rows; raise join_growth or "
+                "repartition finer")
         return [b for b in unstack_shards(res) if int(b.num_rows) > 0]
 
     def _leaf_stack(self, leaf: TpuExec, ctx: ExecContext):
@@ -356,14 +372,17 @@ class MeshQueryExecutor:
             out_cap = round_pow2(probe.capacity * growth)
             jt = node.join_type
             if jt in ("left_semi", "left_anti"):
-                out, _ = K.semi_anti_join(
+                out, total = K.semi_anti_join(
                     probe, bk, pk, build.live_mask(),
                     anti=(jt == "left_anti"),
                     scratch_capacity=out_cap)
             elif jt == "inner":
-                out, _ = K.inner_join(probe, build, pk, bk, out_cap)
+                out, total = K.inner_join(probe, build, pk, bk, out_cap)
             else:
-                out, _ = K.left_join(probe, build, pk, bk, out_cap)
+                out, total = K.left_join(probe, build, pk, bk, out_cap)
+            # the kernel reports the TRUE required size; overflow fails
+            # the run (checked host-side) rather than dropping matches
+            self._checks.append(total <= out_cap)
             return node._reorder_columns(out)
         return join_fn
 
@@ -451,6 +470,25 @@ def _normalize_strings(batches: List[ColumnarBatch]) -> None:
 
 
 def run_on_mesh(physical: TpuExec, mesh: Mesh,
-                conf: Optional[SrtConf] = None) -> List[ColumnarBatch]:
-    """Convenience wrapper: compile + run one plan over a mesh."""
-    return MeshQueryExecutor(mesh, conf).run(physical)
+                conf: Optional[SrtConf] = None,
+                join_growth: int = 2,
+                max_join_growth: int = 64) -> List[ColumnarBatch]:
+    """Compile + run one plan over a mesh with whole-program join
+    grow-and-retry: a traced SPMD program cannot grow a join output
+    mid-flight the way the single-stream exec does per batch
+    (exec/join.py _join_pair), so overflow reports re-lower the WHOLE
+    plan at doubled growth until the true size fits — skew-free plans
+    settle on the first compile."""
+    g = join_growth
+    while True:
+        try:
+            return MeshQueryExecutor(mesh, conf, join_growth=g) \
+                .run(physical)
+        except RuntimeError as e:
+            if "mesh join output overflowed" not in str(e) \
+                    or g >= max_join_growth:
+                raise
+            g *= 2
+            # every retry MUST reset stateful exchange/broadcast nodes
+            # before leaves re-execute
+            physical.reset_for_rerun()
