@@ -24,7 +24,11 @@ def _pin_cpu_emulation() -> None:
     """Standalone/subprocess entry ONLY (must run before jax imports):
     embedded callers (__graft_entry__.dryrun_multichip_nds) keep
     whatever platform the driver initialized."""
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # explicit assignment: the launching shell may export
+    # JAX_PLATFORMS=axon (the TPU tunnel), and a dead tunnel turns
+    # backend init into an infinite sleep-retry — the standalone tool
+    # is cpu-emulation by definition
+    os.environ["JAX_PLATFORMS"] = "cpu"
     flags = os.environ.get("XLA_FLAGS", "")
     if "host_platform_device_count" not in flags:
         flags += " --xla_force_host_platform_device_count=8"
@@ -51,8 +55,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 #: 20+ minutes per query — they run in the single-stream differential
 #: proof (NDS_100K_PROOF) and are out of this subset's budget, not its
 #: vocabulary.
-SUBSET = ["q3", "q7", "q19", "q36", "q38", "q42", "q51", "q52",
-          "q55", "q62", "q67", "q68", "q77", "q87", "q89", "q96"]
+#: cheap-first order: a timeboxed run persists incrementally, so the
+#: record carries maximal coverage even when the heavy tail is cut
+SUBSET = ["q42", "q52", "q55", "q96", "q62", "q3", "q19", "q38",
+          "q87", "q36", "q77", "q51", "q89", "q68", "q67", "q7"]
 
 
 def run_subset(scale_rows: int, qids=None, n_devices: int = 8):
@@ -138,7 +144,16 @@ def _run_one_subprocess(qid: str, scale: int, n_devices: int,
     1-core thread-starvation flake, LOG(FATAL) kills the process) then
     loses one ATTEMPT, not the whole record; retries re-roll the
     scheduler."""
+    import resource
     import subprocess
+
+    def _cap_memory():
+        # q19-class mesh programs have blown past 100 GB on retry
+        # ladders; cap the subprocess address space so a memory bomb
+        # dies as ONE failed attempt instead of OOMing the box
+        lim = 48 * 2 ** 30
+        resource.setrlimit(resource.RLIMIT_AS, (lim, lim))
+
     last = None
     for attempt in range(attempts):
         t0 = time.time()
@@ -146,7 +161,8 @@ def _run_one_subprocess(qid: str, scale: int, n_devices: int,
             p = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), "--one",
                  qid, str(scale), str(n_devices)],
-                capture_output=True, timeout=timeout_s)
+                capture_output=True, timeout=timeout_s,
+                preexec_fn=_cap_memory)
             out = p.stdout.decode("utf-8", "replace")
             for line in reversed(out.splitlines()):
                 if line.startswith("{"):
@@ -179,15 +195,53 @@ def main():
     out_path = sys.argv[1] if len(sys.argv) > 1 else "MESH_NDS_r05.json"
     t0 = time.time()
     full = {}
-    for qid in SUBSET:
-        full[qid] = _run_one_subprocess(qid, 8000, 8, timeout_s=1500)
-        print(f"vocab {qid}: {full[qid]}", flush=True)
     at_scale = {}
+    # resume: earlier ok results in an existing record are kept (the
+    # driver may be restarted after pruning a pathological query)
+    try:
+        with open(out_path) as f:
+            prev = json.load(f)
+        full.update({q: r for q, r in prev.get(
+            "vocabulary_pass", {}).get("per_query", {}).items()
+            if r.get("ok")})
+        at_scale.update({q: r for q, r in prev.get(
+            "scale_pass", {}).get("per_query", {}).items()
+            if r.get("ok")})
+    except Exception:
+        pass
+
+    def persist():
+        rec = _record(full, at_scale, time.time() - t0)
+        with open(out_path, "w") as f:
+            json.dump(rec, f, indent=1)
+        return rec
+
+    # scale pass FIRST: the >=100k datapoints carry the most evidence
+    # weight; the vocabulary tail fills whatever budget remains
     for qid in SCALE_SUBSET:
+        if qid in at_scale:
+            continue
         at_scale[qid] = _run_one_subprocess(qid, 100_000, 2,
                                             timeout_s=1800)
         print(f"scale {qid}: {at_scale[qid]}", flush=True)
-    rec = {
+        persist()
+    for qid in SUBSET:
+        if qid in full:
+            continue
+        full[qid] = _run_one_subprocess(qid, 8000, 8, timeout_s=1500)
+        print(f"vocab {qid}: {full[qid]}", flush=True)
+        persist()
+    rec = persist()
+    print(json.dumps({
+        "vocab_ok": rec["vocabulary_pass"]["queries_ok"],
+        "vocab_total": rec["vocabulary_pass"]["queries_total"],
+        "scale_ok": rec["scale_pass"]["queries_ok"],
+        "scale_total": rec["scale_pass"]["queries_total"],
+        "total_s": rec["total_s"]}))
+
+
+def _record(full, at_scale, elapsed):
+    return {
         "vocabulary_pass": {
             "scale_rows": 8000, "n_devices": 8,
             "queries_ok": sum(1 for r in full.values() if r["ok"]),
@@ -196,16 +250,8 @@ def main():
             "scale_rows": 100_000, "n_devices": 2,
             "queries_ok": sum(1 for r in at_scale.values() if r["ok"]),
             "queries_total": len(at_scale), "per_query": at_scale},
-        "total_s": round(time.time() - t0, 1),
+        "total_s": round(elapsed, 1),
     }
-    with open(out_path, "w") as f:
-        json.dump(rec, f, indent=1)
-    print(json.dumps({
-        "vocab_ok": rec["vocabulary_pass"]["queries_ok"],
-        "vocab_total": rec["vocabulary_pass"]["queries_total"],
-        "scale_ok": rec["scale_pass"]["queries_ok"],
-        "scale_total": rec["scale_pass"]["queries_total"],
-        "total_s": rec["total_s"]}))
 
 
 if __name__ == "__main__":
