@@ -422,6 +422,33 @@ class HashAggregateExec(TpuExec):
                 return child.execute_partition_groups(ctx, groups)
         return child.execute_partitioned(ctx)
 
+    def execute_partitioned(self, ctx: ExecContext):
+        """A FINAL grouped aggregate ADVERTISES its child exchange's
+        hash partitioning (output_partitioning above), so partition-wise
+        consumers (a co-partitioned join) must see one output partition
+        per child partition — the default whole-stream yield made the
+        advertisement a lie: a join zipping this against a real
+        N-partition exchange raised 'partition counts differ' (or worse
+        under same-count coalescing). Found by the SF1 run (q11/q74:
+        the build side outgrew adaptive broadcast at 3M rows and the
+        zip path engaged)."""
+        if self.mode != FINAL or not self.group_exprs:
+            yield self.execute(ctx)
+            return
+        m = ctx.metrics_for(self.exec_id)
+        agg_time = m.setdefault("aggTime", Metric("aggTime",
+                                                  Metric.MODERATE, "ns"))
+        for part in self._final_merge_partitions(ctx, agg_time):
+            # partitioned consumers bypass execute(): account here
+            yield self._measure_stream(ctx, part)
+
+    def _final_merge_partitions(self, ctx: ExecContext, agg_time):
+        """One merged output stream per child partition — the single
+        source of truth for FINAL grouped merging (both consumption
+        paths flatten this)."""
+        for part in self._child_partitions(ctx):
+            yield self._merge_partition(ctx, part, agg_time)
+
     def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
         m = ctx.metrics_for(self.exec_id)
         agg_time = m.setdefault("aggTime", Metric("aggTime", Metric.MODERATE,
@@ -435,14 +462,19 @@ class HashAggregateExec(TpuExec):
             yield from self._partial_stream(ctx, agg_time)
             return
         if self.mode == FINAL:
-            # partition-wise merge: >=1 output batch per child partition
-            # (AQE coalesces small shuffle partitions into one merge)
+            if self.group_exprs:
+                # same loop the partitioned consumers use — but through
+                # the UNMEASURED core: execute() wraps this method with
+                # the output accounting already
+                for part in self._final_merge_partitions(ctx, agg_time):
+                    yield from part
+                return
             saw_any = False
             for part in self._child_partitions(ctx):
                 for out in self._merge_partition(ctx, part, agg_time):
                     saw_any = True
                     yield out
-            if not saw_any and not self.group_exprs and \
+            if not saw_any and \
                     (ctx.cluster is None or ctx.cluster.owns_first()):
                 # cluster mode: exactly ONE worker emits the global
                 # empty-input row (count()=0, sum()=null)

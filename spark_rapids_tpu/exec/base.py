@@ -285,6 +285,20 @@ class TpuExec:
         """
         yield self.execute(ctx)
 
+    def _measure_stream(self, ctx: "ExecContext", stream):
+        """Output accounting for partition-wise consumption paths that
+        bypass ``execute()`` (which does this for the plain path)."""
+        m = ctx.metrics_for(self.exec_id)
+        rows = m.setdefault("numOutputRows",
+                            Metric("numOutputRows", Metric.ESSENTIAL))
+        batches = m.setdefault(
+            "numOutputBatches", Metric("numOutputBatches",
+                                       Metric.MODERATE))
+        for b in stream:
+            rows.add(int(b.num_rows))
+            batches.add(1)
+            yield b
+
     def execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
         m = ctx.metrics_for(self.exec_id)
         rows = m.setdefault("numOutputRows", Metric("numOutputRows",

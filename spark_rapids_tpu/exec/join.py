@@ -727,6 +727,31 @@ class BroadcastHashJoinExec(_HashJoinBase):
             else self.children[1]
         return probe.output_partitioning
 
+    def execute_partitioned(self, ctx: ExecContext):
+        """The advertised partitioning is the PROBE side's, so a
+        partition-wise consumer (a co-partitioned join above) must see
+        one joined output partition per probe partition — the build
+        side is the same broadcast table for every one of them. The
+        whole-stream default made the advertisement a lie (SF1 q11/q74:
+        'join children partition counts differ' one join up).
+
+        The build concats ONCE (each _join_partition then no-ops its
+        single-batch concat) and runtime partition pruning runs BEFORE
+        the probe side starts executing — the first pull on a probe
+        exchange drains its scans, after which a prune is too late."""
+        probe_child = self.children[0] if self.build_side == "right" \
+            else self.children[1]
+        build = self._concat_build(ctx, self._build_stream(ctx))
+        if build is not None:
+            self._runtime_partition_prune(ctx, build)
+        for probe in probe_child.execute_partitioned(ctx):
+            if build is None:
+                yield self._measure_stream(
+                    ctx, self._empty_result(probe, ctx))
+            else:
+                yield self._measure_stream(
+                    ctx, self._join_partition(ctx, probe, iter([build])))
+
     def node_description(self) -> str:
         return (f"BroadcastHashJoin[{self.join_type}, "
                 f"build={self.build_side}]")

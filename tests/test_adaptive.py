@@ -243,3 +243,108 @@ def test_full_outer_join_with_aqe_coalesce_global_agg():
                         THEN 1 ELSE 0 END) AS both_cnt
         FROM lo FULL OUTER JOIN hi ON lo.a = hi.a AND lo.c = hi.c""")
     assert_tpu_cpu_equal_df(out)
+
+
+def test_final_aggregate_joins_partition_wise():
+    """A FINAL grouped aggregate advertises its child exchange's hash
+    partitioning; a co-partitioned join must therefore receive one
+    output partition per child partition from it (SF1 q11/q74
+    regression: the whole-stream default raised 'join children
+    partition counts differ' once the build side outgrew adaptive
+    broadcast)."""
+    import numpy as np
+    from spark_rapids_tpu.conf import SrtConf
+    from spark_rapids_tpu.expr.aggregates import CountStar, Sum
+    from spark_rapids_tpu.expr.core import Alias, col
+    from spark_rapids_tpu.plan.session import TpuSession
+
+    conf = SrtConf({"srt.shuffle.partitions": 4,
+                    # force the shuffled-join zip path: no broadcast,
+                    # no adaptive re-planning
+                    "srt.sql.broadcastRowThreshold": 1,
+                    "srt.sql.adaptive.enabled": False})
+    sess = TpuSession(conf)
+    rng = np.random.default_rng(8)
+    n = 6000
+    t = sess.create_dataframe({
+        "k": rng.integers(0, 97, n).tolist(),
+        "v": rng.uniform(0, 10, n).tolist()})
+    u = sess.create_dataframe({
+        "k": rng.integers(0, 97, n).tolist(),
+        "w": rng.uniform(0, 5, n).tolist()})
+    agg_t = t.group_by("k").agg(Alias(Sum(col("v")), "sv"),
+                                Alias(CountStar(), "ct"))
+    agg_u = u.group_by("k").agg(Alias(Sum(col("w")), "sw"))
+    joined = agg_t.join(agg_u, "k")
+    rows = {r["k"]: (r["sv"], r["ct"], r["sw"]) for r in joined.collect()}
+    kt = np.array(t.to_pandas()["k"])
+    vt = np.array(t.to_pandas()["v"])
+    ku = np.array(u.to_pandas()["k"])
+    wu = np.array(u.to_pandas()["w"])
+    keys = sorted(set(kt) & set(ku))
+    assert len(rows) == len(keys)
+    for k in keys:
+        sv, ct, sw = rows[k]
+        assert ct == int((kt == k).sum())
+        assert abs(sv - vt[kt == k].sum()) < 1e-9
+        assert abs(sw - wu[ku == k].sum()) < 1e-9
+
+
+def test_broadcast_join_partition_wise_chain():
+    """q11's plan shape: FINAL aggregate -> broadcast join -> shuffled
+    join. The broadcast join advertises the aggregate's hash
+    partitioning, so the shuffled join above consumes IT partition-wise
+    — one joined partition per probe partition, same broadcast build
+    for all (and an empty build must empty every partition)."""
+    import numpy as np
+    from spark_rapids_tpu.conf import SrtConf
+    from spark_rapids_tpu.expr.aggregates import Sum
+    from spark_rapids_tpu.expr.core import Alias, col
+    from spark_rapids_tpu.plan.session import TpuSession
+
+    conf = SrtConf({"srt.shuffle.partitions": 4,
+                    # dims under 50 rows broadcast; the big sides shuffle
+                    "srt.sql.broadcastRowThreshold": 50,
+                    "srt.sql.adaptive.enabled": False})
+    sess = TpuSession(conf)
+    rng = np.random.default_rng(15)
+    n = 5000
+    t = sess.create_dataframe({
+        "k": rng.integers(0, 61, n).tolist(),
+        "j": rng.integers(0, 5, n).tolist(),
+        "v": rng.uniform(0, 10, n).tolist()})
+    u = sess.create_dataframe({
+        "k": rng.integers(0, 61, n).tolist(),
+        "w": rng.uniform(0, 5, n).tolist()})
+    dim = sess.create_dataframe({"j": list(range(5)),
+                                 "tag": [f"d{i}" for i in range(5)]})
+    agg_t = t.group_by("k", "j").agg(Alias(Sum(col("v")), "sv"))
+    agg_u = u.group_by("k").agg(Alias(Sum(col("w")), "sw"))
+    chain = agg_t.join(dim, "j").join(agg_u, "k")
+    tree = __import__(
+        "spark_rapids_tpu.plan.overrides", fromlist=["apply_overrides"]
+    ).apply_overrides(chain.plan, conf).tree_string()
+    assert "BroadcastHashJoin" in tree and "ShuffledHashJoin" in tree, \
+        tree
+    got = {}
+    for r in chain.collect():
+        got.setdefault(r["k"], 0.0)
+        got[r["k"]] += r["sv"]
+    kt, jt_, vt = (np.array(t.to_pandas()[c]) for c in ("k", "j", "v"))
+    ku, wu = (np.array(u.to_pandas()[c]) for c in ("k", "w"))
+    keys = sorted(set(kt) & set(ku))
+    assert set(got) == set(keys)
+    for k in keys:
+        assert abs(got[k] - vt[kt == k].sum()) < 1e-9
+
+    # empty broadcast build: inner join must produce zero rows from
+    # EVERY partition (the _empty_result lane, per partition)
+    empty_dim = sess.create_dataframe({"j": [], "tag": []},
+                                      [("j", __import__(
+                                          "spark_rapids_tpu.columnar.dtypes",
+                                          fromlist=["INT64"]).INT64),
+                                       ("tag", __import__(
+                                           "spark_rapids_tpu.columnar.dtypes",
+                                           fromlist=["STRING"]).STRING)])
+    chain2 = agg_t.join(empty_dim, "j").join(agg_u, "k")
+    assert chain2.collect() == []
