@@ -923,6 +923,10 @@ extern "C" int64_t parquet_decode_chunk_binary(
         int64_t need = (int64_t)h.num_values * 4 + total_b;
         // dict must survive page decompression into the head
         if (need * 2 > scratch_cap) return -3;
+        // the tail build memmoves from `page` (which may itself live in
+        // the scratch head after decompression): the source must end
+        // before the tail begins, or the copy corrupts the dictionary
+        if (page_len > scratch_cap - need) return -3;
         dict_tail = need;
         uint8_t* tail = scratch + scratch_cap - need;
         dict_ends = reinterpret_cast<int32_t*>(tail);

@@ -99,4 +99,49 @@ if "JAX_COMPILATION_CACHE_DIR" not in _os.environ:
     # pays full recompilation
     _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
 
+
+def _patch_atomic_cache_writes() -> None:
+    # jax's disk cache writes entries with a bare write_bytes: a process
+    # killed mid-write (OOM killer, test-budget SIGKILL) leaves a
+    # truncated .bin behind, and the cache READ path then hard-segfaults
+    # in executable deserialization on every later run that hits the
+    # key — one bad write permanently poisons the shared directory.
+    # Rewrite put() to stage into a same-dir temp file and os.replace()
+    # it into place, so a visible entry is always complete.
+    try:
+        from jax._src import lru_cache as _lc
+    except Exception:  # private module moved — lose atomicity, not boot
+        return
+    if getattr(_lc.LRUCache.put, "_srt_atomic", False):
+        return
+
+    def _atomic_put(self, key, val):
+        import time as _t
+        if not key:
+            raise ValueError("key cannot be empty")
+        if self.eviction_enabled and len(val) > self.max_size:
+            return
+        cache_path = self.path / f"{key}{_lc._CACHE_SUFFIX}"
+        atime_path = self.path / f"{key}{_lc._ATIME_SUFFIX}"
+        if self.eviction_enabled:
+            self.lock.acquire(timeout=self.lock_timeout_secs)
+        try:
+            if cache_path.exists():
+                return
+            self._evict_if_needed(additional_size=len(val))
+            tmp = cache_path.with_name(
+                f"{cache_path.name}.tmp{_os.getpid()}")
+            tmp.write_bytes(val)
+            _os.replace(tmp, cache_path)
+            atime_path.write_bytes(_t.time_ns().to_bytes(8, "little"))
+        finally:
+            if self.eviction_enabled:
+                self.lock.release()
+
+    _atomic_put._srt_atomic = True
+    _lc.LRUCache.put = _atomic_put
+
+
+_patch_atomic_cache_writes()
+
 from . import columnar  # noqa: F401,E402

@@ -488,6 +488,46 @@ SHUFFLE_FETCH_IN_FLIGHT_BYTES = conf("srt.shuffle.fetch.inFlightBytes") \
          "fan-in host memory.") \
     .check(_positive).integer(128 * 1024 * 1024)
 
+FETCH_MAX_RETRIES = conf("srt.shuffle.fetch.maxRetries") \
+    .doc("Reconnect attempts per peer when a shuffle block fetch fails "
+         "mid-stream (connection refused/reset, timeout). Already-"
+         "received blocks are skipped on the retried stream, so a "
+         "retry never duplicates a block "
+         "(RapidsShuffleClient retry discipline).") \
+    .check(lambda v: None if v >= 0 else "must be >= 0").integer(3)
+
+FETCH_BACKOFF_BASE_S = conf("srt.shuffle.fetch.backoffBaseSec") \
+    .doc("Base delay for exponential backoff between shuffle fetch "
+         "retries; attempt n sleeps base * 2^(n-1) * (1 + jitter), "
+         "jitter in [0, 0.25).") \
+    .check(_positive).double(0.05)
+
+FETCH_TIMEOUT_S = conf("srt.shuffle.fetch.timeoutSec") \
+    .doc("Per-ATTEMPT socket timeout for shuffle block fetches (connect "
+         "and each read); a stalled peer costs one attempt, not the "
+         "whole fetch.") \
+    .check(_positive).double(30.0)
+
+HEARTBEAT_INTERVAL_S = conf("srt.cluster.heartbeatIntervalSec") \
+    .doc("Seconds between a cluster worker's liveness heartbeats to the "
+         "driver's ShuffleHeartbeatManager "
+         "(RapidsShuffleHeartbeatManager executorHeartbeatInterval).") \
+    .check(_positive).double(2.0)
+
+HEARTBEAT_TIMEOUT_S = conf("srt.cluster.heartbeatTimeoutSec") \
+    .doc("Seconds of heartbeat silence before the driver declares a "
+         "worker dead, evicts it, and breaks its barriers (failure "
+         "detection instead of waiting out barrierTimeoutSec). Keep "
+         "comfortably above the longest GIL-bound stall (XLA compiles "
+         "block the heartbeat thread).") \
+    .check(_positive).double(30.0)
+
+FAULT_PLAN_SPEC = conf("srt.test.faultPlan") \
+    .doc("Fault-injection plan spec (robustness/faults.py grammar), "
+         "armed in every process that executes with this conf — cluster "
+         "workers arm it from the job conf. Empty disables injection.") \
+    .internal().string("")
+
 DPP_ENABLED = conf("srt.sql.dpp.enabled") \
     .doc("Runtime dynamic partition pruning: when a broadcast join's "
          "probe side scans a partitioned table on a partition column, "

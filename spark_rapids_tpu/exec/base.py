@@ -309,11 +309,21 @@ class TpuExec:
                                                "ns"))
         from ..conf import DEBUG_DUMP_PATH
         dump_dir = ctx.conf.get(DEBUG_DUMP_PATH)
+        # fault injection at operator granularity: tag the pulling
+        # thread with this operator's exec_id so memory.reserve fault
+        # sites can ~match on it. Only when a plan is armed — the
+        # production path never touches the scope TLS.
+        from ..robustness import faults
+        scope = faults.op_scope(self.exec_id) if faults.armed() else None
         it = iter(self.do_execute(ctx))
         while True:
             with SelfTimer(ctx.timer_stack, optime, self.exec_id):
                 try:
-                    batch = next(it)
+                    if scope is None:
+                        batch = next(it)
+                    else:
+                        with scope:
+                            batch = next(it)
                 except StopIteration:
                     return
                 except BaseException as e:

@@ -230,6 +230,19 @@ class ShuffleExchangeExec(TpuExec):
         every worker derives IDENTICAL bounds and range partitions stay
         globally consistent."""
         orders = self.sort_orders
+        pre = (ctx.cluster.bounds_for(self.shuffle_id)
+               if ctx.cluster is not None else None)
+        if pre is not None:
+            # stage-level retry of a REUSED range exchange: the renamed
+            # blocks were cut with the previous attempt's bounds, so the
+            # freshly re-executed shards must use the SAME bounds — and
+            # every worker takes this shortcut consistently (skipping
+            # the sample gather without deadlock), because the driver
+            # only marks a position reusable after verifying every
+            # survivor holds the job's record.
+            bounds_rows = [tuple(r) for r in pre]
+            ctx.cluster.record_bounds(self.shuffle_id, bounds_rows)
+            return self._bounds_device_cols(bounds_rows)
         samples = self._sample_rows(ctx, batches, num_parts)
         if ctx.cluster is not None:
             gathered = ctx.cluster.gather(("bounds", self.shuffle_id),
@@ -258,6 +271,14 @@ class ShuffleExchangeExec(TpuExec):
         m = len(samples)
         for i in range(1, num_parts):
             bounds_rows.append(samples[min(m - 1, (i * m) // num_parts)])
+        if ctx.cluster is not None:
+            # remember the cut rows: a stage-level retry that reuses
+            # this exchange's blocks must partition with the same bounds
+            ctx.cluster.record_bounds(self.shuffle_id, bounds_rows)
+        return self._bounds_device_cols(bounds_rows)
+
+    def _bounds_device_cols(self, bounds_rows):
+        orders = self.sort_orders
         # build device columns for the bounds; capacity == bound count
         # exactly (range_partition_ids treats every slot as a bound).
         # Sampled non-string values are already physical lanes (the
@@ -302,7 +323,10 @@ class ShuffleExchangeExec(TpuExec):
         write_rows = m.setdefault("shuffleWriteRows",
                                   Metric("shuffleWriteRows",
                                          Metric.ESSENTIAL))
-        map_id = 0
+        # per-attempt map-id namespace: a stage retry renames the prior
+        # attempt's surviving blocks into this shuffle id, so freshly
+        # re-executed shards must not collide with their map ids
+        map_id = ctx.cluster.map_id_base if ctx.cluster is not None else 0
         if self.sort_orders:
             # buffer spillable, sample bounds, then partition
             from ..memory.spill import SpillableBatch, SpillPriority
@@ -455,16 +479,20 @@ class ShuffleExchangeExec(TpuExec):
             max(mgr.num_partitions(self.shuffle_id) - len(groups), 0))
         if ctx.cluster is not None:
             from ..parallel.transport import fetch_all_partitions
-            ctx.cluster.barrier(self.shuffle_id)
+            ctx.cluster.barrier(self.shuffle_id,
+                                getattr(self, "_cluster_pos", -1))
             peers = ctx.cluster.peers
+            resolver = ctx.cluster.resolve_endpoint
+            dsid = getattr(self, "_downstream_sid", None)
 
             def remote_group(gi, g):
                 mm = (map_mod or {}).get(gi)
                 for reduce_id in g:
                     ctx.partition_id = reduce_id
                     yield from fetch_all_partitions(
-                        peers, self.shuffle_id, reduce_id, map_mod=mm)
-            for gi in ctx.cluster.assigned(len(groups)):
+                        peers, self.shuffle_id, reduce_id, map_mod=mm,
+                        endpoint_resolver=resolver)
+            for gi in ctx.cluster.assigned(len(groups), dsid):
                 yield remote_group(gi, groups[gi])
             return
 
@@ -496,14 +524,18 @@ class ShuffleExchangeExec(TpuExec):
         n_parts = mgr.num_partitions(self.shuffle_id)
         if ctx.cluster is not None:
             from ..parallel.transport import fetch_all_partitions
-            ctx.cluster.barrier(self.shuffle_id)
+            ctx.cluster.barrier(self.shuffle_id,
+                                getattr(self, "_cluster_pos", -1))
             peers = ctx.cluster.peers
+            resolver = ctx.cluster.resolve_endpoint
+            dsid = getattr(self, "_downstream_sid", None)
 
             def remote_read(reduce_id):
                 ctx.partition_id = reduce_id
                 yield from fetch_all_partitions(peers, self.shuffle_id,
-                                                reduce_id)
-            for reduce_id in ctx.cluster.assigned(n_parts):
+                                                reduce_id,
+                                                endpoint_resolver=resolver)
+            for reduce_id in ctx.cluster.assigned(n_parts, dsid):
                 yield remote_read(reduce_id)
             # no unregister here: PEERS fetch this worker's blocks until
             # the whole job completes — the driver's post-job reset (or

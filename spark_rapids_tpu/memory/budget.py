@@ -21,6 +21,8 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
+from ..robustness.faults import fault_point
+
 
 class OutOfDeviceMemory(RuntimeError):
     """Base for device-memory pressure errors (GpuOOM in the JNI)."""
@@ -123,6 +125,9 @@ class MemoryBudget:
 
     def reserve(self, nbytes: int) -> None:
         task_context().on_alloc_attempt()
+        # seeded fault-site: forced RetryOOM/SplitAndRetryOOM at
+        # operator granularity (detail defaults to the armed op_scope)
+        fault_point("memory.reserve")
         with self._lock:
             if self.used + nbytes <= self.limit:
                 self.used += nbytes

@@ -474,6 +474,11 @@ def group_aggregate_pallas(batch: ColumnarBatch, key_cols: Sequence[Column],
 
     if not (_use_hash_grouping(batch, key_cols, agg_fns)
             and cap >= num_buckets
+            # counts accumulate in float32 lanes on the MXU: a group
+            # can hold at most `cap` rows, and float32 represents
+            # integers exactly only below 2^24 — larger batches must
+            # take the stock integer path or Count/CountStar drift
+            and cap < (1 << 24)
             and pallas_group_fns_ok(agg_inputs, agg_fns)):
         kb, st = group_aggregate(batch, key_cols, agg_inputs, agg_fns,
                                  row_offset)

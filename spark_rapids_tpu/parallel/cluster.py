@@ -30,6 +30,20 @@ Execution model (one plan, W workers):
   partition from every peer over the transport,
 - final output rows stream back to the driver as pickled pydicts.
 
+Fault tolerance (docs/ROBUSTNESS.md has the full contract):
+- workers heartbeat the driver's ShuffleHeartbeatManager; silence past
+  ``srt.cluster.heartbeatTimeoutSec`` evicts the worker and breaks any
+  barrier it would have joined (failure DETECTION, instead of waiting
+  out the barrier timeout),
+- sharding is by LOGICAL worker id over a fixed modulus: each physical
+  worker carries a contiguous ascending segment of logical ids, so a
+  dead worker's shard can be re-attached to a survivor without
+  reshuffling anyone else's data or breaking global partition order,
+- recovery is STAGE-level first: shuffles whose barrier released in the
+  failed attempt keep their map outputs — survivors rename the blocks
+  under the re-planned exchange's fresh shuffle id and only the dead
+  worker's shards re-execute; whole-job retry is the outer last resort.
+
 Workers run on any reachable host; tests drive the full stack with
 subprocess workers on localhost (the reference's own test strategy —
 SURVEY §4: no real multi-node cluster anywhere in CI).
@@ -45,7 +59,9 @@ import struct
 import subprocess
 import sys
 import threading
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..robustness.faults import FaultDrop, fault_point
 
 _FRAME = struct.Struct(">I")
 
@@ -82,41 +98,116 @@ class WorkerLost(RuntimeError):
         self.worker_id = worker_id
 
 
+class StageRetryFailed(RuntimeError):
+    """A survivor could not satisfy a stage-level retry (its recorded
+    job state is gone or from another job) — fall back to whole-job."""
+
+    def __init__(self, worker_id: int, detail: str):
+        super().__init__(f"stage retry failed at worker {worker_id}: "
+                         f"{detail}")
+        self.worker_id = worker_id
+
+
 class ClusterTaskContext:
     """Per-worker execution context handed to the exec layer via
-    ExecContext.cluster."""
+    ExecContext.cluster.
+
+    ``worker_id``/``num_workers`` are PHYSICAL (this attempt's worker
+    list); sharding is by LOGICAL ids over ``shard_mod`` — the worker
+    count of the job's first attempt — so a retry can hand a dead
+    worker's logical shards to a survivor without moving anyone else's
+    data. ``fresh_ids`` are the logical ids this worker newly adopted
+    in this attempt: stages feeding a REUSED exchange re-execute only
+    those (the survivors' own map outputs were renamed into the new
+    shuffle id), while stages feeding a non-reused exchange run all of
+    ``logical_ids``.
+    """
 
     def __init__(self, worker_id: int, num_workers: int,
-                 peers: List[str], driver_addr: Tuple[str, int]):
+                 peers: List[str], driver_addr: Tuple[str, int],
+                 logical_ids: Optional[List[int]] = None,
+                 fresh_ids: Optional[List[int]] = None,
+                 shard_mod: Optional[int] = None,
+                 map_id_base: int = 0, attempt: int = 0):
         self.worker_id = worker_id
         self.num_workers = num_workers
         self.peers = peers  # shuffle endpoints "host:port", worker order
         self.driver_addr = driver_addr
+        self.logical_ids = (sorted(logical_ids) if logical_ids is not None
+                            else [worker_id])
+        self.fresh_ids = (sorted(fresh_ids) if fresh_ids is not None
+                          else list(self.logical_ids))
+        self.shard_mod = shard_mod if shard_mod is not None else num_workers
+        self.map_id_base = map_id_base
+        self.attempt = attempt
+        #: shuffle ids (THIS attempt's) whose map outputs were reused
+        #: from the previous attempt — gates stage_ids()
+        self.reusable_sids: Set[int] = set()
+        self.sid_to_pos: Dict[int, int] = {}
+        #: range-partition bounds carried over from the previous attempt
+        #: (sid -> rows); a reused range exchange must keep its original
+        #: bounds or the renamed blocks would disagree with fresh ones
+        self._prefilled_bounds: Dict[int, list] = {}
+        #: bounds recorded DURING this attempt (aliased into the
+        #: worker's _last_job so the next retry can prefill)
+        self.bounds_out: Dict[int, list] = {}
 
-    def assigned(self, num_partitions: int) -> List[int]:
-        """Contiguous block of reduce partitions for this worker."""
-        w, W = self.worker_id, self.num_workers
-        lo = (num_partitions * w) // W
-        hi = (num_partitions * (w + 1)) // W
-        return list(range(lo, hi))
+    def lids_csv(self) -> str:
+        return ",".join(str(l) for l in self.logical_ids)
+
+    def stage_ids(self, downstream_sid: Optional[int] = None) -> List[int]:
+        """Logical shards this worker runs for the plan segment feeding
+        ``downstream_sid`` (None/unknown → the full logical set)."""
+        if downstream_sid is not None and \
+                downstream_sid in self.reusable_sids:
+            return self.fresh_ids
+        return self.logical_ids
+
+    def assigned(self, num_partitions: int,
+                 downstream_sid: Optional[int] = None) -> List[int]:
+        """Contiguous reduce partitions for this worker: the union of
+        each owned logical id's block. Logical ids are contiguous per
+        worker, so the union is one contiguous range and concatenating
+        worker results in physical order preserves partition order."""
+        out: Set[int] = set()
+        for lid in self.stage_ids(downstream_sid):
+            lo = (num_partitions * lid) // self.shard_mod
+            hi = (num_partitions * (lid + 1)) // self.shard_mod
+            out.update(range(lo, hi))
+        return sorted(out)
 
     def owns_first(self) -> bool:
         return self.worker_id == 0
+
+    # --- recorded range-partition bounds (stage-retry determinism) ---
+    def prefill_bounds(self, shuffle_id: int, rows: list) -> None:
+        self._prefilled_bounds[shuffle_id] = rows
+
+    def bounds_for(self, shuffle_id: int) -> Optional[list]:
+        return self._prefilled_bounds.get(shuffle_id)
+
+    def record_bounds(self, shuffle_id: int, rows: list) -> None:
+        self.bounds_out[shuffle_id] = [tuple(r) for r in rows]
 
     def _timeout(self) -> int:
         from ..conf import CLUSTER_BARRIER_TIMEOUT, active_conf
         return active_conf().get(CLUSTER_BARRIER_TIMEOUT)
 
-    def barrier(self, shuffle_id: int) -> None:
+    def barrier(self, shuffle_id: int, pos: int = -1) -> None:
         """Block until every worker's map side for shuffle_id is
-        written (driver-released)."""
+        written (driver-released). ``pos`` is the exchange's stable
+        traversal position — the driver's map-output registry records
+        completion by position, not by (attempt-fresh) shuffle id."""
+        fault_point("cluster.barrier",
+                    f"attempt={self.attempt};workers={self.lids_csv()};"
+                    f"pos={pos};")
         if os.environ.get("SRT_CLUSTER_DEBUG"):
-            print(f"[w{self.worker_id}] barrier {shuffle_id}",
+            print(f"[w{self.worker_id}] barrier {shuffle_id} pos={pos}",
                   file=sys.stderr, flush=True)
         with socket.create_connection(self.driver_addr,
                                       timeout=self._timeout()) as s:
             _send_msg(s, {"type": "barrier", "shuffle_id": shuffle_id,
-                          "worker": self.worker_id})
+                          "worker": self.worker_id, "pos": pos})
             reply = _recv_msg(s)
         if not reply or reply.get("type") != "release":
             raise RuntimeError(f"barrier {shuffle_id} failed: {reply!r}")
@@ -137,23 +228,113 @@ class ClusterTaskContext:
             raise RuntimeError(f"gather {key} failed: {reply!r}")
         return reply["payloads"]
 
+    def resolve_endpoint(self, endpoint: str) -> Optional[str]:
+        """Ask the driver's heartbeat registry for the CURRENT endpoint
+        of the (live) executor that ever served ``endpoint`` — the
+        shuffle fetch failover hook (transport.fetch_all_partitions
+        endpoint_resolver). None when that executor is gone."""
+        try:
+            with socket.create_connection(self.driver_addr,
+                                          timeout=10) as s:
+                _send_msg(s, {"type": "resolve", "endpoint": endpoint})
+                reply = _recv_msg(s)
+            if not reply or reply.get("type") != "resolved":
+                return None
+            return reply.get("endpoint")
+        except OSError:
+            return None
+
+
+# ---------------------------------------------------------------------------
+# plan annotation (stage positions + downstream-exchange links)
+# ---------------------------------------------------------------------------
+
+_MISSING = object()
+
+
+def _annotate_plan(physical) -> Tuple[Dict[int, int], Set[int]]:
+    """Walk the physical plan pre-order, assigning each shuffle
+    exchange a stable traversal POSITION (``_cluster_pos``) and
+    recording, on every exchange and file scan, the shuffle id of the
+    exchange its output feeds (``_downstream_sid`` /
+    ``_shard_downstream``; None for the final result segment and under
+    broadcasts, which rebuild every attempt).
+
+    Returns ``(sid_to_pos, tainted_sids)``. Pure function of the plan:
+    every worker and every attempt derives identical positions, which
+    is what lets the driver name stages by position while shuffle ids
+    stay fresh per attempt. A subtree SHARED by two different consumer
+    exchanges taints both consumers: a fresh-shard-only re-run cannot
+    split its output between them, so neither is eligible for reuse.
+    """
+    from ..exec.exchange import BroadcastExchangeExec, ShuffleExchangeExec
+    from ..io.scan import FileSourceScanExec
+
+    sid_to_pos: Dict[int, int] = {}
+    tainted: Set[int] = set()
+    seen_under: Dict[int, object] = {}  # id(node) -> first downstream sid
+    counter = [0]
+
+    def walk(node, downstream: Optional[int]) -> None:
+        nid = id(node)
+        prev = seen_under.get(nid, _MISSING)
+        if prev is not _MISSING:
+            if prev != downstream:
+                for d in (prev, downstream):
+                    if d is not None:
+                        tainted.add(d)
+            return
+        seen_under[nid] = downstream
+        if isinstance(node, ShuffleExchangeExec):
+            node._cluster_pos = counter[0]
+            node._downstream_sid = downstream
+            sid_to_pos[node.shuffle_id] = counter[0]
+            counter[0] += 1
+            for c in node.children:
+                walk(c, node.shuffle_id)
+            return
+        if isinstance(node, BroadcastExchangeExec):
+            for c in node.children:
+                walk(c, None)
+            return
+        if isinstance(node, FileSourceScanExec):
+            node._shard_downstream = downstream
+        for c in node.children:
+            walk(c, downstream)
+
+    walk(physical, None)
+    return sid_to_pos, tainted
+
 
 # ---------------------------------------------------------------------------
 # worker
 # ---------------------------------------------------------------------------
 
-def _shard_scans(physical, worker_id: int, num_workers: int) -> None:
-    """Round-robin file-scan leaves by file index, EXCEPT under
-    broadcast exchanges (replicated build sides)."""
+def _shard_scans(physical, worker_id: int, num_workers: int,
+                 cluster: Optional[ClusterTaskContext] = None) -> None:
+    """Shard file-scan leaves by file index over the logical id set,
+    EXCEPT under broadcast exchanges (replicated build sides). With a
+    ``cluster`` context the shard set is per-scan: scans feeding a
+    REUSED exchange keep only the freshly adopted shards."""
     from ..exec.exchange import BroadcastExchangeExec
     from ..io.scan import FileScan
+
+    done: Set[int] = set()  # shared subtrees: shard each scan once
 
     def walk(node, under_broadcast: bool) -> None:
         from ..io.scan import FileSourceScanExec
         if isinstance(node, FileSourceScanExec) and not under_broadcast:
+            if id(node) in done:
+                return
+            done.add(id(node))
+            if cluster is None:
+                ids, mod = {worker_id}, num_workers
+            else:
+                dsid = getattr(node, "_shard_downstream", None)
+                ids = set(cluster.stage_ids(dsid))
+                mod = cluster.shard_mod
             scan = node.scan
-            mine = [p for i, p in enumerate(scan.paths)
-                    if i % num_workers == worker_id]
+            mine = [p for i, p in enumerate(scan.paths) if i % mod in ids]
             sharded = FileScan.__new__(FileScan)
             sharded.__dict__.update(scan.__dict__)
             sharded.paths = mine
@@ -199,55 +380,141 @@ class ClusterWorker:
         assert self.manager.mode == "MULTITHREADED", self.manager.mode
         self.server = ShuffleBlockServer(self.manager, host=host)
         self.host = host
+        #: state of the most recent job attempt, kept across failures so
+        #: a stage-level retry can rename completed map outputs:
+        #: {"token": job_token, "sids": [sid by position],
+        #:  "bounds": {sid: bounds_rows}}
+        self._last_job: Optional[dict] = None
+
+    def _heartbeat_loop(self, executor_id: str, interval: float,
+                        stop: threading.Event) -> None:
+        """Liveness beats on fresh connections (the control socket is
+        owned by the job dialogue). A ``drop`` fault skips one beat; a
+        ``delay`` fault models a slow peer; killing this thread (any
+        other injected error) models a silently wedged worker."""
+        while not stop.wait(interval):
+            try:
+                fault_point("cluster.heartbeat",
+                            f"executor={executor_id};")
+            except FaultDrop:
+                continue
+            try:
+                with socket.create_connection(
+                        self.driver_addr,
+                        timeout=max(5.0, interval * 2)) as s:
+                    _send_msg(s, {"type": "heartbeat",
+                                  "executor_id": executor_id,
+                                  "endpoint": self.server.endpoint})
+                    _recv_msg(s)
+            except OSError:
+                pass  # driver unreachable; the main loop will notice
 
     def run_forever(self) -> None:
         """Register, then serve job requests until shutdown."""
-        with socket.create_connection(self.driver_addr, timeout=120) as s:
-            _send_msg(s, {"type": "register",
-                          "shuffle_endpoint": self.server.endpoint})
-            while True:
+        stop_hb = threading.Event()
+        try:
+            with socket.create_connection(self.driver_addr,
+                                          timeout=120) as s:
+                _send_msg(s, {"type": "register",
+                              "shuffle_endpoint": self.server.endpoint})
                 msg = _recv_msg(s)
-                if msg is None or msg["type"] == "shutdown":
-                    return
-                if msg["type"] == "reset":
-                    # failed-attempt cleanup before a retry: drop every
-                    # shuffle's blocks (stale state must not leak into
-                    # the re-run)
-                    for sid in list(self.manager._registered):
-                        self.manager.unregister_shuffle(sid)
-                    _send_msg(s, {"type": "reset_done"})
-                elif msg["type"] == "job":
-                    try:
-                        rows, metrics = self._run_job(msg)
-                        _send_msg(s, {"type": "result", "rows": rows,
-                                      "metrics": metrics})
-                    except BaseException as e:  # surface to driver
-                        import traceback
-                        _send_msg(s, {"type": "error",
-                                      "error": f"{e}\n"
-                                      f"{traceback.format_exc()}"})
+                if isinstance(msg, dict) and \
+                        msg.get("type") == "registered":
+                    hb = threading.Thread(
+                        target=self._heartbeat_loop,
+                        args=(msg["executor_id"],
+                              float(msg.get("heartbeat_interval", 2.0)),
+                              stop_hb),
+                        daemon=True)
+                    hb.start()
+                    msg = _recv_msg(s)
+                while True:
+                    if msg is None or msg["type"] == "shutdown":
+                        return
+                    if msg["type"] == "reset":
+                        # failed-attempt / post-job cleanup: drop every
+                        # shuffle's blocks (stale state must not leak
+                        # into the re-run) and forget the job record
+                        for sid in list(self.manager._registered):
+                            self.manager.unregister_shuffle(sid)
+                        self._last_job = None
+                        _send_msg(s, {"type": "reset_done"})
+                    elif msg["type"] == "prepare_retry":
+                        # stage-level retry probe: report which job's
+                        # map outputs this worker still holds — NO
+                        # blocks are dropped (that is the whole point)
+                        token = (self._last_job or {}).get("token")
+                        _send_msg(s, {"type": "retry_ready",
+                                      "token": token})
+                    elif msg["type"] == "job":
+                        try:
+                            rows, metrics = self._run_job(msg)
+                            _send_msg(s, {"type": "result", "rows": rows,
+                                          "metrics": metrics})
+                        except BaseException as e:  # surface to driver
+                            import traceback
+                            _send_msg(s, {"type": "error",
+                                          "error": f"{e}\n"
+                                          f"{traceback.format_exc()}"})
+                    msg = _recv_msg(s)
+        finally:
+            stop_hb.set()
 
-    def _run_job(self, msg) -> List[dict]:
+    def _run_job(self, msg) -> Tuple[List[dict], dict]:
         from ..conf import SrtConf, set_active_conf
         from ..exec.base import ExecContext
         from ..plan import overrides
         from ..plan.host_table import batch_to_table, to_pydict
+        from ..robustness import faults
         logical = pickle.loads(msg["plan"])
         settings = dict(msg["conf"])
         settings["srt.shuffle.mode"] = "MULTITHREADED"
         conf = SrtConf(settings)
         set_active_conf(conf)
-        cluster = ClusterTaskContext(msg["worker_id"], msg["num_workers"],
-                                     msg["peers"], self.driver_addr)
+        # arm (or keep, or disarm) this process's fault plan from the
+        # job conf — the driver-side test's spec reaches every worker
+        faults.arm_from_conf(conf)
+        attempt = msg.get("attempt", 0)
+        logical_ids = msg.get("logical_ids") or [msg["worker_id"]]
+        fresh_ids = msg.get("fresh_ids")
+        cluster = ClusterTaskContext(
+            msg["worker_id"], msg["num_workers"], msg["peers"],
+            self.driver_addr, logical_ids=logical_ids,
+            fresh_ids=fresh_ids if fresh_ids is not None else logical_ids,
+            shard_mod=msg.get("shard_mod") or msg["num_workers"],
+            map_id_base=msg.get("map_id_base", 0), attempt=attempt)
+        fault_point("cluster.job",
+                    f"attempt={attempt};workers={cluster.lids_csv()};")
         physical = overrides.apply_overrides(logical, conf)
         if _worker_has_local_relation(physical, cluster.num_workers):
             raise RuntimeError(
                 "cluster mode shards file scans; non-broadcast local "
                 "relations would duplicate (write the input to files)")
-        _shard_scans(physical, cluster.worker_id, cluster.num_workers)
+        sid_to_pos, tainted = _annotate_plan(physical)
+        sids_by_pos = [sid for sid, _pos in
+                       sorted(sid_to_pos.items(), key=lambda kv: kv[1])]
+        cluster.sid_to_pos = sid_to_pos
+        reuse_token = msg.get("reuse_token")
+        if reuse_token is not None:
+            self._prepare_reuse(msg, cluster, sids_by_pos, tainted,
+                                reuse_token)
+        else:
+            # fresh attempt: stale blocks (a failed attempt the driver
+            # chose not to stage-retry) were dropped by "reset"
+            self._last_job = None
+        # record BEFORE executing: a crash mid-job must leave behind
+        # the sid map + bounds that DID complete (bounds_out is aliased,
+        # so _compute_bounds fills it in place as the job runs)
+        self._last_job = {"token": msg.get("job_token"),
+                          "sids": sids_by_pos,
+                          "bounds": cluster.bounds_out}
+        _shard_scans(physical, cluster.worker_id, cluster.num_workers,
+                     cluster)
         debug = os.environ.get("SRT_CLUSTER_DEBUG")
         if debug:
-            print(f"[w{cluster.worker_id}] plan:\n"
+            print(f"[w{cluster.worker_id}] plan (lids="
+                  f"{cluster.logical_ids} fresh={cluster.fresh_ids} "
+                  f"reuse={sorted(cluster.reusable_sids)}):\n"
                   f"{physical.tree_string()}", file=sys.stderr, flush=True)
         ctx = ExecContext(conf)
         ctx.cluster = cluster
@@ -269,6 +536,34 @@ class ClusterWorker:
         metrics = {eid: {m.name: m.value for m in md.values()}
                    for eid, md in ctx.metrics.items()}
         return rows, metrics
+
+    def _prepare_reuse(self, msg, cluster: ClusterTaskContext,
+                       sids_by_pos: List[int], tainted: Set[int],
+                       reuse_token: str) -> None:
+        """Stage-level retry: re-key the previous attempt's completed
+        map outputs under this attempt's fresh shuffle ids; drop the
+        rest. Raises when this worker's record cannot satisfy the
+        driver's request (driver falls back to whole-job retry)."""
+        last = self._last_job
+        if last is None or last.get("token") != reuse_token or \
+                len(last.get("sids") or []) != len(sids_by_pos):
+            raise RuntimeError(
+                "stage-reuse state unavailable: worker job record "
+                f"{(last or {}).get('token')!r} cannot satisfy retry of "
+                f"job {reuse_token!r}")
+        reusable_positions = set(msg.get("reusable_positions") or [])
+        reused: Set[int] = set()
+        for pos, new_sid in enumerate(sids_by_pos):
+            old_sid = last["sids"][pos]
+            if pos in reusable_positions and new_sid not in tainted:
+                self.manager.rename_shuffle(old_sid, new_sid)
+                reused.add(new_sid)
+                old_bounds = last["bounds"].get(old_sid)
+                if old_bounds is not None:
+                    cluster.prefill_bounds(new_sid, old_bounds)
+            else:
+                self.manager.unregister_shuffle(old_sid)
+        cluster.reusable_sids = reused
 
     def close(self) -> None:
         self.server.close()
@@ -292,24 +587,52 @@ def worker_main(argv=None) -> None:  # pragma: no cover - subprocess body
 # ---------------------------------------------------------------------------
 
 class ClusterDriver:
-    """Coordinates registration, shuffle barriers, and job execution
-    across workers."""
+    """Coordinates registration, heartbeats, shuffle barriers, and job
+    execution across workers."""
 
     def __init__(self, num_workers: int, host: str = "127.0.0.1",
-                 barrier_timeout: float = 120.0):
+                 barrier_timeout: float = 120.0,
+                 heartbeat_interval: Optional[float] = None,
+                 heartbeat_timeout: Optional[float] = None):
+        from ..conf import (HEARTBEAT_INTERVAL_S, HEARTBEAT_TIMEOUT_S,
+                            active_conf)
+        from .shuffle_manager import (MapOutputRegistry,
+                                      ShuffleHeartbeatManager)
+        conf = active_conf()
         self.num_workers = num_workers
         self.barrier_timeout = barrier_timeout
-        self._workers: List[Tuple[socket.socket, str]] = []
+        self.heartbeat_interval = (
+            heartbeat_interval if heartbeat_interval is not None
+            else conf.get(HEARTBEAT_INTERVAL_S))
+        self.heartbeat_timeout = (
+            heartbeat_timeout if heartbeat_timeout is not None
+            else conf.get(HEARTBEAT_TIMEOUT_S))
+        self._workers: List[Tuple[socket.socket, str, str]] = []
         self._registered = threading.Event()
         self._barriers: Dict = {}
         self._gathers: Dict = {}
         self._block = threading.Lock()
+        self._exec_seq = 0
+        self._heartbeats = ShuffleHeartbeatManager(
+            timeout_s=self.heartbeat_timeout)
+        self._registry = MapOutputRegistry()
+        #: per-failed-attempt assignment record for stage retries:
+        #: executor_id -> logical ids it carried in the last attempt
+        self._last_assign: Optional[Dict[str, List[int]]] = None
+        self._last_shard_mod: Optional[int] = None
+        #: what recovery did, in order — tests and operators read this
+        #: ({"type": "stage_retry"|"job_retry"|"heartbeat_eviction", ...})
+        self.recovery_events: List[dict] = []
+        self._stop = threading.Event()
         self._server = socketserver.ThreadingTCPServer(
             (host, 0), self._make_handler(), bind_and_activate=True)
         self._server.daemon_threads = True
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True)
         self._thread.start()
+        self._monitor = threading.Thread(target=self._monitor_loop,
+                                         daemon=True)
+        self._monitor.start()
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -323,31 +646,70 @@ class ClusterDriver:
                 msg = _recv_msg(self.request)
                 if not msg:
                     return
-                if msg["type"] == "register":
+                t = msg.get("type")
+                if t == "register":
                     with driver._block:
+                        eid = f"exec-{driver._exec_seq}"
+                        driver._exec_seq += 1
                         driver._workers.append(
-                            (self.request, msg["shuffle_endpoint"]))
-                        if len(driver._workers) == driver.num_workers:
-                            driver._registered.set()
+                            (self.request, msg["shuffle_endpoint"], eid))
+                        driver._heartbeats.register(
+                            eid, msg["shuffle_endpoint"])
+                        ready = (len(driver._workers)
+                                 >= driver.num_workers)
+                    _send_msg(self.request,
+                              {"type": "registered", "executor_id": eid,
+                               "heartbeat_interval":
+                                   driver.heartbeat_interval})
+                    if ready:
+                        driver._registered.set()
                     # keep the connection open: job dialogue reuses it
                     threading.Event().wait()  # parked; driver drives
-                elif msg["type"] == "barrier":
-                    driver._barrier(msg["shuffle_id"])
+                elif t == "barrier":
+                    try:
+                        driver._barrier(msg["shuffle_id"],
+                                        msg.get("pos", -1))
+                    except threading.BrokenBarrierError:
+                        # aborted by the failure monitor: answer with a
+                        # clean error instead of an EOF'd connection
+                        _send_msg(self.request,
+                                  {"type": "error",
+                                   "error": "barrier aborted"})
+                        return
                     _send_msg(self.request, {"type": "release"})
-                elif msg["type"] == "gather":
-                    payloads = driver._gather(msg["key"], msg["worker"],
-                                              msg["payload"])
+                elif t == "gather":
+                    try:
+                        payloads = driver._gather(msg["key"],
+                                                  msg["worker"],
+                                                  msg["payload"])
+                    except threading.BrokenBarrierError:
+                        _send_msg(self.request,
+                                  {"type": "error",
+                                   "error": "gather aborted"})
+                        return
                     _send_msg(self.request, {"type": "gathered",
                                              "payloads": payloads})
+                elif t == "heartbeat":
+                    driver._heartbeats.heartbeat(msg["executor_id"],
+                                                 msg.get("endpoint"))
+                    _send_msg(self.request, {"type": "ok"})
+                elif t == "resolve":
+                    _send_msg(self.request,
+                              {"type": "resolved",
+                               "endpoint": driver._heartbeats.resolve(
+                                   msg["endpoint"])})
         return Handler
 
-    def _barrier(self, shuffle_id) -> None:
+    def _barrier(self, shuffle_id, pos: int = -1) -> None:
         with self._block:
             b = self._barriers.get(shuffle_id)
             if b is None:
                 b = self._barriers[shuffle_id] = threading.Barrier(
                     self.num_workers)
         b.wait(timeout=self.barrier_timeout)
+        # barrier released == every worker's map side wrote: record the
+        # stage as complete for stage-level retries (by stable position)
+        self._registry.mark_complete(pos, shuffle_id)
 
     def _gather(self, key, worker: int, payload) -> List:
         with self._block:
@@ -360,6 +722,50 @@ class ClusterDriver:
         g["barrier"].wait(timeout=self.barrier_timeout)
         return [g["data"].get(w) for w in range(self.num_workers)]
 
+    def _abort_sync(self) -> None:
+        """Break every waiting barrier/gather (failure path: blocked
+        survivors must error out instead of waiting out the timeout)."""
+        with self._block:
+            barriers = list(self._barriers.values())
+            gathers = list(self._gathers.values())
+        for b in barriers:
+            try:
+                b.abort()
+            except Exception:
+                pass
+        for g in gathers:
+            try:
+                g["barrier"].abort()
+            except Exception:
+                pass
+
+    def _monitor_loop(self) -> None:
+        """Failure DETECTION: evict workers whose heartbeats went
+        silent, break the barriers they would have joined, and shut
+        their control sockets so the blocked job dialogue surfaces
+        WorkerLost instead of waiting out the barrier timeout."""
+        period = max(0.2, min(1.0, self.heartbeat_timeout / 4.0))
+        while not self._stop.wait(period):
+            try:
+                dead = self._heartbeats.expire_dead()
+            except Exception:
+                continue
+            if not dead:
+                continue
+            print(f"[driver] heartbeat loss: evicting {sorted(dead)}",
+                  file=sys.stderr, flush=True)
+            self.recovery_events.append({"type": "heartbeat_eviction",
+                                         "executors": sorted(dead)})
+            self._abort_sync()
+            with self._block:
+                targets = [s for s, _ep, eid in self._workers
+                           if eid in set(dead)]
+            for s in targets:
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+
     def wait_for_workers(self, timeout: float = 60.0) -> None:
         if not self._registered.wait(timeout):
             raise TimeoutError(
@@ -371,49 +777,93 @@ class ClusterDriver:
         """Execute one plan across the cluster; returns merged rows in
         worker order (= partition order for sorted plans).
 
-        Failure recovery (SURVEY §5 failure detection / shuffle retry):
-        a lost worker aborts the attempt; the driver prunes dead
-        workers, breaks any waiting barriers, resets survivors' shuffle
-        state, and re-runs the whole job on the surviving set (map
-        inputs re-shard automatically because sharding derives from
-        worker_id/num_workers). Deterministic worker ERRORS do not
-        retry — they reproduce."""
+        Failure recovery (SURVEY §5 failure detection / shuffle retry),
+        innermost first:
+        1. transport-level: fetch retries with backoff, then endpoint
+           failover through the heartbeat registry (transport.py);
+        2. STAGE-level: on WorkerLost, shuffles whose barrier released
+           keep their map outputs — survivors rename the blocks under
+           the retry's fresh shuffle ids, the dead worker's logical
+           shards re-execute on a survivor, everything downstream of
+           the last completed exchange re-runs;
+        3. whole-job: when no stage completed or a survivor lost its
+           job record, reset everyone and re-run on the surviving set.
+        Deterministic worker ERRORS do not retry — they reproduce."""
         self.wait_for_workers()
+        job_token = os.urandom(8).hex()
         last: Optional[BaseException] = None
-        for _attempt in range(max_retries + 1):
+        retry_spec: Optional[dict] = None
+        for attempt in range(max_retries + 1):
             try:
-                return self._run_once(logical_plan, conf_settings)
+                return self._run_once(logical_plan, conf_settings,
+                                      job_token, attempt, retry_spec)
+            except StageRetryFailed as e:
+                last = e
+                retry_spec = None
+                self.recovery_events.append({"type": "job_retry",
+                                             "cause": str(e)})
+                self._recover()
             except WorkerLost as e:
                 last = e
-                self._recover()
-                if not self._workers:
-                    break
+                retry_spec = self._plan_stage_retry(job_token)
+                if retry_spec is None:
+                    self.recovery_events.append({"type": "job_retry",
+                                                 "cause": str(e)})
+                    self._recover()
+            if not self._workers:
+                break
         raise RuntimeError(
             f"job failed after worker losses: {last}") from last
 
-    def _run_once(self, logical_plan, conf_settings) -> List[dict]:
+    def _run_once(self, logical_plan, conf_settings, job_token: str,
+                  attempt: int, retry_spec: Optional[dict]) -> List[dict]:
         import cloudpickle
-        self._barriers.clear()
-        self._gathers.clear()
-        workers = list(self._workers)
+        self._registry.start_attempt()
+        with self._block:
+            self._barriers.clear()
+            self._gathers.clear()
+            workers = list(self._workers)
         n = len(workers)
         self.num_workers = n
-        peers = [ep for _, ep in workers]
+        peers = [ep for _s, ep, _e in workers]
+        if retry_spec is not None:
+            assign = retry_spec["assign"]
+            fresh = retry_spec["fresh"]
+            shard_mod = retry_spec["shard_mod"]
+            reusable = list(retry_spec["reusable_positions"])
+            reuse_token: Optional[str] = job_token
+        else:
+            assign = [[w] for w in range(n)]
+            fresh = [list(a) for a in assign]
+            shard_mod = n
+            reusable = []
+            reuse_token = None
+        self._last_assign = {eid: list(a) for (_s, _ep, eid), a
+                             in zip(workers, assign)}
+        self._last_shard_mod = shard_mod
         blob = cloudpickle.dumps(logical_plan)
-        for w, (sock, _ep) in enumerate(workers):
+        for w, (sock, _ep, _eid) in enumerate(workers):
             try:
                 _send_msg(sock, {"type": "job", "plan": blob,
                                  "conf": dict(conf_settings or {}),
                                  "worker_id": w,
                                  "num_workers": n,
-                                 "peers": peers})
+                                 "peers": peers,
+                                 "job_token": job_token,
+                                 "attempt": attempt,
+                                 "logical_ids": assign[w],
+                                 "fresh_ids": fresh[w],
+                                 "shard_mod": shard_mod,
+                                 "map_id_base": attempt << 20,
+                                 "reusable_positions": reusable,
+                                 "reuse_token": reuse_token})
             except OSError:
                 raise WorkerLost(w)
         results: List[Optional[List[dict]]] = [None] * n
         #: per-worker {exec_id: {metric: value}} of the last successful
         #: job — AQE tests read skew/coalesce counters through this
         worker_metrics: List[dict] = [{} for _ in range(n)]
-        for w, (sock, _ep) in enumerate(workers):
+        for w, (sock, _ep, _eid) in enumerate(workers):
             try:
                 reply = _recv_msg(sock)
             except OSError:
@@ -421,14 +871,16 @@ class ClusterDriver:
             if reply is None:
                 raise WorkerLost(w)
             if reply["type"] == "error":
-                if "barrier" in reply["error"] or \
-                        "gather" in reply["error"] or \
-                        "peer closed" in reply["error"] or \
-                        "refused" in reply["error"]:
+                err = reply["error"]
+                if "stage-reuse state unavailable" in err:
+                    raise StageRetryFailed(w, err)
+                if "barrier" in err or "gather" in err or \
+                        "peer closed" in err or "refused" in err or \
+                        "FetchFailed" in err:
                     # collateral of a lost peer, not a plan error
                     raise WorkerLost(w)
                 raise RuntimeError(
-                    f"worker {w} failed:\n{reply['error']}")
+                    f"worker {w} failed:\n{err}")
             results[w] = reply["rows"]
             worker_metrics[w] = reply.get("metrics", {})
         # post-job cleanup: peers are done fetching once every worker
@@ -437,7 +889,7 @@ class ClusterDriver:
         # (only the failure path used to reset). Best-effort: the job
         # already succeeded, a worker dying here is the next run's
         # problem.
-        for sock, _ep in workers:
+        for sock, _ep, _eid in workers:
             try:
                 _send_msg(sock, {"type": "reset"})
                 _recv_msg(sock)  # reset_done (keeps protocol in sync)
@@ -449,18 +901,89 @@ class ClusterDriver:
             out.extend(rows or [])
         return out
 
-    def _recover(self) -> None:
-        """Prune dead workers, unblock stuck barriers, reset
-        survivors."""
-        for b in self._barriers.values():
+    def _plan_stage_retry(self, job_token: str) -> Optional[dict]:
+        """After WorkerLost: decide whether the next attempt can reuse
+        completed stages. Probes every worker with ``prepare_retry``
+        (which also drains the failed attempt's stale replies and
+        prunes the dead), re-attaches dead logical ids to survivors
+        keeping segments contiguous, and returns the retry spec — or
+        None when nothing completed / no usable survivor record, in
+        which case the caller falls back to whole-job recovery."""
+        completed = self._registry.complete_positions()
+        self._abort_sync()
+        prev_assign = self._last_assign
+        alive: List[Tuple[socket.socket, str, str]] = []
+        for sock, ep, eid in self._workers:
+            ok = False
             try:
-                b.abort()
-            except Exception:
-                pass
-        self._barriers.clear()
-        self._gathers.clear()
+                _send_msg(sock, {"type": "prepare_retry"})
+                sock.settimeout(self.barrier_timeout * 2 + 60)
+                try:
+                    for _ in range(32):
+                        reply = _recv_msg(sock)
+                        if reply is None:
+                            break
+                        if reply.get("type") == "retry_ready":
+                            ok = reply.get("token") == job_token
+                            break
+                finally:
+                    sock.settimeout(None)
+            except OSError:
+                ok = False
+            if ok:
+                alive.append((sock, ep, eid))
+        if not alive:
+            self._workers = []
+            self.num_workers = 0
+            return None
+        self._workers = alive
+        self.num_workers = len(alive)
+        if not completed or not prev_assign or \
+                any(eid not in prev_assign for _s, _ep, eid in alive):
+            return None
+        alive_eids = {eid for _s, _ep, eid in alive}
+        dead_lids = sorted(l for eid, lids in prev_assign.items()
+                           if eid not in alive_eids for l in lids)
+        new_assign = {eid: sorted(prev_assign[eid])
+                      for _s, _ep, eid in alive}
+        for lid in dead_lids:
+            # attach to the LAST survivor whose segment starts below the
+            # dead id (else the first): all ids between adjacent
+            # survivor segments are dead, so this keeps every survivor's
+            # logical ids one contiguous ascending run — which is what
+            # preserves global partition order on concat
+            best = None
+            for _s, _ep, eid in alive:
+                if min(new_assign[eid]) < lid:
+                    best = eid
+            if best is None:
+                best = alive[0][2]
+            new_assign[best].append(lid)
+            new_assign[best].sort()
+        assign = [list(new_assign[eid]) for _s, _ep, eid in alive]
+        fresh = [sorted(set(new_assign[eid]) - set(prev_assign[eid]))
+                 for _s, _ep, eid in alive]
+        spec = {"assign": assign, "fresh": fresh,
+                "shard_mod": self._last_shard_mod,
+                "reusable_positions": list(completed)}
+        self.recovery_events.append(
+            {"type": "stage_retry", "reused_positions": list(completed),
+             "assign": assign, "fresh": fresh})
+        print(f"[driver] stage-level retry: reusing map outputs at plan "
+              f"positions {list(completed)}; re-executing logical shards "
+              f"{sorted(dead_lids)} on {len(alive)} surviving workers",
+              file=sys.stderr, flush=True)
+        return spec
+
+    def _recover(self) -> None:
+        """Whole-job fallback: prune dead workers, unblock stuck
+        barriers, reset survivors (drops ALL shuffle state)."""
+        self._abort_sync()
+        with self._block:
+            self._barriers.clear()
+            self._gathers.clear()
         alive = []
-        for sock, ep in self._workers:
+        for sock, ep, eid in self._workers:
             try:
                 _send_msg(sock, {"type": "reset"})
                 # drain stale replies of the aborted attempt (a worker
@@ -474,7 +997,7 @@ class ClusterDriver:
                         if reply is None:
                             break
                         if reply.get("type") == "reset_done":
-                            alive.append((sock, ep))
+                            alive.append((sock, ep, eid))
                             break
                 finally:
                     sock.settimeout(None)
@@ -484,7 +1007,8 @@ class ClusterDriver:
         self.num_workers = len(alive)
 
     def shutdown(self) -> None:
-        for sock, _ep in self._workers:
+        self._stop.set()
+        for sock, _ep, _eid in self._workers:
             try:
                 _send_msg(sock, {"type": "shutdown"})
             except OSError:

@@ -34,6 +34,7 @@ from ..columnar.vector import ColumnarBatch, choose_capacity
 from ..conf import (SHUFFLE_COMPRESS, SHUFFLE_MODE, SHUFFLE_PARTITIONS,
                     SrtConf, active_conf)
 from ..memory.spill import SpillPriority, SpillableBatch
+from ..robustness.faults import fault_point
 from .serializer import deserialize_batch, serialize_batch
 
 BlockId = Tuple[int, int, int]  # (shuffle_id, map_id, reduce_id)
@@ -114,6 +115,13 @@ class HostBlockStore:
                 self.bytes_written -= len(self._blocks.pop(b))
             return len(gone)
 
+    def rename_shuffle(self, old_id: int, new_id: int) -> int:
+        with self._lock:
+            gone = [b for b in self._blocks if b[0] == old_id]
+            for b in gone:
+                self._blocks[(new_id, b[1], b[2])] = self._blocks.pop(b)
+            return len(gone)
+
 
 @dataclass
 class ShuffleWriteMetrics:
@@ -157,6 +165,20 @@ class ShuffleManager:
             for k in [k for k in self._part_rows if k[0] == shuffle_id]:
                 del self._part_rows[k]
 
+    def rename_shuffle(self, old_id: int, new_id: int) -> int:
+        """Re-key every surviving block (and its AQE row stats) from
+        ``old_id`` to ``new_id`` — stage-level recovery reuses a prior
+        attempt's completed map outputs under the re-planned exchange's
+        fresh shuffle id instead of recomputing them."""
+        moved = self.host_store.rename_shuffle(old_id, new_id)
+        with self._lock:
+            if old_id in self._registered:
+                self._registered[new_id] = self._registered.pop(old_id)
+            for k in [k for k in self._part_rows if k[0] == old_id]:
+                self._part_rows[(new_id, k[1], k[2])] = \
+                    self._part_rows.pop(k)
+        return moved
+
     def partition_row_counts(self, shuffle_id: int) -> List[int]:
         """Rows per reduce partition (valid once the map side wrote)."""
         n = self.num_partitions(shuffle_id)
@@ -174,6 +196,7 @@ class ShuffleManager:
     def write_map_output(self, shuffle_id: int, map_id: int,
                          partitions: Sequence[ColumnarBatch]) -> None:
         """One map task's output: partitions[i] goes to reduce i."""
+        fault_point("shuffle.write", f"sid={shuffle_id};map={map_id};")
         t0 = time.perf_counter_ns()
         futures = []
         local_rows: Dict[int, int] = {}
@@ -211,6 +234,7 @@ class ShuffleManager:
         """All map outputs for one reduce partition, in map order.
         ``map_mod=(s, S)`` keeps only blocks with map_id % S == s — a
         skewed reduce partition splits into S disjoint map slices."""
+        fault_point("shuffle.read", f"sid={shuffle_id};reduce={reduce_id};")
         def keep(map_id: int) -> bool:
             return map_mod is None or map_id % map_mod[1] == map_mod[0]
         if self.mode == "CACHE_ONLY":
@@ -279,6 +303,10 @@ class ShuffleHeartbeatManager:
     def __init__(self, timeout_s: float = 60.0):
         self.timeout_s = timeout_s
         self._executors: Dict[str, ExecutorInfo] = {}
+        #: every endpoint an executor EVER served from -> executor_id;
+        #: a peer holding a stale endpoint resolves the executor's
+        #: current one through this (fetch failover)
+        self._aliases: Dict[str, str] = {}
         self._lock = threading.Lock()
 
     def register(self, executor_id: str, endpoint: str) -> List[ExecutorInfo]:
@@ -287,16 +315,35 @@ class ShuffleHeartbeatManager:
         with self._lock:
             self._executors[executor_id] = ExecutorInfo(executor_id,
                                                         endpoint)
+            self._aliases[endpoint] = executor_id
             return [e for e in self._executors.values()
                     if e.executor_id != executor_id]
 
-    def heartbeat(self, executor_id: str) -> bool:
+    def heartbeat(self, executor_id: str,
+                  endpoint: Optional[str] = None) -> bool:
         with self._lock:
             info = self._executors.get(executor_id)
             if info is None:
                 return False  # unknown: executor must re-register
             info.last_heartbeat = time.monotonic()
+            if endpoint and endpoint != info.endpoint:
+                # shuffle server moved (restart on a new port): keep the
+                # old endpoint as an alias so in-flight fetches fail over
+                info.endpoint = endpoint
+                self._aliases[endpoint] = executor_id
             return True
+
+    def resolve(self, endpoint: str) -> Optional[str]:
+        """Current endpoint of the live executor that served
+        ``endpoint`` at any point — None when that executor is unknown
+        or has gone silent past the timeout."""
+        now = time.monotonic()
+        with self._lock:
+            eid = self._aliases.get(endpoint)
+            info = self._executors.get(eid) if eid else None
+            if info is None or now - info.last_heartbeat > self.timeout_s:
+                return None
+            return info.endpoint
 
     def live_executors(self) -> List[str]:
         now = time.monotonic()
@@ -312,3 +359,35 @@ class ShuffleHeartbeatManager:
             for eid in dead:
                 del self._executors[eid]
             return dead
+
+
+# ---------------------------------------------------------------------------
+# map-output registry (MapOutputTracker role, stage-level recovery)
+# ---------------------------------------------------------------------------
+
+class MapOutputRegistry:
+    """Driver-side record of which shuffles' map phases COMPLETED in
+    the current job attempt (Spark's MapOutputTracker role, reduced to
+    what stage-level recovery needs). Shuffles are keyed by their
+    traversal POSITION in the physical plan — shuffle ids are fresh per
+    attempt, positions are stable across re-plans of the same job —
+    and a position is complete once its barrier released (every
+    worker's map side wrote before any barrier reply goes out)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._complete: Dict[int, int] = {}  # pos -> shuffle_id
+
+    def start_attempt(self) -> None:
+        with self._lock:
+            self._complete.clear()
+
+    def mark_complete(self, pos: int, shuffle_id: int) -> None:
+        if pos < 0:
+            return
+        with self._lock:
+            self._complete[pos] = shuffle_id
+
+    def complete_positions(self) -> List[int]:
+        with self._lock:
+            return sorted(self._complete)

@@ -19,19 +19,39 @@ receiving side deserializes straight into capacity-bucketed batches
 
 from __future__ import annotations
 
+import random
 import socket
 import socketserver
 import struct
 import threading
-from typing import Iterator, List, Optional, Tuple
+import time
+from typing import Callable, Iterator, List, Optional, Tuple
 
 from ..columnar.vector import ColumnarBatch
+from ..robustness.faults import fault_point
 from .serializer import deserialize_batch
 from .shuffle_manager import ShuffleManager
 
 MAGIC = 0x53525453  # "SRTS"
 _REQ = struct.Struct("<III")
 _BLOCK_HDR = struct.Struct("<IQ")
+
+
+class FetchFailed(ConnectionError):
+    """A reduce-side fetch exhausted its retries (and failover, when a
+    resolver was available). Carries the peer endpoint so the driver
+    can attribute the loss to a specific worker (Spark's FetchFailed →
+    map-stage resubmission signal)."""
+
+    def __init__(self, endpoint: str, shuffle_id: int, reduce_id: int,
+                 cause: BaseException):
+        super().__init__(
+            f"FetchFailed(endpoint={endpoint}, shuffle={shuffle_id}, "
+            f"reduce={reduce_id}): {cause}")
+        self.endpoint = endpoint
+        self.shuffle_id = shuffle_id
+        self.reduce_id = reduce_id
+        self.cause = cause
 
 
 class _Handler(socketserver.BaseRequestHandler):
@@ -43,11 +63,27 @@ class _Handler(socketserver.BaseRequestHandler):
         magic, shuffle_id, reduce_id = _REQ.unpack(raw)
         if magic != MAGIC:
             return
+        try:
+            fault_point("transport.serve",
+                        f"sid={shuffle_id};reduce={reduce_id};")
+        except ConnectionResetError:
+            return  # injected: drop the request before answering
         blocks = mgr.host_store.blocks_for_reduce(shuffle_id, reduce_id)
         payload = [(b[1], mgr.host_store.get(b)) for b in blocks]
         payload = [(m, d) for m, d in payload if d is not None]
         self.request.sendall(struct.pack("<I", len(payload)))
         for map_id, data in payload:
+            try:
+                fault_point("transport.serve_block",
+                            f"sid={shuffle_id};reduce={reduce_id};"
+                            f"m={map_id};")
+            except ConnectionResetError:
+                # injected mid-frame reset: promise the block, send half
+                # the payload, drop the connection — the client observes
+                # a peer death mid-block
+                self.request.sendall(_BLOCK_HDR.pack(map_id, len(data)))
+                self.request.sendall(data[: len(data) // 2])
+                return
             self.request.sendall(_BLOCK_HDR.pack(map_id, len(data)))
             self.request.sendall(data)
 
@@ -86,19 +122,34 @@ class ShuffleBlockServer:
 
 
 class ShuffleBlockClient:
-    """Fetches a reduce partition's blocks from a peer
-    (RapidsShuffleClient.doFetch)."""
+    """Fetches a reduce partition's blocks from a peer with bounded
+    retry (RapidsShuffleClient.doFetch): each attempt runs under a
+    per-attempt socket timeout; failed attempts reconnect after
+    exponential backoff with jitter, and blocks already received are
+    skipped on the retried stream so a retry never duplicates."""
 
-    def __init__(self, endpoint: str, timeout_s: float = 30.0):
+    def __init__(self, endpoint: str, timeout_s: Optional[float] = None,
+                 max_retries: Optional[int] = None,
+                 backoff_base_s: Optional[float] = None):
+        from ..conf import (FETCH_BACKOFF_BASE_S, FETCH_MAX_RETRIES,
+                            FETCH_TIMEOUT_S, active_conf)
+        conf = active_conf()
+        self.endpoint = endpoint
         self.host, port = endpoint.rsplit(":", 1)
         self.port = int(port)
-        self.timeout_s = timeout_s
+        self.timeout_s = conf.get(FETCH_TIMEOUT_S) \
+            if timeout_s is None else timeout_s
+        self.max_retries = conf.get(FETCH_MAX_RETRIES) \
+            if max_retries is None else max_retries
+        self.backoff_base_s = conf.get(FETCH_BACKOFF_BASE_S) \
+            if backoff_base_s is None else backoff_base_s
 
-    def stream_raw(self, shuffle_id: int,
-                   reduce_id: int) -> Iterator[Tuple[int, bytes]]:
+    def _stream_attempt(self, shuffle_id: int, reduce_id: int,
+                        seen: set) -> Iterator[Tuple[int, bytes]]:
         """STREAM blocks one at a time in map order — the socket's TCP
         window is the only read-ahead, so a huge partition never
         buffers whole in this process (WindowedBlockIterator role)."""
+        fault_point("transport.connect", self.endpoint)
         with socket.create_connection((self.host, self.port),
                                       timeout=self.timeout_s) as sock:
             sock.sendall(_REQ.pack(MAGIC, shuffle_id, reduce_id))
@@ -106,7 +157,18 @@ class ShuffleBlockClient:
             for _ in range(count):
                 map_id, length = _BLOCK_HDR.unpack(
                     _recv_exact(sock, _BLOCK_HDR.size))
-                yield map_id, _recv_exact(sock, length)
+                fault_point("transport.block",
+                            f"{self.endpoint}#m{map_id}")
+                data = _recv_exact(sock, length)
+                if map_id in seen:
+                    continue
+                seen.add(map_id)
+                yield map_id, data
+
+    def stream_raw(self, shuffle_id: int,
+                   reduce_id: int) -> Iterator[Tuple[int, bytes]]:
+        yield from _retrying_stream(self, shuffle_id, reduce_id,
+                                    set(), None)
 
     def fetch_raw(self, shuffle_id: int,
                   reduce_id: int) -> List[Tuple[int, bytes]]:
@@ -126,6 +188,63 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
             raise ConnectionError("peer closed mid-message")
         buf += chunk
     return buf
+
+
+def _retrying_stream(cli: ShuffleBlockClient, shuffle_id: int,
+                     reduce_id: int, seen: set,
+                     resolver: Optional[Callable[[str], Optional[str]]]
+                     ) -> Iterator[Tuple[int, bytes]]:
+    """Drive ``cli`` attempts until the stream completes: bounded
+    same-endpoint retries with exponential backoff + jitter, then one
+    endpoint failover through ``resolver`` (the heartbeat registry's
+    current endpoint for the same executor) with a fresh retry budget.
+    ``seen`` spans attempts and endpoints: a block is yielded once."""
+    attempt = 0
+    failed_over = False
+    while True:
+        try:
+            yield from cli._stream_attempt(shuffle_id, reduce_id, seen)
+            return
+        except OSError as e:
+            attempt += 1
+            if attempt <= cli.max_retries:
+                time.sleep(cli.backoff_base_s * (2 ** (attempt - 1))
+                           * (1.0 + random.random() * 0.25))
+                continue
+            if resolver is not None and not failed_over:
+                try:
+                    alt = resolver(cli.endpoint)
+                except Exception:
+                    alt = None
+                if alt and alt != cli.endpoint:
+                    cli = ShuffleBlockClient(alt, cli.timeout_s,
+                                             cli.max_retries,
+                                             cli.backoff_base_s)
+                    failed_over = True
+                    attempt = 0
+                    continue
+            raise
+
+
+def stream_with_failover(endpoint: str, shuffle_id: int, reduce_id: int,
+                         endpoint_resolver: Optional[
+                             Callable[[str], Optional[str]]] = None,
+                         timeout_s: Optional[float] = None,
+                         max_retries: Optional[int] = None,
+                         backoff_base_s: Optional[float] = None
+                         ) -> Iterator[Tuple[int, bytes]]:
+    """Fetch one peer's blocks for a reduce partition, surviving
+    transient faults; a definitive failure surfaces as ``FetchFailed``
+    naming the peer."""
+    cli = ShuffleBlockClient(endpoint, timeout_s, max_retries,
+                             backoff_base_s)
+    try:
+        yield from _retrying_stream(cli, shuffle_id, reduce_id, set(),
+                                    endpoint_resolver)
+    except OSError as e:
+        if isinstance(e, FetchFailed):
+            raise
+        raise FetchFailed(endpoint, shuffle_id, reduce_id, e) from e
 
 
 class ByteBudget:
@@ -159,21 +278,40 @@ def fetch_all_partitions(endpoints: List[str], shuffle_id: int,
                          max_concurrent: Optional[int] = None,
                          in_flight_bytes: Optional[int] = None,
                          budget: Optional[ByteBudget] = None,
-                         map_mod=None) -> Iterator[ColumnarBatch]:
+                         map_mod=None,
+                         endpoint_resolver: Optional[
+                             Callable[[str], Optional[str]]] = None
+                         ) -> Iterator[ColumnarBatch]:
     """Reduce-side iterator over every peer's blocks for one partition
     (RapidsShuffleIterator role): up to ``max_concurrent`` peers fetch
     in parallel threads, blocks stage through a ``ByteBudget``-bounded
     hand-off, and each deserializes on the consuming thread. Block
     order is preserved per peer (map order); cross-peer order is
     arrival order, which no consumer depends on (partition contents
-    are set-semantics until a downstream sort)."""
-    from ..conf import (SHUFFLE_FETCH_IN_FLIGHT_BYTES,
+    are set-semantics until a downstream sort).
+
+    Per-peer streams retry with backoff and, when ``endpoint_resolver``
+    is given (cluster mode wires the driver's heartbeat registry), fail
+    over once to the peer's current endpoint before surfacing
+    ``FetchFailed``. Conf knobs resolve HERE, on the consuming thread —
+    fetch worker threads are fresh and would only see defaults."""
+    from ..conf import (FETCH_BACKOFF_BASE_S, FETCH_MAX_RETRIES,
+                        FETCH_TIMEOUT_S, SHUFFLE_FETCH_IN_FLIGHT_BYTES,
                         SHUFFLE_FETCH_MAX_CONCURRENT, active_conf)
     conf = active_conf()
     if max_concurrent is None:
         max_concurrent = conf.get(SHUFFLE_FETCH_MAX_CONCURRENT)
     if in_flight_bytes is None:
         in_flight_bytes = conf.get(SHUFFLE_FETCH_IN_FLIGHT_BYTES)
+    timeout_s = conf.get(FETCH_TIMEOUT_S)
+    max_retries = conf.get(FETCH_MAX_RETRIES)
+    backoff_base_s = conf.get(FETCH_BACKOFF_BASE_S)
+
+    def open_stream(ep: str) -> Iterator[Tuple[int, bytes]]:
+        return stream_with_failover(ep, shuffle_id, reduce_id,
+                                    endpoint_resolver, timeout_s,
+                                    max_retries, backoff_base_s)
+
     def keep(map_id: int) -> bool:
         # skew split: client-side map-slice filter ((s, S) keeps
         # map_id % S == s); blocks outside the slice are dropped before
@@ -181,8 +319,7 @@ def fetch_all_partitions(endpoints: List[str], shuffle_id: int,
         return map_mod is None or map_id % map_mod[1] == map_mod[0]
     if len(endpoints) <= 1 or max_concurrent <= 1:
         for ep in endpoints:
-            for map_id, data in ShuffleBlockClient(ep).stream_raw(
-                    shuffle_id, reduce_id):
+            for map_id, data in open_stream(ep):
                 if keep(map_id):
                     yield deserialize_batch(data)
         return
@@ -195,8 +332,7 @@ def fetch_all_partitions(endpoints: List[str], shuffle_id: int,
 
     def worker(ep: str) -> None:
         try:
-            for map_id, data in ShuffleBlockClient(ep).stream_raw(
-                    shuffle_id, reduce_id):
+            for map_id, data in open_stream(ep):
                 if stop.is_set():
                     return
                 if not keep(map_id):

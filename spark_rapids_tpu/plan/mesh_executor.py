@@ -112,10 +112,20 @@ class MeshQueryExecutor:
             return jax.tree_util.tree_map(lambda x: x[None], (out, ok))
 
         from ..shims import shard_map as _shard_map
-        step = jax.jit(_shard_map()(
+        sm = _shard_map()
+        # the replication-check kwarg was renamed check_rep -> check_vma
+        # across jax releases; pass whichever this release understands
+        import inspect
+        sm_params = inspect.signature(sm).parameters
+        check_kw = {}
+        for name in ("check_vma", "check_rep"):
+            if name in sm_params:
+                check_kw[name] = False
+                break
+        step = jax.jit(sm(
             shard_step, mesh=self.mesh,
             in_specs=tuple(P(self.axis) for _ in range(n_leaves)),
-            out_specs=P(self.axis), check_vma=False))
+            out_specs=P(self.axis), **check_kw))
         res, ok = step(*stacks)
         jax.block_until_ready(jax.tree_util.tree_leaves(res))
         if not bool(jnp.all(ok)):
@@ -235,8 +245,14 @@ class MeshQueryExecutor:
             return sample_fn
 
         if isinstance(node, ExpandExec):
+            from ..exec.basic import _expand_project_builder
             child = self._lower(node.children[0])
-            fns = [node._make_project(p) for p in node.projections]
+            # node.projections are already dtype-unified across lists
+            # (ExpandExec.__init__ casts divergent slots); build raw
+            # un-jitted projectors — the mesh trace jits the whole shard
+            out_names = [n for n, _ in node.output_schema]
+            fns = [_expand_project_builder(p, out_names)
+                   for p in node.projections]
 
             def expand_fn(env):
                 b = child(env)

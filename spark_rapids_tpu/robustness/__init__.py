@@ -1,0 +1,10 @@
+"""Robustness layer: deterministic fault injection (faults.py) used to
+prove out the transport/cluster/memory hardening paths."""
+
+from .faults import (FaultPlan, FaultSpec, active_plan, arm_fault_plan,
+                     arm_from_conf, current_op, disarm_fault_plan,
+                     fault_point, op_scope)
+
+__all__ = ["FaultPlan", "FaultSpec", "fault_point", "arm_fault_plan",
+           "disarm_fault_plan", "arm_from_conf", "active_plan",
+           "op_scope", "current_op"]

@@ -17,6 +17,19 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
+# XLA compile cache: a TESTS-ONLY shared dir (the package honors this
+# env override and skips its general-purpose per-machine dir). Test
+# processes all run with the identical cpu/x64/8-device config, so
+# every entry here is safe to reuse — unlike the package dir, which
+# bench/driver processes populate under other XLA flag sets. Entries
+# are complete even if a run is killed mid-write: the package patches
+# jax's cache put() to stage-and-rename (see _patch_atomic_cache_writes
+# — a truncated entry segfaults the jax cache READ path on every later
+# run, which is how the shared dir got poisoned before).
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    f"/tmp/srt_jax_cache_tests-{os.getuid() if hasattr(os, 'getuid') else 0}")
+
 import jax  # noqa: E402
 
 # The axon TPU plugin force-sets jax_platforms='axon,cpu' at import,
@@ -26,6 +39,35 @@ if not os.environ.get("SRT_TEST_TPU"):
     jax.config.update("jax_platforms", "cpu")
 
 jax.config.update("jax_enable_x64", True)
-# Persistent compile cache: kernel shapes repeat across test runs.
-jax.config.update("jax_compilation_cache_dir", "/tmp/srt_jax_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+# Persist every compile (the package only sets this when it owns the
+# cache dir); sub-0.5s kernel compiles dominate on CPU.
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+
+# This jaxlib segfaults in executable DESERIALIZATION once a process
+# has loaded ~2.3k entries from the disk cache (reproduced at the same
+# cumulative read count across full-suite runs, while the very same
+# entry deserializes fine earlier in the run — the trigger is process
+# state, not the entry). Work around it: shed the in-memory executable
+# caches once near the danger zone, then stop disk reads entirely just
+# below the observed trip point and fall back to fresh compiles —
+# slower past the cap, but the run survives instead of dying mid-suite.
+from jax._src import compiler as _compiler  # noqa: E402
+
+_CACHE_READ_CLEAR_AT = 1700
+_CACHE_READ_STOP_AT = 2000
+_cache_reads = [0]
+_orig_cache_read = _compiler._cache_read
+
+
+def _capped_cache_read(module_name, cache_key, compile_options, backend):
+    n = _cache_reads[0]
+    if n >= _CACHE_READ_STOP_AT:
+        return None, None
+    if n == _CACHE_READ_CLEAR_AT:
+        jax.clear_caches()
+    _cache_reads[0] = n + 1
+    return _orig_cache_read(module_name, cache_key, compile_options,
+                            backend)
+
+
+_compiler._cache_read = _capped_cache_read

@@ -77,22 +77,29 @@ def test_fetch_mixed_live_and_dead_endpoints_raises_after_drain():
 
 class _TruncatingHandler(threading.Thread):
     """A fake peer that advertises one block then dies mid-payload —
-    the peer-death-mid-fetch scenario."""
+    the peer-death-mid-fetch scenario. Accepts connections in a loop so
+    every RETRY hits the same truncation (the client reconnects after a
+    mid-stream death; a one-shot accept would turn the retries into
+    connect timeouts and mask the original error)."""
 
     def __init__(self):
         super().__init__(daemon=True)
         self.sock = socket.socket()
         self.sock.bind(("127.0.0.1", 0))
-        self.sock.listen(1)
+        self.sock.listen(8)
         self.endpoint = "127.0.0.1:%d" % self.sock.getsockname()[1]
 
     def run(self):
-        conn, _ = self.sock.accept()
-        conn.recv(12)  # request
-        conn.sendall(struct.pack("<I", 1))            # one block
-        conn.sendall(struct.pack("<IQ", 0, 1 << 20))  # promises 1 MiB
-        conn.sendall(b"x" * 100)                      # ...sends 100 B
-        conn.close()
+        while True:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            conn.recv(12)  # request
+            conn.sendall(struct.pack("<I", 1))            # one block
+            conn.sendall(struct.pack("<IQ", 0, 1 << 20))  # promises 1 MiB
+            conn.sendall(b"x" * 100)                      # ...sends 100 B
+            conn.close()
 
 
 def test_peer_death_mid_block_raises_connection_error():

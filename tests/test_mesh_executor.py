@@ -227,3 +227,23 @@ def test_sample_and_mono_id_on_mesh():
         vs.extend(d["v"])
     assert len(set(ids)) == len(ids)  # shard-unique ids
     assert 100 < len(vs) < 300  # ~50% sample
+
+
+def test_mesh_rollup_expand(mesh):
+    """ExpandExec (GROUPING SETS / ROLLUP pre-projection, GpuExpandExec
+    role) lowered onto the mesh — the NDS q36/q77 plan shape. Guards
+    the mesh lowering's projection-builder seam against drift in
+    ExpandExec's internals."""
+    conf = _conf()
+    s = TpuSession(conf)
+    rng = np.random.default_rng(7)
+    df = s.create_dataframe({
+        "a": rng.integers(0, 4, 300).tolist(),
+        "b": rng.integers(0, 3, 300).tolist(),
+        "v": rng.uniform(-10, 10, 300).tolist(),
+    })
+    s.create_or_replace_temp_view("t", df)
+    q = s.sql("SELECT a, b, SUM(v) AS s, COUNT(*) AS c FROM t "
+              "GROUP BY ROLLUP(a, b)")
+    phys = overrides.apply_overrides(q.plan, conf)
+    _assert_same(run_on_mesh(phys, mesh, conf), q)

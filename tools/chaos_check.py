@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""Chaos smoke for the distributed runtime: run a real multi-process
+cluster job under a sweep of seeded fault plans and verify every run
+stays oracle-identical.
+
+Each plan ships to the workers via ``srt.test.faultPlan`` (see
+docs/ROBUSTNESS.md for the spec grammar and fault-site catalog). The
+sweep covers the transient-transport paths (refused connects,
+mid-frame resets, delays, dropped heartbeats) and the stage-level
+recovery path (a worker crash at a stage boundary). A nonzero exit
+means a divergent result, a failed run, or a blown wall-clock budget —
+any of which is a real robustness regression.
+
+Usage:
+    python tools/chaos_check.py [--quick] [--workers N] [--budget SEC]
+
+``--quick`` (2 workers, 2 plans) is wired into tier-1 as
+tests/test_fault_injection.py::test_chaos_check_quick.
+"""
+
+import argparse
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# transient-transport sweep: safe to run back-to-back on one cluster
+TRANSIENT_PLANS = [
+    ("refused-connect + mid-frame reset",
+     "seed=11|transport.connect:refuse@1|transport.serve_block:reset@1"),
+    ("probabilistic block delays + dropped heartbeats",
+     "seed=5|transport.block:delay%0.3*20+0.02"
+     "|cluster.heartbeat:drop%1.0*3"),
+]
+
+# kills logical worker 1 at the final (range-exchange) barrier of
+# attempt 0 — after the hash exchange completed — forcing the driver's
+# stage-level retry path; runs LAST because it costs a worker
+CRASH_PLAN = ("worker crash at stage boundary",
+              "seed=3|cluster.barrier:crash@1~attempt=0;workers=1;pos=0;")
+
+
+def _rows_match(rows, oracle):
+    if [r["k"] for r in rows] != [r["k"] for r in oracle]:
+        return False
+    for got, want in zip(rows, oracle):
+        if got["c"] != want["c"]:
+            return False
+        if abs(got["s"] - want["s"]) > 1e-6 * max(1.0, abs(want["s"])):
+            return False
+    return True
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--quick", action="store_true",
+                    help="2 workers, 2 plans (tier-1 smoke)")
+    ap.add_argument("--workers", type=int, default=None)
+    ap.add_argument("--budget", type=float, default=None,
+                    help="wall-clock budget in seconds (hard exit 2)")
+    args = ap.parse_args()
+    n_workers = args.workers or (2 if args.quick else 3)
+    budget = args.budget or (300.0 if args.quick else 600.0)
+
+    # a hung barrier or lost abort would otherwise stall forever: the
+    # watchdog turns "hang" into a loud, bounded failure
+    def _expired():
+        print(f"[chaos] FAIL: wall-clock budget of {budget:.0f}s "
+              f"exhausted — treating as hang", file=sys.stderr,
+              flush=True)
+        os._exit(2)
+
+    watchdog = threading.Timer(budget, _expired)
+    watchdog.daemon = True
+    watchdog.start()
+    t0 = time.monotonic()
+
+    import numpy as np
+
+    from spark_rapids_tpu.conf import SrtConf
+    from spark_rapids_tpu.expr import col
+    from spark_rapids_tpu.expr.aggregates import CountStar, Sum
+    from spark_rapids_tpu.expr.core import Alias
+    from spark_rapids_tpu.parallel.cluster import (ClusterDriver,
+                                                   launch_local_workers)
+    from spark_rapids_tpu.plan import TpuSession
+
+    plans = ([TRANSIENT_PLANS[0], CRASH_PLAN] if args.quick
+             else TRANSIENT_PLANS + [CRASH_PLAN])
+
+    with tempfile.TemporaryDirectory(prefix="srt_chaos_") as tmp:
+        session = TpuSession(SrtConf({}))
+        rng = np.random.default_rng(29)
+        n = 8_000
+        fact_dir = os.path.join(tmp, "fact")
+        session.create_dataframe({
+            "k": rng.integers(0, 40, n).tolist(),
+            "v": rng.uniform(0, 10, n).tolist(),
+        }).write.parquet(fact_dir)
+
+        def logical(sess):
+            return sess.read.parquet(fact_dir) \
+                .group_by("k").agg(Alias(Sum(col("v")), "s"),
+                                   Alias(CountStar(), "c")) \
+                .sort("k")
+
+        oracle = logical(TpuSession(SrtConf({}))).collect()
+        print(f"[chaos] oracle: {len(oracle)} groups from {n} rows",
+              flush=True)
+
+        driver = ClusterDriver(num_workers=n_workers, barrier_timeout=60,
+                               heartbeat_interval=0.5, heartbeat_timeout=6)
+        procs = launch_local_workers(driver, n_workers)
+        failures = 0
+        try:
+            driver.wait_for_workers(timeout=120)
+            for name, spec in plans:
+                job_conf = {"srt.shuffle.partitions": 4,
+                            "srt.cluster.barrierTimeoutSec": 60,
+                            "srt.test.faultPlan": spec}
+                t = time.monotonic()
+                try:
+                    rows = driver.run(logical(session).plan, job_conf)
+                except Exception as e:
+                    print(f"[chaos] FAIL [{name}]: job raised "
+                          f"{type(e).__name__}: {e}", file=sys.stderr,
+                          flush=True)
+                    failures += 1
+                    continue
+                ok = _rows_match(rows, oracle)
+                recov = [e["type"] for e in driver.recovery_events]
+                print(f"[chaos] {'PASS' if ok else 'FAIL'} [{name}] "
+                      f"{time.monotonic() - t:.1f}s workers="
+                      f"{driver.num_workers} recovery={recov}",
+                      flush=True)
+                if not ok:
+                    failures += 1
+        finally:
+            driver.shutdown()
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except Exception:
+                    p.kill()
+        # the crash plan must actually have exercised stage-level
+        # recovery, else the sweep silently stopped proving anything
+        if not any(e["type"] == "stage_retry"
+                   for e in driver.recovery_events):
+            print("[chaos] FAIL: crash plan produced no stage_retry "
+                  "recovery event", file=sys.stderr, flush=True)
+            failures += 1
+    watchdog.cancel()
+    print(f"[chaos] done in {time.monotonic() - t0:.1f}s, "
+          f"{failures} failure(s)", flush=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
