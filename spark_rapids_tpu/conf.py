@@ -350,6 +350,31 @@ ANSI_ENABLED = conf("srt.sql.ansi.enabled") \
          "GpuCast.scala AnsiCast paths).") \
     .boolean(False)
 
+IGNORE_CORRUPT_FILES = conf("srt.sql.ignoreCorruptFiles") \
+    .doc("Skip-and-warn instead of failing when a file is corrupt "
+         "(unreadable, truncated, bad checksum) during a scan — "
+         "Spark's spark.sql.files.ignoreCorruptFiles semantics: rows "
+         "already decoded from the broken file are kept, the rest of "
+         "the file is dropped with a warning. Default FAILFAST "
+         "(raise).") \
+    .boolean(False)
+
+IGNORE_MISSING_FILES = conf("srt.sql.ignoreMissingFiles") \
+    .doc("Skip-and-warn instead of failing when a scan file has been "
+         "deleted between planning and execution — Spark's "
+         "spark.sql.files.ignoreMissingFiles semantics.") \
+    .boolean(False)
+
+INTEGRITY_CHECKSUM = conf("srt.integrity.checksum.enabled") \
+    .doc("Verify crc32c-style checksums on every off-device byte path "
+         "(shuffle blocks at serve/fetch/local read, host+disk spill "
+         "entries at re-materialization, file-cache entries on hit). "
+         "Corruption converts to a retryable fetch failure on the "
+         "transport and raises DataCorruption from storage tiers — "
+         "no silent wrong answers. Disable only to A/B the (noise-"
+         "level) checksum overhead.") \
+    .boolean(True)
+
 MESH_DATA_AXIS = conf("srt.mesh.dataAxis") \
     .doc("Name of the mesh axis partitions are sharded over.") \
     .internal().string("data")

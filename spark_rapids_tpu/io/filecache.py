@@ -20,16 +20,27 @@ TPU rebuild: one module provides both seams.
   is forced (test knob), mirroring the reference's
   "only cache remote filesystems" default. Hit/miss counts are exposed
   for metrics and tests.
+
+Cached copies are integrity-checked: each entry records the copied
+length and a crc32c-style checksum, and a hit re-validates both before
+the path is handed to a reader. A mismatch (bit rot, a truncated copy,
+another process scribbling on the cache dir) evicts the entry and falls
+back to a fresh copy from the source — never a silent wrong answer.
 """
 
 from __future__ import annotations
 
 import hashlib
+import logging
 import os
 import shutil
 import threading
 from collections import OrderedDict
 from typing import Optional, Tuple
+
+from ..robustness import integrity
+
+logger = logging.getLogger("spark_rapids_tpu.filecache")
 
 _LOCAL_SCHEMES = ("file://",)
 
@@ -55,30 +66,76 @@ def _strip_scheme(path: str) -> str:
     return path
 
 
+def _copy_and_checksum(src: str, dst: str, chunk: int = 1 << 20) -> int:
+    """Copy ``src`` to ``dst`` computing the checksum of the bytes
+    actually written (single pass — no re-read of the copy)."""
+    crc = 0
+    with open(src, "rb") as fin, open(dst, "wb") as fout:
+        while True:
+            buf = fin.read(chunk)
+            if not buf:
+                break
+            fout.write(buf)
+            crc = integrity.checksum_update(crc, buf)
+    return integrity.mask_crc(crc)
+
+
 class FileCache:
-    """Bounded local copy cache with LRU eviction."""
+    """Bounded local copy cache with LRU eviction + hit validation."""
 
     def __init__(self, cache_dir: str, max_bytes: int,
-                 cache_local: bool = False):
+                 cache_local: bool = False, verify: bool = True):
         self.cache_dir = cache_dir
         self.max_bytes = max_bytes
         self.cache_local = cache_local
+        self.verify = verify
         os.makedirs(cache_dir, exist_ok=True)
         self._lock = threading.Lock()
-        # key -> (local_path, size); insertion order = LRU order
-        self._entries: "OrderedDict[str, Tuple[str, int]]" = OrderedDict()
+        # key -> (local_path, size, crc); insertion order = LRU order
+        self._entries: "OrderedDict[str, Tuple[str, int, int]]" = \
+            OrderedDict()
         self._used = 0
         self.hits = 0
         self.misses = 0
+        self.validation_failures = 0
 
     def _key(self, path: str, st: os.stat_result) -> str:
         raw = f"{path}:{st.st_size}:{st.st_mtime_ns}"
         return hashlib.sha256(raw.encode()).hexdigest()[:32]
 
+    def _validate(self, key: str, ent: Tuple[str, int, int]) -> bool:
+        """Re-check a hit against the recorded length + checksum.
+        Returns False (after evicting the entry) when the cached copy
+        no longer matches what was copied in."""
+        local, size, crc = ent
+        ok = False
+        try:
+            if os.path.getsize(local) == size:
+                ok = (not self.verify) or integrity.file_checksum(local) == crc
+        except OSError:
+            ok = False
+        if ok:
+            return True
+        self.validation_failures += 1
+        logger.warning("file cache entry %s failed validation; evicting "
+                       "and re-reading from source", local)
+        with self._lock:
+            cur = self._entries.get(key)
+            if cur is not None and cur[0] == local:
+                del self._entries[key]
+                self._used -= cur[1]
+        try:
+            os.unlink(local)
+        except OSError:
+            pass
+        return False
+
     def get_local(self, path: str) -> str:
         """Local path for reading ``path`` — the cached copy when
         caching applies, the original otherwise. Stale entries (source
-        changed size/mtime) miss naturally via the key."""
+        changed size/mtime) miss naturally via the key; entries whose
+        on-disk copy fails length/checksum validation are evicted and
+        re-copied from the source."""
         src = _strip_scheme(path)
         if not self.cache_local:
             return src
@@ -89,17 +146,20 @@ class FileCache:
             if ent is not None:
                 self._entries.move_to_end(key)
                 self.hits += 1
-                return ent[0]
-            self.misses += 1
+            else:
+                self.misses += 1
+        if ent is not None and self._validate(key, ent):
+            return ent[0]
         local = os.path.join(self.cache_dir,
                              key + "_" + os.path.basename(src))
-        shutil.copyfile(src, local)
+        crc = _copy_and_checksum(src, local)
         size = os.path.getsize(local)
         with self._lock:
-            self._entries[key] = (local, size)
+            self._entries[key] = (local, size, crc)
             self._used += size
             while self._used > self.max_bytes and len(self._entries) > 1:
-                _, (old_path, old_size) = self._entries.popitem(last=False)
+                _, (old_path, old_size, _c) = \
+                    self._entries.popitem(last=False)
                 self._used -= old_size
                 try:
                     os.unlink(old_path)
@@ -118,17 +178,18 @@ def resolve_read_path(path: str, conf=None) -> str:
     cache when enabled."""
     from ..conf import (FILECACHE_DIR, FILECACHE_ENABLED,
                         FILECACHE_LOCAL_FS, FILECACHE_MAX_SIZE,
-                        URI_REWRITE_RULES, active_conf)
+                        INTEGRITY_CHECKSUM, URI_REWRITE_RULES, active_conf)
     conf = conf or active_conf()
     path = rewrite_uri(path, conf.get(URI_REWRITE_RULES))
     if not conf.get(FILECACHE_ENABLED):
         return _strip_scheme(path)
     global _CACHE, _CACHE_KEY
     key = (conf.get(FILECACHE_DIR), conf.get(FILECACHE_MAX_SIZE),
-           conf.get(FILECACHE_LOCAL_FS))
+           conf.get(FILECACHE_LOCAL_FS), conf.get(INTEGRITY_CHECKSUM))
     with _CACHE_LOCK:
         if _CACHE is None or _CACHE_KEY != key:
-            _CACHE = FileCache(key[0], key[1], cache_local=key[2])
+            _CACHE = FileCache(key[0], key[1], cache_local=key[2],
+                               verify=key[3])
             _CACHE_KEY = key
         cache = _CACHE
     return cache.get_local(path)
@@ -137,9 +198,11 @@ def resolve_read_path(path: str, conf=None) -> str:
 def cache_stats() -> dict:
     with _CACHE_LOCK:
         if _CACHE is None:
-            return {"hits": 0, "misses": 0, "entries": 0, "bytes": 0}
+            return {"hits": 0, "misses": 0, "entries": 0, "bytes": 0,
+                    "validationFailures": 0}
         return {"hits": _CACHE.hits, "misses": _CACHE.misses,
-                "entries": len(_CACHE._entries), "bytes": _CACHE._used}
+                "entries": len(_CACHE._entries), "bytes": _CACHE._used,
+                "validationFailures": _CACHE.validation_failures}
 
 
 def reset_cache() -> None:

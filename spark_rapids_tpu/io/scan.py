@@ -22,8 +22,11 @@ purely an I/O reduction, never a semantics change.
 from __future__ import annotations
 
 import concurrent.futures as cf
+import errno
 import glob as globlib
+import logging
 import os
+import struct as structlib
 from typing import Iterator, List, Optional, Sequence
 
 import numpy as np
@@ -37,7 +40,11 @@ from ..expr import core as E
 from ..expr import predicates as P
 from ..plan.host_table import HostTable, concat_tables, table_to_batch
 from ..plan.logical import LogicalPlan
+from ..robustness.faults import fault_point
+from ..robustness.integrity import DataCorruption
 from .arrow_convert import arrow_schema_to_schema, arrow_to_host_table
+
+logger = logging.getLogger("spark_rapids_tpu.scan")
 
 FORMATS = ("parquet", "orc", "csv", "json", "avro", "hivetext")
 
@@ -440,6 +447,21 @@ def _mark_decode(options, native: bool, cols: int = 0) -> None:
         stats["host_columns"] += cols
 
 
+#: error classes treated as "this file is corrupt" under
+#: srt.sql.ignoreCorruptFiles (Spark catches IOException +
+#: RuntimeException inside FilePartitionReader the same broad way):
+#: checksum failures, truncated/garbled streams (EOF, struct unpack),
+#: decoder rejections (ValueError covers AvroUnsupported and the
+#: native parquet/ORC validators), and pyarrow's ArrowException tree.
+_CORRUPT_ERRORS = (DataCorruption, OSError, EOFError, ValueError,
+                   structlib.error, pa.lib.ArrowException)
+
+
+def _is_missing_file_error(e: BaseException) -> bool:
+    return isinstance(e, FileNotFoundError) or (
+        isinstance(e, OSError) and e.errno == errno.ENOENT)
+
+
 def iter_file_tables(path: str, fmt: str, schema: Schema,
                      options: dict, arrow_filter,
                      max_rows: int, conf=None,
@@ -449,7 +471,43 @@ def iter_file_tables(path: str, fmt: str, schema: Schema,
     error is re-raised with the failing file's path prepended (same
     exception type, so callers' handling is unchanged) — the
     GpuMultiFileReader contract that a multi-file task failure
-    identifies WHICH file broke."""
+    identifies WHICH file broke.
+
+    Also the per-file seam for Spark's lenient-scan semantics:
+    ``srt.sql.ignoreMissingFiles`` swallows files deleted between
+    planning and read, and ``srt.sql.ignoreCorruptFiles`` swallows
+    decode/checksum failures — both skip-and-warn, keeping any rows the
+    file already yielded (FilePartitionReader.ignoreCorruptFiles
+    contract). Default for both is false: fail fast."""
+    from ..conf import (IGNORE_CORRUPT_FILES, IGNORE_MISSING_FILES,
+                        active_conf)
+    cnf = conf or active_conf()
+    try:
+        fault_point("scan.file", detail=path)
+        yield from _named_file_tables(path, fmt, schema, options,
+                                      arrow_filter, max_rows, conf,
+                                      partition_values)
+    except Exception as e:
+        if _is_missing_file_error(e):
+            if cnf.get(IGNORE_MISSING_FILES):
+                logger.warning(
+                    "skipping missing file %s (srt.sql.ignoreMissingFiles"
+                    "=true): %s", path, e)
+                return
+        elif isinstance(e, _CORRUPT_ERRORS):
+            if cnf.get(IGNORE_CORRUPT_FILES):
+                logger.warning(
+                    "skipping corrupt file %s (srt.sql.ignoreCorruptFiles"
+                    "=true): %s", path, e)
+                return
+        raise
+
+
+def _named_file_tables(path: str, fmt: str, schema: Schema,
+                       options: dict, arrow_filter,
+                       max_rows: int, conf=None,
+                       partition_values: Optional[dict] = None
+                       ) -> Iterator[HostTable]:
     try:
         yield from _iter_file_tables(path, fmt, schema, options,
                                      arrow_filter, max_rows, conf,

@@ -50,6 +50,7 @@ class TaskContext:
         self.retry_count = 0
         self.split_count = 0
         self.spilled_bytes = 0
+        self.alloc_attempts = 0
         # GpuTaskMetrics.scala:81-146 accumulators
         self.semaphore_wait_ns = 0
         self.spill_time_ns = 0
@@ -75,7 +76,7 @@ class TaskContext:
         self._inject_split_after = num_allocs_before
 
     def on_alloc_attempt(self) -> None:
-        self.alloc_attempts = getattr(self, "alloc_attempts", 0) + 1
+        self.alloc_attempts += 1
         if self._inject_retry_after is not None:
             if self._inject_retry_after == 0:
                 self._inject_retry_after = None
@@ -133,14 +134,20 @@ class MemoryBudget:
                 self.used += nbytes
                 return
             needed = self.used + nbytes - self.limit
-        # Out of budget: try to spill (outside the lock — spilling calls
-        # back into release()).
-        if self._spill_fn is not None:
+        # Out of budget: spill-then-recheck in a loop (outside the lock —
+        # spilling calls back into release()). A single spill pass can
+        # free less than asked — other tasks reserve concurrently, and
+        # the catalog frees whole batches — so keep asking until the
+        # reservation fits or the catalog frees nothing more.
+        while self._spill_fn is not None:
             freed = self._spill_fn(needed)
             with self._lock:
                 if self.used + nbytes <= self.limit:
                     self.used += nbytes
                     return
+                needed = self.used + nbytes - self.limit
+            if freed <= 0:
+                break
         raise RetryOOM(
             f"device budget exhausted: used={self.used} request={nbytes} "
             f"limit={self.limit}")

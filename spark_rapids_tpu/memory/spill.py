@@ -19,7 +19,10 @@ relieves device pressure.
 
 from __future__ import annotations
 
+import atexit
 import os
+import re
+import shutil
 import tempfile
 import threading
 from enum import IntEnum
@@ -30,6 +33,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..columnar.vector import ColumnarBatch
+from ..robustness import faults as _faults
+from ..robustness.integrity import DataCorruption, array_checksum
 from .budget import MemoryBudget, device_budget
 
 
@@ -136,7 +141,7 @@ class SpillableBatch:
     __slots__ = ("_batch", "_host", "_pooled", "_treedef", "_path",
                  "_nbytes", "priority", "_lock", "_catalog", "handle",
                  "closed", "_scalars", "_nleaves", "_num_rows",
-                 "creation_stack", "_slab")
+                 "creation_stack", "_slab", "_crcs")
 
     def __init__(self, batch: ColumnarBatch,
                  priority: SpillPriority = SpillPriority.ACTIVE_ON_DECK,
@@ -151,6 +156,7 @@ class SpillableBatch:
         self._treedef = None
         self._path: Optional[str] = None
         self._slab = None  # (metas, scalars, nleaves, total) for .slab
+        self._crcs = None  # per-leaf checksums taken at spill time
         self.priority = priority
         self._lock = threading.Lock()
         self.closed = False
@@ -185,6 +191,13 @@ class SpillableBatch:
                 return 0
             t0 = _time.perf_counter_ns()
             host, self._treedef = _tree_to_host(self._batch)
+            # checksum every leaf the moment it leaves the device: the
+            # host and disk tiers both verify against these at
+            # re-materialization (device->host->disk chain integrity)
+            if self._catalog.verify_checksums:
+                self._crcs = tuple(
+                    array_checksum(x) if isinstance(x, np.ndarray)
+                    else None for x in host)
             # host tier backing: native pool slab when space can be
             # found (cascading older host entries to disk), else plain
             # numpy under the same byte accounting
@@ -259,38 +272,99 @@ class SpillableBatch:
             if self._batch is not None:
                 return self._batch
         self._catalog.budget.reserve(self._nbytes)
-        with self._lock:
-            if self.closed:
-                self._catalog.budget.release(self._nbytes)
-                raise ValueError("SpillableBatch used after close")
-            if self._batch is not None:  # raced with another get()
-                self._catalog.budget.release(self._nbytes)
-                return self._batch
-            if self._host is None and self._pooled is None and \
-                    self._path is not None:
-                if self._slab is not None:
-                    self._host = self._load_slab()
-                else:
-                    data = np.load(self._path)
-                    leaves = []
-                    for i in range(self._nleaves):
-                        if i in self._scalars:
-                            leaves.append(self._scalars[i])
+        try:
+            with self._lock:
+                if self.closed:
+                    self._catalog.budget.release(self._nbytes)
+                    raise ValueError("SpillableBatch used after close")
+                if self._batch is not None:  # raced with another get()
+                    self._catalog.budget.release(self._nbytes)
+                    return self._batch
+                if self._host is None and self._pooled is None and \
+                        self._path is not None:
+                    # a corrupt spill file may fail to even PARSE
+                    # (flipped npz metadata, short read): any decode
+                    # error here is at-rest corruption, same as a
+                    # checksum mismatch
+                    try:
+                        if self._slab is not None:
+                            self._host = self._load_slab()
                         else:
-                            leaves.append(data[f"a{i}"])
-                    self._host = leaves
-                os.unlink(self._path)
-                self._path = None
-            if self._pooled is not None:
-                host = self._pooled.unpack()
+                            data = np.load(self._path)
+                            leaves = []
+                            for i in range(self._nleaves):
+                                if i in self._scalars:
+                                    leaves.append(self._scalars[i])
+                                else:
+                                    leaves.append(data[f"a{i}"])
+                            self._host = leaves
+                    except Exception as e:
+                        raise DataCorruption(
+                            f"spill entry handle={self.handle} "
+                            f"unreadable at re-materialization: "
+                            f"{type(e).__name__}: {e}",
+                            detail="entry dropped; recompute the "
+                                   "batch") from e
+                    os.unlink(self._path)
+                    self._path = None
+                if self._pooled is not None:
+                    host = self._pooled.unpack()  # copies out of the slab
+                    self._pooled.free()
+                    self._pooled = None
+                else:
+                    host = self._host
+                self._host = None
+                # every tier funnels through one verification point
+                # before touching the device
+                host = self._verify_host(host)
                 self._batch = _tree_to_device(host, self._treedef)
-                del host  # pool views die before the slab frees
-                self._pooled.free()
-                self._pooled = None
-            else:
-                self._batch = _tree_to_device(self._host, self._treedef)
-            self._host = None
-            return self._batch
+                return self._batch
+        except DataCorruption:
+            # the entry's bytes are gone for good — drop it so retries
+            # cannot re-read garbage; the caller (retry framework /
+            # stage rerun) recomputes the batch from its lineage
+            with self._lock:
+                self.closed = True
+                self._host = None
+                if self._pooled is not None:
+                    self._pooled.free()
+                    self._pooled = None
+                if self._path is not None:
+                    try:
+                        os.unlink(self._path)
+                    except OSError:
+                        pass
+                    self._path = None
+            self._catalog.budget.release(self._nbytes)
+            self._catalog.unregister(self.handle)
+            raise
+
+    def _verify_host(self, host):
+        """Seeded corruption site plus checksum verification at
+        re-materialization — host- and disk-tier entries both pass
+        through here on their way back to the device."""
+        if _faults.armed():
+            host = list(host)
+            for idx, leaf in enumerate(host):
+                if isinstance(leaf, np.ndarray) and leaf.size:
+                    # adopt the return value: read-only leaves are
+                    # corrupted on a copy, not in place
+                    host[idx] = _faults.corrupt_point(
+                        "spill.materialize", leaf,
+                        f"handle={self.handle};leaf={idx};")
+        if self._crcs is None:
+            return host
+        for idx, (leaf, crc) in enumerate(zip(host, self._crcs)):
+            if crc is None or not isinstance(leaf, np.ndarray):
+                continue
+            actual = array_checksum(leaf)
+            if actual != crc:
+                raise DataCorruption(
+                    f"spill entry handle={self.handle} leaf={idx} "
+                    f"failed verification at re-materialization",
+                    expected=crc, actual=actual,
+                    detail="entry dropped; recompute the batch")
+        return host
 
     def _load_slab(self):
         """Read a raw .slab spill back (O_DIRECT when the 4K-aligned
@@ -356,13 +430,25 @@ class SpillCatalog:
     def __init__(self, budget: Optional[MemoryBudget] = None,
                  host_limit: Optional[int] = None,
                  spill_dir: Optional[str] = None):
-        from ..conf import HOST_SPILL_LIMIT, SPILL_DIR, active_conf
+        from ..conf import (HOST_SPILL_LIMIT, INTEGRITY_CHECKSUM,
+                            SPILL_DIR, active_conf)
         conf = active_conf()
         self.budget = budget or device_budget()
         self.budget.set_spill_callback(self.synchronous_spill)
         self.host_limit = host_limit or conf.get(HOST_SPILL_LIMIT)
-        self.spill_dir = spill_dir or conf.get(SPILL_DIR)
-        os.makedirs(self.spill_dir, exist_ok=True)
+        self.verify_checksums = conf.get(INTEGRITY_CHECKSUM)
+        # disk-tier entries live in a PER-SESSION directory under the
+        # configured root: a process killed mid-query cannot leak
+        # orphaned mkstemp files forever — this process removes its own
+        # dir at exit, and any dir whose owning pid is gone is swept
+        # here on the next catalog init
+        base = spill_dir or conf.get(SPILL_DIR)
+        os.makedirs(base, exist_ok=True)
+        sweep_stale_spill_dirs(base)
+        self.spill_root = base
+        self.spill_dir = tempfile.mkdtemp(
+            prefix=f"session-{os.getpid()}-", dir=base)
+        atexit.register(_remove_session_dir, self.spill_dir)
         self._entries: Dict[int, SpillableBatch] = {}
         self._next = 0
         self._lock = threading.Lock()
@@ -475,6 +561,44 @@ class SpillCatalog:
         tiers["budget_used"] = self.budget.used
         tiers["budget_limit"] = self.budget.limit
         return tiers
+
+
+_SESSION_DIR_RE = re.compile(r"^session-(\d+)-")
+
+
+def _remove_session_dir(path: str) -> None:
+    shutil.rmtree(path, ignore_errors=True)
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except OSError:
+        return True  # exists but not ours (EPERM etc.)
+    return True
+
+
+def sweep_stale_spill_dirs(base: str) -> int:
+    """Remove session spill directories whose owning process is gone
+    (killed mid-query before its atexit cleanup could run). Returns the
+    number of directories swept."""
+    try:
+        names = os.listdir(base)
+    except OSError:
+        return 0
+    swept = 0
+    for name in names:
+        m = _SESSION_DIR_RE.match(name)
+        if m is None:
+            continue
+        pid = int(m.group(1))
+        if pid == os.getpid() or _pid_alive(pid):
+            continue
+        shutil.rmtree(os.path.join(base, name), ignore_errors=True)
+        swept += 1
+    return swept
 
 
 _CATALOG: Optional[SpillCatalog] = None

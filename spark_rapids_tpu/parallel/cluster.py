@@ -556,6 +556,14 @@ class ClusterWorker:
         for pos, new_sid in enumerate(sids_by_pos):
             old_sid = last["sids"][pos]
             if pos in reusable_positions and new_sid not in tainted:
+                if self.manager.is_poisoned(old_sid):
+                    # a corrupt block was quarantined from this
+                    # shuffle: its map outputs are incomplete and must
+                    # NOT be reused — fail the stage retry so the
+                    # driver's whole-job fallback regenerates them
+                    raise RuntimeError(
+                        "stage-reuse state unavailable: shuffle "
+                        f"{old_sid} quarantined after DataCorruption")
                 self.manager.rename_shuffle(old_sid, new_sid)
                 reused.add(new_sid)
                 old_bounds = last["bounds"].get(old_sid)
@@ -876,8 +884,10 @@ class ClusterDriver:
                     raise StageRetryFailed(w, err)
                 if "barrier" in err or "gather" in err or \
                         "peer closed" in err or "refused" in err or \
-                        "FetchFailed" in err:
-                    # collateral of a lost peer, not a plan error
+                        "FetchFailed" in err or "DataCorruption" in err:
+                    # collateral of a lost peer — or detected data
+                    # corruption, which a rerun regenerates — not a
+                    # plan error
                     raise WorkerLost(w)
                 raise RuntimeError(
                     f"worker {w} failed:\n{err}")
@@ -913,6 +923,7 @@ class ClusterDriver:
         self._abort_sync()
         prev_assign = self._last_assign
         alive: List[Tuple[socket.socket, str, str]] = []
+        reuse_refused = False
         for sock, ep, eid in self._workers:
             ok = False
             try:
@@ -923,6 +934,17 @@ class ClusterDriver:
                         reply = _recv_msg(sock)
                         if reply is None:
                             break
+                        # a worker that refused the FAILED attempt's
+                        # reuse request (quarantined/poisoned shuffle)
+                        # may have its refusal sitting in the stale
+                        # backlog while the driver classified a peer's
+                        # collateral barrier error first — honor it
+                        # here, or the driver would re-plan the same
+                        # doomed stage retry until attempts run out
+                        if reply.get("type") == "error" and \
+                                "stage-reuse state unavailable" in \
+                                reply.get("error", ""):
+                            reuse_refused = True
                         if reply.get("type") == "retry_ready":
                             ok = reply.get("token") == job_token
                             break
@@ -938,6 +960,11 @@ class ClusterDriver:
             return None
         self._workers = alive
         self.num_workers = len(alive)
+        if reuse_refused:
+            print("[driver] stage retry unusable: a worker refused map-"
+                  "output reuse (quarantined shuffle); falling back to "
+                  "whole-job retry", file=sys.stderr, flush=True)
+            return None
         if not completed or not prev_assign or \
                 any(eid not in prev_assign for _s, _ep, eid in alive):
             return None

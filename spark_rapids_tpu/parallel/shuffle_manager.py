@@ -34,7 +34,8 @@ from ..columnar.vector import ColumnarBatch, choose_capacity
 from ..conf import (SHUFFLE_COMPRESS, SHUFFLE_MODE, SHUFFLE_PARTITIONS,
                     SrtConf, active_conf)
 from ..memory.spill import SpillPriority, SpillableBatch
-from ..robustness.faults import fault_point
+from ..robustness import integrity
+from ..robustness.faults import corrupt_point, fault_point
 from .serializer import deserialize_batch, serialize_batch
 
 BlockId = Tuple[int, int, int]  # (shuffle_id, map_id, reduce_id)
@@ -86,7 +87,10 @@ class ShuffleBlockCatalog:
 
 class HostBlockStore:
     """Serialized host-memory blocks (the MULTITHREADED mode's 'shuffle
-    files')."""
+    files'). Blocks are stored inside the integrity layer's framed
+    checksum envelope — the checksum is computed once at
+    write/registration (SPARK-35275 role) and verified at every
+    consumption point (server serve, remote fetch, local read)."""
 
     def __init__(self):
         self._blocks: Dict[BlockId, bytes] = {}
@@ -94,13 +98,28 @@ class HostBlockStore:
         self.bytes_written = 0
 
     def put(self, block: BlockId, data: bytes) -> None:
+        framed = integrity.wrap(data)
+        # seeded at-rest corruption (chaos/tests): flips a byte of the
+        # stored frame so every later verification path must catch it
+        framed = corrupt_point(
+            "shuffle.block.store", framed,
+            f"sid={block[0]};map={block[1]};reduce={block[2]};")
         with self._lock:
-            self._blocks[block] = data
-            self.bytes_written += len(data)
+            self._blocks[block] = framed
+            self.bytes_written += len(framed)
 
     def get(self, block: BlockId) -> Optional[bytes]:
+        """The raw FRAMED bytes (header + payload) — what the transport
+        serves; consumers unwrap/verify."""
         with self._lock:
             return self._blocks.get(block)
+
+    def remove_block(self, block: BlockId) -> bool:
+        with self._lock:
+            data = self._blocks.pop(block, None)
+            if data is not None:
+                self.bytes_written -= len(data)
+            return data is not None
 
     def blocks_for_reduce(self, shuffle_id: int,
                           reduce_id: int) -> List[BlockId]:
@@ -140,8 +159,15 @@ class ShuffleManager:
         self.mode = self.conf.get(SHUFFLE_MODE).upper()  # MESH|MULTITHREADED|CACHE_ONLY
         self.codec = self.conf.get(SHUFFLE_COMPRESS).lower()
         self.compress = self.codec != "none"
+        from ..conf import INTEGRITY_CHECKSUM
+        self.verify_checksums = self.conf.get(INTEGRITY_CHECKSUM)
         self.catalog = ShuffleBlockCatalog()
         self.host_store = HostBlockStore()
+        #: shuffles with a corrupt-at-rest block: their outputs must
+        #: never be served or reused (stage-level reuse of a poisoned
+        #: sid fails over to a whole-job retry that regenerates them)
+        self._poisoned_sids: set = set()
+        self.integrity_failures = 0
         self._pool = cf.ThreadPoolExecutor(max_workers=num_threads)
         self._registered: Dict[int, int] = {}  # shuffle_id -> num_parts
         #: (shuffle_id, reduce_id) -> rows written (AQE statistics — the
@@ -162,8 +188,28 @@ class ShuffleManager:
         self.host_store.remove_shuffle(shuffle_id)
         with self._lock:
             self._registered.pop(shuffle_id, None)
+            self._poisoned_sids.discard(shuffle_id)
             for k in [k for k in self._part_rows if k[0] == shuffle_id]:
                 del self._part_rows[k]
+
+    # --- integrity ---
+    def is_poisoned(self, shuffle_id: int) -> bool:
+        with self._lock:
+            return shuffle_id in self._poisoned_sids
+
+    def quarantine_block(self, block: BlockId, reason: str = "") -> None:
+        """A stored block failed verification: drop it and poison its
+        shuffle so no consumer can ever read a partial partition — the
+        ONLY safe recoveries are stage rerun / whole-job retry, both of
+        which refuse poisoned state and regenerate from scratch."""
+        import logging
+        self.host_store.remove_block(block)
+        with self._lock:
+            self._poisoned_sids.add(block[0])
+            self.integrity_failures += 1
+        logging.getLogger("spark_rapids_tpu.shuffle").warning(
+            "quarantined corrupt shuffle block %s%s", block,
+            f": {reason}" if reason else "")
 
     def rename_shuffle(self, old_id: int, new_id: int) -> int:
         """Re-key every surviving block (and its AQE row stats) from
@@ -172,6 +218,9 @@ class ShuffleManager:
         fresh shuffle id instead of recomputing them."""
         moved = self.host_store.rename_shuffle(old_id, new_id)
         with self._lock:
+            if old_id in self._poisoned_sids:  # defensive: reuse of a
+                self._poisoned_sids.discard(old_id)  # poisoned sid is
+                self._poisoned_sids.add(new_id)      # refused upstream
             if old_id in self._registered:
                 self._registered[new_id] = self._registered.pop(old_id)
             for k in [k for k in self._part_rows if k[0] == old_id]:
@@ -235,6 +284,10 @@ class ShuffleManager:
         ``map_mod=(s, S)`` keeps only blocks with map_id % S == s — a
         skewed reduce partition splits into S disjoint map slices."""
         fault_point("shuffle.read", f"sid={shuffle_id};reduce={reduce_id};")
+        if self.is_poisoned(shuffle_id):
+            raise integrity.DataCorruption(
+                f"shuffle {shuffle_id} quarantined after a corrupt "
+                f"block; partition {reduce_id} is incomplete")
         def keep(map_id: int) -> bool:
             return map_mod is None or map_id % map_mod[1] == map_mod[0]
         if self.mode == "CACHE_ONLY":
@@ -253,9 +306,20 @@ class ShuffleManager:
                 yield batch
 
     def _deserialize_one(self, block: BlockId) -> Optional[ColumnarBatch]:
-        data = self.host_store.get(block)
-        if data is None:
+        framed = self.host_store.get(block)
+        if framed is None:
             return None
+        if not self.verify_checksums:
+            return deserialize_batch(integrity.strip(framed))
+        try:
+            data = integrity.unwrap(
+                framed, what=f"shuffle block sid={block[0]} "
+                             f"map={block[1]} reduce={block[2]}")
+        except integrity.DataCorruption:
+            # local read of a corrupt-at-rest block: quarantine and
+            # surface — returning garbage rows is never an option
+            self.quarantine_block(block, reason="local read")
+            raise
         return deserialize_batch(data)
 
     def shutdown(self) -> None:

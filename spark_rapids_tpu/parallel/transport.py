@@ -12,9 +12,14 @@ this transport is the fetch path, with the heartbeat registry
 Wire protocol (all little-endian):
   request:  magic u32 | shuffle_id u32 | reduce_id u32
   response: count u32, then per block: map_id u32 | length u64 | bytes
-Transfers reuse the serializer's self-describing block format, so the
-receiving side deserializes straight into capacity-bucketed batches
-(ShuffleReceivedBufferCatalog role falls to the caller's manager).
+Each block's bytes are the integrity layer's framed checksum envelope
+around the serializer's self-describing block format: the server
+verifies the stored frame before serving (corrupt-at-rest blocks are
+quarantined and the fetch converted into a failure), the client
+verifies after receive (wire corruption becomes a retryable error),
+and the receiving side then deserializes straight into
+capacity-bucketed batches (ShuffleReceivedBufferCatalog role falls to
+the caller's manager).
 """
 
 from __future__ import annotations
@@ -28,7 +33,9 @@ import time
 from typing import Callable, Iterator, List, Optional, Tuple
 
 from ..columnar.vector import ColumnarBatch
-from ..robustness.faults import fault_point
+from ..robustness import integrity
+from ..robustness.faults import corrupt_point, fault_point
+from ..robustness.integrity import DataCorruption
 from .serializer import deserialize_batch
 from .shuffle_manager import ShuffleManager
 
@@ -68,9 +75,34 @@ class _Handler(socketserver.BaseRequestHandler):
                         f"sid={shuffle_id};reduce={reduce_id};")
         except ConnectionResetError:
             return  # injected: drop the request before answering
+        if mgr.is_poisoned(shuffle_id):
+            # quarantined shuffle: abort without answering — serving
+            # the surviving blocks would silently drop the lost one;
+            # the client's fetch fails definitively and stage rerun /
+            # job retry regenerates the whole map output
+            return
         blocks = mgr.host_store.blocks_for_reduce(shuffle_id, reduce_id)
-        payload = [(b[1], mgr.host_store.get(b)) for b in blocks]
-        payload = [(m, d) for m, d in payload if d is not None]
+        payload = []
+        for b in blocks:
+            framed = mgr.host_store.get(b)
+            if framed is None:
+                continue
+            if mgr.verify_checksums:
+                try:
+                    integrity.verify_framed(
+                        framed, what=f"stored shuffle block {b}")
+                except DataCorruption as e:
+                    # at-rest corruption caught before a single byte is
+                    # served: quarantine and drop the connection
+                    mgr.quarantine_block(b, reason=str(e))
+                    return
+            # seeded wire corruption (chaos/tests): mutates the frame
+            # in flight, so the CLIENT-side verification must catch it
+            # and the refetch must heal (the stored copy is intact)
+            framed = corrupt_point(
+                "shuffle.block.wire", framed,
+                f"sid={shuffle_id};reduce={reduce_id};m={b[1]};")
+            payload.append((b[1], framed))
         self.request.sendall(struct.pack("<I", len(payload)))
         for map_id, data in payload:
             try:
@@ -132,8 +164,10 @@ class ShuffleBlockClient:
                  max_retries: Optional[int] = None,
                  backoff_base_s: Optional[float] = None):
         from ..conf import (FETCH_BACKOFF_BASE_S, FETCH_MAX_RETRIES,
-                            FETCH_TIMEOUT_S, active_conf)
+                            FETCH_TIMEOUT_S, INTEGRITY_CHECKSUM,
+                            active_conf)
         conf = active_conf()
+        self.verify_checksums = conf.get(INTEGRITY_CHECKSUM)
         self.endpoint = endpoint
         self.host, port = endpoint.rsplit(":", 1)
         self.port = int(port)
@@ -162,8 +196,21 @@ class ShuffleBlockClient:
                 data = _recv_exact(sock, length)
                 if map_id in seen:
                     continue
+                # verify BEFORE marking seen: a block that fails its
+                # checksum was never received, and the retried stream
+                # must fetch it again
+                try:
+                    payload = integrity.unwrap(
+                        data, what=f"shuffle block sid={shuffle_id} "
+                                   f"m={map_id} from {self.endpoint}") \
+                        if self.verify_checksums else integrity.strip(data)
+                except DataCorruption as e:
+                    # convert to a retryable transport failure: wire
+                    # corruption heals on refetch; an at-rest-corrupt
+                    # source aborts server-side and ends in FetchFailed
+                    raise ConnectionError(str(e)) from e
                 seen.add(map_id)
-                yield map_id, data
+                yield map_id, payload
 
     def stream_raw(self, shuffle_id: int,
                    reduce_id: int) -> Iterator[Tuple[int, bytes]]:

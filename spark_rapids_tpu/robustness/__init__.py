@@ -1,10 +1,16 @@
 """Robustness layer: deterministic fault injection (faults.py) used to
-prove out the transport/cluster/memory hardening paths."""
+prove out the transport/cluster/memory hardening paths, and the data
+integrity layer (integrity.py: checksummed shuffle/spill/cache tiers
+with DataCorruption detection and recovery)."""
 
 from .faults import (FaultPlan, FaultSpec, active_plan, arm_fault_plan,
-                     arm_from_conf, current_op, disarm_fault_plan,
-                     fault_point, op_scope)
+                     arm_from_conf, corrupt_point, current_op,
+                     disarm_fault_plan, fault_point, op_scope)
+from .integrity import (DataCorruption, checksum, unwrap, verify_framed,
+                        wrap)
 
-__all__ = ["FaultPlan", "FaultSpec", "fault_point", "arm_fault_plan",
-           "disarm_fault_plan", "arm_from_conf", "active_plan",
-           "op_scope", "current_op"]
+__all__ = ["FaultPlan", "FaultSpec", "fault_point", "corrupt_point",
+           "arm_fault_plan", "disarm_fault_plan", "arm_from_conf",
+           "active_plan", "op_scope", "current_op",
+           "DataCorruption", "checksum", "wrap", "unwrap",
+           "verify_framed"]

@@ -30,7 +30,11 @@ Spec grammar (clauses joined by ``|``; first clause may be ``seed=N``)::
   (ConnectionResetError), ``delay`` (sleep ``delay_s``), ``crash``
   (``os._exit(137)``), ``retry_oom`` / ``split_oom`` (RetryOOM /
   SplitAndRetryOOM), ``drop`` (FaultDrop — sites that poll, e.g. the
-  heartbeat loop, treat it as "skip this beat").
+  heartbeat loop, treat it as "skip this beat"), ``corrupt`` /
+  ``truncate`` (data corruption: at a data-bearing
+  ``corrupt_point(site, data)`` the bytes are deterministically
+  byte-flipped / tail-truncated; at a plain ``fault_point`` site both
+  raise ``DataCorruption`` — a file that reads as garbage).
 - ``@nth`` fires on exactly the nth *matching* hit (1-based);
   ``%prob`` fires each matching hit with probability ``prob`` from the
   plan's seeded RNG. Exactly one of the two; ``@1`` assumed otherwise.
@@ -73,7 +77,11 @@ class FaultSpec:
     match: str = ""              # substring filter on the hit detail
 
     _KINDS = ("refuse", "reset", "delay", "crash", "retry_oom",
-              "split_oom", "drop")
+              "split_oom", "drop", "corrupt", "truncate")
+    #: kinds that mutate data at corrupt_point sites (all other kinds
+    #: are ignored there; at plain fault_point sites these raise
+    #: DataCorruption instead)
+    _DATA_KINDS = ("corrupt", "truncate")
 
     @classmethod
     def parse(cls, clause: str) -> "FaultSpec":
@@ -218,9 +226,74 @@ class FaultPlan:
         if sp.kind == "split_oom":
             from ..memory.budget import SplitAndRetryOOM
             raise SplitAndRetryOOM(msg)
+        if sp.kind in FaultSpec._DATA_KINDS:
+            # a corrupt/truncate clause armed on a plain (non-data)
+            # fault site models a file/entry that reads as garbage
+            from .integrity import DataCorruption
+            raise DataCorruption(msg)
         if sp.kind == "crash":
             print(msg, file=sys.stderr, flush=True)
             os._exit(137)
+
+    def mutate(self, site: str, data, detail: Optional[str]):
+        """corrupt_point dispatch: find the first armed corrupt/truncate
+        clause matching this data-bearing site hit and apply it. The
+        flip position comes from the plan's seeded RNG, so replays with
+        the same spec over the same workload corrupt the same byte;
+        every mutation is recorded in ``plan.log`` with its position."""
+        to_fire: Optional[FaultSpec] = None
+        hit_no = 0
+        ref = detail if detail is not None else current_op()
+        with self._lock:
+            for i, sp in enumerate(self.specs):
+                if sp.site != site or sp.kind not in FaultSpec._DATA_KINDS:
+                    continue
+                if sp.match and sp.match not in ref:
+                    continue
+                self._hits[i] += 1
+                if self._fires[i] >= sp.count:
+                    continue
+                if sp.nth is not None:
+                    fire = self._hits[i] == sp.nth
+                else:
+                    fire = self._rng.random() < sp.prob
+                if not fire:
+                    continue
+                self._fires[i] += 1
+                hit_no = self._hits[i]
+                to_fire = sp
+                break
+            if to_fire is None:
+                return data
+            n = int(data.nbytes) if hasattr(data, "nbytes") else len(data)
+            if n == 0:
+                self.log.append(FaultEvent(site, to_fire.kind,
+                                           f"{ref};empty;", hit_no))
+                return data
+            if to_fire.kind == "truncate":
+                cut = max(n // 2, 1) if n > 1 else 0
+                self.log.append(FaultEvent(site, "truncate",
+                                           f"{ref};cut={cut};", hit_no))
+                return data[:cut]
+            pos = self._rng.randrange(n)
+            self.log.append(FaultEvent(site, "corrupt",
+                                       f"{ref};byte={pos};", hit_no))
+            if hasattr(data, "dtype"):   # numpy array: mutate in place
+                import numpy as np
+                if not data.flags.writeable:
+                    # device->host leaves can be read-only views; the
+                    # caller must adopt the returned copy
+                    data = data.copy()
+                if data.flags["C_CONTIGUOUS"]:
+                    data.view(np.uint8).reshape(-1)[pos] ^= 0xFF
+                else:   # rare: perturb one element instead
+                    idx = tuple(np.unravel_index(pos % data.size,
+                                                 data.shape))
+                    data[idx] = data[idx] + type(data[idx].item())(1)
+                return data
+            out = bytearray(data)
+            out[pos] ^= 0xFF
+            return bytes(out)
 
 
 _PLAN: Optional[FaultPlan] = None
@@ -233,6 +306,17 @@ def fault_point(site: str, detail: Optional[str] = None) -> None:
     if _PLAN is None:
         return
     _PLAN.hit(site, detail)
+
+
+def corrupt_point(site: str, data, detail: Optional[str] = None):
+    """Data-bearing fault hook: returns ``data`` unchanged (one global
+    load + `is` compare) unless an armed plan has a ``corrupt`` /
+    ``truncate`` clause matching this site hit, in which case the
+    returned bytes are deterministically mutated (numpy arrays are
+    mutated in place). Non-data fault kinds never fire here."""
+    if _PLAN is None:
+        return data
+    return _PLAN.mutate(site, data, detail)
 
 
 def armed() -> bool:

@@ -47,7 +47,10 @@ def test_spec_parse_unparse_roundtrip():
               "cluster.barrier:crash@1~attempt=0;workers=1;pos=0;",
               "memory.reserve:retry_oom@1~HashAggregateExec",
               "transport.block:delay@1+0.25",
-              "cluster.heartbeat:drop@2*5~executor=exec-1;"]:
+              "cluster.heartbeat:drop@2*5~executor=exec-1;",
+              "shuffle.block.store:corrupt@1~map=0;",
+              "shuffle.block.wire:corrupt%0.5*2",
+              "spill.materialize:truncate@3"]:
         spec = FaultSpec.parse(s)
         assert spec.unparse() == s
         assert FaultSpec.parse(spec.unparse()).unparse() == s
@@ -115,6 +118,37 @@ def test_match_filters_on_detail():
         except faults.FaultDrop:
             assert k == 3
     assert [e.detail for e in plan.fired()] == ["k=3;"]
+
+
+def test_corrupt_on_non_data_site_raises_data_corruption():
+    """A corrupt clause armed on a plain (non-data) fault_point site
+    models an entry that reads back as garbage: the hit raises
+    DataCorruption instead of mutating bytes it doesn't have."""
+    from spark_rapids_tpu.robustness.integrity import DataCorruption
+    plan = FaultPlan([FaultSpec.parse("scan.file:corrupt@1")])
+    with pytest.raises(DataCorruption):
+        plan.hit("scan.file", "some/file.parquet")
+    assert len(plan.fired("scan.file")) == 1
+
+
+def test_corrupt_replay_same_spec_same_bytes():
+    """The determinism contract for corruption faults: re-running the
+    same spec over the same payload sequence flips the same byte of the
+    same hit (what makes a chaos failure reproducible)."""
+    spec = "seed=19|shuffle.block.store:corrupt%0.4*3"
+    payloads = [bytes([i] * 64) for i in range(20)]
+
+    def replay():
+        plan = FaultPlan.parse(spec)
+        outs = [plan.mutate("shuffle.block.store", p, f"map={i};")
+                for i, p in enumerate(payloads)]
+        return outs, [(e.hit, e.detail) for e in plan.log]
+
+    a, la = replay()
+    b, lb = replay()
+    assert a == b and la == lb
+    assert la                                # it did fire
+    assert any(x != p for x, p in zip(a, payloads))
 
 
 def test_unarmed_fault_point_is_cheap():

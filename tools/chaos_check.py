@@ -6,10 +6,14 @@ stays oracle-identical.
 Each plan ships to the workers via ``srt.test.faultPlan`` (see
 docs/ROBUSTNESS.md for the spec grammar and fault-site catalog). The
 sweep covers the transient-transport paths (refused connects,
-mid-frame resets, delays, dropped heartbeats) and the stage-level
-recovery path (a worker crash at a stage boundary). A nonzero exit
-means a divergent result, a failed run, or a blown wall-clock budget —
-any of which is a real robustness regression.
+mid-frame resets, delays, dropped heartbeats), the stage-level
+recovery path (a worker crash at a stage boundary), and the data
+integrity paths (seeded byte-flips of shuffle payloads on the wire and
+at rest, corrupt input files, and a flipped disk-tier spill entry —
+every one must be detected and recovered, never a silently wrong
+answer). A nonzero exit means a divergent result, a failed run, or a
+blown wall-clock budget — any of which is a real robustness
+regression.
 
 Usage:
     python tools/chaos_check.py [--quick] [--workers N] [--budget SEC]
@@ -37,11 +41,75 @@ TRANSIENT_PLANS = [
      "|cluster.heartbeat:drop%1.0*3"),
 ]
 
+# seeded data-corruption sweep: a byte-flip injected at each off-device
+# byte path must be caught by the checksum envelope and healed by the
+# corresponding recovery mechanism (same-endpoint refetch for wire
+# corruption; quarantine -> fetch failure -> rerun for at-rest
+# corruption; DataCorruption -> rerun for a corrupt input file)
+CORRUPTION_PLANS = [
+    ("shuffle payload corrupted on the wire",
+     "seed=17|shuffle.block.wire:corrupt@1"),
+    # pinned to attempt 0 via the map-id match (retry attempts offset
+    # map ids by attempt<<20, so "map=0;" never re-fires): each worker
+    # keeps its own fault counters across attempts, and an un-pinned
+    # @1 would inject FRESH corruption from a worker whose store site
+    # was first reached only during a retry — an unwinnable plan, not
+    # a recovery bug
+    ("shuffle payload corrupted at rest",
+     "seed=19|shuffle.block.store:corrupt@1~map=0;"),
+    ("input file read fails with DataCorruption",
+     "seed=23|scan.file:corrupt@1"),
+]
+
 # kills logical worker 1 at the final (range-exchange) barrier of
 # attempt 0 — after the hash exchange completed — forcing the driver's
 # stage-level retry path; runs LAST because it costs a worker
 CRASH_PLAN = ("worker crash at stage boundary",
               "seed=3|cluster.barrier:crash@1~attempt=0;workers=1;pos=0;")
+
+
+def _spill_corruption_check() -> int:
+    """Deterministic in-process disk-tier check: spill a batch to disk,
+    flip one byte in the spill file, and require ``get()`` to raise
+    ``DataCorruption`` with the entry dropped — a silent wrong batch or
+    a reusable corrupt entry is a failure. Returns failure count."""
+    import tempfile as _tf
+
+    from spark_rapids_tpu.columnar.vector import batch_from_pydict
+    from spark_rapids_tpu.memory.budget import (MemoryBudget,
+                                                reset_task_context)
+    from spark_rapids_tpu.memory.spill import (SpillableBatch,
+                                               reset_spill_catalog)
+    from spark_rapids_tpu.robustness.integrity import DataCorruption
+
+    with _tf.TemporaryDirectory(prefix="srt_chaos_spill_") as sdir:
+        reset_task_context()
+        cat = reset_spill_catalog(budget=MemoryBudget(1 << 30),
+                                  host_limit=1 << 20, spill_dir=sdir)
+        sb = SpillableBatch(batch_from_pydict(
+            {"a": list(range(512)), "b": [float(i) for i in range(512)]}))
+        sb.spill_to_host()
+        sb.spill_to_disk()
+        path = sb._path
+        with open(path, "r+b") as f:
+            f.seek(max(os.path.getsize(path) // 2, 0))
+            b = f.read(1)
+            f.seek(-1, os.SEEK_CUR)
+            f.write(bytes([b[0] ^ 0xFF]))
+        try:
+            sb.get()
+        except DataCorruption as e:
+            dropped = sb.closed and not cat.leak_report()
+            print(f"[chaos] {'PASS' if dropped else 'FAIL'} "
+                  f"[disk spill entry corrupted]: {e}", flush=True)
+            failures = 0 if dropped else 1
+        else:
+            print("[chaos] FAIL [disk spill entry corrupted]: get() "
+                  "returned a batch from a corrupted spill file",
+                  file=sys.stderr, flush=True)
+            failures = 1
+    reset_spill_catalog(budget=MemoryBudget(1 << 40))
+    return failures
 
 
 def _rows_match(rows, oracle):
@@ -89,8 +157,9 @@ def main() -> int:
                                                    launch_local_workers)
     from spark_rapids_tpu.plan import TpuSession
 
-    plans = ([TRANSIENT_PLANS[0], CRASH_PLAN] if args.quick
-             else TRANSIENT_PLANS + [CRASH_PLAN])
+    plans = ([TRANSIENT_PLANS[0], CORRUPTION_PLANS[0], CRASH_PLAN]
+             if args.quick
+             else TRANSIENT_PLANS + CORRUPTION_PLANS + [CRASH_PLAN])
 
     with tempfile.TemporaryDirectory(prefix="srt_chaos_") as tmp:
         session = TpuSession(SrtConf({}))
@@ -153,6 +222,8 @@ def main() -> int:
             print("[chaos] FAIL: crash plan produced no stage_retry "
                   "recovery event", file=sys.stderr, flush=True)
             failures += 1
+    # deterministic local spill-corruption probe (no cluster involved)
+    failures += _spill_corruption_check()
     watchdog.cancel()
     print(f"[chaos] done in {time.monotonic() - t0:.1f}s, "
           f"{failures} failure(s)", flush=True)
