@@ -96,6 +96,28 @@ def emit(final: bool = False) -> None:
     print(json.dumps(RESULT), flush=True)
 
 
+def dump_metrics_snapshot() -> None:
+    """SRT_BENCH_METRICS=<path> writes the in-process metrics-registry
+    snapshot (per-query summaries + lifetime counters, see
+    spark_rapids_tpu/obs/registry.py) next to the bench record, plus a
+    Prometheus text exposition at <path>.prom. The registry records
+    every query the bench ran regardless of srt.eventLog.enabled, so
+    this costs nothing when the variable is unset."""
+    path = os.environ.get("SRT_BENCH_METRICS")
+    if not path:
+        return
+    try:
+        from spark_rapids_tpu.obs.registry import registry
+        reg = registry()
+        with open(path, "w") as f:
+            json.dump(reg.snapshot(), f, indent=2, default=str)
+        with open(path + ".prom", "w") as f:
+            f.write(reg.prometheus_text())
+        log(f"metrics snapshot -> {path}")
+    except Exception as e:  # never let observability kill the bench
+        log(f"metrics snapshot failed: {e}")
+
+
 def ensure_data(scale: int, data_dir: str) -> dict:
     """Generate (once) lineitem/orders/customer parquet at ``scale``."""
     from spark_rapids_tpu.datagen import generate_table, lineitem_spec, \
@@ -598,6 +620,7 @@ def main():
         except Exception as e:  # breadth stage must never kill the bench
             log(f"nds power run failed: {e}")
 
+    dump_metrics_snapshot()
     emit(final=True)
 
 

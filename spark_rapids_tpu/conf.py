@@ -329,10 +329,39 @@ PARQUET_REBASE_WRITE = conf("srt.sql.parquet.datetimeRebaseModeInWrite") \
     .check_values(["CORRECTED", "LEGACY", "EXCEPTION"]) \
     .string("CORRECTED")
 
-METRICS_LEVEL = conf("srt.sql.metrics.level") \
-    .doc("Operator metric detail: ESSENTIAL, MODERATE, DEBUG. "
+METRICS_LEVEL = conf("srt.metrics.level") \
+    .doc("Operator metric detail kept in per-query summaries and the "
+         "metrics registry: ESSENTIAL, MODERATE, DEBUG. "
          "(spark.rapids.sql.metrics.level, GpuExec.scala:36-49)") \
     .check_values(["ESSENTIAL", "MODERATE", "DEBUG"]).string("MODERATE")
+
+EVENT_LOG_ENABLED = conf("srt.eventLog.enabled") \
+    .doc("Write a structured JSONL event log (QueryStart/End, "
+         "StageSubmitted/Completed, TaskEnd, SpillToHost/Disk, "
+         "FetchFailed, RetryAttempt, FaultInjected, "
+         "CorruptionDetected...) to srt.eventLog.dir — one "
+         "events-<pid>.jsonl per process, Spark history-server role. "
+         "Off by default: when disabled no event sink is instantiated "
+         "and every emit site is a single None check "
+         "(obs/events.py).") \
+    .boolean(False)
+
+EVENT_LOG_DIR = conf("srt.eventLog.dir") \
+    .doc("Directory for event-log files (and per-query Chrome traces "
+         "when srt.eventLog.trace.enabled). Created on first emit; "
+         "defaults to ./srt-events when enabled without a dir. Feed "
+         "it to tools/profile_report.py for an offline per-query "
+         "report (spark.eventLog.dir role).") \
+    .string("")
+
+TRACE_ENABLED = conf("srt.eventLog.trace.enabled") \
+    .doc("Record per-query spans (query -> stage -> task -> operator) "
+         "and write a Chrome-trace (catapult) JSON file "
+         "trace-<query_id>.json next to the event log. Requires "
+         "srt.eventLog.enabled for the file to land; spans add one "
+         "object per operator pull, so leave off for benchmarking "
+         "(NvtxWithMetrics.scala role).") \
+    .boolean(False)
 
 CPU_ORACLE_STRICT = conf("srt.test.cpuOracle.strict") \
     .doc("Test-only: fail instead of falling back when an operator cannot "

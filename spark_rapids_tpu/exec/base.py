@@ -68,10 +68,13 @@ class NvtxTimer:
         return self
 
     def __exit__(self, *exc):
-        if self._trace is not None:
-            self._trace.__exit__(*exc)
-        if self.metric is not None:
-            self.metric.add(time.perf_counter_ns() - self._t0)
+        try:
+            if self._trace is not None:
+                self._trace.__exit__(*exc)
+        finally:
+            self._trace = None
+            if self.metric is not None:
+                self.metric.add(time.perf_counter_ns() - self._t0)
         return False
 
 
@@ -87,11 +90,15 @@ class SelfTimer:
     single-threaded; I/O thread pools do their timing elsewhere).
     """
 
-    def __init__(self, stack: list, metric: Optional[Metric], name: str = ""):
+    def __init__(self, stack: list, metric: Optional[Metric], name: str = "",
+                 tracer=None):
         self.stack = stack
         self.metric = metric
         self.name = name
+        self.tracer = tracer
         self._t0 = 0
+        self._span = None
+        self._trace = None
 
     def __enter__(self):
         t = time.perf_counter_ns()
@@ -101,6 +108,21 @@ class SelfTimer:
                 parent.metric.add(t - parent._t0)
         self._t0 = t
         self.stack.append(self)
+        if self.tracer is not None:
+            # Inclusive operator span: parent is the nearest enclosing
+            # timed frame's span, else the thread's open scope (the
+            # query/task span).
+            parent_id = None
+            for frame in reversed(self.stack[:-1]):
+                sp = getattr(frame, "_span", None)
+                if sp is not None:
+                    parent_id = sp.span_id
+                    break
+            if parent_id is None:
+                parent_id = self.tracer.current_id()
+            self._span = self.tracer.begin(self.name or "op",
+                                           kind="operator",
+                                           parent=parent_id)
         try:
             import jax.profiler
             self._trace = jax.profiler.TraceAnnotation(self.name or "op")
@@ -110,14 +132,34 @@ class SelfTimer:
         return self
 
     def __exit__(self, *exc):
-        if self._trace is not None:
-            self._trace.__exit__(*exc)
-        t = time.perf_counter_ns()
-        if self.metric is not None:
-            self.metric.add(t - self._t0)
-        self.stack.pop()
-        if self.stack:
-            self.stack[-1]._t0 = t
+        try:
+            if self._trace is not None:
+                self._trace.__exit__(*exc)
+        finally:
+            self._trace = None
+            t = time.perf_counter_ns()
+            if self in self.stack:
+                # An exception below us may have abandoned deeper frames
+                # (a suspended generator torn down without its __exit__
+                # in stack order). Discard them so the stack stays
+                # consistent: the deepest one was the frame actually
+                # running, so it gets the elapsed time; the others (and
+                # we) were already paused at their child's enter and
+                # accrue nothing more.
+                dangled = False
+                while self.stack[-1] is not self:
+                    frame = self.stack.pop()
+                    if not dangled and frame.metric is not None:
+                        frame.metric.add(t - frame._t0)
+                    dangled = True
+                self.stack.pop()
+                if self.metric is not None and not dangled:
+                    self.metric.add(t - self._t0)
+                if self.stack:
+                    self.stack[-1]._t0 = t
+            if self._span is not None and self.tracer is not None:
+                self.tracer.end(self._span)
+                self._span = None
         return False
 
 
@@ -203,6 +245,9 @@ class ExecContext:
         #: crash-dump ring (srt.debug.dumpPath): exec_id -> last batch
         self.last_batches: Dict[str, tuple] = {}
         self._dumped = False
+        #: per-query span tracer (obs/trace.py) when
+        #: srt.eventLog.trace.enabled; None = no span allocation
+        self.tracer = None
 
     def dump_crash(self, failing_exec, error: BaseException,
                    dump_dir: str) -> Optional[str]:
@@ -305,7 +350,7 @@ class TpuExec:
                                                     Metric.ESSENTIAL))
         batches = m.setdefault("numOutputBatches",
                                Metric("numOutputBatches", Metric.MODERATE))
-        optime = m.setdefault("opTime", Metric("opTime", Metric.MODERATE,
+        optime = m.setdefault("opTime", Metric("opTime", Metric.ESSENTIAL,
                                                "ns"))
         from ..conf import DEBUG_DUMP_PATH
         dump_dir = ctx.conf.get(DEBUG_DUMP_PATH)
@@ -317,7 +362,8 @@ class TpuExec:
         scope = faults.op_scope(self.exec_id) if faults.armed() else None
         it = iter(self.do_execute(ctx))
         while True:
-            with SelfTimer(ctx.timer_stack, optime, self.exec_id):
+            with SelfTimer(ctx.timer_stack, optime, self.exec_id,
+                           ctx.tracer):
                 try:
                     if scope is None:
                         batch = next(it)

@@ -323,6 +323,9 @@ class ShuffleExchangeExec(TpuExec):
         write_rows = m.setdefault("shuffleWriteRows",
                                   Metric("shuffleWriteRows",
                                          Metric.ESSENTIAL))
+        write_bytes = m.setdefault("shuffleBytesWritten",
+                                   Metric("shuffleBytesWritten",
+                                          Metric.ESSENTIAL, "B"))
         # per-attempt map-id namespace: a stage retry renames the prior
         # attempt's surviving blocks into this shuffle id, so freshly
         # re-executed shards must not collide with their map ids
@@ -359,9 +362,9 @@ class ShuffleExchangeExec(TpuExec):
                             # holds ~1/P of the rows
                             parts = [K.compact_for_transfer(p)
                                      for p in fn(batch, bounds)]
-                        mgr.write_map_output(self.shuffle_id, map_id,
-                                             parts)
-                    with_retry_no_split(write_one)
+                        return mgr.write_map_output(self.shuffle_id,
+                                                    map_id, parts)
+                    write_bytes.add(with_retry_no_split(write_one))
                     part_time.add(time.perf_counter_ns() - t0)
                     write_rows.add(int(batch.num_rows))
                     map_id += 1
@@ -384,11 +387,13 @@ class ShuffleExchangeExec(TpuExec):
                     fn = self._partition_fn(n_parts)
                     parts = [K.compact_for_transfer(p)
                              for p in fn(b)]
-                mgr.write_map_output(self.shuffle_id, map_id, parts)
-                return int(b.num_rows)
-            rows_written = with_retry_no_split(write_one)
+                wrote = mgr.write_map_output(self.shuffle_id, map_id,
+                                             parts)
+                return int(b.num_rows), wrote
+            rows_written, bytes_written = with_retry_no_split(write_one)
             part_time.add(time.perf_counter_ns() - t0)
             write_rows.add(rows_written)
+            write_bytes.add(bytes_written)
             map_id += 1
 
     def _release(self, mgr) -> None:

@@ -16,6 +16,7 @@ from __future__ import annotations
 from typing import Callable, Iterator, List, TypeVar, Union
 
 from ..conf import RETRY_MAX_SPLITS, active_conf
+from ..obs import events as _events
 from .budget import RetryOOM, SplitAndRetryOOM, task_context
 from .spill import SpillableBatch, spill_catalog
 
@@ -80,6 +81,8 @@ def with_retry(
         except RetryOOM:
             ctx.retry_count += 1
             retries_this_attempt += 1
+            _events.emit("RetryAttempt", scope="oom", kind="retry",
+                         attempt=retries_this_attempt)
             freed = spill_catalog().synchronous_spill(attempt.nbytes)
             if retries_this_attempt > max_retries or (
                     freed == 0 and retries_this_attempt > 1):
@@ -98,6 +101,8 @@ def with_retry(
                     f"still OOM after {splits_done} splits")
             ctx.split_count += 1
             splits_done += 1
+            _events.emit("RetryAttempt", scope="oom", kind="split",
+                         attempt=splits_done)
             try:
                 halves = split_policy(attempt)
             except BaseException:
@@ -122,6 +127,7 @@ def with_retry_no_split(body: Callable[[], R], max_retries: int = 8) -> R:
         except RetryOOM as e:
             ctx.retry_count += 1
             last = e
+            _events.emit("RetryAttempt", scope="oom", kind="retry_no_split")
             spill_catalog().synchronous_spill(1 << 20)
     raise RetryOOM(f"exhausted {max_retries} retries") from last
 

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..columnar.vector import ColumnarBatch
+from ..obs import events as _events
 from ..robustness import faults as _faults
 from ..robustness.integrity import DataCorruption, array_checksum
 from .budget import MemoryBudget, device_budget
@@ -210,6 +211,9 @@ class SpillableBatch:
             ctx = task_context()
             ctx.spilled_bytes += self._nbytes
             ctx.spill_time_ns += _time.perf_counter_ns() - t0
+            _events.emit("SpillToHost", bytes=self._nbytes,
+                         time_ns=_time.perf_counter_ns() - t0,
+                         priority=int(self.priority))
             return self._nbytes
 
     def spill_to_disk(self) -> int:
@@ -237,6 +241,8 @@ class SpillableBatch:
                                   self._pooled.total)
                     self._pooled.free()
                     self._pooled = None
+                    _events.emit("SpillToDisk", bytes=self._nbytes,
+                                 tier="slab")
                     return self._nbytes
                 os.unlink(path)  # direct write failed: npz fallback
             host = self._host if self._host is not None \
@@ -256,6 +262,7 @@ class SpillableBatch:
             if self._pooled is not None:
                 self._pooled.free()
                 self._pooled = None
+            _events.emit("SpillToDisk", bytes=self._nbytes, tier="npz")
             return self._nbytes
 
     def get(self) -> ColumnarBatch:

@@ -1,0 +1,160 @@
+"""Metrics registry: per-query summaries + Prometheus-style snapshot.
+
+The exec layer already accumulates leveled ``Metric``s per operator
+(``ExecContext.metrics: {exec_id: {name: Metric}}``); this module
+aggregates them the way the reference accelerator's SQL UI does —
+filtered by ``srt.metrics.level`` (ESSENTIAL < MODERATE < DEBUG),
+rolled up per query, and kept in a bounded process-wide registry that
+``bench.py`` and tests can snapshot or export as Prometheus text.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+LEVEL_ORDER = {"ESSENTIAL": 0, "MODERATE": 1, "DEBUG": 2}
+
+
+def level_allows(conf_level: str, metric_level: str) -> bool:
+    """True when a metric at ``metric_level`` should be reported under
+    the configured ``conf_level`` (ESSENTIAL shows the least)."""
+    want = LEVEL_ORDER.get(str(conf_level).upper(), 1)
+    have = LEVEL_ORDER.get(str(metric_level).upper(), 1)
+    return have <= want
+
+
+def summarize_metrics(ctx_metrics: Dict[str, Dict[str, Any]],
+                      level: str = "MODERATE") -> Dict[str, Dict[str, dict]]:
+    """Flatten ``{exec_id: {name: Metric}}`` into plain dicts, keeping
+    only metrics at or below the configured level."""
+    out: Dict[str, Dict[str, dict]] = {}
+    for exec_id, metrics in ctx_metrics.items():
+        kept: Dict[str, dict] = {}
+        for name, m in metrics.items():
+            m_level = getattr(m, "level", "MODERATE")
+            if not level_allows(level, m_level):
+                continue
+            kept[name] = {"value": getattr(m, "value", m),
+                          "level": m_level,
+                          "unit": getattr(m, "unit", "")}
+        if kept:
+            out[str(exec_id)] = kept
+    return out
+
+
+def query_totals(summary: Dict[str, Dict[str, dict]]) -> Dict[str, Any]:
+    """Cross-operator totals for the headline numbers."""
+    totals: Dict[str, Any] = {"opTimeNs": 0, "numOutputRows": 0,
+                              "numOutputBatches": 0, "spilledBytes": 0,
+                              "shuffleBytesWritten": 0}
+    for metrics in summary.values():
+        for name, rec in metrics.items():
+            v = rec.get("value", 0)
+            if not isinstance(v, (int, float)):
+                continue
+            if name == "opTime":
+                totals["opTimeNs"] += v
+            elif name in totals:
+                totals[name] += v
+    return totals
+
+
+class MetricsRegistry:
+    """Bounded process-wide record of completed queries plus running
+    totals. Cheap enough to leave always-on: recording happens once
+    per query, never per batch."""
+
+    def __init__(self, max_queries: int = 64):
+        self._lock = threading.Lock()
+        self._queries: deque = deque(maxlen=max_queries)
+        self._counters: Dict[str, float] = {
+            "queries_total": 0,
+            "queries_failed_total": 0,
+            "op_time_ns_total": 0,
+            "output_rows_total": 0,
+            "output_batches_total": 0,
+            "wall_time_ns_total": 0,
+        }
+
+    def record_query(self, query_id: str,
+                     summary: Dict[str, Dict[str, dict]],
+                     wall_ns: int = 0, status: str = "ok",
+                     **extra: Any) -> Dict[str, Any]:
+        totals = query_totals(summary)
+        rec = {"query_id": query_id, "status": status,
+               "wall_ns": wall_ns, "totals": totals,
+               "operators": summary}
+        rec.update(extra)
+        with self._lock:
+            self._queries.append(rec)
+            self._counters["queries_total"] += 1
+            if status != "ok":
+                self._counters["queries_failed_total"] += 1
+            self._counters["op_time_ns_total"] += totals["opTimeNs"]
+            self._counters["output_rows_total"] += totals["numOutputRows"]
+            self._counters["output_batches_total"] += \
+                totals["numOutputBatches"]
+            self._counters["wall_time_ns_total"] += wall_ns
+        return rec
+
+    def queries(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._queries)
+
+    def last_query(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            return self._queries[-1] if self._queries else None
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"counters": dict(self._counters),
+                    "queries": list(self._queries)}
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition format of the running counters
+        plus per-operator op-time of the most recent query."""
+        lines: List[str] = []
+        with self._lock:
+            counters = dict(self._counters)
+            last = self._queries[-1] if self._queries else None
+        for name, value in sorted(counters.items()):
+            metric = f"srt_{name}"
+            lines.append(f"# TYPE {metric} counter")
+            lines.append(f"{metric} {value:g}")
+        if last is not None:
+            metric = "srt_last_query_op_time_ns"
+            lines.append(f"# TYPE {metric} gauge")
+            for exec_id, metrics in sorted(last["operators"].items()):
+                rec = metrics.get("opTime")
+                if rec is None:
+                    continue
+                lines.append(
+                    f'{metric}{{exec_id="{exec_id}"}} '
+                    f'{rec.get("value", 0):g}')
+        return "\n".join(lines) + "\n"
+
+    def reset(self) -> None:
+        with self._lock:
+            self._queries.clear()
+            for k in self._counters:
+                self._counters[k] = 0
+
+
+_REGISTRY: Optional[MetricsRegistry] = None
+_REG_LOCK = threading.Lock()
+
+
+def registry() -> MetricsRegistry:
+    global _REGISTRY
+    with _REG_LOCK:
+        if _REGISTRY is None:
+            _REGISTRY = MetricsRegistry()
+        return _REGISTRY
+
+
+def reset_registry() -> None:
+    global _REGISTRY
+    with _REG_LOCK:
+        _REGISTRY = None

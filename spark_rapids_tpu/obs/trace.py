@@ -1,0 +1,185 @@
+"""Span tracer: query → stage → task → operator spans.
+
+A minimal Dapper-style tracer over ``time.perf_counter_ns``. Spans
+carry a kind (``query``/``stage``/``task``/``operator``), a parent
+link, and free-form attributes; a finished tracer exports the whole
+tree as Chrome-trace (catapult) JSON — loadable in ``chrome://tracing``
+/ Perfetto, and parseable by ``tools/profile_report.py``.
+
+Tracers are created per query by the session (``srt.eventLog.trace.
+enabled``) and handed to operators through ``ExecContext.tracer``; the
+disabled path is ``ctx.tracer is None`` — no span allocation, no
+clock reads beyond what the metrics layer already pays.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class Span:
+    """One finished (or in-flight) span. Timestamps are monotonic
+    ``perf_counter_ns`` values, so durations are exact and spans from
+    one process share a timeline; wall-clock anchoring lives in the
+    event log, not here."""
+
+    __slots__ = ("name", "kind", "span_id", "parent_id", "t0_ns",
+                 "t1_ns", "attrs", "tid")
+
+    def __init__(self, name: str, kind: str, span_id: int,
+                 parent_id: Optional[int], t0_ns: int,
+                 attrs: Optional[dict], tid: int):
+        self.name = name
+        self.kind = kind
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.t0_ns = t0_ns
+        self.t1_ns: Optional[int] = None
+        self.attrs = attrs
+        self.tid = tid
+
+    @property
+    def duration_ns(self) -> int:
+        return 0 if self.t1_ns is None else self.t1_ns - self.t0_ns
+
+    def __repr__(self):
+        return (f"Span({self.kind}:{self.name} id={self.span_id} "
+                f"parent={self.parent_id} dur={self.duration_ns}ns)")
+
+
+class _SpanScope:
+    """Context manager returned by :meth:`Tracer.span`."""
+
+    __slots__ = ("tracer", "span")
+
+    def __init__(self, tracer: "Tracer", span: Span):
+        self.tracer = tracer
+        self.span = span
+
+    def __enter__(self) -> Span:
+        self.tracer._push(self.span)
+        return self.span
+
+    def __exit__(self, *exc):
+        try:
+            self.tracer._pop(self.span)
+        finally:
+            self.tracer.end(self.span)
+        return False
+
+
+class Tracer:
+    """Thread-safe span collector. One per traced query.
+
+    Two usage styles:
+    - ``with tracer.span("q", kind="query"): ...`` — pushes onto a
+      thread-local stack so nested spans parent automatically;
+    - ``s = tracer.begin(name, kind, parent=...); ...; tracer.end(s)``
+      — explicit parentage for callers that already maintain their own
+      stack (the exec layer's exclusive-time timer stack).
+    """
+
+    def __init__(self):
+        self._spans: List[Span] = []
+        self._lock = threading.Lock()
+        self._next_id = 1
+        self._tls = threading.local()
+
+    # --- explicit API ---
+    def begin(self, name: str, kind: str = "span",
+              parent: Optional[int] = None,
+              attrs: Optional[dict] = None) -> Span:
+        """Start a span. ``parent=None`` links to the calling thread's
+        innermost open ``span()`` scope (the query span, usually)."""
+        if parent is None:
+            stack = getattr(self._tls, "stack", None)
+            if stack:
+                parent = stack[-1].span_id
+        with self._lock:
+            sid = self._next_id
+            self._next_id += 1
+        return Span(name, kind, sid, parent, time.perf_counter_ns(),
+                    attrs, threading.get_ident())
+
+    def end(self, span: Span) -> None:
+        span.t1_ns = time.perf_counter_ns()
+        with self._lock:
+            self._spans.append(span)
+
+    # --- scoped API ---
+    def span(self, name: str, kind: str = "span",
+             parent: Optional[int] = None,
+             attrs: Optional[dict] = None) -> _SpanScope:
+        return _SpanScope(self, self.begin(name, kind, parent, attrs))
+
+    def _push(self, span: Span) -> None:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        stack.append(span)
+
+    def _pop(self, span: Span) -> None:
+        stack = getattr(self._tls, "stack", None)
+        if stack and stack[-1] is span:
+            stack.pop()
+        elif stack and span in stack:  # exception-skewed exit order
+            stack.remove(span)
+
+    def current_id(self) -> Optional[int]:
+        stack = getattr(self._tls, "stack", None)
+        return stack[-1].span_id if stack else None
+
+    def instant(self, name: str, attrs: Optional[dict] = None) -> None:
+        """Zero-duration marker (Chrome-trace ``ph: i``)."""
+        s = self.begin(name, kind="instant", attrs=attrs)
+        s.t1_ns = s.t0_ns
+        with self._lock:
+            self._spans.append(s)
+
+    def spans(self) -> List[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    # --- export ---
+    def export_chrome_trace(self) -> str:
+        """Chrome-trace (catapult) JSON object format. Every event
+        carries the required ``ph``/``ts``/``pid`` fields; ``ts`` is
+        microseconds (float) on the monotonic timeline."""
+        pid = os.getpid()
+        events: List[dict] = []
+        for s in self.spans():
+            args: Dict = {"span_id": s.span_id}
+            if s.parent_id is not None:
+                args["parent_id"] = s.parent_id
+            if s.attrs:
+                args.update(s.attrs)
+            if s.kind == "instant":
+                events.append({"name": s.name, "cat": s.kind, "ph": "i",
+                               "ts": s.t0_ns / 1e3, "pid": pid,
+                               "tid": s.tid, "s": "t", "args": args})
+                continue
+            events.append({"name": s.name, "cat": s.kind, "ph": "X",
+                           "ts": s.t0_ns / 1e3,
+                           "dur": (s.t1_ns or s.t0_ns) / 1e3
+                                  - s.t0_ns / 1e3,
+                           "pid": pid, "tid": s.tid, "args": args})
+        return json.dumps({"traceEvents": events,
+                           "displayTimeUnit": "ms"})
+
+    def write_chrome_trace(self, path: str) -> str:
+        with open(path, "w") as f:
+            f.write(self.export_chrome_trace())
+        return path
+
+
+def maybe_tracer(conf) -> Optional[Tracer]:
+    """A fresh per-query tracer when ``srt.eventLog.trace.enabled`` is
+    on, else None (the zero-overhead disabled path)."""
+    from ..conf import TRACE_ENABLED
+    if not conf.get(TRACE_ENABLED):
+        return None
+    return Tracer()

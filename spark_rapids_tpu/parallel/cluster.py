@@ -474,6 +474,10 @@ class ClusterWorker:
         # arm (or keep, or disarm) this process's fault plan from the
         # job conf — the driver-side test's spec reaches every worker
         faults.arm_from_conf(conf)
+        # same hand-off for the event log: srt.eventLog.* in the job
+        # conf lights up (or tears down) this worker's JSONL sink
+        from ..obs import events as _events
+        _events.configure_from_conf(conf)
         attempt = msg.get("attempt", 0)
         logical_ids = msg.get("logical_ids") or [msg["worker_id"]]
         fresh_ids = msg.get("fresh_ids")
@@ -535,6 +539,9 @@ class ClusterWorker:
                   file=sys.stderr, flush=True)
         metrics = {eid: {m.name: m.value for m in md.values()}
                    for eid, md in ctx.metrics.items()}
+        _events.emit("TaskEnd", worker_id=cluster.worker_id,
+                     logical_ids=list(cluster.logical_ids),
+                     attempt=attempt, rows=len(rows), metrics=metrics)
         return rows, metrics
 
     def _prepare_reuse(self, msg, cluster: ClusterTaskContext,
@@ -764,6 +771,8 @@ class ClusterDriver:
                   file=sys.stderr, flush=True)
             self.recovery_events.append({"type": "heartbeat_eviction",
                                          "executors": sorted(dead)})
+            from ..obs import events as _events
+            _events.emit("WorkerEvicted", executors=sorted(dead))
             self._abort_sync()
             with self._block:
                 targets = [s for s, _ep, eid in self._workers
@@ -798,6 +807,15 @@ class ClusterDriver:
            job record, reset everyone and re-run on the surviving set.
         Deterministic worker ERRORS do not retry — they reproduce."""
         self.wait_for_workers()
+        # the driver process logs events too (workers configure
+        # themselves from the same conf dict inside _run_job)
+        from ..conf import SrtConf
+        from ..obs import events as _events
+        try:
+            _events.configure_from_conf(SrtConf(dict(conf_settings
+                                                     or {})))
+        except Exception:
+            pass  # an invalid test conf must not mask the real error
         job_token = os.urandom(8).hex()
         last: Optional[BaseException] = None
         retry_spec: Optional[dict] = None
@@ -810,13 +828,25 @@ class ClusterDriver:
                 retry_spec = None
                 self.recovery_events.append({"type": "job_retry",
                                              "cause": str(e)})
+                _events.emit("RetryAttempt", scope="job",
+                             job_token=job_token, attempt=attempt,
+                             cause=str(e))
                 self._recover()
             except WorkerLost as e:
                 last = e
                 retry_spec = self._plan_stage_retry(job_token)
-                if retry_spec is None:
+                if retry_spec is not None:
+                    _events.emit("RetryAttempt", scope="stage",
+                                 job_token=job_token, attempt=attempt,
+                                 reused_positions=list(
+                                     retry_spec["reusable_positions"]),
+                                 cause=str(e))
+                else:
                     self.recovery_events.append({"type": "job_retry",
                                                  "cause": str(e)})
+                    _events.emit("RetryAttempt", scope="job",
+                                 job_token=job_token, attempt=attempt,
+                                 cause=str(e))
                     self._recover()
             if not self._workers:
                 break
@@ -849,6 +879,10 @@ class ClusterDriver:
         self._last_assign = {eid: list(a) for (_s, _ep, eid), a
                              in zip(workers, assign)}
         self._last_shard_mod = shard_mod
+        from ..obs import events as _events
+        _events.emit("StageSubmitted", job_token=job_token,
+                     attempt=attempt, num_workers=n, assign=assign,
+                     reused_positions=reusable)
         blob = cloudpickle.dumps(logical_plan)
         for w, (sock, _ep, _eid) in enumerate(workers):
             try:

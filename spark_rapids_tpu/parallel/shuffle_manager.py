@@ -243,10 +243,12 @@ class ShuffleManager:
 
     # --- write path ---
     def write_map_output(self, shuffle_id: int, map_id: int,
-                         partitions: Sequence[ColumnarBatch]) -> None:
-        """One map task's output: partitions[i] goes to reduce i."""
+                         partitions: Sequence[ColumnarBatch]) -> int:
+        """One map task's output: partitions[i] goes to reduce i.
+        Returns serialized bytes written (0 in CACHE_ONLY mode)."""
         fault_point("shuffle.write", f"sid={shuffle_id};map={map_id};")
         t0 = time.perf_counter_ns()
+        bytes_before = self.write_metrics.bytes_written
         futures = []
         local_rows: Dict[int, int] = {}
         for reduce_id, batch in enumerate(partitions):
@@ -266,7 +268,15 @@ class ShuffleManager:
         with self._lock:
             for reduce_id, rows in local_rows.items():
                 self._part_rows[(shuffle_id, map_id, reduce_id)] = rows
-        self.write_metrics.write_time_ns += time.perf_counter_ns() - t0
+        dt_ns = time.perf_counter_ns() - t0
+        self.write_metrics.write_time_ns += dt_ns
+        wrote = self.write_metrics.bytes_written - bytes_before
+        from ..obs import events as _events
+        _events.emit("ShuffleWrite", shuffle_id=shuffle_id,
+                     map_id=map_id, blocks=len(local_rows),
+                     rows=sum(local_rows.values()), bytes=wrote,
+                     write_time_ns=dt_ns)
+        return wrote
 
     def _serialize_one(self, block: BlockId, batch: ColumnarBatch) -> None:
         data = serialize_batch(batch, compress=self.compress,
@@ -450,7 +460,13 @@ class MapOutputRegistry:
         if pos < 0:
             return
         with self._lock:
+            fresh = self._complete.get(pos) != shuffle_id
             self._complete[pos] = shuffle_id
+        if fresh:
+            # once per barrier release, not once per worker reply
+            from ..obs import events as _events
+            _events.emit("StageCompleted", position=pos,
+                         shuffle_id=shuffle_id)
 
     def complete_positions(self) -> List[int]:
         with self._lock:

@@ -254,6 +254,11 @@ def _retrying_stream(cli: ShuffleBlockClient, shuffle_id: int,
             return
         except OSError as e:
             attempt += 1
+            from ..obs import events as _events
+            _events.emit("RetryAttempt", scope="fetch",
+                         endpoint=cli.endpoint, shuffle_id=shuffle_id,
+                         reduce_id=reduce_id, attempt=attempt,
+                         error=str(e))
             if attempt <= cli.max_retries:
                 time.sleep(cli.backoff_base_s * (2 ** (attempt - 1))
                            * (1.0 + random.random() * 0.25))
@@ -291,6 +296,10 @@ def stream_with_failover(endpoint: str, shuffle_id: int, reduce_id: int,
     except OSError as e:
         if isinstance(e, FetchFailed):
             raise
+        from ..obs import events as _events
+        _events.emit("FetchFailed", endpoint=endpoint,
+                     shuffle_id=shuffle_id, reduce_id=reduce_id,
+                     error=str(e))
         raise FetchFailed(endpoint, shuffle_id, reduce_id, e) from e
 
 

@@ -95,6 +95,9 @@ class MeshQueryExecutor:
     def run(self, physical: TpuExec) -> List[ColumnarBatch]:
         """Execute the plan; returns host-ordered result batches (shard
         order is partition order for sorted plans)."""
+        from ..obs import events as _events
+        _events.emit("StageSubmitted", mode="mesh",
+                     num_shards=self.n, join_growth=self.join_growth)
         self._leaves = []
         fn = self._lower(physical)
         ctx = ExecContext(self.conf)
@@ -128,6 +131,8 @@ class MeshQueryExecutor:
             out_specs=P(self.axis), **check_kw))
         res, ok = step(*stacks)
         jax.block_until_ready(jax.tree_util.tree_leaves(res))
+        _events.emit("StageCompleted", mode="mesh", num_shards=self.n,
+                     overflowed=not bool(jnp.all(ok)))
         if not bool(jnp.all(ok)):
             raise RuntimeError(
                 "mesh join output overflowed its static capacity "

@@ -90,11 +90,18 @@ class TpuSession:
     """Entry point (SparkSession analogue). Holds the active conf and
     the temp-view catalog backing ``sql()``."""
 
+    #: process-wide query sequence — query ids stay unique across
+    #: sessions within one process (event-log files are per process)
+    _query_seq = [0]
+
     def __init__(self, conf: Optional[SrtConf] = None):
         self.conf = conf or active_conf()
         self._catalog: Dict[str, "DataFrame"] = {}
         from .plan_cache import PhysicalPlanCache
         self._plan_cache = PhysicalPlanCache()
+        #: (physical, ctx, query_id, wall_ns) of the most recent
+        #: execute — explain(metrics=True) renders from this
+        self._last_execution = None
 
     # --- constructors ---
     def create_dataframe(self, data: Dict[str, list],
@@ -159,14 +166,87 @@ class TpuSession:
                 self._plan_cache.put(key, physical)
         elif isinstance(physical, TpuExec):
             physical.reset_for_rerun()
+        return self._execute_physical(physical, plan)
+
+    def _execute_physical(self, physical, plan: L.LogicalPlan
+                          ) -> HostTable:
+        """Run a planned physical tree with the query-level
+        observability wrapper: QueryStart/QueryEnd events, optional
+        per-query span tracer (written out as a Chrome trace), and a
+        per-query metrics summary recorded in the process registry.
+        When observability is off this adds one conf check and one
+        per-query summary — nothing per batch."""
+        import time as _time
+
+        from ..conf import METRICS_LEVEL
+        from ..obs import events as _events
+        from ..obs.registry import registry as _registry
+        from ..obs.registry import summarize_metrics
+        from ..obs.trace import maybe_tracer
+        from ..memory.budget import task_context
+        _events.configure_from_conf(self.conf)
         ctx = ExecContext(self.conf)
-        if isinstance(physical, TpuExec):
-            tables = [batch_to_table(b) for b in physical.execute(ctx)
-                      if int(b.num_rows) > 0]
-            if not tables:
-                return empty_like(plan.schema)
-            return concat_tables(tables)
-        return physical.evaluate(ctx)
+        ctx.tracer = maybe_tracer(self.conf)
+        tc = task_context()
+        tc0 = (tc.spilled_bytes, tc.retry_count, tc.split_count)
+        TpuSession._query_seq[0] += 1
+        qid = f"q{_os.getpid()}-{TpuSession._query_seq[0]}"
+        is_tpu = isinstance(physical, TpuExec)
+        if _events.enabled():
+            _events.emit("QueryStart", query_id=qid, device=is_tpu,
+                         plan=physical.tree_string() if is_tpu
+                         else type(physical).__name__)
+        qspan = ctx.tracer.span(qid, kind="query") \
+            if ctx.tracer is not None else None
+        t0 = _time.perf_counter_ns()
+        status = "ok"
+        error = None
+        try:
+            if qspan is not None:
+                qspan.__enter__()
+            try:
+                if is_tpu:
+                    tables = [batch_to_table(b)
+                              for b in physical.execute(ctx)
+                              if int(b.num_rows) > 0]
+                    result = concat_tables(tables) if tables \
+                        else empty_like(plan.schema)
+                else:
+                    result = physical.evaluate(ctx)
+            finally:
+                if qspan is not None:
+                    qspan.__exit__(None, None, None)
+        except BaseException as e:
+            status = "error"
+            error = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            wall_ns = _time.perf_counter_ns() - t0
+            summary = summarize_metrics(ctx.metrics,
+                                        self.conf.get(METRICS_LEVEL))
+            extra = {"spilled_bytes": tc.spilled_bytes - tc0[0],
+                     "oom_retries": tc.retry_count - tc0[1],
+                     "oom_splits": tc.split_count - tc0[2]}
+            rec = _registry().record_query(qid, summary, wall_ns,
+                                           status, **extra)
+            self._last_execution = {"physical": physical, "ctx": ctx,
+                                    "query_id": qid, "wall_ns": wall_ns,
+                                    "record": rec}
+            if _events.enabled():
+                end: Dict = {"query_id": qid, "status": status,
+                             "wall_ns": wall_ns, "metrics": summary}
+                end.update(extra)
+                if error is not None:
+                    end["error"] = error
+                _events.emit("QueryEnd", **end)
+                if ctx.tracer is not None and \
+                        _events.log_dir() is not None:
+                    try:
+                        ctx.tracer.write_chrome_trace(_os.path.join(
+                            _events.log_dir(), f"trace-{qid}.json"))
+                    except OSError:
+                        pass
+        return result
 
 
 def _infer_value_type(sample, values=()):
@@ -486,16 +566,71 @@ class DataFrame:
                 for name, c in zip(merged.names, merged.columns)}
         return DeviceColumns(cols, int(merged.num_rows))
 
-    def explain(self, mode: str = "ALL") -> str:
+    def explain(self, mode: str = "ALL", metrics: bool = False) -> str:
+        if metrics:
+            return self._explain_metrics()
         meta = overrides.tag_only(self.plan)
         out = "\n".join(meta.explain_lines(
             only_not_on_tpu=(mode == "NOT_ON_TPU")))
         print(out)
         return out
 
+    def _explain_metrics(self) -> str:
+        """Execute the query, then render the physical tree with each
+        operator's accumulated metrics (rows / batches / op-time /
+        shuffle bytes; the reference SQL-UI annotation role) plus a
+        query-level footer with wall time and spill totals."""
+        from ..conf import METRICS_LEVEL
+        self.session.execute(self.plan)
+        last = self.session._last_execution
+        physical, ctx = last["physical"], last["ctx"]
+        level = self.session.conf.get(METRICS_LEVEL)
+        if isinstance(physical, TpuExec):
+            body = _metrics_tree_lines(physical, ctx.metrics, level)
+        else:
+            body = [f"* {type(physical).__name__} (CPU fallback path)"]
+        rec = last["record"]
+        totals = rec["totals"]
+        footer = (f"query {last['query_id']}: "
+                  f"wall={last['wall_ns'] / 1e6:.1f}ms "
+                  f"opTime={totals['opTimeNs'] / 1e6:.1f}ms "
+                  f"rows={totals['numOutputRows']} "
+                  f"shuffleBytes={totals['shuffleBytesWritten']} "
+                  f"spilledBytes={rec.get('spilled_bytes', 0)} "
+                  f"oomRetries={rec.get('oom_retries', 0)}")
+        out = "\n".join(body + [footer])
+        print(out)
+        return out
+
     def __repr__(self):
         cols = ", ".join(f"{n}: {t}" for n, t in self.plan.schema)
         return f"DataFrame[{cols}]"
+
+
+def _metrics_tree_lines(node: TpuExec, metrics: Dict, level: str,
+                        indent: int = 0) -> List[str]:
+    """Physical tree lines with per-operator metric annotations,
+    filtered by the configured metrics level."""
+    from ..obs.registry import level_allows
+    line = "  " * indent + "* " + node.node_description()
+    m = metrics.get(node.exec_id, {})
+    parts = []
+    for name in sorted(m):
+        met = m[name]
+        if not level_allows(level, met.level):
+            continue
+        if met.unit == "ns":
+            parts.append(f"{name}={met.value / 1e6:.1f}ms")
+        else:
+            parts.append(f"{name}={met.value}{met.unit}")
+    if parts:
+        line += "  [" + ", ".join(parts) + "]"
+    lines = [line]
+    for c in node.children:
+        if isinstance(c, TpuExec):
+            lines.extend(_metrics_tree_lines(c, metrics, level,
+                                             indent + 1))
+    return lines
 
 
 class GroupedData:

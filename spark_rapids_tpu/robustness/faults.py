@@ -182,6 +182,16 @@ class FaultPlan:
         with self._lock:
             return [e for e in self.log if site is None or e.site == site]
 
+    def _note(self, ev: FaultEvent) -> None:
+        """Record a fired fault in the replay log AND the observability
+        event log. Called with the plan lock held, BEFORE the fault
+        actually fires — the event writer flushes per line, so even a
+        ``crash`` clause (os._exit) leaves its FaultInjected on disk."""
+        self.log.append(ev)
+        from ..obs import events as _events
+        _events.emit("FaultInjected", site=ev.site, kind=ev.kind,
+                     detail=ev.detail, hit=ev.hit, seed=self.seed)
+
     def hit(self, site: str, detail: Optional[str]) -> None:
         to_fire: Optional[FaultSpec] = None
         hit_no = 0
@@ -204,7 +214,7 @@ class FaultPlan:
                 self._fires[i] += 1
                 hit_no = self._hits[i]
                 to_fire = sp
-                self.log.append(FaultEvent(site, sp.kind, ref, hit_no))
+                self._note(FaultEvent(site, sp.kind, ref, hit_no))
                 break
         if to_fire is not None:
             self._fire(to_fire, site, ref)
@@ -267,17 +277,17 @@ class FaultPlan:
                 return data
             n = int(data.nbytes) if hasattr(data, "nbytes") else len(data)
             if n == 0:
-                self.log.append(FaultEvent(site, to_fire.kind,
-                                           f"{ref};empty;", hit_no))
+                self._note(FaultEvent(site, to_fire.kind,
+                                      f"{ref};empty;", hit_no))
                 return data
             if to_fire.kind == "truncate":
                 cut = max(n // 2, 1) if n > 1 else 0
-                self.log.append(FaultEvent(site, "truncate",
-                                           f"{ref};cut={cut};", hit_no))
+                self._note(FaultEvent(site, "truncate",
+                                      f"{ref};cut={cut};", hit_no))
                 return data[:cut]
             pos = self._rng.randrange(n)
-            self.log.append(FaultEvent(site, "corrupt",
-                                       f"{ref};byte={pos};", hit_no))
+            self._note(FaultEvent(site, "corrupt",
+                                  f"{ref};byte={pos};", hit_no))
             if hasattr(data, "dtype"):   # numpy array: mutate in place
                 import numpy as np
                 if not data.flags.writeable:

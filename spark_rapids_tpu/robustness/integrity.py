@@ -70,6 +70,12 @@ class DataCorruption(RuntimeError):
         self.expected = expected
         self.actual = actual
         self.detail = detail
+        # central choke point: every verification failure in the engine
+        # constructs one of these, so the event log sees them all
+        from ..obs import events as _events
+        _events.emit("CorruptionDetected", what=what,
+                     expected=_hex(expected), actual=_hex(actual),
+                     detail=detail)
 
 
 def _hex(v: Optional[int]) -> str:

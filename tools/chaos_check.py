@@ -112,6 +112,53 @@ def _spill_corruption_check() -> int:
     return failures
 
 
+def _new_fault_events(events_dir, offsets):
+    """FaultInjected events appended to the event log since the last
+    call. ``offsets`` ({path: records_seen}) is updated in place so
+    each plan only sees its own events — the same worker processes
+    (and files) carry across the whole sweep."""
+    from spark_rapids_tpu.obs import events as ev
+    out = []
+    if not os.path.isdir(events_dir):
+        return out
+    for path in ev.iter_log_files(events_dir):
+        recs = ev.read_events(path)
+        start = offsets.get(path, 0)
+        out.extend(r for r in recs[start:]
+                   if r.get("event") == "FaultInjected")
+        offsets[path] = len(recs)
+    return out
+
+
+def _check_fault_events(name, spec, fired):
+    """Every injected fault must be visible in the event log: each
+    DETERMINISTIC clause (@nth, or %prob >= 1.0 — probabilistic
+    clauses may legitimately never fire) needs a matching (site, kind)
+    FaultInjected event, and every logged event must come from one of
+    the plan's clauses. Returns failure count."""
+    from spark_rapids_tpu.robustness.faults import FaultPlan
+    plan = FaultPlan.parse(spec)
+    failures = 0
+    logged = {(e.get("site"), e.get("kind")) for e in fired}
+    armed = {(sp.site, sp.kind) for sp in plan.specs}
+    for sp in plan.specs:
+        if sp.nth is None and sp.prob < 1.0:
+            continue  # probabilistic: firing is not guaranteed
+        if (sp.site, sp.kind) not in logged:
+            print(f"[chaos] FAIL [{name}]: injected fault "
+                  f"{sp.site}:{sp.kind} produced no FaultInjected "
+                  f"event (logged: {sorted(logged)})",
+                  file=sys.stderr, flush=True)
+            failures += 1
+    stray = logged - armed
+    if stray:
+        print(f"[chaos] FAIL [{name}]: FaultInjected events from "
+              f"un-armed clauses: {sorted(stray)}",
+              file=sys.stderr, flush=True)
+        failures += 1
+    return failures
+
+
 def _rows_match(rows, oracle):
     if [r["k"] for r in rows] != [r["k"] for r in oracle]:
         return False
@@ -185,11 +232,15 @@ def main() -> int:
                                heartbeat_interval=0.5, heartbeat_timeout=6)
         procs = launch_local_workers(driver, n_workers)
         failures = 0
+        events_dir = os.path.join(tmp, "events")
+        event_offsets: dict = {}
         try:
             driver.wait_for_workers(timeout=120)
             for name, spec in plans:
                 job_conf = {"srt.shuffle.partitions": 4,
                             "srt.cluster.barrierTimeoutSec": 60,
+                            "srt.eventLog.enabled": "true",
+                            "srt.eventLog.dir": events_dir,
                             "srt.test.faultPlan": spec}
                 t = time.monotonic()
                 try:
@@ -199,6 +250,7 @@ def main() -> int:
                           f"{type(e).__name__}: {e}", file=sys.stderr,
                           flush=True)
                     failures += 1
+                    _new_fault_events(events_dir, event_offsets)
                     continue
                 ok = _rows_match(rows, oracle)
                 recov = [e["type"] for e in driver.recovery_events]
@@ -208,6 +260,9 @@ def main() -> int:
                       flush=True)
                 if not ok:
                     failures += 1
+                # every injected fault must show in the event log
+                fired = _new_fault_events(events_dir, event_offsets)
+                failures += _check_fault_events(name, spec, fired)
         finally:
             driver.shutdown()
             for p in procs:

@@ -1,0 +1,236 @@
+#!/usr/bin/env python
+"""Offline profiler: turn an event log into per-query reports.
+
+The reference ships a profiling tool that reconstructs per-query
+behavior from Spark event logs; this is its analogue over the JSONL
+logs written by ``spark_rapids_tpu/obs/events.py``
+(``srt.eventLog.enabled``). For each query it reports:
+
+- per-operator op-time breakdown (exclusive ns, % of wall clock),
+  rows and batches, from the QueryEnd metrics summary;
+- shuffle bytes/rows per exchange, from ShuffleWrite events;
+- spill / OOM-retry / fetch-failure / injected-fault / corruption
+  counts in the query's time window;
+- a critical-path estimate: summed exclusive op-time vs wall clock
+  (exclusive times are disjoint by construction, so their sum is the
+  single-threaded busy time; the gap to wall clock is waiting —
+  shuffle barriers, semaphore, host I/O).
+
+Usage:
+    python tools/profile_report.py EVENT_LOG [--json] [--query QID]
+
+``EVENT_LOG`` is one ``events-*.jsonl`` file or a directory of them
+(``srt.eventLog.dir``); multi-process runs merge on read.
+"""
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from spark_rapids_tpu.obs import events as ev  # noqa: E402
+
+#: events without a query_id are attributed to the query whose
+#: [QueryStart, QueryEnd] wall-clock window contains them
+_WINDOWED = ("SpillToHost", "SpillToDisk", "ShuffleWrite", "FetchFailed",
+             "RetryAttempt", "FaultInjected", "CorruptionDetected",
+             "StageSubmitted", "StageCompleted", "TaskEnd",
+             "WorkerEvicted")
+
+
+def build_queries(records: List[dict]) -> List[dict]:
+    """Group a merged event stream into per-query dicts."""
+    queries: List[dict] = []
+    open_q: Dict[str, dict] = {}
+    loose: List[dict] = []  # windowed events, matched afterwards
+    for r in records:
+        kind = r.get("event")
+        if kind == "QueryStart":
+            q = {"query_id": r.get("query_id"), "t_start": r["ts"],
+                 "t_end": None, "plan": r.get("plan", ""),
+                 "wall_ns": 0, "status": "unknown", "metrics": {},
+                 "spilled_bytes": 0, "oom_retries": 0,
+                 "events": {k: [] for k in _WINDOWED}}
+            open_q[q["query_id"]] = q
+            queries.append(q)
+        elif kind == "QueryEnd":
+            q = open_q.pop(r.get("query_id"), None)
+            if q is None:
+                continue
+            q["t_end"] = r["ts"]
+            q["wall_ns"] = r.get("wall_ns", 0)
+            q["status"] = r.get("status", "unknown")
+            q["metrics"] = r.get("metrics", {}) or {}
+            q["spilled_bytes"] = r.get("spilled_bytes", 0)
+            q["oom_retries"] = r.get("oom_retries", 0)
+        elif kind in _WINDOWED:
+            loose.append(r)
+    for r in loose:
+        for q in queries:
+            end = q["t_end"] if q["t_end"] is not None else float("inf")
+            if q["t_start"] <= r["ts"] <= end:
+                q["events"][r["event"]].append(r)
+                break
+    return queries
+
+
+def analyze(q: dict) -> dict:
+    """Per-query analysis: op-time table, shuffle/spill/fault totals,
+    critical-path estimate."""
+    ops = []
+    total_op_ns = 0
+    for exec_id, metrics in q["metrics"].items():
+        rec = metrics.get("opTime", {})
+        op_ns = rec.get("value", 0) if isinstance(rec, dict) else 0
+        total_op_ns += op_ns
+        rows = metrics.get("numOutputRows", {})
+        batches = metrics.get("numOutputBatches", {})
+        shuf = metrics.get("shuffleBytesWritten", {})
+        ops.append({
+            "exec_id": exec_id,
+            "op_time_ns": op_ns,
+            "rows": rows.get("value", 0) if isinstance(rows, dict) else 0,
+            "batches": batches.get("value", 0)
+                       if isinstance(batches, dict) else 0,
+            "shuffle_bytes": shuf.get("value", 0)
+                             if isinstance(shuf, dict) else 0,
+        })
+    ops.sort(key=lambda o: -o["op_time_ns"])
+    wall = q["wall_ns"] or 0
+    for o in ops:
+        o["pct_of_wall"] = (100.0 * o["op_time_ns"] / wall) if wall else 0.0
+    shuffles = {}
+    for r in q["events"]["ShuffleWrite"]:
+        s = shuffles.setdefault(r.get("shuffle_id"),
+                                {"bytes": 0, "rows": 0, "blocks": 0,
+                                 "maps": 0})
+        s["bytes"] += r.get("bytes", 0)
+        s["rows"] += r.get("rows", 0)
+        s["blocks"] += r.get("blocks", 0)
+        s["maps"] += 1
+    retry_scopes: Dict[str, int] = {}
+    for r in q["events"]["RetryAttempt"]:
+        retry_scopes[r.get("scope", "?")] = \
+            retry_scopes.get(r.get("scope", "?"), 0) + 1
+    return {
+        "query_id": q["query_id"],
+        "status": q["status"],
+        "wall_ns": wall,
+        "op_time_ns": total_op_ns,
+        # exclusive op-times are disjoint: their sum is busy time; the
+        # remainder of wall clock is waiting (barriers, I/O, semaphore)
+        "critical_path": {
+            "busy_ns": total_op_ns,
+            "wait_ns": max(wall - total_op_ns, 0),
+            "busy_fraction": (total_op_ns / wall) if wall else 0.0,
+        },
+        "operators": ops,
+        "shuffles": shuffles,
+        "spill": {
+            "to_host": len(q["events"]["SpillToHost"]),
+            "to_disk": len(q["events"]["SpillToDisk"]),
+            "bytes": q["spilled_bytes"] or sum(
+                r.get("bytes", 0) for r in q["events"]["SpillToHost"]),
+        },
+        "retries": {"oom": q["oom_retries"], "by_scope": retry_scopes},
+        "faults_injected": len(q["events"]["FaultInjected"]),
+        "corruption_detected": len(q["events"]["CorruptionDetected"]),
+        "fetch_failures": len(q["events"]["FetchFailed"]),
+        "stages": {
+            "submitted": len(q["events"]["StageSubmitted"]),
+            "completed": len(q["events"]["StageCompleted"]),
+            "tasks": len(q["events"]["TaskEnd"]),
+        },
+    }
+
+
+def _fmt_ns(ns: float) -> str:
+    return f"{ns / 1e6:.1f}ms"
+
+
+def _fmt_bytes(b: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(b) < 1024 or unit == "GiB":
+            return f"{b:.0f}{unit}" if unit == "B" else f"{b:.1f}{unit}"
+        b /= 1024.0
+    return f"{b:.1f}GiB"
+
+
+def render(rep: dict) -> str:
+    lines = []
+    cp = rep["critical_path"]
+    lines.append(f"=== query {rep['query_id']} [{rep['status']}] "
+                 f"wall={_fmt_ns(rep['wall_ns'])} ===")
+    lines.append(f"critical path: busy={_fmt_ns(cp['busy_ns'])} "
+                 f"({100 * cp['busy_fraction']:.0f}% of wall), "
+                 f"wait={_fmt_ns(cp['wait_ns'])}")
+    if rep["operators"]:
+        lines.append("  operator op-time breakdown:")
+        w = max(len(o["exec_id"]) for o in rep["operators"])
+        for o in rep["operators"]:
+            lines.append(
+                f"    {o['exec_id']:<{w}}  "
+                f"{_fmt_ns(o['op_time_ns']):>10}  "
+                f"{o['pct_of_wall']:5.1f}%  rows={o['rows']:<10} "
+                f"batches={o['batches']}"
+                + (f"  shuffleBytes={_fmt_bytes(o['shuffle_bytes'])}"
+                   if o["shuffle_bytes"] else ""))
+    if rep["shuffles"]:
+        lines.append("  shuffle exchanges:")
+        for sid, s in sorted(rep["shuffles"].items(),
+                             key=lambda kv: str(kv[0])):
+            lines.append(f"    shuffle {sid}: {_fmt_bytes(s['bytes'])} "
+                         f"rows={s['rows']} blocks={s['blocks']} "
+                         f"maps={s['maps']}")
+    sp = rep["spill"]
+    lines.append(f"  spill: host={sp['to_host']} disk={sp['to_disk']} "
+                 f"bytes={_fmt_bytes(sp['bytes'])}")
+    lines.append(f"  retries: oom={rep['retries']['oom']} "
+                 f"by_scope={rep['retries']['by_scope']}")
+    lines.append(f"  faults injected={rep['faults_injected']} "
+                 f"corruption detected={rep['corruption_detected']} "
+                 f"fetch failures={rep['fetch_failures']}")
+    st = rep["stages"]
+    if st["submitted"] or st["tasks"]:
+        lines.append(f"  stages: submitted={st['submitted']} "
+                     f"completed={st['completed']} tasks={st['tasks']}")
+    return "\n".join(lines)
+
+
+def report(path: str, query_id: Optional[str] = None) -> List[dict]:
+    records = ev.read_all_events(path)
+    queries = build_queries(records)
+    if query_id is not None:
+        queries = [q for q in queries if q["query_id"] == query_id]
+    return [analyze(q) for q in queries]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("event_log",
+                    help="events-*.jsonl file or srt.eventLog.dir")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    ap.add_argument("--query", default=None,
+                    help="report only this query id")
+    args = ap.parse_args(argv)
+    if not os.path.exists(args.event_log):
+        print(f"no such event log: {args.event_log}", file=sys.stderr)
+        return 2
+    reports = report(args.event_log, args.query)
+    if not reports:
+        print("no queries found in event log", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(reports, indent=2, default=str))
+    else:
+        print("\n\n".join(render(r) for r in reports))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
