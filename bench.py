@@ -23,7 +23,9 @@ stages — emitting what completed — rather than overrunning.
 
 Environment knobs: SRT_BENCH_SCALE (lineitem rows, default 6,000,000 =
 SF1-shaped; auto-reduced to 1.5M on the CPU fallback backend),
-SRT_BENCH_ITERS, SRT_BENCH_DIR (parquet cache), SRT_BENCH_BUDGET.
+SRT_BENCH_ITERS, SRT_BENCH_DIR (parquet cache), SRT_BENCH_BUDGET,
+SRT_BENCH_PIPELINE=on|off|both (async-pipeline A/B on the NDS sweep;
+"both" records pipelined-vs-sync walls and their delta).
 """
 
 import json
@@ -266,10 +268,13 @@ def pandas_mortgage(mort_dir):
 # framework end-to-end
 # ---------------------------------------------------------------------------
 
-def framework_session():
+def framework_session(extra: dict = None):
     from spark_rapids_tpu.conf import SrtConf
     from spark_rapids_tpu.plan.session import TpuSession
-    return TpuSession(SrtConf({"srt.shuffle.partitions": 4}))
+    settings = {"srt.shuffle.partitions": 4}
+    if extra:
+        settings.update(extra)
+    return TpuSession(SrtConf(settings))
 
 
 def framework_queries(session, paths):
@@ -538,7 +543,12 @@ def main():
             log(f"mortgage bench failed: {e}")
 
     # --- NDS mini power-run (BASELINE config 2 breadth evidence):
-    # the full 99-query suite swept once, total wall + per-query recorded
+    # the full 99-query suite swept once, total wall + per-query
+    # recorded. SRT_BENCH_PIPELINE selects the async-pipeline lane:
+    # "on" (default, srt.exec.pipeline.enabled=true), "off" (sync
+    # execution), or "both" — an A/B sweep whose record carries both
+    # lanes' walls plus the pipelined-vs-sync delta over the queries
+    # BOTH lanes completed (budget cuts can truncate either lane).
     if left("nds power run", need=60):
         try:
             from spark_rapids_tpu.models.nds import (NDS_QUERIES,
@@ -551,23 +561,14 @@ def main():
                 100_000 if backend != "cpu" else 8000))
             nds_dir = os.path.join(os.path.dirname(data_dir),
                                    f"nds_{nds_scale}")
-            nds_sess = framework_session()
-            register_nds(nds_sess, nds_dir, scale_rows=nds_scale)
-            # drop the headline queries' in-memory executables before
-            # the 70-query sweep (see the % 5 clear below)
+            pipe_mode = os.environ.get("SRT_BENCH_PIPELINE",
+                                       "on").lower()
+            legs = {"on": [("on", "true")], "off": [("off", "false")],
+                    "both": [("on", "true"), ("off", "false")]}.get(
+                pipe_mode, [("on", "true")])
+            RESULT["nds_pipeline_mode"] = pipe_mode
             import gc
-            jax.clear_caches()
-            gc.collect()
-            t0 = time.perf_counter()
-            done = 0
-            per_q = {}
 
-            def nds_snapshot():
-                RESULT["nds_queries_run"] = done
-                RESULT["nds_scale_rows"] = nds_scale
-                RESULT["nds_per_query_s"] = dict(per_q)
-                RESULT["nds_total_s"] = round(
-                    time.perf_counter() - t0, 2)
             # cheap-first static order (round-5 measured warm walls on
             # the CPU lane): a budget cut then truncates the heavy
             # TAIL, so queries_run is maximal for any budget — the
@@ -588,35 +589,83 @@ def main():
                 "q67", "q57", "q47"]
             ordered = [q for q in nds_order if q in NDS_QUERIES] + \
                 sorted(set(NDS_QUERIES) - set(nds_order))
-            for qid in ordered:
-                if not left(f"nds {qid}", need=20):
-                    break
-                tq = time.perf_counter()
-                nds_sess.sql(NDS_QUERIES[qid]).collect()
-                per_q[qid] = round(time.perf_counter() - tq, 2)
-                done += 1
-                if done % 10 == 0:
-                    # progressive record: a crash mid-suite still
-                    # leaves the completed queries on stdout
-                    nds_snapshot()
-                    emit()
-                if done % 5 == 0 and _rss_fraction() > 0.35:
-                    # in-memory jit/executable caches grow without
-                    # bound across 70+ distinct heavy queries and can
-                    # exhaust host RAM (LLVM 'Cannot allocate memory'
-                    # -> SIGSEGV); the persistent DISK compile cache
-                    # keeps re-runs cheap, so when resident size nears
-                    # the host's memory drop the in-memory layer —
-                    # trading a little re-trace time for survival
-                    # (unconditional clearing cost ~30%+ of sweep time
-                    # on big-RAM boxes that never needed it)
-                    nds_sess._plan_cache.clear()
-                    jax.clear_caches()
-                    gc.collect()
-            nds_snapshot()
-            log(f"nds power run: {done}/{len(NDS_QUERIES)} queries in "
-                f"{RESULT['nds_total_s']}s")
-            emit()
+
+            def run_leg(label, enabled, key_prefix):
+                nds_sess = framework_session(
+                    {"srt.exec.pipeline.enabled": enabled})
+                register_nds(nds_sess, nds_dir, scale_rows=nds_scale)
+                # drop the previous lane's in-memory executables before
+                # the 70-query sweep (see the % 5 clear below)
+                jax.clear_caches()
+                gc.collect()
+                t0 = time.perf_counter()
+                done = 0
+                per_q = {}
+
+                def snapshot():
+                    RESULT[f"{key_prefix}queries_run"] = done
+                    RESULT["nds_scale_rows"] = nds_scale
+                    RESULT[f"{key_prefix}per_query_s"] = dict(per_q)
+                    RESULT[f"{key_prefix}total_s"] = round(
+                        time.perf_counter() - t0, 2)
+                for qid in ordered:
+                    if not left(f"nds {qid} [{label}]", need=20):
+                        break
+                    tq = time.perf_counter()
+                    nds_sess.sql(NDS_QUERIES[qid]).collect()
+                    per_q[qid] = round(time.perf_counter() - tq, 2)
+                    done += 1
+                    if done % 10 == 0:
+                        # progressive record: a crash mid-suite still
+                        # leaves the completed queries on stdout
+                        snapshot()
+                        emit()
+                    if done % 5 == 0 and _rss_fraction() > 0.35:
+                        # in-memory jit/executable caches grow without
+                        # bound across 70+ distinct heavy queries and
+                        # can exhaust host RAM (LLVM 'Cannot allocate
+                        # memory' -> SIGSEGV); the persistent DISK
+                        # compile cache keeps re-runs cheap, so when
+                        # resident size nears the host's memory drop
+                        # the in-memory layer — trading a little
+                        # re-trace time for survival (unconditional
+                        # clearing cost ~30%+ of sweep time on big-RAM
+                        # boxes that never needed it)
+                        nds_sess._plan_cache.clear()
+                        jax.clear_caches()
+                        gc.collect()
+                snapshot()
+                log(f"nds power run [pipeline={label}]: "
+                    f"{done}/{len(NDS_QUERIES)} queries in "
+                    f"{RESULT[f'{key_prefix}total_s']}s")
+                emit()
+                return per_q
+
+            if len(legs) == 1:
+                # single lane keeps the historical record keys
+                run_leg(legs[0][0], legs[0][1], "nds_")
+            else:
+                walls = {}
+                for label, enabled in legs:
+                    walls[label] = run_leg(label, enabled,
+                                           f"nds_{label}_")
+                # delta over the queries BOTH lanes completed — a
+                # budget cut mid-lane must not skew the comparison
+                common = sorted(set(walls["on"]) & set(walls["off"]))
+                if common:
+                    on_s = sum(walls["on"][q] for q in common)
+                    off_s = sum(walls["off"][q] for q in common)
+                    RESULT["nds_pipeline_common_queries"] = len(common)
+                    RESULT["nds_pipelined_common_s"] = round(on_s, 2)
+                    RESULT["nds_sync_common_s"] = round(off_s, 2)
+                    # >0: pipelining saved wall; <0: it cost wall
+                    RESULT["nds_pipeline_delta_pct"] = round(
+                        100.0 * (off_s - on_s) / off_s, 2) \
+                        if off_s else 0.0
+                    log(f"nds pipeline A/B over {len(common)} common "
+                        f"queries: on={on_s:.2f}s off={off_s:.2f}s "
+                        f"delta={RESULT['nds_pipeline_delta_pct']}%")
+                emit()
         except Exception as e:  # breadth stage must never kill the bench
             log(f"nds power run failed: {e}")
 

@@ -542,6 +542,40 @@ SHUFFLE_FETCH_IN_FLIGHT_BYTES = conf("srt.shuffle.fetch.inFlightBytes") \
          "fan-in host memory.") \
     .check(_positive).integer(128 * 1024 * 1024)
 
+SHUFFLE_FETCH_POOL_SIZE = conf("srt.shuffle.fetch.poolSize") \
+    .doc("Worker threads in the process-wide shuffle fetch pool shared "
+         "by every reduce partition (replaces per-endpoint one-shot "
+         "thread churn; RapidsShuffleClient exec pool role). Per-reduce "
+         "concurrency is still capped by "
+         "srt.shuffle.fetch.maxConcurrent.") \
+    .check(_positive).integer(8)
+
+PIPELINE_ENABLED = conf("srt.exec.pipeline.enabled") \
+    .doc("Run blocking plan edges (scan decode, shuffle fetch/"
+         "deserialize, broadcast materialization) on background "
+         "producer threads behind a bounded prefetch queue so host I/O "
+         "overlaps device compute (exec/pipeline.py; multithreaded "
+         "reader + RapidsShuffleIterator fetch-ahead role). Queued "
+         "batches register as on-deck spillable; producer-side "
+         "failures re-raise on the consuming thread at the same plan "
+         "node as synchronous mode.") \
+    .commonly_used().boolean(True)
+
+PIPELINE_DEPTH = conf("srt.exec.pipeline.depth") \
+    .doc("Max batches queued per pipelined edge. 2 double-buffers: the "
+         "producer stages batch N+1 while the consumer computes on "
+         "batch N; higher values smooth bursty sources at the cost of "
+         "more on-deck memory (bounded by "
+         "srt.exec.pipeline.maxBytesInFlight).") \
+    .check(_positive).integer(2)
+
+PIPELINE_MAX_BYTES = conf("srt.exec.pipeline.maxBytesInFlight") \
+    .doc("Byte budget for batches queued per pipelined edge; the "
+         "producer stalls while the queue holds this much. A single "
+         "batch over the budget is admitted alone into an empty queue "
+         "(progress guarantee). Accepts k/m/g suffixes.") \
+    .check(_positive).bytes_(256 * 1024 * 1024)
+
 FETCH_MAX_RETRIES = conf("srt.shuffle.fetch.maxRetries") \
     .doc("Reconnect attempts per peer when a shuffle block fetch fails "
          "mid-stream (connection refused/reset, timeout). Already-"

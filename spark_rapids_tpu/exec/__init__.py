@@ -13,6 +13,7 @@ from .basic import (BatchScanExec, CoalesceBatchesExec, ExpandExec,
                     FilterExec, LocalLimitExec, ProjectExec, RangeExec,
                     UnionExec)
 from .aggregate import HashAggregateExec
+from .pipeline import PrefetchExec, PrefetchIterator
 from .sort import SortExec, SortOrder, TopNExec
 from .join import BroadcastHashJoinExec, ShuffledHashJoinExec
 
@@ -20,6 +21,7 @@ __all__ = [
     "ExecContext", "Metric", "TpuExec", "TpuSemaphore",
     "BatchScanExec", "CoalesceBatchesExec", "ExpandExec", "FilterExec",
     "LocalLimitExec", "ProjectExec", "RangeExec", "UnionExec",
-    "HashAggregateExec", "SortExec", "SortOrder", "TopNExec",
+    "HashAggregateExec", "PrefetchExec", "PrefetchIterator",
+    "SortExec", "SortOrder", "TopNExec",
     "BroadcastHashJoinExec", "ShuffledHashJoinExec",
 ]

@@ -85,9 +85,11 @@ class SelfTimer:
     timer would charge the whole subtree to every ancestor (the reference
     explicitly excludes child time from op time). A per-context timer
     stack pauses the enclosing operator's clock while a nested one runs:
-    each metric receives only the time its own operator spent. The stack
-    assumes one pulling thread per ExecContext (the generator pipeline is
-    single-threaded; I/O thread pools do their timing elsewhere).
+    each metric receives only the time its own operator spent. Each
+    pulling thread has its own stack (ExecContext.timer_stack is
+    thread-local): frames on different threads run genuinely in
+    parallel — pipelined producers (exec/pipeline.py) — and must not
+    pause each other; I/O thread pools do their timing elsewhere.
     """
 
     def __init__(self, stack: list, metric: Optional[Metric], name: str = "",
@@ -234,7 +236,8 @@ class ExecContext:
         self.conf = conf or active_conf()
         self.semaphore = device_semaphore()
         self.metrics: Dict[str, Dict[str, Metric]] = {}
-        self.timer_stack: list = []
+        #: SelfTimer stacks, one per pulling thread (see timer_stack)
+        self._timer_stacks = threading.local()
         #: current reduce-partition index for context expressions
         #: (spark_partition_id / monotonically_increasing_id); operators
         #: that stream one partition at a time set this while iterating
@@ -276,6 +279,17 @@ class ExecContext:
             except Exception:
                 pass  # best-effort: a corrupt batch may be the cause
         return out
+
+    @property
+    def timer_stack(self) -> list:
+        """This thread's SelfTimer stack. Per-thread so pipelined
+        producer threads (exec/pipeline.py) attribute their operators'
+        exclusive time on their own stack — frames on different threads
+        genuinely run concurrently and must not pause each other."""
+        st = getattr(self._timer_stacks, "stack", None)
+        if st is None:
+            st = self._timer_stacks.stack = []
+        return st
 
     def metrics_for(self, exec_id: str) -> Dict[str, Metric]:
         return self.metrics.setdefault(exec_id, {})

@@ -23,7 +23,7 @@ from ..columnar.vector import (ColumnVector, ColumnarBatch, choose_capacity,
 from ..expr.core import Expression, output_name
 from ..jit_registry import shared_fn_jit, shared_method_jit
 from ..ops import kernels as K
-from .base import ExecContext, NvtxTimer, Schema, TpuExec
+from .base import ExecContext, Metric, NvtxTimer, Schema, TpuExec
 
 
 class BatchScanExec(TpuExec):
@@ -274,9 +274,17 @@ class CoalesceBatchesExec(TpuExec):
         return self.children[0].output_schema
 
     def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        import time as _time
+
         from ..conf import BATCH_SIZE_ROWS
         from ..memory.spill import SpillableBatch, SpillPriority
         target = self.target_rows or ctx.conf.get(BATCH_SIZE_ROWS)
+        # time spent blocked pulling the child: under pipelining the
+        # child is a prefetcher, so this is the residual stall the
+        # background producer could not hide
+        wait = ctx.metrics_for(self.exec_id).setdefault(
+            "coalesceWaitTime",
+            Metric("coalesceWaitTime", Metric.MODERATE, "ns"))
         pending: List[SpillableBatch] = []
         pending_rows = 0
 
@@ -296,14 +304,30 @@ class CoalesceBatchesExec(TpuExec):
             pending, pending_rows = [], 0
             return out
 
-        for batch in self.children[0].execute(ctx):
+        it = iter(self.children[0].execute(ctx))
+        while True:
+            t0 = _time.perf_counter_ns()
+            try:
+                batch = next(it)
+            except StopIteration:
+                wait.add(_time.perf_counter_ns() - t0)
+                break
+            wait.add(_time.perf_counter_ns() - t0)
             n = int(batch.num_rows)
             if n == 0:
+                continue
+            if n >= target and not pending:
+                # already at target with nothing buffered: skip the
+                # spill-registration + get() round-trip entirely
+                yield batch
                 continue
             if pending_rows + n > target and pending:
                 out = flush()
                 if out is not None:
                     yield out
+                if n >= target:
+                    yield batch
+                    continue
             pending.append(SpillableBatch(batch,
                                           SpillPriority.ACTIVE_ON_DECK))
             pending_rows += n

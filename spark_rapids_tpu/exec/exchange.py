@@ -461,6 +461,20 @@ class ShuffleExchangeExec(TpuExec):
                 groups.append(cur)
         return groups
 
+    def _maybe_prefetch(self, ctx: ExecContext, factory, name: str):
+        """Read-side pipelining (RapidsShuffleIterator fetch-ahead
+        role): pull one reduce partition's block stream — fetch,
+        checksum verify, deserialize — on a background producer so it
+        overlaps the consumer's reduce compute. Gated on the conf AND
+        the planner's ``_pipeline_ok`` safety tag; off = the plain
+        synchronous generator. The producer for partition i starts only
+        when the consumer requests partition i, so ``ctx.partition_id``
+        advances strictly behind the consumer."""
+        from .pipeline import pipeline_enabled, prefetch_batches
+        if not pipeline_enabled(ctx, self):
+            return factory()
+        return prefetch_batches(ctx, self, factory, name=name)
+
     def execute_partition_groups(self, ctx: ExecContext,
                                  groups: List[List[int]],
                                  map_mod: Optional[dict] = None):
@@ -498,7 +512,9 @@ class ShuffleExchangeExec(TpuExec):
                         peers, self.shuffle_id, reduce_id, map_mod=mm,
                         endpoint_resolver=resolver)
             for gi in ctx.cluster.assigned(len(groups), dsid):
-                yield remote_group(gi, groups[gi])
+                yield self._maybe_prefetch(
+                    ctx, lambda _gi=gi: remote_group(_gi, groups[_gi]),
+                    f"shuffle-g{gi}")
             return
 
         def read_group(gi, g):
@@ -509,7 +525,9 @@ class ShuffleExchangeExec(TpuExec):
                                               reduce_id, map_mod=mm)
         try:
             for gi, g in enumerate(groups):
-                yield read_group(gi, g)
+                yield self._maybe_prefetch(
+                    ctx, lambda _gi=gi, _g=g: read_group(_gi, _g),
+                    f"shuffle-g{gi}")
         finally:
             self._release(mgr)
 
@@ -541,7 +559,9 @@ class ShuffleExchangeExec(TpuExec):
                                                 reduce_id,
                                                 endpoint_resolver=resolver)
             for reduce_id in ctx.cluster.assigned(n_parts, dsid):
-                yield remote_read(reduce_id)
+                yield self._maybe_prefetch(
+                    ctx, lambda rid=reduce_id: remote_read(rid),
+                    f"shuffle-p{reduce_id}")
             # no unregister here: PEERS fetch this worker's blocks until
             # the whole job completes — the driver's post-job reset (or
             # failure-path reset) frees them (cluster.py _run_once)
@@ -552,7 +572,9 @@ class ShuffleExchangeExec(TpuExec):
             yield from mgr.read_partition(self.shuffle_id, reduce_id)
         try:
             for reduce_id in range(n_parts):
-                yield local_read(reduce_id)
+                yield self._maybe_prefetch(
+                    ctx, lambda rid=reduce_id: local_read(rid),
+                    f"shuffle-p{reduce_id}")
         finally:
             self._release(mgr)
 
@@ -617,8 +639,18 @@ class BroadcastExchangeExec(TpuExec):
             m = ctx.metrics_for(self.exec_id)
             bt = m.setdefault("broadcastTime",
                               Metric("broadcastTime", Metric.MODERATE, "ns"))
+            from .pipeline import pipeline_enabled, prefetch_batches
+            if pipeline_enabled(ctx, self):
+                # drain the child through a background producer: decode
+                # and upload of batch N+1 overlap the consumer's
+                # accumulation of batch N
+                stream = prefetch_batches(
+                    ctx, self, lambda: self.children[0].execute(ctx),
+                    name="broadcast")
+            else:
+                stream = self.children[0].execute(ctx)
             with NvtxTimer(bt, "broadcast.build"):
-                batches = [b for b in self.children[0].execute(ctx)
+                batches = [b for b in stream
                            if int(b.num_rows) > 0]
                 if not batches:
                     return None

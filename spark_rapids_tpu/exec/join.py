@@ -452,6 +452,7 @@ class _HashJoinBase(TpuExec):
         from ..io.scan import FileSourceScanExec
         from .basic import (CoalesceBatchesExec, FilterExec, LocalLimitExec,
                             ProjectExec)
+        from .pipeline import PrefetchExec
         if isinstance(node, FileSourceScanExec):
             if any(k == name for k, _ in node.scan.partition_schema):
                 yield node
@@ -467,7 +468,7 @@ class _HashJoinBase(TpuExec):
                 return
             return
         if isinstance(node, (FilterExec, CoalesceBatchesExec,
-                             LocalLimitExec)):
+                             LocalLimitExec, PrefetchExec)):
             yield from self._dpp_scans(node.children[0], name)
             return
         # unknown/multi-child operator: don't assume pass-through

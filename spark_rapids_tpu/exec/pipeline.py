@@ -1,0 +1,338 @@
+"""Asynchronous pipelined execution: overlap I/O with device compute.
+
+The engine is a pull-based iterator chain, so every scan decode,
+shuffle fetch, checksum verify, and host->device transfer stalls the
+consumer (and therefore the TPU) for its full duration. The reference
+plugin hides these latencies with a multithreaded reader and the
+RapidsShuffleIterator's fetch-ahead window; this module is the common
+primitive behind both: ``PrefetchIterator`` runs a producer iterator on
+a background thread behind a bounded queue with byte-budget
+backpressure, and ``prefetch_batches`` specializes it for
+ColumnarBatch streams — every in-flight batch registers with the spill
+catalog as ACTIVE_ON_DECK so memory pressure can reclaim it, and with
+``srt.exec.pipeline.depth`` >= 2 the producer's upload of batch N+1
+overlaps the consumer's compute on batch N (double buffering; JAX's
+async dispatch makes the device transfer itself non-blocking on the
+producer).
+
+Insertion points (see plan/overrides.py ``_insert_pipeline``):
+  * ``PrefetchExec`` wraps ``FileSourceScanExec`` output — decode
+    overlaps compute,
+  * the read side of ``ShuffleExchangeExec`` wraps each reduce
+    partition's block stream — fetch/verify/deserialize overlap reduce
+    compute,
+  * ``BroadcastExchangeExec.materialize`` drains its child through a
+    prefetcher while concat-staging runs on the consumer.
+
+Correctness contract:
+  * items arrive in producer order (single producer, FIFO deque);
+  * a producer-side exception is re-raised on the CONSUMING thread —
+    the original exception object, after all items produced before it
+    have been drained — so ``FetchFailed`` / ``DataCorruption`` /
+    injected faults surface at the same plan node and with the same
+    type as in synchronous mode, and stage-retry / whole-job-retry
+    isinstance checks keep firing;
+  * the producer thread inherits the query conf (``set_active_conf``)
+    and, when a fault plan is armed, the wrapping operator's fault
+    scope, so ``~op=`` site matches behave as if the work ran inline;
+  * ``close()`` is idempotent, joins the producer, and discards (via
+    ``on_discard``) anything still queued, so an abandoned consumer
+    (LocalLimit, error unwind) leaks neither threads nor spill-catalog
+    registrations.
+
+The SelfTimer disjointness invariant (obs: exclusive op-times on one
+thread never overlap) holds because each thread pulls through its own
+timer stack (ExecContext.timer_stack is thread-local): producer-side
+operators attribute their op-time on the producer's stack, the
+``PrefetchExec`` / exchange frames attribute only wait time on the
+consumer's. tools/profile_report.py folds the two by treating
+sum(op-time) > wall as pipeline overlap, not double-charging.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Iterable, Iterator, Optional
+
+from ..conf import (PIPELINE_DEPTH, PIPELINE_ENABLED, PIPELINE_MAX_BYTES,
+                    SrtConf, set_active_conf)
+from .base import ExecContext, Metric, Schema, TpuExec
+
+__all__ = ["PrefetchIterator", "PrefetchExec", "prefetch_batches",
+           "pipeline_enabled"]
+
+
+class PrefetchIterator:
+    """Run ``source_factory()`` on a background thread; consume here.
+
+    The factory (not a live iterator) crosses the thread boundary so
+    the source generator is CREATED on the producer thread — generator
+    bodies that capture thread-local state at first-next (conf, fault
+    scopes, task context) see the producer's, which this class sets up
+    to mirror the consumer's.
+
+    Backpressure: the producer blocks while ``depth`` items are queued
+    or queued bytes would exceed ``max_bytes``; an oversized single
+    item is admitted only into an EMPTY queue (progress guarantee, the
+    ByteBudget convention). ``nbytes`` sizes items; None = count-only.
+    """
+
+    def __init__(self, source_factory: Callable[[], Iterable],
+                 depth: int = 2,
+                 max_bytes: int = 0,
+                 nbytes: Optional[Callable] = None,
+                 conf: Optional[SrtConf] = None,
+                 fault_tag: str = "",
+                 on_discard: Optional[Callable] = None,
+                 name: str = "prefetch",
+                 wait_metric: Optional[Metric] = None,
+                 depth_peak_metric: Optional[Metric] = None,
+                 bytes_peak_metric: Optional[Metric] = None):
+        self._factory = source_factory
+        self._depth = max(int(depth), 1)
+        self._max_bytes = max(int(max_bytes), 0)
+        self._nbytes = nbytes
+        self._conf = conf
+        self._fault_tag = fault_tag
+        self._on_discard = on_discard
+        self._wait_metric = wait_metric
+        self._depth_peak_metric = depth_peak_metric
+        self._bytes_peak_metric = bytes_peak_metric
+        self._cv = threading.Condition()
+        self._buf: deque = deque()  # (item, nbytes)
+        self._bytes = 0
+        self._depth_peak = 0
+        self._bytes_peak = 0
+        self._done = False
+        self._stopped = False
+        self._error: Optional[BaseException] = None
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._run, name=f"srt-prefetch-{name}", daemon=True)
+        self._thread.start()
+
+    # --- producer side ---------------------------------------------------
+    def _run(self) -> None:
+        from ..robustness import faults
+        if self._conf is not None:
+            set_active_conf(self._conf)
+        scope = (faults.op_scope(self._fault_tag)
+                 if self._fault_tag and faults.armed() else None)
+        src = None
+        try:
+            if scope is not None:
+                scope.__enter__()
+            try:
+                src = iter(self._factory())
+                for item in src:
+                    n = int(self._nbytes(item)) if self._nbytes else 0
+                    if not self._admit(item, n):
+                        break
+            finally:
+                if scope is not None:
+                    scope.__exit__(None, None, None)
+        except BaseException as e:  # noqa: BLE001 — relayed to consumer
+            with self._cv:
+                self._error = e
+                self._cv.notify_all()
+        finally:
+            # tear the source down on ITS OWN thread (generator finally
+            # blocks may release locks/sockets owned by this thread)
+            if src is not None and hasattr(src, "close"):
+                try:
+                    src.close()
+                except Exception:
+                    pass
+            with self._cv:
+                self._done = True
+                self._cv.notify_all()
+
+    def _admit(self, item, n: int) -> bool:
+        """Queue one item, honoring depth + byte backpressure. False =
+        stopped: the item was discarded and the producer should quit."""
+        with self._cv:
+            while not self._stopped and self._buf and (
+                    len(self._buf) >= self._depth
+                    or (self._max_bytes
+                        and self._bytes + n > self._max_bytes)):
+                self._cv.wait()
+            if self._stopped:
+                self._discard(item)
+                return False
+            self._buf.append((item, n))
+            self._bytes += n
+            if len(self._buf) > self._depth_peak:
+                self._depth_peak = len(self._buf)
+            if self._bytes > self._bytes_peak:
+                self._bytes_peak = self._bytes
+            self._cv.notify_all()
+            return True
+
+    def _discard(self, item) -> None:
+        if self._on_discard is not None:
+            try:
+                self._on_discard(item)
+            except Exception:
+                pass
+
+    # --- consumer side ---------------------------------------------------
+    def __iter__(self) -> "PrefetchIterator":
+        return self
+
+    def __next__(self):
+        with self._cv:
+            waited = 0
+            while True:
+                if self._buf:
+                    item, n = self._buf.popleft()
+                    self._bytes -= n
+                    self._cv.notify_all()
+                    if waited and self._wait_metric is not None:
+                        self._wait_metric.add(waited)
+                    return item
+                # buffered items drain before an error surfaces: the
+                # consumer sees exactly the prefix the producer emitted
+                # before failing, same as synchronous execution
+                if self._error is not None:
+                    err = self._error
+                    self._stopped = True
+                    self._cv.notify_all()
+                    self._flush_peaks()
+                    raise err
+                if self._done:
+                    self._flush_peaks()
+                    raise StopIteration
+                t0 = time.perf_counter_ns()
+                self._cv.wait()
+                waited += time.perf_counter_ns() - t0
+
+    def _flush_peaks(self) -> None:
+        # peaks fold across partitions sharing one metrics dict: keep
+        # the query-wide max (single consuming thread, no set() race)
+        if self._depth_peak_metric is not None:
+            self._depth_peak_metric.set(
+                max(self._depth_peak_metric.value, self._depth_peak))
+        if self._bytes_peak_metric is not None:
+            self._bytes_peak_metric.set(
+                max(self._bytes_peak_metric.value, self._bytes_peak))
+
+    def close(self, join_timeout: float = 30.0) -> None:
+        """Stop the producer, join it, and discard queued items."""
+        if self._closed:
+            return
+        self._closed = True
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+        self._thread.join(timeout=join_timeout)
+        with self._cv:
+            while self._buf:
+                item, _ = self._buf.popleft()
+                self._discard(item)
+            self._bytes = 0
+            self._flush_peaks()
+
+    def __enter__(self) -> "PrefetchIterator":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+
+def pipeline_enabled(ctx: ExecContext, node=None) -> bool:
+    """Runtime gate: the conf switch AND (for exchanges) the planner's
+    safety tag. The planner withholds ``_pipeline_ok`` from plans with
+    partition-context expressions (spark_partition_id() et al) whose
+    values would race against a producer advancing ``ctx.partition_id``.
+    """
+    if not ctx.conf.get(PIPELINE_ENABLED):
+        return False
+    if node is not None and not getattr(node, "_pipeline_ok", False):
+        return False
+    return True
+
+
+def prefetch_batches(ctx: ExecContext, node: TpuExec,
+                     source_factory: Callable[[], Iterable],
+                     name: str = "") -> Iterator:
+    """Pull a ColumnarBatch stream through a background prefetcher.
+
+    Each produced batch registers with the spill catalog as an
+    ACTIVE_ON_DECK SpillableBatch while it waits in the queue (memory
+    pressure can push queued batches to host/disk instead of OOMing);
+    the consumer re-materializes (usually a no-op: still on device) and
+    releases the registration before yielding. Metrics land on
+    ``node``: prefetchWaitTime (consumer blocked on an empty queue),
+    prefetchQueueDepthPeak, prefetchBytesPeak.
+    """
+    from ..memory.spill import SpillableBatch, SpillPriority
+    m = ctx.metrics_for(node.exec_id)
+    wait = m.setdefault("prefetchWaitTime",
+                        Metric("prefetchWaitTime", Metric.MODERATE, "ns"))
+    dpk = m.setdefault("prefetchQueueDepthPeak",
+                       Metric("prefetchQueueDepthPeak", Metric.DEBUG))
+    bpk = m.setdefault("prefetchBytesPeak",
+                       Metric("prefetchBytesPeak", Metric.DEBUG))
+
+    def staged() -> Iterator[SpillableBatch]:
+        for batch in source_factory():
+            yield SpillableBatch(batch, SpillPriority.ACTIVE_ON_DECK)
+
+    pf = PrefetchIterator(
+        staged,
+        depth=ctx.conf.get(PIPELINE_DEPTH),
+        max_bytes=ctx.conf.get(PIPELINE_MAX_BYTES),
+        nbytes=lambda sb: sb.nbytes,
+        conf=ctx.conf,
+        fault_tag=node.exec_id,
+        on_discard=lambda sb: sb.close(),
+        name=name or node.exec_id,
+        wait_metric=wait,
+        depth_peak_metric=dpk,
+        bytes_peak_metric=bpk)
+
+    def consume() -> Iterator:
+        try:
+            for sb in pf:
+                try:
+                    batch = sb.get()
+                finally:
+                    sb.close()
+                yield batch
+        finally:
+            pf.close()
+    return consume()
+
+
+class PrefetchExec(TpuExec):
+    """Transparent pipelining node: runs its child on a background
+    thread (prefetch_batches) and re-yields. Inserted by the planner
+    above blocking sources (today: FileSourceScanExec); schema and
+    partitioning pass through. When ``srt.exec.pipeline.enabled`` is
+    off at run time (a cached plan re-run under a different conf) it
+    degrades to a synchronous pass-through."""
+
+    def __init__(self, child: TpuExec):
+        super().__init__(child)
+        self._pipeline_ok = True
+
+    @property
+    def output_schema(self) -> Schema:
+        return self.children[0].output_schema
+
+    @property
+    def output_partitioning(self):
+        return self.children[0].output_partitioning
+
+    def do_execute(self, ctx: ExecContext) -> Iterator:
+        child = self.children[0]
+        if not pipeline_enabled(ctx, self):
+            yield from child.execute(ctx)
+            return
+        yield from prefetch_batches(ctx, self, lambda: child.execute(ctx))
+
+    def node_description(self) -> str:
+        return "Prefetch"

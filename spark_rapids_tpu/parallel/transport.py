@@ -329,6 +329,56 @@ class ByteBudget:
             self._cv.notify_all()
 
 
+class _FetchPool:
+    """Process-wide fetch worker pool (RapidsShuffleClient exec pool
+    role). One reduce partition used to spawn a fresh one-shot
+    ``threading.Thread`` per endpoint — hundreds of thread creations
+    per shuffle-heavy query; the pool's daemon workers are reused
+    across every reduce of every query in the process. Tasks are plain
+    closures; per-reduce fan-out stays capped by ``maxConcurrent``, the
+    pool size only bounds PROCESS-wide fetch parallelism."""
+
+    def __init__(self, size: int):
+        import queue as _q
+        self.size = max(int(size), 1)
+        self._q: "_q.SimpleQueue" = _q.SimpleQueue()
+        self._threads = []
+        for i in range(self.size):
+            t = threading.Thread(target=self._loop, daemon=True,
+                                 name=f"srt-fetch-{i}")
+            t.start()
+            self._threads.append(t)
+
+    def _loop(self) -> None:
+        while True:
+            task = self._q.get()
+            if task is None:
+                return
+            try:
+                task()
+            except BaseException:
+                pass  # tasks report through their own channels
+
+    def submit(self, task: Callable[[], None]) -> None:
+        self._q.put(task)
+
+
+_POOL: Optional[_FetchPool] = None
+_POOL_LOCK = threading.Lock()
+
+
+def fetch_pool() -> _FetchPool:
+    """The process-wide pool, created on first use at the size of
+    ``srt.shuffle.fetch.poolSize`` (later conf changes do not resize —
+    the pool outlives any one query by design)."""
+    global _POOL
+    with _POOL_LOCK:
+        if _POOL is None:
+            from ..conf import SHUFFLE_FETCH_POOL_SIZE, active_conf
+            _POOL = _FetchPool(active_conf().get(SHUFFLE_FETCH_POOL_SIZE))
+        return _POOL
+
+
 def fetch_all_partitions(endpoints: List[str], shuffle_id: int,
                          reduce_id: int,
                          max_concurrent: Optional[int] = None,
@@ -383,11 +433,13 @@ def fetch_all_partitions(endpoints: List[str], shuffle_id: int,
     import queue as _q
     budget = budget or ByteBudget(in_flight_bytes)
     outq: "_q.Queue" = _q.Queue()
-    _DONE = object()
     stop = threading.Event()
+    pool = fetch_pool()
 
     def worker(ep: str) -> None:
         try:
+            if stop.is_set():  # abandoned before this task ran
+                return
             for map_id, data in open_stream(ep):
                 if stop.is_set():
                     return
@@ -400,41 +452,33 @@ def fetch_all_partitions(endpoints: List[str], shuffle_id: int,
         finally:
             outq.put(("done", None))
 
-    threads = []
     pending = list(endpoints)
-    live = 0
     try:
+        live = 0
         while pending and live < max_concurrent:
-            t = threading.Thread(target=worker, args=(pending.pop(0),),
-                                 daemon=True)
-            t.start()
-            threads.append(t)
+            pool.submit(lambda ep=pending.pop(0): worker(ep))
             live += 1
         done = 0
-        error = None
         total = len(endpoints)
         while done < total:
             kind, payload = outq.get()
             if kind == "done":
                 done += 1
                 if pending:
-                    t = threading.Thread(target=worker,
-                                         args=(pending.pop(0),),
-                                         daemon=True)
-                    t.start()
-                    threads.append(t)
+                    pool.submit(lambda ep=pending.pop(0): worker(ep))
                 continue
             if kind == "error":
-                error = payload
-                continue
+                # fail fast: the partition is already doomed — raising
+                # now (instead of after every endpoint drains) stops
+                # the consumer deserializing blocks it will throw away;
+                # the finally below unwinds the other workers
+                raise payload
             data = payload
             try:
                 batch = deserialize_batch(data)
             finally:
                 budget.release(len(data))
             yield batch
-        if error is not None:
-            raise error
     finally:
         stop.set()
         # unblock any producer stuck on a full budget

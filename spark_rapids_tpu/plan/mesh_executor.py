@@ -202,6 +202,12 @@ class MeshQueryExecutor:
         if isinstance(node, CoalesceBatchesExec):
             return self._lower(node.children[0])
 
+        from ..exec.pipeline import PrefetchExec
+        if isinstance(node, PrefetchExec):
+            # host-side pipelining has no meaning inside one traced
+            # mesh program: transparent pass-through
+            return self._lower(node.children[0])
+
         if isinstance(node, UnionExec):
             kids = [self._lower(c) for c in node.children]
 

@@ -18,7 +18,8 @@ from typing import Callable, Dict, List, Optional, Type
 
 from ..columnar import dtypes as dt
 from ..conf import (BROADCAST_THRESHOLD_ROWS, EXCHANGE_ENABLED, EXPLAIN,
-                    SHUFFLE_PARTITIONS, SQL_ENABLED, SrtConf, active_conf)
+                    PIPELINE_ENABLED, SHUFFLE_PARTITIONS, SQL_ENABLED,
+                    SrtConf, active_conf)
 from ..exec.aggregate import HashAggregateExec
 from ..exec.base import TpuExec
 from ..exec.basic import (BatchScanExec, CoalesceBatchesExec, ExpandExec,
@@ -1094,7 +1095,64 @@ def apply_overrides(plan: LogicalPlan, conf: Optional[SrtConf] = None):
             print("\n".join(lines))
     root = _ensure_physical(_to_physical(meta, conf), conf)
     _count_exchange_consumers(root)
+    root = _insert_pipeline(plan, root, conf)
     return root
+
+
+def _plan_is_pipeline_safe(plan: LogicalPlan) -> bool:
+    """Partition-context expressions — spark_partition_id(),
+    monotonically_increasing_id(), input_file_*() — read state the
+    consuming thread mutates while iterating (``ctx.partition_id``,
+    the input-file TLS), which a background producer running ahead
+    would race. Plans holding any of them run synchronously."""
+    from ..expr.misc import (InputFileName, MonotonicallyIncreasingID,
+                             SparkPartitionID, _InputFileBlock)
+    ctx_types = (InputFileName, _InputFileBlock, SparkPartitionID,
+                 MonotonicallyIncreasingID)
+
+    def expr_has(e) -> bool:
+        if isinstance(e, ctx_types):
+            return True
+        return any(expr_has(c) for c in e.children)
+
+    def walk(node) -> bool:
+        if any(expr_has(e) for e in node.expressions()):
+            return False
+        return all(walk(c) for c in node.children)
+
+    return walk(plan)
+
+
+def _insert_pipeline(plan: LogicalPlan, root, conf: SrtConf):
+    """Pipelining pass (exec/pipeline.py): wrap every eligible
+    FileSourceScanExec in a PrefetchExec (decode overlaps compute) and
+    tag exchange instances ``_pipeline_ok`` so their read side / the
+    broadcast build drains through a background producer. Exchanges
+    are TAGGED rather than wrapped: AQE transforms locate them with
+    direct-child isinstance checks that an interposed node would break.
+    Scans already forced to the PERFILE reader by an input_file_name()
+    ancestor stay synchronous (the expression reads per-batch TLS the
+    producer thread would own), and whole plans with partition-context
+    expressions opt out via ``_plan_is_pipeline_safe``."""
+    if not conf.get(PIPELINE_ENABLED) or not _plan_is_pipeline_safe(plan):
+        return root
+    from ..exec.exchange import BroadcastExchangeExec, ShuffleExchangeExec
+    from ..exec.pipeline import PrefetchExec
+    from ..io.scan import FileSourceScanExec
+
+    def walk(n):
+        kids = getattr(n, "children", None)
+        if kids:
+            for i, c in enumerate(kids):
+                kids[i] = walk(c)
+        if isinstance(n, (ShuffleExchangeExec, BroadcastExchangeExec)):
+            n._pipeline_ok = True
+        elif isinstance(n, FileSourceScanExec) and \
+                n.scan.options.get("_reader_override") != "PERFILE":
+            return PrefetchExec(n)
+        return n
+
+    return walk(root)
 
 
 def _count_exchange_consumers(root) -> None:

@@ -1,0 +1,409 @@
+"""Asynchronous pipelined execution tests (spark_rapids_tpu/exec/pipeline.py):
+
+- PrefetchIterator: producer order preserved at depth>1, byte-budget
+  backpressure caps peak in-flight bytes (with the oversized-item
+  progress guarantee), original-exception propagation to the consuming
+  thread (``DataCorruption`` / ``FetchFailed`` keep their types for the
+  retry machinery), clean shutdown with no leaked threads;
+- fault-harness integration: an armed ``scan.file:corrupt`` plan fires
+  on the prefetch producer thread and still surfaces at ``collect()``;
+- planner pass: PrefetchExec inserted above eligible scans, withheld
+  for input_file_name()/spark_partition_id() plans, exchanges tagged;
+- pipeline-on vs pipeline-off bit-identical results on an NDS sample
+  query;
+- satellites: the shared shuffle fetch pool is reused across reduces
+  and fails fast on a dead peer; CoalesceBatchesExec passes an
+  already-full batch through untouched and meters coalesceWaitTime.
+"""
+
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.conf import SrtConf
+from spark_rapids_tpu.exec.pipeline import PrefetchExec, PrefetchIterator
+from spark_rapids_tpu.plan import overrides
+from spark_rapids_tpu.plan.session import TpuSession
+from spark_rapids_tpu.robustness.faults import (arm_fault_plan,
+                                                disarm_fault_plan)
+from spark_rapids_tpu.robustness.integrity import DataCorruption
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    disarm_fault_plan()
+    yield
+    disarm_fault_plan()
+
+
+def _prefetch_threads():
+    return [t for t in threading.enumerate()
+            if t.name.startswith("srt-prefetch")]
+
+
+# ---------------------------------------------------------------------------
+# PrefetchIterator unit behavior
+# ---------------------------------------------------------------------------
+
+def test_ordering_preserved_under_depth():
+    for depth in (1, 2, 4, 16):
+        pf = PrefetchIterator(lambda: iter(range(200)), depth=depth)
+        try:
+            assert list(pf) == list(range(200))
+        finally:
+            pf.close()
+
+
+def test_byte_budget_caps_peak_in_flight_bytes():
+    item = b"x" * 1000
+
+    def produce():
+        for _ in range(50):
+            yield item
+
+    pf = PrefetchIterator(produce, depth=64, max_bytes=3000,
+                          nbytes=len)
+    got = 0
+    for chunk in pf:
+        got += 1
+        time.sleep(0.001)  # slow consumer: the producer runs ahead
+    assert got == 50
+    # the queue never held more than the byte budget
+    assert pf._bytes_peak <= 3000
+    pf.close()
+
+
+def test_oversized_item_admitted_alone():
+    """A single item larger than the whole budget must still flow
+    (progress guarantee) — admitted only into an empty queue."""
+    big = b"y" * 10_000
+    pf = PrefetchIterator(lambda: iter([big, big, big]), depth=8,
+                          max_bytes=100, nbytes=len)
+    try:
+        assert [len(x) for x in pf] == [10_000] * 3
+        assert pf._depth_peak == 1  # never two oversized items queued
+    finally:
+        pf.close()
+
+
+def test_producer_exception_propagates_original_object():
+    err = DataCorruption("seeded corruption on producer thread")
+
+    def produce():
+        yield 1
+        yield 2
+        raise err
+
+    pf = PrefetchIterator(produce, depth=2)
+    got = []
+    with pytest.raises(DataCorruption) as ei:
+        for x in pf:
+            got.append(x)
+    # items produced before the failure drain first, THEN the original
+    # exception object (type intact for retry isinstance checks)
+    assert got == [1, 2]
+    assert ei.value is err
+    pf.close()
+
+
+def test_fetch_failed_keeps_type_across_threads():
+    from spark_rapids_tpu.parallel.transport import FetchFailed
+
+    def produce():
+        yield 0
+        raise FetchFailed("10.0.0.1:99", 7, 3, OSError("boom"))
+
+    pf = PrefetchIterator(produce)
+    with pytest.raises(FetchFailed) as ei:
+        list(pf)
+    assert ei.value.endpoint == "10.0.0.1:99"
+    assert ei.value.shuffle_id == 7 and ei.value.reduce_id == 3
+    assert isinstance(ei.value, ConnectionError)  # retry classification
+    pf.close()
+
+
+def test_close_stops_producer_and_discards_with_callback():
+    discarded = []
+    done = threading.Event()
+
+    def produce():
+        try:
+            for i in range(10_000):
+                yield i
+        finally:
+            done.set()
+
+    pf = PrefetchIterator(produce, depth=4,
+                          on_discard=discarded.append)
+    assert next(pf) == 0
+    pf.close()
+    assert done.wait(5.0), "producer generator was not torn down"
+    assert discarded, "queued items were not discarded through on_discard"
+    assert not [t for t in _prefetch_threads() if t.is_alive()]
+
+
+def test_clean_shutdown_leaks_no_threads():
+    before = {t for t in threading.enumerate()}
+    for _ in range(5):
+        pf = PrefetchIterator(lambda: iter(range(100)), depth=3)
+        assert len(list(pf)) == 100
+        pf.close()
+    # also an abandoned (never-drained) iterator
+    pf = PrefetchIterator(lambda: iter(range(100)), depth=3)
+    next(pf)
+    pf.close()
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and [
+            t for t in _prefetch_threads() if t.is_alive()]:
+        time.sleep(0.01)
+    leaked = [t for t in set(threading.enumerate()) - before
+              if t.name.startswith("srt-prefetch") and t.is_alive()]
+    assert not leaked, f"leaked prefetch threads: {leaked}"
+
+
+def test_wait_metric_counts_only_blocking():
+    from spark_rapids_tpu.exec.base import Metric
+    wait = Metric("prefetchWaitTime", unit="ns")
+
+    def slow():
+        for i in range(3):
+            time.sleep(0.02)
+            yield i
+
+    pf = PrefetchIterator(slow, depth=2, wait_metric=wait)
+    assert list(pf) == [0, 1, 2]
+    pf.close()
+    assert wait.value > 0  # consumer had to block on the slow producer
+
+
+# ---------------------------------------------------------------------------
+# planner pass
+# ---------------------------------------------------------------------------
+
+def _write_table(session, tmp_path, n=2000):
+    rng = np.random.default_rng(11)
+    path = os.path.join(str(tmp_path), "t")
+    session.create_dataframe({
+        "k": rng.integers(0, 25, n).tolist(),
+        "v": rng.uniform(0, 9, n).tolist(),
+    }).write.parquet(path)
+    return path
+
+
+def _tree_types(root):
+    out = [type(root).__name__]
+    for c in getattr(root, "children", []):
+        out.extend(_tree_types(c))
+    return out
+
+
+def test_planner_inserts_prefetch_above_scan(tmp_path):
+    session = TpuSession(SrtConf({"srt.shuffle.partitions": 2}))
+    path = _write_table(session, tmp_path)
+    from spark_rapids_tpu.expr import col
+    from spark_rapids_tpu.expr.aggregates import Sum
+    from spark_rapids_tpu.expr.core import Alias
+    df = session.read.parquet(path).group_by("k") \
+        .agg(Alias(Sum(col("v")), "s"))
+    root = overrides.apply_overrides(df.plan, session.conf)
+    assert "PrefetchExec" in _tree_types(root)
+    # exchanges carry the planner's safety tag rather than a wrapper
+    from spark_rapids_tpu.exec.exchange import ShuffleExchangeExec
+
+    def find(n, cls):
+        hits = [n] if isinstance(n, cls) else []
+        for c in getattr(n, "children", []):
+            hits.extend(find(c, cls))
+        return hits
+    for ex in find(root, ShuffleExchangeExec):
+        assert getattr(ex, "_pipeline_ok", False)
+
+
+def test_planner_withholds_pipeline_for_context_exprs(tmp_path):
+    session = TpuSession(SrtConf({"srt.shuffle.partitions": 2}))
+    path = _write_table(session, tmp_path)
+    from spark_rapids_tpu.expr.misc import (input_file_name,
+                                            spark_partition_id)
+    df = session.read.parquet(path).with_column("f", input_file_name())
+    root = overrides.apply_overrides(df.plan, session.conf)
+    assert "PrefetchExec" not in _tree_types(root)
+    df2 = session.read.parquet(path).with_column("p", spark_partition_id())
+    root2 = overrides.apply_overrides(df2.plan, session.conf)
+    assert "PrefetchExec" not in _tree_types(root2)
+
+
+def test_planner_respects_conf_off(tmp_path):
+    session = TpuSession(SrtConf({"srt.exec.pipeline.enabled": "false"}))
+    path = _write_table(session, tmp_path)
+    df = session.read.parquet(path)
+    root = overrides.apply_overrides(df.plan, session.conf)
+    assert "PrefetchExec" not in _tree_types(root)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: faults on producer threads, parity, thread hygiene
+# ---------------------------------------------------------------------------
+
+def test_producer_thread_fault_surfaces_at_collect(tmp_path):
+    """An armed corrupt-file fault fires on the PREFETCH PRODUCER
+    thread (the scan runs there) and must surface as DataCorruption on
+    the consuming thread at collect() — not hang, not vanish."""
+    session = TpuSession(SrtConf({"srt.shuffle.partitions": 2}))
+    path = _write_table(session, tmp_path)
+    df = session.read.parquet(path).group_by("k").count()
+    arm_fault_plan("seed=5|scan.file:corrupt@1")
+    with pytest.raises(DataCorruption):
+        df.collect()
+    disarm_fault_plan()
+    # and the engine recovers cleanly for the next (unfaulted) run
+    assert len(TpuSession(SrtConf({"srt.shuffle.partitions": 2}))
+               .read.parquet(path).group_by("k").count().collect()) == 25
+
+
+def test_pipeline_on_off_bit_identical_nds(tmp_path):
+    """NDS sample query: pipelined and synchronous execution must
+    produce bit-identical results (same rows, same order)."""
+    from spark_rapids_tpu.datagen import generate_table
+    from spark_rapids_tpu.models.nds import NDS_QUERIES, nds_specs
+
+    def run(pipelined):
+        session = TpuSession(SrtConf({
+            "srt.shuffle.partitions": 2,
+            "srt.exec.pipeline.enabled": "true" if pipelined else "false",
+        }))
+        data_dir = os.path.join(str(tmp_path), "nds")
+        needed = {"store_sales", "date_dim", "item"}
+        for spec in nds_specs(3_000):
+            if spec.name not in needed:
+                continue
+            out = os.path.join(data_dir, spec.name)
+            if not os.path.exists(out):
+                generate_table(session, spec, out, chunk_rows=1 << 16)
+            session.create_or_replace_temp_view(
+                spec.name, session.read.parquet(out))
+        return session.sql(NDS_QUERIES["q3"]).collect()
+
+    assert run(pipelined=True) == run(pipelined=False)
+
+
+def test_no_thread_leak_after_query(tmp_path):
+    session = TpuSession(SrtConf({"srt.shuffle.partitions": 2}))
+    path = _write_table(session, tmp_path)
+    df = session.read.parquet(path).group_by("k").count().sort("k")
+    assert len(df.collect()) == 25
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and [
+            t for t in _prefetch_threads() if t.is_alive()]:
+        time.sleep(0.01)
+    assert not [t for t in _prefetch_threads() if t.is_alive()]
+
+
+def test_limit_abandons_pipeline_without_leak(tmp_path):
+    """A consumer that stops early (limit) abandons live prefetchers;
+    their producers must be shut down, not leaked."""
+    session = TpuSession(SrtConf({"srt.shuffle.partitions": 2}))
+    path = _write_table(session, tmp_path, n=5000)
+    rows = session.read.parquet(path).limit(7).collect()
+    assert len(rows) == 7
+    import gc
+    gc.collect()  # abandoned generators close via GC finalization
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and [
+            t for t in _prefetch_threads() if t.is_alive()]:
+        time.sleep(0.01)
+    assert not [t for t in _prefetch_threads() if t.is_alive()]
+
+
+# ---------------------------------------------------------------------------
+# satellites: shared fetch pool, coalesce fast path
+# ---------------------------------------------------------------------------
+
+def test_fetch_pool_reused_across_reduces():
+    """The process-wide fetch pool replaces per-endpoint thread churn:
+    repeated multi-peer fetches must reuse the same srt-fetch workers,
+    never spawn new ones."""
+    from spark_rapids_tpu.columnar import dtypes as dt
+    from spark_rapids_tpu.columnar.vector import batch_from_pydict
+    from spark_rapids_tpu.parallel.serializer import serialize_batch
+    from spark_rapids_tpu.parallel.shuffle_manager import ShuffleManager
+    from spark_rapids_tpu.parallel.transport import (ShuffleBlockServer,
+                                                     fetch_all_partitions,
+                                                     fetch_pool)
+
+    def mgr_with_blocks():
+        mgr = ShuffleManager(SrtConf({}))
+        for m in range(3):
+            for r in range(2):
+                b = batch_from_pydict({"i": list(range(32))},
+                                      schema=[("i", dt.INT64)])
+                mgr.host_store.put((9, m, r), serialize_batch(b))
+        return mgr
+
+    servers = [ShuffleBlockServer(mgr_with_blocks()) for _ in range(2)]
+    try:
+        pool = fetch_pool()
+        n_threads = len([t for t in threading.enumerate()
+                         if t.name.startswith("srt-fetch")])
+        assert n_threads == pool.size
+        for _ in range(3):
+            for r in range(2):
+                got = list(fetch_all_partitions(
+                    [s.endpoint for s in servers], 9, r,
+                    max_concurrent=2))
+                assert len(got) == 2 * 3  # 2 peers x 3 maps
+        after = len([t for t in threading.enumerate()
+                     if t.name.startswith("srt-fetch")])
+        assert after == n_threads, "fetch pool spawned extra threads"
+    finally:
+        for s in servers:
+            s.close()
+
+
+def test_fetch_fails_fast_on_dead_peer():
+    """A dead endpoint must abort the fetch on FIRST error — not after
+    every live peer drains (the old deferred-error behavior)."""
+    from spark_rapids_tpu.parallel.transport import fetch_all_partitions
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead = "127.0.0.1:%d" % s.getsockname()[1]
+    s.close()
+    t0 = time.monotonic()
+    with pytest.raises(OSError):
+        list(fetch_all_partitions([dead, dead, dead], 7, 0,
+                                  max_concurrent=3))
+    assert time.monotonic() - t0 < 30.0
+
+
+def test_coalesce_fast_path_passes_full_batch_through():
+    from spark_rapids_tpu.columnar import dtypes as dt
+    from spark_rapids_tpu.columnar.vector import batch_from_pydict
+    from spark_rapids_tpu.exec.base import ExecContext, TpuExec
+    from spark_rapids_tpu.exec.basic import CoalesceBatchesExec
+
+    schema = [("a", dt.INT64)]
+    big = batch_from_pydict({"a": list(range(512))}, schema=schema)
+    small1 = batch_from_pydict({"a": list(range(10))}, schema=schema)
+    small2 = batch_from_pydict({"a": list(range(10, 20))}, schema=schema)
+
+    class Src(TpuExec):
+        @property
+        def output_schema(self):
+            return schema
+
+        def do_execute(self, ctx):
+            yield small1
+            yield small2
+            yield big
+
+    node = CoalesceBatchesExec(Src(), target_rows=256)
+    ctx = ExecContext(SrtConf({}))
+    out = list(node.do_execute(ctx))
+    # smalls coalesce into one batch; the already-full batch is passed
+    # through as the SAME object (no concat / spill round-trip)
+    assert len(out) == 2
+    assert out[1] is big
+    assert "coalesceWaitTime" in ctx.metrics_for(node.exec_id)

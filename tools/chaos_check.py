@@ -130,27 +130,38 @@ def _new_fault_events(events_dir, offsets):
     return out
 
 
-def _check_fault_events(name, spec, fired):
+def _unfired_deterministic(spec, fired):
+    """Deterministic clauses (@nth, or %prob >= 1.0) of ``spec`` with
+    no matching (site, kind) FaultInjected event yet. Probabilistic
+    clauses may legitimately never fire and are never reported."""
+    from spark_rapids_tpu.robustness.faults import FaultPlan
+    logged = {(e.get("site"), e.get("kind")) for e in fired}
+    return [sp for sp in FaultPlan.parse(spec).specs
+            if (sp.nth is not None or sp.prob >= 1.0)
+            and (sp.site, sp.kind) not in logged]
+
+
+def _check_fault_events(name, spec, fired, prev_armed=()):
     """Every injected fault must be visible in the event log: each
     DETERMINISTIC clause (@nth, or %prob >= 1.0 — probabilistic
     clauses may legitimately never fire) needs a matching (site, kind)
     FaultInjected event, and every logged event must come from one of
-    the plan's clauses. Returns failure count."""
+    the plan's clauses (``prev_armed`` tolerates late fires from the
+    PREVIOUS plan's async sites — the worker heartbeat loop keeps
+    hitting an armed plan after its job returns). Returns failure
+    count."""
     from spark_rapids_tpu.robustness.faults import FaultPlan
     plan = FaultPlan.parse(spec)
     failures = 0
     logged = {(e.get("site"), e.get("kind")) for e in fired}
+    for sp in _unfired_deterministic(spec, fired):
+        print(f"[chaos] FAIL [{name}]: injected fault "
+              f"{sp.site}:{sp.kind} produced no FaultInjected "
+              f"event (logged: {sorted(logged)})",
+              file=sys.stderr, flush=True)
+        failures += 1
     armed = {(sp.site, sp.kind) for sp in plan.specs}
-    for sp in plan.specs:
-        if sp.nth is None and sp.prob < 1.0:
-            continue  # probabilistic: firing is not guaranteed
-        if (sp.site, sp.kind) not in logged:
-            print(f"[chaos] FAIL [{name}]: injected fault "
-                  f"{sp.site}:{sp.kind} produced no FaultInjected "
-                  f"event (logged: {sorted(logged)})",
-                  file=sys.stderr, flush=True)
-            failures += 1
-    stray = logged - armed
+    stray = logged - armed - set(prev_armed)
     if stray:
         print(f"[chaos] FAIL [{name}]: FaultInjected events from "
               f"un-armed clauses: {sorted(stray)}",
@@ -234,13 +245,42 @@ def main() -> int:
         failures = 0
         events_dir = os.path.join(tmp, "events")
         event_offsets: dict = {}
+        # pipelining matrix: every plan runs with background prefetch
+        # producers enabled (faults now fire on producer threads and
+        # must still recover); the full sweep adds a synchronous leg so
+        # the pipeline-off path stays covered. The crash plan runs one
+        # leg only — it permanently costs a worker, and a rerun would
+        # arm a crash for an already-evicted worker id (an unwinnable
+        # plan, not a recovery bug).
+        legs = ([("on", "true")] if args.quick
+                else [("on", "true"), ("off", "false")])
+
+        def _reseed(spec, offset):
+            # each leg must be a fresh experiment: workers keep their
+            # fault counters when re-armed with an identically-worded
+            # plan (arm_from_conf preserves counters across stage
+            # retries within a job), so a second leg reusing the spec
+            # verbatim would find its @1 clauses already consumed.
+            # Re-seeding yields a distinct spec string -> fresh arm.
+            head, rest = spec.split("|", 1)
+            return f"seed={int(head[len('seed='):]) + offset}|{rest}"
+
+        runs = []
+        for name, spec in plans:
+            plan_legs = legs[:1] if (name, spec) == CRASH_PLAN else legs
+            for i, (leg_label, leg) in enumerate(plan_legs):
+                leg_spec = spec if i == 0 else _reseed(spec, 1000 * i)
+                runs.append((f"{name} | pipeline={leg_label}",
+                             leg_spec, leg))
         try:
             driver.wait_for_workers(timeout=120)
-            for name, spec in plans:
+            prev_armed: set = set()
+            for name, spec, pipelined in runs:
                 job_conf = {"srt.shuffle.partitions": 4,
                             "srt.cluster.barrierTimeoutSec": 60,
                             "srt.eventLog.enabled": "true",
                             "srt.eventLog.dir": events_dir,
+                            "srt.exec.pipeline.enabled": pipelined,
                             "srt.test.faultPlan": spec}
                 t = time.monotonic()
                 try:
@@ -260,9 +300,23 @@ def main() -> int:
                       flush=True)
                 if not ok:
                     failures += 1
-                # every injected fault must show in the event log
+                # every injected fault must show in the event log.
+                # Async sites (the worker heartbeat loop) fire on their
+                # own cadence, not the job's: a fast job can return
+                # before a single beat hit the armed plan, so poll a
+                # few beat intervals before declaring a clause unfired
                 fired = _new_fault_events(events_dir, event_offsets)
-                failures += _check_fault_events(name, spec, fired)
+                grace = time.monotonic() + 3.0
+                while _unfired_deterministic(spec, fired) \
+                        and time.monotonic() < grace:
+                    time.sleep(0.3)
+                    fired += _new_fault_events(events_dir,
+                                               event_offsets)
+                failures += _check_fault_events(name, spec, fired,
+                                                prev_armed)
+                from spark_rapids_tpu.robustness.faults import FaultPlan
+                prev_armed = {(sp.site, sp.kind)
+                              for sp in FaultPlan.parse(spec).specs}
         finally:
             driver.shutdown()
             for p in procs:

@@ -81,23 +81,37 @@ def build_queries(records: List[dict]) -> List[dict]:
 def analyze(q: dict) -> dict:
     """Per-query analysis: op-time table, shuffle/spill/fault totals,
     critical-path estimate."""
+    def _val(metrics, name):
+        rec = metrics.get(name, {})
+        return rec.get("value", 0) if isinstance(rec, dict) else 0
+
     ops = []
     total_op_ns = 0
+    total_busy_ns = 0
+    prefetch = {"wait_ns": 0, "depth_peak": 0, "bytes_peak": 0}
     for exec_id, metrics in q["metrics"].items():
-        rec = metrics.get("opTime", {})
-        op_ns = rec.get("value", 0) if isinstance(rec, dict) else 0
+        op_ns = _val(metrics, "opTime")
         total_op_ns += op_ns
-        rows = metrics.get("numOutputRows", {})
-        batches = metrics.get("numOutputBatches", {})
-        shuf = metrics.get("shuffleBytesWritten", {})
+        # pipelined edges (exec/pipeline.py): prefetchWaitTime is the
+        # slice of this operator's exclusive opTime spent blocked on an
+        # empty prefetch queue — waiting, not compute; producer-side
+        # operators meanwhile accrue opTime on their own threads, so
+        # summed busy can legitimately exceed wall (overlap)
+        pf_wait = _val(metrics, "prefetchWaitTime")
+        total_busy_ns += max(op_ns - pf_wait, 0)
+        prefetch["wait_ns"] += pf_wait
+        prefetch["depth_peak"] = max(prefetch["depth_peak"],
+                                     _val(metrics, "prefetchQueueDepthPeak"))
+        prefetch["bytes_peak"] = max(prefetch["bytes_peak"],
+                                     _val(metrics, "prefetchBytesPeak"))
         ops.append({
             "exec_id": exec_id,
             "op_time_ns": op_ns,
-            "rows": rows.get("value", 0) if isinstance(rows, dict) else 0,
-            "batches": batches.get("value", 0)
-                       if isinstance(batches, dict) else 0,
-            "shuffle_bytes": shuf.get("value", 0)
-                             if isinstance(shuf, dict) else 0,
+            "prefetch_wait_ns": pf_wait,
+            "coalesce_wait_ns": _val(metrics, "coalesceWaitTime"),
+            "rows": _val(metrics, "numOutputRows"),
+            "batches": _val(metrics, "numOutputBatches"),
+            "shuffle_bytes": _val(metrics, "shuffleBytesWritten"),
         })
     ops.sort(key=lambda o: -o["op_time_ns"])
     wall = q["wall_ns"] or 0
@@ -121,13 +135,18 @@ def analyze(q: dict) -> dict:
         "status": q["status"],
         "wall_ns": wall,
         "op_time_ns": total_op_ns,
-        # exclusive op-times are disjoint: their sum is busy time; the
-        # remainder of wall clock is waiting (barriers, I/O, semaphore)
+        # exclusive op-times are disjoint PER THREAD: net of prefetch
+        # wait, their sum is busy time. Busy beyond wall clock is work
+        # pipelined onto producer threads (overlap); the remainder of
+        # wall is waiting (barriers, I/O, semaphore)
         "critical_path": {
-            "busy_ns": total_op_ns,
-            "wait_ns": max(wall - total_op_ns, 0),
-            "busy_fraction": (total_op_ns / wall) if wall else 0.0,
+            "busy_ns": total_busy_ns,
+            "wait_ns": max(wall - total_busy_ns, 0),
+            "overlap_ns": max(total_busy_ns - wall, 0),
+            "busy_fraction": min(total_busy_ns / wall, 1.0)
+                             if wall else 0.0,
         },
+        "prefetch": prefetch,
         "operators": ops,
         "shuffles": shuffles,
         "spill": {
@@ -167,7 +186,14 @@ def render(rep: dict) -> str:
                  f"wall={_fmt_ns(rep['wall_ns'])} ===")
     lines.append(f"critical path: busy={_fmt_ns(cp['busy_ns'])} "
                  f"({100 * cp['busy_fraction']:.0f}% of wall), "
-                 f"wait={_fmt_ns(cp['wait_ns'])}")
+                 f"wait={_fmt_ns(cp['wait_ns'])}"
+                 + (f", pipelined overlap={_fmt_ns(cp['overlap_ns'])}"
+                    if cp.get("overlap_ns") else ""))
+    pf = rep.get("prefetch", {})
+    if pf.get("wait_ns") or pf.get("depth_peak"):
+        lines.append(f"  prefetch: wait={_fmt_ns(pf['wait_ns'])} "
+                     f"queueDepthPeak={pf['depth_peak']} "
+                     f"bytesPeak={_fmt_bytes(pf['bytes_peak'])}")
     if rep["operators"]:
         lines.append("  operator op-time breakdown:")
         w = max(len(o["exec_id"]) for o in rep["operators"])
