@@ -98,6 +98,35 @@ def emit(final: bool = False) -> None:
     print(json.dumps(RESULT), flush=True)
 
 
+def embed_metrics() -> None:
+    """Fold a COMPACT registry snapshot into the bench record itself
+    (RESULT["metrics"]): lifetime counters, histogram quantiles
+    (task time, shuffle block size, fetch latency, batch shapes), and
+    per-query spill/retry counts — so every BENCH_*.json carries its
+    own profile, not just wall clocks."""
+    try:
+        from spark_rapids_tpu.obs.registry import registry
+        reg = registry()
+        snap = reg.snapshot()
+        per_query = [{"query_id": q.get("query_id"),
+                      "status": q.get("status"),
+                      "wall_ns": q.get("wall_ns"),
+                      "op_time_ns": q.get("totals", {}).get("opTimeNs"),
+                      "rows": q.get("totals", {}).get("numOutputRows"),
+                      "shuffle_bytes": q.get("totals", {})
+                                        .get("shuffleBytesWritten"),
+                      "spilled_bytes": q.get("spilled_bytes", 0),
+                      "oom_retries": q.get("oom_retries", 0)}
+                     for q in snap.get("queries", [])]
+        RESULT["metrics"] = {
+            "counters": snap.get("counters", {}),
+            "histograms": snap.get("histograms", {}),
+            "queries": per_query,
+        }
+    except Exception as e:  # never let observability kill the bench
+        log(f"metrics embed failed: {e}")
+
+
 def dump_metrics_snapshot() -> None:
     """SRT_BENCH_METRICS=<path> writes the in-process metrics-registry
     snapshot (per-query summaries + lifetime counters, see
@@ -669,6 +698,7 @@ def main():
         except Exception as e:  # breadth stage must never kill the bench
             log(f"nds power run failed: {e}")
 
+    embed_metrics()
     dump_metrics_snapshot()
     emit(final=True)
 

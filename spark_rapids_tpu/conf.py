@@ -125,6 +125,10 @@ def _positive(v) -> Optional[str]:
     return None if v > 0 else "must be positive"
 
 
+def _non_negative(v) -> Optional[str]:
+    return None if v >= 0 else "must be non-negative"
+
+
 def _fraction(v) -> Optional[str]:
     return None if 0.0 < v <= 1.0 else "must be in (0, 1]"
 
@@ -360,8 +364,30 @@ TRACE_ENABLED = conf("srt.eventLog.trace.enabled") \
          "trace-<query_id>.json next to the event log. Requires "
          "srt.eventLog.enabled for the file to land; spans add one "
          "object per operator pull, so leave off for benchmarking "
-         "(NvtxWithMetrics.scala role).") \
+         "(NvtxWithMetrics.scala role). On a cluster the driver ships "
+         "its trace context with each job so worker spans parent "
+         "under the driver's; tools/history_report.py clock-aligns "
+         "and merges the per-process trace-*.json files.") \
     .boolean(False)
+
+EVENT_LOG_MAX_BYTES = conf("srt.eventLog.maxBytes") \
+    .doc("Rotate events-<pid>.jsonl when it exceeds this many bytes: "
+         "the live file rolls to .1 (and .1 to .2, which is dropped "
+         "on the next roll), bounding a long-running process to about "
+         "three segments of this size. 0 disables rotation. Readers "
+         "(tools/profile_report.py, tools/history_report.py) stitch "
+         "rolled segments back in order (spark.eventLog.rolling role).") \
+    .check(_non_negative).bytes_(0)
+
+RESOURCE_SAMPLE_INTERVAL_MS = conf("srt.obs.resource.intervalMs") \
+    .doc("Period of the background resource sampler, which records "
+         "ResourceSample events (RSS, device memory in use, spill-pool "
+         "occupancy, fetch-pool queue depth, prefetch buffer bytes) to "
+         "the event log so stalls can be correlated with memory "
+         "pressure. Requires srt.eventLog.enabled. 0 (default) "
+         "disables sampling: no thread is started and the hot path "
+         "stays a module-global None check.") \
+    .check(_non_negative).integer(0)
 
 CPU_ORACLE_STRICT = conf("srt.test.cpuOracle.strict") \
     .doc("Test-only: fail instead of falling back when an operator cannot "

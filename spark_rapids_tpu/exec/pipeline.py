@@ -53,6 +53,7 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 from collections import deque
 from typing import Callable, Iterable, Iterator, Optional
 
@@ -61,7 +62,20 @@ from ..conf import (PIPELINE_DEPTH, PIPELINE_ENABLED, PIPELINE_MAX_BYTES,
 from .base import ExecContext, Metric, Schema, TpuExec
 
 __all__ = ["PrefetchIterator", "PrefetchExec", "prefetch_batches",
-           "pipeline_enabled"]
+           "pipeline_enabled", "prefetch_buffer_bytes"]
+
+# Live iterators, for the resource sampler's prefetch-occupancy gauge.
+# Weak so an abandoned iterator never outlives its consumer.
+_LIVE: "weakref.WeakSet[PrefetchIterator]" = weakref.WeakSet()
+_LIVE_LOCK = threading.Lock()
+
+
+def prefetch_buffer_bytes() -> int:
+    """Total bytes queued across all live prefetchers in this process
+    (obs/resource.py sampler probe; racy reads are fine for a gauge)."""
+    with _LIVE_LOCK:
+        its = list(_LIVE)
+    return sum(it._bytes for it in its)
 
 
 class PrefetchIterator:
@@ -89,7 +103,9 @@ class PrefetchIterator:
                  name: str = "prefetch",
                  wait_metric: Optional[Metric] = None,
                  depth_peak_metric: Optional[Metric] = None,
-                 bytes_peak_metric: Optional[Metric] = None):
+                 bytes_peak_metric: Optional[Metric] = None,
+                 tracer=None,
+                 parent_span_id: Optional[int] = None):
         self._factory = source_factory
         self._depth = max(int(depth), 1)
         self._max_bytes = max(int(max_bytes), 0)
@@ -100,6 +116,14 @@ class PrefetchIterator:
         self._wait_metric = wait_metric
         self._depth_peak_metric = depth_peak_metric
         self._bytes_peak_metric = bytes_peak_metric
+        self._name = name
+        # span parenting across the thread boundary: the producer
+        # thread's tracer stack starts empty, so without an explicit
+        # parent captured at construction (on the CONSUMER thread,
+        # where the enclosing operator span is live) every
+        # producer-side span would orphan
+        self._tracer = tracer
+        self._parent_span_id = parent_span_id
         self._cv = threading.Condition()
         self._buf: deque = deque()  # (item, nbytes)
         self._bytes = 0
@@ -111,6 +135,8 @@ class PrefetchIterator:
         self._closed = False
         self._thread = threading.Thread(
             target=self._run, name=f"srt-prefetch-{name}", daemon=True)
+        with _LIVE_LOCK:
+            _LIVE.add(self)
         self._thread.start()
 
     # --- producer side ---------------------------------------------------
@@ -120,8 +146,17 @@ class PrefetchIterator:
             set_active_conf(self._conf)
         scope = (faults.op_scope(self._fault_tag)
                  if self._fault_tag and faults.armed() else None)
+        # scoped producer span: pushed onto THIS thread's tracer stack,
+        # so operator spans opened by the source (SelfTimer falls back
+        # to tracer.current_id()) parent here instead of orphaning
+        span_scope = (self._tracer.span(f"prefetch-{self._name}",
+                                        kind="producer",
+                                        parent=self._parent_span_id)
+                      if self._tracer is not None else None)
         src = None
         try:
+            if span_scope is not None:
+                span_scope.__enter__()
             if scope is not None:
                 scope.__enter__()
             try:
@@ -133,6 +168,8 @@ class PrefetchIterator:
             finally:
                 if scope is not None:
                     scope.__exit__(None, None, None)
+                if span_scope is not None:
+                    span_scope.__exit__(None, None, None)
         except BaseException as e:  # noqa: BLE001 — relayed to consumer
             with self._cv:
                 self._error = e
@@ -281,6 +318,19 @@ def prefetch_batches(ctx: ExecContext, node: TpuExec,
         for batch in source_factory():
             yield SpillableBatch(batch, SpillPriority.ACTIVE_ON_DECK)
 
+    # capture the enclosing operator span NOW, on the consumer thread:
+    # the nearest timed frame with a live span, else the thread's open
+    # scope (query/task span) — the producer thread can't see either
+    parent_span_id = None
+    if ctx.tracer is not None:
+        for frame in reversed(ctx.timer_stack):
+            sp = getattr(frame, "_span", None)
+            if sp is not None:
+                parent_span_id = sp.span_id
+                break
+        if parent_span_id is None:
+            parent_span_id = ctx.tracer.current_id()
+
     pf = PrefetchIterator(
         staged,
         depth=ctx.conf.get(PIPELINE_DEPTH),
@@ -292,7 +342,9 @@ def prefetch_batches(ctx: ExecContext, node: TpuExec,
         name=name or node.exec_id,
         wait_metric=wait,
         depth_peak_metric=dpk,
-        bytes_peak_metric=bpk)
+        bytes_peak_metric=bpk,
+        tracer=ctx.tracer,
+        parent_span_id=parent_span_id)
 
     def consume() -> Iterator:
         try:

@@ -17,7 +17,12 @@ TPU rebuild's counterpart, split the same way:
   framework, retry framework, and fault harness.
 - :mod:`.registry` — aggregation of the per-operator ``Metric``
   accumulators into per-query summaries, gated by ``srt.metrics.level``
-  (ESSENTIAL/MODERATE/DEBUG), plus a Prometheus-style text snapshot.
+  (ESSENTIAL/MODERATE/DEBUG), plus bounded log-bucketed histograms
+  (task time, shuffle block size, fetch latency...) and a
+  Prometheus-style text snapshot with p50/p90/p99.
+- :mod:`.resource` — an optional background sampler
+  (``srt.obs.resource.intervalMs``) recording RSS, device memory,
+  spill/fetch/prefetch occupancy as periodic ResourceSample events.
 
 Design contract (same discipline as the unarmed ``fault_point`` sites):
 **zero overhead when disabled.** Every hook threaded through the hot
@@ -27,4 +32,4 @@ no per-batch work happens. ``tools/profile_report.py`` turns an event
 log back into a per-query report offline.
 """
 
-from . import events, registry, trace  # noqa: F401
+from . import events, registry, resource, trace  # noqa: F401

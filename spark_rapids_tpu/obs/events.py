@@ -45,14 +45,37 @@ EVENT_TYPES = (
 class EventLogWriter:
     """Append-only JSONL sink. Thread-safe, flush-per-line, and
     silent on I/O failure — an event log must never take the query
-    down with it."""
+    down with it.
 
-    def __init__(self, log_dir: str):
+    With ``max_bytes > 0`` (``srt.eventLog.maxBytes``) the file
+    rotates once it exceeds the cap: the live file rolls to ``.1``,
+    a previous ``.1`` to ``.2``, and an old ``.2`` is dropped —
+    bounding a long-running/serving process to roughly three segments.
+    Readers (``iter_log_files``) stitch ``.2``, ``.1``, live back in
+    write order."""
+
+    def __init__(self, log_dir: str, max_bytes: int = 0):
         self.log_dir = log_dir
+        self.max_bytes = int(max_bytes or 0)
         self.path = os.path.join(log_dir, f"events-{os.getpid()}.jsonl")
         self._lock = threading.Lock()
         self._file = None
+        self._size = 0
         self._broken = False
+
+    def _rollover_locked(self) -> None:
+        try:
+            self._file.close()
+        except OSError:
+            pass
+        self._file = None
+        self._size = 0
+        try:
+            if os.path.exists(self.path + ".1"):
+                os.replace(self.path + ".1", self.path + ".2")
+            os.replace(self.path, self.path + ".1")
+        except OSError:
+            pass  # keep appending to the oversized live file
 
     def emit(self, event: str, **fields: Any) -> None:
         rec: Dict[str, Any] = {"event": event, "ts": time.time(),
@@ -69,8 +92,12 @@ class EventLogWriter:
                 if self._file is None:
                     os.makedirs(self.log_dir, exist_ok=True)
                     self._file = open(self.path, "a")
+                    self._size = self._file.tell()
                 self._file.write(line + "\n")
                 self._file.flush()
+                self._size += len(line) + 1
+                if self.max_bytes and self._size > self.max_bytes:
+                    self._rollover_locked()
             except OSError:
                 self._broken = True
 
@@ -119,18 +146,21 @@ def configure_from_conf(conf) -> None:
     ``set_active_conf`` — the same hand-off pattern as
     ``faults.arm_from_conf``."""
     global _SINK, _CONF_MANAGED
-    from ..conf import EVENT_LOG_DIR, EVENT_LOG_ENABLED
+    from ..conf import (EVENT_LOG_DIR, EVENT_LOG_ENABLED,
+                        EVENT_LOG_MAX_BYTES)
     try:
         on = bool(conf.get(EVENT_LOG_ENABLED))
         log_dir = conf.get(EVENT_LOG_DIR) or ""
+        max_bytes = int(conf.get(EVENT_LOG_MAX_BYTES) or 0)
     except Exception:
         return
     if on:
         log_dir = log_dir or os.path.join(".", "srt-events")
-        if _SINK is not None and _SINK.log_dir == log_dir:
+        if (_SINK is not None and _SINK.log_dir == log_dir
+                and _SINK.max_bytes == max_bytes):
             return  # already pointed at the right place
         old = _SINK
-        _SINK = EventLogWriter(log_dir)
+        _SINK = EventLogWriter(log_dir, max_bytes=max_bytes)
         _CONF_MANAGED = True
         if old is not None:
             old.close()
@@ -166,15 +196,35 @@ def read_events(path: str) -> List[Dict[str, Any]]:
     return out
 
 
+def _with_rolled(path: str) -> Iterator[str]:
+    """Yield a log file's rolled segments oldest-first (``.2``, ``.1``)
+    before the live file itself."""
+    for suffix in (".2", ".1"):
+        if os.path.exists(path + suffix):
+            yield path + suffix
+    if os.path.exists(path):
+        yield path
+
+
 def iter_log_files(path: str) -> Iterator[str]:
     """Yield event-log files under ``path`` (a file, or a dir holding
-    ``events-*.jsonl`` from several processes)."""
+    ``events-*.jsonl`` from several processes), including rotation
+    segments (``.2`` then ``.1`` then live, per process — write
+    order)."""
     if os.path.isdir(path):
-        for name in sorted(os.listdir(path)):
-            if name.startswith("events-") and name.endswith(".jsonl"):
-                yield os.path.join(path, name)
-    elif os.path.exists(path):
-        yield path
+        # key on the BASE name so a process whose live file rolled
+        # away (last emit crossed the cap, or crashed post-rollover)
+        # still gets its .1/.2 segments read
+        bases = set()
+        for name in os.listdir(path):
+            for suffix in (".jsonl", ".jsonl.1", ".jsonl.2"):
+                if name.startswith("events-") and name.endswith(suffix):
+                    bases.add(name[:len(name) - len(suffix)] + ".jsonl")
+                    break
+        for base in sorted(bases):
+            yield from _with_rolled(os.path.join(path, base))
+    else:
+        yield from _with_rolled(path)
 
 
 def read_all_events(path: str) -> List[Dict[str, Any]]:

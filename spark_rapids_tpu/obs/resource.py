@@ -1,0 +1,148 @@
+"""Background resource sampler: periodic ResourceSample events.
+
+A single daemon thread (per process, ``srt.obs.resource.intervalMs``)
+snapshots cheap process-level gauges and emits them to the event log
+so the offline profiler can correlate stalls with memory pressure:
+
+- host RSS (``/proc/self/statm``, no psutil dependency);
+- device memory in use (``jax.local_devices()[0].memory_stats()``,
+  guarded — CPU backends usually return nothing);
+- spill-pool occupancy (``memory/spill.py`` catalog stats — read only
+  if the process already built a catalog, never instantiates one);
+- shuffle fetch-pool queue depth (``parallel/transport.py``);
+- live prefetch buffer bytes (``exec/pipeline.py``).
+
+Zero-overhead contract: with the conf at its default (0) or the event
+log off, :func:`configure_from_conf` is a no-op — no thread starts,
+and nothing in the engine's hot path ever touches this module. The
+sampler holds no references into the engine; every probe is a
+module-global read guarded against absence.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+_PAGE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+
+
+def _rss_bytes() -> int:
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * _PAGE
+    except (OSError, ValueError, IndexError):
+        return 0
+
+
+def _device_bytes_in_use() -> int:
+    try:
+        import jax
+        stats = jax.local_devices()[0].memory_stats()
+        return int((stats or {}).get("bytes_in_use", 0))
+    except Exception:
+        return 0
+
+
+def sample() -> dict:
+    """One snapshot of every probe. Each probe degrades to 0/absent
+    rather than raising — sampling must never hurt the engine."""
+    s = {"rss_bytes": _rss_bytes(),
+         "device_bytes_in_use": _device_bytes_in_use()}
+    try:
+        from ..memory import spill as _spill
+        cat = _spill._CATALOG
+        if cat is not None:
+            s["spill"] = cat.stats()
+    except Exception:
+        pass
+    try:
+        from ..parallel import transport as _transport
+        pool = _transport._POOL
+        if pool is not None:
+            s["fetch_queue_depth"] = pool._q.qsize()
+    except Exception:
+        pass
+    try:
+        from ..exec import pipeline as _pipeline
+        s["prefetch_buffer_bytes"] = _pipeline.prefetch_buffer_bytes()
+    except Exception:
+        pass
+    return s
+
+
+class ResourceSampler:
+    """Daemon sampling thread; emits one ResourceSample event per
+    interval through ``obs.events.emit`` (so samples land in the same
+    per-process JSONL as everything else)."""
+
+    def __init__(self, interval_ms: int):
+        self.interval_s = max(interval_ms, 1) / 1000.0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="srt-resource-sampler", daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+
+    @property
+    def alive(self) -> bool:
+        return self._thread.is_alive()
+
+    def _run(self) -> None:
+        from . import events as _events
+        while not self._stop.wait(self.interval_s):
+            try:
+                _events.emit("ResourceSample", **sample())
+            except Exception:
+                pass  # flight recorder, never fatal
+
+
+# --- module-global sampler (the zero-overhead guard) ---
+_SAMPLER: Optional[ResourceSampler] = None
+_LOCK = threading.Lock()
+
+
+def enabled() -> bool:
+    return _SAMPLER is not None
+
+
+def configure_from_conf(conf) -> None:
+    """Start/stop the process sampler from a live conf — the same
+    hand-off pattern as ``events.configure_from_conf`` (driver session
+    and cluster workers call it after ``set_active_conf``). Starts a
+    thread only when ``srt.obs.resource.intervalMs > 0`` AND the event
+    log is on; otherwise tears down any running sampler."""
+    global _SAMPLER
+    from ..conf import EVENT_LOG_ENABLED, RESOURCE_SAMPLE_INTERVAL_MS
+    try:
+        interval_ms = int(conf.get(RESOURCE_SAMPLE_INTERVAL_MS) or 0)
+        on = interval_ms > 0 and bool(conf.get(EVENT_LOG_ENABLED))
+    except Exception:
+        return
+    with _LOCK:
+        if on:
+            if (_SAMPLER is not None and _SAMPLER.alive
+                    and _SAMPLER.interval_s * 1000.0 == interval_ms):
+                return
+            if _SAMPLER is not None:
+                _SAMPLER.stop()
+            _SAMPLER = ResourceSampler(interval_ms)
+            _SAMPLER.start()
+        elif _SAMPLER is not None:
+            _SAMPLER.stop()
+            _SAMPLER = None
+
+
+def shutdown() -> None:
+    """Stop the sampler if one is running (tests, process exit)."""
+    global _SAMPLER
+    with _LOCK:
+        if _SAMPLER is not None:
+            _SAMPLER.stop()
+            _SAMPLER = None

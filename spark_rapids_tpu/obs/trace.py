@@ -25,7 +25,7 @@ class Span:
     """One finished (or in-flight) span. Timestamps are monotonic
     ``perf_counter_ns`` values, so durations are exact and spans from
     one process share a timeline; wall-clock anchoring lives in the
-    event log, not here."""
+    tracer's anchor pair (exported as trace metadata), not per span."""
 
     __slots__ = ("name", "kind", "span_id", "parent_id", "t0_ns",
                  "t1_ns", "attrs", "tid")
@@ -81,26 +81,66 @@ class Tracer:
     - ``s = tracer.begin(name, kind, parent=...); ...; tracer.end(s)``
       — explicit parentage for callers that already maintain their own
       stack (the exec layer's exclusive-time timer stack).
+
+    Cross-process: the driver ships ``tracer.context()`` with each
+    cluster job; a worker rebuilds a child tracer from it with
+    :meth:`from_context`, so worker spans (a) share the driver's
+    ``trace_id``, (b) default-parent under the driver's job span
+    (``_remote_parent``), and (c) allocate span ids in a
+    pid-namespaced range that cannot collide with other processes.
+    Every tracer stamps a monotonic↔wall-clock anchor pair at
+    construction; :func:`merge_chrome_traces` uses the anchors to
+    clock-align per-process trace files onto one timeline.
     """
 
-    def __init__(self):
+    def __init__(self, trace_id: Optional[str] = None,
+                 remote_parent: Optional[int] = None):
+        self.trace_id = trace_id or os.urandom(8).hex()
+        self._remote_parent = remote_parent
+        # paired clock reads: anchor_unix_s is the wall-clock time at
+        # monotonic instant anchor_mono_ns (per-process alignment key)
+        self.anchor_mono_ns = time.perf_counter_ns()
+        self.anchor_unix_s = time.time()
         self._spans: List[Span] = []
         self._lock = threading.Lock()
+        # span ids are namespaced by pid so ids minted on different
+        # processes of one trace never collide when merged
+        self._id_base = (os.getpid() & 0x3FFFFF) << 32
         self._next_id = 1
         self._tls = threading.local()
+
+    # --- cross-process context ---
+    def context(self, span: Optional[Span] = None) -> dict:
+        """Serializable trace context to ship with a remote job: the
+        given span (or the calling thread's innermost open scope)
+        becomes the remote side's default parent."""
+        sid = span.span_id if span is not None else self.current_id()
+        return {"trace_id": self.trace_id, "span_id": sid,
+                "pid": os.getpid()}
+
+    @classmethod
+    def from_context(cls, ctx: Optional[dict]) -> "Tracer":
+        """Child tracer parented under a remote span context."""
+        if not ctx:
+            return cls()
+        return cls(trace_id=ctx.get("trace_id"),
+                   remote_parent=ctx.get("span_id"))
 
     # --- explicit API ---
     def begin(self, name: str, kind: str = "span",
               parent: Optional[int] = None,
               attrs: Optional[dict] = None) -> Span:
         """Start a span. ``parent=None`` links to the calling thread's
-        innermost open ``span()`` scope (the query span, usually)."""
+        innermost open ``span()`` scope (the query span, usually), or
+        to the remote parent on a worker-side tracer."""
         if parent is None:
             stack = getattr(self._tls, "stack", None)
             if stack:
                 parent = stack[-1].span_id
+            else:
+                parent = self._remote_parent
         with self._lock:
-            sid = self._next_id
+            sid = self._id_base + self._next_id
             self._next_id += 1
         return Span(name, kind, sid, parent, time.perf_counter_ns(),
                     attrs, threading.get_ident())
@@ -168,12 +208,62 @@ class Tracer:
                                   - s.t0_ns / 1e3,
                            "pid": pid, "tid": s.tid, "args": args})
         return json.dumps({"traceEvents": events,
-                           "displayTimeUnit": "ms"})
+                           "displayTimeUnit": "ms",
+                           "metadata": {
+                               "trace_id": self.trace_id,
+                               "pid": pid,
+                               "anchor_mono_ns": self.anchor_mono_ns,
+                               "anchor_unix_s": self.anchor_unix_s,
+                               "remote_parent": self._remote_parent,
+                           }})
 
     def write_chrome_trace(self, path: str) -> str:
         with open(path, "w") as f:
             f.write(self.export_chrome_trace())
         return path
+
+
+def merge_chrome_traces(paths) -> dict:
+    """Clock-align and merge per-process Chrome-trace files into one.
+
+    Each file's events sit on that process's private monotonic
+    timeline; its metadata anchor pair (``anchor_mono_ns`` at wall
+    clock ``anchor_unix_s``) converts them to a shared wall-clock
+    timeline: ``ts_wall_us = ts_us + anchor_unix_s*1e6 -
+    anchor_mono_ns/1e3``. Events keep their originating ``pid`` so the
+    merged view shows one lane per process. Returns the merged
+    catapult object (``traceEvents`` sorted by aligned ts)."""
+    events: List[dict] = []
+    sources: List[dict] = []
+    trace_ids = set()
+    for path in paths:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        meta = doc.get("metadata") or {}
+        if meta.get("trace_id"):
+            trace_ids.add(meta["trace_id"])
+        offset_us = 0.0
+        if "anchor_mono_ns" in meta and "anchor_unix_s" in meta:
+            offset_us = (meta["anchor_unix_s"] * 1e6
+                         - meta["anchor_mono_ns"] / 1e3)
+        sources.append({"path": os.path.basename(str(path)),
+                        "pid": meta.get("pid"),
+                        "offset_us": offset_us,
+                        "trace_id": meta.get("trace_id")})
+        for ev in doc.get("traceEvents", []):
+            ev = dict(ev)
+            if "ts" in ev:
+                ev["ts"] = ev["ts"] + offset_us
+            events.append(ev)
+    events.sort(key=lambda e: e.get("ts", 0.0))
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "metadata": {"trace_id": (sorted(trace_ids)[0]
+                                      if len(trace_ids) == 1 else
+                                      sorted(trace_ids)),
+                         "sources": sources}}
 
 
 def maybe_tracer(conf) -> Optional[Tracer]:

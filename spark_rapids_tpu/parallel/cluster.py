@@ -59,6 +59,7 @@ import struct
 import subprocess
 import sys
 import threading
+import time
 from typing import Dict, List, Optional, Set, Tuple
 
 from ..robustness.faults import FaultDrop, fault_point
@@ -475,9 +476,19 @@ class ClusterWorker:
         # job conf — the driver-side test's spec reaches every worker
         faults.arm_from_conf(conf)
         # same hand-off for the event log: srt.eventLog.* in the job
-        # conf lights up (or tears down) this worker's JSONL sink
+        # conf lights up (or tears down) this worker's JSONL sink,
+        # and srt.obs.resource.intervalMs the resource sampler
         from ..obs import events as _events
+        from ..obs import resource as _resource
         _events.configure_from_conf(conf)
+        _resource.configure_from_conf(conf)
+        # cross-process tracing: rebuild a child tracer from the
+        # driver's shipped context so this worker's task/operator spans
+        # share the driver's trace_id and parent under its job span
+        from ..conf import TRACE_ENABLED
+        from ..obs.trace import Tracer
+        tracer = (Tracer.from_context(msg.get("trace_ctx"))
+                  if conf.get(TRACE_ENABLED) else None)
         attempt = msg.get("attempt", 0)
         logical_ids = msg.get("logical_ids") or [msg["worker_id"]]
         fresh_ids = msg.get("fresh_ids")
@@ -522,26 +533,58 @@ class ClusterWorker:
                   f"{physical.tree_string()}", file=sys.stderr, flush=True)
         ctx = ExecContext(conf)
         ctx.cluster = cluster
+        ctx.tracer = tracer
         # distinct per-worker default so monotonically_increasing_id /
         # spark_partition_id stay unique when no exchange streams reduce
         # partitions (exchanges overwrite this with the global reduce id)
         ctx.partition_id = cluster.worker_id
         rows: List[dict] = []
-        for batch in physical.execute(ctx):
-            if int(batch.num_rows) == 0:
-                continue
-            d = to_pydict(batch_to_table(batch))
-            names = list(d)
-            for i in range(len(d[names[0]]) if names else 0):
-                rows.append({k: d[k][i] for k in names})
+        t0 = time.perf_counter_ns()
+        # the task span opens on THIS thread (the one pulling the
+        # operator chain), so operator spans parent under it through
+        # the tracer's thread-local scope stack
+        task_scope = (tracer.span(
+            f"task-w{cluster.worker_id}-a{attempt}", kind="task",
+            attrs={"worker_id": cluster.worker_id, "attempt": attempt,
+                   "logical_ids": list(cluster.logical_ids),
+                   "job_token": msg.get("job_token")})
+            if tracer is not None else None)
+        if task_scope is not None:
+            task_scope.__enter__()
+        try:
+            for batch in physical.execute(ctx):
+                if int(batch.num_rows) == 0:
+                    continue
+                d = to_pydict(batch_to_table(batch))
+                names = list(d)
+                for i in range(len(d[names[0]]) if names else 0):
+                    rows.append({k: d[k][i] for k in names})
+        finally:
+            if task_scope is not None:
+                task_scope.__exit__(None, None, None)
+            if tracer is not None:
+                log_dir = _events.log_dir()
+                if log_dir:
+                    try:
+                        tracer.write_chrome_trace(os.path.join(
+                            log_dir,
+                            f"trace-{tracer.trace_id}-"
+                            f"w{cluster.worker_id}-a{attempt}-"
+                            f"{os.getpid()}.json"))
+                    except OSError:
+                        pass
+        wall_ns = time.perf_counter_ns() - t0
         if debug:
             print(f"[w{cluster.worker_id}] rows={len(rows)}",
                   file=sys.stderr, flush=True)
         metrics = {eid: {m.name: m.value for m in md.values()}
                    for eid, md in ctx.metrics.items()}
+        from ..obs import registry as _registry
+        _registry.observe("task_time_ns", wall_ns, "ns")
         _events.emit("TaskEnd", worker_id=cluster.worker_id,
                      logical_ids=list(cluster.logical_ids),
-                     attempt=attempt, rows=len(rows), metrics=metrics)
+                     attempt=attempt, rows=len(rows), wall_ns=wall_ns,
+                     job_token=msg.get("job_token"), metrics=metrics)
         return rows, metrics
 
     def _prepare_reuse(self, msg, cluster: ClusterTaskContext,
@@ -811,50 +854,78 @@ class ClusterDriver:
         # themselves from the same conf dict inside _run_job)
         from ..conf import SrtConf
         from ..obs import events as _events
+        from ..obs import resource as _resource
+        from ..obs.trace import maybe_tracer
+        tracer = None
         try:
-            _events.configure_from_conf(SrtConf(dict(conf_settings
-                                                     or {})))
+            dconf = SrtConf(dict(conf_settings or {}))
+            _events.configure_from_conf(dconf)
+            _resource.configure_from_conf(dconf)
+            tracer = maybe_tracer(dconf)
         except Exception:
             pass  # an invalid test conf must not mask the real error
         job_token = os.urandom(8).hex()
-        last: Optional[BaseException] = None
-        retry_spec: Optional[dict] = None
-        for attempt in range(max_retries + 1):
-            try:
-                return self._run_once(logical_plan, conf_settings,
-                                      job_token, attempt, retry_spec)
-            except StageRetryFailed as e:
-                last = e
-                retry_spec = None
-                self.recovery_events.append({"type": "job_retry",
-                                             "cause": str(e)})
-                _events.emit("RetryAttempt", scope="job",
-                             job_token=job_token, attempt=attempt,
-                             cause=str(e))
-                self._recover()
-            except WorkerLost as e:
-                last = e
-                retry_spec = self._plan_stage_retry(job_token)
-                if retry_spec is not None:
-                    _events.emit("RetryAttempt", scope="stage",
-                                 job_token=job_token, attempt=attempt,
-                                 reused_positions=list(
-                                     retry_spec["reusable_positions"]),
-                                 cause=str(e))
-                else:
+        # the driver's job span roots the whole distributed trace; its
+        # context ships with every job message so worker spans parent
+        # under it across the process boundary
+        job_span = (tracer.begin(f"job-{job_token}", kind="job",
+                                 attrs={"job_token": job_token})
+                    if tracer is not None else None)
+        trace_ctx = (tracer.context(job_span)
+                     if tracer is not None else None)
+        try:
+            last: Optional[BaseException] = None
+            retry_spec: Optional[dict] = None
+            for attempt in range(max_retries + 1):
+                try:
+                    return self._run_once(logical_plan, conf_settings,
+                                          job_token, attempt, retry_spec,
+                                          trace_ctx)
+                except StageRetryFailed as e:
+                    last = e
+                    retry_spec = None
                     self.recovery_events.append({"type": "job_retry",
                                                  "cause": str(e)})
                     _events.emit("RetryAttempt", scope="job",
                                  job_token=job_token, attempt=attempt,
                                  cause=str(e))
                     self._recover()
-            if not self._workers:
-                break
-        raise RuntimeError(
-            f"job failed after worker losses: {last}") from last
+                except WorkerLost as e:
+                    last = e
+                    retry_spec = self._plan_stage_retry(job_token)
+                    if retry_spec is not None:
+                        _events.emit("RetryAttempt", scope="stage",
+                                     job_token=job_token, attempt=attempt,
+                                     reused_positions=list(
+                                         retry_spec["reusable_positions"]),
+                                     cause=str(e))
+                    else:
+                        self.recovery_events.append({"type": "job_retry",
+                                                     "cause": str(e)})
+                        _events.emit("RetryAttempt", scope="job",
+                                     job_token=job_token, attempt=attempt,
+                                     cause=str(e))
+                        self._recover()
+                if not self._workers:
+                    break
+            raise RuntimeError(
+                f"job failed after worker losses: {last}") from last
+        finally:
+            if tracer is not None:
+                tracer.end(job_span)
+                log_dir = _events.log_dir()
+                if log_dir:
+                    try:
+                        tracer.write_chrome_trace(os.path.join(
+                            log_dir,
+                            f"trace-{tracer.trace_id}-driver-"
+                            f"{os.getpid()}.json"))
+                    except OSError:
+                        pass
 
     def _run_once(self, logical_plan, conf_settings, job_token: str,
-                  attempt: int, retry_spec: Optional[dict]) -> List[dict]:
+                  attempt: int, retry_spec: Optional[dict],
+                  trace_ctx: Optional[dict] = None) -> List[dict]:
         import cloudpickle
         self._registry.start_attempt()
         with self._block:
@@ -898,7 +969,8 @@ class ClusterDriver:
                                  "shard_mod": shard_mod,
                                  "map_id_base": attempt << 20,
                                  "reusable_positions": reusable,
-                                 "reuse_token": reuse_token})
+                                 "reuse_token": reuse_token,
+                                 "trace_ctx": trace_ctx})
             except OSError:
                 raise WorkerLost(w)
         results: List[Optional[List[dict]]] = [None] * n

@@ -282,6 +282,8 @@ class ShuffleManager:
         data = serialize_batch(batch, compress=self.compress,
                                codec=self.codec)
         self.host_store.put(block, data)
+        from ..obs import registry as _registry
+        _registry.observe("shuffle_block_bytes", len(data), "bytes")
         with self._lock:  # writer pool threads race on the counters
             self.write_metrics.rows_written += int(batch.num_rows)
             self.write_metrics.blocks_written += 1

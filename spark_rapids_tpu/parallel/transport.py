@@ -250,7 +250,11 @@ def _retrying_stream(cli: ShuffleBlockClient, shuffle_id: int,
     failed_over = False
     while True:
         try:
+            t0 = time.perf_counter_ns()
             yield from cli._stream_attempt(shuffle_id, reduce_id, seen)
+            from ..obs import registry as _registry
+            _registry.observe("fetch_latency_ns",
+                              time.perf_counter_ns() - t0, "ns")
             return
         except OSError as e:
             attempt += 1

@@ -180,11 +180,13 @@ class TpuSession:
 
         from ..conf import METRICS_LEVEL
         from ..obs import events as _events
+        from ..obs import resource as _resource
         from ..obs.registry import registry as _registry
         from ..obs.registry import summarize_metrics
         from ..obs.trace import maybe_tracer
         from ..memory.budget import task_context
         _events.configure_from_conf(self.conf)
+        _resource.configure_from_conf(self.conf)
         ctx = ExecContext(self.conf)
         ctx.tracer = maybe_tracer(self.conf)
         tc = task_context()
@@ -206,9 +208,19 @@ class TpuSession:
                 qspan.__enter__()
             try:
                 if is_tpu:
-                    tables = [batch_to_table(b)
-                              for b in physical.execute(ctx)
-                              if int(b.num_rows) > 0]
+                    from ..memory.spill import batch_nbytes
+                    reg = _registry()
+                    tables = []
+                    for b in physical.execute(ctx):
+                        n = int(b.num_rows)
+                        if n == 0:
+                            continue
+                        # output-batch shape distributions (once per
+                        # OUTPUT batch, not per operator pull)
+                        reg.observe("batch_rows", n, "rows")
+                        reg.observe("batch_bytes", batch_nbytes(b),
+                                    "bytes")
+                        tables.append(batch_to_table(b))
                     result = concat_tables(tables) if tables \
                         else empty_like(plan.schema)
                 else:
@@ -222,6 +234,7 @@ class TpuSession:
             raise
         finally:
             wall_ns = _time.perf_counter_ns() - t0
+            _registry().observe("task_time_ns", wall_ns, "ns")
             summary = summarize_metrics(ctx.metrics,
                                         self.conf.get(METRICS_LEVEL))
             extra = {"spilled_bytes": tc.spilled_bytes - tc0[0],

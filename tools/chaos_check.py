@@ -170,6 +170,106 @@ def _check_fault_events(name, spec, fired, prev_armed=()):
     return failures
 
 
+def _telemetry_check(n_workers: int = 4) -> int:
+    """Distributed-telemetry leg: run one clean query on a 4-worker
+    cluster with event logs + tracing + resource sampling on, then
+    require ``tools/history_report.py`` to merge the per-process logs
+    into one coherent report — every worker contributed spans, all
+    span parentage resolves across process boundaries (worker task
+    spans under the driver's job span), and the clock-aligned
+    timelines agree with the event log to < 50ms. Returns failure
+    count."""
+    import numpy as np
+
+    from spark_rapids_tpu.conf import SrtConf
+    from spark_rapids_tpu.expr import col
+    from spark_rapids_tpu.expr.aggregates import CountStar, Sum
+    from spark_rapids_tpu.expr.core import Alias
+    from spark_rapids_tpu.parallel.cluster import (ClusterDriver,
+                                                   launch_local_workers)
+    from spark_rapids_tpu.plan import TpuSession
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from history_report import build_report
+
+    failures = 0
+    t0 = time.monotonic()
+    with tempfile.TemporaryDirectory(prefix="srt_telemetry_") as tmp:
+        session = TpuSession(SrtConf({}))
+        rng = np.random.default_rng(41)
+        n = 6_000
+        fact_dir = os.path.join(tmp, "fact")
+        session.create_dataframe({
+            "k": rng.integers(0, 30, n).tolist(),
+            "v": rng.uniform(0, 10, n).tolist(),
+        }).write.parquet(fact_dir)
+        plan = session.read.parquet(fact_dir) \
+            .group_by("k").agg(Alias(Sum(col("v")), "s"),
+                               Alias(CountStar(), "c")) \
+            .sort("k").plan
+        events_dir = os.path.join(tmp, "events")
+        driver = ClusterDriver(num_workers=n_workers,
+                               barrier_timeout=60,
+                               heartbeat_interval=0.5,
+                               heartbeat_timeout=10)
+        procs = launch_local_workers(driver, n_workers)
+        try:
+            driver.wait_for_workers(timeout=120)
+            rows = driver.run(plan, {
+                "srt.shuffle.partitions": 4,
+                "srt.cluster.barrierTimeoutSec": 60,
+                "srt.eventLog.enabled": "true",
+                "srt.eventLog.dir": events_dir,
+                "srt.eventLog.trace.enabled": "true",
+                "srt.obs.resource.intervalMs": 50,
+            })
+            if len(rows) != 30:
+                print(f"[chaos] FAIL [telemetry]: expected 30 groups, "
+                      f"got {len(rows)}", file=sys.stderr, flush=True)
+                failures += 1
+        finally:
+            driver.shutdown()
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except Exception:
+                    p.kill()
+        rep = build_report(events_dir)
+        checks = []
+        jobs = rep["jobs"]
+        checks.append(("one cluster job recorded", len(jobs) == 1))
+        if jobs:
+            wids = {w["worker_id"] for w in jobs[0]["workers"]}
+            checks.append((f"all {n_workers} workers reported TaskEnd",
+                           wids == set(range(n_workers))))
+        tr = rep.get("trace")
+        checks.append(("trace files merged", tr is not None))
+        if tr is not None:
+            checks.append((f"driver + {n_workers} workers contributed "
+                           "spans",
+                           len(tr["processes"]) >= n_workers + 1))
+            checks.append(("no unparented spans",
+                           not tr["unparented"]))
+            checks.append(("aligned clock skew < 50ms",
+                           tr["max_skew_ms"] is not None
+                           and tr["max_skew_ms"] < 50.0))
+        res = rep.get("resources")
+        checks.append(("resource samples recorded",
+                       bool(res and res["samples"])))
+        checks.append(("every advisor rule evaluated",
+                       len(rep["advisor"]) >= 5))
+        for what, ok in checks:
+            if not ok:
+                print(f"[chaos] FAIL [telemetry]: {what}",
+                      file=sys.stderr, flush=True)
+                failures += 1
+        print(f"[chaos] {'PASS' if not failures else 'FAIL'} "
+              f"[telemetry: {n_workers}-worker history report] "
+              f"{time.monotonic() - t0:.1f}s "
+              f"({len(checks)} checks)", flush=True)
+    return failures
+
+
 def _rows_match(rows, oracle):
     if [r["k"] for r in rows] != [r["k"] for r in oracle]:
         return False
@@ -333,6 +433,8 @@ def main() -> int:
             failures += 1
     # deterministic local spill-corruption probe (no cluster involved)
     failures += _spill_corruption_check()
+    # distributed-telemetry leg: 4-worker run, merged history report
+    failures += _telemetry_check()
     watchdog.cancel()
     print(f"[chaos] done in {time.monotonic() - t0:.1f}s, "
           f"{failures} failure(s)", flush=True)

@@ -1,0 +1,439 @@
+#!/usr/bin/env python
+"""Cluster history aggregator + conf advisor.
+
+Where ``tools/profile_report.py`` reads one process's event log, this
+tool ingests a DIRECTORY of per-process logs from a cluster run —
+``events-<pid>.jsonl`` (with rotation segments) from the driver and
+every worker, plus the per-process ``trace-*.json`` Chrome traces —
+and reconstructs the distributed picture, the way the reference's
+profiling/auto-tuning tool digests Spark history logs:
+
+- per-job worker table: tasks, rows, wall clock, busy/wait/overlap
+  (from TaskEnd operator metrics, prefetch-wait adjusted), and the
+  slowest/fastest task spread (straggler skew);
+- per-shuffle partition-size quantiles (p50/p90/p99 over per-map
+  ShuffleWrite bytes) and the p99/p50 skew ratio;
+- a clock-aligned merged trace: every process's monotonic span
+  timeline is shifted onto the shared wall clock using the anchor
+  pair its tracer stamped (``--merge-trace OUT.json`` writes the
+  merged catapult file), with a parentage check that worker span
+  trees resolve into the driver's job span across process boundaries
+  and a cross-check of span end times against event timestamps
+  (residual skew after alignment);
+- an ADVISOR: every rule is evaluated and reported (triggered or
+  not) with the measured evidence and the concrete conf to change.
+
+Usage:
+    python tools/history_report.py LOG_DIR [--json] [--merge-trace OUT]
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(1, os.path.dirname(os.path.abspath(__file__)))
+
+from spark_rapids_tpu.obs import events as ev  # noqa: E402
+from spark_rapids_tpu.obs.trace import merge_chrome_traces  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# small stats helpers (event-log side; no registry needed offline)
+# ---------------------------------------------------------------------------
+
+def _quantile(values: List[float], q: float) -> float:
+    if not values:
+        return 0.0
+    vs = sorted(values)
+    idx = min(int(q * len(vs)), len(vs) - 1)
+    return vs[idx]
+
+
+def _pcts(values: List[float]) -> Dict[str, float]:
+    return {"p50": _quantile(values, 0.50),
+            "p90": _quantile(values, 0.90),
+            "p99": _quantile(values, 0.99),
+            "min": min(values) if values else 0,
+            "max": max(values) if values else 0,
+            "n": len(values)}
+
+
+def _metric_val(metrics: Dict[str, Any], name: str) -> float:
+    rec = metrics.get(name, 0)
+    if isinstance(rec, dict):  # QueryEnd summaries nest {value, level}
+        return rec.get("value", 0)
+    return rec if isinstance(rec, (int, float)) else 0
+
+
+# ---------------------------------------------------------------------------
+# event-log aggregation
+# ---------------------------------------------------------------------------
+
+def build_jobs(records: List[dict]) -> List[dict]:
+    """Group the merged event stream into cluster jobs by job_token
+    (StageSubmitted on the driver, TaskEnd on each worker)."""
+    jobs: Dict[str, dict] = {}
+    order: List[str] = []
+    for r in records:
+        kind = r.get("event")
+        token = r.get("job_token")
+        if kind == "StageSubmitted" and token:
+            j = jobs.get(token)
+            if j is None:
+                j = jobs[token] = {"job_token": token, "attempts": 0,
+                                   "num_workers": 0, "tasks": [],
+                                   "retries": []}
+                order.append(token)
+            j["attempts"] = max(j["attempts"], r.get("attempt", 0) + 1)
+            j["num_workers"] = max(j["num_workers"],
+                                   r.get("num_workers", 0))
+        elif kind == "TaskEnd" and token:
+            j = jobs.get(token)
+            if j is None:
+                j = jobs[token] = {"job_token": token, "attempts": 1,
+                                   "num_workers": 0, "tasks": [],
+                                   "retries": []}
+                order.append(token)
+            j["tasks"].append(r)
+        elif kind == "RetryAttempt" and token and \
+                r.get("scope") in ("job", "stage"):
+            j = jobs.get(token)
+            if j is not None:
+                j["retries"].append(r)
+    return [jobs[t] for t in order]
+
+
+def analyze_job(job: dict) -> dict:
+    """Per-worker busy/wait/overlap + straggler spread for one job."""
+    workers: Dict[int, dict] = {}
+    walls: List[float] = []
+    for t in job["tasks"]:
+        wid = t.get("worker_id", -1)
+        w = workers.setdefault(wid, {"worker_id": wid, "tasks": 0,
+                                     "rows": 0, "wall_ns": 0,
+                                     "busy_ns": 0, "prefetch_wait_ns": 0,
+                                     "pid": t.get("pid")})
+        w["tasks"] += 1
+        w["rows"] += t.get("rows", 0)
+        wall = t.get("wall_ns", 0)
+        w["wall_ns"] += wall
+        if wall:
+            walls.append(wall)
+        for metrics in (t.get("metrics") or {}).values():
+            op = _metric_val(metrics, "opTime")
+            pf = _metric_val(metrics, "prefetchWaitTime")
+            w["busy_ns"] += max(op - pf, 0)
+            w["prefetch_wait_ns"] += pf
+    for w in workers.values():
+        w["wait_ns"] = max(w["wall_ns"] - w["busy_ns"], 0)
+        w["overlap_ns"] = max(w["busy_ns"] - w["wall_ns"], 0)
+    spread = (max(walls) / max(min(walls), 1)) if walls else 0.0
+    return {"job_token": job["job_token"],
+            "attempts": job["attempts"],
+            "num_workers": job["num_workers"] or len(workers),
+            "retries": len(job["retries"]),
+            "workers": [workers[k] for k in sorted(workers)],
+            "task_wall": dict(_pcts(walls), spread=spread)}
+
+
+def analyze_shuffles(records: List[dict]) -> Dict[Any, dict]:
+    """Per-shuffle partition-size stats over per-map ShuffleWrite
+    bytes — the skew signal the advisor keys on."""
+    per_shuffle: Dict[Any, List[dict]] = {}
+    for r in records:
+        if r.get("event") == "ShuffleWrite":
+            per_shuffle.setdefault(r.get("shuffle_id"), []).append(r)
+    out: Dict[Any, dict] = {}
+    for sid, writes in per_shuffle.items():
+        sizes = [w.get("bytes", 0) for w in writes]
+        pcts = _pcts(sizes)
+        out[sid] = {"bytes": sum(sizes),
+                    "rows": sum(w.get("rows", 0) for w in writes),
+                    "maps": len(writes),
+                    "blocks": sum(w.get("blocks", 0) for w in writes),
+                    "map_bytes": pcts,
+                    "skew_ratio": (pcts["p99"] / pcts["p50"])
+                                  if pcts["p50"] else 0.0}
+    return out
+
+
+def analyze_resources(records: List[dict]) -> Optional[dict]:
+    samples = [r for r in records if r.get("event") == "ResourceSample"]
+    if not samples:
+        return None
+    per_pid: Dict[int, int] = {}
+    for s in samples:
+        per_pid[s.get("pid", 0)] = per_pid.get(s.get("pid", 0), 0) + 1
+    return {"samples": len(samples), "processes": len(per_pid),
+            "rss_bytes": _pcts([s.get("rss_bytes", 0) for s in samples]),
+            "prefetch_buffer_bytes": _pcts(
+                [s.get("prefetch_buffer_bytes", 0) for s in samples
+                 if "prefetch_buffer_bytes" in s])}
+
+
+# ---------------------------------------------------------------------------
+# trace merge + cross-process consistency checks
+# ---------------------------------------------------------------------------
+
+def analyze_traces(log_dir: str, records: List[dict]) -> Optional[dict]:
+    """Merge the per-process trace files, verify span parentage
+    resolves across process boundaries, and measure the residual
+    clock skew after alignment (aligned task-span end vs the TaskEnd
+    event's wall-clock timestamp from the same process)."""
+    paths = sorted(glob.glob(os.path.join(log_dir, "trace-*.json")))
+    if not paths:
+        return None
+    merged = merge_chrome_traces(paths)
+    events = merged["traceEvents"]
+    span_ids = set()
+    by_pid_tasks: Dict[int, List[dict]] = {}
+    pids = set()
+    for e in events:
+        args = e.get("args") or {}
+        if "span_id" in args:
+            span_ids.add(args["span_id"])
+        pids.add(e.get("pid"))
+        if e.get("cat") == "task":
+            by_pid_tasks.setdefault(e.get("pid"), []).append(e)
+    unparented = []
+    for e in events:
+        args = e.get("args") or {}
+        parent = args.get("parent_id")
+        if parent is not None and parent not in span_ids:
+            unparented.append({"name": e.get("name"),
+                               "pid": e.get("pid"),
+                               "parent_id": parent})
+    # residual skew: each TaskEnd event (wall clock at emit) should
+    # land within a few ms of its task span's aligned end time
+    task_ends = [r for r in records if r.get("event") == "TaskEnd"]
+    skews_ms: List[float] = []
+    for te in task_ends:
+        spans = by_pid_tasks.get(te.get("pid"))
+        if not spans:
+            continue
+        ends_s = [(s.get("ts", 0) + s.get("dur", 0)) / 1e6
+                  for s in spans]
+        skews_ms.append(min(abs(te["ts"] - t) * 1000.0
+                            for t in ends_s))
+    return {"files": [os.path.basename(p) for p in paths],
+            "processes": sorted(p for p in pids if p is not None),
+            "spans": len(events),
+            "trace_id": merged["metadata"].get("trace_id"),
+            "unparented": unparented,
+            "max_skew_ms": max(skews_ms) if skews_ms else None,
+            "merged": merged}
+
+
+# ---------------------------------------------------------------------------
+# advisor
+# ---------------------------------------------------------------------------
+
+def advise(jobs: List[dict], shuffles: Dict[Any, dict],
+           queries: List[dict], records: List[dict]) -> List[dict]:
+    """Evaluate every rule against the measured run; each entry says
+    what was measured and, when triggered, which conf to change —
+    the reference profiler's auto-tuner recommendations role."""
+    rules: List[dict] = []
+
+    # 1. shuffle partition skew → skew split / more partitions
+    worst = max(shuffles.values(), key=lambda s: s["skew_ratio"],
+                default=None)
+    ratio = worst["skew_ratio"] if worst else 0.0
+    rules.append({
+        "rule": "shuffle-partition-skew",
+        "triggered": ratio > 4.0,
+        "evidence": (f"worst shuffle map-output skew p99/p50 = "
+                     f"{ratio:.1f}x" if worst else "no shuffle writes"),
+        "suggestion": ("lower srt.sql.adaptive.skewJoin.partitionRows "
+                       "(enable skew split) or raise "
+                       "srt.shuffle.partitions")
+                      if ratio > 4.0 else None})
+
+    # 2. prefetch starvation → deeper pipeline
+    pf_wait = wall = 0
+    for j in jobs:
+        for w in j["workers"]:
+            pf_wait += w["prefetch_wait_ns"]
+            wall += w["wall_ns"]
+    for q in queries:
+        pf_wait += q.get("prefetch", {}).get("wait_ns", 0)
+        wall += q.get("wall_ns", 0)
+    frac = (pf_wait / wall) if wall else 0.0
+    rules.append({
+        "rule": "prefetch-starvation",
+        "triggered": frac > 0.40,
+        "evidence": f"prefetch wait is {100 * frac:.0f}% of wall clock",
+        "suggestion": ("raise srt.exec.pipeline.depth / "
+                       "srt.exec.pipeline.maxBytesInFlight")
+                      if frac > 0.40 else None})
+
+    # 3. spill pressure → bigger pool / smaller batches
+    spills = [r for r in records
+              if r.get("event") in ("SpillToHost", "SpillToDisk")]
+    spill_bytes = sum(r.get("bytes", 0) for r in spills)
+    rules.append({
+        "rule": "spill-pressure",
+        "triggered": bool(spills),
+        "evidence": f"{len(spills)} spill events, {spill_bytes} bytes",
+        "suggestion": ("raise srt.memory.tpu.poolSize or lower "
+                       "srt.sql.batchSizeRows") if spills else None})
+
+    # 4. fetch retries → longer timeouts / more retries
+    fetch_retries = [r for r in records
+                     if r.get("event") == "RetryAttempt"
+                     and r.get("scope") == "fetch"]
+    rules.append({
+        "rule": "fetch-instability",
+        "triggered": bool(fetch_retries),
+        "evidence": f"{len(fetch_retries)} fetch retry attempts",
+        "suggestion": ("raise srt.shuffle.fetch.timeoutSec / "
+                       "srt.shuffle.fetch.maxRetries")
+                      if fetch_retries else None})
+
+    # 5. straggler workers → repartition
+    worst_spread = max((j["task_wall"]["spread"] for j in jobs),
+                      default=0.0)
+    rules.append({
+        "rule": "worker-straggler",
+        "triggered": worst_spread > 2.0,
+        "evidence": (f"slowest/fastest task wall = "
+                     f"{worst_spread:.1f}x" if jobs
+                     else "no cluster jobs"),
+        "suggestion": ("raise srt.shuffle.partitions so work "
+                       "redistributes, or check input file sharding")
+                      if worst_spread > 2.0 else None})
+    return rules
+
+
+# ---------------------------------------------------------------------------
+# top level
+# ---------------------------------------------------------------------------
+
+def build_report(log_dir: str) -> dict:
+    records = ev.read_all_events(log_dir)
+    # reuse the single-process per-query analysis for driver queries
+    from profile_report import analyze as analyze_query
+    from profile_report import build_queries
+    queries = [analyze_query(q) for q in build_queries(records)]
+    jobs = [analyze_job(j) for j in build_jobs(records)]
+    shuffles = analyze_shuffles(records)
+    traces = analyze_traces(log_dir, records)
+    report = {
+        "log_dir": log_dir,
+        "events": len(records),
+        "processes": sorted({r.get("pid") for r in records
+                             if r.get("pid") is not None}),
+        "queries": queries,
+        "jobs": jobs,
+        "shuffles": {str(k): v for k, v in shuffles.items()},
+        "resources": analyze_resources(records),
+        "advisor": advise(jobs, shuffles, queries, records),
+    }
+    if traces is not None:
+        merged = traces.pop("merged")
+        report["trace"] = traces
+        report["_merged_trace"] = merged  # stripped before printing
+    return report
+
+
+def _fmt_ns(ns: float) -> str:
+    return f"{ns / 1e6:.1f}ms"
+
+
+def _fmt_bytes(b: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(b) < 1024 or unit == "GiB":
+            return f"{b:.0f}{unit}" if unit == "B" else f"{b:.1f}{unit}"
+        b /= 1024.0
+    return f"{b:.1f}GiB"
+
+
+def render(rep: dict) -> str:
+    lines: List[str] = []
+    lines.append(f"=== cluster history: {rep['log_dir']} "
+                 f"({rep['events']} events from "
+                 f"{len(rep['processes'])} processes) ===")
+    for j in rep["jobs"]:
+        tw = j["task_wall"]
+        lines.append(f"job {j['job_token']}: workers="
+                     f"{j['num_workers']} attempts={j['attempts']} "
+                     f"retries={j['retries']}")
+        lines.append(f"  task wall: p50={_fmt_ns(tw['p50'])} "
+                     f"p99={_fmt_ns(tw['p99'])} "
+                     f"spread={tw['spread']:.1f}x")
+        for w in j["workers"]:
+            lines.append(
+                f"  w{w['worker_id']} (pid {w['pid']}): "
+                f"tasks={w['tasks']} rows={w['rows']} "
+                f"wall={_fmt_ns(w['wall_ns'])} "
+                f"busy={_fmt_ns(w['busy_ns'])} "
+                f"wait={_fmt_ns(w['wait_ns'])}"
+                + (f" overlap={_fmt_ns(w['overlap_ns'])}"
+                   if w["overlap_ns"] else ""))
+    if rep["shuffles"]:
+        lines.append("shuffle exchanges:")
+        for sid, s in sorted(rep["shuffles"].items()):
+            mb = s["map_bytes"]
+            lines.append(
+                f"  shuffle {sid}: {_fmt_bytes(s['bytes'])} "
+                f"maps={s['maps']} per-map p50={_fmt_bytes(mb['p50'])} "
+                f"p99={_fmt_bytes(mb['p99'])} "
+                f"skew={s['skew_ratio']:.1f}x")
+    res = rep.get("resources")
+    if res:
+        lines.append(f"resources: {res['samples']} samples from "
+                     f"{res['processes']} processes, rss p99="
+                     f"{_fmt_bytes(res['rss_bytes']['p99'])}")
+    tr = rep.get("trace")
+    if tr:
+        skew = tr["max_skew_ms"]
+        lines.append(f"trace: {tr['spans']} spans from "
+                     f"{len(tr['files'])} files "
+                     f"(processes {tr['processes']}), "
+                     f"unparented={len(tr['unparented'])}, "
+                     f"aligned clock skew="
+                     + (f"{skew:.1f}ms" if skew is not None else "n/a"))
+    lines.append("advisor:")
+    for a in rep["advisor"]:
+        flag = "!" if a["triggered"] else " "
+        lines.append(f"  [{flag}] {a['rule']}: {a['evidence']}"
+                     + (f" -> {a['suggestion']}" if a["suggestion"]
+                        else ""))
+    nq = len(rep["queries"])
+    if nq:
+        lines.append(f"(driver queries: {nq} — see "
+                     "tools/profile_report.py for per-operator detail)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("log_dir", help="srt.eventLog.dir of a cluster run")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    ap.add_argument("--merge-trace", default=None, metavar="OUT",
+                    help="write the clock-aligned merged Chrome trace")
+    args = ap.parse_args(argv)
+    if not os.path.isdir(args.log_dir):
+        print(f"no such log dir: {args.log_dir}", file=sys.stderr)
+        return 2
+    rep = build_report(args.log_dir)
+    merged = rep.pop("_merged_trace", None)
+    if args.merge_trace and merged is not None:
+        with open(args.merge_trace, "w") as f:
+            json.dump(merged, f)
+        print(f"merged trace -> {args.merge_trace}", file=sys.stderr)
+    if args.json:
+        print(json.dumps(rep, indent=2, default=str))
+    else:
+        print(render(rep))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
