@@ -25,7 +25,13 @@ Environment knobs: SRT_BENCH_SCALE (lineitem rows, default 6,000,000 =
 SF1-shaped; auto-reduced to 1.5M on the CPU fallback backend),
 SRT_BENCH_ITERS, SRT_BENCH_DIR (parquet cache), SRT_BENCH_BUDGET,
 SRT_BENCH_PIPELINE=on|off|both (async-pipeline A/B on the NDS sweep;
-"both" records pipelined-vs-sync walls and their delta).
+"both" records pipelined-vs-sync walls and their delta),
+SRT_BENCH_FUSION=on|off|both (operator-fusion A/B: "off" disables
+srt.exec.fusion.enabled for every engine session; "both" additionally
+re-times q6/q3 unfused — recording q*_unfused_s / q*_fusion_speedup —
+and switches the NDS A/B dimension from pipeline to fusion, with
+nds_fusion_* common-query delta keys and jit-registry hit/miss counts
+for the fused-program cache).
 """
 
 import json
@@ -48,6 +54,13 @@ KERNEL_ITERS = 10
 # bytes per lineitem row actually touched by q6 on device:
 # l_extendedprice/l_discount/l_quantity float64 + l_shipdate int32-date
 Q6_BYTES_PER_ROW = 8 * 3 + 4
+# q1: quantity/extendedprice/discount/tax float64 + returnflag/
+# linestatus 1B dictionary codes + shipdate int32-date
+Q1_BYTES_PER_ROW = 8 * 4 + 1 + 1 + 4
+# q3 lineitem side: orderkey/extendedprice/discount float64-width +
+# shipdate int32-date (customer/orders are ~1/10th the rows; the
+# effective-GB/s headline normalizes on lineitem like q6/q1)
+Q3_BYTES_PER_ROW = 8 * 3 + 4
 
 
 def log(msg: str) -> None:
@@ -297,13 +310,28 @@ def pandas_mortgage(mort_dir):
 # framework end-to-end
 # ---------------------------------------------------------------------------
 
+# SRT_BENCH_FUSION=off flows through every engine session the bench
+# creates (headline, delta, mortgage, NDS) via this module-level conf
+# overlay; main() populates it before the first session is built.
+_FUSION_EXTRA: dict = {}
+
+
 def framework_session(extra: dict = None):
     from spark_rapids_tpu.conf import SrtConf
     from spark_rapids_tpu.plan.session import TpuSession
     settings = {"srt.shuffle.partitions": 4}
+    settings.update(_FUSION_EXTRA)
     if extra:
         settings.update(extra)
     return TpuSession(SrtConf(settings))
+
+
+def fusion_counters() -> dict:
+    """Fused-pipeline construction + jit-cache counters (cumulative
+    for the process): chains/stages planned so far plus the shared-jit
+    registry's hit/miss/entries stats for the fused-program module."""
+    from spark_rapids_tpu.exec.fused import fusion_stats
+    return fusion_stats()
 
 
 def framework_queries(session, paths):
@@ -429,6 +457,13 @@ def main():
             except Exception:
                 pass
 
+    fusion_mode = os.environ.get("SRT_BENCH_FUSION", "on").lower()
+    if fusion_mode not in ("on", "off", "both"):
+        fusion_mode = "on"
+    RESULT["fusion_mode"] = fusion_mode
+    if fusion_mode == "off":
+        _FUSION_EXTRA["srt.exec.fusion.enabled"] = "false"
+
     scale = int(os.environ.get("SRT_BENCH_SCALE", 0))
     if not scale:
         # the CPU fallback runs the same honest pipeline but ~50x
@@ -461,8 +496,9 @@ def main():
     log(f"q6: {q6_s:.3f}s (pandas {cpu_q6:.3f}s)")
     emit()
 
-    # --- q1/q3 breadth numbers
-    for name, baseline in (("q1", pandas_q1), ("q3", pandas_q3)):
+    # --- q1/q3 breadth numbers (effective GB/s headlined like q6)
+    for name, baseline, row_bytes in (("q1", pandas_q1, Q1_BYTES_PER_ROW),
+                                      ("q3", pandas_q3, Q3_BYTES_PER_ROW)):
         if not left(name, need=60):
             break
         queries[name]()
@@ -470,8 +506,37 @@ def main():
         c = _best(lambda: baseline(paths), 1)
         RESULT[f"{name}_s"] = round(t, 4)
         RESULT[f"{name}_vs_baseline"] = round(c / t, 3)
+        RESULT[f"{name}_effective_gb_s"] = round(
+            scale * row_bytes / t / 1e9, 2)
         log(f"{name}: {t:.3f}s (pandas {c:.3f}s)")
         emit()
+
+    # --- operator-fusion A/B on the headline queries: re-time q6/q3
+    # with srt.exec.fusion.enabled=false in a fresh session and record
+    # the unfused walls + speedups next to the fused headline numbers
+    if fusion_mode == "both" and left("fusion A/B", need=60):
+        try:
+            RESULT["fusion_counters"] = fusion_counters()
+            unfused_sess = framework_session(
+                {"srt.exec.fusion.enabled": "false"})
+            unfused_q = framework_queries(unfused_sess, paths)
+            # iteration counts MUST mirror the fused headline lanes
+            # (q6 ran ITERS, q3 ran ITERS-1) or min-of-N asymmetry
+            # masquerades as a fusion delta on noisy boxes
+            for name, iters in (("q6", ITERS), ("q3", max(ITERS - 1, 1))):
+                if f"{name}_s" not in RESULT or not left(
+                        f"fusion A/B {name}", need=45):
+                    continue
+                unfused_q[name]()  # warm: compile the unfused plans
+                t = _best(unfused_q[name], iters)
+                RESULT[f"{name}_unfused_s"] = round(t, 4)
+                RESULT[f"{name}_fusion_speedup"] = round(
+                    t / RESULT[f"{name}_s"], 3)
+                log(f"{name} unfused: {t:.3f}s (fusion speedup "
+                    f"{RESULT[f'{name}_fusion_speedup']}x)")
+            emit()
+        except Exception as e:  # A/B must never kill the headline run
+            log(f"fusion A/B failed: {e}")
 
     # --- kernel-only q6 + measured roofline (HBM utilization estimate)
     if backend == "cpu":
@@ -592,10 +657,21 @@ def main():
                                    f"nds_{nds_scale}")
             pipe_mode = os.environ.get("SRT_BENCH_PIPELINE",
                                        "on").lower()
-            legs = {"on": [("on", "true")], "off": [("off", "false")],
-                    "both": [("on", "true"), ("off", "false")]}.get(
-                pipe_mode, [("on", "true")])
+            # SRT_BENCH_FUSION=both takes over the NDS A/B dimension:
+            # both legs keep the pipeline default and toggle fusion
+            # instead (one A/B dimension per sweep keeps it readable)
+            if fusion_mode == "both":
+                leg_conf, leg_dim = "srt.exec.fusion.enabled", "fusion"
+                legs = [("on", "true"), ("off", "false")]
+            else:
+                leg_conf, leg_dim = "srt.exec.pipeline.enabled", \
+                    "pipeline"
+                legs = {"on": [("on", "true")],
+                        "off": [("off", "false")],
+                        "both": [("on", "true"), ("off", "false")]}.get(
+                    pipe_mode, [("on", "true")])
             RESULT["nds_pipeline_mode"] = pipe_mode
+            RESULT["nds_ab_dimension"] = leg_dim
             import gc
 
             # cheap-first static order (round-5 measured warm walls on
@@ -620,8 +696,7 @@ def main():
                 sorted(set(NDS_QUERIES) - set(nds_order))
 
             def run_leg(label, enabled, key_prefix):
-                nds_sess = framework_session(
-                    {"srt.exec.pipeline.enabled": enabled})
+                nds_sess = framework_session({leg_conf: enabled})
                 register_nds(nds_sess, nds_dir, scale_rows=nds_scale)
                 # drop the previous lane's in-memory executables before
                 # the 70-query sweep (see the % 5 clear below)
@@ -630,6 +705,7 @@ def main():
                 t0 = time.perf_counter()
                 done = 0
                 per_q = {}
+                fuse0 = fusion_counters()
 
                 def snapshot():
                     RESULT[f"{key_prefix}queries_run"] = done
@@ -664,9 +740,22 @@ def main():
                         jax.clear_caches()
                         gc.collect()
                 snapshot()
-                log(f"nds power run [pipeline={label}]: "
+                fuse1 = fusion_counters()
+                # per-leg deltas: chains planned during this leg + the
+                # fused-program jit cache's hit/miss counts (hits =
+                # partitions/queries that reused a compiled program)
+                RESULT[f"{key_prefix}fusion"] = {
+                    "chains": fuse1["chains"] - fuse0["chains"],
+                    "stages": fuse1["stages"] - fuse0["stages"],
+                    "jit_hits": (fuse1["registry"]["hits"]
+                                 - fuse0["registry"]["hits"]),
+                    "jit_misses": (fuse1["registry"]["misses"]
+                                   - fuse0["registry"]["misses"]),
+                }
+                log(f"nds power run [{leg_dim}={label}]: "
                     f"{done}/{len(NDS_QUERIES)} queries in "
-                    f"{RESULT[f'{key_prefix}total_s']}s")
+                    f"{RESULT[f'{key_prefix}total_s']}s "
+                    f"(fusion {RESULT[f'{key_prefix}fusion']})")
                 emit()
                 return per_q
 
@@ -676,24 +765,38 @@ def main():
             else:
                 walls = {}
                 for label, enabled in legs:
-                    walls[label] = run_leg(label, enabled,
-                                           f"nds_{label}_")
+                    walls[label] = run_leg(
+                        label, enabled, f"nds_{leg_dim}_{label}_"
+                        if leg_dim == "fusion" else f"nds_{label}_")
                 # delta over the queries BOTH lanes completed — a
                 # budget cut mid-lane must not skew the comparison
                 common = sorted(set(walls["on"]) & set(walls["off"]))
                 if common:
                     on_s = sum(walls["on"][q] for q in common)
                     off_s = sum(walls["off"][q] for q in common)
-                    RESULT["nds_pipeline_common_queries"] = len(common)
-                    RESULT["nds_pipelined_common_s"] = round(on_s, 2)
-                    RESULT["nds_sync_common_s"] = round(off_s, 2)
-                    # >0: pipelining saved wall; <0: it cost wall
-                    RESULT["nds_pipeline_delta_pct"] = round(
-                        100.0 * (off_s - on_s) / off_s, 2) \
-                        if off_s else 0.0
-                    log(f"nds pipeline A/B over {len(common)} common "
+                    if leg_dim == "fusion":
+                        RESULT["nds_fusion_common_queries"] = \
+                            len(common)
+                        RESULT["nds_fused_common_s"] = round(on_s, 2)
+                        RESULT["nds_unfused_common_s"] = round(off_s, 2)
+                        # >0: fusion saved wall; <0: it cost wall
+                        RESULT["nds_fusion_delta_pct"] = round(
+                            100.0 * (off_s - on_s) / off_s, 2) \
+                            if off_s else 0.0
+                        delta = RESULT["nds_fusion_delta_pct"]
+                    else:
+                        RESULT["nds_pipeline_common_queries"] = \
+                            len(common)
+                        RESULT["nds_pipelined_common_s"] = round(on_s, 2)
+                        RESULT["nds_sync_common_s"] = round(off_s, 2)
+                        # >0: pipelining saved wall; <0: it cost wall
+                        RESULT["nds_pipeline_delta_pct"] = round(
+                            100.0 * (off_s - on_s) / off_s, 2) \
+                            if off_s else 0.0
+                        delta = RESULT["nds_pipeline_delta_pct"]
+                    log(f"nds {leg_dim} A/B over {len(common)} common "
                         f"queries: on={on_s:.2f}s off={off_s:.2f}s "
-                        f"delta={RESULT['nds_pipeline_delta_pct']}%")
+                        f"delta={delta}%")
                 emit()
         except Exception as e:  # breadth stage must never kill the bench
             log(f"nds power run failed: {e}")

@@ -677,6 +677,45 @@ PALLAS_GROUPED_ENABLED = conf("srt.sql.pallas.groupedAgg.enabled") \
          "variableFloatAgg-class deviation on TPU.") \
     .boolean(True)
 
+PALLAS_GROUP_MAX_CAPACITY = conf("srt.exec.pallas.groupAgg.maxCapacity") \
+    .doc("Batch-capacity ceiling for the grouped pallas MXU lane. "
+         "Per-bucket counts accumulate in float32 lanes on the MXU and "
+         "float32 represents integers exactly only below 2^24, so "
+         "batches at or above this capacity take the stock integer "
+         "scatter/sort path (Count/CountStar would otherwise drift). "
+         "Raising it past 2^24 trades count exactness for MXU "
+         "coverage; a forced fallback logs one PallasCapacityFallback "
+         "event per process.") \
+    .check(_positive).integer(1 << 24)
+
+FUSION_ENABLED = conf("srt.exec.fusion.enabled") \
+    .doc("Operator-fusion pass (plan/overrides.py -> exec/fused.py): "
+         "collapse scan -> filter -> project -> partial-aggregate "
+         "chains into one jitted program per chain so intermediate "
+         "batches never round-trip through HBM (cuDF fused "
+         "filter/project + GpuHashAggregateExec partial-on-scan role). "
+         "Chains holding eager or partition-context expressions "
+         "(input_file_name, spark_partition_id, ...) always stay "
+         "unfused.") \
+    .commonly_used().boolean(True)
+
+FUSION_EXCLUDE_EXECS = conf("srt.exec.fusion.excludeExecs") \
+    .doc("Comma-separated exec class names (FilterExec, ProjectExec, "
+         "HashAggregateExec) the fusion matcher must not absorb into a "
+         "FusedPipelineExec — an opt-out list for isolating a "
+         "suspected fusion miscompare without turning the whole pass "
+         "off. An excluded class breaks the chain at that node.") \
+    .string("")
+
+FUSION_DONATE = conf("srt.exec.fusion.donateInputs") \
+    .doc("Donate the input batch's device buffers to the fused program "
+         "(jax.jit donate_argnums) so XLA reuses them for the output "
+         "instead of allocating fresh HBM. Applied only on non-CPU "
+         "backends and only when the chain's source produces "
+         "single-use buffers (file scans, not in-memory tables whose "
+         "batches are re-executed).") \
+    .boolean(True)
+
 OPTIMIZER_ENABLED = conf("srt.sql.optimizer.enabled") \
     .doc("Cost-based optimizer: keep plans below the row threshold on "
          "the CPU engine where device compile/transfer overhead "

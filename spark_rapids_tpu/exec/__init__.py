@@ -13,6 +13,7 @@ from .basic import (BatchScanExec, CoalesceBatchesExec, ExpandExec,
                     FilterExec, LocalLimitExec, ProjectExec, RangeExec,
                     UnionExec)
 from .aggregate import HashAggregateExec
+from .fused import FusedPipelineExec
 from .pipeline import PrefetchExec, PrefetchIterator
 from .sort import SortExec, SortOrder, TopNExec
 from .join import BroadcastHashJoinExec, ShuffledHashJoinExec
@@ -21,7 +22,8 @@ __all__ = [
     "ExecContext", "Metric", "TpuExec", "TpuSemaphore",
     "BatchScanExec", "CoalesceBatchesExec", "ExpandExec", "FilterExec",
     "LocalLimitExec", "ProjectExec", "RangeExec", "UnionExec",
-    "HashAggregateExec", "PrefetchExec", "PrefetchIterator",
+    "HashAggregateExec", "FusedPipelineExec", "PrefetchExec",
+    "PrefetchIterator",
     "SortExec", "SortOrder", "TopNExec",
     "BroadcastHashJoinExec", "ShuffledHashJoinExec",
 ]

@@ -233,7 +233,8 @@ class HashAggregateExec(TpuExec):
         key_cols, agg_in = self._eval_update_inputs(batch)
         key_batch, states, used = K.group_aggregate_pallas(
             batch, key_cols, agg_in, [fn for fn, _ in self.agg_exprs],
-            row_offset=row_offset)
+            row_offset=row_offset,
+            max_capacity=getattr(self, "_pallas_max_cap", 1 << 24))
         return self._pack(key_batch, states, key_batch.num_rows,
                           batch.capacity), used
 
@@ -253,9 +254,12 @@ class HashAggregateExec(TpuExec):
             return None
         fn = self._pallas_cache.get("grouped_update")
         if fn is None:
+            from ..conf import PALLAS_GROUP_MAX_CAPACITY
+            self._pallas_max_cap = int(
+                ctx.conf.get(PALLAS_GROUP_MAX_CAPACITY))
             agg_fields = ("group_exprs", "agg_exprs", "_key_names",
                           "_state_schemas", "_result_schema",
-                          "_packed_schema")
+                          "_packed_schema", "_pallas_max_cap")
             fn = self._pallas_cache["grouped_update"] = shared_method_jit(
                 self, "_update_pallas", agg_fields)
         return fn

@@ -1,0 +1,316 @@
+"""Fused operator pipelines: one jitted program per linear chain.
+
+The planner (plan/overrides.py, ``_insert_fusion``) collapses
+scan -> filter -> project -> partial-aggregate chains into a single
+``FusedPipelineExec`` whose per-batch compute is ONE ``jax.jit``
+program, registered through ``jit_registry.shared_fn_jit`` so the
+traced artifact is shared across partitions and across queries with
+structurally identical chains. This is the direct analogue of the
+reference keeping whole operator pipelines resident on device — cuDF's
+fused filter/project paths and GpuHashAggregateExec running its update
+pass directly on the scan output — instead of materializing every
+operator boundary to HBM and reading it back.
+
+Three things the fused program buys over the stock per-operator path:
+
+- XLA sees the whole chain in one trace, so filter masks, projection
+  arithmetic and the aggregate update fuse into one kernel schedule
+  with no intermediate batch round-tripping through HBM;
+- the input batch's buffers can be DONATED to the program
+  (``donate_argnums``) on non-CPU backends, letting XLA alias them for
+  scratch/output instead of allocating fresh device memory;
+- one compiled program per distinct chain shape, reused by every
+  partition of every query with the same structure (the registry key
+  covers the expression trees and schemas, nothing per-instance).
+
+Correctness contract: the fused program is the literal composition of
+the same stage functions the unfused operators trace (``FilterExec.
+_filter``, ``ProjectExec._project``, ``HashAggregateExec._update``),
+so fused output is bit-identical to unfused output per batch —
+``tests/test_fusion.py`` proves this on NDS queries and the matcher
+refuses any chain whose semantics depend on host-side state (eager
+expressions, partition-context expressions).
+
+OOM handling: each input batch runs through the memory framework's
+``with_retry`` with the standard halve-by-rows split policy, so a
+RetryOOM spills-and-retries and a SplitAndRetryOOM re-enters the fused
+program on each half. Retryable OOMs are raised by the python-side
+budget/fault layer BEFORE the program launches, so donation (which
+consumes the input on launch) composes with retry.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar.vector import ColumnarBatch
+from ..jit_registry import shared_fn_jit
+from ..jit_registry import stats as _registry_stats
+from ..ops import kernels as K
+from .base import ExecContext, Metric, NvtxTimer, Schema, TpuExec
+
+#: module-level fusion tally (bench reads this + the registry's
+#: per-module stats to report compile reuse across a sweep)
+FUSION_STATS = {"chains": 0, "stages": 0}
+
+#: HashAggregateExec fields the fused terminal stage reads, in spec
+#: order (must stay in sync with the agg spec built in __init__)
+_AGG_FIELDS = ("group_exprs", "agg_exprs", "_key_names",
+               "_state_schemas", "_result_schema", "_packed_schema")
+
+
+def fusion_stats() -> dict:
+    """Chains/stages fused this process plus the jit-registry share
+    charged to this module (hits = compiled-program reuse)."""
+    s = dict(FUSION_STATS)
+    s["registry"] = _registry_stats(module=__name__)
+    return s
+
+
+def _row_stage_fn(spec):
+    kind = spec[0]
+    if kind == "filter":
+        cond = spec[1]
+
+        def filt(batch: ColumnarBatch) -> ColumnarBatch:
+            return K.filter_batch(batch, cond.eval(batch))
+        return filt
+    exprs, names = spec[1], spec[2]
+
+    def proj(batch: ColumnarBatch) -> ColumnarBatch:
+        return ColumnarBatch([e.eval(batch) for e in exprs],
+                             list(names), batch.num_rows)
+    return proj
+
+
+def _agg_shell(spec):
+    from .aggregate import HashAggregateExec
+    shell = object.__new__(HashAggregateExec)
+    for name, val in zip(_AGG_FIELDS, spec[3:]):
+        setattr(shell, name, list(val))
+    shell._pallas_max_cap = int(spec[2])
+    return shell
+
+
+def _fused_program_builder(specs):
+    """MODULE-LEVEL builder for shared_fn_jit: the fused per-batch
+    program, a pure function of the stage specs.
+
+    Non-aggregate chains: ``run(batch) -> batch``. Aggregate-terminated
+    chains: ``run(batch, row_offset) -> (packed, rows_in, pallas_used)``
+    where ``rows_in`` (rows that reached the update pass) advances the
+    caller's row_offset and ``pallas_used`` reports the grouped MXU
+    lane's per-batch engagement.
+    """
+    specs = tuple(specs)
+    terminal = specs[-1]
+    has_agg = terminal[0] == "agg"
+    stage_fns = [_row_stage_fn(s) for s in
+                 (specs[:-1] if has_agg else specs)]
+    if not has_agg:
+        def run(batch):
+            for f in stage_fns:
+                batch = f(batch)
+            return batch
+        return run
+    shell = _agg_shell(terminal)
+    use_pallas = bool(terminal[1])
+
+    def run_agg(batch, row_offset):
+        for f in stage_fns:
+            batch = f(batch)
+        rows_in = batch.num_rows
+        if use_pallas:
+            packed, used = shell._update_pallas(batch, row_offset)
+        else:
+            packed = shell._update(batch, row_offset)
+            used = jnp.bool_(False)
+        return packed, rows_in, used
+    return run_agg
+
+
+def _schema_row_bytes(schema: Schema) -> int:
+    """Estimated device bytes per capacity slot for ``schema`` (data +
+    validity lane); variable-width columns counted at a nominal 16B."""
+    total = 0
+    for _, t in schema:
+        phys = getattr(t, "physical", None)
+        if phys is None:
+            total += 16
+        else:
+            try:
+                total += jnp.dtype(phys).itemsize
+            except Exception:
+                total += 16
+        total += 1  # validity
+    return total
+
+
+class FusedPipelineExec(TpuExec):
+    """A planner-fused linear chain executed as one jitted program.
+
+    ``stages`` are the ORIGINAL exec nodes in application order
+    (bottom-up: filter before project before partial aggregate); they
+    are kept both as the source of the fused program's specs and so
+    tree consumers that must see through the fusion (mesh lowering,
+    DPP's column-passthrough walk) can reuse the unfused chain — the
+    stage nodes still reference their original children.
+    """
+
+    def __init__(self, source: TpuExec, stages: List[TpuExec],
+                 use_pallas: bool = False, pallas_max_cap: int = 1 << 24,
+                 donate: bool = False):
+        super().__init__(source)
+        from .aggregate import HashAggregateExec
+        from .basic import FilterExec, ProjectExec
+        self.stages = list(stages)
+        terminal = self.stages[-1]
+        self._agg = terminal if isinstance(terminal, HashAggregateExec) \
+            else None
+        self._use_pallas = bool(use_pallas and self._agg is not None)
+        self._schema = list(terminal.output_schema)
+        specs = []
+        for st in self.stages:
+            if isinstance(st, FilterExec):
+                specs.append(("filter", st.condition))
+            elif isinstance(st, ProjectExec):
+                specs.append(("project", tuple(st.exprs),
+                              tuple(n for n, _ in st.output_schema)))
+            else:
+                specs.append(("agg", self._use_pallas,
+                              int(pallas_max_cap)) +
+                             tuple(tuple(getattr(st, f))
+                                   for f in _AGG_FIELDS))
+        self._specs = tuple(specs)
+        # donation is only sound when the source's buffers are
+        # single-use (planner gates on file scans) and only effective
+        # off-CPU (the CPU backend ignores donations with a warning)
+        self.donate = bool(donate) and jax.default_backend() != "cpu"
+        jit_kwargs = {"donate_argnums": (0,)} if self.donate else {}
+        self._fn = shared_fn_jit(_fused_program_builder, self._specs,
+                                 **jit_kwargs)
+        # bytes an unfused pipeline would materialize per capacity slot
+        # at every internal operator boundary (each non-terminal
+        # stage's output batch) — the HBM round-trips fusion removes
+        self._saved_bytes_per_slot = sum(
+            _schema_row_bytes(st.output_schema)
+            for st in self.stages[:-1])
+        FUSION_STATS["chains"] += 1
+        FUSION_STATS["stages"] += len(self.stages)
+
+    @property
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    @property
+    def output_partitioning(self):
+        return self.stages[-1].output_partitioning
+
+    def node_description(self) -> str:
+        inner = " -> ".join(type(s).__name__ for s in self.stages)
+        tags = []
+        if self._use_pallas:
+            tags.append("pallas")
+        if self.donate:
+            tags.append("donate")
+        tag = f" ({', '.join(tags)})" if tags else ""
+        return f"FusedPipeline[{inner}]{tag}"
+
+    # --- per-stage attribution (tracer-gated calibration) ---
+    def _calibrate(self, ctx: ExecContext, batch: ColumnarBatch,
+                   row_offset: int, metrics) -> None:
+        """Run the first batch stage-by-stage through the operators'
+        own jitted functions, timing each with a device sync, and emit
+        one ``fused:<Stage>`` span + metric per stage. This is the
+        per-stage op-time attribution for the fused program (which is
+        opaque to host timers); outputs are discarded — the stream's
+        results always come from the fused program. Only runs when the
+        span tracer is on, and only once per execution."""
+        import time as _time
+        parent = None
+        for frame in reversed(ctx.timer_stack):
+            sp = getattr(frame, "_span", None)
+            if sp is not None:
+                parent = sp.span_id
+                break
+        if parent is None:
+            parent = ctx.tracer.current_id()
+        cur = batch
+        off = jnp.int64(row_offset)
+        for i, st in enumerate(self.stages):
+            name = f"fused:{type(st).__name__}"
+            span = ctx.tracer.begin(
+                name, kind="operator", parent=parent,
+                attrs={"stage": i, "fused_in": self.exec_id,
+                       "desc": st.node_description()})
+            t0 = _time.perf_counter_ns()
+            if st is self._agg:
+                cur = st._jit_update(cur, off)
+            else:
+                cur = st._jit(cur)
+            jax.block_until_ready(cur)
+            ns = _time.perf_counter_ns() - t0
+            ctx.tracer.end(span)
+            mname = f"fusedStageTime.{i}.{type(st).__name__}"
+            metrics.setdefault(
+                mname, Metric(mname, Metric.MODERATE, "ns")).add(ns)
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        from ..memory.retry import (split_spillable_in_half_by_rows,
+                                    with_retry)
+        from ..memory.spill import SpillableBatch, SpillPriority
+        m = ctx.metrics_for(self.exec_id)
+        fused_ops = m.setdefault("fusedOps",
+                                 Metric("fusedOps", Metric.ESSENTIAL))
+        saved = m.setdefault(
+            "fusionBytesSaved",
+            Metric("fusionBytesSaved", Metric.ESSENTIAL, "B"))
+        fuse_time = m.setdefault("fusedTime",
+                                 Metric("fusedTime", Metric.MODERATE,
+                                        "ns"))
+        fused_ops.set(len(self.stages))
+        state = {"offset": 0}
+        used_flags: List = []
+        calibrated = ctx.tracer is None
+
+        def run_one(sb):
+            batch = sb.get()
+            with ctx.semaphore, NvtxTimer(fuse_time, "fused"):
+                if self._agg is not None:
+                    out, rows_in, used = self._fn(
+                        batch, jnp.int64(state["offset"]))
+                    n_in = int(rows_in)
+                    state["offset"] += n_in
+                    if n_in == 0:
+                        # the unfused aggregate never sees (and never
+                        # emits a partial for) a batch that filtered
+                        # down to nothing (_partial_stream skips them)
+                        sb.close()
+                        return None
+                    if self._use_pallas:
+                        used_flags.append(used)
+                else:
+                    out = self._fn(batch)
+            saved.add(self._saved_bytes_per_slot * int(batch.capacity))
+            sb.close()
+            return out
+
+        for batch in self.children[0].execute(ctx):
+            if int(batch.num_rows) == 0:
+                continue
+            if not calibrated:
+                self._calibrate(ctx, batch, state["offset"], m)
+                calibrated = True
+            sb = SpillableBatch(batch, SpillPriority.ACTIVE_ON_DECK)
+            for out in with_retry(
+                    sb, run_one,
+                    split_policy=split_spillable_in_half_by_rows):
+                if out is not None:
+                    yield out
+        if used_flags:
+            pb = m.setdefault("pallasBatches",
+                              Metric("pallasBatches", Metric.DEBUG))
+            pb.add(sum(int(u) for u in used_flags))

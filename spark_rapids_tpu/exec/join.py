@@ -471,6 +471,13 @@ class _HashJoinBase(TpuExec):
                              LocalLimitExec, PrefetchExec)):
             yield from self._dpp_scans(node.children[0], name)
             return
+        from .fused import FusedPipelineExec
+        if isinstance(node, FusedPipelineExec):
+            # see through the fusion wrapper via the original chain —
+            # the stage nodes keep their unfused child links, so the
+            # usual Project/Filter pass-through rules apply unchanged
+            yield from self._dpp_scans(node.stages[-1], name)
+            return
         # unknown/multi-child operator: don't assume pass-through
 
     def _runtime_partition_prune(self, ctx: ExecContext,

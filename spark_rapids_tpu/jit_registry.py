@@ -41,13 +41,24 @@ from __future__ import annotations
 
 import os
 import threading
-from typing import Callable, Dict, Sequence
+from typing import Callable, Dict, Optional, Sequence
 
 import jax
 
 _REGISTRY: Dict = {}
 _LOCK = threading.Lock()
 _STATS = {"hits": 0, "misses": 0, "uncached": 0}
+# per defining module (builder's or method class's __module__), so a
+# subsystem can report ITS share — e.g. bench reads the fused-pipeline
+# compile reuse rate from module "spark_rapids_tpu.exec.fused"
+_MODULE_STATS: Dict[str, Dict[str, int]] = {}
+
+
+def _count(module: str, kind: str) -> None:
+    _STATS[kind] += 1
+    m = _MODULE_STATS.setdefault(
+        module, {"hits": 0, "misses": 0, "uncached": 0})
+    m[kind] += 1
 
 _ENABLED = os.environ.get("SRT_JIT_REGISTRY", "1") != "0"
 
@@ -86,7 +97,8 @@ def shared_method_jit(obj, method_name: str, fields: Sequence[str],
     cls = type(obj)
     enc = _encode([getattr(obj, f) for f in fields]) if _ENABLED else None
     if enc is None:
-        _STATS["uncached"] += 1
+        with _LOCK:
+            _count(cls.__module__, "uncached")
         return jax.jit(getattr(obj, method_name), **jit_kwargs)
     key = (cls.__module__, cls.__qualname__, method_name, tuple(fields),
            enc, tuple(extra),
@@ -94,14 +106,14 @@ def shared_method_jit(obj, method_name: str, fields: Sequence[str],
     with _LOCK:
         fn = _REGISTRY.get(key)
         if fn is not None:
-            _STATS["hits"] += 1
+            _count(cls.__module__, "hits")
             return fn
         shell = object.__new__(cls)
         for f in fields:
             setattr(shell, f, getattr(obj, f))
         fn = jax.jit(getattr(shell, method_name), **jit_kwargs)
         _put(key, fn)
-        _STATS["misses"] += 1
+        _count(cls.__module__, "misses")
     return fn
 
 
@@ -115,7 +127,8 @@ def shared_fn_jit(builder: Callable, *key_args, **jit_kwargs) -> Callable:
     """
     enc = _encode(list(key_args)) if _ENABLED else None
     if enc is None:
-        _STATS["uncached"] += 1
+        with _LOCK:
+            _count(builder.__module__, "uncached")
         return jax.jit(builder(*key_args), **jit_kwargs)
     key = (builder.__module__,
            getattr(builder, "__qualname__", builder.__name__), enc,
@@ -123,18 +136,27 @@ def shared_fn_jit(builder: Callable, *key_args, **jit_kwargs) -> Callable:
     with _LOCK:
         fn = _REGISTRY.get(key)
         if fn is not None:
-            _STATS["hits"] += 1
+            _count(builder.__module__, "hits")
             return fn
         fn = jax.jit(builder(*key_args), **jit_kwargs)
         _put(key, fn)
-        _STATS["misses"] += 1
+        _count(builder.__module__, "misses")
     return fn
 
 
-def stats() -> dict:
-    s = dict(_STATS)
-    s["entries"] = len(_REGISTRY)
-    return s
+def stats(module: Optional[str] = None) -> dict:
+    """Registry counters; with ``module``, only the hits/misses/
+    uncached charged to wrappers defined in that module (plus the
+    module's live entry count)."""
+    with _LOCK:
+        if module is not None:
+            s = dict(_MODULE_STATS.get(
+                module, {"hits": 0, "misses": 0, "uncached": 0}))
+            s["entries"] = sum(1 for k in _REGISTRY if k[0] == module)
+            return s
+        s = dict(_STATS)
+        s["entries"] = len(_REGISTRY)
+        return s
 
 
 def clear() -> None:
@@ -145,3 +167,4 @@ def clear() -> None:
     with _LOCK:
         _REGISTRY.clear()
         _STATS.update(hits=0, misses=0, uncached=0)
+        _MODULE_STATS.clear()

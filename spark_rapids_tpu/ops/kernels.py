@@ -435,11 +435,18 @@ def pallas_group_fns_ok(agg_inputs: Sequence[Optional[Column]],
     return lanes <= 128
 
 
+#: one PallasCapacityFallback event per process: the capacity gate is
+#: static per compiled program, so the event would otherwise repeat for
+#: every trace of every over-capacity shape
+_CAP_FALLBACK_WARNED = [False]
+
+
 def group_aggregate_pallas(batch: ColumnarBatch, key_cols: Sequence[Column],
                            agg_inputs: Sequence[Optional[Column]],
                            agg_fns: Sequence, row_offset=0,
                            num_buckets: int = 1024,
-                           interpret: Optional[bool] = None
+                           interpret: Optional[bool] = None,
+                           max_capacity: int = 1 << 24,
                            ) -> Tuple[ColumnarBatch, List[dict], jnp.ndarray]:
     """Grouped update pass with the pallas one-hot MXU lane.
 
@@ -472,14 +479,28 @@ def group_aggregate_pallas(batch: ColumnarBatch, key_cols: Sequence[Column],
                                     perm=None if fast else perm))
         return key_batch, states
 
+    # counts accumulate in float32 lanes on the MXU: a group can hold
+    # at most `cap` rows, and float32 represents integers exactly only
+    # below 2^24 — batches at or past the ceiling must take the stock
+    # integer path or Count/CountStar drift. The ceiling is
+    # conf-controlled (srt.exec.pallas.groupAgg.maxCapacity); raising
+    # it past 2^24 trades Count exactness for MXU throughput.
+    cap_ok = cap < int(max_capacity)
     if not (_use_hash_grouping(batch, key_cols, agg_fns)
             and cap >= num_buckets
-            # counts accumulate in float32 lanes on the MXU: a group
-            # can hold at most `cap` rows, and float32 represents
-            # integers exactly only below 2^24 — larger batches must
-            # take the stock integer path or Count/CountStar drift
-            and cap < (1 << 24)
+            and cap_ok
             and pallas_group_fns_ok(agg_inputs, agg_fns)):
+        if (not cap_ok and not _CAP_FALLBACK_WARNED[0]
+                and _use_hash_grouping(batch, key_cols, agg_fns)
+                and cap >= num_buckets
+                and pallas_group_fns_ok(agg_inputs, agg_fns)):
+            # only the capacity ceiling blocked the MXU lane: surface
+            # it once so fusion's terminal-stage choice is observable
+            _CAP_FALLBACK_WARNED[0] = True
+            from ..obs import events as _events
+            _events.emit("PallasCapacityFallback", scope="pallas",
+                         capacity=int(cap),
+                         max_capacity=int(max_capacity))
         kb, st = group_aggregate(batch, key_cols, agg_inputs, agg_fns,
                                  row_offset)
         return kb, st, jnp.bool_(False)

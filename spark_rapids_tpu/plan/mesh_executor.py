@@ -208,6 +208,13 @@ class MeshQueryExecutor:
             # mesh program: transparent pass-through
             return self._lower(node.children[0])
 
+        from ..exec.fused import FusedPipelineExec
+        if isinstance(node, FusedPipelineExec):
+            # the whole mesh program is already one traced jit, so the
+            # fusion wrapper adds nothing here: lower the original
+            # chain (stage nodes keep their unfused child links)
+            return self._lower(node.stages[-1])
+
         if isinstance(node, UnionExec):
             kids = [self._lower(c) for c in node.children]
 

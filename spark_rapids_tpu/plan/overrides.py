@@ -18,8 +18,10 @@ from typing import Callable, Dict, List, Optional, Type
 
 from ..columnar import dtypes as dt
 from ..conf import (BROADCAST_THRESHOLD_ROWS, EXCHANGE_ENABLED, EXPLAIN,
-                    PIPELINE_ENABLED, SHUFFLE_PARTITIONS, SQL_ENABLED,
-                    SrtConf, active_conf)
+                    FUSION_DONATE, FUSION_ENABLED, FUSION_EXCLUDE_EXECS,
+                    PALLAS_ENABLED, PALLAS_GROUP_MAX_CAPACITY,
+                    PALLAS_GROUPED_ENABLED, PIPELINE_ENABLED,
+                    SHUFFLE_PARTITIONS, SQL_ENABLED, SrtConf, active_conf)
 from ..exec.aggregate import HashAggregateExec
 from ..exec.base import TpuExec
 from ..exec.basic import (BatchScanExec, CoalesceBatchesExec, ExpandExec,
@@ -1095,8 +1097,143 @@ def apply_overrides(plan: LogicalPlan, conf: Optional[SrtConf] = None):
             print("\n".join(lines))
     root = _ensure_physical(_to_physical(meta, conf), conf)
     _count_exchange_consumers(root)
+    root = _insert_fusion(root, conf)
     root = _insert_pipeline(plan, root, conf)
     return root
+
+
+def _fusion_blocked_exprs(exprs) -> bool:
+    """Expressions a fused program cannot reproduce: eager trees (must
+    evaluate un-jitted so data-dependent raises reach the caller) and
+    partition-context expressions (read ``ctx.partition_id`` / the
+    input-file TLS through ``traced_context``, which the fused program
+    does not thread)."""
+    from ..expr.misc import (InputFileName, MonotonicallyIncreasingID,
+                             SparkPartitionID, _InputFileBlock,
+                             contains_eager)
+    if contains_eager(exprs):
+        return True
+    ctx_types = (InputFileName, _InputFileBlock, SparkPartitionID,
+                 MonotonicallyIncreasingID)
+
+    def walk(e) -> bool:
+        if isinstance(e, ctx_types):
+            return True
+        return any(walk(c) for c in e.children)
+
+    return any(walk(e) for e in exprs)
+
+
+def _insert_fusion(root, conf: SrtConf):
+    """Operator-fusion pass (exec/fused.py): collapse linear
+    scan -> filter -> project -> partial-aggregate chains (and their
+    filter/project-only prefixes) into one FusedPipelineExec whose
+    per-batch compute is a single shared-jit program, so intermediate
+    batches never materialize between operators and XLA schedules the
+    whole chain as one program.
+
+    Matching is top-down from each chain terminal (a PARTIAL
+    HashAggregateExec, else the topmost Filter/Project): consecutive
+    Filter/Project stages are absorbed downward until the chain bottoms
+    out at a scan; a chain shorter than two stages, or whose ultimate
+    source is not a scan, stays unfused. A no-op CoalesceBatchesExec
+    (target_rows=None — re-batches to the session default without
+    changing boundaries' semantics) does not break the match: it stays
+    in place as (part of) the fused node's source subtree and the
+    matcher looks through it when checking for the scan.
+
+    Opt-outs: ``srt.exec.fusion.enabled`` kills the pass;
+    ``srt.exec.fusion.excludeExecs`` breaks chains at the named
+    classes; stages with eager or partition-context expressions never
+    fuse (``_fusion_blocked_exprs``); a terminal aggregate eligible for
+    the global-agg pallas lane stays unfused so
+    ``_pallas_stream_or_none`` keeps its direct Filter-child peek.
+    When the grouped pallas lane is fully enabled the fused program
+    uses ``_update_pallas`` as its terminal stage instead of the stock
+    update — pallas_agg as a fusable terminal."""
+    if not conf.get(FUSION_ENABLED):
+        return root
+    from ..exec import pallas_agg
+    from ..exec.aggregate import PARTIAL
+    from ..exec.fused import FusedPipelineExec
+    from ..io.scan import FileSourceScanExec
+    excludes = {s.strip() for s in
+                conf.get(FUSION_EXCLUDE_EXECS).split(",") if s.strip()}
+    pallas_on = conf.get(PALLAS_ENABLED)
+    grouped_conf = pallas_on and conf.get(PALLAS_GROUPED_ENABLED)
+    donate_conf = conf.get(FUSION_DONATE)
+    max_cap = conf.get(PALLAS_GROUP_MAX_CAPACITY)
+
+    def stage_ok(n) -> bool:
+        if type(n).__name__ in excludes:
+            return False
+        if isinstance(n, FilterExec):
+            return not _fusion_blocked_exprs([n.condition])
+        if isinstance(n, ProjectExec):
+            return not _fusion_blocked_exprs(n.exprs)
+        return False
+
+    def agg_ok(a) -> bool:
+        if type(a).__name__ in excludes or a.mode != PARTIAL or a._eager:
+            return False
+        if _fusion_blocked_exprs(list(a.group_exprs) +
+                                 [fn for fn, _ in a.agg_exprs]):
+            return False
+        # the global-aggregate pallas lane peeks at the agg's direct
+        # Filter child (_pallas_stream_or_none); fusing would steal it
+        if a._pallas_gate and pallas_on:
+            return False
+        return True
+
+    def through_noop_coalesce(n):
+        while isinstance(n, CoalesceBatchesExec) and n.target_rows is None:
+            n = n.children[0]
+        return n
+
+    def try_fuse(n):
+        stages = []
+        cur = n
+        if isinstance(cur, HashAggregateExec):
+            if not agg_ok(cur):
+                return n
+            stages.append(cur)
+            cur = cur.children[0]
+        while stage_ok(cur):
+            stages.append(cur)
+            cur = cur.children[0]
+        if len(stages) < 2:
+            return n
+        src = through_noop_coalesce(cur)
+        if not isinstance(src, (BatchScanExec, FileSourceScanExec)):
+            return n
+        stages.reverse()  # application order, bottom-up
+        terminal = stages[-1]
+        use_pallas = bool(
+            isinstance(terminal, HashAggregateExec) and grouped_conf
+            and terminal._pallas_grouped_gate
+            and pallas_agg.grouped_lane_on()
+            and pallas_agg.grouped_kernel_ok())
+        # donation is sound only when the source's buffers are
+        # single-use: file scans decode fresh arrays per run;
+        # BatchScanExec re-yields the same in-memory arrays on re-runs
+        donate = bool(donate_conf and isinstance(src, FileSourceScanExec))
+        return FusedPipelineExec(cur, stages, use_pallas=use_pallas,
+                                 pallas_max_cap=max_cap, donate=donate)
+
+    def walk(n):
+        if isinstance(n, (HashAggregateExec, FilterExec, ProjectExec)):
+            fused = try_fuse(n)
+            if fused is not n:
+                # below the fused node only scan-ish sources remain
+                # (scan, or no-op coalesce over scan) — nothing fusable
+                return fused
+        kids = getattr(n, "children", None)
+        if kids:
+            for i, c in enumerate(kids):
+                kids[i] = walk(c)
+        return n
+
+    return walk(root)
 
 
 def _plan_is_pipeline_safe(plan: LogicalPlan) -> bool:

@@ -1,0 +1,326 @@
+"""Operator-fusion tests: planner chain matching, fused-vs-unfused
+bit-identity (hand-built chains + NDS queries), OOM split-and-retry
+re-entering the fused program, and compiled-program reuse through the
+shared jit registry."""
+
+import os
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columnar.vector import (batch_from_pydict,
+                                              batch_to_pydict)
+from spark_rapids_tpu.conf import SrtConf
+from spark_rapids_tpu.exec import (BatchScanExec, CoalesceBatchesExec,
+                                   ExecContext, FilterExec,
+                                   FusedPipelineExec, HashAggregateExec,
+                                   ProjectExec)
+from spark_rapids_tpu.exec.aggregate import FINAL, PARTIAL
+from spark_rapids_tpu.expr import col, input_file_name, spark_partition_id
+from spark_rapids_tpu.expr.aggregates import CountStar, Sum
+from spark_rapids_tpu.memory.budget import reset_task_context
+from spark_rapids_tpu.plan.overrides import _insert_fusion
+
+
+def scan(data, capacity=None, nbatches=1):
+    n = len(next(iter(data.values())))
+    per = -(-n // nbatches)
+    batches = []
+    for i in range(0, n, per):
+        chunk = {k: v[i:i + per] for k, v in data.items()}
+        batches.append(batch_from_pydict(chunk, capacity=capacity))
+    schema = batches[0].schema() if batches else []
+    return BatchScanExec(batches, schema)
+
+
+def collect(node):
+    ctx = ExecContext()
+    names = [n for n, _ in node.output_schema]
+    rows = {n: [] for n in names}
+    for batch in node.execute(ctx):
+        d = batch_to_pydict(batch)
+        for n in names:
+            rows[n].extend(d[n])
+    return rows
+
+
+def _chain_data(n=200):
+    rng = np.random.default_rng(11)
+    return {"k": rng.integers(0, 8, n).tolist(),
+            "v": rng.integers(-50, 50, n).tolist()}
+
+
+def _chain(data, nbatches=4, coalesce=None):
+    """scan [-> coalesce] -> filter -> project -> partial agg."""
+    src = scan(data, nbatches=nbatches)
+    if coalesce is not None:
+        src = CoalesceBatchesExec(src, target_rows=coalesce)
+    filt = FilterExec(src, col("v") > -20)
+    proj = ProjectExec(filt, [col("k"), (col("v") * 2).alias("v2")])
+    return HashAggregateExec(proj, [col("k")],
+                             [(Sum(col("v2")), "s"), (CountStar(), "n")],
+                             mode=PARTIAL)
+
+
+def _totals(out):
+    """Sum every packed partial-state column per group key (partial
+    aggregate states — sums and counts — merge by addition)."""
+    val_cols = [c for c in out if c != "k"]
+    agg = {}
+    for i, k in enumerate(out["k"]):
+        vals = tuple(out[c][i] for c in val_cols)
+        cur = agg.get(k)
+        agg[k] = vals if cur is None else \
+            tuple(a + b for a, b in zip(cur, vals))
+    return agg
+
+
+def _has_fused(node):
+    if isinstance(node, FusedPipelineExec):
+        return True
+    return any(_has_fused(c) for c in getattr(node, "children", []))
+
+
+# --------------------------------------------------------------------------
+# planner matching rules
+# --------------------------------------------------------------------------
+
+def test_fuse_filter_project_partial_agg_chain():
+    root = _insert_fusion(_chain(_chain_data()), SrtConf({}))
+    assert isinstance(root, FusedPipelineExec)
+    assert [type(s).__name__ for s in root.stages] == \
+        ["FilterExec", "ProjectExec", "HashAggregateExec"]
+    # fused node advertises the terminal's schema
+    assert [n for n, _ in root.output_schema] == \
+        [n for n, _ in root.stages[-1].output_schema]
+
+
+def test_fusion_conf_disabled_leaves_plan_alone():
+    tree = _chain(_chain_data())
+    root = _insert_fusion(tree, SrtConf({"srt.exec.fusion.enabled":
+                                         "false"}))
+    assert root is tree and not _has_fused(root)
+
+
+def test_context_sensitive_exprs_stay_unfused():
+    data = _chain_data()
+    # traced partition context in the filter condition
+    t1 = FilterExec(ProjectExec(scan(data), [col("k"), col("v")]),
+                    (col("v") + spark_partition_id()) > 0)
+    assert not _has_fused(_insert_fusion(t1, SrtConf({})))
+    # eager host-side expression in the projection
+    t2 = ProjectExec(FilterExec(scan(data), col("v") > 0),
+                     [col("k"), input_file_name().alias("f")])
+    assert not _has_fused(_insert_fusion(t2, SrtConf({})))
+
+
+def test_exclude_list_breaks_chain():
+    conf = SrtConf({"srt.exec.fusion.excludeExecs": "FilterExec"})
+    root = _insert_fusion(_chain(_chain_data()), conf)
+    assert not _has_fused(root)
+    # excluding only the aggregate still fuses the filter->project prefix
+    conf2 = SrtConf({"srt.exec.fusion.excludeExecs": "HashAggregateExec"})
+    root2 = _insert_fusion(_chain(_chain_data()), conf2)
+    assert isinstance(root2, HashAggregateExec)
+    assert isinstance(root2.children[0], FusedPipelineExec)
+    assert [type(s).__name__ for s in root2.children[0].stages] == \
+        ["FilterExec", "ProjectExec"]
+
+
+def test_final_agg_terminal_not_fused():
+    data = _chain_data()
+    tree = HashAggregateExec(
+        ProjectExec(FilterExec(scan(data), col("v") > 0),
+                    [col("k"), col("v")]),
+        [col("k")], [(Sum(col("v")), "s")], mode=FINAL)
+    root = _insert_fusion(tree, SrtConf({}))
+    # the FINAL agg is never a fused terminal; its filter->project
+    # child prefix still fuses
+    assert isinstance(root, HashAggregateExec) and root.mode == FINAL
+    assert isinstance(root.children[0], FusedPipelineExec)
+
+
+def test_noop_coalesce_seen_through_explicit_blocks():
+    data = _chain_data()
+    fused = _insert_fusion(_chain(data, coalesce=None), SrtConf({}))
+    tree_noop = HashAggregateExec(
+        ProjectExec(FilterExec(CoalesceBatchesExec(scan(data, nbatches=4)),
+                               col("v") > -20),
+                    [col("k"), (col("v") * 2).alias("v2")]),
+        [col("k")], [(Sum(col("v2")), "s"), (CountStar(), "n")],
+        mode=PARTIAL)
+    root = _insert_fusion(tree_noop, SrtConf({}))
+    assert isinstance(root, FusedPipelineExec)
+    # the no-op coalesce stays in place as the fused node's input
+    assert isinstance(root.children[0], CoalesceBatchesExec)
+    # regression: see-through must not change results (int aggregates
+    # so partial states compare exactly); the noop-coalesced lane
+    # produces the same GROUPED TOTALS even though batch boundaries
+    # (and so partial-output rows) differ
+    assert _totals(collect(root)) == _totals(collect(fused))
+    # an explicit repartitioning coalesce breaks the chain
+    blocked = _insert_fusion(_chain(data, coalesce=64), SrtConf({}))
+    assert not _has_fused(blocked)
+
+
+# --------------------------------------------------------------------------
+# fused-vs-unfused bit-identity
+# --------------------------------------------------------------------------
+
+def test_fused_bit_identical_to_unfused_chain():
+    data = _chain_data(500)
+    unfused = _chain(data, nbatches=5)
+    fused = _insert_fusion(_chain(data, nbatches=5), SrtConf({}))
+    assert isinstance(fused, FusedPipelineExec)
+    assert collect(fused) == collect(unfused)
+
+
+def test_fused_skips_batches_filtered_to_empty():
+    # one batch filters down to zero rows: the unfused partial stream
+    # emits no partial for it and the fused lane must match
+    data = {"k": [1] * 10 + [2] * 10, "v": [-100] * 10 + [5] * 10}
+    unfused = _chain(data, nbatches=2)
+    fused = _insert_fusion(_chain(data, nbatches=2), SrtConf({}))
+    assert collect(fused) == collect(unfused)
+
+
+def _nds_bit_identity(tmp_path, scale_rows, qids):
+    from spark_rapids_tpu.conf import SrtConf as C
+    from spark_rapids_tpu.datagen import generate_table
+    from spark_rapids_tpu.models.nds import NDS_QUERIES, nds_specs
+    from spark_rapids_tpu.plan.session import TpuSession
+
+    def run(fusion):
+        session = TpuSession(C({
+            "srt.shuffle.partitions": 2,
+            "srt.exec.fusion.enabled": "true" if fusion else "false",
+        }))
+        data_dir = os.path.join(str(tmp_path), "nds")
+        needed = {"store_sales", "date_dim", "item"}
+        for spec in nds_specs(scale_rows):
+            if spec.name not in needed:
+                continue
+            out = os.path.join(data_dir, spec.name)
+            if not os.path.exists(out):
+                generate_table(session, spec, out, chunk_rows=1 << 16)
+            session.create_or_replace_temp_view(
+                spec.name, session.read.parquet(out))
+        return {q: session.sql(NDS_QUERIES[q]).collect() for q in qids}
+
+    fused, unfused = run(True), run(False)
+    for q in qids:
+        assert fused[q] == unfused[q], f"{q} diverged under fusion"
+
+
+def test_nds_fusion_bit_identical_quick(tmp_path):
+    """Fast tier-1 leg of the differential: 3 star queries at a scale
+    that keeps the test in seconds."""
+    _nds_bit_identity(tmp_path, 4_000, ("q3", "q42", "q52"))
+
+
+@pytest.mark.slow
+def test_nds_fusion_bit_identical_100k(tmp_path):
+    """The ISSUE's differential-proof scale: 100k store_sales rows,
+    three NDS queries, fusion on == fusion off bit-identically."""
+    _nds_bit_identity(tmp_path, 100_000, ("q3", "q42", "q52"))
+
+
+# --------------------------------------------------------------------------
+# OOM retry through the fused program
+# --------------------------------------------------------------------------
+
+def _arm_launch_oom(fused):
+    """Make the fused node's first program launch raise
+    SplitAndRetryOOM — the input batch is materialized (``sb.get()``)
+    but its buffers have NOT been handed to (donated into) the
+    program yet, which is exactly where real budget pressure raises.
+    Subsequent launches (the split halves) run the real program."""
+    from spark_rapids_tpu.memory.budget import SplitAndRetryOOM
+    real_fn, armed = fused._fn, [True]
+
+    def flaky(*a, **k):
+        if armed[0]:
+            armed[0] = False
+            raise SplitAndRetryOOM("injected before fused launch")
+        return real_fn(*a, **k)
+    fused._fn = flaky
+
+
+def test_fused_split_and_retry_reenters_program():
+    """A SplitAndRetryOOM on the first fused launch must split the
+    batch and re-enter the fused program on each half, losing no rows
+    and changing none."""
+    data = _chain_data(400)
+    # non-agg chain: filter -> project, so row payloads compare 1:1
+    tree = ProjectExec(FilterExec(scan(data, nbatches=1), col("v") > -20),
+                       [col("k"), (col("v") * 2).alias("v2")])
+    fused = _insert_fusion(tree, SrtConf({}))
+    assert isinstance(fused, FusedPipelineExec)
+    expected = collect(ProjectExec(
+        FilterExec(scan(data, nbatches=1), col("v") > -20),
+        [col("k"), (col("v") * 2).alias("v2")]))
+
+    ctx = reset_task_context()
+    _arm_launch_oom(fused)
+    try:
+        got = collect(fused)
+    finally:
+        reset_task_context()
+    assert got == expected
+    assert ctx.split_count == 1
+
+
+def test_fused_agg_split_and_retry():
+    """Same injection against an aggregate-terminated chain: the split
+    halves each run the fused update pass and the grouped totals
+    across all emitted partials are unchanged."""
+    data = _chain_data(300)
+    fused = _insert_fusion(_chain(data, nbatches=1), SrtConf({}))
+    baseline = collect(_chain(data, nbatches=1))
+
+    ctx = reset_task_context()
+    _arm_launch_oom(fused)
+    try:
+        got = collect(fused)
+    finally:
+        reset_task_context()
+    assert _totals(got) == _totals(baseline)
+    assert ctx.split_count == 1
+
+
+# --------------------------------------------------------------------------
+# compiled-program reuse
+# --------------------------------------------------------------------------
+
+def test_fused_program_shared_across_identical_chains():
+    """Two structurally identical chains (= two partitions / two
+    queries with the same shape) must share ONE registered fused
+    program: the second construction is a registry hit."""
+    from spark_rapids_tpu import jit_registry
+    data = _chain_data()
+
+    def mk():
+        # a chain shape unique to THIS test (output name "v3"), so the
+        # first build is a genuine registry miss even when other tests
+        # in the session already registered the _chain shape
+        proj = ProjectExec(FilterExec(scan(data, nbatches=2),
+                                      col("v") > -20),
+                           [col("k"), (col("v") * 3).alias("v3")])
+        return HashAggregateExec(proj, [col("k")],
+                                 [(Sum(col("v3")), "s"),
+                                  (CountStar(), "n")], mode=PARTIAL)
+
+    before = jit_registry.stats(module="spark_rapids_tpu.exec.fused")
+    f1 = _insert_fusion(mk(), SrtConf({}))
+    mid = jit_registry.stats(module="spark_rapids_tpu.exec.fused")
+    f2 = _insert_fusion(mk(), SrtConf({}))
+    after = jit_registry.stats(module="spark_rapids_tpu.exec.fused")
+    assert isinstance(f1, FusedPipelineExec)
+    assert isinstance(f2, FusedPipelineExec)
+    # first build mints (miss), second reuses (hit, no new entry)
+    assert mid["misses"] == before["misses"] + 1
+    assert after["hits"] == mid["hits"] + 1
+    assert after["misses"] == mid["misses"]
+    assert after["entries"] == mid["entries"]
+    # and both nodes produce identical output through the shared program
+    assert collect(f1) == collect(f2)

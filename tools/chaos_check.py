@@ -330,7 +330,11 @@ def main() -> int:
         }).write.parquet(fact_dir)
 
         def logical(sess):
+            # the filter keeps a scan -> filter -> partial-agg chain in
+            # the plan so the fusion legs actually execute the fused
+            # path (exec/fused.py) under fault injection
             return sess.read.parquet(fact_dir) \
+                .filter(col("v") < 8.0) \
                 .group_by("k").agg(Alias(Sum(col("v")), "s"),
                                    Alias(CountStar(), "c")) \
                 .sort("k")
@@ -345,15 +349,21 @@ def main() -> int:
         failures = 0
         events_dir = os.path.join(tmp, "events")
         event_offsets: dict = {}
-        # pipelining matrix: every plan runs with background prefetch
-        # producers enabled (faults now fire on producer threads and
-        # must still recover); the full sweep adds a synchronous leg so
-        # the pipeline-off path stays covered. The crash plan runs one
-        # leg only — it permanently costs a worker, and a rerun would
-        # arm a crash for an already-evicted worker id (an unwinnable
-        # plan, not a recovery bug).
-        legs = ([("on", "true")] if args.quick
-                else [("on", "true"), ("off", "false")])
+        # pipelining x fusion matrix: every plan runs with background
+        # prefetch producers AND operator fusion enabled (faults now
+        # fire on producer threads / inside the fused program and must
+        # still recover); the sweep adds a fusion-off leg so recovery
+        # behavior can be asserted IDENTICAL with and without fusion,
+        # and the full sweep keeps the synchronous (pipeline-off) leg.
+        # The crash plan runs one leg only — it permanently costs a
+        # worker, and a rerun would arm a crash for an already-evicted
+        # worker id (an unwinnable plan, not a recovery bug). Legs:
+        # (pipeline_label, pipeline, fusion_label, fusion)
+        legs = ([("on", "true", "on", "true"),
+                 ("on", "true", "off", "false")] if args.quick
+                else [("on", "true", "on", "true"),
+                      ("on", "true", "off", "false"),
+                      ("off", "false", "on", "true")])
 
         def _reseed(spec, offset):
             # each leg must be a fresh experiment: workers keep their
@@ -368,21 +378,30 @@ def main() -> int:
         runs = []
         for name, spec in plans:
             plan_legs = legs[:1] if (name, spec) == CRASH_PLAN else legs
-            for i, (leg_label, leg) in enumerate(plan_legs):
+            for i, (pipe_label, pipe, fuse_label, fuse) \
+                    in enumerate(plan_legs):
                 leg_spec = spec if i == 0 else _reseed(spec, 1000 * i)
-                runs.append((f"{name} | pipeline={leg_label}",
-                             leg_spec, leg))
+                runs.append((f"{name} | pipeline={pipe_label} "
+                             f"fusion={fuse_label}",
+                             name, fuse_label, leg_spec, pipe, fuse))
+        # per-(plan, fusion-leg) recovery deltas, compared after the
+        # sweep: a fault plan must recover the SAME way with fusion on
+        # and off
+        leg_recovery: dict = {}
         try:
             driver.wait_for_workers(timeout=120)
             prev_armed: set = set()
-            for name, spec, pipelined in runs:
+            for name, base_name, fuse_label, spec, pipelined, fused \
+                    in runs:
                 job_conf = {"srt.shuffle.partitions": 4,
                             "srt.cluster.barrierTimeoutSec": 60,
                             "srt.eventLog.enabled": "true",
                             "srt.eventLog.dir": events_dir,
                             "srt.exec.pipeline.enabled": pipelined,
+                            "srt.exec.fusion.enabled": fused,
                             "srt.test.faultPlan": spec}
                 t = time.monotonic()
+                recov_before = len(driver.recovery_events)
                 try:
                     rows = driver.run(logical(session).plan, job_conf)
                 except Exception as e:
@@ -394,6 +413,8 @@ def main() -> int:
                     continue
                 ok = _rows_match(rows, oracle)
                 recov = [e["type"] for e in driver.recovery_events]
+                leg_recovery[(base_name, fuse_label)] = \
+                    recov[recov_before:]
                 print(f"[chaos] {'PASS' if ok else 'FAIL'} [{name}] "
                       f"{time.monotonic() - t:.1f}s workers="
                       f"{driver.num_workers} recovery={recov}",
@@ -431,6 +452,18 @@ def main() -> int:
             print("[chaos] FAIL: crash plan produced no stage_retry "
                   "recovery event", file=sys.stderr, flush=True)
             failures += 1
+        # fusion must not change HOW a fault recovers: every plan run
+        # both ways must produce the same recovery-event sequence
+        for base in {b for b, _ in leg_recovery}:
+            on = leg_recovery.get((base, "on"))
+            off = leg_recovery.get((base, "off"))
+            if on is None or off is None:
+                continue
+            if on != off:
+                print(f"[chaos] FAIL [{base}]: recovery diverged "
+                      f"between fusion legs: on={on} off={off}",
+                      file=sys.stderr, flush=True)
+                failures += 1
     # deterministic local spill-corruption probe (no cluster involved)
     failures += _spill_corruption_check()
     # distributed-telemetry leg: 4-worker run, merged history report

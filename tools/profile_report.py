@@ -89,9 +89,30 @@ def analyze(q: dict) -> dict:
     total_op_ns = 0
     total_busy_ns = 0
     prefetch = {"wait_ns": 0, "depth_peak": 0, "bytes_peak": 0}
+    fusion = {"fused_execs": 0, "fused_ops": 0, "bytes_saved": 0,
+              "fused_op_ns": 0, "stages": []}
     for exec_id, metrics in q["metrics"].items():
         op_ns = _val(metrics, "opTime")
         total_op_ns += op_ns
+        if exec_id.startswith("FusedPipelineExec"):
+            # fusion-aware attribution (exec/fused.py): fusedOps counts
+            # collapsed operators; fusionBytesSaved estimates the
+            # operator-boundary HBM traffic the fused program removed;
+            # fusedStageTime.* is the tracer-gated per-stage calibration
+            fusion["fused_execs"] += 1
+            fusion["fused_ops"] += _val(metrics, "fusedOps")
+            fusion["bytes_saved"] += _val(metrics, "fusionBytesSaved")
+            fusion["fused_op_ns"] += op_ns
+            for name in metrics:
+                if name.startswith("fusedStageTime."):
+                    parts = name.split(".", 2)
+                    fusion["stages"].append({
+                        "exec_id": exec_id,
+                        "stage": int(parts[1]) if len(parts) > 1 and
+                        parts[1].isdigit() else -1,
+                        "op": parts[2] if len(parts) > 2 else "?",
+                        "calibrated_ns": _val(metrics, name),
+                    })
         # pipelined edges (exec/pipeline.py): prefetchWaitTime is the
         # slice of this operator's exclusive opTime spent blocked on an
         # empty prefetch queue — waiting, not compute; producer-side
@@ -147,6 +168,13 @@ def analyze(q: dict) -> dict:
                              if wall else 0.0,
         },
         "prefetch": prefetch,
+        "fusion": {
+            **fusion,
+            "stages": sorted(fusion["stages"],
+                             key=lambda s: (s["exec_id"], s["stage"])),
+            # fused vs unfused split of the summed exclusive op-time
+            "unfused_op_ns": max(total_op_ns - fusion["fused_op_ns"], 0),
+        },
         "operators": ops,
         "shuffles": shuffles,
         "spill": {
@@ -205,6 +233,21 @@ def render(rep: dict) -> str:
                 f"batches={o['batches']}"
                 + (f"  shuffleBytes={_fmt_bytes(o['shuffle_bytes'])}"
                    if o["shuffle_bytes"] else ""))
+    fu = rep.get("fusion", {})
+    if fu.get("fused_execs"):
+        lines.append(
+            f"  fusion: {fu['fused_execs']} fused pipeline(s) covering "
+            f"{fu['fused_ops']} operators, "
+            f"fused time={_fmt_ns(fu['fused_op_ns'])} vs "
+            f"unfused time={_fmt_ns(fu['unfused_op_ns'])}, "
+            f"boundary bytes saved={_fmt_bytes(fu['bytes_saved'])}")
+        if fu.get("stages"):
+            lines.append("    per-stage calibration (first batch, "
+                         "tracer runs only):")
+            for s in fu["stages"]:
+                lines.append(
+                    f"      {s['exec_id']} stage {s['stage']} "
+                    f"{s['op']}: {_fmt_ns(s['calibrated_ns'])}")
     if rep["shuffles"]:
         lines.append("  shuffle exchanges:")
         for sid, s in sorted(rep["shuffles"].items(),
