@@ -13,6 +13,12 @@ leaves the q6 number on stdout). Extra keys carry q1/q3 wall-clocks,
 the kernel-only q6 number (so regressions are attributable to kernels
 vs the pipeline around them), effective scan bandwidth, and a
 measured-roofline HBM utilization estimate for the kernel pipeline.
+Each query lane also records its first-iteration wall (``*_first_s``:
+compile + cache warmup, split from the steady-state best-of-N), the
+record embeds the jit-registry compile ledger (``compile_ledger``,
+per-module trace/lower/compile totals), and the run ends with a
+report-only perf-gate readout against the newest committed
+BENCH_r*.json (tools/perf_gate.py).
 
 Budget discipline (the round-2 bench TIMED OUT, rc=124, and recorded
 nothing): the backend probe is capped at 30s, the parquet inputs are
@@ -138,6 +144,48 @@ def embed_metrics() -> None:
         }
     except Exception as e:  # never let observability kill the bench
         log(f"metrics embed failed: {e}")
+
+
+def embed_compile_ledger() -> None:
+    """Fold the jit-registry compile ledger into the bench record
+    (RESULT["compile_ledger"]: per-module trace/lower/compile wall
+    totals + shared-program counts, spark_rapids_tpu/obs/roofline.py)
+    so every BENCH_*.json says how much of its wall went to XLA
+    compilation — the compile-share axis tools/perf_gate.py gates on,
+    and the denominator for the *_first_s warmup splits."""
+    try:
+        from spark_rapids_tpu.obs import roofline
+        RESULT["compile_ledger"] = roofline.ledger_totals()
+    except Exception as e:  # never let observability kill the bench
+        log(f"compile ledger embed failed: {e}")
+
+
+def run_perf_gate() -> None:
+    """Report-only regression readout against the newest committed
+    BENCH_r*.json at the repo root (tools/perf_gate.py), printed to
+    stderr and embedded as RESULT["perf_gate"]. Report-only by design:
+    the gating exit code belongs to CI (``python tools/perf_gate.py
+    BASE NEW``), not to the bench emitting its own numbers."""
+    try:
+        import glob
+        here = os.path.dirname(os.path.abspath(__file__))
+        prevs = sorted(glob.glob(os.path.join(here, "BENCH_r*.json")))
+        if not prevs:
+            return
+        sys.path.insert(0, os.path.join(here, "tools"))
+        import perf_gate
+        base = perf_gate.load_bench(prevs[-1])
+        res = perf_gate.compare(base, RESULT)
+        for line in perf_gate.render(res, os.path.basename(prevs[-1]),
+                                     "this run").splitlines():
+            log(line)
+        RESULT["perf_gate"] = {
+            "baseline": os.path.basename(prevs[-1]),
+            "comparable": res["comparable"],
+            "regressions": [list(r) for r in res["regressions"]],
+        }
+    except Exception as e:  # report-only: never fail the bench
+        log(f"perf gate failed: {e}")
 
 
 def dump_metrics_snapshot() -> None:
@@ -483,7 +531,11 @@ def main():
     queries = framework_queries(session, paths)
 
     # --- q6: the headline number, first so a timeout still records it
+    # (*_first_s = first-iteration wall: compile + cache population,
+    # split out so steady-state numbers stay clean of warmup)
+    t0 = time.perf_counter()
     queries["q6"]()  # warm: compile + populate caches
+    RESULT["q6_first_s"] = round(time.perf_counter() - t0, 4)
     q6_s = _best(queries["q6"], ITERS)
     cpu_q6 = _best(lambda: pandas_q6(paths), 1)
     RESULT.update({
@@ -501,7 +553,9 @@ def main():
                                       ("q3", pandas_q3, Q3_BYTES_PER_ROW)):
         if not left(name, need=60):
             break
+        t0 = time.perf_counter()
         queries[name]()
+        RESULT[f"{name}_first_s"] = round(time.perf_counter() - t0, 4)
         t = _best(queries[name], max(ITERS - 1, 1))
         c = _best(lambda: baseline(paths), 1)
         RESULT[f"{name}_s"] = round(t, 4)
@@ -527,7 +581,10 @@ def main():
                 if f"{name}_s" not in RESULT or not left(
                         f"fusion A/B {name}", need=45):
                     continue
+                t0 = time.perf_counter()
                 unfused_q[name]()  # warm: compile the unfused plans
+                RESULT[f"{name}_unfused_first_s"] = round(
+                    time.perf_counter() - t0, 4)
                 t = _best(unfused_q[name], iters)
                 RESULT[f"{name}_unfused_s"] = round(t, 4)
                 RESULT[f"{name}_fusion_speedup"] = round(
@@ -624,7 +681,10 @@ def main():
                 arrs = feats.to_device_arrays()
                 return arrs
 
+            t0 = time.perf_counter()
             run_etl()  # warm
+            RESULT["mortgage_first_s"] = round(
+                time.perf_counter() - t0, 3)
             etl_s = _best(run_etl, max(ITERS - 1, 1))
             c = _best(lambda: pandas_mortgage(mort_dir), 1)
             RESULT["mortgage_etl_s"] = round(etl_s, 3)
@@ -674,6 +734,8 @@ def main():
             RESULT["nds_ab_dimension"] = leg_dim
             import gc
 
+            from spark_rapids_tpu import jit_registry as _jitreg
+
             # cheap-first static order (round-5 measured warm walls on
             # the CPU lane): a budget cut then truncates the heavy
             # TAIL, so queries_run is maximal for any budget — the
@@ -699,8 +761,11 @@ def main():
                 nds_sess = framework_session({leg_conf: enabled})
                 register_nds(nds_sess, nds_dir, scale_rows=nds_scale)
                 # drop the previous lane's in-memory executables before
-                # the 70-query sweep (see the % 5 clear below)
+                # the 70-query sweep (see the % 5 clear below); the
+                # shared-program wrappers hold AOT executables jax's
+                # own caches don't track, so release those too
                 jax.clear_caches()
+                _jitreg.release_executables()
                 gc.collect()
                 t0 = time.perf_counter()
                 done = 0
@@ -738,6 +803,7 @@ def main():
                         # boxes that never needed it)
                         nds_sess._plan_cache.clear()
                         jax.clear_caches()
+                        _jitreg.release_executables()
                         gc.collect()
                 snapshot()
                 fuse1 = fusion_counters()
@@ -802,6 +868,8 @@ def main():
             log(f"nds power run failed: {e}")
 
     embed_metrics()
+    embed_compile_ledger()
+    run_perf_gate()
     dump_metrics_snapshot()
     emit(final=True)
 

@@ -19,6 +19,16 @@ from ..conf import CONCURRENT_TASKS, SrtConf, active_conf
 
 Schema = List  # [(name, DType), ...]
 
+# Resolved once: the profiler annotation class used by the scoped
+# timers. Both timers run on every operator pull, so the per-enter
+# ``import jax.profiler`` + except dance was measurable overhead on
+# the hot path (part of the roofline layer's <=2% sampling budget);
+# a module-level None check is the same cost as the tracer gate.
+try:
+    from jax.profiler import TraceAnnotation as _TraceAnnotation
+except Exception:  # pragma: no cover - jax always present in-tree
+    _TraceAnnotation = None
+
 
 class Metric:
     """One operator metric (GpuMetric). Thread-safe accumulator."""
@@ -59,11 +69,13 @@ class NvtxTimer:
 
     def __enter__(self):
         self._t0 = time.perf_counter_ns()
-        try:
-            import jax.profiler
-            self._trace = jax.profiler.TraceAnnotation(self.name or "op")
-            self._trace.__enter__()
-        except Exception:
+        if _TraceAnnotation is not None:
+            try:
+                self._trace = _TraceAnnotation(self.name or "op")
+                self._trace.__enter__()
+            except Exception:
+                self._trace = None
+        else:
             self._trace = None
         return self
 
@@ -125,12 +137,12 @@ class SelfTimer:
             self._span = self.tracer.begin(self.name or "op",
                                            kind="operator",
                                            parent=parent_id)
-        try:
-            import jax.profiler
-            self._trace = jax.profiler.TraceAnnotation(self.name or "op")
-            self._trace.__enter__()
-        except Exception:
-            self._trace = None
+        if _TraceAnnotation is not None:
+            try:
+                self._trace = _TraceAnnotation(self.name or "op")
+                self._trace.__enter__()
+            except Exception:
+                self._trace = None
         return self
 
     def __exit__(self, *exc):

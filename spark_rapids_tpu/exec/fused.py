@@ -47,6 +47,7 @@ import jax
 import jax.numpy as jnp
 
 from ..columnar.vector import ColumnarBatch
+from ..jit_registry import annotate as _annotate
 from ..jit_registry import shared_fn_jit
 from ..jit_registry import stats as _registry_stats
 from ..ops import kernels as K
@@ -192,6 +193,11 @@ class FusedPipelineExec(TpuExec):
         jit_kwargs = {"donate_argnums": (0,)} if self.donate else {}
         self._fn = shared_fn_jit(_fused_program_builder, self._specs,
                                  **jit_kwargs)
+        # roofline attribution: name the shared program after the
+        # chain (the structural key already covers the specs, so every
+        # chain of this shape shares both the program and the label)
+        _annotate(self._fn, "Fused[" + " -> ".join(
+            type(s).__name__ for s in self.stages) + "]")
         # bytes an unfused pipeline would materialize per capacity slot
         # at every internal operator boundary (each non-terminal
         # stage's output batch) — the HBM round-trips fusion removes

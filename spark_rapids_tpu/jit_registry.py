@@ -28,6 +28,18 @@ Two entry points:
 Anything the structural encoder (plan/plan_cache._enc) cannot encode
 falls back to a private ``jax.jit`` — unshared, never wrong.
 
+Every shared program is wrapped in a :class:`_SharedProgram` — the
+compile-ledger hook (obs/roofline.py): the wrapper AOT-compiles each
+new input signature through ``trace()/lower()/compile()`` with each
+phase wall-timed, captures XLA ``cost_analysis()`` flops/bytes, and
+keeps the compiled executable for direct dispatch (so the AOT step
+REPLACES jit's internal first-call trace, it does not duplicate it).
+Launches are counted on the ledger entry, and every Nth launch
+(``srt.obs.roofline.sampleEvery``) is timed with a device sync and
+joined with the program's bytes/flops into achieved GB/s. Disable
+just the ledger with ``SRT_JIT_LEDGER=0`` (plain ``jax.jit`` wrappers,
+pre-ledger behavior).
+
 Reference role: the spark-rapids plugin loads/caches each cuDF kernel
 once per JVM, not once per operator instance
 (sql-plugin/src/main/scala/.../GpuOverrides.scala module-level kernel
@@ -39,14 +51,18 @@ private ``jax.jit``) when isolating trace-level bugs.
 
 from __future__ import annotations
 
+import hashlib
 import os
 import threading
+import time
 from typing import Callable, Dict, Optional, Sequence
 
 import jax
 
 _REGISTRY: Dict = {}
-_LOCK = threading.Lock()
+# RLock so the counter helpers may take it even when the caller
+# already holds it for a lookup+insert critical section.
+_LOCK = threading.RLock()
 _STATS = {"hits": 0, "misses": 0, "uncached": 0}
 # per defining module (builder's or method class's __module__), so a
 # subsystem can report ITS share — e.g. bench reads the fused-pipeline
@@ -55,12 +71,17 @@ _MODULE_STATS: Dict[str, Dict[str, int]] = {}
 
 
 def _count(module: str, kind: str) -> None:
-    _STATS[kind] += 1
-    m = _MODULE_STATS.setdefault(
-        module, {"hits": 0, "misses": 0, "uncached": 0})
-    m[kind] += 1
+    """Count one hit/miss/uncached for ``module``. Takes ``_LOCK``
+    itself (reentrant), so every mutation of ``_STATS``/
+    ``_MODULE_STATS`` is race-free regardless of the call site."""
+    with _LOCK:
+        _STATS[kind] += 1
+        m = _MODULE_STATS.setdefault(
+            module, {"hits": 0, "misses": 0, "uncached": 0})
+        m[kind] += 1
 
 _ENABLED = os.environ.get("SRT_JIT_REGISTRY", "1") != "0"
+_LEDGER_ENABLED = os.environ.get("SRT_JIT_LEDGER", "1") != "0"
 
 # Soft cap: parameterized workloads (distinct literals, growing
 # out_capacity buckets) mint unbounded distinct keys; past the cap the
@@ -86,6 +107,235 @@ def _encode(parts):
         return None
 
 
+# --- compile ledger / roofline instrumentation (obs/roofline.py) ---
+
+def _key_hash(key) -> str:
+    """Stable short id for a structural key (ledger/event correlation
+    across processes of the same build)."""
+    try:
+        return hashlib.sha1(repr(key).encode()).hexdigest()[:16]
+    except Exception:
+        return hex(id(key))[2:]
+
+
+def _cost_of(compiled):
+    """(flops, bytes_accessed) from ``compiled.cost_analysis()``, each
+    None when the backend/jaxlib does not report it (CPU backends and
+    older jaxlibs return None, a bare dict, or miss keys) — graceful
+    degradation, never an error."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return None, None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None, None
+
+    def _num(k):
+        v = ca.get(k)
+        try:
+            v = float(v)
+        except (TypeError, ValueError):
+            return None
+        return v if v >= 0 else None
+    return _num("flops"), _num("bytes accessed")
+
+
+def _signature(args):
+    """Hashable input signature (treedef + per-leaf aval incl. weak
+    type) — the AOT executable cache key. Raises when any leaf has no
+    aval (caller falls back to the plain jit path)."""
+    from jax.api_util import shaped_abstractify
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    for leaf in leaves:
+        if isinstance(leaf, jax.core.Tracer):
+            # called under an enclosing trace (mesh lowering): jit
+            # inlines fine, an AOT executable cannot run on tracers
+            return None
+    return treedef, tuple(shaped_abstractify(x) for x in leaves)
+
+
+class _SharedProgram:
+    """Callable wrapper around one shared jitted program that owns its
+    compile-ledger entry.
+
+    First call per input signature AOT-compiles (trace -> lower ->
+    compile, each phase wall-timed, ``cost_analysis`` captured) and
+    caches the compiled executable; later matching calls dispatch the
+    executable directly — no re-trace, same steady-state as jit's own
+    C++ cache. Unmatchable calls (kwargs, tracer args, signature-cache
+    overflow, any AOT failure) fall back to the inner ``jax.jit``
+    wrapper, so behavior never depends on the ledger. Every launch
+    increments the entry's launch counter; every Nth launch
+    (``roofline.sample_every()``) is synced and timed into the
+    achieved-GB/s join.
+
+    Holds only the jit wrapper, avals, and compiled executables —
+    never the exec tree (the shell-detachment contract above stands).
+    """
+
+    #: distinct input signatures AOT-cached per program; beyond this
+    #: (unbounded capacity buckets) calls run through the inner jit
+    _SIG_CAP = 16
+
+    __slots__ = ("fn", "entry", "_sigs", "_n", "_lock")
+
+    def __init__(self, fn, entry):
+        self.fn = fn
+        self.entry = entry
+        self._sigs: Dict = {}
+        self._n = 0
+        self._lock = threading.Lock()
+
+    # attribute pass-through (e.g. .lower on the inner jit wrapper)
+    def __getattr__(self, name):
+        return getattr(self.fn, name)
+
+    def drop_executables(self) -> None:
+        """Release AOT executables (mmap-guard / cache hygiene; the
+        next call re-compiles through the ledger, which records it as
+        the recompile it is)."""
+        with self._lock:
+            self._sigs.clear()
+
+    def _aot(self, args):
+        """Timed trace/lower/compile for ``args``; returns
+        (compiled, bytes, flops) or None when AOT is not possible."""
+        from .obs import roofline
+        try:
+            t0 = time.perf_counter_ns()
+            tracer = getattr(self.fn, "trace", None)
+            if tracer is not None:
+                traced = tracer(*args)
+                t1 = time.perf_counter_ns()
+                lowered = traced.lower()
+            else:  # older jax: trace folded into lower
+                traced = None
+                t1 = t0
+                lowered = self.fn.lower(*args)
+            t2 = time.perf_counter_ns()
+            compiled = lowered.compile()
+            t3 = time.perf_counter_ns()
+        except Exception:
+            return None
+        flops, nbytes = _cost_of(compiled)
+        try:
+            roofline.record_compile(self.entry, trace_ns=t1 - t0,
+                                    lower_ns=t2 - t1,
+                                    compile_ns=t3 - t2, flops=flops,
+                                    bytes_accessed=nbytes)
+        except Exception:
+            pass
+        return compiled, nbytes, flops
+
+    def _launch(self, runner, args, kwargs, nbytes, flops):
+        from .obs import roofline
+        entry = self.entry
+        entry.count_launch()
+        self._n += 1
+        stride = roofline.sample_every()
+        if stride > 0 and self._n % stride == 1 % stride:
+            t0 = time.perf_counter_ns()
+            out = runner(*args, **kwargs)
+            try:
+                jax.block_until_ready(out)
+                roofline.record_sample(
+                    entry, time.perf_counter_ns() - t0, nbytes, flops)
+            except Exception:
+                pass
+            return out
+        return runner(*args, **kwargs)
+
+    def __call__(self, *args, **kwargs):
+        if not kwargs:
+            try:
+                sig = _signature(args)
+            except Exception:
+                sig = None
+            if sig is not None:
+                rec = self._sigs.get(sig)
+                if rec is None and sig not in self._sigs:
+                    with self._lock:
+                        rec = self._sigs.get(sig)
+                        if rec is None and sig not in self._sigs:
+                            if len(self._sigs) < self._SIG_CAP:
+                                rec = self._aot(args)
+                                self._sigs[sig] = rec
+                if rec is not None:
+                    compiled, nbytes, flops = rec
+                    try:
+                        return self._launch(compiled, args, {},
+                                            nbytes, flops)
+                    except (TypeError, ValueError):
+                        # aval/placement mismatch the signature missed:
+                        # the inner jit re-specializes, always right
+                        pass
+        # fallback: kwargs, tracers, unsignable leaves, sig overflow,
+        # or failed AOT — plain shared jit, still launch-counted (no
+        # per-sig cost known, so samples join with bytes=None)
+        return self._launch(self.fn, args, kwargs, None, None)
+
+
+def _wrap_program(fn, key, module: str, label: str):
+    """Attach the compile-ledger wrapper to a fresh shared jit (miss
+    path). With the ledger disabled the raw jit is stored instead."""
+    if not _LEDGER_ENABLED:
+        return fn
+    try:
+        from .obs import roofline
+        entry = roofline.ensure_entry(_key_hash(key), module, label)
+    except Exception:
+        return fn
+    return _SharedProgram(fn, entry)
+
+
+def annotate(fn, display: str) -> None:
+    """Set the operator-facing display label on a shared program's
+    ledger entry (e.g. the fused chain description). No-op for plain
+    jits (uncached fallbacks, ledger disabled)."""
+    entry = getattr(fn, "entry", None)
+    if entry is not None:
+        entry.display = str(display)
+
+
+def rebind_ledger_entries() -> None:
+    """Give every live wrapper a FRESH ledger entry under its original
+    key. ``roofline.reset()`` (tests) calls this after dropping the
+    ledger: without it, wrappers registered before the reset would keep
+    counting into orphaned entries the new ledger never sees."""
+    with _LOCK:
+        fns = [f for f in _REGISTRY.values()
+               if isinstance(f, _SharedProgram)]
+    try:
+        from .obs import roofline
+    except Exception:
+        return
+    for f in fns:
+        old = f.entry
+        new = roofline.ensure_entry(old.key, old.module, old.label)
+        if new is not old:
+            new.display = old.display
+            f.entry = new
+
+
+def release_executables() -> None:
+    """Drop every shared program's AOT executables (companion to
+    ``jax.clear_caches()`` in the mmap guard and bench sweeps — the
+    wrappers hold compiled programs jax's own caches do not track).
+    Ledger counters and the registry itself survive; next launches
+    re-compile and are ledgered as recompiles."""
+    with _LOCK:
+        fns = list(_REGISTRY.values())
+    for fn in fns:
+        drop = getattr(fn, "drop_executables", None)
+        if drop is not None:
+            try:
+                drop()
+            except Exception:
+                pass
+
+
 def shared_method_jit(obj, method_name: str, fields: Sequence[str],
                       extra=(), **jit_kwargs) -> Callable:
     """Shared jit of ``type(obj).<method_name>`` bound to a detached
@@ -97,8 +347,7 @@ def shared_method_jit(obj, method_name: str, fields: Sequence[str],
     cls = type(obj)
     enc = _encode([getattr(obj, f) for f in fields]) if _ENABLED else None
     if enc is None:
-        with _LOCK:
-            _count(cls.__module__, "uncached")
+        _count(cls.__module__, "uncached")
         return jax.jit(getattr(obj, method_name), **jit_kwargs)
     key = (cls.__module__, cls.__qualname__, method_name, tuple(fields),
            enc, tuple(extra),
@@ -111,7 +360,9 @@ def shared_method_jit(obj, method_name: str, fields: Sequence[str],
         shell = object.__new__(cls)
         for f in fields:
             setattr(shell, f, getattr(obj, f))
-        fn = jax.jit(getattr(shell, method_name), **jit_kwargs)
+        fn = _wrap_program(
+            jax.jit(getattr(shell, method_name), **jit_kwargs), key,
+            cls.__module__, f"{cls.__qualname__}.{method_name}")
         _put(key, fn)
         _count(cls.__module__, "misses")
     return fn
@@ -127,8 +378,7 @@ def shared_fn_jit(builder: Callable, *key_args, **jit_kwargs) -> Callable:
     """
     enc = _encode(list(key_args)) if _ENABLED else None
     if enc is None:
-        with _LOCK:
-            _count(builder.__module__, "uncached")
+        _count(builder.__module__, "uncached")
         return jax.jit(builder(*key_args), **jit_kwargs)
     key = (builder.__module__,
            getattr(builder, "__qualname__", builder.__name__), enc,
@@ -138,7 +388,10 @@ def shared_fn_jit(builder: Callable, *key_args, **jit_kwargs) -> Callable:
         if fn is not None:
             _count(builder.__module__, "hits")
             return fn
-        fn = jax.jit(builder(*key_args), **jit_kwargs)
+        fn = _wrap_program(
+            jax.jit(builder(*key_args), **jit_kwargs), key,
+            builder.__module__,
+            getattr(builder, "__qualname__", builder.__name__))
         _put(key, fn)
         _count(builder.__module__, "misses")
     return fn
@@ -147,7 +400,9 @@ def shared_fn_jit(builder: Callable, *key_args, **jit_kwargs) -> Callable:
 def stats(module: Optional[str] = None) -> dict:
     """Registry counters; with ``module``, only the hits/misses/
     uncached charged to wrappers defined in that module (plus the
-    module's live entry count)."""
+    module's live entry count). The whole snapshot is built under
+    ``_LOCK`` — one consistent point in time, with the per-module
+    dicts copied so callers never alias live counters."""
     with _LOCK:
         if module is not None:
             s = dict(_MODULE_STATS.get(
@@ -156,6 +411,7 @@ def stats(module: Optional[str] = None) -> dict:
             return s
         s = dict(_STATS)
         s["entries"] = len(_REGISTRY)
+        s["modules"] = {m: dict(d) for m, d in _MODULE_STATS.items()}
         return s
 
 

@@ -39,6 +39,7 @@ EVENT_TYPES = (
     "FetchFailed", "RetryAttempt",
     "FaultInjected", "CorruptionDetected",
     "WorkerEvicted",
+    "ProgramCompiled", "RooflineSummary",
 )
 
 

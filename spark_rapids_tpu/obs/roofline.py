@@ -1,0 +1,418 @@
+"""In-engine roofline observability: compile ledger + device-time join.
+
+ROADMAP item 3's headline number (``kernel_hbm_util_est ~ 0.046``) is a
+coarse offline estimate computed once per bench run; this module makes
+the same quantity a first-class, per-program, per-query signal:
+
+- **Compile ledger** — every shared-program miss in ``jit_registry``
+  AOT-compiles through ``trace()/lower()/compile()`` and records the
+  wall time of each phase plus XLA's ``cost_analysis()`` flops and
+  bytes-accessed here, keyed by the structural program key and
+  attributed to the owning module. Each compile emits a
+  ``ProgramCompiled`` event when the event log is on.
+- **Device-time sampling** — every Nth launch of a ledgered program
+  (``srt.obs.roofline.sampleEvery``; 0 = off) is timed with a device
+  sync and joined with the ledger's bytes/flops: achieved GB/s and
+  GFLOP/s land in ``effective_gb_s``/``effective_gflop_s`` histograms
+  (MetricsRegistry) and accumulate on the ledger entry. Between
+  samples the cost is one counter increment per launch.
+- **Per-query windows** — the session snapshots the ledger before a
+  query and diffs after it, producing a ``RooflineSummary`` event and
+  a ``roofline`` block on the query's registry record: per-program
+  launches, extrapolated device busy time, achieved rates, and —
+  when the peak is calibrated — roofline *utilization*.
+- **Peak calibration** — ``srt.obs.roofline.calibrate`` runs the
+  ``tools/roofline.py`` copy-probe denominator once in-engine, so
+  utilization is achieved/measured-peak, not achieved/datasheet.
+
+Graceful-degradation contract: ``cost_analysis()`` may be ``None`` or
+missing keys (CPU backend, older jaxlib); the ledger records what it
+can, rates involving missing quantities stay ``None``, and offline
+reports print ``n/a``. Observability never raises into execution —
+every hook here is wrapped so a failure degrades to "not measured".
+
+Zero-overhead contract (same discipline as ``events``/``resource``):
+with sampling off the per-launch hook is one attribute read and one
+integer increment; with the event log off no events are built.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from . import events as _events
+from . import registry as _registry
+
+# --- process-global config (set by configure_from_conf) ---
+_ENABLED = True        # srt.obs.roofline.enabled
+_SAMPLE_EVERY = 0      # srt.obs.roofline.sampleEvery; 0 until configured
+_LOCK = threading.RLock()
+
+# --- the ledger: structural-key hash -> LedgerEntry, insertion order ---
+_ENTRIES: Dict[str, "LedgerEntry"] = {}
+_MAX_ENTRIES = 4096
+
+# --- calibration state ---
+_PEAK_GBS: Optional[float] = None
+_PROBE_LAUNCHES = 0
+_PROBE_ELEMS = 1 << 23  # 32MB f32: big enough to defeat caches, quick
+
+
+class LedgerEntry:
+    """Per-program record: compile phases, XLA cost, sampled launches.
+
+    One entry per structural program key, shared by every launch of the
+    registry wrapper that owns it. Counter mutation takes the entry
+    lock — launches are hot but the critical section is a handful of
+    integer adds.
+    """
+
+    __slots__ = ("key", "module", "label", "display",
+                 "compiles", "trace_ns", "lower_ns", "compile_ns",
+                 "flops", "bytes_accessed",
+                 "launches", "sampled_launches", "sampled_ns",
+                 "sampled_bytes", "sampled_flops", "lock")
+
+    def __init__(self, key: str, module: str, label: str):
+        self.key = key
+        self.module = module
+        self.label = label
+        #: operator-facing name (e.g. "Fused[Scan->Filter->Agg]") set
+        #: via jit_registry.annotate; defaults to the structural label
+        self.display = label
+        self.compiles = 0
+        self.trace_ns = 0
+        self.lower_ns = 0
+        self.compile_ns = 0
+        #: most recent compile's cost analysis; None = unavailable
+        self.flops: Optional[float] = None
+        self.bytes_accessed: Optional[float] = None
+        self.launches = 0
+        self.sampled_launches = 0
+        self.sampled_ns = 0
+        #: bytes/flops summed over sampled launches whose signature had
+        #: a known cost analysis — the GB/s join numerators
+        self.sampled_bytes = 0.0
+        self.sampled_flops = 0.0
+        self.lock = threading.Lock()
+
+    def count_launch(self) -> None:
+        with self.lock:
+            self.launches += 1
+
+    def as_dict(self) -> Dict[str, Any]:
+        with self.lock:
+            d = {
+                "program": self.key, "module": self.module,
+                "label": self.label, "display": self.display,
+                "compiles": self.compiles, "trace_ns": self.trace_ns,
+                "lower_ns": self.lower_ns, "compile_ns": self.compile_ns,
+                "flops": self.flops, "bytes_accessed": self.bytes_accessed,
+                "launches": self.launches,
+                "sampled_launches": self.sampled_launches,
+                "sampled_ns": self.sampled_ns,
+                "sampled_bytes": self.sampled_bytes,
+                "sampled_flops": self.sampled_flops,
+            }
+        return d
+
+
+# --- config ---
+def configure_from_conf(conf) -> None:
+    """Refresh process-global roofline config from a live conf; runs
+    the one-time peak probe when calibration is requested. Called by
+    the session per query and by cluster workers after
+    ``set_active_conf`` — same hand-off as ``events``/``resource``."""
+    global _ENABLED, _SAMPLE_EVERY
+    try:
+        from ..conf import (ROOFLINE_CALIBRATE, ROOFLINE_ENABLED,
+                            ROOFLINE_SAMPLE_EVERY)
+        on = bool(conf.get(ROOFLINE_ENABLED))
+        every = int(conf.get(ROOFLINE_SAMPLE_EVERY) or 0)
+        calibrate = bool(conf.get(ROOFLINE_CALIBRATE))
+    except Exception:
+        return
+    _ENABLED = on
+    _SAMPLE_EVERY = every if on else 0
+    if on and calibrate and _PEAK_GBS is None:
+        _run_probe()
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def sample_every() -> int:
+    """Current sampling stride (0 = sampling off). Read per launch by
+    the registry wrappers — a module-global int read."""
+    return _SAMPLE_EVERY
+
+
+def set_sample_every(every: int) -> None:
+    """Direct override (tests, bench legs that force sampling)."""
+    global _SAMPLE_EVERY
+    _SAMPLE_EVERY = int(every)
+
+
+def active() -> bool:
+    """True when per-launch sampling (and so per-query summaries) is
+    on."""
+    return _ENABLED and _SAMPLE_EVERY > 0
+
+
+# --- peak calibration ---
+def _run_probe() -> None:
+    """Measure peak copy bandwidth with a jitted read+write probe (the
+    tools/roofline.py denominator, moved in-engine). Best of three,
+    counted in ``probe_launches`` so tests can assert the conf gate.
+    Never raises — on any failure the peak simply stays unknown."""
+    global _PEAK_GBS, _PROBE_LAUNCHES
+    try:
+        import jax
+        import jax.numpy as jnp
+        n = _PROBE_ELEMS
+        x = jnp.ones((n,), dtype=jnp.float32)
+        f = jax.jit(lambda a: a * 1.0000001)
+        best = None
+        for _ in range(3):
+            t0 = time.perf_counter_ns()
+            jax.block_until_ready(f(x))
+            dt = time.perf_counter_ns() - t0
+            _PROBE_LAUNCHES += 1
+            if best is None or dt < best:
+                best = dt
+        # first launch includes compile; with 3 reps the min is a
+        # steady-state launch. read n*4 + write n*4 bytes.
+        if best and best > 0:
+            _PEAK_GBS = (2.0 * 4.0 * n) / best  # bytes/ns == GB/s
+    except Exception:
+        pass
+
+
+def calibrated_peak() -> Optional[float]:
+    """Measured peak copy bandwidth in GB/s, or None when the
+    calibration probe has not run (srt.obs.roofline.calibrate off)."""
+    return _PEAK_GBS
+
+
+def set_peak(gbs: Optional[float]) -> None:
+    """Inject a peak (tests; bench runs that already measured one)."""
+    global _PEAK_GBS
+    _PEAK_GBS = float(gbs) if gbs else None
+
+
+def probe_launches() -> int:
+    return _PROBE_LAUNCHES
+
+
+# --- ledger writes (called from jit_registry) ---
+def ensure_entry(key: str, module: str, label: str) -> LedgerEntry:
+    with _LOCK:
+        e = _ENTRIES.get(key)
+        if e is None:
+            while len(_ENTRIES) >= _MAX_ENTRIES:
+                _ENTRIES.pop(next(iter(_ENTRIES)))
+            e = _ENTRIES[key] = LedgerEntry(key, module, label)
+        return e
+
+
+def record_compile(entry: LedgerEntry, trace_ns: int, lower_ns: int,
+                   compile_ns: int, flops: Optional[float],
+                   bytes_accessed: Optional[float]) -> None:
+    """Fold one AOT compile into the ledger and emit ProgramCompiled.
+    ``flops``/``bytes_accessed`` are None when ``cost_analysis()`` was
+    unavailable or partial — recorded as unknown, never fatal."""
+    with entry.lock:
+        entry.compiles += 1
+        entry.trace_ns += int(trace_ns)
+        entry.lower_ns += int(lower_ns)
+        entry.compile_ns += int(compile_ns)
+        if flops is not None:
+            entry.flops = float(flops)
+        if bytes_accessed is not None:
+            entry.bytes_accessed = float(bytes_accessed)
+    if _ENABLED and _events.enabled():
+        _events.emit("ProgramCompiled", program=entry.key,
+                     module=entry.module, label=entry.label,
+                     display=entry.display, trace_ns=int(trace_ns),
+                     lower_ns=int(lower_ns), compile_ns=int(compile_ns),
+                     flops=flops, bytes_accessed=bytes_accessed,
+                     compiles=entry.compiles)
+
+
+def record_sample(entry: LedgerEntry, elapsed_ns: int,
+                  bytes_accessed: Optional[float],
+                  flops: Optional[float]) -> None:
+    """Fold one synced launch measurement into the ledger and the
+    effective-rate histograms. bytes/ns is numerically GB/s."""
+    elapsed_ns = max(int(elapsed_ns), 1)
+    with entry.lock:
+        entry.sampled_launches += 1
+        entry.sampled_ns += elapsed_ns
+        if bytes_accessed is not None:
+            entry.sampled_bytes += float(bytes_accessed)
+        if flops is not None:
+            entry.sampled_flops += float(flops)
+    try:
+        if bytes_accessed is not None:
+            _registry.observe("effective_gb_s",
+                              int(bytes_accessed / elapsed_ns), "GB/s")
+        if flops is not None:
+            _registry.observe("effective_gflop_s",
+                              int(flops / elapsed_ns), "GFLOP/s")
+    except Exception:
+        pass
+
+
+# --- reads ---
+def snapshot() -> List[Dict[str, Any]]:
+    """Consistent copy of every ledger entry (insertion order)."""
+    with _LOCK:
+        entries = list(_ENTRIES.values())
+    return [e.as_dict() for e in entries]
+
+
+def ledger_totals() -> Dict[str, Any]:
+    """Per-module trace/lower/compile totals + program counts — the
+    block bench embeds into BENCH_*.json for perf_gate's compile-time
+    gate."""
+    modules: Dict[str, Dict[str, Any]] = {}
+    totals = {"programs": 0, "compiles": 0, "trace_ns": 0,
+              "lower_ns": 0, "compile_ns": 0}
+    for d in snapshot():
+        m = modules.setdefault(d["module"],
+                               {"programs": 0, "compiles": 0,
+                                "trace_ns": 0, "lower_ns": 0,
+                                "compile_ns": 0})
+        for agg in (m, totals):
+            agg["programs"] += 1
+            agg["compiles"] += d["compiles"]
+            agg["trace_ns"] += d["trace_ns"]
+            agg["lower_ns"] += d["lower_ns"]
+            agg["compile_ns"] += d["compile_ns"]
+    totals["modules"] = modules
+    return totals
+
+
+# --- per-query window ---
+_WINDOW_FIELDS = ("launches", "sampled_launches", "sampled_ns",
+                  "sampled_bytes", "sampled_flops", "compiles",
+                  "trace_ns", "lower_ns", "compile_ns")
+#: cap on per-program rows carried by one RooflineSummary event
+_SUMMARY_TOP = 24
+
+
+class Window:
+    """Ledger counter baseline taken at query start; ``finish`` diffs
+    against the live ledger to produce the query's roofline summary.
+
+    Counters are process-global, so under concurrent queries a window
+    sees the union of everything launched while it was open — the same
+    approximation the reference accepts for device-level metrics.
+    """
+
+    def __init__(self):
+        self._base: Dict[str, tuple] = {
+            d["program"]: tuple(d[f] for f in _WINDOW_FIELDS)
+            for d in snapshot()}
+
+    def finish(self, query_id: str) -> Optional[Dict[str, Any]]:
+        try:
+            return self._finish(query_id)
+        except Exception:
+            return None  # observability must never break the query
+
+    def _finish(self, query_id: str) -> Optional[Dict[str, Any]]:
+        progs: List[Dict[str, Any]] = []
+        for d in snapshot():
+            base = self._base.get(d["program"],
+                                  (0,) * len(_WINDOW_FIELDS))
+            delta = {f: d[f] - base[i]
+                     for i, f in enumerate(_WINDOW_FIELDS)}
+            if delta["launches"] <= 0 and delta["compiles"] <= 0:
+                continue
+            row: Dict[str, Any] = {
+                "program": d["program"], "module": d["module"],
+                "label": d["label"], "display": d["display"],
+                "bytes_accessed": d["bytes_accessed"],
+                "flops": d["flops"],
+            }
+            row.update(delta)
+            # extrapolate device busy time from the sampled subset
+            if delta["sampled_launches"] > 0:
+                row["est_busy_ns"] = int(
+                    delta["sampled_ns"] * delta["launches"]
+                    / delta["sampled_launches"])
+                if delta["sampled_bytes"] > 0:
+                    row["gb_s"] = delta["sampled_bytes"] / \
+                        delta["sampled_ns"]
+                if delta["sampled_flops"] > 0:
+                    row["gflop_s"] = delta["sampled_flops"] / \
+                        delta["sampled_ns"]
+            else:
+                row["est_busy_ns"] = 0
+            progs.append(row)
+        if not progs:
+            return None
+        busy = sum(p["est_busy_ns"] for p in progs)
+        attributed = sum(p["est_busy_ns"] for p in progs
+                         if p.get("gb_s") is not None)
+        s_ns = sum(p["sampled_ns"] for p in progs)
+        s_bytes = sum(p["sampled_bytes"] for p in progs)
+        s_flops = sum(p["sampled_flops"] for p in progs)
+        peak = _PEAK_GBS
+        gb_s = (s_bytes / s_ns) if s_ns > 0 and s_bytes > 0 else None
+        summary: Dict[str, Any] = {
+            "query_id": query_id,
+            "device_busy_est_ns": busy,
+            "attributed_busy_ns": attributed,
+            "sampled_ns": s_ns,
+            "gb_s": gb_s,
+            "gflop_s": (s_flops / s_ns) if s_ns > 0 and s_flops > 0
+            else None,
+            "peak_gb_s": peak,
+            "utilization": (gb_s / peak)
+            if gb_s is not None and peak else None,
+            "compiles": sum(p["compiles"] for p in progs),
+            "compile_ns": sum(p["compile_ns"] for p in progs),
+            "sample_every": _SAMPLE_EVERY,
+        }
+        progs.sort(key=lambda p: p["est_busy_ns"], reverse=True)
+        summary["programs"] = progs[:_SUMMARY_TOP]
+        if len(progs) > _SUMMARY_TOP:
+            summary["programs_dropped"] = len(progs) - _SUMMARY_TOP
+        if _ENABLED and _events.enabled():
+            _events.emit("RooflineSummary", **summary)
+        return summary
+
+
+def window() -> Optional[Window]:
+    """Open a per-query window, or None when sampling is off (the
+    zero-overhead path: no snapshot, no per-query work)."""
+    if not active():
+        return None
+    try:
+        return Window()
+    except Exception:
+        return None
+
+
+def reset() -> None:
+    """Tests only: drop the ledger, calibration, and sampling config.
+    Live registry wrappers are re-homed onto fresh entries so their
+    post-reset launches stay visible (jit_registry holds the entry
+    object, not the key)."""
+    global _PEAK_GBS, _PROBE_LAUNCHES, _SAMPLE_EVERY, _ENABLED
+    with _LOCK:
+        _ENTRIES.clear()
+    _PEAK_GBS = None
+    _PROBE_LAUNCHES = 0
+    _SAMPLE_EVERY = 0
+    _ENABLED = True
+    try:
+        from .. import jit_registry
+        jit_registry.rebind_ledger_entries()
+    except Exception:
+        pass

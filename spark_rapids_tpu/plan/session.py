@@ -75,8 +75,13 @@ def _mmap_guard(session) -> None:
     import gc
 
     import jax
+
+    from ..jit_registry import release_executables
     session._plan_cache.clear()
     jax.clear_caches()
+    # the ledger wrappers hold AOT executables jax's caches don't
+    # track — release those mappings too, or the guard under-frees
+    release_executables()
     gc.collect()
     if debug:
         with open("/proc/self/maps", "rb") as f:
@@ -181,12 +186,18 @@ class TpuSession:
         from ..conf import METRICS_LEVEL
         from ..obs import events as _events
         from ..obs import resource as _resource
+        from ..obs import roofline as _roofline
         from ..obs.registry import registry as _registry
         from ..obs.registry import summarize_metrics
         from ..obs.trace import maybe_tracer
         from ..memory.budget import task_context
         _events.configure_from_conf(self.conf)
         _resource.configure_from_conf(self.conf)
+        _roofline.configure_from_conf(self.conf)
+        # per-query roofline window: ledger counter baseline, diffed
+        # in the finally into a RooflineSummary (None = sampling off,
+        # and then the whole layer is skipped)
+        rwin = _roofline.window()
         ctx = ExecContext(self.conf)
         ctx.tracer = maybe_tracer(self.conf)
         tc = task_context()
@@ -240,6 +251,10 @@ class TpuSession:
             extra = {"spilled_bytes": tc.spilled_bytes - tc0[0],
                      "oom_retries": tc.retry_count - tc0[1],
                      "oom_splits": tc.split_count - tc0[2]}
+            if rwin is not None:
+                rsum = rwin.finish(qid)  # emits RooflineSummary
+                if rsum is not None:
+                    extra["roofline"] = rsum
             rec = _registry().record_query(qid, summary, wall_ns,
                                            status, **extra)
             self._last_execution = {"physical": physical, "ctx": ctx,

@@ -1,0 +1,267 @@
+"""Roofline observability: compile ledger, device-time sampling join,
+calibration gating, event schema, and the perf regression gate.
+
+Contracts under test (spark_rapids_tpu/obs/roofline.py +
+jit_registry._SharedProgram + tools/perf_gate.py):
+
+- the ledger is populated on a registry MISS (one entry, one compile on
+  first launch), never on a hit;
+- with sampling on, a real NDS q3 run joins sampled launch times with
+  XLA bytes into finite, positive GB/s;
+- the calibration probe only runs when ``srt.obs.roofline.calibrate``
+  is on — zero probe launches otherwise;
+- ProgramCompiled / RooflineSummary events carry their documented
+  schema;
+- ``tools/perf_gate.py`` passes on a good candidate and exits nonzero
+  on a synthetic regression.
+"""
+
+import json
+import math
+import os
+import subprocess
+import sys
+
+import jax.numpy as jnp
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.vector import (ColumnVector, ColumnarBatch,
+                                              live_mask)
+from spark_rapids_tpu.conf import SrtConf
+from spark_rapids_tpu.exec import BatchScanExec, ExecContext, ProjectExec
+from spark_rapids_tpu.expr.core import col, lit
+from spark_rapids_tpu.obs import events as ev
+from spark_rapids_tpu.obs import roofline
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_roofline():
+    roofline.reset()
+    yield
+    roofline.reset()
+    ev.install(None)
+
+
+def _scan(n=64):
+    data = jnp.arange(n, dtype=jnp.int64)
+    b = ColumnarBatch([ColumnVector(data, live_mask(n, n), dt.INT64)],
+                      ["x"], n)
+    return BatchScanExec([b], [("x", dt.INT64)])
+
+
+def _run(node):
+    return list(node.execute(ExecContext()))
+
+
+# --- ledger: populated on miss, untouched on hit ---
+
+def test_ledger_populated_on_miss_not_on_hit():
+    roofline.set_sample_every(1)
+    keys0 = {e["program"] for e in roofline.snapshot()}
+    # a unique literal guarantees a registry MISS even when earlier
+    # test modules already registered projection programs
+    p1 = ProjectExec(_scan(), [(col("x") * lit(987_001)).alias("y")])
+    misses = {e["program"] for e in roofline.snapshot()} - keys0
+    assert misses, "a registry miss must mint ledger entries"
+
+    p2 = ProjectExec(_scan(), [(col("x") * lit(987_001)).alias("y")])
+    assert p1._jit is p2._jit  # second construction was a registry hit
+    hits = {e["program"] for e in roofline.snapshot()} - keys0
+    assert hits == misses, "a registry hit must not add ledger entries"
+
+    def entries():
+        return {e["program"]: e for e in roofline.snapshot()
+                if e["program"] in misses}
+
+    assert all(e["compiles"] == 0 for e in entries().values()), \
+        "AOT is lazy until the first launch"
+
+    _run(p1)
+    ents = entries()
+    compiled = [e for e in ents.values() if e["compiles"] > 0]
+    assert compiled
+    for e in compiled:
+        assert e["compiles"] == 1
+        assert e["trace_ns"] + e["lower_ns"] + e["compile_ns"] > 0
+    launches = sum(e["launches"] for e in ents.values())
+    assert launches >= 1
+
+    _run(p2)  # same wrappers: launches grow, compile counts do not
+    ents = entries()
+    assert all(e["compiles"] <= 1 for e in ents.values()), \
+        "a hit launch must not recompile"
+    assert sum(e["launches"] for e in ents.values()) > launches
+    assert any(e["sampled_launches"] >= 1 and e["sampled_ns"] > 0
+               for e in ents.values())
+
+
+def test_graceful_when_cost_analysis_missing():
+    """A launch with unknown bytes/flops still counts and samples —
+    rates just stay None (the n/a path) instead of breaking."""
+    roofline.set_sample_every(1)
+    entry = roofline.ensure_entry("synthetic-key", "m", "lbl")
+    roofline.record_compile(entry, 10, 20, 30, flops=None,
+                            bytes_accessed=None)
+    roofline.record_sample(entry, 1000, bytes_accessed=None, flops=None)
+    d = entry.as_dict()
+    assert d["compiles"] == 1 and d["sampled_launches"] == 1
+    assert d["flops"] is None and d["bytes_accessed"] is None
+    assert d["sampled_bytes"] == 0.0
+
+
+# --- sampled join on a real NDS q3 run ---
+
+def test_nds_q3_sampled_join_finite_gb_s(tmp_path):
+    from spark_rapids_tpu.datagen import generate_table
+    from spark_rapids_tpu.models.nds import NDS_QUERIES, nds_specs
+    from spark_rapids_tpu.plan.session import TpuSession
+
+    events_dir = str(tmp_path / "events")
+    session = TpuSession(SrtConf({
+        "srt.shuffle.partitions": 2,
+        "srt.eventLog.enabled": "true",
+        "srt.eventLog.dir": events_dir,
+        "srt.obs.roofline.sampleEvery": "1",
+    }))
+    data_dir = str(tmp_path / "nds")
+    needed = {"store_sales", "date_dim", "item"}
+    for spec in nds_specs(4_000):
+        if spec.name not in needed:
+            continue
+        out = os.path.join(data_dir, spec.name)
+        generate_table(session, spec, out, chunk_rows=1 << 16)
+        session.create_or_replace_temp_view(
+            spec.name, session.read.parquet(out))
+    assert session.sql(NDS_QUERIES["q3"]).collect() is not None
+
+    summaries = [r for r in ev.read_all_events(events_dir)
+                 if r.get("event") == "RooflineSummary"]
+    assert summaries, "sampled query must produce a RooflineSummary"
+    s = summaries[-1]
+    assert s["device_busy_est_ns"] > 0
+    assert s["gb_s"] is not None
+    assert math.isfinite(s["gb_s"]) and s["gb_s"] > 0
+    rated = [p for p in s["programs"] if p.get("gb_s") is not None]
+    assert rated, "per-program rows must carry joined GB/s"
+    for p in rated:
+        assert math.isfinite(p["gb_s"]) and p["gb_s"] > 0
+
+
+# --- calibration conf gate ---
+
+def test_calibration_gated_by_conf():
+    assert roofline.probe_launches() == 0
+    roofline.configure_from_conf(SrtConf({}))  # calibrate defaults off
+    assert roofline.probe_launches() == 0
+    assert roofline.calibrated_peak() is None
+
+    roofline.configure_from_conf(SrtConf(
+        {"srt.obs.roofline.calibrate": "true"}))
+    assert roofline.probe_launches() > 0
+    peak = roofline.calibrated_peak()
+    assert peak is not None and peak > 0
+    # one-time: a second configure must not re-probe
+    n = roofline.probe_launches()
+    roofline.configure_from_conf(SrtConf(
+        {"srt.obs.roofline.calibrate": "true"}))
+    assert roofline.probe_launches() == n
+
+
+# --- event schema ---
+
+def test_event_schema(tmp_path):
+    sink = ev.EventLogWriter(str(tmp_path))
+    ev.install(sink)
+    roofline.set_sample_every(1)
+    roofline.set_peak(10.0)
+
+    p = ProjectExec(_scan(), [(col("x") + lit(987_002)).alias("y")])
+    win = roofline.window()
+    assert win is not None
+    _run(p)
+    assert win.finish("q-schema") is not None
+    sink.close()
+
+    recs = ev.read_all_events(str(tmp_path))
+    compiled = [r for r in recs if r["event"] == "ProgramCompiled"]
+    assert compiled
+    for r in compiled:
+        for k in ("program", "module", "label", "display", "trace_ns",
+                  "lower_ns", "compile_ns", "flops", "bytes_accessed",
+                  "compiles"):
+            assert k in r, f"ProgramCompiled missing {k}"
+    [s] = [r for r in recs if r["event"] == "RooflineSummary"]
+    for k in ("query_id", "device_busy_est_ns", "attributed_busy_ns",
+              "sampled_ns", "gb_s", "gflop_s", "peak_gb_s",
+              "utilization", "compiles", "compile_ns", "sample_every",
+              "programs"):
+        assert k in s, f"RooflineSummary missing {k}"
+    assert s["query_id"] == "q-schema"
+    assert s["sample_every"] == 1
+    assert s["peak_gb_s"] == 10.0
+    for p_row in s["programs"]:
+        for k in ("program", "module", "label", "display", "launches",
+                  "sampled_launches", "est_busy_ns"):
+            assert k in p_row, f"summary program row missing {k}"
+
+
+def test_window_none_when_sampling_off():
+    roofline.set_sample_every(0)
+    assert roofline.window() is None  # the zero-overhead path
+
+
+# --- perf gate on synthetic BENCH pairs ---
+
+_BASE = {"metric": "tpch_q6_e2e_throughput", "value": 30.0,
+         "unit": "Mrows/s", "backend": "cpu", "rows": 1_500_000,
+         "q6_s": 0.050, "q6_first_s": 2.0, "q3_s": 1.10,
+         "q6_effective_gb_s": 0.90, "vs_baseline": 3.0,
+         "compile_ledger": {"programs": 10, "compiles": 12,
+                            "trace_ns": int(2e9), "lower_ns": int(1e9),
+                            "compile_ns": int(3e9)}}
+
+
+def _gate(tmp_path, new, *extra):
+    a, b = tmp_path / "base.json", tmp_path / "new.json"
+    a.write_text(json.dumps(_BASE))
+    b.write_text(json.dumps(new))
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "perf_gate.py"),
+         str(a), str(b), *extra],
+        capture_output=True, text=True, cwd=REPO)
+
+
+def test_perf_gate_passes_good_candidate(tmp_path):
+    good = dict(_BASE, q6_s=0.048, value=31.0)
+    r = _gate(tmp_path, good)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "PASS" in r.stdout
+
+
+def test_perf_gate_fails_synthetic_regression(tmp_path):
+    bad = dict(_BASE, q6_s=0.090, value=17.0)  # ~2x slower
+    r = _gate(tmp_path, bad)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "REG" in r.stdout
+    assert "q6_s" in r.stdout and "value" in r.stdout
+
+
+def test_perf_gate_flags_compile_time_growth(tmp_path):
+    bloated = dict(_BASE)
+    bloated["compile_ledger"] = dict(_BASE["compile_ledger"],
+                                     compile_ns=int(9e9))
+    r = _gate(tmp_path, bloated)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "compile_ledger_total_s" in r.stdout
+
+
+def test_perf_gate_report_only_and_shape_mismatch(tmp_path):
+    bad = dict(_BASE, q6_s=0.090)
+    assert _gate(tmp_path, bad, "--report-only").returncode == 0
+    other_scale = dict(_BASE, q6_s=0.500, rows=6_000_000)
+    r = _gate(tmp_path, other_scale)
+    assert r.returncode == 0, "different workload shape must not gate"
+    assert "INCOMPARABLE" in r.stdout

@@ -1,0 +1,198 @@
+#!/usr/bin/env python
+"""Perf regression gate: compare two BENCH_*.json files.
+
+Turns the BENCH trajectory (BENCH_r01..r05 at the repo root) from
+prose into a CI-checkable signal. Compares a baseline and a candidate
+bench result on three axes:
+
+- **time keys** (``*_s``: q6_s, q3_s, nds_total_s...) — lower is
+  better; regression when ``new > base * (1 + tolerance)``;
+- **rate keys** (``*_gb_s``, ``*_rows_s``, ``*_mrows_s``, ``value``,
+  ``*_vs_baseline``) — higher is better; regression when
+  ``new < base * (1 - tolerance)``;
+- **compile-time share** — from the embedded compile ledger
+  (``compile_ledger.compile_ns``, bench.py satellite) and the
+  first-iteration splits (``*_first_s``): regression when total
+  compile time grows past the tolerance.
+
+Keys present in only one file are reported and skipped (benches grow
+new sections PR over PR; the gate only compares what both measured).
+Runs whose recorded workload shape differs (``rows``, ``backend``,
+``nds_scale_rows``) are **incomparable**: the gate reports and exits 0
+rather than failing on an apples-to-oranges pair — gate thresholds
+mean nothing across scales.
+
+Exit codes: 0 = pass (or report-only / incomparable), 1 = regression,
+2 = usage/IO error.
+
+Usage:
+    python tools/perf_gate.py BASELINE.json NEW.json
+        [--tolerance 0.15] [--compile-tolerance 0.25] [--report-only]
+
+Accepts both raw bench RESULT dicts and the committed BENCH_r*.json
+wrapper shape (``{"cmd", "parsed", ...}``).
+"""
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+#: top-level keys that identify the workload shape; a mismatch makes
+#: timing comparisons meaningless (different scale / backend)
+_SHAPE_KEYS = ("backend", "rows", "nds_scale_rows")
+
+#: rate-key suffixes (higher is better)
+_RATE_SUFFIXES = ("_gb_s", "_gbs", "_rows_s", "_mrows_s",
+                  "_vs_baseline", "_speedup")
+_RATE_KEYS = ("value",)
+
+#: keys that end in _s but are not durations
+_NOT_TIME = ("_rows_s", "_mrows_s", "_gb_s")
+
+
+def load_bench(path: str) -> Dict[str, Any]:
+    """Load a bench result, unwrapping the committed
+    ``{"cmd","n","parsed","rc","tail"}`` capture shape if present."""
+    with open(path) as f:
+        d = json.load(f)
+    if isinstance(d, dict) and isinstance(d.get("parsed"), dict) \
+            and "cmd" in d:
+        d = d["parsed"]
+    if not isinstance(d, dict):
+        raise ValueError(f"{path}: not a bench result dict")
+    return d
+
+
+def _is_rate(key: str) -> bool:
+    return key in _RATE_KEYS or key.endswith(_RATE_SUFFIXES)
+
+
+def _is_time(key: str) -> bool:
+    return key.endswith("_s") and not key.endswith(_NOT_TIME)
+
+
+def _numeric_keys(d: Dict[str, Any]) -> Dict[str, float]:
+    out = {}
+    for k, v in d.items():
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            continue
+        if _is_rate(k) or _is_time(k):
+            out[k] = float(v)
+    return out
+
+
+def _compile_totals(d: Dict[str, Any]) -> Optional[float]:
+    """Total ledgered trace+lower+compile seconds, when embedded."""
+    led = d.get("compile_ledger")
+    if not isinstance(led, dict):
+        return None
+    ns = sum(float(led.get(f) or 0)
+             for f in ("trace_ns", "lower_ns", "compile_ns"))
+    return ns / 1e9 if ns > 0 else None
+
+
+def compare(base: Dict[str, Any], new: Dict[str, Any],
+            tolerance: float = 0.15,
+            compile_tolerance: float = 0.25) -> Dict[str, Any]:
+    """Pure comparison (bench.py calls this with in-memory dicts).
+
+    Returns {"comparable", "shape_mismatch", "checks", "regressions",
+    "skipped"}; each check is (key, kind, base, new, ratio, ok).
+    """
+    shape_mismatch = [
+        (k, base.get(k), new.get(k)) for k in _SHAPE_KEYS
+        if k in base and k in new and base.get(k) != new.get(k)]
+    bk, nk = _numeric_keys(base), _numeric_keys(new)
+    checks: List[Tuple] = []
+    regressions: List[Tuple] = []
+    skipped = sorted((set(bk) ^ set(nk)))
+    for key in sorted(set(bk) & set(nk)):
+        b, n = bk[key], nk[key]
+        if b <= 0:
+            continue
+        ratio = n / b
+        if _is_time(key):
+            ok = n <= b * (1.0 + tolerance)
+            kind = "time"
+        else:
+            ok = n >= b * (1.0 - tolerance)
+            kind = "rate"
+        checks.append((key, kind, b, n, ratio, ok))
+        if not ok:
+            regressions.append((key, kind, b, n, ratio))
+    cb, cn = _compile_totals(base), _compile_totals(new)
+    if cb is not None and cn is not None and cb > 0:
+        ratio = cn / cb
+        ok = cn <= cb * (1.0 + compile_tolerance)
+        checks.append(("compile_ledger_total_s", "compile", cb, cn,
+                       ratio, ok))
+        if not ok:
+            regressions.append(("compile_ledger_total_s", "compile",
+                                cb, cn, ratio))
+    return {
+        "comparable": not shape_mismatch,
+        "shape_mismatch": shape_mismatch,
+        "checks": checks,
+        "regressions": regressions if not shape_mismatch else [],
+        "skipped": skipped,
+    }
+
+
+def render(result: Dict[str, Any], base_name: str = "base",
+           new_name: str = "new") -> str:
+    lines: List[str] = []
+    w = lines.append
+    w(f"== perf gate: {base_name} -> {new_name} ==")
+    if result["shape_mismatch"]:
+        w("INCOMPARABLE — workload shape differs; no gating applied:")
+        for k, b, n in result["shape_mismatch"]:
+            w(f"  {k}: {b} vs {n}")
+    for key, kind, b, n, ratio, ok in result["checks"]:
+        arrow = "worse" if not ok else (
+            "better" if (kind == "time") == (ratio < 1.0) else "~")
+        w(f"  [{'OK ' if ok else 'REG'}] {key:32s} "
+          f"{b:12.4f} -> {n:12.4f}  ({ratio:6.3f}x {kind}, {arrow})")
+    if result["skipped"]:
+        w(f"  skipped (missing in one side): "
+          f"{', '.join(result['skipped'][:12])}"
+          + (" ..." if len(result["skipped"]) > 12 else ""))
+    regs = result["regressions"]
+    w(f"  => {len(regs)} regression(s)"
+      + ("" if regs else " — PASS"))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("baseline", help="baseline BENCH_*.json")
+    ap.add_argument("candidate", help="candidate BENCH_*.json")
+    ap.add_argument("--tolerance", type=float, default=0.15,
+                    help="allowed relative slip per time/rate key "
+                         "(default 0.15)")
+    ap.add_argument("--compile-tolerance", type=float, default=0.25,
+                    help="allowed relative growth of ledgered "
+                         "compile time (default 0.25)")
+    ap.add_argument("--report-only", action="store_true",
+                    help="always exit 0; print the comparison")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    try:
+        base = load_bench(args.baseline)
+        new = load_bench(args.candidate)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"perf_gate: {e}", file=sys.stderr)
+        return 2
+    result = compare(base, new, tolerance=args.tolerance,
+                     compile_tolerance=args.compile_tolerance)
+    if args.json:
+        print(json.dumps(result, indent=2, default=str))
+    else:
+        print(render(result, args.baseline, args.candidate))
+    if args.report_only or not result["comparable"]:
+        return 0
+    return 1 if result["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,249 @@
+#!/usr/bin/env python
+"""Roofline report: rank operators by roofline-gap x time-weight.
+
+Reads the event log written by the in-engine roofline layer
+(``spark_rapids_tpu/obs/roofline.py``: ``ProgramCompiled`` on every
+shared-program compile, ``RooflineSummary`` per query when
+``srt.obs.roofline.sampleEvery`` > 0) and aggregates across queries:
+
+- per-program (operator / fused stage): extrapolated device busy
+  time, achieved GB/s and GFLOP/s (bytes/flops from XLA
+  ``cost_analysis`` joined with sampled launch times), utilization
+  against the calibrated peak, and a **rank score** =
+  roofline gap x busy-time share — a literal priority list for the
+  next fusion/kernel PR;
+- attribution: how much of the measured device busy time maps to
+  ledger programs with known bytes (the rest ran through fallback
+  paths or had no cost analysis — printed, never hidden);
+- compile ledger: per-module trace/lower/compile totals.
+
+Rates whose inputs are unavailable (CPU backends without cost
+analysis, unsampled programs) print ``n/a`` — graceful degradation,
+same contract as the in-engine layer.
+
+Usage:
+    python tools/roofline_report.py EVENT_LOG [--json] [--peak GBS]
+    python tools/roofline_report.py --diff BEFORE AFTER   # fusion A/B
+
+``EVENT_LOG`` is one ``events-*.jsonl`` file or a directory
+(``srt.eventLog.dir``). ``--diff`` compares two runs' event logs
+(e.g. fusion off vs on) per program label and in total.
+"""
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from spark_rapids_tpu.obs import events as ev  # noqa: E402
+
+
+def _fmt(v: Optional[float], spec: str = "8.3f") -> str:
+    return format(v, spec) if v is not None else " " * (
+        int(spec.split(".")[0]) - 3) + "n/a"
+
+
+def build(records: List[Dict[str, Any]],
+          peak: Optional[float] = None) -> Dict[str, Any]:
+    """Aggregate ProgramCompiled + RooflineSummary events into one
+    report structure (also the --json payload)."""
+    programs: Dict[str, Dict[str, Any]] = {}
+    compiled: Dict[str, Dict[str, Any]] = {}
+    queries = 0
+    peak_seen: Optional[float] = None
+    for rec in records:
+        etype = rec.get("event")
+        if etype == "ProgramCompiled":
+            c = compiled.setdefault(rec.get("program", "?"), {
+                "module": rec.get("module", "?"),
+                "label": rec.get("label", "?"),
+                "display": rec.get("display") or rec.get("label", "?"),
+                "compiles": 0, "trace_ns": 0, "lower_ns": 0,
+                "compile_ns": 0})
+            c["compiles"] += 1
+            for f in ("trace_ns", "lower_ns", "compile_ns"):
+                c[f] += int(rec.get(f) or 0)
+            c["display"] = rec.get("display") or c["display"]
+        elif etype == "RooflineSummary":
+            queries += 1
+            if rec.get("peak_gb_s"):
+                peak_seen = float(rec["peak_gb_s"])
+            for p in rec.get("programs", []):
+                key = p.get("program", p.get("label", "?"))
+                agg = programs.setdefault(key, {
+                    "module": p.get("module", "?"),
+                    "label": p.get("label", "?"),
+                    "display": p.get("display") or p.get("label", "?"),
+                    "launches": 0, "sampled_launches": 0,
+                    "sampled_ns": 0, "sampled_bytes": 0.0,
+                    "sampled_flops": 0.0, "est_busy_ns": 0,
+                    "compiles": 0, "compile_ns": 0})
+                for f in ("launches", "sampled_launches", "sampled_ns",
+                          "est_busy_ns", "compiles", "compile_ns"):
+                    agg[f] += int(p.get(f) or 0)
+                for f in ("sampled_bytes", "sampled_flops"):
+                    agg[f] += float(p.get(f) or 0.0)
+                if p.get("display"):
+                    agg["display"] = p["display"]
+    use_peak = peak if peak is not None else peak_seen
+    total_busy = sum(p["est_busy_ns"] for p in programs.values())
+    attributed = 0
+    rows: List[Dict[str, Any]] = []
+    for key, p in programs.items():
+        gb_s = (p["sampled_bytes"] / p["sampled_ns"]) \
+            if p["sampled_ns"] > 0 and p["sampled_bytes"] > 0 else None
+        gflop_s = (p["sampled_flops"] / p["sampled_ns"]) \
+            if p["sampled_ns"] > 0 and p["sampled_flops"] > 0 else None
+        util = (gb_s / use_peak) if gb_s is not None and use_peak \
+            else None
+        share = (p["est_busy_ns"] / total_busy) if total_busy else 0.0
+        # unknown utilization counts as full gap: un-measured programs
+        # should rise in the priority list, not vanish from it
+        gap = (1.0 - min(util, 1.0)) if util is not None else 1.0
+        if gb_s is not None:
+            attributed += p["est_busy_ns"]
+        rows.append({"program": key, **p, "gb_s": gb_s,
+                     "gflop_s": gflop_s, "utilization": util,
+                     "busy_share": share, "gap": gap,
+                     "score": gap * share})
+    rows.sort(key=lambda r: r["score"], reverse=True)
+    return {
+        "queries": queries,
+        "peak_gb_s": use_peak,
+        "total_busy_ns": total_busy,
+        "attributed_busy_ns": attributed,
+        "attributed_frac": (attributed / total_busy)
+        if total_busy else None,
+        "programs": rows,
+        "compiled": compiled,
+    }
+
+
+def report(path: str, peak: Optional[float] = None) -> Dict[str, Any]:
+    return build(ev.read_all_events(path), peak=peak)
+
+
+def render(rep: Dict[str, Any]) -> str:
+    lines: List[str] = []
+    w = lines.append
+    w("== roofline report ==")
+    w(f"queries with summaries : {rep['queries']}")
+    w(f"measured peak          : "
+      f"{_fmt(rep['peak_gb_s'], '6.2f')} GB/s"
+      + ("" if rep["peak_gb_s"] is not None
+         else "  (srt.obs.roofline.calibrate off; pass --peak)"))
+    w(f"device busy (est)      : {rep['total_busy_ns'] / 1e6:10.2f} ms")
+    frac = rep["attributed_frac"]
+    w("attributed to ledger   : "
+      + (f"{frac * 100:6.1f}%" if frac is not None else "   n/a")
+      + "  (busy time with known bytes/flops)")
+    w("")
+    w("rank  score   busy_ms  share%   GB/s     util%   launches  "
+      "program")
+    for i, r in enumerate(rows_to_show(rep), 1):
+        util = r["utilization"]
+        w(f"{i:>4}  {r['score']:.3f} {r['est_busy_ns'] / 1e6:9.2f}  "
+          f"{r['busy_share'] * 100:5.1f}  {_fmt(r['gb_s'])}  "
+          f"{_fmt(util * 100 if util is not None else None, '6.1f')}  "
+          f"{r['launches']:9d}  {r['display']}")
+    comp = rep.get("compiled", {})
+    if comp:
+        w("")
+        w("== compile ledger ==")
+        mods: Dict[str, Dict[str, float]] = {}
+        for c in comp.values():
+            m = mods.setdefault(c["module"], {"programs": 0,
+                                              "compiles": 0,
+                                              "total_ns": 0})
+            m["programs"] += 1
+            m["compiles"] += c["compiles"]
+            m["total_ns"] += (c["trace_ns"] + c["lower_ns"]
+                              + c["compile_ns"])
+        w("programs  compiles  total_ms  module")
+        for mod in sorted(mods, key=lambda m: -mods[m]["total_ns"]):
+            m = mods[mod]
+            w(f"{m['programs']:8d}  {m['compiles']:8d}  "
+              f"{m['total_ns'] / 1e6:8.1f}  {mod}")
+    return "\n".join(lines)
+
+
+def rows_to_show(rep: Dict[str, Any], limit: int = 20
+                 ) -> List[Dict[str, Any]]:
+    return [r for r in rep["programs"] if r["est_busy_ns"] > 0 or
+            r["launches"] > 0][:limit]
+
+
+def render_diff(before: Dict[str, Any], after: Dict[str, Any]) -> str:
+    """Fusion before/after mode: per-label busy/rate deltas."""
+    lines: List[str] = []
+    w = lines.append
+    w("== roofline diff (before -> after) ==")
+    tb, ta = before["total_busy_ns"], after["total_busy_ns"]
+    ratio = (ta / tb) if tb else None
+    w(f"device busy (est) : {tb / 1e6:10.2f} ms -> {ta / 1e6:10.2f} ms"
+      + (f"   ({ratio:0.2f}x)" if ratio is not None else ""))
+
+    def _by_label(rep):
+        out: Dict[str, Dict[str, float]] = {}
+        for r in rep["programs"]:
+            d = out.setdefault(r["display"], {"busy": 0, "bytes": 0.0,
+                                              "ns": 0})
+            d["busy"] += r["est_busy_ns"]
+            d["bytes"] += r["sampled_bytes"]
+            d["ns"] += r["sampled_ns"]
+        return out
+    b, a = _by_label(before), _by_label(after)
+    w("")
+    w("   before_ms    after_ms     delta  GB/s(b)  GB/s(a)  program")
+    for label in sorted(set(b) | set(a),
+                        key=lambda k: -(b.get(k, {}).get("busy", 0)
+                                        + a.get(k, {}).get("busy", 0))):
+        db, da = b.get(label), a.get(label)
+        bb = db["busy"] / 1e6 if db else 0.0
+        ba = da["busy"] / 1e6 if da else 0.0
+
+        def _rate(d):
+            return (d["bytes"] / d["ns"]) \
+                if d and d["ns"] > 0 and d["bytes"] > 0 else None
+        w(f"{bb:12.2f}{ba:12.2f}{ba - bb:10.2f}  "
+          f"{_fmt(_rate(db))} {_fmt(_rate(da))}  {label}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("event_log", nargs="?",
+                    help="events-*.jsonl file or srt.eventLog.dir")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the aggregated report as JSON")
+    ap.add_argument("--peak", type=float, default=None,
+                    help="peak GB/s override when no in-engine "
+                         "calibration ran")
+    ap.add_argument("--diff", nargs=2, metavar=("BEFORE", "AFTER"),
+                    help="compare two runs' event logs (fusion A/B)")
+    args = ap.parse_args(argv)
+    if args.diff:
+        before = report(args.diff[0], peak=args.peak)
+        after = report(args.diff[1], peak=args.peak)
+        if args.json:
+            print(json.dumps({"before": before, "after": after},
+                             indent=2, default=str))
+        else:
+            print(render_diff(before, after))
+        return 0
+    if not args.event_log:
+        ap.error("event_log is required (or use --diff)")
+    rep = report(args.event_log, peak=args.peak)
+    if args.json:
+        print(json.dumps(rep, indent=2, default=str))
+    else:
+        print(render(rep))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
