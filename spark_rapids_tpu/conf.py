@@ -761,6 +761,42 @@ OPTIMIZER_ROW_THRESHOLD = conf("srt.sql.optimizer.rowThreshold") \
          "on CPU (only with srt.sql.optimizer.enabled).") \
     .check(_positive).integer(10_000)
 
+CONCURRENT_QUERY_TASKS = conf("srt.sql.concurrentQueryTasks") \
+    .doc("Number of queries admitted to execute concurrently against "
+         "the device pool; further queries wait in a bounded admission "
+         "queue. Also sets the number of per-query memory-budget "
+         "slices. (spark.rapids.sql.concurrentGpuTasks / "
+         "GpuSemaphore.scala, lifted from task to query granularity)") \
+    .check(_positive).commonly_used().integer(4)
+
+ADMISSION_MAX_QUEUE_DEPTH = conf("srt.sql.admission.maxQueueDepth") \
+    .doc("Maximum queries allowed to WAIT for admission on top of the "
+         "running set; arrivals beyond this are load-shed with a "
+         "retryable AdmissionRejected instead of queueing unboundedly.") \
+    .check(_non_negative).integer(16)
+
+ADMISSION_BACKOFF_BASE_S = conf("srt.sql.admission.backoffBaseSec") \
+    .doc("Base seconds for the exponential backoff (with jitter) a "
+         "queued query sleeps between admission re-checks; doubles per "
+         "attempt up to a small cap. Bounds cancellation/deadline "
+         "latency while queued.") \
+    .check(_positive).double(0.05)
+
+QUERY_TIMEOUT_S = conf("srt.sql.queryTimeout") \
+    .doc("Per-query deadline in seconds, measured from admission "
+         "request to last batch; 0 disables. On expiry the query tears "
+         "down through every pipeline/fetch thread and raises "
+         "DeadlineExceeded. df.collect(timeout=...) overrides per "
+         "call.") \
+    .check(_non_negative).commonly_used().double(0.0)
+
+SHUFFLE_HEARTBEAT_TIMEOUT_S = conf("srt.shuffle.heartbeat.timeoutSec") \
+    .doc("Seconds of heartbeat silence before the shuffle heartbeat "
+         "manager declares an executor dead and its map outputs "
+         "unfetchable (standalone shuffle service default; cluster "
+         "runs pass srt.cluster.heartbeatTimeoutSec through instead).") \
+    .check(_positive).double(60.0)
+
 
 class SrtConf:
     """Immutable snapshot of settings, one per session (RapidsConf)."""

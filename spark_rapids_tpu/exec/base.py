@@ -244,8 +244,14 @@ def device_semaphore() -> TpuSemaphore:
 class ExecContext:
     """Per-query execution context: conf, metrics sink, semaphore."""
 
-    def __init__(self, conf: Optional[SrtConf] = None):
+    def __init__(self, conf: Optional[SrtConf] = None, query=None):
         self.conf = conf or active_conf()
+        #: cancellation/deadline token (robustness/admission.py
+        #: QueryContext); None = non-cancellable run. Checked once per
+        #: batch in ``TpuExec.execute`` — the universal teardown point
+        #: covering every operator — and shipped to producer/fetch
+        #: threads spawned on the query's behalf.
+        self.query = query
         self.semaphore = device_semaphore()
         self.metrics: Dict[str, Dict[str, Metric]] = {}
         #: SelfTimer stacks, one per pulling thread (see timer_stack)
@@ -386,8 +392,15 @@ class TpuExec:
         # production path never touches the scope TLS.
         from ..robustness import faults
         scope = faults.op_scope(self.exec_id) if faults.armed() else None
+        qctx = ctx.query
         it = iter(self.do_execute(ctx))
         while True:
+            # per-batch cancellation/deadline point: every operator's
+            # pull loop funnels through here, so one check covers scans,
+            # fused programs, joins, and exchanges alike (None check
+            # only when cancellation is unused)
+            if qctx is not None:
+                qctx.check()
             with SelfTimer(ctx.timer_stack, optime, self.exec_id,
                            ctx.tracer):
                 try:

@@ -62,12 +62,23 @@ from ..conf import (PIPELINE_DEPTH, PIPELINE_ENABLED, PIPELINE_MAX_BYTES,
 from .base import ExecContext, Metric, Schema, TpuExec
 
 __all__ = ["PrefetchIterator", "PrefetchExec", "prefetch_batches",
-           "pipeline_enabled", "prefetch_buffer_bytes"]
+           "pipeline_enabled", "prefetch_buffer_bytes",
+           "prefetch_thread_leaks"]
 
 # Live iterators, for the resource sampler's prefetch-occupancy gauge.
 # Weak so an abandoned iterator never outlives its consumer.
 _LIVE: "weakref.WeakSet[PrefetchIterator]" = weakref.WeakSet()
 _LIVE_LOCK = threading.Lock()
+
+#: producer threads that outlived close()'s join timeout — a stuck
+#: source (hung socket, wedged decode). Chaos runs and the leak gate
+#: fail loudly on a nonzero count instead of silently shipping a
+#: daemon thread per wedged query.
+_THREAD_LEAKS = [0]
+
+
+def prefetch_thread_leaks() -> int:
+    return _THREAD_LEAKS[0]
 
 
 def prefetch_buffer_bytes() -> int:
@@ -105,8 +116,16 @@ class PrefetchIterator:
                  depth_peak_metric: Optional[Metric] = None,
                  bytes_peak_metric: Optional[Metric] = None,
                  tracer=None,
-                 parent_span_id: Optional[int] = None):
+                 parent_span_id: Optional[int] = None,
+                 query=None,
+                 leak_metric: Optional[Metric] = None):
         self._factory = source_factory
+        #: cancellation token (robustness/admission.py QueryContext):
+        #: the producer observes it between items and while blocked on
+        #: backpressure, the consumer while blocked on an empty queue —
+        #: a cancelled query drains and joins instead of wedging
+        self._query = query
+        self._leak_metric = leak_metric
         self._depth = max(int(depth), 1)
         self._max_bytes = max(int(max_bytes), 0)
         self._nbytes = nbytes
@@ -142,8 +161,14 @@ class PrefetchIterator:
     # --- producer side ---------------------------------------------------
     def _run(self) -> None:
         from ..robustness import faults
+        from ..robustness.admission import set_current_query
         if self._conf is not None:
             set_active_conf(self._conf)
+        # producer thread inherits the query identity the same way it
+        # inherits the conf: spillable registrations it creates carry
+        # the owning query's budget-slice tag, and retry/backoff sleeps
+        # deep in the source (transport) become cancel-aware
+        set_current_query(self._query)
         scope = (faults.op_scope(self._fault_tag)
                  if self._fault_tag and faults.armed() else None)
         # scoped producer span: pushed onto THIS thread's tracer stack,
@@ -162,6 +187,13 @@ class PrefetchIterator:
             try:
                 src = iter(self._factory())
                 for item in src:
+                    if self._query is not None and (
+                            self._query.is_cancelled()
+                            or self._query.expired()):
+                        # observe-and-drain: no error relay — the
+                        # consumer raises the typed teardown itself
+                        self._discard(item)
+                        break
                     n = int(self._nbytes(item)) if self._nbytes else 0
                     if not self._admit(item, n):
                         break
@@ -194,7 +226,16 @@ class PrefetchIterator:
                     len(self._buf) >= self._depth
                     or (self._max_bytes
                         and self._bytes + n > self._max_bytes)):
-                self._cv.wait()
+                if self._query is not None:
+                    if self._query.is_cancelled() or \
+                            self._query.expired():
+                        self._discard(item)
+                        return False
+                    # bounded wait so a cancel with a wedged consumer
+                    # still unblocks the producer
+                    self._cv.wait(timeout=0.25)
+                else:
+                    self._cv.wait()
             if self._stopped:
                 self._discard(item)
                 return False
@@ -239,10 +280,22 @@ class PrefetchIterator:
                     self._flush_peaks()
                     raise err
                 if self._done:
+                    # a producer that DRAINED on cancel/deadline looks
+                    # exactly like clean end-of-stream — re-check the
+                    # token before reporting exhaustion, or the query
+                    # would return a silently truncated prefix
+                    if self._query is not None:
+                        self._query.check()
                     self._flush_peaks()
                     raise StopIteration
                 t0 = time.perf_counter_ns()
-                self._cv.wait()
+                if self._query is not None:
+                    # typed teardown even when the producer is wedged
+                    # in a hung source: poll the token while waiting
+                    self._query.check()
+                    self._cv.wait(timeout=0.25)
+                else:
+                    self._cv.wait()
                 waited += time.perf_counter_ns() - t0
 
     def _flush_peaks(self) -> None:
@@ -256,7 +309,14 @@ class PrefetchIterator:
                 max(self._bytes_peak_metric.value, self._bytes_peak))
 
     def close(self, join_timeout: float = 30.0) -> None:
-        """Stop the producer, join it, and discard queued items."""
+        """Stop the producer, join it, and discard queued items.
+
+        A producer that outlives the join timeout is wedged inside its
+        source (hung socket, stuck decode) — it leaks as a daemon
+        thread. That must fail loudly, not silently: a warning event,
+        the process-wide ``prefetch_thread_leaks`` counter, and the
+        node's ``prefetchThreadLeaks`` metric all record it so chaos
+        runs and the serving tier's health checks trip."""
         if self._closed:
             return
         self._closed = True
@@ -264,6 +324,19 @@ class PrefetchIterator:
             self._stopped = True
             self._cv.notify_all()
         self._thread.join(timeout=join_timeout)
+        if self._thread.is_alive():
+            _THREAD_LEAKS[0] += 1
+            if self._leak_metric is not None:
+                self._leak_metric.add(1)
+            from ..obs import events as _events
+            _events.emit("PrefetchThreadLeak",
+                         thread=self._thread.name,
+                         join_timeout_s=join_timeout,
+                         queued=len(self._buf))
+            import logging
+            logging.getLogger("spark_rapids_tpu.exec").warning(
+                "prefetch producer %s leaked: still alive %.0fs after "
+                "close()", self._thread.name, join_timeout)
         with self._cv:
             while self._buf:
                 item, _ = self._buf.popleft()
@@ -313,6 +386,8 @@ def prefetch_batches(ctx: ExecContext, node: TpuExec,
                        Metric("prefetchQueueDepthPeak", Metric.DEBUG))
     bpk = m.setdefault("prefetchBytesPeak",
                        Metric("prefetchBytesPeak", Metric.DEBUG))
+    leaks = m.setdefault("prefetchThreadLeaks",
+                         Metric("prefetchThreadLeaks", Metric.ESSENTIAL))
 
     def staged() -> Iterator[SpillableBatch]:
         for batch in source_factory():
@@ -344,7 +419,9 @@ def prefetch_batches(ctx: ExecContext, node: TpuExec,
         depth_peak_metric=dpk,
         bytes_peak_metric=bpk,
         tracer=ctx.tracer,
-        parent_span_id=parent_span_id)
+        parent_span_id=parent_span_id,
+        query=ctx.query,
+        leak_metric=leaks)
 
     def consume() -> Iterator:
         try:

@@ -105,6 +105,23 @@ def reset_task_context() -> TaskContext:
     return _TL.ctx
 
 
+#: sentinel: "resolve the owner from the thread's current query" —
+#: distinct from None, which means an explicitly untagged reservation
+_RESOLVE_OWNER = object()
+
+
+class _QuerySlice:
+    """Per-query partition of the device budget: equal ``share`` of
+    the pool, plus whatever idle-slot capacity the query borrows."""
+
+    __slots__ = ("query_id", "share", "used")
+
+    def __init__(self, query_id: str, share: int):
+        self.query_id = query_id
+        self.share = share
+        self.used = 0
+
+
 class MemoryBudget:
     """Logical byte budget over device HBM.
 
@@ -113,6 +130,20 @@ class MemoryBudget:
     release bytes (synchronousSpill, RapidsBufferCatalog.scala:589) and
     only then raises RetryOOM. Thread-safe; shared across tasks like a
     single device pool.
+
+    Multi-tenant isolation (ROADMAP item 1): while queries are
+    registered (``register_query``), the pool is carved into
+    ``slots`` equal slices — the admission semaphore's permit count —
+    and a query's reservations are checked against its own slice.
+    Capacity not claimed by a registered query (empty slots + the
+    integer-division remainder) forms an idle pool a query may borrow
+    from; it may never eat into another *registered* query's share.
+    Spill pressure is scoped the same way: ``reserve`` hands the
+    requesting query's id and the live-owner set to the spill
+    callback, which then refuses to evict batches belonging to other
+    live queries. With no queries registered (single-query sessions,
+    unit tests, worker processes) every check degrades to the plain
+    global budget — bit-identical to the pre-partition behavior.
     """
 
     def __init__(self, limit_bytes: int):
@@ -120,41 +151,122 @@ class MemoryBudget:
         self.used = 0
         self._lock = threading.Lock()
         self._spill_fn = None  # wired by the spill catalog
+        self._slices: dict = {}  # query_id -> _QuerySlice
+        self._nslots = 1
 
     def set_spill_callback(self, fn) -> None:
         self._spill_fn = fn
 
-    def reserve(self, nbytes: int) -> None:
+    # --- per-query slices -------------------------------------------------
+    def register_query(self, query_id: str,
+                       slots: Optional[int] = None) -> None:
+        """Claim a budget slice for an admitted query. ``slots`` is the
+        admission concurrency (slice count); sticky across calls so
+        per-call callers only pass it once per process lifetime."""
+        with self._lock:
+            if slots is not None:
+                self._nslots = max(int(slots), 1)
+            share = self.limit // self._nslots
+            self._slices[query_id] = _QuerySlice(query_id, share)
+
+    def unregister_query(self, query_id: str) -> None:
+        """Release a finished query's slice. Bytes it still holds
+        (e.g. shuffle map outputs pending fetch) stay accounted
+        globally and become fair spill victims for everyone."""
+        with self._lock:
+            self._slices.pop(query_id, None)
+
+    def active_owners(self) -> set:
+        with self._lock:
+            return set(self._slices)
+
+    def query_used(self, query_id: str) -> int:
+        with self._lock:
+            sl = self._slices.get(query_id)
+            return sl.used if sl is not None else 0
+
+    def _slice_cap_locked(self, sl: "_QuerySlice") -> int:
+        """Effective byte cap for one slice: its own share plus the
+        idle pool (capacity not reserved to any live query), minus
+        what other queries already borrowed from that pool."""
+        idle_pool = self.limit - sum(
+            s.share for s in self._slices.values())
+        borrowed_others = sum(
+            max(0, s.used - s.share)
+            for s in self._slices.values() if s is not sl)
+        return sl.share + max(0, idle_pool - borrowed_others)
+
+    def _try_reserve_locked(self, nbytes: int, owner) -> int:
+        """Commit the reservation if it fits; else return the byte
+        deficit the spill pass must free (>= 1)."""
+        sl = self._slices.get(owner) if owner else None
+        if self.used + nbytes > self.limit:
+            deficit = self.used + nbytes - self.limit
+        elif sl is not None and len(self._slices) > 1:
+            cap = self._slice_cap_locked(sl)
+            deficit = max(0, sl.used + nbytes - cap)
+        else:
+            # unpartitioned, untagged, or sole tenant: whole pool
+            deficit = 0
+        if deficit:
+            return deficit
+        self.used += nbytes
+        if sl is not None:
+            sl.used += nbytes
+        return 0
+
+    def reserve(self, nbytes: int, owner=_RESOLVE_OWNER) -> None:
         task_context().on_alloc_attempt()
         # seeded fault-site: forced RetryOOM/SplitAndRetryOOM at
         # operator granularity (detail defaults to the armed op_scope)
         fault_point("memory.reserve")
+        if owner is _RESOLVE_OWNER:
+            # un-plumbed call sites charge the thread's current query;
+            # spill.py passes the batch's recorded owner explicitly so
+            # reserve/release pair up on the same slice regardless of
+            # which thread re-materializes
+            from ..robustness.admission import current_query
+            q = current_query()
+            owner = q.query_id if q is not None else None
         with self._lock:
-            if self.used + nbytes <= self.limit:
-                self.used += nbytes
+            needed = self._try_reserve_locked(nbytes, owner)
+            if not needed:
                 return
-            needed = self.used + nbytes - self.limit
         # Out of budget: spill-then-recheck in a loop (outside the lock —
         # spilling calls back into release()). A single spill pass can
         # free less than asked — other tasks reserve concurrently, and
         # the catalog frees whole batches — so keep asking until the
-        # reservation fits or the catalog frees nothing more.
+        # reservation fits or the catalog frees nothing more. The
+        # requester's identity scopes victim selection: other live
+        # queries' batches are off the table.
         while self._spill_fn is not None:
-            freed = self._spill_fn(needed)
+            try:
+                freed = self._spill_fn(needed, owner,
+                                       self.active_owners())
+            except TypeError:
+                freed = self._spill_fn(needed)  # legacy 1-arg callback
             with self._lock:
-                if self.used + nbytes <= self.limit:
-                    self.used += nbytes
+                needed = self._try_reserve_locked(nbytes, owner)
+                if not needed:
                     return
-                needed = self.used + nbytes - self.limit
             if freed <= 0:
                 break
+        with self._lock:
+            sl = self._slices.get(owner) if owner else None
+            slice_info = (f" slice[{owner}]={sl.used}/"
+                          f"{self._slice_cap_locked(sl)}"
+                          if sl is not None else "")
         raise RetryOOM(
             f"device budget exhausted: used={self.used} request={nbytes} "
-            f"limit={self.limit}")
+            f"limit={self.limit}{slice_info}")
 
-    def release(self, nbytes: int) -> None:
+    def release(self, nbytes: int, owner: Optional[str] = None) -> None:
         with self._lock:
             self.used = max(0, self.used - nbytes)
+            if owner:
+                sl = self._slices.get(owner)
+                if sl is not None:
+                    sl.used = max(0, sl.used - nbytes)
 
 
 _DEVICE_BUDGET: Optional[MemoryBudget] = None

@@ -142,14 +142,22 @@ class SpillableBatch:
     __slots__ = ("_batch", "_host", "_pooled", "_treedef", "_path",
                  "_nbytes", "priority", "_lock", "_catalog", "handle",
                  "closed", "_scalars", "_nleaves", "_num_rows",
-                 "creation_stack", "_slab", "_crcs")
+                 "creation_stack", "_slab", "_crcs", "owner")
 
     def __init__(self, batch: ColumnarBatch,
                  priority: SpillPriority = SpillPriority.ACTIVE_ON_DECK,
                  catalog: Optional["SpillCatalog"] = None):
         self._nbytes = batch_nbytes(batch)
         self._catalog = catalog or spill_catalog()
-        self._catalog.budget.reserve(self._nbytes)
+        # budget-slice owner: the query whose thread registered this
+        # batch. Reserve/release always pair on this tag so slice
+        # accounting stays consistent no matter which thread spills or
+        # re-materializes; victim selection uses it to keep one
+        # tenant's pressure off another's batches.
+        from ..robustness.admission import current_query
+        q = current_query()
+        self.owner: Optional[str] = q.query_id if q is not None else None
+        self._catalog.budget.reserve(self._nbytes, owner=self.owner)
         self._batch: Optional[ColumnarBatch] = batch
         self._num_rows = int(batch.num_rows)
         self._host = None
@@ -206,14 +214,19 @@ class SpillableBatch:
             if self._pooled is None:
                 self._host = host
             self._batch = None
-            self._catalog.budget.release(self._nbytes)
+            self._catalog.budget.release(self._nbytes, owner=self.owner)
             from .budget import task_context
+            from ..robustness.admission import current_query
             ctx = task_context()
             ctx.spilled_bytes += self._nbytes
             ctx.spill_time_ns += _time.perf_counter_ns() - t0
+            rq = current_query()
             _events.emit("SpillToHost", bytes=self._nbytes,
                          time_ns=_time.perf_counter_ns() - t0,
-                         priority=int(self.priority))
+                         priority=int(self.priority),
+                         owner=self.owner,
+                         requested_by=rq.query_id
+                         if rq is not None else None)
             return self._nbytes
 
     def spill_to_disk(self) -> int:
@@ -278,14 +291,16 @@ class SpillableBatch:
                 raise ValueError("SpillableBatch used after close")
             if self._batch is not None:
                 return self._batch
-        self._catalog.budget.reserve(self._nbytes)
+        self._catalog.budget.reserve(self._nbytes, owner=self.owner)
         try:
             with self._lock:
                 if self.closed:
-                    self._catalog.budget.release(self._nbytes)
+                    self._catalog.budget.release(self._nbytes,
+                                                 owner=self.owner)
                     raise ValueError("SpillableBatch used after close")
                 if self._batch is not None:  # raced with another get()
-                    self._catalog.budget.release(self._nbytes)
+                    self._catalog.budget.release(self._nbytes,
+                                                 owner=self.owner)
                     return self._batch
                 if self._host is None and self._pooled is None and \
                         self._path is not None:
@@ -342,7 +357,7 @@ class SpillableBatch:
                     except OSError:
                         pass
                     self._path = None
-            self._catalog.budget.release(self._nbytes)
+            self._catalog.budget.release(self._nbytes, owner=self.owner)
             self._catalog.unregister(self.handle)
             raise
 
@@ -405,7 +420,8 @@ class SpillableBatch:
                 return
             self.closed = True
             if self._batch is not None:
-                self._catalog.budget.release(self._nbytes)
+                self._catalog.budget.release(self._nbytes,
+                                             owner=self.owner)
                 self._batch = None
             self._host = None
             if self._pooled is not None:
@@ -505,14 +521,42 @@ class SpillCatalog:
                 (e for e in self._entries.values() if e.tier == "device"),
                 key=lambda e: (e.priority, -e.nbytes))
 
-    def synchronous_spill(self, target_bytes: int) -> int:
+    def synchronous_spill(self, target_bytes: int,
+                          requester: Optional[str] = None,
+                          active_owners=None) -> int:
         """Free >= target_bytes of device memory if possible
-        (RapidsBufferCatalog.synchronousSpill:589)."""
+        (RapidsBufferCatalog.synchronousSpill:589).
+
+        Budget-slice isolation: when the budget passes the requesting
+        query and the live-owner set, candidates belonging to OTHER
+        live queries are skipped — a tenant's pressure spills only its
+        own batches, untagged ones, and leftovers of finished queries
+        (idle slices). Legacy single-tenant callers pass neither and
+        see the original all-candidates behavior. A cancel/deadline on
+        the requesting query aborts mid-spill (the reservation that
+        triggered this pass is moot)."""
+        from ..robustness.admission import current_query
+        qc = current_query()
         freed = 0
         for e in self.device_candidates():
             if freed >= target_bytes:
                 break
-            freed += e.spill_to_host()
+            if qc is not None:
+                qc.check()  # teardown point: mid-spill cancellation
+            owner = e.owner
+            if (active_owners and owner is not None
+                    and owner != requester and owner in active_owners):
+                continue  # another live query's slice: not evictable
+            n = e.spill_to_host()
+            if n and owner is not None and owner != requester:
+                # observable proof of the isolation contract: only
+                # finished queries' leftovers cross tenant lines
+                _events.emit("CrossQuerySpill", bytes=n, owner=owner,
+                             requested_by=requester,
+                             owner_active=bool(
+                                 active_owners
+                                 and owner in active_owners))
+            freed += n
         self._enforce_host_limit()
         return freed
 

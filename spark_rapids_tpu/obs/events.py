@@ -40,6 +40,9 @@ EVENT_TYPES = (
     "FaultInjected", "CorruptionDetected",
     "WorkerEvicted",
     "ProgramCompiled", "RooflineSummary",
+    "QueryAdmitted", "AdmissionQueued", "AdmissionRejected",
+    "AdmissionAbandoned", "QueryCancelled", "DeadlineExceeded",
+    "CrossQuerySpill", "PrefetchThreadLeak", "ClusterCancelBroadcast",
 )
 
 

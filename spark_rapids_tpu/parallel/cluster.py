@@ -53,6 +53,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import select
 import socket
 import socketserver
 import struct
@@ -429,6 +430,10 @@ class ClusterWorker:
                         daemon=True)
                     hb.start()
                     msg = _recv_msg(s)
+                #: control frames the mid-job cancel listener consumed
+                #: early — replayed in order once the job has replied,
+                #: preserving the pre-listener queue-in-socket semantics
+                pending: List[dict] = []
                 while True:
                     if msg is None or msg["type"] == "shutdown":
                         return
@@ -447,21 +452,83 @@ class ClusterWorker:
                         token = (self._last_job or {}).get("token")
                         _send_msg(s, {"type": "retry_ready",
                                       "token": token})
+                    elif msg["type"] == "cancel":
+                        # stale cancel: the job it targeted already
+                        # replied (the broadcast raced our result) —
+                        # nothing to do, stay in protocol sync
+                        pass
                     elif msg["type"] == "job":
-                        try:
-                            rows, metrics = self._run_job(msg)
-                            _send_msg(s, {"type": "result", "rows": rows,
-                                          "metrics": metrics})
-                        except BaseException as e:  # surface to driver
-                            import traceback
-                            _send_msg(s, {"type": "error",
-                                          "error": f"{e}\n"
-                                          f"{traceback.format_exc()}"})
-                    msg = _recv_msg(s)
+                        alive = self._serve_job(s, msg, pending)
+                        if not alive:
+                            return
+                    msg = pending.pop(0) if pending else _recv_msg(s)
         finally:
             stop_hb.set()
 
-    def _run_job(self, msg) -> Tuple[List[dict], dict]:
+    def _serve_job(self, s: socket.socket, msg,
+                   pending: List[dict]) -> bool:
+        """Run one job on a side thread while THIS (control) thread
+        keeps listening on the driver socket — the only way a cancel
+        can reach a busy worker. Mid-job, a ``cancel`` frame (or a
+        closed connection: driver gone) flips the job's cancel token
+        and the executing thread surfaces QueryCancelled at its next
+        check point; any OTHER frame (reset/prepare_retry of an aborted
+        attempt) is appended to ``pending`` for the caller to replay
+        after the reply, exactly as it would have queued in the socket
+        buffer before this listener existed. Returns False when the
+        dialogue is over (driver lost / shutdown mid-job)."""
+        from ..robustness.admission import QueryContext
+        qctx = QueryContext(
+            query_id=f"{msg.get('job_token', 'job')}"
+                     f"-w{msg.get('worker_id', 0)}")
+        reply: List[Optional[dict]] = [None]
+
+        def _job() -> None:
+            try:
+                rows, metrics = self._run_job(msg, qctx)
+                reply[0] = {"type": "result", "rows": rows,
+                            "metrics": metrics}
+            except BaseException as e:  # surface to driver
+                import traceback
+                reply[0] = {"type": "error",
+                            "error": f"{e}\n{traceback.format_exc()}"}
+
+        jt = threading.Thread(target=_job, daemon=True,
+                              name="srt-worker-job")
+        jt.start()
+        while jt.is_alive():
+            readable, _w, _x = select.select([s], [], [], 0.25)
+            if not readable:
+                continue
+            try:
+                ctl = _recv_msg(s)
+            except OSError:
+                ctl = None
+            if ctl is None:
+                # driver connection lost: abandon the job (nobody is
+                # left to receive the result)
+                qctx.cancel("driver connection lost")
+                jt.join(timeout=30.0)
+                return False
+            t = ctl.get("type")
+            if t == "cancel":
+                qctx.cancel(ctl.get("reason") or "driver cancel")
+            elif t == "shutdown":
+                qctx.cancel("worker shutdown")
+                jt.join(timeout=30.0)
+                return False
+            else:
+                # a reset/prepare_retry mid-job means the driver gave
+                # up on this attempt: finish fast, reply (the driver
+                # drains it), then let the caller replay the frame
+                if t == "reset":
+                    qctx.cancel("driver reset during job")
+                pending.append(ctl)
+        jt.join()
+        _send_msg(s, reply[0])
+        return True
+
+    def _run_job(self, msg, qctx=None) -> Tuple[List[dict], dict]:
         from ..conf import SrtConf, set_active_conf
         from ..exec.base import ExecContext
         from ..plan import overrides
@@ -472,6 +539,19 @@ class ClusterWorker:
         settings["srt.shuffle.mode"] = "MULTITHREADED"
         conf = SrtConf(settings)
         set_active_conf(conf)
+        # cancellation/deadline token: explicit cancels arrive over the
+        # control socket (see _serve_job); the DEADLINE propagates
+        # through the job conf — srt.sql.queryTimeout ships with every
+        # job, so each worker arms its own clock from job start (driver
+        # queueing time is not counted against the worker's slice)
+        from ..conf import QUERY_TIMEOUT_S
+        from ..robustness.admission import QueryContext, set_current_query
+        if qctx is None:
+            qctx = QueryContext(
+                query_id=f"{msg.get('job_token', 'job')}"
+                         f"-w{msg.get('worker_id', 0)}")
+        qctx.set_timeout(conf.get(QUERY_TIMEOUT_S))
+        set_current_query(qctx)
         # arm (or keep, or disarm) this process's fault plan from the
         # job conf — the driver-side test's spec reaches every worker
         faults.arm_from_conf(conf)
@@ -535,7 +615,7 @@ class ClusterWorker:
                   f"{cluster.logical_ids} fresh={cluster.fresh_ids} "
                   f"reuse={sorted(cluster.reusable_sids)}):\n"
                   f"{physical.tree_string()}", file=sys.stderr, flush=True)
-        ctx = ExecContext(conf)
+        ctx = ExecContext(conf, query=qctx)
         ctx.cluster = cluster
         ctx.tracer = tracer
         # distinct per-worker default so monotonically_increasing_id /
@@ -564,6 +644,7 @@ class ClusterWorker:
                 for i in range(len(d[names[0]]) if names else 0):
                     rows.append({k: d[k][i] for k in names})
         finally:
+            set_current_query(None)
             if task_scope is not None:
                 task_scope.__exit__(None, None, None)
             if tracer is not None:
@@ -670,6 +751,10 @@ class ClusterDriver:
             heartbeat_timeout if heartbeat_timeout is not None
             else conf.get(HEARTBEAT_TIMEOUT_S))
         self._workers: List[Tuple[socket.socket, str, str]] = []
+        #: serializes frames on the worker control sockets — a cancel
+        #: broadcast from another thread must not interleave with the
+        #: job dialogue's own sends mid-frame
+        self._ctl_send_lock = threading.Lock()
         self._registered = threading.Event()
         self._barriers: Dict = {}
         self._gathers: Dict = {}
@@ -830,6 +915,23 @@ class ClusterDriver:
                 except OSError:
                     pass
 
+    def cancel_job(self, reason: str = "driver cancel") -> None:
+        """Broadcast a cancel to every worker's control socket. Workers
+        flip their in-flight job's cancel token (see _serve_job); a
+        worker that already replied reads the frame as a stale no-op.
+        Safe from any thread; best-effort per socket."""
+        from ..obs import events as _events
+        with self._block:
+            targets = list(self._workers)
+        _events.emit("ClusterCancelBroadcast", reason=reason,
+                     num_workers=len(targets))
+        for sock, _ep, _eid in targets:
+            try:
+                with self._ctl_send_lock:
+                    _send_msg(sock, {"type": "cancel", "reason": reason})
+            except OSError:
+                pass
+
     def wait_for_workers(self, timeout: float = 60.0) -> None:
         if not self._registered.wait(timeout):
             raise TimeoutError(
@@ -882,11 +984,19 @@ class ClusterDriver:
         try:
             last: Optional[BaseException] = None
             retry_spec: Optional[dict] = None
+            from ..robustness.admission import QueryInterrupted
             for attempt in range(max_retries + 1):
                 try:
                     return self._run_once(logical_plan, conf_settings,
                                           job_token, attempt, retry_spec,
                                           trace_ctx)
+                except QueryInterrupted:
+                    # typed cancel/deadline — NOT a failure to retry:
+                    # stop the rest of the fleet and drain the aborted
+                    # dialogue so the next job starts in protocol sync
+                    self.cancel_job("peer query interrupted")
+                    self._recover()
+                    raise
                 except StageRetryFailed as e:
                     last = e
                     retry_spec = None
@@ -963,35 +1073,66 @@ class ClusterDriver:
         blob = cloudpickle.dumps(logical_plan)
         for w, (sock, _ep, _eid) in enumerate(workers):
             try:
-                _send_msg(sock, {"type": "job", "plan": blob,
-                                 "conf": dict(conf_settings or {}),
-                                 "worker_id": w,
-                                 "num_workers": n,
-                                 "peers": peers,
-                                 "job_token": job_token,
-                                 "attempt": attempt,
-                                 "logical_ids": assign[w],
-                                 "fresh_ids": fresh[w],
-                                 "shard_mod": shard_mod,
-                                 "map_id_base": attempt << 20,
-                                 "reusable_positions": reusable,
-                                 "reuse_token": reuse_token,
-                                 "trace_ctx": trace_ctx})
+                with self._ctl_send_lock:
+                    _send_msg(sock, {"type": "job", "plan": blob,
+                                     "conf": dict(conf_settings or {}),
+                                     "worker_id": w,
+                                     "num_workers": n,
+                                     "peers": peers,
+                                     "job_token": job_token,
+                                     "attempt": attempt,
+                                     "logical_ids": assign[w],
+                                     "fresh_ids": fresh[w],
+                                     "shard_mod": shard_mod,
+                                     "map_id_base": attempt << 20,
+                                     "reusable_positions": reusable,
+                                     "reuse_token": reuse_token,
+                                     "trace_ctx": trace_ctx})
             except OSError:
                 raise WorkerLost(w)
         results: List[Optional[List[dict]]] = [None] * n
         #: per-worker {exec_id: {metric: value}} of the last successful
         #: job — AQE tests read skew/coalesce counters through this
         worker_metrics: List[dict] = [{} for _ in range(n)]
+        # reply wait is cancel-aware: when the DRIVER thread runs under
+        # a query token (session-driven runs), poll it between select
+        # ticks — the first trip broadcasts cancel to every worker, then
+        # we keep draining their (now typed-error) replies in order
+        from ..robustness.admission import (DeadlineExceeded,
+                                            QueryCancelled, current_query)
+        qc = current_query()
+        cancel_sent = False
         for w, (sock, _ep, _eid) in enumerate(workers):
             try:
-                reply = _recv_msg(sock)
+                if qc is None:
+                    reply = _recv_msg(sock)
+                else:
+                    while True:
+                        if not cancel_sent and (qc.is_cancelled()
+                                                or qc.expired()):
+                            self.cancel_job(qc.cancel_reason
+                                            or "deadline exceeded")
+                            cancel_sent = True
+                        readable, _w2, _x = select.select(
+                            [sock], [], [], 0.25)
+                        if readable:
+                            reply = _recv_msg(sock)
+                            break
             except OSError:
                 reply = None
             if reply is None:
                 raise WorkerLost(w)
             if reply["type"] == "error":
                 err = reply["error"]
+                if "QueryCancelled" in err or "DeadlineExceeded" in err:
+                    # typed interrupt from the worker — NOT a worker
+                    # loss, must NOT trigger stage/job retry (a rerun
+                    # of a cancelled query is exactly what cancel
+                    # forbids); surface the matching driver-side type
+                    first = err.splitlines()[0] if err else err
+                    cls = (DeadlineExceeded if "DeadlineExceeded" in err
+                           else QueryCancelled)
+                    raise cls(f"worker {w}: {first}")
                 if "stage-reuse state unavailable" in err:
                     raise StageRetryFailed(w, err)
                 if "barrier" in err or "gather" in err or \
@@ -1013,7 +1154,8 @@ class ClusterDriver:
         # problem.
         for sock, _ep, _eid in workers:
             try:
-                _send_msg(sock, {"type": "reset"})
+                with self._ctl_send_lock:
+                    _send_msg(sock, {"type": "reset"})
                 _recv_msg(sock)  # reset_done (keeps protocol in sync)
             except OSError:
                 pass
@@ -1124,7 +1266,8 @@ class ClusterDriver:
         alive = []
         for sock, ep, eid in self._workers:
             try:
-                _send_msg(sock, {"type": "reset"})
+                with self._ctl_send_lock:
+                    _send_msg(sock, {"type": "reset"})
                 # drain stale replies of the aborted attempt (a worker
                 # stuck at a now-aborted barrier first reports its job
                 # error, THEN processes the reset); budget covers a full

@@ -247,6 +247,8 @@ class ShuffleManager:
         """One map task's output: partitions[i] goes to reduce i.
         Returns serialized bytes written (0 in CACHE_ONLY mode)."""
         fault_point("shuffle.write", f"sid={shuffle_id};map={map_id};")
+        from ..robustness.admission import check_current_query
+        check_current_query()  # cancelled query: skip the whole write
         t0 = time.perf_counter_ns()
         bytes_before = self.write_metrics.bytes_written
         futures = []
@@ -312,7 +314,11 @@ class ShuffleManager:
             shuffle_id, reduce_id) if keep(b[1])]
         futures = [self._pool.submit(self._deserialize_one, b)
                    for b in blocks]
+        from ..robustness.admission import check_current_query
         for f in futures:
+            # abort the fan-in between blocks when the consuming
+            # query was cancelled or blew its deadline
+            check_current_query()
             batch = f.result()
             if batch is not None:
                 yield batch
@@ -376,7 +382,12 @@ class ShuffleHeartbeatManager:
     carries host:port endpoints for the DCN block-fetch path and lets
     the planner exclude dead peers."""
 
-    def __init__(self, timeout_s: float = 60.0):
+    def __init__(self, timeout_s: Optional[float] = None):
+        if timeout_s is None:
+            # standalone default from conf; cluster runs pass the
+            # driver's srt.cluster.heartbeatTimeoutSec through instead
+            from ..conf import SHUFFLE_HEARTBEAT_TIMEOUT_S, active_conf
+            timeout_s = active_conf().get(SHUFFLE_HEARTBEAT_TIMEOUT_S)
         self.timeout_s = timeout_s
         self._executors: Dict[str, ExecutorInfo] = {}
         #: every endpoint an executor EVER served from -> executor_id;

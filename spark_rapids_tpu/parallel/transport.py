@@ -264,8 +264,17 @@ def _retrying_stream(cli: ShuffleBlockClient, shuffle_id: int,
                          reduce_id=reduce_id, attempt=attempt,
                          error=str(e))
             if attempt <= cli.max_retries:
-                time.sleep(cli.backoff_base_s * (2 ** (attempt - 1))
+                backoff = (cli.backoff_base_s * (2 ** (attempt - 1))
                            * (1.0 + random.random() * 0.25))
+                # cancel-aware backoff: an in-flight fetch for a
+                # cancelled/expired query aborts here instead of
+                # sleeping out its whole retry budget
+                from ..robustness.admission import current_query
+                qc = current_query()
+                if qc is not None:
+                    qc.sleep(backoff)  # raises on cancel/deadline
+                else:
+                    time.sleep(backoff)
                 continue
             if resolver is not None and not failed_over:
                 try:
@@ -435,22 +444,31 @@ def fetch_all_partitions(endpoints: List[str], shuffle_id: int,
         return
 
     import queue as _q
+    from ..robustness.admission import current_query, query_scope
     budget = budget or ByteBudget(in_flight_bytes)
     outq: "_q.Queue" = _q.Queue()
     stop = threading.Event()
     pool = fetch_pool()
+    # captured HERE on the consuming thread (pool workers are reused
+    # across queries and carry no query identity of their own): each
+    # worker re-binds it so retry backoffs abort and staged blocks
+    # stop flowing the moment the query is torn down
+    qc = current_query()
 
     def worker(ep: str) -> None:
         try:
             if stop.is_set():  # abandoned before this task ran
                 return
-            for map_id, data in open_stream(ep):
-                if stop.is_set():
-                    return
-                if not keep(map_id):
-                    continue
-                budget.acquire(len(data))
-                outq.put(("block", data))
+            with query_scope(qc):
+                for map_id, data in open_stream(ep):
+                    if stop.is_set() or (
+                            qc is not None and (qc.is_cancelled()
+                                                or qc.expired())):
+                        return
+                    if not keep(map_id):
+                        continue
+                    budget.acquire(len(data))
+                    outq.put(("block", data))
         except BaseException as e:  # surfaced on the consumer side
             outq.put(("error", e))
         finally:
@@ -465,7 +483,18 @@ def fetch_all_partitions(endpoints: List[str], shuffle_id: int,
         done = 0
         total = len(endpoints)
         while done < total:
-            kind, payload = outq.get()
+            if qc is None:
+                kind, payload = outq.get()
+            else:
+                # bounded waits: a hung peer must not outlast the
+                # query's deadline or ignore its cancel token
+                while True:
+                    qc.check()
+                    try:
+                        kind, payload = outq.get(timeout=0.25)
+                        break
+                    except _q.Empty:
+                        continue
             if kind == "done":
                 done += 1
                 if pending:

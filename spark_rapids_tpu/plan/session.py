@@ -107,6 +107,19 @@ class TpuSession:
         #: (physical, ctx, query_id, wall_ns) of the most recent
         #: execute — explain(metrics=True) renders from this
         self._last_execution = None
+        #: QueryContext of the query this session is currently
+        #: executing (None when idle): the cancel handle for other
+        #: threads — ``session.cancel()`` / serving-tier aborts
+        self._active_query = None
+
+    def cancel(self, reason: str = "session.cancel()") -> bool:
+        """Cancel the in-flight query, if any (thread-safe; callable
+        from any thread). Returns True if a query was signalled."""
+        q = self._active_query
+        if q is None:
+            return False
+        q.cancel(reason)
+        return True
 
     # --- constructors ---
     def create_dataframe(self, data: Dict[str, list],
@@ -144,7 +157,8 @@ class TpuSession:
         return DataFrameReader(self)
 
     # --- execution ---
-    def execute(self, plan: L.LogicalPlan) -> HostTable:
+    def execute(self, plan: L.LogicalPlan,
+                timeout: Optional[float] = None) -> HostTable:
         """Run a logical plan to a host table.
 
         Physical plans are memoized on a structural key (plan_cache.py)
@@ -171,39 +185,74 @@ class TpuSession:
                 self._plan_cache.put(key, physical)
         elif isinstance(physical, TpuExec):
             physical.reset_for_rerun()
-        return self._execute_physical(physical, plan)
+        return self._execute_physical(physical, plan, timeout=timeout)
 
-    def _execute_physical(self, physical, plan: L.LogicalPlan
-                          ) -> HostTable:
+    def _execute_physical(self, physical, plan: L.LogicalPlan,
+                          timeout: Optional[float] = None) -> HostTable:
         """Run a planned physical tree with the query-level
         observability wrapper: QueryStart/QueryEnd events, optional
         per-query span tracer (written out as a Chrome trace), and a
         per-query metrics summary recorded in the process registry.
         When observability is off this adds one conf check and one
-        per-query summary — nothing per batch."""
+        per-query summary — nothing per batch.
+
+        Concurrency contract (robustness/admission.py): the query
+        first passes admission (``srt.sql.concurrentQueryTasks``
+        running, bounded queue, load-shed with AdmissionRejected),
+        claims a per-query budget slice, and executes under a
+        QueryContext cancel token armed from ``timeout`` (collect) or
+        ``srt.sql.queryTimeout`` — cancellation/deadline surface as
+        QueryCancelled / DeadlineExceeded after a clean teardown
+        through every producer and fetch thread."""
         import time as _time
 
-        from ..conf import METRICS_LEVEL
+        from ..conf import METRICS_LEVEL, QUERY_TIMEOUT_S
         from ..obs import events as _events
         from ..obs import resource as _resource
         from ..obs import roofline as _roofline
         from ..obs.registry import registry as _registry
         from ..obs.registry import summarize_metrics
         from ..obs.trace import maybe_tracer
-        from ..memory.budget import task_context
+        from ..memory.budget import device_budget, task_context
+        from ..robustness.admission import (DeadlineExceeded,
+                                            QueryContext,
+                                            QueryInterrupted,
+                                            query_scope, query_semaphore)
         _events.configure_from_conf(self.conf)
         _resource.configure_from_conf(self.conf)
         _roofline.configure_from_conf(self.conf)
-        # per-query roofline window: ledger counter baseline, diffed
-        # in the finally into a RooflineSummary (None = sampling off,
-        # and then the whole layer is skipped)
-        rwin = _roofline.window()
-        ctx = ExecContext(self.conf)
-        ctx.tracer = maybe_tracer(self.conf)
-        tc = task_context()
-        tc0 = (tc.spilled_bytes, tc.retry_count, tc.split_count)
         TpuSession._query_seq[0] += 1
         qid = f"q{_os.getpid()}-{TpuSession._query_seq[0]}"
+        qctx = QueryContext(query_id=qid)
+        qctx.set_timeout(timeout if timeout is not None
+                         else self.conf.get(QUERY_TIMEOUT_S))
+        # admission before any work: may park this thread in the
+        # bounded queue, load-shed (AdmissionRejected — retryable, no
+        # resources held), or give up on cancel/deadline while queued
+        sem = query_semaphore(self.conf)
+        sem.acquire(qctx)
+        budget = None
+        try:
+            budget = device_budget()
+            budget.register_query(qid, slots=sem.permits)
+            self._active_query = qctx
+            qscope = query_scope(qctx)
+            qscope.__enter__()
+            # per-query roofline window: ledger counter baseline,
+            # diffed in the finally into a RooflineSummary (None =
+            # sampling off, and then the whole layer is skipped)
+            rwin = _roofline.window()
+            ctx = ExecContext(self.conf, query=qctx)
+            ctx.tracer = maybe_tracer(self.conf)
+        except BaseException:
+            # a failed setup must not leak the admission permit —
+            # that would wedge every later query behind a ghost
+            if budget is not None:
+                budget.unregister_query(qid)
+            sem.release()
+            raise
+        tc = task_context()
+        tc0 = (tc.spilled_bytes, tc.retry_count, tc.split_count)
         is_tpu = isinstance(physical, TpuExec)
         if _events.enabled():
             _events.emit("QueryStart", query_id=qid, device=is_tpu,
@@ -236,14 +285,32 @@ class TpuSession:
                         else empty_like(plan.schema)
                 else:
                     result = physical.evaluate(ctx)
+                # final token check: a cancel/deadline that flipped as
+                # the last producer drained must never surface as a
+                # silently truncated "successful" result — a cancelled
+                # query's caller gets the typed error even if the race
+                # finished the pull loop first
+                qctx.check()
             finally:
                 if qspan is not None:
                     qspan.__exit__(None, None, None)
+        except QueryInterrupted as e:
+            status = "deadline_exceeded" \
+                if isinstance(e, DeadlineExceeded) else "cancelled"
+            error = f"{type(e).__name__}: {e}"
+            _events.emit(type(e).__name__, query_id=qid,
+                         reason=qctx.cancel_reason)
+            raise
         except BaseException as e:
             status = "error"
             error = f"{type(e).__name__}: {e}"
             raise
         finally:
+            qscope.__exit__(None, None, None)
+            budget.unregister_query(qid)
+            sem.release()
+            if self._active_query is qctx:
+                self._active_query = None
             wall_ns = _time.perf_counter_ns() - t0
             _registry().observe("task_time_ns", wall_ns, "ns")
             summary = summarize_metrics(ctx.metrics,
@@ -527,8 +594,11 @@ class DataFrame:
         return col(name)
 
     # --- actions ---
-    def collect(self) -> List[dict]:
-        table = self.session.execute(self.plan)
+    def collect(self, timeout: Optional[float] = None) -> List[dict]:
+        """Run the query and return rows. ``timeout`` (seconds) arms a
+        per-call deadline — the query tears down cleanly and raises
+        DeadlineExceeded on expiry; overrides ``srt.sql.queryTimeout``."""
+        table = self.session.execute(self.plan, timeout=timeout)
         data = to_pydict(table)
         names = list(data.keys())
         n = table.num_rows

@@ -372,6 +372,212 @@ def _roofline_check() -> int:
     return failures
 
 
+def _concurrency_check(n_threads: int = 8, queries_per_thread: int = 4,
+                       seed: int = 1337) -> int:
+    """Concurrent-serving leg: N threads race mixed queries through a
+    2-permit admission semaphore over a deliberately small device
+    budget, with seeded delay faults widening the cancel windows and
+    seeded cancels/deadlines fired mid-flight. Every query must end in
+    exactly one of {bit-identical to the serial oracle, QueryCancelled,
+    DeadlineExceeded, AdmissionRejected-then-retried-to-identical} —
+    and afterwards the engine must be pristine: zero leaked threads,
+    zero prefetch-thread leaks, empty budget slices, a drained
+    semaphore, and no cross-budget violation (a spill stealing from a
+    LIVE sibling's slice) in the event log. Returns failure count."""
+    import random as _random
+
+    import numpy as np
+
+    from spark_rapids_tpu.conf import SrtConf, set_active_conf
+    from spark_rapids_tpu.exec.pipeline import prefetch_thread_leaks
+    from spark_rapids_tpu.expr import col
+    from spark_rapids_tpu.expr.aggregates import CountStar, Sum
+    from spark_rapids_tpu.expr.core import Alias
+    from spark_rapids_tpu.memory.budget import (device_budget,
+                                                reset_device_budget)
+    from spark_rapids_tpu.memory.spill import reset_spill_catalog
+    from spark_rapids_tpu.obs import events as ev
+    from spark_rapids_tpu.plan import TpuSession
+    from spark_rapids_tpu.robustness.admission import (AdmissionRejected,
+                                                       DeadlineExceeded,
+                                                       QueryCancelled,
+                                                       query_semaphore,
+                                                       reset_query_semaphore)
+    from spark_rapids_tpu.robustness.faults import (FaultPlan,
+                                                    arm_fault_plan,
+                                                    disarm_fault_plan)
+
+    failures = 0
+    t0 = time.monotonic()
+    with tempfile.TemporaryDirectory(prefix="srt_conc_") as tmp:
+        events_dir = os.path.join(tmp, "events")
+        data_dir = os.path.join(tmp, "fact")
+        rng = np.random.default_rng(seed)
+        n = 40_000
+        TpuSession(SrtConf({})).create_dataframe({
+            "k": rng.integers(0, 64, n).tolist(),
+            "v": rng.uniform(0, 10, n).tolist(),
+        }).write.parquet(data_dir)
+
+        def shapes(sess):
+            scan = sess.read.parquet(data_dir)
+            return [
+                scan.filter(col("v") < 8.0).group_by("k")
+                    .agg(Alias(Sum(col("v")), "s"),
+                         Alias(CountStar(), "c")).sort("k"),
+                scan.group_by("k")
+                    .agg(Alias(CountStar(), "c")).sort("k"),
+                scan.filter(col("v") >= 2.0).group_by("k")
+                    .agg(Alias(Sum(col("v")), "s")).sort("k"),
+            ]
+
+        oracles = [d.collect() for d in shapes(TpuSession(SrtConf({})))]
+        conf = SrtConf({
+            "srt.sql.concurrentQueryTasks": "2",
+            "srt.sql.admission.maxQueueDepth": "3",
+            "srt.sql.admission.backoffBaseSec": "0.01",
+            "srt.eventLog.enabled": "true",
+            "srt.eventLog.dir": events_dir,
+        })
+        # contention: a small shared budget forces spill pressure
+        # across the slices, and the delay faults stretch reserve and
+        # scan long enough for cancels/deadlines to land mid-query
+        reset_device_budget(24 << 20)
+        reset_spill_catalog()
+        reset_query_semaphore(conf)
+        arm_fault_plan(FaultPlan.parse(
+            f"seed={seed}|memory.reserve:delay%0.15*40+0.01"
+            f"|scan.file:delay%0.2*30+0.01"))
+        leaks_before = prefetch_thread_leaks()
+        baseline = {t.ident for t in threading.enumerate()}
+        outcomes = {"identical": 0, "cancelled": 0, "deadline": 0,
+                    "retried": 0}
+        errors = []
+        timers = []
+        timers_lock = threading.Lock()
+
+        def worker(i):
+            r = _random.Random(seed * 1000 + i)
+            set_active_conf(conf)
+            sess = TpuSession(conf)
+            plans = shapes(sess)
+            for q in range(queries_per_thread):
+                shape = r.randrange(len(plans))
+                action = r.choice(["none", "none", "cancel",
+                                   "deadline", "tiny-deadline"])
+                timeout = None
+                if action == "deadline":
+                    timeout = r.uniform(0.02, 0.2)
+                elif action == "tiny-deadline":
+                    timeout = 1e-4  # certain to trip: proves the path
+                elif action == "cancel":
+                    tm = threading.Timer(r.uniform(0.01, 0.15),
+                                         sess.cancel, ("chaos cancel",))
+                    tm.daemon = True
+                    with timers_lock:
+                        timers.append(tm)
+                    tm.start()
+                rejected = 0
+                while True:
+                    try:
+                        rows = plans[shape].collect(timeout=timeout)
+                        if rows == oracles[shape]:
+                            outcomes["retried" if rejected
+                                     else "identical"] += 1
+                        else:
+                            errors.append(
+                                f"t{i} q{q} shape{shape} diverged "
+                                f"({len(rows)} rows)")
+                        break
+                    except QueryCancelled:
+                        outcomes["cancelled"] += 1
+                        break
+                    except DeadlineExceeded:
+                        outcomes["deadline"] += 1
+                        break
+                    except AdmissionRejected:
+                        rejected += 1
+                        if rejected > 25:
+                            errors.append(f"t{i} q{q}: admission never "
+                                          f"succeeded after {rejected}")
+                            break
+                        time.sleep(0.01 * rejected)
+                    except BaseException as e:  # noqa: BLE001
+                        errors.append(f"t{i} q{q}: unexpected "
+                                      f"{type(e).__name__}: {e}")
+                        break
+
+        threads = [threading.Thread(target=worker, args=(i,),
+                                    name=f"chaos-conc-{i}")
+                   for i in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(180)
+        with timers_lock:
+            for tm in timers:
+                tm.cancel()
+                tm.join(5)
+        for msg in errors:
+            print(f"[chaos] FAIL [concurrency]: {msg}",
+                  file=sys.stderr, flush=True)
+        failures += len(errors)
+
+        sem = query_semaphore(conf)
+        checks = [
+            ("every typed outcome observed at least once",
+             outcomes["deadline"] > 0 and outcomes["identical"] > 0),
+            ("admission semaphore drained",
+             sem.active() == 0 and sem.queue_depth() == 0),
+            ("budget slices all unregistered",
+             device_budget().active_owners() == set()),
+            ("zero prefetch thread leaks",
+             prefetch_thread_leaks() == leaks_before),
+        ]
+        # worker threads (prefetch producers, timers) must all be gone;
+        # give slow daemon exits a settle window before declaring leaks
+        settle = time.monotonic() + 5.0
+        stray = [t for t in threading.enumerate()
+                 if t.ident not in baseline and t.is_alive()]
+        while stray and time.monotonic() < settle:
+            time.sleep(0.1)
+            stray = [t for t in threading.enumerate()
+                     if t.ident not in baseline and t.is_alive()]
+        checks.append(("zero leaked threads", not stray))
+        # cross-budget isolation: no spill may have evicted a LIVE
+        # sibling query's batch (idle/finished owners are fair game)
+        recs = ev.read_all_events(events_dir)
+        violations = [r for r in recs
+                      if r.get("event") == "CrossQuerySpill"
+                      and r.get("owner_active")]
+        checks.append(("zero cross-budget violations", not violations))
+        admitted = sum(1 for r in recs
+                       if r.get("event") == "QueryAdmitted")
+        checks.append(("admission events logged", admitted > 0))
+        for what, ok in checks:
+            if not ok:
+                print(f"[chaos] FAIL [concurrency]: {what}"
+                      + (f" (stray={[t.name for t in stray]})"
+                         if what == "zero leaked threads" else "")
+                      + (f" ({len(violations)} violations)"
+                         if what == "zero cross-budget violations"
+                         else ""),
+                      file=sys.stderr, flush=True)
+                failures += 1
+        # restore process-wide state for whatever runs next
+        disarm_fault_plan()
+        reset_query_semaphore()
+        reset_device_budget(None)
+        reset_spill_catalog()
+        ev.configure_from_conf(SrtConf({}))
+        print(f"[chaos] {'PASS' if not failures else 'FAIL'} "
+              f"[concurrency: {n_threads} threads x "
+              f"{queries_per_thread} queries, outcomes={outcomes}] "
+              f"{time.monotonic() - t0:.1f}s "
+              f"({len(checks)} checks)", flush=True)
+    return failures
+
+
 def _rows_match(rows, oracle):
     if [r["k"] for r in rows] != [r["k"] for r in oracle]:
         return False
@@ -572,6 +778,8 @@ def main() -> int:
     failures += _telemetry_check()
     # roofline-observability leg: sampled query -> report, off -> silent
     failures += _roofline_check()
+    # concurrent-serving leg: admission + budget slices + cancellation
+    failures += _concurrency_check()
     watchdog.cancel()
     print(f"[chaos] done in {time.monotonic() - t0:.1f}s, "
           f"{failures} failure(s)", flush=True)
