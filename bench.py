@@ -37,7 +37,13 @@ srt.exec.fusion.enabled for every engine session; "both" additionally
 re-times q6/q3 unfused — recording q*_unfused_s / q*_fusion_speedup —
 and switches the NDS A/B dimension from pipeline to fusion, with
 nds_fusion_* common-query delta keys and jit-registry hit/miss counts
-for the fused-program cache).
+for the fused-program cache),
+SRT_BENCH_ADAPTIVE=on|off|both (adaptive-query-execution A/B: "off"
+disables srt.sql.adaptive.enabled for every engine session; "both"
+switches the NDS A/B dimension to adaptive, recording
+nds_adaptive_on_* / nds_adaptive_off_* per-leg keys plus the
+nds_adaptive_delta_pct common-query delta — adaptive takes the A/B
+slot over fusion when both ask for it).
 """
 
 import json
@@ -512,6 +518,16 @@ def main():
     if fusion_mode == "off":
         _FUSION_EXTRA["srt.exec.fusion.enabled"] = "false"
 
+    adaptive_mode = os.environ.get("SRT_BENCH_ADAPTIVE", "on").lower()
+    if adaptive_mode not in ("on", "off", "both"):
+        adaptive_mode = "on"
+    RESULT["adaptive_mode"] = adaptive_mode
+    if adaptive_mode == "off":
+        # single-lane off: every engine session the bench opens runs
+        # with adaptive execution disabled (rides the same channel as
+        # SRT_BENCH_FUSION=off)
+        _FUSION_EXTRA["srt.sql.adaptive.enabled"] = "false"
+
     scale = int(os.environ.get("SRT_BENCH_SCALE", 0))
     if not scale:
         # the CPU fallback runs the same honest pipeline but ~50x
@@ -696,6 +712,73 @@ def main():
         except Exception as e:
             log(f"mortgage bench failed: {e}")
 
+    # --- adaptive skew-join A/B: a seeded >=10x-skewed fact joined
+    # against a small dim under WRONG compile-time settings (broadcast
+    # disabled by a 1-row threshold), adaptive on vs off. Adaptive
+    # demotes the shuffled join from the MEASURED build size — skipping
+    # the probe-side shuffle entirely — while "off" pays the full
+    # mis-planned shuffle of every fact row. Warm timings (second run)
+    # so the delta is execution, not compile.
+    if left("adaptive skew join", need=45):
+        try:
+            import numpy as np
+
+            from spark_rapids_tpu.expr.aggregates import (CountStar,
+                                                          Sum)
+            from spark_rapids_tpu.expr.core import Alias, col as _col
+            n_sk = max(scale // 3, 100_000)
+            rng = np.random.default_rng(97)
+            sk_keys = np.where(rng.random(n_sk) < 0.9, 7,
+                               rng.integers(0, 100, n_sk))
+            sk_dir = os.path.join(os.path.dirname(data_dir),
+                                  f"skew_{n_sk}")
+            if not os.path.isdir(sk_dir):
+                base_sess = framework_session()
+                base_sess.create_dataframe({
+                    "k": sk_keys.tolist(),
+                    "v": rng.uniform(0, 10, n_sk).tolist(),
+                }).write.parquet(os.path.join(sk_dir, "fact"))
+                base_sess.create_dataframe({
+                    "k": list(range(100)),
+                    "w": [float(i) for i in range(100)],
+                }).write.parquet(os.path.join(sk_dir, "dim"))
+
+            def run_skew(adaptive_on):
+                sess = framework_session({
+                    "srt.shuffle.partitions": 8,
+                    "srt.sql.broadcastRowThreshold": 1,
+                    "srt.sql.adaptive.enabled":
+                        "true" if adaptive_on else "false",
+                    "srt.sql.adaptive.autoBroadcastJoinRows": 100000})
+                f = sess.read.parquet(os.path.join(sk_dir, "fact"))
+                d = sess.read.parquet(os.path.join(sk_dir, "dim"))
+                q = f.join(d, ([_col("k")], [_col("k")]),
+                           how="inner") \
+                    .agg(Alias(Sum(_col("v")), "sv"),
+                         Alias(CountStar(), "c"))
+                q.collect()  # warm: compile + plan
+                t0 = time.perf_counter()
+                rows = q.collect()
+                return time.perf_counter() - t0, rows
+
+            on_s, on_rows = run_skew(True)
+            off_s, off_rows = run_skew(False)
+            if on_rows[0]["c"] != off_rows[0]["c"]:
+                log(f"adaptive skew join DIVERGED: "
+                    f"{on_rows} vs {off_rows}")
+            else:
+                RESULT["skew_join_rows"] = n_sk
+                RESULT["skew_join_adaptive_on_s"] = round(on_s, 3)
+                RESULT["skew_join_adaptive_off_s"] = round(off_s, 3)
+                RESULT["skew_join_adaptive_speedup"] = round(
+                    off_s / on_s, 3) if on_s else 0.0
+                log(f"adaptive skew join ({n_sk} rows, 90% hot key): "
+                    f"on={on_s:.3f}s off={off_s:.3f}s "
+                    f"({RESULT['skew_join_adaptive_speedup']}x)")
+            emit()
+        except Exception as e:
+            log(f"adaptive skew join bench failed: {e}")
+
     # --- NDS mini power-run (BASELINE config 2 breadth evidence):
     # the full 99-query suite swept once, total wall + per-query
     # recorded. SRT_BENCH_PIPELINE selects the async-pipeline lane:
@@ -717,10 +800,14 @@ def main():
                                    f"nds_{nds_scale}")
             pipe_mode = os.environ.get("SRT_BENCH_PIPELINE",
                                        "on").lower()
-            # SRT_BENCH_FUSION=both takes over the NDS A/B dimension:
-            # both legs keep the pipeline default and toggle fusion
-            # instead (one A/B dimension per sweep keeps it readable)
-            if fusion_mode == "both":
+            # SRT_BENCH_ADAPTIVE=both / SRT_BENCH_FUSION=both take
+            # over the NDS A/B dimension (adaptive wins when both are
+            # requested; one A/B dimension per sweep keeps it readable)
+            if adaptive_mode == "both":
+                leg_conf, leg_dim = "srt.sql.adaptive.enabled", \
+                    "adaptive"
+                legs = [("on", "true"), ("off", "false")]
+            elif fusion_mode == "both":
                 leg_conf, leg_dim = "srt.exec.fusion.enabled", "fusion"
                 legs = [("on", "true"), ("off", "false")]
             else:
@@ -757,7 +844,7 @@ def main():
             ordered = [q for q in nds_order if q in NDS_QUERIES] + \
                 sorted(set(NDS_QUERIES) - set(nds_order))
 
-            def run_leg(label, enabled, key_prefix):
+            def run_leg(label, enabled, key_prefix, deadline=None):
                 nds_sess = framework_session({leg_conf: enabled})
                 register_nds(nds_sess, nds_dir, scale_rows=nds_scale)
                 # drop the previous lane's in-memory executables before
@@ -780,6 +867,11 @@ def main():
                         time.perf_counter() - t0, 2)
                 for qid in ordered:
                     if not left(f"nds {qid} [{label}]", need=20):
+                        break
+                    if deadline is not None and \
+                            time.monotonic() >= deadline:
+                        log(f"leg budget exhausted before "
+                            f"nds {qid} [{label}]")
                         break
                     tq = time.perf_counter()
                     nds_sess.sql(NDS_QUERIES[qid]).collect()
@@ -830,17 +922,36 @@ def main():
                 run_leg(legs[0][0], legs[0][1], "nds_")
             else:
                 walls = {}
-                for label, enabled in legs:
+                # split the remaining budget evenly so the first lane
+                # can't starve the second — an A/B with an empty off
+                # lane has no common queries and records no delta
+                rem = BUDGET - (time.monotonic() - T_START)
+                for i, (label, enabled) in enumerate(legs):
+                    share = rem / len(legs) * (i + 1)
                     walls[label] = run_leg(
                         label, enabled, f"nds_{leg_dim}_{label}_"
-                        if leg_dim == "fusion" else f"nds_{label}_")
+                        if leg_dim in ("fusion", "adaptive")
+                        else f"nds_{label}_",
+                        deadline=T_START + (BUDGET - rem) + share)
                 # delta over the queries BOTH lanes completed — a
                 # budget cut mid-lane must not skew the comparison
                 common = sorted(set(walls["on"]) & set(walls["off"]))
                 if common:
                     on_s = sum(walls["on"][q] for q in common)
                     off_s = sum(walls["off"][q] for q in common)
-                    if leg_dim == "fusion":
+                    if leg_dim == "adaptive":
+                        RESULT["nds_adaptive_common_queries"] = \
+                            len(common)
+                        RESULT["nds_adaptive_on_common_s"] = \
+                            round(on_s, 2)
+                        RESULT["nds_adaptive_off_common_s"] = \
+                            round(off_s, 2)
+                        # >0: adaptive saved wall; <0: it cost wall
+                        RESULT["nds_adaptive_delta_pct"] = round(
+                            100.0 * (off_s - on_s) / off_s, 2) \
+                            if off_s else 0.0
+                        delta = RESULT["nds_adaptive_delta_pct"]
+                    elif leg_dim == "fusion":
                         RESULT["nds_fusion_common_queries"] = \
                             len(common)
                         RESULT["nds_fused_common_s"] = round(on_s, 2)

@@ -310,6 +310,89 @@ ADAPTIVE_SKEW_ROWS = conf("srt.sql.adaptive.skewJoin.partitionRows") \
          "GpuCustomShuffleReaderExec skewed-partition-spec role).") \
     .check(_positive).integer(1 << 20)
 
+ADAPTIVE_COALESCE_ENABLED = conf(
+    "srt.sql.adaptive.coalescePartitions.enabled") \
+    .doc("AQE rule 1: merge adjacent small reduce partitions after the "
+         "map side materializes, using measured per-partition rows and "
+         "bytes (spark.sql.adaptive.coalescePartitions.enabled).") \
+    .boolean(True)
+
+ADAPTIVE_TARGET_BYTES = conf(
+    "srt.sql.adaptive.coalescePartitions.targetBytes") \
+    .doc("Coalesced partition groups close once they reach this many "
+         "measured shuffle bytes, even below minPartitionRows — the "
+         "byte-size generalization of the row floor "
+         "(spark.sql.adaptive.advisoryPartitionSizeInBytes). 0 keeps "
+         "the rows-only behavior.") \
+    .check(_non_negative).bytes_(8 << 20)
+
+ADAPTIVE_SKEW_ENABLED = conf("srt.sql.adaptive.skewJoin.enabled") \
+    .doc("AQE rule 2: split skewed reduce partitions of a shuffled "
+         "join into map-slices replicated against the other side "
+         "(spark.sql.adaptive.skewJoin.enabled).") \
+    .boolean(True)
+
+ADAPTIVE_SKEW_BYTES = conf("srt.sql.adaptive.skewJoin.partitionBytes") \
+    .doc("A reduce partition whose PROBE side exceeds this many "
+         "measured shuffle bytes is skew-split, independent of the row "
+         "threshold (spark.sql.adaptive.skewJoin."
+         "skewedPartitionThresholdInBytes). 0 disables the byte "
+         "trigger.") \
+    .check(_non_negative).bytes_(64 << 20)
+
+ADAPTIVE_JOIN_ENABLED = conf("srt.sql.adaptive.join.enabled") \
+    .doc("AQE rule 3: demote a shuffled join to broadcast (or cap an "
+         "oversized broadcast build via sub-partitioning) when the "
+         "MEASURED build side contradicts the plan-time estimate "
+         "(DynamicJoinSelection / spark.sql.adaptive."
+         "autoBroadcastJoinThreshold direction flips).") \
+    .boolean(True)
+
+ADAPTIVE_BROADCAST_BYTES = conf("srt.sql.adaptive.autoBroadcastJoinBytes") \
+    .doc("A shuffled join whose materialized build side has at most "
+         "this many measured shuffle bytes switches to broadcast at "
+         "runtime, in addition to the autoBroadcastJoinRows row "
+         "trigger. 0 disables the byte trigger.") \
+    .check(_non_negative).bytes_(0)
+
+ADAPTIVE_MAX_BROADCAST_BYTES = conf(
+    "srt.sql.adaptive.maxBroadcastBuildBytes") \
+    .doc("A plan-time broadcast join whose MATERIALIZED build side "
+         "exceeds this many bytes is forced onto the bounded "
+         "sub-partition join path (the broadcast->shuffle 'promote' "
+         "mitigation: the exchange topology is fixed per attempt, so "
+         "the memory-safety half of promotion is what AQE can still "
+         "deliver mid-flight). 0 disables.") \
+    .check(_non_negative).bytes_(0)
+
+ADAPTIVE_SPECULATION_ENABLED = conf("srt.sql.adaptive.speculation.enabled") \
+    .doc("AQE rule 4: when a heartbeat-alive worker lags the map side "
+         "of a shuffle stage, the driver re-executes its map shards on "
+         "an idle worker; first result wins in the map-output registry "
+         "and losing blocks are never fetched "
+         "(spark.speculation; default off, matching Spark).") \
+    .boolean(False)
+
+ADAPTIVE_SPECULATION_FACTOR = conf(
+    "srt.sql.adaptive.speculation.slowWorkerFactor") \
+    .doc("A worker is a straggler once its barrier arrival lags the "
+         "median arrived worker by this multiple "
+         "(spark.speculation.multiplier).") \
+    .check(_positive).double(3.0)
+
+ADAPTIVE_SPECULATION_MIN_WAIT_S = conf(
+    "srt.sql.adaptive.speculation.minWaitSec") \
+    .doc("Never speculate before the first arrival has waited this "
+         "many seconds — bounds wasted duplicate work on naturally "
+         "short stages.") \
+    .check(_non_negative).double(1.0)
+
+LEGACY_ADAPTIVE_BROADCAST_ROWS = conf("srt.sql.adaptiveBroadcastRows") \
+    .doc("DEPRECATED alias for srt.sql.adaptive.autoBroadcastJoinRows "
+         "(pre-AQE-subsystem name). Setting it forwards to the new key "
+         "and warns once per process.") \
+    .integer(0)
+
 SESSION_TIMEZONE = conf("srt.sql.session.timeZone") \
     .doc("Session timezone id used by timezone-aware SQL functions "
          "(spark.sql.session.timeZone). Conversions run on device "
@@ -798,6 +881,15 @@ SHUFFLE_HEARTBEAT_TIMEOUT_S = conf("srt.shuffle.heartbeat.timeoutSec") \
     .check(_positive).double(60.0)
 
 
+# (key, replacement) pairs resolved in SrtConf.__init__: the old key's
+# value forwards to the new key when the new key is unset, with a
+# once-per-process deprecation warning.
+_DEPRECATED_ALIASES = {
+    "srt.sql.adaptiveBroadcastRows": "srt.sql.adaptive.autoBroadcastJoinRows",
+}
+_ALIAS_WARNED: set = set()
+
+
 class SrtConf:
     """Immutable snapshot of settings, one per session (RapidsConf)."""
 
@@ -807,6 +899,15 @@ class SrtConf:
             if k.startswith("srt.") and k not in _REGISTRY:
                 raise KeyError(f"unknown config {k!r}; registered: "
                                f"{sorted(_REGISTRY)}")
+        for old, new in _DEPRECATED_ALIASES.items():
+            if old not in self._settings:
+                continue
+            if old not in _ALIAS_WARNED:
+                _ALIAS_WARNED.add(old)
+                import warnings
+                warnings.warn(f"config {old!r} is deprecated; use {new!r}",
+                              DeprecationWarning, stacklevel=2)
+            self._settings.setdefault(new, self._settings[old])
 
     def get(self, entry: ConfEntry):
         return entry.get(self._settings)

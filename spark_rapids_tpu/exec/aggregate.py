@@ -410,19 +410,18 @@ class HashAggregateExec(TpuExec):
         """Child partition streams; with AQE on and an exchange child,
         small reduce partitions group together before the merge
         (CoalesceShufflePartitions over the FINAL aggregate)."""
-        from ..conf import ADAPTIVE_ENABLED, ADAPTIVE_MIN_PARTITION_ROWS
         from .exchange import ShuffleExchangeExec
         child = self.children[0]
-        if ctx.conf.get(ADAPTIVE_ENABLED) and \
-                not self.preserve_partitioning and \
+        if not self.preserve_partitioning and \
                 isinstance(child, ShuffleExchangeExec):
-            # cluster-safe: counts are gathered GLOBAL statistics, so
-            # every worker computes the same groups and streams its own
-            # contiguous block of them
-            counts = child.materialized_row_counts(ctx)
-            groups = child.coalesce_groups(
-                counts, ctx.conf.get(ADAPTIVE_MIN_PARTITION_ROWS))
-            if len(groups) < len(counts):
+            # decision delegated to plan/adaptive.py (byte-target aware,
+            # cached on the exchange, shared with the eager stage
+            # executor); cluster-safe: computed from gathered GLOBAL
+            # statistics, so every worker derives the same groups and
+            # streams its own contiguous block of them
+            from ..plan.adaptive import stage_groups
+            groups = stage_groups(ctx, child)
+            if groups is not None:
                 return child.execute_partition_groups(ctx, groups)
         return child.execute_partitioned(ctx)
 

@@ -430,6 +430,10 @@ class TpuExec:
         re-executed (plan/plan_cache.py). Compile caches (jit wrappers)
         must survive — they are the point of caching the tree; stateful
         nodes (shuffle writes, broadcast materialization) override."""
+        # adaptive decisions are derived from ONE run's measured sizes;
+        # the next run measures afresh (plan/adaptive.py caches)
+        self.__dict__.pop("_adaptive_decision", None)
+        self.__dict__.pop("_adaptive_groups_cache", None)
         for c in self.children:
             if isinstance(c, TpuExec):
                 c.reset_for_rerun()

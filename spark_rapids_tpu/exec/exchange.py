@@ -134,6 +134,14 @@ class ShuffleExchangeExec(TpuExec):
         self.shuffle_id = next_shuffle_id()
         self._written = False
         self._jit_cache = {}
+        self._global_counts = None
+        self._global_stats = None
+        #: speculation outcome from the driver barrier: None, or
+        #: {"allowed": {worker_id: (map_ids...)}} restricting which
+        #: peer blocks readers may consume (first-result-wins dedup)
+        self._winners = None
+        self._barrier_done = False
+        self._own_map_ids: List[int] = []
 
     def reset_for_rerun(self) -> None:
         super().reset_for_rerun()
@@ -142,6 +150,10 @@ class ShuffleExchangeExec(TpuExec):
         self.shuffle_id = next_shuffle_id()
         self._written = False
         self._global_counts = None
+        self._global_stats = None
+        self._winners = None
+        self._barrier_done = False
+        self._own_map_ids = []
 
     @property
     def output_schema(self) -> Schema:
@@ -367,13 +379,36 @@ class ShuffleExchangeExec(TpuExec):
                     write_bytes.add(with_retry_no_split(write_one))
                     part_time.add(time.perf_counter_ns() - t0)
                     write_rows.add(int(batch.num_rows))
+                    self._own_map_ids.append(map_id)
                     map_id += 1
             finally:
                 for sb in held:
                     sb.close()
             return
+        self._own_map_ids.extend(
+            self._run_map_loop(ctx, mgr, n_parts, map_id,
+                               self.children[0]))
+
+    def _run_map_loop(self, ctx: ExecContext, mgr, n_parts: int,
+                      map_id: int, child: TpuExec) -> List[int]:
+        """Drain ``child``, partition every batch, write blocks under
+        ascending map ids from ``map_id``; returns the ids written.
+        Shared by the normal (non-range) map phase and speculative
+        re-execution of a straggler's shard, which runs a re-sharded
+        clone of the stage subtree under a disjoint map-id namespace."""
+        m = ctx.metrics_for(self.exec_id)
+        part_time = m.setdefault("partitionTime",
+                                 Metric("partitionTime", Metric.MODERATE,
+                                        "ns"))
+        write_rows = m.setdefault("shuffleWriteRows",
+                                  Metric("shuffleWriteRows",
+                                         Metric.ESSENTIAL))
+        write_bytes = m.setdefault("shuffleBytesWritten",
+                                   Metric("shuffleBytesWritten",
+                                          Metric.ESSENTIAL, "B"))
         from ..memory.retry import with_retry_no_split
-        for batch in self.children[0].execute(ctx):
+        written: List[int] = []
+        for batch in child.execute(ctx):
             if int(batch.num_rows) == 0:
                 continue
             t0 = time.perf_counter_ns()
@@ -394,7 +429,28 @@ class ShuffleExchangeExec(TpuExec):
             part_time.add(time.perf_counter_ns() - t0)
             write_rows.add(rows_written)
             write_bytes.add(bytes_written)
+            written.append(map_id)
             map_id += 1
+        return written
+
+    def run_speculative_maps(self, ctx: ExecContext,
+                             map_id_base: int) -> List[int]:
+        """Speculative map execution entry: run THIS exchange's map
+        phase under an explicit map-id namespace, bypassing the
+        ``_written`` idempotence latch and the barrier. The cluster's
+        speculate callback invokes it on a clone of the stage subtree
+        re-sharded to the straggler's logical ids, with ``shuffle_id``
+        pointed at the live shuffle — blocks land in this worker's
+        store and win or lose at the driver's first-result-wins
+        commit."""
+        if self.sort_orders:
+            raise RuntimeError(
+                "range exchanges are not speculation-eligible")
+        mgr = self.manager or shuffle_manager()
+        n_parts = self._effective_parts(ctx)
+        mgr.register_shuffle(self.shuffle_id, n_parts)
+        return self._run_map_loop(ctx, mgr, n_parts, map_id_base,
+                                  self.children[0])
 
     def _release(self, mgr) -> None:
         """One consumer finished a full drain. Shared subtrees (the two
@@ -417,43 +473,108 @@ class ShuffleExchangeExec(TpuExec):
         yield from mgr.read_partition(self.shuffle_id, reduce_id)
 
     # --- AQE surface (GpuCustomShuffleReaderExec analogue) ---
-    def materialized_row_counts(self, ctx: ExecContext) -> List[int]:
-        """Write the map side (idempotent) and return rows per reduce
-        partition — the MapOutputStatistics AQE decisions read.
+    def _cluster_barrier(self, ctx: ExecContext):
+        """Speculation-aware driver barrier, once per run: reports this
+        worker's own map ids and exact per-(map, reduce) sizes, may run
+        speculative work for a straggler inside the call, and caches
+        the winners verdict that filters every subsequent read and
+        stats gather (first-result-wins dedup). With speculation off
+        the driver keeps its plain all-or-nothing barrier and the
+        verdict is None (no filtering)."""
+        if self._barrier_done:
+            return self._winners
+        mgr = self.manager or shuffle_manager()
+        detail = mgr.map_output_statistics(
+            self.shuffle_id, map_ids=set(self._own_map_ids)).detail
+        def leaf_stage(node) -> bool:
+            return all(not isinstance(c, ShuffleExchangeExec)
+                       and leaf_stage(c) for c in node.children)
 
-        Cluster mode: local counts all-gather through the driver and
-        sum, so every worker computes IDENTICAL global statistics (the
-        fix for round-2's divergent-coalescing bug — decisions must be
-        a pure function of global state, never of local map outputs).
-        The gather itself is a barrier: by the time it returns, every
-        worker's map side is written."""
+        # only leaf map stages are speculation-eligible: a re-run of a
+        # subtree with its own exchange would need a nested barrier,
+        # and range exchanges gather bounds cooperatively
+        self._winners = ctx.cluster.barrier(
+            self.shuffle_id, getattr(self, "_cluster_pos", -1),
+            detail=detail,
+            spec_ok=not self.sort_orders and leaf_stage(self))
+        self._barrier_done = True
+        return self._winners
+
+    def _allowed_by_endpoint(self, ctx: ExecContext):
+        """Winners verdict -> per-peer-endpoint allowed map-id sets for
+        the fetch filter. None when no speculation verdict exists (all
+        blocks are authoritative)."""
+        winners = self._winners
+        if not winners or winners.get("allowed") is None:
+            return None
+        peers = ctx.cluster.peers
+        allowed = winners["allowed"]
+        return {peers[w]: set(allowed.get(w, ()))
+                for w in range(len(peers))}
+
+    def materialized_stats(self, ctx: ExecContext):
+        """Write the map side (idempotent) and return
+        ``(rows, bytes)`` lists per reduce partition — the
+        MapOutputStatistics AQE decisions read.
+
+        Cluster mode: a speculation-aware barrier resolves which maps
+        won, then each worker's WINNING local stats all-gather through
+        the driver and sum, so every worker computes IDENTICAL global
+        statistics (the fix for round-2's divergent-coalescing bug —
+        decisions must be a pure function of global state, never of
+        local map outputs)."""
         mgr = self.manager or shuffle_manager()
         self._write(ctx)
-        counts = mgr.partition_row_counts(self.shuffle_id)
-        if ctx.cluster is not None:
-            cached = getattr(self, "_global_counts", None)
-            if cached is not None:
-                return cached
-            all_counts = ctx.cluster.gather(
-                ("aqe_counts", self.shuffle_id), counts)
-            counts = [sum(c[i] for c in all_counts)
-                      for i in range(len(counts))]
-            self._global_counts = counts
-        return counts
+        if ctx.cluster is None:
+            st = mgr.map_output_statistics(self.shuffle_id)
+            return st.rows_by_reduce, st.bytes_by_reduce
+        if self._global_stats is not None:
+            return self._global_stats
+        winners = self._cluster_barrier(ctx)
+        mine: Optional[set] = set(self._own_map_ids)
+        if winners and winners.get("allowed") is not None:
+            mine = set(winners["allowed"].get(
+                ctx.cluster.worker_id, ()))
+        st = mgr.map_output_statistics(self.shuffle_id, map_ids=mine)
+        gathered = ctx.cluster.gather(
+            ("aqe_stats", self.shuffle_id),
+            (st.rows_by_reduce, st.bytes_by_reduce))
+        n = st.num_partitions
+        rows = [sum(g[0][i] for g in gathered if g) for i in range(n)]
+        nbytes = [sum(g[1][i] for g in gathered if g) for i in range(n)]
+        self._global_stats = (rows, nbytes)
+        self._global_counts = rows
+        return self._global_stats
+
+    def materialized_row_counts(self, ctx: ExecContext) -> List[int]:
+        """Rows per reduce partition (the byte-blind legacy accessor;
+        kept for existing callers — materialized_stats is the AQE
+        surface)."""
+        return self.materialized_stats(ctx)[0]
 
     @staticmethod
-    def coalesce_groups(counts: List[int], min_rows: int) -> List[List[int]]:
-        """Greedy adjacent grouping: each group reaches min_rows (the
-        last group may not). CoalesceShufflePartitions' strategy."""
+    def coalesce_groups(counts: List[int], min_rows: int,
+                        byte_counts: Optional[List[int]] = None,
+                        target_bytes: int = 0) -> List[List[int]]:
+        """Greedy adjacent grouping: each group closes on reaching
+        min_rows OR, when measured byte sizes are supplied,
+        target_bytes — whichever lands first (the last group may reach
+        neither). CoalesceShufflePartitions' strategy generalized from
+        rows to measured bytes."""
         groups: List[List[int]] = []
         cur: List[int] = []
         acc = 0
+        acc_b = 0
         for i, c in enumerate(counts):
             cur.append(i)
             acc += c
-            if acc >= min_rows:
+            if byte_counts is not None and i < len(byte_counts):
+                acc_b += byte_counts[i]
+            if acc >= min_rows or (target_bytes > 0
+                                   and byte_counts is not None
+                                   and acc_b >= target_bytes):
                 groups.append(cur)
-                cur, acc = [], 0
+                cur, acc, acc_b = [], 0, 0
         if cur:
             if groups:
                 groups[-1].extend(cur)
@@ -498,8 +619,8 @@ class ShuffleExchangeExec(TpuExec):
             max(mgr.num_partitions(self.shuffle_id) - len(groups), 0))
         if ctx.cluster is not None:
             from ..parallel.transport import fetch_all_partitions
-            ctx.cluster.barrier(self.shuffle_id,
-                                getattr(self, "_cluster_pos", -1))
+            self._cluster_barrier(ctx)
+            allowed = self._allowed_by_endpoint(ctx)
             peers = ctx.cluster.peers
             resolver = ctx.cluster.resolve_endpoint
             dsid = getattr(self, "_downstream_sid", None)
@@ -510,7 +631,7 @@ class ShuffleExchangeExec(TpuExec):
                     ctx.partition_id = reduce_id
                     yield from fetch_all_partitions(
                         peers, self.shuffle_id, reduce_id, map_mod=mm,
-                        endpoint_resolver=resolver)
+                        endpoint_resolver=resolver, allowed=allowed)
             for gi in ctx.cluster.assigned(len(groups), dsid):
                 yield self._maybe_prefetch(
                     ctx, lambda _gi=gi: remote_group(_gi, groups[_gi]),
@@ -547,8 +668,8 @@ class ShuffleExchangeExec(TpuExec):
         n_parts = mgr.num_partitions(self.shuffle_id)
         if ctx.cluster is not None:
             from ..parallel.transport import fetch_all_partitions
-            ctx.cluster.barrier(self.shuffle_id,
-                                getattr(self, "_cluster_pos", -1))
+            self._cluster_barrier(ctx)
+            allowed = self._allowed_by_endpoint(ctx)
             peers = ctx.cluster.peers
             resolver = ctx.cluster.resolve_endpoint
             dsid = getattr(self, "_downstream_sid", None)
@@ -557,7 +678,8 @@ class ShuffleExchangeExec(TpuExec):
                 ctx.partition_id = reduce_id
                 yield from fetch_all_partitions(peers, self.shuffle_id,
                                                 reduce_id,
-                                                endpoint_resolver=resolver)
+                                                endpoint_resolver=resolver,
+                                                allowed=allowed)
             for reduce_id in ctx.cluster.assigned(n_parts, dsid):
                 yield self._maybe_prefetch(
                     ctx, lambda rid=reduce_id: remote_read(rid),

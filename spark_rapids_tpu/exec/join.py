@@ -529,8 +529,25 @@ class _HashJoinBase(TpuExec):
         self._runtime_partition_prune(ctx, build)
         probe_stream = self._bloom_prefilter(ctx, probe_stream, build)
         threshold = ctx.conf.get(JOIN_SUB_PARTITION_ROWS)
-        if int(build.num_rows) > threshold and (self.left_keys or
-                                                self.right_keys):
+        n_rows = int(build.num_rows)
+        keyed = bool(self.left_keys or self.right_keys)
+        sub = n_rows > threshold and keyed
+        if not sub and keyed:
+            # adaptive byte cap: a build side whose MEASURED bytes
+            # exceed srt.sql.adaptive.maxBroadcastJoinBytes joins
+            # sub-partitioned even when its row count looks benign
+            # (wide rows defeat the row threshold) — the single hash
+            # table is bounded either way
+            from ..conf import ADAPTIVE_MAX_BROADCAST_BYTES
+            if ctx.conf.get(ADAPTIVE_MAX_BROADCAST_BYTES) > 0:
+                from ..memory.spill import batch_nbytes
+                from ..plan.adaptive import broadcast_oversize_slices
+                slices = broadcast_oversize_slices(
+                    ctx, self, n_rows, batch_nbytes(build))
+                if slices:
+                    threshold = max(-(-n_rows // slices), 1)
+                    sub = True
+        if sub:
             holder = [build]
             del build
             yield from self._sub_partition_join(ctx, probe_stream, holder,
@@ -567,32 +584,19 @@ class ShuffledHashJoinExec(_HashJoinBase):
             else self.children[1]
         return probe.output_partitioning
 
-    def _adaptive_broadcast(self, ctx: ExecContext):
-        """Runtime join-strategy switch (the AQE decision the reference
-        takes via GpuQueryStagePrepOverrides + Spark's
-        DynamicJoinSelection): once the build side's exchange has
-        materialized, a small actual row count downgrades the
-        partitioned join to a broadcast-style single stream — the
-        probe-side exchange is BYPASSED entirely (its map phase never
-        runs). Returns (probe_stream, build_stream) or None."""
-        from ..conf import (ADAPTIVE_BROADCAST_ROWS, ADAPTIVE_ENABLED,
-                            BROADCAST_THRESHOLD_ROWS)
-        from .exchange import ShuffleExchangeExec
-        if not ctx.conf.get(ADAPTIVE_ENABLED) or \
-                self.preserve_partitioning:
-            return None
+    def _demoted_broadcast_streams(self, ctx: ExecContext):
+        """Execution body of the joinStrategy demotion decided by
+        plan/adaptive.py (the AQE decision the reference takes via
+        GpuQueryStagePrepOverrides + Spark's DynamicJoinSelection): the
+        measured-small build side streams whole as a broadcast-style
+        single stream and the probe-side exchange is BYPASSED entirely
+        (its map phase never runs). Returns (probe_stream,
+        build_stream)."""
         build_child = self.children[1] if self.build_side == "right" \
             else self.children[0]
         probe_child = self.children[0] if self.build_side == "right" \
             else self.children[1]
-        if not isinstance(build_child, ShuffleExchangeExec) or \
-                not isinstance(probe_child, ShuffleExchangeExec):
-            return None
-        threshold = ctx.conf.get(ADAPTIVE_BROADCAST_ROWS) or \
-            ctx.conf.get(BROADCAST_THRESHOLD_ROWS)
-        counts = build_child.materialized_row_counts(ctx)
-        if sum(counts) > threshold:
-            return None
+        counts, _ = build_child.materialized_stats(ctx)
         m = ctx.metrics_for(self.exec_id)
         m.setdefault("adaptiveBroadcastJoins",
                      Metric("adaptiveBroadcastJoins",
@@ -602,13 +606,17 @@ class ShuffledHashJoinExec(_HashJoinBase):
             if ctx.cluster is not None:
                 # broadcast semantics: EVERY worker needs the FULL
                 # build side — fetch all reduce partitions from all
-                # peers (materialized_row_counts' gather already
-                # synchronized the map writes)
+                # peers (materialized_stats' gather already
+                # synchronized the map writes; `allowed` restricts
+                # reads to the maps that won speculation)
                 from ..parallel.transport import fetch_all_partitions
                 peers = ctx.cluster.peers
+                allowed = build_child._allowed_by_endpoint(ctx)
+                resolver = ctx.cluster.resolve_endpoint
                 for reduce_id in range(len(counts)):
                     yield from fetch_all_partitions(
-                        peers, build_child.shuffle_id, reduce_id)
+                        peers, build_child.shuffle_id, reduce_id,
+                        endpoint_resolver=resolver, allowed=allowed)
                 return
             for part in build_child.execute_partitioned(ctx):
                 yield from part
@@ -618,75 +626,34 @@ class ShuffledHashJoinExec(_HashJoinBase):
         # exactly the broadcast-join probe distribution
         return probe_child.children[0].execute(ctx), build_stream()
 
-    def _zipped_partitions(self, ctx: ExecContext):
+    def _zipped_partitions(self, ctx: ExecContext, decision):
         """Pairwise (probe, build) partition streams. zip_longest (not
         zip) so both child generators are driven to exhaustion in order
         — an exchange unregisters its shuffle in a finally that must run
-        only after its last partition has been consumed. With AQE on
-        and both children exchanges, small reduce partitions coalesce
-        with ONE grouping applied to both sides (keys stay aligned)."""
+        only after its last partition has been consumed. When the
+        adaptive decision regrouped partitions, ONE grouping applies to
+        both sides (keys stay aligned) and skewed groups read the probe
+        side in map-id slices."""
         import itertools
-        from ..conf import (ADAPTIVE_ENABLED,
-                            ADAPTIVE_MIN_PARTITION_ROWS,
-                            ADAPTIVE_SKEW_ROWS)
-        from .exchange import ShuffleExchangeExec
         l, r = self.children[0], self.children[1]
-        if ctx.conf.get(ADAPTIVE_ENABLED) and \
-                not self.preserve_partitioning and \
-                isinstance(l, ShuffleExchangeExec) and \
-                isinstance(r, ShuffleExchangeExec):
-            # cluster-safe: materialized_row_counts gathers GLOBAL
-            # stats, so every worker derives identical groups/slices
-            lc = l.materialized_row_counts(ctx)
-            rc = r.materialized_row_counts(ctx)
-            if len(lc) == len(rc):
-                probe_is_left = self.build_side == "right"
-                probe_counts = lc if probe_is_left else rc
-                combined = [a + b for a, b in zip(lc, rc)]
-                groups = ShuffleExchangeExec.coalesce_groups(
-                    combined, ctx.conf.get(ADAPTIVE_MIN_PARTITION_ROWS))
-                skew_rows = ctx.conf.get(ADAPTIVE_SKEW_ROWS)
-                # skew split: a group that is ONE oversized partition
-                # splits the PROBE side into map slices, each joined
-                # against the full build partition. Only valid when
-                # the join never emits unmatched BUILD rows (slices
-                # would emit them once each).
-                can_split = self.join_type in (
-                    "inner", "left_outer", "left_semi", "left_anti") \
-                    if probe_is_left else self.join_type == "inner"
-                out_groups: list = []
-                probe_mod: dict = {}
-                build_groups: list = []
-                n_skewed = 0
-                for g in groups:
-                    pc = sum(probe_counts[i] for i in g)
-                    if can_split and len(g) == 1 and pc > skew_rows:
-                        S = min(-(-pc // skew_rows), 16)
-                        n_skewed += 1
-                        for s in range(S):
-                            probe_mod[len(out_groups)] = (s, S)
-                            out_groups.append(g)
-                            build_groups.append(g)
-                    else:
-                        out_groups.append(g)
-                        build_groups.append(g)
-                if len(out_groups) != len(combined) or probe_mod:
-                    if n_skewed:
-                        m = ctx.metrics_for(self.exec_id)
-                        m.setdefault(
-                            "skewedJoinPartitions",
-                            Metric("skewedJoinPartitions",
-                                   Metric.MODERATE)).add(n_skewed)
-                    probe_x, build_x = (l, r) if probe_is_left \
-                        else (r, l)
-                    probe_parts = probe_x.execute_partition_groups(
-                        ctx, out_groups, map_mod=probe_mod)
-                    build_parts = build_x.execute_partition_groups(
-                        ctx, build_groups)
-                    for pp, bp in itertools.zip_longest(probe_parts,
-                                                        build_parts):
-                        yield (pp, bp)
-                    return
+        if decision.mode == "partitioned" and \
+                decision.out_groups is not None:
+            if decision.n_skewed:
+                m = ctx.metrics_for(self.exec_id)
+                m.setdefault(
+                    "skewedJoinPartitions",
+                    Metric("skewedJoinPartitions",
+                           Metric.MODERATE)).add(decision.n_skewed)
+            probe_is_left = self.build_side == "right"
+            probe_x, build_x = (l, r) if probe_is_left else (r, l)
+            probe_parts = probe_x.execute_partition_groups(
+                ctx, decision.out_groups, map_mod=decision.probe_mod)
+            build_parts = build_x.execute_partition_groups(
+                ctx, decision.build_groups)
+            for pp, bp in itertools.zip_longest(probe_parts,
+                                                build_parts):
+                yield (pp, bp)
+            return
         left_parts = l.execute_partitioned(ctx)
         right_parts = r.execute_partitioned(ctx)
         for lp, rp in itertools.zip_longest(left_parts, right_parts):
@@ -700,12 +667,18 @@ class ShuffledHashJoinExec(_HashJoinBase):
             yield from part
 
     def execute_partitioned(self, ctx: ExecContext):
-        switched = self._adaptive_broadcast(ctx)
-        if switched is not None:
-            probe_stream, build_stream = switched
+        # the rules live in plan/adaptive.py; the decision is cached on
+        # this node (the eager stage executor may have attached it
+        # already), cluster-safe by construction — a pure function of
+        # globally gathered statistics
+        from ..plan.adaptive import join_decision
+        decision = join_decision(ctx, self)
+        if decision.mode == "broadcast_build":
+            probe_stream, build_stream = \
+                self._demoted_broadcast_streams(ctx)
             yield self._join_partition(ctx, probe_stream, build_stream)
             return
-        for probe, build in self._zipped_partitions(ctx):
+        for probe, build in self._zipped_partitions(ctx, decision):
             yield self._join_partition(ctx, probe, build)
 
     def node_description(self) -> str:

@@ -43,6 +43,7 @@ EVENT_TYPES = (
     "QueryAdmitted", "AdmissionQueued", "AdmissionRejected",
     "AdmissionAbandoned", "QueryCancelled", "DeadlineExceeded",
     "CrossQuerySpill", "PrefetchThreadLeak", "ClusterCancelBroadcast",
+    "AdaptivePlanChanged", "SkewSplit", "SpeculativeTask",
 )
 
 

@@ -153,6 +153,12 @@ class ClusterTaskContext:
         #: bounds recorded DURING this attempt (aliased into the
         #: worker's _last_job so the next retry can prefill)
         self.bounds_out: Dict[int, list] = {}
+        #: speculation callback installed by _run_job:
+        #: (pos, unit_lids, map_id_base, live_sid) -> (map_ids, detail)
+        #: — builds a re-sharded clone of the stage subtree at plan
+        #: position ``pos`` and runs its map phase for the straggler's
+        #: logical ids under a disjoint map-id namespace
+        self.spec_factory = None
 
     def lids_csv(self) -> str:
         return ",".join(str(l) for l in self.logical_ids)
@@ -195,24 +201,80 @@ class ClusterTaskContext:
         from ..conf import CLUSTER_BARRIER_TIMEOUT, active_conf
         return active_conf().get(CLUSTER_BARRIER_TIMEOUT)
 
-    def barrier(self, shuffle_id: int, pos: int = -1) -> None:
+    def barrier(self, shuffle_id: int, pos: int = -1,
+                detail: Optional[dict] = None,
+                spec_ok: bool = False) -> Optional[dict]:
         """Block until every worker's map side for shuffle_id is
         written (driver-released). ``pos`` is the exchange's stable
         traversal position — the driver's map-output registry records
-        completion by position, not by (attempt-fresh) shuffle id."""
+        completion by position, not by (attempt-fresh) shuffle id.
+
+        ``detail`` is this worker's exact per-(map, reduce)
+        (rows, bytes) report, recorded into the driver's map-output
+        registry. With speculation enabled the driver may answer
+        ``speculate`` instead of ``release``: this worker then runs a
+        straggler's shard through ``spec_factory`` under a disjoint
+        map-id namespace and re-arrives with the speculative report.
+        Returns the driver's winners verdict ({"allowed": {worker:
+        (map_ids...)}}) under speculation, else None (no filtering)."""
         fault_point("cluster.barrier",
                     f"attempt={self.attempt};workers={self.lids_csv()};"
                     f"pos={pos};")
         if os.environ.get("SRT_CLUSTER_DEBUG"):
             print(f"[w{self.worker_id}] barrier {shuffle_id} pos={pos}",
                   file=sys.stderr, flush=True)
-        with socket.create_connection(self.driver_addr,
-                                      timeout=self._timeout()) as s:
-            _send_msg(s, {"type": "barrier", "shuffle_id": shuffle_id,
-                          "worker": self.worker_id, "pos": pos})
-            reply = _recv_msg(s)
-        if not reply or reply.get("type") != "release":
-            raise RuntimeError(f"barrier {shuffle_id} failed: {reply!r}")
+        spec_on = False
+        try:
+            from ..conf import ADAPTIVE_SPECULATION_ENABLED, active_conf
+            spec_on = bool(active_conf().get(ADAPTIVE_SPECULATION_ENABLED))
+        except Exception:
+            spec_on = False
+        msg: dict = {"type": "barrier", "shuffle_id": shuffle_id,
+                     "worker": self.worker_id, "pos": pos}
+        if detail is not None:
+            msg["detail"] = dict(detail)
+            msg["map_ids"] = sorted({m for (m, _r) in detail})
+        if spec_on:
+            msg["speculation"] = True
+            msg["spec_ok"] = bool(spec_ok
+                                  and self.spec_factory is not None)
+            msg["unit"] = list(self.logical_ids)
+        while True:
+            with socket.create_connection(self.driver_addr,
+                                          timeout=self._timeout()) as s:
+                _send_msg(s, msg)
+                reply = _recv_msg(s)
+            if reply and reply.get("type") == "release":
+                return reply.get("winners")
+            if reply and reply.get("type") == "speculate":
+                unit = list(reply.get("unit") or ())
+                # disjoint namespace: high bit within this attempt's
+                # map-id space, sub-ranged by speculator worker, so a
+                # spec map can never collide with a normal map id or
+                # another speculator's
+                base = self.map_id_base + (1 << 19) + (self.worker_id << 14)
+                spec_ids: List[int] = []
+                spec_detail: dict = {}
+                failed = False
+                try:
+                    if self.spec_factory is None:
+                        raise RuntimeError("no spec_factory installed")
+                    spec_ids, spec_detail = self.spec_factory(
+                        pos, unit, base, shuffle_id)
+                except Exception:
+                    # report the failure; the driver must NOT commit an
+                    # empty result for the straggler's unit
+                    failed = True
+                    spec_ids, spec_detail = [], {}
+                msg = {"type": "barrier", "shuffle_id": shuffle_id,
+                       "worker": self.worker_id, "pos": pos,
+                       "speculation": True, "spec_report": True,
+                       "spec_failed": failed, "unit": unit,
+                       "detail": spec_detail,
+                       "map_ids": sorted(spec_ids)}
+                continue
+            raise RuntimeError(
+                f"barrier {shuffle_id} failed: {reply!r}")
 
     def gather(self, key, payload) -> List:
         """All-gather a picklable payload across workers through the
@@ -609,6 +671,8 @@ class ClusterWorker:
                           "bounds": cluster.bounds_out}
         _shard_scans(physical, cluster.worker_id, cluster.num_workers,
                      cluster)
+        cluster.spec_factory = self._make_spec_factory(msg, conf, qctx,
+                                                       cluster)
         debug = os.environ.get("SRT_CLUSTER_DEBUG")
         if debug:
             print(f"[w{cluster.worker_id}] plan (lids="
@@ -636,7 +700,8 @@ class ClusterWorker:
         if task_scope is not None:
             task_scope.__enter__()
         try:
-            for batch in physical.execute(ctx):
+            from ..plan.adaptive import adaptive_execute
+            for batch in adaptive_execute(physical, ctx):
                 if int(batch.num_rows) == 0:
                     continue
                 d = to_pydict(batch_to_table(batch))
@@ -671,6 +736,66 @@ class ClusterWorker:
                      attempt=attempt, rows=len(rows), wall_ns=wall_ns,
                      job_token=msg.get("job_token"), metrics=metrics)
         return rows, metrics
+
+    def _make_spec_factory(self, msg, conf, qctx,
+                           cluster: ClusterTaskContext):
+        """Speculation callback for ClusterTaskContext.barrier: build a
+        FRESH clone of the plan, locate the exchange at the straggler's
+        stage position, point it at the live shuffle id, re-shard its
+        subtree's scans to the straggler's logical ids, and run the map
+        phase under the given disjoint map-id namespace. Returns
+        ``(map_ids, detail)`` — the speculative report the worker
+        re-arrives at the barrier with."""
+        def spec_factory(pos: int, unit_lids: List[int], base: int,
+                         live_sid: int):
+            from ..exec.base import ExecContext
+            from ..exec.exchange import ShuffleExchangeExec
+            from ..plan import overrides
+            clone = overrides.apply_overrides(pickle.loads(msg["plan"]),
+                                              conf)
+            _annotate_plan(clone)
+            target: List = [None]
+
+            def find(node):
+                if target[0] is not None:
+                    return
+                if isinstance(node, ShuffleExchangeExec) and \
+                        getattr(node, "_cluster_pos", -1) == pos:
+                    target[0] = node
+                    return
+                for c in node.children:
+                    find(c)
+
+            find(clone)
+            ex = target[0]
+            if ex is None:
+                raise RuntimeError(
+                    f"speculation: no exchange at position {pos}")
+
+            def has_nested(node) -> bool:
+                return any(isinstance(c, ShuffleExchangeExec)
+                           or has_nested(c) for c in node.children)
+
+            if has_nested(ex):
+                # a non-leaf stage would need ANOTHER barrier from
+                # inside this one — refuse (spec_ok should have gated)
+                raise RuntimeError(
+                    "speculation: stage has nested exchanges")
+            ex.shuffle_id = live_sid
+            spec_cluster = ClusterTaskContext(
+                cluster.worker_id, cluster.num_workers, cluster.peers,
+                cluster.driver_addr, logical_ids=list(unit_lids),
+                shard_mod=cluster.shard_mod,
+                map_id_base=base, attempt=cluster.attempt)
+            _shard_scans(ex, cluster.worker_id, cluster.num_workers,
+                         spec_cluster)
+            sctx = ExecContext(conf, query=qctx)
+            sctx.partition_id = cluster.worker_id
+            spec_ids = ex.run_speculative_maps(sctx, base)
+            detail = self.manager.map_output_statistics(
+                live_sid, map_ids=set(spec_ids)).detail
+            return spec_ids, detail
+        return spec_factory
 
     def _prepare_reuse(self, msg, cluster: ClusterTaskContext,
                        sids_by_pos: List[int], tainted: Set[int],
@@ -758,6 +883,17 @@ class ClusterDriver:
         self._registered = threading.Event()
         self._barriers: Dict = {}
         self._gathers: Dict = {}
+        #: speculation-aware barrier states (condition-based; used only
+        #: when the job conf enables srt.sql.adaptive.speculation) —
+        #: shuffle_id -> state dict, see _spec_state
+        self._spec_barriers: Dict = {}
+        #: (slowWorkerFactor, minWaitSec) parsed from the job conf
+        self._spec_conf: Tuple[float, float] = (3.0, 1.0)
+        #: per-worker-index unit keys (tuple of logical ids) the
+        #: current attempt expects at every speculative barrier
+        self._expected_units: Optional[List[Tuple[int, ...]]] = None
+        #: executor ids in worker-index order for the current attempt
+        self._worker_eids: List[str] = []
         self._block = threading.Lock()
         self._exec_seq = 0
         self._heartbeats = ShuffleHeartbeatManager(
@@ -814,8 +950,19 @@ class ClusterDriver:
                     threading.Event().wait()  # parked; driver drives
                 elif t == "barrier":
                     try:
-                        driver._barrier(msg["shuffle_id"],
-                                        msg.get("pos", -1))
+                        # exact map-output sizes ride every barrier
+                        # message: the registry's MapOutputStatistics
+                        # is fed here regardless of speculation
+                        if msg.get("detail"):
+                            driver._registry.record_map_stats(
+                                msg["shuffle_id"], msg["worker"],
+                                msg["detail"])
+                        if msg.get("speculation"):
+                            reply = driver._barrier_speculative(msg)
+                        else:
+                            driver._barrier(msg["shuffle_id"],
+                                            msg.get("pos", -1))
+                            reply = {"type": "release"}
                     except threading.BrokenBarrierError:
                         # aborted by the failure monitor: answer with a
                         # clean error instead of an EOF'd connection
@@ -823,7 +970,7 @@ class ClusterDriver:
                                   {"type": "error",
                                    "error": "barrier aborted"})
                         return
-                    _send_msg(self.request, {"type": "release"})
+                    _send_msg(self.request, reply)
                 elif t == "gather":
                     try:
                         payloads = driver._gather(msg["key"],
@@ -858,6 +1005,146 @@ class ClusterDriver:
         # stage as complete for stage-level retries (by stable position)
         self._registry.mark_complete(pos, shuffle_id)
 
+    # --- speculation-aware barrier (condition-based, early release) ---
+    def _spec_state(self, shuffle_id: int) -> dict:
+        with self._block:
+            st = self._spec_barriers.get(shuffle_id)
+            if st is None:
+                st = self._spec_barriers[shuffle_id] = {
+                    "cond": threading.Condition(),
+                    "arrived": {},      # worker -> monotonic arrival t
+                    "spec_ok": {},      # worker -> bool
+                    "speculating": set(),  # workers given a directive
+                    "assigned_units": {},  # unit -> speculator worker
+                    "pos": -1,
+                    "released": False,
+                    "winners": None,
+                    "aborted": False,
+                }
+            return st
+
+    def _expected_unit_list(self) -> List[Tuple[int, ...]]:
+        if self._expected_units:
+            return list(self._expected_units)
+        return [(w,) for w in range(self.num_workers)]
+
+    def _barrier_speculative(self, msg) -> dict:
+        """Condition-based replacement for the all-or-nothing barrier,
+        used when the job conf enables speculation. Every arrival
+        commits its unit's map ids first-result-wins; release happens
+        as soon as every expected unit has a committed producer — which
+        may be BEFORE a straggler arrives, because a waiting worker can
+        be handed a ``speculate`` directive to re-run the straggler's
+        shard. The release reply carries the winners verdict that
+        filters all reads."""
+        sid = msg["shuffle_id"]
+        w = msg["worker"]
+        pos = msg.get("pos", -1)
+        map_ids = list(msg.get("map_ids") or ())
+        unit = tuple(msg.get("unit") or ())
+        is_spec = bool(msg.get("spec_report"))
+        st = self._spec_state(sid)
+        cond = st["cond"]
+        from ..obs import events as _events
+        with cond:
+            if st["aborted"]:
+                raise threading.BrokenBarrierError()
+            if pos >= 0:
+                st["pos"] = pos
+            if unit and not (is_spec and msg.get("spec_failed")):
+                winner = self._registry.try_commit_maps(
+                    sid, unit, w, map_ids)
+                if is_spec:
+                    _events.emit("SpeculativeTask", phase="result",
+                                 shuffle_id=sid, unit=list(unit),
+                                 speculator=w, won=winner[0] == w)
+            if not is_spec:
+                st["arrived"][w] = time.monotonic()
+                st["spec_ok"][w] = bool(msg.get("spec_ok"))
+            self._maybe_release_spec(sid, st)
+            deadline = time.monotonic() + self.barrier_timeout
+            while not st["released"]:
+                if st["aborted"]:
+                    raise threading.BrokenBarrierError()
+                if not is_spec:
+                    directive = self._maybe_speculate(sid, st, w)
+                    if directive is not None:
+                        return directive
+                cond.wait(timeout=0.1)
+                if time.monotonic() > deadline:
+                    raise threading.BrokenBarrierError()
+            winners = st["winners"]
+        reply = {"type": "release"}
+        if winners is not None:
+            reply["winners"] = winners
+        return reply
+
+    def _maybe_release_spec(self, sid: int, st: dict) -> None:
+        """cond held. Release once every expected unit committed a
+        producer; build the winners verdict ({worker: map_ids}). A
+        stage where any unit was won by a NON-owner is not marked
+        reuse-complete: stage retry renames each worker's LOCAL blocks,
+        and a suppressed straggler's store disagrees with the verdict."""
+        if st["released"]:
+            return
+        committed = self._registry.committed_maps(sid)
+        expected = self._expected_unit_list()
+        if any(u not in committed for u in expected):
+            return
+        allowed: Dict[int, Tuple[int, ...]] = {
+            wi: () for wi in range(self.num_workers)}
+        suppressed = False
+        for wi, u in enumerate(expected):
+            ww, mids = committed[u]
+            allowed[ww] = tuple(sorted(set(allowed[ww]) | set(mids)))
+            if ww != wi:
+                suppressed = True
+        st["winners"] = {"allowed": allowed}
+        st["released"] = True
+        st["cond"].notify_all()
+        if not suppressed:
+            self._registry.mark_complete(st["pos"], sid)
+
+    def _maybe_speculate(self, sid: int, st: dict,
+                         w: int) -> Optional[dict]:
+        """cond held; ``w`` is a non-spec arrival still waiting. Hand
+        it a speculate directive when (a) it is the earliest-arrived
+        eligible waiter, (b) some expected unit has neither arrived nor
+        been assigned, (c) that unit's owner is heartbeat-ALIVE (a dead
+        owner is the eviction monitor's job, not speculation's), and
+        (d) the wait since the last arrival exceeds
+        max(minWaitSec, slowWorkerFactor x arrival spread)."""
+        if not st["spec_ok"].get(w) or w in st["speculating"]:
+            return None
+        candidates = [x for x in st["arrived"]
+                      if st["spec_ok"].get(x)
+                      and x not in st["speculating"]]
+        if not candidates or w != min(
+                candidates, key=lambda x: st["arrived"][x]):
+            return None
+        times = list(st["arrived"].values())
+        factor, min_wait = self._spec_conf
+        spread = (max(times) - min(times)) if len(times) > 1 else 0.0
+        if time.monotonic() - max(times) <= max(min_wait,
+                                                factor * spread):
+            return None
+        expected = self._expected_unit_list()
+        for wi, unit in enumerate(expected):
+            if wi in st["arrived"] or unit in st["assigned_units"]:
+                continue
+            eid = (self._worker_eids[wi]
+                   if wi < len(self._worker_eids) else None)
+            if eid is not None and not self._heartbeats.is_alive(eid):
+                continue
+            st["assigned_units"][unit] = w
+            st["speculating"].add(w)
+            from ..obs import events as _events
+            _events.emit("SpeculativeTask", phase="launch",
+                         shuffle_id=sid, unit=list(unit),
+                         speculator=w, straggler=wi)
+            return {"type": "speculate", "unit": list(unit)}
+        return None
+
     def _gather(self, key, worker: int, payload) -> List:
         with self._block:
             g = self._gathers.get(key)
@@ -875,6 +1162,7 @@ class ClusterDriver:
         with self._block:
             barriers = list(self._barriers.values())
             gathers = list(self._gathers.values())
+            spec_states = list(self._spec_barriers.values())
         for b in barriers:
             try:
                 b.abort()
@@ -883,6 +1171,13 @@ class ClusterDriver:
         for g in gathers:
             try:
                 g["barrier"].abort()
+            except Exception:
+                pass
+        for st in spec_states:
+            try:
+                with st["cond"]:
+                    st["aborted"] = True
+                    st["cond"].notify_all()
             except Exception:
                 pass
 
@@ -1047,7 +1342,18 @@ class ClusterDriver:
         with self._block:
             self._barriers.clear()
             self._gathers.clear()
+            self._spec_barriers.clear()
             workers = list(self._workers)
+        try:
+            from ..conf import (ADAPTIVE_SPECULATION_FACTOR,
+                                ADAPTIVE_SPECULATION_MIN_WAIT_S)
+            from ..conf import SrtConf as _SC
+            _c = _SC(dict(conf_settings or {}))
+            self._spec_conf = (
+                float(_c.get(ADAPTIVE_SPECULATION_FACTOR)),
+                float(_c.get(ADAPTIVE_SPECULATION_MIN_WAIT_S)))
+        except Exception:
+            self._spec_conf = (3.0, 1.0)
         n = len(workers)
         self.num_workers = n
         peers = [ep for _s, ep, _e in workers]
@@ -1066,6 +1372,12 @@ class ClusterDriver:
         self._last_assign = {eid: list(a) for (_s, _ep, eid), a
                              in zip(workers, assign)}
         self._last_shard_mod = shard_mod
+        # the speculative barrier names its per-worker units by the
+        # attempt's logical-id assignment (a speculator re-runs a
+        # straggler's WHOLE shard set: one worker's maps are one
+        # inseparable unit, first full result wins)
+        self._expected_units = [tuple(sorted(a)) for a in assign]
+        self._worker_eids = [eid for (_s, _ep, eid) in workers]
         from ..obs import events as _events
         _events.emit("StageSubmitted", job_token=job_token,
                      attempt=attempt, num_workers=n, assign=assign,

@@ -150,6 +150,57 @@ class ShuffleWriteMetrics:
     write_time_ns: int = 0
 
 
+@dataclass
+class MapOutputStatistics:
+    """Exact per-(map, reduce) shuffle sizes for one shuffle — what the
+    reference's AQE reads from Spark's MapOutputStatistics, here with
+    both rows and bytes so adaptive rules can reason in either unit.
+    ``detail`` maps (map_id, reduce_id) -> (rows, bytes); the
+    ``*_by_reduce`` lists are its per-reduce sums."""
+
+    shuffle_id: int
+    num_partitions: int
+    rows_by_reduce: List[int]
+    bytes_by_reduce: List[int]
+    detail: Dict[Tuple[int, int], Tuple[int, int]]
+
+    @property
+    def total_rows(self) -> int:
+        return sum(self.rows_by_reduce)
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(self.bytes_by_reduce)
+
+    @classmethod
+    def from_events(cls, events: Sequence[dict],
+                    shuffle_id: int) -> "MapOutputStatistics":
+        """Rebuild the statistics offline from ShuffleWrite event-log
+        records (tools/history_report.py's path; the in-engine path
+        reads ShuffleManager.map_output_statistics instead). JSON
+        round-trips dict keys as strings, hence the int() parses."""
+        detail: Dict[Tuple[int, int], Tuple[int, int]] = {}
+        nparts = 0
+        for rec in events:
+            if (rec.get("event") != "ShuffleWrite"
+                    or rec.get("shuffle_id") != shuffle_id):
+                continue
+            mid = int(rec.get("map_id", 0))
+            rrows = rec.get("reduce_rows") or {}
+            rbytes = rec.get("reduce_bytes") or {}
+            for rid_s, rows in rrows.items():
+                rid = int(rid_s)
+                nparts = max(nparts, rid + 1)
+                detail[(mid, rid)] = (int(rows),
+                                      int(rbytes.get(rid_s, 0) or 0))
+        rows_by = [0] * nparts
+        bytes_by = [0] * nparts
+        for (_mid, rid), (rows, nbytes) in detail.items():
+            rows_by[rid] += rows
+            bytes_by[rid] += nbytes
+        return cls(shuffle_id, nparts, rows_by, bytes_by, detail)
+
+
 class ShuffleManager:
     """getWriter/getReader surface over the mode-selected store."""
 
@@ -175,6 +226,10 @@ class ShuffleManager:
         #: rows per (shuffle, map, reduce): replays overwrite their
         #: own map's contribution instead of double-counting
         self._part_rows: Dict[Tuple[int, int, int], int] = {}
+        #: exact serialized bytes per (shuffle, map, reduce) — recorded
+        #: at write time (CACHE_ONLY estimates from device buffers);
+        #: the byte half of MapOutputStatistics
+        self._part_bytes: Dict[Tuple[int, int, int], int] = {}
         self.write_metrics = ShuffleWriteMetrics()
         self._lock = threading.Lock()
 
@@ -191,6 +246,8 @@ class ShuffleManager:
             self._poisoned_sids.discard(shuffle_id)
             for k in [k for k in self._part_rows if k[0] == shuffle_id]:
                 del self._part_rows[k]
+            for k in [k for k in self._part_bytes if k[0] == shuffle_id]:
+                del self._part_bytes[k]
 
     # --- integrity ---
     def is_poisoned(self, shuffle_id: int) -> bool:
@@ -226,6 +283,9 @@ class ShuffleManager:
             for k in [k for k in self._part_rows if k[0] == old_id]:
                 self._part_rows[(new_id, k[1], k[2])] = \
                     self._part_rows.pop(k)
+            for k in [k for k in self._part_bytes if k[0] == old_id]:
+                self._part_bytes[(new_id, k[1], k[2])] = \
+                    self._part_bytes.pop(k)
         return moved
 
     def partition_row_counts(self, shuffle_id: int) -> List[int]:
@@ -237,6 +297,42 @@ class ShuffleManager:
                 if sid == shuffle_id and rid < n:
                     out[rid] += v
         return out
+
+    def partition_byte_counts(self, shuffle_id: int) -> List[int]:
+        """Serialized bytes per reduce partition (CACHE_ONLY: device
+        buffer estimate)."""
+        n = self.num_partitions(shuffle_id)
+        out = [0] * n
+        with self._lock:
+            for (sid, _mid, rid), v in self._part_bytes.items():
+                if sid == shuffle_id and rid < n:
+                    out[rid] += v
+        return out
+
+    def map_output_statistics(self, shuffle_id: int,
+                              map_ids: Optional[set] = None
+                              ) -> MapOutputStatistics:
+        """Exact per-(map, reduce) rows/bytes for this process's map
+        outputs of ``shuffle_id``. ``map_ids`` restricts the view to a
+        subset of maps — speculation reports only the maps a worker WON
+        so losing duplicates never reach the global statistics."""
+        n = self.num_partitions(shuffle_id)
+        detail: Dict[Tuple[int, int], Tuple[int, int]] = {}
+        with self._lock:
+            for (sid, mid, rid), rows in self._part_rows.items():
+                if sid != shuffle_id or rid >= n:
+                    continue
+                if map_ids is not None and mid not in map_ids:
+                    continue
+                detail[(mid, rid)] = (
+                    rows, self._part_bytes.get((sid, mid, rid), 0))
+        rows_by = [0] * n
+        bytes_by = [0] * n
+        for (_mid, rid), (rows, nbytes) in detail.items():
+            rows_by[rid] += rows
+            bytes_by[rid] += nbytes
+        return MapOutputStatistics(shuffle_id, n, rows_by, bytes_by,
+                                   detail)
 
     def num_partitions(self, shuffle_id: int) -> int:
         return self._registered[shuffle_id]
@@ -253,23 +349,28 @@ class ShuffleManager:
         bytes_before = self.write_metrics.bytes_written
         futures = []
         local_rows: Dict[int, int] = {}
+        local_bytes: Dict[int, int] = {}
         for reduce_id, batch in enumerate(partitions):
             if batch is None or int(batch.num_rows) == 0:
                 continue
             local_rows[reduce_id] = int(batch.num_rows)
             block = (shuffle_id, map_id, reduce_id)
             if self.mode == "CACHE_ONLY":
+                from ..memory.spill import batch_nbytes
+                local_bytes[reduce_id] = batch_nbytes(batch)
                 self.catalog.add(block, batch)
                 self.write_metrics.rows_written += int(batch.num_rows)
                 self.write_metrics.blocks_written += 1
             else:  # MULTITHREADED (MESH writes never reach here)
-                futures.append(self._pool.submit(
-                    self._serialize_one, block, batch))
-        for f in futures:
-            f.result()
+                futures.append((reduce_id, self._pool.submit(
+                    self._serialize_one, block, batch)))
+        for reduce_id, f in futures:
+            local_bytes[reduce_id] = f.result()
         with self._lock:
             for reduce_id, rows in local_rows.items():
                 self._part_rows[(shuffle_id, map_id, reduce_id)] = rows
+                self._part_bytes[(shuffle_id, map_id, reduce_id)] = \
+                    local_bytes.get(reduce_id, 0)
         dt_ns = time.perf_counter_ns() - t0
         self.write_metrics.write_time_ns += dt_ns
         wrote = self.write_metrics.bytes_written - bytes_before
@@ -277,10 +378,14 @@ class ShuffleManager:
         _events.emit("ShuffleWrite", shuffle_id=shuffle_id,
                      map_id=map_id, blocks=len(local_rows),
                      rows=sum(local_rows.values()), bytes=wrote,
-                     write_time_ns=dt_ns)
+                     write_time_ns=dt_ns,
+                     reduce_rows={str(r): v
+                                  for r, v in sorted(local_rows.items())},
+                     reduce_bytes={str(r): v
+                                   for r, v in sorted(local_bytes.items())})
         return wrote
 
-    def _serialize_one(self, block: BlockId, batch: ColumnarBatch) -> None:
+    def _serialize_one(self, block: BlockId, batch: ColumnarBatch) -> int:
         data = serialize_batch(batch, compress=self.compress,
                                codec=self.codec)
         self.host_store.put(block, data)
@@ -290,6 +395,7 @@ class ShuffleManager:
             self.write_metrics.rows_written += int(batch.num_rows)
             self.write_metrics.blocks_written += 1
             self.write_metrics.bytes_written += len(data)
+        return len(data)
 
     # --- read path ---
     def read_partition(self, shuffle_id: int, reduce_id: int,
@@ -438,6 +544,16 @@ class ShuffleHeartbeatManager:
             return [e.executor_id for e in self._executors.values()
                     if now - e.last_heartbeat <= self.timeout_s]
 
+    def is_alive(self, executor_id: str) -> bool:
+        """Heartbeat-based liveness — the slow-vs-dead discriminator
+        speculation needs: a straggler still heartbeats (speculate), a
+        dead worker does not (evict + stage retry instead)."""
+        with self._lock:
+            info = self._executors.get(executor_id)
+            if info is None:
+                return False
+            return time.monotonic() - info.last_heartbeat <= self.timeout_s
+
     def expire_dead(self) -> List[str]:
         now = time.monotonic()
         with self._lock:
@@ -464,10 +580,81 @@ class MapOutputRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._complete: Dict[int, int] = {}  # pos -> shuffle_id
+        #: exact per-(map, reduce) sizes reported by workers at barrier
+        #: time: (shuffle_id, worker) -> {(map_id, reduce_id):
+        #: (rows, bytes)} — the registry half of MapOutputStatistics
+        self._map_stats: Dict[Tuple[int, int],
+                              Dict[Tuple[int, int],
+                                   Tuple[int, int]]] = {}
+        #: first-result-wins commits under speculation:
+        #: shuffle_id -> {logical_shard: (worker, (map_ids...))}
+        self._commits: Dict[int, Dict[int, Tuple[int,
+                                                 Tuple[int, ...]]]] = {}
 
     def start_attempt(self) -> None:
         with self._lock:
             self._complete.clear()
+            self._map_stats.clear()
+            self._commits.clear()
+
+    # --- map-output statistics (exact sizes, reported at barriers) ---
+    def record_map_stats(self, shuffle_id: int, worker: int,
+                         detail: Dict[Tuple[int, int],
+                                      Tuple[int, int]]) -> None:
+        with self._lock:
+            self._map_stats[(shuffle_id, worker)] = dict(detail or {})
+
+    def map_output_statistics(self, shuffle_id: int,
+                              num_partitions: int) -> MapOutputStatistics:
+        """Driver-side merged view across every reporting worker,
+        restricted to COMMITTED maps when speculation produced
+        duplicates (first result wins; losers never count)."""
+        with self._lock:
+            commits = self._commits.get(shuffle_id)
+            won: Optional[set] = None
+            if commits:
+                won = {(worker, mid)
+                       for worker, mids in commits.values()
+                       for mid in mids}
+            detail: Dict[Tuple[int, int], Tuple[int, int]] = {}
+            for (sid, worker), d in self._map_stats.items():
+                if sid != shuffle_id:
+                    continue
+                for (mid, rid), v in d.items():
+                    if won is not None and (worker, mid) not in won:
+                        continue
+                    detail[(mid, rid)] = v
+        rows_by = [0] * num_partitions
+        bytes_by = [0] * num_partitions
+        for (_mid, rid), (rows, nbytes) in detail.items():
+            if rid < num_partitions:
+                rows_by[rid] += rows
+                bytes_by[rid] += nbytes
+        return MapOutputStatistics(shuffle_id, num_partitions, rows_by,
+                                   bytes_by, detail)
+
+    # --- first-result-wins commits (speculative execution dedup) ---
+    def try_commit_maps(self, shuffle_id: int, logical_shard: int,
+                        worker: int,
+                        map_ids: Sequence[int]) -> Tuple[int,
+                                                         Tuple[int, ...]]:
+        """Commit ``worker`` as the producer of ``logical_shard``'s map
+        outputs unless another worker already committed — the
+        first-result-wins rule. Returns the WINNING (worker, map_ids),
+        which is the caller's when it won the race."""
+        with self._lock:
+            by_shard = self._commits.setdefault(shuffle_id, {})
+            cur = by_shard.get(logical_shard)
+            if cur is None:
+                cur = by_shard[logical_shard] = (worker, tuple(map_ids))
+            return cur
+
+    def committed_maps(self, shuffle_id: int) -> Dict[int,
+                                                      Tuple[int,
+                                                            Tuple[int,
+                                                                  ...]]]:
+        with self._lock:
+            return dict(self._commits.get(shuffle_id, {}))
 
     def mark_complete(self, pos: int, shuffle_id: int) -> None:
         if pos < 0:

@@ -399,7 +399,8 @@ def fetch_all_partitions(endpoints: List[str], shuffle_id: int,
                          budget: Optional[ByteBudget] = None,
                          map_mod=None,
                          endpoint_resolver: Optional[
-                             Callable[[str], Optional[str]]] = None
+                             Callable[[str], Optional[str]]] = None,
+                         allowed: Optional[dict] = None
                          ) -> Iterator[ColumnarBatch]:
     """Reduce-side iterator over every peer's blocks for one partition
     (RapidsShuffleIterator role): up to ``max_concurrent`` peers fetch
@@ -431,15 +432,25 @@ def fetch_all_partitions(endpoints: List[str], shuffle_id: int,
                                     endpoint_resolver, timeout_s,
                                     max_retries, backoff_base_s)
 
-    def keep(map_id: int) -> bool:
+    def keep(map_id: int, ep: str) -> bool:
         # skew split: client-side map-slice filter ((s, S) keeps
         # map_id % S == s); blocks outside the slice are dropped before
         # deserialization
-        return map_mod is None or map_id % map_mod[1] == map_mod[0]
+        if map_mod is not None and map_id % map_mod[1] != map_mod[0]:
+            return False
+        # speculation dedup: ``allowed`` maps each ORIGINAL peer
+        # endpoint to the map ids the driver committed as winners
+        # there; anything else on that peer (a losing duplicate, or a
+        # straggler's late write) is dropped before deserialization.
+        # Keyed by the endpoint the fetch was ADDRESSED to, so failover
+        # to a moved peer keeps the same filter.
+        if allowed is not None and map_id not in allowed.get(ep, ()):
+            return False
+        return True
     if len(endpoints) <= 1 or max_concurrent <= 1:
         for ep in endpoints:
             for map_id, data in open_stream(ep):
-                if keep(map_id):
+                if keep(map_id, ep):
                     yield deserialize_batch(data)
         return
 
@@ -465,7 +476,7 @@ def fetch_all_partitions(endpoints: List[str], shuffle_id: int,
                             qc is not None and (qc.is_cancelled()
                                                 or qc.expired())):
                         return
-                    if not keep(map_id):
+                    if not keep(map_id, ep):
                         continue
                     budget.acquire(len(data))
                     outq.put(("block", data))

@@ -1,0 +1,358 @@
+"""Adaptive query execution: stage-boundary re-planning from measured
+runtime statistics (Spark's AdaptiveSparkPlanExec recast for this
+engine's pull-based executor).
+
+The physical plan breaks into *query stages* at shuffle-exchange
+boundaries. Stages materialize in dependency order (deepest first,
+build side before probe side); after each map phase completes, the
+exact per-(map, reduce) byte sizes recorded by the shuffle manager
+feed four re-planning rules over the not-yet-started remainder:
+
+* **coalescePartitions** — undersized reduce partitions group together
+  until they reach a target byte size (or row floor), one grouping
+  applied to every consumer of the exchange so join keys stay aligned.
+* **skewJoin** — an oversized partition feeding a shuffled hash join
+  splits the probe side into map-id slices, each joined against the
+  full build partition (GpuSubPartitionHashJoin's decomposition driven
+  from measured sizes instead of estimates).
+* **joinStrategy** — a build side that materialized small demotes the
+  partitioned join to a broadcast-style single stream, bypassing the
+  probe-side exchange entirely; a broadcast build that materialized
+  HUGE falls back to sub-partitioned joining so the single hash table
+  never exceeds the configured byte bound.
+* **speculation** — straggler map tasks re-execute on idle workers,
+  first result wins (parallel/cluster.py's barrier owns the protocol;
+  this module only defines eligibility).
+
+Decisions are *pure functions of globally gathered statistics*: in
+cluster mode every worker derives the identical decision from the
+identical stats (divergent local decisions would deadlock the shuffle
+barriers), so there is no decision broadcast. Each decision is
+computed once, cached on the consuming node, and announced through an
+``AdaptivePlanChanged`` event (plus ``SkewSplit`` per split partition)
+so ``tools/history_report.py`` can reconstruct what the optimizer did
+and why.
+
+Two entry styles share the same rule functions:
+
+* ``adaptive_execute(physical, ctx)`` — the session/cluster pull loops
+  route through this; it materializes stages in dependency order and
+  attaches decisions eagerly, so by the time the root pulls, the
+  remainder of the plan is already re-planned.
+* lazy — operators (``ShuffledHashJoinExec``, ``HashAggregateExec``)
+  ask ``join_decision`` / ``stage_groups`` at first consumption; if the
+  eager pass already ran, the cached decision is returned, otherwise it
+  is computed on the spot. This keeps direct ``physical.execute(ctx)``
+  callers (tests, embedded uses) on identical behavior.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ..conf import (ADAPTIVE_BROADCAST_BYTES, ADAPTIVE_BROADCAST_ROWS,
+                    ADAPTIVE_COALESCE_ENABLED, ADAPTIVE_ENABLED,
+                    ADAPTIVE_JOIN_ENABLED, ADAPTIVE_MIN_PARTITION_ROWS,
+                    ADAPTIVE_SKEW_BYTES, ADAPTIVE_SKEW_ENABLED,
+                    ADAPTIVE_SKEW_ROWS, ADAPTIVE_TARGET_BYTES,
+                    BROADCAST_THRESHOLD_ROWS)
+from ..obs import events as _events
+
+#: hard cap on skew slices per partition — each slice re-reads the full
+#: build partition, so unbounded fan-out would trade skew for overhead
+MAX_SKEW_SLICES = 16
+
+_UNSET = object()
+
+
+# --- decisions ------------------------------------------------------------
+
+@dataclass
+class JoinDecision:
+    """Cached outcome of the adaptive rules for one shuffled hash join.
+
+    ``mode``:
+      * ``"static"`` — adaptive stood down (disabled, pinned layout, or
+        children are not both shuffle exchanges): plain partition zip.
+      * ``"broadcast_build"`` — joinStrategy demotion: stream the full
+        build side once, probe side bypasses its exchange.
+      * ``"partitioned"`` — partition-wise join; ``out_groups`` is None
+        when measurement changed nothing, else the coalesced/split
+        grouping with ``probe_mod`` carrying skew slice specs.
+    """
+    mode: str
+    out_groups: Optional[List[List[int]]] = None
+    build_groups: Optional[List[List[int]]] = None
+    probe_mod: Dict[int, Tuple[int, int]] = field(default_factory=dict)
+    n_skewed: int = 0
+
+
+def _conf(ctx, entry, default=None):
+    try:
+        return ctx.conf.get(entry)
+    except Exception:
+        return default
+
+
+def _coalesce(ctx, exchange, rows: List[int], nbytes: List[int]):
+    """Shared coalesce arithmetic: byte-target grouping with a row
+    floor. Returns the grouping (possibly identity)."""
+    from ..exec.exchange import ShuffleExchangeExec
+    if not _conf(ctx, ADAPTIVE_COALESCE_ENABLED, True):
+        return [[i] for i in range(len(rows))]
+    return ShuffleExchangeExec.coalesce_groups(
+        rows, _conf(ctx, ADAPTIVE_MIN_PARTITION_ROWS, 1 << 16),
+        byte_counts=nbytes,
+        target_bytes=_conf(ctx, ADAPTIVE_TARGET_BYTES, 0))
+
+
+def stage_groups(ctx, exchange) -> Optional[List[List[int]]]:
+    """coalescePartitions decision for a single-consumer exchange (a
+    FINAL aggregate's input). Returns the grouping, or None when the
+    measurement changed nothing. Cached on the exchange; the decision
+    event fires once, at computation time."""
+    cached = getattr(exchange, "_adaptive_groups_cache", _UNSET)
+    if cached is not _UNSET:
+        return cached
+    groups = None
+    if _conf(ctx, ADAPTIVE_ENABLED, False) and \
+            _conf(ctx, ADAPTIVE_COALESCE_ENABLED, True) and \
+            not getattr(exchange, "preserve_partitioning", False):
+        rows, nbytes = exchange.materialized_stats(ctx)
+        g = _coalesce(ctx, exchange, rows, nbytes)
+        if len(g) < len(rows):
+            groups = g
+            _events.emit("AdaptivePlanChanged", rule="coalescePartitions",
+                         shuffle_id=exchange.shuffle_id,
+                         partitions_before=len(rows),
+                         partitions_after=len(g),
+                         total_rows=sum(rows), total_bytes=sum(nbytes))
+    exchange._adaptive_groups_cache = groups
+    return groups
+
+
+def join_decision(ctx, join) -> JoinDecision:
+    """All adaptive rules for one ShuffledHashJoinExec, computed from
+    the measured sizes of its child exchanges and cached on the node."""
+    cached = getattr(join, "_adaptive_decision", None)
+    if cached is not None:
+        return cached
+    d = _compute_join_decision(ctx, join)
+    join._adaptive_decision = d
+    return d
+
+
+def _compute_join_decision(ctx, join) -> JoinDecision:
+    from ..exec.exchange import ShuffleExchangeExec
+    if not _conf(ctx, ADAPTIVE_ENABLED, False) or join.preserve_partitioning:
+        return JoinDecision("static")
+    l, r = join.children[0], join.children[1]
+    if not (isinstance(l, ShuffleExchangeExec) and
+            isinstance(r, ShuffleExchangeExec)):
+        return JoinDecision("static")
+    probe_is_left = join.build_side == "right"
+    build_x = r if probe_is_left else l
+    probe_x = l if probe_is_left else r
+
+    # -- joinStrategy: demote on MEASURED build size (build side
+    # materializes first; on demotion the probe exchange never runs) --
+    if _conf(ctx, ADAPTIVE_JOIN_ENABLED, True):
+        b_rows, b_bytes = build_x.materialized_stats(ctx)
+        rows_thr = _conf(ctx, ADAPTIVE_BROADCAST_ROWS, 0) or \
+            _conf(ctx, BROADCAST_THRESHOLD_ROWS, 0)
+        bytes_thr = _conf(ctx, ADAPTIVE_BROADCAST_BYTES, 0)
+        total_rows, total_bytes = sum(b_rows), sum(b_bytes)
+        if total_rows <= rows_thr or (bytes_thr > 0 and
+                                      total_bytes <= bytes_thr):
+            _events.emit("AdaptivePlanChanged", rule="joinStrategy",
+                         decision="broadcast_build",
+                         join=join.node_description(),
+                         build_shuffle_id=build_x.shuffle_id,
+                         bypassed_shuffle_id=probe_x.shuffle_id,
+                         build_rows=total_rows, build_bytes=total_bytes,
+                         row_threshold=rows_thr, byte_threshold=bytes_thr)
+            return JoinDecision("broadcast_build")
+
+    lc, lb = l.materialized_stats(ctx)
+    rc, rb = r.materialized_stats(ctx)
+    if len(lc) != len(rc):
+        return JoinDecision("static")
+    combined = [a + b for a, b in zip(lc, rc)]
+    combined_b = [a + b for a, b in zip(lb, rb)]
+    groups = _coalesce(ctx, join, combined, combined_b)
+
+    probe_counts = lc if probe_is_left else rc
+    probe_bytes = lb if probe_is_left else rb
+    skew_rows = _conf(ctx, ADAPTIVE_SKEW_ROWS, 1 << 20)
+    skew_bytes = _conf(ctx, ADAPTIVE_SKEW_BYTES, 0)
+    skew_on = _conf(ctx, ADAPTIVE_SKEW_ENABLED, True)
+    # skew split: a group that is ONE oversized partition splits the
+    # PROBE side into map slices, each joined against the full build
+    # partition. Only valid when the join never emits unmatched BUILD
+    # rows (slices would emit them once each).
+    can_split = join.join_type in (
+        "inner", "left_outer", "left_semi", "left_anti") \
+        if probe_is_left else join.join_type == "inner"
+    out_groups: List[List[int]] = []
+    build_groups: List[List[int]] = []
+    probe_mod: Dict[int, Tuple[int, int]] = {}
+    n_skewed = 0
+    for g in groups:
+        pc = sum(probe_counts[i] for i in g)
+        pb = sum(probe_bytes[i] for i in g)
+        split_rows = pc > skew_rows
+        split_bytes = skew_bytes > 0 and pb > skew_bytes
+        if skew_on and can_split and len(g) == 1 and \
+                (split_rows or split_bytes):
+            s_r = -(-pc // skew_rows) if split_rows else 1
+            s_b = -(-pb // skew_bytes) if split_bytes else 1
+            S = min(max(s_r, s_b), MAX_SKEW_SLICES)
+            n_skewed += 1
+            _events.emit("SkewSplit", join=join.node_description(),
+                         partition=g[0], rows=pc, bytes=pb, slices=S)
+            for s in range(S):
+                probe_mod[len(out_groups)] = (s, S)
+                out_groups.append(g)
+                build_groups.append(g)
+        else:
+            out_groups.append(g)
+            build_groups.append(g)
+    if len(out_groups) == len(combined) and not probe_mod:
+        return JoinDecision("partitioned")
+    _events.emit("AdaptivePlanChanged",
+                 rule="skewJoin" if n_skewed else "coalescePartitions",
+                 join=join.node_description(),
+                 shuffle_id=probe_x.shuffle_id,
+                 partitions_before=len(combined),
+                 partitions_after=len(out_groups),
+                 skewed_partitions=n_skewed)
+    return JoinDecision("partitioned", out_groups, build_groups,
+                        probe_mod, n_skewed)
+
+
+def broadcast_oversize_slices(ctx, join, build_rows: int,
+                              build_bytes: int) -> int:
+    """joinStrategy *promotion* guard for an already-broadcast join: a
+    build side whose measured bytes exceed
+    ``srt.sql.adaptive.maxBroadcastJoinBytes`` cannot be re-planned
+    into a shuffle at this point (it is already materialized on every
+    node), but it CAN be joined sub-partitioned so the single hash
+    table never holds the whole thing. Returns the slice count (0 = no
+    action)."""
+    from ..conf import ADAPTIVE_MAX_BROADCAST_BYTES
+    if not _conf(ctx, ADAPTIVE_ENABLED, False):
+        return 0
+    cap = _conf(ctx, ADAPTIVE_MAX_BROADCAST_BYTES, 0)
+    if cap <= 0 or build_bytes <= cap or build_rows <= 1:
+        return 0
+    slices = min(-(-build_bytes // cap), MAX_SKEW_SLICES)
+    _events.emit("AdaptivePlanChanged", rule="joinStrategy",
+                 decision="subpartition_broadcast",
+                 join=join.node_description(), build_rows=build_rows,
+                 build_bytes=build_bytes, byte_cap=cap, slices=slices)
+    return slices
+
+
+# --- stage graph ----------------------------------------------------------
+
+@dataclass
+class QueryStage:
+    """One materialization unit: a shuffle exchange and the subtree
+    below it (up to deeper exchanges, which are their own stages)."""
+    exchange: object
+    depth: int          # exchanges on the path from the root, inclusive
+    order: int          # pre-order position (tiebreak within a depth)
+    role: str           # "build" | "probe" | "other"
+    consumer: object    # direct parent when it is a decision point
+
+
+def collect_stages(root) -> List[QueryStage]:
+    """Walk the physical tree collecting shuffle-exchange stages.
+    Broadcast subtrees are skipped (they materialize through their own
+    lazy path); shared exchanges (full-outer lowering) appear once."""
+    from ..exec.exchange import BroadcastExchangeExec, ShuffleExchangeExec
+    from ..exec.join import ShuffledHashJoinExec
+    stages: List[QueryStage] = []
+    seen: set = set()
+    counter = [0]
+
+    def role_of(parent, node) -> str:
+        if isinstance(parent, ShuffledHashJoinExec):
+            build = parent.children[1] if parent.build_side == "right" \
+                else parent.children[0]
+            return "build" if node is build else "probe"
+        return "other"
+
+    def walk(node, depth, parent):
+        if isinstance(node, BroadcastExchangeExec):
+            return
+        if isinstance(node, ShuffleExchangeExec):
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            stages.append(QueryStage(node, depth + 1, counter[0],
+                                     role_of(parent, node), parent))
+            counter[0] += 1
+            for c in getattr(node, "children", []):
+                walk(c, depth + 1, node)
+            return
+        for c in getattr(node, "children", []):
+            walk(c, depth, node)
+
+    walk(root, 0, None)
+    return stages
+
+
+def execution_order(stages: List[QueryStage]) -> List[QueryStage]:
+    """Dependency order: deeper exchanges first (a stage depends only
+    on exchanges strictly below it), build side before probe side at
+    equal depth (joinStrategy decides off the build before the probe's
+    map phase is committed), then plan pre-order for determinism —
+    every cluster worker derives the identical schedule."""
+    rank = {"build": 0, "other": 1, "probe": 2}
+    return sorted(stages, key=lambda s: (-s.depth, rank[s.role], s.order))
+
+
+class AdaptiveExecutor:
+    """Eager stage-ordered driver: materialize each stage, re-plan the
+    remainder from its measured sizes, then pull the root. Decisions
+    land in the same per-node caches the lazy operator path reads, so
+    the final ``root.execute`` consumes them without recomputation."""
+
+    def __init__(self, physical, ctx):
+        self.physical = physical
+        self.ctx = ctx
+
+    def execute(self) -> Iterator:
+        from ..exec.aggregate import HashAggregateExec
+        from ..exec.join import ShuffledHashJoinExec
+        ctx = self.ctx
+        skipped: set = set()   # exchanges bypassed by a demoted join
+        for st in execution_order(collect_stages(self.physical)):
+            ex = st.exchange
+            if id(ex) in skipped:
+                continue
+            # materialize the map phase and gather global sizes; cached,
+            # so consumers (and re-visits through a demoted join's
+            # subtree) see the same stats without re-running anything
+            ex.materialized_stats(ctx)
+            c = st.consumer
+            if isinstance(c, ShuffledHashJoinExec) and st.role == "build":
+                d = join_decision(ctx, c)
+                if d.mode == "broadcast_build":
+                    probe = c.children[0] if c.build_side == "right" \
+                        else c.children[1]
+                    skipped.add(id(probe))
+            elif isinstance(c, HashAggregateExec):
+                stage_groups(ctx, ex)
+        yield from self.physical.execute(ctx)
+
+
+def adaptive_execute(physical, ctx) -> Iterator:
+    """Entry point for the session/cluster pull loops: stage-ordered
+    adaptive execution when enabled, plain execution otherwise."""
+    if not _conf(ctx, ADAPTIVE_ENABLED, False):
+        yield from physical.execute(ctx)
+        return
+    yield from AdaptiveExecutor(physical, ctx).execute()

@@ -269,9 +269,10 @@ class TpuSession:
             try:
                 if is_tpu:
                     from ..memory.spill import batch_nbytes
+                    from .adaptive import adaptive_execute
                     reg = _registry()
                     tables = []
-                    for b in physical.execute(ctx):
+                    for b in adaptive_execute(physical, ctx):
                         n = int(b.num_rows)
                         if n == 0:
                             continue

@@ -348,3 +348,373 @@ def test_broadcast_join_partition_wise_chain():
                                            fromlist=["STRING"]).STRING)])
     chain2 = agg_t.join(empty_dim, "j").join(agg_u, "k")
     assert chain2.collect() == []
+
+
+# ------------------------------------------------- byte-based triggers
+
+def test_byte_target_coalescing():
+    """Rows alone would never coalesce (huge row floor); the byte
+    target must close groups on measured partition bytes instead."""
+    s = make_session(**{
+        "srt.sql.adaptive.coalescePartitions.minPartitionRows": "1000000",
+        "srt.sql.adaptive.coalescePartitions.targetBytes": "100000000"})
+    df = make_df(s, {"k": IntGen(lo=0, hi=40), "v": IntGen()}, 400, seed=3)
+    q = df.group_by(col("k")).agg(Sum(col("v")).alias("sv"))
+    assert_tpu_cpu_equal_df(q)
+    _, metrics = _run_with_metrics(q)
+    # 400 rows over 8 partitions, all under both budgets -> one group
+    assert metrics.get("adaptiveCoalescedPartitions", 0) >= 4
+
+
+def test_byte_skew_split():
+    """Skew detected by partition BYTES (row threshold out of reach):
+    the dominant key's partition must be sub-partitioned and results
+    must still match the oracle."""
+    s = make_session(**{
+        "srt.sql.adaptive.skewJoin.partitionRows": "100000000",
+        "srt.sql.adaptive.skewJoin.partitionBytes": "2048",
+        "srt.sql.adaptive.coalescePartitions.minPartitionRows": "1"})
+    left = make_df(s, {"k": IntGen(lo=0, hi=2), "v": IntGen()}, 600,
+                   seed=17)
+    right = make_df(s, {"k": IntGen(lo=0, hi=2), "w": IntGen()}, 600,
+                    seed=19)
+    q = left.join(right, ([col("k")], [col("k")]), how="inner")
+    out, metrics = _run_with_metrics(q)
+    assert metrics.get("skewedJoinPartitions", 0) >= 1
+    assert_tpu_cpu_equal_df(q)
+
+
+def test_byte_broadcast_demote():
+    """Demotion driven by measured build-side BYTES: the row threshold
+    is disabled (broadcastRowThreshold=1 keeps the static plan
+    shuffled, adaptive row threshold inherits it), so only
+    autoBroadcastJoinBytes can trigger the switch."""
+    s = make_session(**{"srt.sql.adaptive.autoBroadcastJoinBytes":
+                        "104857600"})
+    left = make_df(s, {"k": IntGen(lo=0, hi=30), "v": IntGen()}, 400,
+                   seed=9)
+    right = make_df(s, {"k": IntGen(lo=0, hi=30), "w": IntGen()}, 50,
+                    seed=11)
+    q = left.join(right, ([col("k")], [col("k")]), how="inner")
+    out, metrics = _run_with_metrics(q)
+    assert metrics.get("adaptiveBroadcastJoins", 0) == 1
+    assert_tpu_cpu_equal_df(q)
+
+
+def test_max_broadcast_build_bytes_subpartitions():
+    """An oversized BROADCAST build (planned at compile time) must be
+    sub-partitioned when it exceeds maxBroadcastBuildBytes, with
+    results unchanged and the decision logged."""
+    import spark_rapids_tpu.obs.events as ev
+    import tempfile
+    logdir = tempfile.mkdtemp(prefix="srt_adaptive_ev_")
+    ev.install(ev.EventLogWriter(logdir))
+    try:
+        s = TpuSession(SrtConf({
+            "srt.shuffle.partitions": 4,
+            # generous row threshold -> static plan broadcasts
+            "srt.sql.broadcastRowThreshold": "100000",
+            "srt.sql.adaptive.maxBroadcastBuildBytes": "512"}))
+        left = make_df(s, {"k": IntGen(lo=0, hi=30), "v": IntGen()},
+                       400, seed=21)
+        right = make_df(s, {"k": IntGen(lo=0, hi=30), "w": IntGen()},
+                        200, seed=23)
+        q = left.join(right, ([col("k")], [col("k")]), how="inner")
+        from spark_rapids_tpu.plan import overrides
+        tree = overrides.apply_overrides(
+            q.plan, s.conf).tree_string()
+        assert "BroadcastHashJoin" in tree, tree
+        assert_tpu_cpu_equal_df(q, conf=s.conf)
+        recs = ev.read_all_events(logdir)
+        sub = [r for r in recs if r.get("event") == "AdaptivePlanChanged"
+               and r.get("decision") == "subpartition_broadcast"]
+        assert sub, [r.get("event") for r in recs]
+        assert sub[0]["slices"] >= 2
+    finally:
+        ev.install(None)
+
+
+# -------------------------------------------------- events + conf alias
+
+def test_adaptive_decision_events():
+    """Every adaptive plan change must leave an AdaptivePlanChanged
+    (and, for skew, SkewSplit) record in the event log."""
+    import spark_rapids_tpu.obs.events as ev
+    import tempfile
+    logdir = tempfile.mkdtemp(prefix="srt_adaptive_ev_")
+    ev.install(ev.EventLogWriter(logdir))
+    try:
+        # coalesce
+        s = make_session()
+        df = make_df(s, {"k": IntGen(lo=0, hi=40), "v": IntGen()}, 200,
+                     seed=3)
+        _run_with_metrics(df.group_by(col("k"))
+                          .agg(Sum(col("v")).alias("sv")))
+        # demote
+        s2 = make_session(
+            **{"srt.sql.adaptive.autoBroadcastJoinRows": "1000"})
+        l2 = make_df(s2, {"k": IntGen(lo=0, hi=30), "v": IntGen()}, 400,
+                     seed=9)
+        r2 = make_df(s2, {"k": IntGen(lo=0, hi=30), "w": IntGen()}, 50,
+                     seed=11)
+        _run_with_metrics(l2.join(r2, ([col("k")], [col("k")]),
+                                  how="inner"))
+        # skew split
+        s3 = make_session(**{
+            "srt.sql.adaptive.skewJoin.partitionRows": "128",
+            "srt.sql.adaptive.coalescePartitions.minPartitionRows": "1"})
+        l3 = make_df(s3, {"k": IntGen(lo=0, hi=1), "v": IntGen()}, 600,
+                     seed=25)
+        r3 = make_df(s3, {"k": IntGen(lo=0, hi=1), "w": IntGen()}, 600,
+                     seed=27)
+        _run_with_metrics(l3.join(r3, ([col("k")], [col("k")]),
+                                  how="inner"))
+        recs = ev.read_all_events(logdir)
+        by_rule = {}
+        for r in recs:
+            if r.get("event") == "AdaptivePlanChanged":
+                by_rule.setdefault(r.get("rule"), []).append(r)
+        assert "coalescePartitions" in by_rule, sorted(by_rule)
+        assert "joinStrategy" in by_rule, sorted(by_rule)
+        assert "skewJoin" in by_rule, sorted(by_rule)
+        demote = by_rule["joinStrategy"][0]
+        assert demote["decision"] == "broadcast_build"
+        assert demote["build_rows"] <= 1000
+        splits = [r for r in recs if r.get("event") == "SkewSplit"]
+        assert splits and splits[0]["slices"] >= 2
+    finally:
+        ev.install(None)
+
+
+def test_legacy_adaptive_broadcast_rows_alias():
+    """The deprecated srt.sql.adaptiveBroadcastRows key must feed the
+    new srt.sql.adaptive.autoBroadcastJoinRows entry."""
+    from spark_rapids_tpu.conf import ADAPTIVE_BROADCAST_ROWS
+    s = make_session(**{"srt.sql.adaptiveBroadcastRows": "777"})
+    assert s.conf.get(ADAPTIVE_BROADCAST_ROWS) == 777
+    # and it still drives the demotion rule end to end
+    left = make_df(s, {"k": IntGen(lo=0, hi=30), "v": IntGen()}, 400,
+                   seed=9)
+    right = make_df(s, {"k": IntGen(lo=0, hi=30), "w": IntGen()}, 50,
+                    seed=11)
+    q = left.join(right, ([col("k")], [col("k")]), how="inner")
+    _, metrics = _run_with_metrics(q)
+    assert metrics.get("adaptiveBroadcastJoins", 0) == 1
+
+
+# ------------------------------------------------ speculation protocol
+
+def test_speculative_barrier_protocol():
+    """Driver-side speculation protocol, single-threaded: worker 0
+    arrives, waits past minWait, receives a speculate directive for the
+    straggler's unit, reports the result, and the release verdict
+    routes ALL reads to worker 0's copies. The late straggler's commit
+    loses first-result-wins."""
+    from spark_rapids_tpu.parallel.cluster import ClusterDriver
+    driver = ClusterDriver(num_workers=2)
+    try:
+        driver._spec_conf = (1.0, 0.05)          # factor, min_wait
+        driver._expected_units = [(0,), (1,)]
+        driver._worker_eids = []                 # no heartbeat gating
+        sid = 55
+        r1 = driver._barrier_speculative({
+            "shuffle_id": sid, "worker": 0, "pos": 2,
+            "speculation": True, "spec_ok": True,
+            "unit": (0,), "map_ids": [100]})
+        assert r1 == {"type": "speculate", "unit": [1]}
+        r2 = driver._barrier_speculative({
+            "shuffle_id": sid, "worker": 0, "pos": 2,
+            "speculation": True, "spec_report": True,
+            "unit": (1,), "map_ids": [200]})
+        assert r2["type"] == "release"
+        allowed = r2["winners"]["allowed"]
+        assert tuple(allowed[0]) == (100, 200)
+        assert tuple(allowed[1]) == ()
+        # straggler finally arrives: sticky release, losing commit
+        r3 = driver._barrier_speculative({
+            "shuffle_id": sid, "worker": 1, "pos": 2,
+            "speculation": True, "spec_ok": True,
+            "unit": (1,), "map_ids": [150]})
+        assert r3["winners"]["allowed"] == allowed
+        committed = driver._registry.committed_maps(sid)
+        assert committed[(1,)][0] == 0          # worker 0 won unit (1,)
+        # a suppressed stage must NOT be reusable across retries
+        assert 2 not in driver._registry.complete_positions()
+    finally:
+        driver.shutdown()
+
+
+def test_cluster_speculation_end_to_end(tmp_path_factory):
+    """Real 2-worker cluster: worker 1 stalls 6s at the barrier via
+    fault injection, worker 0 speculates its shard, the job finishes
+    early with oracle-identical results, and the event log shows the
+    launch and the winning result."""
+    import tempfile
+    import numpy as np
+    import spark_rapids_tpu.obs.events as ev
+    from spark_rapids_tpu.expr.aggregates import CountStar
+    from spark_rapids_tpu.expr.core import Alias
+    from spark_rapids_tpu.parallel.cluster import (ClusterDriver,
+                                                   launch_local_workers)
+    root = tmp_path_factory.mktemp("spec_cluster")
+    session = TpuSession(SrtConf({}))
+    rng = np.random.default_rng(31)
+    n = 12_000
+    fact = session.create_dataframe({
+        "k": rng.integers(0, 40, n).tolist(),
+        "v": rng.uniform(0, 10, n).tolist()})
+    fact_dir = str(root / "fact")
+    fact.write.parquet(fact_dir)
+    logdir = str(root / "events")
+    ev.install(ev.EventLogWriter(logdir))
+    driver = ClusterDriver(num_workers=2, barrier_timeout=60)
+    procs = launch_local_workers(driver, 2)
+    job_conf = {
+        "srt.shuffle.partitions": 4,
+        "srt.cluster.barrierTimeoutSec": 60,
+        "srt.sql.adaptive.speculation.enabled": "true",
+        "srt.sql.adaptive.speculation.minWaitSec": "0.3",
+        "srt.sql.adaptive.speculation.slowWorkerFactor": "1.0",
+        "srt.test.faultPlan":
+            "seed=5|cluster.barrier:delay@1+6.0~workers=1;",
+    }
+    try:
+        driver.wait_for_workers(timeout=90)
+        sess = TpuSession(SrtConf({}))
+        plan = sess.read.parquet(fact_dir).group_by("k").agg(
+            Alias(Sum(col("v")), "s"), Alias(CountStar(), "c")).plan
+        rows = driver.run(plan, job_conf)
+        expect = {r["k"]: r for r in TpuSession(SrtConf({})).read
+                  .parquet(fact_dir).group_by("k")
+                  .agg(Alias(Sum(col("v")), "s"),
+                       Alias(CountStar(), "c")).collect()}
+        assert len(rows) == len(expect)
+        for r in rows:
+            e = expect[r["k"]]
+            assert r["c"] == e["c"]
+            assert r["s"] == pytest.approx(e["s"], rel=1e-9)
+        recs = ev.read_all_events(logdir)
+        launches = [r for r in recs
+                    if r.get("event") == "SpeculativeTask"
+                    and r.get("phase") == "launch"]
+        results = [r for r in recs
+                   if r.get("event") == "SpeculativeTask"
+                   and r.get("phase") == "result"]
+        assert launches, [r.get("event") for r in recs]
+        assert launches[0]["speculator"] == 0
+        assert launches[0]["straggler"] == 1
+        assert results and results[0]["won"] is True, results
+    finally:
+        ev.install(None)
+        driver.shutdown()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                p.kill()
+
+
+def test_stage_retry_with_adaptive_replan(tmp_path_factory):
+    """Stage-level retry x adaptive: worker 1 crashes at the final
+    (range-exchange) barrier AFTER the hash exchange completed, with
+    adaptive coalescing active. The retry must reuse the completed
+    hash exchange, re-derive the SAME coalesce decision from the
+    surviving stats, and produce oracle-identical sorted rows."""
+    import numpy as np
+    from spark_rapids_tpu.expr.aggregates import CountStar
+    from spark_rapids_tpu.expr.core import Alias
+    from spark_rapids_tpu.parallel.cluster import (ClusterDriver,
+                                                   launch_local_workers)
+    root = tmp_path_factory.mktemp("adaptive_retry")
+    session = TpuSession(SrtConf({}))
+    rng = np.random.default_rng(41)
+    n = 9_000
+    fact = session.create_dataframe({
+        "k": rng.integers(0, 40, n).tolist(),
+        "v": rng.uniform(0, 10, n).tolist()})
+    fact_dir = str(root / "fact")
+    fact.write.parquet(fact_dir)
+    spec = "seed=3|cluster.barrier:crash@1~attempt=0;workers=1;pos=0;"
+    job_conf = {
+        "srt.shuffle.partitions": 4,
+        "srt.cluster.barrierTimeoutSec": 60,
+        # row floor far above any partition -> every reduce stage
+        # coalesces into one group on every attempt
+        "srt.sql.adaptive.coalescePartitions.minPartitionRows": "100000",
+        "srt.test.faultPlan": spec}
+    driver = ClusterDriver(num_workers=3, barrier_timeout=60,
+                           heartbeat_interval=0.5, heartbeat_timeout=6)
+    procs = launch_local_workers(driver, 3)
+    try:
+        driver.wait_for_workers(timeout=90)
+        sess = TpuSession(SrtConf({}))
+        plan = sess.read.parquet(fact_dir) \
+            .group_by("k").agg(Alias(Sum(col("v")), "s"),
+                               Alias(CountStar(), "c")) \
+            .sort("k").plan
+        rows = driver.run(plan, job_conf)
+        expect = TpuSession(SrtConf({})).read.parquet(fact_dir) \
+            .group_by("k").agg(Alias(Sum(col("v")), "s"),
+                               Alias(CountStar(), "c")) \
+            .sort("k").collect()
+        assert [r["k"] for r in rows] == [r["k"] for r in expect]
+        for got, want in zip(rows, expect):
+            assert got["c"] == want["c"]
+            assert got["s"] == pytest.approx(want["s"], rel=1e-9)
+        stage = [e for e in driver.recovery_events
+                 if e["type"] == "stage_retry"]
+        assert stage, driver.recovery_events
+        assert stage[0]["reused_positions"] == [1], driver.recovery_events
+        coalesced = sum(v.get("adaptiveCoalescedPartitions", 0)
+                        for wm in driver.last_metrics
+                        for v in wm.values())
+        assert coalesced >= 1, driver.last_metrics
+    finally:
+        driver.shutdown()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                p.kill()
+
+
+# ----------------------------------------------- NDS differential runs
+
+NDS_AB_QUERIES = ("q3", "q19", "q42")
+
+
+def _nds_rows(data_dir, qid, scale, adaptive_on):
+    from spark_rapids_tpu.models.nds import NDS_QUERIES, register_nds
+    s = TpuSession(SrtConf({
+        "srt.shuffle.partitions": 8,
+        "srt.sql.adaptive.enabled": "true" if adaptive_on else "false",
+        # low floor so coalescing actually fires at tiny scale
+        "srt.sql.adaptive.coalescePartitions.minPartitionRows": "256"}))
+    register_nds(s, data_dir, scale_rows=scale)
+    rows = s.sql(NDS_QUERIES[qid]).collect()
+    keys = sorted(rows[0]) if rows else []
+    return sorted((tuple(r[k] for k in keys) for r in rows), key=repr)
+
+
+@pytest.fixture(scope="module")
+def nds_ab_data(tmp_path_factory):
+    return str(tmp_path_factory.mktemp("adaptive_nds") / "data")
+
+
+@pytest.mark.parametrize("qid", NDS_AB_QUERIES)
+def test_nds_adaptive_bit_identical(nds_ab_data, qid):
+    """Adaptive on vs off must be BIT-IDENTICAL on NDS queries:
+    coalescing only regroups disjoint hash buckets, so every key's
+    accumulation order is unchanged."""
+    on = _nds_rows(nds_ab_data, qid, 4_000, True)
+    off = _nds_rows(nds_ab_data, qid, 4_000, False)
+    assert on == off
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("qid", NDS_AB_QUERIES)
+def test_nds_adaptive_bit_identical_100k(tmp_path_factory, qid):
+    data = str(tmp_path_factory.mktemp("adaptive_nds_100k") / "data")
+    on = _nds_rows(data, qid, 100_000, True)
+    off = _nds_rows(data, qid, 100_000, False)
+    assert on == off

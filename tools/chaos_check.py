@@ -7,11 +7,13 @@ Each plan ships to the workers via ``srt.test.faultPlan`` (see
 docs/ROBUSTNESS.md for the spec grammar and fault-site catalog). The
 sweep covers the transient-transport paths (refused connects,
 mid-frame resets, delays, dropped heartbeats), the stage-level
-recovery path (a worker crash at a stage boundary), and the data
+recovery path (a worker crash at a stage boundary), the data
 integrity paths (seeded byte-flips of shuffle payloads on the wire and
 at rest, corrupt input files, and a flipped disk-tier spill entry —
 every one must be detected and recovered, never a silently wrong
-answer). A nonzero exit means a divergent result, a failed run, or a
+answer), and the adaptive-execution paths (seeded skew and wrong
+broadcast thresholds swept adaptive on/off with identical results,
+plus a speculated straggler). A nonzero exit means a divergent result, a failed run, or a
 blown wall-clock budget — any of which is a real robustness
 regression.
 
@@ -578,6 +580,168 @@ def _concurrency_check(n_threads: int = 8, queries_per_thread: int = 4,
     return failures
 
 
+def _adaptive_check(n_workers: int = 2) -> int:
+    """Adaptive-execution leg: seeded skewed data under deliberately
+    WRONG compile-time settings (broadcast disabled by a 1-row
+    threshold, a skew threshold far below the hot partition, a row
+    floor far above every partition) on a real cluster, swept adaptive
+    ON and OFF. The two sweeps must produce identical, oracle-matching
+    results, the ON sweep's event log must carry at least one of every
+    decision event (AdaptivePlanChanged for coalescePartitions /
+    skewJoin / joinStrategy, SkewSplit), and an injected 4 s straggler
+    under speculation must leave a SpeculativeTask launch/result pair.
+    Returns failure count."""
+    import numpy as np
+
+    from spark_rapids_tpu.conf import SrtConf
+    from spark_rapids_tpu.expr import col
+    from spark_rapids_tpu.expr.aggregates import CountStar, Sum
+    from spark_rapids_tpu.expr.core import Alias
+    from spark_rapids_tpu.obs import events as ev
+    from spark_rapids_tpu.parallel.cluster import (ClusterDriver,
+                                                   launch_local_workers)
+    from spark_rapids_tpu.plan import TpuSession
+
+    failures = 0
+    t0 = time.monotonic()
+    with tempfile.TemporaryDirectory(prefix="srt_adaptive_") as tmp:
+        session = TpuSession(SrtConf({}))
+        rng = np.random.default_rng(37)
+        n = 12_000
+        # ~90% of rows share one hot key: the skew the compile-time
+        # plan knows nothing about
+        keys = np.where(rng.random(n) < 0.9, 7,
+                        rng.integers(0, 40, n))
+        fact_dir = os.path.join(tmp, "fact")
+        session.create_dataframe({
+            "k": keys.tolist(),
+            "v": rng.uniform(0, 10, n).tolist(),
+        }).write.parquet(fact_dir)
+        dim_dir = os.path.join(tmp, "dim")
+        session.create_dataframe({
+            "k": list(range(40)),
+            "w": [i * 2 for i in range(40)],
+        }).write.parquet(dim_dir)
+        events_dir = os.path.join(tmp, "events")
+
+        # a downstream group-by would PIN the join's partitioning and
+        # (correctly) stand the join rules down, so the join runs bare
+        def join_plan(sess):
+            f = sess.read.parquet(fact_dir)
+            d = sess.read.parquet(dim_dir)
+            return f.join(d, ([col("k")], [col("k")]), how="inner")
+
+        def agg_plan(sess):
+            return sess.read.parquet(fact_dir).group_by("k").agg(
+                Alias(Sum(col("v")), "s"), Alias(CountStar(), "c"))
+
+        def canon(which, rows):
+            if which == "join":
+                return sorted((r["k"], round(r["v"], 6), r["w"])
+                              for r in rows)
+            return sorted((r["k"], r["c"], round(r["s"], 6))
+                          for r in rows)
+
+        oracle_sess = TpuSession(SrtConf(
+            {"srt.sql.adaptive.enabled": "false",
+             "srt.sql.broadcastRowThreshold": 1}))
+        oracles = {
+            "join": canon("join", join_plan(oracle_sess).collect()),
+            "agg": canon("agg", agg_plan(oracle_sess).collect())}
+
+        # driver-side sink: SpeculativeTask launch/result events are
+        # emitted by the DRIVER's barrier, i.e. this process
+        ev.install(ev.EventLogWriter(events_dir))
+        driver = ClusterDriver(num_workers=n_workers,
+                               barrier_timeout=60,
+                               heartbeat_interval=0.5,
+                               heartbeat_timeout=10)
+        procs = launch_local_workers(driver, n_workers)
+        base_conf = {"srt.shuffle.partitions": 4,
+                     "srt.cluster.barrierTimeoutSec": 60,
+                     "srt.eventLog.enabled": "true",
+                     "srt.eventLog.dir": events_dir}
+        # (name, plan builder, wrong-settings conf)
+        runs = [
+            ("skew split", join_plan,
+             {"srt.sql.broadcastRowThreshold": 1,
+              "srt.sql.adaptive.autoBroadcastJoinRows": 1,
+              "srt.sql.adaptive.skewJoin.partitionRows": 1000,
+              "srt.sql.adaptive.coalescePartitions.minPartitionRows":
+                  1}),
+            ("broadcast demote", join_plan,
+             {"srt.sql.broadcastRowThreshold": 1,
+              "srt.sql.adaptive.autoBroadcastJoinRows": 100000}),
+            ("speculated straggler + coalesce", agg_plan,
+             {"srt.sql.adaptive.coalescePartitions.minPartitionRows":
+                  1 << 16,
+              "srt.sql.adaptive.speculation.enabled": "true",
+              "srt.sql.adaptive.speculation.minWaitSec": "0.3",
+              "srt.sql.adaptive.speculation.slowWorkerFactor": "1.0",
+              "srt.test.faultPlan":
+                  "seed=7|cluster.barrier:delay@1+4.0~workers=1;"}),
+        ]
+        try:
+            driver.wait_for_workers(timeout=120)
+            for name, build, extra in runs:
+                which = "join" if build is join_plan else "agg"
+                for label, on in (("adaptive=on", "true"),
+                                  ("adaptive=off", "false")):
+                    if build is agg_plan and on == "false":
+                        continue  # the off leg would just wait 4s
+                    conf = dict(base_conf, **extra)
+                    conf["srt.sql.adaptive.enabled"] = on
+                    t = time.monotonic()
+                    try:
+                        rows = driver.run(build(session).plan, conf)
+                    except Exception as e:
+                        print(f"[chaos] FAIL [adaptive: {name} "
+                              f"{label}]: job raised "
+                              f"{type(e).__name__}: {e}",
+                              file=sys.stderr, flush=True)
+                        failures += 1
+                        continue
+                    ok = canon(which, rows) == oracles[which]
+                    print(f"[chaos] {'PASS' if ok else 'FAIL'} "
+                          f"[adaptive: {name} {label}] "
+                          f"{time.monotonic() - t:.1f}s", flush=True)
+                    if not ok:
+                        failures += 1
+        finally:
+            ev.install(None)
+            driver.shutdown()
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except Exception:
+                    p.kill()
+        recs = ev.read_all_events(events_dir)
+        rules = {r.get("rule") for r in recs
+                 if r.get("event") == "AdaptivePlanChanged"}
+        spec_phases = {r.get("phase") for r in recs
+                       if r.get("event") == "SpeculativeTask"}
+        checks = [
+            ("coalescePartitions decision logged",
+             "coalescePartitions" in rules),
+            ("skewJoin decision logged", "skewJoin" in rules),
+            ("joinStrategy decision logged", "joinStrategy" in rules),
+            ("SkewSplit events logged",
+             any(r.get("event") == "SkewSplit" for r in recs)),
+            ("speculation launch + result logged",
+             {"launch", "result"} <= spec_phases),
+        ]
+        for what, ok in checks:
+            if not ok:
+                print(f"[chaos] FAIL [adaptive]: {what}",
+                      file=sys.stderr, flush=True)
+                failures += 1
+        print(f"[chaos] {'PASS' if not failures else 'FAIL'} "
+              f"[adaptive: skew/demote/coalesce/speculation sweep] "
+              f"{time.monotonic() - t0:.1f}s ({len(checks)} checks)",
+              flush=True)
+    return failures
+
+
 def _rows_match(rows, oracle):
     if [r["k"] for r in rows] != [r["k"] for r in oracle]:
         return False
@@ -598,7 +762,7 @@ def main() -> int:
                     help="wall-clock budget in seconds (hard exit 2)")
     args = ap.parse_args()
     n_workers = args.workers or (2 if args.quick else 3)
-    budget = args.budget or (300.0 if args.quick else 600.0)
+    budget = args.budget or (360.0 if args.quick else 660.0)
 
     # a hung barrier or lost abort would otherwise stall forever: the
     # watchdog turns "hang" into a loud, bounded failure
@@ -780,6 +944,8 @@ def main() -> int:
     failures += _roofline_check()
     # concurrent-serving leg: admission + budget slices + cancellation
     failures += _concurrency_check()
+    # adaptive-execution leg: skew/demote/coalesce/speculation sweep
+    failures += _adaptive_check()
     watchdog.cancel()
     print(f"[chaos] done in {time.monotonic() - t0:.1f}s, "
           f"{failures} failure(s)", flush=True)

@@ -162,6 +162,43 @@ def analyze_shuffles(records: List[dict]) -> Dict[Any, dict]:
     return out
 
 
+def analyze_adaptive(records: List[dict]) -> Optional[dict]:
+    """Adaptive-decision history: what the optimizer changed at stage
+    boundaries (AdaptivePlanChanged), which partitions were split
+    (SkewSplit) and speculation launches/outcomes (SpeculativeTask) —
+    the audit trail plan/adaptive.py emits, one event per decision."""
+    changes = [r for r in records
+               if r.get("event") == "AdaptivePlanChanged"]
+    splits = [r for r in records if r.get("event") == "SkewSplit"]
+    specs = [r for r in records if r.get("event") == "SpeculativeTask"]
+    if not (changes or splits or specs):
+        return None
+    by_rule: Dict[str, int] = {}
+    for c in changes:
+        rule = c.get("rule", "?")
+        by_rule[rule] = by_rule.get(rule, 0) + 1
+    launches = [s for s in specs if s.get("phase") == "launch"]
+    results = [s for s in specs if s.get("phase") == "result"]
+    return {
+        "plan_changes": len(changes),
+        "by_rule": by_rule,
+        "coalesced_partitions": sum(
+            max(c.get("partitions_before", 0)
+                - c.get("partitions_after", 0), 0) for c in changes),
+        "broadcast_demotions": sum(
+            1 for c in changes
+            if c.get("decision") == "broadcast_build"),
+        "skew_splits": [{"partition": s.get("partition"),
+                         "rows": s.get("rows"),
+                         "bytes": s.get("bytes"),
+                         "slices": s.get("slices")} for s in splits],
+        "speculation": {
+            "launched": len(launches),
+            "won": sum(1 for s in results if s.get("won")),
+            "lost": sum(1 for s in results if not s.get("won"))},
+    }
+
+
 def analyze_resources(records: List[dict]) -> Optional[dict]:
     samples = [r for r in records if r.get("event") == "ResourceSample"]
     if not samples:
@@ -295,7 +332,7 @@ def advise(jobs: List[dict], shuffles: Dict[Any, dict],
                        "srt.shuffle.fetch.maxRetries")
                       if fetch_retries else None})
 
-    # 5. straggler workers → repartition
+    # 5. straggler workers → repartition / speculate
     worst_spread = max((j["task_wall"]["spread"] for j in jobs),
                       default=0.0)
     rules.append({
@@ -304,9 +341,25 @@ def advise(jobs: List[dict], shuffles: Dict[Any, dict],
         "evidence": (f"slowest/fastest task wall = "
                      f"{worst_spread:.1f}x" if jobs
                      else "no cluster jobs"),
-        "suggestion": ("raise srt.shuffle.partitions so work "
-                       "redistributes, or check input file sharding")
+        "suggestion": ("enable srt.sql.adaptive.speculation.enabled "
+                       "(re-run straggler maps), raise "
+                       "srt.shuffle.partitions so work redistributes, "
+                       "or check input file sharding")
                       if worst_spread > 2.0 else None})
+
+    # 6. adaptive stood silent under measured skew → check its gates
+    adaptive = analyze_adaptive(records)
+    decided = bool(adaptive and adaptive["plan_changes"])
+    silent = ratio > 4.0 and not decided
+    rules.append({
+        "rule": "adaptive-coverage",
+        "triggered": silent,
+        "evidence": (f"{adaptive['plan_changes']} adaptive plan changes"
+                     if adaptive else "no adaptive decision events"),
+        "suggestion": ("skewed run with no adaptive decisions: check "
+                       "srt.sql.adaptive.enabled and the skewJoin/"
+                       "coalescePartitions thresholds") if silent
+                      else None})
     return rules
 
 
@@ -331,6 +384,7 @@ def build_report(log_dir: str) -> dict:
         "queries": queries,
         "jobs": jobs,
         "shuffles": {str(k): v for k, v in shuffles.items()},
+        "adaptive": analyze_adaptive(records),
         "resources": analyze_resources(records),
         "advisor": advise(jobs, shuffles, queries, records),
     }
@@ -384,6 +438,22 @@ def render(rep: dict) -> str:
                 f"maps={s['maps']} per-map p50={_fmt_bytes(mb['p50'])} "
                 f"p99={_fmt_bytes(mb['p99'])} "
                 f"skew={s['skew_ratio']:.1f}x")
+    ad = rep.get("adaptive")
+    if ad:
+        spec = ad["speculation"]
+        lines.append(
+            f"adaptive: {ad['plan_changes']} plan changes "
+            + " ".join(f"{k}={v}" for k, v in
+                       sorted(ad["by_rule"].items()))
+            + (f" coalesced={ad['coalesced_partitions']}"
+               if ad["coalesced_partitions"] else "")
+            + (f" speculation launched={spec['launched']} "
+               f"won={spec['won']}" if spec["launched"] else ""))
+        for s in ad["skew_splits"]:
+            lines.append(
+                f"  skew split: partition {s['partition']} "
+                f"rows={s['rows']} bytes={_fmt_bytes(s['bytes'] or 0)} "
+                f"-> {s['slices']} slices")
     res = rep.get("resources")
     if res:
         lines.append(f"resources: {res['samples']} samples from "
