@@ -73,6 +73,10 @@ Q1_BYTES_PER_ROW = 8 * 4 + 1 + 1 + 4
 # shipdate int32-date (customer/orders are ~1/10th the rows; the
 # effective-GB/s headline normalizes on lineitem like q6/q1)
 Q3_BYTES_PER_ROW = 8 * 3 + 4
+# mortgage ETL bytes per performance row touched on device:
+# loan_id int64 + current_upb float64 + days_delinquent int32
+# (acquisitions is 1/12th the rows; normalize on performance)
+MORTGAGE_BYTES_PER_ROW = 8 + 8 + 4
 
 
 def log(msg: str) -> None:
@@ -166,18 +170,21 @@ def embed_compile_ledger() -> None:
         log(f"compile ledger embed failed: {e}")
 
 
-def run_perf_gate() -> None:
-    """Report-only regression readout against the newest committed
-    BENCH_r*.json at the repo root (tools/perf_gate.py), printed to
-    stderr and embedded as RESULT["perf_gate"]. Report-only by design:
-    the gating exit code belongs to CI (``python tools/perf_gate.py
-    BASE NEW``), not to the bench emitting its own numbers."""
+def run_perf_gate() -> bool:
+    """Regression gate against the newest committed BENCH_r*.json at
+    the repo root (tools/perf_gate.py), printed to stderr and embedded
+    as RESULT["perf_gate"]. ENFORCING by default: a comparable baseline
+    with regressions beyond tolerance makes the bench exit non-zero
+    (after emitting the record, so the numbers are still inspectable).
+    ``SRT_BENCH_GATE=report`` opts back into report-only. Returns True
+    when the gate passes (or cannot compare)."""
+    enforce = os.environ.get("SRT_BENCH_GATE", "enforce") != "report"
     try:
         import glob
         here = os.path.dirname(os.path.abspath(__file__))
         prevs = sorted(glob.glob(os.path.join(here, "BENCH_r*.json")))
         if not prevs:
-            return
+            return True
         sys.path.insert(0, os.path.join(here, "tools"))
         import perf_gate
         base = perf_gate.load_bench(prevs[-1])
@@ -188,10 +195,17 @@ def run_perf_gate() -> None:
         RESULT["perf_gate"] = {
             "baseline": os.path.basename(prevs[-1]),
             "comparable": res["comparable"],
+            "enforcing": enforce,
             "regressions": [list(r) for r in res["regressions"]],
         }
-    except Exception as e:  # report-only: never fail the bench
+        if enforce and res["comparable"] and res["regressions"]:
+            log("perf gate: FAIL (enforcing; "
+                "SRT_BENCH_GATE=report to opt out)")
+            return False
+        return True
+    except Exception as e:  # infra failure is not a perf regression
         log(f"perf gate failed: {e}")
+        return True
 
 
 def dump_metrics_snapshot() -> None:
@@ -706,6 +720,8 @@ def main():
             RESULT["mortgage_etl_s"] = round(etl_s, 3)
             RESULT["mortgage_rows_s"] = round(perf_rows / etl_s / 1e6, 3)
             RESULT["mortgage_vs_baseline"] = round(c / etl_s, 3)
+            RESULT["mortgage_effective_gb_s"] = round(
+                perf_rows * MORTGAGE_BYTES_PER_ROW / etl_s / 1e9, 2)
             log(f"mortgage etl ({perf_rows} perf rows): {etl_s:.2f}s "
                 f"(pandas {c:.2f}s)")
             emit()
@@ -980,9 +996,11 @@ def main():
 
     embed_metrics()
     embed_compile_ledger()
-    run_perf_gate()
+    gate_ok = run_perf_gate()
     dump_metrics_snapshot()
     emit(final=True)
+    if not gate_ok:
+        sys.exit(3)
 
 
 if __name__ == "__main__":

@@ -17,7 +17,18 @@
 #include <cstring>
 
 #include <zlib.h>
+#if defined(__has_include) && __has_include(<zstd.h>)
 #include <zstd.h>
+#else
+// zstd dev headers absent; the runtime soname may still be present
+// (the build links it by path) -- declare the two stable simple-API
+// symbols we use.
+extern "C" {
+size_t ZSTD_decompress(void *dst, size_t dstCapacity, const void *src,
+                       size_t srcSize);
+unsigned ZSTD_isError(size_t code);
+}
+#endif
 
 namespace {
 

@@ -829,7 +829,49 @@ FUSION_DONATE = conf("srt.exec.fusion.donateInputs") \
          "instead of allocating fresh HBM. Applied only on non-CPU "
          "backends and only when the chain's source produces "
          "single-use buffers (file scans, not in-memory tables whose "
-         "batches are re-executed).") \
+         "batches are re-executed). For fused joins the probe batch is "
+         "donated only on capacity-measured relaunches, where the "
+         "launch is provably final and the batch provably dead.") \
+    .boolean(True)
+
+FUSION_JOINS = conf("srt.exec.fusion.joins") \
+    .doc("Hash-join fusion (fusion v2): compile build+probe plus the "
+         "filter/project/partial-aggregate suffix above the join into "
+         "one jitted program per probe batch, so the joined batch "
+         "never materializes in HBM between operators. The join node "
+         "keeps all of its own orchestration — broadcast demotion, "
+         "skew splits, sub-partitioning, bloom prefilter, DPP and "
+         "capacity-growth retries (plan/adaptive.py decisions apply "
+         "unchanged; only the per-pair program is swapped). Joins "
+         "with eager key expressions or a post-join condition stay "
+         "unfused.") \
+    .commonly_used().boolean(True)
+
+FUSION_FINAL_AGG = conf("srt.exec.fusion.finalAgg") \
+    .doc("FINAL-mode HashAggregate fusion (fusion v2): compile the "
+         "post-shuffle merge pass together with its upstream "
+         "coalesce/project — partial batches concatenate, project and "
+         "merge+finalize inside one jitted program instead of an "
+         "eager concat followed by a separate merge launch. Falls "
+         "back to an eager pre-concat above "
+         "srt.exec.fusion.finalAgg.maxMergeInputs batches.") \
+    .commonly_used().boolean(True)
+
+FUSION_MERGE_MAX_INPUTS = conf("srt.exec.fusion.finalAgg.maxMergeInputs") \
+    .doc("Largest number of partial batches handed to the fused "
+         "FINAL-merge program as separate arguments (each distinct "
+         "count is its own cached program signature). Above this the "
+         "batches are eagerly concatenated first and the single-input "
+         "fused program runs — correctness is unchanged, one extra "
+         "HBM materialization is paid.") \
+    .check(_positive).integer(8)
+
+FUSION_SORT = conf("srt.exec.fusion.sort") \
+    .doc("Sort-prefix fusion (fusion v2) for the out-of-core sorter "
+         "(exec/sort.py): chunk slicing + head-row extraction, "
+         "carry+chunk concat + key-extraction + local sort, and the "
+         "bound-row safe-prefix count each run as one jitted program "
+         "instead of eager kernel calls between separate launches.") \
     .boolean(True)
 
 OPTIMIZER_ENABLED = conf("srt.sql.optimizer.enabled") \

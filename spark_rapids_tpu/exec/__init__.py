@@ -13,7 +13,7 @@ from .basic import (BatchScanExec, CoalesceBatchesExec, ExpandExec,
                     FilterExec, LocalLimitExec, ProjectExec, RangeExec,
                     UnionExec)
 from .aggregate import HashAggregateExec
-from .fused import FusedPipelineExec
+from .fused import FusedHashJoinExec, FusedPipelineExec
 from .pipeline import PrefetchExec, PrefetchIterator
 from .sort import SortExec, SortOrder, TopNExec
 from .join import BroadcastHashJoinExec, ShuffledHashJoinExec
@@ -22,7 +22,8 @@ __all__ = [
     "ExecContext", "Metric", "TpuExec", "TpuSemaphore",
     "BatchScanExec", "CoalesceBatchesExec", "ExpandExec", "FilterExec",
     "LocalLimitExec", "ProjectExec", "RangeExec", "UnionExec",
-    "HashAggregateExec", "FusedPipelineExec", "PrefetchExec",
+    "HashAggregateExec", "FusedHashJoinExec", "FusedPipelineExec",
+    "PrefetchExec",
     "PrefetchIterator",
     "SortExec", "SortOrder", "TopNExec",
     "BroadcastHashJoinExec", "ShuffledHashJoinExec",

@@ -203,6 +203,65 @@ class HashAggregateExec(TpuExec):
             states.append(d)
         return key_cols, states
 
+    # --- FINAL-merge fusion (fusion v2, planner-armed) ---
+
+    #: list of fused-away upstream ProjectExecs (top-down order) when
+    #: plan/overrides.py armed merge fusion on this FINAL aggregate;
+    #: None keeps the stock eager-concat + _jit_merge path
+    _merge_fusion = None
+
+    def arm_merge_fusion(self, projs) -> None:
+        """plan/overrides.py hook: compile this FINAL aggregate's merge
+        pass together with the concat of its partition's partials (and
+        any projection prefix the planner absorbed) into one jitted
+        program (exec/fused.py _fused_merge_builder)."""
+        self._merge_fusion = list(projs)
+        self._fused_merge_cache = {}
+        from .fused import FUSION_STATS
+        FUSION_STATS["chains"] += 1
+        FUSION_STATS["stages"] += len(projs) + 1
+        FUSION_STATS["final_aggs"] += 1
+
+    def _fused_merge_fn(self, cap: int, with_prefix: bool = True):
+        from .fused import fused_final_merge_fn
+        key = (cap, with_prefix)
+        fn = self._fused_merge_cache.get(key)
+        if fn is None:
+            projs = list(reversed(self._merge_fusion)) \
+                if with_prefix else []
+            fn = fused_final_merge_fn(self, projs, cap)
+            self._fused_merge_cache[key] = fn
+        return fn
+
+    def _apply_merge_prefix(self, ctx: ExecContext,
+                            batch: ColumnarBatch) -> ColumnarBatch:
+        """Fused-away projection prefix applied eagerly — used where
+        the merge path must bucket by group key BEFORE merging (the
+        re-partition fallback's bucket split reads post-projection key
+        columns)."""
+        for p in reversed(self._merge_fusion):
+            with ctx.semaphore:
+                batch = p._jit(batch)
+        return batch
+
+    def _run_merge(self, ctx: ExecContext, batches, cap: int,
+                   with_prefix: bool = True) -> ColumnarBatch:
+        """Merge one held batch list: the fused concat+prefix+merge
+        program when armed (argument count bounded by
+        srt.exec.fusion.finalAgg.maxMergeInputs — past it an eager
+        pre-concat feeds the single-input program), the stock eager
+        concat + _jit_merge otherwise. Bit-identical either way: the
+        fused program is the literal composition of the same traced
+        functions."""
+        if self._merge_fusion is None:
+            merged_in = (batches[0] if len(batches) == 1
+                         else K.concat_batches(batches, cap))
+            return self._jit_merge(merged_in)
+        from ..conf import FUSION_MERGE_MAX_INPUTS
+        if len(batches) > ctx.conf.get(FUSION_MERGE_MAX_INPUTS):
+            batches = [K.concat_batches(batches, cap)]
+        return self._fused_merge_fn(cap, with_prefix)(*batches)
+
     # --- phase 2: merge partials + finalize ---
     def _merge_finalize(self, batch: ColumnarBatch) -> ColumnarBatch:
         key_cols, states = self._unpack(batch)
@@ -323,9 +382,7 @@ class HashAggregateExec(TpuExec):
             def merge_all():
                 batches = [sb.get() for sb in held]
                 with ctx.semaphore, NvtxTimer(agg_time, "agg.merge"):
-                    merged_in = (batches[0] if len(batches) == 1
-                                 else K.concat_batches(batches, cap))
-                    return self._jit_merge(merged_in)
+                    return self._run_merge(ctx, batches, cap)
             # RetryOOM mid-merge: spill + re-run (the merge is a pure
             # function of the held spillables — RmmRapidsRetryIterator
             # withRetryNoSplit contract)
@@ -371,6 +428,12 @@ class HashAggregateExec(TpuExec):
         try:
             for sb in held:
                 batch = sb.get()
+                if self._merge_fusion:
+                    # the bucket split reads post-projection key
+                    # columns, so an absorbed projection prefix must
+                    # land before bucketing (merge_bucket then runs the
+                    # prefix-free fused program)
+                    batch = self._apply_merge_prefix(ctx, batch)
                 for p in range(P):
                     with ctx.semaphore:
                         sub = split(batch, jnp.int32(p))
@@ -392,10 +455,8 @@ class HashAggregateExec(TpuExec):
                     batches = [b.get() for b in buckets[p]]
                     with ctx.semaphore, NvtxTimer(agg_time,
                                                   "agg.merge"):
-                        merged_in = (batches[0] if len(batches) == 1
-                                     else K.concat_batches(batches,
-                                                           cap))
-                        return self._jit_merge(merged_in)
+                        return self._run_merge(ctx, batches, cap,
+                                               with_prefix=False)
                 from ..memory.retry import with_retry_no_split
                 yield with_retry_no_split(merge_bucket)
                 for b in buckets[p]:

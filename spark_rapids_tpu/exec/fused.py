@@ -54,8 +54,10 @@ from ..ops import kernels as K
 from .base import ExecContext, Metric, NvtxTimer, Schema, TpuExec
 
 #: module-level fusion tally (bench reads this + the registry's
-#: per-module stats to report compile reuse across a sweep)
-FUSION_STATS = {"chains": 0, "stages": 0}
+#: per-module stats to report compile reuse across a sweep);
+#: joins/final_aggs/sorts are the v2 shapes layered on the v1 chains
+FUSION_STATS = {"chains": 0, "stages": 0, "joins": 0, "final_aggs": 0,
+                "sorts": 0}
 
 #: HashAggregateExec fields the fused terminal stage reads, in spec
 #: order (must stay in sync with the agg spec built in __init__)
@@ -131,6 +133,99 @@ def _fused_program_builder(specs):
             used = jnp.bool_(False)
         return packed, rows_in, used
     return run_agg
+
+
+def _fused_join_builder(join_type, probe_keys, build_keys, out_capacity,
+                        reorder_n, suffix_specs):
+    """MODULE-LEVEL builder for shared_fn_jit: one program running the
+    build+probe gather-map join, the left/right column reorder, and the
+    probe-side suffix chain (filter/project/partial-agg), so the joined
+    batch never materializes in HBM between operators.
+
+    Non-aggregate suffixes: ``run(probe, build) -> (batch, total)``.
+    Aggregate-terminated: ``run(probe, build, row_offset) ->
+    (packed, rows_in, pallas_used, total)``. ``total`` is the join
+    kernel's true required output size — the host only trusts the
+    suffix output when ``total <= out_capacity`` (the capacity-growth
+    contract of exec/join.py, unchanged by fusion)."""
+    from .join import _join_run_builder
+    base = _join_run_builder(join_type, list(probe_keys),
+                             list(build_keys), out_capacity)
+    specs = tuple(suffix_specs)
+    has_agg = bool(specs) and specs[-1][0] == "agg"
+    stage_fns = [_row_stage_fn(s) for s in
+                 (specs[:-1] if has_agg else specs)]
+
+    def reorder(out: ColumnarBatch) -> ColumnarBatch:
+        # kernel output is probe-then-build; plan output is
+        # left-then-right (same rule as _HashJoinBase._reorder_columns)
+        if reorder_n is None:
+            return out
+        cols = out.columns[reorder_n:] + out.columns[:reorder_n]
+        names = out.names[reorder_n:] + out.names[:reorder_n]
+        return ColumnarBatch(cols, names, out.num_rows)
+
+    if not has_agg:
+        def run(probe, build):
+            out, total = base(probe, build)
+            out = reorder(out)
+            for f in stage_fns:
+                out = f(out)
+            return out, total
+        return run
+    shell = _agg_shell(specs[-1])
+    use_pallas = bool(specs[-1][1])
+
+    def run_agg(probe, build, row_offset):
+        out, total = base(probe, build)
+        out = reorder(out)
+        for f in stage_fns:
+            out = f(out)
+        rows_in = out.num_rows
+        if use_pallas:
+            packed, used = shell._update_pallas(out, row_offset)
+        else:
+            packed = shell._update(out, row_offset)
+            used = jnp.bool_(False)
+        return packed, rows_in, used, total
+    return run_agg
+
+
+def _fused_merge_builder(prefix_specs, agg_spec, cap):
+    """MODULE-LEVEL builder for shared_fn_jit: the FINAL-merge fusion
+    program. ``run(*batches)`` concatenates one partition's packed
+    partials into ``cap`` slots, applies the projection prefix the
+    planner absorbed, and merges+finalizes — one program instead of an
+    eager concat followed by a separate merge launch. Each distinct
+    batch count is its own cached signature (callers bound it with
+    srt.exec.fusion.finalAgg.maxMergeInputs)."""
+    stage_fns = [_row_stage_fn(s) for s in tuple(prefix_specs)]
+    shell = _agg_shell(agg_spec)
+
+    def run(*batches):
+        b = batches[0] if len(batches) == 1 \
+            else K.concat_batches(list(batches), cap)
+        for f in stage_fns:
+            b = f(b)
+        return shell._merge_finalize(b)
+    return run
+
+
+def fused_final_merge_fn(agg, projs, cap: int):
+    """Shared fused FINAL-merge program for ``agg`` (exec/aggregate.py
+    calls this when the planner armed merge fusion). ``projs`` are the
+    fused-away ProjectExecs in application order (bottom-up)."""
+    prefix_specs = tuple(
+        ("project", tuple(p.exprs), tuple(n for n, _ in p.output_schema))
+        for p in projs)
+    # same spec layout as the v1 "agg" spec so _agg_shell applies
+    # (the pallas fields are dead in the merge pass)
+    agg_spec = ("agg", False, 0) + tuple(
+        tuple(getattr(agg, f)) for f in _AGG_FIELDS)
+    fn = shared_fn_jit(_fused_merge_builder, prefix_specs, agg_spec, cap)
+    _annotate(fn, "fused-final:concat+" + "project+" * len(projs)
+              + "merge[" + ", ".join(agg._key_names) + "]")
+    return fn
 
 
 def _schema_row_bytes(schema: Schema) -> int:
@@ -227,15 +322,29 @@ class FusedPipelineExec(TpuExec):
 
     # --- per-stage attribution (tracer-gated calibration) ---
     def _calibrate(self, ctx: ExecContext, batch: ColumnarBatch,
-                   row_offset: int, metrics) -> None:
+                   row_offset: int, metrics) -> bool:
         """Run the first batch stage-by-stage through the operators'
         own jitted functions, timing each with a device sync, and emit
         one ``fused:<Stage>`` span + metric per stage. This is the
         per-stage op-time attribution for the fused program (which is
         opaque to host timers); outputs are discarded — the stream's
         results always come from the fused program. Only runs when the
-        span tracer is on, and only once per execution."""
+        span tracer is on, and only once per execution.
+
+        Returns False — and emits no spans or metrics — when the batch
+        empties mid-chain: the unfused operators never charge op time
+        for stages an emptied batch would not reach (_partial_stream
+        and the Project/Filter loops all skip empty inputs), so
+        calibrating on it would skew fused-vs-unfused op-time
+        comparisons. The caller retries on the next batch."""
         import time as _time
+        cur = batch
+        for st in self.stages:
+            if st is self._agg:
+                break
+            cur = st._jit(cur)
+            if int(cur.num_rows) == 0:
+                return False
         parent = None
         for frame in reversed(ctx.timer_stack):
             sp = getattr(frame, "_span", None)
@@ -263,6 +372,7 @@ class FusedPipelineExec(TpuExec):
             mname = f"fusedStageTime.{i}.{type(st).__name__}"
             metrics.setdefault(
                 mname, Metric(mname, Metric.MODERATE, "ns")).add(ns)
+        return True
 
     def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
         from ..memory.retry import (split_spillable_in_half_by_rows,
@@ -308,8 +418,8 @@ class FusedPipelineExec(TpuExec):
             if int(batch.num_rows) == 0:
                 continue
             if not calibrated:
-                self._calibrate(ctx, batch, state["offset"], m)
-                calibrated = True
+                calibrated = self._calibrate(ctx, batch,
+                                             state["offset"], m)
             sb = SpillableBatch(batch, SpillPriority.ACTIVE_ON_DECK)
             for out in with_retry(
                     sb, run_one,
@@ -320,3 +430,250 @@ class FusedPipelineExec(TpuExec):
             pb = m.setdefault("pallasBatches",
                               Metric("pallasBatches", Metric.DEBUG))
             pb.add(sum(int(u) for u in used_flags))
+
+
+class FusedHashJoinExec(TpuExec):
+    """A planner-fused hash join plus its probe-side suffix chain
+    (fusion v2, shape (a): device-side hash-join fusion).
+
+    Wraps the ORIGINAL join node — ``children = [join]``, so every
+    tree walk (exchange-consumer counting, the adaptive stage
+    collector's parent checks, pipeline insertion) sees the join and
+    its exchanges unchanged — and arms it (``join._fusion = self``) so
+    the join's per-pair program is swapped for one jitted program
+    running build+probe join, column reorder, and the absorbed
+    filter/project/partial-agg suffix. Everything ELSE the join does
+    stays in the join: broadcast demotion, skew splits,
+    sub-partitioning, bloom prefilter, DPP and the capacity-growth
+    retry contract all apply unchanged, which is what keeps fusion
+    composable with every plan/adaptive.py decision — the decisions
+    re-evaluate at execute time, after any adaptive rewrite, never
+    before.
+
+    OOM handling mirrors FusedPipelineExec: each probe batch runs
+    under ``with_retry`` with the halve-by-rows split policy (sound
+    for every supported join type — the probe is the preserved side,
+    so probe-row chunks join independently). Donation: the probe batch
+    is donated only on a capacity-measured relaunch, where the
+    reported total makes the launch provably final and the batch
+    provably dead (a first launch may overflow and need the probe
+    again).
+    """
+
+    def __init__(self, join: TpuExec, suffix: List[TpuExec],
+                 use_pallas: bool = False, pallas_max_cap: int = 1 << 24,
+                 donate: bool = False):
+        super().__init__(join)
+        from .aggregate import HashAggregateExec
+        from .basic import FilterExec, ProjectExec
+        from .join import LEFT_ANTI, LEFT_SEMI
+        self.join = join
+        self.suffix = list(suffix)
+        terminal = self.suffix[-1]
+        self._agg = terminal if isinstance(terminal, HashAggregateExec) \
+            else None
+        self._use_pallas = bool(use_pallas and self._agg is not None)
+        self._schema = list(terminal.output_schema)
+        specs = []
+        for st in self.suffix:
+            if isinstance(st, FilterExec):
+                specs.append(("filter", st.condition))
+            elif isinstance(st, ProjectExec):
+                specs.append(("project", tuple(st.exprs),
+                              tuple(n for n, _ in st.output_schema)))
+            else:
+                specs.append(("agg", self._use_pallas,
+                              int(pallas_max_cap)) +
+                             tuple(tuple(getattr(st, f))
+                                   for f in _AGG_FIELDS))
+        self._suffix_specs = tuple(specs)
+        reorder = not (join.build_side == "right"
+                       or join.join_type in (LEFT_SEMI, LEFT_ANTI))
+        self._reorder_n = len(join.children[1].output_schema) \
+            if reorder else None
+        self.donate = bool(donate) and jax.default_backend() != "cpu"
+        self._fn_cache = {}
+        # bytes an unfused plan would materialize per capacity slot at
+        # the join output and every internal suffix boundary
+        self._saved_bytes_per_slot = (
+            _schema_row_bytes(join.output_schema) +
+            sum(_schema_row_bytes(st.output_schema)
+                for st in self.suffix[:-1]))
+        build_child = join.children[1] if join.build_side == "right" \
+            else join.children[0]
+        probe_child = join.children[0] if join.build_side == "right" \
+            else join.children[1]
+        self._label = ("fused-join:%s⋈%s -> %s [%s]" % (
+            type(build_child).__name__, type(probe_child).__name__,
+            " -> ".join(type(s).__name__ for s in self.suffix),
+            join.join_type))
+        self._exec_state = None
+        join._fusion = self
+        FUSION_STATS["chains"] += 1
+        FUSION_STATS["stages"] += len(self.suffix) + 1
+        FUSION_STATS["joins"] += 1
+
+    @property
+    def output_schema(self) -> Schema:
+        return self._schema
+
+    @property
+    def output_partitioning(self):
+        return self.suffix[-1].output_partitioning
+
+    def node_description(self) -> str:
+        tags = []
+        if self._use_pallas:
+            tags.append("pallas")
+        if self.donate:
+            tags.append("donate")
+        tag = f" ({', '.join(tags)})" if tags else ""
+        return (f"FusedHashJoin[{self.join.node_description()} -> "
+                + " -> ".join(type(s).__name__ for s in self.suffix)
+                + f"]{tag}")
+
+    def _fused_fn(self, out_cap: int, donate: bool):
+        key = (out_cap, donate)
+        fn = self._fn_cache.get(key)
+        if fn is None:
+            jit_kwargs = {"donate_argnums": (0,)} if donate else {}
+            fn = shared_fn_jit(
+                _fused_join_builder, self.join.join_type,
+                tuple(self.join._probe_key_exprs),
+                tuple(self.join._build_key_exprs),
+                out_cap, self._reorder_n, self._suffix_specs,
+                **jit_kwargs)
+            _annotate(fn, self._label)
+            self._fn_cache[key] = fn
+        return fn
+
+    # --- execute-time hooks the armed join calls back into ---
+
+    def fused_pairs(self, ctx: ExecContext, probe: ColumnarBatch,
+                    build: ColumnarBatch, retries: Metric
+                    ) -> Iterator[ColumnarBatch]:
+        """One probe batch against one build batch through the fused
+        program, with per-batch split-and-retry re-entry (the join's
+        _join_batches delegates here when armed)."""
+        from ..memory.retry import (split_spillable_in_half_by_rows,
+                                    with_retry)
+        from ..memory.spill import SpillableBatch, SpillPriority
+        st = self._exec_state
+
+        def run_one(psb):
+            pb = psb.get()
+            out = self._run_pair(ctx, pb, build, retries, st)
+            psb.close()
+            return out
+
+        sb = SpillableBatch(probe, SpillPriority.ACTIVE_ON_DECK)
+        for out in with_retry(
+                sb, run_one,
+                split_policy=split_spillable_in_half_by_rows):
+            if out is not None:
+                yield out
+
+    def _run_pair(self, ctx: ExecContext, probe: ColumnarBatch,
+                  build: ColumnarBatch, retries: Metric, st):
+        from ..columnar.vector import choose_capacity
+        from ..conf import JOIN_GROWTH_STEPS
+        n_probe = int(probe.num_rows)
+        max_steps = ctx.conf.get(JOIN_GROWTH_STEPS)
+        out_cap = choose_capacity(max(n_probe, 16))
+        measured = False
+        total = 0
+        for _ in range(max_steps + 1):
+            donate = self.donate and measured
+            fn = self._fused_fn(out_cap, donate)
+            with ctx.semaphore, NvtxTimer(st["fuse_time"], "fused-join"):
+                if self._agg is not None:
+                    out, rows_in, used, total = fn(
+                        probe, build, jnp.int64(st["offset"]))
+                else:
+                    out, total = fn(probe, build)
+            total = int(total)
+            if total <= out_cap:
+                st["saved"].add(self._saved_bytes_per_slot * out_cap)
+                if self._agg is None:
+                    return out
+                n_in = int(rows_in)
+                st["offset"] += n_in
+                if n_in == 0:
+                    # mirror the unfused partial aggregate: no partial
+                    # emitted for a pair that filtered down to nothing
+                    return None
+                if self._use_pallas:
+                    st["used"].append(used)
+                return out
+            if donate:
+                # the measured capacity makes a relaunch overflow a
+                # kernel contract violation — and the probe is gone
+                raise RuntimeError(
+                    "fused join under-reported its output size on a "
+                    "donated relaunch")
+            retries.add(1)
+            out_cap = choose_capacity(total)
+            measured = True
+        raise RuntimeError(
+            f"join expansion {total} exceeded capacity after "
+            f"{max_steps} growth steps")
+
+    def suffix_fallback(self, ctx: ExecContext, stream
+                        ) -> Iterator[ColumnarBatch]:
+        """Empty-build path: the join produced its passthrough /
+        null-extend batches eagerly (_empty_result_core), so run the
+        suffix through the operators' OWN jitted functions exactly as
+        the unfused plan would — same pallas-lane choice, same
+        row_offset threading, same empty-batch skips."""
+        st = self._exec_state
+        grouped_fn = self._agg._grouped_pallas_fn(ctx) \
+            if self._use_pallas and self._agg is not None else None
+        for batch in stream:
+            if int(batch.num_rows) == 0:
+                continue
+            cur = batch
+            emit = True
+            for stage in self.suffix:
+                if stage is self._agg:
+                    n_in = int(cur.num_rows)
+                    if n_in == 0:
+                        emit = False
+                        break
+                    with ctx.semaphore:
+                        if grouped_fn is not None:
+                            cur, used = grouped_fn(
+                                cur, jnp.int64(st["offset"]))
+                            st["used"].append(used)
+                        else:
+                            cur = stage._jit_update(
+                                cur, jnp.int64(st["offset"]))
+                    st["offset"] += n_in
+                else:
+                    with ctx.semaphore:
+                        cur = stage._jit(cur)
+            if emit:
+                yield cur
+
+    def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
+        m = ctx.metrics_for(self.exec_id)
+        m.setdefault("fusedOps",
+                     Metric("fusedOps", Metric.ESSENTIAL)).set(
+            len(self.suffix) + 1)
+        self._exec_state = {
+            "offset": 0,
+            "saved": m.setdefault(
+                "fusionBytesSaved",
+                Metric("fusionBytesSaved", Metric.ESSENTIAL, "B")),
+            "fuse_time": m.setdefault(
+                "fusedTime", Metric("fusedTime", Metric.MODERATE, "ns")),
+            "used": [],
+        }
+        try:
+            yield from self.children[0].execute(ctx)
+        finally:
+            st = self._exec_state
+            if st is not None and st["used"]:
+                pb = m.setdefault("pallasBatches",
+                                  Metric("pallasBatches", Metric.DEBUG))
+                pb.add(sum(int(u) for u in st["used"]))
+            self._exec_state = None

@@ -102,6 +102,13 @@ class _HashJoinBase(TpuExec):
     """Shared machinery: build-side materialization + per-probe-batch
     gather-map join with capacity retry."""
 
+    #: armed by exec/fused.py FusedHashJoinExec (plan/overrides.py
+    #: fusion pass): when set, the per-pair join program is the fused
+    #: join+suffix program; ALL orchestration around it (broadcast
+    #: demotion, skew splits, sub-partitioning, bloom, DPP, growth
+    #: retries) stays in this class unchanged
+    _fusion = None
+
     def __init__(self, left: TpuExec, right: TpuExec,
                  left_keys: Sequence[Expression],
                  right_keys: Sequence[Expression],
@@ -209,7 +216,17 @@ class _HashJoinBase(TpuExec):
 
     def _empty_result(self, probe_stream, ctx) -> Iterator[ColumnarBatch]:
         """Build side empty: inner/semi produce nothing; left-outer and
-        anti pass probe rows with null build columns."""
+        anti pass probe rows with null build columns. An armed fusion
+        runs its absorbed suffix over the passthrough batches (the
+        unfused plan's filter/project/agg would see them too)."""
+        stream = self._empty_result_core(probe_stream, ctx)
+        if self._fusion is not None and \
+                self._fusion._exec_state is not None:
+            stream = self._fusion.suffix_fallback(ctx, stream)
+        yield from stream
+
+    def _empty_result_core(self, probe_stream, ctx
+                           ) -> Iterator[ColumnarBatch]:
         jt = self.join_type
         if jt in (INNER, LEFT_SEMI):
             return
@@ -260,6 +277,22 @@ class _HashJoinBase(TpuExec):
         raise RuntimeError(
             f"join expansion {total} exceeded capacity after "
             f"{max_steps} growth steps")
+
+    def _join_batches(self, ctx: ExecContext, probe: ColumnarBatch,
+                      build: ColumnarBatch, retries: Metric
+                      ) -> Iterator[ColumnarBatch]:
+        """One probe batch against one build batch. Unfused: a single
+        capacity-retried gather-map join. When a FusedHashJoinExec
+        armed this node, the pair runs through the fused join+suffix
+        program with per-batch split-and-retry instead (possibly
+        several output batches, or none when an absorbed filter drops
+        everything)."""
+        if self._fusion is not None and \
+                self._fusion._exec_state is not None:
+            yield from self._fusion.fused_pairs(ctx, probe, build,
+                                                retries)
+            return
+        yield self._join_pair(ctx, probe, build, retries)
 
     def _split_fn(self, num_parts: int, side: str):
         """jit'd key-hash bucket filter (ops/kernels.py bucket_compact):
@@ -373,12 +406,12 @@ class _HashJoinBase(TpuExec):
                             chunk = self._jit_cache[ck](
                                 bucket_build, jnp.int32(ci * threshold))
                         for psb in probe_buckets[p]:
-                            yield self._join_pair(ctx, psb.get(), chunk,
-                                                  retries)
+                            yield from self._join_batches(
+                                ctx, psb.get(), chunk, retries)
                 else:
                     for psb in probe_buckets[p]:
-                        yield self._join_pair(ctx, psb.get(), bucket_build,
-                                              retries)
+                        yield from self._join_batches(
+                            ctx, psb.get(), bucket_build, retries)
                 for psb in probe_buckets[p]:
                     psb.close()
                 probe_buckets[p] = []
@@ -556,7 +589,7 @@ class _HashJoinBase(TpuExec):
         for probe in probe_stream:
             if int(probe.num_rows) == 0:
                 continue
-            yield self._join_pair(ctx, probe, build, retries)
+            yield from self._join_batches(ctx, probe, build, retries)
 
     def do_execute(self, ctx: ExecContext) -> Iterator[ColumnarBatch]:
         yield from self._join_partition(ctx, self._probe_stream(ctx),

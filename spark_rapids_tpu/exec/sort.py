@@ -30,6 +30,60 @@ from ..ops import kernels as K
 from .base import ExecContext, Metric, Schema, TpuExec
 
 
+def _concat_sort_builder(order, cap):
+    """MODULE-LEVEL builder for shared_fn_jit (fusion v2, sort-prefix
+    fusion): concat + key extraction + sort as ONE program — the
+    out-of-core merge step (carry + chunk) and the pending-head pick
+    both otherwise pay an eager concat that round-trips HBM before the
+    sort launch reads it back."""
+    def run(*batches):
+        b = batches[0] if len(batches) == 1 \
+            else K.concat_batches(list(batches), cap)
+        keys = [o.expr.eval(b) for o in order]
+        return K.sort_batch(b, keys,
+                            [o.ascending for o in order],
+                            [o.nulls_first for o in order])
+    return run
+
+
+def _chunk_head_builder(length, cap):
+    """MODULE-LEVEL builder for shared_fn_jit: slice one C-row chunk
+    out of a sorted run AND capture its head-row token (8-cap batch
+    with the __run tag column) in the same program."""
+    def run(run_b, start):
+        piece = K.slice_batch(run_b, start, length, cap)
+        head = K.slice_batch(piece, 0, 1, 8)
+        tag = ColumnVector(jnp.zeros(8, jnp.int32),
+                           live_mask(8, head.num_rows), dt.INT32)
+        head8 = ColumnarBatch(head.columns + [tag],
+                              head.names + ["__run"], head.num_rows)
+        return piece, head8
+    return run
+
+
+def _bound_prefix_builder(order):
+    """MODULE-LEVEL builder for shared_fn_jit: bound-row slice + safe-
+    prefix count in one program (the fused form of
+    _safe_prefix_builder — takes the sorted pending-heads batch and
+    slices its first row as the bound internally)."""
+    from ..parallel.partition import range_partition_ids
+
+    def run(mb, hs):
+        bb = K.slice_batch(hs, 0, 1, 8)
+        keys = [o.expr.eval(mb) for o in order]
+        bkeys = [o.expr.eval(bb) for o in order]
+        bkeys = [c.gather(jnp.zeros(1, jnp.int32),
+                          live_mask(1, bb.num_rows))
+                 if hasattr(c, "chars") else
+                 type(c)(c.data[:1], c.validity[:1], c.dtype)
+                 for c in bkeys]
+        pid = range_partition_ids(
+            keys, bkeys, [o.ascending for o in order],
+            [o.nulls_first for o in order])
+        return jnp.sum((pid == 0) & mb.live_mask()).astype(jnp.int32)
+    return run
+
+
 def _safe_prefix_builder(order):
     from ..parallel.partition import range_partition_ids
 
@@ -67,9 +121,53 @@ class SortExec(TpuExec):
         self.global_sort = global_sort
         from ..expr.misc import contains_eager
         # eager sort keys (ANSI guards) evaluate outside jit
-        self._jit_sort = self._sort_one \
-            if contains_eager([o.expr for o in self.order]) \
+        self._eager_keys = contains_eager([o.expr for o in self.order])
+        self._jit_sort = self._sort_one if self._eager_keys \
             else shared_method_jit(self, "_sort_one", ("order",))
+        self._fused_cache = {}
+
+    # --- sort-prefix fusion (fusion v2) ---
+
+    def _sort_fusion_on(self, ctx: ExecContext) -> bool:
+        from ..conf import FUSION_ENABLED, FUSION_SORT
+        return (not self._eager_keys
+                and ctx.conf.get(FUSION_ENABLED)
+                and ctx.conf.get(FUSION_SORT))
+
+    def _fused_concat_sort(self, cap: int):
+        """One-program concat+key-extraction+sort at ``cap`` slots."""
+        key = ("concat_sort", cap)
+        fn = self._fused_cache.get(key)
+        if fn is None:
+            fn = shared_fn_jit(_concat_sort_builder, self.order, cap)
+            from ..jit_registry import annotate
+            annotate(fn, "fused-sort:concat+sort[" + ", ".join(
+                repr(o.expr) for o in self.order) + "]")
+            from .fused import FUSION_STATS
+            FUSION_STATS["sorts"] += 1
+            self._fused_cache[key] = fn
+        return fn
+
+    def _fused_chunk_head(self, length: int, cap: int):
+        key = ("chunk_head", length, cap)
+        fn = self._fused_cache.get(key)
+        if fn is None:
+            fn = shared_fn_jit(_chunk_head_builder, length, cap)
+            from ..jit_registry import annotate
+            annotate(fn, "fused-sort:chunk+head")
+            self._fused_cache[key] = fn
+        return fn
+
+    def _fused_bound_prefix(self):
+        key = "bound_prefix"
+        fn = self._fused_cache.get(key)
+        if fn is None:
+            fn = shared_fn_jit(_bound_prefix_builder, self.order)
+            from ..jit_registry import annotate
+            annotate(fn, "fused-sort:safe-prefix[" + ", ".join(
+                repr(o.expr) for o in self.order) + "]")
+            self._fused_cache[key] = fn
+        return fn
 
     def _sort_one(self, batch: ColumnarBatch) -> ColumnarBatch:
         key_cols = [o.expr.eval(batch) for o in self.order]
@@ -123,9 +221,16 @@ class SortExec(TpuExec):
                 cap = choose_capacity(total)
                 batches = [sb.get() for sb in runs]
                 with ctx.semaphore:
-                    merged = (batches[0] if len(batches) == 1
-                              else K.concat_batches(batches, cap))
-                    yield self._jit_sort(merged)
+                    if self._sort_fusion_on(ctx) and 1 < len(batches) <= 16:
+                        # concat + key extraction + sort as one program
+                        # (each batch count is its own signature, so
+                        # bound the fan-in; bigger sets concat eagerly,
+                        # and a lone batch reuses the shared sort program)
+                        yield self._fused_concat_sort(cap)(*batches)
+                    else:
+                        merged = (batches[0] if len(batches) == 1
+                                  else K.concat_batches(batches, cap))
+                        yield self._jit_sort(merged)
                 return
             yield from self._ooc_merge(ctx, runs, budget)
         finally:
@@ -197,11 +302,18 @@ class SortExec(TpuExec):
         chunk_cap = choose_capacity(C)
         n = int(run.num_rows)
         parts, part_heads = [], []
+        fused = self._fused_chunk_head(C, chunk_cap) \
+            if self._sort_fusion_on(ctx) else None
         for start in range(0, max(n, 1), C):
             with ctx.semaphore:
-                piece = K.slice_batch(run, start, jnp.int32(C),
-                                      chunk_cap)
-                part_heads.append(self._head_row(piece, 0))
+                if fused is not None:
+                    # chunk slice + head-row token in one program
+                    piece, head = fused(run, jnp.int32(start))
+                    part_heads.append(head)
+                else:
+                    piece = K.slice_batch(run, start, jnp.int32(C),
+                                          chunk_cap)
+                    part_heads.append(self._head_row(piece, 0))
             parts.append(with_retry_no_split(
                 lambda p=piece: SpillableBatch(
                     p, SpillPriority.ACTIVE_ON_DECK)))
@@ -240,6 +352,17 @@ class SortExec(TpuExec):
             return [h if h is not None else self._dead_head(schema_like)
                     for h in heads]
 
+        fuse = self._sort_fusion_on(ctx)
+
+        def pick_heads() -> ColumnarBatch:
+            """Sorted pending-heads batch — fused concat+sort when on
+            (one program), eager concat + sort launch otherwise."""
+            with ctx.semaphore:
+                if fuse:
+                    return self._fused_concat_sort(8 * k)(*pending())
+                hb = K.concat_batches(pending(), 8 * k)
+                return self._jit_sort_heads(hb)
+
         try:
             while True:
                 live_heads = [h for h in heads if h is not None]
@@ -249,9 +372,7 @@ class SortExec(TpuExec):
                     return
                 # pick the run whose pending chunk head is smallest
                 # (device comparison — exact sort semantics)
-                with ctx.semaphore:
-                    hb = K.concat_batches(pending(), 8 * k)
-                    hs = self._jit_sort_heads(hb)
+                hs = pick_heads()
                 r = int(hs.column("__run").data[0])
                 i = next_chunk[r]
                 chunk = with_retry_no_split(chunks[r][i].get)
@@ -270,6 +391,9 @@ class SortExec(TpuExec):
                             return self._jit_sort(chunk)
                         cap = choose_capacity(
                             int(carry.num_rows) + int(chunk.num_rows))
+                        if fuse:
+                            return self._fused_concat_sort(cap)(
+                                carry, chunk)
                         return self._jit_sort(K.concat_batches(
                             [carry, chunk], cap))
                 merged = with_retry_no_split(merge_step)
@@ -278,11 +402,14 @@ class SortExec(TpuExec):
                 if not live_heads:
                     carry = merged
                     continue
+                hs = pick_heads()
                 with ctx.semaphore:
-                    hb = K.concat_batches(pending(), 8 * k)
-                    hs = self._jit_sort_heads(hb)
-                    bound = K.slice_batch(hs, 0, 1, 8)
-                    n_le = self._jit_safe_prefix(merged, bound)
+                    if fuse:
+                        # bound-row slice + prefix count, one program
+                        n_le = self._fused_bound_prefix()(merged, hs)
+                    else:
+                        bound = K.slice_batch(hs, 0, 1, 8)
+                        n_le = self._jit_safe_prefix(merged, bound)
                 n = int(n_le)
                 if n > 0:
                     with ctx.semaphore:

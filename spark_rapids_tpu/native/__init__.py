@@ -38,6 +38,27 @@ _LIB = None
 _LIB_LOCK = threading.Lock()
 
 
+def _zstd_link_args():
+    """Link zstd however this box provides it: ``-lzstd`` when the dev
+    package's unversioned symlink exists, else the runtime soname by
+    path (images often ship libzstd.so.1 without zstd-dev; the two
+    simple-API symbols we call are ABI-stable)."""
+    try:
+        out = subprocess.run(["ldconfig", "-p"], capture_output=True,
+                             text=True).stdout
+    except Exception:
+        return ["-lzstd"]
+    soname = None
+    for line in out.splitlines():
+        if "libzstd.so" not in line or "=>" not in line:
+            continue
+        path = line.split("=>")[-1].strip()
+        if path.endswith("libzstd.so"):
+            return ["-lzstd"]
+        soname = soname or path
+    return [soname] if soname else ["-lzstd"]
+
+
 def _build_lib() -> str:
     h = hashlib.sha256()
     for src in _SRCS:
@@ -50,7 +71,7 @@ def _build_lib() -> str:
         tmp = so + ".tmp"
         subprocess.run(
             ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp]
-            + _SRCS + ["-lz", "-lzstd"],
+            + _SRCS + ["-lz"] + _zstd_link_args(),
             check=True, capture_output=True)
         os.replace(tmp, so)
     return so

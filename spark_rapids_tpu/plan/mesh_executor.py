@@ -215,6 +215,14 @@ class MeshQueryExecutor:
             # chain (stage nodes keep their unfused child links)
             return self._lower(node.stages[-1])
 
+        from ..exec.fused import FusedHashJoinExec
+        if isinstance(node, FusedHashJoinExec):
+            # same story as FusedPipelineExec: the suffix nodes keep
+            # their original child links down to the wrapped join, so
+            # lowering the terminal suffix stage recovers the whole
+            # join+suffix chain inside the one mesh trace
+            return self._lower(node.suffix[-1])
+
         if isinstance(node, UnionExec):
             kids = [self._lower(c) for c in node.children]
 
@@ -371,6 +379,15 @@ class MeshQueryExecutor:
             child = self._lower(node.children[0])
             return lambda env: node._update(child(env), jnp.int64(0))
         if node.mode == FINAL:
+            # FINAL-merge fusion removed any project prefix from the
+            # tree (arm_merge_fusion); re-apply it here, bottom-up,
+            # before the merge — the mesh trace fuses it all anyway
+            prefix = list(reversed(node._merge_fusion or []))
+
+            def pre(b):
+                for p in prefix:
+                    b = p._project(b)
+                return b
             ex = node.children[0]
             if (not node.group_exprs and
                     isinstance(ex, ShuffleExchangeExec) and
@@ -382,12 +399,12 @@ class MeshQueryExecutor:
 
                 def global_fn(env):
                     gathered = all_gather_batch(inner(env), n, ax)
-                    return _mask_to_shard0(node._merge_finalize(gathered),
-                                           ax)
+                    return _mask_to_shard0(
+                        node._merge_finalize(pre(gathered)), ax)
                 return global_fn
             child = self._lower(ex) if isinstance(ex, ShuffleExchangeExec) \
                 else self._lower(node.children[0])
-            return lambda env: node._merge_finalize(child(env))
+            return lambda env: node._merge_finalize(pre(child(env)))
         # COMPLETE single-stage: update + merge locally is only correct
         # on one shard — require staged plans on mesh
         raise UnsupportedMeshLowering("complete-mode aggregate")

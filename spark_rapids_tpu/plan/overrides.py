@@ -19,9 +19,10 @@ from typing import Callable, Dict, List, Optional, Type
 from ..columnar import dtypes as dt
 from ..conf import (BROADCAST_THRESHOLD_ROWS, EXCHANGE_ENABLED, EXPLAIN,
                     FUSION_DONATE, FUSION_ENABLED, FUSION_EXCLUDE_EXECS,
-                    PALLAS_ENABLED, PALLAS_GROUP_MAX_CAPACITY,
-                    PALLAS_GROUPED_ENABLED, PIPELINE_ENABLED,
-                    SHUFFLE_PARTITIONS, SQL_ENABLED, SrtConf, active_conf)
+                    FUSION_FINAL_AGG, FUSION_JOINS, PALLAS_ENABLED,
+                    PALLAS_GROUP_MAX_CAPACITY, PALLAS_GROUPED_ENABLED,
+                    PIPELINE_ENABLED, SHUFFLE_PARTITIONS, SQL_ENABLED,
+                    SrtConf, active_conf)
 from ..exec.aggregate import HashAggregateExec
 from ..exec.base import TpuExec
 from ..exec.basic import (BatchScanExec, CoalesceBatchesExec, ExpandExec,
@@ -1150,12 +1151,33 @@ def _insert_fusion(root, conf: SrtConf):
     ``_pallas_stream_or_none`` keeps its direct Filter-child peek.
     When the grouped pallas lane is fully enabled the fused program
     uses ``_update_pallas`` as its terminal stage instead of the stock
-    update — pallas_agg as a fusable terminal."""
+    update — pallas_agg as a fusable terminal.
+
+    Fusion v2 extends the same matcher beyond linear scan chains:
+
+    - **hash-join fusion** (``srt.exec.fusion.joins``): a chain whose
+      ultimate source is a hash join wraps the join in a
+      FusedHashJoinExec — build+probe and the suffix compile into one
+      program per probe batch, while the join node keeps ALL of its
+      orchestration (adaptive demotion/skew splits, sub-partitioning,
+      bloom, DPP, growth retries). The matcher then keeps walking the
+      join's children, so scan chains on the exchanges' map sides
+      still fuse. Fusion arms at execute time through the join's
+      ``_fusion`` hook, which is what lets plan/adaptive.py decisions
+      re-evaluate after adaptive rewrites, never before.
+    - **FINAL-aggregate fusion** (``srt.exec.fusion.finalAgg``): a
+      FINAL HashAggregateExec whose child chain reaches its shuffle
+      exchange through only no-op coalesces and fusable projects is
+      armed (``arm_merge_fusion``) so the per-partition concat +
+      projection prefix + merge+finalize runs as one program.
+    - sort-prefix fusion (``srt.exec.fusion.sort``) needs no planner
+      work — exec/sort.py self-arms from the conf at execute time."""
     if not conf.get(FUSION_ENABLED):
         return root
     from ..exec import pallas_agg
-    from ..exec.aggregate import PARTIAL
-    from ..exec.fused import FusedPipelineExec
+    from ..exec.aggregate import FINAL, PARTIAL
+    from ..exec.fused import FusedHashJoinExec, FusedPipelineExec
+    from ..exec.join import _HashJoinBase
     from ..io.scan import FileSourceScanExec
     excludes = {s.strip() for s in
                 conf.get(FUSION_EXCLUDE_EXECS).split(",") if s.strip()}
@@ -1163,6 +1185,8 @@ def _insert_fusion(root, conf: SrtConf):
     grouped_conf = pallas_on and conf.get(PALLAS_GROUPED_ENABLED)
     donate_conf = conf.get(FUSION_DONATE)
     max_cap = conf.get(PALLAS_GROUP_MAX_CAPACITY)
+    join_conf = conf.get(FUSION_JOINS)
+    final_conf = conf.get(FUSION_FINAL_AGG)
 
     def stage_ok(n) -> bool:
         if type(n).__name__ in excludes:
@@ -1190,6 +1214,15 @@ def _insert_fusion(root, conf: SrtConf):
             n = n.children[0]
         return n
 
+    def join_ok(j) -> bool:
+        # a post-join condition or eager key expressions need the
+        # unfused host-side evaluation; an already-armed join never
+        # re-arms (idempotency)
+        return (type(j).__name__ not in excludes
+                and j.condition is None
+                and j._fusion is None
+                and not j._eager_keys())
+
     def try_fuse(n):
         stages = []
         cur = n
@@ -1201,11 +1234,9 @@ def _insert_fusion(root, conf: SrtConf):
         while stage_ok(cur):
             stages.append(cur)
             cur = cur.children[0]
-        if len(stages) < 2:
+        if not stages:
             return n
         src = through_noop_coalesce(cur)
-        if not isinstance(src, (BatchScanExec, FileSourceScanExec)):
-            return n
         stages.reverse()  # application order, bottom-up
         terminal = stages[-1]
         use_pallas = bool(
@@ -1213,20 +1244,64 @@ def _insert_fusion(root, conf: SrtConf):
             and terminal._pallas_grouped_gate
             and pallas_agg.grouped_lane_on()
             and pallas_agg.grouped_kernel_ok())
-        # donation is sound only when the source's buffers are
-        # single-use: file scans decode fresh arrays per run;
-        # BatchScanExec re-yields the same in-memory arrays on re-runs
-        donate = bool(donate_conf and isinstance(src, FileSourceScanExec))
-        return FusedPipelineExec(cur, stages, use_pallas=use_pallas,
-                                 pallas_max_cap=max_cap, donate=donate)
+        if len(stages) >= 2 and isinstance(src, (BatchScanExec,
+                                                 FileSourceScanExec)):
+            # donation is sound only when the source's buffers are
+            # single-use: file scans decode fresh arrays per run;
+            # BatchScanExec re-yields the same in-memory arrays on
+            # re-runs
+            donate = bool(donate_conf
+                          and isinstance(src, FileSourceScanExec))
+            return FusedPipelineExec(cur, stages, use_pallas=use_pallas,
+                                     pallas_max_cap=max_cap,
+                                     donate=donate)
+        if join_conf and isinstance(src, _HashJoinBase) and join_ok(src):
+            # a single suffix stage is already worth it (join+stage is
+            # two operators in one program); the no-op coalesce between
+            # join and suffix (if any) is dropped — the fused program
+            # consumes join pairs directly and re-batching boundaries
+            # carry no semantics the suffix observes
+            return FusedHashJoinExec(src, stages, use_pallas=use_pallas,
+                                     pallas_max_cap=max_cap,
+                                     donate=donate_conf)
+        return n
+
+    def try_fuse_final(a) -> None:
+        if not final_conf or type(a).__name__ in excludes or a._eager \
+                or a._merge_fusion is not None:
+            return
+        if _fusion_blocked_exprs(list(a.group_exprs) +
+                                 [fn for fn, _ in a.agg_exprs]):
+            return
+        from ..exec.exchange import ShuffleExchangeExec
+        projs = []
+        cur = through_noop_coalesce(a.children[0])
+        while isinstance(cur, ProjectExec) and stage_ok(cur):
+            projs.append(cur)
+            cur = through_noop_coalesce(cur.children[0])
+        if not isinstance(cur, ShuffleExchangeExec):
+            return
+        # arm the fused concat+prefix+merge program and rewire the agg
+        # straight onto its exchange (the absorbed coalesce/projects
+        # run inside the fused program; projs stay in top-down order)
+        a.arm_merge_fusion(projs)
+        a.children[0] = cur
 
     def walk(n):
         if isinstance(n, (HashAggregateExec, FilterExec, ProjectExec)):
             fused = try_fuse(n)
             if fused is not n:
-                # below the fused node only scan-ish sources remain
+                if isinstance(fused, FusedHashJoinExec):
+                    # keep walking below the join — the exchanges' map
+                    # sides hold fusable scan chains of their own
+                    kids = fused.join.children
+                    for i, c in enumerate(kids):
+                        kids[i] = walk(c)
+                # below a fused scan chain only scan-ish sources remain
                 # (scan, or no-op coalesce over scan) — nothing fusable
                 return fused
+        if isinstance(n, HashAggregateExec) and n.mode == FINAL:
+            try_fuse_final(n)
         kids = getattr(n, "children", None)
         if kids:
             for i, c in enumerate(kids):

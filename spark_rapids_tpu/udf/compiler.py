@@ -53,6 +53,13 @@ _COMPARE = {
     ">=": GreaterThanOrEqual, "==": EqualTo,
 }
 
+# Python <= 3.10 emits one opcode per operator instead of BINARY_OP
+_LEGACY_BINARY = {
+    "BINARY_ADD": "+", "BINARY_SUBTRACT": "-", "BINARY_MULTIPLY": "*",
+    "BINARY_TRUE_DIVIDE": "/", "BINARY_FLOOR_DIVIDE": "//",
+    "BINARY_MODULO": "%", "BINARY_POWER": "**",
+}
+
 # callables resolvable from globals/builtins
 _GLOBAL_FUNCS: Dict[object, Callable] = {
     abs: lambda a: Abs(a),
@@ -224,6 +231,25 @@ class _Compiler:
                     stack.append(_BINARY[sym](a, b))
                 else:
                     self._fail(ins)
+            elif op in _LEGACY_BINARY:  # <= 3.10
+                b = _to_expr(stack.pop())
+                a = _to_expr(stack.pop())
+                sym = _LEGACY_BINARY[op]
+                if sym == "**":
+                    stack.append(M.Pow(a, b))
+                elif _BINARY.get(sym) is not None:
+                    stack.append(_BINARY[sym](a, b))
+                else:
+                    self._fail(ins)
+            elif op == "CALL_FUNCTION":  # <= 3.10
+                argc = ins.argval
+                args = [stack.pop() for _ in range(argc)][::-1]
+                callee = stack.pop()
+                stack.append(self._call(ins, callee, args))
+            elif op == "DUP_TOP":  # <= 3.10
+                stack.append(stack[-1])
+            elif op == "ROT_TWO":  # <= 3.10
+                stack[-1], stack[-2] = stack[-2], stack[-1]
             elif op == "COMPARE_OP":
                 b = stack.pop()
                 a = stack.pop()

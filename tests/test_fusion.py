@@ -324,3 +324,224 @@ def test_fused_program_shared_across_identical_chains():
     assert after["entries"] == mid["entries"]
     # and both nodes produce identical output through the shared program
     assert collect(f1) == collect(f2)
+
+
+# --------------------------------------------------------------------------
+# fusion v2: hash-join fusion, FINAL-merge fusion, adaptive composition
+# --------------------------------------------------------------------------
+
+def _join_data(n=300, nkeys=20, seed=7):
+    rng = np.random.default_rng(seed)
+    fact = {"k": rng.integers(0, nkeys, n).tolist(),
+            "v": rng.integers(-50, 50, n).tolist()}
+    dim = {"dk": list(range(nkeys)),
+           "w": rng.integers(1, 5, nkeys).tolist()}
+    return fact, dim
+
+
+def _join_chain(fact, dim, agg=False, nbatches=3):
+    """fact ⋈ dim -> filter -> project [-> partial agg]."""
+    from spark_rapids_tpu.exec import ShuffledHashJoinExec
+    j = ShuffledHashJoinExec(scan(fact, nbatches=nbatches), scan(dim),
+                             [col("k")], [col("dk")])
+    f = FilterExec(j, col("v") > -20)
+    p = ProjectExec(f, [col("k"), (col("v") * col("w")).alias("vw")])
+    if not agg:
+        return p
+    return HashAggregateExec(p, [col("k")],
+                             [(Sum(col("vw")), "s"), (CountStar(), "n")],
+                             mode=PARTIAL)
+
+
+def test_fuse_join_suffix_chain():
+    from spark_rapids_tpu.exec import FusedHashJoinExec
+    fact, dim = _join_data()
+    root = _insert_fusion(_join_chain(fact, dim), SrtConf({}))
+    assert isinstance(root, FusedHashJoinExec)
+    assert root.join._fusion is root
+    assert [type(s).__name__ for s in root.suffix] == \
+        ["FilterExec", "ProjectExec"]
+    assert [n for n, _ in root.output_schema] == ["k", "vw"]
+    # conf opt-out leaves the join alone
+    off = _insert_fusion(
+        _join_chain(fact, dim),
+        SrtConf({"srt.exec.fusion.joins": "false"}))
+    assert not isinstance(off, FusedHashJoinExec)
+
+
+def test_fused_join_bit_identical_to_unfused():
+    from spark_rapids_tpu.exec import FusedHashJoinExec
+    fact, dim = _join_data(400)
+    unfused = collect(_join_chain(fact, dim))
+    fused = _insert_fusion(_join_chain(fact, dim), SrtConf({}))
+    assert isinstance(fused, FusedHashJoinExec)
+    assert collect(fused) == unfused
+
+
+def test_fused_join_agg_bit_identical():
+    from spark_rapids_tpu.exec import FusedHashJoinExec
+    fact, dim = _join_data(400)
+    baseline = collect(_join_chain(fact, dim, agg=True))
+    fused = _insert_fusion(_join_chain(fact, dim, agg=True), SrtConf({}))
+    assert isinstance(fused, FusedHashJoinExec)
+    assert _totals(collect(fused)) == _totals(baseline)
+
+
+def test_fused_join_split_and_retry_reenters():
+    """SplitAndRetryOOM on the first fused join launch must split the
+    probe batch and re-enter the fused program on each half."""
+    from spark_rapids_tpu.exec import FusedHashJoinExec
+    from spark_rapids_tpu.memory.budget import SplitAndRetryOOM
+    fact, dim = _join_data(400)
+    expected = collect(_join_chain(fact, dim, nbatches=1))
+    fused = _insert_fusion(_join_chain(fact, dim, nbatches=1),
+                           SrtConf({}))
+    assert isinstance(fused, FusedHashJoinExec)
+    real, armed = fused._run_pair, [True]
+
+    def flaky(*a, **k):
+        if armed[0]:
+            armed[0] = False
+            raise SplitAndRetryOOM("injected before fused join launch")
+        return real(*a, **k)
+    fused._run_pair = flaky
+
+    ctx = reset_task_context()
+    try:
+        got = collect(fused)
+    finally:
+        reset_task_context()
+    assert got == expected
+    assert ctx.split_count == 1
+
+
+def _session(extra=None):
+    from spark_rapids_tpu.plan.session import TpuSession
+    base = {"srt.shuffle.partitions": 4}
+    base.update(extra or {})
+    return TpuSession(SrtConf(base))
+
+
+def test_final_merge_fusion_bit_identical():
+    """Session-level join + FINAL aggregate + sort: fusion on (joins,
+    final-merge and sort-prefix programs all armed) must match fusion
+    off exactly, and the FINAL agg must actually be armed."""
+    from spark_rapids_tpu.expr.core import Alias
+    from spark_rapids_tpu.plan import overrides
+
+    def q(sess):
+        rng = np.random.default_rng(5)
+        n = 4000
+        fact = sess.create_dataframe({
+            "k": rng.integers(0, 30, n).tolist(),
+            "v": rng.integers(-100, 100, n).tolist()})
+        dim = sess.create_dataframe({
+            "dk": list(range(30)), "grp": [i % 7 for i in range(30)]})
+        return fact.join(dim, ([col("k")], [col("dk")]), how="inner") \
+            .filter(col("v") > -50) \
+            .group_by("grp").agg(Alias(Sum(col("v")), "sv"),
+                                 Alias(CountStar(), "c")) \
+            .sort("grp")
+
+    s_on = _session()
+    df_on = q(s_on)
+    phys = overrides.apply_overrides(df_on.plan, s_on.conf)
+
+    def armed_final(n):
+        if isinstance(n, HashAggregateExec) and n.mode == FINAL \
+                and n._merge_fusion is not None:
+            return True
+        kids = getattr(n, "children", [])
+        return any(armed_final(c) for c in kids)
+    assert armed_final(phys)
+    on = df_on.collect()
+    off = q(_session({"srt.exec.fusion.enabled": "false"})).collect()
+    assert on == off
+
+
+def test_adaptive_broadcast_demote_fusion_identical():
+    """Adaptive broadcast demotion must still fire under join fusion
+    (the decision re-evaluates at execute time, after the fused
+    wrapper armed the join) and results must match fusion off."""
+    from spark_rapids_tpu.exec.base import ExecContext
+    from spark_rapids_tpu.plan import overrides
+
+    def q(sess):
+        rng = np.random.default_rng(9)
+        fact = sess.create_dataframe({
+            "k": rng.integers(0, 30, 1500).tolist(),
+            "v": rng.integers(-50, 50, 1500).tolist()})
+        dim = sess.create_dataframe({
+            "dk": list(range(30)),
+            "w": [i * 3 for i in range(30)]})
+        return fact.join(dim, ([col("k")], [col("dk")]), how="inner") \
+            .filter(col("v") > -40)
+
+    def run(extra):
+        sess = _session({"srt.sql.broadcastRowThreshold": 1,
+                         "srt.sql.adaptive.autoBroadcastJoinRows": "1000",
+                         **extra})
+        df = q(sess)
+        phys = overrides.apply_overrides(df.plan, sess.conf)
+        ctx = ExecContext(sess.conf)
+        rows = []
+        for b in phys.execute(ctx):
+            d = batch_to_pydict(b)
+            rows.extend(sorted(zip(*(d[c] for c in sorted(d)))))
+        merged = {}
+        for em in ctx.metrics.values():
+            for name, metric in em.items():
+                merged[name] = merged.get(name, 0) + metric.value
+        return sorted(rows), merged
+
+    on_rows, on_m = run({})
+    off_rows, off_m = run({"srt.exec.fusion.enabled": "false"})
+    assert on_m.get("adaptiveBroadcastJoins", 0) == 1, on_m
+    assert off_m.get("adaptiveBroadcastJoins", 0) == 1, off_m
+    assert on_rows == off_rows
+
+
+def test_adaptive_skew_split_fusion_identical():
+    """Skew splits must still fire under join fusion and produce
+    bit-identical rows to the fusion-off run."""
+    from spark_rapids_tpu.exec.base import ExecContext
+    from spark_rapids_tpu.plan import overrides
+
+    def q(sess):
+        rng = np.random.default_rng(3)
+        keys = np.where(rng.random(6000) < 0.9, 7,
+                        rng.integers(0, 40, 6000))
+        fact = sess.create_dataframe({
+            "k": keys.tolist(),
+            "v": rng.integers(-50, 50, 6000).tolist()})
+        dim = sess.create_dataframe({
+            "dk": list(range(40)),
+            "w": [i * 2 for i in range(40)]})
+        return fact.join(dim, ([col("k")], [col("dk")]), how="inner") \
+            .filter(col("v") > -40)
+
+    def run(extra):
+        sess = _session({
+            "srt.shuffle.partitions": 8,
+            "srt.sql.broadcastRowThreshold": 1,
+            "srt.sql.adaptive.skewJoin.partitionRows": 500,
+            "srt.sql.adaptive.coalescePartitions.minPartitionRows": 1,
+            **extra})
+        df = q(sess)
+        phys = overrides.apply_overrides(df.plan, sess.conf)
+        ctx = ExecContext(sess.conf)
+        rows = []
+        for b in phys.execute(ctx):
+            d = batch_to_pydict(b)
+            rows.extend(sorted(zip(*(d[c] for c in sorted(d)))))
+        merged = {}
+        for em in ctx.metrics.values():
+            for name, metric in em.items():
+                merged[name] = merged.get(name, 0) + metric.value
+        return sorted(rows), merged
+
+    on_rows, on_m = run({})
+    off_rows, off_m = run({"srt.exec.fusion.enabled": "false"})
+    assert on_m.get("skewedJoinPartitions", 0) >= 1, on_m
+    assert off_m.get("skewedJoinPartitions", 0) >= 1, off_m
+    assert on_rows == off_rows

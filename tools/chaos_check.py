@@ -800,14 +800,23 @@ def main() -> int:
             "k": rng.integers(0, 40, n).tolist(),
             "v": rng.uniform(0, 10, n).tolist(),
         }).write.parquet(fact_dir)
+        dim_dir = os.path.join(tmp, "dim")
+        session.create_dataframe({
+            "k": list(range(40)),
+            "w": [float(1 + i % 3) for i in range(40)],
+        }).write.parquet(dim_dir)
 
         def logical(sess):
             # the filter keeps a scan -> filter -> partial-agg chain in
             # the plan so the fusion legs actually execute the fused
-            # path (exec/fused.py) under fault injection
-            return sess.read.parquet(fact_dir) \
-                .filter(col("v") < 8.0) \
-                .group_by("k").agg(Alias(Sum(col("v")), "s"),
+            # pipeline (exec/fused.py) under fault injection; the
+            # fact ⋈ dim join plus the FINAL merge above the shuffle
+            # exercise the v2 fused-join and fused-final-merge programs
+            # in the same sweep
+            fact = sess.read.parquet(fact_dir).filter(col("v") < 8.0)
+            dim = sess.read.parquet(dim_dir)
+            return fact.join(dim, on="k") \
+                .group_by("k").agg(Alias(Sum(col("v") * col("w")), "s"),
                                    Alias(CountStar(), "c")) \
                 .sort("k")
 
