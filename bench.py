@@ -43,7 +43,15 @@ disables srt.sql.adaptive.enabled for every engine session; "both"
 switches the NDS A/B dimension to adaptive, recording
 nds_adaptive_on_* / nds_adaptive_off_* per-leg keys plus the
 nds_adaptive_delta_pct common-query delta — adaptive takes the A/B
-slot over fusion when both ask for it).
+slot over fusion when both ask for it),
+SRT_BENCH_SHUFFLE=push|pull|both (push-based-shuffle A/B on a seeded
+skewed wide exchange at the transport layer: "pull" disables
+srt.shuffle.push.enabled for every engine session; "both" times the
+shuffle READ phase under eager push + per-reducer segments vs classic
+per-block pull, recording nds_shuffle_push_read_s /
+nds_shuffle_pull_read_s, per-partition fetch-latency p99s, the
+nds_shuffle_push_speedup ratio, and the zero-copy
+nds_shuffle_bytes_bypassed count from a local-session lane).
 """
 
 import json
@@ -542,6 +550,15 @@ def main():
         # SRT_BENCH_FUSION=off)
         _FUSION_EXTRA["srt.sql.adaptive.enabled"] = "false"
 
+    shuffle_mode = os.environ.get("SRT_BENCH_SHUFFLE", "push").lower()
+    if shuffle_mode not in ("push", "pull", "both"):
+        shuffle_mode = "push"
+    RESULT["shuffle_mode"] = shuffle_mode
+    if shuffle_mode == "pull":
+        # single-lane pull: every engine session runs with the eager
+        # push path disabled (classic fetch-on-demand shuffle)
+        _FUSION_EXTRA["srt.shuffle.push.enabled"] = "false"
+
     scale = int(os.environ.get("SRT_BENCH_SCALE", 0))
     if not scale:
         # the CPU fallback runs the same honest pipeline but ~50x
@@ -794,6 +811,152 @@ def main():
             emit()
         except Exception as e:
             log(f"adaptive skew join bench failed: {e}")
+
+    # --- push-shuffle A/B (shuffle-phase micro-bench): a seeded skewed
+    # wide exchange driven at the transport layer — two in-process
+    # manager+server nodes, every map's blocks written on both, then
+    # the READ phase (what a released reducer actually waits on) timed
+    # with eager push + per-reducer segment consolidation vs classic
+    # per-block pull. The in-process _LOCAL_ENDPOINTS short-circuit is
+    # narrowed to each reader's OWN endpoint during the fetch so the
+    # peer's blocks travel real sockets in both legs, matching the
+    # production topology. A local-session lane records the zero-copy
+    # bypass byte count.
+    if left("shuffle A/B", need=30):
+        try:
+            import numpy as np
+
+            from spark_rapids_tpu.columnar.vector import batch_from_pydict
+            from spark_rapids_tpu.conf import SrtConf
+            from spark_rapids_tpu.parallel import transport as _T
+            from spark_rapids_tpu.parallel.shuffle_manager import (
+                ShuffleManager, reset_shuffle_manager, shuffle_manager)
+            from spark_rapids_tpu.parallel.transport import (
+                ShuffleBlockServer, fetch_all_partitions)
+
+            n_maps, n_parts, base_rows = 12, 8, 20000
+            rng = np.random.default_rng(11)
+            vals = rng.uniform(0, 1, base_rows * 6)
+
+            def shuffle_leg(push_on):
+                conf = SrtConf({
+                    "srt.shuffle.mode": "MULTITHREADED",
+                    "srt.shuffle.push.enabled":
+                        "true" if push_on else "false"})
+                nodes = [ShuffleManager(conf) for _ in range(2)]
+                servers = [ShuffleBlockServer(m) for m in nodes]
+                eps = [srv.endpoint for srv in servers]
+                sid = 9100 + int(push_on)
+                lat, rows = [], 0
+                try:
+                    # map phase on both nodes; partition 0 is the hot
+                    # (6x) skew partition; push uploads each map's
+                    # blocks at completion, bounded by the in-flight
+                    # window, and drains before the "barrier"
+                    t0 = time.perf_counter()
+                    for w, mgr in enumerate(nodes):
+                        mgr.register_shuffle(sid, n_parts)
+                        route = {pp: eps[pp % 2] for pp in range(n_parts)}
+                        for m in range(n_maps):
+                            parts = [batch_from_pydict(
+                                {"v": vals[:base_rows * 6 if pp == 0
+                                           else base_rows].tolist()})
+                                for pp in range(n_parts)]
+                            mgr.write_map_output(sid, m, parts)
+                            if push_on:
+                                mgr.push_map_output(sid, m, route)
+                        if push_on:
+                            mgr.drain_pushes()
+                    write_s = time.perf_counter() - t0
+
+                    # read phase: each node fetches its owned
+                    # partitions from both endpoints; only the
+                    # reader's own endpoint may short-circuit. The
+                    # fetch is idempotent (segment snapshot + pull
+                    # with excludes), so best-of-3 like the headline
+                    # queries — one pass is too noisy on a shared box
+                    def read_pass():
+                        got_rows, pass_lat = 0, []
+                        t0 = time.perf_counter()
+                        for w, mgr in enumerate(nodes):
+                            _T._LOCAL_ENDPOINTS.clear()
+                            _T._LOCAL_ENDPOINTS[eps[w]] = mgr
+                            for pp in range(w, n_parts, 2):
+                                tf = time.perf_counter_ns()
+                                for b in fetch_all_partitions(
+                                        eps, sid, pp, manager=mgr):
+                                    got_rows += int(b.num_rows)
+                                pass_lat.append(
+                                    time.perf_counter_ns() - tf)
+                        return (time.perf_counter() - t0, pass_lat,
+                                got_rows)
+
+                    saved = dict(_T._LOCAL_ENDPOINTS)
+                    try:
+                        read_s, lat, rows = min(
+                            (read_pass() for _ in range(3)),
+                            key=lambda r: r[0])
+                    finally:
+                        _T._LOCAL_ENDPOINTS.clear()
+                        _T._LOCAL_ENDPOINTS.update(saved)
+                finally:
+                    for srv in servers:
+                        srv.close()
+                lat.sort()
+                p99 = lat[min(len(lat) - 1,
+                              max(0, int(len(lat) * 0.99)))]
+                return write_s, read_s, p99, rows
+
+            legs = {"push": [True], "pull": [False],
+                    "both": [True, False]}[shuffle_mode]
+            got = {}
+            for on in legs:
+                tag = "push" if on else "pull"
+                w_s, r_s, p99, rows = shuffle_leg(on)
+                got[tag] = (r_s, rows)
+                RESULT[f"nds_shuffle_{tag}_write_s"] = round(w_s, 4)
+                RESULT[f"nds_shuffle_{tag}_read_s"] = round(r_s, 4)
+                RESULT[f"nds_shuffle_{tag}_fetch_p99_ns"] = p99
+                log(f"shuffle [{tag}]: write={w_s:.3f}s "
+                    f"read={r_s:.3f}s p99={p99 / 1e6:.1f}ms "
+                    f"rows={rows}")
+            if len(got) == 2:
+                if got["push"][1] != got["pull"][1]:
+                    log(f"shuffle A/B DIVERGED: {got}")
+                else:
+                    RESULT["nds_shuffle_push_speedup"] = round(
+                        got["pull"][0] / got["push"][0], 3) \
+                        if got["push"][0] else 0.0
+                    log(f"shuffle A/B: push read is "
+                        f"{RESULT['nds_shuffle_push_speedup']}x pull")
+            # zero-copy lane: a local MULTITHREADED session under push
+            # hands live batches through the device catalog — count
+            # the bytes that skipped serialize/socket/deserialize
+            if shuffle_mode != "pull":
+                by_conf = SrtConf({
+                    "srt.shuffle.mode": "MULTITHREADED",
+                    "srt.shuffle.partitions": 4})
+                reset_shuffle_manager(by_conf)
+                try:
+                    from spark_rapids_tpu.expr.aggregates import Sum
+                    from spark_rapids_tpu.expr.core import Alias, col
+                    from spark_rapids_tpu.plan.session import TpuSession
+                    sess = TpuSession(by_conf)
+                    sess.create_dataframe({
+                        "k": [int(x) for x in rng.integers(0, 50, 20000)],
+                        "v": rng.uniform(0, 1, 20000).tolist(),
+                    }).group_by("k").agg(Alias(Sum(col("v")), "s")) \
+                        .collect()
+                    RESULT["nds_shuffle_bytes_bypassed"] = \
+                        shuffle_manager().bypassed_bytes
+                    log(f"shuffle local bypass: "
+                        f"{RESULT['nds_shuffle_bytes_bypassed']} bytes "
+                        f"zero-copy")
+                finally:
+                    reset_shuffle_manager()
+            emit()
+        except Exception as e:  # A/B must never kill the headline run
+            log(f"shuffle A/B failed: {e}")
 
     # --- NDS mini power-run (BASELINE config 2 breadth evidence):
     # the full 99-query suite swept once, total wall + per-query

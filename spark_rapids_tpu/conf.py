@@ -271,12 +271,41 @@ SORT_OOC_ROWS = conf("srt.sql.sort.oocRowBudget") \
          "out-of-core iterator of GpuSortExec.scala:242).") \
     .check(_positive).integer(1 << 22)
 
+_SHUFFLE_CODECS = ("NONE", "LZ4", "ZSTD")
+
 SHUFFLE_COMPRESS = conf("srt.shuffle.compression.codec") \
     .doc("Codec for serialized shuffle buffers: NONE, LZ4 (native "
          "codec), or ZSTD. "
          "(spark.rapids.shuffle.compression.codec, nvcomp LZ4 in the "
          "reference)") \
-    .check_values(["NONE", "LZ4", "ZSTD"]).string("NONE")
+    .check(lambda v: None if str(v).upper() in _SHUFFLE_CODECS
+           else f"unknown codec {v!r}; allowed (case-insensitive): "
+                f"{list(_SHUFFLE_CODECS)}").string("NONE")
+
+SHUFFLE_PUSH_ENABLED = conf("srt.shuffle.push.enabled") \
+    .doc("Push-based shuffle: map tasks eagerly push their compressed "
+         "blocks to the owning reducer's endpoint at map completion, "
+         "and the receiving side consolidates them into per-reducer "
+         "segments so a reduce read is one sequential scan plus a "
+         "pull of whatever was never pushed (the pull path is the "
+         "always-correct fallback). (Spark's push-based shuffle / "
+         "magnet role)") \
+    .commonly_used().boolean(True)
+
+SHUFFLE_PUSH_IN_FLIGHT_BYTES = conf("srt.shuffle.push.maxInFlightBytes") \
+    .doc("Per-endpoint cap on un-acknowledged pushed bytes; map tasks "
+         "block pushing to a slow reducer past this window so push "
+         "memory stays bounded regardless of fan-out "
+         "(BounceBufferManager role on the push side).") \
+    .check(_positive).bytes_(32 << 20)
+
+SHUFFLE_PUSH_LOCAL_BYPASS = conf("srt.shuffle.push.localBypass") \
+    .doc("Locality bypass: when producer and consumer share a process "
+         "(driver-local session; mesh-co-located partitions in MESH "
+         "mode) the live ColumnarBatch is handed through a zero-copy "
+         "local channel, skipping serializer+socket+deserializer. "
+         "Bypassed bytes are reported as shuffleBytesBypassed.") \
+    .boolean(True)
 
 ADAPTIVE_ENABLED = conf("srt.sql.adaptive.enabled") \
     .doc("Adaptive query execution: re-plan stages on runtime shuffle "
@@ -941,6 +970,12 @@ class SrtConf:
             if k.startswith("srt.") and k not in _REGISTRY:
                 raise KeyError(f"unknown config {k!r}; registered: "
                                f"{sorted(_REGISTRY)}")
+            if k in _REGISTRY and _REGISTRY[k].checker is not None:
+                # fail fast AT SET TIME, not at first read deep inside a
+                # query: run the entry's converter+checker now so e.g. an
+                # unknown srt.shuffle.compression.codec raises here with
+                # the allowed set in the message
+                _REGISTRY[k].get({k: self._settings[k]})
         for old, new in _DEPRECATED_ALIASES.items():
             if old not in self._settings:
                 continue

@@ -342,6 +342,8 @@ class ShuffleExchangeExec(TpuExec):
         # attempt's surviving blocks into this shuffle id, so freshly
         # re-executed shards must not collide with their map ids
         map_id = ctx.cluster.map_id_base if ctx.cluster is not None else 0
+        push_route = self._push_route(ctx, mgr, n_parts)
+        bypassed_before = getattr(mgr, "bypassed_bytes", 0)
         if self.sort_orders:
             # buffer spillable, sample bounds, then partition
             from ..memory.spill import SpillableBatch, SpillPriority
@@ -374,23 +376,72 @@ class ShuffleExchangeExec(TpuExec):
                             # holds ~1/P of the rows
                             parts = [K.compact_for_transfer(p)
                                      for p in fn(batch, bounds)]
-                        return mgr.write_map_output(self.shuffle_id,
-                                                    map_id, parts)
+                        return mgr.write_map_output(
+                            self.shuffle_id, map_id, parts,
+                            local_ok=ctx.cluster is None)
                     write_bytes.add(with_retry_no_split(write_one))
                     part_time.add(time.perf_counter_ns() - t0)
                     write_rows.add(int(batch.num_rows))
+                    if push_route is not None:
+                        mgr.push_map_output(self.shuffle_id, map_id,
+                                            push_route,
+                                            who=self._push_who(ctx))
                     self._own_map_ids.append(map_id)
                     map_id += 1
             finally:
                 for sb in held:
                     sb.close()
+            self._finish_write(ctx, mgr, push_route, bypassed_before)
             return
         self._own_map_ids.extend(
             self._run_map_loop(ctx, mgr, n_parts, map_id,
-                               self.children[0]))
+                               self.children[0], push_route=push_route))
+        self._finish_write(ctx, mgr, push_route, bypassed_before)
+
+    def _push_route(self, ctx: ExecContext, mgr,
+                    n_parts: int) -> Optional[dict]:
+        """reduce partition -> owning endpoint, when push-based shuffle
+        applies to this exchange: cluster mode, the manager's push path
+        on, and the planner's ``_push_ok`` tag present (overrides tags
+        every planned shuffle exchange; hand-built plans opt in
+        explicitly). Routing is BEST-EFFORT — AQE may later coalesce or
+        skew-split partitions across different readers, in which case a
+        mispredicted push just idles in a segment nobody reads and the
+        pull path serves the real reader."""
+        if (ctx.cluster is None
+                or not getattr(mgr, "push_enabled", False)
+                or not getattr(self, "_push_ok", False)):
+            return None
+        try:
+            return ctx.cluster.partition_owners(n_parts)
+        except Exception:
+            return None  # no assignment info: pull covers everything
+
+    @staticmethod
+    def _push_who(ctx: ExecContext) -> str:
+        """Stable sender label for the ``push.send`` fault site, so a
+        chaos plan can address exactly one worker's push path (ports
+        are random; worker ids are not)."""
+        return (f"w={ctx.cluster.worker_id}"
+                if ctx.cluster is not None else "w=local")
+
+    def _finish_write(self, ctx: ExecContext, mgr, push_route,
+                      bypassed_before: int) -> None:
+        """Map phase epilogue: drain in-flight pushes BEFORE the stage
+        barrier can release readers, and report bytes that took the
+        zero-copy local channel."""
+        if push_route is not None:
+            mgr.drain_pushes()
+        bypassed = getattr(mgr, "bypassed_bytes", 0) - bypassed_before
+        if bypassed > 0:
+            m = ctx.metrics_for(self.exec_id)
+            m.setdefault("shuffleBytesBypassed",
+                         Metric("shuffleBytesBypassed",
+                                Metric.ESSENTIAL, "B")).add(bypassed)
 
     def _run_map_loop(self, ctx: ExecContext, mgr, n_parts: int,
-                      map_id: int, child: TpuExec) -> List[int]:
+                      map_id: int, child: TpuExec,
+                      push_route: Optional[dict] = None) -> List[int]:
         """Drain ``child``, partition every batch, write blocks under
         ascending map ids from ``map_id``; returns the ids written.
         Shared by the normal (non-range) map phase and speculative
@@ -422,13 +473,20 @@ class ShuffleExchangeExec(TpuExec):
                     fn = self._partition_fn(n_parts)
                     parts = [K.compact_for_transfer(p)
                              for p in fn(b)]
-                wrote = mgr.write_map_output(self.shuffle_id, map_id,
-                                             parts)
+                wrote = mgr.write_map_output(
+                    self.shuffle_id, map_id, parts,
+                    local_ok=ctx.cluster is None)
                 return int(b.num_rows), wrote
             rows_written, bytes_written = with_retry_no_split(write_one)
             part_time.add(time.perf_counter_ns() - t0)
             write_rows.add(rows_written)
             write_bytes.add(bytes_written)
+            if push_route is not None:
+                # eager push at map completion: this map's blocks start
+                # uploading to their reducers while the next batch is
+                # still computing
+                mgr.push_map_output(self.shuffle_id, map_id, push_route,
+                                    who=self._push_who(ctx))
             written.append(map_id)
             map_id += 1
         return written
@@ -449,8 +507,16 @@ class ShuffleExchangeExec(TpuExec):
         mgr = self.manager or shuffle_manager()
         n_parts = self._effective_parts(ctx)
         mgr.register_shuffle(self.shuffle_id, n_parts)
-        return self._run_map_loop(ctx, mgr, n_parts, map_id_base,
-                                  self.children[0])
+        push_route = self._push_route(ctx, mgr, n_parts)
+        written = self._run_map_loop(ctx, mgr, n_parts, map_id_base,
+                                     self.children[0],
+                                     push_route=push_route)
+        if push_route is not None:
+            # speculative pushes drain before the result reports: the
+            # winners filter applies at segment-index granularity, so a
+            # losing worker's pushed entries are simply never consumed
+            mgr.drain_pushes()
+        return written
 
     def _release(self, mgr) -> None:
         """One consumer finished a full drain. Shared subtrees (the two
@@ -582,6 +648,26 @@ class ShuffleExchangeExec(TpuExec):
                 groups.append(cur)
         return groups
 
+    def _fetch_metrics_cb(self, ctx: ExecContext):
+        """Per-source read attribution: segment (pushed + consolidated
+        locally), local (self-endpoint short-circuit, no socket), or
+        remote (pulled over the wire)."""
+        m = ctx.metrics_for(self.exec_id)
+        counters = {
+            kind: m.setdefault(name, Metric(name, Metric.MODERATE))
+            for kind, name in (("segment", "shuffleSegmentBlocksRead"),
+                               ("local", "shuffleLocalBlocksRead"),
+                               ("remote", "shuffleRemoteBlocksRead"))}
+        fetched = m.setdefault("shuffleBytesFetched",
+                               Metric("shuffleBytesFetched",
+                                      Metric.MODERATE, "B"))
+
+        def on_block(kind: str, nbytes: int) -> None:
+            counters[kind].add(1)
+            if kind == "remote":
+                fetched.add(nbytes)
+        return on_block
+
     def _maybe_prefetch(self, ctx: ExecContext, factory, name: str):
         """Read-side pipelining (RapidsShuffleIterator fetch-ahead
         role): pull one reduce partition's block stream — fetch,
@@ -594,7 +680,15 @@ class ShuffleExchangeExec(TpuExec):
         from .pipeline import pipeline_enabled, prefetch_batches
         if not pipeline_enabled(ctx, self):
             return factory()
-        return prefetch_batches(ctx, self, factory, name=name)
+        mgr = self.manager or shuffle_manager()
+        # locality bypass may hand LIVE manager-owned batches through
+        # this stream — don't re-wrap them as spillables (double
+        # memory accounting; a queue discard would close a batch the
+        # manager still serves to replays)
+        stage = not (ctx.cluster is None
+                     and getattr(mgr, "push_enabled", False)
+                     and getattr(mgr, "local_bypass", False))
+        return prefetch_batches(ctx, self, factory, name=name, stage=stage)
 
     def execute_partition_groups(self, ctx: ExecContext,
                                  groups: List[List[int]],
@@ -624,6 +718,7 @@ class ShuffleExchangeExec(TpuExec):
             peers = ctx.cluster.peers
             resolver = ctx.cluster.resolve_endpoint
             dsid = getattr(self, "_downstream_sid", None)
+            on_block = self._fetch_metrics_cb(ctx)
 
             def remote_group(gi, g):
                 mm = (map_mod or {}).get(gi)
@@ -631,7 +726,8 @@ class ShuffleExchangeExec(TpuExec):
                     ctx.partition_id = reduce_id
                     yield from fetch_all_partitions(
                         peers, self.shuffle_id, reduce_id, map_mod=mm,
-                        endpoint_resolver=resolver, allowed=allowed)
+                        endpoint_resolver=resolver, allowed=allowed,
+                        manager=mgr, metrics_cb=on_block)
             for gi in ctx.cluster.assigned(len(groups), dsid):
                 yield self._maybe_prefetch(
                     ctx, lambda _gi=gi: remote_group(_gi, groups[_gi]),
@@ -673,13 +769,16 @@ class ShuffleExchangeExec(TpuExec):
             peers = ctx.cluster.peers
             resolver = ctx.cluster.resolve_endpoint
             dsid = getattr(self, "_downstream_sid", None)
+            on_block = self._fetch_metrics_cb(ctx)
 
             def remote_read(reduce_id):
                 ctx.partition_id = reduce_id
                 yield from fetch_all_partitions(peers, self.shuffle_id,
                                                 reduce_id,
                                                 endpoint_resolver=resolver,
-                                                allowed=allowed)
+                                                allowed=allowed,
+                                                manager=mgr,
+                                                metrics_cb=on_block)
             for reduce_id in ctx.cluster.assigned(n_parts, dsid):
                 yield self._maybe_prefetch(
                     ctx, lambda rid=reduce_id: remote_read(rid),

@@ -365,9 +365,31 @@ def pipeline_enabled(ctx: ExecContext, node=None) -> bool:
     return True
 
 
+class _Unstaged:
+    """Queue-slot shim matching SpillableBatch's get/close/nbytes
+    surface WITHOUT taking a spill-catalog registration or ownership.
+    Used for zero-copy shuffle-bypass streams: those batches are live
+    objects the shuffle manager still owns (already spill-registered in
+    its device catalog), so re-wrapping would double-account the bytes
+    and a queue discard would close a batch other readers may replay.
+    """
+
+    __slots__ = ("_batch", "nbytes")
+
+    def __init__(self, batch):
+        self._batch = batch
+        self.nbytes = int(getattr(batch, "nbytes", 0))
+
+    def get(self):
+        return self._batch
+
+    def close(self) -> None:
+        pass
+
+
 def prefetch_batches(ctx: ExecContext, node: TpuExec,
                      source_factory: Callable[[], Iterable],
-                     name: str = "") -> Iterator:
+                     name: str = "", stage: bool = True) -> Iterator:
     """Pull a ColumnarBatch stream through a background prefetcher.
 
     Each produced batch registers with the spill catalog as an
@@ -377,6 +399,11 @@ def prefetch_batches(ctx: ExecContext, node: TpuExec,
     releases the registration before yielding. Metrics land on
     ``node``: prefetchWaitTime (consumer blocked on an empty queue),
     prefetchQueueDepthPeak, prefetchBytesPeak.
+
+    ``stage=False`` skips the SpillableBatch wrap — for streams that
+    may hand through ALREADY-owned live batches (the shuffle locality
+    bypass), where a second registration would double-count memory and
+    discard-on-close would free somebody else's batch.
     """
     from ..memory.spill import SpillableBatch, SpillPriority
     m = ctx.metrics_for(node.exec_id)
@@ -389,9 +416,10 @@ def prefetch_batches(ctx: ExecContext, node: TpuExec,
     leaks = m.setdefault("prefetchThreadLeaks",
                          Metric("prefetchThreadLeaks", Metric.ESSENTIAL))
 
-    def staged() -> Iterator[SpillableBatch]:
+    def staged() -> Iterator:
         for batch in source_factory():
-            yield SpillableBatch(batch, SpillPriority.ACTIVE_ON_DECK)
+            yield SpillableBatch(batch, SpillPriority.ACTIVE_ON_DECK) \
+                if stage else _Unstaged(batch)
 
     # capture the enclosing operator span NOW, on the consumer thread:
     # the nearest timed frame with a live span, else the thread's open

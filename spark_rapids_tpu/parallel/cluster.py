@@ -130,7 +130,8 @@ class ClusterTaskContext:
                  logical_ids: Optional[List[int]] = None,
                  fresh_ids: Optional[List[int]] = None,
                  shard_mod: Optional[int] = None,
-                 map_id_base: int = 0, attempt: int = 0):
+                 map_id_base: int = 0, attempt: int = 0,
+                 assign: Optional[List[List[int]]] = None):
         self.worker_id = worker_id
         self.num_workers = num_workers
         self.peers = peers  # shuffle endpoints "host:port", worker order
@@ -140,6 +141,12 @@ class ClusterTaskContext:
         self.fresh_ids = (sorted(fresh_ids) if fresh_ids is not None
                           else list(self.logical_ids))
         self.shard_mod = shard_mod if shard_mod is not None else num_workers
+        #: the FULL logical-id assignment of this attempt (one list per
+        #: physical worker, same order as ``peers``) — lets the map side
+        #: predict which endpoint will read each reduce partition (the
+        #: push-based shuffle's routing table)
+        self.assign = ([list(a) for a in assign] if assign is not None
+                       else [[w] for w in range(num_workers)])
         self.map_id_base = map_id_base
         self.attempt = attempt
         #: shuffle ids (THIS attempt's) whose map outputs were reused
@@ -183,6 +190,23 @@ class ClusterTaskContext:
             hi = (num_partitions * (lid + 1)) // self.shard_mod
             out.update(range(lo, hi))
         return sorted(out)
+
+    def partition_owners(self, num_partitions: int) -> Dict[int, str]:
+        """reduce partition -> the endpoint expected to READ it, from
+        the attempt's full logical-id assignment (same contiguous-range
+        arithmetic as ``assigned``). Best-effort by construction: AQE
+        may coalesce or skew-split partitions afterwards, so push
+        consumers treat a miss as 'pull it instead', never an error."""
+        owners: Dict[int, str] = {}
+        for w, lids in enumerate(self.assign):
+            if w >= len(self.peers):
+                break
+            for lid in lids:
+                lo = (num_partitions * lid) // self.shard_mod
+                hi = (num_partitions * (lid + 1)) // self.shard_mod
+                for r in range(lo, hi):
+                    owners[r] = self.peers[w]
+        return owners
 
     def owns_first(self) -> bool:
         return self.worker_id == 0
@@ -643,7 +667,8 @@ class ClusterWorker:
             self.driver_addr, logical_ids=logical_ids,
             fresh_ids=fresh_ids if fresh_ids is not None else logical_ids,
             shard_mod=msg.get("shard_mod") or msg["num_workers"],
-            map_id_base=msg.get("map_id_base", 0), attempt=attempt)
+            map_id_base=msg.get("map_id_base", 0), attempt=attempt,
+            assign=msg.get("assign"))
         fault_point("cluster.job",
                     f"attempt={attempt};workers={cluster.lids_csv()};")
         physical = overrides.apply_overrides(logical, conf)
@@ -786,7 +811,8 @@ class ClusterWorker:
                 cluster.worker_id, cluster.num_workers, cluster.peers,
                 cluster.driver_addr, logical_ids=list(unit_lids),
                 shard_mod=cluster.shard_mod,
-                map_id_base=base, attempt=cluster.attempt)
+                map_id_base=base, attempt=cluster.attempt,
+                assign=cluster.assign)
             _shard_scans(ex, cluster.worker_id, cluster.num_workers,
                          spec_cluster)
             sctx = ExecContext(conf, query=qctx)
@@ -1395,6 +1421,7 @@ class ClusterDriver:
                                      "attempt": attempt,
                                      "logical_ids": assign[w],
                                      "fresh_ids": fresh[w],
+                                     "assign": assign,
                                      "shard_mod": shard_mod,
                                      "map_id_base": attempt << 20,
                                      "reusable_positions": reusable,

@@ -21,8 +21,8 @@ makes the format independent of either side's capacity choices.
 
 from __future__ import annotations
 
-import io
 import struct
+import threading
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -56,47 +56,91 @@ def _tag_dtype(tag: str) -> dt.DType:
     raise ValueError(f"unknown dtype tag {tag!r}")
 
 
+_FALLBACK_LOCK = threading.Lock()
+_FALLBACK_WARNED: set = set()  # requested codecs already warned about
+
+
+def _warn_fallback(requested: str, used: str, err: Exception) -> None:
+    with _FALLBACK_LOCK:
+        if requested in _FALLBACK_WARNED:
+            return
+        _FALLBACK_WARNED.add(requested)
+    import warnings
+    warnings.warn(
+        f"srt.shuffle.compression.codec={requested} requested but that "
+        f"codec is unavailable here ({err!r}); using {used} for this "
+        "process", RuntimeWarning)
+
+
+def _compress_body(body: bytes, codec: str) -> Tuple[bytes, int]:
+    """Compress with the requested codec, falling back (with a
+    once-per-process warning) LZ4 -> zstd -> uncompressed when the
+    native extension / module is absent. Returns (bytes, flag); the
+    flag self-describes the wire bytes, so the receiving side never
+    needs to know the sender fell back."""
+    last: Optional[Exception] = None
+    order = ("lz4", "zstd") if codec == "lz4" else ("zstd", "lz4")
+    for attempt in order:
+        try:
+            if attempt == "lz4":
+                from ..native import lz4_compress
+                out, flag = lz4_compress(body), FLAG_LZ4
+            else:
+                import zstandard
+                out = zstandard.ZstdCompressor(level=1).compress(body)
+                flag = FLAG_ZSTD
+        except Exception as e:
+            last = e
+            continue
+        if attempt != codec:
+            _warn_fallback(codec, attempt, last)
+        return out, flag
+    _warn_fallback(codec, "no compression", last)
+    return body, 0
+
+
 def serialize_batch(batch: ColumnarBatch, compress: bool = False,
                     codec: str = "zstd") -> bytes:
     n = int(batch.num_rows)
     flags = 0
-    if compress:
-        flags = FLAG_LZ4 if codec.lower() == "lz4" else FLAG_ZSTD
-    head = io.BytesIO()
-    head.write(struct.pack("<IHHII", MAGIC, VERSION, flags, n,
-                           batch.num_columns))
-    payload = io.BytesIO()
+    # header and payload build as lists of bytes-like parts joined ONCE
+    # at the end — no intermediate io.BytesIO copy of the (potentially
+    # large) column data; numpy buffer exports stay zero-copy until the
+    # single join
+    head: List[bytes] = [struct.pack("<IHHII", MAGIC, VERSION, flags, n,
+                                     batch.num_columns)]
+    parts: List[bytes] = []
     for name, col in zip(batch.names, batch.columns):
         nb = name.encode("utf-8")
         tag = _dtype_tag(col.dtype).encode("utf-8")
         kind = 1 if isinstance(col, StringColumn) else 0
-        head.write(struct.pack("<H", len(nb)))
-        head.write(nb)
-        head.write(struct.pack("<H", len(tag)))
-        head.write(tag)
-        head.write(struct.pack("<B", kind))
+        head.append(struct.pack("<H", len(nb)))
+        head.append(nb)
+        head.append(struct.pack("<H", len(tag)))
+        head.append(tag)
+        head.append(struct.pack("<B", kind))
         validity = np.asarray(col.validity)[:n]
-        payload.write(np.packbits(validity, bitorder="little").tobytes())
+        parts.append(memoryview(
+            np.packbits(validity, bitorder="little")).cast("B"))
         if kind == 1:
             offs = np.asarray(col.offsets)[:n + 1].astype("<i4")
             total = int(offs[-1]) if n else 0
-            payload.write(offs.tobytes())
-            payload.write(np.asarray(col.chars)[:total]
-                          .astype("<u1").tobytes())
+            parts.append(memoryview(offs).cast("B"))
+            parts.append(memoryview(np.ascontiguousarray(
+                np.asarray(col.chars)[:total], dtype="<u1")).cast("B"))
         else:
             data = np.asarray(col.data)[:n]
-            payload.write(np.ascontiguousarray(
-                data, dtype=data.dtype.newbyteorder("<")).tobytes())
-    body = payload.getvalue()
+            parts.append(memoryview(np.ascontiguousarray(
+                data, dtype=data.dtype.newbyteorder("<"))).cast("B"))
+    body = b"".join(parts)
     raw_len = len(body)
-    if flags & FLAG_LZ4:
-        from ..native import lz4_compress
-        body = lz4_compress(body)
-    elif flags & FLAG_ZSTD:
-        import zstandard
-        body = zstandard.ZstdCompressor(level=1).compress(body)
-    head.write(struct.pack("<II", len(body), raw_len))
-    return head.getvalue() + body
+    if compress:
+        body, flags = _compress_body(body, codec.lower())
+        head[0] = struct.pack("<IHHII", MAGIC, VERSION, flags, n,
+                              batch.num_columns)
+    head.append(struct.pack("<II", len(body), raw_len))
+    head.append(body)
+    return b"".join(head)
 
 
 def deserialize_batch(buf: bytes,

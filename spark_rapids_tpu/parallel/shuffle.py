@@ -18,6 +18,16 @@ plain ColumnarBatch. This keeps ragged string buffers and the scalar
 ``num_rows`` well-defined per shard — a plain row-sharding of a string
 column's (offsets, chars) pair would not be meaningful.
 
+Not every hash exchange needs the collective at all: when the child's
+``output_partitioning`` is already HashPartitioning on the same expr
+sequence, rows are on their target shard and the mesh lowering skips
+``shuffle_exchange`` entirely (the MESH face of the push-shuffle v2
+locality bypass — ``plan/mesh_executor.py:_hash_colocated``, the
+``MeshColocationBypass`` event, docs/SHUFFLE.md). The placement
+contract that makes this sound: every exchange routes with
+``pmod(murmur3(keys), num_shards)`` against the mesh size, so identical
+key exprs imply identical placement.
+
 ``distributed_aggregate`` is the flagship distributed pipeline: local
 partial aggregation, key-hash all-to-all of the *partial states* (far
 smaller than raw rows — same motivation as the reference's partial-then-

@@ -142,6 +142,190 @@ class HostBlockStore:
             return len(gone)
 
 
+class _Segment:
+    """One reduce partition's append-only consolidated bytes: the
+    framed envelopes of every pushed block, back to back, plus an index
+    of where each (origin, map_id) entry sits."""
+
+    __slots__ = ("buf", "index")
+
+    def __init__(self):
+        self.buf = bytearray()
+        #: (origin_endpoint, map_id) -> (offset, length, rows); a
+        #: re-pushed entry (map replay) re-points the index at its new
+        #: bytes — the old range becomes dead space, never re-read
+        self.index: Dict[Tuple[str, int], Tuple[int, int, int]] = {}
+
+
+class SegmentStore:
+    """Receive-side consolidation of PUSHED shuffle blocks into
+    per-reducer segments (the push-based shuffle's 'merged shuffle
+    file' role, Spark's magnet push-merge). Each pushed block is
+    appended — still inside its integrity frame — to the segment for
+    its (shuffle_id, reduce_id), so a reducer's read is ONE sequential
+    scan over local memory instead of maps-many socket round trips.
+
+    Integrity granularity is the ENTRY: every frame verifies on scan,
+    and a corrupt entry is quarantined alone (dropped from the index)
+    — the reader re-pulls just that (origin, map_id) from its origin,
+    never losing the rest of the segment. Entries carry exact
+    (rows, bytes), so the index doubles as the receive-side
+    MapOutputStatistics source (no second accounting pass)."""
+
+    def __init__(self):
+        self._segments: Dict[Tuple[int, int], _Segment] = {}
+        self._lock = threading.Lock()
+        self.bytes_appended = 0
+        self.entries_appended = 0
+        self.entries_quarantined = 0
+
+    def append(self, shuffle_id: int, reduce_id: int, origin: str,
+               map_id: int, rows: int, framed: bytes) -> None:
+        # seeded corrupt-at-rest-in-segment (chaos/tests): flips a byte
+        # of the entry as stored, so the per-entry verification on scan
+        # must quarantine exactly this entry
+        framed = corrupt_point(
+            "shuffle.segment.store", framed,
+            f"sid={shuffle_id};reduce={reduce_id};m={map_id};"
+            f"origin={origin};")
+        with self._lock:
+            seg = self._segments.setdefault((shuffle_id, reduce_id),
+                                            _Segment())
+            off = len(seg.buf)
+            seg.buf += framed
+            seg.index[(origin, map_id)] = (off, len(framed), int(rows))
+            self.bytes_appended += len(framed)
+            self.entries_appended += 1
+
+    def entries(self, shuffle_id: int, reduce_id: int
+                ) -> List[Tuple[str, int, int, int]]:
+        """Sorted (origin, map_id, length, rows) index view."""
+        with self._lock:
+            seg = self._segments.get((shuffle_id, reduce_id))
+            if seg is None:
+                return []
+            return sorted((o, m, ln, rows)
+                          for (o, m), (_off, ln, rows) in
+                          seg.index.items())
+
+    def map_ids_from(self, shuffle_id: int,
+                     reduce_id: int) -> Dict[str, set]:
+        """origin endpoint -> map ids present — the pull path's
+        per-peer exclude sets."""
+        out: Dict[str, set] = {}
+        with self._lock:
+            seg = self._segments.get((shuffle_id, reduce_id))
+            if seg is None:
+                return out
+            for (o, m) in seg.index:
+                out.setdefault(o, set()).add(m)
+        return out
+
+    def scan(self, shuffle_id: int, reduce_id: int, keep=None,
+             verify: bool = True):
+        """One sequential pass over the segment: yields
+        ``(origin, map_id, payload)`` for every live index entry that
+        passes ``keep(origin, map_id)``, verifying each frame. A frame
+        that fails verification quarantines ONLY its own entry (the
+        index forgets it; the dead bytes stay) — the caller's pull
+        fallback refetches that (origin, map_id) from its origin."""
+        with self._lock:
+            seg = self._segments.get((shuffle_id, reduce_id))
+            if seg is None:
+                return
+            # snapshot in OFFSET order (the sequential scan); appends
+            # during iteration only extend past the snapshot
+            items = sorted(((off, ln, rows, o, m)
+                            for (o, m), (off, ln, rows) in
+                            seg.index.items()))
+            buf = seg.buf
+        for off, ln, _rows, origin, map_id in items:
+            if keep is not None and not keep(origin, map_id):
+                continue
+            framed = bytes(buf[off:off + ln])
+            if not verify:
+                yield origin, map_id, integrity.strip(framed)
+                continue
+            try:
+                payload = integrity.unwrap(
+                    framed, what=f"segment entry sid={shuffle_id} "
+                                 f"reduce={reduce_id} m={map_id} "
+                                 f"from {origin}")
+            except integrity.DataCorruption as e:
+                self.quarantine_entry(shuffle_id, reduce_id, origin,
+                                      map_id, reason=str(e))
+                continue
+            yield origin, map_id, payload
+
+    def quarantine_entry(self, shuffle_id: int, reduce_id: int,
+                         origin: str, map_id: int,
+                         reason: str = "") -> bool:
+        """Drop ONE corrupt entry from the index — unlike block-store
+        quarantine this never poisons the shuffle: the origin still
+        holds the authoritative block, so recovery is a point refetch
+        (recompute of one entry), not a whole-segment loss."""
+        import logging
+        with self._lock:
+            seg = self._segments.get((shuffle_id, reduce_id))
+            dropped = (seg is not None
+                       and seg.index.pop((origin, map_id), None)
+                       is not None)
+            if dropped:
+                self.entries_quarantined += 1
+        if dropped:
+            logging.getLogger("spark_rapids_tpu.shuffle").warning(
+                "quarantined corrupt segment entry sid=%s reduce=%s "
+                "map=%s origin=%s%s", shuffle_id, reduce_id, map_id,
+                origin, f": {reason}" if reason else "")
+        return dropped
+
+    def statistics(self, shuffle_id: int,
+                   num_partitions: int) -> MapOutputStatistics:
+        """Exact per-(map, reduce) (rows, bytes) straight from the
+        segment index — what pushed entries declared at write time, no
+        re-walk of any block store. Bytes are the framed payload sizes
+        (frame header excluded) to match the write-side accounting."""
+        detail: Dict[Tuple[int, int], Tuple[int, int]] = {}
+        with self._lock:
+            for (sid, rid), seg in self._segments.items():
+                if sid != shuffle_id or rid >= num_partitions:
+                    continue
+                for (_o, m), (_off, ln, rows) in seg.index.items():
+                    pr, pb = detail.get((m, rid), (0, 0))
+                    detail[(m, rid)] = (
+                        pr + rows,
+                        pb + max(ln - integrity.HEADER_SIZE, 0))
+        rows_by = [0] * num_partitions
+        bytes_by = [0] * num_partitions
+        for (_m, rid), (rows, nbytes) in detail.items():
+            rows_by[rid] += rows
+            bytes_by[rid] += nbytes
+        return MapOutputStatistics(shuffle_id, num_partitions, rows_by,
+                                   bytes_by, detail)
+
+    def remove_shuffle(self, shuffle_id: int) -> int:
+        with self._lock:
+            gone = [k for k in self._segments if k[0] == shuffle_id]
+            n = 0
+            for k in gone:
+                seg = self._segments.pop(k)
+                n += len(seg.index)
+                self.bytes_appended -= len(seg.buf)
+            return n
+
+    def rename_shuffle(self, old_id: int, new_id: int) -> int:
+        """Stage-level retry: received segments re-key alongside the
+        origin blocks, so surviving pushed entries keep serving reads
+        under the re-planned exchange's fresh shuffle id."""
+        with self._lock:
+            gone = [k for k in self._segments if k[0] == old_id]
+            n = 0
+            for k in gone:
+                self._segments[(new_id, k[1])] = self._segments.pop(k)
+                n += 1
+            return n
+
+
 @dataclass
 class ShuffleWriteMetrics:
     blocks_written: int = 0
@@ -212,8 +396,25 @@ class ShuffleManager:
         self.compress = self.codec != "none"
         from ..conf import INTEGRITY_CHECKSUM
         self.verify_checksums = self.conf.get(INTEGRITY_CHECKSUM)
+        from ..conf import (SHUFFLE_PUSH_ENABLED, SHUFFLE_PUSH_LOCAL_BYPASS)
+        #: push-based shuffle only applies to the serialized-block mode;
+        #: CACHE_ONLY never leaves the process and MESH shuffles inside
+        #: the compiled program
+        self.push_enabled = (self.conf.get(SHUFFLE_PUSH_ENABLED)
+                             and self.mode == "MULTITHREADED")
+        self.local_bypass = self.conf.get(SHUFFLE_PUSH_LOCAL_BYPASS)
         self.catalog = ShuffleBlockCatalog()
         self.host_store = HostBlockStore()
+        self.segments = SegmentStore()
+        #: this process's shuffle-server endpoint ("host:port"), set by
+        #: ShuffleBlockServer — the ORIGIN stamped on every pushed block
+        #: (map ids are only unique per peer, so segment entries key on
+        #: (origin, map_id))
+        self.local_endpoint: Optional[str] = None
+        self._pusher = None
+        #: bytes handed through the zero-copy local channel instead of
+        #: serializer+socket+deserializer (shuffleBytesBypassed)
+        self.bypassed_bytes = 0
         #: shuffles with a corrupt-at-rest block: their outputs must
         #: never be served or reused (stage-level reuse of a poisoned
         #: sid fails over to a whole-job retry that regenerates them)
@@ -230,6 +431,11 @@ class ShuffleManager:
         #: at write time (CACHE_ONLY estimates from device buffers);
         #: the byte half of MapOutputStatistics
         self._part_bytes: Dict[Tuple[int, int, int], int] = {}
+        #: running per-(shuffle, reduce) sums maintained at write time —
+        #: partition_row_counts/partition_byte_counts read these in O(n)
+        #: instead of scanning every (map, reduce) entry per call
+        self._reduce_rows: Dict[Tuple[int, int], int] = {}
+        self._reduce_bytes: Dict[Tuple[int, int], int] = {}
         self.write_metrics = ShuffleWriteMetrics()
         self._lock = threading.Lock()
 
@@ -241,6 +447,7 @@ class ShuffleManager:
     def unregister_shuffle(self, shuffle_id: int) -> None:
         self.catalog.remove_shuffle(shuffle_id)
         self.host_store.remove_shuffle(shuffle_id)
+        self.segments.remove_shuffle(shuffle_id)
         with self._lock:
             self._registered.pop(shuffle_id, None)
             self._poisoned_sids.discard(shuffle_id)
@@ -248,6 +455,9 @@ class ShuffleManager:
                 del self._part_rows[k]
             for k in [k for k in self._part_bytes if k[0] == shuffle_id]:
                 del self._part_bytes[k]
+            for d in (self._reduce_rows, self._reduce_bytes):
+                for k in [k for k in d if k[0] == shuffle_id]:
+                    del d[k]
 
     # --- integrity ---
     def is_poisoned(self, shuffle_id: int) -> bool:
@@ -274,6 +484,7 @@ class ShuffleManager:
         attempt's completed map outputs under the re-planned exchange's
         fresh shuffle id instead of recomputing them."""
         moved = self.host_store.rename_shuffle(old_id, new_id)
+        self.segments.rename_shuffle(old_id, new_id)
         with self._lock:
             if old_id in self._poisoned_sids:  # defensive: reuse of a
                 self._poisoned_sids.discard(old_id)  # poisoned sid is
@@ -286,28 +497,27 @@ class ShuffleManager:
             for k in [k for k in self._part_bytes if k[0] == old_id]:
                 self._part_bytes[(new_id, k[1], k[2])] = \
                     self._part_bytes.pop(k)
+            for d in (self._reduce_rows, self._reduce_bytes):
+                for k in [k for k in d if k[0] == old_id]:
+                    d[(new_id, k[1])] = d.pop(k)
         return moved
 
     def partition_row_counts(self, shuffle_id: int) -> List[int]:
-        """Rows per reduce partition (valid once the map side wrote)."""
+        """Rows per reduce partition (valid once the map side wrote).
+        Reads the running per-reduce sums maintained at write time —
+        O(partitions), not O(all blocks ever written)."""
         n = self.num_partitions(shuffle_id)
-        out = [0] * n
         with self._lock:
-            for (sid, _mid, rid), v in self._part_rows.items():
-                if sid == shuffle_id and rid < n:
-                    out[rid] += v
-        return out
+            return [self._reduce_rows.get((shuffle_id, r), 0)
+                    for r in range(n)]
 
     def partition_byte_counts(self, shuffle_id: int) -> List[int]:
         """Serialized bytes per reduce partition (CACHE_ONLY: device
         buffer estimate)."""
         n = self.num_partitions(shuffle_id)
-        out = [0] * n
         with self._lock:
-            for (sid, _mid, rid), v in self._part_bytes.items():
-                if sid == shuffle_id and rid < n:
-                    out[rid] += v
-        return out
+            return [self._reduce_bytes.get((shuffle_id, r), 0)
+                    for r in range(n)]
 
     def map_output_statistics(self, shuffle_id: int,
                               map_ids: Optional[set] = None
@@ -337,16 +547,81 @@ class ShuffleManager:
     def num_partitions(self, shuffle_id: int) -> int:
         return self._registered[shuffle_id]
 
+    def received_statistics(self, shuffle_id: int) -> MapOutputStatistics:
+        """Receive-side view: exact per-(map, reduce) sizes of every
+        pushed entry, read straight from the segment index."""
+        return self.segments.statistics(shuffle_id,
+                                        self.num_partitions(shuffle_id))
+
+    # --- push path ---
+    def _get_pusher(self):
+        if self._pusher is None:
+            from .transport import BlockPusher
+            with self._lock:
+                if self._pusher is None:
+                    self._pusher = BlockPusher()
+        return self._pusher
+
+    def push_map_output(self, shuffle_id: int, map_id: int,
+                        route: Dict[int, str], who: str = "") -> int:
+        """Eagerly replicate this map's freshly serialized blocks to
+        the endpoints that own their reduce partitions (``route``:
+        reduce_id -> endpoint), so the reduce-side fetch overlaps the
+        remaining map work. Push is REPLICATION — the origin keeps its
+        blocks, a failed push silently degrades to the pull path, and
+        self-owned partitions are skipped (they read through the local
+        short-circuit, no copy needed). Returns blocks enqueued."""
+        if not self.push_enabled or self.mode != "MULTITHREADED":
+            return 0
+        origin = self.local_endpoint
+        if not origin:
+            return 0  # no server running: nothing can address us back
+        pusher = self._get_pusher()
+        pushed = 0
+        for reduce_id, endpoint in route.items():
+            if not endpoint or endpoint == origin:
+                continue
+            block = (shuffle_id, map_id, reduce_id)
+            framed = self.host_store.get(block)
+            if framed is None:
+                continue  # empty partition for this map
+            with self._lock:
+                rows = self._part_rows.get(block, 0)
+            pusher.push(endpoint, shuffle_id, reduce_id, map_id, rows,
+                        framed, origin, who=who)
+            pushed += 1
+        return pushed
+
+    def drain_pushes(self, timeout_s: float = 30.0) -> bool:
+        """Block until every enqueued push acked, failed, or timed out
+        — called before the stage barrier so a released reducer sees
+        all successful pushes in its segment. False = timed out with
+        pushes still in flight (harmless: readers snapshot + exclude,
+        so a late push is simply ignored and its block pulls)."""
+        if self._pusher is None:
+            return True
+        return self._pusher.drain(timeout_s)
+
     # --- write path ---
     def write_map_output(self, shuffle_id: int, map_id: int,
-                         partitions: Sequence[ColumnarBatch]) -> int:
+                         partitions: Sequence[ColumnarBatch],
+                         local_ok: bool = False) -> int:
         """One map task's output: partitions[i] goes to reduce i.
-        Returns serialized bytes written (0 in CACHE_ONLY mode)."""
+        Returns serialized bytes written (0 in CACHE_ONLY mode).
+
+        ``local_ok=True`` asserts every consumer of this shuffle runs in
+        THIS process (driver-local session) — with the push locality
+        bypass on, MULTITHREADED writes then hand the live batch through
+        the device catalog (zero-copy local channel) instead of
+        serializer+socket+deserializer, counted as bypassed bytes."""
         fault_point("shuffle.write", f"sid={shuffle_id};map={map_id};")
         from ..robustness.admission import check_current_query
         check_current_query()  # cancelled query: skip the whole write
         t0 = time.perf_counter_ns()
         bytes_before = self.write_metrics.bytes_written
+        bypass = (local_ok and self.mode == "MULTITHREADED"
+                  and self.push_enabled and self.local_bypass)
+        bypassed_nb = 0
         futures = []
         local_rows: Dict[int, int] = {}
         local_bytes: Dict[int, int] = {}
@@ -355,22 +630,36 @@ class ShuffleManager:
                 continue
             local_rows[reduce_id] = int(batch.num_rows)
             block = (shuffle_id, map_id, reduce_id)
-            if self.mode == "CACHE_ONLY":
+            if self.mode == "CACHE_ONLY" or bypass:
                 from ..memory.spill import batch_nbytes
-                local_bytes[reduce_id] = batch_nbytes(batch)
+                nb = batch_nbytes(batch)
+                local_bytes[reduce_id] = nb
                 self.catalog.add(block, batch)
                 self.write_metrics.rows_written += int(batch.num_rows)
                 self.write_metrics.blocks_written += 1
+                if bypass:
+                    bypassed_nb += nb
             else:  # MULTITHREADED (MESH writes never reach here)
                 futures.append((reduce_id, self._pool.submit(
                     self._serialize_one, block, batch)))
         for reduce_id, f in futures:
             local_bytes[reduce_id] = f.result()
         with self._lock:
+            self.bypassed_bytes += bypassed_nb
             for reduce_id, rows in local_rows.items():
-                self._part_rows[(shuffle_id, map_id, reduce_id)] = rows
-                self._part_bytes[(shuffle_id, map_id, reduce_id)] = \
-                    local_bytes.get(reduce_id, 0)
+                key = (shuffle_id, map_id, reduce_id)
+                tot = (shuffle_id, reduce_id)
+                nb = local_bytes.get(reduce_id, 0)
+                # running per-reduce sums: a replayed map replaces its
+                # own prior contribution instead of double-counting
+                self._reduce_rows[tot] = (self._reduce_rows.get(tot, 0)
+                                          + rows
+                                          - self._part_rows.get(key, 0))
+                self._reduce_bytes[tot] = (
+                    self._reduce_bytes.get(tot, 0) + nb
+                    - self._part_bytes.get(key, 0))
+                self._part_rows[key] = rows
+                self._part_bytes[key] = nb
         dt_ns = time.perf_counter_ns() - t0
         self.write_metrics.write_time_ns += dt_ns
         wrote = self.write_metrics.bytes_written - bytes_before
@@ -416,6 +705,12 @@ class ShuffleManager:
                 if keep(block[1]):
                     yield from self.catalog.get(block)
             return
+        # zero-copy locality bypass: blocks the writer handed through
+        # the device catalog (never serialized) serve directly
+        for block in self.catalog.blocks_for_reduce(shuffle_id,
+                                                    reduce_id):
+            if keep(block[1]):
+                yield from self.catalog.get(block)
         blocks = [b for b in self.host_store.blocks_for_reduce(
             shuffle_id, reduce_id) if keep(b[1])]
         futures = [self._pool.submit(self._deserialize_one, b)

@@ -9,9 +9,19 @@ replaces this entirely; across pods (DCN) — or between plain hosts —
 this transport is the fetch path, with the heartbeat registry
 (shuffle_manager.ShuffleHeartbeatManager) distributing endpoints.
 
-Wire protocol (all little-endian):
-  request:  magic u32 | shuffle_id u32 | reduce_id u32
-  response: count u32, then per block: map_id u32 | length u64 | bytes
+Wire protocol (all little-endian), three request kinds sharing the
+``magic u32 | shuffle_id u32 | reduce_id u32`` prefix:
+  fetch v1  ("SRTS"): response: count u32, then per block:
+            map_id u32 | length u64 | bytes
+  fetch v2  ("SRTF"): request adds n_excl u32 | n_excl x map_id u32 —
+            the server serves every block EXCEPT the excluded map ids
+            (the reader already holds those from pushed segments);
+            response as v1
+  push      ("SRTP"): request adds map_id u32 | rows u64 |
+            frame_len u64 | origin_len u16 | origin utf8 | frame bytes;
+            the receiver verifies the frame and appends it to the
+            (shuffle, reduce) segment, then answers one status byte
+            (1 = stored, 0 = verification failed, sender may retry)
 Each block's bytes are the integrity layer's framed checksum envelope
 around the serializer's self-describing block format: the server
 verifies the stored frame before serving (corrupt-at-rest blocks are
@@ -30,7 +40,8 @@ import socketserver
 import struct
 import threading
 import time
-from typing import Callable, Iterator, List, Optional, Tuple
+from typing import (Callable, Dict, FrozenSet, Iterator, List, Optional,
+                    Set, Tuple)
 
 from ..columnar.vector import ColumnarBatch
 from ..robustness import integrity
@@ -39,9 +50,24 @@ from ..robustness.integrity import DataCorruption
 from .serializer import deserialize_batch
 from .shuffle_manager import ShuffleManager
 
-MAGIC = 0x53525453  # "SRTS"
+MAGIC = 0x53525453        # "SRTS" fetch v1
+MAGIC_FETCH2 = 0x53525446  # "SRTF" fetch with exclude list
+MAGIC_PUSH = 0x53525450    # "SRTP" push upload
 _REQ = struct.Struct("<III")
 _BLOCK_HDR = struct.Struct("<IQ")
+_PUSH_HDR = struct.Struct("<IQQH")  # map_id | rows | frame_len | origin_len
+
+#: endpoint -> the ShuffleManager served AT that endpoint by a server in
+#: THIS process. Lets a reader recognize its own (or a co-resident)
+#: endpoint and short-circuit the fetch through the local block store —
+#: no socket round trip, no extra copy of the framed bytes.
+_LOCAL_ENDPOINTS: Dict[str, ShuffleManager] = {}
+_LOCAL_LOCK = threading.Lock()
+
+
+def local_manager_for(endpoint: str) -> Optional[ShuffleManager]:
+    with _LOCAL_LOCK:
+        return _LOCAL_ENDPOINTS.get(endpoint)
 
 
 class FetchFailed(ConnectionError):
@@ -68,7 +94,22 @@ class _Handler(socketserver.BaseRequestHandler):
         if raw is None:
             return
         magic, shuffle_id, reduce_id = _REQ.unpack(raw)
-        if magic != MAGIC:
+        if magic == MAGIC_PUSH:
+            self._handle_push(mgr, shuffle_id, reduce_id)
+            return
+        exclude: FrozenSet[int] = frozenset()
+        if magic == MAGIC_FETCH2:
+            raw = self._recv_exact(4)
+            if raw is None:
+                return
+            (n_excl,) = struct.unpack("<I", raw)
+            if n_excl:
+                raw = self._recv_exact(4 * n_excl)
+                if raw is None:
+                    return
+                exclude = frozenset(
+                    struct.unpack(f"<{n_excl}I", raw))
+        elif magic != MAGIC:
             return
         try:
             fault_point("transport.serve",
@@ -84,6 +125,10 @@ class _Handler(socketserver.BaseRequestHandler):
         blocks = mgr.host_store.blocks_for_reduce(shuffle_id, reduce_id)
         payload = []
         for b in blocks:
+            if b[1] in exclude:
+                # the reader already consolidated this map's block from
+                # a pushed segment — don't re-ship it
+                continue
             framed = mgr.host_store.get(b)
             if framed is None:
                 continue
@@ -119,6 +164,40 @@ class _Handler(socketserver.BaseRequestHandler):
             self.request.sendall(_BLOCK_HDR.pack(map_id, len(data)))
             self.request.sendall(data)
 
+    def _handle_push(self, mgr: ShuffleManager, shuffle_id: int,
+                     reduce_id: int) -> None:
+        """Receive one eagerly pushed block and consolidate it into the
+        (shuffle, reduce) segment. The frame verifies BEFORE it is
+        stored — a wire-corrupt push is NAKed (status 0) so the origin
+        can resend; the origin's copy stays authoritative either way."""
+        raw = self._recv_exact(_PUSH_HDR.size)
+        if raw is None:
+            return
+        map_id, rows, frame_len, origin_len = _PUSH_HDR.unpack(raw)
+        origin_b = self._recv_exact(origin_len)
+        framed = self._recv_exact(frame_len)
+        if origin_b is None or framed is None:
+            return
+        try:
+            fault_point("transport.push",
+                        f"sid={shuffle_id};reduce={reduce_id};"
+                        f"m={map_id};")
+        except ConnectionResetError:
+            return  # injected: swallow the upload, never ack
+        status = 1
+        if mgr.verify_checksums:
+            try:
+                integrity.verify_framed(
+                    framed, what=f"pushed shuffle block sid={shuffle_id} "
+                                 f"m={map_id} reduce={reduce_id}")
+            except DataCorruption:
+                status = 0  # corrupted in flight: reject, sender retries
+        if status:
+            mgr.segments.append(shuffle_id, reduce_id,
+                                origin_b.decode("utf-8"), map_id,
+                                rows, framed)
+        self.request.sendall(struct.pack("<B", status))
+
     def _recv_exact(self, n: int) -> Optional[bytes]:
         buf = b""
         while len(buf) < n:
@@ -139,9 +218,16 @@ class ShuffleBlockServer:
             (host, port), _Handler, bind_and_activate=True)
         self._server.daemon_threads = True
         self._server.manager = manager  # type: ignore
+        self._manager = manager
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True)
         self._thread.start()
+        # the serving endpoint is this manager's identity on the wire:
+        # readers in the same process short-circuit fetches through it,
+        # and pushed blocks stamp it as their origin
+        with _LOCAL_LOCK:
+            _LOCAL_ENDPOINTS[self.endpoint] = manager
+        manager.local_endpoint = self.endpoint
 
     @property
     def endpoint(self) -> str:
@@ -151,6 +237,11 @@ class ShuffleBlockServer:
     def close(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        with _LOCAL_LOCK:
+            if _LOCAL_ENDPOINTS.get(self.endpoint) is self._manager:
+                del _LOCAL_ENDPOINTS[self.endpoint]
+        if self._manager.local_endpoint == self.endpoint:
+            self._manager.local_endpoint = None
 
 
 class ShuffleBlockClient:
@@ -179,14 +270,25 @@ class ShuffleBlockClient:
             if backoff_base_s is None else backoff_base_s
 
     def _stream_attempt(self, shuffle_id: int, reduce_id: int,
-                        seen: set) -> Iterator[Tuple[int, bytes]]:
+                        seen: set, exclude: FrozenSet[int] = frozenset()
+                        ) -> Iterator[Tuple[int, bytes]]:
         """STREAM blocks one at a time in map order — the socket's TCP
         window is the only read-ahead, so a huge partition never
-        buffers whole in this process (WindowedBlockIterator role)."""
+        buffers whole in this process (WindowedBlockIterator role).
+        ``exclude`` names map ids the caller already holds (pushed
+        segment entries): a v2 request ships the list so those blocks
+        never cross the wire at all."""
         fault_point("transport.connect", self.endpoint)
         with socket.create_connection((self.host, self.port),
                                       timeout=self.timeout_s) as sock:
-            sock.sendall(_REQ.pack(MAGIC, shuffle_id, reduce_id))
+            if exclude:
+                ex = sorted(exclude)
+                sock.sendall(_REQ.pack(MAGIC_FETCH2, shuffle_id,
+                                       reduce_id)
+                             + struct.pack(f"<I{len(ex)}I",
+                                           len(ex), *ex))
+            else:
+                sock.sendall(_REQ.pack(MAGIC, shuffle_id, reduce_id))
             count = struct.unpack("<I", _recv_exact(sock, 4))[0]
             for _ in range(count):
                 map_id, length = _BLOCK_HDR.unpack(
@@ -239,7 +341,8 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 def _retrying_stream(cli: ShuffleBlockClient, shuffle_id: int,
                      reduce_id: int, seen: set,
-                     resolver: Optional[Callable[[str], Optional[str]]]
+                     resolver: Optional[Callable[[str], Optional[str]]],
+                     exclude: FrozenSet[int] = frozenset()
                      ) -> Iterator[Tuple[int, bytes]]:
     """Drive ``cli`` attempts until the stream completes: bounded
     same-endpoint retries with exponential backoff + jitter, then one
@@ -251,7 +354,8 @@ def _retrying_stream(cli: ShuffleBlockClient, shuffle_id: int,
     while True:
         try:
             t0 = time.perf_counter_ns()
-            yield from cli._stream_attempt(shuffle_id, reduce_id, seen)
+            yield from cli._stream_attempt(shuffle_id, reduce_id, seen,
+                                           exclude)
             from ..obs import registry as _registry
             _registry.observe("fetch_latency_ns",
                               time.perf_counter_ns() - t0, "ns")
@@ -296,7 +400,8 @@ def stream_with_failover(endpoint: str, shuffle_id: int, reduce_id: int,
                              Callable[[str], Optional[str]]] = None,
                          timeout_s: Optional[float] = None,
                          max_retries: Optional[int] = None,
-                         backoff_base_s: Optional[float] = None
+                         backoff_base_s: Optional[float] = None,
+                         exclude: FrozenSet[int] = frozenset()
                          ) -> Iterator[Tuple[int, bytes]]:
     """Fetch one peer's blocks for a reduce partition, surviving
     transient faults; a definitive failure surfaces as ``FetchFailed``
@@ -305,7 +410,7 @@ def stream_with_failover(endpoint: str, shuffle_id: int, reduce_id: int,
                              backoff_base_s)
     try:
         yield from _retrying_stream(cli, shuffle_id, reduce_id, set(),
-                                    endpoint_resolver)
+                                    endpoint_resolver, exclude)
     except OSError as e:
         if isinstance(e, FetchFailed):
             raise
@@ -314,6 +419,169 @@ def stream_with_failover(endpoint: str, shuffle_id: int, reduce_id: int,
                      shuffle_id=shuffle_id, reduce_id=reduce_id,
                      error=str(e))
         raise FetchFailed(endpoint, shuffle_id, reduce_id, e) from e
+
+
+def _local_stream(mgr: ShuffleManager, endpoint: str, shuffle_id: int,
+                  reduce_id: int,
+                  exclude: FrozenSet[int] = frozenset()
+                  ) -> Iterator[Tuple[int, bytes]]:
+    """Self-endpoint short-circuit: the addressed endpoint is served by
+    a manager in THIS process, so read its host store directly — same
+    verification and failure semantics as the socket path (poisoned
+    shuffle / corrupt-at-rest block -> ``FetchFailed``), none of the
+    serialize-to-socket round trip."""
+    fault_point("transport.local",
+                f"sid={shuffle_id};reduce={reduce_id};")
+    if mgr.is_poisoned(shuffle_id):
+        raise FetchFailed(
+            endpoint, shuffle_id, reduce_id,
+            DataCorruption(f"shuffle {shuffle_id} quarantined; "
+                           f"partition {reduce_id} is incomplete"))
+    for b in mgr.host_store.blocks_for_reduce(shuffle_id, reduce_id):
+        if b[1] in exclude:
+            continue
+        framed = mgr.host_store.get(b)
+        if framed is None:
+            continue
+        if not mgr.verify_checksums:
+            yield b[1], integrity.strip(framed)
+            continue
+        try:
+            payload = integrity.unwrap(
+                framed, what=f"local shuffle block {b}")
+        except DataCorruption as e:
+            # same recovery as the server path: quarantine at-rest
+            # corruption and fail the fetch definitively
+            mgr.quarantine_block(b, reason=str(e))
+            raise FetchFailed(endpoint, shuffle_id, reduce_id, e) from e
+        yield b[1], payload
+
+
+def _push_once(endpoint: str, shuffle_id: int, reduce_id: int,
+               map_id: int, rows: int, framed: bytes, origin: str,
+               timeout_s: float) -> bool:
+    """One push upload attempt. Returns True when the receiver stored
+    the block (ACK), False on a NAK (receiver saw a corrupt frame —
+    the corruption happened in flight, resending heals it)."""
+    # seeded push-wire corruption (chaos/tests): applied per attempt so
+    # a one-shot corrupt spec NAKs the first send and the retry heals
+    wire = corrupt_point(
+        "shuffle.block.pushwire", framed,
+        f"sid={shuffle_id};reduce={reduce_id};m={map_id};")
+    host, port = endpoint.rsplit(":", 1)
+    ob = origin.encode("utf-8")
+    with socket.create_connection((host, int(port)),
+                                  timeout=timeout_s) as sock:
+        sock.sendall(_REQ.pack(MAGIC_PUSH, shuffle_id, reduce_id)
+                     + _PUSH_HDR.pack(map_id, rows, len(wire), len(ob))
+                     + ob)
+        sock.sendall(wire)
+        status = _recv_exact(sock, 1)[0]
+    return status == 1
+
+
+class BlockPusher:
+    """Map-side eager push (the magnet/push-based-shuffle sender role):
+    blocks enqueue onto the process-wide fetch pool and upload in the
+    background while the map task moves on, bounded PER ENDPOINT by a
+    ``ByteBudget`` window of un-acknowledged bytes — a slow reducer
+    backpressures only its own pushes. Push is best-effort replication:
+    any failure just leaves the block to the pull path, so no push
+    outcome can ever affect correctness."""
+
+    def __init__(self, max_in_flight: Optional[int] = None,
+                 timeout_s: Optional[float] = None):
+        from ..conf import (FETCH_TIMEOUT_S, SHUFFLE_PUSH_IN_FLIGHT_BYTES,
+                            active_conf)
+        conf = active_conf()
+        self.max_in_flight = conf.get(SHUFFLE_PUSH_IN_FLIGHT_BYTES) \
+            if max_in_flight is None else max_in_flight
+        self.timeout_s = conf.get(FETCH_TIMEOUT_S) \
+            if timeout_s is None else timeout_s
+        self._budgets: Dict[str, ByteBudget] = {}
+        self._lock = threading.Lock()
+        self._cv = threading.Condition()
+        self._in_flight = 0
+        self.pushed_blocks = 0
+        self.pushed_bytes = 0
+        self.failed_blocks = 0
+
+    def _budget(self, endpoint: str) -> "ByteBudget":
+        with self._lock:
+            b = self._budgets.get(endpoint)
+            if b is None:
+                b = self._budgets[endpoint] = ByteBudget(
+                    self.max_in_flight)
+            return b
+
+    def push(self, endpoint: str, shuffle_id: int, reduce_id: int,
+             map_id: int, rows: int, framed: bytes,
+             origin: str, who: str = "") -> None:
+        """Enqueue one block for background upload. Blocks the CALLING
+        (map) thread only while the target endpoint's in-flight window
+        is full. ``who`` is an opaque sender label (e.g. ``w=1``) that
+        chaos plans can match to target one worker's push path."""
+        try:
+            fault_point("push.send",
+                        f"sid={shuffle_id};reduce={reduce_id};"
+                        f"m={map_id};ep={endpoint};"
+                        + (who + ";" if who else ""))
+        except OSError:
+            # injected send failure: this block silently degrades to
+            # the pull path
+            with self._cv:
+                self.failed_blocks += 1
+            return
+        budget = self._budget(endpoint)
+        budget.acquire(len(framed))
+        with self._cv:
+            self._in_flight += 1
+
+        def task() -> None:
+            ok = False
+            try:
+                for _attempt in range(2):
+                    try:
+                        if _push_once(endpoint, shuffle_id, reduce_id,
+                                      map_id, rows, framed, origin,
+                                      self.timeout_s):
+                            ok = True
+                            break
+                        # NAK: receiver rejected a wire-corrupt frame;
+                        # resend the (intact) origin copy once
+                    except OSError:
+                        break  # dead/slow peer: pull covers it
+            finally:
+                budget.release(len(framed))
+                with self._cv:
+                    if ok:
+                        self.pushed_blocks += 1
+                        self.pushed_bytes += len(framed)
+                    else:
+                        self.failed_blocks += 1
+                    self._in_flight -= 1
+                    self._cv.notify_all()
+
+        fetch_pool().submit(task)
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Wait until every enqueued push resolved (acked or failed).
+        Called before the stage barrier; a timeout just means late
+        pushes land after the readers snapshot — they'll be ignored
+        (readers exclude exactly what they consumed) and the blocks
+        pull normally."""
+        deadline = time.monotonic() + timeout_s
+        from ..robustness.admission import current_query
+        qc = current_query()
+        with self._cv:
+            while self._in_flight > 0:
+                if qc is not None:
+                    qc.check()  # cancelled query: stop waiting
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cv.wait(min(left, 0.25))
+        return True
 
 
 class ByteBudget:
@@ -400,7 +668,10 @@ def fetch_all_partitions(endpoints: List[str], shuffle_id: int,
                          map_mod=None,
                          endpoint_resolver: Optional[
                              Callable[[str], Optional[str]]] = None,
-                         allowed: Optional[dict] = None
+                         allowed: Optional[dict] = None,
+                         manager: Optional[ShuffleManager] = None,
+                         metrics_cb: Optional[
+                             Callable[[str, int], None]] = None
                          ) -> Iterator[ColumnarBatch]:
     """Reduce-side iterator over every peer's blocks for one partition
     (RapidsShuffleIterator role): up to ``max_concurrent`` peers fetch
@@ -409,6 +680,16 @@ def fetch_all_partitions(endpoints: List[str], shuffle_id: int,
     order is preserved per peer (map order); cross-peer order is
     arrival order, which no consumer depends on (partition contents
     are set-semantics until a downstream sort).
+
+    With push-based shuffle on, the read is SEGMENT-FIRST: one
+    sequential scan over the locally consolidated segment yields every
+    pushed block that passes the filters, then the residual pull sends
+    per-peer exclude lists (fetch v2) so consumed blocks never cross
+    the wire again. A corrupt segment entry is quarantined alone and —
+    being absent from the exclude list — re-pulled from its origin.
+    Self-owned endpoints short-circuit through the local block store
+    without a socket. ``metrics_cb(kind, nbytes)`` (kind in
+    {"segment", "local", "remote"}) attributes each block's source.
 
     Per-peer streams retry with backoff and, when ``endpoint_resolver``
     is given (cluster mode wires the driver's heartbeat registry), fail
@@ -427,11 +708,6 @@ def fetch_all_partitions(endpoints: List[str], shuffle_id: int,
     max_retries = conf.get(FETCH_MAX_RETRIES)
     backoff_base_s = conf.get(FETCH_BACKOFF_BASE_S)
 
-    def open_stream(ep: str) -> Iterator[Tuple[int, bytes]]:
-        return stream_with_failover(ep, shuffle_id, reduce_id,
-                                    endpoint_resolver, timeout_s,
-                                    max_retries, backoff_base_s)
-
     def keep(map_id: int, ep: str) -> bool:
         # skew split: client-side map-slice filter ((s, S) keeps
         # map_id % S == s); blocks outside the slice are dropped before
@@ -447,10 +723,48 @@ def fetch_all_partitions(endpoints: List[str], shuffle_id: int,
         if allowed is not None and map_id not in allowed.get(ep, ()):
             return False
         return True
+
+    if manager is None:
+        from .shuffle_manager import shuffle_manager
+        manager = shuffle_manager()
+
+    # --- segment-first: drain the consolidated pushed blocks, building
+    # per-origin exclude sets as we go (only what was actually CONSUMED
+    # is excluded — a quarantined entry stays pullable) ---
+    excludes: Dict[str, Set[int]] = {}
+    if getattr(manager, "push_enabled", False):
+        epset = set(endpoints)
+        for origin, map_id, payload in manager.segments.scan(
+                shuffle_id, reduce_id,
+                # entries from endpoints outside this read's peer list
+                # (a replaced worker's stale pushes) never serve — the
+                # live peer re-executed those maps and pull owns them
+                keep=lambda o, m: o in epset and keep(m, o),
+                verify=manager.verify_checksums):
+            excludes.setdefault(origin, set()).add(map_id)
+            if metrics_cb is not None:
+                metrics_cb("segment", len(payload))
+            yield deserialize_batch(payload)
+
+    def open_stream(ep: str) -> Iterator[Tuple[int, bytes]]:
+        ex = frozenset(excludes.get(ep, ()))
+        local = local_manager_for(ep)
+        if local is not None:
+            return _local_stream(local, ep, shuffle_id, reduce_id, ex)
+        return stream_with_failover(ep, shuffle_id, reduce_id,
+                                    endpoint_resolver, timeout_s,
+                                    max_retries, backoff_base_s, ex)
+
+    def block_kind(ep: str) -> str:
+        return "local" if local_manager_for(ep) is not None else "remote"
+
     if len(endpoints) <= 1 or max_concurrent <= 1:
         for ep in endpoints:
+            kind = block_kind(ep)
             for map_id, data in open_stream(ep):
                 if keep(map_id, ep):
+                    if metrics_cb is not None:
+                        metrics_cb(kind, len(data))
                     yield deserialize_batch(data)
         return
 
@@ -470,6 +784,7 @@ def fetch_all_partitions(endpoints: List[str], shuffle_id: int,
         try:
             if stop.is_set():  # abandoned before this task ran
                 return
+            kind = block_kind(ep)
             with query_scope(qc):
                 for map_id, data in open_stream(ep):
                     if stop.is_set() or (
@@ -479,7 +794,7 @@ def fetch_all_partitions(endpoints: List[str], shuffle_id: int,
                     if not keep(map_id, ep):
                         continue
                     budget.acquire(len(data))
-                    outq.put(("block", data))
+                    outq.put(("block", (data, kind)))
         except BaseException as e:  # surfaced on the consumer side
             outq.put(("error", e))
         finally:
@@ -517,7 +832,9 @@ def fetch_all_partitions(endpoints: List[str], shuffle_id: int,
                 # the consumer deserializing blocks it will throw away;
                 # the finally below unwinds the other workers
                 raise payload
-            data = payload
+            data, kind = payload
+            if metrics_cb is not None:
+                metrics_cb(kind, len(data))
             try:
                 batch = deserialize_batch(data)
             finally:

@@ -231,6 +231,42 @@ def _compute_join_decision(ctx, join) -> JoinDecision:
                         probe_mod, n_skewed)
 
 
+def push_coverage(ctx, exchange) -> Optional[Tuple[int, int]]:
+    """``(pushed_bytes, owned_bytes)`` for THIS worker's owned reduce
+    partitions of one materialized exchange: how much of the next
+    stage's input the push path pre-positioned into local segments
+    before the stage boundary closed. Exact per-(map, reduce) sizes
+    come straight from the receive-side segment index; the speculation
+    winners verdict filters at segment-index granularity, so a losing
+    map's pushed entries never count as coverage. None when push is
+    off, local session, or the stage has not materialized."""
+    from ..parallel.shuffle_manager import shuffle_manager
+    from ..robustness import integrity
+    if ctx.cluster is None:
+        return None
+    mgr = exchange.manager or shuffle_manager()
+    if not getattr(mgr, "push_enabled", False):
+        return None
+    stats = getattr(exchange, "_global_stats", None)
+    if stats is None:
+        return None
+    nbytes = stats[1]
+    owned = ctx.cluster.assigned(len(nbytes))
+    allowed = exchange._allowed_by_endpoint(ctx)
+    peers = set(ctx.cluster.peers)
+    pushed = 0
+    for rid in owned:
+        for origin, map_id, ln, _rows in mgr.segments.entries(
+                exchange.shuffle_id, rid):
+            if origin not in peers:
+                continue  # stale entry from a replaced worker
+            if allowed is not None and \
+                    map_id not in allowed.get(origin, ()):
+                continue
+            pushed += max(ln - integrity.HEADER_SIZE, 0)
+    return pushed, sum(nbytes[r] for r in owned if r < len(nbytes))
+
+
 def broadcast_oversize_slices(ctx, join, build_rows: int,
                               build_bytes: int) -> int:
     """joinStrategy *promotion* guard for an already-broadcast join: a
@@ -337,6 +373,11 @@ class AdaptiveExecutor:
             # so consumers (and re-visits through a demoted join's
             # subtree) see the same stats without re-running anything
             ex.materialized_stats(ctx)
+            cov = push_coverage(ctx, ex)
+            if cov is not None and cov[1] > 0:
+                _events.emit("StagePushCoverage",
+                             shuffle_id=ex.shuffle_id,
+                             pushed_bytes=cov[0], owned_bytes=cov[1])
             c = st.consumer
             if isinstance(c, ShuffledHashJoinExec) and st.role == "build":
                 d = join_decision(ctx, c)

@@ -88,6 +88,29 @@ class MeshQueryExecutor:
         #: silently dropping matches (single-stream joins grow-and-
         #: retry on the host; a traced SPMD program cannot)
         self._checks: List = []
+        #: exec_ids of hash exchanges lowered as identity (co-location
+        #: bypass): child rows were already on their target shard
+        self.colocated_exchanges: List[str] = []
+
+    def _hash_colocated(self, node: ShuffleExchangeExec) -> bool:
+        """True when this hash exchange's all_to_all is provably the
+        identity permutation on this mesh: the child's advertised
+        partitioning is HashPartitioning on the SAME expr sequence
+        (placement for both is pmod(murmur3(exprs), n) with n = mesh
+        size — plan-level num_partitions never enters mesh placement).
+        Only exchanges originate HashPartitioning here and
+        partition-preserving operators propagate it, so the claim
+        always traces back to a collective this executor lowered."""
+        from .distribution import HashPartitioning, _expr_key
+        from ..conf import SHUFFLE_PUSH_ENABLED, SHUFFLE_PUSH_LOCAL_BYPASS
+        if not (self.conf.get(SHUFFLE_PUSH_ENABLED)
+                and self.conf.get(SHUFFLE_PUSH_LOCAL_BYPASS)):
+            return False
+        p = node.children[0].output_partitioning
+        if not isinstance(p, HashPartitioning):
+            return False
+        return ([_expr_key(e) for e in p.exprs]
+                == [_expr_key(e) for e in node.key_exprs])
 
     # ------------------------------------------------------------------
     # host side
@@ -353,6 +376,18 @@ class MeshQueryExecutor:
             return range_fn
         if node.key_exprs:
             keys = node.key_exprs
+            if self._hash_colocated(node):
+                # Locality bypass on the mesh lane: the child already
+                # placed every row by pmod(murmur3(keys), n) on THIS
+                # mesh (its partitioning came up from a lowered hash
+                # exchange on the same key sequence), so the all_to_all
+                # would be the identity permutation. Hand the
+                # shard-local batch through untouched.
+                self.colocated_exchanges.append(node.exec_id)
+                from ..obs import events as _events
+                _events.emit("MeshColocationBypass", exec_id=node.exec_id,
+                             keys=[repr(e) for e in keys])
+                return child
 
             def hash_fn(env):
                 batch = child(env)

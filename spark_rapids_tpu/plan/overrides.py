@@ -1100,6 +1100,7 @@ def apply_overrides(plan: LogicalPlan, conf: Optional[SrtConf] = None):
     _count_exchange_consumers(root)
     root = _insert_fusion(root, conf)
     root = _insert_pipeline(plan, root, conf)
+    _tag_push(root, conf)
     return root
 
 
@@ -1365,6 +1366,29 @@ def _insert_pipeline(plan: LogicalPlan, root, conf: SrtConf):
         return n
 
     return walk(root)
+
+
+def _tag_push(root, conf: SrtConf) -> None:
+    """Push-based-shuffle pass: tag every planned ShuffleExchangeExec
+    ``_push_ok`` so its map phase eagerly pushes blocks to the owning
+    reducers' endpoints (exec/exchange.py ``_push_route``). Tagged, not
+    wrapped, for the same reason as ``_pipeline_ok`` — AQE locates
+    exchanges by direct isinstance checks. Range (sort_orders)
+    exchanges are tagged too: their partition ownership follows the
+    same contiguous arithmetic. Hand-built plans that skip the planner
+    opt in by setting the attribute themselves."""
+    from ..conf import SHUFFLE_PUSH_ENABLED
+    if not conf.get(SHUFFLE_PUSH_ENABLED):
+        return
+    from ..exec.exchange import ShuffleExchangeExec
+
+    def walk(n) -> None:
+        if isinstance(n, ShuffleExchangeExec):
+            n._push_ok = True
+        for c in getattr(n, "children", []):
+            walk(c)
+
+    walk(root)
 
 
 def _count_exchange_consumers(root) -> None:

@@ -742,6 +742,147 @@ def _adaptive_check(n_workers: int = 2) -> int:
     return failures
 
 
+def _push_shuffle_check(n_workers: int = 2) -> int:
+    """Push-shuffle leg: one join+agg plan on a real cluster swept
+    across push on (eager push + segment consolidation), push off
+    (classic pull), corrupt-on-wire (receiver NAKs, sender resends),
+    corrupt-at-rest-in-segment (per-entry quarantine, pull refetches
+    exactly that block), and a worker killed mid-push (stage retry on
+    the survivor; its stale pushed segments must never serve). Every
+    sweep must produce oracle-identical results — push is replication,
+    so no push-path fault may change WHAT a query returns, only where
+    bytes travel. Returns failure count."""
+    import numpy as np
+
+    from spark_rapids_tpu.conf import SrtConf
+    from spark_rapids_tpu.expr import col
+    from spark_rapids_tpu.expr.aggregates import CountStar, Sum
+    from spark_rapids_tpu.expr.core import Alias
+    from spark_rapids_tpu.obs import events as ev
+    from spark_rapids_tpu.parallel.cluster import (ClusterDriver,
+                                                   launch_local_workers)
+    from spark_rapids_tpu.plan import TpuSession
+
+    failures = 0
+    t0 = time.monotonic()
+    with tempfile.TemporaryDirectory(prefix="srt_push_") as tmp:
+        session = TpuSession(SrtConf({}))
+        rng = np.random.default_rng(41)
+        n = 6_000
+        fact_dir = os.path.join(tmp, "fact")
+        session.create_dataframe({
+            "k": rng.integers(0, 40, n).tolist(),
+            "v": rng.uniform(0, 10, n).tolist(),
+        }).write.parquet(fact_dir)
+        dim_dir = os.path.join(tmp, "dim")
+        session.create_dataframe({
+            "k": list(range(40)),
+            "w": [float(1 + i % 5) for i in range(40)],
+        }).write.parquet(dim_dir)
+        events_dir = os.path.join(tmp, "events")
+
+        def logical(sess):
+            fact = sess.read.parquet(fact_dir)
+            dim = sess.read.parquet(dim_dir)
+            return fact.join(dim, on="k") \
+                .group_by("k").agg(Alias(Sum(col("v") * col("w")), "s"),
+                                   Alias(CountStar(), "c")) \
+                .sort("k")
+
+        def canon(rows):
+            return sorted((r["k"], r["c"], round(r["s"], 6))
+                          for r in rows)
+
+        oracle = canon(logical(TpuSession(SrtConf({}))).collect())
+
+        driver = ClusterDriver(num_workers=n_workers,
+                               barrier_timeout=60,
+                               heartbeat_interval=0.5,
+                               heartbeat_timeout=6)
+        procs = launch_local_workers(driver, n_workers)
+        base_conf = {"srt.shuffle.partitions": 4,
+                     "srt.cluster.barrierTimeoutSec": 60,
+                     "srt.eventLog.enabled": "true",
+                     "srt.eventLog.dir": events_dir}
+        # (name, extra job conf, FaultInjected site that must appear).
+        # The crash leg runs LAST: it permanently costs a worker, and
+        # the ~w=1; match pins the os._exit to worker 1's push path so
+        # the survivor (w=0) carries the stage retry.
+        legs = [
+            ("push on", {}, None),
+            ("push off", {"srt.shuffle.push.enabled": "false"}, None),
+            ("corrupt on wire",
+             {"srt.test.faultPlan":
+                  "seed=51|shuffle.block.pushwire:corrupt@1"},
+             "shuffle.block.pushwire"),
+            ("corrupt at rest in segment",
+             {"srt.test.faultPlan":
+                  "seed=53|shuffle.segment.store:corrupt@1"},
+             "shuffle.segment.store"),
+            ("worker kill mid-push",
+             {"srt.test.faultPlan": "seed=55|push.send:crash@1~w=1;"},
+             "push.send"),
+        ]
+        results = {}
+        try:
+            driver.wait_for_workers(timeout=120)
+            for name, extra, _site in legs:
+                job_conf = dict(base_conf, **extra)
+                t = time.monotonic()
+                try:
+                    rows = driver.run(logical(session).plan, job_conf)
+                except Exception as e:
+                    print(f"[chaos] FAIL [push: {name}]: job raised "
+                          f"{type(e).__name__}: {e}",
+                          file=sys.stderr, flush=True)
+                    failures += 1
+                    continue
+                results[name] = canon(rows)
+                ok = results[name] == oracle
+                print(f"[chaos] {'PASS' if ok else 'FAIL'} "
+                      f"[push: {name}] {time.monotonic() - t:.1f}s "
+                      f"workers={driver.num_workers}", flush=True)
+                if not ok:
+                    failures += 1
+        finally:
+            driver.shutdown()
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except Exception:
+                    p.kill()
+        recs = ev.read_all_events(events_dir)
+        fired = {r.get("site") for r in recs
+                 if r.get("event") == "FaultInjected"}
+        checks = [
+            # identical-recovery: flipping push on/off must not change
+            # the answer (same rows either way, both oracle-equal)
+            ("push on/off identical results",
+             "push on" in results and "push off" in results
+             and results["push on"] == results["push off"]),
+            # each fault must actually have hit the push path — a leg
+            # that silently never pushed would pass vacuously
+            ("on-wire corruption fired on push path",
+             "shuffle.block.pushwire" in fired),
+            ("at-rest segment corruption fired",
+             "shuffle.segment.store" in fired),
+            ("mid-push crash fired", "push.send" in fired),
+            ("worker loss recovered via stage retry",
+             any(e["type"] == "stage_retry"
+                 for e in driver.recovery_events)),
+        ]
+        for what, ok in checks:
+            if not ok:
+                print(f"[chaos] FAIL [push]: {what}",
+                      file=sys.stderr, flush=True)
+                failures += 1
+        print(f"[chaos] {'PASS' if not failures else 'FAIL'} "
+              f"[push: on/off/corrupt-wire/corrupt-rest/kill sweep] "
+              f"{time.monotonic() - t0:.1f}s ({len(checks)} checks)",
+              flush=True)
+    return failures
+
+
 def _rows_match(rows, oracle):
     if [r["k"] for r in rows] != [r["k"] for r in oracle]:
         return False
@@ -955,6 +1096,8 @@ def main() -> int:
     failures += _concurrency_check()
     # adaptive-execution leg: skew/demote/coalesce/speculation sweep
     failures += _adaptive_check()
+    # push-shuffle leg: eager push / segments / locality under faults
+    failures += _push_shuffle_check()
     watchdog.cancel()
     print(f"[chaos] done in {time.monotonic() - t0:.1f}s, "
           f"{failures} failure(s)", flush=True)
