@@ -781,6 +781,33 @@ HEARTBEAT_TIMEOUT_S = conf("srt.cluster.heartbeatTimeoutSec") \
          "block the heartbeat thread).") \
     .check(_positive).double(30.0)
 
+DECOMMISSION_ENABLED = conf("srt.cluster.decommission.enabled") \
+    .doc("Workers install a SIGTERM handler for graceful decommission "
+         "(Spark's spark.decommission.enabled role): on SIGTERM or a "
+         "driver 'decommission' frame the worker finishes its in-flight "
+         "job, drains pending pushes, migrates its completed map-output "
+         "blocks to a live buddy peer as replicas, and deregisters — so "
+         "a planned shutdown costs zero stage re-executions.") \
+    .boolean(True)
+
+DECOMMISSION_TIMEOUT_S = conf("srt.cluster.decommission.timeoutSec") \
+    .doc("Wall-clock budget in seconds for a decommissioning worker's "
+         "drain + block-migration phase; on expiry the remaining blocks "
+         "are abandoned to normal recovery (buddy replicas if "
+         "replicated, else stage re-execution).") \
+    .check(_positive).double(30.0)
+
+SHUFFLE_REPLICATION_FACTOR = conf("srt.shuffle.replication.factor") \
+    .doc("Copies of each completed map-output block across the cluster: "
+         "1 keeps the origin worker authoritative (classic); 2 also "
+         "pushes every block to a deterministic buddy worker over the "
+         "eager-push framing, so a hard worker kill degrades to a "
+         "buddy replica fetch instead of a stage re-execution. Replicas "
+         "are addressed by (origin, shuffle, map, reduce) and never "
+         "serve normal fetches, so map-id collisions across workers "
+         "are impossible.") \
+    .check(lambda v: None if v >= 1 else "must be >= 1").integer(1)
+
 FAULT_PLAN_SPEC = conf("srt.test.faultPlan") \
     .doc("Fault-injection plan spec (robustness/faults.py grammar), "
          "armed in every process that executes with this conf — cluster "
@@ -945,11 +972,11 @@ QUERY_TIMEOUT_S = conf("srt.sql.queryTimeout") \
     .check(_non_negative).commonly_used().double(0.0)
 
 SHUFFLE_HEARTBEAT_TIMEOUT_S = conf("srt.shuffle.heartbeat.timeoutSec") \
-    .doc("Seconds of heartbeat silence before the shuffle heartbeat "
-         "manager declares an executor dead and its map outputs "
-         "unfetchable (standalone shuffle service default; cluster "
-         "runs pass srt.cluster.heartbeatTimeoutSec through instead).") \
-    .check(_positive).double(60.0)
+    .doc("DEPRECATED alias for srt.cluster.heartbeatTimeoutSec (the "
+         "standalone shuffle service and the cluster driver once read "
+         "different keys). Setting it forwards to the new key and warns "
+         "once per process.") \
+    .check(_positive).double(30.0)
 
 
 # (key, replacement) pairs resolved in SrtConf.__init__: the old key's
@@ -957,6 +984,7 @@ SHUFFLE_HEARTBEAT_TIMEOUT_S = conf("srt.shuffle.heartbeat.timeoutSec") \
 # once-per-process deprecation warning.
 _DEPRECATED_ALIASES = {
     "srt.sql.adaptiveBroadcastRows": "srt.sql.adaptive.autoBroadcastJoinRows",
+    "srt.shuffle.heartbeat.timeoutSec": "srt.cluster.heartbeatTimeoutSec",
 }
 _ALIAS_WARNED: set = set()
 

@@ -54,6 +54,22 @@ def next_shuffle_id() -> int:
         return next(_SHUFFLE_IDS)
 
 
+def seed_shuffle_ids(base: int) -> None:
+    """Restart the local shuffle-id counter at ``base``.
+
+    Shuffle ids are allocated during per-worker plan translation, so
+    peers agree on them only if their counters start from the same
+    point. A process-lifetime counter breaks the moment membership is
+    elastic: a worker that joins (or REjoins) mid-session has built
+    fewer exchanges than the veterans, its ids lag theirs, and the
+    cluster deadlocks with every worker waiting at a differently-keyed
+    stage barrier. The driver therefore ships a fresh ``sid_base``
+    with every attempt and workers re-seed before translating."""
+    global _SHUFFLE_IDS
+    with _IDS_LOCK:
+        _SHUFFLE_IDS = itertools.count(base)
+
+
 def partition_slice(pb: PartitionedBatch, i: int) -> ColumnarBatch:
     """Extract partition i of a PartitionedBatch as a standalone batch."""
     S = pb.slot_capacity
@@ -343,6 +359,7 @@ class ShuffleExchangeExec(TpuExec):
         # re-executed shards must not collide with their map ids
         map_id = ctx.cluster.map_id_base if ctx.cluster is not None else 0
         push_route = self._push_route(ctx, mgr, n_parts)
+        buddy = self._buddy_endpoint(ctx)
         bypassed_before = getattr(mgr, "bypassed_bytes", 0)
         if self.sort_orders:
             # buffer spillable, sample bounds, then partition
@@ -386,17 +403,24 @@ class ShuffleExchangeExec(TpuExec):
                         mgr.push_map_output(self.shuffle_id, map_id,
                                             push_route,
                                             who=self._push_who(ctx))
+                    if buddy is not None:
+                        mgr.replicate_map_output(self.shuffle_id,
+                                                 map_id, buddy,
+                                                 who=self._push_who(ctx))
                     self._own_map_ids.append(map_id)
                     map_id += 1
             finally:
                 for sb in held:
                     sb.close()
-            self._finish_write(ctx, mgr, push_route, bypassed_before)
+            self._finish_write(ctx, mgr, push_route, bypassed_before,
+                               buddy=buddy)
             return
         self._own_map_ids.extend(
             self._run_map_loop(ctx, mgr, n_parts, map_id,
-                               self.children[0], push_route=push_route))
-        self._finish_write(ctx, mgr, push_route, bypassed_before)
+                               self.children[0], push_route=push_route,
+                               buddy=buddy))
+        self._finish_write(ctx, mgr, push_route, bypassed_before,
+                           buddy=buddy)
 
     def _push_route(self, ctx: ExecContext, mgr,
                     n_parts: int) -> Optional[dict]:
@@ -425,13 +449,48 @@ class ShuffleExchangeExec(TpuExec):
         return (f"w={ctx.cluster.worker_id}"
                 if ctx.cluster is not None else "w=local")
 
+    def _buddy_endpoint(self, ctx: ExecContext) -> Optional[str]:
+        """Replication target for this worker's completed map output
+        under k=2 shuffle durability: the next peer in ring order.
+        None when replication is off, local mode, or there is no
+        distinct peer to hold the copy."""
+        from ..conf import SHUFFLE_REPLICATION_FACTOR
+        if (ctx.cluster is None
+                or ctx.conf.get(SHUFFLE_REPLICATION_FACTOR) < 2):
+            return None
+        peers = ctx.cluster.peers
+        if len(peers) < 2:
+            return None
+        return peers[(ctx.cluster.worker_id + 1) % len(peers)]
+
+    @staticmethod
+    def _replica_targets(ctx: ExecContext) -> Optional[dict]:
+        """origin endpoint -> its ring buddy, handed to the fetch path
+        as a last-resort fallback. Always populated in multi-worker
+        clusters — with replication off (or an incomplete replica set)
+        the buddy answers "no coverage" and the reader falls back to
+        the normal stage-retry path, so the only cost is one extra
+        round-trip on an already-failing fetch."""
+        if ctx.cluster is None:
+            return None
+        peers = ctx.cluster.peers
+        n = len(peers)
+        if n < 2:
+            return None
+        return {peers[i]: peers[(i + 1) % n] for i in range(n)}
+
     def _finish_write(self, ctx: ExecContext, mgr, push_route,
-                      bypassed_before: int) -> None:
+                      bypassed_before: int, buddy=None) -> None:
         """Map phase epilogue: drain in-flight pushes BEFORE the stage
         barrier can release readers, and report bytes that took the
-        zero-copy local channel."""
-        if push_route is not None:
+        zero-copy local channel. With a replication buddy, the replica
+        manifest publishes AFTER the drain (so it only ever vouches for
+        blocks that actually landed) and BEFORE the barrier report (so
+        any map id a reader can learn about is covered)."""
+        if push_route is not None or buddy is not None:
             mgr.drain_pushes()
+        if buddy is not None:
+            mgr.publish_replica_manifest(self.shuffle_id, buddy)
         bypassed = getattr(mgr, "bypassed_bytes", 0) - bypassed_before
         if bypassed > 0:
             m = ctx.metrics_for(self.exec_id)
@@ -441,7 +500,8 @@ class ShuffleExchangeExec(TpuExec):
 
     def _run_map_loop(self, ctx: ExecContext, mgr, n_parts: int,
                       map_id: int, child: TpuExec,
-                      push_route: Optional[dict] = None) -> List[int]:
+                      push_route: Optional[dict] = None,
+                      buddy: Optional[str] = None) -> List[int]:
         """Drain ``child``, partition every batch, write blocks under
         ascending map ids from ``map_id``; returns the ids written.
         Shared by the normal (non-range) map phase and speculative
@@ -487,6 +547,9 @@ class ShuffleExchangeExec(TpuExec):
                 # still computing
                 mgr.push_map_output(self.shuffle_id, map_id, push_route,
                                     who=self._push_who(ctx))
+            if buddy is not None:
+                mgr.replicate_map_output(self.shuffle_id, map_id, buddy,
+                                         who=self._push_who(ctx))
             written.append(map_id)
             map_id += 1
         return written
@@ -508,14 +571,19 @@ class ShuffleExchangeExec(TpuExec):
         n_parts = self._effective_parts(ctx)
         mgr.register_shuffle(self.shuffle_id, n_parts)
         push_route = self._push_route(ctx, mgr, n_parts)
+        buddy = self._buddy_endpoint(ctx)
         written = self._run_map_loop(ctx, mgr, n_parts, map_id_base,
                                      self.children[0],
-                                     push_route=push_route)
-        if push_route is not None:
+                                     push_route=push_route, buddy=buddy)
+        if push_route is not None or buddy is not None:
             # speculative pushes drain before the result reports: the
             # winners filter applies at segment-index granularity, so a
             # losing worker's pushed entries are simply never consumed
             mgr.drain_pushes()
+        if buddy is not None:
+            # re-publish: the manifest must cover the speculative maps
+            # before their ids can reach the driver's commit
+            mgr.publish_replica_manifest(self.shuffle_id, buddy)
         return written
 
     def _release(self, mgr) -> None:
@@ -727,7 +795,8 @@ class ShuffleExchangeExec(TpuExec):
                     yield from fetch_all_partitions(
                         peers, self.shuffle_id, reduce_id, map_mod=mm,
                         endpoint_resolver=resolver, allowed=allowed,
-                        manager=mgr, metrics_cb=on_block)
+                        manager=mgr, metrics_cb=on_block,
+                        replicas=self._replica_targets(ctx))
             for gi in ctx.cluster.assigned(len(groups), dsid):
                 yield self._maybe_prefetch(
                     ctx, lambda _gi=gi: remote_group(_gi, groups[_gi]),
@@ -773,12 +842,11 @@ class ShuffleExchangeExec(TpuExec):
 
             def remote_read(reduce_id):
                 ctx.partition_id = reduce_id
-                yield from fetch_all_partitions(peers, self.shuffle_id,
-                                                reduce_id,
-                                                endpoint_resolver=resolver,
-                                                allowed=allowed,
-                                                manager=mgr,
-                                                metrics_cb=on_block)
+                yield from fetch_all_partitions(
+                    peers, self.shuffle_id, reduce_id,
+                    endpoint_resolver=resolver, allowed=allowed,
+                    manager=mgr, metrics_cb=on_block,
+                    replicas=self._replica_targets(ctx))
             for reduce_id in ctx.cluster.assigned(n_parts, dsid):
                 yield self._maybe_prefetch(
                     ctx, lambda rid=reduce_id: remote_read(rid),

@@ -44,6 +44,8 @@ EVENT_TYPES = (
     "AdmissionAbandoned", "QueryCancelled", "DeadlineExceeded",
     "CrossQuerySpill", "PrefetchThreadLeak", "ClusterCancelBroadcast",
     "AdaptivePlanChanged", "SkewSplit", "SpeculativeTask",
+    "WorkerDecommissioned", "BlockMigrated", "ZombieFenced",
+    "ReplicaFetch", "RecoveryTimed",
 )
 
 

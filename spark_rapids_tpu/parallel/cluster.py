@@ -100,6 +100,33 @@ class WorkerLost(RuntimeError):
         self.worker_id = worker_id
 
 
+class _DecommissionRequested(BaseException):
+    """Raised by the worker's SIGTERM handler to interrupt the IDLE
+    control-socket recv (BaseException: must not be swallowed by a
+    generic except). Mid-job, the handler only sets the flag — the job
+    finishes and replies first."""
+
+
+class RecoveryTimer:
+    """Failure-detection → first-post-recovery-result span. Stamped at
+    the moment the driver classifies a failure; ``finish`` observes the
+    ``recovery_time_ns`` histogram and emits a RecoveryTimed event —
+    the chaos legs' recovery-budget assertion hook."""
+
+    def __init__(self, kind: str):
+        self.kind = kind
+        self.t0 = time.perf_counter_ns()
+
+    def finish(self, **attrs) -> int:
+        dt = time.perf_counter_ns() - self.t0
+        from ..obs import events as _events
+        from ..obs import registry as _registry
+        _registry.observe("recovery_time_ns", dt, "ns")
+        _events.emit("RecoveryTimed", kind=self.kind,
+                     recovery_time_ns=dt, **attrs)
+        return dt
+
+
 class StageRetryFailed(RuntimeError):
     """A survivor could not satisfy a stage-level retry (its recorded
     job state is gone or from another job) — fall back to whole-job."""
@@ -131,8 +158,13 @@ class ClusterTaskContext:
                  fresh_ids: Optional[List[int]] = None,
                  shard_mod: Optional[int] = None,
                  map_id_base: int = 0, attempt: int = 0,
-                 assign: Optional[List[List[int]]] = None):
+                 assign: Optional[List[List[int]]] = None,
+                 epoch: int = 0):
         self.worker_id = worker_id
+        #: incarnation epoch assigned at registration; rides every
+        #: barrier/gather frame so the driver can fence a zombie
+        #: predecessor after eviction/decommission/rejoin
+        self.epoch = epoch
         self.num_workers = num_workers
         self.peers = peers  # shuffle endpoints "host:port", worker order
         self.driver_addr = driver_addr
@@ -254,7 +286,8 @@ class ClusterTaskContext:
         except Exception:
             spec_on = False
         msg: dict = {"type": "barrier", "shuffle_id": shuffle_id,
-                     "worker": self.worker_id, "pos": pos}
+                     "worker": self.worker_id, "pos": pos,
+                     "epoch": self.epoch}
         if detail is not None:
             msg["detail"] = dict(detail)
             msg["map_ids"] = sorted({m for (m, _r) in detail})
@@ -292,6 +325,7 @@ class ClusterTaskContext:
                     spec_ids, spec_detail = [], {}
                 msg = {"type": "barrier", "shuffle_id": shuffle_id,
                        "worker": self.worker_id, "pos": pos,
+                       "epoch": self.epoch,
                        "speculation": True, "spec_report": True,
                        "spec_failed": failed, "unit": unit,
                        "detail": spec_detail,
@@ -310,7 +344,8 @@ class ClusterTaskContext:
         with socket.create_connection(self.driver_addr,
                                       timeout=self._timeout()) as s:
             _send_msg(s, {"type": "gather", "key": key,
-                          "worker": self.worker_id, "payload": payload})
+                          "worker": self.worker_id, "payload": payload,
+                          "epoch": self.epoch})
             reply = _recv_msg(s)
         if not reply or reply.get("type") != "gathered":
             raise RuntimeError(f"gather {key} failed: {reply!r}")
@@ -473,6 +508,20 @@ class ClusterWorker:
         #: {"token": job_token, "sids": [sid by position],
         #:  "bounds": {sid: bounds_rows}}
         self._last_job: Optional[dict] = None
+        # --- graceful decommission state (SIGTERM or driver frame) ---
+        self._decommission = threading.Event()
+        #: True only while the control thread is blocked in the IDLE
+        #: recv — the one place the SIGTERM handler may raise to
+        #: interrupt (mid-job it just sets the event; the job replies
+        #: first and the loop picks the flag up after)
+        self._idle_wait = False
+        self._executor_id: Optional[str] = None
+        self._epoch = 0
+        #: the last job's peer list + own index — the decommission path
+        #: computes its ring buddy from these (replicas already live
+        #: there under k=2 replication)
+        self._last_peers: List[str] = []
+        self._last_worker_id = 0
 
     def _heartbeat_loop(self, executor_id: str, interval: float,
                         stop: threading.Event) -> None:
@@ -480,7 +529,10 @@ class ClusterWorker:
         owned by the job dialogue). A ``drop`` fault skips one beat; a
         ``delay`` fault models a slow peer; killing this thread (any
         other injected error) models a silently wedged worker."""
-        while not stop.wait(interval):
+        import random
+        # ±10% jitter: a fleet of workers started together must not
+        # phase-lock their beats into synchronized driver load spikes
+        while not stop.wait(interval * random.uniform(0.9, 1.1)):
             try:
                 fault_point("cluster.heartbeat",
                             f"executor={executor_id};")
@@ -497,17 +549,53 @@ class ClusterWorker:
             except OSError:
                 pass  # driver unreachable; the main loop will notice
 
+    def _on_sigterm(self, signum, frame) -> None:
+        self._decommission.set()
+        if self._idle_wait:
+            raise _DecommissionRequested()
+
+    def _recv_ctl(self, s: socket.socket):
+        """Idle control-socket recv, interruptible by SIGTERM: the
+        handler's raise (or an already-set flag) converts to a
+        synthetic ``decommission`` frame."""
+        if self._decommission.is_set():
+            return {"type": "decommission", "reason": "sigterm"}
+        self._idle_wait = True
+        try:
+            return _recv_msg(s)
+        except _DecommissionRequested:
+            return {"type": "decommission", "reason": "sigterm"}
+        finally:
+            self._idle_wait = False
+
     def run_forever(self) -> None:
         """Register, then serve job requests until shutdown."""
+        from ..conf import DECOMMISSION_ENABLED, active_conf
+        if active_conf().get(DECOMMISSION_ENABLED) and \
+                threading.current_thread() is threading.main_thread():
+            import signal
+            try:
+                signal.signal(signal.SIGTERM, self._on_sigterm)
+            except (ValueError, OSError):
+                pass  # exotic embedding: SIGTERM stays default
         stop_hb = threading.Event()
         try:
             with socket.create_connection(self.driver_addr,
                                           timeout=120) as s:
-                _send_msg(s, {"type": "register",
-                              "shuffle_endpoint": self.server.endpoint})
+                reg: dict = {"type": "register",
+                             "shuffle_endpoint": self.server.endpoint}
+                # rejoin: declare which dead incarnation's endpoint
+                # this process replaces — the driver re-points block
+                # ownership and fences the predecessor's epoch
+                prior = os.environ.get("SRT_REJOIN_ENDPOINT")
+                if prior:
+                    reg["prior_endpoint"] = prior
+                _send_msg(s, reg)
                 msg = _recv_msg(s)
                 if isinstance(msg, dict) and \
                         msg.get("type") == "registered":
+                    self._executor_id = msg["executor_id"]
+                    self._epoch = int(msg.get("epoch", 0))
                     hb = threading.Thread(
                         target=self._heartbeat_loop,
                         args=(msg["executor_id"],
@@ -515,7 +603,7 @@ class ClusterWorker:
                               stop_hb),
                         daemon=True)
                     hb.start()
-                    msg = _recv_msg(s)
+                    msg = self._recv_ctl(s)
                 #: control frames the mid-job cancel listener consumed
                 #: early — replayed in order once the job has replied,
                 #: preserving the pre-listener queue-in-socket semantics
@@ -529,6 +617,10 @@ class ClusterWorker:
                         # into the re-run) and forget the job record
                         for sid in list(self.manager._registered):
                             self.manager.unregister_shuffle(sid)
+                        # held replicas too: a fresh run's shuffle ids
+                        # restart from the same counter, so a stale
+                        # replica under a recycled sid must not survive
+                        self.manager.replicas.clear()
                         self._last_job = None
                         _send_msg(s, {"type": "reset_done"})
                     elif msg["type"] == "prepare_retry":
@@ -543,13 +635,98 @@ class ClusterWorker:
                         # replied (the broadcast raced our result) —
                         # nothing to do, stay in protocol sync
                         pass
+                    elif msg["type"] == "decommission":
+                        self._decommission_now(
+                            s, msg.get("reason") or "driver request")
+                        return
                     elif msg["type"] == "job":
                         alive = self._serve_job(s, msg, pending)
                         if not alive:
                             return
-                    msg = pending.pop(0) if pending else _recv_msg(s)
+                    msg = (pending.pop(0) if pending
+                           else self._recv_ctl(s))
         finally:
             stop_hb.set()
+
+    def _decommission_now(self, s: socket.socket, reason: str) -> None:
+        """Graceful exit: stop taking work, drain in-flight pushes,
+        migrate this worker's hot shuffle blocks to a live peer (as
+        manifest-covered replicas — the same durability path k=2
+        replication uses), then deregister. A worker SIGTERM'd mid-job
+        lands here only AFTER the job replied, so the driver never
+        loses a result to decommission."""
+        from ..conf import DECOMMISSION_TIMEOUT_S, active_conf
+        deadline = time.monotonic() + active_conf().get(
+            DECOMMISSION_TIMEOUT_S)
+        # Briefly drain queued control frames: the post-job reset must
+        # apply BEFORE migration, or we would ship a finished job's
+        # (already-freed-on-the-driver's-books) blocks to the buddy.
+        drain_until = time.monotonic() + 1.0
+        while time.monotonic() < drain_until:
+            readable, _w, _x = select.select([s], [], [], 0.1)
+            if not readable:
+                continue
+            try:
+                ctl = _recv_msg(s)
+            except OSError:
+                break
+            if ctl is None:
+                break
+            if ctl.get("type") == "reset":
+                for sid in list(self.manager._registered):
+                    self.manager.unregister_shuffle(sid)
+                self.manager.replicas.clear()
+                self._last_job = None
+                try:
+                    _send_msg(s, {"type": "reset_done"})
+                except OSError:
+                    pass
+            elif ctl.get("type") == "shutdown":
+                return
+        # announce: the driver stops assigning this worker jobs and
+        # answers with the surviving peer list (migration targets)
+        peers: List[str] = []
+        try:
+            with socket.create_connection(self.driver_addr,
+                                          timeout=10) as c:
+                _send_msg(c, {"type": "decommission_request",
+                              "executor_id": self._executor_id,
+                              "endpoint": self.server.endpoint})
+                reply = _recv_msg(c)
+            if isinstance(reply, dict):
+                peers = list(reply.get("peers") or ())
+        except OSError:
+            pass  # driver gone: nothing to migrate FOR; exit anyway
+        self.manager.drain_pushes()
+        own = self.server.endpoint
+        candidates = [p for p in peers if p != own]
+        target: Optional[str] = None
+        if self._last_peers and len(self._last_peers) > 1:
+            ring = self._last_peers[(self._last_worker_id + 1)
+                                    % len(self._last_peers)]
+            if ring in candidates:
+                target = ring  # replicas (if any) already live there
+        if target is None and candidates:
+            target = candidates[0]
+        migrated: List[int] = []
+        if target is not None:
+            migrated = self.manager.migrate_blocks(target, deadline)
+            self.manager.drain_pushes()
+            for sid in migrated:
+                self.manager.publish_replica_manifest(
+                    sid, target,
+                    timeout_s=max(1.0, deadline - time.monotonic()))
+        try:
+            with socket.create_connection(self.driver_addr,
+                                          timeout=10) as c:
+                _send_msg(c, {"type": "decommission_done",
+                              "executor_id": self._executor_id,
+                              "endpoint": own, "reason": reason,
+                              "migrated_sids": migrated,
+                              "target": target})
+                _recv_msg(c)
+        except OSError:
+            pass
 
     def _serve_job(self, s: socket.socket, msg,
                    pending: List[dict]) -> bool:
@@ -662,15 +839,27 @@ class ClusterWorker:
         attempt = msg.get("attempt", 0)
         logical_ids = msg.get("logical_ids") or [msg["worker_id"]]
         fresh_ids = msg.get("fresh_ids")
+        self._last_peers = list(msg["peers"])
+        self._last_worker_id = msg["worker_id"]
         cluster = ClusterTaskContext(
             msg["worker_id"], msg["num_workers"], msg["peers"],
             self.driver_addr, logical_ids=logical_ids,
             fresh_ids=fresh_ids if fresh_ids is not None else logical_ids,
             shard_mod=msg.get("shard_mod") or msg["num_workers"],
             map_id_base=msg.get("map_id_base", 0), attempt=attempt,
-            assign=msg.get("assign"))
+            assign=msg.get("assign"),
+            epoch=int(msg.get("epoch", self._epoch)))
         fault_point("cluster.job",
                     f"attempt={attempt};workers={cluster.lids_csv()};")
+        # shuffle ids are allocated during the translation below, and
+        # peers must agree on them: seed the counter from the driver's
+        # per-attempt base so veterans and late (re)joiners — whose
+        # process-lifetime counters have diverged — produce identical
+        # ids for the same plan
+        sid_base = msg.get("sid_base")
+        if sid_base:
+            from ..exec.exchange import seed_shuffle_ids
+            seed_shuffle_ids(int(sid_base))
         physical = overrides.apply_overrides(logical, conf)
         if _worker_has_local_relation(physical, cluster.num_workers):
             raise RuntimeError(
@@ -922,6 +1111,18 @@ class ClusterDriver:
         self._worker_eids: List[str] = []
         self._block = threading.Lock()
         self._exec_seq = 0
+        #: executor_id -> incarnation epoch (assigned at registration);
+        #: epochs of evicted/decommissioned/superseded incarnations
+        #: move to the fence set — their barrier/gather frames are
+        #: refused, so a zombie can never commit or serve blocks
+        self._epochs: Dict[str, int] = {}
+        self._fenced_epochs: Set[int] = set()
+        #: executor_id -> Event set when its decommission completes
+        self._decommissioned: Dict[str, threading.Event] = {}
+        #: per-attempt shuffle-id base shipped with every job: workers
+        #: re-seed their local allocator from it, keeping shuffle ids
+        #: identical across veterans and late (re)joiners
+        self._sid_attempts = 0
         self._heartbeats = ShuffleHeartbeatManager(
             timeout_s=self.heartbeat_timeout)
         self._registry = MapOutputRegistry()
@@ -957,17 +1158,33 @@ class ClusterDriver:
                     return
                 t = msg.get("type")
                 if t == "register":
+                    prior = msg.get("prior_endpoint")
                     with driver._block:
                         eid = f"exec-{driver._exec_seq}"
+                        epoch = driver._exec_seq + 1
                         driver._exec_seq += 1
+                        driver._epochs[eid] = epoch
+                        if prior:
+                            # rejoin: fence the incarnation that last
+                            # served this endpoint and drop its stale
+                            # control socket from the worker list
+                            old = driver._heartbeats.owner_of(prior)
+                            if old is not None and old != eid:
+                                driver._fenced_epochs.add(
+                                    driver._epochs.get(old, -1))
+                            driver._workers = [
+                                w for w in driver._workers
+                                if w[1] != prior]
                         driver._workers.append(
                             (self.request, msg["shuffle_endpoint"], eid))
                         driver._heartbeats.register(
-                            eid, msg["shuffle_endpoint"])
+                            eid, msg["shuffle_endpoint"],
+                            prior_endpoint=prior)
                         ready = (len(driver._workers)
                                  >= driver.num_workers)
                     _send_msg(self.request,
                               {"type": "registered", "executor_id": eid,
+                               "epoch": epoch,
                                "heartbeat_interval":
                                    driver.heartbeat_interval})
                     if ready:
@@ -975,6 +1192,9 @@ class ClusterDriver:
                     # keep the connection open: job dialogue reuses it
                     threading.Event().wait()  # parked; driver drives
                 elif t == "barrier":
+                    if driver._is_fenced(msg):
+                        self._refuse_fenced(msg)
+                        return
                     try:
                         # exact map-output sizes ride every barrier
                         # message: the registry's MapOutputStatistics
@@ -998,6 +1218,9 @@ class ClusterDriver:
                         return
                     _send_msg(self.request, reply)
                 elif t == "gather":
+                    if driver._is_fenced(msg):
+                        self._refuse_fenced(msg)
+                        return
                     try:
                         payloads = driver._gather(msg["key"],
                                                   msg["worker"],
@@ -1018,7 +1241,60 @@ class ClusterDriver:
                               {"type": "resolved",
                                "endpoint": driver._heartbeats.resolve(
                                    msg["endpoint"])})
+                elif t == "decommission_request":
+                    # the worker stops being schedulable NOW; it keeps
+                    # heartbeating (and serving fetches) through the
+                    # migration window that follows
+                    eid = msg.get("executor_id")
+                    with driver._block:
+                        driver._workers = [w for w in driver._workers
+                                           if w[2] != eid]
+                        driver.num_workers = len(driver._workers)
+                        peers = [ep for _s, ep, _e in driver._workers]
+                    _send_msg(self.request,
+                              {"type": "ok", "peers": peers})
+                elif t == "decommission_done":
+                    eid = msg.get("executor_id")
+                    with driver._block:
+                        driver._fenced_epochs.add(
+                            driver._epochs.get(eid, -1))
+                    driver._heartbeats.deregister(eid)
+                    migrated = list(msg.get("migrated_sids") or ())
+                    driver.recovery_events.append(
+                        {"type": "decommission", "executor": eid,
+                         "migrated_sids": migrated,
+                         "target": msg.get("target")})
+                    from ..obs import events as _events
+                    _events.emit("WorkerDecommissioned", executor=eid,
+                                 endpoint=msg.get("endpoint"),
+                                 reason=msg.get("reason"),
+                                 migrated_sids=migrated,
+                                 target=msg.get("target"))
+                    driver._decommissioned.setdefault(
+                        eid, threading.Event()).set()
+                    _send_msg(self.request, {"type": "ok"})
+
+            def _refuse_fenced(self, msg) -> None:
+                from ..obs import events as _events
+                _events.emit("ZombieFenced", epoch=msg.get("epoch"),
+                             mtype=msg.get("type"),
+                             worker=msg.get("worker"))
+                try:
+                    _send_msg(self.request,
+                              {"type": "fenced",
+                               "error": "fenced: stale incarnation "
+                                        "epoch"})
+                except OSError:
+                    pass
         return Handler
+
+    def _is_fenced(self, msg) -> bool:
+        """True when the frame carries a fenced incarnation epoch —
+        checked BEFORE any registry write, so a zombie predecessor can
+        neither commit map output nor join a sync point. Frames with no
+        epoch (older workers) are treated as live."""
+        ep = msg.get("epoch")
+        return ep is not None and ep in self._fenced_epochs
 
     def _barrier(self, shuffle_id, pos: int = -1) -> None:
         with self._block:
@@ -1224,6 +1500,12 @@ class ClusterDriver:
                   file=sys.stderr, flush=True)
             self.recovery_events.append({"type": "heartbeat_eviction",
                                          "executors": sorted(dead)})
+            with self._block:
+                for eid in dead:
+                    # fence the evicted incarnation: if it was merely
+                    # wedged (not dead) and wakes up, its frames must
+                    # not corrupt the retry's registry state
+                    self._fenced_epochs.add(self._epochs.get(eid, -1))
             from ..obs import events as _events
             _events.emit("WorkerEvicted", executors=sorted(dead))
             self._abort_sync()
@@ -1305,12 +1587,19 @@ class ClusterDriver:
         try:
             last: Optional[BaseException] = None
             retry_spec: Optional[dict] = None
+            rec_timer: Optional[RecoveryTimer] = None
             from ..robustness.admission import QueryInterrupted
             for attempt in range(max_retries + 1):
                 try:
-                    return self._run_once(logical_plan, conf_settings,
-                                          job_token, attempt, retry_spec,
-                                          trace_ctx)
+                    out = self._run_once(logical_plan, conf_settings,
+                                         job_token, attempt, retry_spec,
+                                         trace_ctx)
+                    if rec_timer is not None:
+                        # failure detection → first post-recovery
+                        # result: the recovery span chaos legs budget
+                        rec_timer.finish(job_token=job_token,
+                                         attempt=attempt)
+                    return out
                 except QueryInterrupted:
                     # typed cancel/deadline — NOT a failure to retry:
                     # stop the rest of the fleet and drain the aborted
@@ -1321,6 +1610,8 @@ class ClusterDriver:
                 except StageRetryFailed as e:
                     last = e
                     retry_spec = None
+                    if rec_timer is None:
+                        rec_timer = RecoveryTimer("job_retry")
                     self.recovery_events.append({"type": "job_retry",
                                                  "cause": str(e)})
                     _events.emit("RetryAttempt", scope="job",
@@ -1330,6 +1621,10 @@ class ClusterDriver:
                 except WorkerLost as e:
                     last = e
                     retry_spec = self._plan_stage_retry(job_token)
+                    if rec_timer is None:
+                        rec_timer = RecoveryTimer(
+                            "stage_retry" if retry_spec is not None
+                            else "job_retry")
                     if retry_spec is not None:
                         _events.emit("RetryAttempt", scope="stage",
                                      job_token=job_token, attempt=attempt,
@@ -1409,10 +1704,16 @@ class ClusterDriver:
                      attempt=attempt, num_workers=n, assign=assign,
                      reused_positions=reusable)
         blob = cloudpickle.dumps(logical_plan)
+        # 4096 ids of headroom per attempt covers any plan's exchange
+        # count plus AQE/speculative re-allocations within the job
+        self._sid_attempts += 1
+        sid_base = self._sid_attempts * 4096 + 1
         for w, (sock, _ep, _eid) in enumerate(workers):
             try:
                 with self._ctl_send_lock:
                     _send_msg(sock, {"type": "job", "plan": blob,
+                                     "epoch": self._epochs.get(_eid, 0),
+                                     "sid_base": sid_base,
                                      "conf": dict(conf_settings or {}),
                                      "worker_id": w,
                                      "num_workers": n,
@@ -1547,6 +1848,7 @@ class ClusterDriver:
                 ok = False
             if ok:
                 alive.append((sock, ep, eid))
+        self._fence_pruned(alive)
         if not alive:
             self._workers = []
             self.num_workers = 0
@@ -1624,8 +1926,66 @@ class ClusterDriver:
                     sock.settimeout(None)
             except OSError:
                 pass
+        self._fence_pruned(alive)
         self._workers = alive
         self.num_workers = len(alive)
+
+    def _fence_pruned(self, alive: List[Tuple[socket.socket, str, str]]
+                      ) -> None:
+        """Fence every worker about to be dropped from the roster: a
+        pruned-but-breathing process (hung, paused, partitioned) must
+        not commit into the attempt that replaces it."""
+        alive_eids = {eid for _s, _ep, eid in alive}
+        pruned = []
+        with self._block:
+            for _s, _ep, eid in self._workers:
+                if eid not in alive_eids:
+                    self._fenced_epochs.add(self._epochs.get(eid, -1))
+                    pruned.append(eid)
+        if pruned:
+            # socket-close detection beats the heartbeat monitor when
+            # the death happens mid-dialogue; the eviction is just as
+            # real, so it gets the same event
+            from ..obs import events as _events
+            _events.emit("WorkerEvicted", executors=sorted(pruned),
+                         detection="socket")
+
+    def decommission(self, executor_id: Optional[str] = None,
+                     timeout: float = 60.0) -> bool:
+        """Ask one worker (default: the last-registered) to gracefully
+        decommission: it finishes any in-flight job, migrates its hot
+        shuffle blocks to a live peer, deregisters, and exits. Returns
+        True once the worker's ``decommission_done`` lands."""
+        with self._block:
+            targets = list(self._workers)
+        if executor_id is not None:
+            targets = [t for t in targets if t[2] == executor_id]
+        if not targets:
+            return False
+        sock, _ep, eid = targets[-1]
+        ev = self._decommissioned.setdefault(eid, threading.Event())
+        try:
+            with self._ctl_send_lock:
+                _send_msg(sock, {"type": "decommission",
+                                 "reason": "driver request"})
+        except OSError:
+            return False
+        return ev.wait(timeout)
+
+    def wait_for_n_workers(self, n: int, timeout: float = 60.0) -> None:
+        """Block until ``n`` workers are registered — the rejoin/elastic
+        counterpart of ``wait_for_workers`` (which waits for the
+        roster's ORIGINAL size)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._block:
+                if len(self._workers) >= n:
+                    self.num_workers = len(self._workers)
+                    return
+                have = len(self._workers)
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"{have}/{n} workers registered")
+            time.sleep(0.05)
 
     def shutdown(self) -> None:
         self._stop.set()
@@ -1663,7 +2023,11 @@ def launch_local_workers(driver: ClusterDriver, n: int,
         # cache grows). Logs go to files for post-mortem instead.
         log_path = os.path.join(tempfile.gettempdir(),
                                 f"srt_worker_{os.getpid()}_{i}.log")
-        log_f = open(log_path, "wb")
+        # append: elastic clusters launch replacements from the same
+        # driver pid, and truncating would destroy the incumbent's log
+        # (it still holds the old fd, so both would interleave into a
+        # truncated file)
+        log_f = open(log_path, "ab")
         procs.append(subprocess.Popen(
             [sys.executable, "-m", "spark_rapids_tpu.parallel.cluster",
              "--driver", f"{host}:{port}"],

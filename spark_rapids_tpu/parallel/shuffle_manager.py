@@ -127,6 +127,12 @@ class HostBlockStore:
             return sorted(b for b in self._blocks
                           if b[0] == shuffle_id and b[2] == reduce_id)
 
+    def blocks_for_map(self, shuffle_id: int,
+                       map_id: int) -> List[BlockId]:
+        with self._lock:
+            return sorted(b for b in self._blocks
+                          if b[0] == shuffle_id and b[1] == map_id)
+
     def remove_shuffle(self, shuffle_id: int) -> int:
         with self._lock:
             gone = [b for b in self._blocks if b[0] == shuffle_id]
@@ -385,6 +391,110 @@ class MapOutputStatistics:
         return cls(shuffle_id, nparts, rows_by, bytes_by, detail)
 
 
+class ReplicaStore:
+    """Buddy copies of OTHER workers' completed map output, keyed
+    ``(origin_endpoint, shuffle_id, map_id, reduce_id)`` — origin is
+    part of the key because map ids are only unique per worker (every
+    worker numbers maps from the same ``attempt << 20`` base), so
+    merging replicas into the host store would silently collide.
+    Replicas never feed normal fetches, statistics, or local reads;
+    they serve only origin-addressed replica fetches (transport
+    MAGIC_FETCH_REPL) issued by a reader whose pull from the origin
+    failed terminally. Entries keep their integrity framing so the
+    checksum travels with the bytes."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._blocks: Dict[Tuple[str, int, int, int], bytes] = {}
+        #: (origin, shuffle_id) -> {reduce_id: (map ids...)} — what a
+        #: COMPLETE replica set contains, published by the origin only
+        #: AFTER its replica pushes drained. Replica pushes are
+        #: best-effort (a dead buddy or timeout silently drops one), so
+        #: without the manifest a buddy fetch could serve a partial
+        #: partition as if it were whole. No manifest, or a manifest
+        #: block missing from the store -> no coverage -> the reader
+        #: falls back to stage retry.
+        self._manifests: Dict[Tuple[str, int],
+                              Dict[int, Tuple[int, ...]]] = {}
+        self.bytes_stored = 0
+        self.blocks_stored = 0
+
+    def put(self, origin: str, shuffle_id: int, map_id: int,
+            reduce_id: int, framed: bytes) -> None:
+        with self._lock:
+            key = (origin, shuffle_id, map_id, reduce_id)
+            prev = self._blocks.get(key)
+            self._blocks[key] = framed
+            self.bytes_stored += len(framed) - (len(prev) if prev else 0)
+            if prev is None:
+                self.blocks_stored += 1
+
+    def put_manifest(self, origin: str, shuffle_id: int,
+                     manifest: Dict[int, Tuple[int, ...]]) -> None:
+        with self._lock:
+            self._manifests[(origin, shuffle_id)] = {
+                int(r): tuple(sorted(ms))
+                for r, ms in manifest.items()}
+
+    def coverage(self, origin: str, shuffle_id: int, reduce_id: int
+                 ) -> Optional[List[Tuple[int, bytes]]]:
+        """The COMPLETE replica set for one (origin, reduce) — (map_id,
+        framed) in map order — or None when this store cannot vouch for
+        completeness (no manifest from the origin, or a manifest block
+        that never arrived). An empty list is a real answer: the origin
+        produced no blocks for this partition."""
+        with self._lock:
+            man = self._manifests.get((origin, shuffle_id))
+            if man is None:
+                return None
+            out: List[Tuple[int, bytes]] = []
+            for map_id in man.get(reduce_id, ()):
+                framed = self._blocks.get(
+                    (origin, shuffle_id, map_id, reduce_id))
+                if framed is None:
+                    return None
+                out.append((map_id, framed))
+            return out
+
+    def drop(self, origin: str, shuffle_id: int, map_id: int,
+             reduce_id: int) -> None:
+        with self._lock:
+            prev = self._blocks.pop(
+                (origin, shuffle_id, map_id, reduce_id), None)
+            if prev is not None:
+                self.bytes_stored -= len(prev)
+                self.blocks_stored -= 1
+
+    def remove_shuffle(self, shuffle_id: int) -> None:
+        with self._lock:
+            for k in [k for k in self._blocks if k[1] == shuffle_id]:
+                self.bytes_stored -= len(self._blocks[k])
+                self.blocks_stored -= 1
+                del self._blocks[k]
+            for k in [k for k in self._manifests if k[1] == shuffle_id]:
+                del self._manifests[k]
+
+    def rename_shuffle(self, old_id: int, new_id: int) -> None:
+        with self._lock:
+            for k in [k for k in self._blocks if k[1] == old_id]:
+                self._blocks[(k[0], new_id, k[2], k[3])] = \
+                    self._blocks.pop(k)
+            for k in [k for k in self._manifests if k[1] == old_id]:
+                self._manifests[(k[0], new_id)] = \
+                    self._manifests.pop(k)
+
+    def clear(self) -> None:
+        """Drop everything — replicas have no cross-job value (shuffle
+        ids are fresh per attempt), and a rejoined worker process
+        restarts its shuffle-id counter, so stale entries from an
+        earlier incarnation could otherwise collide with new sids."""
+        with self._lock:
+            self._blocks.clear()
+            self._manifests.clear()
+            self.bytes_stored = 0
+            self.blocks_stored = 0
+
+
 class ShuffleManager:
     """getWriter/getReader surface over the mode-selected store."""
 
@@ -406,6 +516,7 @@ class ShuffleManager:
         self.catalog = ShuffleBlockCatalog()
         self.host_store = HostBlockStore()
         self.segments = SegmentStore()
+        self.replicas = ReplicaStore()
         #: this process's shuffle-server endpoint ("host:port"), set by
         #: ShuffleBlockServer — the ORIGIN stamped on every pushed block
         #: (map ids are only unique per peer, so segment entries key on
@@ -448,6 +559,7 @@ class ShuffleManager:
         self.catalog.remove_shuffle(shuffle_id)
         self.host_store.remove_shuffle(shuffle_id)
         self.segments.remove_shuffle(shuffle_id)
+        self.replicas.remove_shuffle(shuffle_id)
         with self._lock:
             self._registered.pop(shuffle_id, None)
             self._poisoned_sids.discard(shuffle_id)
@@ -485,6 +597,7 @@ class ShuffleManager:
         fresh shuffle id instead of recomputing them."""
         moved = self.host_store.rename_shuffle(old_id, new_id)
         self.segments.rename_shuffle(old_id, new_id)
+        self.replicas.rename_shuffle(old_id, new_id)
         with self._lock:
             if old_id in self._poisoned_sids:  # defensive: reuse of a
                 self._poisoned_sids.discard(old_id)  # poisoned sid is
@@ -591,6 +704,112 @@ class ShuffleManager:
                         framed, origin, who=who)
             pushed += 1
         return pushed
+
+    def replicate_map_output(self, shuffle_id: int, map_id: int,
+                             buddy: str, who: str = "") -> int:
+        """Conf-gated k=2 durability (srt.shuffle.replication.factor):
+        push EVERY block of this completed map — all reduce partitions,
+        including the ones this worker owns — to ``buddy``'s replica
+        store, so a hard kill of this worker degrades to a buddy fetch
+        instead of a stage re-execution. Reuses the eager-push framing
+        and integrity checksums; a failed replica push silently leaves
+        that block at k=1 (stage retry still covers it). Returns blocks
+        enqueued; the caller's drain covers them."""
+        if self.mode != "MULTITHREADED":
+            return 0
+        origin = self.local_endpoint
+        if not origin or not buddy or buddy == origin:
+            return 0
+        pusher = self._get_pusher()
+        pushed = 0
+        for block in sorted(self.host_store.blocks_for_map(shuffle_id,
+                                                           map_id)):
+            framed = self.host_store.get(block)
+            if framed is None:
+                continue
+            with self._lock:
+                rows = self._part_rows.get(block, 0)
+            pusher.push(buddy, shuffle_id, block[2], map_id, rows,
+                        framed, origin, who=who, replica=True)
+            pushed += 1
+        return pushed
+
+    def publish_replica_manifest(self, shuffle_id: int, buddy: str,
+                                 timeout_s: float = 30.0) -> bool:
+        """After this shuffle's replica pushes drained: tell ``buddy``
+        exactly which blocks a COMPLETE replica set of this origin
+        contains ({reduce: (map ids...)}, read from the host store).
+        The buddy only answers replica fetches for partitions where it
+        holds every manifest block — so a silently dropped best-effort
+        push degrades coverage to none (stage retry) instead of to a
+        partial partition (wrong rows). Synchronous single attempt;
+        False means the buddy never learned of these replicas."""
+        if self.mode != "MULTITHREADED":
+            return False
+        origin = self.local_endpoint
+        if not origin or not buddy or buddy == origin:
+            return False
+        with self._lock:
+            nparts = self._registered.get(shuffle_id)
+        if nparts is None or self.is_poisoned(shuffle_id):
+            return False
+        manifest = {
+            rid: tuple(b[1] for b in self.host_store.blocks_for_reduce(
+                shuffle_id, rid))
+            for rid in range(nparts)}
+        import pickle
+        framed = integrity.wrap(pickle.dumps(manifest))
+        from .transport import _MANIFEST_MAP_ID, _push_once
+        try:
+            return _push_once(buddy, shuffle_id, 0, _MANIFEST_MAP_ID,
+                              0, framed, origin, timeout_s,
+                              replica=True)
+        except OSError:
+            return False
+
+    def migrate_blocks(self, target: str, deadline: float) -> List[int]:
+        """Graceful-decommission block migration: replica-push every
+        registered, non-poisoned shuffle's host-store blocks (this
+        worker's own completed map output — received push segments need
+        no migration, their origins stay authoritative) to ``target``,
+        stopping at ``deadline`` (time.monotonic). Returns the shuffle
+        ids migrated and emits one BlockMigrated event per shuffle; the
+        caller must drain the pusher and then publish_replica_manifest
+        for each returned sid — without the manifest the buddy will
+        never vouch for (or serve) these replicas."""
+        origin = self.local_endpoint
+        if (self.mode != "MULTITHREADED" or not origin or not target
+                or target == origin):
+            return []
+        from ..obs import events as _events
+        pusher = self._get_pusher()
+        migrated: List[int] = []
+        with self._lock:
+            registered = dict(self._registered)
+        for sid, nparts in sorted(registered.items()):
+            if self.is_poisoned(sid):
+                continue
+            moved = 0
+            for rid in range(nparts):
+                if time.monotonic() >= deadline:
+                    break
+                for block in self.host_store.blocks_for_reduce(sid, rid):
+                    framed = self.host_store.get(block)
+                    if framed is None:
+                        continue
+                    with self._lock:
+                        rows = self._part_rows.get(block, 0)
+                    pusher.push(target, sid, rid, block[1], rows,
+                                framed, origin, who="decommission",
+                                replica=True)
+                    moved += 1
+            if time.monotonic() >= deadline:
+                break
+            if moved:
+                _events.emit("BlockMigrated", shuffle_id=sid,
+                             blocks=moved, target=target, origin=origin)
+            migrated.append(sid)
+        return migrated
 
     def drain_pushes(self, timeout_s: float = 30.0) -> bool:
         """Block until every enqueued push acked, failed, or timed out
@@ -785,10 +1004,12 @@ class ShuffleHeartbeatManager:
 
     def __init__(self, timeout_s: Optional[float] = None):
         if timeout_s is None:
-            # standalone default from conf; cluster runs pass the
-            # driver's srt.cluster.heartbeatTimeoutSec through instead
-            from ..conf import SHUFFLE_HEARTBEAT_TIMEOUT_S, active_conf
-            timeout_s = active_conf().get(SHUFFLE_HEARTBEAT_TIMEOUT_S)
+            # standalone default from conf; the cluster driver passes
+            # its own srt.cluster.heartbeatTimeoutSec through instead.
+            # srt.shuffle.heartbeat.timeoutSec is a deprecated alias
+            # that forwards to the same key.
+            from ..conf import HEARTBEAT_TIMEOUT_S, active_conf
+            timeout_s = active_conf().get(HEARTBEAT_TIMEOUT_S)
         self.timeout_s = timeout_s
         self._executors: Dict[str, ExecutorInfo] = {}
         #: every endpoint an executor EVER served from -> executor_id;
@@ -797,15 +1018,42 @@ class ShuffleHeartbeatManager:
         self._aliases: Dict[str, str] = {}
         self._lock = threading.Lock()
 
-    def register(self, executor_id: str, endpoint: str) -> List[ExecutorInfo]:
+    def register(self, executor_id: str, endpoint: str,
+                 prior_endpoint: Optional[str] = None
+                 ) -> List[ExecutorInfo]:
         """Returns the current peer list (what a new executor needs to
-        open connections)."""
+        open connections). ``prior_endpoint`` declares this executor
+        the REPLACEMENT of whichever executor last served that
+        endpoint (worker rejoin): the predecessor is dropped and every
+        alias it ever held re-points at the replacement, so
+        ``resolve(old_endpoint)`` reroutes in-flight fetches to the
+        new incarnation."""
         with self._lock:
+            if prior_endpoint is not None:
+                old_eid = self._aliases.get(prior_endpoint)
+                if old_eid is not None and old_eid != executor_id:
+                    self._executors.pop(old_eid, None)
+                    for ep, eid in list(self._aliases.items()):
+                        if eid == old_eid:
+                            self._aliases[ep] = executor_id
             self._executors[executor_id] = ExecutorInfo(executor_id,
                                                         endpoint)
             self._aliases[endpoint] = executor_id
             return [e for e in self._executors.values()
                     if e.executor_id != executor_id]
+
+    def deregister(self, executor_id: str) -> None:
+        """Forget a gracefully-decommissioned executor. Its aliases are
+        kept (resolving them returns None until a replacement
+        re-registers over one of them)."""
+        with self._lock:
+            self._executors.pop(executor_id, None)
+
+    def owner_of(self, endpoint: str) -> Optional[str]:
+        """Executor id that ever served ``endpoint`` (live or not) —
+        lets the driver fence a rejoining worker's predecessor."""
+        with self._lock:
+            return self._aliases.get(endpoint)
 
     def heartbeat(self, executor_id: str,
                   endpoint: Optional[str] = None) -> bool:

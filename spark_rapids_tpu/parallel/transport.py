@@ -9,7 +9,7 @@ replaces this entirely; across pods (DCN) — or between plain hosts —
 this transport is the fetch path, with the heartbeat registry
 (shuffle_manager.ShuffleHeartbeatManager) distributing endpoints.
 
-Wire protocol (all little-endian), three request kinds sharing the
+Wire protocol (all little-endian), five request kinds sharing the
 ``magic u32 | shuffle_id u32 | reduce_id u32`` prefix:
   fetch v1  ("SRTS"): response: count u32, then per block:
             map_id u32 | length u64 | bytes
@@ -22,6 +22,17 @@ Wire protocol (all little-endian), three request kinds sharing the
             the receiver verifies the frame and appends it to the
             (shuffle, reduce) segment, then answers one status byte
             (1 = stored, 0 = verification failed, sender may retry)
+  replica push  ("SRTQ"): same request as push, but the receiver
+            stores the frame in its origin-keyed ReplicaStore (k=2
+            map-output durability / decommission migration) instead of
+            the consolidated segment — replicas never serve normal
+            fetches
+  replica fetch ("SRTR"): request adds origin_len u16 | origin utf8 |
+            n_excl u32 | n_excl x map_id u32 — serve the replicas held
+            HERE for ``origin``'s blocks of this partition; response:
+            have u8 (0 = this origin was never replicated here: the
+            reader must NOT treat the empty stream as a complete
+            partition) | count u32 | blocks as v1
 Each block's bytes are the integrity layer's framed checksum envelope
 around the serializer's self-describing block format: the server
 verifies the stored frame before serving (corrupt-at-rest blocks are
@@ -53,6 +64,13 @@ from .shuffle_manager import ShuffleManager
 MAGIC = 0x53525453        # "SRTS" fetch v1
 MAGIC_FETCH2 = 0x53525446  # "SRTF" fetch with exclude list
 MAGIC_PUSH = 0x53525450    # "SRTP" push upload
+MAGIC_PUSH_REPL = 0x53525451   # "SRTQ" replica push (durability)
+MAGIC_FETCH_REPL = 0x53525452  # "SRTR" origin-addressed replica fetch
+#: replica-push map-id sentinel: the frame is a pickled replica
+#: MANIFEST ({reduce: (map ids...)}) for (origin, shuffle), published
+#: by the origin after its replica pushes drained — the buddy's
+#: completeness contract for serving replica fetches
+_MANIFEST_MAP_ID = 0xFFFFFFFF
 _REQ = struct.Struct("<III")
 _BLOCK_HDR = struct.Struct("<IQ")
 _PUSH_HDR = struct.Struct("<IQQH")  # map_id | rows | frame_len | origin_len
@@ -94,8 +112,16 @@ class _Handler(socketserver.BaseRequestHandler):
         if raw is None:
             return
         magic, shuffle_id, reduce_id = _REQ.unpack(raw)
-        if magic == MAGIC_PUSH:
-            self._handle_push(mgr, shuffle_id, reduce_id)
+        # push and replica traffic dispatch BEFORE the fetch path's
+        # "transport.serve" fault point: a plan killing pull serves
+        # must not take down the very replication that recovery relies
+        # on (replica serving has its own transport.serve_replica site)
+        if magic in (MAGIC_PUSH, MAGIC_PUSH_REPL):
+            self._handle_push(mgr, shuffle_id, reduce_id,
+                              replica=(magic == MAGIC_PUSH_REPL))
+            return
+        if magic == MAGIC_FETCH_REPL:
+            self._handle_replica_fetch(mgr, shuffle_id, reduce_id)
             return
         exclude: FrozenSet[int] = frozenset()
         if magic == MAGIC_FETCH2:
@@ -165,11 +191,13 @@ class _Handler(socketserver.BaseRequestHandler):
             self.request.sendall(data)
 
     def _handle_push(self, mgr: ShuffleManager, shuffle_id: int,
-                     reduce_id: int) -> None:
+                     reduce_id: int, replica: bool = False) -> None:
         """Receive one eagerly pushed block and consolidate it into the
-        (shuffle, reduce) segment. The frame verifies BEFORE it is
-        stored — a wire-corrupt push is NAKed (status 0) so the origin
-        can resend; the origin's copy stays authoritative either way."""
+        (shuffle, reduce) segment — or, for a replica push, into the
+        origin-keyed ReplicaStore (k=2 durability / decommission
+        migration). The frame verifies BEFORE it is stored — a
+        wire-corrupt push is NAKed (status 0) so the origin can resend;
+        the origin's copy stays authoritative either way."""
         raw = self._recv_exact(_PUSH_HDR.size)
         if raw is None:
             return
@@ -193,10 +221,85 @@ class _Handler(socketserver.BaseRequestHandler):
             except DataCorruption:
                 status = 0  # corrupted in flight: reject, sender retries
         if status:
-            mgr.segments.append(shuffle_id, reduce_id,
-                                origin_b.decode("utf-8"), map_id,
-                                rows, framed)
+            if replica and map_id == _MANIFEST_MAP_ID:
+                import pickle
+                try:
+                    manifest = pickle.loads(integrity.unwrap(
+                        framed, what=f"replica manifest "
+                                     f"sid={shuffle_id}"))
+                    mgr.replicas.put_manifest(origin_b.decode("utf-8"),
+                                              shuffle_id, manifest)
+                except Exception:
+                    status = 0  # corrupt/garbled manifest: NAK
+            elif replica:
+                mgr.replicas.put(origin_b.decode("utf-8"), shuffle_id,
+                                 map_id, reduce_id, framed)
+            else:
+                mgr.segments.append(shuffle_id, reduce_id,
+                                    origin_b.decode("utf-8"), map_id,
+                                    rows, framed)
         self.request.sendall(struct.pack("<B", status))
+
+    def _handle_replica_fetch(self, mgr: ShuffleManager,
+                              shuffle_id: int, reduce_id: int) -> None:
+        """Serve the replicas held HERE for one origin's blocks of one
+        reduce partition — the degraded-mode read a peer issues after
+        its pull from the origin failed terminally. The ``have`` byte
+        distinguishes 'all blocks excluded' (complete) from 'this
+        origin was never replicated here' (the reader must fall back
+        to stage retry, not treat silence as completeness)."""
+        raw = self._recv_exact(2)
+        if raw is None:
+            return
+        (origin_len,) = struct.unpack("<H", raw)
+        origin_b = self._recv_exact(origin_len)
+        raw = self._recv_exact(4)
+        if origin_b is None or raw is None:
+            return
+        (n_excl,) = struct.unpack("<I", raw)
+        exclude: FrozenSet[int] = frozenset()
+        if n_excl:
+            raw = self._recv_exact(4 * n_excl)
+            if raw is None:
+                return
+            exclude = frozenset(struct.unpack(f"<{n_excl}I", raw))
+        try:
+            fault_point("transport.serve_replica",
+                        f"sid={shuffle_id};reduce={reduce_id};")
+        except ConnectionResetError:
+            return
+        origin = origin_b.decode("utf-8")
+        # coverage contract: only a manifest-complete replica set may
+        # serve (None = no manifest, or a best-effort push silently
+        # dropped a block — the reader must stage-retry, not consume a
+        # partial partition as if it were whole)
+        complete = mgr.replicas.coverage(origin, shuffle_id, reduce_id)
+        if complete is None:
+            self.request.sendall(struct.pack("<BI", 0, 0))
+            return
+        payload = []
+        for map_id, framed in complete:
+            if map_id in exclude:
+                continue
+            if mgr.verify_checksums:
+                try:
+                    integrity.verify_framed(
+                        framed,
+                        what=f"replica block sid={shuffle_id} "
+                             f"m={map_id} origin={origin}")
+                except DataCorruption:
+                    # a corrupt-at-rest replica cannot complete the
+                    # partition; serving the survivors would be
+                    # silently wrong — drop the entry AND the
+                    # connection so the reader falls back to retry
+                    mgr.replicas.drop(origin, shuffle_id, map_id,
+                                      reduce_id)
+                    return
+            payload.append((map_id, framed))
+        self.request.sendall(struct.pack("<BI", 1, len(payload)))
+        for map_id, data in payload:
+            self.request.sendall(_BLOCK_HDR.pack(map_id, len(data)))
+            self.request.sendall(data)
 
     def _recv_exact(self, n: int) -> Optional[bytes]:
         buf = b""
@@ -330,12 +433,49 @@ class ShuffleBlockClient:
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    """Read exactly ``n`` bytes. When the calling thread carries a
+    query token, the blocking read is chopped into short sub-waits
+    that poll the token between chunks — a fetch thread whose query
+    was cancelled (or whose worker the driver evicted mid-fetch)
+    unwinds within a beat instead of blocking out the full socket
+    timeout against a wedged peer, releasing its fetch-pool slot and
+    letting PrefetchIterator.close() join its producers. The overall
+    deadline stays the socket's configured timeout, so retry/failover
+    semantics are unchanged for live queries."""
+    from ..robustness.admission import current_query
+    qc = current_query()
+    if qc is None:
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("peer closed mid-message")
+            buf += chunk
+        return buf
+    total = sock.gettimeout()
+    deadline = None if total is None else time.monotonic() + total
     buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            raise ConnectionError("peer closed mid-message")
-        buf += chunk
+    try:
+        while len(buf) < n:
+            qc.check()  # raises on cancel / blown deadline
+            left = None if deadline is None \
+                else deadline - time.monotonic()
+            if left is not None and left <= 0:
+                raise socket.timeout("shuffle read timed out")
+            sock.settimeout(
+                0.25 if left is None else max(min(left, 0.25), 0.001))
+            try:
+                chunk = sock.recv(n - len(buf))
+            except socket.timeout:
+                continue  # poll tick: re-check the query token
+            if not chunk:
+                raise ConnectionError("peer closed mid-message")
+            buf += chunk
+    finally:
+        try:
+            sock.settimeout(total)
+        except OSError:
+            pass
     return buf
 
 
@@ -459,10 +599,12 @@ def _local_stream(mgr: ShuffleManager, endpoint: str, shuffle_id: int,
 
 def _push_once(endpoint: str, shuffle_id: int, reduce_id: int,
                map_id: int, rows: int, framed: bytes, origin: str,
-               timeout_s: float) -> bool:
+               timeout_s: float, replica: bool = False) -> bool:
     """One push upload attempt. Returns True when the receiver stored
     the block (ACK), False on a NAK (receiver saw a corrupt frame —
-    the corruption happened in flight, resending heals it)."""
+    the corruption happened in flight, resending heals it). With
+    ``replica`` the receiver files the frame in its origin-keyed
+    ReplicaStore instead of the consolidated segment."""
     # seeded push-wire corruption (chaos/tests): applied per attempt so
     # a one-shot corrupt spec NAKs the first send and the retry heals
     wire = corrupt_point(
@@ -470,14 +612,77 @@ def _push_once(endpoint: str, shuffle_id: int, reduce_id: int,
         f"sid={shuffle_id};reduce={reduce_id};m={map_id};")
     host, port = endpoint.rsplit(":", 1)
     ob = origin.encode("utf-8")
+    magic = MAGIC_PUSH_REPL if replica else MAGIC_PUSH
     with socket.create_connection((host, int(port)),
                                   timeout=timeout_s) as sock:
-        sock.sendall(_REQ.pack(MAGIC_PUSH, shuffle_id, reduce_id)
+        sock.sendall(_REQ.pack(magic, shuffle_id, reduce_id)
                      + _PUSH_HDR.pack(map_id, rows, len(wire), len(ob))
                      + ob)
         sock.sendall(wire)
         status = _recv_exact(sock, 1)[0]
     return status == 1
+
+
+def _replica_stream(buddy: str, origin: str, shuffle_id: int,
+                    reduce_id: int, exclude: FrozenSet[int],
+                    timeout_s: float, verify: bool = True
+                    ) -> Iterator[Tuple[int, bytes]]:
+    """Degraded-mode read: stream ``origin``'s replicated blocks for
+    one reduce partition from its ``buddy``. Single attempt, no retry
+    budget — the caller already burned the origin's, and on any
+    failure it re-raises the ORIGINAL FetchFailed so recovery falls
+    back to the stage-retry path. Raises ConnectionError when the
+    buddy holds no replicas for this origin (the ``have`` bit): an
+    empty stream must never be mistaken for a complete partition."""
+    local = local_manager_for(buddy)
+    if local is not None:
+        # in a 2-worker cluster the reader IS the dead peer's buddy:
+        # its replica store serves without a socket
+        complete = local.replicas.coverage(origin, shuffle_id,
+                                           reduce_id)
+        if complete is None:
+            raise ConnectionError(
+                f"no replica coverage for origin={origin} "
+                f"sid={shuffle_id} on {buddy}")
+        for map_id, framed in complete:
+            if map_id in exclude:
+                continue
+            try:
+                payload = integrity.unwrap(
+                    framed, what=f"replica block sid={shuffle_id} "
+                                 f"m={map_id} origin={origin}") \
+                    if verify else integrity.strip(framed)
+            except DataCorruption as e:
+                raise ConnectionError(str(e)) from e
+            yield map_id, payload
+        return
+    host, port = buddy.rsplit(":", 1)
+    ob = origin.encode("utf-8")
+    ex = sorted(exclude)
+    with socket.create_connection((host, int(port)),
+                                  timeout=timeout_s) as sock:
+        sock.sendall(_REQ.pack(MAGIC_FETCH_REPL, shuffle_id, reduce_id)
+                     + struct.pack("<H", len(ob)) + ob
+                     + struct.pack(f"<I{len(ex)}I", len(ex), *ex))
+        have = _recv_exact(sock, 1)[0]
+        if not have:
+            raise ConnectionError(
+                f"no replica coverage for origin={origin} "
+                f"sid={shuffle_id} on {buddy}")
+        count = struct.unpack("<I", _recv_exact(sock, 4))[0]
+        for _ in range(count):
+            map_id, length = _BLOCK_HDR.unpack(
+                _recv_exact(sock, _BLOCK_HDR.size))
+            data = _recv_exact(sock, length)
+            try:
+                payload = integrity.unwrap(
+                    data, what=f"replica block sid={shuffle_id} "
+                               f"m={map_id} origin={origin} "
+                               f"from {buddy}") \
+                    if verify else integrity.strip(data)
+            except DataCorruption as e:
+                raise ConnectionError(str(e)) from e
+            yield map_id, payload
 
 
 class BlockPusher:
@@ -516,11 +721,13 @@ class BlockPusher:
 
     def push(self, endpoint: str, shuffle_id: int, reduce_id: int,
              map_id: int, rows: int, framed: bytes,
-             origin: str, who: str = "") -> None:
+             origin: str, who: str = "", replica: bool = False) -> None:
         """Enqueue one block for background upload. Blocks the CALLING
         (map) thread only while the target endpoint's in-flight window
         is full. ``who`` is an opaque sender label (e.g. ``w=1``) that
-        chaos plans can match to target one worker's push path."""
+        chaos plans can match to target one worker's push path.
+        ``replica`` uploads into the receiver's origin-keyed
+        ReplicaStore (durability/migration) instead of its segment."""
         try:
             fault_point("push.send",
                         f"sid={shuffle_id};reduce={reduce_id};"
@@ -544,7 +751,7 @@ class BlockPusher:
                     try:
                         if _push_once(endpoint, shuffle_id, reduce_id,
                                       map_id, rows, framed, origin,
-                                      self.timeout_s):
+                                      self.timeout_s, replica=replica):
                             ok = True
                             break
                         # NAK: receiver rejected a wire-corrupt frame;
@@ -671,7 +878,8 @@ def fetch_all_partitions(endpoints: List[str], shuffle_id: int,
                          allowed: Optional[dict] = None,
                          manager: Optional[ShuffleManager] = None,
                          metrics_cb: Optional[
-                             Callable[[str, int], None]] = None
+                             Callable[[str, int], None]] = None,
+                         replicas: Optional[Dict[str, str]] = None
                          ) -> Iterator[ColumnarBatch]:
     """Reduce-side iterator over every peer's blocks for one partition
     (RapidsShuffleIterator role): up to ``max_concurrent`` peers fetch
@@ -694,7 +902,14 @@ def fetch_all_partitions(endpoints: List[str], shuffle_id: int,
     Per-peer streams retry with backoff and, when ``endpoint_resolver``
     is given (cluster mode wires the driver's heartbeat registry), fail
     over once to the peer's current endpoint before surfacing
-    ``FetchFailed``. Conf knobs resolve HERE, on the consuming thread —
+    ``FetchFailed``. ``replicas`` (origin endpoint -> buddy endpoint)
+    arms one further layer: a terminally failed pull degrades to an
+    origin-addressed replica fetch from the buddy (k=2 durability /
+    decommission migration), excluding everything already received —
+    the recovery the RecoveryTimed/recovery_time_ns span measures. A
+    buddy without coverage re-raises the ORIGINAL failure, so the
+    fallback can never turn a lost partition into a silently partial
+    one. Conf knobs resolve HERE, on the consuming thread —
     fetch worker threads are fresh and would only see defaults."""
     from ..conf import (FETCH_BACKOFF_BASE_S, FETCH_MAX_RETRIES,
                         FETCH_TIMEOUT_S, SHUFFLE_FETCH_IN_FLIGHT_BYTES,
@@ -746,14 +961,74 @@ def fetch_all_partitions(endpoints: List[str], shuffle_id: int,
                 metrics_cb("segment", len(payload))
             yield deserialize_batch(payload)
 
+    def guarded_stream(ep: str, base: Iterator[Tuple[int, bytes]],
+                       ex: FrozenSet[int]
+                       ) -> Iterator[Tuple[int, bytes]]:
+        # buddy-replica fallback: track every map id this peer DID
+        # deliver (plus the segment-consumed excludes) so the replica
+        # fetch after a mid-stream death resumes exactly where the
+        # pull stopped, never duplicating a block
+        got: Set[int] = set(ex)
+        try:
+            for map_id, data in base:
+                got.add(map_id)
+                yield map_id, data
+            return
+        except (FetchFailed, OSError) as primary:
+            buddy = replicas.get(ep) if replicas else None
+            if not buddy or buddy == ep:
+                raise
+            from ..obs import events as _events
+            from ..obs import registry as _registry
+            _events.emit("ReplicaFetch", origin=ep, buddy=buddy,
+                         shuffle_id=shuffle_id, reduce_id=reduce_id,
+                         cause=str(primary))
+            t0 = time.perf_counter_ns()
+            served = 0
+            try:
+                for map_id, data in _replica_stream(
+                        buddy, ep, shuffle_id, reduce_id,
+                        frozenset(got), timeout_s,
+                        verify=manager.verify_checksums):
+                    if served == 0:
+                        # failure detection -> first post-recovery
+                        # block: the RecoveryTimer span of the ISSUE
+                        dt = time.perf_counter_ns() - t0
+                        _registry.observe("recovery_time_ns", dt, "ns")
+                        _events.emit("RecoveryTimed",
+                                     kind="buddy_fetch", origin=ep,
+                                     buddy=buddy, shuffle_id=shuffle_id,
+                                     reduce_id=reduce_id,
+                                     recovery_time_ns=dt)
+                    served += 1
+                    yield map_id, data
+            except (OSError, ConnectionError):
+                # no coverage / buddy also failing: surface the
+                # ORIGINAL failure so stage retry attributes the loss
+                # to the right peer
+                raise primary
+            if served == 0:
+                # coverage existed but every block was already held:
+                # the recovery completed instantly
+                dt = time.perf_counter_ns() - t0
+                _registry.observe("recovery_time_ns", dt, "ns")
+                _events.emit("RecoveryTimed", kind="buddy_fetch",
+                             origin=ep, buddy=buddy,
+                             shuffle_id=shuffle_id,
+                             reduce_id=reduce_id, recovery_time_ns=dt)
+
     def open_stream(ep: str) -> Iterator[Tuple[int, bytes]]:
         ex = frozenset(excludes.get(ep, ()))
         local = local_manager_for(ep)
         if local is not None:
-            return _local_stream(local, ep, shuffle_id, reduce_id, ex)
-        return stream_with_failover(ep, shuffle_id, reduce_id,
-                                    endpoint_resolver, timeout_s,
-                                    max_retries, backoff_base_s, ex)
+            base = _local_stream(local, ep, shuffle_id, reduce_id, ex)
+        else:
+            base = stream_with_failover(ep, shuffle_id, reduce_id,
+                                        endpoint_resolver, timeout_s,
+                                        max_retries, backoff_base_s, ex)
+        if replicas and replicas.get(ep) not in (None, ep):
+            return guarded_stream(ep, base, ex)
+        return base
 
     def block_kind(ep: str) -> str:
         return "local" if local_manager_for(ep) is not None else "remote"

@@ -157,7 +157,16 @@ def _compute_join_decision(ctx, join) -> JoinDecision:
 
     # -- joinStrategy: demote on MEASURED build size (build side
     # materializes first; on demotion the probe exchange never runs) --
-    if _conf(ctx, ADAPTIVE_JOIN_ENABLED, True):
+    # Never demote over a stage-retry REUSED exchange: measured stats
+    # count only the attempt's freshly written maps (renamed blocks are
+    # invisible, so a reused build side measures near-zero and demotes
+    # falsely), and the demoted path streams the probe exchange's
+    # CHILD, which reuse sharded down to the freshly adopted ids —
+    # silently dropping every surviving worker's own rows.
+    reused_side = (ctx.cluster is not None and
+                   (build_x.shuffle_id in ctx.cluster.reusable_sids or
+                    probe_x.shuffle_id in ctx.cluster.reusable_sids))
+    if _conf(ctx, ADAPTIVE_JOIN_ENABLED, True) and not reused_side:
         b_rows, b_bytes = build_x.materialized_stats(ctx)
         rows_thr = _conf(ctx, ADAPTIVE_BROADCAST_ROWS, 0) or \
             _conf(ctx, BROADCAST_THRESHOLD_ROWS, 0)

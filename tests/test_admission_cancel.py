@@ -447,12 +447,30 @@ def test_cluster_deadline_and_cancel_propagation(tmp_path):
 # ---------------------------------------------------------- conf plumbing
 
 def test_shuffle_heartbeat_timeout_conf_hoist():
+    import warnings
+
+    from spark_rapids_tpu import conf as conf_mod
     from spark_rapids_tpu.parallel.shuffle_manager import \
         ShuffleHeartbeatManager
-    assert ShuffleHeartbeatManager().timeout_s == 60.0  # registered default
-    set_active_conf(SrtConf({"srt.shuffle.heartbeat.timeoutSec": "7.5"}))
+    # unified with srt.cluster.heartbeatTimeoutSec (30.0 default)
+    assert ShuffleHeartbeatManager().timeout_s == 30.0
+    # the old key is a deprecated alias: it forwards to the new key
+    # and warns once per process
+    conf_mod._ALIAS_WARNED.discard("srt.shuffle.heartbeat.timeoutSec")
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        set_active_conf(SrtConf({"srt.shuffle.heartbeat.timeoutSec":
+                                 "7.5"}))
+    assert any(issubclass(w.category, DeprecationWarning)
+               and "srt.cluster.heartbeatTimeoutSec" in str(w.message)
+               for w in caught), [str(w.message) for w in caught]
     try:
         assert ShuffleHeartbeatManager().timeout_s == 7.5
+        # the new key wins when both are set
+        set_active_conf(SrtConf(
+            {"srt.shuffle.heartbeat.timeoutSec": "7.5",
+             "srt.cluster.heartbeatTimeoutSec": "11.0"}))
+        assert ShuffleHeartbeatManager().timeout_s == 11.0
         # an explicit argument (the cluster driver's pass-through) wins
         assert ShuffleHeartbeatManager(timeout_s=3.0).timeout_s == 3.0
     finally:

@@ -883,6 +883,303 @@ def _push_shuffle_check(n_workers: int = 2) -> int:
     return failures
 
 
+def _membership_check(n_workers: int = 3) -> int:
+    """Elastic-membership leg: one cluster taken through the full
+    membership lifecycle. (1) k=2 buddy replication with every remote
+    pull serve dying — readers must degrade to manifest-covered
+    replica fetches and finish with ZERO stage retries, bit-identical;
+    (2) a SIGTERM graceful decommission landing MID-query — the worker
+    finishes its job first (zero retries), migrates, deregisters, and
+    the survivors serve the next query; (3) a hard SIGKILL mid-query —
+    eviction + stage/job retry recover the answer, the dead
+    incarnation's epoch is fenced (a zombie barrier frame is refused),
+    a replacement rejoins over the dead endpoint and serves queries,
+    and the driver's recovery_time_ns p99 stays under budget. The
+    mid-stream kill-and-resume probe from the scale roadmap folds in
+    here as leg 3. Returns failure count."""
+    import pickle
+    import signal
+    import socket as _socket
+    import struct
+
+    import numpy as np
+
+    from spark_rapids_tpu.conf import SrtConf
+    from spark_rapids_tpu.expr import col
+    from spark_rapids_tpu.expr.aggregates import CountStar, Sum
+    from spark_rapids_tpu.expr.core import Alias
+    from spark_rapids_tpu.obs import events as ev
+    from spark_rapids_tpu.obs import registry as obs_registry
+    from spark_rapids_tpu.parallel.cluster import (ClusterDriver,
+                                                   launch_local_workers)
+    from spark_rapids_tpu.plan import TpuSession
+
+    failures = 0
+    t0 = time.monotonic()
+    with tempfile.TemporaryDirectory(prefix="srt_member_") as tmp:
+        session = TpuSession(SrtConf({}))
+        rng = np.random.default_rng(61)
+        n = 6_000
+        fact_dir = os.path.join(tmp, "fact")
+        session.create_dataframe({
+            "k": rng.integers(0, 40, n).tolist(),
+            "v": rng.uniform(0, 10, n).tolist(),
+        }).write.parquet(fact_dir)
+        dim_dir = os.path.join(tmp, "dim")
+        session.create_dataframe({
+            "k": list(range(40)),
+            "w": [float(1 + i % 3) for i in range(40)],
+        }).write.parquet(dim_dir)
+        events_dir = os.path.join(tmp, "events")
+
+        def logical(sess):
+            f = sess.read.parquet(fact_dir)
+            d = sess.read.parquet(dim_dir)
+            return f.join(d, on="k") \
+                .group_by("k").agg(Alias(Sum(col("v") * col("w")), "s"),
+                                   Alias(CountStar(), "c")) \
+                .sort("k")
+
+        def canon(rows):
+            return sorted((r["k"], r["c"], round(r["s"], 6))
+                          for r in rows)
+
+        oracle = canon(logical(TpuSession(SrtConf({}))).collect())
+
+        driver = ClusterDriver(num_workers=n_workers, barrier_timeout=60,
+                               heartbeat_interval=0.5,
+                               heartbeat_timeout=6)
+        procs = launch_local_workers(driver, n_workers)
+        base_conf = {"srt.shuffle.partitions": 4,
+                     "srt.cluster.barrierTimeoutSec": 60,
+                     "srt.sql.broadcastRowThreshold": 1,
+                     "srt.eventLog.enabled": "true",
+                     "srt.eventLog.dir": events_dir}
+        checks = []
+
+        def _run_async(conf):
+            out: dict = {}
+            # barrier keys survive a finished job, so "in flight" means
+            # a key that was NOT there before this one was dispatched
+            seen = set(driver._barriers) | set(driver._spec_barriers)
+
+            def _go():
+                try:
+                    out["rows"] = driver.run(logical(session).plan, conf)
+                except Exception as e:  # noqa: BLE001
+                    out["error"] = e
+            th = threading.Thread(target=_go)
+            th.start()
+            # wait until the job is IN FLIGHT (first stage-barrier
+            # arrival) so the chaos action lands mid-query, never
+            # pre-empting the dispatch
+            deadline = time.monotonic() + 60
+            while not ((set(driver._barriers)
+                        | set(driver._spec_barriers)) - seen) \
+                    and time.monotonic() < deadline:
+                time.sleep(0.01)
+            return th, out
+
+        try:
+            driver.wait_for_workers(timeout=120)
+
+            # --- leg 1: buddy replication vs dead pull serves ---
+            t = time.monotonic()
+            recov_before = len(driver.recovery_events)
+            conf = dict(base_conf,
+                        **{"srt.shuffle.push.enabled": "false",
+                           "srt.shuffle.replication.factor": "2",
+                           "srt.shuffle.fetch.maxRetries": "1",
+                           "srt.shuffle.fetch.backoffBaseSec": "0.01",
+                           "srt.test.faultPlan":
+                               "seed=61|transport.serve:reset%1.0*999"})
+            leg_fail = 0
+            try:
+                rows = driver.run(logical(session).plan, conf)
+            except Exception as e:  # noqa: BLE001
+                print(f"[chaos] FAIL [membership: buddy fetch]: job "
+                      f"raised {type(e).__name__}: {e}",
+                      file=sys.stderr, flush=True)
+                leg_fail += 1
+            else:
+                delta = [e["type"] for e in
+                         driver.recovery_events[recov_before:]]
+                recs = ev.read_all_events(events_dir)
+                checks += [
+                    ("buddy-fetch result bit-identical",
+                     canon(rows) == oracle),
+                    ("buddy-fetch zero stage/job retries", not delta),
+                    ("buddy-fetch recovery span recorded",
+                     any(r.get("event") == "RecoveryTimed"
+                         and r.get("kind") == "buddy_fetch"
+                         and r.get("recovery_time_ns", 0) > 0
+                         for r in recs)),
+                    ("replica fetches logged",
+                     any(r.get("event") == "ReplicaFetch"
+                         for r in recs)),
+                ]
+            print(f"[chaos] {'PASS' if not leg_fail else 'FAIL'} "
+                  f"[membership: buddy fetch vs dead serves] "
+                  f"{time.monotonic() - t:.1f}s", flush=True)
+            failures += leg_fail
+
+            # --- leg 2: SIGTERM graceful decommission mid-query ---
+            t = time.monotonic()
+            recov_before = len(driver.recovery_events)
+            th, out = _run_async(dict(base_conf))
+            procs[-1].send_signal(signal.SIGTERM)
+            th.join(120)
+            # the worker decommissions only AFTER its job replies;
+            # wait for the driver-side completion record
+            deadline = time.monotonic() + 60
+            while not any(
+                    e["type"] == "decommission"
+                    for e in driver.recovery_events[recov_before:]) \
+                    and time.monotonic() < deadline:
+                time.sleep(0.1)
+            delta = [e["type"] for e in
+                     driver.recovery_events[recov_before:]]
+            recs = ev.read_all_events(events_dir)
+            leg_ok = not th.is_alive() and "error" not in out
+            checks += [
+                ("decommission query completed",
+                 leg_ok and canon(out.get("rows") or []) == oracle),
+                ("decommission zero stage/job retries",
+                 "stage_retry" not in delta
+                 and "job_retry" not in delta),
+                ("decommission recorded", "decommission" in delta),
+                ("WorkerDecommissioned event logged",
+                 any(r.get("event") == "WorkerDecommissioned"
+                     for r in recs)),
+                ("roster shrank by one",
+                 driver.num_workers == n_workers - 1),
+            ]
+            # survivors serve the next query
+            rows = driver.run(logical(session).plan, dict(base_conf))
+            checks.append(("survivors serve post-decommission query",
+                           canon(rows) == oracle))
+            print(f"[chaos] PASS [membership: SIGTERM decommission "
+                  f"mid-query] {time.monotonic() - t:.1f}s", flush=True)
+
+            # --- leg 3: hard kill mid-query, fence, rejoin ---
+            t = time.monotonic()
+            # the decommissioned process may still be tearing down:
+            # wait it out so the victim below is a live roster member
+            deadline = time.monotonic() + 30
+            while len([p for p in procs if p.poll() is None]) \
+                    > n_workers - 1 and time.monotonic() < deadline:
+                time.sleep(0.1)
+            roster = {eid: ep for _s, ep, eid in driver._workers}
+            recov_before = len(driver.recovery_events)
+            th, out = _run_async(dict(base_conf))
+            victim = [p for p in procs if p.poll() is None][-1]
+            victim.kill()
+            th.join(180)
+            if "error" in out:
+                print(f"[chaos] [membership] kill-leg query raised "
+                      f"{type(out['error']).__name__}: {out['error']}",
+                      file=sys.stderr, flush=True)
+            elif canon(out.get("rows") or []) != oracle:
+                got = canon(out.get("rows") or [])
+                print(f"[chaos] [membership] kill-leg mismatch: "
+                      f"{len(got)} groups vs {len(oracle)}, "
+                      f"count={sum(g[1] for g in got)} vs "
+                      f"{sum(g[1] for g in oracle)}, "
+                      f"diff={[g for g in got if g not in oracle][:3]}"
+                      f" missing="
+                      f"{[g for g in oracle if g not in got][:3]}",
+                      file=sys.stderr, flush=True)
+            delta = [e["type"] for e in
+                     driver.recovery_events[recov_before:]]
+            recs = ev.read_all_events(events_dir)
+            checks += [
+                ("kill-recovery result bit-identical",
+                 not th.is_alive() and "error" not in out
+                 and canon(out.get("rows") or []) == oracle),
+                # mid-dialogue deaths are caught by socket-close before
+                # the heartbeat monitor fires; either way a retry must
+                # have recovered the attempt
+                ("stage/job retry recorded",
+                 "stage_retry" in delta or "job_retry" in delta),
+                ("WorkerEvicted event logged",
+                 any(r.get("event") == "WorkerEvicted" for r in recs)),
+            ]
+            live = {eid for _s, _ep, eid in driver._workers}
+            dead = set(roster) - live
+            fence_ok = False
+            rejoin_ok = False
+            if len(dead) == 1:
+                (dead_eid,) = dead
+                dead_ep = roster[dead_eid]
+                # zombie probe: a barrier frame carrying the fenced
+                # epoch must be refused before touching the registry
+                frame = struct.Struct(">I")
+                payload = pickle.dumps(
+                    {"type": "barrier", "shuffle_id": 999, "worker": 9,
+                     "pos": -1, "epoch": driver._epochs[dead_eid]})
+                with _socket.create_connection(driver.address,
+                                               timeout=10) as s:
+                    s.sendall(frame.pack(len(payload)) + payload)
+                    (ln,) = frame.unpack(s.recv(4))
+                    reply = pickle.loads(s.recv(ln))
+                fence_ok = reply.get("type") == "fenced"
+                # rejoin over the dead endpoint; ownership reroutes
+                procs.extend(launch_local_workers(
+                    driver, 1, env={"SRT_REJOIN_ENDPOINT": dead_ep}))
+                driver.wait_for_n_workers(n_workers - 1, timeout=120)
+                deadline = time.monotonic() + 30
+                new_ep = next(ep for _s, ep, eid in driver._workers
+                              if eid not in roster)
+                while driver._heartbeats.resolve(dead_ep) != new_ep \
+                        and time.monotonic() < deadline:
+                    time.sleep(0.2)
+                rows = driver.run(logical(session).plan,
+                                  dict(base_conf))
+                rejoin_ok = (canon(rows) == oracle
+                             and driver._heartbeats.resolve(dead_ep)
+                             == new_ep)
+            checks += [
+                ("zombie barrier frame fenced", fence_ok),
+                ("rejoined worker serves queries", rejoin_ok),
+            ]
+            hist = obs_registry.registry().histogram("recovery_time_ns")
+            snap = hist.snapshot() if hist is not None else {}
+            checks += [
+                ("recovery_time histogram populated",
+                 snap.get("count", 0) >= 1),
+                ("recovery_time p99 under 120s budget",
+                 0 < snap.get("p99", 0) < 120e9),
+            ]
+            recs = ev.read_all_events(events_dir)
+            checks.append(("zero prefetch thread leaks across "
+                           "membership churn",
+                           not any(r.get("event") == "PrefetchThreadLeak"
+                                   for r in recs)))
+            print(f"[chaos] PASS [membership: kill + fence + rejoin] "
+                  f"{time.monotonic() - t:.1f}s", flush=True)
+        except Exception as e:  # noqa: BLE001
+            print(f"[chaos] FAIL [membership]: {type(e).__name__}: {e}",
+                  file=sys.stderr, flush=True)
+            failures += 1
+        finally:
+            driver.shutdown()
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except Exception:
+                    p.kill()
+        for what, ok in checks:
+            if not ok:
+                print(f"[chaos] FAIL [membership]: {what}",
+                      file=sys.stderr, flush=True)
+                failures += 1
+        print(f"[chaos] {'PASS' if not failures else 'FAIL'} "
+              f"[membership: replication/decommission/kill/rejoin] "
+              f"{time.monotonic() - t0:.1f}s ({len(checks)} checks)",
+              flush=True)
+    return failures
+
+
 def _rows_match(rows, oracle):
     if [r["k"] for r in rows] != [r["k"] for r in oracle]:
         return False
@@ -1098,6 +1395,7 @@ def main() -> int:
     failures += _adaptive_check()
     # push-shuffle leg: eager push / segments / locality under faults
     failures += _push_shuffle_check()
+    failures += _membership_check()
     watchdog.cancel()
     print(f"[chaos] done in {time.monotonic() - t0:.1f}s, "
           f"{failures} failure(s)", flush=True)
