@@ -708,6 +708,59 @@ def main():
         except Exception as e:
             log(f"delta merge bench failed: {e}")
 
+    # --- streaming micro-batch ingestion (exactly-once commit path) -------
+    # measures the transactional lane end-to-end: stage -> fsync ->
+    # rename -> O_EXCL commit -> txn bookkeeping, once with durable
+    # commits (the shipped default) and once relaxed, so the fsync
+    # tax on the exactly-once guarantee is a tracked number
+    if left("streaming ingest", need=30):
+        try:
+            import shutil
+            import tempfile
+
+            from spark_rapids_tpu.delta.streaming import (DeltaIngestor,
+                                                          demo_batch_dict,
+                                                          demo_schema)
+            from spark_rapids_tpu.delta.table import AcidTable
+
+            batches = 16
+            rows_per = max(scale // 400, 2_000)
+
+            def run_ingest(durable: bool) -> float:
+                sess = framework_session(
+                    {"srt.delta.durableCommits": str(durable).lower(),
+                     "srt.delta.checkpointInterval": "8"})
+                d = tempfile.mkdtemp(prefix="srt_ingest_bench_")
+                try:
+                    tab = AcidTable.create(sess, d, demo_schema())
+
+                    def bf(b):
+                        return sess.create_dataframe(
+                            demo_batch_dict(b, rows_per), demo_schema())
+
+                    t0 = time.perf_counter()
+                    DeltaIngestor(tab, "bench").ingest(bf, batches)
+                    return time.perf_counter() - t0
+                finally:
+                    shutil.rmtree(d, ignore_errors=True)
+
+            total = batches * rows_per
+            durable_s = run_ingest(True)
+            relaxed_s = run_ingest(False)
+            RESULT["ingest_rows_per_s"] = round(total / durable_s, 1)
+            RESULT["ingest_relaxed_rows_per_s"] = round(
+                total / relaxed_s, 1)
+            RESULT["ingest_batch_commit_ms"] = round(
+                durable_s / batches * 1e3, 2)
+            RESULT["ingest_durable_overhead_pct"] = round(
+                (durable_s / relaxed_s - 1) * 100, 1)
+            log(f"streaming ingest ({batches}x{rows_per} rows): "
+                f"{RESULT['ingest_rows_per_s']:.0f} rows/s durable "
+                f"({RESULT['ingest_durable_overhead_pct']}% fsync tax)")
+            emit()
+        except Exception as e:
+            log(f"streaming ingest bench failed: {e}")
+
     # --- BASELINE config 5: Mortgage ETL -> device arrays (ML hand-off) ---
     if left("mortgage etl", need=45):
         try:

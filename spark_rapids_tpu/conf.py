@@ -565,6 +565,46 @@ IGNORE_MISSING_FILES = conf("srt.sql.ignoreMissingFiles") \
          "spark.sql.files.ignoreMissingFiles semantics.") \
     .boolean(False)
 
+DELTA_DURABLE_COMMITS = conf("srt.delta.durableCommits") \
+    .doc("Crash-durable Delta commits: every transaction-log commit "
+         "fsyncs the commit file and its parent directory (and every "
+         "staged data file before its rename promotes it), so a "
+         "machine crash immediately after commit() returns can never "
+         "lose or tear the version. Disable only to A/B the fsync "
+         "overhead (the ingest_rows_per_s bench lane measures it).") \
+    .boolean(True)
+
+DELTA_COMMIT_MAX_RETRIES = conf("srt.delta.commit.maxRetries") \
+    .doc("How many times an optimistic Delta committer re-validates "
+         "and retries after losing the O_EXCL race for its target "
+         "version before surfacing CommitConflict.") \
+    .check(lambda v: None if v >= 0 else "must be >= 0").integer(10)
+
+DELTA_COMMIT_BACKOFF_MS = conf("srt.delta.commit.backoffMs") \
+    .doc("Base backoff in milliseconds between Delta commit-conflict "
+         "retries; grows exponentially per attempt with +-50% jitter, "
+         "capped at 32x the base. 0 retries immediately.") \
+    .check(lambda v: None if v >= 0 else "must be >= 0").integer(15)
+
+DELTA_CHECKPOINT_INTERVAL = conf("srt.delta.checkpointInterval") \
+    .doc("Write a compacted log checkpoint (NNN.checkpoint.json + "
+         "_last_checkpoint pointer) every this many commits, bounding "
+         "snapshot replay to the commits after the checkpoint. The "
+         "checkpoint carries a crc32 — a torn/corrupt checkpoint is "
+         "detected and replay falls back to the full JSON log. "
+         "0 disables checkpointing.") \
+    .check(lambda v: None if v >= 0 else "must be >= 0").integer(10)
+
+DELTA_VACUUM_RETENTION_SEC = conf("srt.delta.vacuum.retentionSec") \
+    .doc("VACUUM's retention guard for files the log has never "
+         "referenced (crash orphans: staged .tmp files and promoted-"
+         "but-uncommitted data files): younger files survive the "
+         "sweep because they may belong to a commit in flight. "
+         "Staging files whose owning pid is provably dead are swept "
+         "regardless of age. Files tombstoned by a committed remove "
+         "action are always reclaimable.") \
+    .check(lambda v: None if v >= 0 else "must be >= 0").double(600.0)
+
 INTEGRITY_CHECKSUM = conf("srt.integrity.checksum.enabled") \
     .doc("Verify crc32c-style checksums on every off-device byte path "
          "(shuffle blocks at serve/fetch/local read, host+disk spill "

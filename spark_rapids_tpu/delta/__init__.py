@@ -16,8 +16,19 @@ instead of a plugin into someone else's. Same architecture:
 """
 
 from .log import (CommitConflict, MetadataChangedConflict,
-                  TransactionLog)
+                  StaleWriterEpoch, TransactionLog,
+                  sweep_stale_tmp_files)
 from .table import AcidTable
 
 __all__ = ["AcidTable", "TransactionLog", "CommitConflict",
-           "MetadataChangedConflict"]
+           "MetadataChangedConflict", "StaleWriterEpoch",
+           "DeltaIngestor", "sweep_stale_tmp_files"]
+
+
+def __getattr__(name):
+    # streaming pulls in the session layer; keep it import-lazy so
+    # `from ..delta import TransactionLog` deep in io/ stays cheap
+    if name == "DeltaIngestor":
+        from .streaming import DeltaIngestor
+        return DeltaIngestor
+    raise AttributeError(name)

@@ -11,6 +11,8 @@ from __future__ import annotations
 
 import json
 import os
+import random
+import time
 import uuid
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -24,7 +26,10 @@ from ..plan import logical as L
 from ..plan.host_table import HostTable, concat_tables, empty_like
 from ..io.scan import FileScan
 from ..io.writer import write_host_table
-from .log import CommitConflict, MetadataChangedConflict, TransactionLog
+from ..robustness.faults import fault_point
+from .log import (_TMP_RE, CommitConflict, MetadataChangedConflict,
+                  StaleWriterEpoch, TransactionLog, _pid_alive,
+                  fsync_dir, fsync_file, sweep_stale_tmp_files)
 
 
 def _schema_to_json(schema) -> str:
@@ -38,13 +43,82 @@ def _schema_from_json(s: str):
     return [(n, _tag_dtype(tag)) for n, tag in json.loads(s)]
 
 
+class _StagedWrite:
+    """Data files written to ``<final>.<pid>.tmp`` names, promoted to
+    their final paths by rename only at commit time. A crash before
+    ``promote()`` leaves only tmp names (invisible to every reader,
+    reclaimed by the stale-pid sweep); a crash between ``promote()``
+    and the log commit leaves unreferenced final-named files, which
+    VACUUM's orphan sweep reclaims behind the retention guard."""
+
+    def __init__(self, durable: bool, detail: str = ""):
+        self.pairs: List[Tuple[str, str]] = []   # (tmp, final)
+        self.actions: List[dict] = []
+        self.durable = durable
+        self.detail = detail
+        self.promoted = False
+
+    def promote(self) -> None:
+        if self.promoted:
+            return
+        parents = set()
+        for tmp, final in self.pairs:
+            fault_point("delta.rename",
+                        f"{self.detail}file={os.path.basename(final)};")
+            if self.durable:
+                fsync_file(tmp)
+            os.replace(tmp, final)
+            parents.add(os.path.dirname(final))
+        if self.durable:
+            for d in parents:
+                fsync_dir(d)
+        self.promoted = True
+
+    def discard(self) -> None:
+        """Undo an uncommitted write: tmp names before promotion,
+        final names after (the log never referenced either)."""
+        for tmp, final in self.pairs:
+            for p in ((final,) if self.promoted else (tmp,)):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+        self.pairs = []
+        self.actions = []
+
+
 class AcidTable:
     """A transactional parquet table (DeltaTable API shape)."""
 
     def __init__(self, session, path: str):
         self.session = session
         self.path = path
-        self.log = TransactionLog(path)
+        conf = getattr(session, "conf", None)
+        self.log = TransactionLog(path, conf=conf)
+        # reclaim staging leftovers from committers killed mid-write
+        # (the spill-dir stale-pid sweep, applied at catalog init)
+        sweep_stale_tmp_files(path)
+        sweep_stale_tmp_files(self.log.log_dir)
+
+    # --- commit-protocol conf ---
+    def _conf(self, entry):
+        conf = getattr(self.session, "conf", None)
+        if conf is None:
+            from ..conf import active_conf
+            conf = active_conf()
+        return conf.get(entry)
+
+    def _retry_budget(self) -> Tuple[int, float]:
+        from ..conf import DELTA_COMMIT_BACKOFF_MS, DELTA_COMMIT_MAX_RETRIES
+        return (int(self._conf(DELTA_COMMIT_MAX_RETRIES)),
+                float(self._conf(DELTA_COMMIT_BACKOFF_MS)) / 1e3)
+
+    @staticmethod
+    def _backoff(attempt: int, base_s: float) -> None:
+        if base_s <= 0:
+            return
+        cap = min(base_s * (2 ** attempt), base_s * 32)
+        time.sleep(cap * (0.5 + random.random()))
 
     # --- creation ---
     @classmethod
@@ -92,18 +166,27 @@ class AcidTable:
         return self.log.history()
 
     # --- writes ---
-    def _write_files(self, table: HostTable) -> List[dict]:
-        """Write one parquet file per call (plus stats) -> add actions."""
+    def _write_files(self, table: HostTable,
+                     detail: str = "") -> _StagedWrite:
+        """Stage one parquet file per call as ``<final>.<pid>.tmp``;
+        the add actions name the FINAL path, which exists only after
+        ``promote()`` renames it at commit time."""
+        staged = _StagedWrite(self.log.durable, detail)
         if table.num_rows == 0:
-            return []
+            return staged
         fname = f"part-{uuid.uuid4().hex[:12]}.parquet"
         from ..io.arrow_convert import host_table_to_arrow
         import pyarrow.parquet as pq
         at = host_table_to_arrow(table)
         full = os.path.join(self.path, fname)
-        pq.write_table(at, full)
-        return [{"add": {"path": fname, "numRecords": table.num_rows,
-                         "dataChange": True}}]
+        tmp = f"{full}.{os.getpid()}.tmp"
+        fault_point("delta.stage", f"{detail}file={fname};")
+        pq.write_table(at, tmp)
+        staged.pairs.append((tmp, full))
+        staged.actions.append(
+            {"add": {"path": fname, "numRecords": table.num_rows,
+                     "dataChange": True}})
+        return staged
 
     def _winner_actions(self, read_v: int) -> List[dict]:
         """All actions committed by OTHER writers after our snapshot."""
@@ -132,36 +215,95 @@ class AcidTable:
                     f"{operation}: a concurrent transaction changed "
                     "the table schema; re-run against the new schema")
 
-    def _commit_blind(self, actions: List[dict], operation: str,
-                      retries: int = 3) -> int:
+    def _check_txn(self, txn, staged: Optional[_StagedWrite]
+                   ) -> Optional[int]:
+        """Idempotency + fencing gate, re-evaluated against the LIVE
+        head on every commit attempt. Returns the head version when
+        the batch already committed (exactly-once no-op); raises
+        StaleWriterEpoch when a newer writer incarnation holds the
+        table; None means proceed."""
+        app_id, batch_version, epoch = txn
+        state = self.log.txn_state(app_id)
+        if epoch is not None and state["epoch"] != epoch:
+            if staged is not None:
+                staged.discard()
+            from ..obs import events as _events
+            _events.emit("StaleWriterFenced", table=self.path,
+                         appId=app_id, writerEpoch=epoch,
+                         currentEpoch=state["epoch"],
+                         batch=batch_version)
+            raise StaleWriterEpoch(
+                f"writer epoch {epoch} for app {app_id!r} fenced by "
+                f"epoch {state['epoch']} — a replaced incumbent must "
+                "not commit")
+        if batch_version is not None \
+                and state["version"] >= batch_version:
+            if staged is not None:
+                staged.discard()
+            return self.log.latest_version()
+        return None
+
+    @staticmethod
+    def _txn_action(txn) -> List[dict]:
+        app_id, batch_version, epoch = txn
+        t: dict = {"appId": app_id,
+                   "version": -1 if batch_version is None
+                   else batch_version,
+                   "lastUpdated": int(time.time() * 1000)}
+        if epoch is not None:
+            t["epoch"] = epoch
+        return [{"txn": t}]
+
+    def _commit_blind(self, staged: _StagedWrite, operation: str,
+                      txn: Optional[Tuple] = None) -> int:
         """Snapshot-independent commits (append): retrying the same
         actions against a newer head is safe — unless the schema
-        changed underneath."""
+        changed underneath. ``txn=(appId, batchVersion, epoch|None)``
+        adds the idempotent-transaction action and its exactly-once /
+        fencing checks; staged files are promoted by rename exactly
+        once, immediately before the first commit attempt."""
+        retries, backoff_s = self._retry_budget()
+        actions = list(staged.actions)
+        if txn is not None:
+            actions += self._txn_action(txn)
         for attempt in range(retries + 1):
             read_v = self.log.latest_version()
+            if txn is not None:
+                done = self._check_txn(txn, staged)
+                if done is not None:
+                    return done
+            staged.promote()
             try:
                 return self.log.commit(read_v, actions, operation)
             except CommitConflict:
                 self._check_conflict(read_v, operation)
                 if attempt == retries:
+                    staged.discard()
                     raise
+                self._backoff(attempt, backoff_s)
         raise AssertionError("unreachable")
 
-    def _commit_rewrite(self, build_actions, operation: str,
-                        retries: int = 3) -> int:
+    def _commit_rewrite(self, build_actions, operation: str) -> int:
         """Copy-on-write commits: ``build_actions(read_version)`` must
-        read the CURRENT snapshot and return its actions — on conflict
-        the whole rewrite recomputes against the winner's table state
-        (optimistic losers must not replay stale file sets)."""
+        read the CURRENT snapshot and return ``(actions, staged)`` —
+        on conflict the whole rewrite recomputes against the winner's
+        table state (optimistic losers must not replay stale file
+        sets) and the loser's uncommitted files are reclaimed."""
+        retries, backoff_s = self._retry_budget()
         for attempt in range(retries + 1):
             read_v = self.log.latest_version()
-            actions = build_actions(read_v)
+            actions, staged = build_actions(read_v)
+            staged.promote()
             try:
-                return self.log.commit(read_v, actions, operation)
+                return self.log.commit(read_v,
+                                       actions + staged.actions,
+                                       operation)
             except CommitConflict:
+                staged.discard()
                 self._check_conflict(read_v, operation)
                 if attempt == retries:
                     raise
+                self._backoff(attempt, backoff_s)
         raise AssertionError("unreachable")
 
     def _remove_all_current(self, read_v: int) -> List[dict]:
@@ -169,27 +311,64 @@ class AcidTable:
         return [{"remove": {"path": p, "dataChange": True}}
                 for p in files]
 
-    def append(self, df) -> int:
+    def append(self, df, txn_app_id: Optional[str] = None,
+               txn_version: Optional[int] = None,
+               txn_epoch: Optional[int] = None,
+               operation: str = "WRITE (append)") -> int:
+        """Append ``df``. With ``txn_app_id``/``txn_version`` the
+        commit is exactly-once: a retried/resumed writer whose batch
+        already landed returns without writing (Delta's
+        SetTransaction idempotency); ``txn_epoch`` additionally fences
+        stale writer incarnations (StaleWriterEpoch)."""
+        txn = detail = None
+        if txn_app_id is not None:
+            txn = (txn_app_id, txn_version, txn_epoch)
+            detail = f"app={txn_app_id};batch={txn_version};"
+            # resumed writer: skip even the plan execution when the
+            # batch is already in the log
+            done = self._check_txn(txn, None)
+            if done is not None:
+                return done
         table = self.session.execute(df.plan)
-        actions = self._write_files(table)
-        return self._commit_blind(actions, "WRITE (append)")
+        staged = self._write_files(table, detail or "")
+        return self._commit_blind(staged, operation, txn=txn)
+
+    def acquire_writer_epoch(self, app_id: str) -> int:
+        """Claim the streaming-writer role for ``app_id``: commits an
+        epoch bump that fences every earlier incarnation (their next
+        commit raises StaleWriterEpoch). Returns the new epoch."""
+        retries, backoff_s = self._retry_budget()
+        for attempt in range(retries + 1):
+            read_v = self.log.latest_version()
+            epoch = self.log.txn_epoch(app_id) + 1
+            actions = self._txn_action((app_id, None, epoch))
+            try:
+                self.log.commit(read_v, actions,
+                                f"STREAM EPOCH app={app_id};")
+                return epoch
+            except CommitConflict:
+                self._check_conflict(read_v, "STREAM EPOCH")
+                if attempt == retries:
+                    raise
+                self._backoff(attempt, backoff_s)
+        raise AssertionError("unreachable")
 
     def overwrite(self, df) -> int:
         table = self.session.execute(df.plan)
 
-        def build(read_v: int) -> List[dict]:
-            return self._remove_all_current(read_v) + \
-                self._write_files(table)
+        def build(read_v: int):
+            return (self._remove_all_current(read_v),
+                    self._write_files(table))
         return self._commit_rewrite(build, "WRITE (overwrite)")
 
     def delete(self, condition: Expression) -> int:
         """DELETE WHERE cond (GpuDeleteCommand): rewrite surviving rows."""
 
-        def build(read_v: int) -> List[dict]:
+        def build(read_v: int):
             keep = self.to_df(version=read_v).filter(Not(condition))
             table = self.session.execute(keep.plan)
-            return self._remove_all_current(read_v) + \
-                self._write_files(table)
+            return (self._remove_all_current(read_v),
+                    self._write_files(table))
         return self._commit_rewrite(build, "DELETE")
 
     def update(self, set_exprs: Dict[str, Expression],
@@ -209,8 +388,8 @@ class AcidTable:
                 else:
                     projected.append(col(name))
             table = self.session.execute(L.Project(df.plan, projected))
-            return self._remove_all_current(read_v) + \
-                self._write_files(table)
+            return (self._remove_all_current(read_v),
+                    self._write_files(table))
         return self._commit_rewrite(build, "UPDATE")
 
     def merge(self, source, on: Sequence[str],
@@ -331,8 +510,8 @@ class AcidTable:
                 parts.append(L.Project(unmatched_src, insert_cols))
             plan = parts[0] if len(parts) == 1 else L.Union(*parts)
             table = self.session.execute(plan)
-            return meta_actions + self._remove_all_current(read_v) + \
-                self._write_files(table)
+            return (meta_actions + self._remove_all_current(read_v),
+                    self._write_files(table))
         return self._commit_rewrite(build, "MERGE")
 
     def optimize(self, zorder_by: Optional[Sequence[str]] = None) -> int:
@@ -341,24 +520,77 @@ class AcidTable:
         optimize write, GpuOptimisticTransaction + ZOrderRules)."""
         from ..expr.bitwise import InterleaveBits
 
-        def build(read_v: int) -> List[dict]:
+        def build(read_v: int):
             df = self.to_df(version=read_v)
             if zorder_by:
                 df = df.sort(InterleaveBits(
                     *[col(c) for c in zorder_by]))
             table = self.session.execute(df.plan)
-            return self._remove_all_current(read_v) + \
-                self._write_files(table)
+            return (self._remove_all_current(read_v),
+                    self._write_files(table))
         return self._commit_rewrite(
             build, f"OPTIMIZE{' ZORDER' if zorder_by else ''}")
 
-    def vacuum(self) -> List[str]:
-        """Delete data files no longer referenced by the head snapshot."""
+    def vacuum(self, retention_sec: Optional[float] = None) -> List[str]:
+        """Reclaim dead bytes: data files the log has tombstoned
+        (committed, then removed — always reclaimable), plus crash
+        orphans the log never referenced — staged ``.tmp`` files and
+        promoted-but-uncommitted data files. Orphans younger than
+        ``retention_sec`` (default ``srt.delta.vacuum.retentionSec``)
+        survive, because they may belong to a commit in flight;
+        staging files whose owner pid is dead are swept regardless."""
+        if retention_sec is None:
+            from ..conf import DELTA_VACUUM_RETENTION_SEC
+            retention_sec = float(self._conf(DELTA_VACUUM_RETENTION_SEC))
         _, files = self.log.snapshot()
         live = set(files)
-        removed = []
-        for f in os.listdir(self.path):
-            if f.endswith(".parquet") and f not in live:
-                os.unlink(os.path.join(self.path, f))
+        # every path any commit ever added: present-but-not-live means
+        # tombstoned; never-referenced means a crash orphan
+        referenced = set()
+        for v in self.log.versions():
+            for a in self.log.read_actions(v):
+                if "add" in a:
+                    referenced.add(a["add"]["path"])
+        now = time.time()
+        removed: List[str] = []
+        orphans = 0
+        for f in sorted(os.listdir(self.path)):
+            full = os.path.join(self.path, f)
+            if f in live or not os.path.isfile(full):
+                continue
+            m = _TMP_RE.search(f)
+            if m is not None:
+                pid = int(m.group(1))
+                if pid != os.getpid() and not _pid_alive(pid):
+                    pass          # dead stager: reclaim regardless of age
+                elif self._age(full, now) < retention_sec:
+                    continue      # possibly mid-commit: retention guard
+                orphans += 1
+            elif f.endswith(".parquet"):
+                if f not in referenced \
+                        and self._age(full, now) < retention_sec:
+                    continue      # promoted, commit may be in flight
+                if f not in referenced:
+                    orphans += 1
+            else:
+                continue
+            try:
+                os.unlink(full)
                 removed.append(f)
+            except OSError:
+                pass
+        swept_log = sweep_stale_tmp_files(self.log.log_dir)
+        removed.extend(swept_log)
+        from ..obs import events as _events
+        _events.emit("DeltaOrphanSwept", table=self.path,
+                     removed=len(removed), orphans=orphans,
+                     logTmps=len(swept_log),
+                     retentionSec=retention_sec)
         return removed
+
+    @staticmethod
+    def _age(path: str, now: float) -> float:
+        try:
+            return now - os.path.getmtime(path)
+        except OSError:
+            return float("inf")   # gone already: no need to guard it

@@ -65,9 +65,13 @@ def expand_paths(path_or_paths, conf=None) -> List[str]:
     out: List[str] = []
     for p in paths:
         if os.path.isdir(p):
+            # skip _metadata/.hidden AND *.tmp staging leftovers from
+            # writers killed between encode and rename (io/writer.py,
+            # delta staging) — a tmp is never a readable data file
             for root, _dirs, files in os.walk(p):
                 out.extend(os.path.join(root, f) for f in sorted(files)
-                           if not f.startswith(("_", ".")))
+                           if not f.startswith(("_", "."))
+                           and not f.endswith(".tmp"))
         elif any(ch in p for ch in "*?["):
             out.extend(sorted(globlib.glob(p)))
         else:

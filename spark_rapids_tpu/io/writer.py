@@ -150,8 +150,20 @@ def write_host_table(table: HostTable, path: str, fmt: str,
         os.makedirs(directory, exist_ok=True)
         fname = f"part-{len(stats.partitions):05d}-{job_id}{_EXT[fmt]}"
         full = os.path.join(directory, fname)
+        # temp-file-then-rename: a writer killed mid-encode leaves only
+        # a .tmp (ignored by scans, reclaimed by the stale-pid sweep),
+        # never a truncated file at a final path
+        tmp = f"{full}.{os.getpid()}.tmp"
         at = host_table_to_arrow(sub_table)
-        stats.num_bytes += _write_one(at, full, fmt, options)
+        try:
+            stats.num_bytes += _write_one(at, tmp, fmt, options)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        os.replace(tmp, full)
         stats.num_files += 1
         stats.num_rows += sub_table.num_rows
         stats.partitions.append(part_label or ".")

@@ -46,6 +46,8 @@ EVENT_TYPES = (
     "AdaptivePlanChanged", "SkewSplit", "SpeculativeTask",
     "WorkerDecommissioned", "BlockMigrated", "ZombieFenced",
     "ReplicaFetch", "RecoveryTimed",
+    "DeltaCommit", "DeltaLogCheckpointed", "DeltaOrphanSwept",
+    "StreamBatchCommitted", "StreamBatchSkipped", "StaleWriterFenced",
 )
 
 

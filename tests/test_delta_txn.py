@@ -1,0 +1,460 @@
+"""Exactly-once crash-consistent Delta ingestion (delta/log.py
+transactional commit protocol, delta/streaming.py micro-batches,
+io/writer.py temp-then-rename): crash-grammar fault plans at every new
+fault site, concurrent-committer property, idempotent txn replay,
+checkpoint-compaction equivalence, writer-epoch fencing."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.conf import SrtConf
+from spark_rapids_tpu.delta import (AcidTable, CommitConflict,
+                                    StaleWriterEpoch, TransactionLog,
+                                    sweep_stale_tmp_files)
+from spark_rapids_tpu.delta.streaming import (DeltaIngestor,
+                                              demo_batch_dict,
+                                              demo_expected, demo_schema)
+from spark_rapids_tpu.obs import events as ev
+from spark_rapids_tpu.plan import TpuSession
+from spark_rapids_tpu.robustness.faults import (arm_fault_plan,
+                                                disarm_fault_plan)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+#: pid guaranteed dead (pid_max on Linux caps below 2**22 by default;
+#: 99999999 can never be a live pid on any test box)
+DEAD_PID = 99999999
+
+
+@pytest.fixture(scope="module")
+def session():
+    return TpuSession(SrtConf({"srt.delta.checkpointInterval": "0"}))
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    disarm_fault_plan()
+    ev.install(None)
+
+
+def make_table(session, tmp_path, name="t", **conf):
+    sess = session if not conf else TpuSession(
+        SrtConf(dict({"srt.delta.checkpointInterval": "0"}, **conf)))
+    t = AcidTable.create(sess, str(tmp_path / name),
+                         [("id", dt.INT64), ("v", dt.FLOAT64)])
+    return sess, t
+
+
+def df_for(sess, ids):
+    return sess.create_dataframe(
+        {"id": list(ids), "v": [float(i) for i in ids]},
+        [("id", dt.INT64), ("v", dt.FLOAT64)])
+
+
+def table_ids(t):
+    return sorted(r["id"] for r in t.to_df().collect())
+
+
+# ------------------------------------------------------- tmp hygiene
+
+def test_versions_ignore_tmp_and_checkpoint_files(session, tmp_path):
+    _, t = make_table(session, tmp_path)
+    t.append(df_for(session, [1, 2]))
+    log_dir = t.log.log_dir
+    # a crashed committer's tmp and a checkpoint are not versions
+    for junk in (f"{2:020d}.json.{DEAD_PID}.tmp",
+                 f"{1:020d}.checkpoint.json", "garbage.json"):
+        with open(os.path.join(log_dir, junk), "w") as f:
+            f.write("{}\n")
+    assert t.log.versions() == [0, 1]
+    assert t.log.latest_version() == 1
+    # snapshot unaffected by the leftovers
+    _, files = t.log.snapshot()
+    assert len(files) == 1
+
+
+def test_catalog_init_sweeps_stale_pid_tmps(session, tmp_path):
+    _, t = make_table(session, tmp_path)
+    t.append(df_for(session, [1]))
+    dead_data = os.path.join(t.path, f"part-x.parquet.{DEAD_PID}.tmp")
+    dead_log = os.path.join(t.log.log_dir,
+                            f"{9:020d}.json.{DEAD_PID}.tmp")
+    live_data = os.path.join(t.path,
+                             f"part-y.parquet.{os.getpid()}.tmp")
+    for p in (dead_data, dead_log, live_data):
+        with open(p, "w") as f:
+            f.write("x")
+    AcidTable.for_path(session, t.path)  # init sweep
+    assert not os.path.exists(dead_data)
+    assert not os.path.exists(dead_log)
+    # a LIVE pid's staging file is an in-flight write: untouched
+    assert os.path.exists(live_data)
+
+
+def test_plain_dir_scan_ignores_tmp_leftovers(session, tmp_path):
+    out = str(tmp_path / "plain")
+    df_for(session, [1, 2, 3]).write.parquet(out)
+    with open(os.path.join(out, f"part-zz.parquet.{DEAD_PID}.tmp"),
+              "w") as f:
+        f.write("not parquet at all")
+    rows = session.read.parquet(out).collect()
+    assert sorted(r["id"] for r in rows) == [1, 2, 3]
+
+
+def test_failed_write_leaves_no_final_path(session, tmp_path, monkeypatch):
+    """A writer dying mid-encode must never leave a truncated file at
+    a final path (io/writer.py temp-then-rename)."""
+    import pyarrow.parquet as pq
+    out = str(tmp_path / "dies")
+    orig = pq.write_table
+
+    def dying(table, path, **kw):
+        with open(path, "wb") as f:
+            f.write(b"PAR1\x00trunc")   # half-written bytes
+        raise RuntimeError("killed mid-encode")
+    monkeypatch.setattr(pq, "write_table", dying)
+    with pytest.raises(RuntimeError):
+        df_for(session, [1, 2]).write.parquet(out)
+    monkeypatch.setattr(pq, "write_table", orig)
+    final = [f for f in os.listdir(out)] if os.path.isdir(out) else []
+    assert not any(f.endswith(".parquet") for f in final), final
+
+
+# ------------------------------------------------- idempotent txn
+
+def test_idempotent_txn_replay(session, tmp_path):
+    _, t = make_table(session, tmp_path)
+    v1 = t.append(df_for(session, [1, 2]), txn_app_id="app",
+                  txn_version=0)
+    assert t.log.txn_version("app") == 0
+    # the SAME batch retried (speculative duplicate, resumed writer)
+    # is a no-op: no new version, no duplicate rows
+    v2 = t.append(df_for(session, [1, 2]), txn_app_id="app",
+                  txn_version=0)
+    assert v2 == t.log.latest_version() == v1
+    assert table_ids(t) == [1, 2]
+    # the NEXT batch commits normally
+    t.append(df_for(session, [3]), txn_app_id="app", txn_version=1)
+    assert t.log.txn_version("app") == 1
+    assert table_ids(t) == [1, 2, 3]
+
+
+def test_txn_apps_are_independent(session, tmp_path):
+    _, t = make_table(session, tmp_path)
+    t.append(df_for(session, [1]), txn_app_id="a", txn_version=0)
+    t.append(df_for(session, [2]), txn_app_id="b", txn_version=0)
+    assert t.log.txn_version("a") == 0
+    assert t.log.txn_version("b") == 0
+    assert t.log.txn_version("c") == -1
+    assert table_ids(t) == [1, 2]
+
+
+# ----------------------------------------------- concurrent committers
+
+def test_concurrent_committers_all_land(session, tmp_path):
+    """Property: N threads racing blind appends through the optimistic
+    loop must ALL land (bounded-backoff retry), producing contiguous
+    versions and the union of all rows — no lost update, no dupes."""
+    sess, t = make_table(session, tmp_path,
+                         **{"srt.delta.commit.maxRetries": "30",
+                            "srt.delta.commit.backoffMs": "2"})
+    n_threads, per_thread = 4, 5
+    errors = []
+
+    def worker(k):
+        try:
+            for i in range(per_thread):
+                ids = [k * 1000 + i]
+                t.append(df_for(sess, ids))
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(k,))
+               for k in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(120)
+    assert not errors, errors
+    total = n_threads * per_thread
+    assert t.log.versions() == list(range(total + 1))  # +CREATE
+    expect = sorted(k * 1000 + i for k in range(n_threads)
+                    for i in range(per_thread))
+    assert table_ids(t) == expect
+
+
+def test_conflict_surfaces_after_retries_exhausted(session, tmp_path):
+    sess, t = make_table(session, tmp_path,
+                         **{"srt.delta.commit.maxRetries": "0"})
+    read_v = t.log.latest_version()
+    t.log.commit(read_v, [], "WRITE")  # make the snapshot stale
+    with pytest.raises(CommitConflict):
+        t.log.commit(read_v, [], "WRITE")
+
+
+# ------------------------------------------------- durable commits
+
+def test_durable_commits_fsync_log_and_data(tmp_path, monkeypatch):
+    calls = []
+    real_fsync = os.fsync
+
+    def counting(fd):
+        calls.append(fd)
+        return real_fsync(fd)
+    sess = TpuSession(SrtConf({"srt.delta.checkpointInterval": "0"}))
+    _, t = make_table(sess, tmp_path, "durable")
+    monkeypatch.setattr(os, "fsync", counting)
+    t.append(df_for(sess, [1, 2]))
+    assert calls, "durableCommits=true must fsync"
+    calls.clear()
+    sess2 = TpuSession(SrtConf({"srt.delta.durableCommits": "false",
+                                "srt.delta.checkpointInterval": "0"}))
+    t2 = AcidTable.create(sess2, str(tmp_path / "relaxed"),
+                          [("id", dt.INT64), ("v", dt.FLOAT64)])
+    t2.append(df_for(sess2, [1]))
+    assert not calls, "durableCommits=false must not fsync"
+
+
+def test_staged_files_promoted_only_at_commit(session, tmp_path,
+                                              monkeypatch):
+    """A commit that fails before the log link leaves NO final-named
+    data file the snapshot could ever see."""
+    sess, t = make_table(session, tmp_path,
+                         **{"srt.delta.commit.maxRetries": "0"})
+    boom = RuntimeError("die before log link")
+
+    def no_commit(read_version, actions, operation):
+        raise boom
+    monkeypatch.setattr(t.log, "commit", no_commit)
+    with pytest.raises(RuntimeError):
+        t.append(df_for(sess, [7, 8]))
+    monkeypatch.undo()
+    assert table_ids(t) == []
+    # the staged write was promoted before the failed commit: the
+    # orphan has a final name but is invisible (log never names it)
+    # and reclaimable past retention
+    assert t.to_df().collect() == []
+
+
+# --------------------------------------------------- vacuum guard
+
+def test_vacuum_retention_guard(session, tmp_path):
+    _, t = make_table(session, tmp_path)
+    t.append(df_for(session, [1]))
+    orphan = os.path.join(t.path, "part-orphan00001.parquet")
+    with open(orphan, "wb") as f:
+        f.write(b"never committed")
+    dead_tmp = os.path.join(t.path,
+                            f"part-q.parquet.{DEAD_PID}.tmp")
+    with open(dead_tmp, "w") as f:
+        f.write("x")
+    # young orphan survives the guard; dead-pid staging never does
+    removed = t.vacuum(retention_sec=3600.0)
+    assert os.path.exists(orphan)
+    assert not os.path.exists(dead_tmp)
+    assert os.path.basename(dead_tmp) in removed
+    # past retention (or an explicit 0) the orphan is reclaimed
+    removed = t.vacuum(retention_sec=0.0)
+    assert os.path.basename(orphan) in removed
+    assert not os.path.exists(orphan)
+    # committed live data untouched either way
+    assert table_ids(t) == [1]
+
+
+def test_vacuum_still_reclaims_tombstones_immediately(session, tmp_path):
+    _, t = make_table(session, tmp_path)
+    t.append(df_for(session, [1, 2]))
+    before = {f for f in os.listdir(t.path) if f.endswith(".parquet")}
+    t.overwrite(df_for(session, [9]))
+    removed = t.vacuum()  # default retention: tombstones exempt
+    assert before & set(removed) == before
+    assert table_ids(t) == [9]
+
+
+# ---------------------------------------------- checkpoint compaction
+
+def _full_replay(log: TransactionLog):
+    return log._fold(log.latest_version(), use_checkpoint=False)
+
+
+def test_checkpoint_compaction_equivalence(tmp_path):
+    sess = TpuSession(SrtConf({"srt.delta.checkpointInterval": "3"}))
+    t = AcidTable.create(sess, str(tmp_path / "ck"),
+                         [("id", dt.INT64), ("v", dt.FLOAT64)])
+    for i in range(4):
+        t.append(df_for(sess, [i]), txn_app_id="s", txn_version=i)
+    t.overwrite(df_for(sess, [100, 101]))
+    for i in range(4, 7):
+        t.append(df_for(sess, [i]), txn_app_id="s", txn_version=i)
+    ptr = os.path.join(t.log.log_dir, "_last_checkpoint")
+    assert os.path.exists(ptr)
+    rec = json.load(open(ptr))
+    assert rec["version"] % 3 == 0 and "crc32" in rec
+    # checkpointed fold == full replay, for files AND txn state
+    meta_c, files_c, txns_c = t.log._fold(t.log.latest_version())
+    meta_f, files_f, txns_f = _full_replay(t.log)
+    assert (meta_c, files_c, txns_c) == (meta_f, files_f, txns_f)
+    assert t.log.txn_version("s") == 6
+    # replay is bounded: snapshot() must not read commits at or below
+    # the checkpoint version
+    reads = []
+    orig = TransactionLog.read_actions
+
+    def counting(self, version):
+        reads.append(version)
+        return orig(self, version)
+    try:
+        TransactionLog.read_actions = counting
+        t.log.snapshot()
+    finally:
+        TransactionLog.read_actions = orig
+    assert reads and min(reads) > rec["version"]
+
+
+def test_corrupt_checkpoint_falls_back_to_full_replay(tmp_path):
+    sess = TpuSession(SrtConf({"srt.delta.checkpointInterval": "2"}))
+    t = AcidTable.create(sess, str(tmp_path / "ckc"),
+                         [("id", dt.INT64), ("v", dt.FLOAT64)])
+    for i in range(4):
+        t.append(df_for(sess, [i]))
+    ck = [f for f in os.listdir(t.log.log_dir)
+          if f.endswith(".checkpoint.json")]
+    assert ck
+    path = os.path.join(t.log.log_dir, sorted(ck)[-1])
+    with open(path, "r+b") as f:
+        f.seek(os.path.getsize(path) // 2)
+        b = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([b[0] ^ 0xFF]))
+    # crc catches the flip; the fold silently uses the full JSON log
+    assert table_ids(t) == [0, 1, 2, 3]
+
+
+def test_checkpoint_corrupt_point_detected(tmp_path):
+    """A byte-flip injected AS the checkpoint is written
+    (delta.checkpoint.bytes corrupt site) must be caught by the crc on
+    the next read and reported, never silently folded."""
+    events_dir = str(tmp_path / "events")
+    ev.install(ev.EventLogWriter(events_dir))
+    sess = TpuSession(SrtConf({"srt.delta.checkpointInterval": "2"}))
+    t = AcidTable.create(sess, str(tmp_path / "ckp"),
+                         [("id", dt.INT64), ("v", dt.FLOAT64)])
+    t.append(df_for(sess, [0]))
+    arm_fault_plan("delta.checkpoint.bytes:corrupt@1")
+    t.append(df_for(sess, [1]))       # commit 2 writes the checkpoint
+    disarm_fault_plan()
+    assert table_ids(t) == [0, 1]     # fallback replay, right answer
+    recs = ev.read_all_events(events_dir)
+    assert any(r["event"] == "CorruptionDetected"
+               and r.get("kind") == "delta_checkpoint" for r in recs)
+    # post-corruption commits repair the pointer at the next interval
+    t.append(df_for(sess, [2]))
+    t.append(df_for(sess, [3]))
+    assert table_ids(t) == [0, 1, 2, 3]
+
+
+def test_time_travel_below_checkpoint(tmp_path):
+    sess = TpuSession(SrtConf({"srt.delta.checkpointInterval": "2"}))
+    t = AcidTable.create(sess, str(tmp_path / "tt"),
+                         [("id", dt.INT64), ("v", dt.FLOAT64)])
+    for i in range(5):
+        t.append(df_for(sess, [i]))
+    # version 1 predates every checkpoint: full-replay path
+    rows = t.to_df(version=1).collect()
+    assert sorted(r["id"] for r in rows) == [0]
+
+
+# ------------------------------------------------ writer fencing
+
+def test_writer_epoch_fencing(session, tmp_path):
+    events_dir = str(tmp_path / "events")
+    ev.install(ev.EventLogWriter(events_dir))
+    _, t = make_table(session, tmp_path, "fence")
+    a = DeltaIngestor(t, "app")
+    bf = lambda b: df_for(session, range(b * 10, b * 10 + 10))  # noqa: E731
+    a.ingest(bf, 2)
+    # a replacement incarnation fences the incumbent...
+    b = DeltaIngestor(t, "app")
+    assert b.epoch == a.epoch + 1
+    # ...which may not commit batch 2 even though it is genuinely new
+    with pytest.raises(StaleWriterEpoch):
+        a.ingest(bf, 3)
+    recs = ev.read_all_events(events_dir)
+    fenced = [r for r in recs if r["event"] == "StaleWriterFenced"]
+    assert fenced and fenced[0]["writerEpoch"] == a.epoch \
+        and fenced[0]["currentEpoch"] == b.epoch
+    # the replacement resumes exactly-once past the incumbent's work
+    stats = b.ingest(bf, 3)
+    assert stats == {"committed": 1, "skipped": 2}
+    assert table_ids(t) == list(range(30))
+
+
+def test_ingest_resume_skips_committed(session, tmp_path):
+    _, t = make_table(session, tmp_path, "resume")
+    bf = lambda b: df_for(session, [b])  # noqa: E731
+    DeltaIngestor(t, "s").ingest(bf, 3)
+    stats = DeltaIngestor(t, "s").ingest(bf, 5)
+    assert stats == {"committed": 2, "skipped": 3}
+    assert table_ids(t) == [0, 1, 2, 3, 4]
+
+
+# ---------------------------------------- crash grammar (subprocess)
+
+def _run_child(table, app, batches, rows, fault_plan="", create=False,
+               events_dir=""):
+    cmd = [sys.executable, "-m", "spark_rapids_tpu.delta.streaming",
+           table, app, str(batches), str(rows)]
+    if fault_plan:
+        cmd += ["--fault-plan", fault_plan]
+    if create:
+        cmd += ["--create"]
+    if events_dir:
+        cmd += ["--events-dir", events_dir]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(cmd, cwd=ROOT, env=env, capture_output=True,
+                          text=True, timeout=180)
+
+
+CRASH_SITES = [
+    # (site clause, needs-durable) — every new fault site of the
+    # commit protocol gets a kill, and resume must stay exactly-once
+    "delta.stage:crash@2",
+    "delta.rename:crash@2",
+    "delta.commit:crash@4",       # CREATE + epoch are hits 1-2
+    "delta.commit.fsync:crash@3",
+    "delta.checkpoint:crash@1",
+]
+
+
+@pytest.mark.parametrize("clause", CRASH_SITES)
+def test_crash_then_resume_exactly_once(tmp_path, clause):
+    table = str(tmp_path / "crash")
+    batches, rows = 6, 40
+    p = _run_child(table, "chaos", batches, rows,
+                   fault_plan=f"seed=13|{clause}", create=True)
+    assert p.returncode == 137, \
+        f"child should die at {clause}: rc={p.returncode}\n{p.stderr}"
+    p = _run_child(table, "chaos", batches, rows)
+    assert p.returncode == 0, p.stderr
+    sess = TpuSession()
+    t = AcidTable.for_path(sess, table)
+    got = t.to_df().collect()
+    exp = demo_expected(batches, rows)
+    assert len(got) == exp["rows"]
+    assert len({r["id"] for r in got}) == exp["distinct_ids"]
+    assert abs(sum(r["v"] for r in got) - exp["sum_v"]) < 1e-6
+    # zero uncommitted files after the orphan sweep
+    t.vacuum(retention_sec=0.0)
+    live = set(t.log.snapshot()[1])
+    on_disk = {f for f in os.listdir(table) if f.endswith(".parquet")}
+    assert on_disk == live
+    assert not [f for f in os.listdir(table) if f.endswith(".tmp")]
+    assert not [f for f in os.listdir(t.log.log_dir)
+                if f.endswith(".tmp")]

@@ -13,7 +13,11 @@ at rest, corrupt input files, and a flipped disk-tier spill entry —
 every one must be detected and recovered, never a silently wrong
 answer), and the adaptive-execution paths (seeded skew and wrong
 broadcast thresholds swept adaptive on/off with identical results,
-plus a speculated straggler). A nonzero exit means a divergent result, a failed run, or a
+plus a speculated straggler). A streaming-ingestion leg SIGKILLs the
+Delta ingester child at seeded commit-protocol fault points
+(stage/rename/commit/fsync), relaunches it, and asserts exactly-once
+row counts with zero orphans, plus stale-epoch writer fencing. A
+nonzero exit means a divergent result, a failed run, or a
 blown wall-clock budget — any of which is a real robustness
 regression.
 
@@ -1191,6 +1195,143 @@ def _rows_match(rows, oracle):
     return True
 
 
+def _streaming_ingest_check() -> int:
+    """Exactly-once ingestion leg: the streaming ingester child
+    (``python -m spark_rapids_tpu.delta.streaming``) is SIGKILLed
+    mid-ingest at a seeded fault point in each layer of the commit
+    protocol — data-file staging, staged->final rename, the commit
+    link, the pre-link fsync — then relaunched with no plan. Each
+    resume must land exactly-once row counts (the txn log skips the
+    batches that survived the kill), leave ZERO orphans after the
+    vacuum sweep, and zero staging leftovers. A final in-process leg
+    fences a stale-epoch incumbent and asserts the refusal is
+    observable (StaleWriterFenced). Returns failure count."""
+    import subprocess
+
+    from spark_rapids_tpu.conf import SrtConf
+    from spark_rapids_tpu.delta import AcidTable, StaleWriterEpoch
+    from spark_rapids_tpu.delta.streaming import (DeltaIngestor,
+                                                  demo_batch_dict,
+                                                  demo_expected,
+                                                  demo_schema)
+    from spark_rapids_tpu.obs import events as ev
+    from spark_rapids_tpu.plan import TpuSession
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    failures = 0
+    # hit counts: CREATE and the epoch acquisition are commits 1-2
+    # (they stage no data files), so these land mid-stream, never on
+    # the bootstrap commits
+    sites = [("delta.stage", "crash@2"),
+             ("delta.rename", "crash@2"),
+             ("delta.commit", "crash@4"),
+             ("delta.commit.fsync", "crash@3")]
+    batches, rows = 6, 50
+    expect = demo_expected(batches, rows)
+    session = TpuSession(SrtConf({}))
+    with tempfile.TemporaryDirectory(prefix="srt_ingest_") as tmp:
+        for i, (site, action) in enumerate(sites):
+            t = time.monotonic()
+            name = f"ingest: kill at {site}"
+            table = os.path.join(tmp, f"t{i}")
+            cmd = [sys.executable, "-m",
+                   "spark_rapids_tpu.delta.streaming", table, "chaos",
+                   str(batches), str(rows), "--create"]
+            p = subprocess.run(
+                cmd + ["--fault-plan", f"seed={31 + i}|{site}:{action}"],
+                cwd=root, env=env, capture_output=True, text=True,
+                timeout=180)
+            checks = [(f"child killed mid-ingest (rc 137, got "
+                       f"{p.returncode})", p.returncode == 137)]
+            p = subprocess.run(cmd, cwd=root, env=env,
+                               capture_output=True, text=True,
+                               timeout=180)
+            checks.append((f"resume run exits 0 (got {p.returncode})",
+                           p.returncode == 0))
+            at = AcidTable.for_path(session, table)
+            got = at.to_df().collect()
+            sum_v = sum(r["v"] for r in got)
+            checks += [
+                (f"exactly-once rows ({len(got)}/{expect['rows']})",
+                 len(got) == expect["rows"]),
+                ("no duplicated ids",
+                 len({r["id"] for r in got}) == expect["distinct_ids"]),
+                (f"sum(v) exact ({sum_v} vs {expect['sum_v']})",
+                 abs(sum_v - expect["sum_v"]) < 1e-6),
+            ]
+            at.vacuum(retention_sec=0.0)
+            live = set(at.log.snapshot()[1])
+            on_disk = {f for f in os.listdir(table)
+                       if f.endswith(".parquet")}
+            leftovers = [f for d in (table, at.log.log_dir)
+                         for f in os.listdir(d) if f.endswith(".tmp")]
+            checks += [
+                ("zero orphans after sweep", on_disk == live),
+                (f"zero staging leftovers ({leftovers})",
+                 not leftovers),
+            ]
+            leg_fail = 0
+            for what, ok in checks:
+                if not ok:
+                    print(f"[chaos] FAIL [{name}]: {what}",
+                          file=sys.stderr, flush=True)
+                    leg_fail += 1
+            print(f"[chaos] {'PASS' if not leg_fail else 'FAIL'} "
+                  f"[{name}] {time.monotonic() - t:.1f}s",
+                  flush=True)
+            failures += leg_fail
+
+        # --- stale-epoch fencing: the zombie writer is refused ---
+        t = time.monotonic()
+        name = "ingest: stale-epoch writer fenced"
+        events_dir = os.path.join(tmp, "events")
+        ev.install(ev.EventLogWriter(events_dir))
+        try:
+            table = AcidTable.create(session, os.path.join(tmp, "fence"),
+                                     demo_schema())
+
+            def bf(b):
+                return session.create_dataframe(
+                    demo_batch_dict(b, 20), demo_schema())
+
+            a = DeltaIngestor(table, "app")
+            a.ingest(bf, 2)
+            b = DeltaIngestor(table, "app")   # fences a
+            fenced = False
+            try:
+                a.ingest(bf, 3)
+            except StaleWriterEpoch:
+                fenced = True
+            recs = ev.read_all_events(events_dir)
+            fev = [r for r in recs if r["event"] == "StaleWriterFenced"]
+            stats = b.ingest(bf, 3)
+            rows_now = table.to_df().collect()
+            checks = [
+                ("stale incumbent raises StaleWriterEpoch", fenced),
+                ("refusal emits StaleWriterFenced", bool(fev)),
+                ("event names both epochs",
+                 bool(fev) and fev[0].get("writerEpoch") == a.epoch
+                 and fev[0].get("currentEpoch") == b.epoch),
+                (f"replacement resumes exactly-once ({stats})",
+                 stats == {"committed": 1, "skipped": 2}),
+                ("no rows lost or duplicated", len(rows_now) == 60
+                 and len({r["id"] for r in rows_now}) == 60),
+            ]
+        finally:
+            ev.install(None)
+        leg_fail = 0
+        for what, ok in checks:
+            if not ok:
+                print(f"[chaos] FAIL [{name}]: {what}",
+                      file=sys.stderr, flush=True)
+                leg_fail += 1
+        print(f"[chaos] {'PASS' if not leg_fail else 'FAIL'} [{name}] "
+              f"{time.monotonic() - t:.1f}s", flush=True)
+        failures += leg_fail
+    return failures
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--quick", action="store_true",
@@ -1396,6 +1537,9 @@ def main() -> int:
     # push-shuffle leg: eager push / segments / locality under faults
     failures += _push_shuffle_check()
     failures += _membership_check()
+    # exactly-once streaming-ingest leg: SIGKILL the ingester child at
+    # seeded commit-protocol fault points, resume, assert exactly-once
+    failures += _streaming_ingest_check()
     watchdog.cancel()
     print(f"[chaos] done in {time.monotonic() - t0:.1f}s, "
           f"{failures} failure(s)", flush=True)

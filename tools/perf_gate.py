@@ -43,12 +43,12 @@ from typing import Any, Dict, List, Optional, Tuple
 _SHAPE_KEYS = ("backend", "rows", "nds_scale_rows")
 
 #: rate-key suffixes (higher is better)
-_RATE_SUFFIXES = ("_gb_s", "_gbs", "_rows_s", "_mrows_s",
+_RATE_SUFFIXES = ("_gb_s", "_gbs", "_rows_s", "_mrows_s", "_per_s",
                   "_vs_baseline", "_speedup")
 _RATE_KEYS = ("value",)
 
 #: keys that end in _s but are not durations
-_NOT_TIME = ("_rows_s", "_mrows_s", "_gb_s")
+_NOT_TIME = ("_rows_s", "_mrows_s", "_gb_s", "_per_s")
 
 
 def load_bench(path: str) -> Dict[str, Any]:
