@@ -51,7 +51,13 @@ shuffle READ phase under eager push + per-reducer segments vs classic
 per-block pull, recording nds_shuffle_push_read_s /
 nds_shuffle_pull_read_s, per-partition fetch-latency p99s, the
 nds_shuffle_push_speedup ratio, and the zero-copy
-nds_shuffle_bytes_bypassed count from a local-session lane).
+nds_shuffle_bytes_bypassed count from a local-session lane),
+SRT_BENCH_SERVE=1 (sustained-QPS serving lane: >=4 socket replay
+clients against one SqlServer for >=30s of Zipf-mixed NDS traffic
+through tools/serve_bench.py — records serve_p50/p90/p99_ms with a
+per-admission-tier split, serve_qps_sustained, load-shed and
+cross-query-spill counts, and the result-cache / plan-cache hit
+rates; SRT_BENCH_SERVE_SECONDS / _CLIENTS / _QPS tune the window).
 """
 
 import json
@@ -67,6 +73,11 @@ T_START = time.monotonic()
 # a complete JSON record; the extra room lets the NDS sweep + the
 # delta-merge/mortgage stages (BASELINE configs 4-5) run on slow boxes
 BUDGET = float(os.environ.get("SRT_BENCH_BUDGET", 600))
+# the NDS sweep spends every second the budget has left (per-query
+# left() checks + the A/B legs splitting the full remainder), so the
+# socket serving lane behind it must reserve its window up front
+SERVE_RESERVE = 130.0 if os.environ.get("SRT_BENCH_SERVE") == "1" \
+    else 0.0
 ITERS = int(os.environ.get("SRT_BENCH_ITERS", 2))
 KERNEL_ROWS = 1 << 22
 KERNEL_ITERS = 10
@@ -1098,7 +1109,8 @@ def main():
                     RESULT[f"{key_prefix}total_s"] = round(
                         time.perf_counter() - t0, 2)
                 for qid in ordered:
-                    if not left(f"nds {qid} [{label}]", need=20):
+                    if not left(f"nds {qid} [{label}]",
+                                need=20 + SERVE_RESERVE):
                         break
                     if deadline is not None and \
                             time.monotonic() >= deadline:
@@ -1157,7 +1169,8 @@ def main():
                 # split the remaining budget evenly so the first lane
                 # can't starve the second — an A/B with an empty off
                 # lane has no common queries and records no delta
-                rem = BUDGET - (time.monotonic() - T_START)
+                rem = BUDGET - (time.monotonic() - T_START) \
+                    - SERVE_RESERVE
                 for i, (label, enabled) in enumerate(legs):
                     share = rem / len(legs) * (i + 1)
                     walls[label] = run_leg(
@@ -1209,6 +1222,35 @@ def main():
                 emit()
         except Exception as e:  # breadth stage must never kill the bench
             log(f"nds power run failed: {e}")
+
+    # --- serving lane (SRT_BENCH_SERVE=1): sustained-QPS multi-tenant
+    # window through the socket front door (tools/serve_bench.py) — 4
+    # replay clients against one SqlServer for >=30s of Zipf-mixed NDS
+    # traffic, recording per-tier latency quantiles, sustained QPS,
+    # and the result-cache / plan-cache hit rates the gate enforces
+    if os.environ.get("SRT_BENCH_SERVE", "") == "1" and \
+            left("serving lane", need=120):
+        try:
+            sys.path.insert(0, os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "tools"))
+            from serve_bench import run_serve_bench
+            serve_scale = int(os.environ.get(
+                "SRT_BENCH_NDS_SCALE",
+                100_000 if backend != "cpu" else 8000))
+            serve_keys = run_serve_bench(
+                duration_s=float(os.environ.get(
+                    "SRT_BENCH_SERVE_SECONDS", 35)),
+                clients=int(os.environ.get(
+                    "SRT_BENCH_SERVE_CLIENTS", 4)),
+                qps=float(os.environ.get("SRT_BENCH_SERVE_QPS", 8)),
+                scale_rows=serve_scale,
+                data_dir=os.path.join(os.path.dirname(data_dir),
+                                      f"nds_{serve_scale}"),
+                log=log)
+            RESULT.update(serve_keys)
+            emit()
+        except Exception as e:  # serving lane must never kill the run
+            log(f"serving lane failed: {e}")
 
     embed_metrics()
     embed_compile_ledger()

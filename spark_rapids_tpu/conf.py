@@ -1011,6 +1011,52 @@ QUERY_TIMEOUT_S = conf("srt.sql.queryTimeout") \
          "call.") \
     .check(_non_negative).commonly_used().double(0.0)
 
+SERVE_HOST = conf("srt.serve.host") \
+    .doc("Interface the SQL serving front door (serve/server.py) binds "
+         "its listening socket to.") \
+    .string("127.0.0.1")
+
+SERVE_PORT = conf("srt.serve.port") \
+    .doc("TCP port for the SQL serving front door; 0 picks an "
+         "ephemeral port (the bound port is on SqlServer.endpoint).") \
+    .check(_non_negative).integer(0)
+
+SERVE_AUTH_TOKEN = conf("srt.serve.authToken") \
+    .doc("Shared-secret token clients must present in their HELLO "
+         "frame; empty disables authentication. A mismatch closes the "
+         "connection with a non-retryable error before any session "
+         "state is created.") \
+    .string("")
+
+SERVE_MAX_SESSIONS = conf("srt.serve.maxSessions") \
+    .doc("Maximum concurrently open client sessions; connections "
+         "beyond this are refused at HELLO with a retryable error "
+         "(session-level load shed, upstream of query admission).") \
+    .check(_positive).integer(64)
+
+SERVE_STREAM_CHUNK_ROWS = conf("srt.serve.streamChunkRows") \
+    .doc("Maximum rows per result-batch frame streamed back to a "
+         "client; larger results split into multiple frames in the "
+         "serializer's columnar wire format.") \
+    .check(_positive).integer(1 << 16)
+
+RESULT_CACHE_ENABLED = conf("srt.sql.resultCache.enabled") \
+    .doc("Cross-tenant result reuse in the serving tier: completed "
+         "result sets are cached under a canonicalized-plan "
+         "fingerprint (plan_cache.py structural key: file snapshots "
+         "fold in mtime/size, Delta scans their commit version) and "
+         "replayed for identical resubmissions without re-executing "
+         "or re-passing admission. Entries are crc-framed "
+         "(robustness/integrity.py) and invalidated by Delta commits "
+         "to any scanned table. Bit-identical on/off.") \
+    .commonly_used().boolean(False)
+
+RESULT_CACHE_MAX_BYTES = conf("srt.sql.resultCache.maxBytes") \
+    .doc("Byte budget for the serving result cache; inserting past "
+         "the cap evicts least-recently-used entries first. 0 "
+         "disables caching even when enabled.") \
+    .check(_non_negative).bytes_(64 << 20)
+
 SHUFFLE_HEARTBEAT_TIMEOUT_S = conf("srt.shuffle.heartbeat.timeoutSec") \
     .doc("DEPRECATED alias for srt.cluster.heartbeatTimeoutSec (the "
          "standalone shuffle service and the cluster driver once read "

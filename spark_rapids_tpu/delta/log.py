@@ -52,6 +52,35 @@ from ..robustness.faults import corrupt_point, fault_point
 
 LAST_CHECKPOINT = "_last_checkpoint"
 
+# --- commit listeners ------------------------------------------------------
+# Process-wide callbacks fired after every successful commit() with
+# (table_path, version). The serving result cache registers here so a
+# Delta commit invalidates cached results over the table's old
+# snapshot (serve/result_cache.py); listeners must never raise into
+# the committer — a broken observer is not a failed commit.
+_COMMIT_LISTENERS: List = []
+
+
+def register_commit_listener(fn) -> None:
+    """``fn(table_path: str, version: int)`` after each commit."""
+    if fn not in _COMMIT_LISTENERS:
+        _COMMIT_LISTENERS.append(fn)
+
+
+def unregister_commit_listener(fn) -> None:
+    try:
+        _COMMIT_LISTENERS.remove(fn)
+    except ValueError:
+        pass
+
+
+def _notify_commit(table_path: str, version: int) -> None:
+    for fn in list(_COMMIT_LISTENERS):
+        try:
+            fn(table_path, version)
+        except Exception:
+            pass
+
 #: per-process staging sequence: two threads racing the same commit
 #: version must not share a tmp name (the loser's link would find the
 #: winner already unlinked it)
@@ -379,6 +408,7 @@ class TransactionLog:
         _events.emit("DeltaCommit", table=self.table_path,
                      version=version, operation=operation,
                      actions=len(payload))
+        _notify_commit(self.table_path, version)
         self._maybe_checkpoint(version)
         return version
 

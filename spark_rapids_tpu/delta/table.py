@@ -156,8 +156,13 @@ class AcidTable:
         if not files:
             return self.session.create_dataframe(
                 {n: [] for n, _ in schema}, schema)
-        return DataFrame(self.session,
-                         FileScan(files, "parquet", schema))
+        scan = FileScan(files, "parquet", schema)
+        # snapshot provenance for the serving result cache (same
+        # contract as io/delta_format.read_delta)
+        pinned = version if version is not None \
+            else self.log.latest_version()
+        scan.delta_table = (os.path.abspath(self.path), pinned)
+        return DataFrame(self.session, scan)
 
     def version(self) -> int:
         return self.log.latest_version()

@@ -63,7 +63,7 @@ from .base import ExecContext, Metric, Schema, TpuExec
 
 __all__ = ["PrefetchIterator", "PrefetchExec", "prefetch_batches",
            "pipeline_enabled", "prefetch_buffer_bytes",
-           "prefetch_thread_leaks"]
+           "prefetch_thread_leaks", "close_live_iterators"]
 
 # Live iterators, for the resource sampler's prefetch-occupancy gauge.
 # Weak so an abandoned iterator never outlives its consumer.
@@ -87,6 +87,28 @@ def prefetch_buffer_bytes() -> int:
     with _LIVE_LOCK:
         its = list(_LIVE)
     return sum(it._bytes for it in its)
+
+
+def close_live_iterators(query=None, join_timeout: float = 10.0) -> int:
+    """Close every live PrefetchIterator owned by ``query`` (a
+    QueryContext, or a query-id string; None closes all).
+
+    The serving tier's per-session teardown calls this after a client
+    disconnect: a consumer abandoned mid-stream never reaches the
+    iterator's normal close, and without this the producer thread
+    would count as a leak once its queue backpressure wedged. Returns
+    the number of iterators closed."""
+    qid = getattr(query, "query_id", query)
+    with _LIVE_LOCK:
+        its = list(_LIVE)
+    closed = 0
+    for it in its:
+        owner = it._query
+        if qid is not None and (owner is None or owner.query_id != qid):
+            continue
+        it.close(join_timeout=join_timeout)
+        closed += 1
+    return closed
 
 
 class PrefetchIterator:

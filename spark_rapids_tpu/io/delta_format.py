@@ -221,8 +221,13 @@ def read_delta(session, path: str,
                                         schema)
     from ..plan.session import DataFrame
     from .scan import FileScan
-    return DataFrame(session, FileScan(paths, "parquet", schema,
-                                       partition_info=partition_info))
+    scan = FileScan(paths, "parquet", schema,
+                    partition_info=partition_info)
+    # snapshot provenance for the serving result cache: which Delta
+    # table (and at which commit version) this scan pins — the cache
+    # keys on it and invalidates on later commits to the same root
+    scan.delta_table = (os.path.abspath(path), table.version)
+    return DataFrame(session, scan)
 
 
 # ---------------------------------------------------------------------------
@@ -294,4 +299,8 @@ def write_delta_table(table, root: str,
     with open(commit, "w") as f:
         for a in actions:
             f.write(json.dumps(a) + "\n")
+    # standard-format writes bypass TransactionLog.commit, so feed the
+    # commit listeners (serving result-cache invalidation) here too
+    from ..delta.log import _notify_commit
+    _notify_commit(root, version)
     return version

@@ -48,6 +48,9 @@ EVENT_TYPES = (
     "ReplicaFetch", "RecoveryTimed",
     "DeltaCommit", "DeltaLogCheckpointed", "DeltaOrphanSwept",
     "StreamBatchCommitted", "StreamBatchSkipped", "StaleWriterFenced",
+    "ServeSessionOpen", "ServeSessionClose", "ServeLoadShed",
+    "ResultCacheHit", "ResultCacheMiss", "ResultCacheEvict",
+    "ResultCacheInvalidate", "ResultCacheCorrupt",
 )
 
 

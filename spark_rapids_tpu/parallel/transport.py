@@ -66,6 +66,9 @@ MAGIC_FETCH2 = 0x53525446  # "SRTF" fetch with exclude list
 MAGIC_PUSH = 0x53525450    # "SRTP" push upload
 MAGIC_PUSH_REPL = 0x53525451   # "SRTQ" replica push (durability)
 MAGIC_FETCH_REPL = 0x53525452  # "SRTR" origin-addressed replica fetch
+MAGIC_SERVE = 0x53525456  # "SRTV" SQL serving front door
+#                           (serve/protocol.py frames; registered here
+#                           so every wire magic lives in one namespace)
 #: replica-push map-id sentinel: the frame is a pickled replica
 #: MANIFEST ({reduce: (map ids...)}) for (origin, shuffle), published
 #: by the origin after its replica pushes drained — the buddy's
@@ -477,6 +480,12 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
         except OSError:
             pass
     return buf
+
+
+#: public name for the cancel-aware exact read — the serving front
+#: door (serve/protocol.py) frames its session protocol over the same
+#: primitive so a cancelled query's stream unwinds within a beat
+recv_exact = _recv_exact
 
 
 def _retrying_stream(cli: ShuffleBlockClient, shuffle_id: int,

@@ -112,19 +112,91 @@ def plan_cache_key(plan, conf):
 
 
 class PhysicalPlanCache:
-    """Small FIFO memo of structural key -> physical plan."""
+    """Small FIFO memo of structural key -> physical plan.
+
+    Cached exec trees hold one-shot execution state (shuffle ids,
+    write flags, metrics), so an entry may be EXECUTING on at most one
+    thread at a time. Serial callers reuse via ``reset_for_rerun``;
+    concurrent callers (the serving front door runs many sessions over
+    one shared cache) take an execution *lease* — if the entry's lease
+    is already held, the caller plans a fresh tree instead of racing
+    on shared instances."""
 
     def __init__(self, max_entries: int = 32):
+        import threading
         self.max_entries = max_entries
         self._entries: dict = {}
+        self._leases: dict = {}
+        self._mu = threading.Lock()
+        # lifetime counters, reported as hit rates by the serving
+        # bench (tools/serve_bench.py) alongside the jit-registry's
+        self.hits = 0
+        self.misses = 0
+        self.busy_bypasses = 0
 
     def get(self, key):
-        return self._entries.get(key)
+        with self._mu:
+            p = self._entries.get(key)
+            if p is None:
+                self.misses += 1
+            else:
+                self.hits += 1
+            return p
+
+    def lease(self, key):
+        """(physical, release_fn) with the execution lease held, or
+        (None, None). A busy entry — mid-execution on another thread —
+        counts as a miss (the caller replans uncached)."""
+        with self._mu:
+            p = self._entries.get(key)
+            if p is None:
+                self.misses += 1
+                return None, None
+            lock = self._leases.get(key)
+        if lock is not None and not lock.acquire(blocking=False):
+            with self._mu:
+                self.misses += 1
+                self.busy_bypasses += 1
+            return None, None
+        with self._mu:
+            self.hits += 1
+        return p, (lock.release if lock is not None else None)
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {"hits": self.hits, "misses": self.misses,
+                    "busy_bypasses": self.busy_bypasses,
+                    "entries": len(self._entries)}
 
     def put(self, key, physical) -> None:
-        if len(self._entries) >= self.max_entries:
-            self._entries.pop(next(iter(self._entries)))
-        self._entries[key] = physical
+        import threading
+        with self._mu:
+            if key not in self._entries and \
+                    len(self._entries) >= self.max_entries:
+                oldest = next(iter(self._entries))
+                self._entries.pop(oldest)
+                self._leases.pop(oldest, None)
+            self._entries[key] = physical
+            self._leases[key] = threading.Lock()
+
+    def put_leased(self, key, physical):
+        """Insert with the execution lease pre-acquired: the builder
+        is about to execute the very instance it cached, so no other
+        thread may lease it until that run releases."""
+        import threading
+        lock = threading.Lock()
+        lock.acquire()
+        with self._mu:
+            if key not in self._entries and \
+                    len(self._entries) >= self.max_entries:
+                oldest = next(iter(self._entries))
+                self._entries.pop(oldest)
+                self._leases.pop(oldest, None)
+            self._entries[key] = physical
+            self._leases[key] = lock
+        return lock.release
 
     def clear(self) -> None:
-        self._entries.clear()
+        with self._mu:
+            self._entries.clear()
+            self._leases.clear()

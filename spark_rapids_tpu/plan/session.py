@@ -111,6 +111,13 @@ class TpuSession:
         #: executing (None when idle): the cancel handle for other
         #: threads — ``session.cancel()`` / serving-tier aborts
         self._active_query = None
+        #: serving-tier identity: when set (serve/server.py stamps
+        #: them per client session) QueryStart/QueryEnd events carry
+        #: session_id/tenant fields so per-pid event logs from a
+        #: multi-session server group by tenant in profile_report /
+        #: history_report instead of interleaving anonymously
+        self.session_id: Optional[str] = None
+        self.tenant: Optional[str] = None
 
     def cancel(self, reason: str = "session.cancel()") -> bool:
         """Cancel the in-flight query, if any (thread-safe; callable
@@ -158,7 +165,8 @@ class TpuSession:
 
     # --- execution ---
     def execute(self, plan: L.LogicalPlan,
-                timeout: Optional[float] = None) -> HostTable:
+                timeout: Optional[float] = None,
+                query=None) -> HostTable:
         """Run a logical plan to a host table.
 
         Physical plans are memoized on a structural key (plan_cache.py)
@@ -176,19 +184,30 @@ class TpuSession:
             plan = rewrite_plan(plan)
         from .plan_cache import plan_cache_key
         key = plan_cache_key(plan, self.conf)
-        physical = self._plan_cache.get(key) if key is not None else None
+        physical, release = (None, None)
+        if key is not None:
+            # execution lease: a cached tree may run on one thread at
+            # a time (its shuffle ids / write flags are instance
+            # state); a busy entry makes this caller plan fresh
+            physical, release = self._plan_cache.lease(key)
         if physical is None:
             physical = overrides.apply_overrides(plan, self.conf)
             # only fully-device plans cache: CPU/bridge nodes hold no
             # reset protocol for their one-shot state
             if key is not None and isinstance(physical, TpuExec):
-                self._plan_cache.put(key, physical)
+                release = self._plan_cache.put_leased(key, physical)
         elif isinstance(physical, TpuExec):
             physical.reset_for_rerun()
-        return self._execute_physical(physical, plan, timeout=timeout)
+        try:
+            return self._execute_physical(physical, plan,
+                                          timeout=timeout, query=query)
+        finally:
+            if release is not None:
+                release()
 
     def _execute_physical(self, physical, plan: L.LogicalPlan,
-                          timeout: Optional[float] = None) -> HostTable:
+                          timeout: Optional[float] = None,
+                          query=None) -> HostTable:
         """Run a planned physical tree with the query-level
         observability wrapper: QueryStart/QueryEnd events, optional
         per-query span tracer (written out as a Chrome trace), and a
@@ -221,11 +240,22 @@ class TpuSession:
         _events.configure_from_conf(self.conf)
         _resource.configure_from_conf(self.conf)
         _roofline.configure_from_conf(self.conf)
-        TpuSession._query_seq[0] += 1
-        qid = f"q{_os.getpid()}-{TpuSession._query_seq[0]}"
-        qctx = QueryContext(query_id=qid)
-        qctx.set_timeout(timeout if timeout is not None
-                         else self.conf.get(QUERY_TIMEOUT_S))
+        if query is not None:
+            # externally-supplied cancel token (serve/server.py): the
+            # caller holds the handle before admission, so a client
+            # disconnect cancels a query even while it is still queued
+            qctx = query
+            qid = qctx.query_id
+            if timeout is not None:
+                qctx.set_timeout(timeout)
+            elif qctx.deadline is None:
+                qctx.set_timeout(self.conf.get(QUERY_TIMEOUT_S))
+        else:
+            TpuSession._query_seq[0] += 1
+            qid = f"q{_os.getpid()}-{TpuSession._query_seq[0]}"
+            qctx = QueryContext(query_id=qid)
+            qctx.set_timeout(timeout if timeout is not None
+                             else self.conf.get(QUERY_TIMEOUT_S))
         # admission before any work: may park this thread in the
         # bounded queue, load-shed (AdmissionRejected — retryable, no
         # resources held), or give up on cancel/deadline while queued
@@ -254,10 +284,17 @@ class TpuSession:
         tc = task_context()
         tc0 = (tc.spilled_bytes, tc.retry_count, tc.split_count)
         is_tpu = isinstance(physical, TpuExec)
+        # serving identity fields ride on QueryStart/QueryEnd (only
+        # when set: single-session logs stay byte-identical)
+        ident: Dict = {}
+        if self.session_id is not None:
+            ident["session_id"] = self.session_id
+        if self.tenant is not None:
+            ident["tenant"] = self.tenant
         if _events.enabled():
             _events.emit("QueryStart", query_id=qid, device=is_tpu,
                          plan=physical.tree_string() if is_tpu
-                         else type(physical).__name__)
+                         else type(physical).__name__, **ident)
         qspan = ctx.tracer.span(qid, kind="query") \
             if ctx.tracer is not None else None
         t0 = _time.perf_counter_ns()
@@ -331,6 +368,7 @@ class TpuSession:
             if _events.enabled():
                 end: Dict = {"query_id": qid, "status": status,
                              "wall_ns": wall_ns, "metrics": summary}
+                end.update(ident)
                 end.update(extra)
                 if error is not None:
                     end["error"] = error

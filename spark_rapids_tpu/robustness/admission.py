@@ -79,7 +79,8 @@ class QueryContext:
     armed), and every blocking wait in the engine either polls it or
     waits on ``_cancelled`` directly (``sleep``)."""
 
-    __slots__ = ("query_id", "deadline", "cancel_reason", "_cancelled")
+    __slots__ = ("query_id", "deadline", "cancel_reason", "_cancelled",
+                 "admission_wait_ns")
 
     def __init__(self, query_id: str = "",
                  deadline: Optional[float] = None):
@@ -88,6 +89,20 @@ class QueryContext:
         self.deadline = deadline
         self.cancel_reason = ""
         self._cancelled = threading.Event()
+        #: ns spent queued for admission, stamped by
+        #: QuerySemaphore.acquire: None = never admitted, 0 = admitted
+        #: on the fast path, >0 = waited in the FIFO. The serving tier
+        #: reads this to bucket latency per admission tier.
+        self.admission_wait_ns: Optional[int] = None
+
+    @property
+    def admission_tier(self) -> str:
+        """'immediate' | 'queued' | 'unadmitted' — which admission
+        path this query took (serving-tier latency bucketing)."""
+        w = self.admission_wait_ns
+        if w is None:
+            return "unadmitted"
+        return "queued" if w > 0 else "immediate"
 
     def set_timeout(self, seconds: Optional[float]) -> None:
         if seconds is not None and seconds > 0:
@@ -227,6 +242,8 @@ class QuerySemaphore:
                 self._active += 1
                 self._holders[tid] = 1
                 self.admitted += 1
+                if token is not None:
+                    token.admission_wait_ns = 0
                 _events.emit("QueryAdmitted", query_id=qid,
                              active=self._active, queued_ns=0)
                 return
@@ -263,6 +280,8 @@ class QuerySemaphore:
                 self._holders[tid] = 1
                 self.admitted += 1
                 wait_ns = time.perf_counter_ns() - t0
+                if token is not None:
+                    token.admission_wait_ns = wait_ns
                 from ..memory.budget import task_context
                 task_context().semaphore_wait_ns += wait_ns
                 _events.emit("QueryAdmitted", query_id=qid,

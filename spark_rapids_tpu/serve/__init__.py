@@ -1,0 +1,16 @@
+"""Serving front door: networked SQL service over the framed
+transport, with cross-tenant result reuse.
+
+- protocol.py — the multiplexed session wire protocol
+- server.py — SqlServer: sessions -> admission/budget/cancel tokens
+- client.py — SqlClient: socket client for tests, benches, tools
+- result_cache.py — plan-fingerprint result cache with Delta
+  commit-version invalidation
+"""
+
+from .client import ServeError, ServeLoadShed, ServeResult, SqlClient
+from .result_cache import ResultCache, fingerprint
+from .server import SqlServer
+
+__all__ = ["SqlServer", "SqlClient", "ServeResult", "ServeError",
+           "ServeLoadShed", "ResultCache", "fingerprint"]

@@ -1,0 +1,163 @@
+"""Socket client for the SQL serving front door.
+
+``SqlClient`` speaks the session protocol (serve/protocol.py): one
+TCP connection is one authenticated session; ``submit`` streams the
+result back as serializer-format batches and returns a
+``ServeResult``. ``cancel_active`` may be called from another thread
+to interrupt an in-flight submit (the CANCEL frame interleaves on the
+same socket under the send lock).
+
+Errors are typed: a load-shed (admission queue full server-side)
+raises ``ServeLoadShed`` with ``retryable=True`` so replay clients
+can back off and retry; everything else raises ``ServeError`` with
+the server-reported type.
+"""
+
+from __future__ import annotations
+
+import itertools
+import socket
+import threading
+from typing import Dict, List, Optional
+
+from . import protocol as P
+
+
+class ServeError(RuntimeError):
+    def __init__(self, message: str, kind: str = "ServeError",
+                 retryable: bool = False):
+        super().__init__(message)
+        self.kind = kind
+        self.retryable = retryable
+
+
+class ServeLoadShed(ServeError):
+    def __init__(self, message: str):
+        super().__init__(message, kind="AdmissionRejected",
+                         retryable=True)
+
+
+class ServeResult:
+    """One query's result: host tables (one per streamed frame), the
+    raw wire payloads (bit-identity checks), and the EOS info dict
+    ({"status", "cache", "tier", "wait_ns", "wall_ns", ...})."""
+
+    def __init__(self, tables: List, payloads: List[bytes],
+                 info: Dict):
+        self.tables = tables
+        self.payloads = payloads
+        self.info = info
+
+    @property
+    def num_rows(self) -> int:
+        return sum(t.num_rows for t in self.tables)
+
+    def table(self):
+        from ..plan.host_table import concat_tables
+        if not self.tables:
+            raise ValueError("empty result stream")
+        return concat_tables(self.tables)
+
+    def to_pydict(self) -> dict:
+        from ..plan.host_table import to_pydict
+        return to_pydict(self.table())
+
+
+class SqlClient:
+    def __init__(self, endpoint: str, token: str = "",
+                 tenant: Optional[str] = None,
+                 connect_timeout: float = 10.0,
+                 sock_timeout: Optional[float] = 300.0):
+        host, _, port = endpoint.rpartition(":")
+        self._sock = socket.create_connection(
+            (host, int(port)), timeout=connect_timeout)
+        self._sock.settimeout(sock_timeout)
+        self._send_lock = threading.Lock()
+        self._rid = itertools.count(1)
+        self._active_rid: Optional[int] = None
+        self.session_id = 0
+        P.send_json(self._sock, P.OP_HELLO, 0, 0,
+                    {"token": token, "tenant": tenant},
+                    lock=self._send_lock)
+        op, sid, _rid, payload = P.recv_frame(self._sock)
+        if op != P.OP_OK:
+            err = P.decode_json(payload)
+            raise ServeError(err.get("error", "connect failed"),
+                             kind=err.get("type", "ServeError"),
+                             retryable=bool(err.get("retryable")))
+        self.session_id = sid
+
+    # --- requests ---------------------------------------------------------
+    def submit(self, sql: str, timeout_ms: Optional[int] = None,
+               cache: bool = True) -> ServeResult:
+        """Run ``sql`` server-side; blocks until EOS. Raises
+        ``ServeLoadShed`` (retryable) on admission shed, ``ServeError``
+        on any other failure (including cancel/deadline)."""
+        from ..parallel.serializer import deserialize_batch
+        from ..plan.host_table import batch_to_table
+        rid = next(self._rid)
+        req: Dict = {"sql": sql, "cache": cache}
+        if timeout_ms is not None:
+            req["timeout_ms"] = int(timeout_ms)
+        self._active_rid = rid
+        try:
+            P.send_json(self._sock, P.OP_SUBMIT, self.session_id, rid,
+                        req, lock=self._send_lock)
+            tables: List = []
+            payloads: List[bytes] = []
+            while True:
+                op, _sid, got_rid, payload = P.recv_frame(self._sock)
+                if got_rid != rid:
+                    continue  # stale frame from a cancelled request
+                if op == P.OP_BATCH:
+                    payloads.append(payload)
+                    tables.append(batch_to_table(
+                        deserialize_batch(payload)))
+                elif op == P.OP_EOS:
+                    return ServeResult(tables, payloads,
+                                       P.decode_json(payload))
+                elif op == P.OP_SHED:
+                    err = P.decode_json(payload)
+                    raise ServeLoadShed(err.get("error", "load shed"))
+                elif op == P.OP_ERR:
+                    err = P.decode_json(payload)
+                    raise ServeError(
+                        err.get("error", "request failed"),
+                        kind=err.get("type", "ServeError"),
+                        retryable=bool(err.get("retryable")))
+                else:
+                    raise P.ProtocolError(f"unexpected opcode {op}")
+        finally:
+            self._active_rid = None
+
+    def cancel_active(self) -> bool:
+        """Ask the server to cancel the in-flight submit (call from
+        another thread). True if a request was active."""
+        rid = self._active_rid
+        if rid is None:
+            return False
+        P.send_json(self._sock, P.OP_CANCEL, self.session_id, rid, {},
+                    lock=self._send_lock)
+        return True
+
+    # --- lifecycle --------------------------------------------------------
+    def close(self) -> None:
+        try:
+            rid = next(self._rid)
+            P.send_json(self._sock, P.OP_CLOSE, self.session_id, rid,
+                        {}, lock=self._send_lock)
+            P.recv_frame(self._sock)  # OK ack
+        except (ConnectionError, OSError, P.ProtocolError):
+            pass
+        finally:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+    def __enter__(self) -> "SqlClient":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False

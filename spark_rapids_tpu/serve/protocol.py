@@ -1,0 +1,111 @@
+"""Wire protocol for the SQL serving front door.
+
+One frame shape in both directions, over the same framed-TCP idiom as
+the shuffle transport (parallel/transport.py — little-endian structs,
+a u32 magic registered alongside the shuffle magics, and the
+cancel-aware ``recv_exact``):
+
+    | magic u32 | opcode u8 | session u32 | request u32 | len u32 |
+    | payload: ``len`` bytes |
+
+A connection is one session. Requests multiplex on it by request id
+(client-assigned, monotonically increasing): SUBMIT responses —
+result-batch frames in the serializer's columnar wire format
+(parallel/serializer.py), then one terminal EOS/ERR/SHED — carry the
+request id they answer, and a CANCEL for an in-flight request id can
+interleave with another request's response stream.
+
+Opcodes (client -> server):
+    HELLO   auth token + tenant, before anything else
+    SUBMIT  {"sql": ..., "timeout_ms"?: int, "cache"?: bool}
+    CANCEL  the request id in the header names the target
+    CLOSE   orderly goodbye
+
+Opcodes (server -> client):
+    OK      HELLO/CLOSE ack ({"session_id": ...} on HELLO)
+    BATCH   one serialized result batch (raw serializer bytes)
+    EOS     end of a result stream: {"status", "rows", "wall_ns",
+            "cache": hit|miss|off, "tier": cached|immediate|queued,
+            "wait_ns"}
+    ERR     {"error", "type", "retryable"} — terminal for its request
+    SHED    admission load-shed; like ERR but always retryable
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+from typing import Optional, Tuple
+
+from ..parallel.transport import MAGIC_SERVE, recv_exact
+
+# client -> server
+OP_HELLO = 1
+OP_SUBMIT = 2
+OP_CANCEL = 3
+OP_CLOSE = 4
+# server -> client
+OP_OK = 16
+OP_BATCH = 17
+OP_EOS = 18
+OP_ERR = 19
+OP_SHED = 20
+
+_HDR = struct.Struct("<IBIII")
+
+#: refuse frames beyond this (a corrupted length must not allocate
+#: unbounded memory server-side)
+MAX_PAYLOAD = 1 << 28
+
+
+class ProtocolError(ConnectionError):
+    """Malformed frame on the serving wire."""
+
+
+def send_frame(sock: socket.socket, opcode: int, session_id: int,
+               request_id: int, payload: bytes = b"",
+               lock: Optional[threading.Lock] = None) -> None:
+    """Write one frame; ``lock`` serializes concurrent writers (the
+    per-connection send lock — response streams for multiplexed
+    requests interleave at frame granularity, never inside one)."""
+    buf = _HDR.pack(MAGIC_SERVE, opcode, session_id, request_id,
+                    len(payload)) + payload
+    if lock is None:
+        sock.sendall(buf)
+    else:
+        with lock:
+            sock.sendall(buf)
+
+
+def recv_frame(sock: socket.socket) -> Tuple[int, int, int, bytes]:
+    """Read one frame -> (opcode, session_id, request_id, payload).
+
+    Uses the transport's cancel-aware exact read, so a server-side
+    reader whose thread carries a query token unwinds on cancel."""
+    hdr = recv_exact(sock, _HDR.size)
+    magic, opcode, session_id, request_id, n = _HDR.unpack(hdr)
+    if magic != MAGIC_SERVE:
+        raise ProtocolError(f"bad serve frame magic {magic:#x}")
+    if n > MAX_PAYLOAD:
+        raise ProtocolError(f"serve frame of {n} bytes exceeds cap")
+    payload = recv_exact(sock, n) if n else b""
+    return opcode, session_id, request_id, payload
+
+
+def send_json(sock: socket.socket, opcode: int, session_id: int,
+              request_id: int, obj: dict,
+              lock: Optional[threading.Lock] = None) -> None:
+    send_frame(sock, opcode, session_id, request_id,
+               json.dumps(obj).encode("utf-8"), lock=lock)
+
+
+def decode_json(payload: bytes) -> dict:
+    try:
+        d = json.loads(payload.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as e:
+        raise ProtocolError(f"bad json payload: {e}")
+    if not isinstance(d, dict):
+        raise ProtocolError("json payload is not an object")
+    return d

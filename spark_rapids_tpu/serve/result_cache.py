@@ -1,0 +1,243 @@
+"""Cross-tenant result reuse: plan fingerprint -> cached result bytes.
+
+At production traffic the same dashboard queries recur constantly
+across tenants; re-executing them burns admission permits and device
+time to recompute bytes the server already streamed. This cache keys
+completed result sets on a **canonicalized-plan fingerprint** — the
+structural plan-cache key (plan/plan_cache.py: operators, expressions,
+conf, and per-file ``(path, mtime_ns, size)`` snapshots) plus the
+**Delta snapshot versions** of every Delta-provenanced scan — so a hit
+is only possible for a byte-identical plan over byte-identical data.
+
+Correctness levers:
+
+- **Invalidation feed**: the Delta commit protocol (delta/log.py
+  ``register_commit_listener``; the standard-format writer feeds it
+  too). A commit to any table a cached plan scanned evicts the entry
+  immediately — staleness is bounded by commit publication, not TTL.
+- **Integrity**: every cached payload is crc-framed with the shared
+  integrity envelope (robustness/integrity.py). A mismatch on read
+  (bit rot, or the chaos sweep's seeded ``serve.result_cache``
+  corruption) evicts the entry and reports a miss, so the server
+  recomputes bit-identically instead of serving garbage.
+- **Bounds**: byte-accounted LRU; an insert past
+  ``srt.sql.resultCache.maxBytes`` evicts least-recently-used entries
+  first, and a single result larger than the cap is never cached.
+
+Because entries hold the exact serialized frames the server streamed
+on the fill, a hit replays the same bytes — cache on/off is
+bit-identical by construction.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..delta import log as delta_log
+from ..obs import events as _events
+from ..robustness.faults import corrupt_point
+from ..robustness.integrity import DataCorruption, unwrap, wrap
+
+
+class Fingerprint:
+    """Hashable cache key + the Delta provenance it pinned."""
+
+    __slots__ = ("digest", "delta_roots")
+
+    def __init__(self, digest: str,
+                 delta_roots: Tuple[Tuple[str, int], ...]):
+        self.digest = digest
+        self.delta_roots = delta_roots  # ((abs_root, version), ...)
+
+    def __repr__(self):
+        return f"Fingerprint({self.digest[:12]}..., {self.delta_roots})"
+
+
+def _delta_scans(plan) -> List[Tuple[str, int]]:
+    """Collect ``(abs_root, version)`` provenance from every scan the
+    Delta readers stamped (io/delta_format.read_delta,
+    delta/table.AcidTable.to_df)."""
+    out: List[Tuple[str, int]] = []
+    stack = [plan]
+    while stack:
+        node = stack.pop()
+        prov = getattr(node, "delta_table", None)
+        if prov is not None:
+            out.append((os.path.abspath(prov[0]), int(prov[1])))
+        stack.extend(getattr(node, "children", ()))
+    return out
+
+
+def fingerprint(plan, conf) -> Optional[Fingerprint]:
+    """Canonical fingerprint for (logical plan, conf), or None when
+    the plan is not safely cachable (plan_cache.Uncachable: local
+    data, non-deterministic expressions...)."""
+    from ..plan.plan_cache import plan_cache_key
+    key = plan_cache_key(plan, conf)
+    if key is None:
+        return None
+    roots = tuple(sorted(set(_delta_scans(plan))))
+    digest = hashlib.sha256(
+        repr((key, roots)).encode("utf-8")).hexdigest()
+    return Fingerprint(digest, roots)
+
+
+class _Entry:
+    __slots__ = ("framed", "nbytes", "rows", "delta_roots")
+
+    def __init__(self, framed: List[bytes], rows: int,
+                 delta_roots: Tuple[Tuple[str, int], ...]):
+        self.framed = framed  # integrity-wrapped serialized batches
+        self.nbytes = sum(len(p) for p in framed)
+        self.rows = rows
+        self.delta_roots = delta_roots
+
+
+class ResultCache:
+    """Byte-bounded LRU of fingerprint -> integrity-framed result
+    frames, invalidated by Delta commits. Thread-safe (the server's
+    request threads share one instance)."""
+
+    def __init__(self, max_bytes: int, subscribe: bool = True):
+        self.max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, _Entry]" = OrderedDict()
+        self._by_root: Dict[str, Set[str]] = {}
+        self.bytes = 0
+        # lifetime counters (tests/chaos/bench read these)
+        self.hits = 0
+        self.misses = 0
+        self.puts = 0
+        self.evictions = 0
+        self.invalidations = 0
+        self.corrupt_evictions = 0
+        self._subscribed = False
+        if subscribe:
+            delta_log.register_commit_listener(self._on_delta_commit)
+            self._subscribed = True
+
+    # --- lookup/fill ------------------------------------------------------
+    def get(self, fp: Fingerprint) -> Optional[List[bytes]]:
+        """Verified raw result frames for ``fp``, or None. A checksum
+        mismatch evicts the entry and reports a miss (the caller
+        recomputes and refills)."""
+        with self._lock:
+            entry = self._entries.get(fp.digest)
+            if entry is not None:
+                self._entries.move_to_end(fp.digest)
+        if entry is None:
+            with self._lock:
+                self.misses += 1
+            _events.emit("ResultCacheMiss", fingerprint=fp.digest)
+            return None
+        payloads: List[bytes] = []
+        try:
+            for framed in entry.framed:
+                framed = corrupt_point("serve.result_cache", framed,
+                                       f"fp={fp.digest[:12]};")
+                payloads.append(unwrap(framed, "cached result batch"))
+        except DataCorruption:
+            # integrity.unwrap already emitted CorruptionDetected;
+            # drop the entry so the recompute path refills it clean
+            with self._lock:
+                self._evict_locked(fp.digest)
+                self.corrupt_evictions += 1
+                self.misses += 1
+            _events.emit("ResultCacheCorrupt", fingerprint=fp.digest)
+            return None
+        with self._lock:
+            self.hits += 1
+        _events.emit("ResultCacheHit", fingerprint=fp.digest,
+                     rows=entry.rows, nbytes=entry.nbytes)
+        return payloads
+
+    def put(self, fp: Fingerprint, payloads: List[bytes],
+            rows: int) -> bool:
+        """Insert the serialized result frames for ``fp``; False when
+        the result alone exceeds the byte budget."""
+        framed = [wrap(p) for p in payloads]
+        entry = _Entry(framed, rows, fp.delta_roots)
+        if entry.nbytes > self.max_bytes:
+            return False
+        with self._lock:
+            if fp.digest in self._entries:
+                self._evict_locked(fp.digest, count=False)
+            while self.bytes + entry.nbytes > self.max_bytes \
+                    and self._entries:
+                oldest = next(iter(self._entries))
+                self._evict_locked(oldest)
+                self.evictions += 1
+                _events.emit("ResultCacheEvict", fingerprint=oldest,
+                             reason="lru")
+            self._entries[fp.digest] = entry
+            self.bytes += entry.nbytes
+            for root, _v in fp.delta_roots:
+                self._by_root.setdefault(root, set()).add(fp.digest)
+            self.puts += 1
+        return True
+
+    def _evict_locked(self, digest: str, count: bool = True) -> None:
+        entry = self._entries.pop(digest, None)
+        if entry is None:
+            return
+        self.bytes -= entry.nbytes
+        for root, _v in entry.delta_roots:
+            keys = self._by_root.get(root)
+            if keys is not None:
+                keys.discard(digest)
+                if not keys:
+                    del self._by_root[root]
+
+    # --- invalidation -----------------------------------------------------
+    def _on_delta_commit(self, table_path: str, version: int) -> None:
+        self.invalidate_table(table_path, version)
+
+    def invalidate_table(self, table_path: str,
+                         version: Optional[int] = None) -> int:
+        """Evict every entry whose plan scanned ``table_path``.
+        Returns the eviction count."""
+        root = os.path.abspath(table_path)
+        with self._lock:
+            digests = list(self._by_root.get(root, ()))
+            for d in digests:
+                self._evict_locked(d)
+            self.invalidations += len(digests)
+        if digests:
+            _events.emit("ResultCacheInvalidate", table=root,
+                         version=version, entries=len(digests))
+        return len(digests)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._by_root.clear()
+            self.bytes = 0
+
+    def close(self) -> None:
+        if self._subscribed:
+            delta_log.unregister_commit_listener(self._on_delta_commit)
+            self._subscribed = False
+
+    # --- introspection ----------------------------------------------------
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> dict:
+        with self._lock:
+            lookups = self.hits + self.misses
+            return {
+                "entries": len(self._entries),
+                "bytes": self.bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_rate": (self.hits / lookups) if lookups else 0.0,
+                "puts": self.puts,
+                "evictions": self.evictions,
+                "invalidations": self.invalidations,
+                "corrupt_evictions": self.corrupt_evictions,
+            }

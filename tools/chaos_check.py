@@ -1195,6 +1195,211 @@ def _rows_match(rows, oracle):
     return True
 
 
+def _serving_check() -> int:
+    """Serving front-door leg (spark_rapids_tpu/serve/):
+
+    1. a client CHILD PROCESS is SIGKILLed mid-stream — the server
+       must cancel the query, release the admission permit and budget
+       slice, close live prefetch iterators (zero leaked threads),
+       drop the session, and keep serving;
+    2. a seeded byte-flip on a cached result batch
+       (``serve.result_cache:corrupt@1``) must evict the entry and
+       recompute BIT-IDENTICALLY, never serve garbage;
+    3. a load-shed probe at queue-depth 0 — the shed is a retryable
+       SHED frame and the hog completes untouched.
+
+    Returns failure count."""
+    import signal
+    import subprocess
+
+    from spark_rapids_tpu.conf import SrtConf
+    from spark_rapids_tpu.exec.pipeline import prefetch_thread_leaks
+    from spark_rapids_tpu.memory.budget import device_budget
+    from spark_rapids_tpu.plan import TpuSession
+    from spark_rapids_tpu.robustness.admission import (
+        query_semaphore, reset_query_semaphore)
+    from spark_rapids_tpu.robustness.faults import (arm_fault_plan,
+                                                    disarm_fault_plan)
+    from spark_rapids_tpu.serve import ServeLoadShed, SqlClient, \
+        SqlServer
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    failures = 0
+    slow_sql = "SELECT k, sum(v) AS s FROM f GROUP BY k ORDER BY k"
+
+    with tempfile.TemporaryDirectory(prefix="srt_serve_") as tmp:
+        session = TpuSession(SrtConf({
+            "srt.shuffle.partitions": 2,
+            "srt.sql.resultCache.enabled": "true"}))
+        fact_dir = os.path.join(tmp, "fact")
+        session.create_dataframe({
+            "k": [i % 40 for i in range(8000)],
+            "v": [float(i % 97) for i in range(8000)],
+        }).write.parquet(fact_dir)
+        session.create_or_replace_temp_view(
+            "f", session.read.parquet(fact_dir))
+        oracle = session.sql(slow_sql).collect()
+
+        # --- leg 1: SIGKILL a client child mid-stream --------------
+        t = time.monotonic()
+        name = "serve: client SIGKILL mid-stream"
+        leaks0 = prefetch_thread_leaks()
+        with SqlServer(session) as server:
+            # hold the query in its scan so the kill provably lands
+            # while it is in flight server-side
+            arm_fault_plan("seed=7|scan.file:delay@1+3.0")
+            try:
+                child = subprocess.Popen(
+                    [sys.executable, "-c",
+                     "import sys; sys.path.insert(0, sys.argv[1]); "
+                     "from spark_rapids_tpu.serve import SqlClient; "
+                     "c = SqlClient(sys.argv[2], tenant='victim'); "
+                     "print('connected', flush=True); "
+                     "c.submit(sys.argv[3])",
+                     root, server.endpoint, slow_sql],
+                    cwd=root, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                    stdout=subprocess.PIPE, text=True)
+                assert child.stdout is not None
+                child.stdout.readline()  # "connected": session is up
+                deadline = time.monotonic() + 30
+                while query_semaphore(session.conf).active() == 0 \
+                        and time.monotonic() < deadline:
+                    time.sleep(0.02)
+                in_flight = query_semaphore(session.conf).active() > 0
+                child.send_signal(signal.SIGKILL)
+                child.wait(timeout=30)
+                deadline = time.monotonic() + 60
+                while time.monotonic() < deadline and (
+                        server.open_sessions()
+                        or query_semaphore(session.conf).active()
+                        or device_budget().active_owners()):
+                    time.sleep(0.05)
+                with SqlClient(server.endpoint) as probe:
+                    after = probe.submit(slow_sql, cache=False)
+            finally:
+                disarm_fault_plan()
+            checks = [
+                ("query was in flight at kill time", in_flight),
+                ("admission permit released",
+                 query_semaphore(session.conf).active() == 0),
+                (f"budget slices released "
+                 f"({device_budget().active_owners()})",
+                 device_budget().active_owners() == set()),
+                ("session torn down", server.open_sessions() == 0),
+                ("disconnect cancelled the query server-side",
+                 server.disconnect_cancels >= 1),
+                (f"zero leaked prefetch threads "
+                 f"({prefetch_thread_leaks() - leaks0})",
+                 prefetch_thread_leaks() == leaks0),
+                ("server keeps serving after the kill",
+                 after.info.get("status") == "ok"
+                 and [dict(r) for r in (
+                     {k: after.to_pydict()[k][i]
+                      for k in after.to_pydict()}
+                     for i in range(after.num_rows))] == oracle),
+            ]
+            leg_fail = sum(1 for _w, ok in checks if not ok)
+            for what, ok in checks:
+                if not ok:
+                    print(f"[chaos] FAIL [{name}]: {what}",
+                          file=sys.stderr, flush=True)
+            print(f"[chaos] {'PASS' if not leg_fail else 'FAIL'} "
+                  f"[{name}] {time.monotonic() - t:.1f}s", flush=True)
+            failures += leg_fail
+
+            # --- leg 2: seeded corrupt cached result batch ---------
+            t = time.monotonic()
+            name = "serve: corrupt cached result -> evict + recompute"
+            with SqlClient(server.endpoint, tenant="c2") as c:
+                fill = c.submit(slow_sql)
+                arm_fault_plan("seed=9|serve.result_cache:corrupt@1")
+                try:
+                    recomputed = c.submit(slow_sql)
+                finally:
+                    disarm_fault_plan()
+                again = c.submit(slow_sql)
+            cache = server.result_cache
+            checks = [
+                ("fill was a miss", fill.info.get("cache") == "miss"),
+                ("corrupted entry evicted "
+                 f"(corrupt_evictions={cache.corrupt_evictions})",
+                 cache.corrupt_evictions >= 1),
+                ("recompute was a miss, not served garbage",
+                 recomputed.info.get("cache") == "miss"),
+                ("recompute bit-identical to the fill",
+                 recomputed.payloads == fill.payloads),
+                ("clean refill serves the hit",
+                 again.info.get("cache") == "hit"
+                 and again.payloads == fill.payloads),
+            ]
+            leg_fail = sum(1 for _w, ok in checks if not ok)
+            for what, ok in checks:
+                if not ok:
+                    print(f"[chaos] FAIL [{name}]: {what}",
+                          file=sys.stderr, flush=True)
+            print(f"[chaos] {'PASS' if not leg_fail else 'FAIL'} "
+                  f"[{name}] {time.monotonic() - t:.1f}s", flush=True)
+            failures += leg_fail
+
+        # --- leg 3: load-shed probe at queue-depth 0 ---------------
+        t = time.monotonic()
+        name = "serve: load-shed at queue-depth cap"
+        shed_sess = TpuSession(SrtConf({
+            "srt.shuffle.partitions": 2,
+            "srt.sql.concurrentQueryTasks": "1",
+            "srt.sql.admission.maxQueueDepth": "0"}))
+        shed_sess.create_or_replace_temp_view(
+            "f", shed_sess.read.parquet(fact_dir))
+        reset_query_semaphore(shed_sess.conf)
+        arm_fault_plan("seed=11|scan.file:delay@1+2.0")
+        try:
+            with SqlServer(shed_sess) as server:
+                outcome = {}
+
+                def hog():
+                    try:
+                        with SqlClient(server.endpoint,
+                                       tenant="hog") as c:
+                            outcome["hog"] = \
+                                c.submit(slow_sql).info["status"]
+                    except BaseException as e:  # noqa: BLE001
+                        outcome["hog"] = repr(e)
+
+                th = threading.Thread(target=hog)
+                th.start()
+                deadline = time.monotonic() + 15
+                while query_semaphore(shed_sess.conf).active() == 0 \
+                        and time.monotonic() < deadline:
+                    time.sleep(0.02)
+                shed = retryable = False
+                with SqlClient(server.endpoint, tenant="shed") as c:
+                    try:
+                        c.submit(slow_sql)
+                    except ServeLoadShed as e:
+                        shed, retryable = True, e.retryable
+                th.join(60)
+                checks = [
+                    ("second submit load-shed as SHED frame", shed),
+                    ("shed marked retryable", retryable),
+                    ("server counted the shed",
+                     server.load_shed >= 1),
+                    (f"hog completed untouched ({outcome.get('hog')})",
+                     outcome.get("hog") == "ok"),
+                ]
+        finally:
+            disarm_fault_plan()
+            reset_query_semaphore()
+        leg_fail = sum(1 for _w, ok in checks if not ok)
+        for what, ok in checks:
+            if not ok:
+                print(f"[chaos] FAIL [{name}]: {what}",
+                      file=sys.stderr, flush=True)
+        print(f"[chaos] {'PASS' if not leg_fail else 'FAIL'} "
+              f"[{name}] {time.monotonic() - t:.1f}s", flush=True)
+        failures += leg_fail
+    return failures
+
+
 def _streaming_ingest_check() -> int:
     """Exactly-once ingestion leg: the streaming ingester child
     (``python -m spark_rapids_tpu.delta.streaming``) is SIGKILLed
@@ -1540,6 +1745,7 @@ def main() -> int:
     # exactly-once streaming-ingest leg: SIGKILL the ingester child at
     # seeded commit-protocol fault points, resume, assert exactly-once
     failures += _streaming_ingest_check()
+    failures += _serving_check()
     watchdog.cancel()
     print(f"[chaos] done in {time.monotonic() - t0:.1f}s, "
           f"{failures} failure(s)", flush=True)

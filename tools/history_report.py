@@ -371,7 +371,7 @@ def build_report(log_dir: str) -> dict:
     records = ev.read_all_events(log_dir)
     # reuse the single-process per-query analysis for driver queries
     from profile_report import analyze as analyze_query
-    from profile_report import build_queries
+    from profile_report import build_queries, tenant_summary
     queries = [analyze_query(q) for q in build_queries(records)]
     jobs = [analyze_job(j) for j in build_jobs(records)]
     shuffles = analyze_shuffles(records)
@@ -382,6 +382,9 @@ def build_report(log_dir: str) -> dict:
         "processes": sorted({r.get("pid") for r in records
                              if r.get("pid") is not None}),
         "queries": queries,
+        # serving runs interleave many tenants' queries in one log;
+        # the per-tenant rollup is how operators read those
+        "tenants": tenant_summary(queries),
         "jobs": jobs,
         "shuffles": {str(k): v for k, v in shuffles.items()},
         "adaptive": analyze_adaptive(records),
@@ -474,6 +477,17 @@ def render(rep: dict) -> str:
         lines.append(f"  [{flag}] {a['rule']}: {a['evidence']}"
                      + (f" -> {a['suggestion']}" if a["suggestion"]
                         else ""))
+    tenants = rep.get("tenants") or {}
+    if any(t != "-" for t in tenants):
+        lines.append("tenants:")
+        for t in sorted(tenants):
+            s = tenants[t]
+            lines.append(
+                f"  {t}: queries={s['queries']} failed={s['failed']} "
+                f"sessions={len(s['sessions'])} "
+                f"wall={_fmt_ns(s['wall_ns'])} "
+                f"busy={_fmt_ns(s['busy_ns'])} "
+                f"spill={_fmt_bytes(s['spill_bytes'])}")
     nq = len(rep["queries"])
     if nq:
         lines.append(f"(driver queries: {nq} — see "

@@ -44,7 +44,8 @@ _SHAPE_KEYS = ("backend", "rows", "nds_scale_rows")
 
 #: rate-key suffixes (higher is better)
 _RATE_SUFFIXES = ("_gb_s", "_gbs", "_rows_s", "_mrows_s", "_per_s",
-                  "_vs_baseline", "_speedup")
+                  "_vs_baseline", "_speedup", "_rate",
+                  "_qps_sustained")
 _RATE_KEYS = ("value",)
 
 #: keys that end in _s but are not durations
@@ -69,6 +70,10 @@ def _is_rate(key: str) -> bool:
 
 
 def _is_time(key: str) -> bool:
+    # "_ms" does NOT match endswith("_s") — millisecond latencies
+    # (the serving bench's serve_p*_ms) need their own clause
+    if key.endswith("_ms"):
+        return True
     return key.endswith("_s") and not key.endswith(_NOT_TIME)
 
 

@@ -52,6 +52,12 @@ def build_queries(records: List[dict]) -> List[dict]:
         if kind == "QueryStart":
             q = {"query_id": r.get("query_id"), "t_start": r["ts"],
                  "t_end": None, "plan": r.get("plan", ""),
+                 # serving identity (plan/session.py tags these when a
+                 # server session runs the query): multi-session logs
+                 # in one per-pid file group by tenant instead of
+                 # interleaving anonymously
+                 "session_id": r.get("session_id"),
+                 "tenant": r.get("tenant"),
                  "wall_ns": 0, "status": "unknown", "metrics": {},
                  "spilled_bytes": 0, "oom_retries": 0,
                  "events": {k: [] for k in _WINDOWED}}
@@ -154,6 +160,8 @@ def analyze(q: dict) -> dict:
     return {
         "query_id": q["query_id"],
         "status": q["status"],
+        "session_id": q.get("session_id"),
+        "tenant": q.get("tenant"),
         "wall_ns": wall,
         "op_time_ns": total_op_ns,
         # exclusive op-times are disjoint PER THREAD: net of prefetch
@@ -210,7 +218,11 @@ def _fmt_bytes(b: float) -> str:
 def render(rep: dict) -> str:
     lines = []
     cp = rep["critical_path"]
-    lines.append(f"=== query {rep['query_id']} [{rep['status']}] "
+    who = ""
+    if rep.get("tenant") or rep.get("session_id"):
+        who = (f" tenant={rep.get('tenant') or '?'}"
+               f" session={rep.get('session_id') or '?'}")
+    lines.append(f"=== query {rep['query_id']} [{rep['status']}]{who} "
                  f"wall={_fmt_ns(rep['wall_ns'])} ===")
     lines.append(f"critical path: busy={_fmt_ns(cp['busy_ns'])} "
                  f"({100 * cp['busy_fraction']:.0f}% of wall), "
@@ -270,12 +282,53 @@ def render(rep: dict) -> str:
     return "\n".join(lines)
 
 
-def report(path: str, query_id: Optional[str] = None) -> List[dict]:
+def report(path: str, query_id: Optional[str] = None,
+           tenant: Optional[str] = None) -> List[dict]:
     records = ev.read_all_events(path)
     queries = build_queries(records)
     if query_id is not None:
         queries = [q for q in queries if q["query_id"] == query_id]
+    if tenant is not None:
+        queries = [q for q in queries if q.get("tenant") == tenant]
     return [analyze(q) for q in queries]
+
+
+def tenant_summary(reports: List[dict]) -> Dict[str, dict]:
+    """Roll per-query reports up by tenant (serving logs interleave
+    many tenants in one per-pid file). Untagged queries group under
+    the '-' pseudo-tenant."""
+    out: Dict[str, dict] = {}
+    for rep in reports:
+        t = rep.get("tenant") or "-"
+        s = out.setdefault(t, {
+            "queries": 0, "failed": 0, "wall_ns": 0, "busy_ns": 0,
+            "spill_bytes": 0, "oom_retries": 0,
+            "sessions": set()})
+        s["queries"] += 1
+        if rep["status"] not in ("success", "unknown"):
+            s["failed"] += 1
+        s["wall_ns"] += rep["wall_ns"]
+        s["busy_ns"] += rep["critical_path"]["busy_ns"]
+        s["spill_bytes"] += rep["spill"]["bytes"]
+        s["oom_retries"] += rep["retries"]["oom"]
+        if rep.get("session_id"):
+            s["sessions"].add(rep["session_id"])
+    for s in out.values():
+        s["sessions"] = sorted(s["sessions"])
+    return out
+
+
+def render_tenant_summary(summary: Dict[str, dict]) -> str:
+    lines = ["=== per-tenant summary ==="]
+    for t in sorted(summary):
+        s = summary[t]
+        lines.append(
+            f"  {t}: queries={s['queries']} failed={s['failed']} "
+            f"sessions={len(s['sessions'])} "
+            f"wall={_fmt_ns(s['wall_ns'])} busy={_fmt_ns(s['busy_ns'])} "
+            f"spill={_fmt_bytes(s['spill_bytes'])} "
+            f"oomRetries={s['oom_retries']}")
+    return "\n".join(lines)
 
 
 def main(argv=None) -> int:
@@ -286,18 +339,24 @@ def main(argv=None) -> int:
                     help="machine-readable output")
     ap.add_argument("--query", default=None,
                     help="report only this query id")
+    ap.add_argument("--tenant", default=None,
+                    help="report only this tenant's queries")
     args = ap.parse_args(argv)
     if not os.path.exists(args.event_log):
         print(f"no such event log: {args.event_log}", file=sys.stderr)
         return 2
-    reports = report(args.event_log, args.query)
+    reports = report(args.event_log, args.query, args.tenant)
     if not reports:
         print("no queries found in event log", file=sys.stderr)
         return 1
+    summary = tenant_summary(reports)
     if args.json:
-        print(json.dumps(reports, indent=2, default=str))
+        print(json.dumps({"queries": reports, "tenants": summary},
+                         indent=2, default=str))
     else:
         print("\n\n".join(render(r) for r in reports))
+        if any(t != "-" for t in summary):
+            print("\n" + render_tenant_summary(summary))
     return 0
 
 
