@@ -57,7 +57,14 @@ clients against one SqlServer for >=30s of Zipf-mixed NDS traffic
 through tools/serve_bench.py — records serve_p50/p90/p99_ms with a
 per-admission-tier split, serve_qps_sustained, load-shed and
 cross-query-spill counts, and the result-cache / plan-cache hit
-rates; SRT_BENCH_SERVE_SECONDS / _CLIENTS / _QPS tune the window).
+rates; SRT_BENCH_SERVE_SECONDS / _CLIENTS / _QPS tune the window),
+SRT_BENCH_MESH=on|off|both (SPMD stage-per-program mesh lane: the
+five scale-subset NDS shapes through tools/mesh_nds.py, one
+subprocess per query on an 8-virtual-device CPU mesh — records
+mesh_<q>_s walls plus the stage-boundary byte split
+shuffle_bytes_bypassed / shuffle_bytes_wire; "both" adds a
+serialized single-stream leg per shape as mesh_off_<q>_s;
+SRT_BENCH_MESH_SCALE sets the fact-row scale, default 20000).
 """
 
 import json
@@ -140,6 +147,15 @@ def left(label: str, need: float = 15.0) -> bool:
 RESULT = {"metric": "tpch_q6_e2e_throughput", "value": None,
           "unit": "Mrows/s", "vs_baseline": None}
 
+#: box-drift hardening (tools/perf_gate.py samples= path): lanes that
+#: can re-measure themselves register here as
+#:   name -> {"match": key -> bool, "rerun": () -> {key: value}}.
+#: When the gate finds a regression in a lane's keys, run_perf_gate
+#: reruns that lane up to 2x and gates the affected keys on the MEDIAN
+#: of all measurements — one noisy-box outlier neither fails nor
+#: exonerates a lane on its own.
+RERUN_LANES: dict = {}
+
 
 def emit(final: bool = False) -> None:
     RESULT["partial"] = not final
@@ -208,6 +224,30 @@ def run_perf_gate() -> bool:
         import perf_gate
         base = perf_gate.load_bench(prevs[-1])
         res = perf_gate.compare(base, RESULT)
+        samples: list = []
+        reruns: list = []
+        if res["comparable"] and res["regressions"]:
+            for lane, spec in RERUN_LANES.items():
+                for attempt in (1, 2):
+                    lane_regs = sorted(r[0] for r in res["regressions"]
+                                       if spec["match"](r[0]))
+                    if not lane_regs or \
+                            not left(f"gate rerun {lane}", need=60):
+                        break
+                    log(f"perf gate: rerunning lane '{lane}' "
+                        f"(attempt {attempt}) for {lane_regs}")
+                    try:
+                        s = spec["rerun"]()
+                    except Exception as e:
+                        log(f"gate rerun {lane} failed: {e}")
+                        break
+                    if not s:
+                        break
+                    samples.append(s)
+                    reruns.append({"lane": lane, "attempt": attempt,
+                                   "sample": s})
+                    res = perf_gate.compare(base, RESULT,
+                                            samples=samples)
         for line in perf_gate.render(res, os.path.basename(prevs[-1]),
                                      "this run").splitlines():
             log(line)
@@ -216,6 +256,8 @@ def run_perf_gate() -> bool:
             "comparable": res["comparable"],
             "enforcing": enforce,
             "regressions": [list(r) for r in res["regressions"]],
+            "reruns": reruns,
+            "median_keys": res.get("median_keys", []),
         }
         if enforce and res["comparable"] and res["regressions"]:
             log("perf gate: FAIL (enforcing; "
@@ -1021,6 +1063,71 @@ def main():
             emit()
         except Exception as e:  # A/B must never kill the headline run
             log(f"shuffle A/B failed: {e}")
+
+    # --- SPMD mesh lane (stage-per-program executor): the five
+    # scale-subset NDS shapes through tools/mesh_nds.py, ONE
+    # SUBPROCESS per query — the 8-virtual-device XLA flag must be
+    # set before jax initializes, and this process's jax is long
+    # since live. Records mesh_<q>_s walls plus the stage-boundary
+    # byte split: shuffle_bytes_bypassed (device-resident, never
+    # serialized — gate-protected, shrinking it means stages fell
+    # back to serialization) and shuffle_bytes_wire (the subset that
+    # rode in-program collectives). "both" adds a serialized
+    # single-stream leg per shape (mesh_off_<q>_s).
+    mesh_mode = os.environ.get("SRT_BENCH_MESH", "on").lower()
+    if mesh_mode != "off" and left("mesh lane",
+                                   need=90 + SERVE_RESERVE):
+        try:
+            sys.path.insert(0, os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "tools"))
+            import mesh_nds
+            # cpu fallback keeps the toy scale (matching nds_scale):
+            # each shape is a fresh subprocess with its own compile,
+            # and 20k-row programs on the 1-core emulation box cost
+            # tens of seconds each — starving the NDS sweep behind it
+            mesh_scale = int(os.environ.get(
+                "SRT_BENCH_MESH_SCALE",
+                20000 if backend != "cpu" else 8000))
+            mesh_shapes = list(mesh_nds.SCALE_SUBSET)
+
+            def mesh_lane() -> dict:
+                got: dict = {}
+                bypassed = wire = 0
+                for qid in mesh_shapes:
+                    if not left(f"mesh {qid}",
+                                need=45 + SERVE_RESERVE):
+                        break
+                    rec = mesh_nds.bench_one_subprocess(
+                        qid, mesh_scale, 8,
+                        ab=(mesh_mode == "both"), timeout_s=600)
+                    if not rec.get("ok"):
+                        log(f"mesh {qid}: FAILED {rec.get('error')}")
+                        continue
+                    got[f"mesh_{qid}_s"] = rec["mesh_s"]
+                    if "off_s" in rec:
+                        got[f"mesh_off_{qid}_s"] = rec["off_s"]
+                    bypassed += rec["bypassed"]
+                    wire += rec["wire"]
+                    log(f"mesh {qid}: {rec['mesh_s']}s (first "
+                        f"{rec['mesh_first_s']}s, {rec['stages']} "
+                        f"stages, {rec['bypassed']} B bypassed)"
+                        + (f" vs {rec['off_s']}s serialized"
+                           if "off_s" in rec else ""))
+                if got:
+                    got["shuffle_bytes_bypassed"] = bypassed
+                    got["shuffle_bytes_wire"] = wire
+                return got
+
+            RESULT.update(mesh_lane())
+            RERUN_LANES["mesh"] = {
+                "match": lambda k: (k.startswith("mesh_")
+                                    or k in ("shuffle_bytes_bypassed",
+                                             "shuffle_bytes_wire")),
+                "rerun": mesh_lane,
+            }
+            emit()
+        except Exception as e:  # lane must never kill the headline run
+            log(f"mesh lane failed: {e}")
 
     # --- NDS mini power-run (BASELINE config 2 breadth evidence):
     # the full 99-query suite swept once, total wall + per-query

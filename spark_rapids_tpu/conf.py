@@ -619,6 +619,61 @@ MESH_DATA_AXIS = conf("srt.mesh.dataAxis") \
     .doc("Name of the mesh axis partitions are sharded over.") \
     .internal().string("data")
 
+MESH_STAGE_PROGRAMS = conf("srt.mesh.stagePrograms.enabled") \
+    .doc("Compile one SPMD program per query stage (everything between "
+         "shuffle boundaries, as cut by plan/adaptive.py) instead of "
+         "one monolithic program for the whole plan. Stage outputs "
+         "stay device-resident between programs, exchange collectives "
+         "run at the consumer stage's head (or vanish entirely under "
+         "the residency rule), and a join-overflow retry re-runs ONLY "
+         "the overflowing stage at doubled growth from its retained "
+         "inputs — the whole-plan retry ladder that re-executed every "
+         "leaf (and aborted q19 at scale) is gone. Off = legacy "
+         "whole-plan lowering, kept as the fallback boundary.") \
+    .boolean(True)
+
+MESH_RESIDENCY = conf("srt.mesh.residency.enabled") \
+    .doc("Planner residency rule for mesh exchanges: an exchange whose "
+         "child already satisfies the target placement (hash on the "
+         "same key exprs, range on the same orders, single partition "
+         "over single partition) lowers to a device-resident identity "
+         "hand-through pinned by with_sharding_constraint instead of "
+         "an in-program all_to_all — the generalized "
+         "MeshColocationBypass. Also respects "
+         "srt.shuffle.push.localBypass (the single-box face of the "
+         "same locality contract).") \
+    .boolean(True)
+
+MESH_DONATION = conf("srt.mesh.donation.enabled") \
+    .doc("Donate consumed stage inputs to the stage program "
+         "(jit donate_argnums) so XLA reuses their buffers in place. "
+         "Only applied when the stage cannot retry (no join-overflow "
+         "check) and the input has exactly one consumer.") \
+    .boolean(True)
+
+MESH_BROADCAST_REPLICATED = conf("srt.mesh.broadcastReplicated") \
+    .doc("Place shuffle-free broadcast build subtrees host-executed "
+         "and replicated (PartitionSpec()) on every device instead of "
+         "lowering them per-shard and all_gathering inside the "
+         "program — the partition-rule table's "
+         "BroadcastExchangeExec -> replicated row.") \
+    .boolean(True)
+
+MESH_PARTITION_RULES = conf("srt.mesh.partitionRules") \
+    .doc("Extra partition rules prepended to the built-in table: "
+         "';'-separated 'regex=data|replicated' clauses matched "
+         "against each stage input's rule path (class names joined "
+         "with '/', stage root first). First match wins; the built-in "
+         "table replicates broadcast subtrees and shards everything "
+         "else over the data axis.") \
+    .string("")
+
+MESH_MAX_JOIN_GROWTH = conf("srt.mesh.maxJoinGrowth") \
+    .doc("Upper bound on the per-stage join output growth factor the "
+         "overflow retry may reach before the query fails (each retry "
+         "doubles the factor for the overflowing stage only).") \
+    .check(lambda v: None if v >= 1 else "must be >= 1").integer(64)
+
 URI_REWRITE_RULES = conf("srt.io.uriRewrite") \
     .doc("Ordered 'FROM->TO;FROM2->TO2' prefix rewrite rules applied to "
          "scan paths before file resolution — mount-style remote-store "

@@ -498,6 +498,33 @@ class ShuffleExchangeExec(TpuExec):
                          Metric("shuffleBytesBypassed",
                                 Metric.ESSENTIAL, "B")).add(bypassed)
 
+    def record_mesh_exchange(self, ctx: ExecContext, nbytes: int,
+                             resident: bool) -> None:
+        """Mesh-lane byte accounting for this exchange's stage boundary.
+
+        On the SPMD stage path nothing is serialized: the child stage's
+        output is handed to the consumer program device-resident, so
+        every boundary byte lands in ``shuffleBytesBypassed`` (it
+        bypassed the serialized shuffle write path this class's
+        ``_write`` implements — ``shuffleBytesWritten`` stays 0 on mesh
+        runs, which is exactly the "device-resident stages dominate"
+        signal the bench gate checks). Bytes that additionally rode an
+        in-program collective (a true repartition: non-resident hash /
+        range / round-robin all_to_all, single-partition all_gather)
+        are ALSO counted as ``shuffleBytesWire`` — ICI traffic, not a
+        write. A resident exchange contributes bypassed bytes only.
+        """
+        if nbytes <= 0:
+            return
+        m = ctx.metrics_for(self.exec_id)
+        m.setdefault("shuffleBytesBypassed",
+                     Metric("shuffleBytesBypassed",
+                            Metric.ESSENTIAL, "B")).add(nbytes)
+        if not resident:
+            m.setdefault("shuffleBytesWire",
+                         Metric("shuffleBytesWire",
+                                Metric.ESSENTIAL, "B")).add(nbytes)
+
     def _run_map_loop(self, ctx: ExecContext, mgr, n_parts: int,
                       map_id: int, child: TpuExec,
                       push_route: Optional[dict] = None,

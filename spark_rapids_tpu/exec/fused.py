@@ -310,6 +310,14 @@ class FusedPipelineExec(TpuExec):
     def output_partitioning(self):
         return self.stages[-1].output_partitioning
 
+    def mesh_chain_root(self) -> TpuExec:
+        """The unfused terminal of the wrapped chain. The mesh stage
+        executor traces THROUGH fusion wrappers — a stage program is
+        already one XLA computation, so the single-box fusion adds
+        nothing there; the stage nodes keep their original child links,
+        and lowering from the terminal recovers the whole chain."""
+        return self.stages[-1]
+
     def node_description(self) -> str:
         inner = " -> ".join(type(s).__name__ for s in self.stages)
         tags = []
@@ -520,6 +528,13 @@ class FusedHashJoinExec(TpuExec):
     @property
     def output_partitioning(self):
         return self.suffix[-1].output_partitioning
+
+    def mesh_chain_root(self) -> TpuExec:
+        """Unfused terminal of the join + suffix chain (see
+        FusedPipelineExec.mesh_chain_root): the suffix nodes keep their
+        child links down to the wrapped join, so lowering the terminal
+        suffix stage recovers join and suffix inside one stage trace."""
+        return self.suffix[-1]
 
     def node_description(self) -> str:
         tags = []

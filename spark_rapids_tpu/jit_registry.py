@@ -397,6 +397,39 @@ def shared_fn_jit(builder: Callable, *key_args, **jit_kwargs) -> Callable:
     return fn
 
 
+def shared_stage_jit(build: Callable[[], Callable], key_parts,
+                     module: str, label: str, **jit_kwargs) -> Callable:
+    """Shared jit for a mesh STAGE program (plan/mesh_executor.py).
+
+    Stage programs are built from closures over live plan nodes, so the
+    ``shared_fn_jit`` contract (module-level builder, args-only key)
+    cannot apply; instead the CALLER passes ``key_parts`` — the stage's
+    structural signature (operator classes, expression reprs, schemas,
+    mesh identity, growth factor, donation layout). Two plans whose
+    stages match structurally share ONE jitted wrapper and ONE
+    compile-ledger entry per stage shape — not per device, not per
+    query — and jit's own aval cache handles row-capacity variation
+    beneath that. Unencodable key parts fall back to a private jit
+    (unshared, never wrong). ``build`` is only invoked on a miss.
+    """
+    enc = _encode(list(key_parts)) if _ENABLED else None
+    if enc is None:
+        _count(module, "uncached")
+        return jax.jit(build(), **jit_kwargs)
+    key = (module, "stage_program", enc,
+           tuple(sorted(jit_kwargs.items())) if jit_kwargs else ())
+    with _LOCK:
+        fn = _REGISTRY.get(key)
+        if fn is not None:
+            _count(module, "hits")
+            return fn
+        fn = _wrap_program(jax.jit(build(), **jit_kwargs), key, module,
+                           label)
+        _put(key, fn)
+        _count(module, "misses")
+    return fn
+
+
 def stats(module: Optional[str] = None) -> dict:
     """Registry counters; with ``module``, only the hits/misses/
     uncached charged to wrappers defined in that module (plus the

@@ -14,7 +14,7 @@ from typing import Optional, Sequence
 
 import jax
 import numpy as np
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
 
@@ -35,3 +35,36 @@ def data_mesh(n_devices: Optional[int] = None,
 def local_mesh() -> Mesh:
     """Mesh over every visible device."""
     return data_mesh()
+
+
+def data_sharding(mesh: Mesh, axis: str = DATA_AXIS,
+                  rank: int = 1) -> NamedSharding:
+    """Sharding that splits a stacked tree's leading shard dim over
+    ``axis`` and replicates trailing dims (rank-1 padding)."""
+    return NamedSharding(mesh, P(axis, *((None,) * max(rank - 1, 0))))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Full copy on every mesh device — broadcast build sides."""
+    return NamedSharding(mesh, P())
+
+
+def mesh_key(mesh: Mesh) -> tuple:
+    """Hashable identity of a mesh (device set + axis layout) for
+    structural program-sharing keys: two ``data_mesh(8)`` calls build
+    distinct Mesh objects over the same devices and must share
+    compiled stage programs."""
+    return (tuple(mesh.axis_names),
+            tuple(int(s) for s in mesh.devices.shape),
+            tuple(str(d) for d in mesh.devices.flat))
+
+
+def tree_nbytes(tree) -> int:
+    """Total concrete bytes across a pytree's array leaves (stage-
+    boundary shuffle accounting; 0 for abstract/traced leaves)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        nb = getattr(leaf, "nbytes", None)
+        if isinstance(nb, (int, np.integer)):
+            total += int(nb)
+    return total

@@ -359,6 +359,19 @@ def execution_order(stages: List[QueryStage]) -> List[QueryStage]:
     return sorted(stages, key=lambda s: (-s.depth, rank[s.role], s.order))
 
 
+def stage_dag(root) -> Tuple[List[QueryStage], set]:
+    """Execution-ordered stage list plus the registered exchange-id
+    set — the stage-cut contract shared by the adaptive driver above
+    and the mesh stage executor (plan/mesh_executor.py), which compiles
+    one SPMD program per entry (body = the exchange's child subtree,
+    cut at any registered exchange) plus one for the plan remainder
+    above the shallowest exchanges. Sharing the cut keeps the two
+    schedulers agreeing on what "a stage" is, so AQE statistics and
+    mesh programs describe the same units."""
+    stages = execution_order(collect_stages(root))
+    return stages, {id(s.exchange) for s in stages}
+
+
 class AdaptiveExecutor:
     """Eager stage-ordered driver: materialize each stage, re-plan the
     remainder from its measured sizes, then pull the root. Decisions

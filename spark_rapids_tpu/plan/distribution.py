@@ -178,3 +178,47 @@ class BroadcastPartitioning(Partitioning):
 
     def __repr__(self):
         return "BroadcastPartitioning"
+
+
+# --- mesh placement equivalence --------------------------------------------
+
+def mesh_placement_satisfied(child: Partitioning, exchange) -> bool:
+    """True when ``exchange``'s mesh collective is provably the identity
+    permutation for rows already placed by ``child`` — the planner
+    predicate behind the device-resident exchange bypass
+    (``MeshColocationBypass`` generalized).
+
+    Mesh placement ignores plan-level ``num_partitions``: every lowered
+    exchange routes with the SAME function of the mesh size (hash:
+    ``pmod(murmur3(exprs), n_shards)``; range: quantile bounds of the
+    same sort orders; single: everything on shard 0), so equivalence is
+    purely structural on the exchange's target:
+
+    * hash target — child is ``HashPartitioning`` on the identical expr
+      sequence (subset is NOT enough here: a different expr list hashes
+      rows to different shards even when clustering would be satisfied);
+    * range target — child is ``RangePartitioning`` on a sort-order
+      prefix at least as long as the target's (shards already globally
+      ordered by those orders, which is all downstream sorts consume);
+    * single-partition target — child is ``SinglePartition`` (rows are
+      already concentrated on one shard).
+    """
+    keys = list(getattr(exchange, "key_exprs", None) or [])
+    orders = list(getattr(exchange, "sort_orders", None) or [])
+    if orders:
+        if not isinstance(child, RangePartitioning) \
+                or len(child.sort_orders) < len(orders):
+            return False
+        return all(
+            _expr_key(w.expr) == _expr_key(h.expr)
+            and w.ascending == h.ascending
+            and w.nulls_first == h.nulls_first
+            for w, h in zip(orders, child.sort_orders))
+    if keys:
+        if not isinstance(child, HashPartitioning):
+            return False
+        return ([_expr_key(e) for e in child.exprs]
+                == [_expr_key(e) for e in keys])
+    if (getattr(exchange, "num_partitions", None) or 1) == 1:
+        return isinstance(child, SinglePartition)
+    return False  # round-robin rebalance: always a true repartition

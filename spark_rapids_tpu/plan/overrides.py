@@ -1413,6 +1413,49 @@ def _count_exchange_consumers(root) -> None:
         x._planned_consumers = counts[k]
 
 
+def mesh_resident_exchanges(root, conf: Optional[SrtConf] = None) -> set:
+    """Planner residency rule for the mesh lane: the set of
+    ``ShuffleExchangeExec`` ids (``id(node)``) whose collective is the
+    identity on the mesh, because the child's advertised partitioning
+    already satisfies the exchange's target placement
+    (distribution.mesh_placement_satisfied). The mesh stage executor
+    lowers these as device-resident hand-throughs pinned with
+    ``with_sharding_constraint`` — whole stage DAGs stay on device
+    until a true repartition forces an in-program ``all_to_all``.
+
+    This is the generalization of the old ``_hash_colocated`` special
+    case (hash-over-hash only) to range-over-range and
+    single-over-single, promoted from the lowering into the planner so
+    the decision is visible (MeshResidencyPlanned event) before any
+    program compiles. Gated by ``srt.mesh.residency.enabled`` and the
+    push-shuffle locality confs the single-box bypass honors — the
+    placement contract is the same one.
+    """
+    from ..conf import (MESH_RESIDENCY, SHUFFLE_PUSH_ENABLED,
+                        SHUFFLE_PUSH_LOCAL_BYPASS, active_conf)
+    from ..exec.exchange import ShuffleExchangeExec
+    from ..obs import events as _events
+    from .distribution import mesh_placement_satisfied
+    conf = conf or active_conf()
+    if not (conf.get(MESH_RESIDENCY) and conf.get(SHUFFLE_PUSH_ENABLED)
+            and conf.get(SHUFFLE_PUSH_LOCAL_BYPASS)):
+        return set()
+    resident: set = set()
+
+    def walk(n) -> None:
+        if isinstance(n, ShuffleExchangeExec) and id(n) not in resident:
+            child = n.children[0]
+            if mesh_placement_satisfied(child.output_partitioning, n):
+                resident.add(id(n))
+        for c in getattr(n, "children", []):
+            walk(c)
+
+    walk(root)
+    if resident:
+        _events.emit("MeshResidencyPlanned", count=len(resident))
+    return resident
+
+
 def tag_only(plan: LogicalPlan,
              conf: Optional[SrtConf] = None) -> PlanMeta:
     """Tagging pass without conversion (explain-only mode — the

@@ -1,0 +1,149 @@
+"""Declarative plan-property -> PartitionSpec rules for the mesh lane.
+
+The SPMD stage executor (plan/mesh_executor.py) feeds every stage
+program a tuple of concrete inputs: host-materialized leaf stacks and
+device-resident outputs of earlier stages. Each input needs a
+``PartitionSpec`` twice — once as the ``NamedSharding`` it is placed
+with (``jax.device_put`` / ``with_sharding_constraint``) and once as
+the ``shard_map`` in_spec that splits it across the mesh. Instead of
+hard-coding that mapping per operator, this module matches each
+input's *rule path* (the ``/``-joined class names from the stage root
+down to the input node, e.g.
+``HashAggregateExec/ShuffleExchangeExec``) against an ordered regex
+rule table, first match wins — the same shape as the flax-ecosystem
+``match_partition_rules`` helpers that map parameter path regexes to
+PartitionSpecs for pjit.
+
+Default table:
+
+* anything under a ``BroadcastExchangeExec`` is **replicated**
+  (``P()``): the broadcast build side is placed whole on every device,
+  so the in-program ``all_gather`` disappears;
+* everything else rides the data axis (``P(axis)``): stacked per-shard
+  batches with the leading shard dim split across the mesh.
+
+``srt.mesh.partitionRules`` prepends user rules
+(``"regex=data;regex=replicated"``) — an escape hatch to pin a
+misbehaving input without editing planner code.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Sequence, Tuple
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.mesh import DATA_AXIS
+
+#: rule table entry: (compiled regex, PartitionSpec)
+Rule = Tuple["re.Pattern", P]
+
+
+def default_rules(axis: str = DATA_AXIS) -> List[Rule]:
+    """The built-in table. Order matters: first match wins."""
+    return [
+        (re.compile(r".*BroadcastExchangeExec(/.*)?$"), P()),
+        (re.compile(r".*"), P(axis)),
+    ]
+
+
+def parse_rules(text: str, axis: str = DATA_AXIS) -> List[Rule]:
+    """Parse ``srt.mesh.partitionRules``: ``;``-separated
+    ``regex=data|replicated`` clauses, prepended to the defaults.
+    Malformed clauses raise ValueError at plan time (never mid-trace).
+    """
+    rules: List[Rule] = []
+    for clause in (text or "").split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        if "=" not in clause:
+            raise ValueError(
+                f"partition rule needs regex=placement — {clause!r}")
+        pat, _, placement = clause.rpartition("=")
+        placement = placement.strip().lower()
+        if placement in ("data", "sharded", axis):
+            spec = P(axis)
+        elif placement in ("replicated", "replicate", "full"):
+            spec = P()
+        else:
+            raise ValueError(
+                f"unknown placement {placement!r} in {clause!r} "
+                f"(want data|replicated)")
+        rules.append((re.compile(pat.strip()), spec))
+    return rules + default_rules(axis)
+
+
+def match_partition_rules(rules: Sequence[Rule], path: str) -> P:
+    """First-match-wins lookup of ``path`` in the rule table."""
+    for pat, spec in rules:
+        if pat.search(path):
+            return spec
+    return P(DATA_AXIS)
+
+
+def rule_path(parent_path: str, node) -> str:
+    """Extend a rule path by one plan node (class name)."""
+    name = type(node).__name__
+    return f"{parent_path}/{name}" if parent_path else name
+
+
+def is_replicated(spec: P) -> bool:
+    """True when the spec shards over no axis (full copy per device)."""
+    return not any(ax is not None for ax in tuple(spec))
+
+
+def sharding_for(mesh: Mesh, spec: P) -> NamedSharding:
+    """NamedSharding placing a stacked (or replicated) input tree.
+
+    The leading dim of a stacked tree is the shard dim; trailing dims
+    are always replicated, so a rank-polymorphic leaf sharding must be
+    minted per leaf — callers go through :func:`put_tree`.
+    """
+    return NamedSharding(mesh, spec)
+
+
+def put_tree(tree, mesh: Mesh, spec: P):
+    """``device_put`` every leaf of ``tree`` with ``spec`` padded to
+    the leaf's rank (leading shard dim split, trailing dims
+    replicated). Replicated specs place the full tree per device."""
+    import jax
+
+    def _put(x):
+        if is_replicated(spec):
+            s = NamedSharding(mesh, P())
+        else:
+            pad = (None,) * max(getattr(x, "ndim", 1) - len(tuple(spec)),
+                                0)
+            s = NamedSharding(mesh, P(*tuple(spec), *pad))
+        return jax.device_put(x, s)
+    return jax.tree_util.tree_map(_put, tree)
+
+
+def constrain_tree(tree, mesh: Mesh, spec: P):
+    """``with_sharding_constraint`` analogue of :func:`put_tree`, used
+    INSIDE the stage program's jit (outside its shard_map): pins each
+    stage input to the sharding the partition rule assigned, so a
+    stage output handed device-resident to its consumer is consumed
+    in place and anything else is resharded by XLA instead of raising.
+    Outside a trace (eager debugging) it degrades to device_put."""
+    import jax
+
+    def _pin(x):
+        if is_replicated(spec):
+            s = NamedSharding(mesh, P())
+        else:
+            pad = (None,) * max(getattr(x, "ndim", 1) - len(tuple(spec)),
+                                0)
+            s = NamedSharding(mesh, P(*tuple(spec), *pad))
+        try:
+            return jax.lax.with_sharding_constraint(x, s)
+        except Exception:
+            return jax.device_put(x, s)
+    return jax.tree_util.tree_map(_pin, tree)
+
+
+def spec_signature(spec: P) -> Tuple:
+    """Hashable form of a spec for structural program keys."""
+    return tuple("*" if ax is None else ax for ax in tuple(spec))

@@ -1537,6 +1537,143 @@ def _streaming_ingest_check() -> int:
     return failures
 
 
+def _mesh_child() -> int:
+    """Child body of the SPMD-mesh leg (separate process: the
+    8-virtual-device XLA flag must be set before jax initializes, and
+    the parent's jax is live by the time legs run).
+
+    1. differential: the same join+agg+sort plan through the
+       stage-per-program mesh executor and through single-stream
+       execution must produce identical rows;
+    2. seeded fault at the stage-execution boundary
+       (``mesh.stage.run:reset@1``) — ``run_on_mesh_or_fallback``
+       must degrade CLEANLY to serialized execution and still return
+       the oracle rows, never a partial or wrong answer;
+    3. with the plan disarmed the very next run must come back on the
+       mesh path (the fallback is per-query, not sticky).
+
+    Returns failure count (process exit code)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = \
+            (flags + " --xla_force_host_platform_device_count=8").strip()
+    import numpy as np
+
+    from spark_rapids_tpu import parallel as par
+    from spark_rapids_tpu.columnar.vector import batch_to_pydict
+    from spark_rapids_tpu.conf import SrtConf
+    from spark_rapids_tpu.expr import col
+    from spark_rapids_tpu.expr.aggregates import CountStar, Sum
+    from spark_rapids_tpu.expr.core import Alias
+    from spark_rapids_tpu.plan import TpuSession, overrides
+    from spark_rapids_tpu.plan.host_table import to_pydict
+    from spark_rapids_tpu.plan.mesh_executor import (
+        run_on_mesh, run_on_mesh_or_fallback)
+    from spark_rapids_tpu.robustness import faults
+
+    conf = SrtConf({"srt.shuffle.partitions": 8})
+    sess = TpuSession(conf)
+    mesh = par.data_mesh(8)
+    rng = np.random.default_rng(31)
+    n = 4000
+    fact = sess.create_dataframe({
+        "k": rng.integers(0, 40, n).tolist(),
+        "v": rng.uniform(0, 10, n).tolist()})
+    dim = sess.create_dataframe({
+        "k": list(range(40)),
+        "w": [float(1 + i % 3) for i in range(40)]})
+    df = fact.filter(col("v") < 8.0).join(dim, on="k") \
+        .group_by("k").agg(Alias(Sum(col("v") * col("w")), "s"),
+                           Alias(CountStar(), "c")).sort("k")
+
+    def _rows_of_batches(batches):
+        out = []
+        for b in batches:
+            d = batch_to_pydict(b)
+            ks = list(d)
+            for i in range(len(d[ks[0]]) if ks else 0):
+                out.append(tuple(d[k][i] for k in ks))
+        return out
+
+    single = to_pydict(sess.execute(df.plan))
+    ks = list(single)
+    oracle = [tuple(single[k][i] for k in ks)
+              for i in range(len(single[ks[0]]) if ks else 0)]
+
+    def _canon(rows):
+        return sorted(tuple(round(v, 6) if isinstance(v, float) else v
+                            for v in r) for r in rows)
+
+    failures = 0
+    # 1. mesh-on vs mesh-off identity
+    mesh_rows = _rows_of_batches(run_on_mesh(
+        overrides.apply_overrides(df.plan, conf), mesh, conf))
+    if _canon(mesh_rows) != _canon(oracle):
+        print(f"[chaos] FAIL [mesh identity]: mesh={len(mesh_rows)} "
+              f"rows != single={len(oracle)} rows (or values differ)",
+              file=sys.stderr, flush=True)
+        failures += 1
+    else:
+        print(f"[chaos] PASS [mesh identity] {len(mesh_rows)} rows "
+              f"bit-identical mesh vs single-stream", flush=True)
+    # 2. seeded fault inside stage execution -> clean degradation
+    faults.arm_fault_plan("seed=7|mesh.stage.run:reset@1")
+    try:
+        batches, mode = run_on_mesh_or_fallback(
+            overrides.apply_overrides(df.plan, conf), mesh, conf)
+    finally:
+        faults.disarm_fault_plan()
+    rows = _rows_of_batches(batches)
+    if mode != "serialized" or _canon(rows) != _canon(oracle):
+        print(f"[chaos] FAIL [mesh fault degradation]: mode={mode} "
+              f"rows={len(rows)} (want serialized + oracle rows)",
+              file=sys.stderr, flush=True)
+        failures += 1
+    else:
+        print("[chaos] PASS [mesh fault degradation] stage fault "
+              "-> serialized fallback, rows intact", flush=True)
+    # 3. fallback is per-query: next run returns to the mesh path
+    batches, mode = run_on_mesh_or_fallback(
+        overrides.apply_overrides(df.plan, conf), mesh, conf)
+    rows = _rows_of_batches(batches)
+    if mode != "mesh" or _canon(rows) != _canon(oracle):
+        print(f"[chaos] FAIL [mesh recovery]: mode={mode} after "
+              f"disarm (want mesh)", file=sys.stderr, flush=True)
+        failures += 1
+    else:
+        print("[chaos] PASS [mesh recovery] disarmed run back on "
+              "the mesh path", flush=True)
+    return failures
+
+
+def _mesh_check() -> int:
+    """SPMD-mesh leg: run ``_mesh_child`` in a subprocess (the
+    virtual-device-count XLA flag cannot be applied to this process's
+    already-initialized jax) and fold its verdict in. Returns failure
+    count."""
+    import subprocess
+    t0 = time.monotonic()
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--mesh-child"],
+            capture_output=True, timeout=300)
+    except subprocess.TimeoutExpired:
+        print("[chaos] FAIL [mesh leg]: child timed out (300s)",
+              file=sys.stderr, flush=True)
+        return 1
+    sys.stdout.write(p.stdout.decode("utf-8", "replace"))
+    sys.stdout.flush()
+    if p.returncode != 0:
+        print(f"[chaos] FAIL [mesh leg]: child rc={p.returncode}: "
+              f"{p.stderr.decode('utf-8', 'replace')[-300:]}",
+              file=sys.stderr, flush=True)
+        return 1
+    print(f"[chaos] PASS [mesh leg] {time.monotonic() - t0:.1f}s",
+          flush=True)
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--quick", action="store_true",
@@ -1544,7 +1681,11 @@ def main() -> int:
     ap.add_argument("--workers", type=int, default=None)
     ap.add_argument("--budget", type=float, default=None,
                     help="wall-clock budget in seconds (hard exit 2)")
+    ap.add_argument("--mesh-child", action="store_true",
+                    help=argparse.SUPPRESS)
     args = ap.parse_args()
+    if args.mesh_child:
+        return _mesh_child()
     n_workers = args.workers or (2 if args.quick else 3)
     budget = args.budget or (360.0 if args.quick else 660.0)
 
@@ -1742,6 +1883,9 @@ def main() -> int:
     # push-shuffle leg: eager push / segments / locality under faults
     failures += _push_shuffle_check()
     failures += _membership_check()
+    # SPMD-mesh leg: mesh-vs-single identity + seeded stage fault ->
+    # clean serialized degradation (subprocess, 8 virtual devices)
+    failures += _mesh_check()
     # exactly-once streaming-ingest leg: SIGKILL the ingester child at
     # seeded commit-protocol fault points, resume, assert exactly-once
     failures += _streaming_ingest_check()

@@ -25,9 +25,16 @@ mean nothing across scales.
 Exit codes: 0 = pass (or report-only / incomparable), 1 = regression,
 2 = usage/IO error.
 
+Box-drift hardening: ``--extra-sample PATH`` (repeatable) supplies
+rerun measurements of the same candidate workload; any key a sample
+re-measured gates on the MEDIAN across all measurements, so a single
+noisy-box outlier neither fails nor exonerates a lane (bench.py feeds
+this path automatically by rerunning regressed lanes up to 2x).
+
 Usage:
     python tools/perf_gate.py BASELINE.json NEW.json
         [--tolerance 0.15] [--compile-tolerance 0.25] [--report-only]
+        [--extra-sample RERUN.json ...]
 
 Accepts both raw bench RESULT dicts and the committed BENCH_r*.json
 wrapper shape (``{"cmd", "parsed", ...}``).
@@ -42,10 +49,13 @@ from typing import Any, Dict, List, Optional, Tuple
 #: timing comparisons meaningless (different scale / backend)
 _SHAPE_KEYS = ("backend", "rows", "nds_scale_rows")
 
-#: rate-key suffixes (higher is better)
+#: rate-key suffixes (higher is better). ``_bytes_bypassed`` counts
+#: stage-boundary/shuffle bytes that never touched the serialized
+#: write path (mesh device-residency, local zero-copy) — shrinking it
+#: means work fell back to serialization, a regression.
 _RATE_SUFFIXES = ("_gb_s", "_gbs", "_rows_s", "_mrows_s", "_per_s",
                   "_vs_baseline", "_speedup", "_rate",
-                  "_qps_sustained")
+                  "_qps_sustained", "_bytes_bypassed")
 _RATE_KEYS = ("value",)
 
 #: keys that end in _s but are not durations
@@ -99,21 +109,38 @@ def _compile_totals(d: Dict[str, Any]) -> Optional[float]:
 
 def compare(base: Dict[str, Any], new: Dict[str, Any],
             tolerance: float = 0.15,
-            compile_tolerance: float = 0.25) -> Dict[str, Any]:
+            compile_tolerance: float = 0.25,
+            samples: Optional[List[Dict[str, Any]]] = None
+            ) -> Dict[str, Any]:
     """Pure comparison (bench.py calls this with in-memory dicts).
 
     Returns {"comparable", "shape_mismatch", "checks", "regressions",
-    "skipped"}; each check is (key, kind, base, new, ratio, ok).
+    "skipped", "median_keys"}; each check is
+    (key, kind, base, new, ratio, ok).
+
+    ``samples`` is the box-drift hardening: extra candidate
+    measurements of the SAME workload (lane reruns). Any key a sample
+    re-measured is gated on the MEDIAN of {new} U {samples} instead of
+    the single first measurement, so one noisy-box outlier neither
+    fails nor exonerates a lane on its own; such keys are listed in
+    ``median_keys``.
     """
+    import statistics
     shape_mismatch = [
         (k, base.get(k), new.get(k)) for k in _SHAPE_KEYS
         if k in base and k in new and base.get(k) != new.get(k)]
     bk, nk = _numeric_keys(base), _numeric_keys(new)
+    sample_keys = [_numeric_keys(s) for s in (samples or [])]
     checks: List[Tuple] = []
     regressions: List[Tuple] = []
+    median_keys: List[str] = []
     skipped = sorted((set(bk) ^ set(nk)))
     for key in sorted(set(bk) & set(nk)):
         b, n = bk[key], nk[key]
+        vals = [n] + [s[key] for s in sample_keys if key in s]
+        if len(vals) > 1:
+            n = float(statistics.median(vals))
+            median_keys.append(key)
         if b <= 0:
             continue
         ratio = n / b
@@ -141,6 +168,7 @@ def compare(base: Dict[str, Any], new: Dict[str, Any],
         "checks": checks,
         "regressions": regressions if not shape_mismatch else [],
         "skipped": skipped,
+        "median_keys": median_keys,
     }
 
 
@@ -162,6 +190,10 @@ def render(result: Dict[str, Any], base_name: str = "base",
         w(f"  skipped (missing in one side): "
           f"{', '.join(result['skipped'][:12])}"
           + (" ..." if len(result["skipped"]) > 12 else ""))
+    if result.get("median_keys"):
+        w(f"  median-of-samples gated: "
+          f"{', '.join(result['median_keys'][:12])}"
+          + (" ..." if len(result["median_keys"]) > 12 else ""))
     regs = result["regressions"]
     w(f"  => {len(regs)} regression(s)"
       + ("" if regs else " — PASS"))
@@ -180,16 +212,24 @@ def main(argv=None) -> int:
                          "compile time (default 0.25)")
     ap.add_argument("--report-only", action="store_true",
                     help="always exit 0; print the comparison")
+    ap.add_argument("--extra-sample", action="append", default=[],
+                    metavar="PATH",
+                    help="additional candidate measurement(s) of the "
+                         "same workload (lane reruns); repeatable — "
+                         "keys present in any sample gate on the "
+                         "median across all measurements")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
     try:
         base = load_bench(args.baseline)
         new = load_bench(args.candidate)
+        samples = [load_bench(p) for p in args.extra_sample]
     except (OSError, ValueError, json.JSONDecodeError) as e:
         print(f"perf_gate: {e}", file=sys.stderr)
         return 2
     result = compare(base, new, tolerance=args.tolerance,
-                     compile_tolerance=args.compile_tolerance)
+                     compile_tolerance=args.compile_tolerance,
+                     samples=samples)
     if args.json:
         print(json.dumps(result, indent=2, default=str))
     else:
